@@ -1,0 +1,69 @@
+"""Pytest config: force a clean 8-device virtual-CPU JAX for every test run.
+
+Two things happen here, both before any JAX *backend* is initialized (the
+``jax`` module itself may already be imported by site hooks, but PJRT clients
+are created lazily):
+
+1. **Axon escape hatch.**  On the TPU-tunnel image, a sitecustomize hook
+   registers the ``axon`` PJRT plugin whenever ``PALLAS_AXON_POOL_IPS`` is
+   set; that plugin grabs the (single-holder) TPU tunnel at client-init time
+   and blocks while any other process holds it.  Tests must never touch the
+   real chip, so we force ``jax_platforms=cpu`` and drop the axon factory
+   before any backend comes up.
+2. **Virtual mesh.**  ``--xla_force_host_platform_device_count=8`` gives an
+   8-device CPU mesh — the "fake cluster" test story the reference lacks
+   (SURVEY.md §4: every reference test needs real GPUs under torchrun; ours
+   run anywhere).
+"""
+
+import os
+
+_N_DEVICES = int(os.environ.get("TDT_TEST_DEVICES", "8"))
+_FLAG = f"--xla_force_host_platform_device_count={_N_DEVICES}"
+
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+if not _xb._backends:
+    _xb._backend_factories.pop("axon", None)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+assert jax.devices()[0].platform == "cpu", jax.devices()
+
+
+@pytest.fixture(scope="session")
+def mesh8() -> Mesh:
+    assert jax.device_count() >= 8, jax.devices()
+    return Mesh(np.array(jax.devices()[:8]), ("tp",))
+
+
+@pytest.fixture(scope="session")
+def mesh4() -> Mesh:
+    return Mesh(np.array(jax.devices()[:4]), ("tp",))
+
+
+@pytest.fixture(scope="session")
+def mesh2() -> Mesh:
+    return Mesh(np.array(jax.devices()[:2]), ("tp",))
+
+
+@pytest.fixture(scope="session")
+def mesh2d() -> Mesh:
+    """2×4 mesh for hierarchical (dp × tp) tests."""
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "tp"))
+
+
+@pytest.fixture
+def key():
+    return jax.random.key(0)

@@ -1,0 +1,43 @@
+"""Base Pallas matmul correctness (interpret mode on CPU).
+
+Reference test analog: the GEMM inner loops are only tested via the
+overlapped-op tests (test_ag_gemm.py); we additionally test the base kernel
+standalone.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from triton_dist_tpu.kernels.gemm import MatmulConfig, matmul
+from triton_dist_tpu.runtime import assert_allclose, make_tensor
+
+
+@pytest.mark.parametrize(
+    "m,n,k",
+    [(128, 128, 128), (256, 512, 384), (64, 128, 256)],
+)
+def test_matmul_matches_xla(key, m, n, k):
+    ka, kb = jax.random.split(key)
+    a = make_tensor(ka, (m, k), jnp.float32)
+    b = make_tensor(kb, (k, n), jnp.float32)
+    got = matmul(a, b, interpret=True)
+    want = a @ b
+    assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+def test_matmul_bf16_accumulates_f32(key):
+    ka, kb = jax.random.split(key)
+    a = make_tensor(ka, (256, 256), jnp.bfloat16)
+    b = make_tensor(kb, (256, 256), jnp.bfloat16)
+    got = matmul(a, b, config=MatmulConfig(128, 128, 128), interpret=True)
+    want = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+    assert_allclose(got, want, atol=3e-2, rtol=3e-2)
+
+
+def test_matmul_k_not_multiple_of_block(key):
+    ka, kb = jax.random.split(key)
+    a = make_tensor(ka, (128, 200), jnp.float32)
+    b = make_tensor(kb, (200, 128), jnp.float32)
+    got = matmul(a, b, config=MatmulConfig(128, 128, 128), interpret=True)
+    assert_allclose(got, a @ b, atol=1e-4, rtol=1e-4)

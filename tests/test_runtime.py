@@ -1,0 +1,85 @@
+"""Runtime layer tests: bootstrap, mesh, symm mem, utils, topology.
+
+Reference test analog: the bootstrap parts of every test script
+(initialize_distributed) + utils self-checks.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_dist_tpu import runtime
+from triton_dist_tpu.runtime import symm_mem
+
+
+def test_initialize_distributed_default():
+    mesh = runtime.initialize_distributed()
+    assert mesh.shape["tp"] == jax.device_count()
+    assert runtime.get_mesh() is mesh
+
+
+def test_initialize_distributed_2d():
+    mesh = runtime.initialize_distributed({"dp": 2, "tp": 4})
+    assert mesh.shape == {"dp": 2, "tp": 4}
+    runtime.finalize_distributed()
+
+
+def test_make_tensor_dtypes(key):
+    for dtype in (jnp.float32, jnp.bfloat16, jnp.int8):
+        x = runtime.make_tensor(key, (16, 32), dtype)
+        assert x.shape == (16, 32) and x.dtype == dtype
+    # deterministic for fixed key
+    a = runtime.make_tensor(key, (8, 8), jnp.float32)
+    b = runtime.make_tensor(key, (8, 8), jnp.float32)
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_assert_allclose_reports_location():
+    x = jnp.zeros((4, 4))
+    y = x.at[1, 2].set(1.0)
+    with pytest.raises(AssertionError, match=r"\(1, 2\)"):
+        runtime.assert_allclose(x, y)
+    runtime.assert_allclose(x, x)
+
+
+def test_perf_func_returns_output_and_time():
+    f = jax.jit(lambda: jnp.ones((128, 128)) @ jnp.ones((128, 128)))
+    out, ms = runtime.perf_func(f, iters=3, warmup_iters=1)
+    assert ms > 0
+    assert out.shape == (128, 128)
+
+
+def test_create_symm_tensor(mesh4):
+    t = symm_mem.create_symm_tensor(mesh4, "tp", (8, 128), jnp.float32)
+    assert t.shape == (32, 128)
+    # per-device shard is (8, 128)
+    shard_shapes = {s.data.shape for s in t.addressable_shards}
+    assert shard_shapes == {(8, 128)}
+
+
+def test_symmetric_workspace_caches(mesh4):
+    ws = symm_mem.SymmetricWorkspace(mesh4, "tp")
+    a = ws.get("buf", (8, 128), jnp.float32)
+    b = ws.get("buf", (8, 128), jnp.float32)
+    assert a is b
+    c = ws.get("buf", (16, 128), jnp.float32)
+    assert c is not a
+
+
+def test_topology_detects_cpu_or_tpu():
+    topo = runtime.detect_topology()
+    assert topo.n_devices == jax.device_count()
+    assert topo.bf16_tflops > 0 and topo.hbm_gbps > 0
+
+
+def test_rank_num_ranks_inside_shard_map(mesh4):
+    from jax.sharding import PartitionSpec as P
+
+    def f(x):
+        return x + runtime.rank("tp") - runtime.rank("tp") + runtime.num_ranks("tp")
+
+    y = jax.jit(
+        jax.shard_map(f, mesh=mesh4, in_specs=P("tp"), out_specs=P("tp"))
+    )(jnp.zeros((4,)))
+    assert np.all(np.asarray(y) == 4)

@@ -1,0 +1,32 @@
+"""Distributed kernel library (TPU-native).
+
+Reference analog: ``python/triton_dist/kernels/nvidia/__init__.py:25-38``
+which exports ``ag_gemm``, ``gemm_rs``, ``moe_reduce_rs``, ``ag_group_gemm``,
+``fast_allgather``, ``fast_all_to_all``, ``gqa_fwd_batch_decode*`` and their
+``create_*_context`` factories.
+
+Every collective op here accepts ``impl="auto"|"xla"|"pallas"``:
+
+* ``xla`` — lax collectives under shard_map; XLA's latency-hiding scheduler
+  overlaps them with compute.  Runs everywhere (CPU test meshes included) and
+  is the performance baseline the pallas path must beat.
+* ``pallas`` — hand-scheduled Mosaic kernels: remote DMA + semaphores, with
+  communication pipelined against MXU compute inside one kernel.
+* ``auto`` — pallas on TPU when shapes qualify, else xla.
+"""
+
+from triton_dist_tpu.kernels.gemm import matmul, matmul_kernel_tflops  # noqa: F401
+from triton_dist_tpu.kernels.allgather import (  # noqa: F401
+    all_gather,
+    create_allgather_context,
+    AllGatherMethod,
+)
+from triton_dist_tpu.kernels.reduce_scatter import (  # noqa: F401
+    reduce_scatter,
+    create_reduce_scatter_context,
+)
+from triton_dist_tpu.kernels.common_ops import barrier_all_on_mesh  # noqa: F401
+
+# Overlapped / model-level kernels land as the build progresses:
+# allgather_gemm, gemm_reduce_scatter, low_latency_allgather, all_to_all,
+# flash_decode, moe_reduce_rs, allgather_group_gemm (see SURVEY.md §7).

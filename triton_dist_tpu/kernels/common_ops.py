@@ -1,0 +1,62 @@
+"""Device-side synchronization library.
+
+Reference analog: ``python/triton_dist/kernels/nvidia/common_ops.py`` —
+``barrier_on_this_grid`` (:61-84), ``barrier_all_intra_node_atomic_cas_block``
+(:87-101), ``BarrierAllContext`` (:163-193), host ``wait_eq``/``set_signal``
+via cuStreamWriteValue (:196-229).
+
+TPU-native notes:
+
+* There is no cooperative-grid barrier to build: a Pallas grid on TPU is a
+  sequential loop on the core (megacore partitioning aside), so
+  ``barrier_on_this_grid`` has no analog — cross-"block" ordering is free.
+* Host-side stream signals (``cuStreamWriteValue``) have no analog because
+  there are no user streams; ordering between kernels is XLA data flow.
+* What remains is the cross-device barrier, exposed both as an in-kernel
+  primitive (``language.barrier_all``) and as a host-level op here.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.language import primitives as dl
+from triton_dist_tpu.language.interpret import maybe_interpret
+from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
+
+
+def _barrier_kernel(x_ref, o_ref, *, axis):
+    dl.barrier_all(axis)
+    o_ref[0] = x_ref[0]
+
+
+def _barrier_shard(x, *, axis, interpret):
+    return pl.pallas_call(
+        functools.partial(_barrier_kernel, axis=axis),
+        out_shape=jax.ShapeDtypeStruct((1,), jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=0
+        ),
+        interpret=maybe_interpret(interpret),
+    )(x)
+
+
+def barrier_all_on_mesh(mesh: Mesh, axis: str = "tp", interpret: bool = False):
+    """Host-level barrier over ``axis`` (reference: barrier_all_on_stream).
+
+    Returns a tiny array; blocking on it (``jax.block_until_ready``) means
+    every device reached the barrier kernel.
+    """
+    x = jnp.zeros((mesh.shape[axis],), jnp.int32)
+    fn = cached_shard_jit(
+        _barrier_shard, mesh, P(axis), P(axis), axis=axis, interpret=interpret
+    )
+    return fn(x)

@@ -1,0 +1,124 @@
+"""Base Pallas TPU matmul — the MXU workhorse under every overlapped kernel.
+
+Reference analog: the persistent TMA GEMM inner loops of
+``allgather_gemm.py:133-254`` / ``gemm_reduce_scatter.py:125-188`` (Triton
+``tl.dot`` over K with TMA descriptor loads).
+
+TPU-native design: Pallas ``pallas_call`` with a (m, n, k) grid; the Mosaic
+pipeline plays the role of both the TMA prefetch and the software pipeliner
+(no hand-written double buffering needed for HBM→VMEM streaming).  A float32
+VMEM accumulator carries partial sums across the K grid dimension
+(TPU grids are sequential-by-default, minormost-last — the k axis revisits
+the same output block, which is exactly the reference's K-loop).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+@dataclass(frozen=True)
+class MatmulConfig:
+    block_m: int = 512
+    block_n: int = 512
+    block_k: int = 512
+
+    def for_shape(self, m: int, n: int, k: int) -> "MatmulConfig":
+        """Clamp blocks to the problem (keeps small/test shapes legal)."""
+        return MatmulConfig(
+            block_m=min(self.block_m, max(_round_up(m, 8), 8)),
+            block_n=min(self.block_n, max(_round_up(n, 128), 128)),
+            block_k=min(self.block_k, max(_round_up(k, 128), 128)),
+        )
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _matmul_kernel(a_ref, b_ref, out_ref, acc_ref, *, n_k: int, k_rem: int, out_dtype):
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    a = a_ref[:]
+    if k_rem:
+        # K not divisible by block_k: the last K block reads past the array
+        # end and Pallas pads with unspecified values, which — unlike M/N
+        # padding — would be folded into every output element.  Mask the
+        # tail columns to zero on the final block.
+        @pl.when(k == n_k - 1)
+        def _():
+            col = jax.lax.broadcasted_iota(jnp.int32, a_ref.shape, 1)
+            row = jax.lax.broadcasted_iota(jnp.int32, b_ref.shape, 0)
+            acc_ref[:] += jnp.dot(
+                jnp.where(col < k_rem, a_ref[:], 0).astype(a_ref.dtype),
+                jnp.where(row < k_rem, b_ref[:], 0).astype(b_ref.dtype),
+                preferred_element_type=jnp.float32,
+            )
+
+        @pl.when(k < n_k - 1)
+        def _():
+            acc_ref[:] += jnp.dot(a, b_ref[:], preferred_element_type=jnp.float32)
+    else:
+        acc_ref[:] += jnp.dot(a, b_ref[:], preferred_element_type=jnp.float32)
+
+    @pl.when(k == n_k - 1)
+    def _():
+        out_ref[:] = acc_ref[:].astype(out_dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config", "out_dtype", "interpret")
+)
+def matmul(
+    a: jax.Array,
+    b: jax.Array,
+    config: MatmulConfig | None = None,
+    out_dtype=None,
+    interpret: bool = False,
+) -> jax.Array:
+    """C[m, n] = A[m, k] @ B[k, n] on the MXU with f32 accumulation."""
+    m, k = a.shape
+    k2, n = b.shape
+    assert k == k2, f"contraction mismatch {a.shape} @ {b.shape}"
+    out_dtype = out_dtype or a.dtype
+    cfg = (config or MatmulConfig()).for_shape(m, n, k)
+    bm, bn, bk = cfg.block_m, cfg.block_n, cfg.block_k
+    n_k = pl.cdiv(k, bk)
+
+    grid = (pl.cdiv(m, bm), pl.cdiv(n, bn), n_k)
+    return pl.pallas_call(
+        functools.partial(
+            _matmul_kernel, n_k=n_k, k_rem=k % bk, out_dtype=out_dtype
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (bm, bn), lambda i, j, kk: (i, j), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * n * k,
+            bytes_accessed=(m * k + k * n) * a.dtype.itemsize + m * n * jnp.dtype(out_dtype).itemsize,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(a, b)
+
+
+def matmul_kernel_tflops(m: int, n: int, k: int, ms: float) -> float:
+    """Achieved TFLOPS for a (m, n, k) matmul that took ``ms`` milliseconds."""
+    return 2.0 * m * n * k / (ms * 1e-3) / 1e12

@@ -1,0 +1,81 @@
+"""The distributed primitive toolkit usable inside Pallas TPU kernels.
+
+This module is the TPU-native equivalent of three reference layers at once:
+
+1. the MLIR ``distributed`` dialect's 7 ops
+   (/root/reference/dialect/include/Dialect/Distributed/IR/DistributedOps.td:45-189),
+2. the Python frontend ``triton_dist.language``
+   (/root/reference/python/triton_dist/language.py),
+3. the NVSHMEM device API façade ``libshmem_device``
+   (/root/reference/patches/triton/third_party/nvidia/language/cuda/libnvshmem_device.py).
+
+On TPU there is no separate compiler patch: Mosaic already exposes device
+semaphores and one-sided remote DMA as first-class kernel primitives, so the
+whole dialect + lowering + bitcode-linking stack collapses into this thin
+Python layer.  Mapping table:
+
+================================  =============================================
+reference primitive                TPU-native implementation
+================================  =============================================
+``dl.rank()`` / ``num_ranks()``    ``rank(axis)`` / ``num_ranks(axis)``
+                                   (lax.axis_index / axis_size inside shard_map)
+``dl.wait(barrier, n, scope,       ``wait(sem, n)`` — pltpu.semaphore_wait;
+  semantic)``                      acquire semantics are implied (Mosaic DMA
+                                   completion orders the data before the wait
+                                   returns — no separate consume_token needed)
+``dl.consume_token``               not needed: semaphore waits order
+                                   subsequent ref reads in Mosaic's effect
+                                   system (SSA token dance is a Triton-ism)
+``dl.notify(ptr, rank, sig_op)``   ``notify(sem, device_id, inc)`` —
+                                   pltpu.semaphore_signal (always ADD; SET is
+                                   not exposed by hardware — see docs/design.md)
+``dl.symm_at(ptr, rank)``          remote refs are addressed *per-copy* by
+                                   ``device_id`` (symm_at returns no pointer —
+                                   see ``remote_copy``'s dst semantics)
+``libshmem_device.putmem_block``   ``putmem(src, dst, sems, device_id)`` —
+                                   pltpu.make_async_remote_copy (+.start)
+``putmem_signal[_nbi]_block``      ``putmem_signal(...)`` — remote DMA whose
+                                   recv semaphore IS the signal (fused, like
+                                   put-with-completion-event; no separate flag
+                                   write needed, and it is ordered correctly
+                                   by hardware)
+``getmem_*``                       ``getmem(...)`` — remote DMA with remote
+                                   src (pull); TPU DMA engines support both
+``signal_op(sig, val, ADD, pe)``   ``notify(sem, pe, val)``
+``signal_wait_until(sig, GE, v)``  ``wait(sem, v)`` (decrements; see note)
+``fence()`` / ``quiet()``          ``fence()`` — wait on outstanding send
+                                   semaphores (explicit, per-copy on TPU)
+``barrier_all()``                  ``barrier_all(axis)`` — barrier-semaphore
+                                   round with all peers
+``atomic_add/cas`` (peer mem)      no remote atomics on ICI: use semaphore
+                                   increments (which ARE remote atomic adds)
+                                   or restructure to owner-computes (docs)
+``tid/ntid/__syncthreads`` etc.    no user-visible threads in Mosaic; the
+  (language_extra.py)              VPU/MXU are programmed as whole-core vector
+                                   ops, ``pl.program_id`` plays blockIdx's role
+``multimem_st/ld_reduce``          no NVLink-SHARP analog; ICI all-reduce is
+                                   done in software rings (see kernels/)
+================================  =============================================
+
+Semantics note (wait): NVSHMEM ``signal_wait_until(GE, v)`` leaves the flag
+set; Mosaic ``semaphore_wait(sem, v)`` *decrements* by ``v`` when satisfied.
+Kernels here are written in the decrement style (each producer signal is
+consumed exactly once), which also gives generation-counter reuse for free —
+the double-buffer ``call_count`` parity trick of low_latency_all_to_all.py:35-119
+is unnecessary.
+"""
+
+from triton_dist_tpu.language.primitives import (  # noqa: F401
+    rank,
+    num_ranks,
+    wait,
+    notify,
+    putmem,
+    putmem_signal,
+    getmem,
+    remote_copy,
+    local_copy,
+    fence,
+    barrier_all,
+    SIGNAL_DTYPE,
+)

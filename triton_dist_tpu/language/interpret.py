@@ -1,0 +1,34 @@
+"""Interpret-mode configuration for CPU-mesh testing of distributed kernels.
+
+The Mosaic TPU interpreter (``pltpu.InterpretParams``) simulates multi-device
+Pallas — including cross-chip remote DMA and semaphores — on a virtual CPU
+mesh.  This is the framework's "fake cluster" test backend (SURVEY.md §4: the
+reference has no such thing; every reference test needs real GPUs).
+
+We default to ``dma_execution_mode="eager"``: data movement happens at
+``.start()``, matching the hardware guarantee that a receive-semaphore
+increment implies the data has landed.  The default ``"on_wait"`` mode defers
+DMA execution to semaphore waits, which breaks chained-RDMA patterns (ring
+collectives forwarding a just-received chunk) that are correct on hardware.
+
+Race detection (reference analog: the deliberate comm-stream slowdown
+``_add_noise_workload_debug``, allgather.py:72-77) is available by running a
+kernel with ``interpret_params(detect_races=True)`` — the interpreter's
+vector-clock race detector reports unsynchronized accesses.
+"""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+
+def interpret_params(detect_races: bool = False) -> pltpu.InterpretParams:
+    return pltpu.InterpretParams(
+        dma_execution_mode="eager",
+        detect_races=detect_races,
+    )
+
+
+def maybe_interpret(interpret: bool, detect_races: bool = False):
+    """The value to pass to ``pallas_call(interpret=...)``."""
+    return interpret_params(detect_races) if interpret else False

@@ -1,0 +1,167 @@
+"""Distributed device primitives for Pallas TPU kernels.
+
+See package docstring (`triton_dist_tpu/language/__init__.py`) for the full
+mapping to the reference's dialect ops / libshmem_device API.
+All functions here must be called from *inside* a Pallas kernel body that is
+itself traced under ``shard_map`` (so ``lax.axis_index`` resolves).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Signals are semaphore counts (uint32 internally); exposed for buffers that
+# pack flags into data words (LL protocol, low_latency_allgather.py:549-568).
+SIGNAL_DTYPE = jnp.int32
+
+
+def rank(axis: str | Sequence[str]) -> jax.Array:
+    """My logical device index along the mesh axis.
+
+    Reference: ``dl.rank()`` → GetRankOp → ``nvshmem_my_pe``
+    (DistributedOps.td:113-121).
+    """
+    return jax.lax.axis_index(axis)
+
+
+def num_ranks(axis: str | Sequence[str]):
+    """World size along the mesh axis (reference: GetNumRanksOp)."""
+    return jax.lax.axis_size(axis)
+
+
+def wait(sem, value=1):
+    """Block until ``sem >= value``, then decrement by ``value``.
+
+    Reference: ``dl.wait(barrierPtrs, numBarriers, scope, semantic)``
+    (DistributedOps.td:45-77; PTX spin-loop lowering
+    DistributedOpToLLVM.cpp:144-217).  On TPU the scope/semantic knobs
+    disappear: semaphore waits are full acquire barriers for DMA'd data, and
+    there is no separate ``consume_token`` — Mosaic's effect system orders
+    subsequent reads of the destination ref after the wait.
+    """
+    pltpu.semaphore_wait(sem, value)
+
+
+def notify(sem, device_id=None, inc=1):
+    """Signal (atomically add to) a semaphore, optionally on a remote device.
+
+    Reference: ``dl.notify(ptr, rank, signal_op=ADD, comm_scope)``
+    (DistributedOps.td:151-164) and ``libshmem_device.signal_op``.
+    ``device_id=None`` signals the local semaphore.
+    """
+    if device_id is None:
+        pltpu.semaphore_signal(sem, inc=inc)
+    else:
+        pltpu.semaphore_signal(
+            sem,
+            inc=inc,
+            device_id=device_id,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+
+def remote_copy(src_ref, dst_ref, send_sem, recv_sem, device_id):
+    """Build (not start) an async remote copy: local ``src_ref`` → ``dst_ref``
+    on logical device ``device_id``.
+
+    Reference: the ``symm_at`` + ``putmem`` pair (DistributedOps.td:135-149 +
+    libnvshmem_device putmem family).  NVSHMEM's model is "translate a
+    symmetric address then store through it"; the TPU model is "issue a DMA
+    descriptor naming the target device" — the symmetric-address translation
+    is implicit in SPMD (every device's ``dst_ref`` is the same buffer).
+    Returns the copy object: ``.start()`` / ``.wait()`` /
+    ``.wait_send()`` / ``.wait_recv()``.
+    """
+    return pltpu.make_async_remote_copy(
+        src_ref=src_ref,
+        dst_ref=dst_ref,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id=device_id,
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+
+
+def putmem(src_ref, dst_ref, send_sem, recv_sem, device_id):
+    """Start a non-blocking put (reference: ``putmem_nbi_block``).
+
+    Returns the in-flight copy; call ``.wait_send()`` before reusing
+    ``src_ref`` (NVSHMEM's ``quiet``), and the *receiver* waits on
+    ``recv_sem`` for arrival.
+    """
+    cp = remote_copy(src_ref, dst_ref, send_sem, recv_sem, device_id)
+    cp.start()
+    return cp
+
+
+def putmem_signal(src_ref, dst_ref, send_sem, recv_sem, device_id):
+    """Put + arrival signal, fused (reference: ``putmem_signal_nbi_block``).
+
+    On TPU the recv semaphore *is* the signal and is hardware-ordered after
+    the data, so the reference's separate flag-store + memory-fence dance
+    (NotifyOpConversion, DistributedOpToLLVM.cpp:231-340) is unnecessary.
+    The receiver does ``wait(recv_sem)`` then reads ``dst_ref`` directly.
+    """
+    return putmem(src_ref, dst_ref, send_sem, recv_sem, device_id)
+
+
+def getmem(src_ref, dst_ref, send_sem, recv_sem, device_id):
+    """Start a non-blocking get: remote ``src_ref`` on ``device_id`` → local
+    ``dst_ref`` (reference: ``getmem_nbi_block``).  Pull-style AG variants
+    use this (allgather.py full-mesh *pull*)."""
+    cp = pltpu.make_async_remote_copy(
+        src_ref=src_ref,
+        dst_ref=dst_ref,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id=device_id,
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    cp.start()
+    return cp
+
+
+def local_copy(src_ref, dst_ref, sem):
+    """Async local (same-chip) DMA; reference analog: cudaMemcpyAsync /
+    ``dst.copy_(src)`` on the copy engine (allgather.py:122-135)."""
+    cp = pltpu.make_async_copy(src_ref, dst_ref, sem)
+    cp.start()
+    return cp
+
+
+def fence(*copies):
+    """Complete outstanding sends (reference: ``libshmem_device.fence`` /
+    ``quiet``).  TPU DMAs are tracked per-copy by their send semaphore, so the
+    fence is explicit: pass the in-flight copies to drain."""
+    for cp in copies:
+        cp.wait_send()
+
+
+def barrier_all(axis: str, sem=None):
+    """Full barrier over the mesh axis.
+
+    Reference: ``barrier_all_intra_node_atomic_cas_block``
+    (common_ops.py:87-101) — a sys-scope CAS round over symm_at peers.
+    TPU-native: signal every peer's barrier semaphore, then wait for
+    ``n-1`` signals.  Uses the dedicated hardware barrier semaphore unless a
+    regular semaphore is passed.  Kernels using this must set a
+    ``collective_id`` in their CompilerParams.
+    """
+    n = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    bsem = pltpu.get_barrier_semaphore() if sem is None else sem
+
+    def body(i, _):
+        peer = jax.lax.rem(me + i, n)
+        pltpu.semaphore_signal(
+            bsem, inc=1, device_id=peer, device_id_type=pltpu.DeviceIdType.LOGICAL
+        )
+        return 0
+
+    jax.lax.fori_loop(1, n, body, 0)
+    pltpu.semaphore_wait(bsem, n - 1)

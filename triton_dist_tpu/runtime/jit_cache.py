@@ -1,0 +1,34 @@
+"""Process-wide cache for jitted shard_map entry points.
+
+Host-level ops build ``jax.jit(jax.shard_map(partial(fn, **opts), ...))``
+closures; a fresh closure per call would defeat jit's trace cache and
+recompile every step.  ``cached_shard_jit`` memoizes the jitted callable on
+the (builder, mesh, specs, opts) key so repeated calls hit the compiled
+executable.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+
+
+@functools.lru_cache(maxsize=256)
+def _build(builder: Callable, mesh, in_specs, out_specs, opts: tuple):
+    fn = functools.partial(builder, **dict(opts))
+    return jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    )
+
+
+def cached_shard_jit(builder: Callable, mesh, in_specs, out_specs, **opts):
+    """Return a cached ``jit(shard_map(partial(builder, **opts)))``.
+
+    ``builder`` must be a module-level function (stable identity) and every
+    opt value hashable.
+    """
+    return _build(builder, mesh, in_specs, out_specs, tuple(sorted(opts.items())))

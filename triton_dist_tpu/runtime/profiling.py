@@ -1,0 +1,54 @@
+"""Profiling: per-process traces merged for whole-job timelines.
+
+Reference analog: ``group_profile`` (utils.py:417-501) — per-rank
+torch.profiler chrome traces gathered to rank 0, pid/tid re-namespaced per
+rank, merged and gzipped.
+
+TPU-native design: ``jax.profiler`` already captures device + host activity
+per process into Perfetto/TensorBoard format, and on multi-host TPU each
+process writes its own trace directory.  ``group_profile`` wraps
+``jax.profiler.trace`` with rank-scoped output dirs so a whole-job profile is
+a directory merge (Perfetto loads multi-process traces natively — no pid/tid
+rewriting needed, which removes the reference's entire merge pipeline).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+import jax
+
+
+class group_profile:
+    """Context manager: ``with group_profile("ag_gemm", do_prof=True): ...``.
+
+    Writes traces to ``{base_dir}/{name}/rank{process_index}``; view with
+    TensorBoard's profile plugin or ui.perfetto.dev.
+    """
+
+    def __init__(self, name: str = "trace", do_prof: bool = True, base_dir: str = "prof"):
+        self.name = name
+        self.do_prof = do_prof
+        self.base_dir = base_dir
+        self._cm = None
+
+    def __enter__(self):
+        if self.do_prof:
+            out = os.path.join(self.base_dir, self.name, f"rank{jax.process_index()}")
+            os.makedirs(out, exist_ok=True)
+            self._cm = jax.profiler.trace(out)
+            self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        if self._cm is not None:
+            self._cm.__exit__(*exc)
+        return False
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named trace span (reference analog: launch_metadata proton hooks)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield

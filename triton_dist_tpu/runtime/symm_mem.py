@@ -1,0 +1,91 @@
+"""Symmetric memory: the TPU-native replacement for the NVSHMEM symmetric heap.
+
+Reference analog: ``pynvshmem`` (`shmem/nvshmem_bind/pynvshmem/python/
+pynvshmem/__init__.py:94-167`) — ``nvshmem_create_tensor`` allocates a buffer
+at the same virtual offset on every PE so device code can address peers'
+copies (``nvshmem_ptr``).
+
+TPU-native design: under SPMD (shard_map over a Mesh) every device executes
+the same program on identically-shaped shards, so **symmetry is a property of
+the programming model, not of an allocator**.  A "symmetric tensor" is simply
+a sharded ``jax.Array`` whose per-device shard plays the role of the PE-local
+symmetric buffer; remote access is Mosaic async remote DMA addressed by
+logical device id (`triton_dist_tpu.language.putmem_*` / `symm_at` analog).
+
+What still needs managing is *workspace lifetime*: overlapped kernels need
+persistent scratch (signal arrays, staging buffers) that survives across
+steps and can be donated in-place.  ``SymmetricWorkspace`` provides that.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def create_symm_tensor(
+    mesh: Mesh,
+    axis: str,
+    per_device_shape: Sequence[int],
+    dtype=jnp.bfloat16,
+    init: float | None = 0.0,
+) -> jax.Array:
+    """Allocate a sharded array whose per-device shard has ``per_device_shape``.
+
+    Reference analog: ``nvshmem_create_tensor(shape, dtype)`` — every PE gets
+    a same-shape buffer.  Here the global array has leading dim
+    ``n_ranks * per_device_shape[0]`` sharded over ``axis``.
+    """
+    n = mesh.shape[axis]
+    global_shape = (n * per_device_shape[0], *per_device_shape[1:])
+    sharding = NamedSharding(mesh, P(axis, *([None] * (len(per_device_shape) - 1))))
+    if init is None:
+        return jax.device_put(
+            jnp.empty(global_shape, dtype), sharding
+        )
+    return jax.device_put(jnp.full(global_shape, init, dtype), sharding)
+
+
+@dataclass
+class SymmetricWorkspace:
+    """Persistent per-op scratch buffers, donated in-place across calls.
+
+    Reference analog: the ``*Context`` dataclasses
+    (e.g. ``AllGatherGEMMTensorParallelContext``, allgather_gemm.py:407-489)
+    that own symm workspace + signal arrays + streams.  TPU has no streams;
+    the workspace here is only buffers.  Buffers are keyed by name.
+    """
+
+    mesh: Mesh
+    axis: str
+    buffers: dict = field(default_factory=dict)
+
+    def get(self, name: str, per_device_shape: Sequence[int], dtype=jnp.bfloat16):
+        key = (name, tuple(per_device_shape), jnp.dtype(dtype).name)
+        if key not in self.buffers:
+            self.buffers[key] = create_symm_tensor(
+                self.mesh, self.axis, per_device_shape, dtype
+            )
+        return self.buffers[key]
+
+    def reset(self):
+        self.buffers.clear()
+
+
+def replicate(mesh: Mesh, x) -> jax.Array:
+    """Put an array fully-replicated over ``mesh``."""
+    x = jnp.asarray(x)
+    return jax.device_put(x, NamedSharding(mesh, P(*([None] * x.ndim))))
+
+
+def shard_along(mesh: Mesh, x, axis: str, dim: int = 0) -> jax.Array:
+    """Shard array ``x`` along dim ``dim`` over mesh axis ``axis``."""
+    x = jnp.asarray(x)
+    spec = [None] * x.ndim
+    spec[dim] = axis
+    return jax.device_put(x, NamedSharding(mesh, P(*spec)))

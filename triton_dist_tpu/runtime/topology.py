@@ -1,0 +1,115 @@
+"""Topology introspection: TPU generation, ICI/DCN layout, roofline numbers.
+
+Reference analog: NVLink/PCIe/NUMA detection in ``utils.py``
+(`get_has_fullmesh_nvlink` :761-773, `get_nvlink_max_speed` :621-625,
+`calculate_pcie_bandwidth` :667-702, `get_numa_world_size` :776-786).
+
+TPU-native design: the interesting topology facts are (a) device generation
+(sets MXU TFLOPS + HBM bandwidth), (b) ICI link bandwidth and whether a mesh
+axis rides ICI (intra-slice) or DCN (cross-slice), (c) whether the axis wraps
+(torus) — determines whether a ring uses 1 or 2 hops per step.  These feed
+the perf models (`triton_dist_tpu.kernels.perf_model`) and kernel variant
+auto-selection, just as NVLink-vs-PCIe selects AG variants in the reference
+(allgather.py:54-69).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+
+# Per-generation roofline tables (public figures; bf16 dense TFLOPS per chip,
+# HBM GB/s per chip, ICI GB/s per link per direction).
+# Analog of the tensor-core TFLOPS tables in gemm_perf_model.py:233+.
+_TPU_SPECS = {
+    # name-substring: (bf16 TFLOPS, HBM GB/s, ICI GB/s/link, ici links)
+    "v6e": (918.0, 1640.0, 3584.0 / 8, 4),  # Trillium
+    "v6": (918.0, 1640.0, 448.0, 4),
+    "v5p": (459.0, 2765.0, 4800.0 / 48, 6),
+    "v5e": (197.0, 819.0, 1600.0 / 4, 4),
+    "v5 lite": (197.0, 819.0, 400.0, 4),
+    "v4": (275.0, 1228.0, 2400.0 / 6, 6),
+    "v3": (123.0, 900.0, 70.0, 4),
+    "cpu": (0.5, 50.0, 10.0, 2),  # virtual-device test meshes
+}
+
+
+@dataclass(frozen=True)
+class TopologyInfo:
+    device_kind: str
+    n_devices: int
+    n_processes: int
+    bf16_tflops: float
+    hbm_gbps: float
+    ici_gbps_per_link: float
+    ici_links: int
+    is_tpu: bool
+
+    @property
+    def ici_gbps(self) -> float:
+        """Aggregate per-chip ICI bandwidth (all links, one direction)."""
+        return self.ici_gbps_per_link * self.ici_links
+
+
+def device_kind() -> str:
+    return jax.devices()[0].device_kind
+
+
+def is_tpu() -> bool:
+    return jax.devices()[0].platform == "tpu"
+
+
+def _lookup(kind: str):
+    k = kind.lower()
+    for sub, spec in _TPU_SPECS.items():
+        if sub in k:
+            return spec
+    return _TPU_SPECS["cpu"]
+
+
+def detect_topology() -> TopologyInfo:
+    kind = device_kind()
+    tflops, hbm, ici, links = _lookup(kind)
+    return TopologyInfo(
+        device_kind=kind,
+        n_devices=jax.device_count(),
+        n_processes=jax.process_count(),
+        bf16_tflops=tflops,
+        hbm_gbps=hbm,
+        ici_gbps_per_link=ici,
+        ici_links=links,
+        is_tpu=is_tpu(),
+    )
+
+
+def peak_bf16_tflops() -> float:
+    return detect_topology().bf16_tflops
+
+
+def hbm_bandwidth_gbps() -> float:
+    return detect_topology().hbm_gbps
+
+
+def ici_bandwidth_gbps() -> float:
+    return detect_topology().ici_gbps
+
+
+def axis_is_dcn(mesh, axis: str) -> bool:
+    """True when the mesh axis spans hosts via DCN rather than ICI.
+
+    On multi-slice deployments an axis whose devices live in different
+    processes crosses DCN.  (Analog: COMM_SCOPE INTER_NODE vs INTRA_NODE,
+    DistributedAttrDefs.td:44-53.)
+    """
+    devs = mesh.devices
+    import numpy as np
+
+    ax = mesh.axis_names.index(axis)
+    # Take a pencil of devices along `axis` and check their process indices.
+    idx = [0] * devs.ndim
+    pencil = [
+        devs[tuple(idx[:ax] + [i] + idx[ax + 1:])] for i in range(devs.shape[ax])
+    ]
+    procs = {getattr(d, "process_index", 0) for d in pencil}
+    return len(procs) > 1

@@ -1,0 +1,130 @@
+"""Benchmarking, test-data, printing and correctness-check utilities.
+
+Reference analog: ``python/triton_dist/utils.py`` —
+``perf_func`` (:186-198), ``dist_print`` (:201-230), ``_make_tensor``
+(:134-166), ``generate_data`` (:169-171), ``assert_allclose`` (:789-818).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def dist_print(*args, prefix: bool = True, allowed_ranks: Sequence[int] | str = (0,), **kwargs):
+    """Rank-filtered printing (reference: utils.py:201-230).
+
+    On TPU, "rank" at host level is ``jax.process_index()``.  Pass
+    ``allowed_ranks="all"`` to print from every process, ordered by rank.
+    """
+    pid = jax.process_index()
+    if allowed_ranks == "all":
+        allowed = list(range(jax.process_count()))
+    else:
+        allowed = list(allowed_ranks)
+    if pid in allowed:
+        if prefix:
+            print(f"[rank {pid}]", *args, **kwargs)
+        else:
+            print(*args, **kwargs)
+        sys.stdout.flush()
+
+
+def perf_func(
+    func: Callable[[], jax.Array | Sequence[jax.Array]],
+    iters: int = 100,
+    warmup_iters: int = 10,
+) -> tuple[object, float]:
+    """Time ``func`` and return ``(last_output, avg_ms_per_iter)``.
+
+    Reference analog: CUDA-event timed loop (utils.py:186-198).  TPU-native:
+    dispatch is async, so we block on the final output with
+    ``jax.block_until_ready`` — the XLA analog of event elapsed time.
+    """
+    out = None
+    for _ in range(max(warmup_iters, 1)):
+        out = func()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = func()
+    jax.block_until_ready(out)
+    t1 = time.perf_counter()
+    return out, (t1 - t0) * 1e3 / iters
+
+
+_INT_DTYPES = (jnp.int8, jnp.int16, jnp.int32, jnp.int64, jnp.uint8, jnp.uint32)
+
+
+def make_tensor(
+    key: jax.Array,
+    shape: Sequence[int],
+    dtype=jnp.bfloat16,
+    init: str = "randn",
+    scale: float = 1.0,
+) -> jax.Array:
+    """Seeded tensor factory incl. int8/fp8 (reference: _make_tensor utils.py:134-166).
+
+    ``init``: "randn" | "uniform" | "ones" | "zeros" | "arange" | "randint".
+    """
+    shape = tuple(shape)
+    if init == "ones":
+        return jnp.ones(shape, dtype)
+    if init == "zeros":
+        return jnp.zeros(shape, dtype)
+    if init == "arange":
+        return jnp.arange(np.prod(shape)).reshape(shape).astype(dtype)
+    if init == "randint" or dtype in _INT_DTYPES:
+        return jax.random.randint(key, shape, -3, 4, dtype=jnp.int32).astype(dtype)
+    if init == "uniform":
+        x = jax.random.uniform(key, shape, jnp.float32, -1.0, 1.0)
+    else:
+        x = jax.random.normal(key, shape, jnp.float32)
+    return (x * scale).astype(dtype)
+
+
+def generate_data(key: jax.Array, configs: Sequence[tuple]) -> list[jax.Array]:
+    """Generate a list of tensors from (shape, dtype, init) tuples."""
+    keys = jax.random.split(key, len(configs))
+    return [make_tensor(k, *cfg) for k, cfg in zip(keys, configs)]
+
+
+def assert_allclose(
+    x: jax.Array | np.ndarray,
+    y: jax.Array | np.ndarray,
+    atol: float = 1e-3,
+    rtol: float = 1e-3,
+    max_mismatch_to_print: int = 10,
+    verbose: bool = True,
+):
+    """Verbose allclose with mismatch locations (reference: utils.py:789-818)."""
+    xn = np.asarray(jax.device_get(x), dtype=np.float64)
+    yn = np.asarray(jax.device_get(y), dtype=np.float64)
+    if xn.shape != yn.shape:
+        raise AssertionError(f"shape mismatch: {xn.shape} vs {yn.shape}")
+    close = np.isclose(xn, yn, atol=atol, rtol=rtol)
+    if close.all():
+        return
+    bad = np.argwhere(~close)
+    n_bad = bad.shape[0]
+    msg = [
+        f"assert_allclose failed: {n_bad}/{xn.size} mismatched "
+        f"({100.0 * n_bad / xn.size:.3f}%), atol={atol} rtol={rtol}"
+    ]
+    if verbose:
+        for idx in bad[:max_mismatch_to_print]:
+            t = tuple(int(i) for i in idx)
+            msg.append(f"  at {t}: {xn[t]!r} vs {yn[t]!r} (diff {abs(xn[t]-yn[t]):.6g})")
+        amax = np.unravel_index(np.abs(xn - yn).argmax(), xn.shape)
+        msg.append(f"  max abs diff {np.abs(xn - yn).max():.6g} at {tuple(int(i) for i in amax)}")
+    raise AssertionError("\n".join(msg))
+
+
+def bitwise_equal(x: jax.Array, y: jax.Array) -> bool:
+    """Exact comparison used by deterministic-reduction tests."""
+    return bool(np.array_equal(np.asarray(jax.device_get(x)), np.asarray(jax.device_get(y))))
