@@ -26,7 +26,12 @@ from triton_dist_tpu.kernels.reduce_scatter import (  # noqa: F401
     create_reduce_scatter_context,
 )
 from triton_dist_tpu.kernels.common_ops import barrier_all_on_mesh  # noqa: F401
+from triton_dist_tpu.kernels.allgather_gemm import (  # noqa: F401
+    ag_gemm,
+    ag_gemm_gathered,
+    create_ag_gemm_context,
+)
 
 # Overlapped / model-level kernels land as the build progresses:
-# allgather_gemm, gemm_reduce_scatter, low_latency_allgather, all_to_all,
+# gemm_reduce_scatter, low_latency_allgather, all_to_all,
 # flash_decode, moe_reduce_rs, allgather_group_gemm (see SURVEY.md §7).

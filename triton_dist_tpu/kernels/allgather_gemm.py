@@ -1,0 +1,253 @@
+"""Overlapped AllGather-GEMM — the flagship tensor-parallel forward kernel.
+
+Reference analog: ``python/triton_dist/kernels/nvidia/allgather_gemm.py`` —
+a copy-engine/NVSHMEM producer streams A segments between ranks while a
+persistent consumer GEMM spins on per-rank signals before consuming each
+segment (``dl.wait`` + ``dl.consume_token`` at :226-227), with a rank-swizzled
+tile order so every rank starts on its local data (:206-219).
+
+TPU-native design (NOT a port): TPU has no user streams and no cross-kernel
+spin loops, so producer and consumer live in ONE Pallas kernel:
+
+* Outer loop over ``world`` ring steps.  At step ``s`` the device computes the
+  GEMM for the A segment it already holds (slot ``(me - s) mod world`` — the
+  rank-swizzle falls out of the ring schedule for free: step 0 is always the
+  local segment, exactly like the reference's swizzle) while the same segment
+  is simultaneously forwarded to the right ICI neighbor via async remote DMA.
+* The inner GEMM is a nested Mosaic pipeline (``pltpu.emit_pipeline``) that
+  streams (block_m, block_k) × (block_k, block_n) tiles HBM→VMEM into the MXU
+  with a float32 VMEM accumulator — this plays the role of the reference's
+  persistent TMA GEMM (allgather_gemm.py:133-254), and the Mosaic double
+  buffering plays the role of the Triton software pipeliner.
+* Per-segment readiness = the remote-copy recv semaphore (the reference's
+  per-rank signal array + PTX spin wait, DistributedOpToLLVM.cpp:144-217,
+  becomes a single ``recv_sem`` wait sized to the segment).
+
+The kernel also materializes the gathered A (the reference keeps it in the
+context workspace for later reuse, allgather_gemm.py:407-489).
+
+Sharding contract (1-D TP over ``axis``):
+  A: [M, K]   sharded P(axis, None)   (per-device [m_loc, K])
+  B: [K, N]   sharded P(None, axis)   (per-device [K, n_loc])
+  C: [M, N]   sharded P(None, axis)   (per-device [M, n_loc])
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.kernels.gemm import MatmulConfig
+from triton_dist_tpu.language.interpret import maybe_interpret
+from triton_dist_tpu.runtime import topology
+from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
+
+AG_GEMM_COLLECTIVE_ID = 3
+
+
+def _largest_divisor_block(dim: int, want: int, align: int) -> int:
+    """Largest multiple of ``align`` that divides ``dim`` and is <= ``want``.
+
+    Callers must first check ``pallas_shapes_ok`` (so ``dim % align == 0``),
+    which guarantees a legal result exists (at worst ``align`` itself).
+    """
+    assert dim % align == 0, (dim, align)
+    if dim <= want:
+        return dim
+    best = align
+    b = align
+    while b <= want:
+        if dim % b == 0:
+            best = b
+        b += align
+    return best
+
+
+def pallas_shapes_ok(m_loc: int, n_loc: int, k: int) -> bool:
+    """Whether the per-device problem tiles legally onto the MXU (sublane /
+    lane alignment).  Ragged shapes fall back to the XLA impl — the analog of
+    the reference's dispatcher choosing a non-TMA path for odd shapes."""
+    return m_loc % 8 == 0 and n_loc % 128 == 0 and k % 128 == 0
+
+
+@dataclass
+class AllGatherGEMMContext:
+    """Reference analog: ``AllGatherGEMMTensorParallelContext``
+    (allgather_gemm.py:407-489) — minus the symm workspace/streams, which on
+    TPU are the kernel's own output buffer and DMA queues."""
+
+    mesh: Mesh
+    axis: str = "tp"
+    impl: str = "auto"  # "auto" | "xla" | "pallas"
+    config: MatmulConfig = field(default_factory=MatmulConfig)
+    interpret: bool = False
+
+    @property
+    def world(self) -> int:
+        return self.mesh.shape[self.axis]
+
+
+def create_ag_gemm_context(mesh, axis="tp", impl="auto", config=None,
+                           interpret=False) -> AllGatherGEMMContext:
+    return AllGatherGEMMContext(
+        mesh=mesh, axis=axis, impl=impl,
+        config=config or MatmulConfig(), interpret=interpret,
+    )
+
+
+def _inner_gemm_body(a_blk, b_blk, out_blk, acc_ref, *, n_k, out_dtype):
+    """One (bm, bn, bk) MXU tile; f32 accumulation over the inner k grid."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(a_blk[:], b_blk[:], preferred_element_type=jnp.float32)
+
+    @pl.when(k == n_k - 1)
+    def _():
+        out_blk[:] = acc_ref[:].astype(out_dtype)
+
+
+def _ag_gemm_kernel(
+    a_ref,      # [m_loc, K]      ANY (HBM)
+    b_ref,      # [K, n_loc]      ANY
+    ag_ref,     # [world*m_loc, K] ANY, output: gathered A
+    out_ref,    # [world*m_loc, n_loc] ANY, output: C shard
+    send_sem, recv_sem, copy_sem,
+    acc_ref,    # VMEM (bm, bn) f32 scratch for the inner pipeline
+    *,
+    axis, world, m_loc, bm, bn, bk, out_dtype,
+):
+    me = jax.lax.axis_index(axis)
+    right = jax.lax.rem(me + 1, world)
+    left = jax.lax.rem(me + world - 1, world)
+
+    # Stage local segment into the gathered-A buffer (reference:
+    # local_copy_and_barrier_all, allgather_gemm.py:100-116).
+    cp = pltpu.make_async_copy(a_ref, ag_ref.at[pl.ds(me * m_loc, m_loc)], copy_sem)
+    cp.start()
+    cp.wait()
+
+    # Neighbor barrier before any remote write (same role as the entry
+    # barrier_all: nobody writes into a peer that hasn't entered the kernel).
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(barrier, 2)
+
+    K = a_ref.shape[1]
+    n_loc = b_ref.shape[1]
+    n_m, n_n, n_k = m_loc // bm, n_loc // bn, K // bk
+
+    inner = pltpu.emit_pipeline(
+        functools.partial(_inner_gemm_body, n_k=n_k, out_dtype=out_dtype),
+        grid=(n_m, n_n, n_k),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+        ],
+        out_specs=[pl.BlockSpec((bm, bn), lambda i, j, k: (i, j))],
+    )
+
+    for s in range(world):
+        slot = jax.lax.rem(me - s + world, world)
+        seg = ag_ref.at[pl.ds(slot * m_loc, m_loc)]
+        if s > 0:
+            # Segment for this step was DMA'd by the left neighbor during the
+            # previous step's compute; recv_sem completion == data landed
+            # (the reference's dl.wait on the per-rank signal).
+            pltpu.make_async_copy(seg, seg, recv_sem).wait()
+        if s < world - 1:
+            # Forward the segment along the ring while we compute on it.
+            pltpu.make_async_remote_copy(
+                src_ref=seg, dst_ref=seg,
+                send_sem=send_sem, recv_sem=recv_sem,
+                device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL,
+            ).start()
+
+        # Consume the segment: C[slot block, :] = A_seg @ B_loc on the MXU.
+        inner(seg, b_ref, out_ref.at[pl.ds(slot * m_loc, m_loc)],
+              scratches=(acc_ref,))
+
+        if s < world - 1:
+            pltpu.make_async_copy(seg, seg, send_sem).wait()
+
+
+def ag_gemm_shard(a_shard, b_shard, *, axis, impl, bm, bn, bk, interpret):
+    """Per-device AG-GEMM; call inside shard_map.  Returns (A_full, C_shard)."""
+    world = jax.lax.axis_size(axis)
+    m_loc, K = a_shard.shape
+    n_loc = b_shard.shape[1]
+    out_dtype = a_shard.dtype
+
+    if impl == "xla" or not pallas_shapes_ok(m_loc, n_loc, K):
+        a_full = jax.lax.all_gather(a_shard, axis, axis=0, tiled=True)
+        return a_full, jnp.dot(a_full, b_shard, preferred_element_type=jnp.float32).astype(out_dtype)
+
+    bm = _largest_divisor_block(m_loc, bm, 8)
+    bn = _largest_divisor_block(n_loc, bn, 128)
+    bk = _largest_divisor_block(K, bk, 128)
+
+    return pl.pallas_call(
+        functools.partial(
+            _ag_gemm_kernel, axis=axis, world=world, m_loc=m_loc,
+            bm=bm, bn=bn, bk=bk, out_dtype=out_dtype,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((world * m_loc, K), a_shard.dtype),
+            jax.ShapeDtypeStruct((world * m_loc, n_loc), out_dtype),
+        ],
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)],
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((bm, bn), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=AG_GEMM_COLLECTIVE_ID
+        ),
+        interpret=maybe_interpret(interpret),
+    )(a_shard, b_shard)
+
+
+def _resolve_impl(impl: str, interpret: bool) -> str:
+    if impl == "auto":
+        return "pallas" if (topology.is_tpu() or interpret) else "xla"
+    return impl
+
+
+def ag_gemm(a, b, ctx: AllGatherGEMMContext):
+    """C = allgather(A, axis) @ B_local, overlapped.  Host-level entry
+    (reference: ``ag_gemm`` allgather_gemm.py:539-583)."""
+    return ag_gemm_gathered(a, b, ctx)[1]
+
+
+def ag_gemm_gathered(a, b, ctx: AllGatherGEMMContext):
+    """Like :func:`ag_gemm` but also returns the gathered A (the reference
+    keeps it in ``ctx`` for reuse by subsequent ops)."""
+    impl = _resolve_impl(ctx.impl, ctx.interpret)
+    cfg = ctx.config
+    fn = cached_shard_jit(
+        ag_gemm_shard,
+        ctx.mesh,
+        (P(ctx.axis, None), P(None, ctx.axis)),
+        (P(None, None), P(None, ctx.axis)),
+        axis=ctx.axis, impl=impl,
+        bm=cfg.block_m, bn=cfg.block_n, bk=cfg.block_k,
+        interpret=ctx.interpret,
+    )
+    return fn(a, b)

@@ -22,6 +22,47 @@ from __future__ import annotations
 from jax.experimental.pallas import tpu as pltpu
 
 
+def _register_virtual_tpu_info() -> None:
+    """Teach Pallas's hardware-info query about the CPU interpreter.
+
+    ``pltpu.emit_pipeline`` (and other Mosaic helpers) query
+    ``tpu_info.get_tpu_info()`` for tiling decisions; on the virtual CPU mesh
+    there is no TPU device kind, so we register a virtual chip — modeled on
+    TPU v5p (the bench target) — via the module's public ``registry`` hook.
+    """
+    try:
+        from jax._src.pallas.mosaic import tpu_info as _ti
+    except ImportError:  # pragma: no cover - jax internals moved
+        return
+    reg = getattr(_ti, "registry", None)
+    if reg is None or "cpu" in reg:
+        return
+
+    def _virtual_v5p() -> "_ti.TpuInfo":
+        return _ti.TpuInfo(
+            chip_version=_ti.ChipVersion.TPU_V5P,
+            generation=5,
+            num_cores=1,
+            num_lanes=128,
+            num_sublanes=8,
+            mxu_column_size=128,
+            vmem_capacity_bytes=64 * 1024 * 1024,
+            cmem_capacity_bytes=0,
+            smem_capacity_bytes=1024 * 1024,
+            hbm_capacity_bytes=95_000_000_000 // 2,
+            mem_bw_bytes_per_second=int(2.76e12) // 2,
+            bf16_ops_per_second=int(4.59e14) // 2,
+            int8_ops_per_second=int(9.18e14) // 2,
+            fp8_ops_per_second=0,
+            int4_ops_per_second=0,
+        )
+
+    reg["cpu"] = _virtual_v5p
+
+
+_register_virtual_tpu_info()
+
+
 def interpret_params(detect_races: bool = False) -> pltpu.InterpretParams:
     return pltpu.InterpretParams(
         dma_execution_mode="eager",
