@@ -1,4 +1,4 @@
-"""Pytest config: force a clean 8-device virtual-CPU JAX for every test run.
+"""Pytest config: force a clean multi-device virtual-CPU JAX for every run.
 
 Two things happen here, both before any JAX *backend* is initialized (the
 ``jax`` module itself may already be imported by site hooks, but PJRT clients
@@ -10,15 +10,20 @@ are created lazily):
    and blocks while any other process holds it.  Tests must never touch the
    real chip, so we force ``jax_platforms=cpu`` and drop the axon factory
    before any backend comes up.
-2. **Virtual mesh.**  ``--xla_force_host_platform_device_count=8`` gives an
-   8-device CPU mesh — the "fake cluster" test story the reference lacks
-   (SURVEY.md §4: every reference test needs real GPUs under torchrun; ours
-   run anywhere).
+2. **Virtual mesh.**  ``--xla_force_host_platform_device_count=N`` (default
+   16: 2x the largest 8-device test mesh, so blocked collective kernels can
+   never starve the single-core interpreter) gives the "fake cluster" test
+   story the reference lacks (SURVEY.md §4: every reference test needs real
+   GPUs under torchrun; ours run anywhere).
 """
 
 import os
 
-_N_DEVICES = int(os.environ.get("TDT_TEST_DEVICES", "8"))
+# 2x headroom over the largest test mesh: when every virtual device is
+# blocked inside a collective Pallas kernel (semaphore waits), the
+# single-core CPU interpreter needs spare executor slots to keep making
+# progress — 8 busy devices of 8 can starve, 8 of 16 never does.
+_N_DEVICES = int(os.environ.get("TDT_TEST_DEVICES", "16"))
 _FLAG = f"--xla_force_host_platform_device_count={_N_DEVICES}"
 
 if _FLAG not in os.environ.get("XLA_FLAGS", ""):

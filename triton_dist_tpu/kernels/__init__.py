@@ -31,7 +31,11 @@ from triton_dist_tpu.kernels.allgather_gemm import (  # noqa: F401
     ag_gemm_gathered,
     create_ag_gemm_context,
 )
+from triton_dist_tpu.kernels.gemm_reduce_scatter import (  # noqa: F401
+    gemm_rs,
+    create_gemm_rs_context,
+)
 
 # Overlapped / model-level kernels land as the build progresses:
-# gemm_reduce_scatter, low_latency_allgather, all_to_all,
-# flash_decode, moe_reduce_rs, allgather_group_gemm (see SURVEY.md §7).
+# low_latency_allgather, all_to_all, flash_decode, moe_reduce_rs,
+# allgather_group_gemm (see SURVEY.md §7).

@@ -43,37 +43,17 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
-from triton_dist_tpu.kernels.gemm import MatmulConfig
+from triton_dist_tpu.kernels.gemm import (
+    MatmulConfig,
+    gemm_pipeline_body,
+    largest_divisor_block,
+    pallas_shapes_ok,
+    resolve_impl,
+)
 from triton_dist_tpu.language.interpret import maybe_interpret
-from triton_dist_tpu.runtime import topology
 from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
 
 AG_GEMM_COLLECTIVE_ID = 3
-
-
-def _largest_divisor_block(dim: int, want: int, align: int) -> int:
-    """Largest multiple of ``align`` that divides ``dim`` and is <= ``want``.
-
-    Callers must first check ``pallas_shapes_ok`` (so ``dim % align == 0``),
-    which guarantees a legal result exists (at worst ``align`` itself).
-    """
-    assert dim % align == 0, (dim, align)
-    if dim <= want:
-        return dim
-    best = align
-    b = align
-    while b <= want:
-        if dim % b == 0:
-            best = b
-        b += align
-    return best
-
-
-def pallas_shapes_ok(m_loc: int, n_loc: int, k: int) -> bool:
-    """Whether the per-device problem tiles legally onto the MXU (sublane /
-    lane alignment).  Ragged shapes fall back to the XLA impl — the analog of
-    the reference's dispatcher choosing a non-TMA path for odd shapes."""
-    return m_loc % 8 == 0 and n_loc % 128 == 0 and k % 128 == 0
 
 
 @dataclass
@@ -99,21 +79,6 @@ def create_ag_gemm_context(mesh, axis="tp", impl="auto", config=None,
         mesh=mesh, axis=axis, impl=impl,
         config=config or MatmulConfig(), interpret=interpret,
     )
-
-
-def _inner_gemm_body(a_blk, b_blk, out_blk, acc_ref, *, n_k, out_dtype):
-    """One (bm, bn, bk) MXU tile; f32 accumulation over the inner k grid."""
-    k = pl.program_id(2)
-
-    @pl.when(k == 0)
-    def _():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-
-    acc_ref[:] += jnp.dot(a_blk[:], b_blk[:], preferred_element_type=jnp.float32)
-
-    @pl.when(k == n_k - 1)
-    def _():
-        out_blk[:] = acc_ref[:].astype(out_dtype)
 
 
 def _ag_gemm_kernel(
@@ -150,7 +115,7 @@ def _ag_gemm_kernel(
     n_m, n_n, n_k = m_loc // bm, n_loc // bn, K // bk
 
     inner = pltpu.emit_pipeline(
-        functools.partial(_inner_gemm_body, n_k=n_k, out_dtype=out_dtype),
+        functools.partial(gemm_pipeline_body, n_k=n_k, out_dtype=out_dtype),
         grid=(n_m, n_n, n_k),
         in_specs=[
             pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
@@ -194,9 +159,9 @@ def ag_gemm_shard(a_shard, b_shard, *, axis, impl, bm, bn, bk, interpret):
         a_full = jax.lax.all_gather(a_shard, axis, axis=0, tiled=True)
         return a_full, jnp.dot(a_full, b_shard, preferred_element_type=jnp.float32).astype(out_dtype)
 
-    bm = _largest_divisor_block(m_loc, bm, 8)
-    bn = _largest_divisor_block(n_loc, bn, 128)
-    bk = _largest_divisor_block(K, bk, 128)
+    bm = largest_divisor_block(m_loc, bm, 8)
+    bn = largest_divisor_block(n_loc, bn, 128)
+    bk = largest_divisor_block(K, bk, 128)
 
     return pl.pallas_call(
         functools.partial(
@@ -224,12 +189,6 @@ def ag_gemm_shard(a_shard, b_shard, *, axis, impl, bm, bn, bk, interpret):
     )(a_shard, b_shard)
 
 
-def _resolve_impl(impl: str, interpret: bool) -> str:
-    if impl == "auto":
-        return "pallas" if (topology.is_tpu() or interpret) else "xla"
-    return impl
-
-
 def ag_gemm(a, b, ctx: AllGatherGEMMContext):
     """C = allgather(A, axis) @ B_local, overlapped.  Host-level entry
     (reference: ``ag_gemm`` allgather_gemm.py:539-583)."""
@@ -239,7 +198,7 @@ def ag_gemm(a, b, ctx: AllGatherGEMMContext):
 def ag_gemm_gathered(a, b, ctx: AllGatherGEMMContext):
     """Like :func:`ag_gemm` but also returns the gathered A (the reference
     keeps it in ``ctx`` for reuse by subsequent ops)."""
-    impl = _resolve_impl(ctx.impl, ctx.interpret)
+    impl = resolve_impl(ctx.impl, ctx.interpret)
     cfg = ctx.config
     fn = cached_shard_jit(
         ag_gemm_shard,

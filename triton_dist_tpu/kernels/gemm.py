@@ -42,6 +42,57 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def largest_divisor_block(dim: int, want: int, align: int) -> int:
+    """Largest multiple of ``align`` that divides ``dim`` and is <= ``want``.
+
+    Callers must first check ``pallas_shapes_ok`` (so ``dim % align == 0``),
+    which guarantees a legal result exists (at worst ``align`` itself).
+    """
+    assert dim % align == 0, (dim, align)
+    if dim <= want:
+        return dim
+    best = align
+    b = align
+    while b <= want:
+        if dim % b == 0:
+            best = b
+        b += align
+    return best
+
+
+def pallas_shapes_ok(m_loc: int, n_loc: int, k: int) -> bool:
+    """Whether the per-device problem tiles legally onto the MXU (sublane /
+    lane alignment).  Ragged shapes fall back to the XLA impl — the analog of
+    the reference's dispatcher choosing a non-TMA path for odd shapes."""
+    return m_loc % 8 == 0 and n_loc % 128 == 0 and k % 128 == 0
+
+
+def resolve_impl(impl: str, interpret: bool) -> str:
+    """Shared auto-dispatch: pallas on TPU hardware or under the interpreter,
+    XLA collectives elsewhere (reference analog: the per-op dispatchers)."""
+    from triton_dist_tpu.runtime import topology
+
+    if impl == "auto":
+        return "pallas" if (topology.is_tpu() or interpret) else "xla"
+    return impl
+
+
+def gemm_pipeline_body(a_blk, b_blk, out_blk, acc_ref, *, n_k, out_dtype):
+    """Shared emit_pipeline body for nested MXU matmuls inside overlapped
+    kernels: one (bm, bn, bk) tile with f32 accumulation over the k grid."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(a_blk[:], b_blk[:], preferred_element_type=jnp.float32)
+
+    @pl.when(k == n_k - 1)
+    def _():
+        out_blk[:] = acc_ref[:].astype(out_dtype)
+
+
 def _matmul_kernel(a_ref, b_ref, out_ref, acc_ref, *, n_k: int, k_rem: int, out_dtype):
     k = pl.program_id(2)
 

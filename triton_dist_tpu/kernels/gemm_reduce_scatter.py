@@ -1,0 +1,258 @@
+"""Overlapped GEMM-ReduceScatter — the tensor-parallel backward-half kernel.
+
+Reference analog: ``python/triton_dist/kernels/nvidia/gemm_reduce_scatter.py``
+— a persistent producer GEMM writes output tiles, counts per-segment
+completions with ``tl.atomic_add`` and fires ``dl.notify`` when a rank's
+segment is done (:226-235), while a reduce-scatter consumer on a second
+stream (``rs_stream``) scatters + ring-reduces the segments
+(``reduce_scatter.py:604-860``); a rank-offset tile swizzle makes segment
+``i`` of rank ``r`` finish early (:190-200).
+
+TPU-native design (NOT a port): no streams, no atomics — ONE Pallas kernel
+runs a ring reduce-scatter whose per-chunk partial GEMM overlaps the
+in-flight partial-sum DMA:
+
+* Sharding (row-parallel linear): A [M, K] is K-sharded, B [K, N] K-sharded,
+  so each device's GEMM ``A_loc @ B_loc`` is a *partial sum* of C [M, N];
+  the reduce-scatter sums partials and leaves M-chunk ``d`` on device ``d``.
+* Ring schedule: the partial for chunk ``c`` starts at device ``c+1`` and
+  travels right, accumulating each device's local contribution; after
+  ``world-1`` hops it reaches its owner ``c`` fully reduced.  Device ``d``
+  therefore computes chunks ``(d-1), (d-2), ..., (d+1) mod world`` and
+  finally its own chunk ``d`` — the reference's rank-offset swizzle
+  (gemm_rs_threadblock_swizzle.py) is this schedule's natural order.
+* Overlap: at step ``s`` the inner MXU pipeline computes ``A[c_s] @ B_loc``
+  while the previous partial (sent by the left neighbor during *its* step
+  ``s-1``) is still in flight; the recv wait happens only before the cheap
+  VPU add pass that folds the received partial in.  The add pass is the
+  analog of the reference's ``ring_reduce`` on the reduction stream.
+* Flow control: double-buffered landing slots + a credit semaphore replace
+  the reference's ``wait_eq`` scatter signals (reduce_scatter.py:604-637).
+
+Sharding contract (1-D TP over ``axis``):
+  A: [M, K]   sharded P(None, axis)  (per-device [M, k_loc])
+  B: [K, N]   sharded P(axis, None)  (per-device [k_loc, N])
+  C: [M, N]   sharded P(axis, None)  (per-device [m_loc, N], fully reduced)
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.kernels.gemm import (
+    MatmulConfig,
+    gemm_pipeline_body,
+    largest_divisor_block,
+    pallas_shapes_ok,
+    resolve_impl,
+)
+from triton_dist_tpu.language.interpret import maybe_interpret
+from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
+
+GEMM_RS_COLLECTIVE_ID = 4
+
+
+@dataclass
+class GEMMReduceScatterContext:
+    """Reference analog: ``GEMMReduceScatterTensorParallelContext``
+    (gemm_reduce_scatter.py:240+) minus streams/symm workspace."""
+
+    mesh: Mesh
+    axis: str = "tp"
+    impl: str = "auto"
+    config: MatmulConfig = field(default_factory=MatmulConfig)
+    interpret: bool = False
+
+    @property
+    def world(self) -> int:
+        return self.mesh.shape[self.axis]
+
+
+def create_gemm_rs_context(mesh, axis="tp", impl="auto", config=None,
+                           interpret=False) -> GEMMReduceScatterContext:
+    return GEMMReduceScatterContext(
+        mesh=mesh, axis=axis, impl=impl,
+        config=config or MatmulConfig(), interpret=interpret,
+    )
+
+
+def _add_body(recv_blk, dst_in_blk, dst_out_blk):
+    """dst += recv fold of the in-flight ring partial (the reference's
+    ring_reduce add kernel, reduce_scatter.py:828)."""
+    dst_out_blk[:] = dst_in_blk[:] + recv_blk[:]
+
+
+def _gemm_rs_kernel(
+    a_ref,       # [M, k_loc]        ANY
+    b_ref,       # [k_loc, N]        ANY
+    out_ref,     # [m_loc, N]        ANY, output: reduced C chunk
+    send_ref,    # [2, m_loc, N]     ANY, output (scratch): partial staging
+    recv_ref,    # [2, m_loc, N]     ANY, output (scratch): landing slots
+    send_sem, recv_sem, credit_sem,
+    acc_ref,     # VMEM (bm, bn) f32
+    *,
+    axis, world, m_loc, bm, bn, bk,
+):
+    me = jax.lax.axis_index(axis)
+    right = jax.lax.rem(me + 1, world)
+    left = jax.lax.rem(me + world - 1, world)
+    dtype_ref = out_ref
+
+    k_loc = a_ref.shape[1]
+    N = b_ref.shape[1]
+    n_m, n_n, n_k = m_loc // bm, N // bn, k_loc // bk
+
+    inner_gemm = pltpu.emit_pipeline(
+        functools.partial(gemm_pipeline_body, n_k=n_k, out_dtype=dtype_ref.dtype),
+        grid=(n_m, n_n, n_k),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+        ],
+        out_specs=[pl.BlockSpec((bm, bn), lambda i, j, k: (i, j))],
+    )
+    inner_add = pltpu.emit_pipeline(
+        _add_body,
+        grid=(n_m, n_n),
+        in_specs=[
+            pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+            pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        ],
+        out_specs=[pl.BlockSpec((bm, bn), lambda i, j: (i, j))],
+    )
+
+    if world > 1:
+        # Entry barrier with ring neighbors before any remote write.
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(barrier, 2)
+
+    for s in range(world):
+        p = s % 2
+        last = s == world - 1
+        # Chunk schedule: (me-1-s) mod world, except the final step reduces
+        # our own chunk (see module docstring for the ring derivation).
+        if last:
+            chunk = me
+        else:
+            chunk = jax.lax.rem(me - 1 - s + 2 * world, world)
+        dst = out_ref if last else send_ref.at[p]
+
+        if s >= 2:
+            # send_ref slot p was last DMA'd at step s-2; drain before reuse.
+            # Semaphores are per-slot: with two sends in flight, a shared
+            # semaphore could let the *other* slot's completion satisfy this
+            # wait and the GEMM would overwrite a buffer still being read.
+            pltpu.make_async_copy(send_ref.at[p], send_ref.at[p],
+                                  send_sem.at[p]).wait()
+
+        # Partial GEMM for this chunk — overlaps the in-flight recv DMA.
+        inner_gemm(a_ref.at[pl.ds(chunk * m_loc, m_loc)], b_ref, dst,
+                   scratches=(acc_ref,))
+
+        if s >= 1:
+            # Fold in the partial received from the left (landed in slot p).
+            pltpu.make_async_copy(recv_ref.at[p], recv_ref.at[p],
+                                  recv_sem.at[p]).wait()
+            inner_add(recv_ref.at[p], dst, dst)
+            # Slot p is now free for the left neighbor's step-(s+1) send.
+            pltpu.semaphore_signal(credit_sem, inc=1, device_id=left,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+        if not last:
+            if s >= 2:
+                # Right's landing slot (s+1)%2 is reused from step s-2; wait
+                # for the credit it issued after consuming it at step s-1.
+                pltpu.semaphore_wait(credit_sem, 1)
+            pltpu.make_async_remote_copy(
+                src_ref=send_ref.at[p],
+                dst_ref=recv_ref.at[(s + 1) % 2],
+                send_sem=send_sem.at[p],
+                recv_sem=recv_sem.at[(s + 1) % 2],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            ).start()
+
+    if world > 1:
+        # Drain the final outstanding send (issued at step world-2).
+        pfin = (world - 2) % 2
+        pltpu.make_async_copy(send_ref.at[pfin], send_ref.at[pfin],
+                              send_sem.at[pfin]).wait()
+        # Unconsumed credits: the right neighbor signals one credit per fold
+        # (world-1 total) but we only wait world-3 times; drain the rest so
+        # the semaphore is zero at kernel exit.
+        n_credit_waits = max(world - 3, 0)
+        pltpu.semaphore_wait(credit_sem, (world - 1) - n_credit_waits)
+
+
+def gemm_rs_shard(a_shard, b_shard, *, axis, impl, bm, bn, bk, interpret):
+    """Per-device GEMM-RS; call inside shard_map.  Returns the reduced chunk."""
+    world = jax.lax.axis_size(axis)
+    M, k_loc = a_shard.shape
+    N = b_shard.shape[1]
+    assert M % world == 0, (M, world)
+    m_loc = M // world
+    out_dtype = a_shard.dtype
+
+    if impl == "xla" or not pallas_shapes_ok(m_loc, N, k_loc):
+        partial = jnp.dot(a_shard, b_shard, preferred_element_type=jnp.float32)
+        return jax.lax.psum_scatter(
+            partial, axis, scatter_dimension=0, tiled=True
+        ).astype(out_dtype)
+
+    bm = largest_divisor_block(m_loc, bm, 8)
+    bn = largest_divisor_block(N, bn, 128)
+    bk = largest_divisor_block(k_loc, bk, 128)
+
+    out, _, _ = pl.pallas_call(
+        functools.partial(
+            _gemm_rs_kernel, axis=axis, world=world, m_loc=m_loc,
+            bm=bm, bn=bn, bk=bk,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((m_loc, N), out_dtype),
+            jax.ShapeDtypeStruct((2, m_loc, N), out_dtype),
+            jax.ShapeDtypeStruct((2, m_loc, N), out_dtype),
+        ],
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+            pltpu.VMEM((bm, bn), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=GEMM_RS_COLLECTIVE_ID
+        ),
+        interpret=maybe_interpret(interpret),
+    )(a_shard, b_shard)
+    return out
+
+
+def gemm_rs(a, b, ctx: GEMMReduceScatterContext):
+    """C = reduce_scatter(A_loc @ B_loc, axis), overlapped.  Host entry
+    (reference: ``gemm_rs`` gemm_reduce_scatter.py:547)."""
+    impl = resolve_impl(ctx.impl, ctx.interpret)
+    cfg = ctx.config
+    fn = cached_shard_jit(
+        gemm_rs_shard,
+        ctx.mesh,
+        (P(None, ctx.axis), P(ctx.axis, None)),
+        P(ctx.axis, None),
+        axis=ctx.axis, impl=impl,
+        bm=cfg.block_m, bn=cfg.block_n, bk=cfg.block_k,
+        interpret=ctx.interpret,
+    )
+    return fn(a, b)
