@@ -101,14 +101,16 @@ def _ag_gemm_kernel(
     cp.start()
     cp.wait()
 
-    # Neighbor barrier before any remote write (same role as the entry
-    # barrier_all: nobody writes into a peer that hasn't entered the kernel).
-    barrier = pltpu.get_barrier_semaphore()
-    pltpu.semaphore_signal(barrier, inc=1, device_id=left,
-                           device_id_type=pltpu.DeviceIdType.LOGICAL)
-    pltpu.semaphore_signal(barrier, inc=1, device_id=right,
-                           device_id_type=pltpu.DeviceIdType.LOGICAL)
-    pltpu.semaphore_wait(barrier, 2)
+    if world > 1:
+        # Neighbor barrier before any remote write (same role as the entry
+        # barrier_all: nobody writes into a peer that hasn't entered the
+        # kernel).
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(barrier, 2)
 
     K = a_ref.shape[1]
     n_loc = b_ref.shape[1]
@@ -150,6 +152,7 @@ def _ag_gemm_kernel(
 
 def ag_gemm_shard(a_shard, b_shard, *, axis, impl, bm, bn, bk, interpret):
     """Per-device AG-GEMM; call inside shard_map.  Returns (A_full, C_shard)."""
+    impl = resolve_impl(impl, interpret)
     world = jax.lax.axis_size(axis)
     m_loc, K = a_shard.shape
     n_loc = b_shard.shape[1]
@@ -183,7 +186,8 @@ def ag_gemm_shard(a_shard, b_shard, *, axis, impl, bm, bn, bk, interpret):
             pltpu.VMEM((bm, bn), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
-            has_side_effects=True, collective_id=AG_GEMM_COLLECTIVE_ID
+            has_side_effects=True,
+            collective_id=AG_GEMM_COLLECTIVE_ID if world > 1 else None,
         ),
         interpret=maybe_interpret(interpret),
     )(a_shard, b_shard)
@@ -198,14 +202,13 @@ def ag_gemm(a, b, ctx: AllGatherGEMMContext):
 def ag_gemm_gathered(a, b, ctx: AllGatherGEMMContext):
     """Like :func:`ag_gemm` but also returns the gathered A (the reference
     keeps it in ``ctx`` for reuse by subsequent ops)."""
-    impl = resolve_impl(ctx.impl, ctx.interpret)
     cfg = ctx.config
     fn = cached_shard_jit(
         ag_gemm_shard,
         ctx.mesh,
         (P(ctx.axis, None), P(None, ctx.axis)),
         (P(None, None), P(None, ctx.axis)),
-        axis=ctx.axis, impl=impl,
+        axis=ctx.axis, impl=ctx.impl,
         bm=cfg.block_m, bn=cfg.block_n, bk=cfg.block_k,
         interpret=ctx.interpret,
     )

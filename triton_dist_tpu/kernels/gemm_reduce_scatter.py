@@ -197,6 +197,7 @@ def _gemm_rs_kernel(
 
 def gemm_rs_shard(a_shard, b_shard, *, axis, impl, bm, bn, bk, interpret):
     """Per-device GEMM-RS; call inside shard_map.  Returns the reduced chunk."""
+    impl = resolve_impl(impl, interpret)
     world = jax.lax.axis_size(axis)
     M, k_loc = a_shard.shape
     N = b_shard.shape[1]
@@ -234,7 +235,10 @@ def gemm_rs_shard(a_shard, b_shard, *, axis, impl, bm, bn, bk, interpret):
             pltpu.VMEM((bm, bn), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
-            has_side_effects=True, collective_id=GEMM_RS_COLLECTIVE_ID
+            has_side_effects=True,
+            # Mosaic rejects a collective_id when the kernel never touches
+            # the barrier semaphore (the world-1 degenerate path).
+            collective_id=GEMM_RS_COLLECTIVE_ID if world > 1 else None,
         ),
         interpret=maybe_interpret(interpret),
     )(a_shard, b_shard)
@@ -244,14 +248,13 @@ def gemm_rs_shard(a_shard, b_shard, *, axis, impl, bm, bn, bk, interpret):
 def gemm_rs(a, b, ctx: GEMMReduceScatterContext):
     """C = reduce_scatter(A_loc @ B_loc, axis), overlapped.  Host entry
     (reference: ``gemm_rs`` gemm_reduce_scatter.py:547)."""
-    impl = resolve_impl(ctx.impl, ctx.interpret)
     cfg = ctx.config
     fn = cached_shard_jit(
         gemm_rs_shard,
         ctx.mesh,
         (P(None, ctx.axis), P(ctx.axis, None)),
         P(ctx.axis, None),
-        axis=ctx.axis, impl=impl,
+        axis=ctx.axis, impl=ctx.impl,
         bm=cfg.block_m, bn=cfg.block_n, bk=cfg.block_k,
         interpret=ctx.interpret,
     )

@@ -1,0 +1,15 @@
+"""Model-facing layers wrapping the distributed kernel library.
+
+Reference analog: ``python/triton_dist/layers/nvidia/`` —
+``SpGQAFlashDecodeAttention``, ``EPAll2AllLayer``, ``AllGatherLayer``.
+
+TPU-native additions: differentiable sequence-parallel TP linears
+(``column_parallel_linear`` / ``row_parallel_linear``) whose custom VJPs
+keep the backward pass overlapped too (the reference is inference-only
+kernels; training-capable layers are where the TPU build goes further).
+"""
+
+from triton_dist_tpu.layers.tp_linear import (  # noqa: F401
+    column_parallel_linear,
+    row_parallel_linear,
+)

@@ -1,0 +1,107 @@
+"""Differentiable sequence-parallel tensor-parallel linears.
+
+The Megatron-style sequence-parallel TP pattern is exactly the reference's
+flagship kernel pair (SURVEY.md §2.5):
+
+* **column-parallel** (QKV / up-proj): tokens are sequence-sharded; the
+  weight is output-column-sharded.  Forward = overlapped AllGather-GEMM
+  (``allgather_gemm.py``), output has full sequence, sharded features.
+* **row-parallel** (attn-out / down-proj): input features are sharded; the
+  weight is input-row-sharded.  Forward = overlapped GEMM-ReduceScatter
+  (``gemm_reduce_scatter.py``), output is sequence-sharded again.
+
+The backward passes are each other's duals, so training stays overlapped:
+
+  column fwd:  C = AG(A) @ B
+  column bwd:  dA = GEMM-RS(dC @ Bᵀ)      (ring RS overlapped)
+               dB = AG(A)ᵀ @ dC           (local MXU, AG(A) saved from fwd)
+  row fwd:     C = RS(A @ B)
+  row bwd:     dA = AG(dC) @ Bᵀ           (ring AG overlapped)
+               dB = Aᵀ @ AG(dC)           (local MXU)
+
+Everything here is **shard-level**: call inside ``shard_map``.  The reference
+has no training story at all (kernel library only) — this module is where the
+TPU build exceeds it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.kernels.allgather_gemm import ag_gemm_shard
+from triton_dist_tpu.kernels.gemm_reduce_scatter import gemm_rs_shard
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def column_parallel_linear(a_shard, b_shard, axis, impl="auto",
+                           interpret=False):
+    """[m_loc, K] x [K, n_loc] -> [M, n_loc] via overlapped AG-GEMM.
+
+    ``a_shard`` is the sequence-sharded activation, ``b_shard`` the
+    column-sharded weight.  Returns the full-sequence activation with local
+    feature columns.
+    """
+    _, c = _col_fwd_impl(a_shard, b_shard, axis, impl, interpret)
+    return c
+
+
+def _col_fwd_impl(a_shard, b_shard, axis, impl, interpret):
+    kw = dict(axis=axis, impl=impl, bm=512, bn=512, bk=512,
+              interpret=interpret)
+    a_full, c = ag_gemm_shard(a_shard, b_shard, **kw)
+    return a_full, c
+
+
+def _col_fwd(a_shard, b_shard, axis, impl, interpret):
+    a_full, c = _col_fwd_impl(a_shard, b_shard, axis, impl, interpret)
+    return c, (a_full, b_shard)
+
+
+def _col_bwd(axis, impl, interpret, res, dc):
+    a_full, b_shard = res
+    # dA = reduce_scatter(dC @ B^T) over the sequence axis — the ring
+    # GEMM-RS kernel with K playing the sharded-feature role.
+    da = gemm_rs_shard(dc, b_shard.T, axis=axis, impl=impl,
+                       bm=512, bn=512, bk=512, interpret=interpret)
+    # dB = AG(A)^T @ dC — local MXU matmul on the saved gathered input.
+    db = jnp.dot(a_full.T, dc, preferred_element_type=jnp.float32).astype(
+        b_shard.dtype)
+    return da, db
+
+
+column_parallel_linear.defvjp(_col_fwd, _col_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def row_parallel_linear(a_shard, b_shard, axis, impl="auto",
+                        interpret=False):
+    """[M, k_loc] x [k_loc, N] -> [m_loc, N] via overlapped GEMM-RS.
+
+    ``a_shard`` has full sequence with local feature columns, ``b_shard``
+    the row-sharded weight.  Returns the sequence-sharded output, fully
+    summed over feature shards.
+    """
+    return gemm_rs_shard(a_shard, b_shard, axis=axis, impl=impl,
+                         bm=512, bn=512, bk=512, interpret=interpret)
+
+
+def _row_fwd(a_shard, b_shard, axis, impl, interpret):
+    c = row_parallel_linear(a_shard, b_shard, axis, impl, interpret)
+    return c, (a_shard, b_shard)
+
+
+def _row_bwd(axis, impl, interpret, res, dc):
+    a_shard, b_shard = res
+    # dA = AG(dC) @ B^T — the ring AG-GEMM kernel; its gathered output is
+    # reused for dB, so the gather happens once.
+    dc_full, da = ag_gemm_shard(dc, b_shard.T, axis=axis, impl=impl,
+                                bm=512, bn=512, bk=512, interpret=interpret)
+    db = jnp.dot(a_shard.T, dc_full, preferred_element_type=jnp.float32
+                 ).astype(b_shard.dtype)
+    return da, db
+
+
+row_parallel_linear.defvjp(_row_fwd, _row_bwd)

@@ -1,0 +1,17 @@
+"""End-to-end model families wired through the distributed kernels.
+
+Reference analog: the reference ships no trainer — its model story is the
+LLaMA-shape test configs (test_ag_gemm.py ``--shape_id``) and inference
+layers.  The TPU build provides actual models: a Llama-style dense
+transformer (``llama.py``) and a Mixtral-style MoE (``moe.py``), both
+running forward AND backward through the overlapped kernels.
+"""
+
+from triton_dist_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    init_params,
+    forward_shard,
+    loss_shard,
+    make_forward,
+    make_train_step,
+)
