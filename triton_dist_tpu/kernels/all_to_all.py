@@ -1,0 +1,208 @@
+"""Low-latency EP AllToAll — MoE inference token dispatch/combine.
+
+Reference analog: ``python/triton_dist/kernels/nvidia/low_latency_all_to_all.py``
+— the README's headline 137 µs kernel (vs DeepEP's 182 µs): a single kernel
+where each PE ``putmem_nbi_block``s its token segment + split counts to every
+peer, with ``fence`` + ``signal_op``/``signal_wait_until`` handshakes and
+double-buffering by ``call_count`` parity (:35-119); host wrapper
+``fast_all_to_all`` (:189+), ``all_to_all_post_process`` (:251+) compacts.
+
+TPU-native design (NOT a port):
+
+* **Static max-token padding** (SURVEY.md §7 hard part 2): segment sizes are
+  data-dependent, but TPU DMAs need static sizes; each (src→dst) segment is
+  padded to ``max_tokens`` rows, like the reference's own symm-buffer layout
+  (`AllToAllContext.max_m`, :125-165).  Split counts travel as a second tiny
+  DMA posted back-to-back with (and overlapping) the payload DMA; the recv
+  semaphore supplies the arrival ordering that the reference builds from the
+  LL flag-in-data trick + NVLink 8-byte store atomicity (:549-568).
+* **No parity/double-buffering**: each ``pallas_call`` invocation gets fresh
+  buffers and zeroed semaphores (Mosaic guarantees), so the reference's
+  ``call_count`` parity machinery (:92-101) has no TPU equivalent to need.
+* fp8 payloads: pass an fp8 array; the DMA is dtype-agnostic.  (The
+  reference's separate scale putmem (:76-88) becomes "stack scales as extra
+  hidden columns" at the caller.)
+
+Layout contract (shard-level, inside shard_map over ``axis``):
+  send:  [world, max_tokens, H]  — row block p goes to peer p
+  splits: [world] int32          — valid rows per destination
+  recv:  [world, max_tokens, H]  — row block p arrived from peer p
+  recv_splits: [world] int32
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.kernels.gemm import resolve_impl
+from triton_dist_tpu.language.interpret import maybe_interpret
+from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
+
+A2A_COLLECTIVE_ID = 5
+
+
+@dataclass
+class AllToAllContext:
+    """Reference analog: ``AllToAllContext`` (low_latency_all_to_all.py:125-165)
+    — max_m/hidden/world sizing of the symmetric buffers."""
+
+    mesh: Mesh
+    max_tokens: int
+    hidden: int
+    axis: str = "ep"
+    impl: str = "auto"
+    interpret: bool = False
+
+    @property
+    def world(self) -> int:
+        return self.mesh.shape[self.axis]
+
+
+def create_all_to_all_context(mesh, max_tokens, hidden, axis="ep",
+                              impl="auto", interpret=False) -> AllToAllContext:
+    return AllToAllContext(mesh=mesh, max_tokens=max_tokens, hidden=hidden,
+                           axis=axis, impl=impl, interpret=interpret)
+
+
+def _a2a_kernel(send_ref, splits_ref, recv_ref, recv_splits_ref,
+                send_sem, recv_sem, copy_sem,
+                *, axis, world):
+    """One-shot full-mesh token shuffle.
+
+    Per peer p: a remote DMA moves our [max_tokens, H] segment into the
+    peer's recv slot ``me``, plus a tiny second DMA for that peer's split
+    count — both posted non-blocking back-to-back, so the metadata transfer
+    overlaps the payload transfer (shared semaphore accounting by bytes).
+    """
+    me = jax.lax.axis_index(axis)
+
+    # Local segment: ours lands in recv[me] without touching the wire
+    # (reference: the pe==rank branch of the dispatch loop).
+    cp = pltpu.make_async_copy(send_ref.at[me], recv_ref.at[me], copy_sem)
+    cp.start()
+    sp = pltpu.make_async_copy(splits_ref.at[pl.ds(me, 1)],
+                               recv_splits_ref.at[pl.ds(me, 1)], copy_sem)
+    sp.start()
+    cp.wait()
+    sp.wait()
+
+    if world == 1:
+        return
+
+    # Entry barrier: nobody writes into a peer still outside the kernel.
+    barrier = pltpu.get_barrier_semaphore()
+    for i in range(1, world):
+        peer = jax.lax.rem(me + i, world)
+        pltpu.semaphore_signal(barrier, inc=1, device_id={axis: peer},
+                               device_id_type=pltpu.DeviceIdType.MESH)
+    pltpu.semaphore_wait(barrier, world - 1)
+
+    # Fire all segments at once (the reference's PE-per-block nbi puts).
+    for i in range(1, world):
+        peer = jax.lax.rem(me + i, world)
+        pltpu.make_async_remote_copy(
+            src_ref=send_ref.at[peer],
+            dst_ref=recv_ref.at[me],
+            send_sem=send_sem,
+            recv_sem=recv_sem,
+            device_id={axis: peer},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        ).start()
+        pltpu.make_async_remote_copy(
+            src_ref=splits_ref.at[pl.ds(peer, 1)],
+            dst_ref=recv_splits_ref.at[pl.ds(me, 1)],
+            send_sem=send_sem,
+            recv_sem=recv_sem,
+            device_id={axis: peer},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        ).start()
+
+    # Drain: world-1 outgoing and world-1 incoming (segment + splits each).
+    seg = send_ref.at[0]
+    srow = splits_ref.at[pl.ds(0, 1)]
+    for _ in range(world - 1):
+        pltpu.make_async_copy(seg, seg, send_sem).wait()
+        pltpu.make_async_copy(srow, srow, send_sem).wait()
+    for _ in range(world - 1):
+        pltpu.make_async_copy(seg, seg, recv_sem).wait()
+        pltpu.make_async_copy(srow, srow, recv_sem).wait()
+
+
+def fast_all_to_all_shard(send, splits, *, axis, impl, interpret):
+    """Shard-level entry.  send: [world, max_tokens, H]; splits: [world] i32.
+    Returns (recv [world, max_tokens, H], recv_splits [world])."""
+    impl = resolve_impl(impl, interpret)
+    world, max_tokens, hidden = send.shape
+
+    if impl == "xla":
+        recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0,
+                                  tiled=False)
+        recv_splits = jax.lax.all_to_all(splits.reshape(world, 1), axis,
+                                         split_axis=0, concat_axis=0,
+                                         tiled=False).reshape(world)
+        return recv, recv_splits
+
+    return pl.pallas_call(
+        functools.partial(_a2a_kernel, axis=axis, world=world),
+        out_shape=[
+            jax.ShapeDtypeStruct((world, max_tokens, hidden), send.dtype),
+            jax.ShapeDtypeStruct((world,), jnp.int32),
+        ],
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True,
+            collective_id=A2A_COLLECTIVE_ID if world > 1 else None,
+        ),
+        interpret=maybe_interpret(interpret),
+    )(send, splits)
+
+
+def fast_all_to_all(send, splits, ctx: AllToAllContext):
+    """Host entry (reference: ``fast_all_to_all``, :189+).
+
+    send: [world*world, max_tokens, H] sharded P(axis) so each device holds
+    its [world, max_tokens, H] outgoing block; splits likewise.
+    """
+    w = ctx.world
+    expected = (w * w, ctx.max_tokens, ctx.hidden)
+    if tuple(send.shape) != expected:
+        raise ValueError(
+            f"send shape {tuple(send.shape)} != ctx sizing {expected} "
+            f"(world={w}, max_tokens={ctx.max_tokens}, hidden={ctx.hidden})")
+    fn = cached_shard_jit(
+        fast_all_to_all_shard,
+        ctx.mesh,
+        (P(ctx.axis), P(ctx.axis)),
+        (P(ctx.axis), P(ctx.axis)),
+        axis=ctx.axis, impl=ctx.impl, interpret=ctx.interpret,
+    )
+    return fn(send, splits)
+
+
+def all_to_all_post_process(recv, recv_splits):
+    """Flatten the padded receive buffer and compute the validity mask.
+
+    Reference analog: ``all_to_all_post_process`` (:251+), which compacts to
+    a dense [sum(splits), H] matrix — a dynamic shape, deliberately avoided
+    on TPU.  Instead returns (tokens [world*max_tokens, H] with padding rows
+    left in place, mask [world*max_tokens] bool aligned with the token rows);
+    downstream group-GEMM / reductions consume the mask.
+    """
+    world, max_tokens, hidden = recv.shape
+    idx = jax.lax.broadcasted_iota(jnp.int32, (world, max_tokens), 1)
+    mask = idx < recv_splits[:, None]
+    return recv.reshape(world * max_tokens, hidden), mask.reshape(-1)

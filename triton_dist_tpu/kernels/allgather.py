@@ -108,10 +108,10 @@ def _ring_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *, axis, world
     # docs).  Analog of barrier_all at op entry (allgather_gemm.py:100-116).
     barrier = pltpu.get_barrier_semaphore()
     left = jax.lax.rem(me + world - 1, world)
-    pltpu.semaphore_signal(barrier, inc=1, device_id=left,
-                           device_id_type=pltpu.DeviceIdType.LOGICAL)
-    pltpu.semaphore_signal(barrier, inc=1, device_id=right,
-                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_signal(barrier, inc=1, device_id={axis: left},
+                           device_id_type=pltpu.DeviceIdType.MESH)
+    pltpu.semaphore_signal(barrier, inc=1, device_id={axis: right},
+                           device_id_type=pltpu.DeviceIdType.MESH)
     pltpu.semaphore_wait(barrier, 2)
 
     def step(s, _):
@@ -122,8 +122,8 @@ def _ring_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *, axis, world
             dst_ref=src,
             send_sem=send_sem,
             recv_sem=recv_sem,
-            device_id=right,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
+            device_id={axis: right},
+            device_id_type=pltpu.DeviceIdType.MESH,
         )
         rdma.start()
         rdma.wait()
@@ -148,10 +148,10 @@ def _bidir_ring_ag_kernel(
     cp.wait()
 
     barrier = pltpu.get_barrier_semaphore()
-    pltpu.semaphore_signal(barrier, inc=1, device_id=left,
-                           device_id_type=pltpu.DeviceIdType.LOGICAL)
-    pltpu.semaphore_signal(barrier, inc=1, device_id=right,
-                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_signal(barrier, inc=1, device_id={axis: left},
+                           device_id_type=pltpu.DeviceIdType.MESH)
+    pltpu.semaphore_signal(barrier, inc=1, device_id={axis: right},
+                           device_id_type=pltpu.DeviceIdType.MESH)
     pltpu.semaphore_wait(barrier, 2)
 
     def step(s, _):
@@ -162,12 +162,12 @@ def _bidir_ring_ag_kernel(
         r_f = pltpu.make_async_remote_copy(
             src_ref=fwd, dst_ref=fwd,
             send_sem=send_sem.at[0], recv_sem=recv_sem.at[0],
-            device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL,
+            device_id={axis: right}, device_id_type=pltpu.DeviceIdType.MESH,
         )
         r_b = pltpu.make_async_remote_copy(
             src_ref=bwd, dst_ref=bwd,
             send_sem=send_sem.at[1], recv_sem=recv_sem.at[1],
-            device_id=left, device_id_type=pltpu.DeviceIdType.LOGICAL,
+            device_id={axis: left}, device_id_type=pltpu.DeviceIdType.MESH,
         )
         r_f.start()
         r_b.start()
@@ -193,8 +193,8 @@ def _full_mesh_push_ag_kernel(
     barrier = pltpu.get_barrier_semaphore()
     for i in range(1, world):
         peer = jax.lax.rem(me + i, world)
-        pltpu.semaphore_signal(barrier, inc=1, device_id=peer,
-                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(barrier, inc=1, device_id={axis: peer},
+                               device_id_type=pltpu.DeviceIdType.MESH)
     pltpu.semaphore_wait(barrier, world - 1)
 
     mine = out_ref.at[pl.ds(me * rows, rows)]
@@ -203,7 +203,7 @@ def _full_mesh_push_ag_kernel(
         pltpu.make_async_remote_copy(
             src_ref=mine, dst_ref=mine,
             send_sem=send_sem, recv_sem=recv_sem,
-            device_id=peer, device_id_type=pltpu.DeviceIdType.LOGICAL,
+            device_id={axis: peer}, device_id_type=pltpu.DeviceIdType.MESH,
         ).start()
     # Drain sends, then wait for the world-1 incoming chunks.
     for _ in range(world - 1):
@@ -240,19 +240,23 @@ def _ag_pallas_shard(x_shard, *, axis, world, method, interpret, collective_id=1
     )(x_shard)
 
 
-def all_gather_shard(x_shard, axis: str, method=AllGatherMethod.AUTO, interpret=False):
+def all_gather_shard(x_shard, axis: str, method=AllGatherMethod.AUTO,
+                     interpret=False, collective_id=1):
     """AllGather the leading dim of a per-device shard; use inside shard_map.
 
     Matches ``lax.all_gather(x, axis, tiled=True)`` semantics.
     """
     world = jax.lax.axis_size(axis)
+    if world == 1:
+        return x_shard
     if method is AllGatherMethod.AUTO:
         nbytes = int(np.prod(x_shard.shape)) * x_shard.dtype.itemsize
         method = choose_allgather_method(nbytes, world)
     if method is AllGatherMethod.XLA:
         return jax.lax.all_gather(x_shard, axis, axis=0, tiled=True)
     return _ag_pallas_shard(
-        x_shard, axis=axis, world=world, method=method, interpret=interpret
+        x_shard, axis=axis, world=world, method=method, interpret=interpret,
+        collective_id=collective_id,
     )
 
 

@@ -106,10 +106,10 @@ def _ag_gemm_kernel(
         # barrier_all: nobody writes into a peer that hasn't entered the
         # kernel).
         barrier = pltpu.get_barrier_semaphore()
-        pltpu.semaphore_signal(barrier, inc=1, device_id=left,
-                               device_id_type=pltpu.DeviceIdType.LOGICAL)
-        pltpu.semaphore_signal(barrier, inc=1, device_id=right,
-                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(barrier, inc=1, device_id={axis: left},
+                               device_id_type=pltpu.DeviceIdType.MESH)
+        pltpu.semaphore_signal(barrier, inc=1, device_id={axis: right},
+                               device_id_type=pltpu.DeviceIdType.MESH)
         pltpu.semaphore_wait(barrier, 2)
 
     K = a_ref.shape[1]
@@ -139,7 +139,7 @@ def _ag_gemm_kernel(
             pltpu.make_async_remote_copy(
                 src_ref=seg, dst_ref=seg,
                 send_sem=send_sem, recv_sem=recv_sem,
-                device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL,
+                device_id={axis: right}, device_id_type=pltpu.DeviceIdType.MESH,
             ).start()
 
         # Consume the segment: C[slot block, :] = A_seg @ B_loc on the MXU.

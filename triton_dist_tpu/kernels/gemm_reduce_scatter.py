@@ -131,10 +131,10 @@ def _gemm_rs_kernel(
     if world > 1:
         # Entry barrier with ring neighbors before any remote write.
         barrier = pltpu.get_barrier_semaphore()
-        pltpu.semaphore_signal(barrier, inc=1, device_id=left,
-                               device_id_type=pltpu.DeviceIdType.LOGICAL)
-        pltpu.semaphore_signal(barrier, inc=1, device_id=right,
-                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(barrier, inc=1, device_id={axis: left},
+                               device_id_type=pltpu.DeviceIdType.MESH)
+        pltpu.semaphore_signal(barrier, inc=1, device_id={axis: right},
+                               device_id_type=pltpu.DeviceIdType.MESH)
         pltpu.semaphore_wait(barrier, 2)
 
     for s in range(world):
@@ -166,8 +166,8 @@ def _gemm_rs_kernel(
                                   recv_sem.at[p]).wait()
             inner_add(recv_ref.at[p], dst, dst)
             # Slot p is now free for the left neighbor's step-(s+1) send.
-            pltpu.semaphore_signal(credit_sem, inc=1, device_id=left,
-                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+            pltpu.semaphore_signal(credit_sem, inc=1, device_id={axis: left},
+                                   device_id_type=pltpu.DeviceIdType.MESH)
 
         if not last:
             if s >= 2:
@@ -179,8 +179,8 @@ def _gemm_rs_kernel(
                 dst_ref=recv_ref.at[(s + 1) % 2],
                 send_sem=send_sem.at[p],
                 recv_sem=recv_sem.at[(s + 1) % 2],
-                device_id=right,
-                device_id_type=pltpu.DeviceIdType.LOGICAL,
+                device_id={axis: right},
+                device_id_type=pltpu.DeviceIdType.MESH,
             ).start()
 
     if world > 1:

@@ -1,0 +1,116 @@
+"""Latency-optimized AllGather — the SP-decode communication primitive.
+
+Reference analog: ``python/triton_dist/kernels/nvidia/low_latency_allgather.py``
+— pull / push-2D / push-3D / NUMA-2D variants plus the **LL protocol**: values
+packed with flags as int2 pairs so the receiver spins on the data itself with
+no separate signal (:549-568, `_recv_ll_block` :531-547), double-buffered by a
+generation counter; dispatcher ``fast_allgather`` (:971+).
+
+TPU-native design (NOT a port — see SURVEY.md §7 hard part 5):
+
+* The LL trick exists because on NVLink a signal is a *second* transaction;
+  packing flag-with-value makes arrival self-announcing.  On TPU the recv
+  semaphore update is part of the same DMA transaction — arrival is already
+  self-announcing.  So the TPU "LL protocol" is simply: one-shot full-mesh
+  push of the whole (small) payload, recv-semaphore gated, which is the
+  ``FULL_MESH_PUSH`` kernel.  No flags, no generation counters (fresh
+  semaphores per invocation), no reset kernels.
+* The reference's push-2D/3D hierarchy (intra-node staged + inter-node)
+  maps to two mesh axes: gather along the minor (ICI) axis first, then the
+  major axis — ``fast_allgather_2d``.
+* The payload-packing *use* of LL buffers (flash-decode's (out ⊕ lse) in
+  one buffer, sp_flash_decode_layer.py:135-137) is kept: ``pack_payload`` /
+  ``unpack_payload`` below, consumed by ``kernels/flash_decode.py``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.kernels.allgather import (
+    AllGatherMethod,
+    all_gather_shard,
+)
+from triton_dist_tpu.kernels.gemm import resolve_impl
+from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
+
+
+@dataclass
+class FastAllGatherContext:
+    """Reference analog: ``FastAllGatherContext`` (:781-820)."""
+
+    mesh: Mesh
+    axis: str = "tp"
+    inter_axis: str | None = None  # 2-level gather (DCN/multi-slice tier)
+    impl: str = "auto"
+    interpret: bool = False
+
+    @property
+    def world(self) -> int:
+        w = self.mesh.shape[self.axis]
+        if self.inter_axis:
+            w *= self.mesh.shape[self.inter_axis]
+        return w
+
+
+def create_fast_ag_context(mesh, axis="tp", inter_axis=None, impl="auto",
+                           interpret=False) -> FastAllGatherContext:
+    return FastAllGatherContext(mesh=mesh, axis=axis, inter_axis=inter_axis,
+                                impl=impl, interpret=interpret)
+
+
+def fast_allgather_shard(x_shard, *, axis, inter_axis, impl, interpret):
+    """Latency-tuned gather of a small per-device shard (leading dim).
+
+    1-level: one-shot full-mesh push.  2-level: minor (ICI) axis first, then
+    major — the reference's push-2D staging (:612-698) without the staging
+    buffers (ICI routes multi-hop natively).
+    """
+    impl = resolve_impl(impl, interpret)
+    method = (AllGatherMethod.XLA if impl == "xla"
+              else AllGatherMethod.FULL_MESH_PUSH)
+    out = all_gather_shard(x_shard, axis, method=method, interpret=interpret)
+    if inter_axis is not None:
+        # Distinct collective_id: a second barrier semaphore for the second
+        # device set (the DCN/major tier).
+        out = all_gather_shard(out, inter_axis, method=method,
+                               interpret=interpret, collective_id=6)
+    return out
+
+
+def fast_allgather(x, ctx: FastAllGatherContext):
+    """Host entry (reference dispatcher ``fast_allgather`` :971+)."""
+    in_spec = (P((ctx.inter_axis, ctx.axis)) if ctx.inter_axis
+               else P(ctx.axis))
+    fn = cached_shard_jit(
+        fast_allgather_shard,
+        ctx.mesh,
+        in_spec,
+        P(),
+        axis=ctx.axis, inter_axis=ctx.inter_axis, impl=ctx.impl,
+        interpret=ctx.interpret,
+    )
+    return fn(x)
+
+
+# ---------------------------------------------------------------------------
+# Payload packing (flash-decode partials: out ⊕ lse in one gather)
+# ---------------------------------------------------------------------------
+
+
+def pack_payload(out, lse):
+    """[B, H, D] f32 partials + [B, H] lse -> [B, H, D+1] single buffer.
+
+    Reference: the decode layer packs lse into the last column of the AG
+    buffer so one LL gather moves both (sp_flash_decode_layer.py:135-137).
+    """
+    return jnp.concatenate([out, lse[..., None]], axis=-1)
+
+
+def unpack_payload(buf):
+    """[W, B, H, D+1] -> ([W, B, H, D], [W, B, H])."""
+    return buf[..., :-1], buf[..., -1]

@@ -84,10 +84,10 @@ def _ring_rs_kernel(
     left = jax.lax.rem(me + world - 1, world)
 
     barrier = pltpu.get_barrier_semaphore()
-    pltpu.semaphore_signal(barrier, inc=1, device_id=left,
-                           device_id_type=pltpu.DeviceIdType.LOGICAL)
-    pltpu.semaphore_signal(barrier, inc=1, device_id=right,
-                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_signal(barrier, inc=1, device_id={axis: left},
+                           device_id_type=pltpu.DeviceIdType.MESH)
+    pltpu.semaphore_signal(barrier, inc=1, device_id={axis: right},
+                           device_id_type=pltpu.DeviceIdType.MESH)
     pltpu.semaphore_wait(barrier, 2)
 
     def load_chunk(slot, dst):
@@ -108,8 +108,8 @@ def _ring_rs_kernel(
             acc_buf[:] = local_buf[:] + recv_buf[:]
             # recv_buf consumed → give the left neighbor its send credit.
             pltpu.semaphore_signal(
-                credit_sem, inc=1, device_id=left,
-                device_id_type=pltpu.DeviceIdType.LOGICAL,
+                credit_sem, inc=1, device_id={axis: left},
+                device_id_type=pltpu.DeviceIdType.MESH,
             )
 
         @pl.when(s > 0)
@@ -120,7 +120,7 @@ def _ring_rs_kernel(
         rdma = pltpu.make_async_remote_copy(
             src_ref=acc_buf, dst_ref=recv_buf,
             send_sem=send_sem, recv_sem=recv_sem,
-            device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL,
+            device_id={axis: right}, device_id_type=pltpu.DeviceIdType.MESH,
         )
         rdma.start()
         rdma.wait()

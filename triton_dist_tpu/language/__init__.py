@@ -26,13 +26,15 @@ reference primitive                TPU-native implementation
 ``dl.consume_token``               not needed: semaphore waits order
                                    subsequent ref reads in Mosaic's effect
                                    system (SSA token dance is a Triton-ism)
-``dl.notify(ptr, rank, sig_op)``   ``notify(sem, device_id, inc)`` —
-                                   pltpu.semaphore_signal (always ADD; SET is
-                                   not exposed by hardware — see docs/design.md)
+``dl.notify(ptr, rank, sig_op)``   ``notify(sem, axis=a, device_id=pe,
+                                   inc=v)`` — pltpu.semaphore_signal (always
+                                   ADD; SET is not exposed by hardware).
+                                   ``device_id`` indexes along mesh axis ``a``
 ``dl.symm_at(ptr, rank)``          remote refs are addressed *per-copy* by
                                    ``device_id`` (symm_at returns no pointer —
                                    see ``remote_copy``'s dst semantics)
-``libshmem_device.putmem_block``   ``putmem(src, dst, sems, device_id)`` —
+``libshmem_device.putmem_block``   ``putmem(src, dst, send_sem, recv_sem,
+                                   axis, device_id)`` —
                                    pltpu.make_async_remote_copy (+.start)
 ``putmem_signal[_nbi]_block``      ``putmem_signal(...)`` — remote DMA whose
                                    recv semaphore IS the signal (fused, like
@@ -41,7 +43,8 @@ reference primitive                TPU-native implementation
                                    by hardware)
 ``getmem_*``                       ``getmem(...)`` — remote DMA with remote
                                    src (pull); TPU DMA engines support both
-``signal_op(sig, val, ADD, pe)``   ``notify(sem, pe, val)``
+``signal_op(sig, val, ADD, pe)``   ``notify(sem, axis=a, device_id=pe,
+                                   inc=val)``
 ``signal_wait_until(sig, GE, v)``  ``wait(sem, v)`` (decrements; see note)
 ``fence()`` / ``quiet()``          ``fence()`` — wait on outstanding send
                                    semaphores (explicit, per-copy on TPU)

@@ -47,27 +47,34 @@ def wait(sem, value=1):
     pltpu.semaphore_wait(sem, value)
 
 
-def notify(sem, device_id=None, inc=1):
+def notify(sem, *, axis=None, device_id=None, inc=1):
     """Signal (atomically add to) a semaphore, optionally on a remote device.
 
     Reference: ``dl.notify(ptr, rank, signal_op=ADD, comm_scope)``
     (DistributedOps.td:151-164) and ``libshmem_device.signal_op``.
-    ``device_id=None`` signals the local semaphore.
+    ``device_id`` is the peer's index *along the mesh axis* ``axis`` (other
+    mesh axes default to the caller's own coordinates, so addressing stays
+    correct on multi-axis dp x tp meshes); ``device_id=None`` signals the
+    local semaphore.
     """
     if device_id is None:
         pltpu.semaphore_signal(sem, inc=inc)
     else:
+        if not isinstance(axis, str):
+            raise TypeError(
+                f"notify(device_id=...) needs axis=<mesh axis name>, got {axis!r}")
         pltpu.semaphore_signal(
             sem,
             inc=inc,
-            device_id=device_id,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
+            device_id={axis: device_id},
+            device_id_type=pltpu.DeviceIdType.MESH,
         )
 
 
-def remote_copy(src_ref, dst_ref, send_sem, recv_sem, device_id):
+def remote_copy(src_ref, dst_ref, send_sem, recv_sem, axis, device_id):
     """Build (not start) an async remote copy: local ``src_ref`` → ``dst_ref``
-    on logical device ``device_id``.
+    on the peer at index ``device_id`` along mesh axis ``axis`` (other mesh
+    axes keep the caller's own coordinates).
 
     Reference: the ``symm_at`` + ``putmem`` pair (DistributedOps.td:135-149 +
     libnvshmem_device putmem family).  NVSHMEM's model is "translate a
@@ -82,24 +89,24 @@ def remote_copy(src_ref, dst_ref, send_sem, recv_sem, device_id):
         dst_ref=dst_ref,
         send_sem=send_sem,
         recv_sem=recv_sem,
-        device_id=device_id,
-        device_id_type=pltpu.DeviceIdType.LOGICAL,
+        device_id={axis: device_id},
+        device_id_type=pltpu.DeviceIdType.MESH,
     )
 
 
-def putmem(src_ref, dst_ref, send_sem, recv_sem, device_id):
+def putmem(src_ref, dst_ref, send_sem, recv_sem, axis, device_id):
     """Start a non-blocking put (reference: ``putmem_nbi_block``).
 
     Returns the in-flight copy; call ``.wait_send()`` before reusing
     ``src_ref`` (NVSHMEM's ``quiet``), and the *receiver* waits on
     ``recv_sem`` for arrival.
     """
-    cp = remote_copy(src_ref, dst_ref, send_sem, recv_sem, device_id)
+    cp = remote_copy(src_ref, dst_ref, send_sem, recv_sem, axis, device_id)
     cp.start()
     return cp
 
 
-def putmem_signal(src_ref, dst_ref, send_sem, recv_sem, device_id):
+def putmem_signal(src_ref, dst_ref, send_sem, recv_sem, axis, device_id):
     """Put + arrival signal, fused (reference: ``putmem_signal_nbi_block``).
 
     On TPU the recv semaphore *is* the signal and is hardware-ordered after
@@ -107,10 +114,10 @@ def putmem_signal(src_ref, dst_ref, send_sem, recv_sem, device_id):
     (NotifyOpConversion, DistributedOpToLLVM.cpp:231-340) is unnecessary.
     The receiver does ``wait(recv_sem)`` then reads ``dst_ref`` directly.
     """
-    return putmem(src_ref, dst_ref, send_sem, recv_sem, device_id)
+    return putmem(src_ref, dst_ref, send_sem, recv_sem, axis, device_id)
 
 
-def getmem(src_ref, dst_ref, send_sem, recv_sem, device_id):
+def getmem(src_ref, dst_ref, send_sem, recv_sem, axis, device_id):
     """Start a non-blocking get: remote ``src_ref`` on ``device_id`` → local
     ``dst_ref`` (reference: ``getmem_nbi_block``).  Pull-style AG variants
     use this (allgather.py full-mesh *pull*)."""
@@ -119,8 +126,8 @@ def getmem(src_ref, dst_ref, send_sem, recv_sem, device_id):
         dst_ref=dst_ref,
         send_sem=send_sem,
         recv_sem=recv_sem,
-        device_id=device_id,
-        device_id_type=pltpu.DeviceIdType.LOGICAL,
+        device_id={axis: device_id},
+        device_id_type=pltpu.DeviceIdType.MESH,
     )
     cp.start()
     return cp
@@ -159,7 +166,8 @@ def barrier_all(axis: str, sem=None):
     def body(i, _):
         peer = jax.lax.rem(me + i, n)
         pltpu.semaphore_signal(
-            bsem, inc=1, device_id=peer, device_id_type=pltpu.DeviceIdType.LOGICAL
+            bsem, inc=1, device_id={axis: peer},
+            device_id_type=pltpu.DeviceIdType.MESH,
         )
         return 0
 
