@@ -1,0 +1,124 @@
+"""MoE routing + token sort/align — feeder for the grouped GEMM.
+
+Reference analog: ``csrc/moe_utils.cu`` — the CUDA kernel
+``moe_ag_scatter_align_block_size`` (serial + parallel variants, :61-356)
+sorts gathered tokens by expert and pads each expert's row range to the
+GEMM block size so every tile is single-expert; plus the host-side topk
+preprocessing in ``create_moe_rs_context`` (moe_reduce_rs.py:278+).
+
+TPU-native design: the sort/align runs **on device** as XLA ops (argsort +
+cumsum — no host round trip, where the reference needs a custom CUDA kernel
+and a pinned-memory readback).  Shapes stay static: the padded total is the
+worst-case ``round_up(T*topk + E*(block_m-1), block_m)``, the TPU answer to
+dynamic expert loads (SURVEY.md §7 hard part 2).
+
+Data flow (matching the reference's GroupGEMM contract):
+
+  tokens [T, D], router logits [T, E]
+  -> topk_routing: weights/experts [T, topk]
+  -> sort_align(block_m): dest row for every (token, k) pair, per-tile
+     expert map, padded row count
+  -> gather_sorted: x_sorted [M_pad, D] (padding rows zero)
+  -> group_gemm (kernels/group_gemm.py): y_sorted [M_pad, F]
+  -> combine_topk: out [T, F] = sum_k w[t,k] * y_sorted[dest[t,k]]
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def padded_rows(n_assignments: int, n_experts: int, block_m: int) -> int:
+    """Static worst-case row count after per-expert padding."""
+    return round_up(n_assignments + n_experts * (block_m - 1), block_m)
+
+
+def topk_routing(logits, topk: int):
+    """Softmax-then-topk router (the reference tests' torch preprocessing).
+
+    Returns (weights [T, topk] normalized, experts [T, topk] int32).
+    """
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    weights, experts = jax.lax.top_k(probs, topk)
+    weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    return weights, experts.astype(jnp.int32)
+
+
+def sort_align(experts, n_experts: int, block_m: int):
+    """Stable-sort (token, k) pairs by expert and align groups to block_m.
+
+    experts: [T, topk] int32.  Returns a dict:
+      dest      [T*topk]  destination row of each assignment in the sorted buf
+      tile_expert [M_pad // block_m] expert id of every row tile
+      valid_rows  [M_pad] bool — False for padding rows
+      m_pad     int (static)
+
+    Reference: moe_ag_scatter_align_block_size (moe_utils.cu:61-356) —
+    same outputs (sorted ids, expert offsets, padded sizes), computed with
+    argsort+cumsum instead of a hand-written counting kernel.
+    """
+    T, topk = experts.shape
+    n = T * topk
+    flat = experts.reshape(-1)
+    m_pad = padded_rows(n, n_experts, block_m)
+
+    counts = jnp.bincount(flat, length=n_experts)
+    padded_counts = round_up_arr(counts, block_m)
+    group_starts = jnp.concatenate(
+        [jnp.zeros((1,), counts.dtype), jnp.cumsum(padded_counts)[:-1]])
+
+    # Stable order within an expert = original (token, k) order.
+    order = jnp.argsort(flat, stable=True)          # sorted pos -> flat idx
+    sorted_experts = flat[order]
+    # Rank within group: position among same-expert assignments.
+    seg_starts = jnp.concatenate(
+        [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]])
+    rank_in_group = jnp.arange(n, dtype=counts.dtype) - seg_starts[sorted_experts]
+
+    dest_sorted = group_starts[sorted_experts] + rank_in_group  # row per sorted pos
+    dest = jnp.zeros((n,), jnp.int32).at[order].set(
+        dest_sorted.astype(jnp.int32))
+
+    n_tiles = m_pad // block_m
+    tile_rows = jnp.arange(n_tiles) * block_m
+    group_ends = jnp.cumsum(padded_counts)
+    tile_expert = jnp.searchsorted(group_ends, tile_rows, side="right")
+    tile_expert = jnp.minimum(tile_expert, n_experts - 1).astype(jnp.int32)
+
+    valid = jnp.zeros((m_pad,), bool).at[dest].set(True)
+    return {"dest": dest, "tile_expert": tile_expert,
+            "valid_rows": valid, "m_pad": m_pad}
+
+
+def round_up_arr(x, m: int):
+    return (x + m - 1) // m * m
+
+
+def gather_sorted(x, dest, m_pad: int):
+    """Scatter token rows into the expert-sorted padded buffer.
+
+    x: [T, D]; dest: [T*topk] rows.  Padding rows stay zero so they
+    contribute nothing downstream.
+    """
+    T, D = x.shape
+    topk = dest.shape[0] // T
+    token_of = jnp.arange(dest.shape[0]) // topk
+    return jnp.zeros((m_pad, D), x.dtype).at[dest].set(x[token_of])
+
+
+def combine_topk(y_sorted, dest, weights, out_dtype=None):
+    """out[t] = sum_k weights[t, k] * y_sorted[dest[t, k]].
+
+    Reference: the topk-reduce in consumer_reduce_scatter_reduce_2d
+    (moe_reduce_rs.py:817+).
+    """
+    T, topk = weights.shape
+    gathered = y_sorted[dest.reshape(T, topk)]          # [T, topk, F]
+    out = jnp.einsum("tk,tkf->tf", weights.astype(jnp.float32),
+                     gathered.astype(jnp.float32))
+    return out.astype(out_dtype or y_sorted.dtype)
