@@ -35,7 +35,20 @@ from triton_dist_tpu.kernels.gemm_reduce_scatter import (  # noqa: F401
     gemm_rs,
     create_gemm_rs_context,
 )
+from triton_dist_tpu.kernels.low_latency_allgather import (  # noqa: F401
+    fast_allgather,
+    create_fast_ag_context,
+)
+from triton_dist_tpu.kernels.all_to_all import (  # noqa: F401
+    fast_all_to_all,
+    all_to_all_post_process,
+    create_all_to_all_context,
+)
+from triton_dist_tpu.kernels.flash_decode import (  # noqa: F401
+    gqa_decode_shard,
+    sp_gqa_decode,
+    create_sp_decode_context,
+)
 
 # Overlapped / model-level kernels land as the build progresses:
-# low_latency_allgather, all_to_all, flash_decode, moe_reduce_rs,
-# allgather_group_gemm (see SURVEY.md §7).
+# moe_reduce_rs, allgather_group_gemm (see SURVEY.md §7).

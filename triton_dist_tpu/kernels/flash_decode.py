@@ -1,0 +1,306 @@
+"""Distributed GQA flash-decode — sequence-parallel attention over sharded KV.
+
+Reference analog: ``python/triton_dist/kernels/nvidia/flash_decode.py`` — the
+reference's long-context scaling story (SURVEY.md §5): each rank runs split-KV
+flash-decode on its KV shard (:129-280), combines its own splits (:392-480),
+then the ranks' partial (out, lse) pairs are allgathered and merged by an
+LSE-weighted online-softmax combine (`kernel_inter_rank_gqa_fwd_batch_decode_
+combine_kv`, :481-532).
+
+TPU-native design (NOT a port):
+
+* **Split-KV + intra-rank combine collapse into one kernel.**  The GPU
+  version launches parallel KV splits and then a combine kernel because CUDA
+  blocks run concurrently.  TPU Pallas grids are *sequential* per core, so
+  the split dimension becomes the KV-chunk grid axis with an online-softmax
+  accumulator carried in VMEM scratch across iterations — the Mosaic pipeline
+  overlaps the next chunk's HBM→VMEM DMA with the current chunk's compute,
+  which is exactly the latency-hiding the GPU gets from parallel splits
+  (decode is HBM-bandwidth-bound; the MXU is never the bottleneck).
+* **Inter-rank combine stays**, but as a tiny fused XLA epilogue on the
+  gathered [world, B, H, D+1] buffer rather than a hand-written kernel — at
+  decode sizes it is a few KB and XLA fuses it into one elementwise pass.
+* The (out ⊕ lse) payload packing of the reference's decode layer
+  (sp_flash_decode_layer.py:135-137) is kept: one latency-optimized gather
+  moves both (``low_latency_allgather.pack_payload``).
+* Per-batch KV lengths ride as **scalar-prefetch** arguments (SMEM), the
+  Pallas analog of the reference's ``gqa_fwd_batch_decode`` kv_lens tensor.
+
+Layout contract (shard level, inside shard_map over ``axis``):
+  q:        [B, Hq, D]        replicated (decode queries are tiny)
+  k/v:      [B, Hkv, S_loc, D] sequence-sharded KV cache (head-major so a
+                               KV chunk is one contiguous DMA)
+  kv_lens:  [B] int32          *global* sequence lengths
+  out:      [B, Hq, D]
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.kernels.gemm import resolve_impl
+from triton_dist_tpu.kernels.low_latency_allgather import (
+    fast_allgather_shard,
+    pack_payload,
+    unpack_payload,
+)
+from triton_dist_tpu.language.interpret import maybe_interpret
+from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
+
+NEG_INF = -1.0e30  # finite -inf proxy: survives exp/log without NaNs
+
+SP_DECODE_COLLECTIVE_ID = 7
+
+
+# ---------------------------------------------------------------------------
+# Local shard kernel: online-softmax split-KV decode
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
+                   acc_ref, m_ref, l_ref, *, block_s, n_s, scale):
+    """Grid (B, Hkv, n_s); one (batch, kv-head) pair accumulates across the
+    sequential KV-chunk axis.
+
+    Reference analog: ``kernel_gqa_fwd_batch_decode_split_kv``
+    (flash_decode.py:129-280) — the Triton version parallelizes over splits
+    and re-merges; here the s axis is sequential so the merge is the loop.
+    """
+    b = pl.program_id(0)
+    s = pl.program_id(2)
+
+    @pl.when(s == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    llen = lens_ref[b]  # valid KV rows in *this shard* for batch b
+
+    # Chunks entirely past the valid length are compute-skipped (their DMAs
+    # still stream in; the pipeline cannot be shortened data-dependently).
+    @pl.when(s * block_s < llen)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)          # [G, D]
+        k = k_ref[0, 0].astype(jnp.float32)          # [bs, D]
+        v = v_ref[0, 0].astype(jnp.float32)          # [bs, D]
+
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale        # [G, bs]
+        pos = s * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, 1)
+        valid = pos < llen
+        logits = jnp.where(valid, logits, NEG_INF)
+
+        m_cur = m_ref[:]                                        # [G, 128]
+        row_max = jnp.max(logits, axis=-1, keepdims=True)       # [G, 1]
+        m_new = jnp.maximum(m_cur, row_max)                     # [G, 128]
+        alpha = jnp.exp(m_cur[:, :1] - m_new[:, :1])            # [G, 1]
+        p = jnp.where(valid, jnp.exp(logits - m_new[:, :1]), 0.0)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+
+    @pl.when(s == n_s - 1)
+    def _():
+        l = l_ref[:]                                            # [G, 128]
+        nonempty = l > 0.0  # rank's shard may be wholly past kv_len
+        out_ref[0, 0] = jnp.where(nonempty[:, :1], acc_ref[:] / jnp.where(
+            nonempty[:, :1], l[:, :1], 1.0), 0.0)
+        # lse rides a full-lane [G, 128] buffer (every lane the same value):
+        # Mosaic requires output block lane dims of 128 or the full array dim.
+        lse_ref[0, 0] = jnp.where(
+            nonempty, m_ref[:] + jnp.log(jnp.where(nonempty, l, 1.0)),
+            NEG_INF)
+
+
+def _local_decode_xla(q, k, v, local_lens, *, scale):
+    """Dense fallback for ragged shapes / non-TPU (reference analog: the
+    non-TMA dispatch path).  Same (out, lse) contract as the Pallas kernel."""
+    B, Hq, D = q.shape
+    _, Hkv, S, _ = k.shape
+    g = Hq // Hkv
+    qf = q.astype(jnp.float32).reshape(B, Hkv, g, D)
+    logits = jnp.einsum("bhgd,bhsd->bhgs", qf, k.astype(jnp.float32)) * scale
+    valid = jnp.arange(S)[None, :] < local_lens[:, None]        # [B, S]
+    logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1)                                # [B, Hkv, g]
+    # All-masked rows: keep everything finite, flag via lse = NEG_INF.
+    nonempty = m > NEG_INF / 2
+    p = jnp.where(valid[:, None, None, :],
+                  jnp.exp(logits - m[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum("bhgs,bhsd->bhgd", p, v.astype(jnp.float32))
+    out = jnp.where(nonempty[..., None],
+                    out / jnp.where(nonempty, l, 1.0)[..., None], 0.0)
+    lse = jnp.where(nonempty, m + jnp.log(jnp.where(nonempty, l, 1.0)),
+                    NEG_INF)
+    return out.reshape(B, Hq, D), lse.reshape(B, Hq)
+
+
+def gqa_decode_shard(q, k, v, local_lens, *, block_s=512, impl="auto",
+                     interpret=False):
+    """Single-shard GQA decode: q [B, Hq, D], k/v [B, Hkv, S_loc, D],
+    local_lens [B] (valid rows in this shard).  Returns float32 partials
+    (out [B, Hq, D], lse [B, Hq]).
+
+    Reference analog: ``gqa_fwd_batch_decode_intra_rank``
+    (flash_decode.py:763-860) minus the separate combine launch.
+    """
+    B, Hq, D = q.shape
+    _, Hkv, S, _ = k.shape
+    assert Hq % Hkv == 0, (Hq, Hkv)
+    g = Hq // Hkv
+    scale = 1.0 / math.sqrt(D)
+    impl = resolve_impl(impl, interpret)
+
+    def shapes_ok():
+        return D % 128 == 0 and S % 128 == 0
+
+    if impl == "xla" or not shapes_ok():
+        return _local_decode_xla(q, k, v, local_lens, scale=scale)
+
+    bs = block_s
+    while S % bs:
+        bs //= 2
+    bs = max(bs, 128)
+    n_s = S // bs
+
+    qg = q.reshape(B, Hkv, g, D)
+    grid = (B, Hkv, n_s)
+    out, lse = pl.pallas_call(
+        functools.partial(_decode_kernel, block_s=bs, n_s=n_s, scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, g, D), lambda b, h, s, lens: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, bs, D), lambda b, h, s, lens: (b, h, s, 0)),
+                pl.BlockSpec((1, 1, bs, D), lambda b, h, s, lens: (b, h, s, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, g, D), lambda b, h, s, lens: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, g, 128),
+                             lambda b, h, s, lens: (b, h, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((g, D), jnp.float32),
+                pltpu.VMEM((g, 128), jnp.float32),
+                pltpu.VMEM((g, 128), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hkv, g, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hkv, g, 128), jnp.float32),
+        ],
+        interpret=maybe_interpret(interpret),
+    )(local_lens, qg, k, v)
+    return out.reshape(B, Hq, D), lse[..., 0].reshape(B, Hq)
+
+
+# ---------------------------------------------------------------------------
+# Inter-rank combine
+# ---------------------------------------------------------------------------
+
+
+def combine_partials(outs, lses):
+    """LSE-weighted merge of per-rank partials: outs [W, B, H, D] f32,
+    lses [W, B, H] f32 -> [B, H, D] f32.
+
+    Reference analog: ``kernel_inter_rank_gqa_fwd_batch_decode_combine_kv``
+    (flash_decode.py:481-532) — the same online-softmax rescale, as a fused
+    XLA elementwise pass instead of a hand kernel (decode partials are KB).
+    """
+    m = jnp.max(lses, axis=0, keepdims=True)                    # [1, B, H]
+    w = jnp.exp(lses - m)                                       # [W, B, H]
+    denom = jnp.sum(w, axis=0)                                  # [B, H]
+    out = jnp.sum(outs * w[..., None], axis=0)                  # [B, H, D]
+    return out / denom[..., None]
+
+
+# ---------------------------------------------------------------------------
+# Sequence-parallel decode (shard + host entries)
+# ---------------------------------------------------------------------------
+
+
+def sp_gqa_decode_shard(q, k_shard, v_shard, kv_lens, *, axis, block_s=512,
+                        impl="auto", interpret=False):
+    """Per-device SP decode: local split-KV partials -> one-shot LL gather of
+    (out ⊕ lse) -> LSE combine.  ``kv_lens`` are GLOBAL lengths; the shard
+    owns global rows [me*S_loc, (me+1)*S_loc).
+
+    Reference analog: ``SpGQAFlashDecodeAttention.forward``
+    (sp_flash_decode_layer.py:78-184).
+    """
+    B, Hq, D = q.shape
+    S_loc = k_shard.shape[2]
+    me = jax.lax.axis_index(axis)
+    world = jax.lax.axis_size(axis)
+    local_lens = jnp.clip(kv_lens - me * S_loc, 0, S_loc).astype(jnp.int32)
+
+    out, lse = gqa_decode_shard(q, k_shard, v_shard, local_lens,
+                                block_s=block_s, impl=impl,
+                                interpret=interpret)
+    if world == 1:
+        return out.astype(q.dtype)
+
+    # Decode partials are KB-sized: latency-bound — delegate to the shared
+    # LL-gather policy (the reference's LL-protocol gather role).
+    packed = pack_payload(out, lse)                             # [B, H, D+1]
+    gathered = fast_allgather_shard(packed, axis=axis, impl=impl,
+                                    interpret=interpret,
+                                    collective_id=SP_DECODE_COLLECTIVE_ID)
+    gathered = gathered.reshape(world, B, Hq, D + 1)
+    outs, lses = unpack_payload(gathered)
+    return combine_partials(outs, lses).astype(q.dtype)
+
+
+@dataclass
+class SpDecodeContext:
+    """Sizing/mesh context (reference analog: the create_*_context factories,
+    flash_decode.py:534-585)."""
+
+    mesh: Mesh
+    axis: str = "sp"
+    block_s: int = 512
+    impl: str = "auto"
+    interpret: bool = False
+
+    @property
+    def world(self) -> int:
+        return self.mesh.shape[self.axis]
+
+
+def create_sp_decode_context(mesh, axis="sp", block_s=512, impl="auto",
+                             interpret=False) -> SpDecodeContext:
+    return SpDecodeContext(mesh=mesh, axis=axis, block_s=block_s, impl=impl,
+                           interpret=interpret)
+
+
+def sp_gqa_decode(q, k_cache, v_cache, kv_lens, ctx: SpDecodeContext):
+    """Host entry.  q [B, Hq, D] replicated; k/v_cache [B, Hkv, S, D] sharded
+    on the sequence dim over ``ctx.axis``; kv_lens [B] global lengths.
+    Returns [B, Hq, D] replicated.
+
+    Reference analog: ``gqa_fwd_batch_decode`` host wrappers
+    (flash_decode.py:763-1160).
+    """
+    fn = cached_shard_jit(
+        sp_gqa_decode_shard,
+        ctx.mesh,
+        (P(), P(None, None, ctx.axis), P(None, None, ctx.axis), P()),
+        P(),
+        axis=ctx.axis, block_s=ctx.block_s, impl=ctx.impl,
+        interpret=ctx.interpret,
+    )
+    return fn(q, k_cache, v_cache, kv_lens)

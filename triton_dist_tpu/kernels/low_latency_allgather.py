@@ -63,17 +63,21 @@ def create_fast_ag_context(mesh, axis="tp", inter_axis=None, impl="auto",
                                 impl=impl, interpret=interpret)
 
 
-def fast_allgather_shard(x_shard, *, axis, inter_axis, impl, interpret):
+def fast_allgather_shard(x_shard, *, axis, inter_axis=None, impl="auto",
+                         interpret=False, collective_id=1):
     """Latency-tuned gather of a small per-device shard (leading dim).
 
     1-level: one-shot full-mesh push.  2-level: minor (ICI) axis first, then
     major — the reference's push-2D staging (:612-698) without the staging
-    buffers (ICI routes multi-hop natively).
+    buffers (ICI routes multi-hop natively).  This is THE latency-gather
+    policy: ops gathering small payloads (flash-decode partials etc.) call
+    this rather than picking a method themselves.
     """
     impl = resolve_impl(impl, interpret)
     method = (AllGatherMethod.XLA if impl == "xla"
               else AllGatherMethod.FULL_MESH_PUSH)
-    out = all_gather_shard(x_shard, axis, method=method, interpret=interpret)
+    out = all_gather_shard(x_shard, axis, method=method, interpret=interpret,
+                           collective_id=collective_id)
     if inter_axis is not None:
         # Distinct collective_id: a second barrier semaphore for the second
         # device set (the DCN/major tier).

@@ -29,7 +29,8 @@ import jax
 import jax.numpy as jnp
 
 
-def round_up(x: int, m: int) -> int:
+def round_up(x, m: int):
+    """Round up to a multiple of ``m`` (works on ints and jnp arrays)."""
     return (x + m - 1) // m * m
 
 
@@ -68,7 +69,7 @@ def sort_align(experts, n_experts: int, block_m: int):
     m_pad = padded_rows(n, n_experts, block_m)
 
     counts = jnp.bincount(flat, length=n_experts)
-    padded_counts = round_up_arr(counts, block_m)
+    padded_counts = round_up(counts, block_m)
     group_starts = jnp.concatenate(
         [jnp.zeros((1,), counts.dtype), jnp.cumsum(padded_counts)[:-1]])
 
@@ -93,10 +94,6 @@ def sort_align(experts, n_experts: int, block_m: int):
     valid = jnp.zeros((m_pad,), bool).at[dest].set(True)
     return {"dest": dest, "tile_expert": tile_expert,
             "valid_rows": valid, "m_pad": m_pad}
-
-
-def round_up_arr(x, m: int):
-    return (x + m - 1) // m * m
 
 
 def gather_sorted(x, dest, m_pad: int):

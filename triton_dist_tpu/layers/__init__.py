@@ -13,3 +13,6 @@ from triton_dist_tpu.layers.tp_linear import (  # noqa: F401
     column_parallel_linear,
     row_parallel_linear,
 )
+from triton_dist_tpu.layers.sp_flash_decode import (  # noqa: F401
+    SpGQAFlashDecodeAttention,
+)

@@ -1,0 +1,123 @@
+"""Sequence-parallel GQA flash-decode attention layer.
+
+Reference analog: ``python/triton_dist/layers/nvidia/sp_flash_decode_layer.py``
+(``SpGQAFlashDecodeAttention``, :43-184): local split-KV decode → LL allgather
+of per-rank partials (out ⊕ lse packed) → inter-rank LSE combine, plus
+management of the gather buffer and the KV cache.
+
+TPU-native differences:
+* No symm-buffer grow/shrink machinery (:111-132) — buffers are jax.Arrays
+  sized by the call's shapes; XLA owns allocation.
+* The KV cache is a sequence-sharded jax.Array; appending a decoded token is
+  an owner-ranked dynamic-update inside shard_map (each rank updates only the
+  rows it owns) instead of host-side index writes into a symmetric tensor.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.kernels.flash_decode import (
+    SpDecodeContext,
+    create_sp_decode_context,
+    sp_gqa_decode,
+)
+from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
+
+
+def append_kv_shard(k_cache, v_cache, new_k, new_v, kv_lens, *, axis):
+    """Per-device append of one token's K/V at global position ``kv_lens[b]``.
+
+    k/v_cache: [B, Hkv, S_loc, D] (this rank's sequence shard);
+    new_k/new_v: [B, Hkv, D]; kv_lens: [B] global lengths *before* append.
+    Non-owner ranks rewrite the existing row (no-op by value).
+    """
+    s_loc = k_cache.shape[2]
+    me = jax.lax.axis_index(axis)
+
+    def per_batch(kc, vc, nk, nv, pos):
+        # kc/vc: [Hkv, S_loc, D]; nk/nv: [Hkv, D]; pos: global scalar.
+        lp = jnp.clip(pos - me * s_loc, 0, s_loc - 1)
+        own = (pos >= me * s_loc) & (pos < (me + 1) * s_loc)
+
+        def upd(cache, new):
+            cur = jax.lax.dynamic_slice(
+                cache, (0, lp, 0), (cache.shape[0], 1, cache.shape[2]))
+            val = jnp.where(own, new[:, None, :].astype(cache.dtype), cur)
+            return jax.lax.dynamic_update_slice(cache, val, (0, lp, 0))
+
+        return upd(kc, nk), upd(vc, nv)
+
+    return jax.vmap(per_batch)(k_cache, v_cache, new_k, new_v, kv_lens)
+
+
+class SpGQAFlashDecodeAttention:
+    """Decode-side sequence-parallel attention over a sharded KV cache.
+
+    Usage (host level; arrays carry NamedShardings on ``ctx.mesh``):
+        layer = SpGQAFlashDecodeAttention(mesh, axis="sp")
+        k_cache, v_cache = layer.init_cache(B, Hkv, S, D, dtype)
+        k_cache, v_cache = layer.append_kv(k_cache, v_cache, k_t, v_t, lens)
+        out = layer(q, k_cache, v_cache, lens + 1)
+    """
+
+    def __init__(self, mesh: Mesh, axis: str = "sp", block_s: int = 512,
+                 impl: str = "auto", interpret: bool = False,
+                 check_bounds: bool = True):
+        self.ctx: SpDecodeContext = create_sp_decode_context(
+            mesh, axis=axis, block_s=block_s, impl=impl, interpret=interpret)
+        # The append overflow guard costs a host sync per step (it reads
+        # max(kv_lens)); hot decode loops tracking lengths host-side can
+        # disable it.
+        self.check_bounds = check_bounds
+
+    @property
+    def mesh(self) -> Mesh:
+        return self.ctx.mesh
+
+    @property
+    def world(self) -> int:
+        return self.ctx.world
+
+    def cache_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(None, None, self.ctx.axis))
+
+    def init_cache(self, batch: int, n_kv_heads: int, max_seq: int,
+                   head_dim: int, dtype=jnp.bfloat16):
+        """Zeroed sequence-sharded K/V caches [B, Hkv, S, D]."""
+        assert max_seq % self.world == 0, (max_seq, self.world)
+        shape = (batch, n_kv_heads, max_seq, head_dim)
+        z = jnp.zeros(shape, dtype)
+        sh = self.cache_sharding()
+        return jax.device_put(z, sh), jax.device_put(z, sh)
+
+    def append_kv(self, k_cache, v_cache, new_k, new_v, kv_lens):
+        """Write one new token's K/V at position kv_lens[b] per batch row.
+
+        Raises on cache overflow (pos >= max_seq) when ``kv_lens`` is
+        concrete and ``check_bounds`` — otherwise no rank would own the row
+        and the token would be silently dropped, leaving the next decode
+        stale.
+        """
+        max_seq = k_cache.shape[2]
+        if self.check_bounds and not isinstance(kv_lens, jax.core.Tracer):
+            top = int(jnp.max(kv_lens))
+            if top >= max_seq:
+                raise ValueError(
+                    f"KV cache overflow: append at position {top} but "
+                    f"max_seq={max_seq}")
+        fn = cached_shard_jit(
+            append_kv_shard,
+            self.mesh,
+            (P(None, None, self.ctx.axis), P(None, None, self.ctx.axis),
+             P(), P(), P()),
+            (P(None, None, self.ctx.axis), P(None, None, self.ctx.axis)),
+            axis=self.ctx.axis,
+        )
+        return fn(k_cache, v_cache, new_k, new_v, kv_lens)
+
+    def __call__(self, q, k_cache, v_cache, kv_lens):
+        """q [B, Hq, D] -> attention output [B, Hq, D] (replicated)."""
+        return sp_gqa_decode(q, k_cache, v_cache, kv_lens, self.ctx)
