@@ -49,6 +49,16 @@ from triton_dist_tpu.kernels.flash_decode import (  # noqa: F401
     sp_gqa_decode,
     create_sp_decode_context,
 )
+from triton_dist_tpu.kernels.moe_utils import (  # noqa: F401
+    topk_routing,
+    sort_align,
+    gather_sorted,
+    combine_topk,
+)
+from triton_dist_tpu.kernels.group_gemm import (  # noqa: F401
+    group_gemm,
+    moe_ffn_sorted,
+)
 
 # Overlapped / model-level kernels land as the build progresses:
 # moe_reduce_rs, allgather_group_gemm (see SURVEY.md §7).

@@ -1,0 +1,147 @@
+"""Grouped (expert-blocked) Pallas GEMM — the MoE MXU workhorse.
+
+Reference analog: the token-sorted GroupGEMM producers in
+``python/triton_dist/kernels/nvidia/moe_reduce_rs.py`` (tile loop keyed by
+``gather_a_index``/``expert_idx`` tables) and
+``allgather_group_gemm.py:200-330`` — every ``block_m``-row tile of the
+expert-sorted token buffer belongs to exactly ONE expert, so each row tile
+loads that expert's weight slab and runs a dense matmul.  The CUDA side gets
+its tile→expert map from ``csrc/moe_utils.cu``; ours comes from
+``moe_utils.sort_align`` (same contract: sorted rows padded per expert to the
+tile size).
+
+TPU-native design: a scalar-prefetch grid spec carries the ``tile_expert``
+map into SMEM ahead of the grid, and the weight BlockSpec's index map reads
+it to steer each row tile's slab to ``w[tile_expert[i]]``.  The Mosaic
+pipeline then streams tokens and the selected expert slab HBM→VMEM onto the
+MXU exactly like the dense matmul — no gathered copy of the weights is ever
+materialized (the reference needs neither, and neither do we).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.kernels.gemm import (
+    largest_divisor_block,
+    resolve_impl,
+)
+from triton_dist_tpu.language.interpret import maybe_interpret
+
+
+def _group_gemm_kernel(te_ref, x_ref, w_ref, out_ref, acc_ref, *, n_k, out_dtype):
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(
+        x_ref[:], w_ref[0], preferred_element_type=jnp.float32
+    )
+
+    @pl.when(k == n_k - 1)
+    def _():
+        out_ref[:] = acc_ref[:].astype(out_dtype)
+
+
+def group_gemm_xla(x_sorted, w_stack, tile_expert, block_m: int, out_dtype=None):
+    """Dense-einsum fallback: gather one weight slab per row tile.
+
+    Keeps shapes static (n_tiles × [block_m, K] @ [K, N]); XLA turns the
+    weight gather into per-tile dynamic slices.  Runs everywhere — the
+    correctness baseline for the pallas path.
+    """
+    out_dtype = out_dtype or x_sorted.dtype
+    m_pad, k_dim = x_sorted.shape
+    n_tiles = m_pad // block_m
+    xt = x_sorted.reshape(n_tiles, block_m, k_dim)
+    wt = w_stack[tile_expert]  # [n_tiles, K, N]
+    yt = jnp.einsum("tbk,tkn->tbn", xt, wt, preferred_element_type=jnp.float32)
+    return yt.astype(out_dtype).reshape(m_pad, w_stack.shape[-1])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_m", "bn", "bk", "out_dtype", "impl", "interpret"),
+)
+def group_gemm(
+    x_sorted: jax.Array,     # [M_pad, K] expert-sorted tokens (padding rows 0)
+    w_stack: jax.Array,      # [E, K, N] per-expert weights
+    tile_expert: jax.Array,  # [M_pad // block_m] int32 expert of each row tile
+    *,
+    block_m: int,
+    bn: int = 512,
+    bk: int = 512,
+    out_dtype=None,
+    impl: str = "auto",
+    interpret: bool = False,
+) -> jax.Array:
+    """y[M_pad, N] where row tile i is ``x_tile @ w_stack[tile_expert[i]]``.
+
+    ``block_m`` must be the block size given to ``moe_utils.sort_align`` (it
+    defines the tile→expert granularity).
+    """
+    m_pad, k_dim = x_sorted.shape
+    n_experts, k2, n_dim = w_stack.shape
+    assert k_dim == k2, (x_sorted.shape, w_stack.shape)
+    assert m_pad % block_m == 0, (m_pad, block_m)
+    # A block_m mismatched with the sort_align plan would silently steer
+    # tiles to garbage expert slabs on the pallas path (te[i] read OOB).
+    assert tile_expert.shape == (m_pad // block_m,), (
+        tile_expert.shape, m_pad, block_m)
+    out_dtype = out_dtype or x_sorted.dtype
+
+    impl = resolve_impl(impl, interpret)
+    mxu_ok = block_m % 8 == 0 and n_dim % 128 == 0 and k_dim % 128 == 0
+    if impl == "xla" or not mxu_ok:
+        return group_gemm_xla(x_sorted, w_stack, tile_expert, block_m, out_dtype)
+
+    bn = largest_divisor_block(n_dim, bn, 128)
+    bk = largest_divisor_block(k_dim, bk, 128)
+    n_tiles, n_n, n_k = m_pad // block_m, n_dim // bn, k_dim // bk
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles, n_n, n_k),
+        in_specs=[
+            pl.BlockSpec((block_m, bk), lambda i, j, k, te: (i, k)),
+            pl.BlockSpec((1, bk, bn), lambda i, j, k, te: (te[i], k, j)),
+        ],
+        out_specs=pl.BlockSpec((block_m, bn), lambda i, j, k, te: (i, j)),
+        scratch_shapes=[pltpu.VMEM((block_m, bn), jnp.float32)],
+    )
+    return pl.pallas_call(
+        functools.partial(_group_gemm_kernel, n_k=n_k, out_dtype=out_dtype),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m_pad, n_dim), out_dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m_pad * n_dim * k_dim,
+            bytes_accessed=(m_pad * k_dim + n_experts * k_dim * n_dim)
+            * x_sorted.dtype.itemsize
+            + m_pad * n_dim * jnp.dtype(out_dtype).itemsize,
+            transcendentals=0,
+        ),
+        interpret=maybe_interpret(interpret),
+    )(tile_expert, x_sorted, w_stack)
+
+
+def moe_ffn_sorted(x_sorted, w_gate, w_up, w_down, tile_expert, *,
+                   block_m: int, impl: str = "auto", interpret: bool = False):
+    """SwiGLU expert FFN over the sorted buffer: three grouped GEMMs.
+
+    y = (silu(x @ Wg[e]) * (x @ Wu[e])) @ Wd[e] per expert tile — the
+    per-expert MLP the reference's MoE tests build from its GroupGEMM.
+    """
+    gg = functools.partial(group_gemm, tile_expert=tile_expert,
+                           block_m=block_m, impl=impl, interpret=interpret)
+    gate = gg(x_sorted, w_gate)
+    up = gg(x_sorted, w_up)
+    hidden = (jax.nn.silu(gate.astype(jnp.float32))
+              * up.astype(jnp.float32)).astype(x_sorted.dtype)
+    return gg(hidden, w_down)

@@ -82,7 +82,8 @@ def fast_allgather_shard(x_shard, *, axis, inter_axis=None, impl="auto",
         # Distinct collective_id: a second barrier semaphore for the second
         # device set (the DCN/major tier).
         out = all_gather_shard(out, inter_axis, method=method,
-                               interpret=interpret, collective_id=6)
+                               interpret=interpret,
+                               collective_id=collective_id + 1)
     return out
 
 
