@@ -59,6 +59,11 @@ from triton_dist_tpu.kernels.group_gemm import (  # noqa: F401
     group_gemm,
     moe_ffn_sorted,
 )
-
-# Overlapped / model-level kernels land as the build progresses:
-# moe_reduce_rs, allgather_group_gemm (see SURVEY.md §7).
+from triton_dist_tpu.kernels.allgather_group_gemm import (  # noqa: F401
+    ag_group_gemm,
+    create_ag_group_gemm_context,
+)
+from triton_dist_tpu.kernels.moe_reduce_rs import (  # noqa: F401
+    moe_reduce_rs,
+    create_moe_rs_context,
+)

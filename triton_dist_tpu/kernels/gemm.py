@@ -93,6 +93,23 @@ def gemm_pipeline_body(a_blk, b_blk, out_blk, acc_ref, *, n_k, out_dtype):
         out_blk[:] = acc_ref[:].astype(out_dtype)
 
 
+def group_gemm_pipeline_body(x_blk, w_blk, out_blk, acc_ref, *, n_k, out_dtype):
+    """Grouped-GEMM variant of :func:`gemm_pipeline_body`: the weight block
+    arrives with a leading singleton expert dim (BlockSpec (1, bk, bn) steered
+    by a tile→expert map), so the MXU contraction reads ``w_blk[0]``."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(x_blk[:], w_blk[0], preferred_element_type=jnp.float32)
+
+    @pl.when(k == n_k - 1)
+    def _():
+        out_blk[:] = acc_ref[:].astype(out_dtype)
+
+
 def _matmul_kernel(a_ref, b_ref, out_ref, acc_ref, *, n_k: int, k_rem: int, out_dtype):
     k = pl.program_id(2)
 

@@ -28,26 +28,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from triton_dist_tpu.kernels.gemm import (
+    group_gemm_pipeline_body,
     largest_divisor_block,
+    pallas_shapes_ok,
     resolve_impl,
 )
 from triton_dist_tpu.language.interpret import maybe_interpret
-
-
-def _group_gemm_kernel(te_ref, x_ref, w_ref, out_ref, acc_ref, *, n_k, out_dtype):
-    k = pl.program_id(2)
-
-    @pl.when(k == 0)
-    def _():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-
-    acc_ref[:] += jnp.dot(
-        x_ref[:], w_ref[0], preferred_element_type=jnp.float32
-    )
-
-    @pl.when(k == n_k - 1)
-    def _():
-        out_ref[:] = acc_ref[:].astype(out_dtype)
 
 
 def group_gemm_xla(x_sorted, w_stack, tile_expert, block_m: int, out_dtype=None):
@@ -98,8 +84,7 @@ def group_gemm(
     out_dtype = out_dtype or x_sorted.dtype
 
     impl = resolve_impl(impl, interpret)
-    mxu_ok = block_m % 8 == 0 and n_dim % 128 == 0 and k_dim % 128 == 0
-    if impl == "xla" or not mxu_ok:
+    if impl == "xla" or not pallas_shapes_ok(block_m, n_dim, k_dim):
         return group_gemm_xla(x_sorted, w_stack, tile_expert, block_m, out_dtype)
 
     bn = largest_divisor_block(n_dim, bn, 128)
@@ -116,8 +101,13 @@ def group_gemm(
         out_specs=pl.BlockSpec((block_m, bn), lambda i, j, k, te: (i, j)),
         scratch_shapes=[pltpu.VMEM((block_m, bn), jnp.float32)],
     )
+
+    def _kernel(te_ref, x_ref, w_ref, out_ref, acc_ref):
+        group_gemm_pipeline_body(x_ref, w_ref, out_ref, acc_ref,
+                                 n_k=n_k, out_dtype=out_dtype)
+
     return pl.pallas_call(
-        functools.partial(_group_gemm_kernel, n_k=n_k, out_dtype=out_dtype),
+        _kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((m_pad, n_dim), out_dtype),
         cost_estimate=pl.CostEstimate(

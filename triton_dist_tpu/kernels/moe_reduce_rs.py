@@ -1,0 +1,266 @@
+"""Overlapped GroupGEMM-Reduce-Scatter — MoE tensor-parallel down-proj side.
+
+Reference analog: ``python/triton_dist/kernels/nvidia/moe_reduce_rs.py``
+(1020 LoC) — the token-sorted GroupGEMM scatters its output by topk weight
+into a symmetric buffer and signals per-rank segments via counter +
+``dl.notify`` (:463-464), while a hierarchical reduce-scatter consumer
+(``consumer_reduce_scatter_reduce_2d`` :817+) folds partials; the context
+precomputes sorted token ids (``create_moe_rs_context`` :278+).
+
+TPU-native design (NOT a port): the ring GEMM-RS schedule of
+``gemm_reduce_scatter.py`` with the per-chunk dense GEMM replaced by the
+expert-steered grouped GEMM of ``group_gemm.py``:
+
+* Input ``h`` is in **per-segment expert-sorted layout** ([world, m_pad]
+  rows): segment ``s`` holds rank ``s``'s tokens sorted by expert (the
+  layout ``allgather_group_gemm.py`` gathers, and what the reference's
+  precomputed ``gather_a_index`` tables encode).  Because the sort plans are
+  derived from allgathered routing metadata, every device agrees on row
+  semantics; each device's grouped GEMM output for segment ``s`` is a
+  partial sum over its F shard — exactly the reduce-scatter precondition.
+* Ring: the partial for segment ``c`` starts at device ``c+1`` and travels
+  right accumulating; at each step the *next* chunk's grouped GEMM overlaps
+  the in-flight partial-sum DMA (same credit-semaphore flow control as
+  ``gemm_reduce_scatter.py``).
+* The topk-weighted combine back to token order runs **after** the ring on
+  the owner's reduced segment only (m_pad rows instead of world*m_pad) —
+  the reference instead fuses its topk reduce into the RS consumer; the
+  math is identical, ours just rides XLA's fused gather/einsum.
+
+Sharding contract (1-D TP over ``axis``; E experts, topk assignments):
+  h:       [world*m_pad, F]  P(None, axis)  sorted hidden states (F-sharded)
+  w_stack: [E, F, D]         P(None, axis, None)  down-proj expert weights
+  weights: [T, topk]         P(axis, None)  routing weights
+  experts: [T, topk]         P(axis, None)  routing expert ids (int32)
+  out:     [T, D]            P(axis, None)  reduced token outputs
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.kernels.allgather_group_gemm import _segment_plans
+from triton_dist_tpu.kernels.gemm import (
+    MatmulConfig,
+    group_gemm_pipeline_body,
+    largest_divisor_block,
+    pallas_shapes_ok,
+    resolve_impl,
+)
+from triton_dist_tpu.kernels.group_gemm import group_gemm_xla
+from triton_dist_tpu.kernels.moe_utils import combine_topk
+from triton_dist_tpu.language.interpret import maybe_interpret
+from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
+
+MOE_RS_COLLECTIVE_ID = 10
+
+
+@dataclass
+class MoEReduceRSContext:
+    """Reference analog: ``create_moe_rs_context`` (moe_reduce_rs.py:278+) —
+    the precomputed sort tables become `_segment_plans` recomputed under jit
+    (cheap, and XLA CSEs them with the AG side's)."""
+
+    mesh: Mesh
+    n_experts: int
+    topk: int
+    axis: str = "tp"
+    block_m: int = 128
+    impl: str = "auto"
+    config: MatmulConfig = field(default_factory=MatmulConfig)
+    interpret: bool = False
+
+    @property
+    def world(self) -> int:
+        return self.mesh.shape[self.axis]
+
+
+def create_moe_rs_context(mesh, n_experts, topk, axis="tp", block_m=128,
+                          impl="auto", config=None,
+                          interpret=False) -> MoEReduceRSContext:
+    return MoEReduceRSContext(
+        mesh=mesh, n_experts=n_experts, topk=topk, axis=axis,
+        block_m=block_m, impl=impl, config=config or MatmulConfig(),
+        interpret=interpret,
+    )
+
+
+def _add_body(recv_blk, dst_in_blk, dst_out_blk):
+    dst_out_blk[:] = dst_in_blk[:] + recv_blk[:]
+
+
+def _moe_rs_kernel(
+    te_ref,      # [world, n_tiles] SMEM: per-segment tile→expert maps
+    h_ref,       # [world*m_pad, f_loc] ANY: sorted hidden states
+    w_ref,       # [E, f_loc, D]    ANY: down-proj expert slabs
+    out_ref,     # [m_pad, D]       ANY out: reduced own segment
+    send_ref,    # [2, m_pad, D]    ANY out (scratch)
+    recv_ref,    # [2, m_pad, D]    ANY out (scratch)
+    send_sem, recv_sem, credit_sem,
+    acc_ref,     # VMEM (block_m, bn) f32
+    *,
+    axis, world, m_pad, block_m, bn, bk,
+):
+    me = jax.lax.axis_index(axis)
+    right = jax.lax.rem(me + 1, world)
+    left = jax.lax.rem(me + world - 1, world)
+
+    f_loc = h_ref.shape[1]
+    D = w_ref.shape[2]
+    n_tiles, n_n, n_k = m_pad // block_m, D // bn, f_loc // bk
+
+    inner_add = pltpu.emit_pipeline(
+        _add_body,
+        grid=(n_tiles, n_n),
+        in_specs=[
+            pl.BlockSpec((block_m, bn), lambda i, j: (i, j)),
+            pl.BlockSpec((block_m, bn), lambda i, j: (i, j)),
+        ],
+        out_specs=[pl.BlockSpec((block_m, bn), lambda i, j: (i, j))],
+    )
+
+    if world > 1:
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id={axis: left},
+                               device_id_type=pltpu.DeviceIdType.MESH)
+        pltpu.semaphore_signal(barrier, inc=1, device_id={axis: right},
+                               device_id_type=pltpu.DeviceIdType.MESH)
+        pltpu.semaphore_wait(barrier, 2)
+
+    for s in range(world):
+        p = s % 2
+        last = s == world - 1
+        # Ring chunk schedule (see gemm_reduce_scatter.py docstring).
+        if last:
+            chunk = me
+        else:
+            chunk = jax.lax.rem(me - 1 - s + 2 * world, world)
+        dst = out_ref if last else send_ref.at[p]
+
+        if s >= 2:
+            pltpu.make_async_copy(send_ref.at[p], send_ref.at[p],
+                                  send_sem.at[p]).wait()
+
+        # Grouped partial GEMM for this segment — overlaps in-flight recv.
+        inner_gemm = pltpu.emit_pipeline(
+            functools.partial(group_gemm_pipeline_body, n_k=n_k,
+                              out_dtype=out_ref.dtype),
+            grid=(n_tiles, n_n, n_k),
+            in_specs=[
+                pl.BlockSpec((block_m, bk), lambda i, j, k: (i, k)),
+                pl.BlockSpec(
+                    (1, bk, bn),
+                    lambda i, j, k, chunk=chunk: (te_ref[chunk, i], k, j)),
+            ],
+            out_specs=[pl.BlockSpec((block_m, bn), lambda i, j, k: (i, j))],
+        )
+        inner_gemm(h_ref.at[pl.ds(chunk * m_pad, m_pad)], w_ref, dst,
+                   scratches=(acc_ref,))
+
+        if s >= 1:
+            pltpu.make_async_copy(recv_ref.at[p], recv_ref.at[p],
+                                  recv_sem.at[p]).wait()
+            inner_add(recv_ref.at[p], dst, dst)
+            pltpu.semaphore_signal(credit_sem, inc=1, device_id={axis: left},
+                                   device_id_type=pltpu.DeviceIdType.MESH)
+
+        if not last:
+            if s >= 2:
+                pltpu.semaphore_wait(credit_sem, 1)
+            pltpu.make_async_remote_copy(
+                src_ref=send_ref.at[p],
+                dst_ref=recv_ref.at[(s + 1) % 2],
+                send_sem=send_sem.at[p],
+                recv_sem=recv_sem.at[(s + 1) % 2],
+                device_id={axis: right},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            ).start()
+
+    if world > 1:
+        pfin = (world - 2) % 2
+        pltpu.make_async_copy(send_ref.at[pfin], send_ref.at[pfin],
+                              send_sem.at[pfin]).wait()
+        n_credit_waits = max(world - 3, 0)
+        pltpu.semaphore_wait(credit_sem, (world - 1) - n_credit_waits)
+
+
+def moe_reduce_rs_shard(h_loc, w_stack, weights_loc, experts_loc, *,
+                        axis, n_experts, topk, block_m, bn, bk, impl,
+                        interpret):
+    """Per-device MoE GroupGEMM + ring reduce-scatter; call inside shard_map.
+
+    Returns the local token shard's combined, fully-reduced outputs
+    [t_loc, D].
+    """
+    impl = resolve_impl(impl, interpret)
+    world = jax.lax.axis_size(axis)
+    f_loc = h_loc.shape[1]
+    D = w_stack.shape[2]
+    me = jax.lax.axis_index(axis)
+
+    experts_all = jax.lax.all_gather(experts_loc, axis, axis=0)
+    dest_all, te_all, m_pad = _segment_plans(experts_all, n_experts, block_m)
+    assert h_loc.shape[0] == world * m_pad, (h_loc.shape, world, m_pad)
+
+    if impl == "xla" or not pallas_shapes_ok(block_m, D, f_loc):
+        ys = group_gemm_xla(h_loc, w_stack, te_all.reshape(-1), block_m)
+        ys_me = jax.lax.psum_scatter(ys, axis, scatter_dimension=0, tiled=True)
+    else:
+        bn_ = largest_divisor_block(D, bn, 128)
+        bk_ = largest_divisor_block(f_loc, bk, 128)
+        ys_me, _, _ = pl.pallas_call(
+            functools.partial(
+                _moe_rs_kernel, axis=axis, world=world, m_pad=m_pad,
+                block_m=block_m, bn=bn_, bk=bk_,
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((m_pad, D), h_loc.dtype),
+                jax.ShapeDtypeStruct((2, m_pad, D), h_loc.dtype),
+                jax.ShapeDtypeStruct((2, m_pad, D), h_loc.dtype),
+            ],
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.REGULAR,
+                pltpu.VMEM((block_m, bn_), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                collective_id=MOE_RS_COLLECTIVE_ID if world > 1 else None,
+            ),
+            interpret=maybe_interpret(interpret),
+        )(te_all, h_loc, w_stack)
+
+    # Topk combine on the reduced own segment only (m_pad rows).
+    dest_me = jax.lax.dynamic_index_in_dim(dest_all, me, keepdims=False)
+    return combine_topk(ys_me, dest_me, weights_loc)
+
+
+def moe_reduce_rs(h, w_stack, weights, experts, ctx: MoEReduceRSContext):
+    """out[T, D] = reduce_scatter(GroupGEMM(h) topk-combined), overlapped.
+    Host entry (reference ``moe_reduce_rs`` moe_reduce_rs.py:882-1020)."""
+    cfg = ctx.config
+    fn = cached_shard_jit(
+        moe_reduce_rs_shard,
+        ctx.mesh,
+        (P(None, ctx.axis), P(None, ctx.axis, None),
+         P(ctx.axis, None), P(ctx.axis, None)),
+        P(ctx.axis, None),
+        axis=ctx.axis, n_experts=ctx.n_experts, topk=ctx.topk,
+        block_m=ctx.block_m, bn=cfg.block_n, bk=cfg.block_k,
+        impl=ctx.impl, interpret=ctx.interpret,
+    )
+    return fn(h, w_stack, weights, experts)

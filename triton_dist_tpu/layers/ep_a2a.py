@@ -1,0 +1,164 @@
+"""Expert-parallel AllToAll layer — MoE token dispatch/combine.
+
+Reference analog: ``python/triton_dist/layers/nvidia/ep_a2a_layer.py``
+(``EPAll2AllLayer``, :40-240) — ``dispatch()`` allgathers split counts,
+precomputes receive offsets (with a pinned-memory CPU readback for the
+output allocation, ep_a2a.py:353-387) and putmem's each token to its expert
+ranks; ``combine()`` reverses the shuffle and topk-reduces.
+
+TPU-native design (NOT a port):
+
+* **No dynamic shapes, no CPU readback** (SURVEY.md §7 hard part 2): every
+  (src→dst) segment is padded to ``max_tokens`` slots; overflow assignments
+  beyond a destination's capacity are dropped (the standard capacity-factor
+  truncation — the reference instead sizes ``max_m`` for the worst case,
+  which is also available here by choosing ``max_tokens = t_loc * topk``).
+* **Slot-addressed return routing**: the sender records (dest, slot) for
+  every (token, k) assignment when packing; ``combine`` simply ships the
+  expert outputs back through the inverse AllToAll — same slots, so no
+  index metadata needs to travel back (the reference re-sends topk-id
+  tables both ways).
+* Expert ids ride as a tiny int32 side-channel AllToAll overlapping the
+  payload one (the reference's separate splits/indices putmem).
+
+Expert ownership: expert ``e`` lives on rank ``e // (n_experts // world)``
+(contiguous blocks, the reference's layout).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.kernels.all_to_all import (
+    AllToAllContext,
+    fast_all_to_all_shard,
+)
+from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
+
+META_COLS = 8  # int32 metadata columns (col 0 = expert id), DMA-friendly pad
+
+
+def _exclusive_cumsum(x):
+    return jnp.concatenate([jnp.zeros((1,), x.dtype), jnp.cumsum(x)[:-1]])
+
+
+def ep_dispatch_shard(x_loc, experts_loc, *, axis, n_experts,
+                      max_tokens, impl, interpret):
+    """Pack per-destination-rank slots and shuffle tokens to expert owners.
+
+    x_loc [t_loc, H], experts_loc [t_loc, topk] i32.  Routing weights are
+    only needed at combine time.  Returns (recv [world, max_tokens, H],
+    recv_expert [world, max_tokens] i32, recv_splits [world] i32, plan).
+    """
+    world = jax.lax.axis_size(axis)
+    t_loc, topk = experts_loc.shape
+    hidden = x_loc.shape[1]
+    epr = n_experts // world  # experts per rank
+    n = t_loc * topk
+
+    flat_e = experts_loc.reshape(-1)
+    dest = flat_e // epr                                   # [n] dest rank
+    counts = jnp.bincount(dest, length=world)
+    seg_starts = _exclusive_cumsum(counts)
+
+    # Slot within the destination group, stable by assignment order
+    # (moe_utils.sort_align's rank-in-group computation, keyed by dest rank).
+    order = jnp.argsort(dest, stable=True)
+    rank_sorted = jnp.arange(n, dtype=jnp.int32) - seg_starts[dest[order]].astype(jnp.int32)
+    slot = jnp.zeros((n,), jnp.int32).at[order].set(rank_sorted)
+    valid = slot < max_tokens
+
+    token_of = jnp.arange(n) // topk
+    dest_safe = jnp.where(valid, dest, world)  # OOB rows dropped by scatter
+    send = jnp.zeros((world, max_tokens, hidden), x_loc.dtype)
+    send = send.at[dest_safe, slot].set(x_loc[token_of], mode="drop")
+    meta = jnp.zeros((world, max_tokens, META_COLS), jnp.int32)
+    meta = meta.at[dest_safe, slot, 0].set(flat_e, mode="drop")
+    splits = jnp.minimum(counts, max_tokens).astype(jnp.int32)
+
+    recv, recv_splits = fast_all_to_all_shard(
+        send, splits, axis=axis, impl=impl, interpret=interpret)
+    recv_meta, _ = fast_all_to_all_shard(
+        meta, splits, axis=axis, impl="xla", interpret=interpret)
+
+    # Plan = (dest, slot, valid): a plain tuple so shard_map out_specs stay
+    # hashable for the jit cache.
+    return recv, recv_meta[:, :, 0], recv_splits, (dest, slot, valid)
+
+
+def ep_combine_shard(y, weights_loc, plan, *, axis, impl, interpret):
+    """Inverse shuffle + topk-weighted reduce back to token order.
+
+    y [world, max_tokens, H]: expert outputs in the *received* layout
+    (block p returns to peer p, same slots).  Returns out [t_loc, H].
+    """
+    world, max_tokens, hidden = y.shape
+    t_loc, topk = weights_loc.shape
+    splits = jnp.full((world,), max_tokens, jnp.int32)
+    back, _ = fast_all_to_all_shard(
+        y, splits, axis=axis, impl=impl, interpret=interpret)
+
+    dest, slot, valid = plan
+    vals = back[jnp.minimum(dest, world - 1), jnp.minimum(slot, max_tokens - 1)]
+    w = (weights_loc.reshape(-1, 1) * valid[:, None]).astype(jnp.float32)
+    out = (w * vals.astype(jnp.float32)).reshape(t_loc, topk, hidden).sum(axis=1)
+    return out.astype(y.dtype)
+
+
+@dataclass
+class EPAll2AllLayer:
+    """Reference analog: ``EPAll2AllLayer`` (ep_a2a_layer.py:40-240).
+
+    Functional: ``dispatch`` returns a plan pytree that ``combine`` takes
+    back, instead of mutating layer-owned symm buffers/signals (which a
+    jit-traced TPU program cannot hold across calls anyway).
+    """
+
+    ctx: AllToAllContext
+    n_experts: int
+    topk: int
+
+    def __post_init__(self):
+        assert self.n_experts % self.ctx.world == 0, \
+            (self.n_experts, self.ctx.world)
+
+    @property
+    def experts_per_rank(self) -> int:
+        return self.n_experts // self.ctx.world
+
+    def dispatch(self, x, experts):
+        """x [T, H] P(axis); experts [T, topk] P(axis).
+
+        Returns (recv_tokens [W*world? ...] — shard-stacked receive buffers
+        P(axis), recv_expert, recv_splits, plan), where on each device the
+        receive block is [world, max_tokens, H] and ``recv_expert`` holds
+        the global expert id of every valid received row.
+        """
+        ctx = self.ctx
+        fn = cached_shard_jit(
+            ep_dispatch_shard,
+            ctx.mesh,
+            (P(ctx.axis), P(ctx.axis)),
+            (P(ctx.axis), P(ctx.axis), P(ctx.axis),
+             (P(ctx.axis), P(ctx.axis), P(ctx.axis))),
+            axis=ctx.axis, n_experts=self.n_experts,
+            max_tokens=ctx.max_tokens, impl=ctx.impl, interpret=ctx.interpret,
+        )
+        return fn(x, experts)
+
+    def combine(self, y, weights, plan):
+        """y: expert outputs in received layout, P(axis).  Returns [T, H]."""
+        ctx = self.ctx
+        fn = cached_shard_jit(
+            ep_combine_shard,
+            ctx.mesh,
+            (P(ctx.axis), P(ctx.axis),
+             (P(ctx.axis), P(ctx.axis), P(ctx.axis))),
+            P(ctx.axis),
+            axis=ctx.axis, impl=ctx.impl, interpret=ctx.interpret,
+        )
+        return fn(y, weights, plan)
