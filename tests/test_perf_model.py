@@ -1,0 +1,80 @@
+"""Perf-model sanity tests (reference analog: comm/gemm_perf_model.py)."""
+
+import jax.numpy as jnp
+import pytest
+
+from triton_dist_tpu.kernels import perf_model
+from triton_dist_tpu.runtime import topology
+
+
+def test_mxu_tflops_dtype_scaling():
+    bf16 = perf_model.get_mxu_tflops(jnp.bfloat16)
+    assert bf16 > 0
+    assert perf_model.get_mxu_tflops(jnp.int8) == pytest.approx(2 * bf16)
+    assert perf_model.get_mxu_tflops(jnp.float32) == pytest.approx(bf16 / 4)
+
+
+def test_allgather_monotone_in_size_and_world():
+    t1 = perf_model.estimate_allgather_time_ms(1 << 20, 8)
+    t2 = perf_model.estimate_allgather_time_ms(1 << 21, 8)
+    t3 = perf_model.estimate_allgather_time_ms(1 << 20, 16)
+    assert 0 < t1 < t2
+    assert t1 < t3
+    assert perf_model.estimate_allgather_time_ms(1 << 20, 1) == 0.0
+
+
+def test_reduce_scatter_single_tier_matches_formula():
+    nbytes, world, bw = 8 << 20, 8, 100.0
+    t = perf_model.estimate_reduce_scatter_time_ms(
+        nbytes, world, world, intra_bw_gbps=bw)
+    expect = nbytes / 1e9 / world * (world - 1) / bw * 1e3
+    assert t == pytest.approx(expect)
+
+
+def test_reduce_scatter_hierarchical_formula():
+    nbytes, world, local = 64 << 20, 16, 8
+    intra_bw, inter_bw = 100.0, 12.5
+    hier = perf_model.estimate_reduce_scatter_time_ms(
+        nbytes, world, local, intra_bw_gbps=intra_bw, inter_bw_gbps=inter_bw)
+    intra_ms = nbytes / world * (local - 1) / 1e9 / intra_bw * 1e3
+    inter_ms = nbytes / world / 1e9 / inter_bw * 1e3
+    nnodes = world // local
+    assert hier == pytest.approx(
+        max(intra_ms, inter_ms) * (nnodes - 1) + intra_ms)
+    # A slow DCN tier must dominate when it is the bottleneck.
+    slow = perf_model.estimate_reduce_scatter_time_ms(
+        nbytes, world, local, intra_bw_gbps=intra_bw, inter_bw_gbps=0.1)
+    assert slow > 10 * hier
+
+
+def test_gemm_sol_positive_and_compute_bound_for_big_square():
+    t = perf_model.estimate_gemm_sol_time_ms(8192, 8192, 8192)
+    assert t > 0
+    # Big square bf16 GEMM must be compute-bound: time tracks 1/TFLOPS.
+    flops = 2.0 * 8192**3
+    assert t == pytest.approx(
+        flops / (perf_model.get_mxu_tflops(jnp.bfloat16) * 1e12) * 1e3)
+
+
+def test_gemm_sol_memory_bound_for_skinny():
+    # M=1 decode GEMV is bandwidth-bound.
+    t = perf_model.estimate_gemm_sol_time_ms(1, 8192, 8192)
+    nbytes = (8192 + 8192 * 8192 + 8192) * 2
+    assert t == pytest.approx(nbytes / (perf_model.get_hbm_gbps() * 1e9) * 1e3)
+
+
+def test_overlap_chunk_budget_bounds():
+    for world in (1, 2, 4, 8):
+        c = perf_model.overlap_chunk_budget(8192, 4096, 8192, world)
+        assert 1 <= c <= 8
+    assert perf_model.overlap_chunk_budget(8192, 4096, 8192, 1) == 1
+
+
+def test_dcn_bandwidth_fallback_positive():
+    assert perf_model.get_dcn_bandwidth_gbps_per_host() > 0
+
+
+def test_topology_detects_cpu_mesh():
+    topo = topology.detect_topology()
+    assert topo.n_devices >= 1
+    assert topo.bf16_tflops > 0

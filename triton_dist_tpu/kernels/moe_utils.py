@@ -39,6 +39,26 @@ def padded_rows(n_assignments: int, n_experts: int, block_m: int) -> int:
     return round_up(n_assignments + n_experts * (block_m - 1), block_m)
 
 
+def stable_rank_in_group(keys, n_groups: int):
+    """Rank of each element among same-key elements, stable by position.
+
+    Returns ``(rank [n] int32, counts [n_groups])``.  This is the scatter-slot
+    idiom shared by the expert sort (group GEMM feeder, below) and the EP
+    dispatch slot allocation (layers/ep_a2a.py) — the reference computes the
+    same thing with atomic counters (moe_utils.cu:61-356 /
+    ep_a2a.py:35-146 ``atomic_add_per_warp``).
+    """
+    n = keys.shape[0]
+    counts = jnp.bincount(keys, length=n_groups)
+    seg_starts = jnp.concatenate(
+        [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]])
+    order = jnp.argsort(keys, stable=True)
+    rank_sorted = (jnp.arange(n, dtype=jnp.int32)
+                   - seg_starts[keys[order]].astype(jnp.int32))
+    rank = jnp.zeros((n,), jnp.int32).at[order].set(rank_sorted)
+    return rank, counts
+
+
 def topk_routing(logits, topk: int):
     """Softmax-then-topk router (the reference tests' torch preprocessing).
 
@@ -68,22 +88,12 @@ def sort_align(experts, n_experts: int, block_m: int):
     flat = experts.reshape(-1)
     m_pad = padded_rows(n, n_experts, block_m)
 
-    counts = jnp.bincount(flat, length=n_experts)
+    # Stable rank within each expert group (original (token, k) order).
+    rank, counts = stable_rank_in_group(flat, n_experts)
     padded_counts = round_up(counts, block_m)
     group_starts = jnp.concatenate(
         [jnp.zeros((1,), counts.dtype), jnp.cumsum(padded_counts)[:-1]])
-
-    # Stable order within an expert = original (token, k) order.
-    order = jnp.argsort(flat, stable=True)          # sorted pos -> flat idx
-    sorted_experts = flat[order]
-    # Rank within group: position among same-expert assignments.
-    seg_starts = jnp.concatenate(
-        [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]])
-    rank_in_group = jnp.arange(n, dtype=counts.dtype) - seg_starts[sorted_experts]
-
-    dest_sorted = group_starts[sorted_experts] + rank_in_group  # row per sorted pos
-    dest = jnp.zeros((n,), jnp.int32).at[order].set(
-        dest_sorted.astype(jnp.int32))
+    dest = (group_starts[flat].astype(jnp.int32) + rank)
 
     n_tiles = m_pad // block_m
     tile_rows = jnp.arange(n_tiles) * block_m

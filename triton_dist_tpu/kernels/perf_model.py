@@ -1,0 +1,196 @@
+"""Analytic roofline models for comm and GEMM time on TPU.
+
+Reference analog: ``kernels/nvidia/comm_perf_model.py`` (NIC discovery +
+``estimate_reduce_scatter_time`` :91-110) and ``gemm_perf_model.py``
+(tensor-core TFLOPS tables :158-204, ``estimate_gemm_sol_time_ms`` :233-237).
+The reference uses these to budget SMs between GEMM and communication; on
+TPU there is no SM budget — instead the models budget the *chunking factor*
+of overlapped kernels (how many ring steps / DMA chunks per tile loop) and
+provide speed-of-light baselines for the benchmarks.
+
+TPU mapping:
+- tensor-core TFLOPS table      -> per-generation MXU TFLOPS (topology.py)
+- DRAM GB/s table               -> per-generation HBM GB/s
+- NVLink / PCIe bandwidth       -> ICI per-link bandwidth x links on an axis
+- NIC bandwidth (sysfs/ethtool) -> DCN bandwidth, same sysfs discovery
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.runtime import topology
+
+
+# ---------------------------------------------------------------------------
+# Peak-rate lookups
+# ---------------------------------------------------------------------------
+
+# Relative MXU throughput per dtype vs bf16 (TPU MXUs run int8/fp8 at 2x
+# bf16 on generations that support it; fp32 runs ~1/4 via passes).
+_DTYPE_SPEEDUP = {
+    jnp.bfloat16.dtype: 1.0,
+    jnp.float16.dtype: 1.0,
+    jnp.float32.dtype: 0.25,
+    jnp.int8.dtype: 2.0,
+    jnp.float8_e4m3fn.dtype: 2.0,
+    jnp.float8_e5m2.dtype: 2.0,
+}
+
+
+def get_mxu_tflops(dtype=jnp.bfloat16) -> float:
+    """Peak dense matmul TFLOPS for the local chip at ``dtype``.
+
+    Analog of ``get_tensorcore_tflops`` (gemm_perf_model.py:200-204).
+    """
+    base = topology.peak_bf16_tflops()
+    return base * _DTYPE_SPEEDUP.get(jnp.dtype(dtype), 1.0)
+
+
+def get_hbm_gbps() -> float:
+    """Analog of ``get_dram_gbps`` (gemm_perf_model.py:226-230)."""
+    return topology.hbm_bandwidth_gbps()
+
+
+@functools.lru_cache()
+def _nic_speed_gbps(interface: str) -> float:
+    path = f"/sys/class/net/{interface}/speed"
+    try:
+        with open(path) as f:
+            return int(f.read().strip()) / 1000.0  # Mbps -> Gbps
+    except (OSError, ValueError):
+        return -1.0
+
+
+@functools.lru_cache()
+def get_dcn_bandwidth_gbps_per_host() -> float:
+    """DCN (data-center network) bandwidth per host, GB/s.
+
+    Same sysfs discovery as the reference's ``get_nic_bandwidth_per_gpu``
+    (comm_perf_model.py:83-91): enumerate non-virtual NICs, take all NICs at
+    the max line rate, sum them.  Falls back to 100 GbE when sysfs gives
+    nothing (common in sandboxes).
+    """
+    virtual_prefixes = ("lo", "docker", "veth", "br-", "tun", "lxc", "qemu")
+    try:
+        nics = [n for n in os.listdir("/sys/class/net/")
+                if not n.startswith(virtual_prefixes)]
+    except OSError:
+        nics = []
+    speeds = [s for s in (_nic_speed_gbps(n) for n in nics) if s > 0]
+    if not speeds:
+        return 100.0 / 8.0  # assume 100 GbE
+    mx = max(speeds)
+    return sum(s for s in speeds if s == mx) / 8.0  # Gbps -> GB/s
+
+
+def get_ici_axis_bandwidth_gbps(mesh=None, axis: str | None = None) -> float:
+    """Per-chip bandwidth available to a ring over one mesh axis, GB/s.
+
+    A TPU torus axis gives a ring two links (both directions usable by a
+    bidirectional ring); DCN-crossing axes get the per-host NIC share.
+    """
+    topo = topology.detect_topology()
+    if mesh is not None and axis is not None and topology.axis_is_dcn(mesh, axis):
+        n_local = max(1, topo.n_devices // max(1, topo.n_processes))
+        return get_dcn_bandwidth_gbps_per_host() / n_local
+    return topo.ici_gbps_per_link * 2.0
+
+
+# ---------------------------------------------------------------------------
+# Comm time estimates (ms)
+# ---------------------------------------------------------------------------
+
+def estimate_allgather_time_ms(nbytes_per_shard: int, world_size: int,
+                               bw_gbps: float | None = None) -> float:
+    """Ring allgather: each chip receives (world-1) shards over the axis."""
+    if world_size <= 1:
+        return 0.0
+    bw = bw_gbps if bw_gbps is not None else get_ici_axis_bandwidth_gbps()
+    return nbytes_per_shard * (world_size - 1) / 1e9 / bw * 1e3
+
+
+def estimate_reduce_scatter_time_ms(nbytes_full: int, world_size: int,
+                                    local_world_size: int | None = None,
+                                    intra_bw_gbps: float | None = None,
+                                    inter_bw_gbps: float | None = None) -> float:
+    """Hierarchical RS estimate, analog of comm_perf_model.py:91-110.
+
+    Two-tier: intra-slice ring over ICI, cross-slice exchange over DCN.
+    On a TPU torus the two tiers overlap (like the reference's full-mesh
+    NVLink case), so the slower tier dominates the per-node term.
+    """
+    if world_size <= 1:
+        return 0.0
+    local = local_world_size or world_size
+    intra = intra_bw_gbps if intra_bw_gbps is not None else get_ici_axis_bandwidth_gbps()
+    if world_size == local:
+        return nbytes_full / 1e9 / local * (local - 1) / intra * 1e3
+    assert world_size % local == 0
+    nnodes = world_size // local
+    inter = inter_bw_gbps if inter_bw_gbps is not None else (
+        get_dcn_bandwidth_gbps_per_host())
+    intra_ms = nbytes_full / world_size * (local - 1) / 1e9 / intra * 1e3
+    inter_ms = nbytes_full / world_size / 1e9 / inter * 1e3
+    # ICI and DCN are independent fabrics: the tiers pipeline, so each
+    # round costs the slower (bottleneck) tier.
+    return max(intra_ms, inter_ms) * (nnodes - 1) + intra_ms
+
+
+def estimate_all_to_all_time_ms(nbytes_per_chip: int, world_size: int,
+                                bw_gbps: float | None = None) -> float:
+    """All-to-all: each chip sends (world-1)/world of its payload."""
+    if world_size <= 1:
+        return 0.0
+    bw = bw_gbps if bw_gbps is not None else get_ici_axis_bandwidth_gbps()
+    return nbytes_per_chip * (world_size - 1) / world_size / 1e9 / bw * 1e3
+
+
+# ---------------------------------------------------------------------------
+# GEMM time estimate (ms)
+# ---------------------------------------------------------------------------
+
+def estimate_gemm_sol_time_ms(M: int, N: int, K: int, dtype=jnp.bfloat16) -> float:
+    """Speed-of-light GEMM time: max of MXU-bound and HBM-bound terms.
+
+    Analog of gemm_perf_model.py:233-237, plus a memory-roofline term the
+    reference omits (matters for the skinny-N TP shards we run).
+    """
+    flops = 2.0 * M * N * K
+    compute_ms = flops / (get_mxu_tflops(dtype) * 1e12) * 1e3
+    itemsize = jnp.dtype(dtype).itemsize
+    nbytes = (M * K + K * N) * itemsize + M * N * itemsize
+    memory_ms = nbytes / (get_hbm_gbps() * 1e9) * 1e3
+    return max(compute_ms, memory_ms)
+
+
+# ---------------------------------------------------------------------------
+# Overlap budgeting
+# ---------------------------------------------------------------------------
+
+def overlap_chunk_budget(M: int, N: int, K: int, world_size: int,
+                         dtype=jnp.bfloat16, mesh=None, axis: str | None = None,
+                         max_chunks: int = 8) -> int:
+    """How many ring/DMA chunks an overlapped AG-GEMM should use.
+
+    The reference budgets SMs between GEMM and comm using the two models
+    (SURVEY §2.5 comm_perf_model row); on TPU the analogous knob is the
+    chunk count: enough chunks that per-chunk comm hides under per-chunk
+    compute, but no more (each chunk re-primes the MXU pipeline).
+    """
+    if world_size <= 1:
+        return 1
+    gemm_ms = estimate_gemm_sol_time_ms(M // world_size, N, K, dtype)
+    ag_ms = estimate_allgather_time_ms(
+        M // world_size * K * jnp.dtype(dtype).itemsize, world_size,
+        get_ici_axis_bandwidth_gbps(mesh, axis) if mesh is not None else None)
+    if ag_ms <= 0:
+        return 1
+    # comm-bound: one chunk per ring step; compute-bound: fewer chunks OK.
+    ratio = ag_ms / max(gemm_ms, 1e-6)
+    chunks = world_size if ratio >= 1.0 else max(2, round(world_size * ratio))
+    return int(min(max_chunks, max(1, chunks)))

@@ -37,13 +37,10 @@ from triton_dist_tpu.kernels.all_to_all import (
     AllToAllContext,
     fast_all_to_all_shard,
 )
+from triton_dist_tpu.kernels.moe_utils import stable_rank_in_group
 from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
 
 META_COLS = 8  # int32 metadata columns (col 0 = expert id), DMA-friendly pad
-
-
-def _exclusive_cumsum(x):
-    return jnp.concatenate([jnp.zeros((1,), x.dtype), jnp.cumsum(x)[:-1]])
 
 
 def ep_dispatch_shard(x_loc, experts_loc, *, axis, n_experts,
@@ -62,14 +59,8 @@ def ep_dispatch_shard(x_loc, experts_loc, *, axis, n_experts,
 
     flat_e = experts_loc.reshape(-1)
     dest = flat_e // epr                                   # [n] dest rank
-    counts = jnp.bincount(dest, length=world)
-    seg_starts = _exclusive_cumsum(counts)
-
-    # Slot within the destination group, stable by assignment order
-    # (moe_utils.sort_align's rank-in-group computation, keyed by dest rank).
-    order = jnp.argsort(dest, stable=True)
-    rank_sorted = jnp.arange(n, dtype=jnp.int32) - seg_starts[dest[order]].astype(jnp.int32)
-    slot = jnp.zeros((n,), jnp.int32).at[order].set(rank_sorted)
+    # Slot within the destination group, stable by assignment order.
+    slot, counts = stable_rank_in_group(dest, world)
     valid = slot < max_tokens
 
     token_of = jnp.arange(n) // topk
