@@ -1,0 +1,173 @@
+"""Contextual-autotuner tests (reference analog: autotuner.py protocol)."""
+
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from triton_dist_tpu.autotuner import AutotunedFunction, Config, autotune, contextual_autotune
+
+
+def make_slow_fast(counter):
+    """A tunable fn where cfg slow=True sleeps; tracks calls per config."""
+
+    @autotune(configs=[Config(slow=True), Config(slow=False)])
+    def fn(x, *, slow):
+        counter[slow] = counter.get(slow, 0) + 1
+        if slow:
+            time.sleep(0.005)
+        return x + 1
+
+    return fn
+
+
+def test_eager_tuning_picks_fast_config():
+    counter = {}
+    fn = make_slow_fast(counter)
+    out = fn(jnp.ones((4,)))
+    assert float(out[0]) == 2.0
+    assert fn.best_config == {"slow": False}
+    # cached: further calls only run the best config
+    n_slow = counter[True]
+    fn(jnp.ones((4,)))
+    assert counter[True] == n_slow
+
+
+def test_contextual_tuning_two_inner_tuners():
+    c1, c2 = {}, {}
+    inner1, inner2 = make_slow_fast(c1), make_slow_fast(c2)
+    outer_calls = []
+
+    @contextual_autotune(n_repeat=2, n_warmup=1)
+    def op(x):
+        outer_calls.append(1)
+        return inner2(inner1(x))
+
+    out = op(jnp.zeros((4,)))
+    assert float(out[0]) == 2.0
+    assert inner1.best_config == {"slow": False}
+    assert inner2.best_config == {"slow": False}
+    # lockstep protocol: each outer call advanced each tuner by exactly one
+    # step -> 2 configs x (1 warmup + 2 repeat) = 6 steps, + the closing run
+    assert len(outer_calls) >= 6
+
+
+def test_bad_configs_are_skipped():
+    @autotune(configs=[Config(bm=999), Config(bm=4)])
+    def fn(x, *, bm):
+        if bm > x.shape[0]:
+            raise ValueError("tile larger than array")
+        return x * 2
+
+    out = fn(jnp.ones((8,)))
+    assert float(out[0]) == 2.0
+    assert fn.best_config == {"bm": 4}
+
+
+def test_all_bad_configs_raise():
+    @autotune(configs=[Config(a=1), Config(a=2)])
+    def fn(x, *, a):
+        raise ValueError("nope")
+
+    with pytest.raises(RuntimeError, match="no valid config"):
+        fn(jnp.ones((2,)))
+
+
+def test_cache_keyed_on_shape_and_key_args():
+    calls = []
+
+    @autotune(configs=[Config(c=0), Config(c=1)], key=["mode"])
+    def fn(x, *, mode, c):
+        calls.append((x.shape, mode, c))
+        return x
+
+    fn(jnp.ones((4,)), mode="a")
+    n = len(calls)
+    fn(jnp.ones((4,)), mode="a")   # cache hit: one call
+    assert len(calls) == n + 1
+    fn(jnp.ones((8,)), mode="a")   # new shape: re-tune
+    assert len(calls) > n + 2
+    assert len(fn.cache) == 2
+
+
+def test_single_config_runs_directly():
+    @autotune(configs=[Config(k=3)])
+    def fn(x, *, k):
+        return x * k
+
+    assert float(fn(jnp.ones(()))) == 3.0
+
+
+def test_contextual_with_bad_config_inside():
+    @autotune(configs=[Config(bm=999), Config(bm=2)])
+    def inner(x, *, bm):
+        if bm > x.shape[0]:
+            raise ValueError("bad tile")
+        return x + 1
+
+    @contextual_autotune(n_repeat=1, n_warmup=0)
+    def op(x):
+        return inner(x)
+
+    out = op(jnp.zeros((4,)))
+    assert float(out[0]) == 1.0
+    assert inner.best_config == {"bm": 2}
+
+
+def test_autotuned_function_type():
+    fn = make_slow_fast({})
+    assert isinstance(fn, AutotunedFunction)
+
+
+def test_autotune_real_pallas_matmul():
+    """End-to-end: tune MXU block sizes of the Pallas matmul (interpret)."""
+    import numpy as np
+
+    from triton_dist_tpu.kernels.gemm import matmul_autotuned
+
+    a = jnp.ones((256, 256), jnp.float32)
+    b = jnp.ones((256, 128), jnp.float32)
+    out = matmul_autotuned(a, b, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(a @ b), rtol=1e-5)
+    assert matmul_autotuned.best_config is not None
+    assert set(matmul_autotuned.best_config) == {"bm", "bn", "bk"}
+
+
+def test_distinct_keys_tuned_in_one_contextual_region():
+    """Two shapes inside one region must keep separate sweeps (per-key state)."""
+    calls = []
+
+    @autotune(configs=[Config(c=0), Config(c=1)])
+    def inner(x, *, c):
+        calls.append((x.shape[0], c))
+        return x
+
+    @contextual_autotune(n_repeat=1, n_warmup=0)
+    def op(a, b):
+        return inner(a), inner(b)
+
+    a, b = jnp.zeros((4,)), jnp.zeros((8,))
+    op(a, b)
+    assert len(inner.cache) == 2
+    # each (shape, config) pair was actually measured
+    measured = {(s, c) for (s, c) in calls}
+    assert {(4, 0), (4, 1), (8, 0), (8, 1)} <= measured
+
+
+def test_scalar_kwargs_split_cache_entries():
+    @autotune(configs=[Config(c=0), Config(c=1)])
+    def fn(x, *, flag=False, c):
+        return x
+
+    fn(jnp.ones((4,)), flag=True)
+    fn(jnp.ones((4,)), flag=False)
+    assert len(fn.cache) == 2
+
+
+def test_prune_dedupes_clamped_matmul_configs():
+    from triton_dist_tpu.kernels.gemm import matmul_autotuned
+
+    cfgs = matmul_autotuned._configs_for(
+        (jnp.ones((256, 256), jnp.float32), jnp.ones((256, 128), jnp.float32)),
+        {})
+    assert len(cfgs) == 1  # everything clamps to (256, 128, 256)

@@ -190,3 +190,37 @@ def matmul(
 def matmul_kernel_tflops(m: int, n: int, k: int, ms: float) -> float:
     """Achieved TFLOPS for a (m, n, k) matmul that took ``ms`` milliseconds."""
     return 2.0 * m * n * k / (ms * 1e-3) / 1e12
+
+
+def _make_matmul_autotuned():
+    from triton_dist_tpu.autotuner import Config, autotune
+
+    configs = [
+        Config(bm=bm, bn=bn, bk=bk)
+        for bm in (256, 512) for bn in (256, 512) for bk in (512, 1024)
+    ]
+
+    def dedupe_clamped(cfgs, args, kwargs):
+        # Small shapes clamp many block configs to the same effective
+        # kernel; sweep each effective config once.
+        a, b = args[0], args[1]
+        m, k = a.shape
+        n = b.shape[1]
+        seen = {}
+        for c in cfgs:
+            eff = MatmulConfig(c["bm"], c["bn"], c["bk"]).for_shape(m, n, k)
+            seen.setdefault((eff.block_m, eff.block_n, eff.block_k), c)
+        return list(seen.values())
+
+    @autotune(configs=configs, prune=dedupe_clamped)
+    def matmul_autotuned(a, b, *, bm, bn, bk, out_dtype=None,
+                         interpret=False):
+        return matmul(a, b, config=MatmulConfig(bm, bn, bk),
+                      out_dtype=out_dtype, interpret=interpret)
+
+    return matmul_autotuned
+
+
+# Autotuned matmul: sweeps MXU block sizes per input shape/dtype; usable
+# standalone or inside a ``contextual_autotune`` region (autotuner.py).
+matmul_autotuned = _make_matmul_autotuned()
