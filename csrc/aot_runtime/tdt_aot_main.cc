@@ -1,0 +1,284 @@
+/* tdt_aot_run — manifest-driven, Python-free kernel runner.
+ *
+ * Usage:
+ *   tdt_aot_run --plugin libtpu.so --dir ARTIFACT_DIR --kernel NAME \
+ *       [--algo k=v ...] [--input FILE ...] [--output FILE ...] [--checksum]
+ *   tdt_aot_run --selftest MANIFEST_DIR      (no plugin needed)
+ *
+ * Variant selection = first manifest entry whose algo_info matches every
+ * --algo k=v, mirroring the reference's generated condition dispatcher
+ * (compile_aot.py:392-431).  Inputs are raw little-endian binaries of the
+ * manifest shapes; missing inputs are filled with an LCG pattern so smoke
+ * runs need no data files.
+ */
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include <map>
+#include <string>
+#include <vector>
+
+#include "tdt_aot_runtime.h"
+#include "tdt_json.h"
+
+namespace {
+
+struct Spec {
+  std::vector<int64_t> dims;
+  tdt_dtype dtype = TDT_INVALID;
+  size_t nbytes = 0;
+};
+
+Spec SpecFromJson(const tdt_json::ValuePtr& v) {
+  Spec s;
+  for (size_t i = 0; i < (*v)["shape"]->size(); ++i)
+    s.dims.push_back((*v)["shape"]->at(i)->as_int());
+  s.dtype = tdt_dtype_from_name((*v)["dtype"]->str.c_str());
+  s.nbytes = tdt_dtype_size(s.dtype);
+  for (int64_t d : s.dims) s.nbytes *= (size_t)d;
+  return s;
+}
+
+bool AlgoMatches(const tdt_json::ValuePtr& algo,
+                 const std::map<std::string, std::string>& want) {
+  for (const auto& kv : want) {
+    const tdt_json::ValuePtr& v = (*algo)[kv.first];
+    if (v->is_null()) return false;
+    char buf[64];
+    std::string got;
+    switch (v->kind) {
+      case tdt_json::Value::kString: got = v->str; break;
+      case tdt_json::Value::kBool: got = v->b ? "true" : "false"; break;
+      case tdt_json::Value::kNumber:
+        snprintf(buf, sizeof(buf), "%lld", v->as_int());
+        got = buf;
+        break;
+      default: return false;
+    }
+    if (got != kv.second) return false;
+  }
+  return true;
+}
+
+/* Deterministic fill so smoke runs are reproducible without input files. */
+void FillPattern(void* data, size_t nbytes, tdt_dtype t) {
+  uint32_t state = 0x243F6A88u;
+  if (t == TDT_F32) {
+    float* p = (float*)data;
+    for (size_t i = 0; i < nbytes / 4; ++i) {
+      state = state * 1664525u + 1013904223u;
+      p[i] = (float)(state >> 8) / (float)(1u << 24) - 0.5f;
+    }
+  } else if (t == TDT_S32) {
+    int32_t* p = (int32_t*)data;
+    for (size_t i = 0; i < nbytes / 4; ++i) p[i] = (int32_t)(i % 128);
+  } else {
+    uint8_t* p = (uint8_t*)data;
+    for (size_t i = 0; i < nbytes; ++i) {
+      state = state * 1664525u + 1013904223u;
+      p[i] = (uint8_t)(state >> 24);
+    }
+  }
+}
+
+bool ReadRaw(const char* path, void* dst, size_t nbytes) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return false;
+  size_t got = fread(dst, 1, nbytes, f);
+  fclose(f);
+  return got == nbytes;
+}
+
+bool WriteRaw(const char* path, const void* src, size_t nbytes) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return false;
+  size_t put = fwrite(src, 1, nbytes, f);
+  fclose(f);
+  return put == nbytes;
+}
+
+double Checksum(const void* data, size_t nbytes, tdt_dtype t) {
+  double acc = 0;
+  if (t == TDT_F32) {
+    const float* p = (const float*)data;
+    for (size_t i = 0; i < nbytes / 4; ++i) acc += (double)p[i];
+  } else {
+    const uint8_t* p = (const uint8_t*)data;
+    for (size_t i = 0; i < nbytes; ++i) acc += p[i];
+  }
+  return acc;
+}
+
+int Selftest(const std::string& dir) {
+  /* Plugin-free path: parse manifest, resolve a variant, stat artifacts. */
+  std::string err;
+  tdt_json::ValuePtr m = tdt_json::ParseFile(dir + "/manifest.json", &err);
+  if (!m) {
+    fprintf(stderr, "selftest: %s\n", err.c_str());
+    return 1;
+  }
+  int n_variants = 0;
+  for (const auto& kv : (*m)["kernels"]->obj) {
+    for (size_t i = 0; i < kv.second->size(); ++i) {
+      const tdt_json::ValuePtr& e = kv.second->at(i);
+      Spec in0 = SpecFromJson((*e)["inputs"]->at(0));
+      if (in0.nbytes == 0 || in0.dtype == TDT_INVALID) {
+        fprintf(stderr, "selftest: bad spec in %s\n", kv.first.c_str());
+        return 1;
+      }
+      std::string path = dir + "/" + (*e)["stablehlo"]->str;
+      FILE* f = fopen(path.c_str(), "rb");
+      if (!f) {
+        fprintf(stderr, "selftest: missing artifact %s\n", path.c_str());
+        return 1;
+      }
+      fclose(f);
+      ++n_variants;
+    }
+  }
+  printf("selftest ok: %zu kernels, %d variants\n",
+         (*m)["kernels"]->obj.size(), n_variants);
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string plugin, dir, kernel;
+  std::map<std::string, std::string> algo;
+  std::vector<std::pair<std::string, std::string>> copts;
+  std::vector<std::string> in_files, out_files;
+  bool checksum = false;
+  long variant = -1;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&]() -> const char* {
+      if (i + 1 >= argc) { fprintf(stderr, "missing value for %s\n", a.c_str()); exit(2); }
+      return argv[++i];
+    };
+    if (a == "--plugin") plugin = next();
+    else if (a == "--dir") dir = next();
+    else if (a == "--kernel") kernel = next();
+    else if (a == "--algo") {
+      std::string kv = next();
+      size_t eq = kv.find('=');
+      if (eq == std::string::npos) { fprintf(stderr, "--algo wants k=v\n"); return 2; }
+      algo[kv.substr(0, eq)] = kv.substr(eq + 1);
+    } else if (a == "--copt") {
+      std::string kv = next();
+      size_t eq = kv.find('=');
+      if (eq == std::string::npos) { fprintf(stderr, "--copt wants k=v\n"); return 2; }
+      copts.emplace_back(kv.substr(0, eq), kv.substr(eq + 1));
+    } else if (a == "--input") in_files.push_back(next());
+    else if (a == "--output") out_files.push_back(next());
+    else if (a == "--checksum") checksum = true;
+    else if (a == "--var") variant = strtol(next(), nullptr, 10);
+    else if (a == "--selftest") return Selftest(next());
+    else { fprintf(stderr, "unknown arg %s\n", a.c_str()); return 2; }
+  }
+  if (plugin.empty() || dir.empty() || kernel.empty()) {
+    fprintf(stderr, "usage: tdt_aot_run --plugin SO --dir DIR --kernel NAME "
+                    "[--algo k=v]... [--input F]... [--output F]... "
+                    "[--checksum] | --selftest DIR\n");
+    return 2;
+  }
+
+  std::string err;
+  tdt_json::ValuePtr m = tdt_json::ParseFile(dir + "/manifest.json", &err);
+  if (!m) { fprintf(stderr, "manifest: %s\n", err.c_str()); return 1; }
+
+  const tdt_json::ValuePtr& entries = (*(*m)["kernels"])[kernel];
+  if (entries->is_null()) { fprintf(stderr, "no kernel %s\n", kernel.c_str()); return 1; }
+  tdt_json::ValuePtr chosen;
+  for (size_t i = 0; i < entries->size(); ++i) {
+    if (variant >= 0) {
+      if ((*entries->at(i))["variant"]->as_int() == variant) {
+        chosen = entries->at(i);
+        break;
+      }
+      continue;
+    }
+    if (AlgoMatches((*entries->at(i))["algo_info"], algo)) {
+      chosen = entries->at(i);
+      break;
+    }
+  }
+  if (!chosen) { fprintf(stderr, "no variant matches algo\n"); return 1; }
+
+  std::vector<tdt_option> opts(copts.size());
+  for (size_t i = 0; i < copts.size(); ++i) {
+    opts[i].name = copts[i].first.c_str();
+    char* end = nullptr;
+    long long v = strtoll(copts[i].second.c_str(), &end, 10);
+    if (end && *end == '\0' && !copts[i].second.empty()) {
+      opts[i].is_int = 1;
+      opts[i].int_value = v;
+      opts[i].str_value = nullptr;
+    } else {
+      opts[i].is_int = 0;
+      opts[i].str_value = copts[i].second.c_str();
+      opts[i].int_value = 0;
+    }
+  }
+  tdt_ctx* ctx = tdt_init_with_options(plugin.c_str(), opts.data(),
+                                       (int)opts.size());
+  if (!ctx) return 1;
+  printf("platform: %s\n", tdt_platform(ctx));
+
+  std::string module = dir + "/" + (*chosen)["stablehlo"]->str;
+  std::string options = dir + "/" + (*(*m)["compile_options"]).str;
+  int exec = tdt_load(ctx, module.c_str(), options.c_str());
+  if (exec < 0) { fprintf(stderr, "load: %s\n", tdt_last_error(ctx)); return 1; }
+  printf("loaded %s (%d outputs)\n", module.c_str(), tdt_num_outputs(ctx, exec));
+
+  const tdt_json::ValuePtr& in_specs = (*chosen)["inputs"];
+  const tdt_json::ValuePtr& out_specs = (*chosen)["outputs"];
+  std::vector<tdt_buffer> inputs(in_specs->size());
+  std::vector<std::vector<char>> in_mem(in_specs->size());
+  for (size_t i = 0; i < in_specs->size(); ++i) {
+    Spec s = SpecFromJson(in_specs->at(i));
+    in_mem[i].resize(s.nbytes);
+    if (i < in_files.size()) {
+      if (!ReadRaw(in_files[i].c_str(), in_mem[i].data(), s.nbytes)) {
+        fprintf(stderr, "cannot read %s\n", in_files[i].c_str());
+        return 1;
+      }
+    } else {
+      FillPattern(in_mem[i].data(), s.nbytes, s.dtype);
+    }
+    inputs[i].data = in_mem[i].data();
+    inputs[i].ndims = (int32_t)s.dims.size();
+    for (size_t d = 0; d < s.dims.size(); ++d) inputs[i].dims[d] = s.dims[d];
+    inputs[i].dtype = s.dtype;
+    inputs[i].nbytes = s.nbytes;
+  }
+  std::vector<tdt_buffer> outputs(out_specs->size());
+  std::vector<std::vector<char>> out_mem(out_specs->size());
+  for (size_t i = 0; i < out_specs->size(); ++i) {
+    Spec s = SpecFromJson(out_specs->at(i));
+    out_mem[i].resize(s.nbytes);
+    outputs[i].data = out_mem[i].data();
+    outputs[i].ndims = (int32_t)s.dims.size();
+    for (size_t d = 0; d < s.dims.size(); ++d) outputs[i].dims[d] = s.dims[d];
+    outputs[i].dtype = s.dtype;
+    outputs[i].nbytes = s.nbytes;
+  }
+
+  if (tdt_execute(ctx, exec, inputs.data(), (int)inputs.size(),
+                  outputs.data(), (int)outputs.size()) != 0) {
+    fprintf(stderr, "execute: %s\n", tdt_last_error(ctx));
+    tdt_destroy(ctx);
+    return 1;
+  }
+  for (size_t i = 0; i < outputs.size(); ++i) {
+    if (i < out_files.size())
+      WriteRaw(out_files[i].c_str(), outputs[i].data, outputs[i].nbytes);
+    if (checksum)
+      printf("output[%zu] checksum: %.6f\n", i,
+             Checksum(outputs[i].data, outputs[i].nbytes, outputs[i].dtype));
+  }
+  printf("ok\n");
+  tdt_destroy(ctx);
+  return 0;
+}

@@ -1,0 +1,394 @@
+/* tdt_aot_runtime.cc — PJRT-plugin-backed AOT executor (see header).
+ *
+ * Reference analog: tools/runtime/triton_aot_runtime.cc:56-140 (dlopen'd
+ * driver library + CHECKed symbol resolution); the PJRT C API plays the
+ * role the CUDA driver API plays there.
+ */
+#include "tdt_aot_runtime.h"
+
+#include <dlfcn.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <string>
+#include <vector>
+
+#include "third_party/pjrt_c_api.h"
+
+namespace {
+
+struct Executable {
+  PJRT_LoadedExecutable* loaded = nullptr;
+  PJRT_Executable* exec = nullptr;  /* metadata view */
+  size_t num_outputs = 0;
+};
+
+}  // namespace
+
+struct tdt_ctx {
+  void* dl = nullptr;
+  const PJRT_Api* api = nullptr;
+  PJRT_Client* client = nullptr;
+  PJRT_Device* device = nullptr;
+  std::string platform;
+  std::string error;
+  std::vector<Executable> execs;
+
+  bool Check(PJRT_Error* err, const char* what) {
+    if (err == nullptr) return true;
+    PJRT_Error_Message_Args margs;
+    memset(&margs, 0, sizeof(margs));
+    margs.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+    margs.error = err;
+    api->PJRT_Error_Message(&margs);
+    error.assign(what);
+    error += ": ";
+    error.append(margs.message, margs.message_size);
+    PJRT_Error_Destroy_Args dargs;
+    memset(&dargs, 0, sizeof(dargs));
+    dargs.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    dargs.error = err;
+    api->PJRT_Error_Destroy(&dargs);
+    return false;
+  }
+};
+
+#define INIT_ARGS(T, v)            \
+  T v;                             \
+  memset(&v, 0, sizeof(v));        \
+  v.struct_size = T##_STRUCT_SIZE
+
+static bool read_file(const char* path, std::string* out, std::string* err) {
+  FILE* f = fopen(path, "rb");
+  if (!f) {
+    *err = std::string("cannot open ") + path;
+    return false;
+  }
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  out->resize((size_t)n);
+  size_t got = fread(&(*out)[0], 1, (size_t)n, f);
+  fclose(f);
+  if (got != (size_t)n) {
+    *err = std::string("short read of ") + path;
+    return false;
+  }
+  return true;
+}
+
+extern "C" {
+
+tdt_ctx* tdt_init(const char* plugin_path) {
+  return tdt_init_with_options(plugin_path, nullptr, 0);
+}
+
+tdt_ctx* tdt_init_with_options(const char* plugin_path,
+                               const tdt_option* options, int n_options) {
+  tdt_ctx* ctx = new tdt_ctx();
+  ctx->dl = dlopen(plugin_path, RTLD_LOCAL | RTLD_NOW);
+  if (!ctx->dl) {
+    fprintf(stderr, "tdt_init: dlopen(%s): %s\n", plugin_path, dlerror());
+    delete ctx;
+    return nullptr;
+  }
+  typedef const PJRT_Api* (*GetPjrtApiFn)();
+  GetPjrtApiFn get_api = (GetPjrtApiFn)dlsym(ctx->dl, "GetPjrtApi");
+  if (!get_api) {
+    fprintf(stderr, "tdt_init: no GetPjrtApi in %s\n", plugin_path);
+    dlclose(ctx->dl);
+    delete ctx;
+    return nullptr;
+  }
+  ctx->api = get_api();
+
+  {
+    INIT_ARGS(PJRT_Plugin_Initialize_Args, args);
+    if (!ctx->Check(ctx->api->PJRT_Plugin_Initialize(&args),
+                    "PJRT_Plugin_Initialize")) {
+      fprintf(stderr, "tdt_init: %s\n", ctx->error.c_str());
+      delete ctx;
+      return nullptr;
+    }
+  }
+  {
+    std::vector<PJRT_NamedValue> named((size_t)n_options);
+    for (int i = 0; i < n_options; ++i) {
+      memset(&named[i], 0, sizeof(named[i]));
+      named[i].struct_size = PJRT_NamedValue_STRUCT_SIZE;
+      named[i].name = options[i].name;
+      named[i].name_size = strlen(options[i].name);
+      if (options[i].is_int) {
+        named[i].type = PJRT_NamedValue_kInt64;
+        named[i].int64_value = options[i].int_value;
+        named[i].value_size = 1;
+      } else {
+        named[i].type = PJRT_NamedValue_kString;
+        named[i].string_value = options[i].str_value;
+        named[i].value_size = strlen(options[i].str_value);
+      }
+    }
+    INIT_ARGS(PJRT_Client_Create_Args, args);
+    args.create_options = named.data();
+    args.num_options = named.size();
+    if (!ctx->Check(ctx->api->PJRT_Client_Create(&args),
+                    "PJRT_Client_Create")) {
+      fprintf(stderr, "tdt_init: %s\n", ctx->error.c_str());
+      delete ctx;
+      return nullptr;
+    }
+    ctx->client = args.client;
+  }
+  {
+    INIT_ARGS(PJRT_Client_PlatformName_Args, args);
+    args.client = ctx->client;
+    if (ctx->Check(ctx->api->PJRT_Client_PlatformName(&args),
+                   "PJRT_Client_PlatformName")) {
+      ctx->platform.assign(args.platform_name, args.platform_name_size);
+    }
+  }
+  {
+    INIT_ARGS(PJRT_Client_AddressableDevices_Args, args);
+    args.client = ctx->client;
+    if (!ctx->Check(ctx->api->PJRT_Client_AddressableDevices(&args),
+                    "PJRT_Client_AddressableDevices") ||
+        args.num_addressable_devices == 0) {
+      fprintf(stderr, "tdt_init: no addressable devices\n");
+      tdt_destroy(ctx);
+      return nullptr;
+    }
+    ctx->device = args.addressable_devices[0];
+  }
+  return ctx;
+}
+
+int tdt_load(tdt_ctx* ctx, const char* module_path, const char* options_path) {
+  std::string code, options;
+  if (!read_file(module_path, &code, &ctx->error)) return -1;
+  if (!read_file(options_path, &options, &ctx->error)) return -1;
+
+  INIT_ARGS(PJRT_Program, program);
+  program.code = &code[0];
+  program.code_size = code.size();
+  static const char kFormat[] = "mlir";
+  program.format = kFormat;
+  program.format_size = sizeof(kFormat) - 1;
+
+  INIT_ARGS(PJRT_Client_Compile_Args, args);
+  args.client = ctx->client;
+  args.program = &program;
+  args.compile_options = options.data();
+  args.compile_options_size = options.size();
+  if (!ctx->Check(ctx->api->PJRT_Client_Compile(&args), "PJRT_Client_Compile"))
+    return -1;
+
+  Executable e;
+  e.loaded = args.executable;
+  {
+    INIT_ARGS(PJRT_LoadedExecutable_GetExecutable_Args, gargs);
+    gargs.loaded_executable = e.loaded;
+    if (!ctx->Check(ctx->api->PJRT_LoadedExecutable_GetExecutable(&gargs),
+                    "PJRT_LoadedExecutable_GetExecutable"))
+      return -1;
+    e.exec = gargs.executable;
+  }
+  {
+    INIT_ARGS(PJRT_Executable_NumOutputs_Args, nargs);
+    nargs.executable = e.exec;
+    if (!ctx->Check(ctx->api->PJRT_Executable_NumOutputs(&nargs),
+                    "PJRT_Executable_NumOutputs"))
+      return -1;
+    e.num_outputs = nargs.num_outputs;
+  }
+  ctx->execs.push_back(e);
+  return (int)ctx->execs.size() - 1;
+}
+
+int tdt_num_outputs(tdt_ctx* ctx, int exec) {
+  if (exec < 0 || (size_t)exec >= ctx->execs.size()) return -1;
+  return (int)ctx->execs[exec].num_outputs;
+}
+
+static PJRT_Buffer_Type to_pjrt_type(tdt_dtype t) {
+  switch (t) {
+    case TDT_PRED: return PJRT_Buffer_Type_PRED;
+    case TDT_S8: return PJRT_Buffer_Type_S8;
+    case TDT_S16: return PJRT_Buffer_Type_S16;
+    case TDT_S32: return PJRT_Buffer_Type_S32;
+    case TDT_S64: return PJRT_Buffer_Type_S64;
+    case TDT_U8: return PJRT_Buffer_Type_U8;
+    case TDT_U16: return PJRT_Buffer_Type_U16;
+    case TDT_U32: return PJRT_Buffer_Type_U32;
+    case TDT_U64: return PJRT_Buffer_Type_U64;
+    case TDT_F16: return PJRT_Buffer_Type_F16;
+    case TDT_F32: return PJRT_Buffer_Type_F32;
+    case TDT_F64: return PJRT_Buffer_Type_F64;
+    case TDT_BF16: return PJRT_Buffer_Type_BF16;
+    default: return PJRT_Buffer_Type_INVALID;
+  }
+}
+
+int tdt_execute(tdt_ctx* ctx, int exec, const tdt_buffer* inputs, int n_in,
+                tdt_buffer* outputs, int n_out) {
+  if (exec < 0 || (size_t)exec >= ctx->execs.size()) {
+    ctx->error = "bad executable handle";
+    return 1;
+  }
+  Executable& e = ctx->execs[exec];
+  if ((size_t)n_out != e.num_outputs) {
+    ctx->error = "output count mismatch";
+    return 1;
+  }
+
+  /* host -> device */
+  std::vector<PJRT_Buffer*> in_bufs(n_in, nullptr);
+  std::vector<PJRT_Event*> done_events(n_in, nullptr);
+  int rc = 1;
+  std::vector<PJRT_Buffer*> out_bufs(e.num_outputs, nullptr);
+  PJRT_Event* exec_done = nullptr;
+  for (int i = 0; i < n_in; ++i) {
+    INIT_ARGS(PJRT_Client_BufferFromHostBuffer_Args, args);
+    args.client = ctx->client;
+    args.data = inputs[i].data;
+    args.type = to_pjrt_type(inputs[i].dtype);
+    args.dims = inputs[i].dims;
+    args.num_dims = (size_t)inputs[i].ndims;
+    args.host_buffer_semantics =
+        PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+    args.device = ctx->device;
+    if (!ctx->Check(ctx->api->PJRT_Client_BufferFromHostBuffer(&args),
+                    "PJRT_Client_BufferFromHostBuffer"))
+      goto cleanup;
+    in_bufs[i] = args.buffer;
+    done_events[i] = args.done_with_host_buffer;
+  }
+  for (int i = 0; i < n_in; ++i) {
+    if (!done_events[i]) continue;
+    INIT_ARGS(PJRT_Event_Await_Args, args);
+    args.event = done_events[i];
+    if (!ctx->Check(ctx->api->PJRT_Event_Await(&args), "PJRT_Event_Await"))
+      goto cleanup;
+    INIT_ARGS(PJRT_Event_Destroy_Args, dargs);
+    dargs.event = done_events[i];
+    ctx->api->PJRT_Event_Destroy(&dargs);
+    done_events[i] = nullptr;
+  }
+
+  /* execute */
+  {
+    INIT_ARGS(PJRT_ExecuteOptions, opts);
+    INIT_ARGS(PJRT_LoadedExecutable_Execute_Args, args);
+    args.executable = e.loaded;
+    args.options = &opts;
+    PJRT_Buffer* const* arg_list = in_bufs.data();
+    args.argument_lists = &arg_list;
+    args.num_devices = 1;
+    args.num_args = (size_t)n_in;
+    PJRT_Buffer** out_list = out_bufs.data();
+    args.output_lists = &out_list;
+    args.device_complete_events = &exec_done;
+    args.execute_device = ctx->device;
+    if (!ctx->Check(ctx->api->PJRT_LoadedExecutable_Execute(&args),
+                    "PJRT_LoadedExecutable_Execute"))
+      goto cleanup;
+  }
+  if (exec_done) {
+    INIT_ARGS(PJRT_Event_Await_Args, args);
+    args.event = exec_done;
+    bool ok = ctx->Check(ctx->api->PJRT_Event_Await(&args),
+                         "execute PJRT_Event_Await");
+    INIT_ARGS(PJRT_Event_Destroy_Args, dargs);
+    dargs.event = exec_done;
+    ctx->api->PJRT_Event_Destroy(&dargs);
+    exec_done = nullptr;
+    if (!ok) goto cleanup;
+  }
+
+  /* device -> host */
+  for (int i = 0; i < n_out; ++i) {
+    INIT_ARGS(PJRT_Buffer_ToHostBuffer_Args, args);
+    args.src = out_bufs[i];
+    args.dst = outputs[i].data;
+    args.dst_size = outputs[i].nbytes;
+    if (!ctx->Check(ctx->api->PJRT_Buffer_ToHostBuffer(&args),
+                    "PJRT_Buffer_ToHostBuffer"))
+      goto cleanup;
+    if (args.event) {
+      INIT_ARGS(PJRT_Event_Await_Args, aargs);
+      aargs.event = args.event;
+      bool ok = ctx->Check(ctx->api->PJRT_Event_Await(&aargs),
+                           "to_host PJRT_Event_Await");
+      INIT_ARGS(PJRT_Event_Destroy_Args, dargs);
+      dargs.event = args.event;
+      ctx->api->PJRT_Event_Destroy(&dargs);
+      if (!ok) goto cleanup;
+    }
+  }
+  rc = 0;
+
+cleanup:
+  for (PJRT_Buffer* b : in_bufs) {
+    if (!b) continue;
+    INIT_ARGS(PJRT_Buffer_Destroy_Args, args);
+    args.buffer = b;
+    ctx->api->PJRT_Buffer_Destroy(&args);
+  }
+  for (PJRT_Buffer* b : out_bufs) {
+    if (!b) continue;
+    INIT_ARGS(PJRT_Buffer_Destroy_Args, args);
+    args.buffer = b;
+    ctx->api->PJRT_Buffer_Destroy(&args);
+  }
+  return rc;
+}
+
+const char* tdt_platform(tdt_ctx* ctx) { return ctx->platform.c_str(); }
+
+const char* tdt_last_error(tdt_ctx* ctx) { return ctx->error.c_str(); }
+
+void tdt_destroy(tdt_ctx* ctx) {
+  if (!ctx) return;
+  for (Executable& e : ctx->execs) {
+    if (e.loaded) {
+      INIT_ARGS(PJRT_LoadedExecutable_Destroy_Args, args);
+      args.executable = e.loaded;
+      ctx->api->PJRT_LoadedExecutable_Destroy(&args);
+    }
+  }
+  if (ctx->client) {
+    INIT_ARGS(PJRT_Client_Destroy_Args, args);
+    args.client = ctx->client;
+    ctx->api->PJRT_Client_Destroy(&args);
+  }
+  /* Do not dlclose the plugin: PJRT plugins register global state and
+   * unloading them mid-process is not supported (same reason the reference
+   * keeps libcuda resident). */
+  delete ctx;
+}
+
+size_t tdt_dtype_size(tdt_dtype t) {
+  switch (t) {
+    case TDT_PRED: case TDT_S8: case TDT_U8: return 1;
+    case TDT_S16: case TDT_U16: case TDT_F16: case TDT_BF16: return 2;
+    case TDT_S32: case TDT_U32: case TDT_F32: return 4;
+    case TDT_S64: case TDT_U64: case TDT_F64: return 8;
+    default: return 0;
+  }
+}
+
+tdt_dtype tdt_dtype_from_name(const char* name) {
+  struct Entry { const char* n; tdt_dtype t; };
+  static const Entry kTable[] = {
+      {"bool", TDT_PRED},   {"int8", TDT_S8},    {"int16", TDT_S16},
+      {"int32", TDT_S32},   {"int64", TDT_S64},  {"uint8", TDT_U8},
+      {"uint16", TDT_U16},  {"uint32", TDT_U32}, {"uint64", TDT_U64},
+      {"float16", TDT_F16}, {"float32", TDT_F32}, {"float64", TDT_F64},
+      {"bfloat16", TDT_BF16},
+  };
+  for (const Entry& e : kTable)
+    if (strcmp(name, e.n) == 0) return e.t;
+  return TDT_INVALID;
+}
+
+}  /* extern "C" */

@@ -1,0 +1,160 @@
+#include "tdt_json.h"
+
+#include <cctype>
+#include <cstdio>
+#include <cstdlib>
+
+namespace tdt_json {
+
+static const ValuePtr kNullValue = std::make_shared<Value>();
+
+const ValuePtr& Value::operator[](const std::string& k) const {
+  auto it = obj.find(k);
+  return it == obj.end() ? kNullValue : it->second;
+}
+
+namespace {
+
+struct Parser {
+  const std::string& s;
+  size_t i = 0;
+  std::string* err;
+
+  explicit Parser(const std::string& text, std::string* e) : s(text), err(e) {}
+
+  void Skip() {
+    while (i < s.size() && std::isspace((unsigned char)s[i])) ++i;
+  }
+
+  bool Fail(const char* msg) {
+    char buf[64];
+    snprintf(buf, sizeof(buf), "%s at offset %zu", msg, i);
+    *err = buf;
+    return false;
+  }
+
+  bool ParseString(std::string* out) {
+    if (s[i] != '"') return Fail("expected string");
+    ++i;
+    out->clear();
+    while (i < s.size() && s[i] != '"') {
+      char c = s[i++];
+      if (c == '\\' && i < s.size()) {
+        char e = s[i++];
+        switch (e) {
+          case 'n': out->push_back('\n'); break;
+          case 't': out->push_back('\t'); break;
+          case 'r': out->push_back('\r'); break;
+          case '"': case '\\': case '/': out->push_back(e); break;
+          default: return Fail("unsupported escape");
+        }
+      } else {
+        out->push_back(c);
+      }
+    }
+    if (i >= s.size()) return Fail("unterminated string");
+    ++i;
+    return true;
+  }
+
+  ValuePtr ParseValue() {
+    Skip();
+    if (i >= s.size()) { Fail("unexpected end"); return nullptr; }
+    char c = s[i];
+    auto v = std::make_shared<Value>();
+    if (c == '{') {
+      ++i;
+      v->kind = Value::kObject;
+      Skip();
+      if (i < s.size() && s[i] == '}') { ++i; return v; }
+      while (true) {
+        Skip();
+        std::string key;
+        if (!ParseString(&key)) return nullptr;
+        Skip();
+        if (i >= s.size() || s[i] != ':') { Fail("expected ':'"); return nullptr; }
+        ++i;
+        ValuePtr item = ParseValue();
+        if (!item) return nullptr;
+        v->obj[key] = item;
+        Skip();
+        if (i < s.size() && s[i] == ',') { ++i; continue; }
+        if (i < s.size() && s[i] == '}') { ++i; return v; }
+        Fail("expected ',' or '}'");
+        return nullptr;
+      }
+    }
+    if (c == '[') {
+      ++i;
+      v->kind = Value::kArray;
+      Skip();
+      if (i < s.size() && s[i] == ']') { ++i; return v; }
+      while (true) {
+        ValuePtr item = ParseValue();
+        if (!item) return nullptr;
+        v->arr.push_back(item);
+        Skip();
+        if (i < s.size() && s[i] == ',') { ++i; continue; }
+        if (i < s.size() && s[i] == ']') { ++i; return v; }
+        Fail("expected ',' or ']'");
+        return nullptr;
+      }
+    }
+    if (c == '"') {
+      v->kind = Value::kString;
+      if (!ParseString(&v->str)) return nullptr;
+      return v;
+    }
+    if (s.compare(i, 4, "true") == 0) {
+      v->kind = Value::kBool; v->b = true; i += 4; return v;
+    }
+    if (s.compare(i, 5, "false") == 0) {
+      v->kind = Value::kBool; v->b = false; i += 5; return v;
+    }
+    if (s.compare(i, 4, "null") == 0) { i += 4; return v; }
+    /* number */
+    {
+      char* end = nullptr;
+      v->kind = Value::kNumber;
+      v->num = strtod(s.c_str() + i, &end);
+      if (end == s.c_str() + i) { Fail("bad number"); return nullptr; }
+      i = (size_t)(end - s.c_str());
+      return v;
+    }
+  }
+};
+
+}  // namespace
+
+ValuePtr Parse(const std::string& text, std::string* err) {
+  Parser p(text, err);
+  ValuePtr v = p.ParseValue();
+  if (!v) return nullptr;
+  p.Skip();
+  if (p.i != text.size()) {
+    p.Fail("trailing characters");
+    return nullptr;
+  }
+  return v;
+}
+
+ValuePtr ParseFile(const std::string& path, std::string* err) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) {
+    *err = "cannot open " + path;
+    return nullptr;
+  }
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::string text((size_t)n, '\0');
+  size_t got = fread(&text[0], 1, (size_t)n, f);
+  fclose(f);
+  if (got != (size_t)n) {
+    *err = "short read of " + path;
+    return nullptr;
+  }
+  return Parse(text, err);
+}
+
+}  // namespace tdt_json

@@ -1,0 +1,41 @@
+/* Minimal JSON reader for the compile_aot manifest (we control the writer,
+ * so only the subset it emits is supported: objects, arrays, strings,
+ * integers/doubles, booleans, null).  No external deps — the native runtime
+ * must stand alone, like the reference's AOT C runtime. */
+#ifndef TDT_JSON_H_
+#define TDT_JSON_H_
+
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace tdt_json {
+
+struct Value;
+using ValuePtr = std::shared_ptr<Value>;
+
+struct Value {
+  enum Kind { kNull, kBool, kNumber, kString, kArray, kObject } kind = kNull;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<ValuePtr> arr;
+  std::map<std::string, ValuePtr> obj;
+
+  bool is_null() const { return kind == kNull; }
+  const ValuePtr& operator[](const std::string& k) const;
+  const ValuePtr& at(size_t i) const { return arr.at(i); }
+  size_t size() const { return kind == kArray ? arr.size() : obj.size(); }
+  long long as_int() const { return (long long)num; }
+};
+
+/* Parse; returns null on syntax error and sets *err. */
+ValuePtr Parse(const std::string& text, std::string* err);
+
+/* Load + parse a file. */
+ValuePtr ParseFile(const std::string& path, std::string* err);
+
+}  // namespace tdt_json
+
+#endif  /* TDT_JSON_H_ */

@@ -1,0 +1,119 @@
+"""AOT export/reload tests (reference analog: compile_aot.py + AOT runtime).
+
+The native C++ runtime is exercised separately (csrc/aot_runtime; built in
+test_aot_native.py) — here we check the export tool, manifest dispatch, and
+Python round-trip numerics.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_dist_tpu.tools import compile_aot
+
+
+@pytest.fixture(scope="module")
+def exported(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("aot"))
+    # Importing the kernels populates the registry.
+    import triton_dist_tpu.kernels.flash_decode  # noqa: F401
+    import triton_dist_tpu.kernels.gemm  # noqa: F401
+
+    manifest = compile_aot.export_registered(out, kernels=["matmul"])
+    return out, manifest
+
+
+def test_manifest_structure(exported):
+    out, manifest = exported
+    assert os.path.exists(os.path.join(out, compile_aot.MANIFEST_NAME))
+    assert os.path.exists(os.path.join(out, compile_aot.COMPILE_OPTIONS_NAME))
+    entries = manifest["kernels"]["matmul"]
+    assert len(entries) == 4  # 2 signatures x 2 algo infos
+    for e in entries:
+        assert os.path.exists(os.path.join(out, e["jaxexport"]))
+        assert os.path.exists(os.path.join(out, e["stablehlo"]))
+        assert e["inputs"] and e["outputs"]
+    # manifest is valid JSON on disk
+    with open(os.path.join(out, compile_aot.MANIFEST_NAME)) as f:
+        assert json.load(f)["kernels"]["matmul"]
+
+
+def test_roundtrip_numerics(exported):
+    out, _ = exported
+    fn = compile_aot.load_exported(
+        out, "matmul", algo_info={"bm": 256},
+        inputs=[((1024, 1024), "float32"), ((1024, 512), "float32")])
+    a = np.random.default_rng(0).standard_normal((1024, 1024), np.float32)
+    b = np.random.default_rng(1).standard_normal((1024, 512), np.float32)
+    got = np.asarray(fn(a, b))
+    np.testing.assert_allclose(got, a @ b, rtol=2e-4, atol=2e-4)
+
+
+def test_dispatch_no_match_raises(exported):
+    out, _ = exported
+    with pytest.raises(KeyError, match="no variant"):
+        compile_aot.load_exported(out, "matmul", algo_info={"bm": 777})
+
+
+def test_flash_decode_registered():
+    import triton_dist_tpu.kernels.flash_decode  # noqa: F401
+
+    regs = compile_aot.registered_kernels()
+    assert "gqa_decode" in regs
+    _, sp = regs["gqa_decode"]
+    assert len(sp["algo_infos"]) == 3
+
+
+def test_flash_decode_export_and_reload(tmp_path):
+    import triton_dist_tpu.kernels.flash_decode as fd
+
+    out = str(tmp_path)
+    b, hq, hkv, d, s = 2, 8, 2, 128, 256
+    sig = [[((b, hq, d), "float32"), ((b, hkv, s, d), "float32"),
+            ((b, hkv, s, d), "float32"), ((b,), "int32")]]
+    compile_aot.export_kernel(fd.gqa_decode_shard, "gqa_small", out, sig,
+                              [{"impl": "xla"}])
+    # hand-write a manifest for load_exported
+    manifest = {"kernels": {"gqa_small": [{
+        "kernel": "gqa_small", "variant": 0, "algo_info": {"impl": "xla"},
+        "jaxexport": "gqa_small.v0.jaxexport",
+        "stablehlo": "gqa_small.v0.mlir.bc",
+        "inputs": [], "outputs": [], "platforms": [], "main": "main"}]}}
+    with open(os.path.join(out, compile_aot.MANIFEST_NAME), "w") as f:
+        json.dump(manifest, f)
+
+    fn = compile_aot.load_exported(out, "gqa_small")
+    rng = np.random.default_rng(2)
+    q = rng.standard_normal((b, hq, d), np.float32)
+    k = rng.standard_normal((b, hkv, s, d), np.float32)
+    v = rng.standard_normal((b, hkv, s, d), np.float32)
+    lens = np.full((b,), s, np.int32)
+    o, lse = fn(q, k, v, lens)
+    ref_o, ref_lse = fd.gqa_decode_shard(jnp.asarray(q), jnp.asarray(k),
+                                         jnp.asarray(v), jnp.asarray(lens),
+                                         impl="xla")
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref_o), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_cli_main(tmp_path, capsys):
+    rc = compile_aot.main(["--out", str(tmp_path), "--kernels", "matmul"])
+    assert rc == 0
+    assert "exported" in capsys.readouterr().out
+    assert os.path.exists(os.path.join(str(tmp_path),
+                                       compile_aot.MANIFEST_NAME))
+
+
+def test_gqa_decode_exports_on_cpu(tmp_path):
+    """Regression: registry export must work on non-TPU hosts (impl=auto)."""
+    import triton_dist_tpu.kernels.flash_decode  # noqa: F401
+
+    manifest = compile_aot.export_registered(str(tmp_path),
+                                             kernels=["gqa_decode"])
+    assert len(manifest["kernels"]["gqa_decode"]) == 6  # 2 sigs x 3 algos

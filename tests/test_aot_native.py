@@ -1,0 +1,79 @@
+"""Native AOT runtime tests: build + plugin-free surface.
+
+The PJRT-plugin execution path needs real hardware (no CPU PJRT plugin .so
+ships with jaxlib); it is exercised by scripts/run_aot_native_tpu.sh, which
+ran the exported Pallas matmul through csrc/aot_runtime on the TPU and
+matched numpy bit-exactly.  Here we build the runtime and test everything
+that doesn't need a plugin: the build itself, manifest selftest, dtype
+helpers via ctypes, and the JSON-driven variant dispatch.
+"""
+
+import ctypes
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(REPO, "csrc", "aot_runtime")
+
+
+@pytest.fixture(scope="module")
+def built():
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        pytest.skip("no native toolchain")
+    subprocess.run(["make", "-C", SRC], check=True, capture_output=True)
+    return os.path.join(SRC, "build")
+
+
+def test_build_artifacts(built):
+    assert os.path.exists(os.path.join(built, "libtdt_aot.so"))
+    assert os.path.exists(os.path.join(built, "tdt_aot_run"))
+
+
+def test_selftest_against_exported_manifest(built, tmp_path):
+    import triton_dist_tpu.kernels.gemm  # noqa: F401  (registers matmul)
+    from triton_dist_tpu.tools import compile_aot
+
+    compile_aot.export_registered(str(tmp_path), kernels=["matmul"])
+    out = subprocess.run(
+        [os.path.join(built, "tdt_aot_run"), "--selftest", str(tmp_path)],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert "selftest ok: 1 kernels, 4 variants" in out.stdout
+
+
+def test_selftest_rejects_missing_artifact(built, tmp_path):
+    (tmp_path / "manifest.json").write_text(
+        '{"kernels": {"k": [{"algo_info": {}, "stablehlo": "missing.bc",'
+        ' "inputs": [{"shape": [4], "dtype": "float32"}], "outputs": []}]},'
+        ' "compile_options": "compile_options.pb"}')
+    out = subprocess.run(
+        [os.path.join(built, "tdt_aot_run"), "--selftest", str(tmp_path)],
+        capture_output=True, text=True)
+    assert out.returncode != 0
+    assert "missing artifact" in out.stderr
+
+
+def test_dtype_helpers_via_ctypes(built):
+    lib = ctypes.CDLL(os.path.join(built, "libtdt_aot.so"))
+    lib.tdt_dtype_size.restype = ctypes.c_size_t
+    lib.tdt_dtype_from_name.restype = ctypes.c_int
+    lib.tdt_dtype_from_name.argtypes = [ctypes.c_char_p]
+    TDT_BF16 = 13
+    assert lib.tdt_dtype_from_name(b"bfloat16") == TDT_BF16
+    assert lib.tdt_dtype_size(TDT_BF16) == 2
+    assert lib.tdt_dtype_size(lib.tdt_dtype_from_name(b"float32")) == 4
+    assert lib.tdt_dtype_size(lib.tdt_dtype_from_name(b"int64")) == 8
+    assert lib.tdt_dtype_from_name(b"not_a_dtype") == 0
+
+
+def test_cli_usage_errors(built):
+    exe = os.path.join(built, "tdt_aot_run")
+    out = subprocess.run([exe], capture_output=True, text=True)
+    assert out.returncode == 2
+    assert "usage:" in out.stderr
+    out = subprocess.run([exe, "--algo", "novalue"], capture_output=True,
+                         text=True)
+    assert out.returncode == 2

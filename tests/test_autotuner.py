@@ -171,3 +171,50 @@ def test_prune_dedupes_clamped_matmul_configs():
         (jnp.ones((256, 256), jnp.float32), jnp.ones((256, 128), jnp.float32)),
         {})
     assert len(cfgs) == 1  # everything clamps to (256, 128, 256)
+
+
+def test_aborted_region_does_not_poison_next():
+    """Regression: a region that dies mid-sweep must not leave stale state."""
+    boom = {"on": True}
+
+    @autotune(configs=[Config(c=0), Config(c=1)])
+    def inner(x, *, c):
+        return x + c
+
+    @contextual_autotune(n_repeat=1, n_warmup=0)
+    def op(x):
+        y = inner(x)
+        if boom["on"]:
+            raise RuntimeError("unrelated op failure")
+        return y
+
+    with pytest.raises(RuntimeError, match="unrelated"):
+        op(jnp.zeros((4,)))
+    boom["on"] = False
+    out = op(jnp.zeros((4,)))  # fresh sweep, completes normally
+    assert inner.best_config in ({"c": 0}, {"c": 1})
+    assert float(out[0]) == inner.best_config["c"]
+
+
+def test_all_bad_configs_in_region_then_retry_raises_cleanly():
+    @autotune(configs=[Config(a=1), Config(a=2)])
+    def inner(x, *, a):
+        raise ValueError("nope")
+
+    @contextual_autotune(n_repeat=1, n_warmup=0)
+    def op(x):
+        return inner(x)
+
+    for _ in range(2):  # second call must not hit 'unreachable'
+        with pytest.raises(RuntimeError, match="no valid config"):
+            op(jnp.zeros((2,)))
+
+
+def test_eager_failure_chains_cause():
+    @autotune(configs=[Config(a=1), Config(a=2)])
+    def fn(x, *, a):
+        raise ValueError("root cause here")
+
+    with pytest.raises(RuntimeError) as ei:
+        fn(jnp.ones((2,)))
+    assert isinstance(ei.value.__cause__, ValueError)

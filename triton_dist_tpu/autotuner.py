@@ -90,7 +90,8 @@ class ContextualAutotuner:
         self.n_warmup = n_warmup
         self.log_dir = log_dir
         self._log_file = None
-        self._states: list[_TuningState] = []
+        # (owner AutotunedFunction, cache key, state) per active sweep.
+        self._states: list[tuple] = []
 
     def log(self, *args):
         if self._log_file is None:
@@ -108,10 +109,16 @@ class ContextualAutotuner:
         self._states = []
         try:
             ret = self.fn(*args, **kwargs)  # discovers inner tuners
-            while not all(s.finished for s in self._states):
+            while not all(st.finished for _, _, st in self._states):
                 ret = self.fn(*args, **kwargs)
             return ret
         finally:
+            # Purge unfinished sweeps from their owners so an aborted
+            # region (kernel bug, no-valid-config) can't poison the next
+            # one with stale per-key state.
+            for owner, key, st in self._states:
+                if not st.finished:
+                    owner._states.pop(key, None)
             ContextualAutotuner._INSTANCE = None
             self._states = []
 
@@ -196,17 +203,20 @@ class AutotunedFunction:
         configs = self._configs_for(args, kwargs)
         okay, times = [], []
         last = None
+        last_exc = None
         for i, cfg in enumerate(configs):
             try:
                 for _ in range(2):  # warmup (compile) + 1 measure
                     last, ms = self._timed(args, kwargs, cfg)
                 okay.append((i, cfg))
                 times.append(ms)
-            except Exception:
+            except Exception as e:  # bad config; keep cause for diagnosis
+                last_exc = e
                 continue
         if not okay:
             raise RuntimeError(
-                f"{self.__name__}: no valid config among {configs}")
+                f"{self.__name__}: no valid config among {configs}"
+            ) from last_exc
         (_, best), _ = min(zip(okay, times), key=lambda t: t[-1])
         self.cache[key] = best
         return self._run(args, kwargs, best) if last is None else last
@@ -217,7 +227,7 @@ class AutotunedFunction:
         if st is None:
             st = self._states[key] = _TuningState(
                 self._configs_for(args, kwargs))
-            tuner._states.append(st)
+            tuner._states.append((self, key, st))
 
         n_iters = tuner.n_warmup + tuner.n_repeat
         while st.cfg_i < len(st.configs):

@@ -148,6 +148,37 @@ def _local_decode_xla(q, k, v, local_lens, *, scale):
     return out.reshape(B, Hq, D), lse.reshape(B, Hq)
 
 
+def _register_aot():
+    """AOT export spaces for the decode kernels.
+
+    Reference: ``scripts/aot_kernels.txt`` lists 5 flash-decode kernels as
+    the AOT surface; signatures/algo-infos live in the
+    ``@aot_compile_spaces`` tables (flash_decode.py:534-585).  Shapes below
+    are the decode-serving points the reference tests use (GQA 32/4,
+    head_dim 128).
+    """
+    from triton_dist_tpu.tools.compile_aot import aot_compile_spaces
+
+    b, hq, hkv, d, s = 4, 32, 4, 128, 4096
+    sig = [
+        [((b, hq, d), "bfloat16"), ((b, hkv, s, d), "bfloat16"),
+         ((b, hkv, s, d), "bfloat16"), ((b,), "int32")],
+        [((b, hq, d), "float32"), ((b, hkv, s, d), "float32"),
+         ((b, hkv, s, d), "float32"), ((b,), "int32")],
+    ]
+    return aot_compile_spaces({
+        "gqa_decode": {
+            "signature": sig,
+            # "auto" resolves per export platform (pallas on TPU, XLA on
+            # CPU) so the registry exports anywhere, like matmul's entry.
+            "algo_infos": [{"block_s": 512, "impl": "auto"},
+                           {"block_s": 256, "impl": "auto"},
+                           {"impl": "xla"}],
+        },
+    })
+
+
+@_register_aot()
 def gqa_decode_shard(q, k, v, local_lens, *, block_s=512, impl="auto",
                      interpret=False):
     """Single-shard GQA decode: q [B, Hq, D], k/v [B, Hkv, S_loc, D],

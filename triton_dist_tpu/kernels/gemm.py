@@ -192,6 +192,38 @@ def matmul_kernel_tflops(m: int, n: int, k: int, ms: float) -> float:
     return 2.0 * m * n * k / (ms * 1e-3) / 1e12
 
 
+def _register_gemm_aot():
+    """AOT spaces for the base GEMM (LLaMA-70B FFN shard shapes)."""
+    from triton_dist_tpu.tools.compile_aot import aot_compile_spaces
+
+    return aot_compile_spaces({
+        "matmul": {
+            "signature": [
+                [((8192, 8192), "bfloat16"), ((8192, 3584), "bfloat16")],
+                [((1024, 1024), "float32"), ((1024, 512), "float32")],
+            ],
+            "algo_infos": [
+                {"bm": 512, "bn": 512, "bk": 512},
+                {"bm": 256, "bn": 512, "bk": 512},
+            ],
+        },
+    })
+
+
+@_register_gemm_aot()
+def matmul_with_blocks(a, b, *, bm, bn, bk, impl="auto", out_dtype=None,
+                       interpret=False):
+    """``matmul`` with block sizes as flat kwargs — the AOT entry point
+    (algo-info values must be manifest-serializable primitives).  ``auto``
+    resolves to the Pallas MXU kernel on TPU and plain XLA dot elsewhere,
+    so exports work on whichever platform is doing the exporting."""
+    if resolve_impl(impl, interpret) == "pallas":
+        return matmul(a, b, config=MatmulConfig(bm, bn, bk),
+                      out_dtype=out_dtype, interpret=interpret)
+    return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(
+        out_dtype or a.dtype)
+
+
 def _make_matmul_autotuned():
     from triton_dist_tpu.autotuner import Config, autotune
 

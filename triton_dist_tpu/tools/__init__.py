@@ -1,0 +1,12 @@
+"""Deployment tooling: AOT export + native runtime glue.
+
+Reference analog: ``python/triton_dist/tools/`` (compile_aot.py, the AOT C
+runtime, and the generated libtriton_distributed_kernel).
+"""
+
+from triton_dist_tpu.tools.compile_aot import (  # noqa: F401
+    aot_compile_spaces,
+    export_kernel,
+    export_registered,
+    load_exported,
+)
