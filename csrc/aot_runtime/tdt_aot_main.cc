@@ -122,8 +122,13 @@ int Selftest(const std::string& dir) {
   for (const auto& kv : (*m)["kernels"]->obj) {
     for (size_t i = 0; i < kv.second->size(); ++i) {
       const tdt_json::ValuePtr& e = kv.second->at(i);
+      if ((*e)["inputs"]->size() == 0) {
+        fprintf(stderr, "selftest: no inputs in %s\n", kv.first.c_str());
+        return 1;
+      }
       Spec in0 = SpecFromJson((*e)["inputs"]->at(0));
-      if (in0.nbytes == 0 || in0.dtype == TDT_INVALID) {
+      if (in0.nbytes == 0 || in0.dtype == TDT_INVALID ||
+          in0.dims.size() > 8) {
         fprintf(stderr, "selftest: bad spec in %s\n", kv.first.c_str());
         return 1;
       }
@@ -238,6 +243,11 @@ int main(int argc, char** argv) {
   std::vector<std::vector<char>> in_mem(in_specs->size());
   for (size_t i = 0; i < in_specs->size(); ++i) {
     Spec s = SpecFromJson(in_specs->at(i));
+    if (s.dims.size() > 8) {
+      fprintf(stderr, "input %zu: rank %zu > 8 unsupported\n", i,
+              s.dims.size());
+      return 1;
+    }
     in_mem[i].resize(s.nbytes);
     if (i < in_files.size()) {
       if (!ReadRaw(in_files[i].c_str(), in_mem[i].data(), s.nbytes)) {
@@ -257,6 +267,11 @@ int main(int argc, char** argv) {
   std::vector<std::vector<char>> out_mem(out_specs->size());
   for (size_t i = 0; i < out_specs->size(); ++i) {
     Spec s = SpecFromJson(out_specs->at(i));
+    if (s.dims.size() > 8) {
+      fprintf(stderr, "output %zu: rank %zu > 8 unsupported\n", i,
+              s.dims.size());
+      return 1;
+    }
     out_mem[i].resize(s.nbytes);
     outputs[i].data = out_mem[i].data();
     outputs[i].ndims = (int32_t)s.dims.size();

@@ -59,15 +59,34 @@ struct tdt_ctx {
   memset(&v, 0, sizeof(v));        \
   v.struct_size = T##_STRUCT_SIZE
 
+static void DestroyExecutable(tdt_ctx* ctx, Executable* e) {
+  if (e->exec) {
+    INIT_ARGS(PJRT_Executable_Destroy_Args, args);
+    args.executable = e->exec;
+    ctx->api->PJRT_Executable_Destroy(&args);
+    e->exec = nullptr;
+  }
+  if (e->loaded) {
+    INIT_ARGS(PJRT_LoadedExecutable_Destroy_Args, args);
+    args.executable = e->loaded;
+    ctx->api->PJRT_LoadedExecutable_Destroy(&args);
+    e->loaded = nullptr;
+  }
+}
+
 static bool read_file(const char* path, std::string* out, std::string* err) {
   FILE* f = fopen(path, "rb");
   if (!f) {
     *err = std::string("cannot open ") + path;
     return false;
   }
-  fseek(f, 0, SEEK_END);
-  long n = ftell(f);
-  fseek(f, 0, SEEK_SET);
+  long n = -1;
+  if (fseek(f, 0, SEEK_END) != 0 || (n = ftell(f)) < 0 ||
+      fseek(f, 0, SEEK_SET) != 0) {
+    fclose(f);
+    *err = std::string("cannot stat ") + path;
+    return false;
+  }
   out->resize((size_t)n);
   size_t got = fread(&(*out)[0], 1, (size_t)n, f);
   fclose(f);
@@ -185,21 +204,24 @@ int tdt_load(tdt_ctx* ctx, const char* module_path, const char* options_path) {
 
   Executable e;
   e.loaded = args.executable;
+  bool ok = true;
   {
     INIT_ARGS(PJRT_LoadedExecutable_GetExecutable_Args, gargs);
     gargs.loaded_executable = e.loaded;
-    if (!ctx->Check(ctx->api->PJRT_LoadedExecutable_GetExecutable(&gargs),
-                    "PJRT_LoadedExecutable_GetExecutable"))
-      return -1;
-    e.exec = gargs.executable;
+    ok = ctx->Check(ctx->api->PJRT_LoadedExecutable_GetExecutable(&gargs),
+                    "PJRT_LoadedExecutable_GetExecutable");
+    if (ok) e.exec = gargs.executable;
   }
-  {
+  if (ok) {
     INIT_ARGS(PJRT_Executable_NumOutputs_Args, nargs);
     nargs.executable = e.exec;
-    if (!ctx->Check(ctx->api->PJRT_Executable_NumOutputs(&nargs),
-                    "PJRT_Executable_NumOutputs"))
-      return -1;
-    e.num_outputs = nargs.num_outputs;
+    ok = ctx->Check(ctx->api->PJRT_Executable_NumOutputs(&nargs),
+                    "PJRT_Executable_NumOutputs");
+    if (ok) e.num_outputs = nargs.num_outputs;
+  }
+  if (!ok) {  /* release partly-constructed executable */
+    DestroyExecutable(ctx, &e);
+    return -1;
   }
   ctx->execs.push_back(e);
   return (int)ctx->execs.size() - 1;
@@ -328,6 +350,12 @@ int tdt_execute(tdt_ctx* ctx, int exec, const tdt_buffer* inputs, int n_in,
   rc = 0;
 
 cleanup:
+  for (PJRT_Event* ev : done_events) {
+    if (!ev) continue;
+    INIT_ARGS(PJRT_Event_Destroy_Args, args);
+    args.event = ev;
+    ctx->api->PJRT_Event_Destroy(&args);
+  }
   for (PJRT_Buffer* b : in_bufs) {
     if (!b) continue;
     INIT_ARGS(PJRT_Buffer_Destroy_Args, args);
@@ -349,13 +377,7 @@ const char* tdt_last_error(tdt_ctx* ctx) { return ctx->error.c_str(); }
 
 void tdt_destroy(tdt_ctx* ctx) {
   if (!ctx) return;
-  for (Executable& e : ctx->execs) {
-    if (e.loaded) {
-      INIT_ARGS(PJRT_LoadedExecutable_Destroy_Args, args);
-      args.executable = e.loaded;
-      ctx->api->PJRT_LoadedExecutable_Destroy(&args);
-    }
-  }
+  for (Executable& e : ctx->execs) DestroyExecutable(ctx, &e);
   if (ctx->client) {
     INIT_ARGS(PJRT_Client_Destroy_Args, args);
     args.client = ctx->client;

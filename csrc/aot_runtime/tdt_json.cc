@@ -144,9 +144,13 @@ ValuePtr ParseFile(const std::string& path, std::string* err) {
     *err = "cannot open " + path;
     return nullptr;
   }
-  fseek(f, 0, SEEK_END);
-  long n = ftell(f);
-  fseek(f, 0, SEEK_SET);
+  long n = -1;
+  if (fseek(f, 0, SEEK_END) != 0 || (n = ftell(f)) < 0 ||
+      fseek(f, 0, SEEK_SET) != 0) {
+    fclose(f);
+    *err = "cannot stat " + path;
+    return nullptr;
+  }
   std::string text((size_t)n, '\0');
   size_t got = fread(&text[0], 1, (size_t)n, f);
   fclose(f);

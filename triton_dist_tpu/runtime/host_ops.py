@@ -1,0 +1,158 @@
+"""ctypes bindings for the native host planning ops (csrc/host_ops).
+
+Reference analog: the pybind11 op registry over csrc CUDA host helpers
+(csrc/lib/registry.cc, op_pybind.cc:36-41 exposing
+``moe_ag_scatter_align_block_size``).  Ours binds a plain-C shared library
+with ctypes (no pybind11 in the image) and auto-builds it with make on
+first use; a numpy fallback keeps toolchain-less environments working.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+import threading
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO, "csrc", "host_ops")
+_LIB_PATH = os.path.join(_SRC, "build", "libtdt_hostops.so")
+
+_lock = threading.Lock()
+_lib = None
+_lib_tried = False
+
+
+def _load():
+    global _lib, _lib_tried
+    with _lock:
+        if _lib_tried:
+            return _lib
+        _lib_tried = True
+        if not os.path.exists(_LIB_PATH):
+            if shutil.which("make") is None or shutil.which("g++") is None:
+                return None
+            try:
+                subprocess.run(["make", "-C", _SRC], check=True,
+                               capture_output=True)
+            except (subprocess.CalledProcessError, OSError):
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.tdt_moe_ag_scatter_align_block_size.restype = ctypes.c_int
+        lib.tdt_moe_ag_scatter_align_block_size.argtypes = [
+            i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+            i32p, i32p, i32p, i32p, i32p]
+        lib.tdt_stable_rank_in_group.restype = ctypes.c_int
+        lib.tdt_stable_rank_in_group.argtypes = [
+            i32p, ctypes.c_int64, ctypes.c_int32, i32p, i32p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _as_i32(a) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(a, dtype=np.int32))
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _capacity(numel_per_rank: int, n_ranks: int, n_experts: int,
+              block_m: int) -> int:
+    per_rank = (numel_per_rank + n_experts * (block_m - 1)
+                + block_m - 1) // block_m * block_m
+    return per_rank * n_ranks
+
+
+def moe_ag_scatter_align_block_size(topk_ids, n_ranks: int, n_experts: int,
+                                    block_m: int, pad_value: int = -1,
+                                    impl: str = "auto"):
+    """Host planner for the AG-GroupGEMM feeder (see csrc/host_ops).
+
+    ``topk_ids``: [n_ranks * numel_per_rank] (or [n_ranks, ...]) expert ids
+    in gathered rank-major order.  Returns a dict with ``sorted_token_ids``
+    [capacity], ``tile_expert`` / ``tile_src_rank`` [capacity // block_m],
+    ``rank_block_num`` [n_ranks], ``total_padded`` int.
+    """
+    flat = _as_i32(topk_ids).reshape(-1)
+    assert flat.size % n_ranks == 0, (flat.size, n_ranks)
+    numel_per_rank = flat.size // n_ranks
+    cap = _capacity(numel_per_rank, n_ranks, n_experts, block_m)
+
+    lib = _load() if impl in ("auto", "native") else None
+    if impl == "native" and lib is None:
+        raise RuntimeError("native host ops unavailable (no toolchain?)")
+
+    sorted_ids = np.empty(cap, np.int32)
+    tile_expert = np.full(cap // block_m, -1, np.int32)
+    tile_src_rank = np.full(cap // block_m, -1, np.int32)
+    rank_block_num = np.zeros(n_ranks, np.int32)
+    total = np.zeros(1, np.int32)
+
+    if lib is not None:
+        rc = lib.tdt_moe_ag_scatter_align_block_size(
+            _ptr(flat), numel_per_rank, n_ranks, n_experts, block_m,
+            pad_value, cap, _ptr(sorted_ids), _ptr(tile_expert),
+            _ptr(tile_src_rank), _ptr(rank_block_num), _ptr(total))
+        if rc != 0:
+            raise ValueError(f"moe_ag_scatter_align_block_size rc={rc}")
+    else:  # numpy fallback, same semantics
+        sorted_ids[:] = pad_value
+        base = 0
+        for r in range(n_ranks):
+            seg = flat[r * numel_per_rank:(r + 1) * numel_per_rank]
+            if seg.size and (seg.min() < 0 or seg.max() >= n_experts):
+                raise ValueError("expert id out of range")
+            counts = np.bincount(seg, minlength=n_experts)
+            padded = (counts + block_m - 1) // block_m * block_m
+            starts = np.concatenate([[0], np.cumsum(padded)[:-1]])
+            order = np.argsort(seg, kind="stable")
+            rank_in = np.arange(seg.size) - np.concatenate(
+                [[0], np.cumsum(counts)[:-1]])[seg[order]]
+            dst = base + starts[seg[order]] + rank_in
+            sorted_ids[dst] = order + r * numel_per_rank
+            for e in range(n_experts):
+                t0 = (base + starts[e]) // block_m
+                for t in range(padded[e] // block_m):
+                    tile_expert[t0 + t] = e
+                    tile_src_rank[t0 + t] = r
+            rank_block_num[r] = padded.sum() // block_m
+            base += int(padded.sum())
+        total[0] = base
+
+    return {"sorted_token_ids": sorted_ids, "tile_expert": tile_expert,
+            "tile_src_rank": tile_src_rank, "rank_block_num": rank_block_num,
+            "total_padded": int(total[0])}
+
+
+def stable_rank_in_group_host(keys, n_groups: int):
+    """Host twin of moe_utils.stable_rank_in_group (native when built)."""
+    flat = _as_i32(keys).reshape(-1)
+    rank = np.empty(flat.size, np.int32)
+    counts = np.zeros(n_groups, np.int32)
+    lib = _load()
+    if lib is not None:
+        rc = lib.tdt_stable_rank_in_group(_ptr(flat), flat.size, n_groups,
+                                          _ptr(rank), _ptr(counts))
+        if rc != 0:
+            raise ValueError("key out of range")
+        return rank, counts
+    if flat.size and (flat.min() < 0 or flat.max() >= n_groups):
+        raise ValueError("key out of range")
+    counts_np = np.bincount(flat, minlength=n_groups)
+    order = np.argsort(flat, kind="stable")
+    starts = np.concatenate([[0], np.cumsum(counts_np)[:-1]])
+    rank[order] = np.arange(flat.size) - starts[flat[order]]
+    return rank, counts_np.astype(np.int32)
