@@ -40,6 +40,12 @@ Spec SpecFromJson(const tdt_json::ValuePtr& v) {
   return s;
 }
 
+/* Single home for the spec invariants (rank bound comes from
+ * tdt_buffer.dims[8] in tdt_aot_runtime.h). */
+bool SpecOk(const Spec& s) {
+  return s.dtype != TDT_INVALID && s.nbytes > 0 && s.dims.size() <= 8;
+}
+
 bool AlgoMatches(const tdt_json::ValuePtr& algo,
                  const std::map<std::string, std::string>& want) {
   for (const auto& kv : want) {
@@ -126,11 +132,15 @@ int Selftest(const std::string& dir) {
         fprintf(stderr, "selftest: no inputs in %s\n", kv.first.c_str());
         return 1;
       }
-      Spec in0 = SpecFromJson((*e)["inputs"]->at(0));
-      if (in0.nbytes == 0 || in0.dtype == TDT_INVALID ||
-          in0.dims.size() > 8) {
-        fprintf(stderr, "selftest: bad spec in %s\n", kv.first.c_str());
-        return 1;
+      for (const char* field : {"inputs", "outputs"}) {
+        const tdt_json::ValuePtr& specs = (*e)[field];
+        for (size_t j = 0; j < specs->size(); ++j) {
+          if (!SpecOk(SpecFromJson(specs->at(j)))) {
+            fprintf(stderr, "selftest: bad %s spec %zu in %s\n", field, j,
+                    kv.first.c_str());
+            return 1;
+          }
+        }
       }
       std::string path = dir + "/" + (*e)["stablehlo"]->str;
       FILE* f = fopen(path.c_str(), "rb");
@@ -243,9 +253,8 @@ int main(int argc, char** argv) {
   std::vector<std::vector<char>> in_mem(in_specs->size());
   for (size_t i = 0; i < in_specs->size(); ++i) {
     Spec s = SpecFromJson(in_specs->at(i));
-    if (s.dims.size() > 8) {
-      fprintf(stderr, "input %zu: rank %zu > 8 unsupported\n", i,
-              s.dims.size());
+    if (!SpecOk(s)) {
+      fprintf(stderr, "input %zu: bad spec (rank > 8 or bad dtype)\n", i);
       return 1;
     }
     in_mem[i].resize(s.nbytes);
@@ -267,9 +276,8 @@ int main(int argc, char** argv) {
   std::vector<std::vector<char>> out_mem(out_specs->size());
   for (size_t i = 0; i < out_specs->size(); ++i) {
     Spec s = SpecFromJson(out_specs->at(i));
-    if (s.dims.size() > 8) {
-      fprintf(stderr, "output %zu: rank %zu > 8 unsupported\n", i,
-              s.dims.size());
+    if (!SpecOk(s)) {
+      fprintf(stderr, "output %zu: bad spec (rank > 8 or bad dtype)\n", i);
       return 1;
     }
     out_mem[i].resize(s.nbytes);

@@ -96,6 +96,7 @@ int tdt_moe_ag_scatter_align_block_size(
 int tdt_stable_rank_in_group(const int32_t* keys, int64_t n,
                              int32_t n_groups, int32_t* rank,
                              int32_t* counts) {
+  if (n < 0 || n_groups <= 0) return 1;
   std::vector<int64_t> fill((size_t)n_groups, 0);
   for (int64_t i = 0; i < n; ++i) {
     int32_t k = keys[i];

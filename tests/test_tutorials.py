@@ -1,0 +1,30 @@
+"""Smoke-run every tutorial (each is a correctness check in itself).
+
+Runs as subprocesses because tutorials manage their own env/mesh setup.
+"""
+
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TUTORIALS = sorted(glob.glob(os.path.join(REPO, "tutorials", "[0-9]*.py")))
+
+
+def test_tutorials_exist():
+    assert len(TUTORIALS) == 10
+
+
+@pytest.mark.parametrize("path", TUTORIALS,
+                         ids=[os.path.basename(p) for p in TUTORIALS])
+def test_tutorial_runs(path):
+    env = dict(os.environ)
+    env["TDT_TUTORIAL_DEVICES"] = "16"
+    out = subprocess.run([sys.executable, path], capture_output=True,
+                         text=True, timeout=900, env=env,
+                         cwd=os.path.dirname(path))
+    assert out.returncode == 0, f"{path}\nstdout:{out.stdout}\nstderr:{out.stderr[-2000:]}"
+    assert "OK" in out.stdout

@@ -77,6 +77,7 @@ from triton_dist_tpu.language.primitives import (  # noqa: F401
     putmem_signal,
     getmem,
     remote_copy,
+    wait_arrival,
     local_copy,
     fence,
     barrier_all,

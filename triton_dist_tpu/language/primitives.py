@@ -133,6 +133,19 @@ def getmem(src_ref, dst_ref, send_sem, recv_sem, axis, device_id):
     return cp
 
 
+def wait_arrival(ref, recv_sem):
+    """Receiver-side wait for a sender-initiated put into ``ref``.
+
+    Reference: ``signal_wait_until(sig_addr, NVSHMEM_CMP_GE, v)`` paired
+    with ``putmem_signal`` (low_latency_all_to_all.py:35-119).  On TPU the
+    recv semaphore of the sender's DMA is signaled on *this* device when
+    the data lands; waiting for "one ``ref``-sized DMA worth" of completion
+    consumes that signal.  (DMA semaphores count bytes, not events, so this
+    wraps the make_async_copy descriptor trick.)
+    """
+    pltpu.make_async_copy(ref, ref, recv_sem).wait()
+
+
 def local_copy(src_ref, dst_ref, sem):
     """Async local (same-chip) DMA; reference analog: cudaMemcpyAsync /
     ``dst.copy_(src)`` on the copy engine (allgather.py:122-135)."""

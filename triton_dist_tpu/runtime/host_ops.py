@@ -35,11 +35,22 @@ def _load():
         if not os.path.exists(_LIB_PATH):
             if shutil.which("make") is None or shutil.which("g++") is None:
                 return None
+            # Build into a process-unique dir and publish with an atomic
+            # rename so concurrent workers (one process per host) never
+            # dlopen a half-written .so.
+            tmp_build = f"build.tmp.{os.getpid()}"
             try:
-                subprocess.run(["make", "-C", _SRC], check=True,
-                               capture_output=True)
+                subprocess.run(["make", "-C", _SRC, f"BUILD={tmp_build}"],
+                               check=True, capture_output=True)
+                os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
+                os.replace(os.path.join(_SRC, tmp_build,
+                                        "libtdt_hostops.so"), _LIB_PATH)
             except (subprocess.CalledProcessError, OSError):
-                return None
+                if not os.path.exists(_LIB_PATH):  # a peer may have won
+                    return None
+            finally:
+                shutil.rmtree(os.path.join(_SRC, tmp_build),
+                              ignore_errors=True)
         try:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
@@ -87,7 +98,9 @@ def moe_ag_scatter_align_block_size(topk_ids, n_ranks: int, n_experts: int,
     ``rank_block_num`` [n_ranks], ``total_padded`` int.
     """
     flat = _as_i32(topk_ids).reshape(-1)
-    assert flat.size % n_ranks == 0, (flat.size, n_ranks)
+    if n_ranks <= 0 or flat.size % n_ranks != 0:
+        raise ValueError(
+            f"topk_ids size {flat.size} not divisible by n_ranks {n_ranks}")
     numel_per_rank = flat.size // n_ranks
     cap = _capacity(numel_per_rank, n_ranks, n_experts, block_m)
 

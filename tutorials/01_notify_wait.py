@@ -1,0 +1,125 @@
+"""Tutorial 01: Notify and Wait — the signal-exchange core.
+
+Reference analog: tutorials/01-distributed-notify-wait.py — a producer rank
+streams data through a small queue in the consumer's symmetric memory,
+signaling per slot with ``notify``; the consumer spins in ``dl.wait`` before
+reading each slot, and grants credits back so the producer never overruns
+the queue.
+
+What you learn, TPU-style:
+* ``notify`` / ``wait`` (triton_dist_tpu.language) — TPU device semaphores
+  instead of PTX spin loops on global-memory flags.
+* Symmetric memory on TPU = SPMD: every device runs the same program with
+  identically-shaped buffers, so "the peer's queue" is addressed by a mesh
+  coordinate on the DMA (the ``symm_at`` equivalent), not a pointer.
+* Flow control: the producer waits on a *credit* semaphore before reusing a
+  queue slot — semaphores are counters, so back-pressure is one wait.
+* All overlap lives inside ONE Pallas kernel: no CUDA streams on TPU;
+  concurrency = async remote DMA + semaphores.
+
+Run: python tutorials/01_notify_wait.py
+"""
+
+import _common  # noqa: F401  (must be first: sets up the virtual mesh)
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+import jax.experimental.pallas as pl
+import jax.experimental.pallas.tpu as pltpu
+
+import triton_dist_tpu.language as dl
+from triton_dist_tpu.language.interpret import interpret_params
+from triton_dist_tpu.runtime.bootstrap import initialize_distributed
+
+QUEUE_SLOTS = 2
+SLOT_ROWS = 8
+COLS = 128  # one TPU lane-width tile
+
+
+def queue_kernel(x_ref, out_ref, queue, tmp, send_sem, slot_sem, copy_sem,
+                 credit_sem, *, axis):
+    """Rank r streams all its slots into rank (r+1)'s queue; consumes its own
+    queue (fed by rank r-1), adding 1 to prove it read the data."""
+    world = dl.num_ranks(axis)
+    me = dl.rank(axis)
+    right = jax.lax.rem(me + 1, world)
+    left = jax.lax.rem(me + world - 1, world)
+    n_slots = x_ref.shape[0]
+
+    def step(s, carry):
+        sl = jax.lax.rem(s, QUEUE_SLOTS)
+
+        # producer: once the queue has wrapped, wait for a credit from the
+        # consumer before overwriting slot sl (back-pressure).
+        @pl.when(s >= QUEUE_SLOTS)
+        def _():
+            dl.wait(credit_sem)
+
+        cp = dl.putmem_signal(x_ref.at[s], queue.at[sl], send_sem, slot_sem,
+                              axis, right)
+        cp.wait_send()
+
+        # consumer: wait for OUR slot s (sent by the left neighbor), read it,
+        # then grant the left producer a credit for the freed slot.
+        dl.wait_arrival(queue.at[sl], slot_sem)
+        tmp[...] = queue[sl] + 1.0
+        out_cp = dl.local_copy(tmp, out_ref.at[s], copy_sem)
+        out_cp.wait()
+        dl.notify(credit_sem, axis=axis, device_id=left)
+        return carry
+
+    jax.lax.fori_loop(0, n_slots, step, 0)
+    # Drain the credits of the last QUEUE_SLOTS reads so the semaphore is
+    # zero on exit (Mosaic requires clean semaphores at kernel end).
+    def drain(_, c):
+        dl.wait(credit_sem)
+        return c
+    jax.lax.fori_loop(0, QUEUE_SLOTS, drain, 0)
+
+
+def main():
+    mesh = initialize_distributed(axis_names=("tp",), mesh_shape=(8,))
+    world = 8
+    n_slots = 3 * QUEUE_SLOTS  # stream 6 slots through a 2-slot queue
+
+    x = jnp.arange(world * n_slots * SLOT_ROWS * COLS,
+                   dtype=jnp.float32).reshape(world * n_slots,
+                                              SLOT_ROWS, COLS)
+
+    def shard_fn(x_shard):
+        return pl.pallas_call(
+            functools.partial(queue_kernel, axis="tp"),
+            out_shape=jax.ShapeDtypeStruct(x_shard.shape, x_shard.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[
+                pltpu.VMEM((QUEUE_SLOTS, SLOT_ROWS, COLS), jnp.float32),
+                pltpu.VMEM((SLOT_ROWS, COLS), jnp.float32),
+                pltpu.SemaphoreType.DMA,      # send
+                pltpu.SemaphoreType.DMA,      # slot arrival (the "signal")
+                pltpu.SemaphoreType.DMA,      # local out copy
+                pltpu.SemaphoreType.REGULAR,  # credits
+            ],
+            compiler_params=pltpu.CompilerParams(collective_id=11),
+            interpret=interpret_params() if _common.INTERPRET else False,
+        )(x_shard)
+
+    fn = jax.jit(jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=P("tp"), out_specs=P("tp"),
+        check_vma=False))
+    out = fn(x)
+
+    # Each rank's output = left neighbor's input + 1 (ring shift by one).
+    expect = jnp.roll(x.reshape(world, n_slots, SLOT_ROWS, COLS),
+                      shift=1, axis=0).reshape(x.shape) + 1.0
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect))
+    print(f"tutorial 01 OK: ring notify/wait queue, {world} ranks, "
+          f"{n_slots} slots through a {QUEUE_SLOTS}-slot queue with credits")
+
+
+if __name__ == "__main__":
+    main()

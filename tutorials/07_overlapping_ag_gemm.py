@@ -1,0 +1,82 @@
+"""Tutorial 07: Overlapping AllGather-GEMM (the flagship TP kernel).
+
+Reference analog: tutorials/07-overlapping-allgather-gemm.py — the
+tile-granular producer/consumer overlap of allgather_gemm.py: copy engines
+stream peer shards into symmetric memory while the persistent GEMM's tile
+loop waits per-segment (``dl.wait`` + ``consume_token``) and starts on local
+data first (rank-swizzled tile order).
+
+TPU mapping: ONE Pallas kernel holds both sides.  A bidirectional ring
+forwards A-shards chip-to-chip while a nested MXU pipeline
+(``emit_pipeline``) computes the GEMM of the *previous* shard — the ring
+step s computes segment (me ± s) so compute starts on local data, exactly
+the reference's swizzle, and each arriving shard is consumed as soon as its
+semaphore fires.  XLA's own latency-hiding scheduler (the
+``jax.lax.all_gather`` + dot path) is the baseline to beat.
+
+Run: python tutorials/07_overlapping_ag_gemm.py
+"""
+
+import _common  # noqa: F401
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.kernels.allgather_gemm import ag_gemm_shard
+from triton_dist_tpu.runtime.bootstrap import initialize_distributed
+
+
+def main():
+    mesh = initialize_distributed(axis_names=("tp",), mesh_shape=(8,))
+    M, K, N = 512, 256, 1024  # N/8 = 128: one full lane tile per chip
+
+    a = jax.random.normal(jax.random.key(0), (M, K), jnp.float32)
+    b = jax.random.normal(jax.random.key(1), (K, N), jnp.float32)
+
+    # ours: fused AG+GEMM Pallas kernel (A row-sharded, B col-sharded)
+    fused = jax.jit(jax.shard_map(
+        functools.partial(ag_gemm_shard, axis="tp", impl="pallas",
+                          bm=64, bn=128, bk=64,
+                          interpret=_common.INTERPRET),
+        mesh=mesh, in_specs=(P("tp", None), P(None, "tp")),
+        out_specs=(P("tp", None), P(None, "tp")), check_vma=False))
+
+    # baseline: XLA all_gather then dot (what pjit would emit)
+    def xla_shard(a_s, b_s):
+        a_full = jax.lax.all_gather(a_s, "tp", axis=0, tiled=True)
+        return a_full @ b_s
+
+    baseline = jax.jit(jax.shard_map(
+        xla_shard, mesh=mesh, in_specs=(P("tp", None), P(None, "tp")),
+        out_specs=P(None, "tp"), check_vma=False))
+
+    ag, c = fused(a, b)
+    c_ref = baseline(a, b)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(c_ref),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(a @ b),
+                               rtol=1e-3, atol=1e-3)
+    # every chip returns the FULL gathered A (out_specs stacks the copies)
+    ag_np = np.asarray(ag).reshape(8, M, K)
+    for r in range(8):
+        np.testing.assert_allclose(ag_np[r], np.asarray(a))
+
+    for name, f in [("fused pallas", lambda: fused(a, b)[1]),
+                    ("xla baseline", lambda: baseline(a, b))]:
+        f()  # warm
+        t0 = time.perf_counter()
+        jax.block_until_ready(f())
+        dt = (time.perf_counter() - t0) * 1e3
+        print(f"tutorial 07: {name:13s} {dt:8.2f} ms (interpret mode "
+              f"timings are NOT hardware-representative)")
+    print(f"tutorial 07 OK: overlapped AG-GEMM == all_gather+dot "
+          f"({M}x{K} @ {K}x{N} over 8 ranks)")
+
+
+if __name__ == "__main__":
+    main()

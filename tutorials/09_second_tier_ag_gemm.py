@@ -1,0 +1,56 @@
+"""Tutorial 09: AG-GEMM on the second topology tier (DCN / cross-slice).
+
+Reference analog: tutorials/09-AMD-overlapping-allgather-gemm.py.  The
+reference's "second vendor" (AMD/ROCSHMEM) is, for a TPU framework, a
+second *topology tier*: the same overlapped kernel running over an axis
+that crosses slices (DCN) instead of intra-slice ICI (SURVEY.md §7 item 9).
+
+The kernels are axis-parametric, so this is the tutorial-07 kernel with
+``axis="dcn"`` on a (dcn, tp) mesh — TP weights stay sharded over fast ICI,
+activations allgather over the slow tier, and the ring depth (and thus the
+overlap budget, perf_model.overlap_chunk_budget) follows the axis size.
+
+Run: python tutorials/09_second_tier_ag_gemm.py
+"""
+
+import _common  # noqa: F401
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.kernels.allgather_gemm import ag_gemm_shard
+from triton_dist_tpu.runtime.bootstrap import initialize_distributed
+
+
+def main():
+    mesh = initialize_distributed(axis_names=("dcn", "tp"),
+                                  mesh_shape=(2, 4))
+    M, K, N = 256, 256, 1024
+
+    a = jax.random.normal(jax.random.key(0), (M, K), jnp.float32)
+    b = jax.random.normal(jax.random.key(1), (K, N), jnp.float32)
+
+    # A sharded over the slow (dcn) axis, B over fast ICI (tp): the
+    # overlapped AG rides DCN while each chip's GEMM consumes its ICI-local
+    # B columns.
+    fused = jax.jit(jax.shard_map(
+        functools.partial(ag_gemm_shard, axis="dcn", impl="pallas",
+                          bm=64, bn=128, bk=64,
+                          interpret=_common.INTERPRET),
+        mesh=mesh, in_specs=(P("dcn", None), P(None, "tp")),
+        out_specs=(P(("dcn", "tp"), None), P(None, "tp")),
+        check_vma=False))
+
+    ag, c = fused(a, b)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(a @ b),
+                               rtol=1e-3, atol=1e-3)
+    print("tutorial 09 OK: AG-GEMM over the cross-slice (dcn) tier on a "
+          "2x4 mesh — same kernel, axis-parametric")
+
+
+if __name__ == "__main__":
+    main()
