@@ -17,19 +17,23 @@ are created lazily):
    GPUs under torchrun; ours run anywhere).
 """
 
+import importlib.util
 import os
 
+# Canonical env recipe (loaded by file path — the package __init__ imports
+# jax, which must not happen before the env is set): see
+# triton_dist_tpu/runtime/testenv.py for the rationale of each knob.
 # 2x headroom over the largest test mesh: when every virtual device is
 # blocked inside a collective Pallas kernel (semaphore waits), the
 # single-core CPU interpreter needs spare executor slots to keep making
 # progress — 8 busy devices of 8 can starve, 8 of 16 never does.
 _N_DEVICES = int(os.environ.get("TDT_TEST_DEVICES", "16"))
-_FLAG = f"--xla_force_host_platform_device_count={_N_DEVICES}"
-
-if _FLAG not in os.environ.get("XLA_FLAGS", ""):
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
+_TESTENV = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "triton_dist_tpu", "runtime", "testenv.py")
+_spec = importlib.util.spec_from_file_location("_tdt_testenv", _TESTENV)
+_testenv = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_testenv)
+_testenv.apply_virtual_mesh_env(_N_DEVICES)
 
 import jax  # noqa: E402
 

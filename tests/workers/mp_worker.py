@@ -1,0 +1,62 @@
+"""Worker for the multi-process launcher test (run via scripts/launch.py).
+
+Exercises the full multi-process bootstrap contract: distributed init from
+env, hybrid (dcn x tp) mesh over two processes, hierarchical collectives
+with XLA per-axis impls (cross-process Pallas interpret is not simulated),
+and cross-process agreement on the result.
+"""
+
+import functools
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import jax  # noqa: E402
+
+from triton_dist_tpu.runtime.bootstrap import initialize_distributed  # noqa: E402
+from triton_dist_tpu.runtime import topology  # noqa: E402
+
+initialize_distributed()  # reads JAX_COORDINATOR_ADDRESS etc.
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from triton_dist_tpu.kernels.allgather import AllGatherMethod  # noqa: E402
+from triton_dist_tpu.kernels.hierarchical import (  # noqa: E402
+    hier_all_gather_shard,
+)
+
+nproc = jax.process_count()
+assert nproc == 2, nproc
+mesh = topology.create_hybrid_mesh()  # (dcn=2, tp=local_devices)
+assert mesh.axis_names == ("dcn", "tp"), mesh.axis_names
+assert topology.axis_is_dcn(mesh, "dcn"), "dcn axis must be detected as DCN"
+assert not topology.axis_is_dcn(mesh, "tp") or jax.process_count() == 1
+
+world = mesh.devices.size
+rows, cols = 8, 128
+
+fn = jax.jit(jax.shard_map(
+    functools.partial(hier_all_gather_shard, slow_axis="dcn", fast_axis="tp",
+                      slow_method=AllGatherMethod.XLA,
+                      fast_method=AllGatherMethod.XLA),
+    mesh=mesh, in_specs=P(("dcn", "tp"), None), out_specs=P(None, None),
+    check_vma=False))
+
+# Global array [world*rows, cols], value = global row index.
+garr = jax.make_array_from_callback(
+    (world * rows, cols),
+    NamedSharding(mesh, P(("dcn", "tp"), None)),
+    lambda idx: np.arange(world * rows, dtype=np.float32)[idx[0], None]
+    * np.ones((1, cols), np.float32))
+
+out = fn(garr)
+# out is replicated; every process checks its addressable copy.
+local = np.asarray(out.addressable_shards[0].data)
+want = np.arange(world * rows, dtype=np.float32)[:, None] * np.ones(
+    (1, cols), np.float32)
+np.testing.assert_allclose(local, want)
+print(f"MP_WORKER_OK rank={jax.process_index()} world={world}", flush=True)

@@ -1,0 +1,84 @@
+"""Hierarchical (two-tier ICI x DCN) collectives.
+
+Reference analog: the inter-node variants of allgather.py (:470-591,
+2D rings with same-local-rank P2P over IB) and reduce_scatter.py
+(:525-544, :842-860, per-node scatter + ring reduce + inter-node P2P).
+The reference hand-places every transfer because NVLink and IB are
+different APIs; on TPU both tiers are mesh axes, so the hierarchy is a
+*composition of the per-axis kernels* with an order-restoring relayout —
+each byte crosses the slow wire exactly once.
+
+Conventions (see tutorials 03/06 for the derivations):
+- AllGather: gather the SLOW axis first (only this chip's shard crosses
+  DCN), then the fast axis; blocks come out tier-major and are restored to
+  flat (slow, fast) rank order.
+- ReduceScatter: reduce the FAST axis first (data shrinks fast-fold before
+  touching DCN) — the opposite order, because reductions shrink data.
+  Chip (i, j) ends up holding flat band j*D + i; ``band_index`` exposes
+  that so callers can lay out downstream shards without a reshuffle.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.kernels.allgather import AllGatherMethod, all_gather_shard
+from triton_dist_tpu.kernels.reduce_scatter import (
+    ReduceScatterMethod,
+    reduce_scatter_shard,
+)
+
+__all__ = [
+    "hier_all_gather_shard",
+    "hier_reduce_scatter_shard",
+    "hier_rs_band_index",
+]
+
+
+def hier_all_gather_shard(x, *, slow_axis: str, fast_axis: str,
+                          slow_method=AllGatherMethod.RING_1D,
+                          fast_method=AllGatherMethod.AUTO,
+                          interpret: bool = False):
+    """Two-tier AllGather of the leading dim; call inside shard_map.
+
+    Input: this chip's shard [rows, ...] of an array sharded jointly over
+    (slow_axis, fast_axis), slow-major.  Output: the full array, flat rank
+    order, on every chip.
+    """
+    rows = x.shape[0]
+    d = jax.lax.axis_size(slow_axis)
+    t = jax.lax.axis_size(fast_axis)
+    x = all_gather_shard(x, axis=slow_axis, method=slow_method,
+                         interpret=interpret, collective_id=14)
+    x = all_gather_shard(x, axis=fast_axis, method=fast_method,
+                         interpret=interpret, collective_id=15)
+    # blocks are [fast][slow]-major; restore flat (slow, fast) order
+    x = x.reshape((t, d, rows) + x.shape[1:])
+    x = jnp.moveaxis(x, 1, 0)
+    return x.reshape((d * t * rows,) + x.shape[3:])
+
+
+def hier_rs_band_index(slow_axis: str, fast_axis: str):
+    """Flat band index this chip holds after ``hier_reduce_scatter_shard``:
+    j * D + i for chip (i, j) — fast-major."""
+    d = jax.lax.axis_size(slow_axis)
+    i = jax.lax.axis_index(slow_axis)
+    j = jax.lax.axis_index(fast_axis)
+    return j * d + i
+
+
+def hier_reduce_scatter_shard(x, *, slow_axis: str, fast_axis: str,
+                              slow_method=ReduceScatterMethod.RING_1D,
+                              fast_method=ReduceScatterMethod.AUTO,
+                              interpret: bool = False):
+    """Two-tier ReduceScatter of this chip's full-size partial.
+
+    Output: this chip's band of the total sum (band ``hier_rs_band_index``
+    of D*T bands).  DCN carries 1/T of the data it would in a flat RS.
+    """
+    x = reduce_scatter_shard(x, fast_axis, method=fast_method,
+                             interpret=interpret, collective_id=14)
+    x = reduce_scatter_shard(x, slow_axis, method=slow_method,
+                             interpret=interpret, collective_id=15)
+    return x

@@ -95,6 +95,70 @@ def ici_bandwidth_gbps() -> float:
     return detect_topology().ici_gbps
 
 
+def slice_index(device) -> int:
+    """Slice id of a TPU device (0 on single-slice / non-TPU).
+
+    Multi-slice TPU deployments expose ``slice_index`` on each device; the
+    DCN tier is "between different slice_index groups" (the reference's
+    node boundary, COMM_SCOPE INTER_NODE).
+    """
+    return int(getattr(device, "slice_index", 0) or 0)
+
+
+def n_slices() -> int:
+    return len({slice_index(d) for d in jax.devices()})
+
+
+def create_hybrid_mesh(ici_axes: dict[str, int] | None = None,
+                       dcn_axis: str = "dcn"):
+    """Build a (dcn, *ici) mesh where the leading axis crosses slices.
+
+    Real multi-slice TPU: delegates to ``mesh_utils.create_hybrid_device_mesh``
+    (DCN-aware device ordering).  Single-slice or CPU test meshes: the
+    process boundary plays the slice boundary (processes are connected by
+    gRPC/gloo, the test-world DCN), falling back to a plain split when
+    single-process.
+
+    Reference analog: the nnodes x local_world topology of launch.sh +
+    NVSHMEM teams; here it is just a mesh whose leading axis is the slow
+    tier.
+    """
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    slices = n_slices()
+    n_proc = jax.process_count()
+    # The slow tier is the slice boundary.  On non-TPU backends the process
+    # boundary plays that role (gRPC/gloo between procs).  A single-slice
+    # multi-host TPU pod has NO slow tier — all hosts share one ICI fabric —
+    # so n_slow collapses to 1 there (keeps axis_is_dcn consistent).
+    if slices > 1:
+        n_slow = slices
+    elif devices[0].platform != "tpu":
+        n_slow = max(n_proc, 1)
+    else:
+        n_slow = 1
+    if ici_axes is None:
+        ici_axes = {"tp": len(devices) // n_slow}
+    n_fast = int(np.prod(list(ici_axes.values())))
+
+    if slices > 1:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=tuple(ici_axes.values()),
+            dcn_mesh_shape=(n_slow,) + (1,) * (len(ici_axes) - 1),
+            devices=devices)
+        dev_array = dev_array.reshape((n_slow,) + tuple(ici_axes.values()))
+    else:
+        # process-major ordering: jax.devices() already groups by process
+        assert n_slow * n_fast == len(devices), (n_slow, n_fast, len(devices))
+        dev_array = np.asarray(devices).reshape(
+            (n_slow,) + tuple(ici_axes.values()))
+    return Mesh(dev_array, (dcn_axis,) + tuple(ici_axes.keys()))
+
+
 def axis_is_dcn(mesh, axis: str) -> bool:
     """True when the mesh axis spans hosts via DCN rather than ICI.
 
@@ -111,5 +175,11 @@ def axis_is_dcn(mesh, axis: str) -> bool:
     pencil = [
         devs[tuple(idx[:ax] + [i] + idx[ax + 1:])] for i in range(devs.shape[ax])
     ]
+    # A real multi-slice boundary (slice_index differs) is always DCN; a
+    # process boundary is DCN on CPU/test backends (gRPC between procs) and
+    # on multi-host TPU only when it also crosses slices (a v5p pod spans
+    # many hosts on one ICI fabric).
+    if len({slice_index(d) for d in pencil}) > 1:
+        return True
     procs = {getattr(d, "process_index", 0) for d in pencil}
-    return len(procs) > 1
+    return len(procs) > 1 and pencil[0].platform != "tpu"

@@ -24,7 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from triton_dist_tpu.kernels.allgather import AllGatherMethod, all_gather_shard
+from triton_dist_tpu.kernels.allgather import AllGatherMethod
+from triton_dist_tpu.kernels.hierarchical import hier_all_gather_shard
 from triton_dist_tpu.runtime.bootstrap import initialize_distributed
 
 
@@ -33,18 +34,13 @@ def hierarchical_ag_shard(x, *, interpret):
     only this chip's own shard — the reference's "same-local-rank P2P"
     trick, allgather.py:470-591), then AG the grown block over fast ICI.
 
-    The composition leaves blocks tier-major ([tp][dcn] order); the final
-    reshape/transpose restores flat (dcn, tp) rank order — the analog of
-    the reference writing each segment at its global-rank offset."""
-    rows = x.shape[0]
-    d = jax.lax.axis_size("dcn")
-    t = jax.lax.axis_size("tp")
-    x = all_gather_shard(x, axis="dcn", method=AllGatherMethod.RING_1D,
-                         interpret=interpret)
-    x = all_gather_shard(x, axis="tp", method=AllGatherMethod.RING_BIDIR,
-                         interpret=interpret)
-    x = x.reshape(t, d, rows, x.shape[-1]).transpose(1, 0, 2, 3)
-    return x.reshape(d * t * rows, -1)
+    The composition leaves blocks tier-major; the library function
+    (kernels/hierarchical.py) restores flat (dcn, tp) rank order — the
+    analog of the reference writing each segment at its global-rank
+    offset."""
+    return hier_all_gather_shard(
+        x, slow_axis="dcn", fast_axis="tp",
+        fast_method=AllGatherMethod.RING_BIDIR, interpret=interpret)
 
 
 def main():

@@ -21,29 +21,19 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from triton_dist_tpu.kernels.reduce_scatter import (
-    ReduceScatterMethod,
-    reduce_scatter_shard,
-)
+from triton_dist_tpu.kernels.hierarchical import hier_reduce_scatter_shard
+from triton_dist_tpu.kernels.reduce_scatter import ReduceScatterMethod
 from triton_dist_tpu.runtime.bootstrap import initialize_distributed
 
 
 def hierarchical_rs_shard(p, *, interpret):
-    """p: this chip's full-size partial.  Two-tier RS, flat-band result."""
-    d = jax.lax.axis_size("dcn")
-    t = jax.lax.axis_size("tp")
-    rows = p.shape[0]
-    band = rows // (d * t)
-    # Fast tier first: after this, chip (i, j) holds rows [j*rows/t, ...) of
-    # the tp-partial sum — data shrinks t-fold before touching DCN.
-    p = reduce_scatter_shard(p, "tp", method=ReduceScatterMethod.RING_1D,
-                             interpret=interpret)
-    # Slow tier: reduce-scatter the band across slices.  Chip (i, j) ends
-    # holding band (j*d + i) — tier-major; re-slice to flat band (i*t + j).
-    p = reduce_scatter_shard(p, "dcn", method=ReduceScatterMethod.RING_1D,
-                             interpret=interpret)
-    del band
-    return p
+    """p: this chip's full-size partial.  Two-tier RS via the library
+    function (kernels/hierarchical.py): fast tier first — data shrinks
+    t-fold before touching DCN; chip (i, j) ends holding flat band
+    (j*d + i), which the out_specs below reassembles in order."""
+    return hier_reduce_scatter_shard(
+        p, slow_axis="dcn", fast_axis="tp",
+        fast_method=ReduceScatterMethod.RING_1D, interpret=interpret)
 
 
 def main():

@@ -23,6 +23,15 @@ _FLAG = f"--xla_force_host_platform_device_count={_N}"
 INTERPRET = os.environ.get("TDT_TUTORIAL_REAL_TPU", "0") != "1"
 
 if INTERPRET and not os.environ.get("_TDT_TUTORIAL_REEXEC"):
+    import importlib.util
+
+    _TESTENV = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "triton_dist_tpu", "runtime", "testenv.py")
+    _spec = importlib.util.spec_from_file_location("_tdt_testenv", _TESTENV)
+    _testenv = importlib.util.module_from_spec(_spec)
+    _spec.loader.exec_module(_testenv)
+
     _env_ok = (
         _FLAG in os.environ.get("XLA_FLAGS", "")
         and os.environ.get("JAX_PLATFORMS") == "cpu"
@@ -30,9 +39,6 @@ if INTERPRET and not os.environ.get("_TDT_TUTORIAL_REEXEC"):
         and "jax" not in sys.modules
     )
     if not _env_ok:
-        env = dict(os.environ)
-        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + _FLAG).strip()
-        env["JAX_PLATFORMS"] = "cpu"
-        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env = _testenv.virtual_mesh_env(dict(os.environ), _N)
         env["_TDT_TUTORIAL_REEXEC"] = "1"
         os.execve(sys.executable, [sys.executable] + sys.argv, env)
