@@ -1,0 +1,271 @@
+"""Primitive-level tests for triton_dist_tpu.language.
+
+Reference analog: ``test/nvidia/test_nvshmem_api.py`` (886 LoC, 11 cases:
+getmem/putmem x granularities, signal ops, broadcast, fcollect, barriers)
+and ``test_distributed_wait.py`` / ``test_notify.py``.  Each case runs a
+small Pallas kernel on the virtual CPU mesh and checks against a pure-JAX
+reference.
+"""
+
+import functools
+
+import jax
+import jax.experimental.pallas as pl
+import jax.experimental.pallas.tpu as pltpu
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import triton_dist_tpu.language as dl
+from triton_dist_tpu.language.interpret import interpret_params
+
+
+def run_kernel(mesh, kernel, x, *, out_shape=None, scratch, in_spec=P("tp"),
+               out_spec=P("tp"), collective_id=12):
+    fn = pl.pallas_call(
+        kernel,
+        out_shape=out_shape or jax.ShapeDtypeStruct(
+            (x.shape[0] // mesh.devices.size,) + x.shape[1:], x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=scratch,
+        compiler_params=pltpu.CompilerParams(collective_id=collective_id,
+                                             has_side_effects=True),
+        interpret=interpret_params(),
+    )
+    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_spec,
+                                 out_specs=out_spec, check_vma=False))(x)
+
+
+def test_putmem_ring_shift(mesh4, key):
+    """putmem + wait_arrival: each rank sends its shard right (test_ring_put
+    analog)."""
+
+    def kernel(x_ref, o_ref, send, recv):
+        dl.barrier_all("tp")
+        world = dl.num_ranks("tp")
+        right = jax.lax.rem(dl.rank("tp") + 1, world)
+        cp = dl.putmem(x_ref, o_ref, send, recv, "tp", right)
+        cp.wait_send()
+        dl.wait_arrival(o_ref, recv)
+
+    x = jax.random.normal(key, (4 * 8, 128), jnp.float32)
+    out = run_kernel(mesh4, kernel, x,
+                     scratch=[pltpu.SemaphoreType.DMA,
+                              pltpu.SemaphoreType.DMA])
+    want = np.roll(np.asarray(x).reshape(4, 8, 128), 1, axis=0).reshape(32, 128)
+    np.testing.assert_allclose(np.asarray(out), want)
+
+
+def test_getmem_pull(mesh4, key):
+    """getmem: each rank pulls the LEFT neighbor's shard (pull-mode AG leg)."""
+
+    def kernel(x_ref, o_ref, send, recv):
+        dl.barrier_all("tp")
+        world = dl.num_ranks("tp")
+        left = jax.lax.rem(dl.rank("tp") + world - 1, world)
+        cp = dl.getmem(x_ref, o_ref, send, recv, "tp", left)
+        cp.wait()
+
+    x = jax.random.normal(key, (4 * 8, 128), jnp.float32)
+    out = run_kernel(mesh4, kernel, x,
+                     scratch=[pltpu.SemaphoreType.DMA,
+                              pltpu.SemaphoreType.DMA])
+    want = np.roll(np.asarray(x).reshape(4, 8, 128), 1, axis=0).reshape(32, 128)
+    np.testing.assert_allclose(np.asarray(out), want)
+
+
+def test_notify_wait_counter(mesh4):
+    """notify/wait as signal_op/signal_wait_until: every rank signals every
+    peer twice; each waits for 2*(world) then writes rank (test_notify
+    analog)."""
+
+    def kernel(x_ref, o_ref, tmp, sem, copy_sem):
+        dl.barrier_all("tp")
+        world = dl.num_ranks("tp")
+        me = dl.rank("tp")
+
+        def sig(i, c):
+            dl.notify(sem, axis="tp", device_id=jax.lax.rem(me + i, world),
+                      inc=2)
+            return c
+
+        jax.lax.fori_loop(0, world, sig, 0)
+        dl.wait(sem, 2 * world)
+        tmp[...] = jnp.zeros_like(tmp) + me.astype(jnp.float32)
+        dl.local_copy(tmp, o_ref, copy_sem).wait()
+
+    x = jnp.zeros((4 * 8, 128), jnp.float32)
+    out = run_kernel(mesh4, kernel, x,
+                     scratch=[pltpu.VMEM((8, 128), jnp.float32),
+                              pltpu.SemaphoreType.REGULAR,
+                              pltpu.SemaphoreType.DMA])
+    want = np.repeat(np.arange(4, dtype=np.float32), 8)[:, None] * np.ones(
+        (1, 128), np.float32)
+    np.testing.assert_allclose(np.asarray(out), want)
+
+
+def test_barrier_all(mesh8):
+    """barrier_all: write-barrier-read round trip is deterministic."""
+
+    def kernel(x_ref, o_ref, tmp, copy_sem):
+        me = dl.rank("tp")
+        dl.barrier_all("tp")
+        tmp[...] = jnp.zeros_like(tmp) + (me + 1).astype(jnp.float32)
+        dl.local_copy(tmp, o_ref, copy_sem).wait()
+        dl.barrier_all("tp")
+
+    x = jnp.zeros((8 * 8, 128), jnp.float32)
+    out = run_kernel(mesh8, kernel, x,
+                     scratch=[pltpu.VMEM((8, 128), jnp.float32),
+                              pltpu.SemaphoreType.DMA])
+    want = np.repeat(np.arange(1, 9, dtype=np.float32), 8)[:, None] * np.ones(
+        (1, 128), np.float32)
+    np.testing.assert_allclose(np.asarray(out), want)
+
+
+def test_broadcast_via_putmem(mesh4, key):
+    """fcollect/broadcast analog: rank 0 puts its shard to every peer."""
+
+    def kernel(x_ref, o_ref, send, recv):
+        dl.barrier_all("tp")
+        me = dl.rank("tp")
+        world = dl.num_ranks("tp")
+
+        @pl.when(me == 0)
+        def _():
+            def push(i, c):
+                dl.putmem(x_ref, o_ref, send, recv, "tp", i).wait_send()
+                return c
+            jax.lax.fori_loop(0, world, push, 0)
+
+        dl.wait_arrival(o_ref, recv)
+
+    x = jax.random.normal(key, (4 * 8, 128), jnp.float32)
+    out = run_kernel(mesh4, kernel, x,
+                     scratch=[pltpu.SemaphoreType.DMA,
+                              pltpu.SemaphoreType.DMA])
+    want = np.tile(np.asarray(x)[:8], (4, 1))
+    np.testing.assert_allclose(np.asarray(out), want)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16, jnp.int32])
+def test_putmem_dtypes(mesh2, key, dtype):
+    """putmem across dtypes (test_nvshmem_api dtype coverage)."""
+
+    def kernel(x_ref, o_ref, send, recv):
+        dl.barrier_all("tp")
+        other = 1 - dl.rank("tp")
+        dl.putmem(x_ref, o_ref, send, recv, "tp", other).wait_send()
+        dl.wait_arrival(o_ref, recv)
+
+    if dtype == jnp.int32:
+        x = jax.random.randint(key, (2 * 8, 128), 0, 100, jnp.int32)
+    else:
+        x = jax.random.normal(key, (2 * 8, 128), dtype)
+    out = run_kernel(mesh2, kernel, x,
+                     scratch=[pltpu.SemaphoreType.DMA,
+                              pltpu.SemaphoreType.DMA])
+    want = np.roll(np.asarray(x).reshape(2, 8, 128), 1, axis=0).reshape(16, 128)
+    np.testing.assert_array_equal(np.asarray(out), want)
+
+
+# ---------------------------------------------------------------------------
+# Race detection (reference: for_correctness / _add_noise_workload_debug)
+# ---------------------------------------------------------------------------
+
+def _racy_kernel(x_ref, o_ref, tmp, send, recv, copy_sem, *, skip_wait):
+    """Ring put where the consumer optionally SKIPS the arrival wait.
+
+    The received segment is consumed in-kernel (DMA read into VMEM): without
+    the arrival wait that read is unsynchronized against the incoming put —
+    exactly the bug class the race tooling exists to catch.  The trailing
+    barrier keeps even the racy variant safe to *run* (no device exits while
+    a peer's put is in flight).
+    """
+    dl.barrier_all("tp")
+    world = dl.num_ranks("tp")
+    me = dl.rank("tp")
+    right = jax.lax.rem(me + 1, world)
+    dl.maybe_noise("tp")  # hand-rolled-kernel integration point
+    cp = dl.putmem(x_ref, o_ref, send, recv, "tp", right)
+    cp.wait_send()
+    if not skip_wait:
+        dl.wait_arrival(o_ref, recv)
+    dl.local_copy(o_ref, tmp, copy_sem).wait()
+    dl.barrier_all("tp")
+
+
+_RACY_SCRATCH = [pltpu.VMEM((8, 128), jnp.float32),
+                 pltpu.SemaphoreType.DMA,
+                 pltpu.SemaphoreType.DMA,
+                 pltpu.SemaphoreType.DMA]
+
+
+def _run_racy(mesh, x, skip_wait):
+    kernel = functools.partial(_racy_kernel, skip_wait=skip_wait)
+    return run_kernel(mesh, kernel, x, scratch=list(_RACY_SCRATCH))
+
+
+def _run_race_detector(mesh, x, skip_wait):
+    """Run the (possibly racy) ring-put under the interpreter's vector-clock
+    race detector; return whether any race was flagged.
+
+    The flag lives on a private jax module (no public accessor for the
+    detector's verdict as of jax 0.9); skip rather than fail if it moves.
+    """
+    try:
+        from jax._src.pallas.mosaic.interpret import (
+            interpret_pallas_call as ipc)
+        assert hasattr(ipc, "races")
+    except (ImportError, AssertionError):
+        pytest.skip("jax private race-detector state moved; update accessor")
+
+    kernel = functools.partial(_racy_kernel, skip_wait=skip_wait)
+    fn = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(
+            (x.shape[0] // mesh.devices.size,) + x.shape[1:], x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=list(_RACY_SCRATCH),
+        compiler_params=pltpu.CompilerParams(collective_id=12,
+                                             has_side_effects=True),
+        interpret=interpret_params(detect_races=True),
+    )
+    jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=P("tp"),
+                          out_specs=P("tp"), check_vma=False))(x).block_until_ready()
+    return bool(ipc.races is not None and ipc.races.races_found)
+
+
+def test_race_detector_flags_missing_wait(mesh4, key):
+    """skip_wait=True: reading the put destination without wait_arrival is an
+    unsynchronized access — the vector-clock detector must flag it (this is
+    the test that proves the race tooling detects real races)."""
+    x = jax.random.normal(key, (4 * 8, 128), jnp.float32)
+    assert _run_race_detector(mesh4, x, skip_wait=True)
+
+
+def test_race_detector_passes_correct_kernel(mesh4, key):
+    """The properly synchronized kernel is race-free under the detector."""
+    x = jax.random.normal(key, (4 * 8, 128), jnp.float32)
+    assert not _run_race_detector(mesh4, x, skip_wait=False)
+
+
+def test_noise_preserves_correct_kernels(mesh4, key):
+    """A properly synchronized kernel gives identical results under noise."""
+    x = jax.random.normal(key, (4 * 8, 128), jnp.float32)
+    clean = np.asarray(_run_racy(mesh4, x, skip_wait=False))
+    with dl.for_correctness():
+        noisy = np.asarray(_run_racy(mesh4, x, skip_wait=False))
+    np.testing.assert_array_equal(clean, noisy)
+
+
+def test_for_correctness_flag_scoping():
+    from triton_dist_tpu.language import race
+
+    assert not race.enabled()
+    with dl.for_correctness(max_iters=64):
+        assert race.enabled()
+    assert not race.enabled()

@@ -109,9 +109,14 @@ class ContextualAutotuner:
         self._states = []
         try:
             ret = self.fn(*args, **kwargs)  # discovers inner tuners
+            if not self._states:
+                return ret  # nothing to tune (all cached already)
             while not all(st.finished for _, _, st in self._states):
                 ret = self.fn(*args, **kwargs)
-            return ret
+            # The sweep's last call ran whatever config came last, not the
+            # winner; one more call hits every inner tuner's best-config
+            # cache so the returned value matches the selected configs.
+            return self.fn(*args, **kwargs)
         finally:
             # Purge unfinished sweeps from their owners so an aborted
             # region (kernel bug, no-valid-config) can't poison the next

@@ -41,6 +41,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+import triton_dist_tpu.language as dl
 from triton_dist_tpu.kernels.gemm import resolve_impl
 from triton_dist_tpu.language.interpret import maybe_interpret
 from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
@@ -107,22 +108,10 @@ def _a2a_kernel(send_ref, splits_ref, recv_ref, recv_splits_ref,
     # Fire all segments at once (the reference's PE-per-block nbi puts).
     for i in range(1, world):
         peer = jax.lax.rem(me + i, world)
-        pltpu.make_async_remote_copy(
-            src_ref=send_ref.at[peer],
-            dst_ref=recv_ref.at[me],
-            send_sem=send_sem,
-            recv_sem=recv_sem,
-            device_id={axis: peer},
-            device_id_type=pltpu.DeviceIdType.MESH,
-        ).start()
-        pltpu.make_async_remote_copy(
-            src_ref=splits_ref.at[pl.ds(peer, 1)],
-            dst_ref=recv_splits_ref.at[pl.ds(me, 1)],
-            send_sem=send_sem,
-            recv_sem=recv_sem,
-            device_id={axis: peer},
-            device_id_type=pltpu.DeviceIdType.MESH,
-        ).start()
+        dl.remote_copy(send_ref.at[peer], recv_ref.at[me], send_sem, recv_sem, axis, peer).start()
+        dl.remote_copy(splits_ref.at[pl.ds(peer, 1)],
+                       recv_splits_ref.at[pl.ds(me, 1)],
+                       send_sem, recv_sem, axis, peer).start()
 
     # Drain: world-1 outgoing and world-1 incoming (segment + splits each).
     seg = send_ref.at[0]

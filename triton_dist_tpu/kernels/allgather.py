@@ -36,6 +36,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+import triton_dist_tpu.language as dl
 from triton_dist_tpu.language.interpret import maybe_interpret
 from triton_dist_tpu.runtime import topology
 from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
@@ -117,14 +118,7 @@ def _ring_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *, axis, world
     def step(s, _):
         slot = jax.lax.rem(me - s + world, world)
         src = out_ref.at[pl.ds(slot * rows, rows)]
-        rdma = pltpu.make_async_remote_copy(
-            src_ref=src,
-            dst_ref=src,
-            send_sem=send_sem,
-            recv_sem=recv_sem,
-            device_id={axis: right},
-            device_id_type=pltpu.DeviceIdType.MESH,
-        )
+        rdma = dl.remote_copy(src, src, send_sem, recv_sem, axis, right)
         rdma.start()
         rdma.wait()
         return 0
@@ -159,16 +153,8 @@ def _bidir_ring_ag_kernel(
         bwd_slot = jax.lax.rem(me + s, world)
         fwd = out_ref.at[pl.ds(fwd_slot * rows, half)]
         bwd = out_ref.at[pl.ds(bwd_slot * rows + half, half)]
-        r_f = pltpu.make_async_remote_copy(
-            src_ref=fwd, dst_ref=fwd,
-            send_sem=send_sem.at[0], recv_sem=recv_sem.at[0],
-            device_id={axis: right}, device_id_type=pltpu.DeviceIdType.MESH,
-        )
-        r_b = pltpu.make_async_remote_copy(
-            src_ref=bwd, dst_ref=bwd,
-            send_sem=send_sem.at[1], recv_sem=recv_sem.at[1],
-            device_id={axis: left}, device_id_type=pltpu.DeviceIdType.MESH,
-        )
+        r_f = dl.remote_copy(fwd, fwd, send_sem.at[0], recv_sem.at[0], axis, right)
+        r_b = dl.remote_copy(bwd, bwd, send_sem.at[1], recv_sem.at[1], axis, left)
         r_f.start()
         r_b.start()
         r_f.wait()
@@ -200,11 +186,7 @@ def _full_mesh_push_ag_kernel(
     mine = out_ref.at[pl.ds(me * rows, rows)]
     for i in range(1, world):
         peer = jax.lax.rem(me + i, world)
-        pltpu.make_async_remote_copy(
-            src_ref=mine, dst_ref=mine,
-            send_sem=send_sem, recv_sem=recv_sem,
-            device_id={axis: peer}, device_id_type=pltpu.DeviceIdType.MESH,
-        ).start()
+        dl.remote_copy(mine, mine, send_sem, recv_sem, axis, peer).start()
     # Drain sends, then wait for the world-1 incoming chunks.
     for _ in range(world - 1):
         _wait_bytes(mine, send_sem)

@@ -43,6 +43,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+import triton_dist_tpu.language as dl
 from triton_dist_tpu.kernels.gemm import (
     MatmulConfig,
     gemm_pipeline_body,
@@ -136,11 +137,7 @@ def _ag_gemm_kernel(
             pltpu.make_async_copy(seg, seg, recv_sem).wait()
         if s < world - 1:
             # Forward the segment along the ring while we compute on it.
-            pltpu.make_async_remote_copy(
-                src_ref=seg, dst_ref=seg,
-                send_sem=send_sem, recv_sem=recv_sem,
-                device_id={axis: right}, device_id_type=pltpu.DeviceIdType.MESH,
-            ).start()
+            dl.remote_copy(seg, seg, send_sem, recv_sem, axis, right).start()
 
         # Consume the segment: C[slot block, :] = A_seg @ B_loc on the MXU.
         inner(seg, b_ref, out_ref.at[pl.ds(slot * m_loc, m_loc)],

@@ -45,6 +45,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+import triton_dist_tpu.language as dl
 from triton_dist_tpu.kernels.gemm import (
     MatmulConfig,
     group_gemm_pipeline_body,
@@ -132,11 +133,7 @@ def _ag_group_gemm_kernel(
         if s > 0:
             pltpu.make_async_copy(seg, seg, recv_sem).wait()
         if s < world - 1:
-            pltpu.make_async_remote_copy(
-                src_ref=seg, dst_ref=seg,
-                send_sem=send_sem, recv_sem=recv_sem,
-                device_id={axis: right}, device_id_type=pltpu.DeviceIdType.MESH,
-            ).start()
+            dl.remote_copy(seg, seg, send_sem, recv_sem, axis, right).start()
 
         # Grouped GEMM over this segment: row tile i uses expert slab
         # te[slot, i].  The SMEM read in the index map is the scalar-prefetch

@@ -46,6 +46,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+import triton_dist_tpu.language as dl
 from triton_dist_tpu.kernels.gemm import (
     MatmulConfig,
     gemm_pipeline_body,
@@ -174,14 +175,9 @@ def _gemm_rs_kernel(
                 # Right's landing slot (s+1)%2 is reused from step s-2; wait
                 # for the credit it issued after consuming it at step s-1.
                 pltpu.semaphore_wait(credit_sem, 1)
-            pltpu.make_async_remote_copy(
-                src_ref=send_ref.at[p],
-                dst_ref=recv_ref.at[(s + 1) % 2],
-                send_sem=send_sem.at[p],
-                recv_sem=recv_sem.at[(s + 1) % 2],
-                device_id={axis: right},
-                device_id_type=pltpu.DeviceIdType.MESH,
-            ).start()
+            dl.remote_copy(send_ref.at[p], recv_ref.at[(s + 1) % 2],
+                           send_sem.at[p], recv_sem.at[(s + 1) % 2],
+                           axis, right).start()
 
     if world > 1:
         # Drain the final outstanding send (issued at step world-2).

@@ -46,6 +46,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+import triton_dist_tpu.language as dl
 from triton_dist_tpu.kernels.allgather_group_gemm import _segment_plans
 from triton_dist_tpu.kernels.gemm import (
     MatmulConfig,
@@ -174,14 +175,9 @@ def _moe_rs_kernel(
         if not last:
             if s >= 2:
                 pltpu.semaphore_wait(credit_sem, 1)
-            pltpu.make_async_remote_copy(
-                src_ref=send_ref.at[p],
-                dst_ref=recv_ref.at[(s + 1) % 2],
-                send_sem=send_sem.at[p],
-                recv_sem=recv_sem.at[(s + 1) % 2],
-                device_id={axis: right},
-                device_id_type=pltpu.DeviceIdType.MESH,
-            ).start()
+            dl.remote_copy(send_ref.at[p], recv_ref.at[(s + 1) % 2],
+                           send_sem.at[p], recv_sem.at[(s + 1) % 2],
+                           axis, right).start()
 
     if world > 1:
         pfin = (world - 2) % 2

@@ -32,6 +32,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+import triton_dist_tpu.language as dl
 from triton_dist_tpu.language.interpret import maybe_interpret
 from triton_dist_tpu.runtime import topology
 from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
@@ -117,11 +118,7 @@ def _ring_rs_kernel(
             # Wait until the right neighbor consumed our previous partial.
             pltpu.semaphore_wait(credit_sem, 1)
 
-        rdma = pltpu.make_async_remote_copy(
-            src_ref=acc_buf, dst_ref=recv_buf,
-            send_sem=send_sem, recv_sem=recv_sem,
-            device_id={axis: right}, device_id_type=pltpu.DeviceIdType.MESH,
-        )
+        rdma = dl.remote_copy(acc_buf, recv_buf, send_sem, recv_sem, axis, right)
         rdma.start()
         rdma.wait()
         return 0

@@ -68,6 +68,7 @@ the double-buffer ``call_count`` parity trick of low_latency_all_to_all.py:35-11
 is unnecessary.
 """
 
+from triton_dist_tpu.language.race import for_correctness, maybe_noise  # noqa: F401
 from triton_dist_tpu.language.primitives import (  # noqa: F401
     rank,
     num_ranks,

@@ -84,6 +84,9 @@ def remote_copy(src_ref, dst_ref, send_sem, recv_sem, axis, device_id):
     Returns the copy object: ``.start()`` / ``.wait()`` /
     ``.wait_send()`` / ``.wait_recv()``.
     """
+    from triton_dist_tpu.language import race
+
+    race.maybe_noise(axis)
     return pltpu.make_async_remote_copy(
         src_ref=src_ref,
         dst_ref=dst_ref,
@@ -118,17 +121,39 @@ def putmem_signal(src_ref, dst_ref, send_sem, recv_sem, axis, device_id):
 
 
 def getmem(src_ref, dst_ref, send_sem, recv_sem, axis, device_id):
-    """Start a non-blocking get: remote ``src_ref`` on ``device_id`` → local
-    ``dst_ref`` (reference: ``getmem_nbi_block``).  Pull-style AG variants
-    use this (allgather.py full-mesh *pull*)."""
-    cp = pltpu.make_async_remote_copy(
-        src_ref=src_ref,
-        dst_ref=dst_ref,
-        send_sem=send_sem,
-        recv_sem=recv_sem,
-        device_id={axis: device_id},
-        device_id_type=pltpu.DeviceIdType.MESH,
-    )
+    """Non-blocking pull: ``src_ref`` AS HELD BY ``device_id`` → local
+    ``dst_ref`` (reference: ``getmem_nbi_block``; pull-style AG variants,
+    allgather.py full-mesh *pull*).
+
+    TPU RDMA is push-only (``make_async_remote_copy`` writes the remote
+    dst), so the pull is realized by SPMD mirroring: every device pushes
+    its ``src_ref`` to the peer that wants it, i.e. to ``2*me - device_id``
+    (the inverse of a ring offset).  Valid when ``device_id`` is of the
+    form ``me ± k`` — every use in the reference — NOT for arbitrary
+    per-device permutations (those need the push formulation directly).
+    The caller's ``.wait()`` (or ``wait_arrival`` on ``recv_sem``) observes
+    the data that lands locally, exactly like a completed get.
+
+    A *concrete* ``device_id`` (Python/numpy int) is rejected — it is
+    necessarily the same rank on every device, the "everyone pulls rank 0"
+    broadcast idiom, whose mirror push lands the wrong shards.  The check is
+    best-effort: a *traced* value that does not depend on ``rank(axis)``
+    (e.g. a replicated routing-table entry) passes it and is just as wrong.
+    Only rank-relative expressions (``me ± k``) are supported; express
+    uniform pulls as a push from the owner (``putmem`` loop / broadcast).
+    """
+    if not isinstance(device_id, jax.core.Tracer):
+        raise ValueError(
+            "getmem supports only rank-relative device_id (an expression "
+            f"of rank(axis), e.g. me - 1); got concrete {device_id!r}, "
+            "which is the same on every rank. A uniform broadcast-style "
+            "pull cannot be mirrored into a push — use putmem from the "
+            "owning rank instead. (Traced but rank-invariant values are "
+            "equally unsupported but cannot be detected at trace time.)")
+    me = jax.lax.axis_index(axis)
+    world = jax.lax.axis_size(axis)
+    mirror = jax.lax.rem(2 * me - device_id + 2 * world, world)
+    cp = remote_copy(src_ref, dst_ref, send_sem, recv_sem, axis, mirror)
     cp.start()
     return cp
 
@@ -171,6 +196,17 @@ def barrier_all(axis: str, sem=None):
     ``n-1`` signals.  Uses the dedicated hardware barrier semaphore unless a
     regular semaphore is passed.  Kernels using this must set a
     ``collective_id`` in their CompilerParams.
+
+    **Every collective kernel must call this before its first remote DMA or
+    remote semaphore signal** (the reference's ``local_copy_and_barrier_all``
+    preamble, allgather_gemm.py:100-116): a peer that has not yet entered the
+    kernel may still be using its buffers (on hardware), and in interpret
+    mode its buffers/semaphores may not exist yet — setting a
+    ``collective_id`` suppresses the interpreter's implicit start barrier, so
+    an eager remote DMA into a not-yet-allocated peer buffer kills that
+    device thread and deadlocks the rest.  The barrier semaphore itself is
+    exempt (it pre-exists all kernels, fixed-id), which is what makes this
+    barrier the safe entry point.
     """
     n = jax.lax.axis_size(axis)
     me = jax.lax.axis_index(axis)

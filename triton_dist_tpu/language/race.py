@@ -1,0 +1,119 @@
+"""Race-detection aid: randomized comm-path delays.
+
+Reference analog: the ``for_correctness`` context flag —
+``_add_noise_workload_debug`` injects random multi-second sleeps into the
+comm stream so missing dependencies surface as wrong results instead of
+lucky timing (allgather.py:72-77, used at :118-121; SURVEY.md §5 "race
+detection").
+
+TPU-native design: there is no comm stream to sleep on — delays are dummy
+VPU work executed *before a remote copy is issued*.  Shifting issuance
+order is exactly what breaks kernels that read data without waiting on its
+semaphore: in interpret mode (eager DMA) data lands when the producer
+issues, so a consumer that skips its ``wait``/``wait_arrival`` reads stale
+buffer contents once the producer is delayed; on hardware the same shift
+widens real race windows.  The delay length is pseudorandom per (rank,
+call-site) so every run exercises a different interleaving.
+
+Usage::
+
+    with for_correctness():           # host-side, around tracing
+        out = my_distributed_op(x)    # primitives now inject noise
+
+Kernels built on ``triton_dist_tpu.language`` primitives get this for free
+(putmem/getmem/remote_copy consult the flag at trace time); hand-rolled
+kernels can call ``maybe_noise(axis, salt)`` before issuing DMAs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_ENABLED = False
+_MAX_ITERS = 512
+_callsite_counter = 0
+
+# Hardware nanoseconds of extra sleep per spin iteration (so the injected
+# skew is macroscopic on a real chip, like the reference's multi-second
+# comm-stream sleeps scaled down to kernel timescales).
+_NANOS_PER_ITER = 1000
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def trace_key():
+    """Hashable state that must participate in any trace-cache key.
+
+    ``for_correctness`` changes what gets *traced*; a jit/shard-jit cache
+    that ignores this flag silently serves the noise-free executable.
+    ``runtime.jit_cache`` keys on this; plain ``jax.jit`` users are covered
+    by the cache clears in ``for_correctness``.
+    """
+    return (_ENABLED, _MAX_ITERS)
+
+
+@contextlib.contextmanager
+def for_correctness(max_iters: int = 512):
+    """Enable comm-noise injection while tracing ops under this context.
+
+    Clears jax's trace caches on entry (so ops jitted before the context
+    re-trace WITH noise) and on exit (so noisy executables don't leak into
+    production calls).  This is a debug tool; the recompiles are the cost.
+    """
+    global _ENABLED, _MAX_ITERS, _callsite_counter
+    prev, prev_iters = _ENABLED, _MAX_ITERS
+    _ENABLED, _MAX_ITERS = True, max_iters
+    _callsite_counter = 0
+    jax.clear_caches()
+    try:
+        yield
+    finally:
+        _ENABLED, _MAX_ITERS = prev, prev_iters
+        jax.clear_caches()
+
+
+def delay(iters):
+    """Delay of roughly ``iters`` noise units; survives compilation.
+
+    Two mechanisms, because the two execution paths eliminate work
+    differently:
+
+    * a VPU spin loop — in interpret mode the kernel jaxpr is *evaluated*
+      eqn-by-eqn (no DCE), so the loop burns real wall-clock on the device
+      thread and staggers the simulated devices;
+    * ``pl.delay`` (an effectful Mosaic primitive, a no-op in interpret
+      mode) — on hardware it sleeps ``iters * _NANOS_PER_ITER`` ns, and its
+      operand *consumes the spin result*, so Mosaic/XLA cannot DCE the loop
+      as dead code (a pure unconsumed loop would be eliminated).
+    """
+    def body(_, acc):
+        return acc * 1.000001 + 1.0
+
+    acc = jax.lax.fori_loop(0, iters, body, jnp.float32(1.0))
+    # (acc < 0) is always False but unprovable at compile time; feeding it
+    # into the effectful delay anchors the spin against DCE.
+    pl.delay(iters * _NANOS_PER_ITER + (acc < 0).astype(jnp.int32))
+
+
+def maybe_noise(axis: str, salt: int = 0):
+    """Insert a per-rank pseudorandom delay when ``for_correctness`` is on.
+
+    Call before issuing a remote DMA in hand-rolled kernels.  Cheap no-op
+    (trace-time constant False) when disabled.
+    """
+    global _callsite_counter
+    if not _ENABLED:
+        return
+    _callsite_counter += 1
+    me = jax.lax.axis_index(axis)
+    # xorshift-style mix of rank and call site -> [0, _MAX_ITERS)
+    h = (me.astype(jnp.uint32) * jnp.uint32(2654435761)
+         + jnp.uint32(salt * 40503 + _callsite_counter * 9176))
+    h = h ^ (h >> 13)
+    delay((h % jnp.uint32(_MAX_ITERS)).astype(jnp.int32))

@@ -16,7 +16,7 @@ import jax
 
 
 @functools.lru_cache(maxsize=256)
-def _build(builder: Callable, mesh, in_specs, out_specs, opts: tuple):
+def _build(builder: Callable, mesh, in_specs, out_specs, opts: tuple, _noise_key):
     fn = functools.partial(builder, **dict(opts))
     return jax.jit(
         jax.shard_map(
@@ -29,6 +29,11 @@ def cached_shard_jit(builder: Callable, mesh, in_specs, out_specs, **opts):
     """Return a cached ``jit(shard_map(partial(builder, **opts)))``.
 
     ``builder`` must be a module-level function (stable identity) and every
-    opt value hashable.
+    opt value hashable.  The key includes ``race.trace_key()`` so ops traced
+    inside ``for_correctness()`` (comm-noise injection) never share an
+    executable with production traces.
     """
-    return _build(builder, mesh, in_specs, out_specs, tuple(sorted(opts.items())))
+    from triton_dist_tpu.language import race
+
+    return _build(builder, mesh, in_specs, out_specs,
+                  tuple(sorted(opts.items())), race.trace_key())
