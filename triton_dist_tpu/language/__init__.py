@@ -41,8 +41,9 @@ reference primitive                TPU-native implementation
                                    put-with-completion-event; no separate flag
                                    write needed, and it is ordered correctly
                                    by hardware)
-``getmem_*``                       ``getmem(...)`` — remote DMA with remote
-                                   src (pull); TPU DMA engines support both
+``getmem_*``                       ``getmem(...)`` — pulls are realized by
+                                   SPMD mirror pushes (TPU RDMA is
+                                   push-only); rank-relative peers only
 ``signal_op(sig, val, ADD, pe)``   ``notify(sem, axis=a, device_id=pe,
                                    inc=val)``
 ``signal_wait_until(sig, GE, v)``  ``wait(sem, v)`` (decrements; see note)
