@@ -1,0 +1,126 @@
+"""MoE transformer model: forward parity vs a dense reference + training.
+
+Reference analog: test_ep_moe_inference.py / test_ag_moe.py compare the EP
+MoE kernels against a torch dense-MoE reference on real GPUs; here the whole
+*model* (attention TP + EP FFN) is checked against an unsharded pure-jnp
+implementation on the virtual CPU mesh, and the train step is exercised
+through the AllToAll custom VJP (capability the reference doesn't have).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_dist_tpu.models import moe as M
+
+
+def _reference_forward(params, tokens, cfg, n_groups=1):
+    """Unsharded dense-math forward (full sequence, loop over experts).
+    ``n_groups``: aux-loss device groups to emulate (the sharded model
+    computes per-device balance losses)."""
+    from triton_dist_tpu.models.llama import _attention, _rms_norm, _rope
+
+    lcfg = cfg.as_llama()
+    S, B = tokens.shape
+    hd = cfg.head_dim
+    x = params["embed"][tokens]
+    positions = jnp.arange(S, dtype=jnp.int32)
+    aux_total = jnp.float32(0.0)
+
+    for layer in params["layers"]:
+        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        h2 = h.reshape(S * B, cfg.dim)
+        q = (h2 @ layer["wq"]).reshape(S, B, cfg.n_heads, hd)
+        k = (h2 @ layer["wk"]).reshape(S, B, cfg.n_kv_heads, hd)
+        v = (h2 @ layer["wv"]).reshape(S, B, cfg.n_kv_heads, hd)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        o = _attention(q, k, v, lcfg).reshape(S * B, cfg.n_heads * hd)
+        x = x + (o @ layer["wo"]).reshape(S, B, cfg.dim)
+
+        h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        h2 = h.reshape(S * B, cfg.dim)
+        logits = h2.astype(jnp.float32) @ layer["router"]
+        probs = jax.nn.softmax(logits, axis=-1)
+        w, e = jax.lax.top_k(probs, cfg.topk)
+        w = w / jnp.sum(w, axis=-1, keepdims=True)
+        out = jnp.zeros((S * B, cfg.dim), jnp.float32)
+        for ei in range(cfg.n_experts):
+            gate = h2 @ layer["w_gate"][ei]
+            up = h2 @ layer["w_up"][ei]
+            y = (jax.nn.silu(gate.astype(jnp.float32))
+                 * up.astype(jnp.float32)).astype(h2.dtype) @ layer["w_down"][ei]
+            sel = (e == ei).astype(jnp.float32) * w
+            out = out + sel.sum(axis=-1)[:, None] * y.astype(jnp.float32)
+        # Per-device-group balance loss, averaged over groups (matches the
+        # sharded model's per-device aux; sequence-sharded ⇒ groups are
+        # contiguous seq chunks of the [S*B] token-major flattening).
+        pg = probs.reshape(n_groups, -1, cfg.n_experts)
+        eg = e.reshape(n_groups, -1, cfg.topk)
+        for g in range(n_groups):
+            frac = (jnp.zeros((cfg.n_experts,), jnp.float32)
+                    .at[eg[g].reshape(-1)].add(1.0) / eg[g].size)
+            aux_total = aux_total + cfg.n_experts * jnp.sum(
+                frac * jnp.mean(pg[g], axis=0)) / n_groups
+        x = x + out.astype(x.dtype).reshape(S, B, cfg.dim)
+
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return x @ params["lm_head"], aux_total
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_moe_forward_matches_dense_reference(mesh4, key, impl):
+    cfg = M.MoEConfig.tiny()
+    params = M.init_params(cfg, key)
+    S, B = 32, 2
+    tokens = jax.random.randint(jax.random.key(1), (S, B), 0, cfg.vocab)
+
+    fwd = M.make_forward(cfg, mesh4, axis="tp", impl=impl, interpret=True)
+    got, aux = fwd(M.place_params(params, cfg, mesh4), tokens)
+    want, aux_want = _reference_forward(params, tokens, cfg, n_groups=4)
+
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(float(aux), float(aux_want), rtol=1e-4)
+
+
+def test_moe_train_step_learns(mesh4, key):
+    cfg = M.MoEConfig.tiny()
+    params = M.place_params(M.init_params(cfg, key), cfg, mesh4)
+    S, B = 32, 2
+    tokens = jax.random.randint(jax.random.key(2), (S, B), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=0)
+
+    step, _specs = M.make_train_step(cfg, mesh4, axis="tp", impl="pallas",
+                                     interpret=True, lr=0.5)
+    w_gate_before = np.asarray(params["layers"][0]["w_gate"])
+    router_before = np.asarray(params["layers"][0]["router"])
+    losses = []
+    for _ in range(5):
+        params, loss = step(params, tokens, targets)
+        losses.append(float(loss))
+    assert np.all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+    # Expert grads actually flowed THROUGH the AllToAll + grouped-GEMM VJPs:
+    # expert and router weights moved (not just the attention/embed path).
+    w_gate_after = np.asarray(params["layers"][0]["w_gate"])
+    assert np.isfinite(w_gate_after).all()
+    assert not np.allclose(w_gate_after, w_gate_before)
+    assert not np.allclose(np.asarray(params["layers"][0]["router"]),
+                           router_before)
+
+
+def test_moe_capacity_truncation_is_silent_and_finite(mesh4, key):
+    """Tight capacity drops overflow assignments; outputs stay finite and
+    close to the reference on surviving tokens (spot check: finiteness +
+    shape only — the drop pattern is load-dependent)."""
+    cfg = M.MoEConfig.tiny()
+    cfg = M.MoEConfig(**{**cfg.__dict__, "max_tokens": 8})
+    params = M.init_params(cfg, key)
+    tokens = jax.random.randint(jax.random.key(3), (32, 2), 0, cfg.vocab)
+    fwd = M.make_forward(cfg, mesh4, axis="tp", impl="xla", interpret=True)
+    got, aux = fwd(M.place_params(params, cfg, mesh4), tokens)
+    assert np.isfinite(np.asarray(got)).all()
+    assert got.shape == (32, 2, cfg.vocab)

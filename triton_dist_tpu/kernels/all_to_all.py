@@ -37,6 +37,7 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
@@ -158,6 +159,36 @@ def fast_all_to_all_shard(send, splits, *, axis, impl, interpret):
         ),
         interpret=maybe_interpret(interpret),
     )(send, splits)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def fast_all_to_all_shard_diff(send, splits, axis, impl, interpret):
+    """Differentiable :func:`fast_all_to_all_shard`.
+
+    The global token shuffle is a permutation, and its transpose is the
+    inverse shuffle — which for this symmetric (block p ↔ peer p) layout is
+    the *same* AllToAll applied to the cotangent.  This is what lets MoE EP
+    layers train through the dispatch/combine path (the reference is
+    inference-only here; no backward exists to compare against).
+    """
+    return fast_all_to_all_shard(send, splits, axis=axis, impl=impl,
+                                 interpret=interpret)
+
+
+def _a2a_diff_fwd(send, splits, axis, impl, interpret):
+    recv, recv_splits = fast_all_to_all_shard(
+        send, splits, axis=axis, impl=impl, interpret=interpret)
+    return (recv, recv_splits), recv_splits
+
+
+def _a2a_diff_bwd(axis, impl, interpret, recv_splits, cts):
+    d_recv, _ = cts
+    d_send, _ = fast_all_to_all_shard(
+        d_recv, recv_splits, axis=axis, impl=impl, interpret=interpret)
+    return d_send, np.zeros(recv_splits.shape, jax.dtypes.float0)
+
+
+fast_all_to_all_shard_diff.defvjp(_a2a_diff_fwd, _a2a_diff_bwd)
 
 
 def fast_all_to_all(send, splits, ctx: AllToAllContext):

@@ -24,6 +24,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -71,8 +72,55 @@ def group_gemm(
     """y[M_pad, N] where row tile i is ``x_tile @ w_stack[tile_expert[i]]``.
 
     ``block_m`` must be the block size given to ``moe_utils.sort_align`` (it
-    defines the tile→expert granularity).
+    defines the tile→expert granularity).  Differentiable: see
+    :func:`_group_gemm_core` (dx is a grouped GEMM against transposed slabs;
+    dW segment-sums per-tile outer products by expert).
     """
+    return _group_gemm_core(x_sorted, w_stack, tile_expert, block_m, bn, bk,
+                            out_dtype, impl, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _group_gemm_core(x_sorted, w_stack, tile_expert, block_m, bn, bk,
+                     out_dtype, impl, interpret):
+    return _group_gemm_fwd_impl(x_sorted, w_stack, tile_expert, block_m, bn,
+                                bk, out_dtype, impl, interpret)
+
+
+def _group_gemm_vjp_fwd(x_sorted, w_stack, tile_expert, block_m, bn, bk,
+                        out_dtype, impl, interpret):
+    y = _group_gemm_fwd_impl(x_sorted, w_stack, tile_expert, block_m, bn, bk,
+                             out_dtype, impl, interpret)
+    return y, (x_sorted, w_stack, tile_expert)
+
+
+def _group_gemm_vjp_bwd(block_m, bn, bk, out_dtype, impl, interpret,
+                        res, dy):
+    x_sorted, w_stack, tile_expert = res
+    # dx tile i = dy tile i @ W[te[i]]^T — the same grouped GEMM shape.
+    dx = _group_gemm_core(
+        dy.astype(x_sorted.dtype), jnp.swapaxes(w_stack, 1, 2), tile_expert,
+        block_m, bk, bn, x_sorted.dtype, impl, interpret)
+    # dW[e] = Σ_{i: te[i]=e} x_tile_i^T @ dy_tile_i (padding rows are zero in
+    # x_sorted, so they contribute nothing).  Contract tiles directly into
+    # expert slots via a one-hot factor: peak memory E*K*N, not the
+    # n_tiles*K*N a per-tile outer-product + scatter-add would materialize
+    # (which is GBs at Mixtral shapes).
+    n_tiles = tile_expert.shape[0]
+    n_experts = w_stack.shape[0]
+    xt = x_sorted.reshape(n_tiles, block_m, -1)
+    dyt = dy.reshape(n_tiles, block_m, -1)
+    onehot = jax.nn.one_hot(tile_expert, n_experts, dtype=jnp.float32)
+    dw = jnp.einsum("te,tbk,tbn->ekn", onehot, xt, dyt,
+                    preferred_element_type=jnp.float32).astype(w_stack.dtype)
+    return dx, dw, np.zeros(tile_expert.shape, jax.dtypes.float0)
+
+
+_group_gemm_core.defvjp(_group_gemm_vjp_fwd, _group_gemm_vjp_bwd)
+
+
+def _group_gemm_fwd_impl(x_sorted, w_stack, tile_expert, block_m, bn, bk,
+                         out_dtype, impl, interpret):
     m_pad, k_dim = x_sorted.shape
     n_experts, k2, n_dim = w_stack.shape
     assert k_dim == k2, (x_sorted.shape, w_stack.shape)

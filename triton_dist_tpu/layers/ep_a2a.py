@@ -36,6 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from triton_dist_tpu.kernels.all_to_all import (
     AllToAllContext,
     fast_all_to_all_shard,
+    fast_all_to_all_shard_diff,
 )
 from triton_dist_tpu.kernels.moe_utils import stable_rank_in_group
 from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
@@ -71,8 +72,8 @@ def ep_dispatch_shard(x_loc, experts_loc, *, axis, n_experts,
     meta = meta.at[dest_safe, slot, 0].set(flat_e, mode="drop")
     splits = jnp.minimum(counts, max_tokens).astype(jnp.int32)
 
-    recv, recv_splits = fast_all_to_all_shard(
-        send, splits, axis=axis, impl=impl, interpret=interpret)
+    recv, recv_splits = fast_all_to_all_shard_diff(
+        send, splits, axis, impl, interpret)
     recv_meta, _ = fast_all_to_all_shard(
         meta, splits, axis=axis, impl="xla", interpret=interpret)
 
@@ -90,8 +91,7 @@ def ep_combine_shard(y, weights_loc, plan, *, axis, impl, interpret):
     world, max_tokens, hidden = y.shape
     t_loc, topk = weights_loc.shape
     splits = jnp.full((world,), max_tokens, jnp.int32)
-    back, _ = fast_all_to_all_shard(
-        y, splits, axis=axis, impl=impl, interpret=interpret)
+    back, _ = fast_all_to_all_shard_diff(y, splits, axis, impl, interpret)
 
     dest, slot, valid = plan
     vals = back[jnp.minimum(dest, world - 1), jnp.minimum(slot, max_tokens - 1)]

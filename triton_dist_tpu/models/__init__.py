@@ -15,3 +15,10 @@ from triton_dist_tpu.models.llama import (  # noqa: F401
     make_forward,
     make_train_step,
 )
+from triton_dist_tpu.models.moe import (  # noqa: F401
+    MoEConfig,
+    init_params as moe_init_params,
+    make_forward as moe_make_forward,
+    make_train_step as moe_make_train_step,
+    place_params as moe_place_params,
+)

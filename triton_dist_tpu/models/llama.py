@@ -167,6 +167,39 @@ def _attention(q, k, v, cfg: LlamaConfig):
     return jnp.einsum("bhst,tbhd->sbhd", probs, v)
 
 
+def attention_block_shard(x, layer, cfg: LlamaConfig, *, axis, impl,
+                          interpret):
+    """Sequence-parallel TP attention sub-block shared by the model families
+    (Llama dense, MoE): RMSNorm → fused-QKV column-parallel AG-GEMM → RoPE →
+    causal GQA on local heads → row-parallel GEMM-RS, residual added.
+    x: [S_loc, B, D].  ``layer`` needs attn_norm/wq/wk/wv/wo shards."""
+    world = jax.lax.axis_size(axis)
+    s_loc, b, _ = x.shape
+    hd = cfg.head_dim
+    hq_loc = cfg.n_heads // world
+    hkv_loc = cfg.n_kv_heads // world
+    full_positions = jnp.arange(world * s_loc, dtype=jnp.int32)
+    lin_c = functools.partial(column_parallel_linear, axis=axis, impl=impl,
+                              interpret=interpret)
+    lin_r = functools.partial(row_parallel_linear, axis=axis, impl=impl,
+                              interpret=interpret)
+
+    h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    # Local Q/K/V column shards concatenate into one fused weight so the
+    # sequence-allgather happens once per block.
+    wqkv = jnp.concatenate([layer["wq"], layer["wk"], layer["wv"]], axis=1)
+    qkv = lin_c(h.reshape(s_loc * b, cfg.dim), wqkv)
+    qkv = qkv.reshape(world * s_loc, b, (hq_loc + 2 * hkv_loc) * hd)
+    q, k, v = jnp.split(
+        qkv, [hq_loc * hd, (hq_loc + hkv_loc) * hd], axis=-1)
+    q = _rope(q.reshape(-1, b, hq_loc, hd), full_positions, cfg.rope_theta)
+    k = _rope(k.reshape(-1, b, hkv_loc, hd), full_positions, cfg.rope_theta)
+    v = v.reshape(-1, b, hkv_loc, hd)
+    o = _attention(q, k, v, cfg)  # [S, B, Hq_loc, hd]
+    o = o.reshape(world * s_loc * b, hq_loc * hd)
+    return x + lin_r(o, layer["wo"]).reshape(s_loc, b, cfg.dim)
+
+
 def forward_shard(params, tokens_shard, cfg: LlamaConfig, *, axis="tp",
                   impl="auto", interpret=False):
     """Per-device forward.  tokens_shard: [S_loc, B_loc] int32 (seq-major,
@@ -185,31 +218,12 @@ def forward_shard(params, tokens_shard, cfg: LlamaConfig, *, axis="tp",
                               interpret=interpret)
 
     s_loc, b = tokens_shard.shape
-    hd = cfg.head_dim
-    hq_loc = cfg.n_heads // world
-    hkv_loc = cfg.n_kv_heads // world
-
-    full_positions = jnp.arange(world * s_loc, dtype=jnp.int32)
 
     x = params["embed"][tokens_shard]  # [S_loc, B, D]
 
     for layer in params["layers"]:
-        # --- attention block (sequence-parallel residual) ---
-        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        # Local Q/K/V column shards concatenate into one fused weight so the
-        # sequence-allgather happens once per block.
-        wqkv = jnp.concatenate(
-            [layer["wq"], layer["wk"], layer["wv"]], axis=1)
-        qkv = lin_c(h.reshape(s_loc * b, cfg.dim), wqkv)
-        qkv = qkv.reshape(world * s_loc, b, (hq_loc + 2 * hkv_loc) * hd)
-        q, k, v = jnp.split(
-            qkv, [hq_loc * hd, (hq_loc + hkv_loc) * hd], axis=-1)
-        q = _rope(q.reshape(-1, b, hq_loc, hd), full_positions, cfg.rope_theta)
-        k = _rope(k.reshape(-1, b, hkv_loc, hd), full_positions, cfg.rope_theta)
-        v = v.reshape(-1, b, hkv_loc, hd)
-        o = _attention(q, k, v, cfg)  # [S, B, Hq_loc, hd]
-        o = o.reshape(world * s_loc * b, hq_loc * hd)
-        x = x + lin_r(o, layer["wo"]).reshape(s_loc, b, cfg.dim)
+        x = attention_block_shard(x, layer, cfg, axis=axis, impl=impl,
+                                  interpret=interpret)
 
         # --- MLP block (SwiGLU) ---
         h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
