@@ -1,0 +1,109 @@
+"""Pipeline parallelism: GPipe schedule correctness + full 4-axis training.
+
+The reference implements no PP (SURVEY.md §2.5); these tests pin down the
+TPU build's composition story: the pipelined train step must compute the
+SAME loss as the non-pipelined one (microbatching is math-neutral), and
+the dp × pp × tp(+sp) × ep MoE step must run and learn.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.models import llama as L
+from triton_dist_tpu.models import moe as MoE
+from triton_dist_tpu.models import pp as PP
+from triton_dist_tpu.parallel.pipeline import pipeline_spmd, stack_layer_params
+
+
+@pytest.fixture(scope="module")
+def mesh_pp_tp():
+    return Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("pp", "tp"))
+
+
+@pytest.fixture(scope="module")
+def mesh_dp_pp_tp():
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("dp", "pp", "tp"))
+
+
+def test_pipeline_spmd_matches_sequential(mesh_pp_tp):
+    """The schedule applied to a linear stack == applying the layers in
+    order (checked with a toy elementwise block; pp=2 stages)."""
+    n_layers, n_micro, mb = 4, 3, 8
+    ws = jnp.arange(1.0, n_layers + 1)[:, None] * jnp.ones((n_layers, 128))
+    xs = jax.random.normal(jax.random.key(0), (n_micro, mb, 128))
+
+    def block(w, x):
+        return x * w[None, :] + 1.0
+
+    def shard_fn(ws, xs):
+        out = pipeline_spmd(block, ws, xs, axis="pp", n_micro=n_micro)
+        is_last = jax.lax.axis_index("pp") == jax.lax.axis_size("pp") - 1
+        return jax.lax.psum(jnp.where(is_last, out, 0.0), "pp")
+
+    got = jax.jit(jax.shard_map(
+        shard_fn, mesh=mesh_pp_tp, in_specs=(P("pp"), P()),
+        out_specs=P(), check_vma=False))(ws, xs)
+
+    want = xs
+    for i in range(n_layers):
+        want = want * ws[i][None, None, :] + 1.0
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+def test_pp_llama_loss_matches_non_pp(mesh_pp_tp, key):
+    """Same params, same tokens: pipelined step loss == plain TP step loss,
+    for the initial step AND after one update (i.e. grads agree too)."""
+    cfg = L.LlamaConfig.tiny()
+    base = L.init_params(cfg, key)
+    tokens = jax.random.randint(jax.random.key(1), (32, 4), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=0)
+
+    # Non-PP on a tp-only view of the same 2 tp devices won't see identical
+    # fp reassociation; compare against tp=2 mesh directly.
+    mesh_tp = Mesh(np.asarray(mesh_pp_tp.devices[0]), ("tp",))
+    step_ref, _ = L.make_train_step(cfg, mesh_tp, axis="tp", impl="xla",
+                                    interpret=True, lr=0.1)
+    p_ref, loss_ref0 = step_ref(base, tokens, targets)
+    _, loss_ref1 = step_ref(p_ref, tokens, targets)
+
+    pp_params = PP.place_pp_params(PP.init_pp_params(cfg, key), cfg,
+                                   mesh_pp_tp)
+    step_pp, _ = PP.make_pp_train_step(cfg, mesh_pp_tp, n_micro=2,
+                                       impl="xla", interpret=True, lr=0.1)
+    pp_params, loss_pp0 = step_pp(pp_params, tokens, targets)
+    _, loss_pp1 = step_pp(pp_params, tokens, targets)
+
+    np.testing.assert_allclose(float(loss_pp0), float(loss_ref0), rtol=1e-5)
+    np.testing.assert_allclose(float(loss_pp1), float(loss_ref1), rtol=2e-4)
+
+
+def test_pp_moe_4axis_trains(mesh_dp_pp_tp, key):
+    """The flagship composition: dp=2 × pp=2 × tp=2 (sequence-parallel
+    activations, EP experts over tp) MoE train step runs and learns."""
+    cfg = MoE.MoEConfig.tiny()
+    params = PP.place_pp_params(PP.init_pp_params(cfg, key), cfg,
+                                mesh_dp_pp_tp)
+    tokens = jax.random.randint(jax.random.key(2), (16, 8), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=0)
+    step, _ = PP.make_pp_train_step(cfg, mesh_dp_pp_tp, dp_axis="dp",
+                                    n_micro=2, impl="xla", interpret=True,
+                                    lr=0.5)
+    losses = []
+    for _ in range(4):
+        params, loss = step(params, tokens, targets)
+        losses.append(float(loss))
+    assert np.all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_stack_layer_params_roundtrip(key):
+    cfg = L.LlamaConfig.tiny()
+    params = L.init_params(cfg, key)
+    stacked = stack_layer_params(params["layers"])
+    assert stacked["wq"].shape == (cfg.n_layers,) + params["layers"][0]["wq"].shape
+    np.testing.assert_array_equal(np.asarray(stacked["wo"][1]),
+                                  np.asarray(params["layers"][1]["wo"]))

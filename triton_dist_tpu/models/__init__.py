@@ -22,3 +22,9 @@ from triton_dist_tpu.models.moe import (  # noqa: F401
     make_train_step as moe_make_train_step,
     place_params as moe_place_params,
 )
+from triton_dist_tpu.models.pp import (  # noqa: F401
+    init_pp_params,
+    make_pp_train_step,
+    place_pp_params,
+    pp_param_specs,
+)

@@ -200,6 +200,23 @@ def attention_block_shard(x, layer, cfg: LlamaConfig, *, axis, impl,
     return x + lin_r(o, layer["wo"]).reshape(s_loc, b, cfg.dim)
 
 
+def mlp_block_shard(x, layer, cfg: LlamaConfig, *, axis, impl, interpret):
+    """SwiGLU MLP sub-block (sequence-parallel residual): RMSNorm →
+    column-parallel gate/up AG-GEMMs → silu·mul → row-parallel down
+    GEMM-RS, residual added.  x: [S_loc, B, D]."""
+    s_loc, b, _ = x.shape
+    lin_c = functools.partial(column_parallel_linear, axis=axis, impl=impl,
+                              interpret=interpret)
+    lin_r = functools.partial(row_parallel_linear, axis=axis, impl=impl,
+                              interpret=interpret)
+    h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    h2 = h.reshape(s_loc * b, cfg.dim)
+    gate = lin_c(h2, layer["wgate"])
+    up = lin_c(h2, layer["wup"])
+    act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+    return x + lin_r(act, layer["wdown"]).reshape(s_loc, b, cfg.dim)
+
+
 def forward_shard(params, tokens_shard, cfg: LlamaConfig, *, axis="tp",
                   impl="auto", interpret=False):
     """Per-device forward.  tokens_shard: [S_loc, B_loc] int32 (seq-major,
@@ -212,26 +229,14 @@ def forward_shard(params, tokens_shard, cfg: LlamaConfig, *, axis="tp",
     assert cfg.n_heads % world == 0 and cfg.n_kv_heads % world == 0, (
         f"TP over {world} devices needs n_heads ({cfg.n_heads}) and "
         f"n_kv_heads ({cfg.n_kv_heads}) divisible by it")
-    lin_c = functools.partial(column_parallel_linear, axis=axis, impl=impl,
-                              interpret=interpret)
-    lin_r = functools.partial(row_parallel_linear, axis=axis, impl=impl,
-                              interpret=interpret)
-
-    s_loc, b = tokens_shard.shape
 
     x = params["embed"][tokens_shard]  # [S_loc, B, D]
 
     for layer in params["layers"]:
         x = attention_block_shard(x, layer, cfg, axis=axis, impl=impl,
                                   interpret=interpret)
-
-        # --- MLP block (SwiGLU) ---
-        h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        h2 = h.reshape(s_loc * b, cfg.dim)
-        gate = lin_c(h2, layer["wgate"])
-        up = lin_c(h2, layer["wup"])
-        act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
-        x = x + lin_r(act, layer["wdown"]).reshape(s_loc, b, cfg.dim)
+        x = mlp_block_shard(x, layer, cfg, axis=axis, impl=impl,
+                            interpret=interpret)
 
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     # Vocab projection: local tokens x replicated lm_head (seq stays sharded).

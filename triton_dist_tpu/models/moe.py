@@ -212,6 +212,17 @@ def moe_ffn_shard(h2, layer, cfg: MoEConfig, *, axis, impl, interpret):
     return out.astype(cfg.dtype), aux
 
 
+def moe_block_shard(x, layer, cfg: MoEConfig, *, axis, impl, interpret):
+    """MoE FFN sub-block with residual: RMSNorm → routed expert FFN.
+    x: [S_loc, B, D].  Returns (x', aux contribution).  Shared by the plain
+    forward and the pipelined path (models/pp.py)."""
+    s_loc, b, _ = x.shape
+    h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    out, aux = moe_ffn_shard(h.reshape(s_loc * b, cfg.dim), layer, cfg,
+                             axis=axis, impl=impl, interpret=interpret)
+    return x + out.reshape(s_loc, b, cfg.dim), aux
+
+
 # ---------------------------------------------------------------------------
 # Forward / loss (shard level)
 # ---------------------------------------------------------------------------
@@ -234,13 +245,10 @@ def forward_shard(params, tokens_shard, cfg: MoEConfig, *, axis="tp",
         # --- attention (TP over heads; shared Llama code path) ---
         x = attention_block_shard(x, layer, lcfg, axis=axis, impl=impl,
                                   interpret=interpret)
-
         # --- MoE FFN (EP over the same axis) ---
-        h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        out, aux = moe_ffn_shard(h.reshape(s_loc * b, cfg.dim), layer, cfg,
-                                 axis=axis, impl=impl, interpret=interpret)
+        x, aux = moe_block_shard(x, layer, cfg, axis=axis, impl=impl,
+                                 interpret=interpret)
         aux_total = aux_total + aux
-        x = x + out.reshape(s_loc, b, cfg.dim)
 
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.dot(x, params["lm_head"], preferred_element_type=jnp.float32)

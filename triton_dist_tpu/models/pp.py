@@ -1,0 +1,155 @@
+"""Pipelined (pp × dp × tp/sp/ep) training steps for the model families.
+
+Combines the SPMD GPipe schedule (parallel/pipeline.py) with the kernel-
+wired transformer blocks: layers stack along a leading axis sharded over
+the ``pp`` mesh axis; inside each stage the blocks run the overlapped TP
+kernels (sequence-parallel activations) and — for the MoE family — the EP
+AllToAll expert path.  One ``shard_map`` program therefore exercises every
+parallelism the framework offers:
+
+  dp  — batch axis, gradient psum
+  pp  — layer pipeline, ppermute carries
+  tp  — tensor-parallel projections (AG-GEMM / GEMM-RS)
+  sp  — sequence-sharded activations between blocks (Megatron SP layout)
+  ep  — MoE expert sharding + token AllToAll (MoE family)
+
+The reference implements none of this composition (it is a kernel library;
+SURVEY.md §2.5): this module is where the TPU build shows the kernels are
+actually composable under jit.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.models import llama as L
+from triton_dist_tpu.models import moe as MoE
+from triton_dist_tpu.parallel.pipeline import pipeline_spmd, stack_layer_params
+
+
+def _is_moe(cfg) -> bool:
+    return isinstance(cfg, MoE.MoEConfig)
+
+
+def init_pp_params(cfg, key) -> dict:
+    """Same leaves as the family's init_params, with layers stacked [L, ...]."""
+    base = (MoE.init_params(cfg, key) if _is_moe(cfg)
+            else L.init_params(cfg, key))
+    base["layers"] = stack_layer_params(base["layers"])
+    return base
+
+
+def pp_param_specs(cfg, *, tp_axis="tp", pp_axis="pp") -> dict:
+    """Family specs with the stacked layer axis sharded over ``pp``."""
+    base = (MoE.param_specs(cfg, tp_axis) if _is_moe(cfg)
+            else L.param_specs(cfg))
+    layer0 = base["layers"][0]
+    if not _is_moe(cfg) and tp_axis != "tp":
+        raise NotImplementedError("llama specs are tp-named")
+    stacked = {k: P(pp_axis, *spec) for k, spec in layer0.items()}
+    base["layers"] = stacked
+    return base
+
+
+def _block(layer, carry, cfg, *, tp_axis, impl, interpret):
+    """One decoder layer on one microbatch carry (x, aux)."""
+    x, aux = carry
+    lcfg = cfg.as_llama() if _is_moe(cfg) else cfg
+    x = L.attention_block_shard(x, layer, lcfg, axis=tp_axis, impl=impl,
+                                interpret=interpret)
+    if _is_moe(cfg):
+        x, d_aux = MoE.moe_block_shard(x, layer, cfg, axis=tp_axis,
+                                       impl=impl, interpret=interpret)
+        aux = aux + d_aux
+    else:
+        x = L.mlp_block_shard(x, layer, cfg, axis=tp_axis, impl=impl,
+                              interpret=interpret)
+    return x, aux
+
+
+def make_pp_train_step(cfg, mesh: Mesh, *, tp_axis="tp", pp_axis="pp",
+                       dp_axis=None, n_micro=4, impl="auto",
+                       interpret=False, lr=1e-3):
+    """SGD step over a (dp ×) pp × tp mesh with GPipe microbatching.
+
+    Input tokens/targets: [S, B] (sequence sharded over tp, batch over dp);
+    B is split into ``n_micro`` microbatches host-side.  Returns
+    (jitted step, specs).  Gradient sync rule: every leaf is psum'd over
+    each mesh axis its spec does NOT mention (pipeline masking zeroes the
+    contributions of stages that don't own a replicated leaf's compute).
+    """
+    specs = pp_param_specs(cfg, tp_axis=tp_axis, pp_axis=pp_axis)
+    mesh_axes = tuple(a for a in (tp_axis, pp_axis, dp_axis) if a)
+    tok_spec = P(None, tp_axis, dp_axis) if dp_axis else P(None, tp_axis)
+    coef = getattr(cfg, "aux_loss_coef", 0.0)
+
+    def loss_shard(params, tokens_m, targets_m):
+        """tokens_m: [n_micro, S_loc, mb] int32.  Per-device contribution:
+        psum over ALL mesh axes == global loss."""
+        n_stages = jax.lax.axis_size(pp_axis)
+        is_last = jax.lax.axis_index(pp_axis) == n_stages - 1
+
+        x = params["embed"][tokens_m]             # [n_micro, S_loc, mb, D]
+        xs = (x, jnp.zeros((n_micro,), jnp.float32))
+        block = functools.partial(_block, cfg=cfg, tp_axis=tp_axis,
+                                  impl=impl, interpret=interpret)
+        outs_x, outs_aux = pipeline_spmd(
+            block, params["layers"], xs, axis=pp_axis, n_micro=n_micro)
+
+        # Head + CE on the last stage only (garbage elsewhere — mask it).
+        h = L._rms_norm(outs_x, params["final_norm"], cfg.norm_eps)
+        logits = jnp.dot(h, params["lm_head"],
+                         preferred_element_type=jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(
+            logp, targets_m[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        denom = ll.size * jax.lax.axis_size(tp_axis)
+        aux = jnp.sum(outs_aux) / n_micro
+        if dp_axis is not None:
+            denom = denom * jax.lax.axis_size(dp_axis)
+            aux = aux / jax.lax.axis_size(dp_axis)
+        local = -jnp.sum(ll) / denom + coef * aux
+        return jnp.where(is_last, local, 0.0)
+
+    def step_shard(params, tokens_m, targets_m):
+        local_loss, grads = jax.value_and_grad(loss_shard)(
+            params, tokens_m, targets_m)
+        loss = jax.lax.psum(local_loss, mesh_axes)
+
+        def _reduce(g, spec):
+            axes = tuple(a for a in mesh_axes if a not in spec)
+            return jax.lax.psum(g, axes) if axes else g
+
+        grads = jax.tree.map(_reduce, grads, specs,
+                             is_leaf=lambda x: isinstance(x, P))
+        new_params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype),
+                                  params, grads)
+        return new_params, loss
+
+    inner = jax.shard_map(
+        step_shard,
+        mesh=mesh,
+        in_specs=(specs, tok_spec, tok_spec),
+        out_specs=(specs, P()),
+        check_vma=False,
+    )
+
+    def step(params, tokens, targets):
+        """tokens/targets: [S, B]; B → n_micro × mb microbatches."""
+        S, B = tokens.shape
+        assert B % n_micro == 0, (B, n_micro)
+        reshape = lambda t: jnp.moveaxis(
+            t.reshape(S, n_micro, B // n_micro), 1, 0)
+        return inner(params, reshape(tokens), reshape(targets))
+
+    return jax.jit(step), specs
+
+
+def place_pp_params(params, cfg, mesh, *, tp_axis="tp", pp_axis="pp"):
+    specs = pp_param_specs(cfg, tp_axis=tp_axis, pp_axis=pp_axis)
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs)

@@ -1,0 +1,15 @@
+"""Parallelism schedules above the kernel layer.
+
+The reference is a kernel library: DP and PP are explicitly absent there
+(SURVEY.md §2.5 — "DP and PP are not implemented in the reference; the
+building blocks are").  The TPU build supplies them: data parallelism is a
+mesh axis + gradient psum (models/*.make_train_step), and pipeline
+parallelism lives here as an SPMD GPipe schedule over a mesh axis
+(``pipeline.py``), composing under one ``shard_map`` with the TP/SP/EP
+kernels below it.
+"""
+
+from triton_dist_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_spmd,
+    stack_layer_params,
+)

@@ -1,0 +1,102 @@
+"""SPMD pipeline parallelism: a GPipe schedule under shard_map.
+
+Idiomatic TPU PP (the scaling-book recipe): every stage runs the SAME
+program; layer parameters are stacked along a leading layer axis and
+sharded over the ``pp`` mesh axis, so each stage holds a contiguous block
+of layers and applies them with ``lax.scan``.  The schedule is a single
+``lax.scan`` over ``n_micro + n_stages - 1`` ticks; at every tick each
+stage processes one microbatch-carry and hands it to the next stage with
+``jax.lax.ppermute`` (XLA lowers this to an ICI collective-permute that
+overlaps with the next tick's compute).  Bubbles execute as masked garbage
+— inherent to SPMD GPipe, cost (n_stages-1)/(n_micro+n_stages-1).
+
+The backward pipeline needs no code: ``jax.grad`` through the scan +
+ppermute produces the reverse schedule (ppermute's transpose is the
+inverse permutation), with activations rematerialized per jax defaults or
+``jax.checkpoint`` on the block fn.
+
+The carry is a pytree, so models thread auxiliary state (e.g. the MoE
+load-balance loss) alongside activations through the pipe.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def stack_layer_params(layers: list[dict]) -> dict:
+    """[{leaf: arr}, ...] per-layer dicts → {leaf: arr[L, ...]} stacked.
+
+    The stacked leading axis is what gets sharded over the ``pp`` mesh axis
+    (spec ``P("pp", ...)``); inside a stage it is the ``lax.scan`` axis.
+    """
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+
+
+def pipeline_spmd(
+    block_fn: Callable[[Any, Any], Any],
+    stage_params: Any,
+    xs: Any,
+    *,
+    axis: str,
+    n_micro: int,
+):
+    """Run the GPipe schedule.  Call inside shard_map.
+
+    block_fn(layer_params, carry) -> carry: one layer applied to one
+    microbatch carry (a pytree; leaves shaped [mb, ...]-like).
+    stage_params: this stage's stacked layer block ({leaf: [L_loc, ...]}).
+    xs: input carries, a pytree with leading [n_micro] on every leaf —
+    consumed by stage 0 (other stages receive from their left neighbor).
+
+    Returns the last stage's output carries ([n_micro] leading) — garbage
+    on every other stage; mask with ``jax.lax.axis_index(axis) ==
+    jax.lax.axis_size(axis) - 1`` (scalars from it are typically folded
+    into a psum'd loss).
+    """
+    stage = jax.lax.axis_index(axis)
+    n_stages = jax.lax.axis_size(axis)
+    total = n_micro + n_stages - 1
+
+    def apply_stage(carry):
+        def body(c, layer):
+            return block_fn(layer, c), None
+        out, _ = jax.lax.scan(body, carry, stage_params)
+        return out
+
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    zero_carry = jax.tree.map(lambda l: jnp.zeros_like(l[0]), xs)
+    outs0 = jax.tree.map(
+        lambda l: jnp.zeros((n_micro,) + l.shape[1:], l.dtype), xs)
+
+    def tick(state, t):
+        carry_in, outs = state
+        m_in = jnp.clip(t, 0, n_micro - 1)
+        x_t = jax.tree.map(
+            lambda l: jax.lax.dynamic_index_in_dim(l, m_in, 0,
+                                                   keepdims=False), xs)
+        inp = jax.tree.map(
+            lambda a, b: jnp.where(stage == 0, a, b), x_t, carry_in)
+        y = apply_stage(inp)
+
+        # Last stage finished microbatch m = t - (n_stages - 1) at this tick.
+        m_out = t - (n_stages - 1)
+        valid = m_out >= 0  # (m_out < n_micro holds: t <= total-1)
+        slot = jnp.clip(m_out, 0, n_micro - 1)
+
+        def stash(buf, val):
+            cur = jax.lax.dynamic_index_in_dim(buf, slot, 0, keepdims=False)
+            new = jnp.where(valid, val, cur)
+            return jax.lax.dynamic_update_index_in_dim(buf, new, slot, 0)
+
+        outs = jax.tree.map(stash, outs, y)
+        carry_out = jax.tree.map(
+            lambda l: jax.lax.ppermute(l, axis, perm), y)
+        return (carry_out, outs), None
+
+    (_, outs), _ = jax.lax.scan(
+        tick, (zero_carry, outs0), jnp.arange(total))
+    return outs
