@@ -1,0 +1,103 @@
+"""Ring attention vs full (unsharded) attention, forward and backward.
+
+The training-side SP/CP capability the reference lacks (SURVEY.md §5: its
+long-context path is decode-only).  Both impls must match a dense softmax
+reference; gradients must match autodiff of the dense form.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.kernels.ring_attention import (
+    create_ring_attention_context,
+    ring_attention,
+    ring_attention_shard,
+)
+
+
+def _dense_reference(q, k, v, causal, scale=None):
+    S, B, Hq, hd = q.shape
+    group = Hq // k.shape[2]
+    scale = scale or 1.0 / np.sqrt(hd)
+    kr = jnp.repeat(k, group, axis=2)
+    vr = jnp.repeat(v, group, axis=2)
+    logits = jnp.einsum("sbhd,tbhd->bhst", q, kr,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhst,tbhd->sbhd", p.astype(q.dtype), vr,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _qkv(key, S=32, B=2, Hq=4, Hkv=2, hd=128, dtype=jnp.float32):
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (S, B, Hq, hd), dtype)
+    k = jax.random.normal(ks[1], (S, B, Hkv, hd), dtype)
+    v = jax.random.normal(ks[2], (S, B, Hkv, hd), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_dense(mesh4, key, impl, causal):
+    q, k, v = _qkv(key)
+    ctx = create_ring_attention_context(mesh4, axis="tp", causal=causal,
+                                        impl=impl, interpret=True)
+    got = np.asarray(ring_attention(q, k, v, ctx))
+    want = np.asarray(_dense_reference(q, k, v, causal))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_ring_attention_grads_match_dense(mesh4, key, impl):
+    q, k, v = _qkv(key, S=16, hd=64)
+
+    def ring_loss(q, k, v):
+        fn = jax.shard_map(
+            functools.partial(ring_attention_shard, axis="tp", causal=True,
+                              impl=impl, interpret=True),
+            mesh=mesh4, in_specs=(P("tp"), P("tp"), P("tp")),
+            out_specs=P("tp"), check_vma=False)
+        return jnp.sum(jnp.sin(fn(q, k, v)))
+
+    def dense_loss(q, k, v):
+        return jnp.sum(jnp.sin(_dense_reference(q, k, v, True)))
+
+    got = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    want = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   atol=3e-5, rtol=3e-5, err_msg=name)
+
+
+def test_ring_attention_single_device(mesh2, key):
+    """world sections of the mesh degenerate correctly (2-device ring)."""
+    q, k, v = _qkv(key, S=16, hd=64)
+    ctx = create_ring_attention_context(mesh2, axis="tp", impl="xla",
+                                        interpret=True)
+    got = np.asarray(ring_attention(q, k, v, ctx))
+    want = np.asarray(_dense_reference(q, k, v, True))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_pallas_under_comm_noise(mesh4, key):
+    """The credit-semaphore backpressure must hold under adversarial comm
+    timing (this is the race the noise tool exists to catch: without
+    credits, a fast left neighbor overwrites the slot its right neighbor
+    is still consuming)."""
+    import triton_dist_tpu.language as dl
+
+    q, k, v = _qkv(key)
+    ctx = create_ring_attention_context(mesh4, axis="tp", causal=True,
+                                        impl="pallas", interpret=True)
+    clean = np.asarray(ring_attention(q, k, v, ctx))
+    with dl.for_correctness():
+        noisy = np.asarray(ring_attention(q, k, v, ctx))
+    np.testing.assert_array_equal(clean, noisy)

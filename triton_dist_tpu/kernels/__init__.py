@@ -67,3 +67,9 @@ from triton_dist_tpu.kernels.moe_reduce_rs import (  # noqa: F401
     moe_reduce_rs,
     create_moe_rs_context,
 )
+from triton_dist_tpu.kernels.ring_attention import (  # noqa: F401
+    RingAttentionContext,
+    create_ring_attention_context,
+    ring_attention,
+    ring_attention_shard,
+)

@@ -1,0 +1,324 @@
+"""Ring attention — training-side sequence/context parallelism.
+
+Reference analog: none, by design.  The reference's long-context story is
+decode-side SP only (sharded-KV flash-decode + LL allgather + LSE combine,
+flash_decode.py:481-532; SURVEY.md §5 "ring/Ulysses are natural TPU
+extensions").  This module supplies the training-side half: Q/K/V stay
+sequence-sharded, KV blocks rotate around the mesh-axis ring, and each
+device folds every block into a running online-softmax accumulator (the
+same LSE-merge math as the reference's inter-rank decode combine, applied
+blockwise instead of once).
+
+Two implementations:
+
+* ``xla`` — ``lax.scan`` over ring steps with ``jax.lax.ppermute`` KV
+  rotation.  XLA overlaps the collective-permute with the next block's
+  compute on TPU, and the whole thing is differentiable (the backward
+  pipeline is scan+ppermute transposed — a reverse-direction ring).
+* ``pallas`` — one kernel per device: double-buffered KV slots in HBM;
+  at step s the kernel remote-DMAs the current block to the right
+  neighbor's next slot while the MXU computes this block's flash update
+  (the ag_gemm overlap structure applied to attention).  Whole [S_loc]
+  blocks are staged through VMEM, so S_loc × (B·H·hd) must fit VMEM —
+  fine for long-context configs, which keep B·H small precisely because S
+  is huge.  Differentiable via custom VJP whose backward is the VJP of the
+  (numerically identical) xla path — i.e. flash-style recompute, a second
+  ring pass.
+
+Causality: KV block from rank j attends to local queries with the global
+positions mask; blocks entirely in the future contribute nothing (their
+exp-weights are 0) but still ride the ring — SPMD uniformity.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+import triton_dist_tpu.language as dl
+from triton_dist_tpu.kernels.gemm import resolve_impl
+from triton_dist_tpu.language.interpret import maybe_interpret
+from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
+
+RING_ATTN_COLLECTIVE_ID = 6
+_NEG = -1e30
+
+
+@dataclass
+class RingAttentionContext:
+    mesh: Mesh
+    axis: str = "sp"
+    causal: bool = True
+    impl: str = "auto"
+    interpret: bool = False
+
+    @property
+    def world(self) -> int:
+        return self.mesh.shape[self.axis]
+
+
+def create_ring_attention_context(mesh, axis="sp", causal=True, impl="auto",
+                                  interpret=False) -> RingAttentionContext:
+    return RingAttentionContext(mesh=mesh, axis=axis, causal=causal,
+                                impl=impl, interpret=interpret)
+
+
+def _block_update(q, k_blk, v_blk, m, l, acc, q_off, k_off, *, causal,
+                  scale, group):
+    """One flash/online-softmax fold of a KV block into the running stats.
+
+    q [Sq, B, Hq, hd]; k/v [Sk, B, Hkv, hd]; m/l [B, Hq, Sq];
+    acc [Sq, B, Hq, hd] f32; q_off/k_off: global position of the first
+    query/key row.  Returns updated (m, l, acc).  This is the same merge
+    the reference's decode combine does with per-rank LSEs
+    (flash_decode.py:512-526), done blockwise.
+    """
+    kr = jnp.repeat(k_blk, group, axis=2)
+    vr = jnp.repeat(v_blk, group, axis=2)
+    logits = jnp.einsum("sbhd,tbhd->bhst", q, kr,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        # 2-D iota (Mosaic rejects rank-1 iota on hardware; fine under XLA).
+        sq, sk = q.shape[0], k_blk.shape[0]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        mask = (q_off + rows) >= (k_off + cols)
+        logits = jnp.where(mask[None, None], logits, _NEG)
+    m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+    # Rows with no visible keys yet keep m = _NEG; exp(logits - m) would be
+    # exp(0) = 1 for masked entries, so clamp the rescale instead.
+    p = jnp.exp(logits - m_new[..., None])
+    if causal:
+        p = jnp.where(mask[None, None], p, 0.0)
+    rescale = jnp.exp(jnp.minimum(m - m_new, 0.0))
+    l_new = l * rescale + jnp.sum(p, axis=-1)
+    acc_new = (acc * jnp.moveaxis(rescale, -1, 0)[..., None]
+               + jnp.einsum("bhst,tbhd->sbhd", p.astype(q.dtype), vr,
+                            preferred_element_type=jnp.float32))
+    return m_new, l_new, acc_new
+
+
+def _ring_attention_xla(q, k, v, *, axis, causal, scale):
+    world = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    s_loc = q.shape[0]
+    b, hq, hd = q.shape[1], q.shape[2], q.shape[3]
+    group = hq // k.shape[2]
+    q_off = me * s_loc
+    perm = [(i, (i + 1) % world) for i in range(world)]
+    upd = functools.partial(_block_update, causal=causal, scale=scale,
+                            group=group)
+
+    m0 = jnp.full((b, hq, s_loc), _NEG, jnp.float32)
+    l0 = jnp.zeros((b, hq, s_loc), jnp.float32)
+    a0 = jnp.zeros((s_loc, b, hq, hd), jnp.float32)
+
+    # Local block first (outside the scan), then world-1 steps that each
+    # permute-then-consume — no wasted final permute (a collective inside
+    # the scan body cannot be DCE'd by XLA).
+    m, l, acc = upd(q, k, v, m0, l0, a0, q_off, q_off)
+
+    def step(carry, s):
+        k_blk, v_blk, m, l, acc = carry
+        k_blk = jax.lax.ppermute(k_blk, axis, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis, perm)
+        src = jax.lax.rem(me - s + world, world)
+        m, l, acc = upd(q, k_blk, v_blk, m, l, acc, q_off, src * s_loc)
+        return (k_blk, v_blk, m, l, acc), None
+
+    (_, _, _, l, acc), _ = jax.lax.scan(
+        step, (k, v, m, l, acc), jnp.arange(1, world))
+    out = acc / jnp.maximum(jnp.moveaxis(l, -1, 0), 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas overlapped kernel
+# ---------------------------------------------------------------------------
+
+
+def _ring_attn_kernel(q_ref, k_ref, v_ref, o_ref, kring_ref, vring_ref,
+                      q_vmem, k_vmem, v_vmem,
+                      send_sem, recv_sem, copy_sem, credit_sem,
+                      *, axis, world, causal, scale, hq, hkv, hd):
+    """Double-buffered ring: slot s%2 is consumed while being forwarded to
+    the right neighbor's slot (s+1)%2.  kring/vring: [2, S_loc, cols] HBM;
+    blocks stage through VMEM scratch for the VPU/MXU compute.
+
+    Two slots alone are NOT race-free: the left neighbor's step-s put
+    targets my slot (s+1)%2 — the same slot my step s-1 is consuming.  The
+    credit semaphore adds the missing backpressure (the gemm_rs pattern):
+    after step s finishes with slot s%2 (staged to VMEM and its outbound
+    send drained) I credit my LEFT neighbor, and nobody sends into a
+    reused slot before collecting the matching credit."""
+    me = jax.lax.axis_index(axis)
+    right = jax.lax.rem(me + 1, world)
+    left = jax.lax.rem(me + world - 1, world)
+    s_loc = q_ref.shape[0]
+    b = q_ref.shape[1] // (hq * hd)
+    group = hq // hkv
+
+    # Stage local KV into slot 0 and Q into VMEM.
+    c1 = pltpu.make_async_copy(k_ref, kring_ref.at[0], copy_sem)
+    c2 = pltpu.make_async_copy(v_ref, vring_ref.at[0], copy_sem)
+    c3 = pltpu.make_async_copy(q_ref, q_vmem, copy_sem)
+    c1.start(); c2.start(); c3.start(); c1.wait(); c2.wait(); c3.wait()
+
+    if world > 1:
+        dl.barrier_all(axis)
+
+    q = q_vmem[...].reshape(s_loc, b, hq, hd)
+    q_off = me * s_loc
+
+    m = jnp.full((b, hq, s_loc), _NEG, jnp.float32)
+    l = jnp.zeros((b, hq, s_loc), jnp.float32)
+    acc = jnp.zeros((s_loc, b, hq, hd), jnp.float32)
+
+    for s in range(world):
+        cur, nxt = s % 2, (s + 1) % 2
+        if s > 0:
+            # Block for this step was DMA'd by the left neighbor during the
+            # previous step's compute (two DMAs: k and v).
+            pltpu.make_async_copy(kring_ref.at[cur], kring_ref.at[cur],
+                                  recv_sem).wait()
+            pltpu.make_async_copy(vring_ref.at[cur], vring_ref.at[cur],
+                                  recv_sem).wait()
+        if s < world - 1:
+            if s >= 1:
+                # Right's slot nxt was consumed at its step s-1; wait for
+                # its credit before overwriting.
+                pltpu.semaphore_wait(credit_sem, 1)
+            dl.remote_copy(kring_ref.at[cur], kring_ref.at[nxt],
+                           send_sem, recv_sem, axis, right).start()
+            dl.remote_copy(vring_ref.at[cur], vring_ref.at[nxt],
+                           send_sem, recv_sem, axis, right).start()
+
+        ck = pltpu.make_async_copy(kring_ref.at[cur], k_vmem, copy_sem)
+        cv = pltpu.make_async_copy(vring_ref.at[cur], v_vmem, copy_sem)
+        ck.start(); cv.start(); ck.wait(); cv.wait()
+        k_blk = k_vmem[...].reshape(s_loc, b, hkv, hd)
+        v_blk = v_vmem[...].reshape(s_loc, b, hkv, hd)
+        src = jax.lax.rem(me - s + world, world)
+        m, l, acc = _block_update(q, k_blk, v_blk, m, l, acc, q_off,
+                                  src * s_loc, causal=causal, scale=scale,
+                                  group=group)
+
+        if s < world - 1:
+            # Drain both sends before overwriting/reusing the slot.
+            pltpu.make_async_copy(kring_ref.at[cur], kring_ref.at[cur],
+                                  send_sem).wait()
+            pltpu.make_async_copy(vring_ref.at[cur], vring_ref.at[cur],
+                                  send_sem).wait()
+        if s < world - 2:
+            # Slot cur is now free (staged + drained): left may overwrite it
+            # at its step s+1.
+            pltpu.semaphore_signal(credit_sem, inc=1, device_id={axis: left},
+                                   device_id_type=pltpu.DeviceIdType.MESH)
+
+    out = acc / jnp.maximum(jnp.moveaxis(l, -1, 0), 1e-30)[..., None]
+    # o_ref lives in HBM (ANY): stage through VMEM (q_vmem is free now — q
+    # was materialized as a value before the loop).
+    q_vmem[...] = out.reshape(s_loc, b * hq * hd).astype(q_vmem.dtype)
+    co = pltpu.make_async_copy(q_vmem, o_ref, copy_sem)
+    co.start(); co.wait()
+
+
+def _ring_attention_pallas_fwd(q, k, v, *, axis, causal, scale, interpret):
+    world = jax.lax.axis_size(axis)
+    s_loc, b, hq, hd = q.shape
+    hkv = k.shape[2]
+    q2 = q.reshape(s_loc, b * hq * hd)
+    k2 = k.reshape(s_loc, b * hkv * hd)
+    v2 = v.reshape(s_loc, b * hkv * hd)
+
+    out, _, _ = pl.pallas_call(
+        functools.partial(_ring_attn_kernel, axis=axis, world=world,
+                          causal=causal, scale=scale, hq=hq, hkv=hkv, hd=hd),
+        out_shape=[
+            jax.ShapeDtypeStruct(q2.shape, q.dtype),
+            jax.ShapeDtypeStruct((2,) + k2.shape, k.dtype),  # k ring slots
+            jax.ShapeDtypeStruct((2,) + v2.shape, v.dtype),  # v ring slots
+        ],
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+        scratch_shapes=[
+            pltpu.VMEM(q2.shape, q.dtype),
+            pltpu.VMEM(k2.shape, k.dtype),
+            pltpu.VMEM(v2.shape, v.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.REGULAR,
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True,
+            collective_id=RING_ATTN_COLLECTIVE_ID if world > 1 else None,
+        ),
+        interpret=maybe_interpret(interpret),
+    )(q2, k2, v2)
+    return out.reshape(s_loc, b, hq, hd)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch + differentiability
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_attention_diff(q, k, v, axis, causal, scale, impl, interpret):
+    if impl == "pallas":
+        return _ring_attention_pallas_fwd(q, k, v, axis=axis, causal=causal,
+                                          scale=scale, interpret=interpret)
+    return _ring_attention_xla(q, k, v, axis=axis, causal=causal, scale=scale)
+
+
+def _ring_diff_fwd(q, k, v, axis, causal, scale, impl, interpret):
+    out = _ring_attention_diff(q, k, v, axis, causal, scale, impl, interpret)
+    return out, (q, k, v)
+
+
+def _ring_diff_bwd(axis, causal, scale, impl, interpret, res, dout):
+    # Backward = VJP of the numerically-identical xla ring (flash-style
+    # recompute; the transposed scan runs the ring in reverse).
+    q, k, v = res
+    _, vjp = jax.vjp(
+        functools.partial(_ring_attention_xla, axis=axis, causal=causal,
+                          scale=scale), q, k, v)
+    return vjp(dout)
+
+
+_ring_attention_diff.defvjp(_ring_diff_fwd, _ring_diff_bwd)
+
+
+def ring_attention_shard(q, k, v, *, axis, causal=True, scale=None,
+                         impl="auto", interpret=False):
+    """Shard-level causal GQA ring attention; call inside shard_map.
+
+    q [S_loc, B, Hq, hd]; k/v [S_loc, B, Hkv, hd] — sequence sharded over
+    ``axis``.  Returns [S_loc, B, Hq, hd].  Differentiable on both impls.
+    """
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    impl = resolve_impl(impl, interpret)
+    return _ring_attention_diff(q, k, v, axis, causal, float(scale), impl,
+                                interpret)
+
+
+def ring_attention(q, k, v, ctx: RingAttentionContext):
+    """Host entry: q/k/v [S, B, H, hd] sequence-sharded over ``ctx.axis``."""
+    fn = cached_shard_jit(
+        ring_attention_shard,
+        ctx.mesh,
+        (P(ctx.axis), P(ctx.axis), P(ctx.axis)),
+        P(ctx.axis),
+        axis=ctx.axis, causal=ctx.causal, impl=ctx.impl,
+        interpret=ctx.interpret,
+    )
+    return fn(q, k, v)
