@@ -20,7 +20,8 @@ utilization than the reference achieves on its own hardware.
 
 Timing note: jax.block_until_ready does not actually block on the axon
 tunnel backend, so timings use chained dependent iterations inside one jit
-and subtract the 1-iteration round-trip (see _timed_chain).
+and subtract the 1-iteration round-trip (see _paired_diff_time); block
+sizes are the real-chip sweep winners (MatmulConfig defaults, gemm.py).
 """
 
 import functools
@@ -45,7 +46,7 @@ def _make_chain(mesh, n_iters):
     """n_iters of (AG-GEMM -> matmul-back) with data dependencies, returning
     a scalar so fetching it forces execution."""
     shard_ag = functools.partial(ag_gemm_shard, axis="tp", impl="pallas",
-                                 bm=512, bn=512, bk=512, interpret=False)
+                                 interpret=False)
 
     def body_fn(a, b1, b2):
         def body(i, x):
@@ -59,13 +60,22 @@ def _make_chain(mesh, n_iters):
         out_specs=P(), check_vma=False))
 
 
-def _best_time(fn, *args, trials=5):
-    best = float("inf")
+def _paired_diff_time(fn_short, fn_long, *args, n_extra, trials=6):
+    """Median of per-trial (long - short) / n_extra chain times.
+
+    Pairing short/long inside each trial cancels tunnel-RTT drift that
+    independently-taken best-of-N times do not (observed 1.7x swings on
+    the axon tunnel with unpaired timing)."""
+    diffs = []
     for _ in range(trials):
         t0 = time.perf_counter()
-        float(fn(*args))  # device_get round-trip forces completion
-        best = min(best, time.perf_counter() - t0)
-    return best
+        float(fn_short(*args))  # device_get round-trip forces completion
+        t_short = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(fn_long(*args))
+        t_long = time.perf_counter() - t0
+        diffs.append((t_long - t_short) / n_extra)
+    return max(float(np.median(diffs)), 1e-9)
 
 
 def main():
@@ -78,9 +88,7 @@ def main():
     float(chain1(a, b1, b2))  # warm both executables
     float(chain9(a, b1, b2))
 
-    t1 = _best_time(chain1, a, b1, b2)
-    t9 = _best_time(chain9, a, b1, b2)
-    per_pair_s = max((t9 - t1) / 8, 1e-9)
+    per_pair_s = _paired_diff_time(chain1, chain9, a, b1, b2, n_extra=8)
     flops_per_pair = 2 * M * N_PER_CHIP * K * 2  # ag_gemm + return matmul
     tflops = flops_per_pair / per_pair_s / 1e12
 

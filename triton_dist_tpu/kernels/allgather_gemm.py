@@ -147,8 +147,12 @@ def _ag_gemm_kernel(
             pltpu.make_async_copy(seg, seg, send_sem).wait()
 
 
-def ag_gemm_shard(a_shard, b_shard, *, axis, impl, bm, bn, bk, interpret):
-    """Per-device AG-GEMM; call inside shard_map.  Returns (A_full, C_shard)."""
+def ag_gemm_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
+                  bk=None, interpret=False):
+    """Per-device AG-GEMM; call inside shard_map.  Returns (A_full, C_shard).
+    Block sizes default to the swept MatmulConfig (gemm.py)."""
+    _cfg = MatmulConfig()
+    bm, bn, bk = bm or _cfg.block_m, bn or _cfg.block_n, bk or _cfg.block_k
     impl = resolve_impl(impl, interpret)
     world = jax.lax.axis_size(axis)
     m_loc, K = a_shard.shape

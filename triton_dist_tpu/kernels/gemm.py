@@ -25,8 +25,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 @dataclass(frozen=True)
 class MatmulConfig:
-    block_m: int = 512
-    block_n: int = 512
+    # Swept on a real v5 chip at the bench shape (M=8192 K=8192 N=3584
+    # bf16): (1024, 1024, 512) gives 86% MXU utilization vs 76% for 512³,
+    # and is the VMEM ceiling — (1024,1024,1024)/(2048,...) fail to
+    # compile.  Small shapes clamp via for_shape.
+    block_m: int = 1024
+    block_n: int = 1024
     block_k: int = 512
 
     def for_shape(self, m: int, n: int, k: int) -> "MatmulConfig":
@@ -203,6 +207,7 @@ def _register_gemm_aot():
                 [((1024, 1024), "float32"), ((1024, 512), "float32")],
             ],
             "algo_infos": [
+                {"bm": 1024, "bn": 1024, "bk": 512},  # real-chip sweep winner
                 {"bm": 512, "bn": 512, "bk": 512},
                 {"bm": 256, "bn": 512, "bk": 512},
             ],
@@ -229,7 +234,7 @@ def _make_matmul_autotuned():
 
     configs = [
         Config(bm=bm, bn=bn, bk=bk)
-        for bm in (256, 512) for bn in (256, 512) for bk in (512, 1024)
+        for bm in (256, 512, 1024) for bn in (512, 1024) for bk in (512, 1024)
     ]
 
     def dedupe_clamped(cfgs, args, kwargs):

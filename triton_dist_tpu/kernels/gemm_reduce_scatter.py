@@ -191,8 +191,12 @@ def _gemm_rs_kernel(
         pltpu.semaphore_wait(credit_sem, (world - 1) - n_credit_waits)
 
 
-def gemm_rs_shard(a_shard, b_shard, *, axis, impl, bm, bn, bk, interpret):
-    """Per-device GEMM-RS; call inside shard_map.  Returns the reduced chunk."""
+def gemm_rs_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
+                  bk=None, interpret=False):
+    """Per-device GEMM-RS; call inside shard_map.  Returns the reduced chunk.
+    Block sizes default to the swept MatmulConfig (gemm.py)."""
+    _cfg = MatmulConfig()
+    bm, bn, bk = bm or _cfg.block_m, bn or _cfg.block_n, bk or _cfg.block_k
     impl = resolve_impl(impl, interpret)
     world = jax.lax.axis_size(axis)
     M, k_loc = a_shard.shape

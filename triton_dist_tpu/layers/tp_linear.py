@@ -49,7 +49,7 @@ def column_parallel_linear(a_shard, b_shard, axis, impl="auto",
 
 
 def _col_fwd_impl(a_shard, b_shard, axis, impl, interpret):
-    kw = dict(axis=axis, impl=impl, bm=512, bn=512, bk=512,
+    kw = dict(axis=axis, impl=impl,
               interpret=interpret)
     a_full, c = ag_gemm_shard(a_shard, b_shard, **kw)
     return a_full, c
@@ -65,7 +65,7 @@ def _col_bwd(axis, impl, interpret, res, dc):
     # dA = reduce_scatter(dC @ B^T) over the sequence axis — the ring
     # GEMM-RS kernel with K playing the sharded-feature role.
     da = gemm_rs_shard(dc, b_shard.T, axis=axis, impl=impl,
-                       bm=512, bn=512, bk=512, interpret=interpret)
+                       interpret=interpret)
     # dB = AG(A)^T @ dC — local MXU matmul on the saved gathered input.
     db = jnp.dot(a_full.T, dc, preferred_element_type=jnp.float32).astype(
         b_shard.dtype)
@@ -85,7 +85,7 @@ def row_parallel_linear(a_shard, b_shard, axis, impl="auto",
     summed over feature shards.
     """
     return gemm_rs_shard(a_shard, b_shard, axis=axis, impl=impl,
-                         bm=512, bn=512, bk=512, interpret=interpret)
+                         interpret=interpret)
 
 
 def _row_fwd(a_shard, b_shard, axis, impl, interpret):
@@ -98,7 +98,7 @@ def _row_bwd(axis, impl, interpret, res, dc):
     # dA = AG(dC) @ B^T — the ring AG-GEMM kernel; its gathered output is
     # reused for dB, so the gather happens once.
     dc_full, da = ag_gemm_shard(dc, b_shard.T, axis=axis, impl=impl,
-                                bm=512, bn=512, bk=512, interpret=interpret)
+                                interpret=interpret)
     db = jnp.dot(a_shard.T, dc_full, preferred_element_type=jnp.float32
                  ).astype(b_shard.dtype)
     return da, db
