@@ -1,0 +1,73 @@
+"""Ulysses (head-scatter A2A) attention vs dense reference, fwd + bwd."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.kernels.ulysses_attention import (
+    create_ulysses_context,
+    ulysses_attention,
+    ulysses_attention_shard,
+)
+from tests.test_ring_attention import _dense_reference, _qkv
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_dense(mesh4, key, impl, causal):
+    q, k, v = _qkv(key, Hq=8, Hkv=4)   # heads divisible by world=4
+    ctx = create_ulysses_context(mesh4, axis="tp", causal=causal, impl=impl,
+                                 interpret=True)
+    got = np.asarray(ulysses_attention(q, k, v, ctx))
+    want = np.asarray(_dense_reference(q, k, v, causal))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_ulysses_grads_match_dense(mesh4, key, impl):
+    q, k, v = _qkv(key, S=16, Hq=8, Hkv=4, hd=64)
+
+    def uly_loss(q, k, v):
+        fn = jax.shard_map(
+            functools.partial(ulysses_attention_shard, axis="tp",
+                              causal=True, impl=impl, interpret=True),
+            mesh=mesh4, in_specs=(P("tp"),) * 3, out_specs=P("tp"),
+            check_vma=False)
+        return jnp.sum(jnp.sin(fn(q, k, v)))
+
+    def dense_loss(q, k, v):
+        return jnp.sum(jnp.sin(_dense_reference(q, k, v, True)))
+
+    got = jax.jit(jax.grad(uly_loss, argnums=(0, 1, 2)))(q, k, v)
+    want = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   atol=3e-5, rtol=3e-5, err_msg=name)
+
+
+def test_ulysses_agrees_with_ring(mesh4, key):
+    """The two SP schemes compute the same function."""
+    from triton_dist_tpu.kernels.ring_attention import (
+        create_ring_attention_context,
+        ring_attention,
+    )
+
+    q, k, v = _qkv(key, Hq=8, Hkv=4)
+    uly = create_ulysses_context(mesh4, axis="tp", impl="xla", interpret=True)
+    ring = create_ring_attention_context(mesh4, axis="tp", impl="xla",
+                                         interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(ulysses_attention(q, k, v, uly)),
+        np.asarray(ring_attention(q, k, v, ring)),
+        atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads(mesh4, key):
+    q, k, v = _qkv(key, Hq=4, Hkv=2)   # Hkv=2 not divisible by world=4
+    ctx = create_ulysses_context(mesh4, axis="tp", impl="xla", interpret=True)
+    with pytest.raises(AssertionError, match="ring attention"):
+        ulysses_attention(q, k, v, ctx)

@@ -73,3 +73,9 @@ from triton_dist_tpu.kernels.ring_attention import (  # noqa: F401
     ring_attention,
     ring_attention_shard,
 )
+from triton_dist_tpu.kernels.ulysses_attention import (  # noqa: F401
+    UlyssesContext,
+    create_ulysses_context,
+    ulysses_attention,
+    ulysses_attention_shard,
+)

@@ -1,0 +1,36 @@
+"""Dense (single-device) GQA attention — the shared local building block.
+
+Used wherever a full-sequence attention runs on local heads: the Llama TP
+block (heads sharded, sequence gathered) and the Ulysses SP block (heads
+scattered by the A2A).  The distributed schemes differ in how Q/K/V get to
+the device; the math on arrival is this one function.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NEG = -1e30
+
+
+def dense_gqa_attention(q, k, v, *, causal=True, scale=None):
+    """q [S, B, Hq, hd]; k/v [S, B, Hkv, hd] (Hq % Hkv == 0).
+
+    Returns [S, B, Hq, hd] in q's dtype; softmax statistics in f32.
+    """
+    S = q.shape[0]
+    group = q.shape[2] // k.shape[2]
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    kr = jnp.repeat(k, group, axis=2)
+    vr = jnp.repeat(v, group, axis=2)
+    logits = jnp.einsum("sbhd,tbhd->bhst", q, kr,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        logits = jnp.where(mask[None, None], logits, _NEG)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhst,tbhd->sbhd", p.astype(q.dtype), vr,
+                      preferred_element_type=jnp.float32).astype(q.dtype)

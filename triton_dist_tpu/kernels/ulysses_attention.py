@@ -1,0 +1,127 @@
+"""Ulysses attention — head-scatter AllToAll sequence parallelism.
+
+Reference analog: none (SURVEY.md §5: "No Ulysses (head-scatter A2A) ...
+exist[s] in the reference; ring/Ulysses are natural TPU extensions").  The
+DeepSpeed-Ulysses scheme: activations arrive sequence-sharded; an AllToAll
+re-shards them to head-sharded-with-full-sequence, attention runs locally
+on each device's heads, and the inverse AllToAll restores sequence
+sharding.  Communication is 2 AllToAlls of the QKV/O activations per
+attention call — O(S·B·H·hd / world) per device, independent of world
+size, vs the ring's (world-1) KV-block hops; Ulysses wins when heads are
+plentiful and the sequence shard is large, ring wins when H < world or
+memory for full-sequence scores is tight.
+
+Exactly 2 AllToAlls per attention call: Q/K/V ride ONE fused scatter
+(concatenated along the per-peer head chunk, the same trick as the Llama
+block's fused-QKV allgather), and the output rides the inverse.
+Implementations: ``xla`` (``jax.lax.all_to_all`` — differentiable, fused
+by XLA) and ``pallas`` (the low-latency ``fast_all_to_all`` kernel with
+its custom VJP).  GQA requires ``n_kv_heads % world == 0`` (the standard
+Ulysses constraint); use ring attention otherwise.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.kernels.all_to_all import fast_all_to_all_shard_diff
+from triton_dist_tpu.kernels.attention import dense_gqa_attention
+from triton_dist_tpu.kernels.gemm import resolve_impl
+from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
+
+
+@dataclass
+class UlyssesContext:
+    mesh: Mesh
+    axis: str = "sp"
+    causal: bool = True
+    impl: str = "auto"
+    interpret: bool = False
+
+    @property
+    def world(self) -> int:
+        return self.mesh.shape[self.axis]
+
+
+def create_ulysses_context(mesh, axis="sp", causal=True, impl="auto",
+                           interpret=False) -> UlyssesContext:
+    return UlyssesContext(mesh=mesh, axis=axis, causal=causal, impl=impl,
+                          interpret=interpret)
+
+
+def _a2a_blocks(send, *, axis, impl, interpret):
+    """Peer-block AllToAll: send[p] goes to peer p; recv[p] came from peer
+    p.  send: [world, rows, cols]."""
+    if impl == "xla":
+        return jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0,
+                                  tiled=False)
+    splits = jnp.full((send.shape[0],), send.shape[1], jnp.int32)
+    recv, _ = fast_all_to_all_shard_diff(send, splits, axis, impl, interpret)
+    return recv
+
+
+def _a2a_heads_to_seq(x, *, axis, impl, interpret):
+    """[S, B, H_loc, hd] head-sharded → [S_loc, B, H, hd] seq-sharded."""
+    world = jax.lax.axis_size(axis)
+    s, b, h_loc, hd = x.shape
+    s_loc = s // world
+    send = x.reshape(world, s_loc, b * h_loc * hd)
+    recv = _a2a_blocks(send, axis=axis, impl=impl, interpret=interpret)
+    return (recv.reshape(world, s_loc, b, h_loc, hd)
+            .transpose(1, 2, 0, 3, 4)
+            .reshape(s_loc, b, world * h_loc, hd))
+
+
+def ulysses_attention_shard(q, k, v, *, axis, causal=True, scale=None,
+                            impl="auto", interpret=False):
+    """Shard-level Ulysses attention; call inside shard_map.
+
+    q [S_loc, B, Hq, hd]; k/v [S_loc, B, Hkv, hd], sequence sharded over
+    ``axis``.  Returns [S_loc, B, Hq, hd].  Differentiable on both impls
+    (the A2As carry custom VJPs / native transposes).  Q/K/V travel in ONE
+    fused A2A (per-peer head chunks concatenated), the output in a second.
+    """
+    world = jax.lax.axis_size(axis)
+    s_loc, b, hq, hd = q.shape
+    hkv = k.shape[2]
+    assert hq % world == 0 and hkv % world == 0, (
+        f"Ulysses needs heads divisible by world: Hq={hq} Hkv={hkv} "
+        f"world={world}; use ring attention otherwise")
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    impl = resolve_impl(impl, interpret)
+    hq_loc, hkv_loc = hq // world, hkv // world
+    tot_loc = hq_loc + 2 * hkv_loc
+
+    # Fused scatter: peer p's chunk = my seq block of [q|k|v]'s p-th heads.
+    per_peer = jnp.concatenate([
+        q.reshape(s_loc, b, world, hq_loc, hd),
+        k.reshape(s_loc, b, world, hkv_loc, hd),
+        v.reshape(s_loc, b, world, hkv_loc, hd),
+    ], axis=3)                                  # [S_loc, B, world, tot, hd]
+    send = (per_peer.transpose(2, 0, 1, 3, 4)
+            .reshape(world, s_loc, b * tot_loc * hd))
+    recv = _a2a_blocks(send, axis=axis, impl=impl, interpret=interpret)
+    full = recv.reshape(world * s_loc, b, tot_loc, hd)
+    qh, kh, vh = jnp.split(full, [hq_loc, hq_loc + hkv_loc], axis=2)
+
+    oh = dense_gqa_attention(qh, kh, vh, causal=causal, scale=float(scale))
+    return _a2a_heads_to_seq(oh, axis=axis, impl=impl, interpret=interpret)
+
+
+def ulysses_attention(q, k, v, ctx: UlyssesContext):
+    """Host entry: q/k/v [S, B, H, hd] sequence-sharded over ``ctx.axis``."""
+    fn = cached_shard_jit(
+        ulysses_attention_shard,
+        ctx.mesh,
+        (P(ctx.axis), P(ctx.axis), P(ctx.axis)),
+        P(ctx.axis),
+        axis=ctx.axis, causal=ctx.causal, impl=ctx.impl,
+        interpret=ctx.interpret,
+    )
+    return fn(q, k, v)

@@ -28,3 +28,9 @@ from triton_dist_tpu.models.pp import (  # noqa: F401
     place_pp_params,
     pp_param_specs,
 )
+from triton_dist_tpu.models.cp import (  # noqa: F401
+    cp_param_specs,
+    make_cp_forward,
+    make_cp_train_step,
+    place_cp_params,
+)

@@ -1,0 +1,138 @@
+"""Context-parallel (long-context) training mode for the Llama family.
+
+The training-side long-context story (SURVEY.md §5: the reference scales
+long sequences only at decode time, by sharding the KV cache).  Here the
+*training* sequence is sharded across the ``cp`` mesh axis end-to-end:
+activations stay ``[S_loc, B, D]`` through every block, weights are
+replicated, and attention crosses the shards through either SP scheme:
+
+* ``attn="ring"``   — KV blocks rotate the ring (kernels/ring_attention.py);
+  memory-light, works for any head count.
+* ``attn="ulysses"`` — head-scatter AllToAll (kernels/ulysses_attention.py);
+  communication independent of world size, needs heads % world == 0.
+
+Composes with a ``dp`` axis the usual way (batch sharding + gradient
+psum).  RoPE uses global positions (each shard offsets by its rank), so
+the sharded model is bit-for-bit the same function as the unsharded one.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.kernels.ring_attention import ring_attention_shard
+from triton_dist_tpu.kernels.ulysses_attention import ulysses_attention_shard
+from triton_dist_tpu.models.llama import (
+    LlamaConfig,
+    _rms_norm,
+    _rope,
+    init_params,
+    param_specs as _tp_param_specs,
+)
+
+
+def cp_param_specs(cfg: LlamaConfig) -> dict:
+    """All weights replicated (pure CP; the sharded thing is the sequence)."""
+    return jax.tree.map(lambda _: P(), _tp_param_specs(cfg),
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def _cp_attention_block(x, layer, cfg: LlamaConfig, *, axis, attn, impl,
+                        interpret):
+    """Attention with sequence-sharded activations and replicated weights."""
+    s_loc, b, _ = x.shape
+    me = jax.lax.axis_index(axis)
+    hd = cfg.head_dim
+    positions = me * s_loc + jnp.arange(s_loc, dtype=jnp.int32)
+
+    h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    h2 = h.reshape(s_loc * b, cfg.dim)
+    q = (h2 @ layer["wq"]).reshape(s_loc, b, cfg.n_heads, hd)
+    k = (h2 @ layer["wk"]).reshape(s_loc, b, cfg.n_kv_heads, hd)
+    v = (h2 @ layer["wv"]).reshape(s_loc, b, cfg.n_kv_heads, hd)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+
+    attn_fn = (ring_attention_shard if attn == "ring"
+               else ulysses_attention_shard)
+    o = attn_fn(q, k, v, axis=axis, causal=True, impl=impl,
+                interpret=interpret)
+    o2 = o.reshape(s_loc * b, cfg.n_heads * hd)
+    return x + (o2 @ layer["wo"]).reshape(s_loc, b, cfg.dim)
+
+
+def cp_forward_shard(params, tokens_shard, cfg: LlamaConfig, *, axis,
+                     attn="ring", impl="auto", interpret=False):
+    """tokens_shard [S_loc, B] (sequence sharded).  Local MLP, SP attention."""
+    s_loc, b = tokens_shard.shape
+    x = params["embed"][tokens_shard]
+    for layer in params["layers"]:
+        x = _cp_attention_block(x, layer, cfg, axis=axis, attn=attn,
+                                impl=impl, interpret=interpret)
+        h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        h2 = h.reshape(s_loc * b, cfg.dim)
+        act = (jax.nn.silu((h2 @ layer["wgate"]).astype(jnp.float32))
+               .astype(x.dtype) * (h2 @ layer["wup"]))
+        x = x + (act @ layer["wdown"]).reshape(s_loc, b, cfg.dim)
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return jnp.dot(x, params["lm_head"], preferred_element_type=jnp.float32)
+
+
+def make_cp_train_step(cfg: LlamaConfig, mesh: Mesh, *, axis="cp",
+                       dp_axis=None, attn="ring", impl="auto",
+                       interpret=False, lr=1e-3):
+    """SGD step for the CP mode.  Gradients: every leaf is replicated, so
+    psum over the cp axis (each shard saw only its sequence chunk) and dp."""
+    specs = cp_param_specs(cfg)
+    batch_spec = P(axis, dp_axis) if dp_axis else P(axis)
+    all_axes = (axis,) if dp_axis is None else (axis, dp_axis)
+
+    def loss_shard(params, tokens, targets):
+        logits = cp_forward_shard(params, tokens, cfg, axis=axis, attn=attn,
+                                  impl=impl, interpret=interpret)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(
+            logp, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        denom = ll.size * jax.lax.axis_size(axis)
+        if dp_axis is not None:
+            denom = denom * jax.lax.axis_size(dp_axis)
+        return -jnp.sum(ll) / denom
+
+    def step_shard(params, tokens, targets):
+        local_loss, grads = jax.value_and_grad(loss_shard)(
+            params, tokens, targets)
+        loss = jax.lax.psum(local_loss, all_axes)
+        grads = jax.tree.map(lambda g: jax.lax.psum(g, all_axes), grads)
+        new_params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype),
+                                  params, grads)
+        return new_params, loss
+
+    fn = jax.shard_map(
+        step_shard, mesh=mesh,
+        in_specs=(specs, batch_spec, batch_spec),
+        out_specs=(specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(fn), specs
+
+
+def make_cp_forward(cfg: LlamaConfig, mesh: Mesh, *, axis="cp", attn="ring",
+                    impl="auto", interpret=False):
+    specs = cp_param_specs(cfg)
+    fn = jax.shard_map(
+        functools.partial(cp_forward_shard, cfg=cfg, axis=axis, attn=attn,
+                          impl=impl, interpret=interpret),
+        mesh=mesh, in_specs=(specs, P(axis)), out_specs=P(axis),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def place_cp_params(params, cfg: LlamaConfig, mesh: Mesh) -> dict:
+    specs = cp_param_specs(cfg)
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs)

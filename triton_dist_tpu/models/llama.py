@@ -154,17 +154,10 @@ def _rope(x, positions, theta):
 def _attention(q, k, v, cfg: LlamaConfig):
     """Causal GQA attention on local heads.  q: [S, B, Hq_loc, hd],
     k/v: [S, B, Hkv_loc, hd].  Full sequence, local heads (TP over heads)."""
-    S = q.shape[0]
-    group = q.shape[2] // k.shape[2]
-    k = jnp.repeat(k, group, axis=2)
-    v = jnp.repeat(v, group, axis=2)
-    logits = jnp.einsum("sbhd,tbhd->bhst", q, k,
-                        preferred_element_type=jnp.float32)
-    logits = logits / math.sqrt(cfg.head_dim)
-    mask = jnp.tril(jnp.ones((S, S), bool))
-    logits = jnp.where(mask[None, None], logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhst,tbhd->sbhd", probs, v)
+    from triton_dist_tpu.kernels.attention import dense_gqa_attention
+
+    return dense_gqa_attention(q, k, v, causal=True,
+                               scale=1.0 / math.sqrt(cfg.head_dim))
 
 
 def attention_block_shard(x, layer, cfg: LlamaConfig, *, axis, impl,
