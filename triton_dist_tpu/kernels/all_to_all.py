@@ -82,6 +82,10 @@ def _a2a_kernel(send_ref, splits_ref, recv_ref, recv_splits_ref,
     peer's recv slot ``me``, plus a tiny second DMA for that peer's split
     count — both posted non-blocking back-to-back, so the metadata transfer
     overlaps the payload transfer (shared semaphore accounting by bytes).
+
+    splits travel as [world, 128] int32 rows (count in column 0): Mosaic
+    cannot DMA a sub-lane 1-D int32 slice on hardware, a full 128-lane row
+    is the minimum wire unit.
     """
     me = jax.lax.axis_index(axis)
 
@@ -99,12 +103,7 @@ def _a2a_kernel(send_ref, splits_ref, recv_ref, recv_splits_ref,
         return
 
     # Entry barrier: nobody writes into a peer still outside the kernel.
-    barrier = pltpu.get_barrier_semaphore()
-    for i in range(1, world):
-        peer = jax.lax.rem(me + i, world)
-        pltpu.semaphore_signal(barrier, inc=1, device_id={axis: peer},
-                               device_id_type=pltpu.DeviceIdType.MESH)
-    pltpu.semaphore_wait(barrier, world - 1)
+    dl.barrier_all(axis)
 
     # Fire all segments at once (the reference's PE-per-block nbi puts).
     for i in range(1, world):
@@ -139,11 +138,12 @@ def fast_all_to_all_shard(send, splits, *, axis, impl, interpret):
                                          tiled=False).reshape(world)
         return recv, recv_splits
 
-    return pl.pallas_call(
+    splits_row = jnp.zeros((world, 128), jnp.int32).at[:, 0].set(splits)
+    recv, recv_splits_row = pl.pallas_call(
         functools.partial(_a2a_kernel, axis=axis, world=world),
         out_shape=[
             jax.ShapeDtypeStruct((world, max_tokens, hidden), send.dtype),
-            jax.ShapeDtypeStruct((world,), jnp.int32),
+            jax.ShapeDtypeStruct((world, 128), jnp.int32),
         ],
         in_specs=[pl.BlockSpec(memory_space=pl.ANY),
                   pl.BlockSpec(memory_space=pl.ANY)],
@@ -153,12 +153,11 @@ def fast_all_to_all_shard(send, splits, *, axis, impl, interpret):
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
         ],
-        compiler_params=pltpu.CompilerParams(
-            has_side_effects=True,
-            collective_id=A2A_COLLECTIVE_ID if world > 1 else None,
-        ),
+        compiler_params=dl.collective_compiler_params(
+            world, A2A_COLLECTIVE_ID),
         interpret=maybe_interpret(interpret),
-    )(send, splits)
+    )(send, splits_row)
+    return recv, recv_splits_row[:, 0]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))

@@ -107,13 +107,16 @@ def _ring_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *, axis, world
     # Make sure every peer has entered the kernel before writing into its
     # output buffer (guards cross-invocation semaphore reuse; see JAX dist
     # docs).  Analog of barrier_all at op entry (allgather_gemm.py:100-116).
-    barrier = pltpu.get_barrier_semaphore()
-    left = jax.lax.rem(me + world - 1, world)
-    pltpu.semaphore_signal(barrier, inc=1, device_id={axis: left},
-                           device_id_type=pltpu.DeviceIdType.MESH)
-    pltpu.semaphore_signal(barrier, inc=1, device_id={axis: right},
-                           device_id_type=pltpu.DeviceIdType.MESH)
-    pltpu.semaphore_wait(barrier, 2)
+    # world 1 skips it (and passes no collective_id: a barrier touch with a
+    # degenerate mesh aborts the hardware compiler).
+    if world > 1:
+        barrier = pltpu.get_barrier_semaphore()
+        left = jax.lax.rem(me + world - 1, world)
+        pltpu.semaphore_signal(barrier, inc=1, device_id={axis: left},
+                               device_id_type=pltpu.DeviceIdType.MESH)
+        pltpu.semaphore_signal(barrier, inc=1, device_id={axis: right},
+                               device_id_type=pltpu.DeviceIdType.MESH)
+        pltpu.semaphore_wait(barrier, 2)
 
     def step(s, _):
         slot = jax.lax.rem(me - s + world, world)
@@ -141,12 +144,13 @@ def _bidir_ring_ag_kernel(
     cp.start()
     cp.wait()
 
-    barrier = pltpu.get_barrier_semaphore()
-    pltpu.semaphore_signal(barrier, inc=1, device_id={axis: left},
-                           device_id_type=pltpu.DeviceIdType.MESH)
-    pltpu.semaphore_signal(barrier, inc=1, device_id={axis: right},
-                           device_id_type=pltpu.DeviceIdType.MESH)
-    pltpu.semaphore_wait(barrier, 2)
+    if world > 1:
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id={axis: left},
+                               device_id_type=pltpu.DeviceIdType.MESH)
+        pltpu.semaphore_signal(barrier, inc=1, device_id={axis: right},
+                               device_id_type=pltpu.DeviceIdType.MESH)
+        pltpu.semaphore_wait(barrier, 2)
 
     def step(s, _):
         fwd_slot = jax.lax.rem(me - s + world, world)
@@ -176,12 +180,7 @@ def _full_mesh_push_ag_kernel(
     cp.start()
     cp.wait()
 
-    barrier = pltpu.get_barrier_semaphore()
-    for i in range(1, world):
-        peer = jax.lax.rem(me + i, world)
-        pltpu.semaphore_signal(barrier, inc=1, device_id={axis: peer},
-                               device_id_type=pltpu.DeviceIdType.MESH)
-    pltpu.semaphore_wait(barrier, world - 1)
+    dl.barrier_all(axis)  # self-guards the world-1 degenerate mesh
 
     mine = out_ref.at[pl.ds(me * rows, rows)]
     for i in range(1, world):
@@ -215,9 +214,7 @@ def _ag_pallas_shard(x_shard, *, axis, world, method, interpret, collective_id=1
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[sem_shape, sem_shape, pltpu.SemaphoreType.DMA],
-        compiler_params=pltpu.CompilerParams(
-            has_side_effects=True, collective_id=collective_id
-        ),
+        compiler_params=dl.collective_compiler_params(world, collective_id),
         interpret=maybe_interpret(interpret),
     )(x_shard)
 

@@ -74,33 +74,37 @@ def _block_update(q, k_blk, v_blk, m, l, acc, q_off, k_off, *, causal,
                   scale, group):
     """One flash/online-softmax fold of a KV block into the running stats.
 
-    q [Sq, B, Hq, hd]; k/v [Sk, B, Hkv, hd]; m/l [B, Hq, Sq];
-    acc [Sq, B, Hq, hd] f32; q_off/k_off: global position of the first
-    query/key row.  Returns updated (m, l, acc).  This is the same merge
-    the reference's decode combine does with per-rank LSEs
-    (flash_decode.py:512-526), done blockwise.
+    GROUPED, batch-LEADING layout — (batch, head) folded into one axis
+    because Mosaic's matmul supports at most one batch dim, and placed
+    first because it must be the leading dim: q [G, Sq, hd] with G = B*Hq;
+    k/v [Gk, Sk, hd] (G = group*Gk); m/l [G, Sq]; acc [G, Sq, hd] f32;
+    q_off/k_off: global position of the first query/key row.
+
+    Returns updated (m, l, acc).  This is the same merge the reference's
+    decode combine does with per-rank LSEs (flash_decode.py:512-526), done
+    blockwise.
     """
-    kr = jnp.repeat(k_blk, group, axis=2)
-    vr = jnp.repeat(v_blk, group, axis=2)
-    logits = jnp.einsum("sbhd,tbhd->bhst", q, kr,
+    kr = jnp.repeat(k_blk, group, axis=0)
+    vr = jnp.repeat(v_blk, group, axis=0)
+    logits = jnp.einsum("gsd,gtd->gst", q, kr,
                         preferred_element_type=jnp.float32) * scale
     if causal:
         # 2-D iota (Mosaic rejects rank-1 iota on hardware; fine under XLA).
-        sq, sk = q.shape[0], k_blk.shape[0]
+        sq, sk = q.shape[1], k_blk.shape[1]
         rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
         mask = (q_off + rows) >= (k_off + cols)
-        logits = jnp.where(mask[None, None], logits, _NEG)
+        logits = jnp.where(mask[None], logits, _NEG)
     m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
     # Rows with no visible keys yet keep m = _NEG; exp(logits - m) would be
     # exp(0) = 1 for masked entries, so clamp the rescale instead.
     p = jnp.exp(logits - m_new[..., None])
     if causal:
-        p = jnp.where(mask[None, None], p, 0.0)
+        p = jnp.where(mask[None], p, 0.0)
     rescale = jnp.exp(jnp.minimum(m - m_new, 0.0))
     l_new = l * rescale + jnp.sum(p, axis=-1)
-    acc_new = (acc * jnp.moveaxis(rescale, -1, 0)[..., None]
-               + jnp.einsum("bhst,tbhd->sbhd", p.astype(q.dtype), vr,
+    acc_new = (acc * rescale[..., None]
+               + jnp.einsum("gst,gtd->gsd", p.astype(q.dtype), vr,
                             preferred_element_type=jnp.float32))
     return m_new, l_new, acc_new
 
@@ -116,27 +120,32 @@ def _ring_attention_xla(q, k, v, *, axis, causal, scale):
     upd = functools.partial(_block_update, causal=causal, scale=scale,
                             group=group)
 
-    m0 = jnp.full((b, hq, s_loc), _NEG, jnp.float32)
-    l0 = jnp.zeros((b, hq, s_loc), jnp.float32)
-    a0 = jnp.zeros((s_loc, b, hq, hd), jnp.float32)
+    qg = q.transpose(1, 2, 0, 3).reshape(b * hq, s_loc, hd)
+    kg = k.transpose(1, 2, 0, 3).reshape(b * k.shape[2], s_loc, hd)
+    vg = v.transpose(1, 2, 0, 3).reshape(b * k.shape[2], s_loc, hd)
+
+    m0 = jnp.full((b * hq, s_loc), _NEG, jnp.float32)
+    l0 = jnp.zeros((b * hq, s_loc), jnp.float32)
+    a0 = jnp.zeros((b * hq, s_loc, hd), jnp.float32)
 
     # Local block first (outside the scan), then world-1 steps that each
     # permute-then-consume — no wasted final permute (a collective inside
     # the scan body cannot be DCE'd by XLA).
-    m, l, acc = upd(q, k, v, m0, l0, a0, q_off, q_off)
+    m, l, acc = upd(qg, kg, vg, m0, l0, a0, q_off, q_off)
 
     def step(carry, s):
         k_blk, v_blk, m, l, acc = carry
         k_blk = jax.lax.ppermute(k_blk, axis, perm)
         v_blk = jax.lax.ppermute(v_blk, axis, perm)
         src = jax.lax.rem(me - s + world, world)
-        m, l, acc = upd(q, k_blk, v_blk, m, l, acc, q_off, src * s_loc)
+        m, l, acc = upd(qg, k_blk, v_blk, m, l, acc, q_off, src * s_loc)
         return (k_blk, v_blk, m, l, acc), None
 
     (_, _, _, l, acc), _ = jax.lax.scan(
-        step, (k, v, m, l, acc), jnp.arange(1, world))
-    out = acc / jnp.maximum(jnp.moveaxis(l, -1, 0), 1e-30)[..., None]
-    return out.astype(q.dtype)
+        step, (kg, vg, m, l, acc), jnp.arange(1, world))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]          # [G, S, hd]
+    return (out.reshape(b, hq, s_loc, hd).transpose(2, 0, 1, 3)
+            .astype(q.dtype))
 
 
 # ---------------------------------------------------------------------------
@@ -149,7 +158,7 @@ def _ring_attn_kernel(q_ref, k_ref, v_ref, o_ref, kring_ref, vring_ref,
                       send_sem, recv_sem, copy_sem, credit_sem,
                       *, axis, world, causal, scale, hq, hkv, hd):
     """Double-buffered ring: slot s%2 is consumed while being forwarded to
-    the right neighbor's slot (s+1)%2.  kring/vring: [2, S_loc, cols] HBM;
+    the right neighbor's slot (s+1)%2.  kring/vring: [2, G_kv, S_loc*hd] HBM;
     blocks stage through VMEM scratch for the VPU/MXU compute.
 
     Two slots alone are NOT race-free: the left neighbor's step-s put
@@ -161,8 +170,7 @@ def _ring_attn_kernel(q_ref, k_ref, v_ref, o_ref, kring_ref, vring_ref,
     me = jax.lax.axis_index(axis)
     right = jax.lax.rem(me + 1, world)
     left = jax.lax.rem(me + world - 1, world)
-    s_loc = q_ref.shape[0]
-    b = q_ref.shape[1] // (hq * hd)
+    s_loc = q_ref.shape[1] // hd          # wire layout [G, S_loc*hd]
     group = hq // hkv
 
     # Stage local KV into slot 0 and Q into VMEM.
@@ -174,12 +182,13 @@ def _ring_attn_kernel(q_ref, k_ref, v_ref, o_ref, kring_ref, vring_ref,
     if world > 1:
         dl.barrier_all(axis)
 
-    q = q_vmem[...].reshape(s_loc, b, hq, hd)
+    g_q = q_ref.shape[0]
+    q = q_vmem[...].reshape(g_q, s_loc, hd)
     q_off = me * s_loc
 
-    m = jnp.full((b, hq, s_loc), _NEG, jnp.float32)
-    l = jnp.zeros((b, hq, s_loc), jnp.float32)
-    acc = jnp.zeros((s_loc, b, hq, hd), jnp.float32)
+    m = jnp.full((g_q, s_loc), _NEG, jnp.float32)
+    l = jnp.zeros((g_q, s_loc), jnp.float32)
+    acc = jnp.zeros((g_q, s_loc, hd), jnp.float32)
 
     for s in range(world):
         cur, nxt = s % 2, (s + 1) % 2
@@ -203,8 +212,9 @@ def _ring_attn_kernel(q_ref, k_ref, v_ref, o_ref, kring_ref, vring_ref,
         ck = pltpu.make_async_copy(kring_ref.at[cur], k_vmem, copy_sem)
         cv = pltpu.make_async_copy(vring_ref.at[cur], v_vmem, copy_sem)
         ck.start(); cv.start(); ck.wait(); cv.wait()
-        k_blk = k_vmem[...].reshape(s_loc, b, hkv, hd)
-        v_blk = v_vmem[...].reshape(s_loc, b, hkv, hd)
+        g_kv = k_ref.shape[0]
+        k_blk = k_vmem[...].reshape(g_kv, s_loc, hd)
+        v_blk = v_vmem[...].reshape(g_kv, s_loc, hd)
         src = jax.lax.rem(me - s + world, world)
         m, l, acc = _block_update(q, k_blk, v_blk, m, l, acc, q_off,
                                   src * s_loc, causal=causal, scale=scale,
@@ -222,10 +232,10 @@ def _ring_attn_kernel(q_ref, k_ref, v_ref, o_ref, kring_ref, vring_ref,
             pltpu.semaphore_signal(credit_sem, inc=1, device_id={axis: left},
                                    device_id_type=pltpu.DeviceIdType.MESH)
 
-    out = acc / jnp.maximum(jnp.moveaxis(l, -1, 0), 1e-30)[..., None]
+    out = acc / jnp.maximum(l, 1e-30)[..., None]          # [G, S, hd]
     # o_ref lives in HBM (ANY): stage through VMEM (q_vmem is free now — q
     # was materialized as a value before the loop).
-    q_vmem[...] = out.reshape(s_loc, b * hq * hd).astype(q_vmem.dtype)
+    q_vmem[...] = out.reshape(g_q, s_loc * hd).astype(q_vmem.dtype)
     co = pltpu.make_async_copy(q_vmem, o_ref, copy_sem)
     co.start(); co.wait()
 
@@ -234,9 +244,12 @@ def _ring_attention_pallas_fwd(q, k, v, *, axis, causal, scale, interpret):
     world = jax.lax.axis_size(axis)
     s_loc, b, hq, hd = q.shape
     hkv = k.shape[2]
-    q2 = q.reshape(s_loc, b * hq * hd)
-    k2 = k.reshape(s_loc, b * hkv * hd)
-    v2 = v.reshape(s_loc, b * hkv * hd)
+    # Wire layout [G, S_loc*hd], G leading (matches the kernel's batch-
+    # leading matmul layout; the transpose happens here under XLA, not in
+    # the kernel).
+    q2 = q.transpose(1, 2, 0, 3).reshape(b * hq, s_loc * hd)
+    k2 = k.transpose(1, 2, 0, 3).reshape(b * hkv, s_loc * hd)
+    v2 = v.transpose(1, 2, 0, 3).reshape(b * hkv, s_loc * hd)
 
     out, _, _ = pl.pallas_call(
         functools.partial(_ring_attn_kernel, axis=axis, world=world,
@@ -257,13 +270,11 @@ def _ring_attention_pallas_fwd(q, k, v, *, axis, causal, scale, interpret):
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.REGULAR,
         ],
-        compiler_params=pltpu.CompilerParams(
-            has_side_effects=True,
-            collective_id=RING_ATTN_COLLECTIVE_ID if world > 1 else None,
-        ),
+        compiler_params=dl.collective_compiler_params(
+            world, RING_ATTN_COLLECTIVE_ID),
         interpret=maybe_interpret(interpret),
     )(q2, k2, v2)
-    return out.reshape(s_loc, b, hq, hd)
+    return out.reshape(b, hq, s_loc, hd).transpose(2, 0, 1, 3)
 
 
 # ---------------------------------------------------------------------------

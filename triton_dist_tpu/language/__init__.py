@@ -83,5 +83,6 @@ from triton_dist_tpu.language.primitives import (  # noqa: F401
     local_copy,
     fence,
     barrier_all,
+    collective_compiler_params,
     SIGNAL_DTYPE,
 )

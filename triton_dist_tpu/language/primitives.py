@@ -209,6 +209,12 @@ def barrier_all(axis: str, sem=None):
     barrier the safe entry point.
     """
     n = jax.lax.axis_size(axis)
+    if n == 1:
+        # Degenerate mesh: a barrier touch (get_barrier_semaphore /
+        # wait-for-zero) aborts the Mosaic hardware compiler, and there is
+        # nobody to synchronize with.  Pair with
+        # :func:`collective_compiler_params` so no collective_id is claimed.
+        return
     me = jax.lax.axis_index(axis)
     bsem = pltpu.get_barrier_semaphore() if sem is None else sem
 
@@ -222,3 +228,19 @@ def barrier_all(axis: str, sem=None):
 
     jax.lax.fori_loop(1, n, body, 0)
     pltpu.semaphore_wait(bsem, n - 1)
+
+
+def collective_compiler_params(world: int, collective_id: int, **kwargs):
+    """CompilerParams for a collective Pallas kernel.
+
+    Claims the barrier semaphore only on a real (world > 1) mesh: Mosaic
+    rejects (or aborts on) a ``collective_id`` when the kernel never
+    touches the barrier, and every kernel here guards its barrier/remote
+    ops with ``world > 1`` (``barrier_all`` self-guards).  One helper so
+    new kernels cannot forget the degenerate case.
+    """
+    return pltpu.CompilerParams(
+        has_side_effects=True,
+        collective_id=collective_id if world > 1 else None,
+        **kwargs,
+    )
