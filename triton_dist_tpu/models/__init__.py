@@ -34,3 +34,7 @@ from triton_dist_tpu.models.cp import (  # noqa: F401
     make_cp_train_step,
     place_cp_params,
 )
+from triton_dist_tpu.models.generate import (  # noqa: F401
+    GenerationState,
+    Generator,
+)

@@ -1,0 +1,195 @@
+"""Autoregressive generation over the sequence-parallel KV cache.
+
+The serving-side capability the reference's decode stack exists for, taken
+end-to-end: prefill writes per-layer K/V into sequence-sharded caches, and
+every decode step runs the SP flash-decode path — local split-KV partials
+on each rank's shard, low-latency allgather, LSE combine
+(layers/sp_flash_decode.py; reference sp_flash_decode_layer.py:43-184 has
+the attention module but no model or loop around it).
+
+Weights are replicated (the decode-serving layout: the sharded thing is
+the KV cache); works on any mesh axis, including world 1.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from triton_dist_tpu.layers.sp_flash_decode import SpGQAFlashDecodeAttention
+from triton_dist_tpu.models.llama import LlamaConfig, _rms_norm, _rope
+
+
+@dataclass
+class GenerationState:
+    """Per-layer sharded KV caches + global lengths."""
+
+    caches: list  # [(k_cache, v_cache)] per layer, [B, Hkv, S, D] sharded
+    kv_lens: jax.Array  # [B] int32 — tokens currently in the cache
+    last_logits: jax.Array  # [B, vocab] f32 — logits for the next token
+
+
+def _rope_at(x, pos, theta):
+    """RoPE for single-position vectors.  x [B, H, hd]; pos [B] int32."""
+    hd = x.shape[-1]
+    freqs = 1.0 / (theta ** (np.arange(0, hd, 2, dtype=np.float32) / hd))
+    angles = pos[:, None].astype(jnp.float32) * freqs[None, :]  # [B, hd/2]
+    cos = jnp.cos(angles)[:, None, :]
+    sin = jnp.sin(angles)[:, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+class Generator:
+    """Greedy autoregressive decoder for the Llama family.
+
+    Usage::
+
+        gen = Generator(cfg, mesh, axis="sp", max_seq=4096)
+        state = gen.prefill(params, prompt_tokens)       # [B, S0]
+        tokens, state = gen.generate(params, state, n_new=64)
+    """
+
+    def __init__(self, cfg: LlamaConfig, mesh: Mesh, *, axis: str = "sp",
+                 max_seq: int | None = None, impl: str = "auto",
+                 interpret: bool = False):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.axis = axis
+        self.max_seq = max_seq or cfg.max_seq
+        self.attn = SpGQAFlashDecodeAttention(
+            mesh, axis=axis, impl=impl, interpret=interpret,
+            check_bounds=False)  # Generator guards lengths itself (below)
+        self._prefill_jit = jax.jit(functools.partial(
+            _prompt_forward, cfg=cfg))
+        self._step_jit = jax.jit(self._step_impl)
+
+    # -- prefill ----------------------------------------------------------
+
+    def prefill(self, params, tokens) -> GenerationState:
+        """Run the prompt [B, S0], fill the caches, return the state."""
+        cfg = self.cfg
+        B, S0 = tokens.shape
+        if S0 > self.max_seq:
+            raise ValueError(f"prompt length {S0} > max_seq {self.max_seq}")
+        kvs, logits = self._prefill_jit(params, tokens)
+        lens = jnp.full((B,), S0, jnp.int32)
+        caches = []
+        for (k_new, v_new) in kvs:  # [B, Hkv, S0, hd] each
+            k_c, v_c = self.attn.init_cache(
+                B, cfg.n_kv_heads, self.max_seq, cfg.head_dim,
+                dtype=cfg.dtype)
+            k_c = jax.lax.dynamic_update_slice(k_c, k_new.astype(k_c.dtype),
+                                               (0, 0, 0, 0))
+            v_c = jax.lax.dynamic_update_slice(v_c, v_new.astype(v_c.dtype),
+                                               (0, 0, 0, 0))
+            sh = self.attn.cache_sharding()
+            caches.append((jax.device_put(k_c, sh), jax.device_put(v_c, sh)))
+        return GenerationState(caches=caches, kv_lens=lens,
+                               last_logits=logits[:, -1])
+
+    # -- decode -----------------------------------------------------------
+
+    def step(self, params, state: GenerationState, token) -> GenerationState:
+        """One decode step: token [B] int32 → next state.
+
+        Raises on cache overflow when lengths are concrete (a dropped
+        append would silently leave attention reading stale zero rows);
+        jit-traced callers must bound steps themselves (``generate`` does).
+        """
+        if not isinstance(state.kv_lens, jax.core.Tracer):
+            top = int(jnp.max(state.kv_lens))
+            if top >= self.max_seq:
+                raise ValueError(
+                    f"KV cache overflow: decode at position {top} but "
+                    f"max_seq={self.max_seq}")
+        new_caches, kv_lens, logits = self._step_jit(
+            params, state.caches, state.kv_lens, token)
+        return GenerationState(caches=new_caches, kv_lens=kv_lens,
+                               last_logits=logits)
+
+    def _step_impl(self, params, caches, kv_lens, token):
+        cfg = self.cfg
+        new_caches = []
+        x = params["embed"][token]  # [B, D]
+        for li, layer in enumerate(params["layers"]):
+            k_c, v_c = caches[li]
+            h = _rms_norm(x[:, None], layer["attn_norm"], cfg.norm_eps)[:, 0]
+            q = (h @ layer["wq"]).reshape(-1, cfg.n_heads, cfg.head_dim)
+            k = (h @ layer["wk"]).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+            v = (h @ layer["wv"]).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+            q = _rope_at(q, kv_lens, cfg.rope_theta)
+            k = _rope_at(k, kv_lens, cfg.rope_theta)
+            k_c, v_c = self.attn.append_kv(k_c, v_c, k, v, kv_lens)
+            o = self.attn(q, k_c, v_c, kv_lens + 1)  # [B, Hq, hd]
+            x = x + (o.reshape(o.shape[0], -1).astype(cfg.dtype)
+                     @ layer["wo"])
+            h = _rms_norm(x[:, None], layer["mlp_norm"], cfg.norm_eps)[:, 0]
+            act = (jax.nn.silu((h @ layer["wgate"]).astype(jnp.float32))
+                   .astype(cfg.dtype) * (h @ layer["wup"]))
+            x = x + act @ layer["wdown"]
+            new_caches.append((k_c, v_c))
+        x = _rms_norm(x[:, None], params["final_norm"], cfg.norm_eps)[:, 0]
+        logits = jnp.dot(x, params["lm_head"],
+                         preferred_element_type=jnp.float32)
+        return new_caches, kv_lens + 1, logits
+
+    def generate(self, params, state: GenerationState, n_new: int,
+                 sample=None):
+        """Greedy (or ``sample(logits) -> token``) generation of ``n_new``
+        tokens.  Returns (tokens [B, n_new], final state)."""
+        if not isinstance(state.kv_lens, jax.core.Tracer):
+            top = int(jnp.max(state.kv_lens))
+            if top + n_new > self.max_seq:
+                raise ValueError(
+                    f"generate({n_new}) from position {top} would overflow "
+                    f"max_seq={self.max_seq}")
+        outs = []
+        for _ in range(n_new):
+            token = (jnp.argmax(state.last_logits, axis=-1).astype(jnp.int32)
+                     if sample is None else sample(state.last_logits))
+            state = self.step(params, state, token)
+            outs.append(token)
+        return jnp.stack(outs, axis=1), state
+
+
+def _prompt_forward(params, tokens, *, cfg: LlamaConfig):
+    """Full-sequence forward on replicated weights that also returns the
+    per-layer K/V (post-RoPE, cache layout [B, Hkv, S, hd]) and logits."""
+    from triton_dist_tpu.kernels.attention import dense_gqa_attention
+
+    B, S = tokens.shape
+    hd = cfg.head_dim
+    x = params["embed"][tokens]          # [B, S, D]
+    positions = jnp.arange(S, dtype=jnp.int32)
+    kvs = []
+    for layer in params["layers"]:
+        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        h2 = h.reshape(B * S, cfg.dim)
+        q = (h2 @ layer["wq"]).reshape(B, S, cfg.n_heads, hd)
+        k = (h2 @ layer["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
+        v = (h2 @ layer["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+        # _rope expects [S, B, H, hd] (seq-major).
+        q = _rope(q.transpose(1, 0, 2, 3), positions, cfg.rope_theta)
+        k = _rope(k.transpose(1, 0, 2, 3), positions, cfg.rope_theta)
+        v = v.transpose(1, 0, 2, 3)
+        kvs.append((k.transpose(1, 2, 0, 3), v.transpose(1, 2, 0, 3)))
+        o = dense_gqa_attention(q, k, v, causal=True,
+                                scale=1.0 / np.sqrt(hd))
+        o = o.transpose(1, 0, 2, 3).reshape(B * S, cfg.n_heads * hd)
+        x = x + (o @ layer["wo"]).reshape(B, S, cfg.dim)
+        h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps).reshape(
+            B * S, cfg.dim)
+        act = (jax.nn.silu((h2 @ layer["wgate"]).astype(jnp.float32))
+               .astype(x.dtype) * (h2 @ layer["wup"]))
+        x = x + (act @ layer["wdown"]).reshape(B, S, cfg.dim)
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.dot(x, params["lm_head"],
+                     preferred_element_type=jnp.float32)
+    return kvs, logits
