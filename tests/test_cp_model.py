@@ -63,3 +63,21 @@ def test_cp_with_dp(key):
     params, l0 = step(params, tokens, targets)
     params, l1 = step(params, tokens, targets)
     assert np.isfinite(float(l1)) and float(l1) < float(l0)
+
+
+def test_cp_remat_matches_no_remat(mesh_cp, key):
+    """jax.checkpoint changes memory, not math: losses across two steps
+    (hence gradients too) must match the non-remat path."""
+    cfg = LlamaConfig.tiny()
+    tokens = jax.random.randint(jax.random.key(6), (64, 2), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=0)
+    losses = {}
+    for remat in (False, True):
+        params = CP.place_cp_params(init_params(cfg, key), cfg, mesh_cp)
+        step, _ = CP.make_cp_train_step(cfg, mesh_cp, attn="ring",
+                                        impl="xla", interpret=True,
+                                        lr=0.1, remat=remat)
+        params, l0 = step(params, tokens, targets)
+        _, l1 = step(params, tokens, targets)
+        losses[remat] = (float(l0), float(l1))
+    np.testing.assert_allclose(losses[False], losses[True], rtol=1e-4)

@@ -107,3 +107,20 @@ def test_stack_layer_params_roundtrip(key):
     assert stacked["wq"].shape == (cfg.n_layers,) + params["layers"][0]["wq"].shape
     np.testing.assert_array_equal(np.asarray(stacked["wo"][1]),
                                   np.asarray(params["layers"][1]["wo"]))
+
+
+def test_pp_remat_matches_no_remat(mesh_pp_tp, key):
+    cfg = L.LlamaConfig.tiny()
+    tokens = jax.random.randint(jax.random.key(7), (32, 4), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=0)
+    losses = {}
+    for remat in (False, True):
+        params = PP.place_pp_params(PP.init_pp_params(cfg, key), cfg,
+                                    mesh_pp_tp)
+        step, _ = PP.make_pp_train_step(cfg, mesh_pp_tp, n_micro=2,
+                                        impl="xla", interpret=True,
+                                        lr=0.1, remat=remat)
+        params, l0 = step(params, tokens, targets)
+        _, l1 = step(params, tokens, targets)
+        losses[remat] = (float(l0), float(l1))
+    np.testing.assert_allclose(losses[False], losses[True], rtol=1e-4)

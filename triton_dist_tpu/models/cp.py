@@ -65,26 +65,41 @@ def _cp_attention_block(x, layer, cfg: LlamaConfig, *, axis, attn, impl,
     return x + (o2 @ layer["wo"]).reshape(s_loc, b, cfg.dim)
 
 
+def _cp_layer(x, layer, cfg: LlamaConfig, *, axis, attn, impl, interpret):
+    """One decoder layer (SP attention + local MLP) on x [S_loc, B, D]."""
+    s_loc, b, _ = x.shape
+    x = _cp_attention_block(x, layer, cfg, axis=axis, attn=attn,
+                            impl=impl, interpret=interpret)
+    h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    h2 = h.reshape(s_loc * b, cfg.dim)
+    act = (jax.nn.silu((h2 @ layer["wgate"]).astype(jnp.float32))
+           .astype(x.dtype) * (h2 @ layer["wup"]))
+    return x + (act @ layer["wdown"]).reshape(s_loc, b, cfg.dim)
+
+
 def cp_forward_shard(params, tokens_shard, cfg: LlamaConfig, *, axis,
-                     attn="ring", impl="auto", interpret=False):
-    """tokens_shard [S_loc, B] (sequence sharded).  Local MLP, SP attention."""
-    s_loc, b = tokens_shard.shape
+                     attn="ring", impl="auto", interpret=False,
+                     remat=False):
+    """tokens_shard [S_loc, B] (sequence sharded).  Local MLP, SP attention.
+
+    ``remat=True`` wraps each layer in ``jax.checkpoint``: the backward
+    pass recomputes the layer (including its ring/Ulysses communication)
+    instead of stashing activations — the standard memory/FLOPs trade for
+    long-context training, where per-layer activations dominate HBM."""
+    layer_fn = functools.partial(_cp_layer, cfg=cfg, axis=axis, attn=attn,
+                                 impl=impl, interpret=interpret)
+    if remat:
+        layer_fn = jax.checkpoint(layer_fn)
     x = params["embed"][tokens_shard]
     for layer in params["layers"]:
-        x = _cp_attention_block(x, layer, cfg, axis=axis, attn=attn,
-                                impl=impl, interpret=interpret)
-        h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        h2 = h.reshape(s_loc * b, cfg.dim)
-        act = (jax.nn.silu((h2 @ layer["wgate"]).astype(jnp.float32))
-               .astype(x.dtype) * (h2 @ layer["wup"]))
-        x = x + (act @ layer["wdown"]).reshape(s_loc, b, cfg.dim)
+        x = layer_fn(x, layer)
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     return jnp.dot(x, params["lm_head"], preferred_element_type=jnp.float32)
 
 
 def make_cp_train_step(cfg: LlamaConfig, mesh: Mesh, *, axis="cp",
                        dp_axis=None, attn="ring", impl="auto",
-                       interpret=False, lr=1e-3):
+                       interpret=False, lr=1e-3, remat=False):
     """SGD step for the CP mode.  Gradients: every leaf is replicated, so
     psum over the cp axis (each shard saw only its sequence chunk) and dp."""
     specs = cp_param_specs(cfg)
@@ -93,7 +108,8 @@ def make_cp_train_step(cfg: LlamaConfig, mesh: Mesh, *, axis="cp",
 
     def loss_shard(params, tokens, targets):
         logits = cp_forward_shard(params, tokens, cfg, axis=axis, attn=attn,
-                                  impl=impl, interpret=interpret)
+                                  impl=impl, interpret=interpret,
+                                  remat=remat)
         logp = jax.nn.log_softmax(logits, axis=-1)
         ll = jnp.take_along_axis(
             logp, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]

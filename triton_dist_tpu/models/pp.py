@@ -73,7 +73,7 @@ def _block(layer, carry, cfg, *, tp_axis, impl, interpret):
 
 def make_pp_train_step(cfg, mesh: Mesh, *, tp_axis="tp", pp_axis="pp",
                        dp_axis=None, n_micro=4, impl="auto",
-                       interpret=False, lr=1e-3):
+                       interpret=False, lr=1e-3, remat=False):
     """SGD step over a (dp ×) pp × tp mesh with GPipe microbatching.
 
     Input tokens/targets: [S, B] (sequence sharded over tp, batch over dp);
@@ -97,6 +97,13 @@ def make_pp_train_step(cfg, mesh: Mesh, *, tp_axis="tp", pp_axis="pp",
         xs = (x, jnp.zeros((n_micro,), jnp.float32))
         block = functools.partial(_block, cfg=cfg, tp_axis=tp_axis,
                                   impl=impl, interpret=interpret)
+        if remat:
+            # Recompute each layer in the backward pipeline instead of
+            # stashing n_micro x n_layers activation sets.  prevent_cse is
+            # unnecessary under lax.scan (the schedule's scans already
+            # block the problematic CSE) and would pepper the hot loop
+            # with optimization barriers.
+            block = jax.checkpoint(block, prevent_cse=False)
         outs_x, outs_aux = pipeline_spmd(
             block, params["layers"], xs, axis=pp_axis, n_micro=n_micro)
 
