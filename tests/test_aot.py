@@ -65,7 +65,8 @@ def test_flash_decode_registered():
     regs = compile_aot.registered_kernels()
     assert "gqa_decode" in regs
     _, sp = regs["gqa_decode"]
-    assert len(sp["algo_infos"]) == 3
+    # XLA everywhere + 2 pallas variants only on a TPU export platform.
+    assert len(sp["algo_infos"]) in (1, 3)
 
 
 def test_flash_decode_export_and_reload(tmp_path):
@@ -116,4 +117,5 @@ def test_gqa_decode_exports_on_cpu(tmp_path):
 
     manifest = compile_aot.export_registered(str(tmp_path),
                                              kernels=["gqa_decode"])
-    assert len(manifest["kernels"]["gqa_decode"]) == 6  # 2 sigs x 3 algos
+    # CPU export platform: only the XLA algo (pallas variants are TPU-only).
+    assert len(manifest["kernels"]["gqa_decode"]) == 2  # 2 sigs x 1 algo

@@ -166,20 +166,27 @@ def _register_aot():
         [((b, hq, d), "float32"), ((b, hkv, s, d), "float32"),
          ((b, hkv, s, d), "float32"), ((b,), "int32")],
     ]
+    from triton_dist_tpu.runtime import topology
+
+    # "auto" now resolves to the XLA program everywhere (decode is
+    # bandwidth-bound, docs/perf.md), so the pallas split-KV variants must
+    # be named explicitly to stay in the AOT surface — and they can only
+    # be exported from a platform that can lower them (TPU; the CPU
+    # backend lowers pallas_call in interpret mode only).
+    algos = [{"impl": "xla"}]
+    if topology.is_tpu():
+        algos += [{"block_s": 1024, "impl": "pallas"},
+                  {"block_s": 512, "impl": "pallas"}]
     return aot_compile_spaces({
         "gqa_decode": {
             "signature": sig,
-            # "auto" resolves per export platform (pallas on TPU, XLA on
-            # CPU) so the registry exports anywhere, like matmul's entry.
-            "algo_infos": [{"block_s": 512, "impl": "auto"},
-                           {"block_s": 256, "impl": "auto"},
-                           {"impl": "xla"}],
+            "algo_infos": algos,
         },
     })
 
 
 @_register_aot()
-def gqa_decode_shard(q, k, v, local_lens, *, block_s=512, impl="auto",
+def gqa_decode_shard(q, k, v, local_lens, *, block_s=1024, impl="auto",
                      interpret=False):
     """Single-shard GQA decode: q [B, Hq, D], k/v [B, Hkv, S_loc, D],
     local_lens [B] (valid rows in this shard).  Returns float32 partials
@@ -187,13 +194,21 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=512, impl="auto",
 
     Reference analog: ``gqa_fwd_batch_decode_intra_rank``
     (flash_decode.py:763-860) minus the separate combine launch.
+
+    ``impl`` note: decode is HBM-bandwidth-bound (stream the KV cache
+    once), and on a real v5 chip XLA's fused attention streams it better
+    than the Pallas split-KV kernel (337 vs 365 µs at B=8, 1465 vs 1729 µs
+    at B=32; Hq=32 Hkv=8 S=8192 bf16, block_s swept — see docs/perf.md),
+    so ``auto`` resolves to the XLA path here, unlike the compute-bound
+    overlapped GEMM kernels.  ``impl="pallas"`` still selects the kernel
+    (the split-KV structure is the basis for comm-fused variants).
     """
     B, Hq, D = q.shape
     _, Hkv, S, _ = k.shape
     assert Hq % Hkv == 0, (Hq, Hkv)
     g = Hq // Hkv
     scale = 1.0 / math.sqrt(D)
-    impl = resolve_impl(impl, interpret)
+    impl = resolve_impl(impl, interpret, prefer_xla_on_hw=True)
 
     def shapes_ok():
         return D % 128 == 0 and S % 128 == 0
@@ -264,7 +279,7 @@ def combine_partials(outs, lses):
 # ---------------------------------------------------------------------------
 
 
-def sp_gqa_decode_shard(q, k_shard, v_shard, kv_lens, *, axis, block_s=512,
+def sp_gqa_decode_shard(q, k_shard, v_shard, kv_lens, *, axis, block_s=1024,
                         impl="auto", interpret=False):
     """Per-device SP decode: local split-KV partials -> one-shot LL gather of
     (out ⊕ lse) -> LSE combine.  ``kv_lens`` are GLOBAL lengths; the shard
@@ -303,7 +318,7 @@ class SpDecodeContext:
 
     mesh: Mesh
     axis: str = "sp"
-    block_s: int = 512
+    block_s: int = 1024
     impl: str = "auto"
     interpret: bool = False
 
@@ -312,7 +327,7 @@ class SpDecodeContext:
         return self.mesh.shape[self.axis]
 
 
-def create_sp_decode_context(mesh, axis="sp", block_s=512, impl="auto",
+def create_sp_decode_context(mesh, axis="sp", block_s=1024, impl="auto",
                              interpret=False) -> SpDecodeContext:
     return SpDecodeContext(mesh=mesh, axis=axis, block_s=block_s, impl=impl,
                            interpret=interpret)
