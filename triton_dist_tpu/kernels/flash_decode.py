@@ -166,17 +166,21 @@ def _register_aot():
         [((b, hq, d), "float32"), ((b, hkv, s, d), "float32"),
          ((b, hkv, s, d), "float32"), ((b,), "int32")],
     ]
-    from triton_dist_tpu.runtime import topology
-
     # "auto" now resolves to the XLA program everywhere (decode is
     # bandwidth-bound, docs/perf.md), so the pallas split-KV variants must
     # be named explicitly to stay in the AOT surface — and they can only
-    # be exported from a platform that can lower them (TPU; the CPU
-    # backend lowers pallas_call in interpret mode only).
-    algos = [{"impl": "xla"}]
-    if topology.is_tpu():
-        algos += [{"block_s": 1024, "impl": "pallas"},
-                  {"block_s": 512, "impl": "pallas"}]
+    # be exported for a platform that can lower them (TPU; the CPU
+    # backend lowers pallas_call in interpret mode only).  Resolved at
+    # export time from the target platforms: registration runs at import,
+    # which must never initialize the JAX backend (a ``jax.devices()``
+    # probe here would break a later ``jax.distributed.initialize``).
+    def algos(platforms):
+        out = [{"impl": "xla"}]
+        if "tpu" in platforms:
+            out += [{"block_s": 1024, "impl": "pallas"},
+                    {"block_s": 512, "impl": "pallas"}]
+        return out
+
     return aot_compile_spaces({
         "gqa_decode": {
             "signature": sig,

@@ -36,3 +36,6 @@ from triton_dist_tpu.runtime.topology import (  # noqa: F401
     peak_bf16_tflops,
 )
 from triton_dist_tpu.runtime.profiling import group_profile  # noqa: F401
+from triton_dist_tpu.runtime.checkpoint import (  # noqa: F401
+    CheckpointManager,
+)

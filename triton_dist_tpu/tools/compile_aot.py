@@ -54,12 +54,16 @@ def aot_compile_spaces(spaces: dict):
     ``spaces`` maps export name -> {"signature": [ [(shape, dtype), ...],
     ... ], "algo_infos": [ {kwarg: value, ...}, ... ]}.  Each signature is
     one input list; each algo info is a set of keyword overrides baked in
-    at trace time.
+    at trace time.  ``algo_infos`` may instead be a callable
+    ``platforms -> [algo, ...]`` resolved at export time, for kernels whose
+    variant set depends on the export target (registration must never
+    touch the backend — importing a kernels module has to stay free of
+    ``jax.devices()`` so it can precede ``jax.distributed.initialize``).
     """
     assert isinstance(spaces, dict)
     for name, sp in spaces.items():
         assert "signature" in sp and "algo_infos" in sp, sp
-        assert len(sp["algo_infos"]) > 0, name
+        assert callable(sp["algo_infos"]) or len(sp["algo_infos"]) > 0, name
 
     def decor(fn):
         fn.__aot_compile_spaces__ = spaces
@@ -101,6 +105,8 @@ def export_kernel(fn: Callable, name: str, out_dir: str,
     """
     os.makedirs(out_dir, exist_ok=True)
     platforms = list(platforms or _default_platforms())
+    if callable(algo_infos):
+        algo_infos = list(algo_infos(platforms))
     entries = []
     i = 0
     for sig in signature:
