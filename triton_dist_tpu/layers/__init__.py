@@ -18,3 +18,6 @@ from triton_dist_tpu.layers.sp_flash_decode import (  # noqa: F401
 )
 from triton_dist_tpu.layers.ep_a2a import EPAll2AllLayer  # noqa: F401
 from triton_dist_tpu.layers.allgather_layer import AllGatherLayer  # noqa: F401
+from triton_dist_tpu.layers.moe_inference import (  # noqa: F401
+    DistributedMoELayer,
+)
