@@ -1,0 +1,81 @@
+"""Sampling transforms (models/sampling.py) + Generator integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_dist_tpu.models.sampling import make_sampler, sample_logits
+
+
+def _logits(key, B=4, V=32):
+    return jax.random.normal(key, (B, V), jnp.float32) * 3.0
+
+
+def test_temperature_zero_is_greedy(key):
+    logits = _logits(key)
+    tok = sample_logits(logits, key, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(tok),
+                                  np.asarray(jnp.argmax(logits, -1)))
+
+
+def test_deterministic_given_key(key):
+    logits = _logits(key)
+    a = sample_logits(logits, key, temperature=0.8, top_k=8, top_p=0.9)
+    b = sample_logits(logits, key, temperature=0.8, top_k=8, top_p=0.9)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_top_k_support(key):
+    """Sampled tokens always lie in the top-k set."""
+    logits = _logits(key, B=2, V=64)
+    topk = set()
+    for b in range(2):
+        topk |= {(b, int(i)) for i in
+                 np.argsort(np.asarray(logits[b]))[-5:]}
+    for i in range(50):
+        tok = sample_logits(logits, jax.random.fold_in(key, i),
+                            temperature=1.5, top_k=5)
+        for b in range(2):
+            assert (b, int(tok[b])) in topk
+
+
+def test_top_p_keeps_top_token_even_when_tiny_p(key):
+    logits = _logits(key)
+    tok = sample_logits(logits, key, temperature=1.0, top_p=1e-6)
+    np.testing.assert_array_equal(np.asarray(tok),
+                                  np.asarray(jnp.argmax(logits, -1)))
+
+
+def test_top_p_mass_bound(key):
+    """With top_p=0.5, sampled tokens come from the smallest prefix whose
+    mass reaches 0.5."""
+    logits = _logits(key, B=1, V=16)
+    probs = np.asarray(jax.nn.softmax(logits, -1))[0]
+    order = np.argsort(-probs)
+    cum = np.cumsum(probs[order])
+    allowed = set(order[:int(np.searchsorted(cum, 0.5) + 1)].tolist())
+    for i in range(50):
+        tok = sample_logits(logits, jax.random.fold_in(key, i),
+                            temperature=1.0, top_p=0.5)
+        assert int(tok[0]) in allowed
+
+
+def test_generator_sampling_path(mesh2, key):
+    """End-to-end: stochastic generate() is reproducible under one key and
+    in-vocab."""
+    from triton_dist_tpu.models.generate import Generator
+    from triton_dist_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig(vocab=64, dim=32, n_layers=1, n_heads=4, n_kv_heads=2,
+                      ffn_dim=64, max_seq=32, dtype=jnp.float32)
+    params = init_params(cfg, key)
+    gen = Generator(cfg, mesh2, axis="tp", max_seq=32)
+    prompt = jax.random.randint(key, (2, 4), 0, cfg.vocab, jnp.int32)
+    sampler = make_sampler(temperature=0.7, top_k=16, top_p=0.95)
+    t1, _ = gen.generate(params, gen.prefill(params, prompt), 6,
+                         sample=sampler, key=key)
+    t2, _ = gen.generate(params, gen.prefill(params, prompt), 6,
+                         sample=sampler, key=key)
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+    assert t1.shape == (2, 6)
+    assert int(jnp.max(t1)) < cfg.vocab and int(jnp.min(t1)) >= 0

@@ -141,19 +141,37 @@ class Generator:
         return new_caches, kv_lens + 1, logits
 
     def generate(self, params, state: GenerationState, n_new: int,
-                 sample=None):
-        """Greedy (or ``sample(logits) -> token``) generation of ``n_new``
-        tokens.  Returns (tokens [B, n_new], final state)."""
+                 sample=None, key=None):
+        """Generate ``n_new`` tokens.  Returns (tokens [B, n_new], state).
+
+        Token choice per step:
+        - default: greedy argmax;
+        - ``key``: stochastic sampling — ``sample(logits, subkey)`` with a
+          fresh subkey per step (``sample`` defaults to
+          :func:`models.sampling.sample_logits`; pass
+          ``sampling.make_sampler(temperature=..., top_k=..., top_p=...)``
+          for the serving knobs);
+        - ``sample`` without ``key``: deterministic ``sample(logits)``.
+        """
         if not isinstance(state.kv_lens, jax.core.Tracer):
             top = int(jnp.max(state.kv_lens))
             if top + n_new > self.max_seq:
                 raise ValueError(
                     f"generate({n_new}) from position {top} would overflow "
                     f"max_seq={self.max_seq}")
+        if key is not None and sample is None:
+            from triton_dist_tpu.models.sampling import sample_logits
+            sample = sample_logits
         outs = []
         for _ in range(n_new):
-            token = (jnp.argmax(state.last_logits, axis=-1).astype(jnp.int32)
-                     if sample is None else sample(state.last_logits))
+            if key is not None:
+                key, sub = jax.random.split(key)
+                token = sample(state.last_logits, sub)
+            elif sample is not None:
+                token = sample(state.last_logits)
+            else:
+                token = jnp.argmax(state.last_logits, axis=-1).astype(
+                    jnp.int32)
             state = self.step(params, state, token)
             outs.append(token)
         return jnp.stack(outs, axis=1), state

@@ -1,0 +1,66 @@
+"""Token sampling for autoregressive serving: temperature, top-k, top-p.
+
+The reference stops at the decode-attention kernel (no sampling — its
+serving story ends at logits); a usable serving stack needs the sampler.
+All transforms are shape-static and jit-compatible (``lax.top_k`` + sorted
+cumulative mass for nucleus filtering — no data-dependent shapes), so one
+compiled sampler serves every step.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = jnp.float32(-1e30)
+
+
+def _apply_top_k(logits, top_k: int):
+    """Keep the k highest logits per row, mask the rest to -inf."""
+    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]  # [B, 1]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def _apply_top_p(logits, top_p: float):
+    """Nucleus filtering: keep the smallest prefix of the probability-sorted
+    vocab whose total mass reaches ``top_p`` (the top token always stays)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Row below which (exclusive prefix mass >= top_p) → cut.  Shifting by
+    # one keeps the first token crossing the threshold.
+    cut = cum - probs >= top_p
+    # Cutoff = smallest KEPT logit (mask cut rows to +inf before the min).
+    cutoff = jnp.where(cut, jnp.float32(jnp.inf), sorted_logits).min(
+        axis=-1, keepdims=True)
+    return jnp.where(logits < cutoff, NEG_INF, logits)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("temperature", "top_k", "top_p"))
+def sample_logits(logits, key, *, temperature: float = 1.0,
+                  top_k: int | None = None,
+                  top_p: float | None = None) -> jax.Array:
+    """logits [B, vocab] f32 → token [B] int32.
+
+    ``temperature=0`` is greedy argmax; filters compose as top-k then top-p
+    (the standard serving order).
+    """
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    x = logits.astype(jnp.float32) / temperature
+    if top_k is not None and top_k > 0 and top_k < x.shape[-1]:
+        x = _apply_top_k(x, top_k)
+    if top_p is not None and top_p < 1.0:
+        x = _apply_top_p(x, top_p)
+    return jax.random.categorical(key, x, axis=-1).astype(jnp.int32)
+
+
+def make_sampler(*, temperature: float = 1.0, top_k: int | None = None,
+                 top_p: float | None = None):
+    """``sample(logits, key) -> token`` with the knobs baked in (one
+    compiled executable reused across decode steps)."""
+    return functools.partial(sample_logits, temperature=temperature,
+                             top_k=top_k, top_p=top_p)
