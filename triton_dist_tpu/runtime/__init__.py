@@ -39,3 +39,9 @@ from triton_dist_tpu.runtime.profiling import group_profile  # noqa: F401
 from triton_dist_tpu.runtime.checkpoint import (  # noqa: F401
     CheckpointManager,
 )
+from triton_dist_tpu.runtime.watchdog import (  # noqa: F401
+    Heartbeat,
+    WatchdogTimeout,
+    block_until_ready_with_timeout,
+    run_with_watchdog,
+)

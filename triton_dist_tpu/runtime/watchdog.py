@@ -1,0 +1,134 @@
+"""Failure detection: stall watchdogs and heartbeats for collective code.
+
+The reference's only failure story is a 1800 s NCCL process-group timeout
+plus hard asserts (SURVEY.md §5; reference ``utils.py:103``) — a hung
+collective shows up as a silent 30-minute stall and an opaque NCCL abort.
+Distributed TPU programs hang the same way (a mismatched psum, a peer that
+never signals a semaphore, a dead host in the DCN ring), so the framework
+ships its own detection:
+
+- :func:`run_with_watchdog` — run a blocking thunk (typically
+  ``jax.block_until_ready`` on a collective's outputs) under a deadline;
+  on expiry dump every Python thread's stack to stderr and raise
+  :class:`WatchdogTimeout` (computation keeps running in its thread — XLA
+  dispatches cannot be cancelled — but the trainer regains control and can
+  checkpoint/abort cleanly instead of stalling forever).
+- :class:`Heartbeat` — a tiny mtime-based liveness file an external
+  supervisor (or another rank's host code) can poll to detect a stalled
+  process without any in-band communication.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import os
+import sys
+import threading
+import time
+from typing import Any, Callable
+
+import jax
+
+
+class WatchdogTimeout(TimeoutError):
+    """A watched computation exceeded its deadline."""
+
+
+def run_with_watchdog(fn: Callable[[], Any], timeout_s: float, *,
+                      name: str = "computation",
+                      dump_stacks: bool = True) -> Any:
+    """Run ``fn()`` and return its result, raising :class:`WatchdogTimeout`
+    if it does not finish within ``timeout_s`` seconds.
+
+    ``fn`` runs in a daemon thread; on timeout the thread is left running
+    (device work is not cancellable) but the caller regains control.  Any
+    exception ``fn`` raises is re-raised here.
+    """
+    result: list[Any] = []
+    error: list[BaseException] = []
+    done = threading.Event()
+
+    def body():
+        try:
+            result.append(fn())
+        except BaseException as e:  # noqa: BLE001 — re-raised in caller
+            error.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=body, name=f"watchdog:{name}", daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        if dump_stacks:
+            print(f"[watchdog] '{name}' exceeded {timeout_s}s; "
+                  f"thread stacks follow", file=sys.stderr, flush=True)
+            faulthandler.dump_traceback(file=sys.stderr)
+        raise WatchdogTimeout(
+            f"'{name}' did not complete within {timeout_s}s "
+            f"(process {jax.process_index()} of {jax.process_count()})")
+    if error:
+        raise error[0]
+    return result[0]
+
+
+def block_until_ready_with_timeout(tree: Any, timeout_s: float, *,
+                                   name: str = "collective") -> Any:
+    """``jax.block_until_ready`` under a deadline — the canonical guard for
+    'did every peer show up for this collective'."""
+    return run_with_watchdog(lambda: jax.block_until_ready(tree), timeout_s,
+                             name=name)
+
+
+class Heartbeat:
+    """Liveness file: touch ``path`` every ``interval_s`` from a daemon
+    thread; a supervisor treats ``now - mtime > k * interval_s`` as a stall.
+
+    Use as a context manager around a training loop::
+
+        with Heartbeat(f"/tmp/hb.{jax.process_index()}"):
+            for step in ...: ...
+    """
+
+    def __init__(self, path: str | os.PathLike, interval_s: float = 10.0):
+        self.path = os.fspath(path)
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def beat(self) -> None:
+        """One explicit beat (also called automatically by the thread)."""
+        with open(self.path, "w") as f:
+            f.write(f"{time.time()}\n")
+
+    @staticmethod
+    def age_s(path: str | os.PathLike) -> float | None:
+        """Seconds since the last beat at ``path``; None if never beaten."""
+        try:
+            return time.time() - os.stat(path).st_mtime
+        except FileNotFoundError:
+            return None
+
+    @staticmethod
+    def is_stalled(path: str | os.PathLike, interval_s: float,
+                   tolerance: float = 3.0) -> bool:
+        age = Heartbeat.age_s(path)
+        return age is None or age > tolerance * interval_s
+
+    def __enter__(self) -> "Heartbeat":
+        self.beat()
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                self.beat()
+
+        self._thread = threading.Thread(target=loop, name="heartbeat",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s + 1.0)
+            self._thread = None
