@@ -7,10 +7,11 @@ reference's LLaMA-3.1-70B FFN shard shape (test_ag_gemm.py --shape_id):
 M=8192, K=8192, N=28672/8=3584 per chip, bfloat16.
 
 Hardware note: the bench chip is a single TPU (v5 lite via the axon
-tunnel), so the pallas AG-GEMM runs its world-1 degenerate path — the full
-overlapped kernel machinery (ring loop, semaphores, nested MXU pipeline)
-with no wire traffic.  Multi-chip behavior is validated separately on the
-virtual CPU mesh (tests/) and by `__graft_entry__.dryrun_multichip`.
+tunnel), so `ag_gemm_shard` under auto dispatch takes its world-1 fast
+path (no gather exists at world 1; the ring-kernel machinery itself is
+compiled+run on hardware by scripts/smoke_tpu.py and measured in
+docs/perf.md).  Multi-chip behavior is validated on the virtual CPU mesh
+(tests/) and by `__graft_entry__.dryrun_multichip`.
 
 vs_baseline: the reference's README charts claim AG-GEMM parity with
 hand-tuned libraries (FLUX/cuBLAS) on H800, i.e. ~65% of the H800's 989
@@ -45,7 +46,7 @@ REF_UTILIZATION = 0.65  # reference AG-GEMM ~= hand-tuned library on H800
 def _make_chain(mesh, n_iters):
     """n_iters of (AG-GEMM -> matmul-back) with data dependencies, returning
     a scalar so fetching it forces execution."""
-    shard_ag = functools.partial(ag_gemm_shard, axis="tp", impl="pallas",
+    shard_ag = functools.partial(ag_gemm_shard, axis="tp", impl="auto",
                                  interpret=False)
 
     def body_fn(a, b1, b2):

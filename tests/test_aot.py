@@ -32,7 +32,7 @@ def test_manifest_structure(exported):
     assert os.path.exists(os.path.join(out, compile_aot.MANIFEST_NAME))
     assert os.path.exists(os.path.join(out, compile_aot.COMPILE_OPTIONS_NAME))
     entries = manifest["kernels"]["matmul"]
-    assert len(entries) == 6  # 2 signatures x 3 algo infos
+    assert len(entries) == 8  # 2 signatures x 4 algo infos
     for e in entries:
         assert os.path.exists(os.path.join(out, e["jaxexport"]))
         assert os.path.exists(os.path.join(out, e["stablehlo"]))

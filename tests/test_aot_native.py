@@ -41,7 +41,7 @@ def test_selftest_against_exported_manifest(built, tmp_path):
         [os.path.join(built, "tdt_aot_run"), "--selftest", str(tmp_path)],
         capture_output=True, text=True)
     assert out.returncode == 0, out.stderr
-    assert "selftest ok: 1 kernels, 6 variants" in out.stdout
+    assert "selftest ok: 1 kernels, 8 variants" in out.stdout
 
 
 def test_selftest_rejects_missing_artifact(built, tmp_path):

@@ -26,11 +26,14 @@ from jax.experimental.pallas import tpu as pltpu
 @dataclass(frozen=True)
 class MatmulConfig:
     # Swept on a real v5 chip at the bench shape (M=8192 K=8192 N=3584
-    # bf16): (1024, 1024, 512) gives 86% MXU utilization vs 76% for 512³,
-    # and is the VMEM ceiling — (1024,1024,1024)/(2048,...) fail to
+    # bf16): (2048, 512, 512) with parallel/arbitrary dimension semantics
+    # reaches ~190 TFLOPS (96% of nominal peak, equal to XLA's dot), vs
+    # ~167 for (1024, 1024, 512) and ~146-155 for 512-row blocks.  Taller
+    # M blocks win: fewer accumulator revisits per output column strip.
+    # (2048, 1024, 512) and (4096, 512, 512) exceed VMEM and fail to
     # compile.  Small shapes clamp via for_shape.
-    block_m: int = 1024
-    block_n: int = 1024
+    block_m: int = 2048
+    block_n: int = 512
     block_k: int = 512
 
     def for_shape(self, m: int, n: int, k: int) -> "MatmulConfig":
@@ -198,6 +201,11 @@ def matmul(
             bytes_accessed=(m * k + k * n) * a.dtype.itemsize + m * n * jnp.dtype(out_dtype).itemsize,
             transcendentals=0,
         ),
+        # m/n blocks write disjoint outputs; only k is a sequential
+        # accumulation.  Telling Mosaic so is worth ~5% at the bench shape
+        # (189.6 vs 180.8 TFLOPS, real-chip sweep).
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(a, b)
 
@@ -218,7 +226,8 @@ def _register_gemm_aot():
                 [((1024, 1024), "float32"), ((1024, 512), "float32")],
             ],
             "algo_infos": [
-                {"bm": 1024, "bn": 1024, "bk": 512},  # real-chip sweep winner
+                {"bm": 2048, "bn": 512, "bk": 512},  # real-chip sweep winner
+                {"bm": 1024, "bn": 1024, "bk": 512},
                 {"bm": 512, "bn": 512, "bk": 512},
                 {"bm": 256, "bn": 512, "bk": 512},
             ],
@@ -245,7 +254,8 @@ def _make_matmul_autotuned():
 
     configs = [
         Config(bm=bm, bn=bn, bk=bk)
-        for bm in (256, 512, 1024) for bn in (512, 1024) for bk in (512, 1024)
+        for bm in (256, 512, 1024, 2048)
+        for bn in (512, 1024) for bk in (512, 1024)
     ]
 
     def dedupe_clamped(cfgs, args, kwargs):

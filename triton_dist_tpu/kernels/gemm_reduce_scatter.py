@@ -51,6 +51,7 @@ from triton_dist_tpu.kernels.gemm import (
     MatmulConfig,
     gemm_pipeline_body,
     largest_divisor_block,
+    matmul,
     pallas_shapes_ok,
     resolve_impl,
 )
@@ -197,6 +198,7 @@ def gemm_rs_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
     Block sizes default to the swept MatmulConfig (gemm.py)."""
     _cfg = MatmulConfig()
     bm, bn, bk = bm or _cfg.block_m, bn or _cfg.block_n, bk or _cfg.block_k
+    raw_impl = impl
     impl = resolve_impl(impl, interpret)
     world = jax.lax.axis_size(axis)
     M, k_loc = a_shard.shape
@@ -210,6 +212,12 @@ def gemm_rs_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
         return jax.lax.psum_scatter(
             partial, axis, scatter_dimension=0, tiled=True
         ).astype(out_dtype)
+
+    if world == 1 and raw_impl == "auto" and not interpret:
+        # Degenerate world under auto dispatch: no scatter, no partial
+        # rotation — the plain MXU matmul (see ag_gemm_shard's twin path).
+        return matmul(a_shard, b_shard, config=MatmulConfig(bm, bn, bk),
+                      out_dtype=out_dtype)
 
     bm = largest_divisor_block(m_loc, bm, 8)
     bn = largest_divisor_block(N, bn, 128)
