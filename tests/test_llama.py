@@ -110,3 +110,25 @@ def test_grads_match_single_device_reference(mesh2, cfg):
     flat1, _ = jax.tree.flatten(new_params1)
     for a, b in zip(flat, flat1):
         assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
+
+def test_config_presets_match_reference_shapes():
+    """Presets mirror the reference's --shape_id table
+    (test_ag_gemm.py:149-154): K = dim, N = ffn_dim."""
+    from triton_dist_tpu.models.llama import LlamaConfig
+    from triton_dist_tpu.models.moe import MoEConfig
+
+    table = {
+        "llama3_8b": (4096, 14336),
+        "llama3_70b": (8192, 28672),
+        "llama3_405b": (16384, 53248),
+        "mistral_7b": (4096, 14336),
+        "qwen2_72b": (8192, 29568),
+    }
+    for name, (k, n) in table.items():
+        cfg = getattr(LlamaConfig, name)()
+        assert (cfg.dim, cfg.ffn_dim) == (k, n), name
+        assert cfg.dim % cfg.n_heads == 0 and cfg.n_heads % cfg.n_kv_heads == 0
+
+    ds = MoEConfig.deepseek_moe()
+    assert (ds.dim, ds.n_experts, ds.topk) == (7168, 128, 8)

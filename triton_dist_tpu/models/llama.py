@@ -60,6 +60,32 @@ class LlamaConfig:
         return LlamaConfig(vocab=128256, dim=8192, n_layers=80, n_heads=64,
                            n_kv_heads=8, ffn_dim=28672, dtype=jnp.bfloat16)
 
+    # Presets mirroring the rest of the reference's --shape_id table
+    # (test_ag_gemm.py:149-154): K = dim, N = ffn_dim.
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig(vocab=128256, dim=4096, n_layers=32, n_heads=32,
+                           n_kv_heads=8, ffn_dim=14336, dtype=jnp.bfloat16)
+
+    @staticmethod
+    def llama3_405b() -> "LlamaConfig":
+        return LlamaConfig(vocab=128256, dim=16384, n_layers=126,
+                           n_heads=128, n_kv_heads=8, ffn_dim=53248,
+                           dtype=jnp.bfloat16)
+
+    @staticmethod
+    def mistral_7b() -> "LlamaConfig":
+        return LlamaConfig(vocab=32000, dim=4096, n_layers=32, n_heads=32,
+                           n_kv_heads=8, ffn_dim=14336, rope_theta=1e6,
+                           dtype=jnp.bfloat16)
+
+    @staticmethod
+    def qwen2_72b() -> "LlamaConfig":
+        return LlamaConfig(vocab=152064, dim=8192, n_layers=80, n_heads=64,
+                           n_kv_heads=8, ffn_dim=29568, rope_theta=1e6,
+                           dtype=jnp.bfloat16)
+
     @staticmethod
     def tiny(dtype=jnp.float32) -> "LlamaConfig":
         """CPU-mesh test size; every dim still tiles the MXU legally."""

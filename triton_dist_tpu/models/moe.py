@@ -92,6 +92,15 @@ class MoEConfig:
                          expert_ffn_dim=14336, dtype=jnp.bfloat16)
 
     @staticmethod
+    def deepseek_moe() -> "MoEConfig":
+        """The reference's low-latency AllToAll benchmark config
+        (README.md:87 / test_all_to_all.py: 128 experts, topk 8,
+        hidden 7168 — the DeepSeek-V3 serving point)."""
+        return MoEConfig(vocab=129280, dim=7168, n_layers=61, n_heads=128,
+                         n_kv_heads=128, n_experts=128, topk=8,
+                         expert_ffn_dim=2048, dtype=jnp.bfloat16)
+
+    @staticmethod
     def tiny(dtype=jnp.float32) -> "MoEConfig":
         """CPU-mesh test size (block_m small enough for tiny token counts)."""
         return MoEConfig(vocab=256, dim=128, n_layers=2, n_heads=8,
