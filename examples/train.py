@@ -129,6 +129,7 @@ def main():
 
     def loop():
         nonlocal params
+        saved = start - 1
         for step in range(start, args.steps):
             t0 = time.perf_counter()
             params, loss = step_fn(params, tokens, targets)
@@ -139,8 +140,9 @@ def main():
                        f"{dt * 1e3:7.1f} ms")
             if mgr is not None and (step + 1) % args.ckpt_every == 0:
                 mgr.save(step, params)
+                saved = step
                 dist_print(f"checkpointed step {step}")
-        if mgr is not None:
+        if mgr is not None and saved < args.steps - 1:
             mgr.save(args.steps - 1, params)
 
     import contextlib

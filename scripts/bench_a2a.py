@@ -12,6 +12,7 @@ iteration consumes the previous recv buffer), (t_long - t_short) / extra.
 """
 
 import functools
+import os
 import sys
 import time
 
@@ -20,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from triton_dist_tpu.kernels.all_to_all import fast_all_to_all_shard  # noqa: E402
 
@@ -28,7 +29,7 @@ TOKENS, HIDDEN = 128, 7168
 N_EXTRA = 4096
 
 
-def make_chain(mesh, n, dtype):
+def make_chain(mesh, n):
     shard = functools.partial(fast_all_to_all_shard, axis="ep",
                               impl="pallas", interpret=False)
 
@@ -55,8 +56,7 @@ def main():
     for dtype, hidden, name in cases:
         send = jnp.zeros((1, TOKENS, hidden), dtype)
         splits = jnp.full((1,), TOKENS, jnp.int32)
-        c1, cn = make_chain(mesh, 1, dtype), make_chain(mesh, 1 + N_EXTRA,
-                                                        dtype)
+        c1, cn = make_chain(mesh, 1), make_chain(mesh, 1 + N_EXTRA)
         float(c1(send, splits)); float(cn(send, splits))
         diffs = []
         for _ in range(9):

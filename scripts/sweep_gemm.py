@@ -10,6 +10,7 @@ default env — see .claude/skills/verify/SKILL.md for the axon gotchas).
 """
 
 import functools
+import os
 import sys
 import time
 
@@ -17,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from triton_dist_tpu.kernels.gemm import MatmulConfig, matmul  # noqa: E402
 

@@ -79,3 +79,14 @@ def test_generator_sampling_path(mesh2, key):
     np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
     assert t1.shape == (2, 6)
     assert int(jnp.max(t1)) < cfg.vocab and int(jnp.min(t1)) >= 0
+
+
+def test_top_p_zero_is_greedy(key):
+    """top_p=0.0 keeps exactly the top token (regression: it used to cut
+    the whole vocab and degenerate to always-token-0)."""
+    logits = _logits(key)
+    for i in range(10):
+        tok = sample_logits(logits, jax.random.fold_in(key, i),
+                            temperature=1.0, top_p=0.0)
+        np.testing.assert_array_equal(np.asarray(tok),
+                                      np.asarray(jnp.argmax(logits, -1)))

@@ -32,6 +32,11 @@ def _apply_top_p(logits, top_p: float):
     # Row below which (exclusive prefix mass >= top_p) → cut.  Shifting by
     # one keeps the first token crossing the threshold.
     cut = cum - probs >= top_p
+    # The top token is unconditionally kept (guards top_p <= p(top) —
+    # including top_p=0.0, which would otherwise cut the whole vocab and
+    # degenerate categorical() to always-token-0).
+    idx = jax.lax.broadcasted_iota(jnp.int32, cut.shape, cut.ndim - 1)
+    cut = cut & (idx > 0)
     # Cutoff = smallest KEPT logit (mask cut rows to +inf before the min).
     cutoff = jnp.where(cut, jnp.float32(jnp.inf), sorted_logits).min(
         axis=-1, keepdims=True)
