@@ -38,3 +38,11 @@ from triton_dist_tpu.models.generate import (  # noqa: F401
     GenerationState,
     Generator,
 )
+from triton_dist_tpu.models.generate_moe import (  # noqa: F401
+    MoEGenerator,
+    place_params_serving,
+)
+from triton_dist_tpu.models.sampling import (  # noqa: F401
+    make_sampler,
+    sample_logits,
+)

@@ -1,0 +1,198 @@
+"""Autoregressive generation for the MoE family (EP decode serving).
+
+Extends :class:`models.generate.Generator` to Mixtral/DeepSeek-class MoE
+models: attention decodes over the sequence-parallel KV cache exactly as the
+dense family does (layers/sp_flash_decode.py), while the FFN runs
+**expert-parallel** — expert stacks stay sharded over the mesh axis and each
+device computes only its own experts' contribution for the decode batch,
+followed by one psum.  This is the standard small-batch EP decode layout:
+at B tokens/step the AllToAll's token shuffle has nothing to amortize, so
+replicate-activations + shard-experts + psum is both simpler and faster
+(the large-batch dispatch path remains `layers/moe_inference.py`).
+
+The reference has no MoE generation story at all (its EP machinery stops at
+the kernel tests); this module is where the framework's serving stack and
+MoE stack meet.
+
+Serving placement (`place_params_serving`): expert stacks P(axis, None,
+None), everything else replicated — the decode analog of the training
+layout in ``models/moe.param_specs``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.kernels.attention import dense_gqa_attention
+from triton_dist_tpu.kernels.moe_utils import topk_routing
+from triton_dist_tpu.models.generate import Generator, _rope_at
+from triton_dist_tpu.models.llama import _rms_norm, _rope
+from triton_dist_tpu.models.moe import MoEConfig
+from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
+
+import numpy as np
+
+
+def place_params_serving(params, cfg: MoEConfig, mesh: Mesh,
+                         axis: str = "sp") -> dict:
+    """EP-shard the expert stacks; replicate everything else (the decode
+    layout: the sharded things are the KV cache and the experts)."""
+
+    def spec_of(path_key):
+        return (P(axis, None, None)
+                if path_key in ("w_gate", "w_up", "w_down") else P())
+
+    def place(tree):
+        out = {}
+        for k, v in tree.items():
+            if k == "layers":
+                out[k] = [place(layer) for layer in v]
+            else:
+                out[k] = jax.device_put(
+                    v, NamedSharding(mesh, spec_of(k)))
+        return out
+
+    return place(params)
+
+
+def moe_ffn_decode_shard(h, router, w_gate, w_up, w_down, *, axis,
+                         n_experts, topk):
+    """One decode step's expert FFN, per device (inside shard_map).
+
+    h [B, D] replicated; router [D, E] replicated; w_* are this device's
+    expert slabs [epr, D, F] / [epr, F, D].  Each device accumulates the
+    weighted SwiGLU of its own experts for every token, then a psum sums
+    the topk contributions across owners.  Returns [B, D] replicated.
+    """
+    world = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    epr = n_experts // world
+
+    logits = jnp.dot(h.astype(jnp.float32), router)
+    weights, experts = topk_routing(logits, topk)  # [B, topk]
+
+    y = jnp.zeros_like(h, shape=h.shape, dtype=jnp.float32)
+    for e_loc in range(epr):
+        e_glob = me * epr + e_loc
+        w_tok = jnp.sum(
+            jnp.where(experts == e_glob, weights, 0.0), axis=-1)  # [B]
+        g = jnp.dot(h, w_gate[e_loc], preferred_element_type=jnp.float32)
+        u = jnp.dot(h, w_up[e_loc], preferred_element_type=jnp.float32)
+        act = (jax.nn.silu(g) * u).astype(h.dtype)
+        y += w_tok[:, None] * jnp.dot(act, w_down[e_loc],
+                                      preferred_element_type=jnp.float32)
+    return jax.lax.psum(y, axis).astype(h.dtype)
+
+
+def _moe_prompt_ffn(h2, layer, cfg: MoEConfig):
+    """Prompt-phase routed FFN as a dense one-hot sum over ALL experts.
+
+    Correctness-first: E sequential expert passes over the whole prompt
+    (XLA gathers each EP-sharded slab).  Prefill happens once per request;
+    the dispatch-based path (models/moe.moe_ffn_shard) is the throughput
+    alternative when prompts are long enough to shard.
+    """
+    logits = jnp.dot(h2.astype(jnp.float32), layer["router"])
+    weights, experts = topk_routing(logits, cfg.topk)
+    y = jnp.zeros(h2.shape, jnp.float32)
+    for e in range(cfg.n_experts):
+        w_tok = jnp.sum(jnp.where(experts == e, weights, 0.0), axis=-1)
+        g = jnp.dot(h2, layer["w_gate"][e],
+                    preferred_element_type=jnp.float32)
+        u = jnp.dot(h2, layer["w_up"][e],
+                    preferred_element_type=jnp.float32)
+        act = (jax.nn.silu(g) * u).astype(h2.dtype)
+        y += w_tok[:, None] * jnp.dot(act, layer["w_down"][e],
+                                      preferred_element_type=jnp.float32)
+    return y.astype(h2.dtype)
+
+
+def _moe_prompt_forward(params, tokens, *, cfg: MoEConfig):
+    """Full-prompt forward returning per-layer (K, V) caches + logits
+    (the MoE twin of generate._prompt_forward)."""
+    B, S = tokens.shape
+    hd = cfg.head_dim
+    x = params["embed"][tokens]  # [B, S, D]
+    positions = jnp.arange(S, dtype=jnp.int32)
+    kvs = []
+    for layer in params["layers"]:
+        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        h2 = h.reshape(B * S, cfg.dim)
+        q = (h2 @ layer["wq"]).reshape(B, S, cfg.n_heads, hd)
+        k = (h2 @ layer["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
+        v = (h2 @ layer["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+        q = _rope(q.transpose(1, 0, 2, 3), positions, cfg.rope_theta)
+        k = _rope(k.transpose(1, 0, 2, 3), positions, cfg.rope_theta)
+        v = v.transpose(1, 0, 2, 3)
+        kvs.append((k.transpose(1, 2, 0, 3), v.transpose(1, 2, 0, 3)))
+        o = dense_gqa_attention(q, k, v, causal=True,
+                                scale=1.0 / np.sqrt(hd))
+        o = o.transpose(1, 0, 2, 3).reshape(B * S, cfg.n_heads * hd)
+        x = x + (o @ layer["wo"]).reshape(B, S, cfg.dim)
+        h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps).reshape(
+            B * S, cfg.dim)
+        x = x + _moe_prompt_ffn(h2, layer, cfg).reshape(B, S, cfg.dim)
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.dot(x, params["lm_head"],
+                     preferred_element_type=jnp.float32)
+    return kvs, logits
+
+
+class MoEGenerator(Generator):
+    """Greedy/stochastic decoder for the MoE family.
+
+    Same API as :class:`Generator` (prefill / step / generate, sampling via
+    ``key=``); params come from ``models.moe.init_params`` placed with
+    :func:`place_params_serving` on the same mesh axis the KV cache shards
+    over.
+    """
+
+    def __init__(self, cfg: MoEConfig, mesh: Mesh, *, axis: str = "sp",
+                 max_seq: int | None = None, impl: str = "auto",
+                 interpret: bool = False):
+        super().__init__(cfg, mesh, axis=axis, max_seq=max_seq, impl=impl,
+                         interpret=interpret)
+        self._prefill_jit = jax.jit(functools.partial(
+            _moe_prompt_forward, cfg=cfg))
+
+    def _ffn(self, x, layer):
+        """Decode-step FFN: EP masked-expert compute + psum."""
+        cfg: MoEConfig = self.cfg
+        fn = cached_shard_jit(
+            moe_ffn_decode_shard,
+            self.mesh,
+            (P(), P(), P(self.axis, None, None), P(self.axis, None, None),
+             P(self.axis, None, None)),
+            P(),
+            axis=self.axis, n_experts=cfg.n_experts, topk=cfg.topk,
+        )
+        return fn(x, layer["router"], layer["w_gate"], layer["w_up"],
+                  layer["w_down"])
+
+    def _step_impl(self, params, caches, kv_lens, token):
+        cfg = self.cfg
+        new_caches = []
+        x = params["embed"][token]  # [B, D]
+        for li, layer in enumerate(params["layers"]):
+            k_c, v_c = caches[li]
+            h = _rms_norm(x[:, None], layer["attn_norm"], cfg.norm_eps)[:, 0]
+            q = (h @ layer["wq"]).reshape(-1, cfg.n_heads, cfg.head_dim)
+            k = (h @ layer["wk"]).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+            v = (h @ layer["wv"]).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+            q = _rope_at(q, kv_lens, cfg.rope_theta)
+            k = _rope_at(k, kv_lens, cfg.rope_theta)
+            k_c, v_c = self.attn.append_kv(k_c, v_c, k, v, kv_lens)
+            o = self.attn(q, k_c, v_c, kv_lens + 1)  # [B, Hq, hd]
+            x = x + (o.reshape(o.shape[0], -1).astype(cfg.dtype)
+                     @ layer["wo"])
+            h = _rms_norm(x[:, None], layer["mlp_norm"], cfg.norm_eps)[:, 0]
+            x = x + self._ffn(h, layer)
+            new_caches.append((k_c, v_c))
+        x = _rms_norm(x[:, None], params["final_norm"], cfg.norm_eps)[:, 0]
+        logits = jnp.dot(x, params["lm_head"],
+                         preferred_element_type=jnp.float32)
+        return new_caches, kv_lens + 1, logits
