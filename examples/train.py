@@ -45,6 +45,9 @@ def parse_args():
     p.add_argument("--batch", type=int, default=4, help="global batch")
     p.add_argument("--seq", type=int, default=64, help="sequence length")
     p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--opt", choices=("sgd", "adamw"), default="sgd",
+                   help="sgd = the families' fused step; adamw = optax "
+                        "(models/training.py), opt state checkpointed too")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--ckpt-every", type=int, default=10)
@@ -75,12 +78,30 @@ def build(args, mesh, axis, dp_axis):
                             n_experts=2 * tp, topk=2, expert_ffn_dim=64,
                             max_seq=max(args.seq, 64), block_m=8,
                             dtype=jnp.float32)
-    step_fn, specs = fam.make_train_step(cfg, mesh, axis=axis,
-                                         dp_axis=dp_axis, impl=args.impl,
-                                         lr=args.lr)
     params = fam.place_params(
         fam.init_params(cfg, jax.random.key(args.seed)), cfg, mesh)
-    return cfg, params, step_fn, specs
+    if args.opt == "adamw":
+        import optax
+
+        from triton_dist_tpu.models import training
+        opt_step, opt_init = training.make_optax_train_step(
+            fam, cfg, mesh, optax.adamw(args.lr), axis=axis,
+            dp_axis=dp_axis, impl=args.impl)
+        state = {"params": params, "opt": opt_init(params)}
+
+        def step_fn(st, tokens, targets):
+            p, o, loss = opt_step(st["params"], st["opt"], tokens, targets)
+            return {"params": p, "opt": o}, loss
+    else:
+        sgd_step, _specs = fam.make_train_step(cfg, mesh, axis=axis,
+                                               dp_axis=dp_axis,
+                                               impl=args.impl, lr=args.lr)
+        state = {"params": params}
+
+        def step_fn(st, tokens, targets):
+            p, loss = sgd_step(st["params"], tokens, targets)
+            return {"params": p}, loss
+    return cfg, state, step_fn
 
 
 def main():
@@ -103,7 +124,7 @@ def main():
     axis = "tp"
     dist_print(f"mesh {dict(mesh.shape)}  model={args.model}")
 
-    cfg, params, step_fn, _specs = build(args, mesh, axis, dp_axis)
+    cfg, state, step_fn = build(args, mesh, axis, dp_axis)
 
     # Deterministic toy data: next-token prediction on a fixed random book.
     key = jax.random.key(args.seed + 1)
@@ -118,9 +139,9 @@ def main():
     mgr = None
     if args.ckpt_dir:
         mgr = CheckpointManager(args.ckpt_dir, max_to_keep=args.keep)
-        resumed = mgr.restore_latest(like=params)
+        resumed = mgr.restore_latest(like=state)
         if resumed is not None:
-            start, params = resumed[0] + 1, resumed[1]
+            start, state = resumed[0] + 1, resumed[1]
             dist_print(f"resumed from step {resumed[0]}")
 
     hb_path = args.heartbeat or (
@@ -128,22 +149,22 @@ def main():
         if args.ckpt_dir else None)
 
     def loop():
-        nonlocal params
+        nonlocal state
         saved = start - 1
         for step in range(start, args.steps):
             t0 = time.perf_counter()
-            params, loss = step_fn(params, tokens, targets)
+            state, loss = step_fn(state, tokens, targets)
             loss = block_until_ready_with_timeout(
                 loss, args.step_timeout, name=f"train step {step}")
             dt = time.perf_counter() - t0
             dist_print(f"step {step:4d}  loss {float(loss):.4f}  "
                        f"{dt * 1e3:7.1f} ms")
             if mgr is not None and (step + 1) % args.ckpt_every == 0:
-                mgr.save(step, params)
+                mgr.save(step, state)
                 saved = step
                 dist_print(f"checkpointed step {step}")
         if mgr is not None and saved < args.steps - 1:
-            mgr.save(args.steps - 1, params)
+            mgr.save(args.steps - 1, state)
 
     import contextlib
 

@@ -1,0 +1,95 @@
+"""Optax train steps over the overlapped kernels (models/training.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.models import llama, moe, training
+from triton_dist_tpu.runtime import checkpoint as ck
+from triton_dist_tpu.runtime.utils import bitwise_equal
+
+
+def _llama_cfg():
+    return llama.LlamaConfig(vocab=64, dim=32, n_layers=1, n_heads=4,
+                             n_kv_heads=4, ffn_dim=64, max_seq=32,
+                             dtype=jnp.float32)
+
+
+def _data(cfg, mesh, key, S=16, B=2):
+    tok = jax.device_put(
+        jax.random.randint(key, (S, B), 0, cfg.vocab, jnp.int32),
+        NamedSharding(mesh, P("tp")))
+    return tok, jnp.roll(tok, -1, axis=0)
+
+
+def test_adamw_llama_loss_decreases(mesh4, key):
+    cfg = _llama_cfg()
+    tx = optax.adamw(1e-2)
+    step, init = training.make_optax_train_step(llama, cfg, mesh4, tx)
+    params = llama.place_params(llama.init_params(cfg, key), cfg, mesh4)
+    opt_state = init(params)
+    tok, tgt = _data(cfg, mesh4, key)
+    losses = []
+    for _ in range(8):
+        params, opt_state, loss = step(params, opt_state, tok, tgt)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_opt_state_sharding_mirrors_params(mesh4, key):
+    """Adam moments inherit the parameter shardings via propagation."""
+    cfg = _llama_cfg()
+    step, init = training.make_optax_train_step(llama, cfg, mesh4,
+                                                optax.adam(1e-3))
+    params = llama.place_params(llama.init_params(cfg, key), cfg, mesh4)
+    opt_state = init(params)
+    mu = opt_state[0].mu
+    p_leaf = params["layers"][0]["wq"]          # tp-sharded
+    m_leaf = mu["layers"][0]["wq"]
+    assert m_leaf.sharding == p_leaf.sharding, (m_leaf.sharding,
+                                                p_leaf.sharding)
+
+
+def test_adamw_moe_step_runs(mesh4, key):
+    cfg = moe.MoEConfig(vocab=64, dim=32, n_layers=1, n_heads=4,
+                        n_kv_heads=4, n_experts=4, topk=2,
+                        expert_ffn_dim=32, max_seq=32, block_m=8,
+                        dtype=jnp.float32)
+    step, init = training.make_optax_train_step(moe, cfg, mesh4,
+                                                optax.adamw(1e-3))
+    params = moe.place_params(moe.init_params(cfg, key), cfg, mesh4)
+    opt_state = init(params)
+    tok, tgt = _data(cfg, mesh4, key)
+    l0 = None
+    for _ in range(4):
+        params, opt_state, loss = step(params, opt_state, tok, tgt)
+        l0 = l0 if l0 is not None else float(loss)
+    assert np.isfinite(float(loss)) and float(loss) < l0 + 1e-3
+
+
+def test_optax_state_checkpoints(mesh4, key, tmp_path):
+    """{params, opt_state, step} round-trips; resume is bit-exact."""
+    cfg = _llama_cfg()
+    step, init = training.make_optax_train_step(llama, cfg, mesh4,
+                                                optax.adamw(1e-2))
+    params = llama.place_params(llama.init_params(cfg, key), cfg, mesh4)
+    opt_state = init(params)
+    tok, tgt = _data(cfg, mesh4, key)
+
+    p_ref, s_ref = params, opt_state
+    for _ in range(3):
+        p_ref, s_ref, _ = step(p_ref, s_ref, tok, tgt)
+
+    p, s = params, opt_state
+    for _ in range(2):
+        p, s, _ = step(p, s, tok, tgt)
+    state = {"params": p, "opt": s, "step": jnp.int32(1)}
+    ck.save(tmp_path / "c", state)
+    restored = ck.restore(tmp_path / "c", like=state)
+    p2, s2, _ = step(restored["params"], restored["opt"], tok, tgt)
+    ok = jax.tree.leaves(jax.tree.map(bitwise_equal, p2, p_ref))
+    assert all(ok)
+    ok = jax.tree.leaves(jax.tree.map(bitwise_equal, s2, s_ref))
+    assert all(ok)

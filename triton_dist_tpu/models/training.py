@@ -1,0 +1,124 @@
+"""Optimizer integration: optax train steps over the overlapped kernels.
+
+The family modules (models/llama.py, models/moe.py) ship a plain-SGD
+``make_train_step`` that fuses loss, backward, gradient psums, and the
+update into one shard_map program.  Real training wants a stateful
+optimizer (AdamW etc.); this module composes any optax ``GradientTransform``
+with the families' gradient programs:
+
+- ``make_grads`` — the shard_map program: loss + backward through the
+  overlapped kernels' custom VJPs + the per-leaf gradient psums (the same
+  reduction rules as the SGD steps: tp-sharded leaves are complete per
+  shard, replicated leaves psum over tp, everything psums over dp).
+- ``make_optax_train_step`` — wraps ``make_grads`` with ``tx.update`` under
+  plain jit: the update is elementwise, so XLA propagates the parameter
+  shardings onto the optimizer state (mu/nu mirror the param layout; no
+  hand-written opt-state PartitionSpecs needed).
+
+Optimizer state is a pytree of sharded jax.Arrays like params, so
+``runtime.checkpoint`` saves/restores {params, opt_state, step} together.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _reduce_grads(grads, specs, axis, dp_axis):
+    """The families' shared gradient-reduction rule (llama.py:301-315)."""
+
+    def _reduce(g, spec):
+        sharded_on_tp = any(s == axis for s in spec)
+        axes = () if sharded_on_tp else (axis,)
+        if dp_axis is not None:
+            axes = axes + (dp_axis,)
+        return jax.lax.psum(g, axes) if axes else g
+
+    return jax.tree.map(_reduce, grads, specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def make_grads(family, cfg, mesh: Mesh, *, axis="tp", dp_axis=None,
+               impl="auto", interpret=False) -> tuple[Callable, Any]:
+    """(grads_fn, specs): ``grads_fn(params, tokens, targets) -> (loss,
+    grads)`` jitted over the mesh.  ``family`` is models.llama or
+    models.moe (anything with ``loss_shard`` + ``param_specs``)."""
+    specs = family.param_specs(cfg)
+    batch_spec = P(axis, dp_axis) if dp_axis else P(axis)
+
+    def grads_shard(params, tokens, targets):
+        local_loss, grads = jax.value_and_grad(family.loss_shard)(
+            params, tokens, targets, cfg, axis=axis, dp_axis=dp_axis,
+            impl=impl, interpret=interpret)
+        all_axes = (axis,) if dp_axis is None else (axis, dp_axis)
+        loss = jax.lax.psum(local_loss, all_axes)
+        return loss, _reduce_grads(grads, specs, axis, dp_axis)
+
+    fn = jax.shard_map(
+        grads_shard, mesh=mesh,
+        in_specs=(specs, batch_spec, batch_spec),
+        out_specs=(P(), specs),
+        check_vma=False)
+    return jax.jit(fn), specs
+
+
+def make_optax_train_step(family, cfg, mesh: Mesh, tx, *, axis="tp",
+                          dp_axis=None, impl="auto", interpret=False):
+    """(step, init): optax training over the overlapped kernels.
+
+    ``init(params) -> opt_state`` (sharding follows params);
+    ``step(params, opt_state, tokens, targets) -> (params, opt_state,
+    loss)``.  ``tx`` is any ``optax.GradientTransformation``.
+    """
+    grads_fn, _specs = make_grads(family, cfg, mesh, axis=axis,
+                                  dp_axis=dp_axis, impl=impl,
+                                  interpret=interpret)
+
+    @jax.jit
+    def step(params, opt_state, tokens, targets):
+        loss, grads = grads_fn(params, tokens, targets)
+        # Cast grads to param dtype for the update (families keep bf16
+        # params; optax moments accumulate in the same dtype as given).
+        grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return jax.tree.map(lambda p, u: p + u.astype(p.dtype),
+                            params, updates), opt_state, loss
+
+    def init(params):
+        return shard_opt_state_like(tx.init(params), params)
+
+    return step, init
+
+
+def shard_opt_state_like(opt_state, params):
+    """Place optimizer-state leaves in the matching parameters' shardings.
+
+    ``tx.init`` builds moments with ``zeros_like``, which carries shape and
+    dtype but no *value* dependence on the parameter — so jit's sharding
+    propagation gives the zeros default (single-device) placement.  Optax
+    states embed params-shaped subtrees at params-shaped keypaths (e.g.
+    ``[0].mu['layers'][0]['wq']`` for param ``['layers'][0]['wq']``), so
+    each state leaf takes the sharding of the param whose keypath is a
+    suffix of its own; scalars and unmatched leaves replicate on the same
+    devices.
+    """
+    p_leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    by_path = {tuple(str(k) for k in path): (leaf.sharding, leaf.shape)
+               for path, leaf in p_leaves if isinstance(leaf, jax.Array)}
+    some_sharding = next(iter(by_path.values()))[0]
+    replicated = jax.sharding.NamedSharding(some_sharding.mesh, P())
+
+    def place(path, leaf):
+        keys = tuple(str(k) for k in path)
+        for start in range(len(keys)):
+            hit = by_path.get(keys[start:])
+            if hit is not None and hit[1] == jnp.shape(leaf):
+                return jax.device_put(leaf, hit[0])
+        return jax.device_put(leaf, replicated)
+
+    return jax.tree_util.tree_map_with_path(place, opt_state)
