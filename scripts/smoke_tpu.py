@@ -52,6 +52,20 @@ def main():
     b = jax.random.normal(key, (2048, 1024), jnp.bfloat16)
     check("matmul", lambda: matmul(a, b))
 
+    # 1b. int8 MXU matmul (double-rate path) — exactness vs numpy
+    from triton_dist_tpu.kernels.quant import Int8MatmulConfig, matmul_i8
+    rng = np.random.default_rng(0)
+    ai = jnp.asarray(rng.integers(-127, 128, (512, 512), dtype=np.int8))
+    bi = jnp.asarray(rng.integers(-127, 128, (512, 256), dtype=np.int8))
+
+    def _i8():
+        out = matmul_i8(ai, bi, config=Int8MatmulConfig(256, 256, 256))
+        assert np.array_equal(np.asarray(out),
+                              np.asarray(ai, np.int32) @ np.asarray(bi, np.int32))
+        return out
+
+    check("matmul_i8", _i8)
+
     # 2. grouped GEMM (scalar-prefetch grid)
     from triton_dist_tpu.kernels.group_gemm import group_gemm
     xs = jax.random.normal(key, (1024, 512), jnp.bfloat16)

@@ -16,6 +16,13 @@ Every collective op here accepts ``impl="auto"|"xla"|"pallas"``:
 """
 
 from triton_dist_tpu.kernels.gemm import matmul, matmul_kernel_tflops  # noqa: F401
+from triton_dist_tpu.kernels.quant import (  # noqa: F401
+    Int8MatmulConfig,
+    matmul_i8,
+    quantize_channelwise,
+    quantize_rowwise,
+    w8a8_linear,
+)
 from triton_dist_tpu.kernels.allgather import (  # noqa: F401
     all_gather,
     create_allgather_context,

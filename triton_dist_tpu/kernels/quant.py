@@ -1,0 +1,144 @@
+"""Quantized GEMM: int8 MXU matmul + W8A8 linear with per-channel scales.
+
+Reference analog: the reference threads fp8/s8 dtypes through its kernel
+library (``_make_tensor`` fp8/int8 factories utils.py:134-166, fp8 MoE
+AllToAll payloads low_latency_all_to_all.py:76-88, s8 GEMM test dtypes).
+On TPU the quantized story centers on the MXU's double-rate int8 path:
+v5e peaks at ~394 int8 TOPS vs 197 bf16 TFLOPS.
+
+Measured (real v5 chip, M=8192 K=8192 N=3584): 358 TOPS at block
+(1024, 512, 1024) — 91% of nominal int8 peak and 1.9x the bf16 kernel's
+190 TFLOPS.  int8 halves both HBM traffic and VMEM block bytes, which is
+why the winning int8 block doubles ``bk`` relative to bf16's
+(2048, 512, 512); larger blocks fail to compile (VMEM ceiling).
+
+W8A8 scheme (the standard serving recipe):
+- weights: static symmetric per-output-channel int8 (``quantize_channelwise``);
+- activations: dynamic symmetric per-row int8 (``quantize_rowwise``);
+- GEMM accumulates exact int32 on the MXU, dequant is one rank-1 f32
+  rescale fused into the epilogue by XLA.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.kernels.gemm import resolve_impl
+
+
+@dataclass(frozen=True)
+class Int8MatmulConfig:
+    # Real-chip sweep winners (module docstring).  int8 halves block
+    # bytes, so bk doubles vs the bf16 config at the same VMEM budget.
+    block_m: int = 1024
+    block_n: int = 512
+    block_k: int = 1024
+
+    def for_shape(self, m: int, n: int, k: int) -> "Int8MatmulConfig":
+        rnd = lambda x, a: (x + a - 1) // a * a
+        return Int8MatmulConfig(
+            block_m=min(self.block_m, max(rnd(m, 32), 32)),
+            block_n=min(self.block_n, max(rnd(n, 128), 128)),
+            block_k=min(self.block_k, max(rnd(k, 128), 128)),
+        )
+
+
+def _matmul_i8_kernel(a_ref, b_ref, out_ref, acc_ref, *, n_k: int):
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(a_ref[:], b_ref[:],
+                          preferred_element_type=jnp.int32)
+
+    @pl.when(k == n_k - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("config", "impl", "interpret"))
+def matmul_i8(a: jax.Array, b: jax.Array,
+              config: Int8MatmulConfig | None = None,
+              impl: str = "auto", interpret: bool = False) -> jax.Array:
+    """C[m, n] int32 = A[m, k] int8 @ B[k, n] int8, exact.
+
+    Shapes must tile the MXU (m%32, n%128, k%128 == 0) for the pallas
+    path; anything else (or ``impl="xla"``) uses lax.dot with int32
+    accumulation — bit-identical, just not the double-rate kernel.
+    """
+    assert a.dtype == jnp.int8 and b.dtype == jnp.int8, (a.dtype, b.dtype)
+    m, k = a.shape
+    k2, n = b.shape
+    assert k == k2, (a.shape, b.shape)
+    impl = resolve_impl(impl, interpret)
+    cfg = (config or Int8MatmulConfig()).for_shape(m, n, k)
+    bm, bn, bk = cfg.block_m, cfg.block_n, cfg.block_k
+    ok = m % bm == 0 and n % bn == 0 and k % bk == 0 and m % 32 == 0
+
+    if impl == "xla" or not ok:
+        return jax.lax.dot_general(
+            a, b, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+
+    n_k = k // bk
+    return pl.pallas_call(
+        functools.partial(_matmul_i8_kernel, n_k=n_k),
+        grid=(m // bm, n // bn, n_k),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.int32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(a, b)
+
+
+def quantize_rowwise(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Dynamic symmetric per-row int8: x ≈ q * scale[:, None].
+    x [m, k] float → (q [m, k] int8, scale [m] f32)."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[:, None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def quantize_channelwise(w: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Static symmetric per-output-channel int8: w ≈ q * scale[None, :].
+    w [k, n] float → (q [k, n] int8, scale [n] f32)."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale[None, :]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def w8a8_linear(x: jax.Array, w_q: jax.Array, w_scale: jax.Array, *,
+                out_dtype=None, config: Int8MatmulConfig | None = None,
+                impl: str = "auto", interpret: bool = False) -> jax.Array:
+    """y = x @ dequant(w): dynamic per-row activation quant → int8 MXU
+    GEMM (exact int32) → rank-1 f32 dequant.
+
+    x [m, k] bf16/f32; w_q [k, n] int8 with per-channel ``w_scale`` [n]
+    (from :func:`quantize_channelwise`).
+    """
+    out_dtype = out_dtype or x.dtype
+    x_q, x_scale = quantize_rowwise(x)
+    acc = matmul_i8(x_q, w_q, config=config, impl=impl, interpret=interpret)
+    y = acc.astype(jnp.float32) * x_scale[:, None] * w_scale[None, :]
+    return y.astype(out_dtype)
