@@ -78,3 +78,27 @@ def test_ag_gemm_rerandomized_iterations(mesh4, key):
         a, b = _make_inputs(mesh4, jax.random.fold_in(key, i), 64, 128, 256,
                             jnp.float32)
         assert_allclose(ag_gemm(a, b, ctx), jnp.dot(a, b), atol=1e-5, rtol=1e-5)
+
+
+def test_ag_gemm_int8_exact(mesh4, key):
+    """int8 AG-GEMM: overlapped kernel == all_gather + exact int32 dot."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from triton_dist_tpu.kernels.allgather_gemm import (
+        create_ag_gemm_context, ag_gemm_gathered)
+
+    world, M, K, N = 4, 64, 128, 256
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.integers(-127, 128, (M, K), dtype=np.int8))
+    b = jnp.asarray(rng.integers(-127, 128, (K, N), dtype=np.int8))
+    a = jax.device_put(a, NamedSharding(mesh4, P("tp", None)))
+    b = jax.device_put(b, NamedSharding(mesh4, P(None, "tp")))
+
+    ctx = create_ag_gemm_context(mesh4, axis="tp", impl="pallas",
+                                 interpret=True)
+    a_full, c = ag_gemm_gathered(a, b, ctx)
+    assert c.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(a_full), np.asarray(a))
+    ref = np.asarray(a, np.int32) @ np.asarray(b, np.int32)
+    np.testing.assert_array_equal(np.asarray(c), ref)

@@ -159,11 +159,17 @@ def ag_gemm_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
     world = jax.lax.axis_size(axis)
     m_loc, K = a_shard.shape
     n_loc = b_shard.shape[1]
-    out_dtype = a_shard.dtype
+    # int8 inputs take the MXU double-rate path: exact i32 accumulation
+    # and output (the W8A8 caller dequants outside; see kernels/quant.py).
+    quantized = a_shard.dtype == jnp.int8
+    out_dtype = jnp.int32 if quantized else a_shard.dtype
+    acc_dtype = jnp.int32 if quantized else jnp.float32
 
     if impl == "xla" or not pallas_shapes_ok(m_loc, n_loc, K):
         a_full = jax.lax.all_gather(a_shard, axis, axis=0, tiled=True)
-        return a_full, jnp.dot(a_full, b_shard, preferred_element_type=jnp.float32).astype(out_dtype)
+        pref = jnp.int32 if quantized else jnp.float32
+        return a_full, jnp.dot(
+            a_full, b_shard, preferred_element_type=pref).astype(out_dtype)
 
     if world == 1 and raw_impl == "auto" and not interpret:
         # Degenerate world under auto dispatch: there is nothing to gather,
@@ -171,6 +177,9 @@ def ag_gemm_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
         # write of A) is worth ~7% at the bench shape (182 → 190 TFLOPS).
         # Explicit impl="pallas" still runs the ring kernel (what the
         # hardware smoke exercises); interpret mode keeps it too.
+        if quantized:
+            from triton_dist_tpu.kernels.quant import matmul_i8
+            return a_shard, matmul_i8(a_shard, b_shard)
         c = matmul(a_shard, b_shard, config=MatmulConfig(bm, bn, bk),
                    out_dtype=out_dtype)
         return a_shard, c
@@ -196,7 +205,7 @@ def ag_gemm_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
-            pltpu.VMEM((bm, bn), jnp.float32),
+            pltpu.VMEM((bm, bn), acc_dtype),
         ],
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True,

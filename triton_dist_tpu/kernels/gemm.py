@@ -97,14 +97,17 @@ def resolve_impl(impl: str, interpret: bool,
 
 def gemm_pipeline_body(a_blk, b_blk, out_blk, acc_ref, *, n_k, out_dtype):
     """Shared emit_pipeline body for nested MXU matmuls inside overlapped
-    kernels: one (bm, bn, bk) tile with f32 accumulation over the k grid."""
+    kernels: one (bm, bn, bk) tile accumulated over the k grid.  The
+    accumulator dtype follows the scratch ref: f32 for float inputs, exact
+    i32 for int8 inputs (the MXU double-rate path)."""
     k = pl.program_id(2)
 
     @pl.when(k == 0)
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    acc_ref[:] += jnp.dot(a_blk[:], b_blk[:], preferred_element_type=jnp.float32)
+    acc_ref[:] += jnp.dot(a_blk[:], b_blk[:],
+                          preferred_element_type=acc_ref.dtype)
 
     @pl.when(k == n_k - 1)
     def _():
