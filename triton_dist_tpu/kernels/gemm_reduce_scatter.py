@@ -205,10 +205,15 @@ def gemm_rs_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
     N = b_shard.shape[1]
     assert M % world == 0, (M, world)
     m_loc = M // world
-    out_dtype = a_shard.dtype
+    # int8: exact i32 partials; the ring adds stay exact (i32 + i32), so
+    # the reduced output is bit-equal to an unquantized int accumulation.
+    quantized = a_shard.dtype == jnp.int8
+    out_dtype = jnp.int32 if quantized else a_shard.dtype
+    acc_dtype = jnp.int32 if quantized else jnp.float32
 
     if impl == "xla" or not pallas_shapes_ok(m_loc, N, k_loc):
-        partial = jnp.dot(a_shard, b_shard, preferred_element_type=jnp.float32)
+        pref = jnp.int32 if quantized else jnp.float32
+        partial = jnp.dot(a_shard, b_shard, preferred_element_type=pref)
         return jax.lax.psum_scatter(
             partial, axis, scatter_dimension=0, tiled=True
         ).astype(out_dtype)
@@ -216,6 +221,9 @@ def gemm_rs_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
     if world == 1 and raw_impl == "auto" and not interpret:
         # Degenerate world under auto dispatch: no scatter, no partial
         # rotation — the plain MXU matmul (see ag_gemm_shard's twin path).
+        if quantized:
+            from triton_dist_tpu.kernels.quant import matmul_i8
+            return matmul_i8(a_shard, b_shard)
         return matmul(a_shard, b_shard, config=MatmulConfig(bm, bn, bk),
                       out_dtype=out_dtype)
 
@@ -240,7 +248,7 @@ def gemm_rs_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR,
-            pltpu.VMEM((bm, bn), jnp.float32),
+            pltpu.VMEM((bm, bn), acc_dtype),
         ],
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True,
