@@ -11,7 +11,9 @@ kernels; training-capable layers are where the TPU build goes further).
 
 from triton_dist_tpu.layers.tp_linear import (  # noqa: F401
     column_parallel_linear,
+    column_parallel_linear_w8a8,
     row_parallel_linear,
+    row_parallel_linear_w8a8,
 )
 from triton_dist_tpu.layers.sp_flash_decode import (  # noqa: F401
     SpGQAFlashDecodeAttention,

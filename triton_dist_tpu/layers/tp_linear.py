@@ -105,3 +105,49 @@ def _row_bwd(axis, impl, interpret, res, dc):
 
 
 row_parallel_linear.defvjp(_row_fwd, _row_bwd)
+
+
+# ---------------------------------------------------------------------------
+# W8A8 serving variants (no VJP — inference path; see kernels/quant.py)
+# ---------------------------------------------------------------------------
+
+
+def column_parallel_linear_w8a8(a_shard, w_q, w_scale, axis, impl="auto",
+                                interpret=False):
+    """W8A8 column-parallel forward: int8 rides the overlapped AG-GEMM.
+
+    a_shard [m_loc, K] float; w_q [K, n_loc] int8 with per-channel
+    ``w_scale`` [n_loc].  Activations quantize per local row *before* the
+    gather, their scales allgather alongside (a tiny [m_loc] f32 vector),
+    and the ring kernel moves int8 — half the wire bytes of the bf16 path
+    on top of the double-rate MXU.  Returns [M, n_loc] in a_shard.dtype.
+    """
+    from triton_dist_tpu.kernels.quant import quantize_rowwise
+
+    a_q, a_scale = quantize_rowwise(a_shard)
+    _, acc = ag_gemm_shard(a_q, w_q, axis=axis, impl=impl,
+                           interpret=interpret)  # [M, n_loc] i32, exact
+    a_scale_full = jax.lax.all_gather(a_scale, axis, axis=0, tiled=True)
+    y = acc.astype(jnp.float32) * a_scale_full[:, None] * w_scale[None, :]
+    return y.astype(a_shard.dtype)
+
+
+def row_parallel_linear_w8a8(a_shard, w_q, w_scale, axis, impl="auto",
+                             interpret=False):
+    """W8A8 row-parallel forward: local int8 GEMM + f32 reduce-scatter.
+
+    a_shard [M, k_loc] float; w_q [k_loc, N] int8 quantized per output
+    channel *per rank* (each rank's weight chunk has its own ``w_scale``
+    [N]).  Unlike the AG side, the cross-rank reduction must sum
+    *dequantized* partials (each rank's int32 partial carries different
+    scales), so the exact int8 GEMM runs locally and the psum_scatter
+    moves f32.  Returns [m_loc, N] in a_shard.dtype.
+    """
+    from triton_dist_tpu.kernels.quant import matmul_i8, quantize_rowwise
+
+    a_q, a_scale = quantize_rowwise(a_shard)
+    acc = matmul_i8(a_q, w_q, impl=impl, interpret=interpret)  # [M, N] i32
+    partial = acc.astype(jnp.float32) * a_scale[:, None] * w_scale[None, :]
+    out = jax.lax.psum_scatter(partial, axis, scatter_dimension=0,
+                               tiled=True)
+    return out.astype(a_shard.dtype)
