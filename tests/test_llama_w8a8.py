@@ -1,0 +1,58 @@
+"""W8A8 quantized Llama serving forward (models/llama_w8a8.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_dist_tpu.models import llama
+from triton_dist_tpu.models.llama_w8a8 import (
+    make_w8a8_forward,
+    place_w8a8_params,
+    quantize_params_w8a8,
+)
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_w8a8_forward_close_to_float(impl, mesh4, key):
+    cfg = llama.LlamaConfig(vocab=128, dim=64, n_layers=2, n_heads=4,
+                            n_kv_heads=4, ffn_dim=128, max_seq=64,
+                            dtype=jnp.float32)
+    host = llama.init_params(cfg, key)
+    S, B = 16, 2
+    tokens = jax.device_put(
+        jax.random.randint(key, (S, B), 0, cfg.vocab, jnp.int32),
+        NamedSharding(mesh4, P("tp")))
+
+    ref_fwd = llama.make_forward(cfg, mesh4)
+    ref = np.asarray(ref_fwd(llama.place_params(host, cfg, mesh4), tokens))
+
+    qparams = place_w8a8_params(quantize_params_w8a8(host, cfg, world=4),
+                                cfg, mesh4)
+    fwd = make_w8a8_forward(cfg, mesh4, impl=impl,
+                            interpret=(impl == "pallas"))
+    out = np.asarray(fwd(qparams, tokens))
+
+    assert out.shape == ref.shape
+    # Quantization noise accumulates over layers; demand high logit
+    # agreement rather than elementwise tightness.
+    cos = (out * ref).sum() / (np.linalg.norm(out) * np.linalg.norm(ref))
+    assert cos > 0.999, cos
+    # Greedy decisions almost always agree on this scale of model.
+    agree = (out.argmax(-1) == ref.argmax(-1)).mean()
+    assert agree > 0.9, agree
+
+
+def test_quantize_params_structure(mesh4, key):
+    cfg = llama.LlamaConfig(vocab=64, dim=32, n_layers=1, n_heads=4,
+                            n_kv_heads=2, ffn_dim=64, max_seq=32,
+                            dtype=jnp.float32)
+    q = quantize_params_w8a8(llama.init_params(cfg, key), cfg, world=4)
+    layer = q["layers"][0]
+    hd = cfg.head_dim
+    assert layer["wqkv_q"].dtype == jnp.int8
+    assert layer["wqkv_q"].shape == (
+        cfg.dim, (cfg.n_heads + 2 * cfg.n_kv_heads) * hd)
+    assert layer["wo_s"].shape == (4, cfg.dim)
+    assert layer["wdown_s"].shape == (4, cfg.dim)

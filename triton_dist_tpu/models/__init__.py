@@ -46,3 +46,8 @@ from triton_dist_tpu.models.sampling import (  # noqa: F401
     make_sampler,
     sample_logits,
 )
+from triton_dist_tpu.models.llama_w8a8 import (  # noqa: F401
+    make_w8a8_forward,
+    place_w8a8_params,
+    quantize_params_w8a8,
+)

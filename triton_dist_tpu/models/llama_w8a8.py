@@ -1,0 +1,183 @@
+"""W8A8 quantized serving forward for the dense Llama family.
+
+Weight-only prep (`quantize_params_w8a8`, host-side, once per checkpoint)
+plus a serving forward (`make_w8a8_forward`) where every projection runs
+through the W8A8 TP linears (layers/tp_linear.py):
+
+- column-parallel (fused QKV, gate, up): activations quantize per row
+  before the sequence gather, so the overlapped AG-GEMM ring moves int8 —
+  half the wire bytes AND the MXU double-rate path;
+- row-parallel (attn-out, down): exact local int8 GEMM, dequantized f32
+  reduce-scatter (cross-rank sums need dequantized partials).
+
+Norms, RoPE, attention, embed and lm_head stay in the float dtype — the
+standard W8A8 recipe quantizes the GEMMs, not the pointwise math.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.kernels.quant import quantize_channelwise
+from triton_dist_tpu.layers.tp_linear import (
+    column_parallel_linear_w8a8,
+    row_parallel_linear_w8a8,
+)
+from triton_dist_tpu.models.llama import (
+    LlamaConfig,
+    _attention,
+    _rms_norm,
+    _rope,
+)
+
+
+def _quant_col(w):
+    """Column-parallel weight: one global per-output-channel quant."""
+    q, s = quantize_channelwise(jnp.asarray(w))
+    return q, s
+
+
+def _quant_row(w, world):
+    """Row-parallel weight: quantize each rank's k-chunk independently
+    (each chunk gets its own [N] channel scales, stacked [world, N])."""
+    w = jnp.asarray(w)
+    k = w.shape[0]
+    assert k % world == 0, (k, world)
+    k_loc = k // world
+    qs = [quantize_channelwise(w[i * k_loc:(i + 1) * k_loc])
+          for i in range(world)]
+    return (jnp.concatenate([q for q, _ in qs], axis=0),
+            jnp.stack([s for _, s in qs], axis=0))
+
+
+def _fuse_qkv_by_rank(wq, wk, wv, world):
+    """Fuse Q/K/V so a P(None, axis) column shard gives each rank its own
+    [wq_chunk | wk_chunk | wv_chunk] block (the per-shard concatenation the
+    float path does inside shard_map, done once on the host).  A naive
+    global concat would hand rank 0 nothing but Q columns."""
+    hq = wq.shape[1] // world
+    hk = wk.shape[1] // world
+    cols = []
+    for r in range(world):
+        cols += [wq[:, r * hq:(r + 1) * hq],
+                 wk[:, r * hk:(r + 1) * hk],
+                 wv[:, r * hk:(r + 1) * hk]]
+    return jnp.concatenate(cols, axis=1)
+
+
+def quantize_params_w8a8(params, cfg: LlamaConfig, world: int) -> dict:
+    """Float param tree → W8A8 serving tree (host-side, once).
+
+    Layer keys: ``wqkv_q/wqkv_s`` (fused column weight in per-rank block
+    order), ``wgate_q/s``, ``wup_q/s``, ``wo_q/s``, ``wdown_q/s`` (row
+    weights with [world, N] stacked scales), float norms; top level keeps
+    embed/lm_head/final_norm.
+    """
+    out = {"embed": params["embed"], "lm_head": params["lm_head"],
+           "final_norm": params["final_norm"], "layers": []}
+    for layer in params["layers"]:
+        wqkv = _fuse_qkv_by_rank(layer["wq"], layer["wk"], layer["wv"],
+                                 world)
+        qkv_q, qkv_s = _quant_col(wqkv)
+        gate_q, gate_s = _quant_col(layer["wgate"])
+        up_q, up_s = _quant_col(layer["wup"])
+        wo_q, wo_s = _quant_row(layer["wo"], world)
+        down_q, down_s = _quant_row(layer["wdown"], world)
+        out["layers"].append({
+            "attn_norm": layer["attn_norm"], "mlp_norm": layer["mlp_norm"],
+            "wqkv_q": qkv_q, "wqkv_s": qkv_s,
+            "wgate_q": gate_q, "wgate_s": gate_s,
+            "wup_q": up_q, "wup_s": up_s,
+            "wo_q": wo_q, "wo_s": wo_s,
+            "wdown_q": down_q, "wdown_s": down_s,
+        })
+    return out
+
+
+def w8a8_param_specs(cfg: LlamaConfig, axis: str = "tp") -> dict:
+    layer = {
+        "attn_norm": P(), "mlp_norm": P(),
+        "wqkv_q": P(None, axis), "wqkv_s": P(axis),
+        "wgate_q": P(None, axis), "wgate_s": P(axis),
+        "wup_q": P(None, axis), "wup_s": P(axis),
+        "wo_q": P(axis, None), "wo_s": P(axis, None),
+        "wdown_q": P(axis, None), "wdown_s": P(axis, None),
+    }
+    return {"embed": P(), "lm_head": P(), "final_norm": P(),
+            "layers": [dict(layer) for _ in range(cfg.n_layers)]}
+
+
+def place_w8a8_params(qparams, cfg: LlamaConfig, mesh: Mesh,
+                      axis: str = "tp") -> dict:
+    specs = w8a8_param_specs(cfg, axis)
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        qparams, specs, is_leaf=lambda x: isinstance(x, P))
+
+
+def w8a8_forward_shard(qparams, tokens_shard, cfg: LlamaConfig, *,
+                       axis="tp", impl="auto", interpret=False):
+    """Per-device quantized forward (the W8A8 twin of
+    ``llama.forward_shard``).  tokens_shard [S_loc, B] → logits f32."""
+    world = jax.lax.axis_size(axis)
+    hd = cfg.head_dim
+    hq_loc = cfg.n_heads // world
+    hkv_loc = cfg.n_kv_heads // world
+    lin_c = functools.partial(column_parallel_linear_w8a8, axis=axis,
+                              impl=impl, interpret=interpret)
+    lin_r = functools.partial(row_parallel_linear_w8a8, axis=axis,
+                              impl=impl, interpret=interpret)
+
+    x = qparams["embed"][tokens_shard]  # [S_loc, B, D]
+    s_loc, b, _ = x.shape
+    full_positions = jnp.arange(world * s_loc, dtype=jnp.int32)
+
+    for layer in qparams["layers"]:
+        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        qkv = lin_c(h.reshape(s_loc * b, cfg.dim), layer["wqkv_q"],
+                    layer["wqkv_s"])
+        qkv = qkv.reshape(world * s_loc, b, (hq_loc + 2 * hkv_loc) * hd)
+        q, k, v = jnp.split(
+            qkv, [hq_loc * hd, (hq_loc + hkv_loc) * hd], axis=-1)
+        q = _rope(q.reshape(-1, b, hq_loc, hd), full_positions,
+                  cfg.rope_theta)
+        k = _rope(k.reshape(-1, b, hkv_loc, hd), full_positions,
+                  cfg.rope_theta)
+        v = v.reshape(-1, b, hkv_loc, hd)
+        o = _attention(q, k, v, cfg)
+        o = o.reshape(world * s_loc * b, hq_loc * hd)
+        x = x + lin_r(o, layer["wo_q"], layer["wo_s"][0]).reshape(
+            s_loc, b, cfg.dim)
+
+        h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        h2 = h.reshape(s_loc * b, cfg.dim)
+        gate = lin_c(h2, layer["wgate_q"], layer["wgate_s"])
+        up = lin_c(h2, layer["wup_q"], layer["wup_s"])
+        act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+        x = x + lin_r(act, layer["wdown_q"],
+                      layer["wdown_s"][0]).reshape(s_loc, b, cfg.dim)
+
+    x = _rms_norm(x, qparams["final_norm"], cfg.norm_eps)
+    return jnp.dot(x, qparams["lm_head"],
+                   preferred_element_type=jnp.float32)
+
+
+def make_w8a8_forward(cfg: LlamaConfig, mesh: Mesh, *, axis="tp",
+                      impl="auto", interpret=False):
+    """Jitted quantized forward over the mesh: (qparams, tokens [S, B]
+    P(axis)) → logits [S, B, vocab] P(axis)."""
+    specs = w8a8_param_specs(cfg, axis)
+    fn = jax.shard_map(
+        functools.partial(w8a8_forward_shard, cfg=cfg, axis=axis,
+                          impl=impl, interpret=interpret),
+        mesh=mesh,
+        in_specs=(specs, P(axis)),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    return jax.jit(fn)
