@@ -139,7 +139,16 @@ def main():
     mgr = None
     if args.ckpt_dir:
         mgr = CheckpointManager(args.ckpt_dir, max_to_keep=args.keep)
-        resumed = mgr.restore_latest(like=state)
+        try:
+            resumed = mgr.restore_latest(like=state)
+        except Exception:
+            # Pre-optimizer checkpoints stored the bare param tree; wrap
+            # them into the current {"params": ...} layout on restore.
+            if args.opt != "sgd":
+                raise
+            resumed = mgr.restore_latest(like=state["params"])
+            if resumed is not None:
+                resumed = (resumed[0], {"params": resumed[1]})
         if resumed is not None:
             start, state = resumed[0] + 1, resumed[1]
             dist_print(f"resumed from step {resumed[0]}")

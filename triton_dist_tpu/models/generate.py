@@ -177,11 +177,24 @@ class Generator:
         return jnp.stack(outs, axis=1), state
 
 
-def _prompt_forward(params, tokens, *, cfg: LlamaConfig):
+def _dense_prompt_ffn(h2, layer):
+    """The dense family's SwiGLU MLP over flattened prompt tokens."""
+    act = (jax.nn.silu((h2 @ layer["wgate"]).astype(jnp.float32))
+           .astype(h2.dtype) * (h2 @ layer["wup"]))
+    return act @ layer["wdown"]
+
+
+def _prompt_forward(params, tokens, *, cfg: LlamaConfig, ffn=None):
     """Full-sequence forward on replicated weights that also returns the
-    per-layer K/V (post-RoPE, cache layout [B, Hkv, S, hd]) and logits."""
+    per-layer K/V (post-RoPE, cache layout [B, Hkv, S, hd]) and logits.
+
+    ``ffn(h2, layer) -> [B*S, D]`` swaps the MLP — the MoE family
+    (generate_moe.py) reuses the whole attention/cache body this way.
+    """
     from triton_dist_tpu.kernels.attention import dense_gqa_attention
 
+    if ffn is None:
+        ffn = _dense_prompt_ffn
     B, S = tokens.shape
     hd = cfg.head_dim
     x = params["embed"][tokens]          # [B, S, D]
@@ -204,9 +217,7 @@ def _prompt_forward(params, tokens, *, cfg: LlamaConfig):
         x = x + (o @ layer["wo"]).reshape(B, S, cfg.dim)
         h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps).reshape(
             B * S, cfg.dim)
-        act = (jax.nn.silu((h2 @ layer["wgate"]).astype(jnp.float32))
-               .astype(x.dtype) * (h2 @ layer["wup"]))
-        x = x + (act @ layer["wdown"]).reshape(B, S, cfg.dim)
+        x = x + ffn(h2, layer).reshape(B, S, cfg.dim)
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.dot(x, params["lm_head"],
                      preferred_element_type=jnp.float32)

@@ -27,14 +27,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from triton_dist_tpu.kernels.attention import dense_gqa_attention
 from triton_dist_tpu.kernels.moe_utils import topk_routing
 from triton_dist_tpu.models.generate import Generator, _rope_at
-from triton_dist_tpu.models.llama import _rms_norm, _rope
+from triton_dist_tpu.models.llama import _rms_norm
 from triton_dist_tpu.models.moe import MoEConfig
 from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
-
-import numpy as np
 
 
 def place_params_serving(params, cfg: MoEConfig, mesh: Mesh,
@@ -112,34 +109,14 @@ def _moe_prompt_ffn(h2, layer, cfg: MoEConfig):
 
 
 def _moe_prompt_forward(params, tokens, *, cfg: MoEConfig):
-    """Full-prompt forward returning per-layer (K, V) caches + logits
-    (the MoE twin of generate._prompt_forward)."""
-    B, S = tokens.shape
-    hd = cfg.head_dim
-    x = params["embed"][tokens]  # [B, S, D]
-    positions = jnp.arange(S, dtype=jnp.int32)
-    kvs = []
-    for layer in params["layers"]:
-        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        h2 = h.reshape(B * S, cfg.dim)
-        q = (h2 @ layer["wq"]).reshape(B, S, cfg.n_heads, hd)
-        k = (h2 @ layer["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
-        v = (h2 @ layer["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
-        q = _rope(q.transpose(1, 0, 2, 3), positions, cfg.rope_theta)
-        k = _rope(k.transpose(1, 0, 2, 3), positions, cfg.rope_theta)
-        v = v.transpose(1, 0, 2, 3)
-        kvs.append((k.transpose(1, 2, 0, 3), v.transpose(1, 2, 0, 3)))
-        o = dense_gqa_attention(q, k, v, causal=True,
-                                scale=1.0 / np.sqrt(hd))
-        o = o.transpose(1, 0, 2, 3).reshape(B * S, cfg.n_heads * hd)
-        x = x + (o @ layer["wo"]).reshape(B, S, cfg.dim)
-        h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps).reshape(
-            B * S, cfg.dim)
-        x = x + _moe_prompt_ffn(h2, layer, cfg).reshape(B, S, cfg.dim)
-    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.dot(x, params["lm_head"],
-                     preferred_element_type=jnp.float32)
-    return kvs, logits
+    """Full-prompt forward returning per-layer (K, V) caches + logits —
+    generate._prompt_forward's attention/cache body with the MoE FFN
+    swapped in via its ``ffn`` hook."""
+    from triton_dist_tpu.models.generate import _prompt_forward
+
+    return _prompt_forward(
+        params, tokens, cfg=cfg,
+        ffn=functools.partial(_moe_prompt_ffn, cfg=cfg))
 
 
 class MoEGenerator(Generator):

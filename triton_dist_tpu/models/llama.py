@@ -136,18 +136,18 @@ def init_params(cfg: LlamaConfig, key) -> dict:
     return params
 
 
-def param_specs(cfg: LlamaConfig) -> dict:
-    """PartitionSpec tree matching :func:`init_params` (tp axis only;
-    replicate over dp)."""
+def param_specs(cfg: LlamaConfig, axis: str = "tp") -> dict:
+    """PartitionSpec tree matching :func:`init_params` (sharded over the
+    tensor-parallel ``axis`` only; replicate over dp)."""
     layer = {
         "attn_norm": P(), "mlp_norm": P(),
-        "wq": P(None, "tp"),       # column-parallel (whole heads per device)
-        "wk": P(None, "tp"),
-        "wv": P(None, "tp"),
-        "wo": P("tp", None),       # row-parallel
-        "wgate": P(None, "tp"),
-        "wup": P(None, "tp"),
-        "wdown": P("tp", None),
+        "wq": P(None, axis),       # column-parallel (whole heads per device)
+        "wk": P(None, axis),
+        "wv": P(None, axis),
+        "wo": P(axis, None),       # row-parallel
+        "wgate": P(None, axis),
+        "wup": P(None, axis),
+        "wdown": P(axis, None),
     }
     return {
         "embed": P(), "lm_head": P(), "final_norm": P(),

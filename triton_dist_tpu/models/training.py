@@ -48,7 +48,7 @@ def make_grads(family, cfg, mesh: Mesh, *, axis="tp", dp_axis=None,
     """(grads_fn, specs): ``grads_fn(params, tokens, targets) -> (loss,
     grads)`` jitted over the mesh.  ``family`` is models.llama or
     models.moe (anything with ``loss_shard`` + ``param_specs``)."""
-    specs = family.param_specs(cfg)
+    specs = family.param_specs(cfg, axis)
     batch_spec = P(axis, dp_axis) if dp_axis else P(axis)
 
     def grads_shard(params, tokens, targets):

@@ -18,6 +18,8 @@ Run: python tutorials/12_serving.py
 
 import _common  # noqa: F401  (must be first: sets up the virtual mesh)
 
+from _common import INTERPRET
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -40,7 +42,8 @@ def main():
                       n_kv_heads=2, ffn_dim=128, max_seq=64,
                       dtype=jnp.float32)
     params = init_params(cfg, key)  # replicated serving weights
-    gen = Generator(cfg, mesh, axis="sp", max_seq=64)
+    gen = Generator(cfg, mesh, axis="sp", max_seq=64,
+                    interpret=INTERPRET)
     prompt = jax.random.randint(key, (2, 6), 0, cfg.vocab, jnp.int32)
 
     state = gen.prefill(params, prompt)
@@ -63,7 +66,8 @@ def main():
                          dtype=jnp.float32)
     mparams = place_params_serving(moe.init_params(mcfg, key), mcfg, mesh,
                                    axis="sp")
-    mgen = MoEGenerator(mcfg, mesh, axis="sp", max_seq=32)
+    mgen = MoEGenerator(mcfg, mesh, axis="sp", max_seq=32,
+                        interpret=INTERPRET)
     mprompt = jax.random.randint(key, (2, 4), 0, mcfg.vocab, jnp.int32)
     mtoks, _ = mgen.generate(mparams, mgen.prefill(mparams, mprompt), 4)
     print("moe greedy   :", np.asarray(mtoks))
