@@ -1,0 +1,32 @@
+"""examples/serve.py: the serving CLI."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "examples", "serve.py")
+
+
+def _run(*extra):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--new-tokens", "4", *extra],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    return out.stdout
+
+
+def test_serve_llama_sampled_w8a8():
+    out = _run("--model", "llama", "--temperature", "0.7", "--top-k", "32",
+               "--w8a8")
+    assert "decode 4 steps" in out and "done" in out
+    assert "w8a8 prompt scoring vs float: cosine 0.99" in out
+
+
+def test_serve_moe_greedy():
+    out = _run("--model", "moe")
+    assert "decode 4 steps" in out and "done" in out
