@@ -145,3 +145,23 @@ def test_group_gemm_vjp_matches_autodiff_of_dense(key):
                                rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_ref),
                                rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_group_gemm_int8_exact(impl, key):
+    """int8 grouped GEMM: exact i32 against numpy per-tile expert matmuls."""
+    rng = np.random.default_rng(0)
+    E, bm, K, N = 4, 8, 128, 128
+    n_tiles = 6
+    x = jnp.asarray(rng.integers(-127, 128, (n_tiles * bm, K),
+                                 dtype=np.int8))
+    w = jnp.asarray(rng.integers(-127, 128, (E, K, N), dtype=np.int8))
+    te = jnp.asarray(rng.integers(0, E, (n_tiles,), dtype=np.int32))
+    out = group_gemm(x, w, te, block_m=bm, impl=impl,
+                     interpret=(impl == "pallas"))
+    assert out.dtype == jnp.int32
+    xn, wn = np.asarray(x, np.int32), np.asarray(w, np.int32)
+    for t in range(n_tiles):
+        ref = xn[t * bm:(t + 1) * bm] @ wn[int(te[t])]
+        np.testing.assert_array_equal(np.asarray(out[t * bm:(t + 1) * bm]),
+                                      ref)

@@ -117,14 +117,16 @@ def gemm_pipeline_body(a_blk, b_blk, out_blk, acc_ref, *, n_k, out_dtype):
 def group_gemm_pipeline_body(x_blk, w_blk, out_blk, acc_ref, *, n_k, out_dtype):
     """Grouped-GEMM variant of :func:`gemm_pipeline_body`: the weight block
     arrives with a leading singleton expert dim (BlockSpec (1, bk, bn) steered
-    by a tile→expert map), so the MXU contraction reads ``w_blk[0]``."""
+    by a tile→expert map), so the MXU contraction reads ``w_blk[0]``.  The
+    accumulator dtype follows the scratch ref (f32 float / exact i32 int8)."""
     k = pl.program_id(2)
 
     @pl.when(k == 0)
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    acc_ref[:] += jnp.dot(x_blk[:], w_blk[0], preferred_element_type=jnp.float32)
+    acc_ref[:] += jnp.dot(x_blk[:], w_blk[0],
+                          preferred_element_type=acc_ref.dtype)
 
     @pl.when(k == n_k - 1)
     def _():

@@ -44,12 +44,15 @@ def group_gemm_xla(x_sorted, w_stack, tile_expert, block_m: int, out_dtype=None)
     weight gather into per-tile dynamic slices.  Runs everywhere — the
     correctness baseline for the pallas path.
     """
-    out_dtype = out_dtype or x_sorted.dtype
+    quantized = x_sorted.dtype == jnp.int8
+    out_dtype = out_dtype or (jnp.int32 if quantized else x_sorted.dtype)
     m_pad, k_dim = x_sorted.shape
     n_tiles = m_pad // block_m
     xt = x_sorted.reshape(n_tiles, block_m, k_dim)
     wt = w_stack[tile_expert]  # [n_tiles, K, N]
-    yt = jnp.einsum("tbk,tkn->tbn", xt, wt, preferred_element_type=jnp.float32)
+    yt = jnp.einsum("tbk,tkn->tbn", xt, wt,
+                    preferred_element_type=(jnp.int32 if quantized
+                                            else jnp.float32))
     return yt.astype(out_dtype).reshape(m_pad, w_stack.shape[-1])
 
 
@@ -129,7 +132,11 @@ def _group_gemm_fwd_impl(x_sorted, w_stack, tile_expert, block_m, bn, bk,
     # tiles to garbage expert slabs on the pallas path (te[i] read OOB).
     assert tile_expert.shape == (m_pad // block_m,), (
         tile_expert.shape, m_pad, block_m)
-    out_dtype = out_dtype or x_sorted.dtype
+    # int8 inputs: exact i32 accumulation/output on the MXU double-rate
+    # path (W8A8 expert compute; dequant happens at the caller).
+    quantized = x_sorted.dtype == jnp.int8
+    out_dtype = out_dtype or (jnp.int32 if quantized else x_sorted.dtype)
+    acc_dtype = jnp.int32 if quantized else jnp.float32
 
     impl = resolve_impl(impl, interpret)
     if impl == "xla" or not pallas_shapes_ok(block_m, n_dim, k_dim):
@@ -147,7 +154,7 @@ def _group_gemm_fwd_impl(x_sorted, w_stack, tile_expert, block_m, bn, bk,
             pl.BlockSpec((1, bk, bn), lambda i, j, k, te: (te[i], k, j)),
         ],
         out_specs=pl.BlockSpec((block_m, bn), lambda i, j, k, te: (i, j)),
-        scratch_shapes=[pltpu.VMEM((block_m, bn), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_m, bn), acc_dtype)],
     )
 
     def _kernel(te_ref, x_ref, w_ref, out_ref, acc_ref):
