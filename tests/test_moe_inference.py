@@ -100,3 +100,21 @@ def test_capacity_truncation_drops_not_corrupts(mesh2, key):
                                    atol=2e-4)
         dropped = out[r * t_loc + 2:(r + 1) * t_loc]
         np.testing.assert_array_equal(dropped, np.zeros_like(dropped))
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_forward_w8a8_close_to_float(impl, mesh4, key):
+    """Quantized expert compute tracks the float layer to int8 tolerance."""
+    layer, w, x = _make(mesh4, key, dtype=jnp.float32, impl=impl,
+                        interpret=(impl == "pallas"))
+    weights, experts = layer.route(x)
+    ref = np.asarray(layer.forward(x, experts=experts,
+                                   routing_weights=weights))
+    layer.quantize_weights()
+    assert layer.is_quantized
+    out = np.asarray(layer.forward(x, experts=experts,
+                                   routing_weights=weights))
+    rel = np.abs(out - ref) / (np.abs(ref) + 1e-2)
+    assert np.median(rel) < 0.05, np.median(rel)
+    cos = (out * ref).sum() / (np.linalg.norm(out) * np.linalg.norm(ref))
+    assert cos > 0.995, cos

@@ -23,6 +23,7 @@ requirement; one jitted shard program per (shape, dtype) serves any batch.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -68,6 +69,59 @@ def moe_infer_shard(x_loc, weights_loc, experts_loc, w_gate, w_up, w_down, *,
     y_sorted = moe_ffn_sorted(x_sorted, w_gate, w_up, w_down,
                               splan["tile_expert"], block_m=block_m,
                               impl=impl, interpret=interpret)
+    y = y_sorted[splan["dest"]].reshape(world, max_tokens, hidden)
+
+    return ep_combine_shard(y, weights_loc, plan, axis=axis, impl=impl,
+                            interpret=interpret)
+
+
+def moe_ffn_sorted_w8a8(x_sorted, wg_q, wg_s, wu_q, wu_s, wd_q, wd_s,
+                        tile_expert, *, block_m, impl, interpret):
+    """W8A8 grouped SwiGLU: dynamic per-row activation quant around exact
+    int8 grouped GEMMs, per-expert-channel weight scales.
+
+    x_sorted [M_pad, H] float; w*_q int8 stacks [epr, H, F] / [epr, F, H]
+    with scales [epr, F] / [epr, H]; tile_expert [M_pad // block_m].
+    """
+    from triton_dist_tpu.kernels.group_gemm import group_gemm
+    from triton_dist_tpu.kernels.quant import quantize_rowwise
+
+    gg = functools.partial(group_gemm, tile_expert=tile_expert,
+                           block_m=block_m, impl=impl, interpret=interpret)
+    row_e = jnp.repeat(tile_expert, block_m)          # expert of each row
+
+    x_q, x_s = quantize_rowwise(x_sorted)
+    gate = gg(x_q, wg_q).astype(jnp.float32) * x_s[:, None] * wg_s[row_e]
+    up = gg(x_q, wu_q).astype(jnp.float32) * x_s[:, None] * wu_s[row_e]
+    hidden = jax.nn.silu(gate) * up
+    h_q, h_s = quantize_rowwise(hidden)
+    down = gg(h_q, wd_q).astype(jnp.float32) * h_s[:, None] * wd_s[row_e]
+    return down.astype(x_sorted.dtype)
+
+
+def moe_infer_shard_w8a8(x_loc, weights_loc, experts_loc, wg_q, wg_s, wu_q,
+                         wu_s, wd_q, wd_s, *, axis, n_experts, max_tokens,
+                         block_m, impl, interpret):
+    """W8A8 twin of :func:`moe_infer_shard` (same dispatch/combine; the
+    expert compute runs the int8 grouped GEMMs)."""
+    world = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    epr = n_experts // world
+    hidden = x_loc.shape[1]
+
+    recv, recv_expert, _splits, plan = ep_dispatch_shard(
+        x_loc, experts_loc, axis=axis, n_experts=n_experts,
+        max_tokens=max_tokens, impl=impl, interpret=interpret)
+
+    T = world * max_tokens
+    local_e = jnp.clip(recv_expert.reshape(T, 1) - me * epr, 0, epr - 1)
+    splan = sort_align(local_e, epr, block_m)
+    x_sorted = gather_sorted(recv.reshape(T, hidden), splan["dest"],
+                             splan["m_pad"])
+    y_sorted = moe_ffn_sorted_w8a8(
+        x_sorted, wg_q, wg_s, wu_q, wu_s, wd_q, wd_s,
+        splan["tile_expert"], block_m=block_m, impl=impl,
+        interpret=interpret)
     y = y_sorted[splan["dest"]].reshape(world, max_tokens, hidden)
 
     return ep_combine_shard(y, weights_loc, plan, axis=axis, impl=impl,
@@ -132,6 +186,39 @@ class DistributedMoELayer:
             w, specs)
         return self.weights
 
+    def quantize_weights(self) -> dict:
+        """Convert the expert stacks to W8A8 (int8 + per-expert-channel
+        scales); subsequent ``forward`` calls run the int8 grouped GEMMs.
+        The router stays fp32 (routing is precision-sensitive)."""
+        from triton_dist_tpu.kernels.quant import quantize_channelwise
+
+        def per_expert(w):  # [E, K, N] → ([E, K, N] i8, [E, N] f32)
+            qs = [quantize_channelwise(w[e]) for e in range(w.shape[0])]
+            return (jnp.stack([q for q, _ in qs]),
+                    jnp.stack([s for _, s in qs]))
+
+        w = self.weights
+        gq, gs = per_expert(w["w_gate"])
+        uq, us = per_expert(w["w_up"])
+        dq, ds = per_expert(w["w_down"])
+        qw = {"router": w["router"],
+              "w_gate_q": gq, "w_gate_s": gs,
+              "w_up_q": uq, "w_up_s": us,
+              "w_down_q": dq, "w_down_s": ds}
+        ep = P(self.axis, None, None)
+        sp = P(self.axis, None)
+        specs = {"router": P(), "w_gate_q": ep, "w_gate_s": sp,
+                 "w_up_q": ep, "w_up_s": sp,
+                 "w_down_q": ep, "w_down_s": sp}
+        self.weights = jax.tree.map(
+            lambda a, s: jax.device_put(a, NamedSharding(self.mesh, s)),
+            qw, specs)
+        return self.weights
+
+    @property
+    def is_quantized(self) -> bool:
+        return self.weights is not None and "w_gate_q" in self.weights
+
     # -- forward -----------------------------------------------------------
     def route(self, x) -> tuple[jax.Array, jax.Array]:
         """Router probabilities → (weights [T, topk] f32, experts i32)."""
@@ -148,15 +235,24 @@ class DistributedMoELayer:
             routing_weights = jnp.full(experts.shape, 1.0 / self.topk,
                                        jnp.float32)
         ax = self.axis
+        opts = dict(axis=ax, n_experts=self.n_experts,
+                    max_tokens=self.max_tokens, block_m=self.block_m,
+                    impl=self.impl, interpret=self.interpret)
+        ep = P(ax, None, None)
+        sp = P(ax, None)
+        if self.is_quantized:
+            fn = cached_shard_jit(
+                moe_infer_shard_w8a8, self.mesh,
+                (P(ax), P(ax), P(ax), ep, sp, ep, sp, ep, sp),
+                P(ax), **opts)
+            w = self.weights
+            return fn(x.astype(self.dtype), routing_weights, experts,
+                      w["w_gate_q"], w["w_gate_s"], w["w_up_q"],
+                      w["w_up_s"], w["w_down_q"], w["w_down_s"])
         fn = cached_shard_jit(
-            moe_infer_shard,
-            self.mesh,
-            (P(ax), P(ax), P(ax),
-             P(ax, None, None), P(ax, None, None), P(ax, None, None)),
-            P(ax),
-            axis=ax, n_experts=self.n_experts, max_tokens=self.max_tokens,
-            block_m=self.block_m, impl=self.impl, interpret=self.interpret,
-        )
+            moe_infer_shard, self.mesh,
+            (P(ax), P(ax), P(ax), ep, ep, ep),
+            P(ax), **opts)
         return fn(x.astype(self.dtype), routing_weights, experts,
                   self.weights["w_gate"], self.weights["w_up"],
                   self.weights["w_down"])
