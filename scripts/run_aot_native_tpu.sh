@@ -9,6 +9,7 @@ REPO=$(cd "$(dirname "$0")/.." && pwd)
 python - <<PY
 import triton_dist_tpu.kernels.gemm  # registers "matmul"
 import triton_dist_tpu.kernels.flash_decode  # registers "gqa_decode"
+import triton_dist_tpu.kernels.quant  # registers "matmul_i8"
 from triton_dist_tpu.tools import compile_aot
 man = compile_aot.export_registered("$DIR")
 print("exported", sum(len(v) for v in man["kernels"].values()), "variants")

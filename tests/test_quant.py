@@ -61,3 +61,24 @@ def test_w8a8_linear_accuracy(key):
     # int8 symmetric quant on gaussian data: ~1% typical relative error.
     assert np.median(rel) < 0.02, np.median(rel)
     assert np.mean(rel) < 0.1, np.mean(rel)
+
+
+def test_matmul_i8_aot_registered_and_exports(tmp_path):
+    import triton_dist_tpu.kernels.quant  # noqa: F401 (registers)
+    from triton_dist_tpu.tools import compile_aot
+
+    regs = compile_aot.registered_kernels()
+    assert "matmul_i8" in regs
+    manifest = compile_aot.export_registered(str(tmp_path),
+                                             kernels=["matmul_i8"])
+    entries = manifest["kernels"]["matmul_i8"]
+    assert len(entries) == 2  # 2 sigs x 1 cpu algo
+    fn = compile_aot.load_exported(
+        tmp_path, "matmul_i8",
+        inputs=[((1024, 1024), "int8"), ((1024, 512), "int8")])
+    rng = np.random.default_rng(0)
+    a = rng.integers(-127, 128, (1024, 1024), dtype=np.int8)
+    b = rng.integers(-127, 128, (1024, 512), dtype=np.int8)
+    out = fn(jnp.asarray(a), jnp.asarray(b))
+    np.testing.assert_array_equal(
+        np.asarray(out), a.astype(np.int32) @ b.astype(np.int32))

@@ -108,6 +108,37 @@ def matmul_i8(a: jax.Array, b: jax.Array,
     )(a, b)
 
 
+def _register_quant_aot():
+    """AOT export spaces for the quantized GEMM (joins matmul/gqa_decode in
+    the registry; see tools/compile_aot.py and csrc/aot_runtime)."""
+    from triton_dist_tpu.tools.compile_aot import aot_compile_spaces
+
+    def algos(platforms):
+        if "tpu" in platforms:
+            return [{"bm": 1024, "bn": 512, "bk": 1024},  # sweep winner
+                    {"bm": 256, "bn": 256, "bk": 256}]
+        return [{"bm": 256, "bn": 256, "bk": 256}]
+
+    return aot_compile_spaces({
+        "matmul_i8": {
+            "signature": [
+                [((8192, 8192), "int8"), ((8192, 3584), "int8")],
+                [((1024, 1024), "int8"), ((1024, 512), "int8")],
+            ],
+            "algo_infos": algos,
+        },
+    })
+
+
+@_register_quant_aot()
+def matmul_i8_with_blocks(a, b, *, bm, bn, bk, impl="auto",
+                          interpret=False):
+    """``matmul_i8`` with flat block kwargs — the AOT entry point (algo
+    infos must be manifest-serializable primitives)."""
+    return matmul_i8(a, b, config=Int8MatmulConfig(bm, bn, bk), impl=impl,
+                     interpret=interpret)
+
+
 def quantize_rowwise(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Dynamic symmetric per-row int8: x ≈ q * scale[:, None].
     x [m, k] float → (q [m, k] int8, scale [m] f32)."""
