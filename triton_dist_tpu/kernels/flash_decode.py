@@ -124,14 +124,24 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
             NEG_INF)
 
 
-def _local_decode_xla(q, k, v, local_lens, *, scale):
+def _local_decode_xla(q, k, v, local_lens, *, scale, k_scale=None,
+                      v_scale=None):
     """Dense fallback for ragged shapes / non-TPU (reference analog: the
-    non-TMA dispatch path).  Same (out, lse) contract as the Pallas kernel."""
+    non-TMA dispatch path).  Same (out, lse) contract as the Pallas kernel.
+
+    ``k_scale``/``v_scale`` [B, Hkv, S] dequantize an int8 KV cache
+    (kernels-level int8-KV support; see layers/sp_flash_decode.py).  The
+    scale applies *after* the QK matmul / *before* the PV matmul, so XLA
+    streams the cache from HBM as int8 — decode is bandwidth-bound, and
+    halving the cache bytes is the point.
+    """
     B, Hq, D = q.shape
     _, Hkv, S, _ = k.shape
     g = Hq // Hkv
     qf = q.astype(jnp.float32).reshape(B, Hkv, g, D)
     logits = jnp.einsum("bhgd,bhsd->bhgs", qf, k.astype(jnp.float32)) * scale
+    if k_scale is not None:
+        logits = logits * k_scale[:, :, None, :]
     valid = jnp.arange(S)[None, :] < local_lens[:, None]        # [B, S]
     logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
     m = jnp.max(logits, axis=-1)                                # [B, Hkv, g]
@@ -140,7 +150,8 @@ def _local_decode_xla(q, k, v, local_lens, *, scale):
     p = jnp.where(valid[:, None, None, :],
                   jnp.exp(logits - m[..., None]), 0.0)
     l = jnp.sum(p, axis=-1)
-    out = jnp.einsum("bhgs,bhsd->bhgd", p, v.astype(jnp.float32))
+    pv = p if v_scale is None else p * v_scale[:, :, None, :]
+    out = jnp.einsum("bhgs,bhsd->bhgd", pv, v.astype(jnp.float32))
     out = jnp.where(nonempty[..., None],
                     out / jnp.where(nonempty, l, 1.0)[..., None], 0.0)
     lse = jnp.where(nonempty, m + jnp.log(jnp.where(nonempty, l, 1.0)),
@@ -189,9 +200,19 @@ def _register_aot():
     })
 
 
+def quantize_kv(x):
+    """[..., S, D] float → ([..., S, D] int8, [..., S] f32 scales):
+    symmetric per-position row quant (the standard int8-KV layout)."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    s = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, s
+
+
 @_register_aot()
 def gqa_decode_shard(q, k, v, local_lens, *, block_s=1024, impl="auto",
-                     interpret=False):
+                     interpret=False, k_scale=None, v_scale=None):
     """Single-shard GQA decode: q [B, Hq, D], k/v [B, Hkv, S_loc, D],
     local_lens [B] (valid rows in this shard).  Returns float32 partials
     (out [B, Hq, D], lse [B, Hq]).
@@ -217,8 +238,12 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=1024, impl="auto",
     def shapes_ok():
         return D % 128 == 0 and S % 128 == 0
 
-    if impl == "xla" or not shapes_ok():
-        return _local_decode_xla(q, k, v, local_lens, scale=scale)
+    quantized = k_scale is not None
+    if impl == "xla" or not shapes_ok() or quantized:
+        # int8-KV always takes the XLA program: dequant fuses into the
+        # attention stream and ``auto`` resolves to XLA on hardware anyway.
+        return _local_decode_xla(q, k, v, local_lens, scale=scale,
+                                 k_scale=k_scale, v_scale=v_scale)
 
     bs = block_s
     while S % bs:
@@ -284,10 +309,12 @@ def combine_partials(outs, lses):
 
 
 def sp_gqa_decode_shard(q, k_shard, v_shard, kv_lens, *, axis, block_s=1024,
-                        impl="auto", interpret=False):
+                        impl="auto", interpret=False, k_scale=None,
+                        v_scale=None):
     """Per-device SP decode: local split-KV partials -> one-shot LL gather of
     (out ⊕ lse) -> LSE combine.  ``kv_lens`` are GLOBAL lengths; the shard
-    owns global rows [me*S_loc, (me+1)*S_loc).
+    owns global rows [me*S_loc, (me+1)*S_loc).  Optional ``k/v_scale``
+    [B, Hkv, S_loc] dequantize an int8 cache shard.
 
     Reference analog: ``SpGQAFlashDecodeAttention.forward``
     (sp_flash_decode_layer.py:78-184).
@@ -300,7 +327,8 @@ def sp_gqa_decode_shard(q, k_shard, v_shard, kv_lens, *, axis, block_s=1024,
 
     out, lse = gqa_decode_shard(q, k_shard, v_shard, local_lens,
                                 block_s=block_s, impl=impl,
-                                interpret=interpret)
+                                interpret=interpret, k_scale=k_scale,
+                                v_scale=v_scale)
     if world == 1:
         return out.astype(q.dtype)
 

@@ -22,9 +22,50 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from triton_dist_tpu.kernels.flash_decode import (
     SpDecodeContext,
     create_sp_decode_context,
+    quantize_kv,
     sp_gqa_decode,
+    sp_gqa_decode_shard,
 )
 from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
+
+
+def _sp_decode_q_shard(q, kq, ks, vq, vs, kv_lens, *, axis, block_s, impl,
+                       interpret):
+    """Shard-level SP decode over an int8 cache (positional scales for
+    shard_map)."""
+    return sp_gqa_decode_shard(q, kq, vq, kv_lens, axis=axis,
+                               block_s=block_s, impl=impl,
+                               interpret=interpret, k_scale=ks, v_scale=vs)
+
+
+def append_kv_shard_q(kq, ks, vq, vs, new_k, new_v, kv_lens, *, axis):
+    """Quantized twin of :func:`append_kv_shard`: the new rows quantize per
+    (batch, head) before landing in the int8 cache + scale plane."""
+    nk_q, nk_s = quantize_kv(new_k)          # [B, Hkv, D] i8, [B, Hkv]
+    nv_q, nv_s = quantize_kv(new_v)
+    kq, vq = append_kv_shard(kq, vq, nk_q, nv_q, kv_lens, axis=axis)
+    ks, vs = _append_scale_shard(ks, vs, nk_s, nv_s, kv_lens, axis=axis)
+    return kq, ks, vq, vs
+
+
+def _append_scale_shard(ks, vs, nk_s, nv_s, kv_lens, *, axis):
+    """Write one position's scales at kv_lens[b] (ks/vs [B, Hkv, S_loc])."""
+    s_loc = ks.shape[2]
+    me = jax.lax.axis_index(axis)
+
+    def per_batch(k_row, v_row, nk, nv, pos):
+        lp = jnp.clip(pos - me * s_loc, 0, s_loc - 1)
+        own = (pos >= me * s_loc) & (pos < (me + 1) * s_loc)
+
+        def upd(plane, new):
+            cur = jax.lax.dynamic_slice(plane, (0, lp),
+                                        (plane.shape[0], 1))
+            val = jnp.where(own, new[:, None].astype(plane.dtype), cur)
+            return jax.lax.dynamic_update_slice(plane, val, (0, lp))
+
+        return upd(k_row, nk), upd(v_row, nv)
+
+    return jax.vmap(per_batch)(ks, vs, nk_s, nv_s, kv_lens)
 
 
 def append_kv_shard(k_cache, v_cache, new_k, new_v, kv_lens, *, axis):
@@ -65,13 +106,22 @@ class SpGQAFlashDecodeAttention:
 
     def __init__(self, mesh: Mesh, axis: str = "sp", block_s: int = 1024,
                  impl: str = "auto", interpret: bool = False,
-                 check_bounds: bool = True):
+                 check_bounds: bool = True, kv_dtype=None):
         self.ctx: SpDecodeContext = create_sp_decode_context(
             mesh, axis=axis, block_s=block_s, impl=impl, interpret=interpret)
         # The append overflow guard costs a host sync per step (it reads
         # max(kv_lens)); hot decode loops tracking lengths host-side can
         # disable it.
         self.check_bounds = check_bounds
+        # kv_dtype=jnp.int8 stores the cache quantized (symmetric per-row
+        # int8 + a [B, Hkv, S] f32 scale plane): decode is bandwidth-bound,
+        # so halving cache bytes is a direct speedup (docs/perf.md).
+        assert kv_dtype in (None, jnp.int8), kv_dtype
+        self.kv_dtype = kv_dtype
+
+    @property
+    def quantized(self) -> bool:
+        return self.kv_dtype == jnp.int8
 
     @property
     def mesh(self) -> Mesh:
@@ -85,13 +135,47 @@ class SpGQAFlashDecodeAttention:
         return NamedSharding(self.mesh, P(None, None, self.ctx.axis))
 
     def init_cache(self, batch: int, n_kv_heads: int, max_seq: int,
-                   head_dim: int, dtype=jnp.bfloat16):
-        """Zeroed sequence-sharded K/V caches [B, Hkv, S, D]."""
+                   head_dim: int, dtype=jnp.bfloat16, k_init=None,
+                   v_init=None):
+        """Zeroed sequence-sharded K/V caches [B, Hkv, S, D]; when
+        ``k_init``/``v_init`` [B, Hkv, S0, D] are given (the prefill KVs)
+        they are written at positions [0, S0) — quantized on the way in for
+        an int8 cache.
+
+        Float caches are a (k, v) array pair; int8 caches are a pair of
+        dicts ``{"q": int8 data, "s": f32 [B, Hkv, S] scales}``.  Both go
+        through ``append_kv`` / ``__call__`` unchanged.
+        """
         assert max_seq % self.world == 0, (max_seq, self.world)
         shape = (batch, n_kv_heads, max_seq, head_dim)
-        z = jnp.zeros(shape, dtype)
         sh = self.cache_sharding()
-        return jax.device_put(z, sh), jax.device_put(z, sh)
+
+        def place(x):
+            return jax.device_put(x, sh)
+
+        if not self.quantized:
+            k = jnp.zeros(shape, dtype)
+            v = jnp.zeros(shape, dtype)
+            if k_init is not None:
+                k = jax.lax.dynamic_update_slice(
+                    k, k_init.astype(dtype), (0, 0, 0, 0))
+                v = jax.lax.dynamic_update_slice(
+                    v, v_init.astype(dtype), (0, 0, 0, 0))
+            return place(k), place(v)
+
+        kq = jnp.zeros(shape, jnp.int8)
+        vq = jnp.zeros(shape, jnp.int8)
+        ks = jnp.ones(shape[:3], jnp.float32)
+        vs = jnp.ones(shape[:3], jnp.float32)
+        if k_init is not None:
+            nkq, nks = quantize_kv(k_init)
+            nvq, nvs = quantize_kv(v_init)
+            kq = jax.lax.dynamic_update_slice(kq, nkq, (0, 0, 0, 0))
+            vq = jax.lax.dynamic_update_slice(vq, nvq, (0, 0, 0, 0))
+            ks = jax.lax.dynamic_update_slice(ks, nks, (0, 0, 0))
+            vs = jax.lax.dynamic_update_slice(vs, nvs, (0, 0, 0))
+        return ({"q": place(kq), "s": place(ks)},
+                {"q": place(vq), "s": place(vs)})
 
     def append_kv(self, k_cache, v_cache, new_k, new_v, kv_lens):
         """Write one new token's K/V at position kv_lens[b] per batch row.
@@ -101,23 +185,47 @@ class SpGQAFlashDecodeAttention:
         and the token would be silently dropped, leaving the next decode
         stale.
         """
-        max_seq = k_cache.shape[2]
+        quantized = isinstance(k_cache, dict)
+        max_seq = (k_cache["q"] if quantized else k_cache).shape[2]
         if self.check_bounds and not isinstance(kv_lens, jax.core.Tracer):
             top = int(jnp.max(kv_lens))
             if top >= max_seq:
                 raise ValueError(
                     f"KV cache overflow: append at position {top} but "
                     f"max_seq={max_seq}")
+        seq = P(None, None, self.ctx.axis)
+        if quantized:
+            fn = cached_shard_jit(
+                append_kv_shard_q,
+                self.mesh,
+                (seq, seq, seq, seq, P(), P(), P()),
+                (seq, seq, seq, seq),
+                axis=self.ctx.axis,
+            )
+            kq, ks, vq, vs = fn(k_cache["q"], k_cache["s"], v_cache["q"],
+                                v_cache["s"], new_k, new_v, kv_lens)
+            return {"q": kq, "s": ks}, {"q": vq, "s": vs}
         fn = cached_shard_jit(
             append_kv_shard,
             self.mesh,
-            (P(None, None, self.ctx.axis), P(None, None, self.ctx.axis),
-             P(), P(), P()),
-            (P(None, None, self.ctx.axis), P(None, None, self.ctx.axis)),
+            (seq, seq, P(), P(), P()),
+            (seq, seq),
             axis=self.ctx.axis,
         )
         return fn(k_cache, v_cache, new_k, new_v, kv_lens)
 
     def __call__(self, q, k_cache, v_cache, kv_lens):
         """q [B, Hq, D] -> attention output [B, Hq, D] (replicated)."""
+        if isinstance(k_cache, dict):
+            seq = P(None, None, self.ctx.axis)
+            fn = cached_shard_jit(
+                _sp_decode_q_shard,
+                self.mesh,
+                (P(), seq, seq, seq, seq, P()),
+                P(),
+                axis=self.ctx.axis, block_s=self.ctx.block_s,
+                impl=self.ctx.impl, interpret=self.ctx.interpret,
+            )
+            return fn(q, k_cache["q"], k_cache["s"], v_cache["q"],
+                      v_cache["s"], kv_lens)
         return sp_gqa_decode(q, k_cache, v_cache, kv_lens, self.ctx)

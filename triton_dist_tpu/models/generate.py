@@ -58,14 +58,15 @@ class Generator:
 
     def __init__(self, cfg: LlamaConfig, mesh: Mesh, *, axis: str = "sp",
                  max_seq: int | None = None, impl: str = "auto",
-                 interpret: bool = False):
+                 interpret: bool = False, kv_dtype=None):
         self.cfg = cfg
         self.mesh = mesh
         self.axis = axis
         self.max_seq = max_seq or cfg.max_seq
         self.attn = SpGQAFlashDecodeAttention(
             mesh, axis=axis, impl=impl, interpret=interpret,
-            check_bounds=False)  # Generator guards lengths itself (below)
+            check_bounds=False,  # Generator guards lengths itself (below)
+            kv_dtype=kv_dtype)   # jnp.int8 = quantized KV cache
         self._prefill_jit = jax.jit(functools.partial(
             _prompt_forward, cfg=cfg))
         self._step_jit = jax.jit(self._step_impl)
@@ -82,15 +83,9 @@ class Generator:
         lens = jnp.full((B,), S0, jnp.int32)
         caches = []
         for (k_new, v_new) in kvs:  # [B, Hkv, S0, hd] each
-            k_c, v_c = self.attn.init_cache(
+            caches.append(self.attn.init_cache(
                 B, cfg.n_kv_heads, self.max_seq, cfg.head_dim,
-                dtype=cfg.dtype)
-            k_c = jax.lax.dynamic_update_slice(k_c, k_new.astype(k_c.dtype),
-                                               (0, 0, 0, 0))
-            v_c = jax.lax.dynamic_update_slice(v_c, v_new.astype(v_c.dtype),
-                                               (0, 0, 0, 0))
-            sh = self.attn.cache_sharding()
-            caches.append((jax.device_put(k_c, sh), jax.device_put(v_c, sh)))
+                dtype=cfg.dtype, k_init=k_new, v_init=v_new))
         return GenerationState(caches=caches, kv_lens=lens,
                                last_logits=logits[:, -1])
 

@@ -130,9 +130,9 @@ class MoEGenerator(Generator):
 
     def __init__(self, cfg: MoEConfig, mesh: Mesh, *, axis: str = "sp",
                  max_seq: int | None = None, impl: str = "auto",
-                 interpret: bool = False):
+                 interpret: bool = False, kv_dtype=None):
         super().__init__(cfg, mesh, axis=axis, max_seq=max_seq, impl=impl,
-                         interpret=interpret)
+                         interpret=interpret, kv_dtype=kv_dtype)
         self._prefill_jit = jax.jit(functools.partial(
             _moe_prompt_forward, cfg=cfg))
 
