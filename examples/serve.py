@@ -47,6 +47,8 @@ def parse_args():
     p.add_argument("--w8a8", action="store_true",
                    help="also score the prompt with the W8A8 forward "
                         "(dense family only) and report logit agreement")
+    p.add_argument("--kv-int8", action="store_true",
+                   help="int8 KV cache (half the memory, ~1.55x decode)")
     return p.parse_args()
 
 
@@ -71,7 +73,8 @@ def main():
                                 n_heads=n, n_kv_heads=n, ffn_dim=64 * n,
                                 max_seq=max_seq, dtype=jnp.float32)
         params = llama.init_params(cfg, key)
-        gen = Generator(cfg, mesh, axis="sp", max_seq=max_seq)
+        gen = Generator(cfg, mesh, axis="sp", max_seq=max_seq,
+                        kv_dtype=jnp.int8 if args.kv_int8 else None)
     else:
         from triton_dist_tpu.models import moe
         from triton_dist_tpu.models.generate_moe import (
@@ -82,7 +85,8 @@ def main():
                             dtype=jnp.float32)
         params = place_params_serving(moe.init_params(cfg, key), cfg, mesh,
                                       axis="sp")
-        gen = MoEGenerator(cfg, mesh, axis="sp", max_seq=max_seq)
+        gen = MoEGenerator(cfg, mesh, axis="sp", max_seq=max_seq,
+                           kv_dtype=jnp.int8 if args.kv_int8 else None)
 
     prompt = jax.random.randint(key, (args.batch, args.prompt_len), 0,
                                 cfg.vocab, jnp.int32)
