@@ -202,12 +202,11 @@ def _register_aot():
 
 def quantize_kv(x):
     """[..., S, D] float → ([..., S, D] int8, [..., S] f32 scales):
-    symmetric per-position row quant (the standard int8-KV layout)."""
-    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
-    s = jnp.where(absmax > 0, absmax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s[..., None]),
-                 -127, 127).astype(jnp.int8)
-    return q, s
+    symmetric per-position row quant (the standard int8-KV layout; shares
+    the one recipe in kernels/quant.py)."""
+    from triton_dist_tpu.kernels.quant import symmetric_quantize
+
+    return symmetric_quantize(x, -1)
 
 
 @_register_aot()
@@ -233,12 +232,21 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=1024, impl="auto",
     assert Hq % Hkv == 0, (Hq, Hkv)
     g = Hq // Hkv
     scale = 1.0 / math.sqrt(D)
+    raw_impl = impl
     impl = resolve_impl(impl, interpret, prefer_xla_on_hw=True)
 
     def shapes_ok():
         return D % 128 == 0 and S % 128 == 0
 
     quantized = k_scale is not None
+    if quantized and raw_impl == "pallas" and not interpret:
+        # No silent downgrade: the split-KV kernel has no int8-KV variant
+        # yet, and handing back XLA timings labeled "pallas" would poison
+        # a block_s sweep.  (auto/xla paths below handle int8; interpret
+        # mode also routes here since XLA is what runs either way.)
+        raise NotImplementedError(
+            "impl='pallas' has no int8-KV variant; use impl='auto'/'xla' "
+            "for quantized caches")
     if impl == "xla" or not shapes_ok() or quantized:
         # int8-KV always takes the XLA program: dequant fuses into the
         # attention stream and ``auto`` resolves to XLA on hardware anyway.

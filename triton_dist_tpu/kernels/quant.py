@@ -139,24 +139,29 @@ def matmul_i8_with_blocks(a, b, *, bm, bn, bk, impl="auto",
                      interpret=interpret)
 
 
+def symmetric_quantize(x: jax.Array, axis: int) -> tuple[jax.Array,
+                                                         jax.Array]:
+    """Symmetric absmax int8 quant along ``axis``: x ≈ q * expand(scale).
+    The single recipe behind every int8 surface (W8A8 rows/channels, the
+    int8 KV cache) — change it here and everywhere changes together."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32)
+                           / jnp.expand_dims(scale, axis)),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def quantize_rowwise(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Dynamic symmetric per-row int8: x ≈ q * scale[:, None].
     x [m, k] float → (q [m, k] int8, scale [m] f32)."""
-    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=1)
-    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[:, None]),
-                 -127, 127).astype(jnp.int8)
-    return q, scale
+    return symmetric_quantize(x, 1)
 
 
 def quantize_channelwise(w: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Static symmetric per-output-channel int8: w ≈ q * scale[None, :].
     w [k, n] float → (q [k, n] int8, scale [n] f32)."""
-    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)
-    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale[None, :]),
-                 -127, 127).astype(jnp.int8)
-    return q, scale
+    return symmetric_quantize(w, 0)
 
 
 def w8a8_linear(x: jax.Array, w_q: jax.Array, w_scale: jax.Array, *,

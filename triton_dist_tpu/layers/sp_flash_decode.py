@@ -40,32 +40,16 @@ def _sp_decode_q_shard(q, kq, ks, vq, vs, kv_lens, *, axis, block_s, impl,
 
 def append_kv_shard_q(kq, ks, vq, vs, new_k, new_v, kv_lens, *, axis):
     """Quantized twin of :func:`append_kv_shard`: the new rows quantize per
-    (batch, head) before landing in the int8 cache + scale plane."""
+    (batch, head) before landing in the int8 cache + scale plane.  The
+    scale planes reuse the same owner-rank write by riding through
+    :func:`append_kv_shard` as D=1 caches."""
     nk_q, nk_s = quantize_kv(new_k)          # [B, Hkv, D] i8, [B, Hkv]
     nv_q, nv_s = quantize_kv(new_v)
     kq, vq = append_kv_shard(kq, vq, nk_q, nv_q, kv_lens, axis=axis)
-    ks, vs = _append_scale_shard(ks, vs, nk_s, nv_s, kv_lens, axis=axis)
-    return kq, ks, vq, vs
-
-
-def _append_scale_shard(ks, vs, nk_s, nv_s, kv_lens, *, axis):
-    """Write one position's scales at kv_lens[b] (ks/vs [B, Hkv, S_loc])."""
-    s_loc = ks.shape[2]
-    me = jax.lax.axis_index(axis)
-
-    def per_batch(k_row, v_row, nk, nv, pos):
-        lp = jnp.clip(pos - me * s_loc, 0, s_loc - 1)
-        own = (pos >= me * s_loc) & (pos < (me + 1) * s_loc)
-
-        def upd(plane, new):
-            cur = jax.lax.dynamic_slice(plane, (0, lp),
-                                        (plane.shape[0], 1))
-            val = jnp.where(own, new[:, None].astype(plane.dtype), cur)
-            return jax.lax.dynamic_update_slice(plane, val, (0, lp))
-
-        return upd(k_row, nk), upd(v_row, nv)
-
-    return jax.vmap(per_batch)(ks, vs, nk_s, nv_s, kv_lens)
+    ks1, vs1 = append_kv_shard(ks[..., None], vs[..., None],
+                               nk_s[..., None], nv_s[..., None], kv_lens,
+                               axis=axis)
+    return kq, ks1[..., 0], vq, vs1[..., 0]
 
 
 def append_kv_shard(k_cache, v_cache, new_k, new_v, kv_lens, *, axis):
@@ -186,6 +170,11 @@ class SpGQAFlashDecodeAttention:
         stale.
         """
         quantized = isinstance(k_cache, dict)
+        assert quantized == self.quantized, (
+            "cache/layer mismatch: layer kv_dtype="
+            f"{self.kv_dtype} but cache is "
+            f"{'quantized' if quantized else 'float'} — was this cache "
+            "restored from a run with a different kv_dtype?")
         max_seq = (k_cache["q"] if quantized else k_cache).shape[2]
         if self.check_bounds and not isinstance(kv_lens, jax.core.Tracer):
             top = int(jnp.max(kv_lens))
@@ -216,6 +205,8 @@ class SpGQAFlashDecodeAttention:
 
     def __call__(self, q, k_cache, v_cache, kv_lens):
         """q [B, Hq, D] -> attention output [B, Hq, D] (replicated)."""
+        assert isinstance(k_cache, dict) == self.quantized, (
+            "cache/layer mismatch (see append_kv)")
         if isinstance(k_cache, dict):
             seq = P(None, None, self.ctx.axis)
             fn = cached_shard_jit(
