@@ -69,6 +69,9 @@ class Generator:
             kv_dtype=kv_dtype)   # jnp.int8 = quantized KV cache
         self._prefill_jit = jax.jit(functools.partial(
             _prompt_forward, cfg=cfg))
+        self._chunk_jit = jax.jit(
+            functools.partial(_chunk_forward, cfg=cfg),
+            static_argnames=("quantized",))
         self._step_jit = jax.jit(self._step_impl)
 
     # -- prefill ----------------------------------------------------------
@@ -88,6 +91,33 @@ class Generator:
                 dtype=cfg.dtype, k_init=k_new, v_init=v_new))
         return GenerationState(caches=caches, kv_lens=lens,
                                last_logits=logits[:, -1])
+
+    def prefill_chunked(self, params, tokens,
+                        chunk_size: int = 512) -> GenerationState:
+        """Prefill in fixed-size chunks against the growing KV cache.
+
+        Activation memory is bounded by the chunk (scores are [c, S]
+        instead of the one-shot prefill's [S0, S0]); each chunk's K/V
+        lands in the cache (quantized for int8 caches) and later chunks
+        attend to it.  Same final state as :meth:`prefill` up to KV-cache
+        quantization of earlier chunks.
+        """
+        cfg = self.cfg
+        B, S0 = tokens.shape
+        if S0 > self.max_seq:
+            raise ValueError(f"prompt length {S0} > max_seq {self.max_seq}")
+        caches = [self.attn.init_cache(B, cfg.n_kv_heads, self.max_seq,
+                                       cfg.head_dim, dtype=cfg.dtype)
+                  for _ in range(cfg.n_layers)]
+        logits = None
+        for off in range(0, S0, chunk_size):
+            chunk = tokens[:, off:off + chunk_size]
+            caches, logits = self._chunk_jit(
+                params, chunk, caches, jnp.int32(off),
+                quantized=self.attn.quantized)
+        return GenerationState(caches=caches,
+                               kv_lens=jnp.full((B,), S0, jnp.int32),
+                               last_logits=logits)
 
     # -- decode -----------------------------------------------------------
 
@@ -170,6 +200,96 @@ class Generator:
             state = self.step(params, state, token)
             outs.append(token)
         return jnp.stack(outs, axis=1), state
+
+
+def _attend_prefix(q, k_all, v_all, prefix_len, *, k_scale=None,
+                   v_scale=None):
+    """Chunk attention against the cache prefix + itself.
+
+    q [B, c, Hq, hd]; k/v_all [B, Hkv, S, hd] (the full cache, chunk rows
+    already written at [prefix, prefix+c)); position j is visible to chunk
+    row i iff j <= prefix + i.  Scores are [c, S] — the bounded-memory
+    core of chunked prefill.  Optional scales dequantize an int8 cache.
+    """
+    B, c, Hq, hd = q.shape
+    _, Hkv, S, _ = k_all.shape
+    g = Hq // Hkv
+    qf = q.astype(jnp.float32).reshape(B, c, Hkv, g, hd)
+    logits = jnp.einsum("bchgd,bhsd->bhgcs", qf,
+                        k_all.astype(jnp.float32)) / np.sqrt(hd)
+    if k_scale is not None:
+        logits = logits * k_scale[:, :, None, None, :]
+    pos = jnp.arange(S)[None, :]                     # [1, S]
+    limit = prefix_len + jnp.arange(c)[:, None]      # [c, 1]
+    mask = pos <= limit                              # [c, S]
+    logits = jnp.where(mask[None, None, None], logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    if v_scale is not None:
+        p = p * v_scale[:, :, None, None, :]
+    out = jnp.einsum("bhgcs,bhsd->bchgd", p, v_all.astype(jnp.float32))
+    return out.reshape(B, c, Hq, hd)
+
+
+def _write_chunk(cache, new, prefix_len, quantized):
+    """Write chunk K or V rows [B, Hkv, c, hd] at ``prefix_len``; for a
+    quantized cache dict, rows quantize and the scale plane updates too."""
+    from triton_dist_tpu.kernels.flash_decode import quantize_kv
+
+    if not quantized:
+        return jax.lax.dynamic_update_slice(
+            cache, new.astype(cache.dtype), (0, 0, prefix_len, 0))
+    q8, s = quantize_kv(new)
+    return {
+        "q": jax.lax.dynamic_update_slice(cache["q"], q8,
+                                          (0, 0, prefix_len, 0)),
+        "s": jax.lax.dynamic_update_slice(cache["s"], s,
+                                          (0, 0, prefix_len)),
+    }
+
+
+def _chunk_forward(params, chunk, caches, prefix_len, *, cfg: LlamaConfig,
+                   quantized: bool, ffn=None):
+    """One prompt chunk [B, c] against the cached prefix; returns
+    (new_caches, last_logits [B, V]).  The chunk's own K/V are written to
+    the cache first (quantized if the cache is), then attention reads the
+    cache back — so later chunks and the current one see identical
+    (possibly quantized) K/V, matching the decode path's behavior."""
+    if ffn is None:
+        ffn = _dense_prompt_ffn
+    B, c = chunk.shape
+    hd = cfg.head_dim
+    x = params["embed"][chunk]                       # [B, c, D]
+    positions = prefix_len + jnp.arange(c, dtype=jnp.int32)
+    new_caches = []
+    for li, layer in enumerate(params["layers"]):
+        k_c, v_c = caches[li]
+        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        h2 = h.reshape(B * c, cfg.dim)
+        q = (h2 @ layer["wq"]).reshape(B, c, cfg.n_heads, hd)
+        k = (h2 @ layer["wk"]).reshape(B, c, cfg.n_kv_heads, hd)
+        v = (h2 @ layer["wv"]).reshape(B, c, cfg.n_kv_heads, hd)
+        q = _rope(q.transpose(1, 0, 2, 3), positions,
+                  cfg.rope_theta).transpose(1, 0, 2, 3)
+        k = _rope(k.transpose(1, 0, 2, 3), positions,
+                  cfg.rope_theta).transpose(1, 0, 2, 3)
+        k_c = _write_chunk(k_c, k.transpose(0, 2, 1, 3), prefix_len,
+                           quantized)
+        v_c = _write_chunk(v_c, v.transpose(0, 2, 1, 3), prefix_len,
+                           quantized)
+        new_caches.append((k_c, v_c))
+        if quantized:
+            o = _attend_prefix(q, k_c["q"], v_c["q"], prefix_len,
+                               k_scale=k_c["s"], v_scale=v_c["s"])
+        else:
+            o = _attend_prefix(q, k_c, v_c, prefix_len)
+        o = o.reshape(B * c, cfg.n_heads * hd).astype(cfg.dtype)
+        x = x + (o @ layer["wo"]).reshape(B, c, cfg.dim)
+        h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps).reshape(
+            B * c, cfg.dim)
+        x = x + ffn(h2, layer).reshape(B, c, cfg.dim)
+    x = _rms_norm(x[:, -1], params["final_norm"], cfg.norm_eps)
+    return new_caches, jnp.dot(x, params["lm_head"],
+                               preferred_element_type=jnp.float32)
 
 
 def _dense_prompt_ffn(h2, layer):

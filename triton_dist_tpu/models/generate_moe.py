@@ -135,6 +135,12 @@ class MoEGenerator(Generator):
                          interpret=interpret, kv_dtype=kv_dtype)
         self._prefill_jit = jax.jit(functools.partial(
             _moe_prompt_forward, cfg=cfg))
+        from triton_dist_tpu.models.generate import _chunk_forward
+        self._chunk_jit = jax.jit(
+            functools.partial(_chunk_forward, cfg=cfg,
+                              ffn=functools.partial(_moe_prompt_ffn,
+                                                    cfg=cfg)),
+            static_argnames=("quantized",))
 
     def _ffn(self, x, layer):
         """Decode-step FFN: EP masked-expert compute + psum."""
