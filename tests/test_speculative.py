@@ -1,0 +1,58 @@
+"""Greedy speculative decoding (models/speculative.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_dist_tpu.models.generate import Generator
+from triton_dist_tpu.models.llama import LlamaConfig, init_params
+from triton_dist_tpu.models.speculative import SpeculativeGenerator
+
+
+def _target_cfg():
+    return LlamaConfig(vocab=64, dim=64, n_layers=2, n_heads=4,
+                       n_kv_heads=2, ffn_dim=128, max_seq=64,
+                       dtype=jnp.float32)
+
+
+def _draft_cfg():
+    return LlamaConfig(vocab=64, dim=32, n_layers=1, n_heads=2,
+                       n_kv_heads=2, ffn_dim=32, max_seq=64,
+                       dtype=jnp.float32)
+
+
+def test_identical_draft_accepts_everything(mesh4, key):
+    """Draft == target: every proposal accepted, passes ~ n/(k+1)."""
+    cfg = _target_cfg()
+    params = init_params(cfg, key)
+    tgt = Generator(cfg, mesh4, axis="tp", max_seq=64)
+    drf = Generator(cfg, mesh4, axis="tp", max_seq=64)
+    prompt = jax.random.randint(key, (1, 6), 0, cfg.vocab, jnp.int32)
+
+    ref, _ = tgt.generate(params, tgt.prefill(params, prompt), 12)
+
+    spec = SpeculativeGenerator(tgt, drf, k=4)
+    toks, stats = spec.generate(params, params, prompt, 12)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+    assert stats["accept_rate"] == 1.0, stats
+    # k+1 = 5 tokens per target pass when everything is accepted.
+    assert stats["target_passes"] <= int(np.ceil(12 / 5)) + 1, stats
+
+
+def test_independent_draft_output_is_exact_greedy(mesh4, key):
+    """Whatever the draft does, the output equals pure target greedy."""
+    tcfg, dcfg = _target_cfg(), _draft_cfg()
+    k1, k2 = jax.random.split(key)
+    t_params = init_params(tcfg, k1)
+    d_params = init_params(dcfg, k2)
+    tgt = Generator(tcfg, mesh4, axis="tp", max_seq=64)
+    drf = Generator(dcfg, mesh4, axis="tp", max_seq=64)
+    prompt = jax.random.randint(key, (1, 5), 0, tcfg.vocab, jnp.int32)
+
+    ref, _ = tgt.generate(t_params, tgt.prefill(t_params, prompt), 10)
+
+    spec = SpeculativeGenerator(tgt, drf, k=3)
+    toks, stats = spec.generate(t_params, d_params, prompt, 10)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+    assert 0.0 <= stats["accept_rate"] <= 1.0
+    assert stats["target_passes"] >= 1

@@ -51,3 +51,6 @@ from triton_dist_tpu.models.llama_w8a8 import (  # noqa: F401
     place_w8a8_params,
     quantize_params_w8a8,
 )
+from triton_dist_tpu.models.speculative import (  # noqa: F401
+    SpeculativeGenerator,
+)

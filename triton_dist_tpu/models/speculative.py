@@ -1,0 +1,115 @@
+"""Greedy speculative decoding: a draft model proposes, the target verifies.
+
+Serving-side addition beyond the reference (its decode story ends at the
+attention kernel).  The classic recipe (Leviathan et al. / Chen et al.,
+greedy variant): a small draft model autoregressively proposes ``k``
+tokens; the target model scores all ``k`` in ONE chunk forward over its
+KV cache (models/generate.py ``_chunk_forward`` — the same machinery as
+chunked prefill); the longest prefix whose tokens match the target's
+greedy choices is accepted, plus one bonus token from the target's own
+logits.  Output is **exactly** the target's greedy decode — the draft
+only changes how many expensive target passes are needed.
+
+Cache handling is rollback-by-length: the verify chunk writes all ``k``
+rows into the target cache, and rejected rows are simply left beyond
+``kv_lens`` (decode attention masks by length; later writes overwrite
+them).  Same for the draft's own cache.
+
+v1 scope: batch size 1 (per-row accept counts diverge the chunk prefix),
+greedy only.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_dist_tpu.models.generate import GenerationState, Generator
+
+
+def _greedy(logits) -> jax.Array:
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+class SpeculativeGenerator:
+    """Pairs a target and a draft :class:`Generator` (same tokenizer/vocab;
+    the draft is typically a much smaller config)."""
+
+    def __init__(self, target: Generator, draft: Generator, k: int = 4):
+        assert target.cfg.vocab == draft.cfg.vocab, "vocabularies differ"
+        self.target = target
+        self.draft = draft
+        self.k = int(k)
+
+    def generate(self, t_params, d_params, prompt, n_new: int):
+        """Greedy-decode ``n_new`` tokens for ``prompt`` [1, S0].
+
+        Returns (tokens [1, n_new], stats dict with ``target_passes`` and
+        ``accept_rate``) — tokens are bit-identical to
+        ``target.generate(...)`` greedy output.
+        """
+        assert prompt.shape[0] == 1, "speculative v1 is batch-1"
+        st = self.target.prefill(t_params, prompt)
+        sd = self.draft.prefill(d_params, prompt)
+
+        out: list[int] = []
+        n_target_passes = 0
+        n_proposed = 0
+        n_accepted = 0
+        while len(out) < n_new:
+            L = int(st.kv_lens[0])
+            k = min(self.k, self.target.max_seq - 1 - L,
+                    self.draft.max_seq - 1 - int(sd.kv_lens[0]))
+            if k <= 0:
+                raise ValueError("KV cache exhausted mid-speculation")
+
+            # 1. Draft proposes k greedy tokens (consuming them).
+            proposals = []
+            for _ in range(k):
+                tok = _greedy(sd.last_logits)
+                sd = self.draft.step(d_params, sd, tok)
+                proposals.append(int(tok[0]))
+            n_proposed += k
+
+            # 2. Target scores all k in one chunk forward.
+            chunk = jnp.asarray([proposals], jnp.int32)
+            new_caches, logits_all = self.target._chunk_jit(
+                t_params, chunk, st.caches, jnp.int32(L),
+                quantized=self.target.attn.quantized)
+            n_target_passes += 1
+
+            # 3. Accept the matching prefix; bonus token from the target.
+            expected = int(_greedy(st.last_logits)[0])
+            m = 0
+            while m < k and proposals[m] == expected:
+                out.append(proposals[m])
+                m += 1
+                expected = int(_greedy(logits_all[:, m - 1])[0])
+            n_accepted += m
+            bonus = expected  # the correct greedy token at position L+m
+            out.append(bonus)
+
+            # 4. Roll both models to the accepted length + consume bonus.
+            st = GenerationState(
+                caches=new_caches,
+                kv_lens=jnp.full((1,), L + m, jnp.int32),
+                last_logits=(st.last_logits if m == 0
+                             else logits_all[:, m - 1]))
+            st = self.target.step(t_params, st,
+                                  jnp.asarray([bonus], jnp.int32))
+            sd = GenerationState(
+                caches=sd.caches,
+                kv_lens=jnp.full((1,), L + m, jnp.int32),
+                last_logits=sd.last_logits)  # stale; refreshed by step
+            sd = self.draft.step(d_params, sd,
+                                 jnp.asarray([bonus], jnp.int32))
+
+        tokens = jnp.asarray([out[:n_new]], jnp.int32)
+        stats = {
+            "target_passes": n_target_passes,
+            "proposed": n_proposed,
+            "accepted": n_accepted,
+            "accept_rate": n_accepted / max(n_proposed, 1),
+        }
+        return tokens, stats
