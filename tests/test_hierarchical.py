@@ -127,3 +127,37 @@ def test_launcher_tears_down_on_worker_failure(tmp_path):
         capture_output=True, text=True, timeout=120, env=env)
     assert out.returncode != 0
     assert __import__("time").time() - t0 < 30, "launcher failed to tear down"
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_hier_all_to_all_matches_flat(impl, mesh2d, key):
+    """Two-tier a2a == flat fast_all_to_all on a 2x4 (dp x tp) mesh."""
+    from triton_dist_tpu.kernels.all_to_all import fast_all_to_all_shard
+    from triton_dist_tpu.kernels.hierarchical import hier_all_to_all_shard
+    from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
+
+    world, T, H = 8, 4, 32
+    x = jax.random.normal(key, (world * world, T, H), jnp.float32)
+    splits = jax.random.randint(jax.random.fold_in(key, 1),
+                                (world * world,), 0, T + 1, jnp.int32)
+
+    def flat(send, sp, *, impl, interpret):
+        return fast_all_to_all_shard(
+            send, sp, axis=("dp", "tp"), impl="xla", interpret=interpret)
+
+    def hier(send, sp, *, impl, interpret):
+        return hier_all_to_all_shard(send, sp, slow_axis="dp",
+                                     fast_axis="tp", impl=impl,
+                                     interpret=interpret)
+
+    specs = (P(("dp", "tp")), P(("dp", "tp")))
+    out_specs = (P(("dp", "tp")), P(("dp", "tp")))
+    f_flat = cached_shard_jit(flat, mesh2d, specs, out_specs,
+                              impl="xla", interpret=False)
+    f_hier = cached_shard_jit(hier, mesh2d, specs, out_specs,
+                              impl=impl, interpret=(impl == "pallas"))
+    r_ref, s_ref = f_flat(x, splits)
+    r_got, s_got = f_hier(x, splits)
+    np.testing.assert_allclose(np.asarray(r_got), np.asarray(r_ref),
+                               rtol=0, atol=0)
+    np.testing.assert_array_equal(np.asarray(s_got), np.asarray(s_ref))

@@ -124,9 +124,12 @@ def _a2a_kernel(send_ref, splits_ref, recv_ref, recv_splits_ref,
         pltpu.make_async_copy(srow, srow, recv_sem).wait()
 
 
-def fast_all_to_all_shard(send, splits, *, axis, impl, interpret):
+def fast_all_to_all_shard(send, splits, *, axis, impl, interpret,
+                          collective_id=A2A_COLLECTIVE_ID):
     """Shard-level entry.  send: [world, max_tokens, H]; splits: [world] i32.
-    Returns (recv [world, max_tokens, H], recv_splits [world])."""
+    Returns (recv [world, max_tokens, H], recv_splits [world]).
+    ``collective_id`` must differ between a2a kernels composed in one
+    program (the hierarchical two-stage path)."""
     impl = resolve_impl(impl, interpret)
     world, max_tokens, hidden = send.shape
 
@@ -154,7 +157,7 @@ def fast_all_to_all_shard(send, splits, *, axis, impl, interpret):
             pltpu.SemaphoreType.DMA,
         ],
         compiler_params=dl.collective_compiler_params(
-            world, A2A_COLLECTIVE_ID),
+            world, collective_id),
         interpret=maybe_interpret(interpret),
     )(send, splits_row)
     return recv, recv_splits_row[:, 0]

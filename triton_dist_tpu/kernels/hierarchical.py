@@ -31,6 +31,7 @@ from triton_dist_tpu.kernels.reduce_scatter import (
 
 __all__ = [
     "hier_all_gather_shard",
+    "hier_all_to_all_shard",
     "hier_reduce_scatter_shard",
     "hier_rs_band_index",
 ]
@@ -66,6 +67,58 @@ def hier_rs_band_index(slow_axis: str, fast_axis: str):
     i = jax.lax.axis_index(slow_axis)
     j = jax.lax.axis_index(fast_axis)
     return j * d + i
+
+
+def hier_all_to_all_shard(send, splits, *, slow_axis: str, fast_axis: str,
+                          impl="auto", interpret: bool = False):
+    """Two-tier token AllToAll: every token crosses the slow wire at most
+    once, then fans out inside its destination slice.
+
+    Reference analog: ``kernel_dispatch_token`` (ep_a2a.py:35-146) — the
+    DeepEP cross-node trick: tokens putmem to the *same-local-rank* peer
+    on the target node first, then scatter locally to expert ranks.  Here
+    the two hops are a slow-axis AllToAll of per-slice bundles followed by
+    a fast-axis AllToAll within the slice.
+
+    Contract matches the flat ``fast_all_to_all_shard`` with flat rank
+    ``r = i * T_fast + j`` (slow-major): send [world, T, H] block ``d``
+    goes to flat rank ``d``; recv block ``s`` arrived from flat rank
+    ``s``; splits [world] i32 ride alongside.
+    """
+    from triton_dist_tpu.kernels.all_to_all import fast_all_to_all_shard
+
+    d_ = jax.lax.axis_size(slow_axis)
+    t_ = jax.lax.axis_size(fast_axis)
+    world, tokens, hidden = send.shape
+    assert world == d_ * t_, (world, d_, t_)
+
+    # Stage 1 (slow): bundle by destination slice; peer p along the slow
+    # axis is chip (p, j_me) — the same-lane chip on slice p.
+    bundles = send.reshape(d_, t_ * tokens, hidden)
+    s1, _ = fast_all_to_all_shard(
+        bundles, jnp.zeros((d_,), jnp.int32), axis=slow_axis, impl=impl,
+        interpret=interpret, collective_id=12)
+    sp1, _ = fast_all_to_all_shard(
+        splits.reshape(d_, t_, 1).astype(jnp.int32),
+        jnp.zeros((d_,), jnp.int32), axis=slow_axis, impl="xla",
+        interpret=interpret)
+
+    # s1[p] = tokens from chip (p, j_me) for every lane of MY slice:
+    # [d_, t_lane, T, H] → regroup by destination lane for stage 2.
+    s1 = s1.reshape(d_, t_, tokens, hidden)
+    stage2 = jnp.moveaxis(s1, 1, 0).reshape(t_, d_ * tokens, hidden)
+    s2, _ = fast_all_to_all_shard(
+        stage2, jnp.zeros((t_,), jnp.int32), axis=fast_axis, impl=impl,
+        interpret=interpret, collective_id=13)
+    sp2, _ = fast_all_to_all_shard(
+        jnp.moveaxis(sp1, 1, 0), jnp.zeros((t_,), jnp.int32),
+        axis=fast_axis, impl="xla", interpret=interpret)
+
+    # s2[q][p] = tokens from chip (p, q) → flat source order p * t_ + q.
+    recv = jnp.moveaxis(s2.reshape(t_, d_, tokens, hidden), 1, 0)
+    recv = recv.reshape(world, tokens, hidden)
+    recv_splits = jnp.moveaxis(sp2, 1, 0).reshape(world)
+    return recv, recv_splits
 
 
 def hier_reduce_scatter_shard(x, *, slow_axis: str, fast_axis: str,
