@@ -56,3 +56,20 @@ def test_independent_draft_output_is_exact_greedy(mesh4, key):
     np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
     assert 0.0 <= stats["accept_rate"] <= 1.0
     assert stats["target_passes"] >= 1
+
+
+def test_cache_edge_falls_back_to_plain_steps(mesh4, key):
+    """Near max_seq the speculator degrades to plain greedy instead of
+    raising (regression: it used to error with cache headroom left)."""
+    cfg = LlamaConfig(vocab=64, dim=32, n_layers=1, n_heads=4,
+                      n_kv_heads=2, ffn_dim=32, max_seq=16,
+                      dtype=jnp.float32)
+    params = init_params(cfg, key)
+    tgt = Generator(cfg, mesh4, axis="tp", max_seq=16)
+    drf = Generator(cfg, mesh4, axis="tp", max_seq=16)
+    prompt = jax.random.randint(key, (1, 10), 0, cfg.vocab, jnp.int32)
+
+    ref, _ = tgt.generate(params, tgt.prefill(params, prompt), 6)  # 10+6=16
+    spec = SpeculativeGenerator(tgt, drf, k=4)
+    toks, _ = spec.generate(params, params, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))

@@ -69,9 +69,12 @@ class Generator:
             kv_dtype=kv_dtype)   # jnp.int8 = quantized KV cache
         self._prefill_jit = jax.jit(functools.partial(
             _prompt_forward, cfg=cfg))
+        # caches are donated: each chunk's dynamic-update happens in place
+        # instead of copying every layer's full-size cache per chunk.
         self._chunk_jit = jax.jit(
             functools.partial(_chunk_forward, cfg=cfg),
-            static_argnames=("quantized",))
+            static_argnames=("quantized", "extent"),
+            donate_argnums=(2,))
         self._step_jit = jax.jit(self._step_impl)
 
     # -- prefill ----------------------------------------------------------
@@ -110,14 +113,19 @@ class Generator:
                                        cfg.head_dim, dtype=cfg.dtype)
                   for _ in range(cfg.n_layers)]
         logits = None
+        # Attention only needs cache rows [0, S0); slicing to a fixed
+        # extent keeps scores at [chunk, ~S0] instead of [chunk, max_seq]
+        # (one trace per extent — constant across this prefill's chunks).
+        extent = min(self.max_seq,
+                     -(-S0 // chunk_size) * chunk_size)
         for off in range(0, S0, chunk_size):
             chunk = tokens[:, off:off + chunk_size]
             caches, logits = self._chunk_jit(
                 params, chunk, caches, jnp.int32(off),
-                quantized=self.attn.quantized)
+                quantized=self.attn.quantized, extent=extent)
         return GenerationState(caches=caches,
                                kv_lens=jnp.full((B,), S0, jnp.int32),
-                               last_logits=logits)
+                               last_logits=logits[:, -1])
 
     # -- decode -----------------------------------------------------------
 
@@ -248,12 +256,16 @@ def _write_chunk(cache, new, prefix_len, quantized):
 
 
 def _chunk_forward(params, chunk, caches, prefix_len, *, cfg: LlamaConfig,
-                   quantized: bool, ffn=None):
+                   quantized: bool, ffn=None, extent: int | None = None):
     """One prompt chunk [B, c] against the cached prefix; returns
-    (new_caches, last_logits [B, V]).  The chunk's own K/V are written to
-    the cache first (quantized if the cache is), then attention reads the
-    cache back — so later chunks and the current one see identical
-    (possibly quantized) K/V, matching the decode path's behavior."""
+    (new_caches, logits [B, c, V] — position i predicts the token after
+    chunk[:, i]).  The chunk's own K/V are written to the cache first
+    (quantized if the cache is), then attention reads the cache back — so
+    later chunks and the current one see identical (possibly quantized)
+    K/V, matching the decode path's behavior.  Speculative verification
+    (models/speculative.py) consumes the full per-position logits.
+    ``extent`` (static) bounds the cache rows attention reads — scores
+    stay [c, extent] instead of [c, max_seq]."""
     if ffn is None:
         ffn = _dense_prompt_ffn
     B, c = chunk.shape
@@ -277,17 +289,21 @@ def _chunk_forward(params, chunk, caches, prefix_len, *, cfg: LlamaConfig,
         v_c = _write_chunk(v_c, v.transpose(0, 2, 1, 3), prefix_len,
                            quantized)
         new_caches.append((k_c, v_c))
+        ext = extent or (k_c["q"] if quantized else k_c).shape[2]
         if quantized:
-            o = _attend_prefix(q, k_c["q"], v_c["q"], prefix_len,
-                               k_scale=k_c["s"], v_scale=v_c["s"])
+            o = _attend_prefix(q, k_c["q"][:, :, :ext],
+                               v_c["q"][:, :, :ext], prefix_len,
+                               k_scale=k_c["s"][:, :, :ext],
+                               v_scale=v_c["s"][:, :, :ext])
         else:
-            o = _attend_prefix(q, k_c, v_c, prefix_len)
+            o = _attend_prefix(q, k_c[:, :, :ext], v_c[:, :, :ext],
+                               prefix_len)
         o = o.reshape(B * c, cfg.n_heads * hd).astype(cfg.dtype)
         x = x + (o @ layer["wo"]).reshape(B, c, cfg.dim)
         h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps).reshape(
             B * c, cfg.dim)
         x = x + ffn(h2, layer).reshape(B, c, cfg.dim)
-    x = _rms_norm(x[:, -1], params["final_norm"], cfg.norm_eps)
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     return new_caches, jnp.dot(x, params["lm_head"],
                                preferred_element_type=jnp.float32)
 

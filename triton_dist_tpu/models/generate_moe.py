@@ -140,7 +140,8 @@ class MoEGenerator(Generator):
             functools.partial(_chunk_forward, cfg=cfg,
                               ffn=functools.partial(_moe_prompt_ffn,
                                                     cfg=cfg)),
-            static_argnames=("quantized",))
+            static_argnames=("quantized", "extent"),
+            donate_argnums=(2,))
 
     def _ffn(self, x, layer):
         """Decode-step FFN: EP masked-expert compute + psum."""

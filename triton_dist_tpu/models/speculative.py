@@ -62,7 +62,15 @@ class SpeculativeGenerator:
             k = min(self.k, self.target.max_seq - 1 - L,
                     self.draft.max_seq - 1 - int(sd.kv_lens[0]))
             if k <= 0:
-                raise ValueError("KV cache exhausted mid-speculation")
+                # No headroom to speculate (last cache slots): fall back
+                # to plain greedy target steps — same behavior as
+                # Generator.generate, which this must never under-serve.
+                tok = _greedy(st.last_logits)
+                out.append(int(tok[0]))
+                if len(out) < n_new:
+                    st = self.target.step(t_params, st, tok)
+                    n_target_passes += 1
+                continue
 
             # 1. Draft proposes k greedy tokens (consuming them).
             proposals = []
