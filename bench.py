@@ -61,12 +61,15 @@ def _make_chain(mesh, n_iters):
         out_specs=P(), check_vma=False))
 
 
-def _paired_diff_time(fn_short, fn_long, *args, n_extra, trials=6):
+def _paired_diff_time(fn_short, fn_long, *args, n_extra, trials=14):
     """Median of per-trial (long - short) / n_extra chain times.
 
     Pairing short/long inside each trial cancels tunnel-RTT drift that
     independently-taken best-of-N times do not (observed 1.7x swings on
-    the axon tunnel with unpaired timing)."""
+    the axon tunnel with unpaired timing); the median over a generous
+    trial count rejects congestion outliers in either direction (a
+    min/best-of estimator is biased optimistic here — congested t_short
+    inflates the diff's complement and min() happily reports >peak)."""
     diffs = []
     for _ in range(trials):
         t0 = time.perf_counter()
