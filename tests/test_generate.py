@@ -74,3 +74,30 @@ def test_overflow_raises(mesh_sp, key):
     with pytest.raises(ValueError, match="max_seq"):
         gen.prefill(params, jax.random.randint(
             jax.random.key(5), (1, 16), 0, cfg.vocab))
+
+
+def test_prefill_state_reuse_prompt_caching(mesh2, key):
+    """GenerationState is functional: one prefill seeds many generations
+    (prompt caching across requests for free)."""
+    from triton_dist_tpu.models.llama import LlamaConfig, init_params
+    from triton_dist_tpu.models.generate import Generator
+    from triton_dist_tpu.models.sampling import make_sampler
+
+    cfg = LlamaConfig(vocab=64, dim=32, n_layers=1, n_heads=4, n_kv_heads=2,
+                      ffn_dim=64, max_seq=32, dtype=jnp.float32)
+    params = init_params(cfg, key)
+    gen = Generator(cfg, mesh2, axis="tp", max_seq=32)
+    prompt = jax.random.randint(key, (2, 6), 0, cfg.vocab, jnp.int32)
+
+    state = gen.prefill(params, prompt)          # processed once
+    greedy, _ = gen.generate(params, state, 5)
+    sampler = make_sampler(temperature=1.2)
+    s1, _ = gen.generate(params, state, 5, sample=sampler, key=key)
+    s2, _ = gen.generate(params, state, 5, sample=sampler,
+                         key=jax.random.fold_in(key, 9))
+    greedy_again, _ = gen.generate(params, state, 5)
+
+    # The shared state is untouched by earlier generations.
+    np.testing.assert_array_equal(np.asarray(greedy),
+                                  np.asarray(greedy_again))
+    assert not np.array_equal(np.asarray(s1), np.asarray(s2))
