@@ -69,6 +69,46 @@ def main():
         print(f"a2a {name:10s} {TOKENS} tok x {hidden} cols: "
               f"{us:7.1f} us/iter (single-chip floor)")
 
+    _bench_decode_gather(mesh)
+
+
+def _bench_decode_gather(mesh):
+    """Floor of the SP-decode per-step partials gather (the LL-AG role:
+    one [B, Hq, D+1] f32 payload per chip per decode step)."""
+    from triton_dist_tpu.kernels.low_latency_allgather import (
+        fast_allgather_shard)
+
+    B, Hq, D1 = 8, 32, 129
+    send = jnp.zeros((B, Hq, D1), jnp.float32)
+
+    def body_fn(x):
+        def body(i, x):
+            g = fast_allgather_shard(x, axis="ep", impl="pallas",
+                                     interpret=False)
+            return g.reshape(1, B, Hq, D1)[0]
+        return jax.lax.fori_loop(0, N_EXTRA, body, x)[0, 0, 0]
+
+    def body_one(x):
+        g = fast_allgather_shard(x, axis="ep", impl="pallas",
+                                 interpret=False)
+        return g.reshape(1, B, Hq, D1)[0][0, 0, 0]
+
+    cn = jax.jit(jax.shard_map(body_fn, mesh=mesh, in_specs=P(),
+                               out_specs=P(), check_vma=False))
+    c1 = jax.jit(jax.shard_map(body_one, mesh=mesh, in_specs=P(),
+                               out_specs=P(), check_vma=False))
+    float(c1(send)); float(cn(send))
+    diffs = []
+    for _ in range(9):
+        t0 = time.perf_counter(); float(c1(send))
+        t1 = time.perf_counter() - t0
+        t0 = time.perf_counter(); float(cn(send))
+        tn = time.perf_counter() - t0
+        diffs.append((tn - t1) / N_EXTRA)
+    us = float(np.median(diffs)) * 1e6
+    print(f"ll-ag decode partials [8, 32, 129] f32: {us:7.1f} us/iter "
+          f"(single-chip floor)")
+
 
 if __name__ == "__main__":
     main()
