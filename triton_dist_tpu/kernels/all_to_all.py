@@ -129,9 +129,23 @@ def fast_all_to_all_shard(send, splits, *, axis, impl, interpret,
     """Shard-level entry.  send: [world, max_tokens, H]; splits: [world] i32.
     Returns (recv [world, max_tokens, H], recv_splits [world]).
     ``collective_id`` must differ between a2a kernels composed in one
-    program (the hierarchical two-stage path)."""
+    program (the hierarchical two-stage path).
+
+    A 2-tuple ``axis`` (slow, fast — e.g. ("dcn", "ici")) routes the
+    pallas impl through the hierarchical two-stage kernel (every token
+    crosses the slow wire once); the XLA impl hands the tuple to
+    ``jax.lax.all_to_all`` directly.  Flat rank order is slow-major
+    either way."""
     impl = resolve_impl(impl, interpret)
     world, max_tokens, hidden = send.shape
+
+    if impl != "xla" and isinstance(axis, (tuple, list)) and len(axis) == 2:
+        from triton_dist_tpu.kernels.hierarchical import (
+            hier_all_to_all_shard)
+
+        return hier_all_to_all_shard(send, splits, slow_axis=axis[0],
+                                     fast_axis=axis[1], impl=impl,
+                                     interpret=interpret)
 
     if impl == "xla":
         recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0,

@@ -29,6 +29,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from triton_dist_tpu.kernels.group_gemm import moe_ffn_sorted
@@ -152,7 +153,11 @@ class DistributedMoELayer:
     weights: dict = field(default=None)
 
     def __post_init__(self):
-        self.world = self.mesh.shape[self.axis]
+        # axis may be one mesh axis or a (slow, fast) tuple — the latter
+        # routes dispatch/combine through the two-tier AllToAll
+        # (kernels/hierarchical.py); world is the product either way.
+        axes = (self.axis,) if isinstance(self.axis, str) else self.axis
+        self.world = int(np.prod([self.mesh.shape[a] for a in axes]))
         assert self.n_experts % self.world == 0, (self.n_experts, self.world)
 
     @property
