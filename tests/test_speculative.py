@@ -73,3 +73,45 @@ def test_cache_edge_falls_back_to_plain_steps(mesh4, key):
     spec = SpeculativeGenerator(tgt, drf, k=4)
     toks, _ = spec.generate(params, params, prompt, 6)
     np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+
+
+def test_accept_step_preserves_target_distribution(key):
+    """Monte Carlo: marginal of speculative_accept_step == pi."""
+    from triton_dist_tpu.models.speculative import speculative_accept_step
+
+    V, N = 6, 40000
+    k1, k2 = jax.random.split(key)
+    pi = jax.nn.softmax(jax.random.normal(k1, (V,)) * 1.5)
+    rho = jax.nn.softmax(jax.random.normal(k2, (V,)) * 1.5)
+
+    keys = jax.random.split(jax.random.fold_in(key, 7), N)
+    props = jax.random.categorical(
+        jax.random.fold_in(key, 8), jnp.log(rho)[None].repeat(N, 0))
+
+    def one(p, kk):
+        _, tok = speculative_accept_step(pi, rho, p, kk)
+        return tok
+
+    toks = np.asarray(jax.vmap(one)(props.astype(jnp.int32), keys))
+    freq = np.bincount(toks, minlength=V) / N
+    np.testing.assert_allclose(freq, np.asarray(pi), atol=0.01)
+
+
+def test_sampler_reproducible_and_in_vocab(mesh4, key):
+    from triton_dist_tpu.models.speculative import SpeculativeSampler
+
+    tcfg, dcfg = _target_cfg(), _draft_cfg()
+    k1, k2 = jax.random.split(key)
+    t_params = init_params(tcfg, k1)
+    d_params = init_params(dcfg, k2)
+    tgt = Generator(tcfg, mesh4, axis="tp", max_seq=64)
+    drf = Generator(dcfg, mesh4, axis="tp", max_seq=64)
+    prompt = jax.random.randint(key, (1, 5), 0, tcfg.vocab, jnp.int32)
+
+    spec = SpeculativeSampler(tgt, drf, k=3, temperature=0.9, top_k=32)
+    a, stats = spec.generate(t_params, d_params, prompt, 8, key)
+    b, _ = spec.generate(t_params, d_params, prompt, 8, key)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert a.shape == (1, 8)
+    assert 0 <= int(jnp.min(a)) and int(jnp.max(a)) < tcfg.vocab
+    assert 0.0 <= stats["accept_rate"] <= 1.0

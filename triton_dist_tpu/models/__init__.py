@@ -53,4 +53,5 @@ from triton_dist_tpu.models.llama_w8a8 import (  # noqa: F401
 )
 from triton_dist_tpu.models.speculative import (  # noqa: F401
     SpeculativeGenerator,
+    SpeculativeSampler,
 )

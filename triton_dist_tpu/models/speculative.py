@@ -1,35 +1,83 @@
-"""Greedy speculative decoding: a draft model proposes, the target verifies.
+"""Speculative decoding: a draft model proposes, the target verifies.
 
 Serving-side addition beyond the reference (its decode story ends at the
-attention kernel).  The classic recipe (Leviathan et al. / Chen et al.,
-greedy variant): a small draft model autoregressively proposes ``k``
-tokens; the target model scores all ``k`` in ONE chunk forward over its
-KV cache (models/generate.py ``_chunk_forward`` — the same machinery as
-chunked prefill); the longest prefix whose tokens match the target's
-greedy choices is accepted, plus one bonus token from the target's own
-logits.  Output is **exactly** the target's greedy decode — the draft
-only changes how many expensive target passes are needed.
+attention kernel).  The classic recipe (Leviathan et al. / Chen et al.):
+a small draft model autoregressively proposes ``k`` tokens; the target
+model scores all ``k`` in ONE chunk forward over its KV cache
+(models/generate.py ``_chunk_forward`` — the same machinery as chunked
+prefill); proposals are accepted left to right, plus one bonus token.
+
+Two verifiers:
+- :class:`SpeculativeGenerator` — greedy: accept while the proposal
+  matches the target argmax.  Output is bit-identical to the target's
+  own greedy decode.
+- :class:`SpeculativeSampler` — stochastic rejection sampling: accept
+  proposal ``x`` with prob ``min(1, π(x)/ρ(x))`` (π target, ρ draft,
+  both post temperature/top-k/top-p), resample the first rejection from
+  the residual ``normalize(max(π - ρ, 0))``.  The emitted distribution
+  equals direct sampling from the target (:func:`speculative_accept_step`
+  carries the per-step math; its distributional correctness is unit
+  tested by Monte Carlo).
 
 Cache handling is rollback-by-length: the verify chunk writes all ``k``
 rows into the target cache, and rejected rows are simply left beyond
 ``kv_lens`` (decode attention masks by length; later writes overwrite
 them).  Same for the draft's own cache.
 
-v1 scope: batch size 1 (per-row accept counts diverge the chunk prefix),
-greedy only.
+v1 scope: batch size 1 (per-row accept counts diverge the chunk prefix).
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from triton_dist_tpu.models.generate import GenerationState, Generator
+from triton_dist_tpu.models.sampling import _apply_top_k, _apply_top_p
 
 
 def _greedy(logits) -> jax.Array:
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("temperature", "top_k", "top_p"))
+def filtered_probs(logits, *, temperature: float, top_k=None, top_p=None):
+    """logits [..., V] → the post-filter sampling distribution π [..., V]
+    (what ``sampling.sample_logits`` draws from)."""
+    x = logits.astype(jnp.float32) / temperature
+    if top_k is not None and 0 < top_k < x.shape[-1]:
+        x = _apply_top_k(x, top_k)
+    if top_p is not None and top_p < 1.0:
+        x = _apply_top_p(x, top_p)
+    return jax.nn.softmax(x, axis=-1)
+
+
+@jax.jit
+def speculative_accept_step(pi, rho, proposal, key):
+    """One rejection-sampling step.  pi/rho [V] (target/draft sampling
+    distributions), proposal scalar int32 drawn from rho.
+
+    Returns (accepted bool, token int32): accept the proposal with
+    probability ``min(1, pi/rho)``; otherwise draw from the residual
+    ``normalize(max(pi - rho, 0))``.  Marginally, token ~ pi — the
+    standard speculative-sampling identity.
+    """
+    k1, k2 = jax.random.split(key)
+    ratio = pi[proposal] / jnp.maximum(rho[proposal], 1e-20)
+    accepted = jax.random.uniform(k1) < jnp.minimum(ratio, 1.0)
+    residual = jnp.maximum(pi - rho, 0.0)
+    total = jnp.sum(residual)
+    # Degenerate residual (rho covers pi, ratio>=1 everywhere → accepted
+    # is certain; the fallback to pi keeps categorical well-defined).
+    residual = jnp.where(total > 0, residual / jnp.maximum(total, 1e-20),
+                         pi)
+    alt = jax.random.categorical(k2, jnp.log(residual + 1e-30))
+    token = jnp.where(accepted, proposal, alt).astype(jnp.int32)
+    return accepted, token
 
 
 class SpeculativeGenerator:
@@ -112,6 +160,118 @@ class SpeculativeGenerator:
                 last_logits=sd.last_logits)  # stale; refreshed by step
             sd = self.draft.step(d_params, sd,
                                  jnp.asarray([bonus], jnp.int32))
+
+        tokens = jnp.asarray([out[:n_new]], jnp.int32)
+        stats = {
+            "target_passes": n_target_passes,
+            "proposed": n_proposed,
+            "accepted": n_accepted,
+            "accept_rate": n_accepted / max(n_proposed, 1),
+        }
+        return tokens, stats
+
+
+class SpeculativeSampler:
+    """Stochastic speculative decoding (rejection sampling).
+
+    Same pairing as :class:`SpeculativeGenerator`; the draft *samples* its
+    proposals and the target accepts/resamples so the emitted stream is
+    distributed exactly as direct target sampling with the same
+    temperature/top-k/top-p knobs.
+    """
+
+    def __init__(self, target: Generator, draft: Generator, k: int = 4, *,
+                 temperature: float = 1.0, top_k=None, top_p=None):
+        assert target.cfg.vocab == draft.cfg.vocab, "vocabularies differ"
+        assert temperature > 0, "use SpeculativeGenerator for greedy"
+        self.target = target
+        self.draft = draft
+        self.k = int(k)
+        self._probs = functools.partial(
+            filtered_probs, temperature=temperature, top_k=top_k,
+            top_p=top_p)
+
+    def generate(self, t_params, d_params, prompt, n_new: int, key):
+        """Sample ``n_new`` tokens.  Returns (tokens [1, n_new], stats)."""
+        assert prompt.shape[0] == 1, "speculative v1 is batch-1"
+        st = self.target.prefill(t_params, prompt)
+        sd = self.draft.prefill(d_params, prompt)
+
+        out: list[int] = []
+        n_target_passes = 0
+        n_proposed = 0
+        n_accepted = 0
+        while len(out) < n_new:
+            L = int(st.kv_lens[0])
+            k = min(self.k, self.target.max_seq - 1 - L,
+                    self.draft.max_seq - 1 - int(sd.kv_lens[0]))
+            if k <= 0:
+                key, sub = jax.random.split(key)
+                pi = self._probs(st.last_logits[0])
+                tok = jax.random.categorical(
+                    sub, jnp.log(pi + 1e-30)).astype(jnp.int32)[None]
+                out.append(int(tok[0]))
+                if len(out) < n_new:
+                    st = self.target.step(t_params, st, tok)
+                    n_target_passes += 1
+                continue
+
+            # 1. Draft samples k proposals (recording its distributions).
+            proposals, rhos = [], []
+            for _ in range(k):
+                key, sub = jax.random.split(key)
+                rho = self._probs(sd.last_logits[0])      # [V]
+                tok = jax.random.categorical(
+                    sub, jnp.log(rho + 1e-30)).astype(jnp.int32)[None]
+                rhos.append(rho)
+                sd = self.draft.step(d_params, sd, tok)
+                proposals.append(int(tok[0]))
+            n_proposed += k
+
+            # 2. Target scores all k in one chunk forward.
+            chunk = jnp.asarray([proposals], jnp.int32)
+            new_caches, logits_all = self.target._chunk_jit(
+                t_params, chunk, st.caches, jnp.int32(L),
+                quantized=self.target.attn.quantized)
+            n_target_passes += 1
+
+            # 3. Left-to-right accept/resample.
+            m = 0
+            emitted = None
+            while m < k:
+                pi = self._probs(st.last_logits[0] if m == 0
+                                 else logits_all[0, m - 1])
+                key, sub = jax.random.split(key)
+                accepted, token = speculative_accept_step(
+                    pi, rhos[m], jnp.int32(proposals[m]), sub)
+                if not bool(accepted):
+                    emitted = int(token)      # residual resample; stop
+                    break
+                out.append(int(token))
+                m += 1
+            n_accepted += m
+            if emitted is None:
+                # All k accepted: bonus sample from the target's own
+                # next-position distribution.
+                pi = self._probs(logits_all[0, k - 1])
+                key, sub = jax.random.split(key)
+                emitted = int(jax.random.categorical(
+                    sub, jnp.log(pi + 1e-30)))
+            out.append(emitted)
+
+            # 4. Roll both models to the accepted length + consume emitted.
+            bonus = jnp.asarray([emitted], jnp.int32)
+            st = GenerationState(
+                caches=new_caches,
+                kv_lens=jnp.full((1,), L + m, jnp.int32),
+                last_logits=(st.last_logits if m == 0
+                             else logits_all[:, m - 1]))
+            st = self.target.step(t_params, st, bonus)
+            sd = GenerationState(
+                caches=sd.caches,
+                kv_lens=jnp.full((1,), L + m, jnp.int32),
+                last_logits=sd.last_logits)
+            sd = self.draft.step(d_params, sd, bonus)
 
         tokens = jnp.asarray([out[:n_new]], jnp.int32)
         stats = {
