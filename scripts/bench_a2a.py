@@ -29,6 +29,17 @@ TOKENS, HIDDEN = 128, 7168
 N_EXTRA = 4096
 
 
+def _timed_us(c1, cn, *args, n_extra=None):
+    """bench.py's paired-diff protocol (one shared implementation): warm
+    both chains, then median over 9 trials of (t_long - t_short)/extra."""
+    from bench import _paired_diff_time
+
+    float(c1(*args)); float(cn(*args))
+    return _paired_diff_time(c1, cn, *args,
+                             n_extra=N_EXTRA if n_extra is None else n_extra,
+                             trials=9) * 1e6
+
+
 def make_chain(mesh, n):
     shard = functools.partial(fast_all_to_all_shard, axis="ep",
                               impl="pallas", interpret=False)
@@ -57,15 +68,7 @@ def main():
         send = jnp.zeros((1, TOKENS, hidden), dtype)
         splits = jnp.full((1,), TOKENS, jnp.int32)
         c1, cn = make_chain(mesh, 1), make_chain(mesh, 1 + N_EXTRA)
-        float(c1(send, splits)); float(cn(send, splits))
-        diffs = []
-        for _ in range(9):
-            t0 = time.perf_counter(); float(c1(send, splits))
-            t1 = time.perf_counter() - t0
-            t0 = time.perf_counter(); float(cn(send, splits))
-            tn = time.perf_counter() - t0
-            diffs.append((tn - t1) / N_EXTRA)
-        us = float(np.median(diffs)) * 1e6
+        us = _timed_us(c1, cn, send, splits)
         print(f"a2a {name:10s} {TOKENS} tok x {hidden} cols: "
               f"{us:7.1f} us/iter (single-chip floor)")
 
@@ -97,15 +100,7 @@ def _bench_decode_gather(mesh):
                                out_specs=P(), check_vma=False))
     c1 = jax.jit(jax.shard_map(body_one, mesh=mesh, in_specs=P(),
                                out_specs=P(), check_vma=False))
-    float(c1(send)); float(cn(send))
-    diffs = []
-    for _ in range(9):
-        t0 = time.perf_counter(); float(c1(send))
-        t1 = time.perf_counter() - t0
-        t0 = time.perf_counter(); float(cn(send))
-        tn = time.perf_counter() - t0
-        diffs.append((tn - t1) / N_EXTRA)
-    us = float(np.median(diffs)) * 1e6
+    us = _timed_us(c1, cn, send, n_extra=N_EXTRA - 1)
     print(f"ll-ag decode partials [8, 32, 129] f32: {us:7.1f} us/iter "
           f"(single-chip floor)")
 

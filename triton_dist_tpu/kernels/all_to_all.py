@@ -143,9 +143,13 @@ def fast_all_to_all_shard(send, splits, *, axis, impl, interpret,
         from triton_dist_tpu.kernels.hierarchical import (
             hier_all_to_all_shard)
 
-        return hier_all_to_all_shard(send, splits, slow_axis=axis[0],
-                                     fast_axis=axis[1], impl=impl,
-                                     interpret=interpret)
+        # Two-stage path needs two ids; 2*cid+2/3 keeps distinct caller
+        # ids distinct and maps the default (5) onto the hierarchical
+        # kernels' reserved pair (12, 13).
+        return hier_all_to_all_shard(
+            send, splits, slow_axis=axis[0], fast_axis=axis[1], impl=impl,
+            interpret=interpret,
+            collective_ids=(2 * collective_id + 2, 2 * collective_id + 3))
 
     if impl == "xla":
         recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0,

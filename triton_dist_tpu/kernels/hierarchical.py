@@ -70,7 +70,8 @@ def hier_rs_band_index(slow_axis: str, fast_axis: str):
 
 
 def hier_all_to_all_shard(send, splits, *, slow_axis: str, fast_axis: str,
-                          impl="auto", interpret: bool = False):
+                          impl="auto", interpret: bool = False,
+                          collective_ids=(12, 13)):
     """Two-tier token AllToAll: every token crosses the slow wire at most
     once, then fans out inside its destination slice.
 
@@ -97,7 +98,7 @@ def hier_all_to_all_shard(send, splits, *, slow_axis: str, fast_axis: str,
     bundles = send.reshape(d_, t_ * tokens, hidden)
     s1, _ = fast_all_to_all_shard(
         bundles, jnp.zeros((d_,), jnp.int32), axis=slow_axis, impl=impl,
-        interpret=interpret, collective_id=12)
+        interpret=interpret, collective_id=collective_ids[0])
     sp1, _ = fast_all_to_all_shard(
         splits.reshape(d_, t_, 1).astype(jnp.int32),
         jnp.zeros((d_,), jnp.int32), axis=slow_axis, impl="xla",
@@ -109,7 +110,7 @@ def hier_all_to_all_shard(send, splits, *, slow_axis: str, fast_axis: str,
     stage2 = jnp.moveaxis(s1, 1, 0).reshape(t_, d_ * tokens, hidden)
     s2, _ = fast_all_to_all_shard(
         stage2, jnp.zeros((t_,), jnp.int32), axis=fast_axis, impl=impl,
-        interpret=interpret, collective_id=13)
+        interpret=interpret, collective_id=collective_ids[1])
     sp2, _ = fast_all_to_all_shard(
         jnp.moveaxis(sp1, 1, 0), jnp.zeros((t_,), jnp.int32),
         axis=fast_axis, impl="xla", interpret=interpret)

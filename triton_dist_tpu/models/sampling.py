@@ -43,6 +43,29 @@ def _apply_top_p(logits, top_p: float):
     return jnp.where(logits < cutoff, NEG_INF, logits)
 
 
+def _filtered_logits(logits, temperature: float, top_k, top_p):
+    """The single temperature → top-k → top-p pipeline every sampling
+    surface shares (direct sampling AND speculative verification — the
+    rejection-sampling identity needs both sides to filter identically)."""
+    x = logits.astype(jnp.float32) / temperature
+    if top_k is not None and top_k > 0 and top_k < x.shape[-1]:
+        x = _apply_top_k(x, top_k)
+    if top_p is not None and top_p < 1.0:
+        x = _apply_top_p(x, top_p)
+    return x
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("temperature", "top_k", "top_p"))
+def filtered_probs(logits, *, temperature: float = 1.0,
+                   top_k: int | None = None,
+                   top_p: float | None = None) -> jax.Array:
+    """logits [..., V] → the post-filter sampling distribution π [..., V]
+    (exactly what :func:`sample_logits` draws from)."""
+    return jax.nn.softmax(_filtered_logits(logits, temperature, top_k,
+                                           top_p), axis=-1)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("temperature", "top_k", "top_p"))
 def sample_logits(logits, key, *, temperature: float = 1.0,
@@ -55,11 +78,7 @@ def sample_logits(logits, key, *, temperature: float = 1.0,
     """
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    x = logits.astype(jnp.float32) / temperature
-    if top_k is not None and top_k > 0 and top_k < x.shape[-1]:
-        x = _apply_top_k(x, top_k)
-    if top_p is not None and top_p < 1.0:
-        x = _apply_top_p(x, top_p)
+    x = _filtered_logits(logits, temperature, top_k, top_p)
     return jax.random.categorical(key, x, axis=-1).astype(jnp.int32)
 
 

@@ -7,7 +7,7 @@ model scores all ``k`` in ONE chunk forward over its KV cache
 (models/generate.py ``_chunk_forward`` — the same machinery as chunked
 prefill); proposals are accepted left to right, plus one bonus token.
 
-Two verifiers:
+One round loop (:class:`_SpeculativeBase`) with two verify strategies:
 - :class:`SpeculativeGenerator` — greedy: accept while the proposal
   matches the target argmax.  Output is bit-identical to the target's
   own greedy decode.
@@ -33,27 +33,13 @@ import functools
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from triton_dist_tpu.models.generate import GenerationState, Generator
-from triton_dist_tpu.models.sampling import _apply_top_k, _apply_top_p
+from triton_dist_tpu.models.sampling import filtered_probs
 
 
 def _greedy(logits) -> jax.Array:
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
-
-@functools.partial(jax.jit,
-                   static_argnames=("temperature", "top_k", "top_p"))
-def filtered_probs(logits, *, temperature: float, top_k=None, top_p=None):
-    """logits [..., V] → the post-filter sampling distribution π [..., V]
-    (what ``sampling.sample_logits`` draws from)."""
-    x = logits.astype(jnp.float32) / temperature
-    if top_k is not None and 0 < top_k < x.shape[-1]:
-        x = _apply_top_k(x, top_k)
-    if top_p is not None and top_p < 1.0:
-        x = _apply_top_p(x, top_p)
-    return jax.nn.softmax(x, axis=-1)
 
 
 @jax.jit
@@ -71,8 +57,8 @@ def speculative_accept_step(pi, rho, proposal, key):
     accepted = jax.random.uniform(k1) < jnp.minimum(ratio, 1.0)
     residual = jnp.maximum(pi - rho, 0.0)
     total = jnp.sum(residual)
-    # Degenerate residual (rho covers pi, ratio>=1 everywhere → accepted
-    # is certain; the fallback to pi keeps categorical well-defined).
+    # Degenerate residual (rho covers pi): acceptance is then certain;
+    # the fallback to pi just keeps categorical well-defined.
     residual = jnp.where(total > 0, residual / jnp.maximum(total, 1e-20),
                          pi)
     alt = jax.random.categorical(k2, jnp.log(residual + 1e-30))
@@ -80,9 +66,20 @@ def speculative_accept_step(pi, rho, proposal, key):
     return accepted, token
 
 
-class SpeculativeGenerator:
-    """Pairs a target and a draft :class:`Generator` (same tokenizer/vocab;
-    the draft is typically a much smaller config)."""
+class _SpeculativeBase:
+    """Shared round loop; subclasses supply propose / verify / fallback.
+
+    Strategy contract (batch-1; ``key`` may be None for deterministic
+    strategies and is threaded through otherwise):
+    - ``_propose(d_params, sd, k, key) -> (proposals [k ints], aux, sd,
+      key)`` — draft k tokens, consuming them into the draft cache.
+    - ``_verify(st_logits, logits_all, proposals, aux, key) ->
+      (m, emitted, key)`` — accept count ``m`` and the FULL list of
+      tokens this round emits (accepted prefix + the round-closing
+      token, which is consumed via a regular step next).
+    - ``_fallback(logits, key) -> (token int, key)`` — one plain target
+      token when there is no cache headroom to speculate.
+    """
 
     def __init__(self, target: Generator, draft: Generator, k: int = 4):
         assert target.cfg.vocab == draft.cfg.vocab, "vocabularies differ"
@@ -90,13 +87,9 @@ class SpeculativeGenerator:
         self.draft = draft
         self.k = int(k)
 
-    def generate(self, t_params, d_params, prompt, n_new: int):
-        """Greedy-decode ``n_new`` tokens for ``prompt`` [1, S0].
-
-        Returns (tokens [1, n_new], stats dict with ``target_passes`` and
-        ``accept_rate``) — tokens are bit-identical to
-        ``target.generate(...)`` greedy output.
-        """
+    def generate(self, t_params, d_params, prompt, n_new: int, key=None):
+        """Decode ``n_new`` tokens for ``prompt`` [1, S0].  Returns
+        (tokens [1, n_new], stats with target_passes / accept_rate)."""
         assert prompt.shape[0] == 1, "speculative v1 is batch-1"
         st = self.target.prefill(t_params, prompt)
         sd = self.draft.prefill(d_params, prompt)
@@ -110,22 +103,19 @@ class SpeculativeGenerator:
             k = min(self.k, self.target.max_seq - 1 - L,
                     self.draft.max_seq - 1 - int(sd.kv_lens[0]))
             if k <= 0:
-                # No headroom to speculate (last cache slots): fall back
-                # to plain greedy target steps — same behavior as
-                # Generator.generate, which this must never under-serve.
-                tok = _greedy(st.last_logits)
-                out.append(int(tok[0]))
+                # No headroom to speculate (last cache slots): plain
+                # target steps — this must never under-serve
+                # Generator.generate.
+                token, key = self._fallback(st.last_logits, key)
+                out.append(token)
                 if len(out) < n_new:
-                    st = self.target.step(t_params, st, tok)
+                    st = self.target.step(t_params, st,
+                                          jnp.asarray([token], jnp.int32))
                     n_target_passes += 1
                 continue
 
-            # 1. Draft proposes k greedy tokens (consuming them).
-            proposals = []
-            for _ in range(k):
-                tok = _greedy(sd.last_logits)
-                sd = self.draft.step(d_params, sd, tok)
-                proposals.append(int(tok[0]))
+            # 1. Draft proposes k tokens (consuming them).
+            proposals, aux, sd, key = self._propose(d_params, sd, k, key)
             n_proposed += k
 
             # 2. Target scores all k in one chunk forward.
@@ -135,31 +125,26 @@ class SpeculativeGenerator:
                 quantized=self.target.attn.quantized)
             n_target_passes += 1
 
-            # 3. Accept the matching prefix; bonus token from the target.
-            expected = int(_greedy(st.last_logits)[0])
-            m = 0
-            while m < k and proposals[m] == expected:
-                out.append(proposals[m])
-                m += 1
-                expected = int(_greedy(logits_all[:, m - 1])[0])
+            # 3. Strategy-specific accept + round-closing token.
+            m, emitted, key = self._verify(st.last_logits, logits_all,
+                                           proposals, aux, key)
             n_accepted += m
-            bonus = expected  # the correct greedy token at position L+m
-            out.append(bonus)
+            out.extend(emitted)
 
-            # 4. Roll both models to the accepted length + consume bonus.
+            # 4. Roll both models to the accepted length; consume the
+            # round-closing token via a regular decode step.
+            closing = jnp.asarray([emitted[-1]], jnp.int32)
             st = GenerationState(
                 caches=new_caches,
                 kv_lens=jnp.full((1,), L + m, jnp.int32),
                 last_logits=(st.last_logits if m == 0
                              else logits_all[:, m - 1]))
-            st = self.target.step(t_params, st,
-                                  jnp.asarray([bonus], jnp.int32))
+            st = self.target.step(t_params, st, closing)
             sd = GenerationState(
                 caches=sd.caches,
                 kv_lens=jnp.full((1,), L + m, jnp.int32),
                 last_logits=sd.last_logits)  # stale; refreshed by step
-            sd = self.draft.step(d_params, sd,
-                                 jnp.asarray([bonus], jnp.int32))
+            sd = self.draft.step(d_params, sd, closing)
 
         tokens = jnp.asarray([out[:n_new]], jnp.int32)
         stats = {
@@ -171,113 +156,81 @@ class SpeculativeGenerator:
         return tokens, stats
 
 
-class SpeculativeSampler:
-    """Stochastic speculative decoding (rejection sampling).
+class SpeculativeGenerator(_SpeculativeBase):
+    """Greedy verifier: output is bit-identical to the target's greedy
+    decode; the draft only changes how many target passes are needed
+    (up to k+1 tokens per pass when the draft agrees)."""
 
-    Same pairing as :class:`SpeculativeGenerator`; the draft *samples* its
-    proposals and the target accepts/resamples so the emitted stream is
-    distributed exactly as direct target sampling with the same
-    temperature/top-k/top-p knobs.
-    """
+    def _propose(self, d_params, sd, k, key):
+        proposals = []
+        for _ in range(k):
+            tok = _greedy(sd.last_logits)
+            sd = self.draft.step(d_params, sd, tok)
+            proposals.append(int(tok[0]))
+        return proposals, None, sd, key
+
+    def _verify(self, st_logits, logits_all, proposals, aux, key):
+        expected = int(_greedy(st_logits)[0])
+        emitted = []
+        m = 0
+        while m < len(proposals) and proposals[m] == expected:
+            emitted.append(proposals[m])
+            m += 1
+            expected = int(_greedy(logits_all[:, m - 1])[0])
+        emitted.append(expected)  # the correct greedy token at L+m
+        return m, emitted, key
+
+    def _fallback(self, logits, key):
+        return int(_greedy(logits)[0]), key
+
+
+class SpeculativeSampler(_SpeculativeBase):
+    """Rejection-sampling verifier: the emitted stream is distributed
+    exactly as direct target sampling with the same temperature/top-k/
+    top-p knobs (``generate`` requires a PRNG ``key``)."""
 
     def __init__(self, target: Generator, draft: Generator, k: int = 4, *,
                  temperature: float = 1.0, top_k=None, top_p=None):
-        assert target.cfg.vocab == draft.cfg.vocab, "vocabularies differ"
         assert temperature > 0, "use SpeculativeGenerator for greedy"
-        self.target = target
-        self.draft = draft
-        self.k = int(k)
+        super().__init__(target, draft, k)
         self._probs = functools.partial(
             filtered_probs, temperature=temperature, top_k=top_k,
             top_p=top_p)
 
-    def generate(self, t_params, d_params, prompt, n_new: int, key):
-        """Sample ``n_new`` tokens.  Returns (tokens [1, n_new], stats)."""
-        assert prompt.shape[0] == 1, "speculative v1 is batch-1"
-        st = self.target.prefill(t_params, prompt)
-        sd = self.draft.prefill(d_params, prompt)
+    def _draw(self, pi, key):
+        key, sub = jax.random.split(key)
+        return int(jax.random.categorical(sub, jnp.log(pi + 1e-30))), key
 
-        out: list[int] = []
-        n_target_passes = 0
-        n_proposed = 0
-        n_accepted = 0
-        while len(out) < n_new:
-            L = int(st.kv_lens[0])
-            k = min(self.k, self.target.max_seq - 1 - L,
-                    self.draft.max_seq - 1 - int(sd.kv_lens[0]))
-            if k <= 0:
-                key, sub = jax.random.split(key)
-                pi = self._probs(st.last_logits[0])
-                tok = jax.random.categorical(
-                    sub, jnp.log(pi + 1e-30)).astype(jnp.int32)[None]
-                out.append(int(tok[0]))
-                if len(out) < n_new:
-                    st = self.target.step(t_params, st, tok)
-                    n_target_passes += 1
-                continue
+    def _propose(self, d_params, sd, k, key):
+        proposals, rhos = [], []
+        for _ in range(k):
+            rho = self._probs(sd.last_logits[0])          # [V]
+            tok_i, key = self._draw(rho, key)
+            rhos.append(rho)
+            sd = self.draft.step(d_params, sd,
+                                 jnp.asarray([tok_i], jnp.int32))
+            proposals.append(tok_i)
+        return proposals, rhos, sd, key
 
-            # 1. Draft samples k proposals (recording its distributions).
-            proposals, rhos = [], []
-            for _ in range(k):
-                key, sub = jax.random.split(key)
-                rho = self._probs(sd.last_logits[0])      # [V]
-                tok = jax.random.categorical(
-                    sub, jnp.log(rho + 1e-30)).astype(jnp.int32)[None]
-                rhos.append(rho)
-                sd = self.draft.step(d_params, sd, tok)
-                proposals.append(int(tok[0]))
-            n_proposed += k
+    def _verify(self, st_logits, logits_all, proposals, rhos, key):
+        emitted = []
+        m = 0
+        while m < len(proposals):
+            pi = self._probs(st_logits[0] if m == 0
+                             else logits_all[0, m - 1])
+            key, sub = jax.random.split(key)
+            accepted, token = speculative_accept_step(
+                pi, rhos[m], jnp.int32(proposals[m]), sub)
+            if not bool(accepted):
+                emitted.append(int(token))   # residual resample; stop
+                return m, emitted, key
+            emitted.append(int(token))
+            m += 1
+        # All accepted: bonus sample from the target's next distribution.
+        pi = self._probs(logits_all[0, len(proposals) - 1])
+        tok_i, key = self._draw(pi, key)
+        emitted.append(tok_i)
+        return m, emitted, key
 
-            # 2. Target scores all k in one chunk forward.
-            chunk = jnp.asarray([proposals], jnp.int32)
-            new_caches, logits_all = self.target._chunk_jit(
-                t_params, chunk, st.caches, jnp.int32(L),
-                quantized=self.target.attn.quantized)
-            n_target_passes += 1
-
-            # 3. Left-to-right accept/resample.
-            m = 0
-            emitted = None
-            while m < k:
-                pi = self._probs(st.last_logits[0] if m == 0
-                                 else logits_all[0, m - 1])
-                key, sub = jax.random.split(key)
-                accepted, token = speculative_accept_step(
-                    pi, rhos[m], jnp.int32(proposals[m]), sub)
-                if not bool(accepted):
-                    emitted = int(token)      # residual resample; stop
-                    break
-                out.append(int(token))
-                m += 1
-            n_accepted += m
-            if emitted is None:
-                # All k accepted: bonus sample from the target's own
-                # next-position distribution.
-                pi = self._probs(logits_all[0, k - 1])
-                key, sub = jax.random.split(key)
-                emitted = int(jax.random.categorical(
-                    sub, jnp.log(pi + 1e-30)))
-            out.append(emitted)
-
-            # 4. Roll both models to the accepted length + consume emitted.
-            bonus = jnp.asarray([emitted], jnp.int32)
-            st = GenerationState(
-                caches=new_caches,
-                kv_lens=jnp.full((1,), L + m, jnp.int32),
-                last_logits=(st.last_logits if m == 0
-                             else logits_all[:, m - 1]))
-            st = self.target.step(t_params, st, bonus)
-            sd = GenerationState(
-                caches=sd.caches,
-                kv_lens=jnp.full((1,), L + m, jnp.int32),
-                last_logits=sd.last_logits)
-            sd = self.draft.step(d_params, sd, bonus)
-
-        tokens = jnp.asarray([out[:n_new]], jnp.int32)
-        stats = {
-            "target_passes": n_target_passes,
-            "proposed": n_proposed,
-            "accepted": n_accepted,
-            "accept_rate": n_accepted / max(n_proposed, 1),
-        }
-        return tokens, stats
+    def _fallback(self, logits, key):
+        return self._draw(self._probs(logits[0]), key)
