@@ -49,6 +49,8 @@ def parse_args():
                         "(dense family only) and report logit agreement")
     p.add_argument("--kv-int8", action="store_true",
                    help="int8 KV cache (half the memory, ~1.55x decode)")
+    p.add_argument("--chunk-prefill", type=int, default=None, metavar="C",
+                   help="prefill in C-token chunks (bounded memory)")
     return p.parse_args()
 
 
@@ -91,9 +93,14 @@ def main():
     prompt = jax.random.randint(key, (args.batch, args.prompt_len), 0,
                                 cfg.vocab, jnp.int32)
     t0 = time.perf_counter()
-    state = gen.prefill(params, prompt)
+    if args.chunk_prefill:
+        state = gen.prefill_chunked(params, prompt,
+                                    chunk_size=args.chunk_prefill)
+    else:
+        state = gen.prefill(params, prompt)
     jax.block_until_ready(state.last_logits)
-    dist_print(f"prefill {args.prompt_len} tokens x{args.batch}: "
+    dist_print(f"prefill {args.prompt_len} tokens x{args.batch}"
+               f"{f' (chunks of {args.chunk_prefill})' if args.chunk_prefill else ''}: "
                f"{(time.perf_counter() - t0) * 1e3:.1f} ms")
 
     sampler = None
