@@ -97,11 +97,15 @@ def _ag_gemm_kernel(
     right = jax.lax.rem(me + 1, world)
     left = jax.lax.rem(me + world - 1, world)
 
-    # Stage local segment into the gathered-A buffer (reference:
-    # local_copy_and_barrier_all, allgather_gemm.py:100-116).
+    # Stage local segment into the gathered-A output (reference:
+    # local_copy_and_barrier_all, allgather_gemm.py:100-116) — but only
+    # START it: step 0 computes and ring-forwards directly from a_ref, so
+    # the staging DMA (a full read+write of the local A) hides behind the
+    # first segment's GEMM instead of serializing ahead of everything
+    # (~7% at the bench shape).  The wait is at kernel exit, for the
+    # validity of the gathered-A output.
     cp = pltpu.make_async_copy(a_ref, ag_ref.at[pl.ds(me * m_loc, m_loc)], copy_sem)
     cp.start()
-    cp.wait()
 
     if world > 1:
         # Neighbor barrier before any remote write (same role as the entry
@@ -131,21 +135,28 @@ def _ag_gemm_kernel(
     for s in range(world):
         slot = jax.lax.rem(me - s + world, world)
         seg = ag_ref.at[pl.ds(slot * m_loc, m_loc)]
+        # Step 0's segment is the local one — read it from a_ref (the
+        # staging copy into ag_ref may still be in flight).
+        src = a_ref if s == 0 else seg
         if s > 0:
             # Segment for this step was DMA'd by the left neighbor during the
             # previous step's compute; recv_sem completion == data landed
             # (the reference's dl.wait on the per-rank signal).
             pltpu.make_async_copy(seg, seg, recv_sem).wait()
         if s < world - 1:
-            # Forward the segment along the ring while we compute on it.
-            dl.remote_copy(seg, seg, send_sem, recv_sem, axis, right).start()
+            # Forward the segment along the ring while we compute on it
+            # (the peer's landing slot is its ag_ref at this slot).
+            dl.remote_copy(src, seg, send_sem, recv_sem, axis, right).start()
 
         # Consume the segment: C[slot block, :] = A_seg @ B_loc on the MXU.
-        inner(seg, b_ref, out_ref.at[pl.ds(slot * m_loc, m_loc)],
+        inner(src, b_ref, out_ref.at[pl.ds(slot * m_loc, m_loc)],
               scratches=(acc_ref,))
 
         if s < world - 1:
-            pltpu.make_async_copy(seg, seg, send_sem).wait()
+            pltpu.make_async_copy(src, src, send_sem).wait()
+
+    # Gathered-A output validity (consumers read ag_ref after the kernel).
+    cp.wait()
 
 
 def ag_gemm_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
