@@ -1,0 +1,63 @@
+"""Beam search (models/beam.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_dist_tpu.models.beam import beam_search
+from triton_dist_tpu.models.generate import Generator
+from triton_dist_tpu.models.llama import LlamaConfig, init_params
+
+
+def _cfg(vocab=16):
+    return LlamaConfig(vocab=vocab, dim=32, n_layers=1, n_heads=4,
+                       n_kv_heads=2, ffn_dim=32, max_seq=32,
+                       dtype=jnp.float32)
+
+
+def test_beam_width_one_is_greedy(mesh4, key):
+    cfg = _cfg()
+    params = init_params(cfg, key)
+    gen = Generator(cfg, mesh4, axis="tp", max_seq=32)
+    prompt = jax.random.randint(key, (1, 4), 0, cfg.vocab, jnp.int32)
+    ref, _ = gen.generate(params, gen.prefill(params, prompt), 4)
+    toks, _score = beam_search(gen, params, prompt, 4, num_beams=1)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+
+
+def test_beam_finds_exhaustive_optimum(mesh4, key):
+    """n_new=2 with num_beams=V keeps every first token, so beam search is
+    exhaustive — it must find the argmax joint log-prob sequence."""
+    V = 8
+    cfg = _cfg(vocab=V)
+    params = init_params(cfg, key)
+    gen = Generator(cfg, mesh4, axis="tp", max_seq=32)
+    prompt = jax.random.randint(key, (1, 3), 0, V, jnp.int32)
+
+    lp1 = np.asarray(jax.nn.log_softmax(
+        gen.prefill(params, prompt).last_logits[0]))
+    best, best_score = None, -np.inf
+    for t1 in range(V):
+        ext = jnp.concatenate([prompt, jnp.asarray([[t1]], jnp.int32)], 1)
+        lp2 = np.asarray(jax.nn.log_softmax(
+            gen.prefill(params, ext).last_logits[0]))
+        t2 = int(np.argmax(lp2))
+        score = lp1[t1] + lp2[t2]
+        if score > best_score:
+            best, best_score = [t1, t2], score
+
+    toks, score = beam_search(gen, params, prompt, 2, num_beams=V)
+    np.testing.assert_array_equal(np.asarray(toks)[0], best)
+    assert abs(score - best_score) < 1e-4, (score, best_score)
+
+
+def test_beam_int8_cache(mesh4, key):
+    """Beam reordering works over the quantized cache dicts too."""
+    cfg = _cfg()
+    params = init_params(cfg, key)
+    gen = Generator(cfg, mesh4, axis="tp", max_seq=32, kv_dtype=jnp.int8)
+    prompt = jax.random.randint(key, (1, 4), 0, cfg.vocab, jnp.int32)
+    toks, score = beam_search(gen, params, prompt, 3, num_beams=3)
+    assert toks.shape == (1, 3)
+    assert np.isfinite(score)
+    assert int(jnp.max(toks)) < cfg.vocab

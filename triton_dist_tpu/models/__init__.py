@@ -51,6 +51,7 @@ from triton_dist_tpu.models.llama_w8a8 import (  # noqa: F401
     place_w8a8_params,
     quantize_params_w8a8,
 )
+from triton_dist_tpu.models.beam import beam_search  # noqa: F401
 from triton_dist_tpu.models.speculative import (  # noqa: F401
     SpeculativeGenerator,
     SpeculativeSampler,

@@ -1,0 +1,74 @@
+"""Beam search over the sequence-parallel KV cache.
+
+Serving-side addition beyond the reference.  Beams ride the generator's
+batch dimension: prefill replicates the prompt per beam, every step scores
+all beams in one batched decode, and the top ``num_beams`` (sequence,
+continuation) pairs survive.  Beam reordering gathers the KV caches along
+the batch axis — a [beams, H, S, D] take per layer, which XLA fuses with
+the step's cache update.
+
+Scoring is the standard sum of token log-probs with optional length
+normalization (score / len**alpha at the end).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_dist_tpu.models.generate import GenerationState, Generator
+
+
+def _gather_cache(cache, idx):
+    """Reorder one cache (float array or int8 dict) along the batch dim."""
+    if isinstance(cache, dict):
+        return {"q": cache["q"][idx], "s": cache["s"][idx]}
+    return cache[idx]
+
+
+def beam_search(gen: Generator, params, prompt, n_new: int, *,
+                num_beams: int = 4, length_alpha: float = 0.0):
+    """Beam-decode ``n_new`` tokens for ``prompt`` [1, S0].
+
+    Returns (tokens [1, n_new] — the best beam's continuation,
+    score float — its total log-prob, length-normalized when
+    ``length_alpha`` > 0).
+    """
+    assert prompt.shape[0] == 1, "beam search takes a single prompt"
+    B = num_beams
+    state = gen.prefill(params, jnp.repeat(prompt, B, axis=0))
+
+    logprobs = jax.nn.log_softmax(state.last_logits, axis=-1)  # [B, V]
+    V = logprobs.shape[-1]
+    # First step: all beams are identical — expand from beam 0 only.
+    first = jax.lax.top_k(logprobs[0], B)
+    scores = first[0]                                  # [B]
+    seqs = np.asarray(first[1]).reshape(B, 1)          # [B, 1] host-side
+    token = first[1].astype(jnp.int32)                 # [B]
+
+    for _step in range(1, n_new + 1):
+        state = gen.step(params, state, token)
+        if _step == n_new:
+            break
+        logprobs = jax.nn.log_softmax(state.last_logits, axis=-1)
+        total = scores[:, None] + logprobs               # [B, V]
+        flat = total.reshape(-1)
+        top = jax.lax.top_k(flat, B)
+        scores = top[0]
+        beam_idx = (top[1] // V).astype(jnp.int32)       # [B]
+        token = (top[1] % V).astype(jnp.int32)
+        # Reorder host-side sequences and device-side caches by beam.
+        bi = np.asarray(beam_idx)
+        seqs = np.concatenate([seqs[bi], np.asarray(token)[:, None]],
+                              axis=1)
+        state = GenerationState(
+            caches=[(_gather_cache(k, beam_idx), _gather_cache(v, beam_idx))
+                    for (k, v) in state.caches],
+            kv_lens=state.kv_lens,
+            last_logits=state.last_logits[beam_idx])
+
+    if length_alpha > 0:
+        scores = scores / (seqs.shape[1] ** length_alpha)
+    best = int(jnp.argmax(scores))
+    return jnp.asarray(seqs[best][None], jnp.int32), float(scores[best])
