@@ -92,6 +92,9 @@ def main():
 
     prompt = jax.random.randint(key, (args.batch, args.prompt_len), 0,
                                 cfg.vocab, jnp.int32)
+    if args.chunk_prefill is not None and args.chunk_prefill <= 0:
+        raise SystemExit(f"--chunk-prefill must be positive, got "
+                         f"{args.chunk_prefill}")
     t0 = time.perf_counter()
     if args.chunk_prefill:
         state = gen.prefill_chunked(params, prompt,

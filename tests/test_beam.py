@@ -61,3 +61,14 @@ def test_beam_int8_cache(mesh4, key):
     assert toks.shape == (1, 3)
     assert np.isfinite(score)
     assert int(jnp.max(toks)) < cfg.vocab
+
+
+def test_beam_exact_cache_fit(mesh4, key):
+    """n_new filling the cache exactly works (regression: a discarded
+    trailing step used to overflow)."""
+    cfg = _cfg()
+    params = init_params(cfg, key)
+    gen = Generator(cfg, mesh4, axis="tp", max_seq=8)
+    prompt = jax.random.randint(key, (1, 4), 0, cfg.vocab, jnp.int32)
+    toks, _ = beam_search(gen, params, prompt, 4, num_beams=2)  # 4+4 = 8
+    assert toks.shape == (1, 4)

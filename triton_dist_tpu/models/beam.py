@@ -20,41 +20,47 @@ import numpy as np
 from triton_dist_tpu.models.generate import GenerationState, Generator
 
 
-def _gather_cache(cache, idx):
-    """Reorder one cache (float array or int8 dict) along the batch dim."""
+def _map_cache(cache, fn):
+    """Apply ``fn`` to one cache's arrays (float array or int8 dict)."""
     if isinstance(cache, dict):
-        return {"q": cache["q"][idx], "s": cache["s"][idx]}
-    return cache[idx]
+        return {"q": fn(cache["q"]), "s": fn(cache["s"])}
+    return fn(cache)
 
 
 def beam_search(gen: Generator, params, prompt, n_new: int, *,
-                num_beams: int = 4, length_alpha: float = 0.0):
+                num_beams: int = 4):
     """Beam-decode ``n_new`` tokens for ``prompt`` [1, S0].
 
-    Returns (tokens [1, n_new] — the best beam's continuation,
-    score float — its total log-prob, length-normalized when
-    ``length_alpha`` > 0).
+    Returns (tokens [1, n_new] — the best beam's continuation, score
+    float — its total log-prob).  All beams have the same length (no EOS
+    handling), so GNMT-style length normalization would not change the
+    winner and is deliberately not offered.
     """
     assert prompt.shape[0] == 1, "beam search takes a single prompt"
     B = num_beams
-    state = gen.prefill(params, jnp.repeat(prompt, B, axis=0))
+    # Prefill ONCE; replicate the resulting caches/logits per beam (the
+    # beams only diverge from the first generated token on).
+    s1 = gen.prefill(params, prompt)
+    rep = lambda a: jnp.repeat(a, B, axis=0)  # noqa: E731
+    state = GenerationState(
+        caches=[(_map_cache(k, rep), _map_cache(v, rep))
+                for (k, v) in s1.caches],
+        kv_lens=rep(s1.kv_lens),
+        last_logits=rep(s1.last_logits))
 
     logprobs = jax.nn.log_softmax(state.last_logits, axis=-1)  # [B, V]
     V = logprobs.shape[-1]
-    # First step: all beams are identical — expand from beam 0 only.
+    # First expansion: all beams are identical — expand from beam 0 only.
     first = jax.lax.top_k(logprobs[0], B)
     scores = first[0]                                  # [B]
     seqs = np.asarray(first[1]).reshape(B, 1)          # [B, 1] host-side
     token = first[1].astype(jnp.int32)                 # [B]
 
-    for _step in range(1, n_new + 1):
+    for _step in range(n_new - 1):
         state = gen.step(params, state, token)
-        if _step == n_new:
-            break
         logprobs = jax.nn.log_softmax(state.last_logits, axis=-1)
         total = scores[:, None] + logprobs               # [B, V]
-        flat = total.reshape(-1)
-        top = jax.lax.top_k(flat, B)
+        top = jax.lax.top_k(total.reshape(-1), B)
         scores = top[0]
         beam_idx = (top[1] // V).astype(jnp.int32)       # [B]
         token = (top[1] % V).astype(jnp.int32)
@@ -62,13 +68,13 @@ def beam_search(gen: Generator, params, prompt, n_new: int, *,
         bi = np.asarray(beam_idx)
         seqs = np.concatenate([seqs[bi], np.asarray(token)[:, None]],
                               axis=1)
+        take = lambda a: a[beam_idx]  # noqa: E731
         state = GenerationState(
-            caches=[(_gather_cache(k, beam_idx), _gather_cache(v, beam_idx))
+            caches=[(_map_cache(k, take), _map_cache(v, take))
                     for (k, v) in state.caches],
             kv_lens=state.kv_lens,
             last_logits=state.last_logits[beam_idx])
+    # The final selected tokens are never consumed — no trailing step.
 
-    if length_alpha > 0:
-        scores = scores / (seqs.shape[1] ** length_alpha)
     best = int(jnp.argmax(scores))
     return jnp.asarray(seqs[best][None], jnp.int32), float(scores[best])
