@@ -101,3 +101,31 @@ def test_prefill_state_reuse_prompt_caching(mesh2, key):
     np.testing.assert_array_equal(np.asarray(greedy),
                                   np.asarray(greedy_again))
     assert not np.array_equal(np.asarray(s1), np.asarray(s2))
+
+
+def test_generate_eos_stopping(mesh2, key):
+    """Rows that emit eos_id keep emitting it; the loop stops early when
+    every row has finished; non-finished prefixes match the no-eos run."""
+    from triton_dist_tpu.models.llama import LlamaConfig, init_params
+    from triton_dist_tpu.models.generate import Generator
+
+    cfg = LlamaConfig(vocab=64, dim=32, n_layers=1, n_heads=4, n_kv_heads=2,
+                      ffn_dim=64, max_seq=32, dtype=jnp.float32)
+    params = init_params(cfg, key)
+    gen = Generator(cfg, mesh2, axis="tp", max_seq=32)
+    prompt = jax.random.randint(key, (2, 4), 0, cfg.vocab, jnp.int32)
+
+    ref, _ = gen.generate(params, gen.prefill(params, prompt), 6)
+    ref = np.asarray(ref)
+    eos = int(ref[0, 1])  # row 0 finishes at step 1
+
+    out, _ = gen.generate(params, gen.prefill(params, prompt), 6,
+                          eos_id=eos)
+    out = np.asarray(out)
+    assert out.shape == (2, 6)
+    for b in range(2):
+        hit = np.where(ref[b] == eos)[0]
+        stop = hit[0] if len(hit) else 6
+        np.testing.assert_array_equal(out[b, :stop], ref[b, :stop])
+        if stop < 6:
+            assert (out[b, stop:] == eos).all()

@@ -174,8 +174,9 @@ class Generator:
         return new_caches, kv_lens + 1, logits
 
     def generate(self, params, state: GenerationState, n_new: int,
-                 sample=None, key=None):
-        """Generate ``n_new`` tokens.  Returns (tokens [B, n_new], state).
+                 sample=None, key=None, eos_id: int | None = None):
+        """Generate up to ``n_new`` tokens.  Returns (tokens [B, n_new],
+        state).
 
         Token choice per step:
         - default: greedy argmax;
@@ -185,6 +186,10 @@ class Generator:
           ``sampling.make_sampler(temperature=..., top_k=..., top_p=...)``
           for the serving knobs);
         - ``sample`` without ``key``: deterministic ``sample(logits)``.
+
+        ``eos_id``: rows that emit it keep emitting ``eos_id`` for the
+        rest of the call (their caches still advance — batch rows stay in
+        lockstep); the loop exits early once every row has finished.
         """
         if not isinstance(state.kv_lens, jax.core.Tracer):
             top = int(jnp.max(state.kv_lens))
@@ -196,6 +201,7 @@ class Generator:
             from triton_dist_tpu.models.sampling import sample_logits
             sample = sample_logits
         outs = []
+        done = None
         for _ in range(n_new):
             if key is not None:
                 key, sub = jax.random.split(key)
@@ -205,9 +211,21 @@ class Generator:
             else:
                 token = jnp.argmax(state.last_logits, axis=-1).astype(
                     jnp.int32)
+            if eos_id is not None:
+                if done is None:
+                    done = jnp.zeros(token.shape, bool)
+                token = jnp.where(done, jnp.int32(eos_id), token)
+                done = done | (token == eos_id)
             state = self.step(params, state, token)
             outs.append(token)
-        return jnp.stack(outs, axis=1), state
+            if eos_id is not None and bool(jnp.all(done)):
+                break
+        tokens = jnp.stack(outs, axis=1)
+        if eos_id is not None and tokens.shape[1] < n_new:
+            pad = jnp.full((tokens.shape[0], n_new - tokens.shape[1]),
+                           eos_id, jnp.int32)
+            tokens = jnp.concatenate([tokens, pad], axis=1)
+        return tokens, state
 
 
 def _attend_prefix(q, k_all, v_all, prefix_len, *, k_scale=None,
