@@ -13,8 +13,11 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-NEG_INF = jnp.float32(-1e30)
+# numpy scalar, NOT jnp: a module-level jnp constant would initialize the
+# JAX backend at import time (breaks dryrun_multichip's late CPU pinning).
+NEG_INF = np.float32(-1e30)
 
 
 def _apply_top_k(logits, top_k: int):
