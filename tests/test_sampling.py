@@ -90,3 +90,16 @@ def test_top_p_zero_is_greedy(key):
                             temperature=1.0, top_p=0.0)
         np.testing.assert_array_equal(np.asarray(tok),
                                       np.asarray(jnp.argmax(logits, -1)))
+
+
+def test_zero_temperature_guard(key):
+    """filtered_probs(temperature=0) must raise, not return NaN silently
+    (sample_logits special-cases greedy before the divide)."""
+    import pytest
+    from triton_dist_tpu.models.sampling import filtered_probs
+    logits = _logits(key)
+    with pytest.raises(ValueError, match="temperature"):
+        filtered_probs(logits, temperature=0.0)
+    tok = sample_logits(logits, key, temperature=0.0)  # greedy path still OK
+    np.testing.assert_array_equal(np.asarray(tok),
+                                  np.asarray(jnp.argmax(logits, -1)))

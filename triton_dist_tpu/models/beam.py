@@ -7,8 +7,8 @@ continuation) pairs survive.  Beam reordering gathers the KV caches along
 the batch axis — a [beams, H, S, D] take per layer, which XLA fuses with
 the step's cache update.
 
-Scoring is the standard sum of token log-probs with optional length
-normalization (score / len**alpha at the end).
+Scoring is the standard sum of token log-probs (no length normalization —
+see ``beam_search``'s docstring for why the knob is deliberately absent).
 """
 
 from __future__ import annotations

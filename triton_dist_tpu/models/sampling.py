@@ -49,7 +49,16 @@ def _apply_top_p(logits, top_p: float):
 def _filtered_logits(logits, temperature: float, top_k, top_p):
     """The single temperature → top-k → top-p pipeline every sampling
     surface shares (direct sampling AND speculative verification — the
-    rejection-sampling identity needs both sides to filter identically)."""
+    rejection-sampling identity needs both sides to filter identically).
+
+    ``temperature`` must be > 0: greedy is a separate code path
+    (:func:`sample_logits` special-cases it to argmax before reaching here,
+    and a greedy *distribution* is a one-hot, not a softmax limit we can
+    divide our way to)."""
+    if not temperature > 0.0:
+        raise ValueError(
+            f"temperature must be > 0, got {temperature}; use "
+            "sample_logits(temperature=0) for greedy decoding")
     x = logits.astype(jnp.float32) / temperature
     if top_k is not None and top_k > 0 and top_k < x.shape[-1]:
         x = _apply_top_k(x, top_k)

@@ -213,6 +213,11 @@ class SpeculativeSampler(_SpeculativeBase):
         return proposals, rhos, sd, key
 
     def _verify(self, st_logits, logits_all, proposals, rhos, key):
+        # TODO(perf): this loop does one device->host transfer per drafted
+        # token (bool(accepted)/int(token)), serializing k syncs per round.
+        # The accept chain is expressible as one lax.scan over the k
+        # (pi, rho, proposal) triples with a single [k+1]-token transfer at
+        # the end — worth doing once speculative latency is benchmarked.
         emitted = []
         m = 0
         while m < len(proposals):

@@ -43,7 +43,6 @@ from typing import Any
 
 import jax
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 
 def _is_primary() -> bool:
