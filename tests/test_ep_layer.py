@@ -43,7 +43,9 @@ def test_ep_dispatch_combine_roundtrip(impl, mesh4, key):
         interpret=(impl == "pallas"))
     layer = EPAll2AllLayer(ctx=ctx, n_experts=E, topk=topk)
 
-    recv, recv_expert, recv_splits, plan = layer.dispatch(x, experts)
+    recv, recv_expert, recv_splits, plan, n_dropped = layer.dispatch(
+        x, experts)
+    assert int(n_dropped) == 0  # worst-case sizing never truncates
 
     # Expert compute on each owner: y = token * (1 + expert_id).  recv is
     # P(axis)-stacked [world*world, max_tokens, H]; scale rides the gathered
@@ -58,7 +60,8 @@ def test_ep_dispatch_combine_roundtrip(impl, mesh4, key):
 
 
 def test_ep_dispatch_capacity_drop(mesh2, key):
-    """Overflow beyond max_tokens is dropped, not corrupted."""
+    """Overflow beyond an EXPLICIT tight max_tokens is dropped with exact
+    accounting, not corrupted (and never silently: n_dropped reports it)."""
     world, T, H, E, topk = 2, 16, 32, 2, 1
     # All tokens route to expert 0 → rank 0; capacity 4 < 8 sent.
     x = jax.random.normal(key, (T, H), jnp.float32)
@@ -69,7 +72,11 @@ def test_ep_dispatch_capacity_drop(mesh2, key):
     ctx = create_all_to_all_context(mesh2, max_tokens, H, axis="tp",
                                     impl="xla")
     layer = EPAll2AllLayer(ctx=ctx, n_experts=E, topk=topk)
-    recv, recv_expert, recv_splits, plan = layer.dispatch(x, experts)
+    recv, recv_expert, recv_splits, plan, n_dropped = layer.dispatch(
+        x, experts)
+    # Each src rank sends 8 assignments to rank 0, capacity 4 → 4 dropped
+    # per src, 8 globally.
+    assert int(n_dropped) == world * (T // world - max_tokens) == 8
     out = layer.combine(recv, weights, plan)
 
     # First max_tokens assignments per (src, dst) pair survive identically.
@@ -83,6 +90,26 @@ def test_ep_dispatch_capacity_drop(mesh2, key):
         np.testing.assert_allclose(outn[sl], xn[sl], rtol=1e-6)
         dropped = slice(src * t_loc + max_tokens, (src + 1) * t_loc)
         np.testing.assert_array_equal(outn[dropped], 0.0)
+
+
+def test_ep_dispatch_default_capacity_is_lossless(mesh2, key):
+    """max_tokens=None (the default) sizes for the worst case: even fully
+    adversarial routing (every assignment to one rank) drops nothing."""
+    world, T, H, E, topk = 2, 16, 32, 2, 2
+    x = jax.random.normal(key, (T, H), jnp.float32)
+    weights = jnp.full((T, topk), 0.5, jnp.float32)
+    experts = jnp.zeros((T, topk), jnp.int32)  # everything → rank 0
+
+    ctx = create_all_to_all_context(mesh2, None, H, axis="tp", impl="xla")
+    layer = EPAll2AllLayer(ctx=ctx, n_experts=E, topk=topk)
+    recv, recv_expert, recv_splits, plan, n_dropped = layer.dispatch(
+        x, experts)
+    assert int(n_dropped) == 0
+    t_loc = T // world
+    assert recv.shape[1] == t_loc * topk  # worst-case segment sizing
+    out = layer.combine(recv, weights, plan)
+    # Both assignments hit expert 0 with weight .5 each → identity.
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-5)
 
 
 @pytest.mark.parametrize("impl", ["xla", "pallas"])

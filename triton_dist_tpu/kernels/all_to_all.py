@@ -56,7 +56,10 @@ class AllToAllContext:
     — max_m/hidden/world sizing of the symmetric buffers."""
 
     mesh: Mesh
-    max_tokens: int
+    # None = "size for the lossless worst case at dispatch time" — only
+    # meaningful for EP dispatch/combine (layers/ep_a2a.py), where t_loc and
+    # topk fix the bound; the raw fast_all_to_all entry needs a number.
+    max_tokens: int | None
     hidden: int
     axis: str = "ep"
     impl: str = "auto"
@@ -218,6 +221,11 @@ def fast_all_to_all(send, splits, ctx: AllToAllContext):
     its [world, max_tokens, H] outgoing block; splits likewise.
     """
     w = ctx.world
+    if ctx.max_tokens is None:
+        raise ValueError(
+            "fast_all_to_all needs an explicit ctx.max_tokens (it sizes the "
+            "symmetric buffers); max_tokens=None is only meaningful for the "
+            "EP dispatch path, which derives the worst case itself")
     expected = (w * w, ctx.max_tokens, ctx.hidden)
     if tuple(send.shape) != expected:
         raise ValueError(

@@ -9,10 +9,12 @@ ranks; ``combine()`` reverses the shuffle and topk-reduces.
 TPU-native design (NOT a port):
 
 * **No dynamic shapes, no CPU readback** (SURVEY.md §7 hard part 2): every
-  (src→dst) segment is padded to ``max_tokens`` slots; overflow assignments
-  beyond a destination's capacity are dropped (the standard capacity-factor
-  truncation — the reference instead sizes ``max_m`` for the worst case,
-  which is also available here by choosing ``max_tokens = t_loc * topk``).
+  (src→dst) segment is padded to ``max_tokens`` slots.  The DEFAULT
+  (``max_tokens=None``) is the lossless worst case ``t_loc * topk`` — the
+  reference's ``MAX_M`` sizing (ep_a2a.py:353-387), no token is ever
+  dropped.  Choosing a tighter capacity turns on standard capacity-factor
+  truncation; that is never silent: dispatch returns the exact global
+  dropped-assignment count alongside the payload.
 * **Slot-addressed return routing**: the sender records (dest, slot) for
   every (token, k) assignment when packing; ``combine`` simply ships the
   expert outputs back through the inverse AllToAll — same slots, so no
@@ -45,18 +47,24 @@ META_COLS = 8  # int32 metadata columns (col 0 = expert id), DMA-friendly pad
 
 
 def ep_dispatch_shard(x_loc, experts_loc, *, axis, n_experts,
-                      max_tokens, impl, interpret):
+                      max_tokens=None, impl, interpret):
     """Pack per-destination-rank slots and shuffle tokens to expert owners.
 
     x_loc [t_loc, H], experts_loc [t_loc, topk] i32.  Routing weights are
-    only needed at combine time.  Returns (recv [world, max_tokens, H],
-    recv_expert [world, max_tokens] i32, recv_splits [world] i32, plan).
+    only needed at combine time.  ``max_tokens=None`` (the default) sizes
+    every (src→dst) segment for the lossless worst case ``t_loc * topk``.
+    Returns (recv [world, max_tokens, H], recv_expert [world, max_tokens]
+    i32, recv_splits [world] i32, plan, n_dropped) where ``n_dropped`` is
+    the GLOBAL (psum over ``axis``, replicated) count of (token, k)
+    assignments truncated by capacity — always 0 at the default sizing.
     """
     world = jax.lax.axis_size(axis)
     t_loc, topk = experts_loc.shape
     hidden = x_loc.shape[1]
     epr = n_experts // world  # experts per rank
     n = t_loc * topk
+    if max_tokens is None:
+        max_tokens = n  # worst case: every assignment to one destination
 
     flat_e = experts_loc.reshape(-1)
     dest = flat_e // epr                                   # [n] dest rank
@@ -71,6 +79,8 @@ def ep_dispatch_shard(x_loc, experts_loc, *, axis, n_experts,
     meta = jnp.zeros((world, max_tokens, META_COLS), jnp.int32)
     meta = meta.at[dest_safe, slot, 0].set(flat_e, mode="drop")
     splits = jnp.minimum(counts, max_tokens).astype(jnp.int32)
+    n_dropped = jax.lax.psum(
+        jnp.maximum(counts - max_tokens, 0).sum().astype(jnp.int32), axis)
 
     recv, recv_splits = fast_all_to_all_shard_diff(
         send, splits, axis, impl, interpret)
@@ -79,7 +89,8 @@ def ep_dispatch_shard(x_loc, experts_loc, *, axis, n_experts,
 
     # Plan = (dest, slot, valid): a plain tuple so shard_map out_specs stay
     # hashable for the jit cache.
-    return recv, recv_meta[:, :, 0], recv_splits, (dest, slot, valid)
+    return (recv, recv_meta[:, :, 0], recv_splits, (dest, slot, valid),
+            n_dropped)
 
 
 def ep_combine_shard(y, weights_loc, plan, *, axis, impl, interpret):
@@ -124,10 +135,12 @@ class EPAll2AllLayer:
     def dispatch(self, x, experts):
         """x [T, H] P(axis); experts [T, topk] P(axis).
 
-        Returns (recv_tokens [W*world? ...] — shard-stacked receive buffers
-        P(axis), recv_expert, recv_splits, plan), where on each device the
-        receive block is [world, max_tokens, H] and ``recv_expert`` holds
-        the global expert id of every valid received row.
+        Returns (recv_tokens — shard-stacked receive buffers P(axis),
+        recv_expert, recv_splits, plan, n_dropped), where on each device the
+        receive block is [world, max_tokens, H], ``recv_expert`` holds the
+        global expert id of every valid received row, and ``n_dropped`` is
+        the replicated global truncated-assignment count (0 unless
+        ``ctx.max_tokens`` was set below the ``t_loc * topk`` worst case).
         """
         ctx = self.ctx
         fn = cached_shard_jit(
@@ -135,7 +148,7 @@ class EPAll2AllLayer:
             ctx.mesh,
             (P(ctx.axis), P(ctx.axis)),
             (P(ctx.axis), P(ctx.axis), P(ctx.axis),
-             (P(ctx.axis), P(ctx.axis), P(ctx.axis))),
+             (P(ctx.axis), P(ctx.axis), P(ctx.axis)), P()),
             axis=ctx.axis, n_experts=self.n_experts,
             max_tokens=ctx.max_tokens, impl=ctx.impl, interpret=ctx.interpret,
         )

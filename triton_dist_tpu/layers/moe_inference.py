@@ -55,9 +55,10 @@ def moe_infer_shard(x_loc, weights_loc, experts_loc, w_gate, w_up, w_down, *,
     epr = n_experts // world
     hidden = x_loc.shape[1]
 
-    recv, recv_expert, _splits, plan = ep_dispatch_shard(
+    recv, recv_expert, _splits, plan, _dropped = ep_dispatch_shard(
         x_loc, experts_loc, axis=axis, n_experts=n_experts,
         max_tokens=max_tokens, impl=impl, interpret=interpret)
+    max_tokens = recv.shape[1]  # dispatch owns the None→worst-case rule
 
     # Sort received tokens by local expert and run the grouped SwiGLU.
     # Padding rows carry zeros; steering them to expert 0 is harmless (the
@@ -110,9 +111,10 @@ def moe_infer_shard_w8a8(x_loc, weights_loc, experts_loc, wg_q, wg_s, wu_q,
     epr = n_experts // world
     hidden = x_loc.shape[1]
 
-    recv, recv_expert, _splits, plan = ep_dispatch_shard(
+    recv, recv_expert, _splits, plan, _dropped = ep_dispatch_shard(
         x_loc, experts_loc, axis=axis, n_experts=n_experts,
         max_tokens=max_tokens, impl=impl, interpret=interpret)
+    max_tokens = recv.shape[1]  # dispatch owns the None→worst-case rule
 
     T = world * max_tokens
     local_e = jnp.clip(recv_expert.reshape(T, 1) - me * epr, 0, epr - 1)
@@ -144,7 +146,7 @@ class DistributedMoELayer:
     topk: int
     hidden: int
     intermediate: int
-    max_tokens: int
+    max_tokens: int | None = None
     axis: str = "ep"
     block_m: int = 128
     dtype: Any = jnp.bfloat16

@@ -183,7 +183,6 @@ def moe_ffn_shard(h2, layer, cfg: MoEConfig, *, axis, impl, interpret):
     E = cfg.n_experts
     epr = E // world
     t_loc = h2.shape[0]
-    max_tokens = cfg.max_tokens or t_loc * cfg.topk
 
     logits = jnp.dot(h2.astype(jnp.float32), layer["router"])
     weights, experts = topk_routing(logits, cfg.topk)
@@ -198,9 +197,10 @@ def moe_ffn_shard(h2, layer, cfg: MoEConfig, *, axis, impl, interpret):
             .at[experts.reshape(-1)].add(1.0) / (t_loc * cfg.topk))
     aux = E * jnp.sum(frac * jnp.mean(probs, axis=0)) / world
 
-    recv, recv_expert, _splits, plan = ep_dispatch_shard(
+    recv, recv_expert, _splits, plan, _dropped = ep_dispatch_shard(
         h2.astype(cfg.dtype), experts, axis=axis, n_experts=E,
-        max_tokens=max_tokens, impl=impl, interpret=interpret)
+        max_tokens=cfg.max_tokens, impl=impl, interpret=interpret)
+    max_tokens = recv.shape[1]  # dispatch owns the None→worst-case rule
 
     # Local expert compute over the received buffer.  Zero (padding) rows
     # pass through the bias-free FFN as zeros, so steering them to expert 0

@@ -47,8 +47,11 @@ def main():
                                     interpret=_common.INTERPRET)
     layer = EPAll2AllLayer(ctx=ctx, n_experts=E, topk=topk)
 
-    # dispatch: tokens travel to their expert-owner ranks
-    recv, recv_expert, recv_splits, plan = layer.dispatch(x, experts)
+    # dispatch: tokens travel to their expert-owner ranks; n_dropped counts
+    # capacity truncation (always 0 at the default worst-case sizing)
+    recv, recv_expert, recv_splits, plan, n_dropped = layer.dispatch(
+        x, experts)
+    assert int(n_dropped) == 0
 
     # "expert compute": expert e scales by (1 + e) — enough to prove each
     # token really visited the right expert.
