@@ -171,6 +171,126 @@ def test_putmem_dtypes(mesh2, key, dtype):
     np.testing.assert_array_equal(np.asarray(out), want)
 
 
+@pytest.mark.parametrize("root", [0, 2])
+def test_broadcast_verb(mesh4, key, root):
+    """dl.broadcast: root's shard lands everywhere (broadcastmem analog,
+    root-parametrized like test_nvshmem_api's PE sweep)."""
+
+    def kernel(x_ref, o_ref, send, recv):
+        dl.barrier_all("tp")
+        dl.broadcast(x_ref, o_ref, send, recv, "tp", root=root)
+
+    x = jax.random.normal(key, (4 * 8, 128), jnp.float32)
+    out = run_kernel(mesh4, kernel, x,
+                     scratch=[pltpu.SemaphoreType.DMA,
+                              pltpu.SemaphoreType.DMA])
+    want = np.tile(np.asarray(x)[root * 8:(root + 1) * 8], (4, 1))
+    np.testing.assert_allclose(np.asarray(out), want)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16, jnp.int32])
+def test_broadcast_granularities(mesh2, key, dtype):
+    """Broadcast across dtypes — the reference's broadcast8/16/32/64
+    granularity matrix collapses to ref dtypes on TPU."""
+
+    def kernel(x_ref, o_ref, send, recv):
+        dl.barrier_all("tp")
+        dl.broadcast(x_ref, o_ref, send, recv, "tp", root=1)
+
+    if dtype == jnp.int32:
+        x = jax.random.randint(key, (2 * 8, 128), 0, 100, jnp.int32)
+    else:
+        x = jax.random.normal(key, (2 * 8, 128), dtype)
+    out = run_kernel(mesh2, kernel, x,
+                     scratch=[pltpu.SemaphoreType.DMA,
+                              pltpu.SemaphoreType.DMA])
+    want = np.tile(np.asarray(x)[8:16], (2, 1))
+    np.testing.assert_array_equal(np.asarray(out), want)
+
+
+def test_fcollect_verb(mesh4, key):
+    """dl.fcollect == all-gather into per-rank slots (fcollect analog)."""
+
+    def kernel(x_ref, o_ref, send, recv):
+        dl.barrier_all("tp")
+        dl.fcollect(x_ref, o_ref, send, recv, "tp")
+
+    x = jax.random.normal(key, (4 * 8, 128), jnp.float32)
+    out = run_kernel(
+        mesh4, kernel, x,
+        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),  # per-device
+        out_spec=P("tp"),
+        scratch=[pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA])
+    # Every device holds the full gather → sharded output stacks 4 copies.
+    want = np.tile(np.asarray(x), (4, 1))
+    np.testing.assert_allclose(np.asarray(out), want)
+
+
+def test_notify_signal_op_increments(mesh4):
+    """signal_op ADD with mixed increments: peers contribute 1, 3, 5, 7 —
+    the waiter consumes the exact sum (test_nvshmem_api signal-op variants;
+    SET/atomic flavors collapse to ADD, the one hardware signal op)."""
+
+    def kernel(x_ref, o_ref, tmp, sem, copy_sem):
+        dl.barrier_all("tp")
+        world = dl.num_ranks("tp")
+        me = dl.rank("tp")
+
+        def sig(i, c):
+            peer = jax.lax.rem(me + i, world)
+            dl.notify(sem, axis="tp", device_id=peer, inc=2 * me + 1)
+            return c
+
+        jax.lax.fori_loop(0, world, sig, 0)
+        dl.wait(sem, 1 + 3 + 5 + 7)  # sum over all ranks' contributions
+        tmp[...] = jnp.zeros_like(tmp) + 1.0
+        dl.local_copy(tmp, o_ref, copy_sem).wait()
+
+    x = jnp.zeros((4 * 8, 128), jnp.float32)
+    out = run_kernel(mesh4, kernel, x,
+                     scratch=[pltpu.VMEM((8, 128), jnp.float32),
+                              pltpu.SemaphoreType.REGULAR,
+                              pltpu.SemaphoreType.DMA])
+    np.testing.assert_allclose(np.asarray(out), np.ones((32, 128)))
+
+
+def test_barrier_stress(mesh8):
+    """Back-to-back barrier rounds with interleaved remote puts: each round
+    shifts the block one rank right; 6 rounds = rotation by 6 (barrier
+    stress-loop analog of test_nvshmem_api's repeated barrier case)."""
+    rounds = 6
+
+    def kernel(x_ref, o_ref, tmp, send, recv, copy_sem):
+        world = dl.num_ranks("tp")
+        me = dl.rank("tp")
+        right = jax.lax.rem(me + 1, world)
+        dl.local_copy(x_ref, tmp, copy_sem).wait()
+        dl.barrier_all("tp")
+
+        def one_round(r, c):
+            # Double-buffered rotate: tmp → right's o_ref; the barrier at
+            # the end guarantees every peer has drained o_ref back into tmp
+            # before the next round's put overwrites it.
+            cp = dl.putmem(tmp, o_ref, send, recv, "tp", right)
+            cp.wait_send()
+            dl.wait_arrival(o_ref, recv)
+            dl.local_copy(o_ref, tmp, copy_sem).wait()
+            dl.barrier_all("tp")
+            return c
+
+        jax.lax.fori_loop(0, rounds, one_round, 0)
+
+    x = jax.random.normal(jax.random.key(3), (8 * 8, 128), jnp.float32)
+    out = run_kernel(mesh8, kernel, x,
+                     scratch=[pltpu.VMEM((8, 128), jnp.float32),
+                              pltpu.SemaphoreType.DMA,
+                              pltpu.SemaphoreType.DMA,
+                              pltpu.SemaphoreType.DMA])
+    want = np.roll(np.asarray(x).reshape(8, 8, 128), rounds,
+                   axis=0).reshape(64, 128)
+    np.testing.assert_allclose(np.asarray(out), want)
+
+
 # ---------------------------------------------------------------------------
 # Race detection (reference: for_correctness / _add_noise_workload_debug)
 # ---------------------------------------------------------------------------

@@ -87,11 +87,6 @@ def create_allgather_context(mesh, axis="tp", method=AllGatherMethod.AUTO, inter
 # ---------------------------------------------------------------------------
 
 
-def _wait_bytes(ref, sem):
-    """Wait on ``sem`` for one DMA the size of ``ref`` (descriptor trick)."""
-    pltpu.make_async_copy(ref, ref, sem).wait()
-
-
 def _ring_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *, axis, world, rows):
     """Unidirectional ring: step s forwards chunk (me - s) mod world to the
     right neighbor.  Reference analog: cp_engine_producer_all_gather_ring_push_1d
@@ -173,7 +168,10 @@ def _full_mesh_push_ag_kernel(
 ):
     """Every device pushes its chunk to all peers at once; ICI routes the
     hops.  Latency-optimal for small chunks.  Reference analog: full-mesh
-    push (allgather.py:104-135) over NVLink."""
+    push (allgather.py:104-135) over NVLink.
+
+    The body IS the ``fcollect`` verb: stage my slot (overlapped with kernel
+    entry, hence ``stage_local=False`` below), barrier, gather round."""
     me = jax.lax.axis_index(axis)
 
     cp = pltpu.make_async_copy(x_ref, out_ref.at[pl.ds(me * rows, rows)], copy_sem)
@@ -182,15 +180,7 @@ def _full_mesh_push_ag_kernel(
 
     dl.barrier_all(axis)  # self-guards the world-1 degenerate mesh
 
-    mine = out_ref.at[pl.ds(me * rows, rows)]
-    for i in range(1, world):
-        peer = jax.lax.rem(me + i, world)
-        dl.remote_copy(mine, mine, send_sem, recv_sem, axis, peer).start()
-    # Drain sends, then wait for the world-1 incoming chunks.
-    for _ in range(world - 1):
-        _wait_bytes(mine, send_sem)
-    for _ in range(world - 1):
-        _wait_bytes(mine, recv_sem)
+    dl.fcollect(x_ref, out_ref, send_sem, recv_sem, axis, stage_local=False)
 
 
 _KERNELS = {

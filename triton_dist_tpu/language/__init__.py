@@ -44,6 +44,12 @@ reference primitive                TPU-native implementation
 ``getmem_*``                       ``getmem(...)`` — pulls are realized by
                                    SPMD mirror pushes (TPU RDMA is
                                    push-only); rank-relative peers only
+``broadcast{8,16,...}_block``      ``broadcast(src, dst, ..., root)`` — the
+                                   ~10 granularity variants collapse: one
+                                   remote DMA moves any ref shape/dtype
+``fcollect{8,16,...}``             ``fcollect(src, dst, ...)`` — in-kernel
+                                   all-gather round (full-mesh push into
+                                   per-rank slots)
 ``signal_op(sig, val, ADD, pe)``   ``notify(sem, axis=a, device_id=pe,
                                    inc=val)``
 ``signal_wait_until(sig, GE, v)``  ``wait(sem, v)`` (decrements; see note)
@@ -78,6 +84,8 @@ from triton_dist_tpu.language.primitives import (  # noqa: F401
     putmem,
     putmem_signal,
     getmem,
+    broadcast,
+    fcollect,
     remote_copy,
     wait_arrival,
     local_copy,

@@ -158,6 +158,86 @@ def getmem(src_ref, dst_ref, send_sem, recv_sem, axis, device_id):
     return cp
 
 
+def broadcast(src_ref, dst_ref, send_sem, recv_sem, axis, root=0):
+    """One-to-all, blocking: ``root``'s ``src_ref`` lands in every device's
+    ``dst_ref`` (same shape) along ``axis``.
+
+    Reference: the ``libnvshmem_device`` broadcast family
+    (``broadcastmem_block`` / ``broadcast{8,16,32,64}...``, ~10 variants) —
+    granularity variants collapse on TPU because one remote DMA moves any
+    ref shape.  Owner-push formulation: the root streams its buffer to each
+    peer (ICI routes the hops), peers block on arrival.  Like every
+    collective verb, the caller must ensure all peers have entered the
+    kernel first (``barrier_all`` — see its docstring contract).
+    """
+    world = num_ranks(axis)
+    if not isinstance(root, jax.core.Tracer) and not 0 <= root < world:
+        raise ValueError(
+            f"broadcast root={root} outside [0, {world}): no rank would "
+            "push and every device would hang on arrival")
+    if world == 1:  # degenerate mesh: plain local copy
+        cp = pltpu.make_async_copy(src_ref, dst_ref, send_sem)
+        cp.start()
+        cp.wait()
+        return
+    me = rank(axis)
+    is_root = me == root
+
+    @pl.when(is_root)
+    def _():
+        # Peer pushes source from src_ref, so they are independent of the
+        # local src→dst copy — fire them first, overlap the local copy.
+        for i in range(1, world):
+            peer = jax.lax.rem(root + i, world)
+            remote_copy(src_ref, dst_ref, send_sem, recv_sem, axis,
+                        peer).start()
+        cp = pltpu.make_async_copy(src_ref, dst_ref, send_sem)
+        cp.start()
+        cp.wait()
+        for _ in range(1, world):  # drain sends (quiet)
+            pltpu.make_async_copy(src_ref, src_ref, send_sem).wait()
+
+    @pl.when(jnp.logical_not(is_root))
+    def _():
+        pltpu.make_async_copy(dst_ref, dst_ref, recv_sem).wait()
+
+
+def fcollect(src_ref, dst_ref, send_sem, recv_sem, axis, *, copy_sem=None,
+             stage_local=True):
+    """All-gather, blocking: every device's ``src_ref`` [rows, ...] lands at
+    slot ``rank`` of every device's ``dst_ref`` [world*rows, ...].
+
+    Reference: NVSHMEM ``fcollect{8,16,32,...}`` (libnvshmem_device.py) —
+    the in-kernel gather round the hierarchy/AG kernels otherwise re-derive.
+    Full-mesh push: stage my shard into my slot of ``dst_ref``, push that
+    slot to every peer, drain sends, then wait for the ``world-1`` incoming
+    slots.  ``stage_local=False`` skips the staging copy when the caller
+    already placed its shard (lets a kernel overlap the stage with its entry
+    barrier).  Same entry-barrier contract as :func:`broadcast`.
+    """
+    world = num_ranks(axis)
+    rows = src_ref.shape[0]
+    me = rank(axis)
+    mine = dst_ref.at[pl.ds(me * rows, rows)]
+    # Remote pushes source from src_ref (not the dst slot), so they do not
+    # depend on the staging copy — fire all of them first, then overlap the
+    # local stage with the fan-out.
+    for i in range(1, world):
+        peer = jax.lax.rem(me + i, world)
+        remote_copy(src_ref, mine, send_sem, recv_sem, axis, peer).start()
+    if stage_local:
+        cp = pltpu.make_async_copy(
+            src_ref, mine, send_sem if copy_sem is None else copy_sem)
+        cp.start()
+        cp.wait()
+    if world == 1:
+        return
+    for _ in range(1, world):  # drain sends (quiet)
+        pltpu.make_async_copy(mine, mine, send_sem).wait()
+    for _ in range(1, world):  # arrival of every peer slot
+        pltpu.make_async_copy(mine, mine, recv_sem).wait()
+
+
 def wait_arrival(ref, recv_sem):
     """Receiver-side wait for a sender-initiated put into ``ref``.
 
