@@ -1,0 +1,284 @@
+"""Torus collective tests on the virtual CPU mesh.
+
+Reference analog: the 2D-ring / inter-node AllGather variant tests of
+``test/nvidia/test_ag_gemm.py`` + ``allgather.py:194-258,470-591`` — here
+the fabric-matched schedule is the fused multi-axis torus kernel, checked
+against ``lax.all_gather`` / ``lax.psum_scatter`` over the joint axes.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.kernels.torus import (
+    torus_all_gather_shard,
+    torus_reduce_scatter_shard,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh2x4():
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("x", "y"))
+
+
+@pytest.fixture(scope="module")
+def mesh4x2():
+    return Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("x", "y"))
+
+
+@pytest.fixture(scope="module")
+def mesh2x2x2():
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("x", "y", "z"))
+
+
+def _run_ag(mesh, x, axes):
+    fn = jax.jit(jax.shard_map(
+        functools.partial(torus_all_gather_shard, axes=axes, interpret=True),
+        mesh=mesh, in_specs=P(axes), out_specs=P(), check_vma=False))
+    return fn(x)
+
+
+def _run_rs(mesh, x, axes):
+    # Every device holds a full-size partial (replicated spec in, sharded
+    # out) — psum_scatter semantics.
+    fn = jax.jit(jax.shard_map(
+        functools.partial(torus_reduce_scatter_shard, axes=axes,
+                          interpret=True),
+        mesh=mesh, in_specs=P(), out_specs=P(axes), check_vma=False))
+    return fn(x)
+
+
+@pytest.mark.parametrize("meshname", ["mesh2x4", "mesh4x2"])
+@pytest.mark.parametrize("rows", [8, 6, 4])
+def test_torus2d_allgather(meshname, rows, key, request):
+    """Fused 2D AG == lax.all_gather over the joint axes, including rows
+    not divisible by 4 (uneven quarters) and rows < 4 (inactive paths)."""
+    mesh = request.getfixturevalue(meshname)
+    T = rows * 8
+    x = jax.random.normal(key, (T, 128), jnp.float32)
+    got = _run_ag(mesh, x, ("x", "y"))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(x), rtol=1e-6)
+
+
+def test_torus2d_allgather_order_matches_hier(mesh2x4, key):
+    """Flat output order is axes-major — identical to the hierarchical
+    composition (drop-in replacement contract)."""
+    from triton_dist_tpu.kernels.hierarchical import hier_all_gather_shard
+
+    x = jax.random.normal(key, (64, 128), jnp.float32)
+    got = _run_ag(mesh2x4, x, ("x", "y"))
+    ref = jax.jit(jax.shard_map(
+        functools.partial(hier_all_gather_shard, slow_axis="x",
+                          fast_axis="y", interpret=True),
+        mesh=mesh2x4, in_specs=P(("x", "y")), out_specs=P(),
+        check_vma=False))(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6)
+
+
+def test_torus2d_allgather_bf16(mesh2x4, key):
+    x = jax.random.normal(key, (32, 128), jnp.bfloat16)
+    got = _run_ag(mesh2x4, x, ("x", "y"))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(x))
+
+
+def test_torus3d_allgather(mesh2x2x2, key):
+    """3-axis composition on the 2x2x2 torus (v5p-32-like shape /4)."""
+    x = jax.random.normal(key, (32, 128), jnp.float32)
+    got = _run_ag(mesh2x2x2, x, ("x", "y", "z"))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(x), rtol=1e-6)
+
+
+def test_torus_degenerate_axes(mesh2x4, key):
+    """A size-1 axis falls back to the 1-axis ring path."""
+    mesh1x4 = Mesh(np.array(jax.devices()[:4]).reshape(1, 4), ("x", "y"))
+    x = jax.random.normal(key, (16, 128), jnp.float32)
+    got = jax.jit(jax.shard_map(
+        functools.partial(torus_all_gather_shard, axes=("x", "y"),
+                          interpret=True),
+        mesh=mesh1x4, in_specs=P(("x", "y")), out_specs=P(),
+        check_vma=False))(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(x), rtol=1e-6)
+
+
+@pytest.mark.parametrize("meshname", ["mesh2x4", "mesh4x2"])
+@pytest.mark.parametrize("rows", [8, 5])
+def test_torus2d_reduce_scatter(meshname, rows, key, request):
+    """Fused 2D RS == psum_scatter over the joint axes (incl. odd rows →
+    uneven halves)."""
+    mesh = request.getfixturevalue(meshname)
+    T = rows * 8
+    x = jax.random.normal(key, (T, 128), jnp.float32)
+    got = _run_rs(mesh, x, ("x", "y"))
+    # Reference: every device contributed the same full partial x, so the
+    # reduced result is world * x.
+    np.testing.assert_allclose(np.asarray(got), 8 * np.asarray(x),
+                               rtol=1e-5)
+
+
+def test_torus2d_reduce_scatter_distinct_partials(mesh2x4):
+    """Each device contributes a DIFFERENT partial (P(axes) input sliced as
+    replicated inside): sum must match the dense sum."""
+    world, T = 8, 32
+    base = jnp.arange(T * 128, dtype=jnp.float32).reshape(T, 128)
+
+    def shard_fn(seed_ref):
+        # Per-device partial derived from the device's flat rank.
+        i = jax.lax.axis_index("x")
+        j = jax.lax.axis_index("y")
+        r = (i * 4 + j).astype(jnp.float32)
+        partial = seed_ref * (r + 1.0)
+        return torus_reduce_scatter_shard(partial, ("x", "y"),
+                                          interpret=True)
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("x", "y"))
+    got = jax.jit(jax.shard_map(shard_fn, mesh=mesh, in_specs=P(),
+                                out_specs=P(("x", "y")),
+                                check_vma=False))(base)
+    scale = sum(r + 1.0 for r in range(world))  # 36
+    np.testing.assert_allclose(np.asarray(got), scale * np.asarray(base),
+                               rtol=1e-5)
+
+
+def test_torus3d_reduce_scatter(mesh2x2x2, key):
+    x = jax.random.normal(key, (32, 128), jnp.float32)
+    got = _run_rs(mesh2x2x2, x, ("x", "y", "z"))
+    np.testing.assert_allclose(np.asarray(got), 8 * np.asarray(x),
+                               rtol=1e-5)
+
+
+def test_torus_ag_rs_roundtrip(mesh2x4, key):
+    """RS(AG(x)) == world * x band-for-band (order consistency of the two
+    kernels' flat layouts)."""
+
+    def shard_fn(x_loc):
+        full = torus_all_gather_shard(x_loc, ("x", "y"), interpret=True)
+        return torus_reduce_scatter_shard(full, ("x", "y"), interpret=True)
+
+    x = jax.random.normal(key, (64, 128), jnp.float32)
+    got = jax.jit(jax.shard_map(shard_fn, mesh=mesh2x4,
+                                in_specs=P(("x", "y")),
+                                out_specs=P(("x", "y")),
+                                check_vma=False))(x)
+    np.testing.assert_allclose(np.asarray(got), 8 * np.asarray(x),
+                               rtol=1e-5)
+
+
+def test_multi_axis_dispatch(mesh2x4, key):
+    """all_gather_shard / reduce_scatter_shard route tuple axes to the
+    torus kernels; choose_allgather_method dispatches on mesh shape."""
+    from triton_dist_tpu.kernels.allgather import (
+        AllGatherMethod,
+        all_gather_shard,
+        choose_allgather_method,
+    )
+    from triton_dist_tpu.kernels.reduce_scatter import reduce_scatter_shard
+
+    assert choose_allgather_method(
+        4 << 20, 8, axis_sizes=(2, 4)) is AllGatherMethod.TORUS_2D
+    assert choose_allgather_method(
+        1024, 8, axis_sizes=(2, 4)) is not AllGatherMethod.TORUS_2D
+    assert choose_allgather_method(
+        4 << 20, 8, axis_sizes=(1, 8)) is AllGatherMethod.RING_BIDIR
+
+    x = jax.random.normal(key, (64, 128), jnp.float32)
+    got = jax.jit(jax.shard_map(
+        functools.partial(all_gather_shard, axis=("x", "y"),
+                          method=AllGatherMethod.TORUS_2D, interpret=True),
+        mesh=mesh2x4, in_specs=P(("x", "y")), out_specs=P(),
+        check_vma=False))(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(x), rtol=1e-6)
+
+    got_rs = jax.jit(jax.shard_map(
+        functools.partial(reduce_scatter_shard, axis=("x", "y"),
+                          interpret=True),
+        mesh=mesh2x4, in_specs=P(), out_specs=P(("x", "y")),
+        check_vma=False))(x)
+    np.testing.assert_allclose(np.asarray(got_rs), 8 * np.asarray(x),
+                               rtol=1e-5)
+
+
+def test_torus_perf_model_speedup():
+    """The model shows the fused plane ~2x a single bidir ring and ~4x a
+    unidirectional ring at v5p-32-like shapes (4x4x2 torus, VERDICT #2)."""
+    from triton_dist_tpu.kernels.perf_model import (
+        estimate_allgather_time_ms,
+        estimate_torus_allgather_time_ms,
+        estimate_torus_reduce_scatter_time_ms,
+    )
+
+    S = 64 << 20  # 64 MiB shard
+    bw = 100.0
+    uni = estimate_allgather_time_ms(S, 16, bw_gbps=bw / 2)  # one direction
+    bidir = estimate_torus_allgather_time_ms(S, (16,), bw_gbps=bw)
+    plane = estimate_torus_allgather_time_ms(S, (4, 4), bw_gbps=bw)
+    assert np.isclose(bidir / plane, 2.0, rtol=0.01), (bidir, plane)
+    assert np.isclose(uni / plane, 4.0, rtol=0.01), (uni, plane)
+    # 3-axis: 4x4 plane + ring on the 2-axis; dominated by the third hop.
+    t3 = estimate_torus_allgather_time_ms(S, (2, 4, 4), bw_gbps=bw)
+    assert t3 > plane
+    # RS: square-plane fused path beats the sequential composition bound.
+    rs2 = estimate_torus_reduce_scatter_time_ms(S, (4, 4), bw_gbps=bw)
+    rs1 = estimate_torus_reduce_scatter_time_ms(S, (16,), bw_gbps=bw)
+    assert rs2 < rs1
+
+
+def test_torus_ag_gemm(mesh2x4, key):
+    """2-axis AG-GEMM == allgather(A) @ B, gathered A included (the torus
+    schedule as segment producer, VERDICT #2)."""
+    from triton_dist_tpu.kernels.allgather_gemm import (
+        AllGatherGEMMContext,
+        ag_gemm_gathered,
+    )
+
+    M, K, N = 64, 128, 256
+    ks = jax.random.split(key, 2)
+    a = jax.random.normal(ks[0], (M, K), jnp.float32)
+    b = jax.random.normal(ks[1], (K, N), jnp.float32)
+    ctx = AllGatherGEMMContext(mesh=mesh2x4, axis=("x", "y"), impl="pallas",
+                               interpret=True)
+    a_full, c = ag_gemm_gathered(a, b, ctx)
+    np.testing.assert_allclose(np.asarray(a_full), np.asarray(a), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(a) @ np.asarray(b),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_torus_ag_gemm_bf16(mesh4x2, key):
+    from triton_dist_tpu.kernels.allgather_gemm import (
+        AllGatherGEMMContext,
+        ag_gemm,
+    )
+
+    M, K, N = 64, 128, 256
+    ks = jax.random.split(key, 2)
+    a = jax.random.normal(ks[0], (M, K), jnp.bfloat16)
+    b = jax.random.normal(ks[1], (K, N), jnp.bfloat16)
+    ctx = AllGatherGEMMContext(mesh=mesh4x2, axis=("x", "y"), impl="pallas",
+                               interpret=True)
+    c = ag_gemm(a, b, ctx)
+    ref = (np.asarray(a, np.float32) @ np.asarray(b, np.float32))
+    np.testing.assert_allclose(np.asarray(c, np.float32), ref,
+                               rtol=5e-2, atol=5e-1)
+
+
+def test_torus_gemm_rs(mesh2x4, key):
+    """2-axis GEMM-RS == psum_scatter(A @ B) in natural row order (axis-
+    swapped out_specs reassembly)."""
+    from triton_dist_tpu.kernels.gemm_reduce_scatter import (
+        GEMMReduceScatterContext,
+        gemm_rs,
+    )
+
+    M, K, N = 64, 256, 128
+    ks = jax.random.split(key, 2)
+    a = jax.random.normal(ks[0], (M, K), jnp.float32)
+    b = jax.random.normal(ks[1], (K, N), jnp.float32)
+    ctx = GEMMReduceScatterContext(mesh=mesh2x4, axis=("x", "y"),
+                                   impl="pallas", interpret=True)
+    c = gemm_rs(a, b, ctx)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(a) @ np.asarray(b),
+                               rtol=2e-3, atol=2e-3)

@@ -47,7 +47,11 @@ from triton_dist_tpu.kernels.gemm import resolve_impl
 from triton_dist_tpu.language.interpret import maybe_interpret
 from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
 
-A2A_COLLECTIVE_ID = 5
+from triton_dist_tpu.kernels.collective_ids import (
+    A2A as A2A_COLLECTIVE_ID,
+    HIER_A2A_FAST,
+    HIER_A2A_SLOW,
+)
 
 
 @dataclass
@@ -146,13 +150,12 @@ def fast_all_to_all_shard(send, splits, *, axis, impl, interpret,
         from triton_dist_tpu.kernels.hierarchical import (
             hier_all_to_all_shard)
 
-        # Two-stage path needs two ids; 2*cid+2/3 keeps distinct caller
-        # ids distinct and maps the default (5) onto the hierarchical
-        # kernels' reserved pair (12, 13).
+        # Two-stage path uses the hierarchical kernels' reserved id pair
+        # (collective_ids.py registry).
         return hier_all_to_all_shard(
             send, splits, slow_axis=axis[0], fast_axis=axis[1], impl=impl,
             interpret=interpret,
-            collective_ids=(2 * collective_id + 2, 2 * collective_id + 3))
+            collective_ids=(HIER_A2A_SLOW, HIER_A2A_FAST))
 
     if impl == "xla":
         recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0,

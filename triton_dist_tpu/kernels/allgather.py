@@ -48,15 +48,25 @@ class AllGatherMethod(enum.Enum):
     RING_1D = "ring_1d"
     RING_BIDIR = "ring_bidir"
     FULL_MESH_PUSH = "full_mesh_push"
+    TORUS_2D = "torus_2d"  # fused multi-axis schedule (kernels/torus.py)
 
 
-def choose_allgather_method(nbytes_per_rank: int, n_ranks: int) -> AllGatherMethod:
-    """Topology/size-based auto-selection (reference: allgather.py:54-69).
+def choose_allgather_method(nbytes_per_rank: int, n_ranks: int,
+                            axis_sizes: tuple[int, ...] | None = None
+                            ) -> AllGatherMethod:
+    """Topology/size-based auto-selection (reference: allgather.py:54-69,
+    which picks among six fabric-tuned variants by node topology).
 
-    Small messages are latency-bound → one-hop full-mesh push; large messages
-    are bandwidth-bound → bidirectional ring (saturates both directions of
-    the ICI torus axis).
+    Dispatch here is on mesh shape + payload: a gather spanning >= 2
+    non-trivial torus axes routes to the fused torus schedule (all link
+    directions of the plane busy, ~2x a single bidir ring); on one axis,
+    small messages are latency-bound → one-hop full-mesh push, large
+    messages bandwidth-bound → bidirectional ring.
     """
+    if axis_sizes is not None:
+        real = [s for s in axis_sizes if s > 1]
+        if len(real) >= 2 and nbytes_per_rank > 64 * 1024:
+            return AllGatherMethod.TORUS_2D
     if n_ranks <= 2:
         return AllGatherMethod.FULL_MESH_PUSH
     if nbytes_per_rank <= 256 * 1024:
@@ -209,18 +219,49 @@ def _ag_pallas_shard(x_shard, *, axis, world, method, interpret, collective_id=1
     )(x_shard)
 
 
-def all_gather_shard(x_shard, axis: str, method=AllGatherMethod.AUTO,
+def all_gather_shard(x_shard, axis, method=AllGatherMethod.AUTO,
                      interpret=False, collective_id=1):
     """AllGather the leading dim of a per-device shard; use inside shard_map.
 
-    Matches ``lax.all_gather(x, axis, tiled=True)`` semantics.
+    Matches ``lax.all_gather(x, axis, tiled=True)`` semantics.  ``axis``
+    may be one mesh axis name or a tuple of 2-3 — a multi-axis gather
+    auto-routes to the fused torus schedule (``kernels/torus.py``) when the
+    payload is bandwidth-bound, XLA's joint-axis gather when latency-bound.
     """
+    if isinstance(axis, (tuple, list)) and len(axis) > 1:
+        from triton_dist_tpu.kernels.torus import torus_all_gather_shard
+
+        axes = tuple(axis)
+        sizes = tuple(jax.lax.axis_size(a) for a in axes)
+        real = [a for a, s in zip(axes, sizes) if s > 1]
+        if len(real) <= 1:
+            # Degenerate joint gather: recurse into the single-axis
+            # dispatch below, honoring the caller's explicit method.
+            if not real:
+                return x_shard
+            axis = real[0]
+        else:
+            if method is AllGatherMethod.AUTO:
+                nbytes = int(np.prod(x_shard.shape)) * x_shard.dtype.itemsize
+                method = choose_allgather_method(
+                    nbytes, int(np.prod(sizes)), axis_sizes=sizes)
+            if method is AllGatherMethod.XLA:
+                return jax.lax.all_gather(x_shard, axes, axis=0, tiled=True)
+            # Every pallas method on >= 2 real axes is the fused torus
+            # schedule (the per-axis ring variants have no joint-axis
+            # meaning).
+            return torus_all_gather_shard(x_shard, axes,
+                                          interpret=interpret,
+                                          collective_id=collective_id)
+    axis = axis[0] if isinstance(axis, (tuple, list)) else axis
     world = jax.lax.axis_size(axis)
     if world == 1:
         return x_shard
     if method is AllGatherMethod.AUTO:
         nbytes = int(np.prod(x_shard.shape)) * x_shard.dtype.itemsize
         method = choose_allgather_method(nbytes, world)
+    if method is AllGatherMethod.TORUS_2D:
+        method = AllGatherMethod.RING_BIDIR  # one axis: torus degenerates
     if method is AllGatherMethod.XLA:
         return jax.lax.all_gather(x_shard, axis, axis=0, tiled=True)
     return _ag_pallas_shard(

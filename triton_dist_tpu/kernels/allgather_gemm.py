@@ -55,7 +55,7 @@ from triton_dist_tpu.kernels.gemm import (
 from triton_dist_tpu.language.interpret import maybe_interpret
 from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
 
-AG_GEMM_COLLECTIVE_ID = 3
+from triton_dist_tpu.kernels.collective_ids import AG_GEMM as AG_GEMM_COLLECTIVE_ID
 
 
 @dataclass
@@ -159,14 +159,163 @@ def _ag_gemm_kernel(
     cp.wait()
 
 
+def _torus_ag_gemm_kernel(
+    a_ref,      # [m_loc, K]                ANY (HBM)
+    b_ref,      # [K, n_loc]                ANY
+    ag_ref,     # [wx, wy, m_loc, K]        ANY, output: gathered A
+    out_ref,    # [wx, wy, m_loc, n_loc]    ANY, output: C shard
+    send_x, recv_x, send_y, recv_y, copy_sem,
+    acc_ref,
+    *,
+    ax, ay, wx, wy, m_loc, bm, bn, bk, out_dtype,
+):
+    """2-axis torus AG-GEMM: the torus schedule as the segment producer.
+
+    Phase 1 is the 1-D ring over ``ax`` (slot per step, GEMM consumes each
+    as it arrives); phase 2 rings whole first-axis LINES (wx slots) over
+    ``ay``, each line's forward DMA riding under the wx slot-GEMMs of the
+    previously arrived line.  Per-phase semaphore pairs keep a fast
+    neighbor's early phase-2 arrival from satisfying a phase-1 wait
+    (cf. kernels/torus.py).  Consume order = arrival order, so step 0 is
+    always the local segment — the reference's rank swizzle
+    (allgather_gemm.py:206-219), inherited per axis.
+    """
+    i = jax.lax.axis_index(ax)
+    j = jax.lax.axis_index(ay)
+    right = jax.lax.rem(i + 1, wx)
+    down = jax.lax.rem(j + 1, wy)
+
+    # Stage the local segment (hidden behind step 0's GEMM; waited before
+    # phase 2 ships the line that contains it).
+    cp = pltpu.make_async_copy(a_ref, ag_ref.at[i, j], copy_sem)
+    cp.start()
+
+    dl.barrier_all(ax)
+    dl.barrier_all(ay)
+
+    K = a_ref.shape[1]
+    n_loc = b_ref.shape[1]
+    n_m, n_n, n_k = m_loc // bm, n_loc // bn, K // bk
+
+    inner = pltpu.emit_pipeline(
+        functools.partial(gemm_pipeline_body, n_k=n_k, out_dtype=out_dtype),
+        grid=(n_m, n_n, n_k),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+        ],
+        out_specs=[pl.BlockSpec((bm, bn), lambda i, j, k: (i, j))],
+    )
+
+    # ---- Phase 1: x-ring over my line j, one slot per step. ----
+    for s in range(wx):
+        slot = jax.lax.rem(i - s + wx, wx)
+        seg = ag_ref.at[slot, j]
+        src = a_ref if s == 0 else seg
+        if s > 0:
+            pltpu.make_async_copy(seg, seg, recv_x).wait()
+        if s < wx - 1:
+            dl.remote_copy(src, seg, send_x, recv_x, ax, right).start()
+        inner(src, b_ref, out_ref.at[slot, j], scratches=(acc_ref,))
+        if s < wx - 1:
+            pltpu.make_async_copy(src, src, send_x).wait()
+
+    # Phase 2's first shipped line (j) contains the staged slot, and the
+    # gathered-A output must be valid at kernel exit either way — the
+    # staging DMA has had phase 1's wx GEMMs to hide behind.
+    cp.wait()
+
+    # ---- Phase 2: y-ring over whole lines, wx slot-GEMMs per step. ----
+    for t in range(wy - 1):
+        line_send = jax.lax.rem(j - t + wy, wy)
+        blk = ag_ref.at[:, line_send]
+        dl.remote_copy(blk, blk, send_y, recv_y, ay, down).start()
+
+        line_recv = jax.lax.rem(j - t - 1 + wy, wy)
+        rblk = ag_ref.at[:, line_recv]
+        pltpu.make_async_copy(rblk, rblk, recv_y).wait()
+        for ii in range(wx):
+            inner(ag_ref.at[ii, line_recv], b_ref,
+                  out_ref.at[ii, line_recv], scratches=(acc_ref,))
+        pltpu.make_async_copy(blk, blk, send_y).wait()
+
+
+def _torus_ag_gemm_shard(a_shard, b_shard, *, axes, impl, bm, bn, bk,
+                         interpret):
+    """Per-device 2-axis torus AG-GEMM (see kernel docstring).  Gathered A
+    comes back flat axes-major, C as the matching [W*m_loc, n_loc]."""
+    ax, ay = axes
+    wx = jax.lax.axis_size(ax)
+    wy = jax.lax.axis_size(ay)
+    world = wx * wy
+    m_loc, K = a_shard.shape
+    n_loc = b_shard.shape[1]
+    quantized = a_shard.dtype == jnp.int8
+    out_dtype = jnp.int32 if quantized else a_shard.dtype
+    acc_dtype = jnp.int32 if quantized else jnp.float32
+
+    if impl == "xla" or not pallas_shapes_ok(m_loc, n_loc, K):
+        a_full = jax.lax.all_gather(a_shard, axes, axis=0, tiled=True)
+        pref = jnp.int32 if quantized else jnp.float32
+        return a_full, jnp.dot(
+            a_full, b_shard, preferred_element_type=pref).astype(out_dtype)
+
+    bm = largest_divisor_block(m_loc, bm, 8)
+    bn = largest_divisor_block(n_loc, bn, 128)
+    bk = largest_divisor_block(K, bk, 128)
+
+    ag4, c4 = pl.pallas_call(
+        functools.partial(
+            _torus_ag_gemm_kernel, ax=ax, ay=ay, wx=wx, wy=wy, m_loc=m_loc,
+            bm=bm, bn=bn, bk=bk, out_dtype=out_dtype,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((wx, wy, m_loc, K), a_shard.dtype),
+            jax.ShapeDtypeStruct((wx, wy, m_loc, n_loc), out_dtype),
+        ],
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)],
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((bm, bn), acc_dtype),
+        ],
+        compiler_params=dl.collective_compiler_params(
+            world, AG_GEMM_COLLECTIVE_ID),
+        interpret=maybe_interpret(interpret),
+    )(a_shard, b_shard)
+    return (ag4.reshape(world * m_loc, K),
+            c4.reshape(world * m_loc, n_loc))
+
+
 def ag_gemm_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
                   bk=None, interpret=False):
     """Per-device AG-GEMM; call inside shard_map.  Returns (A_full, C_shard).
-    Block sizes default to the swept MatmulConfig (gemm.py)."""
+    Block sizes default to the swept MatmulConfig (gemm.py).  ``axis`` may
+    be a tuple of 2 mesh axes — A's rows sharded over the axes-major joint
+    axes — routing to the torus schedule (phase-interleaved 2-axis ring
+    producer, ``_torus_ag_gemm_kernel``)."""
     _cfg = MatmulConfig()
     bm, bn, bk = bm or _cfg.block_m, bn or _cfg.block_n, bk or _cfg.block_k
     raw_impl = impl
     impl = resolve_impl(impl, interpret)
+    if isinstance(axis, (tuple, list)) and len(axis) > 1:
+        axes = tuple(axis)
+        if len(axes) != 2:
+            raise ValueError(f"ag_gemm supports 1 or 2 axes, got {axes}")
+        sizes = tuple(jax.lax.axis_size(a) for a in axes)
+        if 1 in sizes:  # degenerate: one real axis
+            axis = axes[sizes.index(max(sizes))]
+        else:
+            return _torus_ag_gemm_shard(a_shard, b_shard, axes=axes,
+                                        impl=impl, bm=bm, bn=bn, bk=bk,
+                                        interpret=interpret)
+    axis = axis[0] if isinstance(axis, (tuple, list)) else axis
     world = jax.lax.axis_size(axis)
     m_loc, K = a_shard.shape
     n_loc = b_shard.shape[1]

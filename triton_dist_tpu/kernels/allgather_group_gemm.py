@@ -63,7 +63,7 @@ from triton_dist_tpu.kernels.moe_utils import (
 from triton_dist_tpu.language.interpret import maybe_interpret
 from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
 
-AG_GROUP_GEMM_COLLECTIVE_ID = 9
+from triton_dist_tpu.kernels.collective_ids import AG_GROUP_GEMM as AG_GROUP_GEMM_COLLECTIVE_ID
 
 
 @dataclass

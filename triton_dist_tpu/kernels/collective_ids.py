@@ -1,0 +1,33 @@
+"""Central registry of Pallas ``collective_id`` values.
+
+Each collectively-launched Mosaic kernel claims a barrier semaphore by
+``collective_id``; two kernels that may be in flight in the same program
+must not share one (aliased barrier semaphores can deadlock or race).
+Keeping every id in one table makes collisions impossible to miss —
+round-2 review caught two independent modules both deriving id 5.
+
+Rule: every kernel module imports its id(s) from here; derived ids
+(``base + 1`` arithmetic) are forbidden outside this file.
+"""
+
+BARRIER_ALL = 0          # kernels/common_ops.py mesh barrier
+ALLGATHER = 1            # kernels/allgather.py default
+REDUCE_SCATTER = 2       # kernels/reduce_scatter.py default
+AG_GEMM = 3              # kernels/allgather_gemm.py (1-axis and torus)
+GEMM_RS = 4              # kernels/gemm_reduce_scatter.py fused kernel
+A2A = 5                  # kernels/all_to_all.py single-tier
+RING_ATTN = 6            # kernels/ring_attention.py
+SP_DECODE = 7            # kernels/flash_decode.py
+LL_AG = 8                # kernels/low_latency_allgather.py intra tier
+AG_GROUP_GEMM = 9        # kernels/allgather_group_gemm.py
+MOE_RS = 10              # kernels/moe_reduce_rs.py
+HIER_A2A_SLOW = 12       # kernels/hierarchical.py two-tier A2A stage 1
+HIER_A2A_FAST = 13       # kernels/hierarchical.py two-tier A2A stage 2
+HIER_STAGE1 = 14         # kernels/hierarchical.py AG slow / RS fast pass
+HIER_STAGE2 = 15         # kernels/hierarchical.py AG fast / RS slow pass
+TORUS_AG = 16            # kernels/torus.py fused 2D AG plane
+TORUS_AG_THIRD = 17      # kernels/torus.py 3-axis third-axis ring
+TORUS_RS = 18            # kernels/torus.py fused 2D RS plane
+TORUS_RS_THIRD = 19      # kernels/torus.py 3-axis third-axis ring
+GEMM_RS_SECOND = 20      # gemm_reduce_scatter.py 2-axis second ring
+LL_AG_INTER = 21         # low_latency_allgather.py inter tier

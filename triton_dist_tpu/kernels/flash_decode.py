@@ -57,7 +57,7 @@ from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
 
 NEG_INF = -1.0e30  # finite -inf proxy: survives exp/log without NaNs
 
-SP_DECODE_COLLECTIVE_ID = 7
+from triton_dist_tpu.kernels.collective_ids import SP_DECODE as SP_DECODE_COLLECTIVE_ID
 
 
 # ---------------------------------------------------------------------------

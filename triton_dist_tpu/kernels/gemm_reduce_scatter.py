@@ -58,7 +58,10 @@ from triton_dist_tpu.kernels.gemm import (
 from triton_dist_tpu.language.interpret import maybe_interpret
 from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
 
-GEMM_RS_COLLECTIVE_ID = 4
+from triton_dist_tpu.kernels.collective_ids import (
+    GEMM_RS as GEMM_RS_COLLECTIVE_ID,
+    GEMM_RS_SECOND,
+)
 
 
 @dataclass
@@ -195,9 +198,37 @@ def _gemm_rs_kernel(
 def gemm_rs_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
                   bk=None, interpret=False):
     """Per-device GEMM-RS; call inside shard_map.  Returns the reduced chunk.
-    Block sizes default to the swept MatmulConfig (gemm.py)."""
+    Block sizes default to the swept MatmulConfig (gemm.py).
+
+    ``axis`` may be a tuple (ax, ay) of mesh axes (K sharded over the joint
+    axes): the fused overlapped kernel then runs over ``ay`` — GEMM hidden
+    under the first, wy-fold heavier ring — and a second wire-only ring RS
+    over ``ax`` moves only 1/wy of the data (reductions shrink: same phase
+    order as ``hierarchical.hier_reduce_scatter_shard``).  Device (i, j)
+    ends with flat band ``j * wx + i``, so a host wrapper using out_specs
+    ``P((ay, ax))`` reassembles C in natural order (see :func:`gemm_rs`).
+    """
     _cfg = MatmulConfig()
     bm, bn, bk = bm or _cfg.block_m, bn or _cfg.block_n, bk or _cfg.block_k
+    if isinstance(axis, (tuple, list)) and len(axis) > 1:
+        from triton_dist_tpu.kernels.reduce_scatter import (
+            reduce_scatter_shard,
+        )
+
+        axes = tuple(axis)
+        if len(axes) != 2:
+            raise ValueError(f"gemm_rs supports 1 or 2 axes, got {axes}")
+        ax, ay = axes
+        sizes = (jax.lax.axis_size(ax), jax.lax.axis_size(ay))
+        if 1 in sizes:
+            axis = axes[sizes.index(max(sizes))]
+        else:
+            part = gemm_rs_shard(a_shard, b_shard, axis=ay, impl=impl,
+                                 bm=bm, bn=bn, bk=bk, interpret=interpret)
+            return reduce_scatter_shard(
+                part, ax, interpret=interpret,
+                collective_id=GEMM_RS_SECOND)
+    axis = axis[0] if isinstance(axis, (tuple, list)) else axis
     raw_impl = impl
     impl = resolve_impl(impl, interpret)
     world = jax.lax.axis_size(axis)
@@ -263,14 +294,22 @@ def gemm_rs_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
 
 def gemm_rs(a, b, ctx: GEMMReduceScatterContext):
     """C = reduce_scatter(A_loc @ B_loc, axis), overlapped.  Host entry
-    (reference: ``gemm_rs`` gemm_reduce_scatter.py:547)."""
+    (reference: ``gemm_rs`` gemm_reduce_scatter.py:547).  With a 2-tuple
+    ``ctx.axis`` the two-tier torus schedule runs; the shard bands come out
+    fast-major, so ``out_specs`` swaps the axes to reassemble C in natural
+    row order."""
     cfg = ctx.config
+    axis = ctx.axis
+    if isinstance(axis, (tuple, list)) and len(axis) > 1:
+        out_spec = P(tuple(reversed(tuple(axis))), None)
+    else:
+        out_spec = P(axis, None)
     fn = cached_shard_jit(
         gemm_rs_shard,
         ctx.mesh,
         (P(None, ctx.axis), P(ctx.axis, None)),
-        P(ctx.axis, None),
-        axis=ctx.axis, impl=ctx.impl,
+        out_spec,
+        axis=tuple(axis) if isinstance(axis, list) else axis, impl=ctx.impl,
         bm=cfg.block_m, bn=cfg.block_n, bk=cfg.block_k,
         interpret=ctx.interpret,
     )

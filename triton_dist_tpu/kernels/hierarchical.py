@@ -23,6 +23,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from triton_dist_tpu.kernels import collective_ids as cid
 from triton_dist_tpu.kernels.allgather import AllGatherMethod, all_gather_shard
 from triton_dist_tpu.kernels.reduce_scatter import (
     ReduceScatterMethod,
@@ -51,9 +52,9 @@ def hier_all_gather_shard(x, *, slow_axis: str, fast_axis: str,
     d = jax.lax.axis_size(slow_axis)
     t = jax.lax.axis_size(fast_axis)
     x = all_gather_shard(x, axis=slow_axis, method=slow_method,
-                         interpret=interpret, collective_id=14)
+                         interpret=interpret, collective_id=cid.HIER_STAGE1)
     x = all_gather_shard(x, axis=fast_axis, method=fast_method,
-                         interpret=interpret, collective_id=15)
+                         interpret=interpret, collective_id=cid.HIER_STAGE2)
     # blocks are [fast][slow]-major; restore flat (slow, fast) order
     x = x.reshape((t, d, rows) + x.shape[1:])
     x = jnp.moveaxis(x, 1, 0)
@@ -71,7 +72,7 @@ def hier_rs_band_index(slow_axis: str, fast_axis: str):
 
 def hier_all_to_all_shard(send, splits, *, slow_axis: str, fast_axis: str,
                           impl="auto", interpret: bool = False,
-                          collective_ids=(12, 13)):
+                          collective_ids=(cid.HIER_A2A_SLOW, cid.HIER_A2A_FAST)):
     """Two-tier token AllToAll: every token crosses the slow wire at most
     once, then fans out inside its destination slice.
 
@@ -132,7 +133,7 @@ def hier_reduce_scatter_shard(x, *, slow_axis: str, fast_axis: str,
     of D*T bands).  DCN carries 1/T of the data it would in a flat RS.
     """
     x = reduce_scatter_shard(x, fast_axis, method=fast_method,
-                             interpret=interpret, collective_id=14)
+                             interpret=interpret, collective_id=cid.HIER_STAGE1)
     x = reduce_scatter_shard(x, slow_axis, method=slow_method,
-                             interpret=interpret, collective_id=15)
+                             interpret=interpret, collective_id=cid.HIER_STAGE2)
     return x

@@ -64,7 +64,7 @@ def create_fast_ag_context(mesh, axis="tp", inter_axis=None, impl="auto",
 
 
 def fast_allgather_shard(x_shard, *, axis, inter_axis=None, impl="auto",
-                         interpret=False, collective_id=1):
+                         interpret=False, collective_id=None):
     """Latency-tuned gather of a small per-device shard (leading dim).
 
     1-level: one-shot full-mesh push.  2-level: minor (ICI) axis first, then
@@ -73,6 +73,10 @@ def fast_allgather_shard(x_shard, *, axis, inter_axis=None, impl="auto",
     policy: ops gathering small payloads (flash-decode partials etc.) call
     this rather than picking a method themselves.
     """
+    from triton_dist_tpu.kernels.collective_ids import LL_AG, LL_AG_INTER
+
+    if collective_id is None:
+        collective_id = LL_AG
     impl = resolve_impl(impl, interpret)
     method = (AllGatherMethod.XLA if impl == "xla"
               else AllGatherMethod.FULL_MESH_PUSH)
@@ -83,7 +87,7 @@ def fast_allgather_shard(x_shard, *, axis, inter_axis=None, impl="auto",
         # device set (the DCN/major tier).
         out = all_gather_shard(out, inter_axis, method=method,
                                interpret=interpret,
-                               collective_id=collective_id + 1)
+                               collective_id=LL_AG_INTER)
     return out
 
 

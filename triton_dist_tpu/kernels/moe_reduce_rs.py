@@ -60,7 +60,7 @@ from triton_dist_tpu.kernels.moe_utils import combine_topk
 from triton_dist_tpu.language.interpret import maybe_interpret
 from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
 
-MOE_RS_COLLECTIVE_ID = 10
+from triton_dist_tpu.kernels.collective_ids import MOE_RS as MOE_RS_COLLECTIVE_ID
 
 
 @dataclass

@@ -141,6 +141,92 @@ def estimate_reduce_scatter_time_ms(nbytes_full: int, world_size: int,
     return max(intra_ms, inter_ms) * (nnodes - 1) + intra_ms
 
 
+def estimate_torus_allgather_time_ms(nbytes_per_shard: int,
+                                     axis_sizes: tuple[int, ...],
+                                     bw_gbps: float | None = None) -> float:
+    """Fused multi-axis torus AG (``kernels/torus.py``).
+
+    The four-path 2D schedule keeps all four link directions of the plane
+    busy in both phases, so the plane's time is the per-link bytes of the
+    BUSIEST path divided by one link's bandwidth — ~2x faster than a
+    sequential per-axis composition and ~2x faster than one bidirectional
+    ring carrying the same total bytes on 2 of the 4 directions.
+
+    Derivation (per path, wx x wy plane, quarter bytes q = S/4 where S =
+    ``nbytes_per_shard``): phase 1 moves (w1-1) slot-quarters, phase 2
+    moves (w2-1) first-axis lines of w1 slot-quarters each → per-link
+    bytes = q*(w1-1) + q*w1*(w2-1) = q*(w1*w2 - 1).  Every path carries
+    the same total, so time = q*(W-1)/bw — W = wx*wy.  A 3-axis torus
+    rings the gathered plane on the third axis's two directions:
+    (S/2)*plane*(w3-1) per link, overlapping nothing (it dominates).
+    """
+    sizes = [s for s in axis_sizes if s > 1]
+    world = 1
+    for s in sizes:
+        world *= s
+    if world <= 1:
+        return 0.0
+    bw = bw_gbps if bw_gbps is not None else get_ici_axis_bandwidth_gbps()
+    # bw is the axis bandwidth (both directions); a single direction is
+    # bw/2, and the quarter/half splits are per-direction streams.
+    link = bw / 2.0
+    if len(sizes) == 1:
+        # bidirectional ring: halves on each direction.
+        return (nbytes_per_shard / 2) * (sizes[0] - 1) / 1e9 / link * 1e3
+    if len(sizes) == 2:
+        plane = sizes[0] * sizes[1]
+        return (nbytes_per_shard / 4) * (plane - 1) / 1e9 / link * 1e3
+    plane = sizes[-2] * sizes[-1]
+    t_plane = (nbytes_per_shard / 4) * (plane - 1) / 1e9 / link * 1e3
+    t_third = ((nbytes_per_shard * plane / 2) * (sizes[0] - 1)
+               / 1e9 / link * 1e3)
+    return t_plane + t_third
+
+
+def estimate_torus_reduce_scatter_time_ms(nbytes_full: int,
+                                          axis_sizes: tuple[int, ...],
+                                          bw_gbps: float | None = None
+                                          ) -> float:
+    """Fused 2D torus RS (``kernels/torus.py``): two concurrent half-paths
+    (x→y and y→x, one direction each).  Per path (half bytes h = F/2 with
+    F = ``nbytes_full``): phase 1 rings (w1-1) line groups of h/w1 bytes,
+    phase 2 (w2-1) slots of h/(w1*w2) → per-link time h*(w1-1)/w1 +
+    h*(w2-1)/(w1*w2); both paths concurrent → wall time = max over paths
+    (equal on square tori).  ~2x the implemented unidirectional 1-axis
+    ring; parity with a (not yet implemented) 1-axis bidirectional RS —
+    the four-quarter bidirectional extension doubles it again.
+    """
+    sizes = [s for s in axis_sizes if s > 1]
+    world = 1
+    for s in sizes:
+        world *= s
+    if world <= 1:
+        return 0.0
+    bw = bw_gbps if bw_gbps is not None else get_ici_axis_bandwidth_gbps()
+    link = bw / 2.0
+    if len(sizes) == 1:
+        # The implemented 1-axis ring RS is unidirectional (RING_1D):
+        # one link direction carries all the bytes.
+        return (nbytes_full * (sizes[0] - 1) / sizes[0]) / 1e9 / link * 1e3
+    if len(sizes) == 3:
+        # Third axis reduces first (shrinks data), then the fused plane.
+        # The implemented third-axis pass is the unidirectional RING_1D —
+        # one link direction, same as the 1-axis branch above.
+        w3 = sizes[0]
+        t3 = (nbytes_full * (w3 - 1) / w3) / 1e9 / link * 1e3
+        return t3 + estimate_torus_reduce_scatter_time_ms(
+            nbytes_full // w3, tuple(sizes[1:]), bw_gbps)
+    w1, w2 = sizes
+    half = nbytes_full / 2
+
+    def path_ms(a, b):
+        p1 = half / a * (a - 1) / 1e9 / link * 1e3
+        p2 = half / (a * b) * (b - 1) / 1e9 / link * 1e3
+        return p1 + p2
+
+    return max(path_ms(w1, w2), path_ms(w2, w1))
+
+
 def estimate_all_to_all_time_ms(nbytes_per_chip: int, world_size: int,
                                 bw_gbps: float | None = None) -> float:
     """All-to-all: each chip sends (world-1)/world of its payload."""

@@ -134,7 +134,21 @@ def reduce_scatter_shard(x_shard, axis: str, method=ReduceScatterMethod.AUTO,
     """Per-shard RS: input (world*rows, ...) → output (rows, ...) summed.
 
     Matches ``lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)``.
+    ``axis`` may be a tuple of 2-3 mesh axes — a multi-axis RS routes to
+    the fused torus schedule (``kernels/torus.py``).
     """
+    if isinstance(axis, (tuple, list)) and len(axis) > 1:
+        from triton_dist_tpu.kernels.torus import torus_reduce_scatter_shard
+
+        if method is ReduceScatterMethod.AUTO:
+            method = resolve_method(interpret)
+        if method is ReduceScatterMethod.XLA:
+            return jax.lax.psum_scatter(x_shard, tuple(axis),
+                                        scatter_dimension=0, tiled=True)
+        return torus_reduce_scatter_shard(x_shard, tuple(axis),
+                                          interpret=interpret,
+                                          collective_id=collective_id)
+    axis = axis[0] if isinstance(axis, (tuple, list)) else axis
     world = jax.lax.axis_size(axis)
     if method is ReduceScatterMethod.AUTO:
         method = resolve_method(interpret)

@@ -47,7 +47,7 @@ from triton_dist_tpu.kernels.gemm import resolve_impl
 from triton_dist_tpu.language.interpret import maybe_interpret
 from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
 
-RING_ATTN_COLLECTIVE_ID = 6
+from triton_dist_tpu.kernels.collective_ids import RING_ATTN as RING_ATTN_COLLECTIVE_ID
 _NEG = -1e30
 
 
