@@ -1,0 +1,110 @@
+"""Single-chip GQA decode step benchmark: pallas split-KV vs XLA fused.
+
+Protocol (docs/perf.md): dependent-iteration chains inside ONE jit (the
+decode output feeds the next step's query, so XLA cannot hoist work),
+timed as (t_long - t_short) / extra to cancel the tunnel RTT; trials of
+ALL configs are interleaved round-robin so slow drift (thermal / tunnel
+host contention, observed at +-15% across minutes) hits every config
+equally; pooled median over >= 9 trials.  Completion barrier is a
+float() materialization — block_until_ready returns early on the tunnel
+backend.
+
+Usage: python scripts/bench_decode.py [--batch 8 32]
+       [--block-s 1024 2048 4096] [--trials 9]
+"""
+
+import argparse
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.kernels.flash_decode import gqa_decode_shard
+
+HQ, HKV, D, S = 32, 8, 128, 8192
+
+
+def make_chain(n_iters, impl, block_s):
+    @jax.jit
+    def chain(q, k, v, lens):
+        def body(_, qq):
+            out, _lse = gqa_decode_shard(qq, k, v, lens, block_s=block_s,
+                                         impl=impl)
+            return out.astype(qq.dtype)
+
+        return jnp.sum(jax.lax.fori_loop(0, n_iters, body, q)
+                       .astype(jnp.float32))
+
+    return chain
+
+
+def bench_batch(B, configs, n_short=32, n_long=288, trials=9):
+    """configs: list of (label, impl, block_s).  Returns {label: µs/step}."""
+    ks = jax.random.split(jax.random.key(0), 3)
+    k = jax.random.normal(ks[1], (B, HKV, S, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, HKV, S, D), jnp.bfloat16)
+    lens = jnp.full((B,), S, jnp.int32)
+    q0 = jax.random.normal(ks[0], (B, HQ, D), jnp.bfloat16)
+
+    chains = {}
+    for label, impl, bs in configs:
+        short = make_chain(n_short, impl, bs)
+        long = make_chain(n_long, impl, bs)
+        float(short(q0, k, v, lens))  # warmup/compile
+        float(long(q0, k, v, lens))
+        chains[label] = (short, long)
+
+    labels = [label for label, _, _ in configs]
+    diffs = {label: [] for label in labels}
+    for t in range(trials):
+        # Fresh q per trial: the tunnel elides repeat calls with
+        # identical args.  Config order rotates per trial so any
+        # position-in-trial effect averages out.
+        q = jax.random.normal(jax.random.fold_in(ks[0], t),
+                              (B, HQ, D), jnp.bfloat16)
+        jax.block_until_ready(q)
+        for label in labels[t % len(labels):] + labels[:t % len(labels)]:
+            short, long = chains[label]
+            t0 = time.perf_counter()
+            float(short(q, k, v, lens))
+            t1 = time.perf_counter()
+            float(long(q, k, v, lens))
+            t2 = time.perf_counter()
+            diffs[label].append(
+                ((t2 - t1) - (t1 - t0)) / (n_long - n_short))
+    out = {}
+    for label, d in diffs.items():
+        d = sorted(x * 1e6 for x in d)
+        med = statistics.median(d)
+        iqr = d[(3 * len(d)) // 4] - d[len(d) // 4]
+        out[label] = (med, iqr)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, nargs="+", default=[8, 32])
+    ap.add_argument("--block-s", type=int, nargs="+",
+                    default=[1024, 2048, 4096])
+    ap.add_argument("--trials", type=int, default=9)
+    args = ap.parse_args()
+
+    for B in args.batch:
+        floor = 2 * B * HKV * S * D * 2 / 819e9 * 1e6
+        configs = [("xla fused", "xla", 1024)]
+        configs += [(f"pallas block_s={bs}", "pallas", bs)
+                    for bs in args.block_s]
+        res = bench_batch(B, configs, trials=args.trials)
+        print(f"\nB={B} Hq={HQ} Hkv={HKV} S={S} bf16 "
+              f"(HBM floor ~{floor:.0f} µs):")
+        for label, (t, iqr) in res.items():
+            print(f"  {label:<22}: {t:8.1f} µs/step  (IQR {iqr:.0f})")
+
+
+if __name__ == "__main__":
+    main()

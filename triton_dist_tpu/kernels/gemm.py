@@ -74,23 +74,14 @@ def pallas_shapes_ok(m_loc: int, n_loc: int, k: int) -> bool:
     return m_loc % 8 == 0 and n_loc % 128 == 0 and k % 128 == 0
 
 
-def resolve_impl(impl: str, interpret: bool,
-                 prefer_xla_on_hw: bool = False) -> str:
+def resolve_impl(impl: str, interpret: bool) -> str:
     """Shared auto-dispatch: pallas on TPU hardware or under the interpreter,
-    XLA collectives elsewhere (reference analog: the per-op dispatchers).
-
-    ``prefer_xla_on_hw``: for bandwidth-bound ops where XLA's fusion beats
-    the hand-written kernel on hardware (measured: GQA decode, see
-    docs/perf.md), ``auto`` picks XLA on hardware while the interpreter
-    still exercises the pallas path.
-    """
+    XLA collectives elsewhere (reference analog: the per-op dispatchers)."""
     from triton_dist_tpu.runtime import topology
 
     if impl == "auto":
         if interpret:
             return "pallas"
-        if prefer_xla_on_hw:
-            return "xla"
         return "pallas" if topology.is_tpu() else "xla"
     return impl
 
