@@ -61,7 +61,8 @@ def _make_chain(mesh, n_iters):
         out_specs=P(), check_vma=False))
 
 
-def _paired_diff_time(fn_short, fn_long, *args, n_extra, trials=14):
+def _paired_diff_time(fn_short, fn_long, *args, n_extra, trials=14,
+                      fresh_args=None):
     """Median of per-trial (long - short) / n_extra chain times.
 
     Pairing short/long inside each trial cancels tunnel-RTT drift that
@@ -69,17 +70,86 @@ def _paired_diff_time(fn_short, fn_long, *args, n_extra, trials=14):
     the axon tunnel with unpaired timing); the median over a generous
     trial count rejects congestion outliers in either direction (a
     min/best-of estimator is biased optimistic here — congested t_short
-    inflates the diff's complement and min() happily reports >peak)."""
+    inflates the diff's complement and min() happily reports >peak).
+
+    ``fresh_args``: callable(t) -> args tuple, generating NEW inputs per
+    trial.  Required for honest numbers: the tunnel backend elides
+    repeated calls with identical args (observed >100%-of-peak readings
+    when the long chain got elided), so fixed ``*args`` are only safe for
+    warmup."""
     diffs = []
-    for _ in range(trials):
+    for t in range(trials):
+        a = args if fresh_args is None else fresh_args(t)
+        if fresh_args is not None:
+            jax.block_until_ready(a)
         t0 = time.perf_counter()
-        float(fn_short(*args))  # device_get round-trip forces completion
+        float(fn_short(*a))  # device_get round-trip forces completion
         t_short = time.perf_counter() - t0
         t0 = time.perf_counter()
-        float(fn_long(*args))
+        float(fn_long(*a))
         t_long = time.perf_counter() - t0
         diffs.append((t_long - t_short) / n_extra)
     return max(float(np.median(diffs)), 1e-9)
+
+
+def _bench_moe_a2a_us(trials=9, n_extra=4096):
+    """MoE AllToAll single-chip floor at the BASELINE serving point
+    (128 tok/rank, hidden 7168, fp8 packed 4-wide into int32 lanes — the
+    recommended fp8 wire layout, scripts/bench_a2a.py).  The reference's
+    137 µs headline is a 32-chip wire number; one chip exposes only the
+    kernel's dispatch + local-segment floor."""
+    from triton_dist_tpu.kernels.all_to_all import fast_all_to_all_shard
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("ep",))
+    send = jnp.zeros((1, 128, 7168 // 4), jnp.int32)
+    splits = jnp.full((1,), 128, jnp.int32)
+
+    def make(n):
+        def body_fn(send, splits):
+            def body(i, x):
+                recv, _ = fast_all_to_all_shard(x, splits, axis="ep",
+                                                impl="pallas",
+                                                interpret=False)
+                return recv
+            return jax.lax.fori_loop(0, n, body, send)[0, 0, 0]
+        return jax.jit(jax.shard_map(
+            body_fn, mesh=mesh, in_specs=(P("ep"), P("ep")), out_specs=P(),
+            check_vma=False))
+
+    c1, cn = make(1), make(1 + n_extra)
+    float(c1(send, splits))
+    float(cn(send, splits))
+    # Fresh payload per trial: the tunnel elides repeated identical calls
+    # (observed medians collapsing to 0 with a fixed payload).
+    diffs = []
+    for t in range(trials):
+        s_t = jax.random.randint(jax.random.key(t), send.shape, 0, 1 << 20,
+                                 jnp.int32)
+        jax.block_until_ready(s_t)
+        t0 = time.perf_counter()
+        float(c1(s_t, splits))
+        t_short = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(cn(s_t, splits))
+        t_long = time.perf_counter() - t0
+        diffs.append((t_long - t_short) / n_extra)
+    return max(float(np.median(diffs)), 0.0) * 1e6
+
+
+def _bench_decode_us(trials=9):
+    """GQA decode step time at the serving shape (B=8, Hq=32, Hkv=8,
+    S=8192 bf16; pallas split-KV under auto).  Delegates to the decode
+    bench's protocol — it additionally feeds a FRESH query per trial,
+    without which the tunnel elides repeated chain calls and the long
+    chain under-measures."""
+    import os as _os
+    import sys as _sys
+
+    _sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+    from scripts.bench_decode import bench_batch
+
+    res = bench_batch(8, [("auto", "auto", 2048)], trials=trials)
+    return res["auto"][0]
 
 
 def main():
@@ -92,9 +162,17 @@ def main():
     float(chain1(a, b1, b2))  # warm both executables
     float(chain9(a, b1, b2))
 
-    per_pair_s = _paired_diff_time(chain1, chain9, a, b1, b2, n_extra=8)
+    def fresh(t):
+        return (jax.random.normal(jax.random.key(t), (M, K), jnp.bfloat16),
+                b1, b2)
+
+    per_pair_s = _paired_diff_time(chain1, chain9, a, b1, b2, n_extra=8,
+                                   fresh_args=fresh)
     flops_per_pair = 2 * M * N_PER_CHIP * K * 2  # ag_gemm + return matmul
     tflops = flops_per_pair / per_pair_s / 1e12
+
+    moe_a2a_us = _bench_moe_a2a_us()
+    decode_us = _bench_decode_us()
 
     peak = peak_bf16_tflops()
     vs = (tflops / peak) / REF_UTILIZATION if peak else 0.0
@@ -103,9 +181,15 @@ def main():
         "value": round(tflops, 1),
         "unit": "TFLOPS",
         "vs_baseline": round(vs, 3),
+        # BASELINE.json co-headline: MoE AllToAll p50 (single-chip floor at
+        # 128 tok/rank, hidden 7168, fp8x4-packed) + the decode step time
+        # (B=8 Hq=32 Hkv=8 S=8192 bf16, pallas under auto).
+        "moe_a2a_floor_us": round(moe_a2a_us, 2),
+        "decode_step_us": round(decode_us, 1),
     }))
     print(f"# chip peak {peak} TFLOPS, utilization "
-          f"{tflops / peak:.1%}, shape M={M} K={K} N/chip={N_PER_CHIP}",
+          f"{tflops / peak:.1%}, shape M={M} K={K} N/chip={N_PER_CHIP}; "
+          f"moe_a2a floor {moe_a2a_us:.2f} us; decode {decode_us:.1f} us",
           file=sys.stderr)
 
 

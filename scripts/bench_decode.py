@@ -44,7 +44,8 @@ def make_chain(n_iters, impl, block_s):
 
 
 def bench_batch(B, configs, n_short=32, n_long=288, trials=9):
-    """configs: list of (label, impl, block_s).  Returns {label: µs/step}."""
+    """configs: list of (label, impl, block_s).
+    Returns {label: (median µs/step, IQR µs)}."""
     ks = jax.random.split(jax.random.key(0), 3)
     k = jax.random.normal(ks[1], (B, HKV, S, D), jnp.bfloat16)
     v = jax.random.normal(ks[2], (B, HKV, S, D), jnp.bfloat16)

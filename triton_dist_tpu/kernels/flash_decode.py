@@ -19,10 +19,12 @@ TPU-native design (NOT a port):
   (decode is HBM-bandwidth-bound; the MXU is never the bottleneck).
 * **Inter-rank combine is comm-fused** (``sp_combine_shard``): each rank
   remote-DMAs its packed (out ⊕ lse) partial plane into every peer's VMEM
-  and the LSE merge runs on the VPU in the SAME Pallas kernel — the
-  reference's LL-gather + combine kernel pair in one launch.  The XLA-only
-  mode (``impl="xla"``, e.g. int8-KV) keeps the latency gather + fused XLA
-  epilogue instead.
+  (the ``dl.fcollect`` verb) and the LSE merge runs on the VPU in the SAME
+  Pallas kernel — the reference's LL-gather + combine kernel pair in one
+  launch.  Explicit ``impl="xla"`` (or a head_dim not lane-divisible)
+  keeps the latency gather + fused XLA epilogue instead; note int8-KV
+  under ``auto`` runs an XLA *local* decode but still the fused combine
+  (the partials are f32 either way).
 * The (out ⊕ lse) payload packing of the reference's decode layer
   (sp_flash_decode_layer.py:135-137) is kept in both paths: one plane/
   gather moves both.
@@ -231,11 +233,10 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=2048, impl="auto",
     ``impl`` note: decode is HBM-bandwidth-bound (stream the KV cache
     once).  Since round 2's kernel tuning (K/V fed to the MXU in their
     storage dtype, P cast down for the PV matmul, parallel (b, h)
-    dimension semantics) the Pallas split-KV kernel beats XLA's fused
-    attention at the serving shapes (B=8: 351 vs 369 µs; B=32: 1414 vs
-    1448 µs; Hq=32 Hkv=8 S=8192 bf16, block_s=2048, rotated-order paired
-    chains — scripts/bench_decode.py, docs/perf.md), so ``auto`` selects
-    the Pallas kernel whenever the shapes allow it.  int8-KV caches still
+    dimension semantics) the Pallas split-KV kernel matches-or-beats XLA's
+    fused attention at the serving shapes (measured table: docs/perf.md,
+    protocol: scripts/bench_decode.py), so ``auto`` selects the Pallas
+    kernel whenever the shapes allow it.  int8-KV caches still
     take the XLA program (the dequant fuses into the attention stream).
     """
     B, Hq, D = q.shape
@@ -327,31 +328,22 @@ def _sp_combine_kernel(plane_in, final_ref, gath, send_sem, recv_sem,
     [BH, d] ⊕ [BH, 128] split costs one extra 128-lane block but halves
     the descriptor count vs two planes).
     """
-    me = jax.lax.axis_index(axis)
-
-    # Stage my own slot (local DMA) and push my plane to every peer; the
-    # pushes read the INPUT ref, so they don't wait on the staging copy.
-    cp = pltpu.make_async_copy(plane_in, gath.at[me], copy_sem)
-    cp.start()
-
     dl.barrier_all(axis)  # nobody lands data in a peer still outside
 
-    for i in range(1, world):
-        peer = jax.lax.rem(me + i, world)
-        dl.remote_copy(plane_in, gath.at[me], send_sem, recv_sem, axis,
-                       peer).start()
-    cp.wait()
-    for _ in range(1, world):  # drain sends
-        pltpu.make_async_copy(plane_in, plane_in, send_sem).wait()
-    for _ in range(1, world):  # arrivals
-        pltpu.make_async_copy(plane_in, plane_in, recv_sem).wait()
+    # The gather round IS the fcollect verb: stage my slot (overlapped
+    # with the peer fan-out, which reads the input ref), push to every
+    # peer, drain, wait arrivals.
+    dl.fcollect(plane_in, gath, send_sem, recv_sem, axis,
+                copy_sem=copy_sem)
 
     # LSE-weighted merge on the VPU (combine_partials' math, in-kernel).
-    lses = gath[:, :, d:]                               # [W, BH, 128]
+    bh = plane_in.shape[0]
+    planes = gath[:].reshape(world, bh, d + 128)
+    lses = planes[:, :, d:]                             # [W, BH, 128]
     m = jnp.max(lses, axis=0)                           # [BH, 128]
     w = jnp.exp(lses - m[None])                         # [W, BH, 128]
     denom = jnp.sum(w, axis=0)                          # [BH, 128]
-    out = jnp.sum(gath[:, :, :d] * w[:, :, :1], axis=0)  # [BH, D]
+    out = jnp.sum(planes[:, :, :d] * w[:, :, :1], axis=0)  # [BH, D]
     final_ref[:] = out / denom[:, :1]
 
 
@@ -373,7 +365,8 @@ def sp_combine_shard(out, lse, *, axis, interpret=False,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((world, BH, D + 128), jnp.float32),
+            # flat [world*BH, D+128]: dl.fcollect's slot layout
+            pltpu.VMEM((world * BH, D + 128), jnp.float32),
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
@@ -429,9 +422,10 @@ def sp_gqa_decode_shard(q, k_shard, v_shard, kv_lens, *, axis, block_s=2048,
     if world == 1:
         return out.astype(q.dtype)
 
-    if resolve_impl(impl, interpret) == "xla":
-        # XLA-only mode: latency gather + fused XLA epilogue (the packed
-        # (out ⊕ lse) payload keeps it one collective).
+    if resolve_impl(impl, interpret) == "xla" or D % 128:
+        # XLA-only mode (or a head_dim the Mosaic combine can't lane-slice):
+        # latency gather + fused XLA epilogue (the packed (out ⊕ lse)
+        # payload keeps it one collective).
         packed = pack_payload(out, lse)                         # [B, H, D+1]
         gathered = fast_allgather_shard(
             packed, axis=axis, impl=impl, interpret=interpret,
