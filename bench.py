@@ -40,6 +40,12 @@ from triton_dist_tpu.kernels.gemm import matmul
 from triton_dist_tpu.runtime.topology import peak_bf16_tflops
 
 M, K, N_PER_CHIP = 8192, 8192, 28672 // 8
+# Per-process time-based seed (see scripts/benchlib.py for the rationale:
+# the tunnel's content-based result cache persists across processes).
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+from scripts.benchlib import RUN_SEED  # noqa: E402
 REF_UTILIZATION = 0.65  # reference AG-GEMM ~= hand-tuned library on H800
 
 
@@ -52,7 +58,13 @@ def _make_chain(mesh, n_iters):
     def body_fn(a, b1, b2):
         def body(i, x):
             _, c = shard_ag(x, b1)     # [M, N_loc]
-            return matmul(c, b2)       # [M, K]
+            nxt = matmul(c, b2)        # [M, K]
+            # Full-reduction dependence: every element of the next input
+            # depends on ALL of this iteration's output, so consecutive
+            # iterations cannot pipeline into each other (row-tile
+            # head-starts were producing >100%-of-peak readings).
+            dep = (jnp.max(nxt) > jnp.bfloat16(1e30)).astype(nxt.dtype)
+            return nxt + dep
         return jax.lax.fori_loop(0, n_iters, body, a)[0, 0]
 
     return jax.jit(jax.shard_map(
@@ -92,12 +104,14 @@ def _paired_diff_time(fn_short, fn_long, *args, n_extra, trials=14,
     return max(float(np.median(diffs)), 1e-9)
 
 
-def _bench_moe_a2a_us(trials=9, n_extra=4096):
+def _bench_moe_a2a_us(n_extra=16384):
     """MoE AllToAll single-chip floor at the BASELINE serving point
     (128 tok/rank, hidden 7168, fp8 packed 4-wide into int32 lanes — the
     recommended fp8 wire layout, scripts/bench_a2a.py).  The reference's
     137 µs headline is a 32-chip wire number; one chip exposes only the
-    kernel's dispatch + local-segment floor."""
+    kernel's dispatch + local-segment floor.  16k-iteration chains: at a
+    ~1 µs floor, 4k iterations sit inside the tunnel's ~30 ms RTT jitter.
+    """
     from triton_dist_tpu.kernels.all_to_all import fast_all_to_all_shard
 
     mesh = Mesh(np.array(jax.devices()[:1]), ("ep",))
@@ -119,21 +133,13 @@ def _bench_moe_a2a_us(trials=9, n_extra=4096):
     c1, cn = make(1), make(1 + n_extra)
     float(c1(send, splits))
     float(cn(send, splits))
-    # Fresh payload per trial: the tunnel elides repeated identical calls
-    # (observed medians collapsing to 0 with a fixed payload).
-    diffs = []
-    for t in range(trials):
-        s_t = jax.random.randint(jax.random.key(t), send.shape, 0, 1 << 20,
-                                 jnp.int32)
-        jax.block_until_ready(s_t)
-        t0 = time.perf_counter()
-        float(c1(s_t, splits))
-        t_short = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        float(cn(s_t, splits))
-        t_long = time.perf_counter() - t0
-        diffs.append((t_long - t_short) / n_extra)
-    return max(float(np.median(diffs)), 0.0) * 1e6
+
+    def fresh(t):
+        return (jax.random.randint(jax.random.key(RUN_SEED + t), send.shape,
+                                   0, 1 << 20, jnp.int32), splits)
+
+    return _paired_diff_time(c1, cn, send, splits, n_extra=n_extra,
+                             trials=9, fresh_args=fresh) * 1e6
 
 
 def _bench_decode_us(trials=9):
@@ -154,17 +160,22 @@ def _bench_decode_us(trials=9):
 
 def main():
     mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
-    a = jnp.zeros((M, K), jnp.bfloat16)
-    b1 = jnp.zeros((K, N_PER_CHIP), jnp.bfloat16)
-    b2 = jnp.zeros((N_PER_CHIP, K), jnp.bfloat16)
+    # NONZERO weights: with zero weights every iteration's values are
+    # identically zero and the tunnel elides the chain (the "values must
+    # actually change" rule — see _paired_diff_time).  Small scale keeps
+    # 9 chained matmuls inside bf16 range.
+    kw = jax.random.split(jax.random.key(RUN_SEED), 3)
+    a = jax.random.normal(kw[0], (M, K), jnp.bfloat16)
+    b1 = jax.random.normal(kw[1], (K, N_PER_CHIP), jnp.bfloat16) * 0.02
+    b2 = jax.random.normal(kw[2], (N_PER_CHIP, K), jnp.bfloat16) * 0.02
 
     chain1, chain9 = _make_chain(mesh, 1), _make_chain(mesh, 9)
     float(chain1(a, b1, b2))  # warm both executables
     float(chain9(a, b1, b2))
 
     def fresh(t):
-        return (jax.random.normal(jax.random.key(t), (M, K), jnp.bfloat16),
-                b1, b2)
+        return (jax.random.normal(jax.random.key(RUN_SEED + t), (M, K),
+                                  jnp.bfloat16), b1, b2)
 
     per_pair_s = _paired_diff_time(chain1, chain9, a, b1, b2, n_extra=8,
                                    fresh_args=fresh)

@@ -25,6 +25,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from triton_dist_tpu.kernels.all_to_all import fast_all_to_all_shard  # noqa: E402
 
+from scripts.benchlib import RUN_SEED  # noqa: E402
+
 TOKENS, HIDDEN = 128, 7168
 N_EXTRA = 16384  # 4096-iter chains sit inside tunnel RTT jitter (~30 ms)
 
@@ -72,7 +74,7 @@ def main():
         c1, cn = make_chain(mesh, 1), make_chain(mesh, 1 + N_EXTRA)
 
         def fresh(t, dtype=dtype, hidden=hidden, splits=splits):
-            x = jax.random.normal(jax.random.key(t), (1, TOKENS, hidden),
+            x = jax.random.normal(jax.random.key(RUN_SEED + t), (1, TOKENS, hidden),
                                   jnp.float32)
             if dtype == jnp.int32:
                 return jax.lax.bitcast_convert_type(x, jnp.int32), splits
@@ -112,8 +114,8 @@ def _bench_decode_gather(mesh):
                                out_specs=P(), check_vma=False))
 
     def fresh(t):
-        return (jax.random.normal(jax.random.key(t), (B, Hq, D1),
-                                  jnp.float32),)
+        return (jax.random.normal(jax.random.key(RUN_SEED + t),
+                                  (B, Hq, D1), jnp.float32),)
 
     us = _timed_us(c1, cn, send, n_extra=N_EXTRA - 1, fresh_args=fresh)
     print(f"ll-ag decode partials [8, 32, 129] f32: {us:7.1f} us/iter "

@@ -24,6 +24,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 
+from scripts.benchlib import RUN_SEED, rotated_paired_bench
 from triton_dist_tpu.kernels.flash_decode import gqa_decode_shard
 
 HQ, HKV, D, S = 32, 8, 128, 8192
@@ -58,33 +59,16 @@ def bench_batch(B, configs, n_short=32, n_long=288, trials=9):
         long = make_chain(n_long, impl, bs)
         float(short(q0, k, v, lens))  # warmup/compile
         float(long(q0, k, v, lens))
-        chains[label] = (short, long)
+        chains[label] = (short, long, (k, v, lens))
 
-    labels = [label for label, _, _ in configs]
-    diffs = {label: [] for label in labels}
-    for t in range(trials):
-        # Fresh q per trial: the tunnel elides repeat calls with
-        # identical args.  Config order rotates per trial so any
-        # position-in-trial effect averages out.
-        q = jax.random.normal(jax.random.fold_in(ks[0], t),
-                              (B, HQ, D), jnp.bfloat16)
-        jax.block_until_ready(q)
-        for label in labels[t % len(labels):] + labels[:t % len(labels)]:
-            short, long = chains[label]
-            t0 = time.perf_counter()
-            float(short(q, k, v, lens))
-            t1 = time.perf_counter()
-            float(long(q, k, v, lens))
-            t2 = time.perf_counter()
-            diffs[label].append(
-                ((t2 - t1) - (t1 - t0)) / (n_long - n_short))
-    out = {}
-    for label, d in diffs.items():
-        d = sorted(x * 1e6 for x in d)
-        med = statistics.median(d)
-        iqr = d[(3 * len(d)) // 4] - d[len(d) // 4]
-        out[label] = (med, iqr)
-    return out
+    def fresh_q(t):
+        return jax.random.normal(jax.random.key(RUN_SEED + t),
+                                 (B, HQ, D), jnp.bfloat16)
+
+    res = rotated_paired_bench(chains, fresh_q, n_long - n_short,
+                               trials=trials)
+    return {label: (med * 1e6, iqr * 1e6) for label, (med, iqr) in
+            res.items()}
 
 
 def main():

@@ -1,0 +1,52 @@
+"""Shared benchmark protocol pieces for the tunnel-attached chip.
+
+One home for the rules every bench script must follow (learned the hard
+way — see docs/perf.md "Grouped GEMM MFU" for the postmortem):
+
+- RUN_SEED: per-process time-based seed for trial inputs.  The tunnel's
+  result cache is content-based and persists ACROSS processes; fixed PRNG
+  keys let re-runs hit cached (executable, args) pairs and report elided
+  (impossible) times.
+- rotated_paired_bench: per-trial fresh inputs, config order rotated per
+  trial (position-in-trial effects average out), paired long/short chain
+  diffs (cancels tunnel RTT), pooled median with a positive floor
+  (congested trials can go negative), IQR reported for stability.
+- Chains must have VALUE dependence between iterations (feed real outputs
+  forward).  Zero-add "dependence" tricks and all-zero weights produce
+  >100%-of-peak readings: values that don't change get elided.
+- Completion barrier is a float()/device-get materialization;
+  block_until_ready returns early on this backend.
+"""
+
+import statistics
+import time
+
+import jax
+
+RUN_SEED = time.time_ns() % (1 << 31)
+
+
+def rotated_paired_bench(chains, fresh_input, n_extra, trials=9):
+    """chains: {label: (short_fn, long_fn, extra_args tuple)} — called as
+    fn(x, *extra_args) where x = fresh_input(trial).  Returns
+    {label: (median seconds/step, iqr seconds/step)}."""
+    labels = list(chains)
+    diffs = {label: [] for label in labels}
+    for t in range(trials):
+        x = fresh_input(t)
+        jax.block_until_ready(x)
+        for label in labels[t % len(labels):] + labels[:t % len(labels)]:
+            short, long, extra = chains[label]
+            t0 = time.perf_counter()
+            float(short(x, *extra))
+            t1 = time.perf_counter()
+            float(long(x, *extra))
+            t2 = time.perf_counter()
+            diffs[label].append(((t2 - t1) - (t1 - t0)) / n_extra)
+    out = {}
+    for label, d in diffs.items():
+        d = sorted(d)
+        med = max(statistics.median(d), 1e-12)
+        iqr = d[(3 * len(d)) // 4] - d[len(d) // 4]
+        out[label] = (med, iqr)
+    return out
