@@ -1,0 +1,93 @@
+"""Kernel IR dumping — the reference's ``dump_ir`` observability hook.
+
+Reference analog: ops take ``dump_ir`` and write ptx/ttir/ttgir/llir per
+kernel (``moe_reduce_rs.py:1009-1015``), plus the ``MLIR_ENABLE_DUMP`` env
+path (``test_ag_gemm.py:108-113``).  The TPU stack's compilation artifacts
+are StableHLO (what jax.export ships) and the optimized HLO after XLA's
+passes (where fusion/layout decisions — the usual "why is this slow /
+why does this not compile" evidence — are visible; Mosaic kernels appear
+as ``tpu_custom_call`` ops carrying their serialized module).
+
+Two entry points:
+
+- ``TDT_DUMP_IR=<dir>`` in the environment: every program built through
+  ``cached_shard_jit`` (all host-level ops) writes
+  ``<dir>/<name>.stablehlo.txt`` and ``<name>.hlo.txt`` on first call.
+- ``dump_lowered(fn, *args, name=...)``: explicit one-shot dump of any
+  jittable callable with example args.
+
+For the full per-pass XLA pipeline (including Mosaic custom-call
+payloads), additionally set ``XLA_FLAGS=--xla_dump_to=<dir>`` before the
+first compile — that knob is the platform's own and subsumes the
+reference's ``MLIR_ENABLE_DUMP``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+ENV_VAR = "TDT_DUMP_IR"
+
+
+def dump_dir() -> str | None:
+    """The active dump directory, or None when dumping is off."""
+    return os.environ.get(ENV_VAR) or None
+
+
+def _safe(name: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.-]+", "_", name)[:120]
+
+
+def _write(path: str, text: str) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(text)
+
+
+def dump_lowered(fn, *args, name: str, directory: str | None = None,
+                 compiled: bool = True) -> list[str]:
+    """Write ``fn``'s StableHLO (and optimized HLO) for ``args``.
+
+    ``fn`` may be a jitted or plain callable (wrapped if needed).  Returns
+    the list of files written.  Never raises on compile failure of the
+    optimized text — the StableHLO alone is then written (it is exactly
+    what a "fails to compile" bug report needs).
+    """
+    import jax
+
+    directory = directory or dump_dir() or "."
+    base = os.path.join(directory, _safe(name))
+    if not hasattr(fn, "lower"):
+        fn = jax.jit(fn)
+    lowered = fn.lower(*args)
+    out = [base + ".stablehlo.txt"]
+    _write(out[0], lowered.as_text())
+    if compiled:
+        try:
+            _write(base + ".hlo.txt", lowered.compile().as_text())
+            out.append(base + ".hlo.txt")
+        except Exception as e:  # compile failure IS the interesting case
+            _write(base + ".compile_error.txt", repr(e))
+            out.append(base + ".compile_error.txt")
+    return out
+
+
+def wrap_for_dump(jitted, name: str):
+    """Wrap a jitted callable so its first invocation also dumps IR (the
+    ``cached_shard_jit`` hook; no-op wrapper when dumping is off)."""
+    if dump_dir() is None:
+        return jitted
+
+    state = {"done": False}
+
+    def wrapper(*args, **kwargs):
+        if not state["done"]:
+            state["done"] = True
+            try:
+                dump_lowered(jitted, *args, name=name)
+            except Exception:
+                pass  # observability must never break the op itself
+        return jitted(*args, **kwargs)
+
+    return wrapper

@@ -17,12 +17,24 @@ import jax
 
 @functools.lru_cache(maxsize=256)
 def _build(builder: Callable, mesh, in_specs, out_specs, opts: tuple, _noise_key):
+    from triton_dist_tpu.runtime import dump
+
     fn = functools.partial(builder, **dict(opts))
-    return jax.jit(
+    jitted = jax.jit(
         jax.shard_map(
             fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
         )
     )
+    # TDT_DUMP_IR=<dir>: write this program's StableHLO + optimized HLO on
+    # first call (the reference's per-kernel dump_ir hook; dump.py).  The
+    # name carries a program discriminator (two programs from one builder
+    # must not overwrite each other) and the rank (shared dump dirs).
+    import hashlib
+
+    disc = hashlib.sha1(repr((str(mesh), in_specs, out_specs,
+                              opts)).encode()).hexdigest()[:8]
+    name = f"{builder.__name__}.{disc}.r{jax.process_index()}"
+    return dump.wrap_for_dump(jitted, name)
 
 
 def cached_shard_jit(builder: Callable, mesh, in_specs, out_specs, **opts):

@@ -4,12 +4,13 @@ Reference analog: ``group_profile`` (utils.py:417-501) — per-rank
 torch.profiler chrome traces gathered to rank 0, pid/tid re-namespaced per
 rank, merged and gzipped.
 
-TPU-native design: ``jax.profiler`` already captures device + host activity
-per process into Perfetto/TensorBoard format, and on multi-host TPU each
-process writes its own trace directory.  ``group_profile`` wraps
-``jax.profiler.trace`` with rank-scoped output dirs so a whole-job profile is
-a directory merge (Perfetto loads multi-process traces natively — no pid/tid
-rewriting needed, which removes the reference's entire merge pipeline).
+TPU-native design: ``jax.profiler`` captures device + host activity per
+process into Perfetto/TensorBoard format; ``group_profile`` scopes each
+rank's output dir, then rank 0 merges every rank's chrome events into ONE
+gzipped timeline with per-rank pid re-namespacing — the same single-
+artifact contract as the reference's merge pipeline, minus its
+gather-to-rank-0 copy step (ranks write a shared filesystem directly).
+The per-rank dirs also remain loadable individually.
 """
 
 from __future__ import annotations
@@ -24,13 +25,21 @@ class group_profile:
     """Context manager: ``with group_profile("ag_gemm", do_prof=True): ...``.
 
     Writes traces to ``{base_dir}/{name}/rank{process_index}``; view with
-    TensorBoard's profile plugin or ui.perfetto.dev.
+    TensorBoard's profile plugin or ui.perfetto.dev.  With ``merge=True``
+    (the default), rank 0 additionally merges every rank's chrome trace
+    into ONE gzipped timeline at ``{base_dir}/{name}/merged.trace.json.gz``
+    — the reference's single-artifact job trace (utils.py:282-501), with
+    pids re-namespaced per rank so a 32-chip job loads as one file in
+    ui.perfetto.dev.
     """
 
-    def __init__(self, name: str = "trace", do_prof: bool = True, base_dir: str = "prof"):
+    def __init__(self, name: str = "trace", do_prof: bool = True,
+                 base_dir: str = "prof", merge: bool = True):
         self.name = name
         self.do_prof = do_prof
         self.base_dir = base_dir
+        self.merge = merge
+        self.merged_path = None
         self._cm = None
 
     def __enter__(self):
@@ -44,7 +53,76 @@ class group_profile:
     def __exit__(self, *exc):
         if self._cm is not None:
             self._cm.__exit__(*exc)
+            if self.merge:
+                if jax.process_count() > 1:
+                    # Every rank must finish flushing its trace files
+                    # before rank 0 reads them (same sync used by
+                    # checkpoint.py).
+                    from jax.experimental import multihost_utils
+
+                    multihost_utils.sync_global_devices(
+                        "group_profile_merge")
+                if jax.process_index() == 0:
+                    try:
+                        self.merged_path = merge_rank_traces(
+                            os.path.join(self.base_dir, self.name))
+                    except Exception:
+                        self.merged_path = None  # per-rank dirs remain
         return False
+
+
+def merge_rank_traces(job_dir: str) -> str | None:
+    """Merge every ``rank*/`` chrome trace under ``job_dir`` into one
+    gzipped timeline ``{job_dir}/merged.trace.json.gz``.
+
+    Each rank's events keep their own pid space, prefixed into a distinct
+    range (rank r's pid p becomes ``r * 10_000_000 + p`` — injective since
+    Linux pids cap at 4194304) and its process
+    names get a ``[rank r]`` suffix — the reference's pid/tid
+    re-namespacing (utils.py:282-501) on the TPU trace layout
+    (``plugins/profile/<run>/*.trace.json.gz`` per process).  Returns the
+    merged path, or None when no per-rank traces exist (e.g. profiling
+    was off).  NOTE: on multi-host, every rank must write under a SHARED
+    filesystem for rank 0 to see the dirs; otherwise per-rank dirs stay
+    separate (perfetto can still load several files side by side).
+    """
+    import glob
+    import gzip
+    import json
+
+    merged_events = []
+    ranks = sorted(glob.glob(os.path.join(job_dir, "rank*")))
+    found = 0
+    for rank_dir in ranks:
+        m = os.path.basename(rank_dir).replace("rank", "")
+        try:
+            rank = int(m)
+        except ValueError:
+            continue
+        traces = sorted(glob.glob(
+            os.path.join(rank_dir, "**", "*.trace.json.gz"),
+            recursive=True))
+        for path in traces:
+            with gzip.open(path, "rt") as f:
+                data = json.load(f)
+            found += 1
+            for ev in data.get("traceEvents", []):
+                if "pid" in ev:
+                    ev = dict(ev)
+                    ev["pid"] = rank * 10_000_000 + int(ev["pid"])
+                    if (ev.get("ph") == "M"
+                            and ev.get("name") == "process_name"):
+                        args = dict(ev.get("args", {}))
+                        args["name"] = (f"{args.get('name', '')} "
+                                        f"[rank {rank}]")
+                        ev["args"] = args
+                merged_events.append(ev)
+    if not found:
+        return None
+    out = os.path.join(job_dir, "merged.trace.json.gz")
+    with gzip.open(out, "wt") as f:
+        json.dump({"traceEvents": merged_events}, f)
+    return out
 
 
 @contextlib.contextmanager
