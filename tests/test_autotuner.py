@@ -218,3 +218,26 @@ def test_eager_failure_chains_cause():
     with pytest.raises(RuntimeError) as ei:
         fn(jnp.ones((2,)))
     assert isinstance(ei.value.__cause__, ValueError)
+
+
+def test_measure_hook_overrides_timing():
+    """A custom measure hook both drives selection and proves pluggability
+    (the tunnel needs a chain-based protocol; autotune_onchip.py)."""
+    from triton_dist_tpu.autotuner import AutotunedFunction, Config
+
+    calls = []
+
+    def fake_measure(fn, args, kwargs, config):
+        calls.append(dict(config))
+        # pretend bm=256 is 10x faster regardless of real time
+        return fn(*args, **{**kwargs, **config}), (
+            1.0 if config["bm"] == 256 else 10.0)
+
+    f = AutotunedFunction(
+        lambda x, *, bm: x * bm,
+        [Config(bm=128), Config(bm=256), Config(bm=512)],
+        measure=fake_measure)
+    f(jnp.ones((4,)))
+    assert f.best_config == {"bm": 256}
+    assert {c["bm"] for c in calls} == {128, 256, 512}
+    assert float(f(jnp.ones((4,)))[0]) == 256.0

@@ -146,11 +146,13 @@ class AutotunedFunction:
     """``@autotune``-wrapped function with a per-key best-config cache."""
 
     def __init__(self, fn: Callable, configs: Sequence[dict],
-                 key: Sequence[str] = (), prune: Callable | None = None):
+                 key: Sequence[str] = (), prune: Callable | None = None,
+                 measure: Callable | None = None):
         self.fn = fn
         self.configs = [dict(c) for c in configs]
         self.key_names = tuple(key)
         self.prune = prune
+        self.measure = measure
         self.cache: dict[tuple, dict] = {}
         self._states: dict[tuple, _TuningState] = {}
         self.__name__ = getattr(fn, "__name__", "autotuned")
@@ -183,6 +185,18 @@ class AutotunedFunction:
         return self.fn(*args, **{**kwargs, **config})
 
     def _timed(self, args, kwargs, config) -> tuple[Any, float]:
+        """(result, milliseconds) for one config invocation.
+
+        The default fence is ``block_until_ready`` — correct on directly
+        attached TPUs (the deployment case).  On the axon TUNNEL it is
+        useless twice over: the fence returns early AND single-call times
+        are swamped by the ~100 ms RTT with tens-of-ms jitter — pass a
+        custom ``measure``
+        (e.g. a dependent-chain protocol, scripts/autotune_onchip.py /
+        scripts/benchlib.py) to tune through the tunnel.
+        """
+        if self.measure is not None:
+            return self.measure(self.fn, args, kwargs, config)
         t0 = time.perf_counter()
         ret = self._run(args, kwargs, config)
         jax.block_until_ready(ret)
@@ -211,8 +225,14 @@ class AutotunedFunction:
         last_exc = None
         for i, cfg in enumerate(configs):
             try:
-                for _ in range(2):  # warmup (compile) + 1 measure
+                if self.measure is not None:
+                    # Custom hooks own their warmup/compile handling; a
+                    # second full protocol run would only replay identical
+                    # inputs (which a content-caching backend elides).
                     last, ms = self._timed(args, kwargs, cfg)
+                else:
+                    for _ in range(2):  # warmup (compile) + 1 measure
+                        last, ms = self._timed(args, kwargs, cfg)
                 okay.append((i, cfg))
                 times.append(ms)
             except Exception as e:  # bad config; keep cause for diagnosis
@@ -239,6 +259,11 @@ class AutotunedFunction:
             cfg = st.configs[st.cfg_i]
             try:
                 ret, ms = self._timed(args, kwargs, cfg)
+                if ret is None:
+                    # Measure hooks may time a surrogate (e.g. a chain) and
+                    # return no result; the surrounding contextual op still
+                    # needs a real output this iteration.
+                    ret = self._run(args, kwargs, cfg)
             except Exception as e:  # bad config (e.g. Mosaic tiling error)
                 tuner.log(f"func: {self.__name__} | config {st.cfg_i} "
                           f"{cfg} | error: {e}")
@@ -286,17 +311,18 @@ class AutotunedFunction:
 
 
 def autotune(configs: Sequence[dict], key: Sequence[str] = (),
-             prune: Callable | None = None):
+             prune: Callable | None = None, measure: Callable | None = None):
     """Decorator marking a function tunable over ``configs``.
 
     Reference: ``triton.autotune``; config kwargs are merged into the call's
     kwargs, later tuners pick per-``key`` cached bests.  ``prune(configs,
     args, kwargs)`` may drop redundant configs per call (reference:
     ``prune_configs_by``) — e.g. dedupe block sizes that clamp identically
-    for a small shape.
+    for a small shape.  ``measure(fn, args, kwargs, config) -> (ret, ms)``
+    overrides the timing protocol (see ``_timed`` for when you must).
     """
 
     def decor(fn):
-        return AutotunedFunction(fn, configs, key, prune)
+        return AutotunedFunction(fn, configs, key, prune, measure)
 
     return decor
