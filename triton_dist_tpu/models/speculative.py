@@ -33,6 +33,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from triton_dist_tpu.models.generate import GenerationState, Generator
 from triton_dist_tpu.models.sampling import filtered_probs
@@ -40,6 +41,59 @@ from triton_dist_tpu.models.sampling import filtered_probs
 
 def _greedy(logits) -> jax.Array:
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+@jax.jit
+def speculative_accept_chain(pis, rhos, proposals, bonus_pi, key):
+    """Whole-round accept chain ON DEVICE: one lax.scan over the k
+    (pi, rho, proposal) triples + the bonus draw — so a round costs ONE
+    [k+1]-token transfer instead of k+1 per-token host syncs (the
+    round-1 advisor's latency finding).
+
+    pis [k, V]: target dist at each position (pis[0] from the pre-round
+    logits); rhos [k, V]; proposals [k] i32; bonus_pi [V]: target dist
+    after all k.  Returns (m, tokens [k+1]) where m is the accept count
+    and tokens[:m+1] is the round's emission (accepted prefix, then the
+    residual sample at the first rejection — or the bonus when all k
+    accepted).  Marginally the stream ~ target sampling (the per-step
+    identity of :func:`speculative_accept_step`, applied left to right).
+    """
+    k = proposals.shape[0]
+    keys = jax.random.split(key, k + 1)
+
+    def step(alive, inp):
+        pi, rho, prop, kk = inp
+        accepted, token = speculative_accept_step(pi, rho, prop, kk)
+        return jnp.logical_and(alive, accepted), (
+            token, jnp.logical_and(alive, accepted))
+
+    _, (tokens, acc) = jax.lax.scan(
+        step, jnp.bool_(True), (pis, rhos, proposals, keys[:k]))
+    m = jnp.sum(acc.astype(jnp.int32))
+    bonus = jax.random.categorical(
+        keys[k], jnp.log(bonus_pi + 1e-30)).astype(jnp.int32)
+    # Position m holds the residual sample when m < k (the rejecting
+    # step's token); when m == k the bonus closes the round.
+    return m, jnp.concatenate([tokens, bonus[None]])
+
+
+@jax.jit
+def greedy_accept_chain(proposals, st_logits, logits_all):
+    """Greedy accept ON DEVICE: expected[i] is the target argmax at
+    position i (independent of acceptance), m = length of the matching
+    prefix, tokens[:m+1] = accepted prefix + the correct greedy token at
+    position m.  One transfer per round, bit-identical to the host loop.
+    """
+    k = proposals.shape[0]
+    expected = jnp.concatenate([
+        _greedy(st_logits),                       # position 0
+        _greedy(logits_all[0, :k]),               # positions 1..k
+    ])                                            # [k+1]
+    matches = (proposals == expected[:k]).astype(jnp.int32)
+    m = jnp.sum(jnp.cumprod(matches))
+    toks = jnp.where(jnp.arange(k + 1) == m, expected,
+                     jnp.concatenate([proposals, proposals[-1:]]))
+    return m, toks
 
 
 @jax.jit
@@ -164,20 +218,15 @@ class SpeculativeGenerator(_SpeculativeBase):
     def _propose(self, d_params, sd, k, key):
         proposals = []
         for _ in range(k):
-            tok = _greedy(sd.last_logits)
+            tok = _greedy(sd.last_logits)   # stays on device: no sync
             sd = self.draft.step(d_params, sd, tok)
-            proposals.append(int(tok[0]))
-        return proposals, None, sd, key
+            proposals.append(tok[0])
+        return jnp.stack(proposals), None, sd, key
 
     def _verify(self, st_logits, logits_all, proposals, aux, key):
-        expected = int(_greedy(st_logits)[0])
-        emitted = []
-        m = 0
-        while m < len(proposals) and proposals[m] == expected:
-            emitted.append(proposals[m])
-            m += 1
-            expected = int(_greedy(logits_all[:, m - 1])[0])
-        emitted.append(expected)  # the correct greedy token at L+m
+        m_dev, toks = greedy_accept_chain(proposals, st_logits, logits_all)
+        m = int(m_dev)
+        emitted = [int(t) for t in np.asarray(toks[:m + 1])]  # ONE fetch
         return m, emitted, key
 
     def _fallback(self, logits, key):
@@ -205,36 +254,27 @@ class SpeculativeSampler(_SpeculativeBase):
         proposals, rhos = [], []
         for _ in range(k):
             rho = self._probs(sd.last_logits[0])          # [V]
-            tok_i, key = self._draw(rho, key)
+            key, sub = jax.random.split(key)
+            tok = jax.random.categorical(
+                sub, jnp.log(rho + 1e-30)).astype(jnp.int32)
             rhos.append(rho)
-            sd = self.draft.step(d_params, sd,
-                                 jnp.asarray([tok_i], jnp.int32))
-            proposals.append(tok_i)
-        return proposals, rhos, sd, key
+            sd = self.draft.step(d_params, sd, tok[None])  # no host sync
+            proposals.append(tok)
+        return jnp.stack(proposals), jnp.stack(rhos), sd, key
 
     def _verify(self, st_logits, logits_all, proposals, rhos, key):
-        # TODO(perf): this loop does one device->host transfer per drafted
-        # token (bool(accepted)/int(token)), serializing k syncs per round.
-        # The accept chain is expressible as one lax.scan over the k
-        # (pi, rho, proposal) triples with a single [k+1]-token transfer at
-        # the end — worth doing once speculative latency is benchmarked.
-        emitted = []
-        m = 0
-        while m < len(proposals):
-            pi = self._probs(st_logits[0] if m == 0
-                             else logits_all[0, m - 1])
-            key, sub = jax.random.split(key)
-            accepted, token = speculative_accept_step(
-                pi, rhos[m], jnp.int32(proposals[m]), sub)
-            if not bool(accepted):
-                emitted.append(int(token))   # residual resample; stop
-                return m, emitted, key
-            emitted.append(int(token))
-            m += 1
-        # All accepted: bonus sample from the target's next distribution.
-        pi = self._probs(logits_all[0, len(proposals) - 1])
-        tok_i, key = self._draw(pi, key)
-        emitted.append(tok_i)
+        # Whole-round accept chain on device (speculative_accept_chain):
+        # ONE [k+1]-token fetch per round instead of one sync per token.
+        # filtered_probs is batched: one vectorized call covers all k
+        # positions plus the bonus distribution.
+        k = proposals.shape[0]
+        all_pi = self._probs(jnp.concatenate([st_logits, logits_all[0]]))
+        pis, bonus_pi = all_pi[:k], all_pi[k]
+        key, sub = jax.random.split(key)
+        m_dev, toks = speculative_accept_chain(pis, rhos, proposals,
+                                               bonus_pi, sub)
+        m = int(m_dev)
+        emitted = [int(t) for t in np.asarray(toks[:m + 1])]
         return m, emitted, key
 
     def _fallback(self, logits, key):
