@@ -23,7 +23,6 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 from triton_dist_tpu.kernels.perf_model import (  # noqa: E402
     estimate_allgather_time_ms,
     estimate_all_to_all_time_ms,
-    estimate_gemm_sol_time_ms,
     estimate_torus_allgather_time_ms,
     estimate_torus_reduce_scatter_time_ms,
 )

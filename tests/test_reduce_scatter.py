@@ -25,7 +25,9 @@ def _reference(x_per_device: list[np.ndarray], world: int):
     return total
 
 
-@pytest.mark.parametrize("method", [ReduceScatterMethod.XLA, ReduceScatterMethod.RING_1D])
+@pytest.mark.parametrize("method", [ReduceScatterMethod.XLA,
+                                    ReduceScatterMethod.RING_1D,
+                                    ReduceScatterMethod.RING_BIDIR])
 def test_reduce_scatter_matches_reference(mesh4, key, method):
     world = 4
     rows, cols = 8, 128
@@ -77,3 +79,22 @@ def test_reduce_scatter_host_entry_rejects_bad_leading_dim(mesh4, key):
     ctx = ReduceScatterContext(mesh=mesh4, axis="tp", interpret=True)
     with pytest.raises(ValueError, match="stacked partials"):
         reduce_scatter(x, ctx)
+
+
+@pytest.mark.parametrize("rows_per_rank", [8, 5, 1])
+def test_bidir_ring_rs_odd_and_tiny_rows(mesh4, key, rows_per_rank):
+    """Bidir RS: odd rows split into unequal direction-halves; a single
+    row degenerates to one active direction."""
+    import functools
+    from jax.sharding import PartitionSpec as P
+
+    T = rows_per_rank * 4
+    x = jax.random.normal(key, (T, 128), jnp.float32)
+    got = jax.jit(jax.shard_map(
+        functools.partial(reduce_scatter_shard, axis="tp",
+                          method=ReduceScatterMethod.RING_BIDIR,
+                          interpret=True),
+        mesh=mesh4, in_specs=P(), out_specs=P("tp"),
+        check_vma=False))(x)
+    np.testing.assert_allclose(np.asarray(got), 4 * np.asarray(x),
+                               rtol=1e-5)

@@ -221,10 +221,11 @@ def test_torus_perf_model_speedup():
     # 3-axis: 4x4 plane + ring on the 2-axis; dominated by the third hop.
     t3 = estimate_torus_allgather_time_ms(S, (2, 4, 4), bw_gbps=bw)
     assert t3 > plane
-    # RS: square-plane fused path beats the sequential composition bound.
+    # RS: the fused four-quarter plane (both orders, both directions)
+    # models at ~2x the bidirectional 1-axis ring (the AUTO default).
     rs2 = estimate_torus_reduce_scatter_time_ms(S, (4, 4), bw_gbps=bw)
-    rs1 = estimate_torus_reduce_scatter_time_ms(S, (16,), bw_gbps=bw)
-    assert rs2 < rs1
+    rs_bidir = estimate_torus_reduce_scatter_time_ms(S, (16,), bw_gbps=bw)
+    assert np.isclose(rs_bidir / rs2, 2.0, rtol=0.15), (rs_bidir, rs2)
 
 
 def test_torus_ag_gemm(mesh2x4, key):

@@ -187,14 +187,14 @@ def estimate_torus_reduce_scatter_time_ms(nbytes_full: int,
                                           axis_sizes: tuple[int, ...],
                                           bw_gbps: float | None = None
                                           ) -> float:
-    """Fused 2D torus RS (``kernels/torus.py``): two concurrent half-paths
-    (x→y and y→x, one direction each).  Per path (half bytes h = F/2 with
-    F = ``nbytes_full``): phase 1 rings (w1-1) line groups of h/w1 bytes,
-    phase 2 (w2-1) slots of h/(w1*w2) → per-link time h*(w1-1)/w1 +
-    h*(w2-1)/(w1*w2); both paths concurrent → wall time = max over paths
-    (equal on square tori).  ~2x the implemented unidirectional 1-axis
-    ring; parity with a (not yet implemented) 1-axis bidirectional RS —
-    the four-quarter bidirectional extension doubles it again.
+    """Fused 2D torus RS (``kernels/torus.py``): FOUR concurrent quarter
+    paths (x→y and y→x orders, each bidirectional — all four link
+    directions reduce at once).  Per path (quarter bytes q = F/4 with
+    F = ``nbytes_full``): phase 1 rings (w1-1) line groups of q/w1 bytes,
+    phase 2 (w2-1) slots of q/(w1*w2) → per-link time q*(w1-1)/w1 +
+    q*(w2-1)/(w1*w2); wall time = max over the two orders (equal on
+    square tori).  ~2x the bidirectional 1-axis ring (the AUTO default),
+    ~4x the unidirectional ring.
     """
     sizes = [s for s in axis_sizes if s > 1]
     world = 1
@@ -205,23 +205,23 @@ def estimate_torus_reduce_scatter_time_ms(nbytes_full: int,
     bw = bw_gbps if bw_gbps is not None else get_ici_axis_bandwidth_gbps()
     link = bw / 2.0
     if len(sizes) == 1:
-        # The implemented 1-axis ring RS is unidirectional (RING_1D):
-        # one link direction carries all the bytes.
-        return (nbytes_full * (sizes[0] - 1) / sizes[0]) / 1e9 / link * 1e3
+        # AUTO now selects the bidirectional ring (RING_BIDIR): halves on
+        # each link direction.
+        return (nbytes_full / 2 * (sizes[0] - 1) / sizes[0]) / 1e9 / link \
+            * 1e3
     if len(sizes) == 3:
-        # Third axis reduces first (shrinks data), then the fused plane.
-        # The implemented third-axis pass is the unidirectional RING_1D —
-        # one link direction, same as the 1-axis branch above.
+        # Third axis reduces first (shrinks data), then the fused plane;
+        # the third-axis pass is the bidirectional ring.
         w3 = sizes[0]
-        t3 = (nbytes_full * (w3 - 1) / w3) / 1e9 / link * 1e3
+        t3 = (nbytes_full / 2 * (w3 - 1) / w3) / 1e9 / link * 1e3
         return t3 + estimate_torus_reduce_scatter_time_ms(
             nbytes_full // w3, tuple(sizes[1:]), bw_gbps)
     w1, w2 = sizes
-    half = nbytes_full / 2
+    quarter = nbytes_full / 4
 
     def path_ms(a, b):
-        p1 = half / a * (a - 1) / 1e9 / link * 1e3
-        p2 = half / (a * b) * (b - 1) / 1e9 / link * 1e3
+        p1 = quarter / a * (a - 1) / 1e9 / link * 1e3
+        p2 = quarter / (a * b) * (b - 1) / 1e9 / link * 1e3
         return p1 + p2
 
     return max(path_ms(w1, w2), path_ms(w2, w1))

@@ -42,6 +42,7 @@ class ReduceScatterMethod(enum.Enum):
     AUTO = "auto"
     XLA = "xla"
     RING_1D = "ring_1d"
+    RING_BIDIR = "ring_bidir"  # both link directions, ~2x RING_1D
 
 
 @dataclass
@@ -62,9 +63,10 @@ def create_reduce_scatter_context(mesh, axis="tp", method=ReduceScatterMethod.AU
 
 
 def resolve_method(interpret: bool) -> ReduceScatterMethod:
-    """AUTO → the pallas ring on TPU (or in interpret-test mode), XLA else."""
+    """AUTO → the bidirectional pallas ring on TPU (or in interpret-test
+    mode), XLA else."""
     if topology.is_tpu() or interpret:
-        return ReduceScatterMethod.RING_1D
+        return ReduceScatterMethod.RING_BIDIR
     return ReduceScatterMethod.XLA
 
 
@@ -129,6 +131,81 @@ def _ring_rs_kernel(
     out_ref[:] = local_buf[:] + recv_buf[:]
 
 
+def _bidir_ring_rs_kernel(
+    x_hbm, out_ref, local_buf, acc_buf, recv_buf,
+    send_sem, recv_sem, credit_sem, copy_sem,
+    *, axis, world, rows, ra,
+):
+    """Bidirectional ring RS: each chunk's rows split in two — half A
+    ([0, ra)) reduces along the rightward ring while half B ([ra, rows))
+    reduces leftward, so both ICI link directions carry ~half the bytes
+    concurrently (~2x RING_1D; the RS twin of the bidirectional AG).
+
+    Per direction the schedule IS the 1-D ring RS (see ``_ring_rs_kernel``'s
+    derivation); the two instances are interleaved per step — start both
+    remote DMAs, then wait both — with per-direction buffers, DMA
+    semaphores ([2]-arrays indexed by direction) and credit semaphores.
+    """
+    me = jax.lax.axis_index(axis)
+    right = jax.lax.rem(me + 1, world)
+    left = jax.lax.rem(me + world - 1, world)
+
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(barrier, inc=1, device_id={axis: left},
+                           device_id_type=pltpu.DeviceIdType.MESH)
+    pltpu.semaphore_signal(barrier, inc=1, device_id={axis: right},
+                           device_id_type=pltpu.DeviceIdType.MESH)
+    pltpu.semaphore_wait(barrier, 2)
+
+    rb = rows - ra  # rb >= ra >= 1 (dispatch gates rows >= 2)
+    # (direction d, half-slice (off, ln), peer, upstream) per path.
+    paths = ((1, 0, ra, right, left), (-1, ra, rb, left, right))
+
+    def load_half(slot, off, ln, dst):
+        cp = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(slot * rows + off, ln)], dst, copy_sem)
+        cp.start()
+        cp.wait()
+
+    def step(s, _):
+        for p, (d, off, ln, peer, prev) in enumerate(paths):
+            slot = jax.lax.rem(me - d * (1 + s) + (1 + s) * world + world,
+                               world)
+            load_half(slot, off, ln, local_buf.at[p, :ln])
+
+            @pl.when(s == 0)
+            def _(p=p, ln=ln):
+                acc_buf[p, :ln] = local_buf[p, :ln]
+
+            @pl.when(s > 0)
+            def _(p=p, ln=ln, prev=prev):
+                acc_buf[p, :ln] = local_buf[p, :ln] + recv_buf[p, :ln]
+                # landing slot consumed → credit the upstream sender
+                pltpu.semaphore_signal(
+                    credit_sem.at[p], inc=1, device_id={axis: prev},
+                    device_id_type=pltpu.DeviceIdType.MESH)
+
+            @pl.when(s > 0)
+            def _(p=p):
+                pltpu.semaphore_wait(credit_sem.at[p], 1)
+
+            dl.remote_copy(acc_buf.at[p, :ln], recv_buf.at[p, :ln],
+                           send_sem.at[p], recv_sem.at[p], axis,
+                           peer).start()
+        for p, (d, off, ln, peer, prev) in enumerate(paths):
+            blk = acc_buf.at[p, :ln]
+            pltpu.make_async_copy(blk, blk, send_sem.at[p]).wait()
+            pltpu.make_async_copy(blk, blk, recv_sem.at[p]).wait()
+        return 0
+
+    jax.lax.fori_loop(0, world - 1, step, 0)
+
+    # Final fold: the last arrival in each direction is MY chunk's half.
+    for p, (d, off, ln, peer, prev) in enumerate(paths):
+        load_half(me, off, ln, local_buf.at[p, :ln])
+        out_ref[pl.ds(off, ln)] = local_buf[p, :ln] + recv_buf[p, :ln]
+
+
 def reduce_scatter_shard(x_shard, axis: str, method=ReduceScatterMethod.AUTO,
                          interpret=False, collective_id=2):
     """Per-shard RS: input (world*rows, ...) → output (rows, ...) summed.
@@ -160,6 +237,29 @@ def reduce_scatter_shard(x_shard, axis: str, method=ReduceScatterMethod.AUTO,
     assert total_rows % world == 0, (total_rows, world)
     rows = total_rows // world
     tail = x_shard.shape[1:]
+    if method is ReduceScatterMethod.RING_BIDIR and rows >= 2:
+        ra = rows // 2  # invariant: rb = rows - ra >= ra >= 1
+        half = pltpu.VMEM((2, rows - ra, *tail), x_shard.dtype)
+        return pl.pallas_call(
+            functools.partial(_bidir_ring_rs_kernel, axis=axis, world=world,
+                              rows=rows, ra=ra),
+            out_shape=jax.ShapeDtypeStruct((rows, *tail), x_shard.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                half,  # local_buf [2, max_half, ...]
+                half,  # acc_buf
+                half,  # recv_buf (remote landing zone)
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.REGULAR((2,)),  # credits per direction
+                pltpu.SemaphoreType.DMA,
+            ],
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True, collective_id=collective_id
+            ),
+            interpret=maybe_interpret(interpret),
+        )(x_shard)
     chunk = pltpu.VMEM((rows, *tail), x_shard.dtype)
     return pl.pallas_call(
         functools.partial(_ring_rs_kernel, axis=axis, world=world, rows=rows),

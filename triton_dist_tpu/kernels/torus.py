@@ -247,13 +247,12 @@ def _torus2d_rs_kernel(x_hbm, out_ref, line_acc, line_recv, slot_acc,
 
     Input ``x_hbm`` [wx, wy, R, C]: this device's partial for every slot.
     Output ``out_ref`` [R, C]: my slot (i, j), summed over all wx*wy
-    devices.  ``halves``: 2 tuples (row_offset, row_len, first_axis, dir) —
-    path A reduces along x then y on rows [0:ra], path B along y then x on
-    rows [ra:R].  The paths' steps are interleaved in ONE loop per phase
-    (start both remote DMAs, then wait both), so phase 1 drives the x and y
-    links concurrently and phase 2 the other pair — that concurrency is the
-    point of the fused kernel.  (One direction per axis; the bidirectional
-    quarter split is a future extension, see module docstring.)
+    devices.  ``halves``: the path tuples (row_offset, row_len,
+    first_axis, direction) — four quarters with the same flavor set as
+    the AG kernel (x→y ±, y→x ±), so ALL FOUR link directions reduce
+    concurrently in both phases.  The paths' steps are interleaved in ONE
+    loop per phase (start every path's remote DMA, then wait them all) —
+    that concurrency is the point of the fused kernel.
 
     Phase-1 ring item for path A = the x-line group {slots (i, j'') for all
     j''} = [wy, ln, C]; after wx-1 steps device (i, j) holds line (i, *)
@@ -412,9 +411,14 @@ def _torus2d_rs_kernel(x_hbm, out_ref, line_acc, line_recv, slot_acc,
                                    + slot_recv[p, 0, :ln])
 
 
-def _split_halves(rows: int):
-    ra = rows // 2
-    return ((0, ra, "x", 1), (ra, rows - ra, "y", 1))
+def _split_rs_quarters(rows: int):
+    """Four (offset, len, first_axis, direction) paths for the fused RS —
+    the same flavor set as the AG quarters: x→y and y→x orders, each
+    bidirectional, so all four link directions reduce concurrently."""
+    return tuple(
+        (off, ln, first, d)
+        for (off, ln), (first, d) in zip(_split_quarters(rows),
+                                         _QUARTER_FLAVORS))
 
 
 def _torus2d_rs(x_shard, *, ax, ay, wx, wy, interpret, collective_id):
@@ -424,7 +428,8 @@ def _torus2d_rs(x_shard, *, ax, ay, wx, wy, interpret, collective_id):
     orig_trailing = x_shard.shape[1:]
     x4 = x_shard.reshape(wx, wy, rows, -1)
     cols = x4.shape[-1]
-    halves = _split_halves(rows)
+    halves = _split_rs_quarters(rows)
+    n_paths = len(halves)
     lmax = max(wx, wy)
     ln_max = max(ln for _, ln, _, _ in halves)
     out = pl.pallas_call(
@@ -434,14 +439,14 @@ def _torus2d_rs(x_shard, *, ax, ay, wx, wy, interpret, collective_id):
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((2, lmax, ln_max, cols), x4.dtype),  # line_acc
-            pltpu.VMEM((2, lmax, ln_max, cols), x4.dtype),  # line_recv
-            pltpu.VMEM((2, 1, ln_max, cols), x4.dtype),     # slot_acc
-            pltpu.VMEM((2, 1, ln_max, cols), x4.dtype),     # slot_recv
-            pltpu.VMEM((2, lmax, ln_max, cols), x4.dtype),  # work_buf
-            pltpu.SemaphoreType.DMA((2, 2)),                # send per path
-            pltpu.SemaphoreType.DMA((2, 2)),                # recv per path
-            pltpu.SemaphoreType.REGULAR((2, 2)),            # credits
+            pltpu.VMEM((n_paths, lmax, ln_max, cols), x4.dtype),  # line_acc
+            pltpu.VMEM((n_paths, lmax, ln_max, cols), x4.dtype),  # line_recv
+            pltpu.VMEM((n_paths, 1, ln_max, cols), x4.dtype),     # slot_acc
+            pltpu.VMEM((n_paths, 1, ln_max, cols), x4.dtype),     # slot_recv
+            pltpu.VMEM((n_paths, lmax, ln_max, cols), x4.dtype),  # work_buf
+            pltpu.SemaphoreType.DMA((n_paths, 2)),          # send per path
+            pltpu.SemaphoreType.DMA((n_paths, 2)),          # recv per path
+            pltpu.SemaphoreType.REGULAR((n_paths, 2)),      # credits
             pltpu.SemaphoreType.DMA,                        # copy
         ],
         compiler_params=dl.collective_compiler_params(wxy, collective_id),
@@ -459,10 +464,10 @@ def torus_reduce_scatter_shard(x_shard, axes, *, interpret=False,
     Output: this device's fully-summed [rows, ...] band — matching
     ``lax.psum_scatter(tiled=True)`` over the joint axes.
 
-    2 axes → the fused two-path kernel (x→y and y→x reductions run
-    concurrently on disjoint links).  3 axes → the (unidirectional)
-    RING_1D ring RS on ``axes[0]`` first (reductions SHRINK data: do the
-    plane-fold heavier axis first), then the fused 2D plane.
+    2 axes → the fused four-quarter kernel (x→y and y→x reduction
+    orders, each bidirectional: all four link directions busy).  3 axes →
+    the bidirectional ring RS on ``axes[0]`` first (reductions SHRINK
+    data: do the plane-fold heavier axis first), then the fused 2D plane.
     """
     from triton_dist_tpu.kernels.reduce_scatter import (
         ReduceScatterMethod,

@@ -225,9 +225,8 @@ class SpeculativeGenerator(_SpeculativeBase):
 
     def _verify(self, st_logits, logits_all, proposals, aux, key):
         m_dev, toks = greedy_accept_chain(proposals, st_logits, logits_all)
-        m = int(m_dev)
-        emitted = [int(t) for t in np.asarray(toks[:m + 1])]  # ONE fetch
-        return m, emitted, key
+        m, toks = jax.device_get((m_dev, toks))  # one round-trip
+        return int(m), [int(t) for t in toks[:int(m) + 1]], key
 
     def _fallback(self, logits, key):
         return int(_greedy(logits)[0]), key
@@ -273,9 +272,8 @@ class SpeculativeSampler(_SpeculativeBase):
         key, sub = jax.random.split(key)
         m_dev, toks = speculative_accept_chain(pis, rhos, proposals,
                                                bonus_pi, sub)
-        m = int(m_dev)
-        emitted = [int(t) for t in np.asarray(toks[:m + 1])]
-        return m, emitted, key
+        m, toks = jax.device_get((m_dev, toks))  # one round-trip
+        return int(m), [int(t) for t in toks[:int(m) + 1]], key
 
     def _fallback(self, logits, key):
         return self._draw(self._probs(logits[0]), key)
