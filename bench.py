@@ -21,14 +21,15 @@ utilization than the reference achieves on its own hardware.
 
 Timing note: jax.block_until_ready does not actually block on the axon
 tunnel backend, so timings use chained dependent iterations inside one jit
-and subtract the 1-iteration round-trip (see _paired_diff_time); block
-sizes are the real-chip sweep winners (MatmulConfig defaults, gemm.py).
+and subtract the 1-iteration round-trip, churn/work chains interleaved in
+one rotated trial loop (scripts/benchlib.py: rotated_paired_bench /
+backout_pair); block sizes are the real-chip sweep winners (MatmulConfig
+defaults, gemm.py).
 """
 
 import functools
 import json
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
@@ -49,9 +50,37 @@ from scripts.benchlib import RUN_SEED  # noqa: E402
 REF_UTILIZATION = 0.65  # reference AG-GEMM ~= hand-tuned library on H800
 
 
+def _feedback(x, i):
+    """Serializing value feedback between chain iterations
+    (benchlib.churn_barrier): an int32-grouped mantissa churn whose lane
+    relayout is a deliberate compute barrier, keyed by a sampled sum (one
+    element per 128x128 tile) so no element of the next input exists
+    before every tile of this output does.
+
+    Why this exact construction (BENCH_r02 postmortem + round-3 protocol
+    sweep, docs/perf.md): a bare matmul chain reads 200-220 "TFLOPS"
+    (above the 197 peak — the TPU pipelines consecutive kernels' tiles),
+    a cheap same-width churn still trips the ceiling guard, and a full
+    f32 RMS rescale reads 141-148 with ±5% spread; the relayout barrier
+    is the only variant both below the measured XLA-dot ceiling and
+    stable (±3% across processes once the median-of-three seed banks is
+    applied; honest range 143-153).  The mantissa-only mask keeps
+    sign/exponent intact (no inf/NaN into the matmuls; value growth is
+    bounded by the 0.02-scaled weights, ~2.2x/iter, inside bf16 range
+    over 17 iterations), and the mixed key guarantees every iteration's
+    values differ (the content-cache elision rule).  The barrier's large
+    bandwidth cost is measured by a feedback-only twin chain and
+    subtracted (backout_pair)."""
+    from scripts.benchlib import churn_barrier
+
+    probe = jnp.sum(x[::128, ::128].astype(jnp.float32))
+    s = jax.lax.bitcast_convert_type(probe, jnp.int32)
+    return churn_barrier(x, i, extra_key=s & 1)
+
+
 def _make_chain(mesh, n_iters):
-    """n_iters of (AG-GEMM -> matmul-back) with data dependencies, returning
-    a scalar so fetching it forces execution."""
+    """n_iters of (AG-GEMM -> matmul-back -> _feedback) with real value
+    dependence, returning a scalar so fetching it forces execution."""
     shard_ag = functools.partial(ag_gemm_shard, axis="tp", impl="auto",
                                  interpret=False)
 
@@ -59,12 +88,7 @@ def _make_chain(mesh, n_iters):
         def body(i, x):
             _, c = shard_ag(x, b1)     # [M, N_loc]
             nxt = matmul(c, b2)        # [M, K]
-            # Full-reduction dependence: every element of the next input
-            # depends on ALL of this iteration's output, so consecutive
-            # iterations cannot pipeline into each other (row-tile
-            # head-starts were producing >100%-of-peak readings).
-            dep = (jnp.max(nxt) > jnp.bfloat16(1e30)).astype(nxt.dtype)
-            return nxt + dep
+            return _feedback(nxt, i)
         return jax.lax.fori_loop(0, n_iters, body, a)[0, 0]
 
     return jax.jit(jax.shard_map(
@@ -73,35 +97,23 @@ def _make_chain(mesh, n_iters):
         out_specs=P(), check_vma=False))
 
 
-def _paired_diff_time(fn_short, fn_long, *args, n_extra, trials=14,
-                      fresh_args=None):
-    """Median of per-trial (long - short) / n_extra chain times.
+def _make_xform_chain(mesh, n_iters):
+    """Feedback-only chain at the same [M, K] shape: measures the feedback
+    transform's own per-iteration cost so the AG-GEMM number can subtract
+    it (the grouped-GEMM sweep's counted-projection protocol,
+    docs/perf.md).  Identical _feedback call as the work chain, so the
+    backout is exact; the mantissa churn inside it keeps the iterates
+    value-changing without the work chain's matmuls."""
 
-    Pairing short/long inside each trial cancels tunnel-RTT drift that
-    independently-taken best-of-N times do not (observed 1.7x swings on
-    the axon tunnel with unpaired timing); the median over a generous
-    trial count rejects congestion outliers in either direction (a
-    min/best-of estimator is biased optimistic here — congested t_short
-    inflates the diff's complement and min() happily reports >peak).
+    def body_fn(a, b1, b2):
+        def body(i, x):
+            return _feedback(x, i)
+        return jax.lax.fori_loop(0, n_iters, body, a)[0, 0]
 
-    ``fresh_args``: callable(t) -> args tuple, generating NEW inputs per
-    trial.  Required for honest numbers: the tunnel backend elides
-    repeated calls with identical args (observed >100%-of-peak readings
-    when the long chain got elided), so fixed ``*args`` are only safe for
-    warmup."""
-    diffs = []
-    for t in range(trials):
-        a = args if fresh_args is None else fresh_args(t)
-        if fresh_args is not None:
-            jax.block_until_ready(a)
-        t0 = time.perf_counter()
-        float(fn_short(*a))  # device_get round-trip forces completion
-        t_short = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        float(fn_long(*a))
-        t_long = time.perf_counter() - t0
-        diffs.append((t_long - t_short) / n_extra)
-    return max(float(np.median(diffs)), 1e-9)
+    return jax.jit(jax.shard_map(
+        body_fn, mesh=mesh,
+        in_specs=(P("tp", None), P(None, "tp"), P(None, None)),
+        out_specs=P(), check_vma=False))
 
 
 def _bench_moe_a2a_us(n_extra=16384):
@@ -111,35 +123,52 @@ def _bench_moe_a2a_us(n_extra=16384):
     137 µs headline is a 32-chip wire number; one chip exposes only the
     kernel's dispatch + local-segment floor.  16k-iteration chains: at a
     ~1 µs floor, 4k iterations sit inside the tunnel's ~30 ms RTT jitter.
-    """
-    from triton_dist_tpu.kernels.all_to_all import fast_all_to_all_shard
 
+    At world=1 the AllToAll itself is the identity, so a bare
+    recv-feedback chain's values never change between iterations and the
+    tunnel elides the whole chain (BENCH_r02 recorded an impossible
+    0.00 µs).  Fix: every iteration XORs the loop index into the payload
+    (values change, one cheap elementwise pass), and a second chain with
+    the XOR alone measures that pass's cost, which is subtracted.
+
+    Returns (floor_us, suspect: bool) — suspect when even the doubled-chain
+    retry stays below the 0.2 µs physical floor (the measured LL-AG
+    [8, 32, 129] gather floor; a 918 KB segment copy cannot beat it).
+    """
     mesh = Mesh(np.array(jax.devices()[:1]), ("ep",))
     send = jnp.zeros((1, 128, 7168 // 4), jnp.int32)
     splits = jnp.full((1,), 128, jnp.int32)
 
-    def make(n):
-        def body_fn(send, splits):
-            def body(i, x):
-                recv, _ = fast_all_to_all_shard(x, splits, axis="ep",
-                                                impl="pallas",
-                                                interpret=False)
-                return recv
-            return jax.lax.fori_loop(0, n, body, send)[0, 0, 0]
-        return jax.jit(jax.shard_map(
-            body_fn, mesh=mesh, in_specs=(P("ep"), P("ep")), out_specs=P(),
-            check_vma=False))
+    from scripts.bench_a2a import make_chain
 
-    c1, cn = make(1), make(1 + n_extra)
-    float(c1(send, splits))
-    float(cn(send, splits))
+    def make(n, with_a2a):
+        return make_chain(mesh, n, with_a2a=with_a2a)
 
-    def fresh(t):
-        return (jax.random.randint(jax.random.key(RUN_SEED + t), send.shape,
-                                   0, 1 << 20, jnp.int32), splits)
+    def measure(n, seed_off=0):
+        # backout_pair interleaves the total and churn-only chains in one
+        # rotated trial loop (tunnel drift cancels out of the difference;
+        # separate loops were producing negative floors).  ``seed_off``
+        # gives the retry fresh trial inputs — replaying the first
+        # measurement's keys would hand the retry cached (executable,
+        # args) pairs, the very contamination it is probing for.
+        from scripts.benchlib import backout_pair
 
-    return _paired_diff_time(c1, cn, send, splits, n_extra=n_extra,
-                             trials=9, fresh_args=fresh) * 1e6
+        ca1, can = make(1, True), make(1 + n, True)
+        cx1, cxn = make(1, False), make(1 + n, False)
+        floor_s, _ = backout_pair(
+            {"total": (ca1, can, (splits,)), "churn": (cx1, cxn, (splits,))},
+            fresh_input=lambda t: jax.random.randint(
+                jax.random.key(RUN_SEED + seed_off + t), send.shape,
+                0, 1 << 20, jnp.int32),
+            n_extra=n, trials=9)
+        return floor_s * 1e6
+
+    us = measure(n_extra)
+    if us < 0.2:  # impossible reading: retry once with doubled chains
+        us = measure(2 * n_extra, seed_off=100_000)
+        if us < 0.2:
+            return max(us, 0.0), True
+    return us, False
 
 
 def _bench_decode_us(trials=9):
@@ -158,36 +187,88 @@ def _bench_decode_us(trials=9):
     return res["auto"][0]
 
 
-def main():
+def _bench_ag_gemm_tflops():
+    """Headline AG-GEMM chain with the rescale-cost backout and the
+    ceiling self-consistency guard (BENCH_r02 postmortem: a reading above
+    the measured XLA-dot ceiling is elision, not performance).
+
+    Returns (tflops, suspect: bool)."""
+    from triton_dist_tpu.runtime.topology import measured_dot_ceiling_tflops
+
     mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
     # NONZERO weights: with zero weights every iteration's values are
     # identically zero and the tunnel elides the chain (the "values must
-    # actually change" rule — see _paired_diff_time).  Small scale keeps
-    # 9 chained matmuls inside bf16 range.
+    # actually change" rule — scripts/benchlib.py).  The 0.02 scale keeps
+    # 17 chained matmul pairs inside bf16 range (~2.2x growth/iter).
     kw = jax.random.split(jax.random.key(RUN_SEED), 3)
-    a = jax.random.normal(kw[0], (M, K), jnp.bfloat16)
     b1 = jax.random.normal(kw[1], (K, N_PER_CHIP), jnp.bfloat16) * 0.02
     b2 = jax.random.normal(kw[2], (N_PER_CHIP, K), jnp.bfloat16) * 0.02
-
-    chain1, chain9 = _make_chain(mesh, 1), _make_chain(mesh, 9)
-    float(chain1(a, b1, b2))  # warm both executables
-    float(chain9(a, b1, b2))
-
-    def fresh(t):
-        return (jax.random.normal(jax.random.key(RUN_SEED + t), (M, K),
-                                  jnp.bfloat16), b1, b2)
-
-    per_pair_s = _paired_diff_time(chain1, chain9, a, b1, b2, n_extra=8,
-                                   fresh_args=fresh)
     flops_per_pair = 2 * M * N_PER_CHIP * K * 2  # ag_gemm + return matmul
-    tflops = flops_per_pair / per_pair_s / 1e12
 
-    moe_a2a_us = _bench_moe_a2a_us()
+    chain_cache = {}
+
+    def chains_for(n_long):
+        # chains depend only on n_long; reuse across the three seed banks
+        # (the closures otherwise miss jax.jit's identity cache and every
+        # measure() call would re-trace + re-compile on the slow tunnel)
+        if n_long not in chain_cache:
+            chain_cache[n_long] = (
+                _make_chain(mesh, 1), _make_chain(mesh, n_long),
+                _make_xform_chain(mesh, 1), _make_xform_chain(mesh, n_long))
+        return chain_cache[n_long]
+
+    def measure(n_long, seed_off=0):
+        # backout_pair: the AG-GEMM chain and the feedback-only chain share
+        # one rotated trial loop so tunnel drift cancels out of the
+        # difference.  ``seed_off`` gives the ceiling-guard retry fresh
+        # trial inputs (replayed keys would hit the tunnel's cache).
+        from scripts.benchlib import backout_pair
+
+        c1, cn, x1, xn = chains_for(n_long)
+        per_pair, _ = backout_pair(
+            {"total": (c1, cn, (b1, b2)), "churn": (x1, xn, (b1, b2))},
+            fresh_input=lambda t: jax.random.normal(
+                jax.random.key(RUN_SEED + seed_off + t), (M, K),
+                jnp.bfloat16),
+            n_extra=n_long - 1, trials=14)
+        return per_pair
+
+    def to_tflops(per_pair):
+        # A non-positive backout means churn out-measured the whole chain:
+        # a failed measurement (elision or extreme drift), not a speed.
+        return (flops_per_pair / per_pair / 1e12) if per_pair > 0 else None
+
+    # Median of three independent measurements (distinct seed banks):
+    # single measure() calls still swing ±10% with the tunnel's
+    # cross-minute drift even though each is internally rotated/paired.
+    import statistics
+
+    samples = [measure(9, seed_off=k * 10_000) for k in range(3)]
+    positive = sorted(s for s in samples if s > 0)
+    tflops = to_tflops(statistics.median(positive) if positive else -1.0)
+    ceiling = measured_dot_ceiling_tflops()
+    if tflops is None or tflops > ceiling:
+        # Impossible: the chain pays AG dispatch on top of two dense
+        # matmuls, so it cannot beat XLA's bare dot at the same shape.
+        # Longer chains dilute whatever the tunnel elided; if the reading
+        # stays impossible, report the bound (ceiling, or 0.0 for a
+        # failed backout) with the suspect flag rather than a fiction.
+        tflops = to_tflops(measure(17, seed_off=100_000))
+        if tflops is None:
+            return 0.0, True
+        if tflops > ceiling:
+            return ceiling, True
+    return tflops, False
+
+
+def main():
+    tflops, ag_suspect = _bench_ag_gemm_tflops()
+    moe_a2a_us, a2a_suspect = _bench_moe_a2a_us()
     decode_us = _bench_decode_us()
 
     peak = peak_bf16_tflops()
     vs = (tflops / peak) / REF_UTILIZATION if peak else 0.0
-    print(json.dumps({
+    out = {
         "metric": "ag_gemm_tflops_per_chip",
         "value": round(tflops, 1),
         "unit": "TFLOPS",
@@ -197,7 +278,14 @@ def main():
         # (B=8 Hq=32 Hkv=8 S=8192 bf16, pallas under auto).
         "moe_a2a_floor_us": round(moe_a2a_us, 2),
         "decode_step_us": round(decode_us, 1),
-    }))
+    }
+    if ag_suspect or a2a_suspect:
+        # Self-consistency guard tripped even after the retry: the value
+        # is reported at its physical bound, not as measured.
+        out["suspect_elision"] = (
+            (["ag_gemm"] if ag_suspect else []) +
+            (["moe_a2a"] if a2a_suspect else []))
+    print(json.dumps(out))
     print(f"# chip peak {peak} TFLOPS, utilization "
           f"{tflops / peak:.1%}, shape M={M} K={K} N/chip={N_PER_CHIP}; "
           f"moe_a2a floor {moe_a2a_us:.2f} us; decode {decode_us:.1f} us",

@@ -22,8 +22,90 @@ import statistics
 import time
 
 import jax
+import jax.numpy as jnp
 
 RUN_SEED = time.time_ns() % (1 << 31)
+
+
+_CHURN_UINT = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32}
+_CHURN_MANTISSA = {1: 0x07, 2: 0x007F, 4: 0x007FFFFF}  # fp8e4m3/bf16/f32
+
+
+def churn(x, i, mantissa_only=False):
+    """XOR a well-mixed function of the loop index into the payload's raw
+    bits (a SAME-WIDTH unsigned bitcast view for float dtypes — a wider
+    grouped view needs a lane relayout on TPU that costs ~10x the copy).
+
+    The value-change rule made cheap: one elementwise pass that changes
+    every element every iteration with no arithmetic hazards (bit garbage
+    is fine for DMA-only chains).  The index is multiplied by the odd
+    Fibonacci-hash constant before the XOR — XOR-ing the bare index
+    self-cancels (x^0^1^2^3 = x: the payload returns to its exact
+    starting bits every 4 iterations, a cycle the content cache can
+    recognize), while the mixed sequence's running XOR never
+    short-cycles.  The key is forced odd, so the low bit always flips.
+
+    ``mantissa_only`` restricts the flips to the dtype's mantissa bits,
+    for chains whose values feed real arithmetic and must stay finite
+    (sign/exponent intact — no inf/NaN, bounded relative perturbation).
+    Churn's bandwidth cost is real: measure a churn-only chain alongside
+    and subtract (:func:`backout_pair`)."""
+    key = (i * jnp.int32(-1640531527)) | 1  # 0x9E3779B9, forced odd
+    if mantissa_only:
+        key = (key & _CHURN_MANTISSA[x.dtype.itemsize]) | 1
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        return x ^ key.astype(x.dtype)
+    u = _CHURN_UINT[x.dtype.itemsize]
+    bits = jax.lax.bitcast_convert_type(x, u) ^ key.astype(u)
+    return jax.lax.bitcast_convert_type(bits, x.dtype)
+
+
+def churn_barrier(x, i, extra_key=0):
+    """Mantissa churn through an int32-GROUPED bitcast view: pairs of bf16
+    lanes pack into 32-bit lanes, which forces a full lane relayout on TPU
+    — deliberately expensive (~10x a copy pass), because the relayout is
+    the strongest compute-serializing barrier we have found on the tunnel
+    backend.
+
+    Chains of MXU work need it: TPU pipelines consecutive kernels'
+    tiles enough that a bare matmul chain reads 200-220 "TFLOPS" (above
+    the 197 peak — physically impossible) and a same-width churn chain
+    still trips the XLA-dot ceiling guard; with this barrier between
+    iterations the AG-GEMM chain reads 143-153 TFLOPS (median-of-three
+    seed banks, ±3% across processes), the only protocol variant that is
+    both stable and below the measured ceiling (docs/perf.md protocol
+    history).  Only the
+    mantissa bits of each half flip (mask 0x007F007F) so values stay
+    finite for downstream matmuls.  Its large bandwidth cost makes the
+    backout twin chain (:func:`backout_pair`) mandatory.
+
+    ``extra_key`` folds a data-dependent scalar (e.g. a sampled-tile
+    probe sum) into the key for full-tensor serialization."""
+    key = ((i ^ extra_key) * jnp.int32(-1640531527)) & 0x007F007F | 1
+    assert x.dtype.itemsize == 2, "barrier churn packs 2-byte lanes"
+    v = x.reshape(*x.shape[:-1], x.shape[-1] // 2, 2)
+    bits = jax.lax.bitcast_convert_type(v, jnp.int32) ^ key
+    return jax.lax.bitcast_convert_type(bits, x.dtype).reshape(x.shape)
+
+
+def backout_pair(chains, fresh_input, n_extra, trials=9):
+    """Measure a work chain against its churn-only twin in ONE rotated
+    trial loop and return ``(total - churn, churn)`` median seconds/step.
+
+    chains: {"total": (short, long, extra), "churn": (short, long, extra)}.
+    Interleaving is required: the tunnel drifts ±10% across minutes, and
+    separately-looped churn/total measurements produce negative floors
+    after subtraction.  Warms every chain with ``fresh_input(-1)`` — an
+    input no trial reuses (warming with trial 0's input makes trial 0 a
+    repeat (executable, args) pair, which the tunnel elides)."""
+    x_warm = fresh_input(-1)
+    jax.block_until_ready(x_warm)
+    for short, long, extra in chains.values():
+        float(short(x_warm, *extra))
+        float(long(x_warm, *extra))
+    res = rotated_paired_bench(chains, fresh_input, n_extra=n_extra,
+                               trials=trials)
+    return res["total"][0] - res["churn"][0], res["churn"][0]
 
 
 def rotated_paired_bench(chains, fresh_input, n_extra, trials=9):

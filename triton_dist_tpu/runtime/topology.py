@@ -87,6 +87,24 @@ def peak_bf16_tflops() -> float:
     return detect_topology().bf16_tflops
 
 
+# Best *measured* dense-dot TFLOPS on each chip kind at the bench shape
+# (M=8192 K=8192 N=3584 bf16; docs/perf.md "AG-GEMM").  bench.py uses this
+# as a self-consistency bound: no honest chain that also pays AG dispatch
+# can beat XLA's own dense dot on the same chip at the same shape, so any
+# reading above it is elision/tunnel contamination, not performance.
+_MEASURED_DOT_CEILING = {"v5e": 189.7, "v5 lite": 189.7}
+
+
+def measured_dot_ceiling_tflops() -> float:
+    """Measured XLA-dot ceiling for this chip kind (bench shape), falling
+    back to 0.97x peak for chip kinds never measured on the tunnel."""
+    kind = device_kind().lower()
+    for sub, v in _MEASURED_DOT_CEILING.items():
+        if sub in kind:
+            return v
+    return 0.97 * peak_bf16_tflops()
+
+
 def hbm_bandwidth_gbps() -> float:
     return detect_topology().hbm_gbps
 
