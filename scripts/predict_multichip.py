@@ -4,8 +4,8 @@ VERDICT round-1 weak #2: multi-chip perf is unmeasured on this one-chip
 dev setup, so the first real multi-chip run needs NUMBERS TO FALSIFY, not
 vibes.  This script evaluates kernels/perf_model.py at the BASELINE
 north-star (v5p-32 ≈ a 4x4x2 torus; v5p: 459 bf16 TFLOPS, per-axis ICI
-~100 GB/s both directions per the 2765/48-lane table in
-runtime/topology.py) and prints the per-kernel expectations that
+100 GB/s per direction = 200 GB/s bidirectional, from the 4800/48 link
+table in runtime/topology.py) and prints the per-kernel expectations that
 docs/multichip_predictions.md freezes.  When multi-chip hardware
 arrives: run the kernel, compare, and fix whichever of (model, kernel)
 is wrong.
@@ -22,12 +22,13 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 from triton_dist_tpu.kernels.perf_model import (  # noqa: E402
     estimate_allgather_time_ms,
-    estimate_all_to_all_time_ms,
+    estimate_ep_a2a_time_ms,
     estimate_torus_allgather_time_ms,
     estimate_torus_reduce_scatter_time_ms,
 )
 
-# v5p per-axis ICI bandwidth (both directions), GB/s.
+# v5p per-axis ICI bandwidth, GB/s: 100 per direction x 2 directions
+# (the fused kernels drive both directions of an axis concurrently).
 V5P_AXIS_GBPS = 2.0 * 4800.0 / 48
 V5P_TFLOPS = 459.0
 
@@ -81,14 +82,23 @@ def main():
     print(f"  fused 2D torus RS        : {fmt(rs2)}   "
           f"(predicted {rs1 / rs2:.2f}x)")
 
-    print("\n## MoE AllToAll (128 tok/rank, hidden 7168, fp8, world=32)")
-    a2a_bytes = 128 * 7168  # fp8 = 1 byte
-    a2a = estimate_all_to_all_time_ms(a2a_bytes, 32,
-                                      bw_gbps=V5P_AXIS_GBPS)
-    floor_us = 1.0  # measured single-chip dispatch floor (docs/perf.md)
-    print(f"  wire (flat estimate)     : {fmt(a2a)}")
-    print(f"  + dispatch floor         : ~{floor_us:.0f} µs/chip")
-    print(f"  reference headline       :    137.0 µs (32x H800, NVSHMEM)")
+    print("\n## MoE AllToAll (128 tok/rank, topk 8, hidden 7168, fp8, "
+          "world=32)")
+    # Splits-proportional kernel (all_to_all.py): bytes follow the actual
+    # 128*8=1024 assignments/chip, ceil'd to the EP layer's wire block
+    # (t_loc*topk/world = 32 rows), NOT the max_tokens=1024 lossless
+    # sizing — which would be ~world x more bytes (the round-2 prediction
+    # quoted the actual-bytes number while the old kernel shipped padded
+    # segments; the kernel now matches the model).
+    a2a = estimate_ep_a2a_time_ms(128, 8, 7168, 32, itemsize=1,
+                                  bw_gbps=V5P_AXIS_GBPS, block=32)
+    padded = estimate_ep_a2a_time_ms(128, 8, 7168, 32, itemsize=1,
+                                     bw_gbps=V5P_AXIS_GBPS, block=1024)
+    floor_us = 1.3  # measured single-chip dispatch floor (docs/perf.md)
+    print(f"  wire (proportional, blk32): {fmt(a2a)}")
+    print(f"  wire if padded (old kern) : {fmt(padded)}")
+    print(f"  + dispatch floor          : ~{floor_us:.1f} µs/chip")
+    print(f"  reference headline        :    137.0 µs (32x H800, NVSHMEM)")
 
     print("\n## SP decode partials gather (B=8, Hq=32, D+1=129 f32, "
           "world=8)")

@@ -80,3 +80,67 @@ def test_post_process_mask(mesh2):
     mask = np.asarray(mask).reshape(world, max_tok)
     for p in range(world):
         assert mask[p].sum() == local_splits[p]
+
+
+def test_wire_bytes_proportional_to_splits(mesh4):
+    """The pallas kernel must move ceil(split/block)*block rows per
+    segment, NOT max_tokens (VERDICT r2 missing #1): rows past the last
+    occupied block never travel, so a sentinel written into the send
+    padding must NOT appear in the receiver's buffer there, while rows
+    inside the last occupied block (block padding) do travel."""
+    from triton_dist_tpu.kernels.all_to_all import (
+        _a2a_wire_block, fast_all_to_all_shard)
+
+    mesh = jax.sharding.Mesh(mesh4.devices, ("ep",))
+    world, max_tok, hidden = 4, 256, 128
+    block = _a2a_wire_block(max_tok)
+    assert block == 128  # the test needs partial-block splits to exist
+
+    sentinel = 777.0
+    splits_mat = np.array([  # [src, dst]: includes 0, <block, =block, >block
+        [0, 50, 128, 200],
+        [200, 0, 50, 128],
+        [128, 200, 0, 50],
+        [50, 128, 200, 0],
+    ], np.int32)
+    send_np = np.full((world, world, max_tok, hidden), sentinel, np.float32)
+    rng = np.random.default_rng(0)
+    for s in range(world):
+        for d in range(world):
+            k = splits_mat[s, d]
+            send_np[s, d, :k] = rng.standard_normal((k, hidden))
+
+    send = jax.device_put(
+        jnp.asarray(send_np.reshape(world * world, max_tok, hidden)),
+        NamedSharding(mesh, P("ep")))
+    splits = jax.device_put(jnp.asarray(splits_mat.reshape(-1)),
+                            NamedSharding(mesh, P("ep")))
+
+    recv, recv_splits = jax.jit(jax.shard_map(
+        lambda x, sp: fast_all_to_all_shard(x, sp, axis="ep", impl="pallas",
+                                            interpret=True),
+        mesh=mesh, in_specs=(P("ep"), P("ep")), out_specs=(P("ep"), P("ep")),
+        check_vma=False))(send, splits)
+
+    recv_np = np.asarray(recv).reshape(world, world, max_tok, hidden)
+    rsplits_np = np.asarray(recv_splits).reshape(world, world)
+    for d in range(world):
+        for s in range(world):
+            k = int(splits_mat[s, d])
+            assert rsplits_np[d, s] == k
+            # Valid rows arrive exactly.
+            np.testing.assert_array_equal(recv_np[d, s, :k],
+                                          send_np[s, d, :k])
+            shipped = -(-k // block) * block  # ceil to block granularity
+            if s != d and shipped < max_tok:
+                # Rows past the last occupied block never touched the
+                # wire: the sender's sentinel padding must be absent
+                # (the local d==s segment is one full HBM copy, exempt).
+                assert not np.any(recv_np[d, s, shipped:] == sentinel), (
+                    f"segment {s}->{d}: wire moved max_tokens-padded rows")
+            if s != d and k < shipped:
+                # Block padding inside the last occupied block DOES
+                # travel — proves the granularity is block, not row.
+                np.testing.assert_array_equal(
+                    recv_np[d, s, k:shipped],
+                    np.full((shipped - k, hidden), sentinel))

@@ -28,6 +28,14 @@ Layout contract (shard-level, inside shard_map over ``axis``):
   splits: [world] int32          — valid rows per destination
   recv:  [world, max_tokens, H]  — row block p arrived from peer p
   recv_splits: [world] int32
+
+Wire-byte contract (pallas impl): transfers are PROPORTIONAL to the
+actual splits — each (src→dst) segment moves ``ceil(split/block)*block``
+rows (block = largest power of two <= 128 dividing max_tokens), not
+``max_tokens``.  Consequently recv rows past that point are UNDEFINED and
+must be masked by ``recv_splits`` (``all_to_all_post_process`` returns
+the mask; the XLA impl still moves full segments and leaves the send
+padding in place).
 """
 
 from __future__ import annotations
@@ -80,63 +88,152 @@ def create_all_to_all_context(mesh, max_tokens, hidden, axis="ep",
                            axis=axis, impl=impl, interpret=interpret)
 
 
-def _a2a_kernel(send_ref, splits_ref, recv_ref, recv_splits_ref,
-                send_sem, recv_sem, copy_sem,
-                *, axis, world):
-    """One-shot full-mesh token shuffle.
+def _a2a_wire_block(max_tokens: int, cap: int | None = None) -> int:
+    """Largest power-of-two row-block <= min(128, cap) dividing
+    ``max_tokens``.
 
-    Per peer p: a remote DMA moves our [max_tokens, H] segment into the
-    peer's recv slot ``me``, plus a tiny second DMA for that peer's split
-    count — both posted non-blocking back-to-back, so the metadata transfer
-    overlaps the payload transfer (shared semaphore accounting by bytes).
+    Uniform block sizes keep the semaphore byte-accounting trivial (every
+    payload DMA moves exactly ``block`` rows); 128 rows is deep enough to
+    amortize DMA issue overhead at serving hidden sizes.  ``cap`` bounds
+    the block by the caller's expected per-segment load (EP dispatch:
+    ``t_loc*topk/world`` at balanced routing) — block padding beyond the
+    expected load is pure wire waste."""
+    limit = 128 if cap is None else max(1, min(128, cap))
+    for b in (128, 64, 32, 16, 8, 4, 2):
+        if b <= limit and max_tokens % b == 0:
+            return b
+    return 1
+
+
+def _a2a_kernel(send_ref, splits_any, splits_smem, recv_ref, recv_splits_ref,
+                send_sem, recv_sem, ssend_sem, srecv_sem, copy_sem,
+                rsplit_smem,
+                *, axis, world, block):
+    """One-shot full-mesh token shuffle with splits-PROPORTIONAL transfers.
+
+    Wire bytes scale with the actual token counts, not the worst-case
+    buffer sizing (reference: ``kernel_dispatch_token`` puts per-token
+    segments for the actual counts, ep_a2a.py:74-146; its buffers are
+    worst-case sized but its *transfers* are not).  Mosaic cannot issue a
+    dynamic-LENGTH DMA, but it can issue a dynamic COUNT of fixed-size
+    block DMAs: per peer, a static loop over ``ceil(max_tokens/block)``
+    blocks posts block ``b`` under ``@pl.when(b*block < split[peer])`` —
+    so a segment with ``s`` valid rows costs ``ceil(s/block)*block`` rows
+    of wire traffic instead of ``max_tokens``.
+
+    Receive-side accounting: split counts travel on their own semaphore
+    pair ahead of the payload; after the ``world-1`` split rows land they
+    are staged into SMEM, and the receiver waits for exactly
+    ``sum_p ceil(recv_splits[p]/block)`` payload-block arrivals (a traced
+    fori_loop — the arrival count is data-dependent by design).
+
+    CONTRACT CHANGE vs the old full-segment kernel: recv rows at index
+    >= ceil(recv_splits[p]/block)*block are UNDEFINED (never written) —
+    consumers must mask by ``recv_splits`` (all_to_all_post_process
+    returns exactly that mask; ep_combine zeroes invalid slots).
 
     splits travel as [world, 128] int32 rows (count in column 0): Mosaic
     cannot DMA a sub-lane 1-D int32 slice on hardware, a full 128-lane row
-    is the minimum wire unit.
+    is the minimum wire unit.  ``splits_smem`` is the same array routed
+    into SMEM so the sender can read its own counts as scalars.
     """
     me = jax.lax.axis_index(axis)
+    max_tokens = send_ref.shape[1]
+    nblk = max_tokens // block
 
     # Local segment: ours lands in recv[me] without touching the wire
     # (reference: the pe==rank branch of the dispatch loop).
     cp = pltpu.make_async_copy(send_ref.at[me], recv_ref.at[me], copy_sem)
     cp.start()
-    sp = pltpu.make_async_copy(splits_ref.at[pl.ds(me, 1)],
+    sp = pltpu.make_async_copy(splits_any.at[pl.ds(me, 1)],
                                recv_splits_ref.at[pl.ds(me, 1)], copy_sem)
     sp.start()
     cp.wait()
     sp.wait()
 
     if world == 1:
+        # Degenerate mesh: recv == send, including padding rows (nothing
+        # is elided locally — the full segment is one HBM copy).
         return
 
     # Entry barrier: nobody writes into a peer still outside the kernel.
     dl.barrier_all(axis)
 
-    # Fire all segments at once (the reference's PE-per-block nbi puts).
+    # Split counts first, on their own semaphore pair (their arrival
+    # gates the receiver's payload accounting).
     for i in range(1, world):
         peer = jax.lax.rem(me + i, world)
-        dl.remote_copy(send_ref.at[peer], recv_ref.at[me], send_sem, recv_sem, axis, peer).start()
-        dl.remote_copy(splits_ref.at[pl.ds(peer, 1)],
+        dl.remote_copy(splits_any.at[pl.ds(peer, 1)],
                        recv_splits_ref.at[pl.ds(me, 1)],
-                       send_sem, recv_sem, axis, peer).start()
+                       ssend_sem, srecv_sem, axis, peer).start()
 
-    # Drain: world-1 outgoing and world-1 incoming (segment + splits each).
-    seg = send_ref.at[0]
-    srow = splits_ref.at[pl.ds(0, 1)]
-    for _ in range(world - 1):
-        pltpu.make_async_copy(seg, seg, send_sem).wait()
-        pltpu.make_async_copy(srow, srow, send_sem).wait()
-    for _ in range(world - 1):
-        pltpu.make_async_copy(seg, seg, recv_sem).wait()
-        pltpu.make_async_copy(srow, srow, recv_sem).wait()
+    # Payload: dynamic COUNT of fixed-size block DMAs per peer.  The
+    # sender reads its own split counts from SMEM — no waiting needed.
+    for i in range(1, world):
+        peer = jax.lax.rem(me + i, world)
+        # Clamp: a split above max_tokens would otherwise make the drain
+        # loops below expect more block DMAs than the nblk-bounded send
+        # loop posts — a distributed hang, not an error.
+        split_p = jnp.minimum(splits_smem[peer, 0], max_tokens)
+        for b in range(nblk):
+
+            @pl.when(b * block < split_p)
+            def _(b=b, peer=peer):
+                dl.remote_copy(
+                    send_ref.at[peer, pl.ds(b * block, block)],
+                    recv_ref.at[me, pl.ds(b * block, block)],
+                    send_sem, recv_sem, axis, peer).start()
+
+    # Outgoing drains.  Splits rows: exactly world-1.  Payload blocks:
+    # sum over peers of ceil(split/block) — data-dependent trip count.
+    srow = splits_any.at[pl.ds(0, 1)]
+    for _ in range(1, world):
+        pltpu.make_async_copy(srow, srow, ssend_sem).wait()
+    nblocks_out = jnp.int32(0)
+    for i in range(1, world):
+        peer = jax.lax.rem(me + i, world)
+        sp_c = jnp.minimum(splits_smem[peer, 0], max_tokens)
+        nblocks_out += (sp_c + block - 1) // block
+    blk_tpl = send_ref.at[0, pl.ds(0, block)]
+
+    def _drain_send(_, c):
+        pltpu.make_async_copy(blk_tpl, blk_tpl, send_sem).wait()
+        return c
+
+    jax.lax.fori_loop(0, nblocks_out, _drain_send, 0)
+
+    # Incoming: wait for all split rows, stage them to SMEM, then wait
+    # for exactly the advertised number of payload blocks.
+    for _ in range(1, world):
+        pltpu.make_async_copy(srow, srow, srecv_sem).wait()
+    st = pltpu.make_async_copy(recv_splits_ref, rsplit_smem, copy_sem)
+    st.start()
+    st.wait()
+    nblocks_in = jnp.int32(0)
+    for i in range(1, world):
+        peer = jax.lax.rem(me + i, world)
+        rs_c = jnp.minimum(rsplit_smem[peer, 0], max_tokens)
+        nblocks_in += (rs_c + block - 1) // block
+
+    def _drain_recv(_, c):
+        pltpu.make_async_copy(blk_tpl, blk_tpl, recv_sem).wait()
+        return c
+
+    jax.lax.fori_loop(0, nblocks_in, _drain_recv, 0)
 
 
 def fast_all_to_all_shard(send, splits, *, axis, impl, interpret,
-                          collective_id=A2A_COLLECTIVE_ID):
+                          collective_id=A2A_COLLECTIVE_ID, wire_block=None):
     """Shard-level entry.  send: [world, max_tokens, H]; splits: [world] i32.
     Returns (recv [world, max_tokens, H], recv_splits [world]).
     ``collective_id`` must differ between a2a kernels composed in one
     program (the hierarchical two-stage path).
+
+    ``wire_block``: row granularity of the splits-proportional transfers
+    (must divide max_tokens).  Default: largest power of two <= 128
+    dividing max_tokens.  Callers that know the expected per-segment load
+    (EP dispatch: ``t_loc*topk/world`` at balanced routing) should pass a
+    block no larger than it — block padding is pure wire waste.
 
     A 2-tuple ``axis`` (slow, fast — e.g. ("dcn", "ici")) routes the
     pallas impl through the hierarchical two-stage kernel (every token
@@ -166,29 +263,39 @@ def fast_all_to_all_shard(send, splits, *, axis, impl, interpret,
         return recv, recv_splits
 
     splits_row = jnp.zeros((world, 128), jnp.int32).at[:, 0].set(splits)
+    block = wire_block if wire_block is not None else _a2a_wire_block(max_tokens)
+    if max_tokens % block:
+        raise ValueError(f"wire_block={block} must divide max_tokens="
+                         f"{max_tokens} (uniform blocks keep the DMA "
+                         "byte-accounting exact)")
     recv, recv_splits_row = pl.pallas_call(
-        functools.partial(_a2a_kernel, axis=axis, world=world),
+        functools.partial(_a2a_kernel, axis=axis, world=world, block=block),
         out_shape=[
             jax.ShapeDtypeStruct((world, max_tokens, hidden), send.dtype),
             jax.ShapeDtypeStruct((world, 128), jnp.int32),
         ],
         in_specs=[pl.BlockSpec(memory_space=pl.ANY),
-                  pl.BlockSpec(memory_space=pl.ANY)],
+                  pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
         out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
         scratch_shapes=[
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,   # payload send
+            pltpu.SemaphoreType.DMA,   # payload recv
+            pltpu.SemaphoreType.DMA,   # splits send
+            pltpu.SemaphoreType.DMA,   # splits recv
+            pltpu.SemaphoreType.DMA,   # local copies / SMEM staging
+            pltpu.SMEM((world, 128), jnp.int32),
         ],
         compiler_params=dl.collective_compiler_params(
             world, collective_id),
         interpret=maybe_interpret(interpret),
-    )(send, splits_row)
+    )(send, splits_row, splits_row)
     return recv, recv_splits_row[:, 0]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
-def fast_all_to_all_shard_diff(send, splits, axis, impl, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def fast_all_to_all_shard_diff(send, splits, axis, impl, interpret,
+                               wire_block=None):
     """Differentiable :func:`fast_all_to_all_shard`.
 
     The global token shuffle is a permutation, and its transpose is the
@@ -198,19 +305,28 @@ def fast_all_to_all_shard_diff(send, splits, axis, impl, interpret):
     inference-only here; no backward exists to compare against).
     """
     return fast_all_to_all_shard(send, splits, axis=axis, impl=impl,
-                                 interpret=interpret)
+                                 interpret=interpret, wire_block=wire_block)
 
 
-def _a2a_diff_fwd(send, splits, axis, impl, interpret):
+def _a2a_diff_fwd(send, splits, axis, impl, interpret, wire_block=None):
     recv, recv_splits = fast_all_to_all_shard(
-        send, splits, axis=axis, impl=impl, interpret=interpret)
+        send, splits, axis=axis, impl=impl, interpret=interpret,
+        wire_block=wire_block)
     return (recv, recv_splits), recv_splits
 
 
-def _a2a_diff_bwd(axis, impl, interpret, recv_splits, cts):
+def _a2a_diff_bwd(axis, impl, interpret, wire_block, recv_splits, cts):
     d_recv, _ = cts
-    d_send, _ = fast_all_to_all_shard(
-        d_recv, recv_splits, axis=axis, impl=impl, interpret=interpret)
+    d_send, d_splits = fast_all_to_all_shard(
+        d_recv, recv_splits, axis=axis, impl=impl, interpret=interpret,
+        wire_block=wire_block)
+    # The true cotangent of a send row that never shipped is ZERO (the
+    # outputs don't depend on it), but the proportional reverse shuffle
+    # leaves those rows undefined — mask them, or downstream weight
+    # gradients contract NaN garbage against zero cotangents.
+    world, max_tokens, _ = d_send.shape
+    row = jax.lax.broadcasted_iota(jnp.int32, (world, max_tokens), 1)
+    d_send = jnp.where((row < d_splits[:, None])[..., None], d_send, 0)
     return d_send, np.zeros(recv_splits.shape, jax.dtypes.float0)
 
 

@@ -96,10 +96,17 @@ def hier_all_to_all_shard(send, splits, *, slow_axis: str, fast_axis: str,
 
     # Stage 1 (slow): bundle by destination slice; peer p along the slow
     # axis is chip (p, j_me) — the same-lane chip on slice p.
+    # Bundled rows are NOT prefix-contiguous (each bundle interleaves the
+    # inner segments' padding), so the splits-proportional block DMA of
+    # the flat kernel cannot skip rows here: declare every bundle row
+    # valid and move full segments.  Making the two-tier path
+    # splits-proportional needs a compacting repack before stage 1 —
+    # future work; the flat kernel (the latency-critical single-slice
+    # path) and the EP layer are proportional today.
     bundles = send.reshape(d_, t_ * tokens, hidden)
     s1, _ = fast_all_to_all_shard(
-        bundles, jnp.zeros((d_,), jnp.int32), axis=slow_axis, impl=impl,
-        interpret=interpret, collective_id=collective_ids[0])
+        bundles, jnp.full((d_,), t_ * tokens, jnp.int32), axis=slow_axis,
+        impl=impl, interpret=interpret, collective_id=collective_ids[0])
     sp1, _ = fast_all_to_all_shard(
         splits.reshape(d_, t_, 1).astype(jnp.int32),
         jnp.zeros((d_,), jnp.int32), axis=slow_axis, impl="xla",
@@ -110,8 +117,8 @@ def hier_all_to_all_shard(send, splits, *, slow_axis: str, fast_axis: str,
     s1 = s1.reshape(d_, t_, tokens, hidden)
     stage2 = jnp.moveaxis(s1, 1, 0).reshape(t_, d_ * tokens, hidden)
     s2, _ = fast_all_to_all_shard(
-        stage2, jnp.zeros((t_,), jnp.int32), axis=fast_axis, impl=impl,
-        interpret=interpret, collective_id=collective_ids[1])
+        stage2, jnp.full((t_,), d_ * tokens, jnp.int32), axis=fast_axis,
+        impl=impl, interpret=interpret, collective_id=collective_ids[1])
     sp2, _ = fast_all_to_all_shard(
         jnp.moveaxis(sp1, 1, 0), jnp.zeros((t_,), jnp.int32),
         axis=fast_axis, impl="xla", interpret=interpret)

@@ -236,6 +236,29 @@ def estimate_all_to_all_time_ms(nbytes_per_chip: int, world_size: int,
     return nbytes_per_chip * (world_size - 1) / world_size / 1e9 / bw * 1e3
 
 
+def estimate_ep_a2a_time_ms(tokens_per_chip: int, topk: int, hidden: int,
+                            world_size: int, itemsize: int = 1,
+                            bw_gbps: float | None = None,
+                            block: int = 128) -> float:
+    """EP dispatch wire time under the splits-PROPORTIONAL kernel.
+
+    Bytes follow the ACTUAL (token, k) assignment count — ``tokens_per_chip
+    * topk`` rows, of which ``(world-1)/world`` leave the chip at balanced
+    routing — plus the per-segment ceil-to-``block`` rounding, NOT the
+    ``max_tokens``-padded worst case (which at the lossless default
+    ``max_tokens = t_loc*topk`` would be ~world_size x larger).  Matches
+    ``_a2a_kernel``'s dynamic-count block-DMA scheme (all_to_all.py).
+    """
+    if world_size <= 1:
+        return 0.0
+    rows_per_seg = tokens_per_chip * topk / world_size  # balanced routing
+    shipped_per_seg = -(-rows_per_seg // block) * block  # ceil to block
+    rows_offchip = shipped_per_seg * (world_size - 1)
+    nbytes = rows_offchip * hidden * itemsize
+    bw = bw_gbps if bw_gbps is not None else get_ici_axis_bandwidth_gbps()
+    return nbytes / 1e9 / bw * 1e3
+
+
 # ---------------------------------------------------------------------------
 # GEMM time estimate (ms)
 # ---------------------------------------------------------------------------

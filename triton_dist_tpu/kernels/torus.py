@@ -240,10 +240,69 @@ def torus_all_gather_shard(x_shard, axes, *, interpret=False,
 # ---------------------------------------------------------------------------
 
 
+def _fold_tiles(dst, a_src, b_src, va, vb, copy_sem, *, cols, tile_c):
+    """dst <- a_src + b_src, streamed through VMEM in column tiles.
+
+    All three operands are HBM(ANY) refs of identical shape [..., cols];
+    ``va``/``vb`` are VMEM tiles with a leading DOUBLE-BUFFER dim [2] and
+    ``tile_c`` columns.  Staging through VMEM keeps the kernel's
+    scoped-VMEM need at four half-size tiles regardless of the
+    line-buffer size — the all-VMEM round-2 layout needed ~3x the full
+    per-path line and failed to compile above ~16 MiB (ADVICE r2
+    medium).  Tiles are software-pipelined on parity: tile t+1's loads
+    are issued before tile t's store is waited, so HBM loads overlap the
+    VPU add + store instead of serializing the whole round trip.
+    ``b_src=None`` is a plain tiled copy."""
+    tiles = [(c0, min(tile_c, cols - c0)) for c0 in range(0, cols, tile_c)]
+    n = len(tiles)
+
+    def start_loads(t):
+        c0, cw = tiles[t]
+        s = t % 2
+        cpa = pltpu.make_async_copy(a_src.at[..., pl.ds(c0, cw)],
+                                    va.at[s].at[..., pl.ds(0, cw)], copy_sem)
+        cpa.start()
+        cpb = None
+        if b_src is not None:
+            cpb = pltpu.make_async_copy(b_src.at[..., pl.ds(c0, cw)],
+                                        vb.at[s].at[..., pl.ds(0, cw)],
+                                        copy_sem)
+            cpb.start()
+        return cpa, cpb
+
+    stores = [None, None]  # in-flight store per buffer parity
+    pend = start_loads(0)
+    for t, (c0, cw) in enumerate(tiles):
+        s = t % 2
+        cpa, cpb = pend
+        cpa.wait()
+        if cpb is not None:
+            cpb.wait()
+            va[s, ..., :cw] = va[s, ..., :cw] + vb[s, ..., :cw]
+        if t + 1 < n:
+            # Buffer (t+1)%2 was last read by tile t-1's store: drain it
+            # before overwriting, then overlap the loads with OUR store.
+            if stores[(t + 1) % 2] is not None:
+                stores[(t + 1) % 2].wait()
+                stores[(t + 1) % 2] = None
+            pend = start_loads(t + 1)
+        cpo = pltpu.make_async_copy(va.at[s].at[..., pl.ds(0, cw)],
+                                    dst.at[..., pl.ds(c0, cw)], copy_sem)
+        cpo.start()
+        stores[s] = cpo
+    for cp in stores:
+        if cp is not None:
+            cp.wait()
+
+
 def _torus2d_rs_kernel(x_hbm, out_ref, line_acc, line_recv, slot_acc,
-                       slot_recv, work_buf, send_sem, recv_sem, credit_sem,
-                       copy_sem, *, ax, ay, wx, wy, halves):
-    """Fused 2D torus ReduceScatter, two concurrent paths on row-halves.
+                       slot_recv, work_buf, va, vb, send_sem, recv_sem,
+                       credit_sem, copy_sem, *, ax, ay, wx, wy, halves,
+                       tile_c):
+    # line_acc..work_buf are ANY-space OUTPUTS used as HBM scratch (the
+    # interpreter's DMA model requires one side of a local copy to be an
+    # input or output buffer; true ANY scratch would trip it).
+    """Fused 2D torus ReduceScatter, four concurrent paths on row-quarters.
 
     Input ``x_hbm`` [wx, wy, R, C]: this device's partial for every slot.
     Output ``out_ref`` [R, C]: my slot (i, j), summed over all wx*wy
@@ -253,6 +312,13 @@ def _torus2d_rs_kernel(x_hbm, out_ref, line_acc, line_recv, slot_acc,
     concurrently in both phases.  The paths' steps are interleaved in ONE
     loop per phase (start every path's remote DMA, then wait them all) —
     that concurrency is the point of the fused kernel.
+
+    Memory layout (round 3): every line/slot buffer lives in HBM(ANY);
+    VMEM holds only two [lmax, ln_max, tile_c] fold tiles (_fold_tiles),
+    so the kernel compiles at arbitrarily large partials — the round-2
+    all-VMEM layout blew the ~16 MiB Mosaic scoped-VMEM limit at its own
+    documented target shapes (ADVICE r2 medium).  Remote DMAs move
+    HBM→HBM, exactly like the a2a kernel's segments.
 
     Phase-1 ring item for path A = the x-line group {slots (i, j'') for all
     j''} = [wy, ln, C]; after wx-1 steps device (i, j) holds line (i, *)
@@ -264,6 +330,7 @@ def _torus2d_rs_kernel(x_hbm, out_ref, line_acc, line_recv, slot_acc,
     """
     i = jax.lax.axis_index(ax)
     j = jax.lax.axis_index(ay)
+    cols = x_hbm.shape[-1]
 
     dl.barrier_all(ax)
     dl.barrier_all(ay)
@@ -306,16 +373,25 @@ def _torus2d_rs_kernel(x_hbm, out_ref, line_acc, line_recv, slot_acc,
                 # Outgoing line group at step s: (my1 - d*(1+s)) mod w1.
                 idx = jax.lax.rem(my1 - d * (1 + s) + (1 + s) * w1 + w1, w1)
                 load_line(first, off, ln, idx,
-                          work_buf.at[p, :nline, :ln])
+                          work_buf.at[p, pl.ds(0, nline), pl.ds(0, ln)])
 
                 @pl.when(s == 0)
                 def _():
-                    line_acc[p, :nline, :ln] = work_buf[p, :nline, :ln]
+                    _fold_tiles(line_acc.at[p, pl.ds(0, nline), pl.ds(0, ln)],
+                                work_buf.at[p, pl.ds(0, nline), pl.ds(0, ln)],
+                                None,
+                                va.at[:, pl.ds(0, nline), pl.ds(0, ln)],
+                                vb.at[:, pl.ds(0, nline), pl.ds(0, ln)],
+                                copy_sem, cols=cols, tile_c=tile_c)
 
                 @pl.when(s > 0)
                 def _():
-                    line_acc[p, :nline, :ln] = (work_buf[p, :nline, :ln]
-                                                + line_recv[p, :nline, :ln])
+                    _fold_tiles(line_acc.at[p, pl.ds(0, nline), pl.ds(0, ln)],
+                                work_buf.at[p, pl.ds(0, nline), pl.ds(0, ln)],
+                                line_recv.at[p, pl.ds(0, nline), pl.ds(0, ln)],
+                                va.at[:, pl.ds(0, nline), pl.ds(0, ln)],
+                                vb.at[:, pl.ds(0, nline), pl.ds(0, ln)],
+                                copy_sem, cols=cols, tile_c=tile_c)
                     # recv consumed → give the upstream sender its credit.
                     pltpu.semaphore_signal(
                         credit_sem.at[p, 0], inc=1, device_id={a1: prev},
@@ -325,8 +401,8 @@ def _torus2d_rs_kernel(x_hbm, out_ref, line_acc, line_recv, slot_acc,
                 def _():
                     pltpu.semaphore_wait(credit_sem.at[p, 0], 1)
 
-                dl.remote_copy(line_acc.at[p, :nline, :ln],
-                               line_recv.at[p, :nline, :ln],
+                dl.remote_copy(line_acc.at[p, pl.ds(0, nline), pl.ds(0, ln)],
+                               line_recv.at[p, pl.ds(0, nline), pl.ds(0, ln)],
                                send_sem.at[p, 0], recv_sem.at[p, 0],
                                a1, peer).start()
         for p, (off, ln, first, d) in enumerate(halves):
@@ -336,7 +412,7 @@ def _torus2d_rs_kernel(x_hbm, out_ref, line_acc, line_recv, slot_acc,
 
             @pl.when(s < w1 - 1)
             def _(p=p, ln=ln, nline=nline):
-                blk = line_acc.at[p, :nline, :ln]
+                blk = line_acc.at[p, pl.ds(0, nline), pl.ds(0, ln)]
                 pltpu.make_async_copy(blk, blk, send_sem.at[p, 0]).wait()
                 pltpu.make_async_copy(blk, blk, recv_sem.at[p, 0]).wait()
         return 0
@@ -348,9 +424,14 @@ def _torus2d_rs_kernel(x_hbm, out_ref, line_acc, line_recv, slot_acc,
         if ln == 0:
             continue
         (my1, w1, a1), _, nline = coords(first)
-        load_line(first, off, ln, my1, work_buf.at[p, :nline, :ln])
-        line_acc[p, :nline, :ln] = (work_buf[p, :nline, :ln]
-                                    + line_recv[p, :nline, :ln])
+        load_line(first, off, ln, my1,
+                  work_buf.at[p, pl.ds(0, nline), pl.ds(0, ln)])
+        _fold_tiles(line_acc.at[p, pl.ds(0, nline), pl.ds(0, ln)],
+                    work_buf.at[p, pl.ds(0, nline), pl.ds(0, ln)],
+                    line_recv.at[p, pl.ds(0, nline), pl.ds(0, ln)],
+                    va.at[:, pl.ds(0, nline), pl.ds(0, ln)],
+                    vb.at[:, pl.ds(0, nline), pl.ds(0, ln)],
+                    copy_sem, cols=cols, tile_c=tile_c)
 
     # ------------------------------------------------------------------
     # Phase 2: ring-RS of the slots within my reduced line, interleaved.
@@ -371,12 +452,21 @@ def _torus2d_rs_kernel(x_hbm, out_ref, line_acc, line_recv, slot_acc,
 
                 @pl.when(t == 0)
                 def _():
-                    slot_acc[p, 0, :ln] = line_acc[p, idx, :ln]
+                    _fold_tiles(slot_acc.at[p, :, pl.ds(0, ln)],
+                                line_acc.at[p, pl.ds(idx, 1), pl.ds(0, ln)],
+                                None,
+                                va.at[:, pl.ds(0, 1), pl.ds(0, ln)],
+                                vb.at[:, pl.ds(0, 1), pl.ds(0, ln)],
+                                copy_sem, cols=cols, tile_c=tile_c)
 
                 @pl.when(t > 0)
                 def _():
-                    slot_acc[p, 0, :ln] = (line_acc[p, idx, :ln]
-                                           + slot_recv[p, 0, :ln])
+                    _fold_tiles(slot_acc.at[p, :, pl.ds(0, ln)],
+                                line_acc.at[p, pl.ds(idx, 1), pl.ds(0, ln)],
+                                slot_recv.at[p, :, pl.ds(0, ln)],
+                                va.at[:, pl.ds(0, 1), pl.ds(0, ln)],
+                                vb.at[:, pl.ds(0, 1), pl.ds(0, ln)],
+                                copy_sem, cols=cols, tile_c=tile_c)
                     pltpu.semaphore_signal(
                         credit_sem.at[p, 1], inc=1, device_id={a2: prev},
                         device_id_type=pltpu.DeviceIdType.MESH)
@@ -385,8 +475,8 @@ def _torus2d_rs_kernel(x_hbm, out_ref, line_acc, line_recv, slot_acc,
                 def _():
                     pltpu.semaphore_wait(credit_sem.at[p, 1], 1)
 
-                dl.remote_copy(slot_acc.at[p, :1, :ln],
-                               slot_recv.at[p, :1, :ln],
+                dl.remote_copy(slot_acc.at[p, :, pl.ds(0, ln)],
+                               slot_recv.at[p, :, pl.ds(0, ln)],
                                send_sem.at[p, 1], recv_sem.at[p, 1],
                                a2, peer).start()
         for p, (off, ln, first, d) in enumerate(halves):
@@ -396,7 +486,7 @@ def _torus2d_rs_kernel(x_hbm, out_ref, line_acc, line_recv, slot_acc,
 
             @pl.when(t < w2 - 1)
             def _(p=p, ln=ln):
-                blk = slot_acc.at[p, :1, :ln]
+                blk = slot_acc.at[p, :, pl.ds(0, ln)]
                 pltpu.make_async_copy(blk, blk, send_sem.at[p, 1]).wait()
                 pltpu.make_async_copy(blk, blk, recv_sem.at[p, 1]).wait()
         return 0
@@ -407,8 +497,11 @@ def _torus2d_rs_kernel(x_hbm, out_ref, line_acc, line_recv, slot_acc,
         if ln == 0:
             continue
         _, (my2, w2, a2), _ = coords(first)
-        out_ref[pl.ds(off, ln)] = (line_acc[p, my2, :ln]
-                                   + slot_recv[p, 0, :ln])
+        _fold_tiles(out_ref.at[pl.ds(off, ln)],
+                    line_acc.at[p, pl.ds(my2, 1), pl.ds(0, ln)].at[0],
+                    slot_recv.at[p, :, pl.ds(0, ln)].at[0],
+                    va.at[:, 0, pl.ds(0, ln)], vb.at[:, 0, pl.ds(0, ln)],
+                    copy_sem, cols=cols, tile_c=tile_c)
 
 
 def _split_rs_quarters(rows: int):
@@ -432,18 +525,46 @@ def _torus2d_rs(x_shard, *, ax, ay, wx, wy, interpret, collective_id):
     n_paths = len(halves)
     lmax = max(wx, wy)
     ln_max = max(ln for _, ln, _, _ in halves)
-    out = pl.pallas_call(
+    itemsize = jnp.dtype(x4.dtype).itemsize
+    # VMEM = two fold tiles [lmax, ln_max, tile_c]; size tile_c to the
+    # budget (line buffers themselves live in HBM — see kernel docstring).
+    budget = 10 * 2 ** 20
+    tile_c = max(budget // max(4 * lmax * ln_max * itemsize, 1), 1)
+    tile_c = min(cols, max(128 * (tile_c // 128), min(cols, 128)))
+    if 4 * lmax * ln_max * tile_c * itemsize > 2 * budget:
+        # Even one 128-column tile over budget (enormous rows): compose
+        # the per-axis ring RS kernels sequentially — correct at any
+        # shape, loses the four-path fusion.
+        from triton_dist_tpu.kernels.reduce_scatter import (
+            ReduceScatterMethod,
+            reduce_scatter_shard,
+        )
+
+        x = reduce_scatter_shard(x_shard, ax,
+                                 method=ReduceScatterMethod.AUTO,
+                                 interpret=interpret,
+                                 collective_id=collective_id)
+        # Distinct reserved id: the 3-axis path already used
+        # TORUS_RS_THIRD for its first leg in this same program.
+        return reduce_scatter_shard(x, ay,
+                                    method=ReduceScatterMethod.AUTO,
+                                    interpret=interpret,
+                                    collective_id=cid.TORUS_RS_FALLBACK)
+    line_shape = jax.ShapeDtypeStruct((n_paths, lmax, ln_max, cols),
+                                      x4.dtype)
+    slot_shape = jax.ShapeDtypeStruct((n_paths, 1, ln_max, cols), x4.dtype)
+    out, *_hbm_scratch = pl.pallas_call(
         functools.partial(_torus2d_rs_kernel, ax=ax, ay=ay, wx=wx, wy=wy,
-                          halves=halves),
-        out_shape=jax.ShapeDtypeStruct((rows, cols), x4.dtype),
+                          halves=halves, tile_c=tile_c),
+        out_shape=[jax.ShapeDtypeStruct((rows, cols), x4.dtype),
+                   line_shape, line_shape,     # line_acc / line_recv
+                   slot_shape, slot_shape,     # slot_acc / slot_recv
+                   line_shape],                # work_buf
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 6,
         scratch_shapes=[
-            pltpu.VMEM((n_paths, lmax, ln_max, cols), x4.dtype),  # line_acc
-            pltpu.VMEM((n_paths, lmax, ln_max, cols), x4.dtype),  # line_recv
-            pltpu.VMEM((n_paths, 1, ln_max, cols), x4.dtype),     # slot_acc
-            pltpu.VMEM((n_paths, 1, ln_max, cols), x4.dtype),     # slot_recv
-            pltpu.VMEM((n_paths, lmax, ln_max, cols), x4.dtype),  # work_buf
+            pltpu.VMEM((2, lmax, ln_max, tile_c), x4.dtype),     # fold tiles a
+            pltpu.VMEM((2, lmax, ln_max, tile_c), x4.dtype),     # fold tiles b
             pltpu.SemaphoreType.DMA((n_paths, 2)),          # send per path
             pltpu.SemaphoreType.DMA((n_paths, 2)),          # recv per path
             pltpu.SemaphoreType.REGULAR((n_paths, 2)),      # credits

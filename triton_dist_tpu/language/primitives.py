@@ -120,8 +120,7 @@ def putmem_signal(src_ref, dst_ref, send_sem, recv_sem, axis, device_id):
     return putmem(src_ref, dst_ref, send_sem, recv_sem, axis, device_id)
 
 
-def getmem(src_ref, dst_ref, send_sem, recv_sem, axis, device_id=None, *,
-           offset=None):
+def getmem(src_ref, dst_ref, send_sem, recv_sem, axis, *, offset):
     """Non-blocking pull: ``src_ref`` AS HELD BY the peer → local
     ``dst_ref`` (reference: ``getmem_nbi_block``; pull-style AG variants,
     allgather.py full-mesh *pull*).
@@ -132,45 +131,28 @@ def getmem(src_ref, dst_ref, send_sem, recv_sem, axis, device_id=None, *,
     (or ``wait_arrival`` on ``recv_sem``) observes the data that lands
     locally, exactly like a completed get.
 
-    **Preferred addressing — ``offset``**: a CONCRETE Python int ``k``
-    meaning "pull from ``(me + k) mod world``".  This form is safe by
+    Addressing is ``offset`` ONLY: a CONCRETE Python int ``k`` meaning
+    "pull from ``(me + k) mod world``".  This form is safe by
     construction (the mirror peer is exactly ``me - k``) and covers every
-    use in the reference (ring neighbors, fixed strides).
-
-    **Legacy addressing — ``device_id``**: a traced expression of
-    ``rank(axis)`` (e.g. ``me - 1``); the mirror is ``2*me - device_id``.
-    Valid ONLY for rank-relative expressions ``me ± k`` — a concrete
-    (rank-invariant) value is rejected at trace time, because the
-    "everyone pulls rank 0" idiom cannot be mirrored into a push (use
-    ``broadcast``/``putmem`` from the owner instead).  The check is
-    best-effort: a traced-but-rank-invariant value (e.g. a replicated
-    routing-table entry) passes it and silently lands wrong shards —
-    which is why ``offset`` is the recommended API.
+    use in the reference (ring neighbors, fixed strides).  The retired
+    traced ``device_id=`` form could not be validated — a
+    traced-but-rank-invariant expression (e.g. a replicated routing-table
+    entry) passed its best-effort guard and silently landed wrong shards
+    (round-2 VERDICT weak #5).  A uniform "everyone pulls rank r" idiom
+    cannot be mirrored into a push at all — use ``broadcast``/``putmem``
+    from the owning rank instead.
     """
     me = jax.lax.axis_index(axis)
     world = jax.lax.axis_size(axis)
-    if (offset is None) == (device_id is None):
-        raise TypeError("getmem takes exactly one of offset= (preferred, "
-                        "a concrete relative int) or device_id= (a traced "
-                        "rank-relative expression)")
-    if offset is not None:
-        if isinstance(offset, jax.core.Tracer):
-            raise ValueError(
-                "getmem offset= must be a concrete Python int (the safe, "
-                "statically rank-relative form); for traced expressions "
-                "use device_id= and read its caveats")
-        offset %= world  # any magnitude/sign normalizes (world is static)
-        mirror = jax.lax.rem(me - offset + 2 * world, world)
-    else:
-        if not isinstance(device_id, jax.core.Tracer):
-            raise ValueError(
-                "getmem supports only rank-relative device_id (an "
-                f"expression of rank(axis), e.g. me - 1); got concrete "
-                f"{device_id!r}, which is the same on every rank. A "
-                "uniform broadcast-style pull cannot be mirrored into a "
-                "push — use broadcast/putmem from the owning rank instead. "
-                "(Prefer the offset= form, which is safe by construction.)")
-        mirror = jax.lax.rem(2 * me - device_id + 2 * world, world)
+    if isinstance(offset, jax.core.Tracer):
+        raise TypeError(
+            "getmem offset= must be a concrete Python int (the statically "
+            "rank-relative form, safe by construction).  Traced peer "
+            "expressions are not supported: a traced-but-rank-invariant "
+            "value cannot be mirrored into a push and silently lands wrong "
+            "shards — restructure as broadcast/putmem from the owner.")
+    offset %= world  # any magnitude/sign normalizes (world is static)
+    mirror = jax.lax.rem(me - offset + 2 * world, world)
     cp = remote_copy(src_ref, dst_ref, send_sem, recv_sem, axis, mirror)
     cp.start()
     return cp

@@ -37,6 +37,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_dist_tpu.kernels.all_to_all import (
     AllToAllContext,
+    _a2a_wire_block,
     fast_all_to_all_shard,
     fast_all_to_all_shard_diff,
 )
@@ -47,7 +48,8 @@ META_COLS = 8  # int32 metadata columns (col 0 = expert id), DMA-friendly pad
 
 
 def ep_dispatch_shard(x_loc, experts_loc, *, axis, n_experts,
-                      max_tokens=None, impl, interpret):
+                      max_tokens=None, impl, interpret,
+                      zero_undefined=False):
     """Pack per-destination-rank slots and shuffle tokens to expert owners.
 
     x_loc [t_loc, H], experts_loc [t_loc, topk] i32.  Routing weights are
@@ -57,6 +59,12 @@ def ep_dispatch_shard(x_loc, experts_loc, *, axis, n_experts,
     i32, recv_splits [world] i32, plan, n_dropped) where ``n_dropped`` is
     the GLOBAL (psum over ``axis``, replicated) count of (token, k)
     assignments truncated by capacity — always 0 at the default sizing.
+
+    Under the splits-proportional a2a, recv rows beyond the last shipped
+    block are UNDEFINED.  ``zero_undefined=True`` masks them to zero (one
+    elementwise pass) — REQUIRED when recv feeds a differentiated matmul:
+    weight gradients contract over all rows, and NaN garbage times a zero
+    cotangent is NaN.  Inference paths that mask at combine can skip it.
     """
     world = jax.lax.axis_size(axis)
     t_loc, topk = experts_loc.shape
@@ -82,15 +90,25 @@ def ep_dispatch_shard(x_loc, experts_loc, *, axis, n_experts,
     n_dropped = jax.lax.psum(
         jnp.maximum(counts - max_tokens, 0).sum().astype(jnp.int32), axis)
 
+    # Wire-block hint: the expected balanced load per (src->dst) segment
+    # is n/world rows; a block larger than that is pure padding on the
+    # wire (the lossless default max_tokens sizing is world x larger than
+    # the balanced load by construction).
+    wb = _a2a_wire_block(max_tokens, cap=n // world)
     recv, recv_splits = fast_all_to_all_shard_diff(
-        send, splits, axis, impl, interpret)
+        send, splits, axis, impl, interpret, wb)
     recv_meta, _ = fast_all_to_all_shard(
         meta, splits, axis=axis, impl="xla", interpret=interpret)
+    if zero_undefined:
+        row = jax.lax.broadcasted_iota(jnp.int32, (world, max_tokens), 1)
+        recv = jnp.where((row < recv_splits[:, None])[..., None], recv, 0)
 
-    # Plan = (dest, slot, valid): a plain tuple so shard_map out_specs stay
-    # hashable for the jit cache.
-    return (recv, recv_meta[:, :, 0], recv_splits, (dest, slot, valid),
-            n_dropped)
+    # Plan = (dest, slot, valid, recv_splits): a plain tuple so shard_map
+    # out_specs stay hashable for the jit cache.  recv_splits rides along
+    # so combine's return shuffle moves only the received rows (wire
+    # bytes proportional to actual tokens, matching dispatch).
+    return (recv, recv_meta[:, :, 0], recv_splits,
+            (dest, slot, valid, recv_splits), n_dropped)
 
 
 def ep_combine_shard(y, weights_loc, plan, *, axis, impl, interpret):
@@ -101,11 +119,18 @@ def ep_combine_shard(y, weights_loc, plan, *, axis, impl, interpret):
     """
     world, max_tokens, hidden = y.shape
     t_loc, topk = weights_loc.shape
-    splits = jnp.full((world,), max_tokens, jnp.int32)
-    back, _ = fast_all_to_all_shard_diff(y, splits, axis, impl, interpret)
+    dest, slot, valid, recv_splits = plan
+    # Send back exactly the rows received (every valid slot is < the
+    # split count by construction); padded slots never touch the wire.
+    wb = _a2a_wire_block(max_tokens, cap=(t_loc * topk) // world)
+    back, _ = fast_all_to_all_shard_diff(y, recv_splits, axis, impl,
+                                         interpret, wb)
 
-    dest, slot, valid = plan
     vals = back[jnp.minimum(dest, world - 1), jnp.minimum(slot, max_tokens - 1)]
+    # Zero invalid slots BEFORE the weighted sum: with proportional
+    # transfers the padded recv rows are undefined (not zeros), and
+    # 0 * garbage could be NaN.
+    vals = jnp.where(valid[:, None], vals, 0)
     w = (weights_loc.reshape(-1, 1) * valid[:, None]).astype(jnp.float32)
     out = (w * vals.astype(jnp.float32)).reshape(t_loc, topk, hidden).sum(axis=1)
     return out.astype(y.dtype)
@@ -148,7 +173,7 @@ class EPAll2AllLayer:
             ctx.mesh,
             (P(ctx.axis), P(ctx.axis)),
             (P(ctx.axis), P(ctx.axis), P(ctx.axis),
-             (P(ctx.axis), P(ctx.axis), P(ctx.axis)), P()),
+             (P(ctx.axis), P(ctx.axis), P(ctx.axis), P(ctx.axis)), P()),
             axis=ctx.axis, n_experts=self.n_experts,
             max_tokens=ctx.max_tokens, impl=ctx.impl, interpret=ctx.interpret,
         )
@@ -161,7 +186,7 @@ class EPAll2AllLayer:
             ep_combine_shard,
             ctx.mesh,
             (P(ctx.axis), P(ctx.axis),
-             (P(ctx.axis), P(ctx.axis), P(ctx.axis))),
+             (P(ctx.axis), P(ctx.axis), P(ctx.axis), P(ctx.axis))),
             P(ctx.axis),
             axis=ctx.axis, impl=ctx.impl, interpret=ctx.interpret,
         )

@@ -61,8 +61,10 @@ def moe_infer_shard(x_loc, weights_loc, experts_loc, w_gate, w_up, w_down, *,
     max_tokens = recv.shape[1]  # dispatch owns the None→worst-case rule
 
     # Sort received tokens by local expert and run the grouped SwiGLU.
-    # Padding rows carry zeros; steering them to expert 0 is harmless (the
-    # FFN is bias-free) and their slots are masked again at combine.
+    # Padding rows are undefined under the splits-proportional a2a (no
+    # longer zero-filled); steering them to expert 0 is harmless — their
+    # values never reach the output (combine zeroes invalid slots before
+    # the weighted sum).
     T = world * max_tokens
     local_e = jnp.clip(recv_expert.reshape(T, 1) - me * epr, 0, epr - 1)
     splan = sort_align(local_e, epr, block_m)

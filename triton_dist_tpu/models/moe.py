@@ -197,9 +197,13 @@ def moe_ffn_shard(h2, layer, cfg: MoEConfig, *, axis, impl, interpret):
             .at[experts.reshape(-1)].add(1.0) / (t_loc * cfg.topk))
     aux = E * jnp.sum(frac * jnp.mean(probs, axis=0)) / world
 
+    # zero_undefined: this is the TRAINING path — recv feeds differentiated
+    # matmuls, whose weight gradients contract over padding rows too
+    # (0-cotangent x NaN-garbage = NaN without the mask).
     recv, recv_expert, _splits, plan, _dropped = ep_dispatch_shard(
         h2.astype(cfg.dtype), experts, axis=axis, n_experts=E,
-        max_tokens=cfg.max_tokens, impl=impl, interpret=interpret)
+        max_tokens=cfg.max_tokens, impl=impl, interpret=interpret,
+        zero_undefined=True)
     max_tokens = recv.shape[1]  # dispatch owns the None→worst-case rule
 
     # Local expert compute over the received buffer.  Zero (padding) rows
