@@ -54,10 +54,17 @@ def main():
                                              bw_gbps=V5P_AXIS_GBPS)
     torus = estimate_torus_allgather_time_ms(a_shard_bytes, (4, 4),
                                              bw_gbps=V5P_AXIS_GBPS)
+    full3d = estimate_torus_allgather_time_ms(a_shard_bytes * 16 // 32,
+                                              (4, 4, 2),
+                                              bw_gbps=V5P_AXIS_GBPS)
+    bidir32 = estimate_torus_allgather_time_ms(a_shard_bytes * 16 // 32,
+                                               (32,), bw_gbps=V5P_AXIS_GBPS)
     print(f"  unidirectional ring      : {fmt(uni)}")
     print(f"  bidirectional ring       : {fmt(bidir)}")
     print(f"  fused 2D torus (4 links) : {fmt(torus)}   "
           f"(predicted {bidir / torus:.2f}x vs bidir ring)")
+    print(f"  TP=32 over the full 4x4x2: fused SIX-path 3D {fmt(full3d)} "
+          f"vs bidir ring {fmt(bidir32)} ({bidir32 / full3d:.2f}x)")
 
     print("\n## AG-GEMM overlap (same shape, N/chip = %d)" % (N // TP))
     # SOL computed against v5p peaks directly (estimate_gemm_sol_time_ms
@@ -78,9 +85,24 @@ def main():
                                                 bw_gbps=V5P_AXIS_GBPS)
     rs2 = estimate_torus_reduce_scatter_time_ms(a_shard_bytes * TP, (4, 4),
                                                 bw_gbps=V5P_AXIS_GBPS)
+    rs3 = estimate_torus_reduce_scatter_time_ms(a_shard_bytes * TP,
+                                                (4, 4, 2),
+                                                bw_gbps=V5P_AXIS_GBPS)
     print(f"  1-axis ring RS           : {fmt(rs1)}")
     print(f"  fused 2D torus RS        : {fmt(rs2)}   "
           f"(predicted {rs1 / rs2:.2f}x)")
+    print(f"  fused 3D six-path RS     : {fmt(rs3)}   (32 chips, same "
+          "bytes)")
+    # GEMM-RS epilogue: the fused four-path kernel keeps both axes' links
+    # busy — its wire floor IS the fused 2D RS number above; the round-2
+    # composition (1-axis fused + wire-only second ring) serialized a
+    # second phase on half the links.
+    old = estimate_torus_reduce_scatter_time_ms(
+        a_shard_bytes * TP, (4,), bw_gbps=V5P_AXIS_GBPS) + \
+        estimate_torus_reduce_scatter_time_ms(
+            a_shard_bytes * TP // 4, (4,), bw_gbps=V5P_AXIS_GBPS)
+    print(f"  gemm_rs epilogue floor   : {fmt(rs2)} fused four-path vs "
+          f"{fmt(old)} round-2 sequential composition")
 
     print("\n## MoE AllToAll (128 tok/rank, topk 8, hidden 7168, fp8, "
           "world=32)")

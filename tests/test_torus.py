@@ -283,3 +283,131 @@ def test_torus_gemm_rs(mesh2x4, key):
     c = gemm_rs(a, b, ctx)
     np.testing.assert_allclose(np.asarray(c), np.asarray(a) @ np.asarray(b),
                                rtol=2e-3, atol=2e-3)
+
+
+def test_torus3d_distinct_partials(mesh2x2x2):
+    """Six-path fused 3D RS with a DIFFERENT partial per device."""
+    T = 48  # 48/8 = 6 rows per device = 1 per path
+    base = jnp.arange(T * 128, dtype=jnp.float32).reshape(T, 128)
+
+    def shard_fn(seed_ref):
+        i = jax.lax.axis_index("x")
+        j = jax.lax.axis_index("y")
+        k = jax.lax.axis_index("z")
+        r = (i * 4 + j * 2 + k).astype(jnp.float32)
+        partial = seed_ref * (r + 1.0)
+        return torus_reduce_scatter_shard(partial, ("x", "y", "z"),
+                                          interpret=True)
+
+    got = jax.jit(jax.shard_map(shard_fn, mesh=mesh2x2x2, in_specs=P(),
+                                out_specs=P(("x", "y", "z")),
+                                check_vma=False))(base)
+    scale = sum(r + 1.0 for r in range(8))  # 36
+    np.testing.assert_allclose(np.asarray(got), scale * np.asarray(base),
+                               rtol=1e-5)
+
+
+def test_torus3d_ag_rs_roundtrip(mesh2x2x2, key):
+    """RS(AG(x)) == world * x band-for-band on the 3-axis torus (flat
+    order consistency of the six-path AG and RS schedules)."""
+
+    def shard_fn(x_loc):
+        full = torus_all_gather_shard(x_loc, ("x", "y", "z"),
+                                      interpret=True)
+        return torus_reduce_scatter_shard(full, ("x", "y", "z"),
+                                          interpret=True)
+
+    x = jax.random.normal(key, (48, 128), jnp.float32)
+    got = jax.jit(jax.shard_map(shard_fn, mesh=mesh2x2x2,
+                                in_specs=P(("x", "y", "z")),
+                                out_specs=P(("x", "y", "z")),
+                                check_vma=False))(x)
+    np.testing.assert_allclose(np.asarray(got), 8 * np.asarray(x),
+                               rtol=1e-5)
+
+
+def test_torus3d_allgather_bf16_uneven(mesh2x2x2, key):
+    """3D AG with rows not divisible by 6 (uneven sixths, some paths
+    longer) and a bf16 payload."""
+    x = jax.random.normal(key, (8 * 7, 128), jnp.bfloat16)
+    got = _run_ag(mesh2x2x2, x, ("x", "y", "z"))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(x))
+
+
+def test_torus3d_perf_model():
+    """Fused six-path 3D: ~3x the bidirectional 1-axis ring on the 4x4x2
+    north star (all 6 link directions vs 2), and faster than the old
+    plane+sequential-third composition."""
+    from triton_dist_tpu.kernels.perf_model import (
+        estimate_torus_allgather_time_ms,
+        estimate_torus_reduce_scatter_time_ms,
+    )
+
+    S = 64 << 20
+    bw = 100.0
+    bidir = estimate_torus_allgather_time_ms(S, (32,), bw_gbps=bw)
+    fused = estimate_torus_allgather_time_ms(S, (4, 4, 2), bw_gbps=bw)
+    assert np.isclose(bidir / fused, 3.0, rtol=0.01), (bidir, fused)
+    rs_bidir = estimate_torus_reduce_scatter_time_ms(S, (32,), bw_gbps=bw)
+    rs_fused = estimate_torus_reduce_scatter_time_ms(S, (4, 4, 2),
+                                                     bw_gbps=bw)
+    assert rs_bidir / rs_fused > 2.5, (rs_bidir, rs_fused)
+
+
+@pytest.mark.parametrize("meshname", ["mesh2x4", "mesh4x2"])
+def test_torus_gemm_rs_fused_epilogue(meshname, key, request):
+    """Fused four-path GEMM-RS (VERDICT r2 #4): both mesh orientations,
+    distinct per-device K-shards, natural axes-major band order."""
+    from triton_dist_tpu.kernels.gemm_reduce_scatter import (
+        GEMMReduceScatterContext,
+        gemm_rs,
+    )
+
+    mesh = request.getfixturevalue(meshname)
+    M, K, N = 64, 1024, 512  # k_loc = 128: the fused kernel RUNS (a
+    # smaller K silently routes to the fallback and tests nothing)
+    ks = jax.random.split(key, 2)
+    a = jax.random.normal(ks[0], (M, K), jnp.float32)
+    b = jax.random.normal(ks[1], (K, N), jnp.float32) / np.sqrt(K)
+    ctx = GEMMReduceScatterContext(mesh=mesh, axis=("x", "y"),
+                                   impl="pallas", interpret=True)
+    c = gemm_rs(a, b, ctx)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(a) @ np.asarray(b),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_torus_gemm_rs_int8_exact(mesh2x4):
+    """int8 partials stay exact int32 through the fused two-phase adds."""
+    from triton_dist_tpu.kernels.gemm_reduce_scatter import (
+        GEMMReduceScatterContext,
+        gemm_rs,
+    )
+
+    M, K, N = 64, 1024, 512  # k_loc = 128 (fused kernel path)
+    rng = np.random.default_rng(3)
+    a = jnp.asarray(rng.integers(-63, 64, (M, K), np.int8))
+    b = jnp.asarray(rng.integers(-63, 64, (K, N), np.int8))
+    ctx = GEMMReduceScatterContext(mesh=mesh2x4, axis=("x", "y"),
+                                   impl="pallas", interpret=True)
+    c = gemm_rs(a, b, ctx)
+    ref = np.asarray(a, np.int64) @ np.asarray(b, np.int64)
+    np.testing.assert_array_equal(np.asarray(c, np.int64), ref)
+
+
+def test_torus3d_ag_gemm(mesh2x2x2, key):
+    """3-axis AG-GEMM: the fused kernel's third (plane-ring) phase."""
+    from triton_dist_tpu.kernels.allgather_gemm import (
+        AllGatherGEMMContext,
+        ag_gemm,
+    )
+
+    M, K, N = 64, 128, 256
+    ks = jax.random.split(key, 2)
+    a = jax.random.normal(ks[0], (M, K), jnp.bfloat16)
+    b = jax.random.normal(ks[1], (K, N), jnp.bfloat16)
+    ctx = AllGatherGEMMContext(mesh=mesh2x2x2, axis=("x", "y", "z"),
+                               impl="pallas", interpret=True)
+    c = ag_gemm(a, b, ctx)
+    ref = (np.asarray(a, np.float32) @ np.asarray(b, np.float32))
+    np.testing.assert_allclose(np.asarray(c, np.float32), ref,
+                               rtol=5e-2, atol=5e-1)

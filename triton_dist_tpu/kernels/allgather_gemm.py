@@ -160,38 +160,47 @@ def _ag_gemm_kernel(
 
 
 def _torus_ag_gemm_kernel(
-    a_ref,      # [m_loc, K]                ANY (HBM)
-    b_ref,      # [K, n_loc]                ANY
-    ag_ref,     # [wx, wy, m_loc, K]        ANY, output: gathered A
-    out_ref,    # [wx, wy, m_loc, n_loc]    ANY, output: C shard
-    send_x, recv_x, send_y, recv_y, copy_sem,
+    a_ref,      # [m_loc, K]                    ANY (HBM)
+    b_ref,      # [K, n_loc]                    ANY
+    ag_ref,     # [wx, wy, wz, m_loc, K]        ANY, output: gathered A
+    out_ref,    # [wx, wy, wz, m_loc, n_loc]    ANY, output: C shard
+    send_x, recv_x, send_y, recv_y, send_z, recv_z, copy_sem,
     acc_ref,
     *,
-    ax, ay, wx, wy, m_loc, bm, bn, bk, out_dtype,
+    ax, ay, az, wx, wy, wz, m_loc, bm, bn, bk, out_dtype,
 ):
-    """2-axis torus AG-GEMM: the torus schedule as the segment producer.
+    """2-/3-axis torus AG-GEMM: the torus schedule as the segment producer.
 
     Phase 1 is the 1-D ring over ``ax`` (slot per step, GEMM consumes each
     as it arrives); phase 2 rings whole first-axis LINES (wx slots) over
     ``ay``, each line's forward DMA riding under the wx slot-GEMMs of the
-    previously arrived line.  Per-phase semaphore pairs keep a fast
-    neighbor's early phase-2 arrival from satisfying a phase-1 wait
-    (cf. kernels/torus.py).  Consume order = arrival order, so step 0 is
-    always the local segment — the reference's rank swizzle
-    (allgather_gemm.py:206-219), inherited per axis.
+    previously arrived line; phase 3 (3-axis meshes) rings whole
+    (x, y)-PLANES over ``az``, each plane's DMA riding under wx*wy
+    slot-GEMMs — the DMA:compute ratio improves every phase.  Per-phase
+    semaphore pairs keep a fast neighbor's early next-phase arrival from
+    satisfying an earlier-phase wait (cf. kernels/torus.py).  Consume
+    order = arrival order, so step 0 is always the local segment — the
+    reference's rank swizzle (allgather_gemm.py:206-219), inherited per
+    axis; the reference's own 3D analog is the push-3D warp-specialized
+    AG (low_latency_allgather.py:570-607).  ``wz == 1`` degenerates to
+    the 2-axis schedule (phase 3 vanishes).
     """
     i = jax.lax.axis_index(ax)
     j = jax.lax.axis_index(ay)
+    k = jax.lax.axis_index(az) if az is not None else 0
     right = jax.lax.rem(i + 1, wx)
     down = jax.lax.rem(j + 1, wy)
+    back = jax.lax.rem(k + 1, wz) if az is not None else 0
 
     # Stage the local segment (hidden behind step 0's GEMM; waited before
     # phase 2 ships the line that contains it).
-    cp = pltpu.make_async_copy(a_ref, ag_ref.at[i, j], copy_sem)
+    cp = pltpu.make_async_copy(a_ref, ag_ref.at[i, j, k], copy_sem)
     cp.start()
 
     dl.barrier_all(ax)
     dl.barrier_all(ay)
+    if az is not None:
+        dl.barrier_all(az)
 
     K = a_ref.shape[1]
     n_loc = b_ref.shape[1]
@@ -207,16 +216,16 @@ def _torus_ag_gemm_kernel(
         out_specs=[pl.BlockSpec((bm, bn), lambda i, j, k: (i, j))],
     )
 
-    # ---- Phase 1: x-ring over my line j, one slot per step. ----
+    # ---- Phase 1: x-ring over my line (j, k), one slot per step. ----
     for s in range(wx):
         slot = jax.lax.rem(i - s + wx, wx)
-        seg = ag_ref.at[slot, j]
+        seg = ag_ref.at[slot, j, k]
         src = a_ref if s == 0 else seg
         if s > 0:
             pltpu.make_async_copy(seg, seg, recv_x).wait()
         if s < wx - 1:
             dl.remote_copy(src, seg, send_x, recv_x, ax, right).start()
-        inner(src, b_ref, out_ref.at[slot, j], scratches=(acc_ref,))
+        inner(src, b_ref, out_ref.at[slot, j, k], scratches=(acc_ref,))
         if s < wx - 1:
             pltpu.make_async_copy(src, src, send_x).wait()
 
@@ -228,26 +237,43 @@ def _torus_ag_gemm_kernel(
     # ---- Phase 2: y-ring over whole lines, wx slot-GEMMs per step. ----
     for t in range(wy - 1):
         line_send = jax.lax.rem(j - t + wy, wy)
-        blk = ag_ref.at[:, line_send]
+        blk = ag_ref.at[:, line_send, k]
         dl.remote_copy(blk, blk, send_y, recv_y, ay, down).start()
 
         line_recv = jax.lax.rem(j - t - 1 + wy, wy)
-        rblk = ag_ref.at[:, line_recv]
+        rblk = ag_ref.at[:, line_recv, k]
         pltpu.make_async_copy(rblk, rblk, recv_y).wait()
         for ii in range(wx):
-            inner(ag_ref.at[ii, line_recv], b_ref,
-                  out_ref.at[ii, line_recv], scratches=(acc_ref,))
+            inner(ag_ref.at[ii, line_recv, k], b_ref,
+                  out_ref.at[ii, line_recv, k], scratches=(acc_ref,))
         pltpu.make_async_copy(blk, blk, send_y).wait()
+
+    # ---- Phase 3: z-ring over whole planes, wx*wy slot-GEMMs each. ----
+    for u in range(wz - 1):
+        plane_send = jax.lax.rem(k - u + wz, wz)
+        blk = ag_ref.at[:, :, plane_send]
+        dl.remote_copy(blk, blk, send_z, recv_z, az, back).start()
+
+        plane_recv = jax.lax.rem(k - u - 1 + wz, wz)
+        rblk = ag_ref.at[:, :, plane_recv]
+        pltpu.make_async_copy(rblk, rblk, recv_z).wait()
+        for ii in range(wx):
+            for jj in range(wy):
+                inner(ag_ref.at[ii, jj, plane_recv], b_ref,
+                      out_ref.at[ii, jj, plane_recv], scratches=(acc_ref,))
+        pltpu.make_async_copy(blk, blk, send_z).wait()
 
 
 def _torus_ag_gemm_shard(a_shard, b_shard, *, axes, impl, bm, bn, bk,
                          interpret):
-    """Per-device 2-axis torus AG-GEMM (see kernel docstring).  Gathered A
-    comes back flat axes-major, C as the matching [W*m_loc, n_loc]."""
-    ax, ay = axes
+    """Per-device 2-/3-axis torus AG-GEMM (see kernel docstring).  Gathered
+    A comes back flat axes-major, C as the matching [W*m_loc, n_loc]."""
+    ax, ay = axes[0], axes[1]
+    az = axes[2] if len(axes) == 3 else None
     wx = jax.lax.axis_size(ax)
     wy = jax.lax.axis_size(ay)
-    world = wx * wy
+    wz = jax.lax.axis_size(az) if az is not None else 1
+    world = wx * wy * wz
     m_loc, K = a_shard.shape
     n_loc = b_shard.shape[1]
     quantized = a_shard.dtype == jnp.int8
@@ -264,14 +290,14 @@ def _torus_ag_gemm_shard(a_shard, b_shard, *, axes, impl, bm, bn, bk,
     bn = largest_divisor_block(n_loc, bn, 128)
     bk = largest_divisor_block(K, bk, 128)
 
-    ag4, c4 = pl.pallas_call(
+    ag5, c5 = pl.pallas_call(
         functools.partial(
-            _torus_ag_gemm_kernel, ax=ax, ay=ay, wx=wx, wy=wy, m_loc=m_loc,
-            bm=bm, bn=bn, bk=bk, out_dtype=out_dtype,
+            _torus_ag_gemm_kernel, ax=ax, ay=ay, az=az, wx=wx, wy=wy,
+            wz=wz, m_loc=m_loc, bm=bm, bn=bn, bk=bk, out_dtype=out_dtype,
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((wx, wy, m_loc, K), a_shard.dtype),
-            jax.ShapeDtypeStruct((wx, wy, m_loc, n_loc), out_dtype),
+            jax.ShapeDtypeStruct((wx, wy, wz, m_loc, K), a_shard.dtype),
+            jax.ShapeDtypeStruct((wx, wy, wz, m_loc, n_loc), out_dtype),
         ],
         in_specs=[pl.BlockSpec(memory_space=pl.ANY),
                   pl.BlockSpec(memory_space=pl.ANY)],
@@ -283,36 +309,38 @@ def _torus_ag_gemm_shard(a_shard, b_shard, *, axes, impl, bm, bn, bk,
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
             pltpu.VMEM((bm, bn), acc_dtype),
         ],
         compiler_params=dl.collective_compiler_params(
             world, AG_GEMM_COLLECTIVE_ID),
         interpret=maybe_interpret(interpret),
     )(a_shard, b_shard)
-    return (ag4.reshape(world * m_loc, K),
-            c4.reshape(world * m_loc, n_loc))
+    return (ag5.reshape(world * m_loc, K),
+            c5.reshape(world * m_loc, n_loc))
 
 
 def ag_gemm_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
                   bk=None, interpret=False):
     """Per-device AG-GEMM; call inside shard_map.  Returns (A_full, C_shard).
     Block sizes default to the swept MatmulConfig (gemm.py).  ``axis`` may
-    be a tuple of 2 mesh axes — A's rows sharded over the axes-major joint
-    axes — routing to the torus schedule (phase-interleaved 2-axis ring
-    producer, ``_torus_ag_gemm_kernel``)."""
+    be a tuple of 2-3 mesh axes — A's rows sharded over the axes-major
+    joint axes — routing to the torus schedule (phase-interleaved multi-
+    axis ring producer, ``_torus_ag_gemm_kernel``)."""
     _cfg = MatmulConfig()
     bm, bn, bk = bm or _cfg.block_m, bn or _cfg.block_n, bk or _cfg.block_k
     raw_impl = impl
     impl = resolve_impl(impl, interpret)
     if isinstance(axis, (tuple, list)) and len(axis) > 1:
         axes = tuple(axis)
-        if len(axes) != 2:
-            raise ValueError(f"ag_gemm supports 1 or 2 axes, got {axes}")
-        sizes = tuple(jax.lax.axis_size(a) for a in axes)
-        if 1 in sizes:  # degenerate: one real axis
-            axis = axes[sizes.index(max(sizes))]
+        if len(axes) not in (2, 3):
+            raise ValueError(f"ag_gemm supports 1-3 axes, got {axes}")
+        real = tuple(a for a in axes if jax.lax.axis_size(a) > 1)
+        if len(real) <= 1:  # degenerate: at most one real axis
+            axis = real[0] if real else axes[0]
         else:
-            return _torus_ag_gemm_shard(a_shard, b_shard, axes=axes,
+            return _torus_ag_gemm_shard(a_shard, b_shard, axes=real,
                                         impl=impl, bm=bm, bn=bn, bk=bk,
                                         interpret=interpret)
     axis = axis[0] if isinstance(axis, (tuple, list)) else axis

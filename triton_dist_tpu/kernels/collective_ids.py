@@ -29,6 +29,6 @@ TORUS_AG = 16            # kernels/torus.py fused 2D AG plane
 TORUS_AG_THIRD = 17      # kernels/torus.py 3-axis third-axis ring
 TORUS_RS = 18            # kernels/torus.py fused 2D RS plane
 TORUS_RS_THIRD = 19      # kernels/torus.py 3-axis third-axis ring
-TORUS_RS_FALLBACK = 20   # kernels/torus.py sequential 2D fallback, 2nd leg
-GEMM_RS_SECOND = 20      # gemm_reduce_scatter.py 2-axis second ring
+GEMM_RS_SECOND = 20      # gemm_reduce_scatter.py 2-axis fallback 2nd leg
 LL_AG_INTER = 21         # low_latency_allgather.py inter tier
+TORUS_RS_FALLBACK = 22   # kernels/torus.py sequential fallback, 2nd/3rd leg

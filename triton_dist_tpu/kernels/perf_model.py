@@ -157,8 +157,10 @@ def estimate_torus_allgather_time_ms(nbytes_per_shard: int,
     moves (w2-1) first-axis lines of w1 slot-quarters each → per-link
     bytes = q*(w1-1) + q*w1*(w2-1) = q*(w1*w2 - 1).  Every path carries
     the same total, so time = q*(W-1)/bw — W = wx*wy.  A 3-axis torus
-    rings the gathered plane on the third axis's two directions:
-    (S/2)*plane*(w3-1) per link, overlapping nothing (it dominates).
+    runs the fused SIX-path schedule (round 3): sixths s = S/6, per-link
+    bytes s*(W-1) per path (the same telescoping sum over three phases),
+    all 6 link directions busy — 3x the bidirectional ring, ~2.3x the
+    old plane+sequential-third composition.
     """
     sizes = [s for s in axis_sizes if s > 1]
     world = 1
@@ -176,11 +178,9 @@ def estimate_torus_allgather_time_ms(nbytes_per_shard: int,
     if len(sizes) == 2:
         plane = sizes[0] * sizes[1]
         return (nbytes_per_shard / 4) * (plane - 1) / 1e9 / link * 1e3
-    plane = sizes[-2] * sizes[-1]
-    t_plane = (nbytes_per_shard / 4) * (plane - 1) / 1e9 / link * 1e3
-    t_third = ((nbytes_per_shard * plane / 2) * (sizes[0] - 1)
-               / 1e9 / link * 1e3)
-    return t_plane + t_third
+    # Fused six-path 3D: each sixth telescopes to (W-1) sixth-bytes per
+    # link across its three phases, identical for every cyclic order.
+    return (nbytes_per_shard / 6) * (world - 1) / 1e9 / link * 1e3
 
 
 def estimate_torus_reduce_scatter_time_ms(nbytes_full: int,
@@ -209,22 +209,23 @@ def estimate_torus_reduce_scatter_time_ms(nbytes_full: int,
         # each link direction.
         return (nbytes_full / 2 * (sizes[0] - 1) / sizes[0]) / 1e9 / link \
             * 1e3
+    part = nbytes_full / (2 * len(sizes))
+
+    def path_ms(order):
+        t, denom = 0.0, 1
+        for w in order:
+            denom *= w
+            t += part * (w - 1) / denom / 1e9 / link * 1e3
+        return t
+
     if len(sizes) == 3:
-        # Third axis reduces first (shrinks data), then the fused plane;
-        # the third-axis pass is the bidirectional ring.
-        w3 = sizes[0]
-        t3 = (nbytes_full / 2 * (w3 - 1) / w3) / 1e9 / link * 1e3
-        return t3 + estimate_torus_reduce_scatter_time_ms(
-            nbytes_full // w3, tuple(sizes[1:]), bw_gbps)
+        # Fused six-path 3D (round 3): cyclic reduction orders; wall time
+        # = the slowest order (they differ on asymmetric tori).
+        w1, w2, w3 = sizes
+        return max(path_ms((w1, w2, w3)), path_ms((w2, w3, w1)),
+                   path_ms((w3, w1, w2)))
     w1, w2 = sizes
-    quarter = nbytes_full / 4
-
-    def path_ms(a, b):
-        p1 = quarter / a * (a - 1) / 1e9 / link * 1e3
-        p2 = quarter / (a * b) * (b - 1) / 1e9 / link * 1e3
-        return p1 + p2
-
-    return max(path_ms(w1, w2), path_ms(w2, w1))
+    return max(path_ms((w1, w2)), path_ms((w2, w1)))
 
 
 def estimate_all_to_all_time_ms(nbytes_per_chip: int, world_size: int,

@@ -2,7 +2,8 @@
 
 Reference analog: the topology-specialized AllGather variants of
 ``python/triton_dist/kernels/nvidia/allgather.py`` — the NUMA-aware 2D ring
-(:194-258) and the inter-node 2D variants (:470-591).  The reference earns
+(:194-258) and the inter-node 2D/3D variants (:470-591; push-3D
+warp-specialized AG, low_latency_allgather.py:570-607).  The reference earns
 its performance by matching the schedule to the fabric; on TPU the fabric is
 a 2D/3D ICI torus, and the matching schedule is *concurrent bidirectional
 rings on every axis*.
@@ -11,31 +12,26 @@ Why not compose per-axis kernels (``hierarchical.py``)?  Composition is
 sequential: during the axis-0 phase every axis-1 link idles and vice versa —
 on a torus whose axes have equal bandwidth that wastes half (2D) or two
 thirds (3D) of the injection bandwidth.  The fused kernel here keeps every
-link direction busy in both phases:
+link direction busy in every phase:
 
-* The shard is split into four **quarters**, each assigned one of the four
-  (first-axis, direction) path flavors: x→y forward, x→y backward, y→x
-  forward, y→x backward.
-* Phase 1: each quarter rings its slot along its first axis — the four
-  concurrent streams ride x+, x-, y+, y- simultaneously.
-* Phase 2: each quarter forwards its gathered first-axis *lines* along the
-  other axis, again on four disjoint link directions (x quarters move to y±,
-  y quarters to x±).
+* The shard is split into ``2 * n_axes`` contiguous **parts** (quarters on
+  a 2D torus, sixths on 3D), each assigned a path flavor
+  ``(cyclic axis order, direction)``: 2D = x→y ±, y→x ±; 3D = x→y→z ±,
+  y→z→x ±, z→x→y ±.
+* Phase ``p``: each part rings what it has gathered so far along axis
+  ``order[p]`` in its direction — at any moment the 4 (2D) or 6 (3D)
+  concurrent streams ride every (axis, direction) link of the torus.
+* After phase ``p`` a part holds the full ``order[:p+1]`` sub-torus of its
+  slice; after the last phase, the whole torus.
 
-Per-(quarter, phase) DMA semaphore pairs keep the byte accounting of the
-four streams and two phases independent (a fast path may enter phase 2
-while a neighbor still drains phase 1; distinct semaphores make the early
-arrival invisible to the neighbor's phase-1 waits).
+Per-(path, phase) DMA semaphore pairs keep the byte accounting of the
+streams and phases independent (a fast path may enter phase ``p+1`` while a
+neighbor still drains phase ``p``; distinct semaphores make the early
+arrival invisible to the neighbor's phase-``p`` waits).
 
-Expected bandwidth: one bidirectional ring saturates 2 of a 2D torus's 4
-link directions; this schedule drives all 4 → ~2× the 1-axis bidir ring,
-~4× the unidirectional ring (see ``perf_model.py:torus_ag_time``).
-
-3-axis tori compose: gather the fused 2D plane, then a bidirectional ring
-on the third axis (``torus_all_gather_shard`` with a 3-tuple) — the third
-axis moves plane-fold more bytes, so it dominates and still overlaps
-nothing; a fully fused 3D six-path schedule is the natural extension once
-an axis-3 mesh is the deployment target.
+Expected bandwidth: one bidirectional ring saturates 2 of a torus's 2n link
+directions; this schedule drives all 2n → ~n× the 1-axis bidir ring (~2x on
+2D, ~3x on 3D — ``perf_model.estimate_torus_allgather_time_ms``).
 
 Output order: flat ``axes``-major (axes[0] slowest), matching
 ``hierarchical.hier_all_gather_shard`` — the two are drop-in replacements
@@ -58,12 +54,14 @@ from triton_dist_tpu.language.interpret import maybe_interpret
 
 __all__ = ["torus_all_gather_shard", "torus_reduce_scatter_shard"]
 
+_LBL = ("x", "y", "z")  # internal storage-order labels for up to 3 axes
 
-def _split_quarters(rows: int):
-    """Split ``rows`` into 4 contiguous (offset, length) quarters; lengths
+
+def _split_parts(rows: int, k: int):
+    """Split ``rows`` into ``k`` contiguous (offset, length) parts; lengths
     may be 0 for tiny shards (those path flavors simply do not run)."""
-    base, rem = divmod(rows, 4)
-    lens = [base + (1 if q < rem else 0) for q in range(4)]
+    base, rem = divmod(rows, k)
+    lens = [base + (1 if q < rem else 0) for q in range(k)]
     offs, o = [], 0
     for ln in lens:
         offs.append(o)
@@ -71,118 +69,127 @@ def _split_quarters(rows: int):
     return list(zip(offs, lens))
 
 
-def _torus2d_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem,
-                       *, ax, ay, wx, wy, quarters):
-    """Fused 2D torus AllGather.  ``out_ref`` is [wx, wy, R, C]; slot (i, j)
-    is device (ax=i, ay=j)'s shard.  ``quarters``: 4 tuples
-    (row_offset, row_len, first_axis ('x'|'y'), direction (+1|-1)).
+def _path_flavors(n: int):
+    """``2n`` (cyclic axis order, direction) flavors: every (axis, dir)
+    link of the torus is the phase-p ring of exactly one path, for every
+    phase p."""
+    orders = [tuple(_LBL[(s + t) % n] for t in range(n)) for s in range(n)]
+    return tuple((order, d) for order in orders for d in (1, -1))
 
-    Semaphore layout: ``send_sem``/``recv_sem`` are [4, 2] DMA semaphore
-    arrays indexed (quarter, phase).
+
+def _paths_for(rows: int, n: int):
+    return tuple((off, ln, order, d)
+                 for (off, ln), (order, d) in zip(_split_parts(rows, 2 * n),
+                                                  _path_flavors(n)))
+
+
+# ---------------------------------------------------------------------------
+# AllGather
+# ---------------------------------------------------------------------------
+
+
+def _torus_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem,
+                     *, axis_names, sizes, paths):
+    """Fused 2D/3D torus AllGather.  ``out_ref`` is [*sizes, R, C]; slot
+    (i, j[, k]) is that device's shard.  ``paths``: 2n tuples
+    (row_offset, row_len, cyclic axis order, direction).
+
+    Phase p forwards, for each path, the ``order[:p]`` sub-torus gathered
+    so far along axis ``order[p]``: e.g. a 3D x→y→z path rings its sixth's
+    slots on x±, then the gathered x-lines on y±, then the (x, y)-planes
+    on z±.  ``send_sem``/``recv_sem`` are [2n, n] DMA semaphore arrays
+    indexed (path, phase).
     """
-    i = jax.lax.axis_index(ax)
-    j = jax.lax.axis_index(ay)
+    n = len(axis_names)
+    lbls = _LBL[:n]
+    coords = {l: jax.lax.axis_index(a) for l, a in zip(lbls, axis_names)}
+    size = dict(zip(lbls, sizes))
+    mesh_ax = dict(zip(lbls, axis_names))
 
-    # Stage my slot, then make sure every device in the plane entered the
-    # kernel before any remote DMA (barrier_all contract; the two-axis
-    # barrier is transitive: after ax all (*, j) entered, after ay all
-    # (i', *) finished their ax barrier → the whole plane is in).
-    cp = pltpu.make_async_copy(x_ref, out_ref.at[i, j], copy_sem)
+    # Stage my slot, then make sure every device in the torus entered the
+    # kernel before any remote DMA (barrier_all contract; the per-axis
+    # barrier chain is transitive across axes).
+    own = tuple(coords[l] for l in lbls)
+    cp = pltpu.make_async_copy(x_ref, out_ref.at[own], copy_sem)
     cp.start()
     cp.wait()
-    dl.barrier_all(ax)
-    dl.barrier_all(ay)
+    for a in axis_names:
+        dl.barrier_all(a)
 
-    def p1_block(q, s, first, d, off, ln):
-        """Quarter q's phase-1 ring block at step s: the slot it forwards."""
-        if first == "x":
-            idx = jax.lax.rem(i - d * s + s * wx + wx, wx)
-            return out_ref.at[idx, j, pl.ds(off, ln)]
-        idx = jax.lax.rem(j - d * s + s * wy + wy, wy)
-        return out_ref.at[i, idx, pl.ds(off, ln)]
+    def blk_ref(order, d, off, ln, p, s):
+        """The block path (order, d) forwards at phase p step s: ring-axis
+        index (my - d*s), gathered axes full, pending axes at my coords."""
+        r = order[p]
+        w = size[r]
+        idx = jax.lax.rem(coords[r] - d * s + s * w + w, w)
+        sel = tuple(
+            idx if l == r else (slice(None) if l in order[:p] else coords[l])
+            for l in lbls)
+        return out_ref.at[sel + (pl.ds(off, ln),)]
 
-    def p2_block(q, t, first, d, off, ln):
-        """Quarter q's phase-2 ring block at step t: the first-axis line it
-        forwards along the second axis."""
-        if first == "x":  # second axis y: forward x-lines (all i', fixed j')
-            jsrc = jax.lax.rem(j - d * t + t * wy + wy, wy)
-            return out_ref.at[:, jsrc, pl.ds(off, ln)]
-        isrc = jax.lax.rem(i - d * t + t * wx + wx, wx)
-        return out_ref.at[isrc, :, pl.ds(off, ln)]
-
-    def ring_meta(first, d, phase):
-        """(axis name, my coord, axis size, peer) for a quarter's phase."""
-        axis_is_x = (first == "x") == (phase == 0)
-        if axis_is_x:
-            return ax, wx, jax.lax.rem(i + d + wx, wx)
-        return ay, wy, jax.lax.rem(j + d + wy, wy)
-
-    def run_phase(phase, block_fn, n_steps_of):
-        n_max = max(n_steps_of(q) for q in range(4))
+    def run_phase(p):
+        active = [(q, pa) for q, pa in enumerate(paths) if pa[1] > 0]
+        if not active:
+            return
+        n_max = max(size[pa[2][p]] for _, pa in active) - 1
 
         def step(s, _):
-            # Start every active quarter's DMA first (concurrency), then
+            # Start every active path's DMA first (concurrency), then
             # wait them all (descriptor trick on the same-shaped block).
-            for q, (off, ln, first, d) in enumerate(quarters):
-                if ln == 0 or n_steps_of(q) == 0:
-                    continue
-                axis, _, peer = ring_meta(first, d, phase)
+            for q, (off, ln, order, d) in active:
+                r = order[p]
+                w = size[r]
+                peer = jax.lax.rem(coords[r] + d + w, w)
 
-                @pl.when(s < n_steps_of(q))
-                def _(q=q, off=off, ln=ln, first=first, d=d, axis=axis,
-                      peer=peer):
-                    blk = block_fn(q, s, first, d, off, ln)
-                    dl.remote_copy(blk, blk, send_sem.at[q, phase],
-                                   recv_sem.at[q, phase], axis, peer).start()
-            for q, (off, ln, first, d) in enumerate(quarters):
-                if ln == 0 or n_steps_of(q) == 0:
-                    continue
+                @pl.when(s < w - 1)
+                def _(q=q, off=off, ln=ln, order=order, d=d, r=r, peer=peer):
+                    blk = blk_ref(order, d, off, ln, p, s)
+                    dl.remote_copy(blk, blk, send_sem.at[q, p],
+                                   recv_sem.at[q, p], mesh_ax[r],
+                                   peer).start()
+            for q, (off, ln, order, d) in active:
+                w = size[order[p]]
 
-                @pl.when(s < n_steps_of(q))
-                def _(q=q, off=off, ln=ln, first=first, d=d):
-                    blk = block_fn(q, s, first, d, off, ln)
+                @pl.when(s < w - 1)
+                def _(q=q, off=off, ln=ln, order=order, d=d):
+                    blk = blk_ref(order, d, off, ln, p, s)
                     pltpu.make_async_copy(blk, blk,
-                                          send_sem.at[q, phase]).wait()
+                                          send_sem.at[q, p]).wait()
                     pltpu.make_async_copy(blk, blk,
-                                          recv_sem.at[q, phase]).wait()
+                                          recv_sem.at[q, p]).wait()
             return 0
 
         if n_max > 0:
             jax.lax.fori_loop(0, n_max, step, 0)
 
-    # Phase 1: ring each quarter's slots along its first axis.
-    run_phase(0, p1_block,
-              lambda q: (wx if quarters[q][2] == "x" else wy) - 1)
-    # Phase 2: ring the gathered first-axis lines along the second axis.
-    run_phase(1, p2_block,
-              lambda q: (wy if quarters[q][2] == "x" else wx) - 1)
+    for p in range(n):
+        run_phase(p)
 
 
-_QUARTER_FLAVORS = (("x", 1), ("x", -1), ("y", 1), ("y", -1))
-
-
-def _torus2d_ag(x_shard, *, ax, ay, wx, wy, interpret, collective_id):
+def _torus_ag(x_shard, *, axis_names, sizes, interpret, collective_id):
+    n = len(axis_names)
     rows = x_shard.shape[0]
     orig_shape = x_shard.shape
     x2 = x_shard.reshape(rows, -1)
     cols = x2.shape[1]
-    quarters = tuple(
-        (off, ln, first, d)
-        for (off, ln), (first, d) in zip(_split_quarters(rows),
-                                         _QUARTER_FLAVORS))
-    out4 = pl.pallas_call(
-        functools.partial(_torus2d_ag_kernel, ax=ax, ay=ay, wx=wx, wy=wy,
-                          quarters=quarters),
-        out_shape=jax.ShapeDtypeStruct((wx, wy, rows, cols), x2.dtype),
+    paths = _paths_for(rows, n)
+    world = 1
+    for w in sizes:
+        world *= w
+    out = pl.pallas_call(
+        functools.partial(_torus_ag_kernel, axis_names=axis_names,
+                          sizes=sizes, paths=paths),
+        out_shape=jax.ShapeDtypeStruct(tuple(sizes) + (rows, cols),
+                                       x2.dtype),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        scratch_shapes=[pltpu.SemaphoreType.DMA((4, 2)),
-                        pltpu.SemaphoreType.DMA((4, 2)),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((2 * n, n)),
+                        pltpu.SemaphoreType.DMA((2 * n, n)),
                         pltpu.SemaphoreType.DMA],
-        compiler_params=dl.collective_compiler_params(wx * wy, collective_id),
+        compiler_params=dl.collective_compiler_params(world, collective_id),
         interpret=maybe_interpret(interpret),
     )(x2)
-    return out4.reshape((wx * wy * rows,) + orig_shape[1:])
+    return out.reshape((world * rows,) + orig_shape[1:])
 
 
 def torus_all_gather_shard(x_shard, axes, *, interpret=False,
@@ -194,10 +201,10 @@ def torus_all_gather_shard(x_shard, axes, *, interpret=False,
     ``lax.all_gather`` over the joint axes and ``hier_all_gather_shard``
     produce.
 
-    2 axes → the fused four-path kernel (all four ICI link directions busy
-    every phase).  3 axes → the fused 2D plane over ``axes[1:]`` then a
-    bidirectional ring on ``axes[0]`` (the dominant, plane-fold heavier
-    phase; see module docstring).
+    2 axes → the fused four-path kernel; 3 axes → the fused SIX-path
+    kernel (x→y→z / y→z→x / z→x→y cyclic orders, each bidirectional): all
+    2n ICI link directions busy in every phase.  Size-1 axes are dropped;
+    a single real axis falls back to the 1-axis ring dispatch.
     """
     from triton_dist_tpu.kernels.allgather import (
         AllGatherMethod,
@@ -205,34 +212,23 @@ def torus_all_gather_shard(x_shard, axes, *, interpret=False,
     )
 
     axes = tuple(axes)
-    if len(axes) == 1:
-        return all_gather_shard(x_shard, axes[0],
+    if len(axes) > 3:
+        raise ValueError(f"torus_all_gather_shard supports 1-3 axes, "
+                         f"got {axes}")
+    sizes = {a: jax.lax.axis_size(a) for a in axes}
+    # Gathering over a size-1 axis is the identity: drop degenerate axes
+    # (the flat axes-major output order is unaffected).
+    real = tuple(a for a in axes if sizes[a] > 1)
+    if not real:
+        return x_shard
+    if len(real) == 1:
+        return all_gather_shard(x_shard, real[0],
                                 method=AllGatherMethod.AUTO,
                                 interpret=interpret,
                                 collective_id=collective_id)
-    if len(axes) == 3:
-        a0 = axes[0]
-        plane = torus_all_gather_shard(x_shard, axes[1:],
-                                       interpret=interpret,
-                                       collective_id=collective_id)
-        return all_gather_shard(plane, a0, method=AllGatherMethod.AUTO,
-                                interpret=interpret,
-                                collective_id=cid.TORUS_AG_THIRD)
-    if len(axes) != 2:
-        raise ValueError(f"torus_all_gather_shard supports 1-3 axes, "
-                         f"got {axes}")
-    ax, ay = axes
-    wx = jax.lax.axis_size(ax)
-    wy = jax.lax.axis_size(ay)
-    if wx * wy == 1:
-        return x_shard
-    if wx == 1 or wy == 1:  # degenerate torus: one real axis
-        axis = ax if wx > 1 else ay
-        return all_gather_shard(x_shard, axis, method=AllGatherMethod.AUTO,
-                                interpret=interpret,
-                                collective_id=collective_id)
-    return _torus2d_ag(x_shard, ax=ax, ay=ay, wx=wx, wy=wy,
-                       interpret=interpret, collective_id=collective_id)
+    return _torus_ag(x_shard, axis_names=real,
+                     sizes=tuple(sizes[a] for a in real),
+                     interpret=interpret, collective_id=collective_id)
 
 
 # ---------------------------------------------------------------------------
@@ -240,18 +236,22 @@ def torus_all_gather_shard(x_shard, axes, *, interpret=False,
 # ---------------------------------------------------------------------------
 
 
-def _fold_tiles(dst, a_src, b_src, va, vb, copy_sem, *, cols, tile_c):
+def _fold_tiles(dst, a_src, b_src, va, vb, load_sem, store_sem, *, cols,
+                tile_c):
     """dst <- a_src + b_src, streamed through VMEM in column tiles.
 
     All three operands are HBM(ANY) refs of identical shape [..., cols];
     ``va``/``vb`` are VMEM tiles with a leading DOUBLE-BUFFER dim [2] and
     ``tile_c`` columns.  Staging through VMEM keeps the kernel's
-    scoped-VMEM need at four half-size tiles regardless of the
-    line-buffer size — the all-VMEM round-2 layout needed ~3x the full
-    per-path line and failed to compile above ~16 MiB (ADVICE r2
-    medium).  Tiles are software-pipelined on parity: tile t+1's loads
-    are issued before tile t's store is waited, so HBM loads overlap the
-    VPU add + store instead of serializing the whole round trip.
+    scoped-VMEM need at four tiles regardless of the line-buffer size —
+    the all-VMEM round-2 layout needed ~3x the full per-path line and
+    failed to compile above ~16 MiB (ADVICE r2 medium).  Tiles are
+    software-pipelined on parity: tile t+1's loads are issued before tile
+    t's store is waited, so HBM loads overlap the VPU add + store instead
+    of serializing the whole round trip.  Loads and stores use SEPARATE
+    semaphores: they move identical byte counts, so on one shared
+    semaphore a load's wait could be satisfied by the concurrent store's
+    completion while the load is still in flight (stale-tile reads).
     ``b_src=None`` is a plain tiled copy."""
     tiles = [(c0, min(tile_c, cols - c0)) for c0 in range(0, cols, tile_c)]
     n = len(tiles)
@@ -260,13 +260,13 @@ def _fold_tiles(dst, a_src, b_src, va, vb, copy_sem, *, cols, tile_c):
         c0, cw = tiles[t]
         s = t % 2
         cpa = pltpu.make_async_copy(a_src.at[..., pl.ds(c0, cw)],
-                                    va.at[s].at[..., pl.ds(0, cw)], copy_sem)
+                                    va.at[s].at[..., pl.ds(0, cw)], load_sem)
         cpa.start()
         cpb = None
         if b_src is not None:
             cpb = pltpu.make_async_copy(b_src.at[..., pl.ds(c0, cw)],
                                         vb.at[s].at[..., pl.ds(0, cw)],
-                                        copy_sem)
+                                        load_sem)
             cpb.start()
         return cpa, cpb
 
@@ -287,7 +287,7 @@ def _fold_tiles(dst, a_src, b_src, va, vb, copy_sem, *, cols, tile_c):
                 stores[(t + 1) % 2] = None
             pend = start_loads(t + 1)
         cpo = pltpu.make_async_copy(va.at[s].at[..., pl.ds(0, cw)],
-                                    dst.at[..., pl.ds(c0, cw)], copy_sem)
+                                    dst.at[..., pl.ds(c0, cw)], store_sem)
         cpo.start()
         stores[s] = cpo
     for cp in stores:
@@ -295,284 +295,246 @@ def _fold_tiles(dst, a_src, b_src, va, vb, copy_sem, *, cols, tile_c):
             cp.wait()
 
 
-def _torus2d_rs_kernel(x_hbm, out_ref, line_acc, line_recv, slot_acc,
-                       slot_recv, work_buf, va, vb, send_sem, recv_sem,
-                       credit_sem, copy_sem, *, ax, ay, wx, wy, halves,
-                       tile_c):
-    # line_acc..work_buf are ANY-space OUTPUTS used as HBM scratch (the
-    # interpreter's DMA model requires one side of a local copy to be an
-    # input or output buffer; true ANY scratch would trip it).
-    """Fused 2D torus ReduceScatter, four concurrent paths on row-quarters.
+def _torus_rs_kernel(x_hbm, out_ref, *bufs_and_sems, axis_names, sizes,
+                     paths, tile_c):
+    """Fused 2D/3D torus ReduceScatter, 2n concurrent paths on row parts.
 
-    Input ``x_hbm`` [wx, wy, R, C]: this device's partial for every slot.
-    Output ``out_ref`` [R, C]: my slot (i, j), summed over all wx*wy
-    devices.  ``halves``: the path tuples (row_offset, row_len,
-    first_axis, direction) — four quarters with the same flavor set as
-    the AG kernel (x→y ±, y→x ±), so ALL FOUR link directions reduce
-    concurrently in both phases.  The paths' steps are interleaved in ONE
-    loop per phase (start every path's remote DMA, then wait them all) —
-    that concurrency is the point of the fused kernel.
+    Input ``x_hbm`` [*sizes, R, C]: this device's partial for every slot.
+    Output ``out_ref`` [R, C]: my slot, summed over all devices.
+    ``paths``: the (row_offset, row_len, cyclic axis order, direction)
+    tuples — the same flavor set as the AG kernel, so ALL 2n link
+    directions reduce concurrently in every phase.  The paths' steps are
+    interleaved in ONE loop per phase (start every path's remote DMA,
+    then wait them all) — that concurrency is the point of the fused
+    kernel.
 
-    Memory layout (round 3): every line/slot buffer lives in HBM(ANY);
-    VMEM holds only two [lmax, ln_max, tile_c] fold tiles (_fold_tiles),
-    so the kernel compiles at arbitrarily large partials — the round-2
-    all-VMEM layout blew the ~16 MiB Mosaic scoped-VMEM limit at its own
-    documented target shapes (ADVICE r2 medium).  Remote DMAs move
-    HBM→HBM, exactly like the a2a kernel's segments.
+    Phase ``l`` ring-reduces, along axis ``order[l]``, the groups of
+    slots spanning the not-yet-reduced axes ``order[l+1:]``: a 3D x→y→z
+    path rings (y, z)-plane groups on x±, then z-line groups on y±, then
+    single slots on z±; after phase ``l`` the device holds its
+    ``order[:l+1]``-coordinates' group summed over the reduced sub-torus.
+    Flow control mirrors the 1-D ring RS: a credit semaphore per
+    (path, phase) stops a sender overwriting a landing buffer the
+    receiver has not folded yet.
 
-    Phase-1 ring item for path A = the x-line group {slots (i, j'') for all
-    j''} = [wy, ln, C]; after wx-1 steps device (i, j) holds line (i, *)
-    summed over its ax-ring (devices (i', j)).  Phase 2 rings the [ln, C]
-    slots of that line along ay, finishing the global sum.  Path B mirrors
-    with axes swapped.  Flow control mirrors the 1-D ring RS: a credit
-    semaphore per (path, phase) stops a sender overwriting a landing buffer
-    the receiver has not folded yet.
+    Memory layout (round 3): per-level acc/recv buffers and the load
+    staging buffer live in HBM — they are ANY-space OUTPUTS, because the
+    interpreter's DMA model requires one side of a local copy to be an
+    input or output buffer — with full-rank group dims (consumed axes
+    kept at extent 1, ``pl.ds`` slicing).  VMEM holds only the
+    double-buffered fold tiles (_fold_tiles), so the kernel compiles at
+    arbitrarily large partials — the round-2 all-VMEM layout blew the
+    ~16 MiB Mosaic scoped-VMEM limit at its own documented target shapes
+    (ADVICE r2 medium).  Remote DMAs move HBM→HBM, exactly like the a2a
+    kernel's segments.
     """
-    i = jax.lax.axis_index(ax)
-    j = jax.lax.axis_index(ay)
+    n = len(axis_names)
+    # bufs: acc[0..n-1], rcv[0..n-1], work; scratch: va, vb, send, recv,
+    # credit, copy.
+    accs = bufs_and_sems[:n]
+    rcvs = bufs_and_sems[n:2 * n]
+    work = bufs_and_sems[2 * n]
+    (va, vb, send_sem, recv_sem, credit_sem, copy_sem,
+     store_sem) = bufs_and_sems[2 * n + 1:]
+    lbls = _LBL[:n]
+    coords = {l: jax.lax.axis_index(a) for l, a in zip(lbls, axis_names)}
+    size = dict(zip(lbls, sizes))
+    mesh_ax = dict(zip(lbls, axis_names))
     cols = x_hbm.shape[-1]
 
-    dl.barrier_all(ax)
-    dl.barrier_all(ay)
+    for a in axis_names:
+        dl.barrier_all(a)
 
-    def coords(first):
-        """(my ring coord, ring size, ring axis) for phase 1 and phase 2,
-        plus the LINE length (number of slots the phase-1 item holds)."""
-        if first == "x":
-            return (i, wx, ax), (j, wy, ay), wy
-        return (j, wy, ay), (i, wx, ax), wx
+    def group_sel(order, l, ring_idx_ds):
+        """Index tuple over the n group dims for the phase-l item whose
+        ring index slice is ``ring_idx_ds``: consumed axes pinned to
+        extent 1, pending axes full extent."""
+        r = order[l]
+        sel = []
+        for lbl in lbls:
+            if lbl == r:
+                sel.append(ring_idx_ds)
+            elif lbl in order[:l]:
+                sel.append(pl.ds(0, 1))
+            else:
+                sel.append(pl.ds(0, size[lbl]))
+        return tuple(sel)
 
-    def load_line(first, off, ln, idx, dst):
-        """dst <- my partial for line group ``idx``: x-path lines are
-        x_hbm[idx, :, off:off+ln] ([wy, ln, C]); y-path x_hbm[:, idx, ...]
-        ([wx, ln, C]).  Scalar indexing squeezes the ring dim."""
-        if first == "x":
-            src = x_hbm.at[idx, :, pl.ds(off, ln)]
-        else:
-            src = x_hbm.at[:, idx, pl.ds(off, ln)]
-        cp = pltpu.make_async_copy(src, dst, copy_sem)
+    def src_ref(q, order, off, ln, l, idx):
+        """The phase-l input group at ring index ``idx``: the raw input
+        for l=0, else the previous level's accumulator."""
+        if l == 0:
+            sel = tuple(pl.ds(idx, 1) if lbl == order[0]
+                        else slice(None) for lbl in lbls)
+            return x_hbm.at[sel + (pl.ds(off, ln),)]
+        return accs[l - 1].at[(q,) + group_sel(order, l, pl.ds(idx, 1))
+                              + (pl.ds(0, ln),)]
+
+    def acc_sel(q, order, l, ln):
+        return (q,) + group_sel(order, l, pl.ds(0, 1)) + (pl.ds(0, ln),)
+
+    def va_sel(order, l, ln):
+        return (slice(None),) + group_sel(order, l, pl.ds(0, 1)) \
+            + (pl.ds(0, ln),)
+
+    def run_phase(l):
+        active = [(q, pa) for q, pa in enumerate(paths) if pa[1] > 0]
+        if not active:
+            return
+        n_max = max(size[pa[2][l]] for _, pa in active) - 1
+
+        def step(s, _):
+            for q, (off, ln, order, d) in active:
+                r = order[l]
+                w = size[r]
+                my = coords[r]
+                peer = jax.lax.rem(my + d + w, w)
+                prev = jax.lax.rem(my - d + w, w)
+
+                @pl.when(s < w - 1)
+                def _(q=q, off=off, ln=ln, order=order, d=d, r=r, w=w,
+                      my=my, peer=peer, prev=prev):
+                    # Outgoing group at step s: (my - d*(1+s)) mod w.
+                    idx = jax.lax.rem(my - d * (1 + s) + (1 + s) * w + w, w)
+                    wsel = (q,) + group_sel(order, l, pl.ds(0, 1)) \
+                        + (pl.ds(0, ln),)
+                    ld = pltpu.make_async_copy(
+                        src_ref(q, order, off, ln, l, idx), work.at[wsel],
+                        copy_sem)
+                    ld.start()
+                    ld.wait()
+
+                    @pl.when(s == 0)
+                    def _():
+                        _fold_tiles(accs[l].at[acc_sel(q, order, l, ln)],
+                                    work.at[wsel], None,
+                                    va.at[va_sel(order, l, ln)],
+                                    vb.at[va_sel(order, l, ln)],
+                                    copy_sem, store_sem, cols=cols, tile_c=tile_c)
+
+                    @pl.when(s > 0)
+                    def _():
+                        _fold_tiles(accs[l].at[acc_sel(q, order, l, ln)],
+                                    work.at[wsel],
+                                    rcvs[l].at[acc_sel(q, order, l, ln)],
+                                    va.at[va_sel(order, l, ln)],
+                                    vb.at[va_sel(order, l, ln)],
+                                    copy_sem, store_sem, cols=cols, tile_c=tile_c)
+                        # recv consumed → upstream sender gets its credit.
+                        pltpu.semaphore_signal(
+                            credit_sem.at[q, l], inc=1, device_id={
+                                mesh_ax[r]: prev},
+                            device_id_type=pltpu.DeviceIdType.MESH)
+
+                    @pl.when(s > 0)
+                    def _():
+                        pltpu.semaphore_wait(credit_sem.at[q, l], 1)
+
+                    dl.remote_copy(accs[l].at[acc_sel(q, order, l, ln)],
+                                   rcvs[l].at[acc_sel(q, order, l, ln)],
+                                   send_sem.at[q, l], recv_sem.at[q, l],
+                                   mesh_ax[r], peer).start()
+            for q, (off, ln, order, d) in active:
+                w = size[order[l]]
+
+                @pl.when(s < w - 1)
+                def _(q=q, ln=ln, order=order):
+                    blk = accs[l].at[acc_sel(q, order, l, ln)]
+                    pltpu.make_async_copy(blk, blk,
+                                          send_sem.at[q, l]).wait()
+                    pltpu.make_async_copy(blk, blk,
+                                          recv_sem.at[q, l]).wait()
+            return 0
+
+        if n_max > 0:
+            jax.lax.fori_loop(0, n_max, step, 0)
+
+        # Final fold: the last arrival is the partial for MY group.
+        for q, (off, ln, order, d) in active:
+            r = order[l]
+            my = coords[r]
+            wsel = (q,) + group_sel(order, l, pl.ds(0, 1)) + (pl.ds(0, ln),)
+            ld = pltpu.make_async_copy(src_ref(q, order, off, ln, l, my),
+                                       work.at[wsel], copy_sem)
+            ld.start()
+            ld.wait()
+            _fold_tiles(accs[l].at[acc_sel(q, order, l, ln)],
+                        work.at[wsel], rcvs[l].at[acc_sel(q, order, l, ln)],
+                        va.at[va_sel(order, l, ln)],
+                        vb.at[va_sel(order, l, ln)],
+                        copy_sem, store_sem, cols=cols, tile_c=tile_c)
+
+    for l in range(n):
+        run_phase(l)
+
+    # My band: the last level's accumulator, squeezed of its unit dims.
+    for q, (off, ln, order, d) in enumerate(paths):
+        if ln == 0:
+            continue
+        src = accs[n - 1].at[(q,) + (0,) * n + (pl.ds(0, ln),)]
+        cp = pltpu.make_async_copy(src, out_ref.at[pl.ds(off, ln)],
+                                   copy_sem)
         cp.start()
         cp.wait()
 
-    # ------------------------------------------------------------------
-    # Phase 1: ring-RS of first-axis line groups, paths interleaved.
-    # ------------------------------------------------------------------
-    n1 = max(wx, wy) - 1
 
-    def step1(s, _):
-        for p, (off, ln, first, d) in enumerate(halves):
-            if ln == 0:
-                continue
-            (my1, w1, a1), _, nline = coords(first)
-            peer = jax.lax.rem(my1 + d + w1, w1)
-            prev = jax.lax.rem(my1 - d + w1, w1)
-
-            @pl.when(s < w1 - 1)
-            def _(p=p, off=off, ln=ln, first=first, d=d, my1=my1, w1=w1,
-                  a1=a1, nline=nline, peer=peer, prev=prev):
-                # Outgoing line group at step s: (my1 - d*(1+s)) mod w1.
-                idx = jax.lax.rem(my1 - d * (1 + s) + (1 + s) * w1 + w1, w1)
-                load_line(first, off, ln, idx,
-                          work_buf.at[p, pl.ds(0, nline), pl.ds(0, ln)])
-
-                @pl.when(s == 0)
-                def _():
-                    _fold_tiles(line_acc.at[p, pl.ds(0, nline), pl.ds(0, ln)],
-                                work_buf.at[p, pl.ds(0, nline), pl.ds(0, ln)],
-                                None,
-                                va.at[:, pl.ds(0, nline), pl.ds(0, ln)],
-                                vb.at[:, pl.ds(0, nline), pl.ds(0, ln)],
-                                copy_sem, cols=cols, tile_c=tile_c)
-
-                @pl.when(s > 0)
-                def _():
-                    _fold_tiles(line_acc.at[p, pl.ds(0, nline), pl.ds(0, ln)],
-                                work_buf.at[p, pl.ds(0, nline), pl.ds(0, ln)],
-                                line_recv.at[p, pl.ds(0, nline), pl.ds(0, ln)],
-                                va.at[:, pl.ds(0, nline), pl.ds(0, ln)],
-                                vb.at[:, pl.ds(0, nline), pl.ds(0, ln)],
-                                copy_sem, cols=cols, tile_c=tile_c)
-                    # recv consumed → give the upstream sender its credit.
-                    pltpu.semaphore_signal(
-                        credit_sem.at[p, 0], inc=1, device_id={a1: prev},
-                        device_id_type=pltpu.DeviceIdType.MESH)
-
-                @pl.when(s > 0)
-                def _():
-                    pltpu.semaphore_wait(credit_sem.at[p, 0], 1)
-
-                dl.remote_copy(line_acc.at[p, pl.ds(0, nline), pl.ds(0, ln)],
-                               line_recv.at[p, pl.ds(0, nline), pl.ds(0, ln)],
-                               send_sem.at[p, 0], recv_sem.at[p, 0],
-                               a1, peer).start()
-        for p, (off, ln, first, d) in enumerate(halves):
-            if ln == 0:
-                continue
-            (my1, w1, a1), _, nline = coords(first)
-
-            @pl.when(s < w1 - 1)
-            def _(p=p, ln=ln, nline=nline):
-                blk = line_acc.at[p, pl.ds(0, nline), pl.ds(0, ln)]
-                pltpu.make_async_copy(blk, blk, send_sem.at[p, 0]).wait()
-                pltpu.make_async_copy(blk, blk, recv_sem.at[p, 0]).wait()
-        return 0
-
-    jax.lax.fori_loop(0, n1, step1, 0)
-
-    # Final phase-1 fold: the last arrival is the partial for MY line.
-    for p, (off, ln, first, d) in enumerate(halves):
-        if ln == 0:
-            continue
-        (my1, w1, a1), _, nline = coords(first)
-        load_line(first, off, ln, my1,
-                  work_buf.at[p, pl.ds(0, nline), pl.ds(0, ln)])
-        _fold_tiles(line_acc.at[p, pl.ds(0, nline), pl.ds(0, ln)],
-                    work_buf.at[p, pl.ds(0, nline), pl.ds(0, ln)],
-                    line_recv.at[p, pl.ds(0, nline), pl.ds(0, ln)],
-                    va.at[:, pl.ds(0, nline), pl.ds(0, ln)],
-                    vb.at[:, pl.ds(0, nline), pl.ds(0, ln)],
-                    copy_sem, cols=cols, tile_c=tile_c)
-
-    # ------------------------------------------------------------------
-    # Phase 2: ring-RS of the slots within my reduced line, interleaved.
-    # Slot index within the line = my second-axis ring coordinate.
-    # ------------------------------------------------------------------
-    def step2(t, _):
-        for p, (off, ln, first, d) in enumerate(halves):
-            if ln == 0:
-                continue
-            _, (my2, w2, a2), _ = coords(first)
-            peer = jax.lax.rem(my2 + d + w2, w2)
-            prev = jax.lax.rem(my2 - d + w2, w2)
-
-            @pl.when(t < w2 - 1)
-            def _(p=p, ln=ln, my2=my2, w2=w2, a2=a2, d=d, peer=peer,
-                  prev=prev):
-                idx = jax.lax.rem(my2 - d * (1 + t) + (1 + t) * w2 + w2, w2)
-
-                @pl.when(t == 0)
-                def _():
-                    _fold_tiles(slot_acc.at[p, :, pl.ds(0, ln)],
-                                line_acc.at[p, pl.ds(idx, 1), pl.ds(0, ln)],
-                                None,
-                                va.at[:, pl.ds(0, 1), pl.ds(0, ln)],
-                                vb.at[:, pl.ds(0, 1), pl.ds(0, ln)],
-                                copy_sem, cols=cols, tile_c=tile_c)
-
-                @pl.when(t > 0)
-                def _():
-                    _fold_tiles(slot_acc.at[p, :, pl.ds(0, ln)],
-                                line_acc.at[p, pl.ds(idx, 1), pl.ds(0, ln)],
-                                slot_recv.at[p, :, pl.ds(0, ln)],
-                                va.at[:, pl.ds(0, 1), pl.ds(0, ln)],
-                                vb.at[:, pl.ds(0, 1), pl.ds(0, ln)],
-                                copy_sem, cols=cols, tile_c=tile_c)
-                    pltpu.semaphore_signal(
-                        credit_sem.at[p, 1], inc=1, device_id={a2: prev},
-                        device_id_type=pltpu.DeviceIdType.MESH)
-
-                @pl.when(t > 0)
-                def _():
-                    pltpu.semaphore_wait(credit_sem.at[p, 1], 1)
-
-                dl.remote_copy(slot_acc.at[p, :, pl.ds(0, ln)],
-                               slot_recv.at[p, :, pl.ds(0, ln)],
-                               send_sem.at[p, 1], recv_sem.at[p, 1],
-                               a2, peer).start()
-        for p, (off, ln, first, d) in enumerate(halves):
-            if ln == 0:
-                continue
-            _, (my2, w2, a2), _ = coords(first)
-
-            @pl.when(t < w2 - 1)
-            def _(p=p, ln=ln):
-                blk = slot_acc.at[p, :, pl.ds(0, ln)]
-                pltpu.make_async_copy(blk, blk, send_sem.at[p, 1]).wait()
-                pltpu.make_async_copy(blk, blk, recv_sem.at[p, 1]).wait()
-        return 0
-
-    jax.lax.fori_loop(0, max(wx, wy) - 1, step2, 0)
-
-    for p, (off, ln, first, d) in enumerate(halves):
-        if ln == 0:
-            continue
-        _, (my2, w2, a2), _ = coords(first)
-        _fold_tiles(out_ref.at[pl.ds(off, ln)],
-                    line_acc.at[p, pl.ds(my2, 1), pl.ds(0, ln)].at[0],
-                    slot_recv.at[p, :, pl.ds(0, ln)].at[0],
-                    va.at[:, 0, pl.ds(0, ln)], vb.at[:, 0, pl.ds(0, ln)],
-                    copy_sem, cols=cols, tile_c=tile_c)
-
-
-def _split_rs_quarters(rows: int):
-    """Four (offset, len, first_axis, direction) paths for the fused RS —
-    the same flavor set as the AG quarters: x→y and y→x orders, each
-    bidirectional, so all four link directions reduce concurrently."""
-    return tuple(
-        (off, ln, first, d)
-        for (off, ln), (first, d) in zip(_split_quarters(rows),
-                                         _QUARTER_FLAVORS))
-
-
-def _torus2d_rs(x_shard, *, ax, ay, wx, wy, interpret, collective_id):
-    wxy = wx * wy
-    assert x_shard.shape[0] % wxy == 0, (x_shard.shape, wx, wy)
-    rows = x_shard.shape[0] // wxy
+def _torus_rs(x_shard, *, axis_names, sizes, interpret, collective_id):
+    n = len(axis_names)
+    world = 1
+    for w in sizes:
+        world *= w
+    assert x_shard.shape[0] % world == 0, (x_shard.shape, sizes)
+    rows = x_shard.shape[0] // world
     orig_trailing = x_shard.shape[1:]
-    x4 = x_shard.reshape(wx, wy, rows, -1)
-    cols = x4.shape[-1]
-    halves = _split_rs_quarters(rows)
-    n_paths = len(halves)
-    lmax = max(wx, wy)
-    ln_max = max(ln for _, ln, _, _ in halves)
-    itemsize = jnp.dtype(x4.dtype).itemsize
-    # VMEM = two fold tiles [lmax, ln_max, tile_c]; size tile_c to the
-    # budget (line buffers themselves live in HBM — see kernel docstring).
+    xnd = x_shard.reshape(tuple(sizes) + (rows, -1))
+    cols = xnd.shape[-1]
+    paths = _paths_for(rows, n)
+    ln_max = max((ln for _, ln, _, _ in paths), default=0)
+    itemsize = jnp.dtype(xnd.dtype).itemsize
+    # VMEM = four fold tiles whose group dims span the whole slot grid
+    # (consumed dims are ds(0,1)-sliced); size tile_c to the budget.
     budget = 10 * 2 ** 20
-    tile_c = max(budget // max(4 * lmax * ln_max * itemsize, 1), 1)
+    cells = world
+    tile_c = max(budget // max(4 * cells * ln_max * itemsize, 1), 1)
     tile_c = min(cols, max(128 * (tile_c // 128), min(cols, 128)))
-    if 4 * lmax * ln_max * tile_c * itemsize > 2 * budget:
+    if 4 * cells * ln_max * tile_c * itemsize > 2 * budget:
         # Even one 128-column tile over budget (enormous rows): compose
         # the per-axis ring RS kernels sequentially — correct at any
-        # shape, loses the four-path fusion.
+        # shape, loses the 2n-path fusion.
         from triton_dist_tpu.kernels.reduce_scatter import (
             ReduceScatterMethod,
             reduce_scatter_shard,
         )
 
-        x = reduce_scatter_shard(x_shard, ax,
-                                 method=ReduceScatterMethod.AUTO,
-                                 interpret=interpret,
-                                 collective_id=collective_id)
-        # Distinct reserved id: the 3-axis path already used
-        # TORUS_RS_THIRD for its first leg in this same program.
-        return reduce_scatter_shard(x, ay,
-                                    method=ReduceScatterMethod.AUTO,
-                                    interpret=interpret,
-                                    collective_id=cid.TORUS_RS_FALLBACK)
-    line_shape = jax.ShapeDtypeStruct((n_paths, lmax, ln_max, cols),
-                                      x4.dtype)
-    slot_shape = jax.ShapeDtypeStruct((n_paths, 1, ln_max, cols), x4.dtype)
+        fallback_ids = (collective_id, cid.TORUS_RS_THIRD,
+                        cid.TORUS_RS_FALLBACK)
+        x = x_shard
+        for a, fid in zip(axis_names, fallback_ids):
+            x = reduce_scatter_shard(x, a, method=ReduceScatterMethod.AUTO,
+                                     interpret=interpret, collective_id=fid)
+        return x
+    npaths = 2 * n
+    buf_shape = jax.ShapeDtypeStruct(
+        (npaths,) + tuple(sizes) + (ln_max, cols), xnd.dtype)
     out, *_hbm_scratch = pl.pallas_call(
-        functools.partial(_torus2d_rs_kernel, ax=ax, ay=ay, wx=wx, wy=wy,
-                          halves=halves, tile_c=tile_c),
-        out_shape=[jax.ShapeDtypeStruct((rows, cols), x4.dtype),
-                   line_shape, line_shape,     # line_acc / line_recv
-                   slot_shape, slot_shape,     # slot_acc / slot_recv
-                   line_shape],                # work_buf
+        functools.partial(_torus_rs_kernel, axis_names=axis_names,
+                          sizes=sizes, paths=paths, tile_c=tile_c),
+        out_shape=[jax.ShapeDtypeStruct((rows, cols), xnd.dtype)]
+        + [buf_shape] * (2 * n + 1),  # acc[l], rcv[l], work
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 6,
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (2 * n + 2),
         scratch_shapes=[
-            pltpu.VMEM((2, lmax, ln_max, tile_c), x4.dtype),     # fold tiles a
-            pltpu.VMEM((2, lmax, ln_max, tile_c), x4.dtype),     # fold tiles b
-            pltpu.SemaphoreType.DMA((n_paths, 2)),          # send per path
-            pltpu.SemaphoreType.DMA((n_paths, 2)),          # recv per path
-            pltpu.SemaphoreType.REGULAR((n_paths, 2)),      # credits
-            pltpu.SemaphoreType.DMA,                        # copy
+            pltpu.VMEM((2,) + tuple(sizes) + (ln_max, tile_c), xnd.dtype),
+            pltpu.VMEM((2,) + tuple(sizes) + (ln_max, tile_c), xnd.dtype),
+            pltpu.SemaphoreType.DMA((npaths, n)),       # send per path
+            pltpu.SemaphoreType.DMA((npaths, n)),       # recv per path
+            pltpu.SemaphoreType.REGULAR((npaths, n)),   # credits
+            pltpu.SemaphoreType.DMA,                    # copy/loads
+            pltpu.SemaphoreType.DMA,                    # fold stores
         ],
-        compiler_params=dl.collective_compiler_params(wxy, collective_id),
+        compiler_params=dl.collective_compiler_params(world, collective_id),
         interpret=maybe_interpret(interpret),
-    )(x4)
+    )(xnd)
     return out.reshape((rows,) + orig_trailing)
 
 
@@ -585,10 +547,11 @@ def torus_reduce_scatter_shard(x_shard, axes, *, interpret=False,
     Output: this device's fully-summed [rows, ...] band — matching
     ``lax.psum_scatter(tiled=True)`` over the joint axes.
 
-    2 axes → the fused four-quarter kernel (x→y and y→x reduction
-    orders, each bidirectional: all four link directions busy).  3 axes →
-    the bidirectional ring RS on ``axes[0]`` first (reductions SHRINK
-    data: do the plane-fold heavier axis first), then the fused 2D plane.
+    2 axes → the fused four-path kernel; 3 axes → the fused SIX-path
+    kernel (cyclic reduction orders x→y→z / y→z→x / z→x→y, each
+    bidirectional: all 2n link directions reduce concurrently in every
+    phase).  Size-1 axes are dropped; a single real axis falls back to
+    the 1-axis ring dispatch.
     """
     from triton_dist_tpu.kernels.reduce_scatter import (
         ReduceScatterMethod,
@@ -596,31 +559,18 @@ def torus_reduce_scatter_shard(x_shard, axes, *, interpret=False,
     )
 
     axes = tuple(axes)
-    if len(axes) == 1:
-        return reduce_scatter_shard(x_shard, axes[0],
-                                    method=ReduceScatterMethod.AUTO,
-                                    interpret=interpret,
-                                    collective_id=collective_id)
-    if len(axes) == 3:
-        x = reduce_scatter_shard(x_shard, axes[0],
-                                 method=ReduceScatterMethod.AUTO,
-                                 interpret=interpret,
-                                 collective_id=cid.TORUS_RS_THIRD)
-        return torus_reduce_scatter_shard(x, axes[1:], interpret=interpret,
-                                          collective_id=collective_id)
-    if len(axes) != 2:
+    if len(axes) > 3:
         raise ValueError(f"torus_reduce_scatter_shard supports 1-3 axes, "
                          f"got {axes}")
-    ax, ay = axes
-    wx = jax.lax.axis_size(ax)
-    wy = jax.lax.axis_size(ay)
-    if wx * wy == 1:
+    sizes = {a: jax.lax.axis_size(a) for a in axes}
+    real = tuple(a for a in axes if sizes[a] > 1)
+    if not real:
         return x_shard
-    if wx == 1 or wy == 1:
-        axis = ax if wx > 1 else ay
-        return reduce_scatter_shard(x_shard, axis,
+    if len(real) == 1:
+        return reduce_scatter_shard(x_shard, real[0],
                                     method=ReduceScatterMethod.AUTO,
                                     interpret=interpret,
                                     collective_id=collective_id)
-    return _torus2d_rs(x_shard, ax=ax, ay=ay, wx=wx, wy=wy,
-                       interpret=interpret, collective_id=collective_id)
+    return _torus_rs(x_shard, axis_names=real,
+                     sizes=tuple(sizes[a] for a in real),
+                     interpret=interpret, collective_id=collective_id)
