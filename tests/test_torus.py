@@ -411,3 +411,24 @@ def test_torus3d_ag_gemm(mesh2x2x2, key):
     ref = (np.asarray(a, np.float32) @ np.asarray(b, np.float32))
     np.testing.assert_allclose(np.asarray(c, np.float32), ref,
                                rtol=5e-2, atol=5e-1)
+
+
+def test_torus_gemm_rs_fused_small(key):
+    """Fast-gate coverage of the fused four-path GEMM-RS kernel itself
+    (k_loc = 128 so the kernel path runs; the 2x4/4x2 variants are
+    slow-marked)."""
+    from triton_dist_tpu.kernels.gemm_reduce_scatter import (
+        GEMMReduceScatterContext,
+        gemm_rs,
+    )
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("x", "y"))
+    M, K, N = 32, 512, 512  # k_loc = 512/4 = 128: the fused kernel runs
+    ks = jax.random.split(key, 2)
+    a = jax.random.normal(ks[0], (M, K), jnp.float32)
+    b = jax.random.normal(ks[1], (K, N), jnp.float32) / np.sqrt(K)
+    ctx = GEMMReduceScatterContext(mesh=mesh, axis=("x", "y"),
+                                   impl="pallas", interpret=True)
+    c = gemm_rs(a, b, ctx)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(a) @ np.asarray(b),
+                               rtol=2e-3, atol=2e-3)

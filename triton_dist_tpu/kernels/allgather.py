@@ -57,16 +57,25 @@ def choose_allgather_method(nbytes_per_rank: int, n_ranks: int,
     """Topology/size-based auto-selection (reference: allgather.py:54-69,
     which picks among six fabric-tuned variants by node topology).
 
-    Dispatch here is on mesh shape + payload: a gather spanning >= 2
-    non-trivial torus axes routes to the fused torus schedule (all link
-    directions of the plane busy, ~2x a single bidir ring); on one axis,
-    small messages are latency-bound → one-hop full-mesh push, large
-    messages bandwidth-bound → bidirectional ring.
+    Dispatch here is on mesh shape + payload: a bandwidth-bound gather
+    spanning >= 2 non-trivial torus axes routes to the fused torus
+    schedule (all link directions of the plane busy, ~2x a single bidir
+    ring), while a latency-bound (<= 64 KiB) multi-axis gather takes
+    XLA's fused joint gather; on one axis, small messages are
+    latency-bound → one-hop full-mesh push, large messages
+    bandwidth-bound → bidirectional ring.
     """
     if axis_sizes is not None:
         real = [s for s in axis_sizes if s > 1]
-        if len(real) >= 2 and nbytes_per_rank > 64 * 1024:
-            return AllGatherMethod.TORUS_2D
+        if len(real) >= 2:
+            if nbytes_per_rank > 64 * 1024:
+                return AllGatherMethod.TORUS_2D
+            # Latency-bound joint-axis gather: the per-axis pallas ring
+            # variants have no joint meaning and the torus schedule is a
+            # bandwidth design — XLA's fused joint gather wins here
+            # (ADVICE r2: FULL_MESH_PUSH was silently mapped to the
+            # bandwidth torus kernel by the multi-axis branch).
+            return AllGatherMethod.XLA
     if n_ranks <= 2:
         return AllGatherMethod.FULL_MESH_PUSH
     if nbytes_per_rank <= 256 * 1024:
