@@ -78,11 +78,16 @@ def _feedback(x, i):
     return churn_barrier(x, i, extra_key=s & 1)
 
 
-def _make_chain(mesh, n_iters):
+def _make_chain(mesh, n_iters, impl="auto", bm=None, bn=None, bk=None):
     """n_iters of (AG-GEMM -> matmul-back -> _feedback) with real value
-    dependence, returning a scalar so fetching it forces execution."""
-    shard_ag = functools.partial(ag_gemm_shard, axis="tp", impl="auto",
-                                 interpret=False)
+    dependence, returning a scalar so fetching it forces execution.
+
+    ``impl``/``bm``/``bn``/``bk`` parameterize the AG-GEMM so the on-chip
+    autotune session (scripts/autotune_onchip.py) reuses this exact
+    protocol with impl="pallas" and swept blocks — one chain
+    implementation, not two drifting copies."""
+    shard_ag = functools.partial(ag_gemm_shard, axis="tp", impl=impl,
+                                 bm=bm, bn=bn, bk=bk, interpret=False)
 
     def body_fn(a, b1, b2):
         def body(i, x):

@@ -2,6 +2,8 @@
 
 import time
 
+import jax
+
 import jax.numpy as jnp
 import pytest
 
@@ -241,3 +243,85 @@ def test_measure_hook_overrides_timing():
     assert f.best_config == {"bm": 256}
     assert {c["bm"] for c in calls} == {128, 256, 512}
     assert float(f(jnp.ones((4,)))[0]) == 256.0
+
+
+def test_contextual_tunes_overlapped_kernels_world8(mesh8, key):
+    """VERDICT r2 #5: the overlapped AG-GEMM and GEMM-RS sweep through
+    contextual_autotune at world>1 — every config call jits + executes
+    the whole collective program on the 8-device mesh, the sweeps run in
+    lockstep inside one region, winners are cached, and the returned
+    values are correct under the selected configs."""
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from triton_dist_tpu.kernels.allgather_gemm import (
+        AllGatherGEMMContext,
+        _ag_gemm_tunable,
+        ag_gemm_autotuned,
+    )
+    from triton_dist_tpu.kernels.gemm_reduce_scatter import (
+        GEMMReduceScatterContext,
+        _gemm_rs_tunable,
+        gemm_rs_autotuned,
+    )
+
+    # Shapes chosen so the PALLAS ring kernels actually run and the
+    # sweep's configs genuinely differ after block clamping: AG side
+    # n_loc = 128, K = 8192 (bk 512 vs 1024 distinct); RS side
+    # k_loc = 1024, N = 1024 (bn and bk distinct).  Smaller shapes
+    # silently route to the XLA fallback / clamp every config identical.
+    M, K, N = 512, 8192, 1024
+    ks = jax.random.split(key, 2)
+    a = jax.random.normal(ks[0], (M, K), jnp.float32)
+    b = jax.random.normal(ks[1], (K, N), jnp.float32) / np.sqrt(K)
+    ref = np.asarray(a) @ np.asarray(b)
+
+    a_ag = jax.device_put(a, NamedSharding(mesh8, P("tp", None)))
+    b_ag = jax.device_put(b, NamedSharding(mesh8, P(None, "tp")))
+    a_rs = jax.device_put(a, NamedSharding(mesh8, P(None, "tp")))
+    b_rs = jax.device_put(b, NamedSharding(mesh8, P("tp", None)))
+    ag_ctx = AllGatherGEMMContext(mesh=mesh8, axis="tp", impl="pallas",
+                                  interpret=True)
+    rs_ctx = GEMMReduceScatterContext(mesh=mesh8, axis="tp",
+                                      impl="pallas", interpret=True)
+
+    _ag_gemm_tunable.cache.clear()
+    _gemm_rs_tunable.cache.clear()
+
+    # Spy that the ring kernels trace (guards against a future shape
+    # change silently routing every config to the XLA fallback).
+    import triton_dist_tpu.kernels.allgather_gemm as agm
+    import triton_dist_tpu.kernels.gemm_reduce_scatter as grs
+    hits = {"ag": 0, "rs": 0}
+    real_ag, real_rs = agm._ag_gemm_kernel, grs._gemm_rs_kernel
+
+    def spy_ag(*a, **k):
+        hits["ag"] += 1
+        return real_ag(*a, **k)
+
+    def spy_rs(*a, **k):
+        hits["rs"] += 1
+        return real_rs(*a, **k)
+
+    agm._ag_gemm_kernel, grs._gemm_rs_kernel = spy_ag, spy_rs
+    try:
+        @contextual_autotune(n_repeat=1, n_warmup=1)
+        def op():
+            c1 = ag_gemm_autotuned(a_ag, b_ag, ag_ctx)
+            c2 = gemm_rs_autotuned(a_rs, b_rs, rs_ctx)
+            return c1, c2
+
+        c_ag, c_rs = op()
+    finally:
+        agm._ag_gemm_kernel, grs._gemm_rs_kernel = real_ag, real_rs
+    assert hits["ag"] > 0 and hits["rs"] > 0, hits
+    assert _ag_gemm_tunable.best_config is not None
+    assert _gemm_rs_tunable.best_config is not None
+    np.testing.assert_allclose(np.asarray(c_ag), ref, rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(c_rs), ref, rtol=2e-3, atol=2e-3)
+
+    # Cached path: immediate reuse, no re-sweep.
+    c_ag2 = ag_gemm_autotuned(a_ag, b_ag, ag_ctx)
+    np.testing.assert_allclose(np.asarray(c_ag2), ref, rtol=2e-3,
+                               atol=2e-3)
