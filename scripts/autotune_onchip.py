@@ -174,7 +174,7 @@ def tune_ring_ag_gemm(trials):
     (tests/test_autotuner.py::test_contextual_tunes_overlapped_kernels_world8);
     this session supplies the real-MXU timings."""
     import numpy as np
-    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.sharding import Mesh
 
 
     mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
@@ -242,6 +242,7 @@ def main():
         print("SESSION INVALID: the dense-matmul canary failed to "
               "re-derive its known winner; tunnel drift is swamping the "
               "sweep. Re-run in a quieter window.")
+        sys.exit(1)  # callers must not archive a drift-contaminated session
 
 
 if __name__ == "__main__":
