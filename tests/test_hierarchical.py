@@ -158,6 +158,16 @@ def test_hier_all_to_all_matches_flat(impl, mesh2d, key):
                               impl=impl, interpret=(impl == "pallas"))
     r_ref, s_ref = f_flat(x, splits)
     r_got, s_got = f_hier(x, splits)
-    np.testing.assert_allclose(np.asarray(r_got), np.asarray(r_ref),
-                               rtol=0, atol=0)
     np.testing.assert_array_equal(np.asarray(s_got), np.asarray(s_ref))
+    # Valid rows must match the flat reference exactly; the two-tier
+    # path's padding rows are defined ZERO (r3 compacting repack — the
+    # xla flat reference instead preserves send padding, so a full-buffer
+    # compare would test send garbage).
+    r_ref = np.asarray(r_ref)
+    r_got = np.asarray(r_got)
+    s_np = np.asarray(s_ref)
+    for b in range(world * world):
+        k = int(s_np[b])
+        np.testing.assert_allclose(r_got[b, :k], r_ref[b, :k],
+                                   rtol=0, atol=0)
+        np.testing.assert_array_equal(r_got[b, k:], 0.0)

@@ -70,6 +70,55 @@ def hier_rs_band_index(slow_axis: str, fast_axis: str):
     return j * d + i
 
 
+def _compact_bundles(bundle, inner_splits, tokens):
+    """Pack each bundle's valid rows into a contiguous prefix.
+
+    bundle [G, S, H] with S = L*tokens (L inner segments, lane-major);
+    inner_splits [G, L] = valid rows per inner segment.  Returns
+    (compacted bundle — valid rows first, lane-major order preserved;
+    bundle_splits [G] = total valid rows), which is exactly what the
+    splits-proportional flat kernel needs to move bytes ∝ tokens across
+    the wire (a raw bundle interleaves padding, so its valid rows are
+    not prefix-contiguous and the block DMAs could skip nothing).
+
+    Linear-time scatter, the exact mirror of :func:`_uncompact_bundles`:
+    the destination of padded row (lane, off) is cum_prev[lane] + off."""
+    G, S, _ = bundle.shape
+    lane = jnp.arange(S) // tokens
+    off = jnp.arange(S) % tokens
+    valid = off[None, :] < inner_splits[:, lane]            # [G, S]
+    cum_prev = jnp.cumsum(inner_splits, axis=1) - inner_splits  # excl. scan
+    pos = cum_prev[:, lane] + off[None, :]
+    pos_safe = jnp.where(valid, pos, S)                     # OOB → dropped
+    comp = jnp.zeros_like(bundle)
+    g = jnp.broadcast_to(jnp.arange(G)[:, None], (G, S))
+    comp = comp.at[g, pos_safe].set(bundle, mode="drop")
+    return comp, valid.sum(axis=1).astype(jnp.int32)
+
+
+def _uncompact_bundles(comp, inner_splits, tokens):
+    """Inverse of :func:`_compact_bundles` at the receiver: scatter the
+    valid prefix back into the padded lane-major layout (padding rows
+    come out ZERO — a defined contract, unlike the flat kernel's
+    undefined tail).  ``inner_splits`` are the RECEIVED per-segment
+    counts."""
+    G, S, H = comp.shape
+    L = S // tokens
+    cum = jnp.cumsum(inner_splits, axis=1)                  # [G, L]
+    k = jnp.arange(S)
+    # lane of compacted row k: number of cumulative boundaries <= k
+    lane = jnp.sum(k[None, :, None] >= cum[:, None, :], axis=2)  # [G, S]
+    prev = jnp.where(lane > 0,
+                     jnp.take_along_axis(cum, jnp.maximum(lane - 1, 0),
+                                         axis=1), 0)
+    pos = jnp.minimum(lane, L - 1) * tokens + (k[None, :] - prev)
+    valid_k = k[None, :] < cum[:, -1:]
+    pos_safe = jnp.where(valid_k, pos, S)                   # OOB → dropped
+    out = jnp.zeros_like(comp)
+    g = jnp.broadcast_to(jnp.arange(G)[:, None], (G, S))
+    return out.at[g, pos_safe].set(comp, mode="drop")
+
+
 def hier_all_to_all_shard(send, splits, *, slow_axis: str, fast_axis: str,
                           impl="auto", interpret: bool = False,
                           collective_ids=(cid.HIER_A2A_SLOW, cid.HIER_A2A_FAST)):
@@ -85,7 +134,10 @@ def hier_all_to_all_shard(send, splits, *, slow_axis: str, fast_axis: str,
     Contract matches the flat ``fast_all_to_all_shard`` with flat rank
     ``r = i * T_fast + j`` (slow-major): send [world, T, H] block ``d``
     goes to flat rank ``d``; recv block ``s`` arrived from flat rank
-    ``s``; splits [world] i32 ride alongside.
+    ``s``; splits [world] i32 ride alongside.  Wire bytes are
+    splits-PROPORTIONAL on both tiers (bundles are compacted before each
+    hop); recv padding rows are ZERO (the flat pallas kernel leaves its
+    tail undefined instead).
     """
     from triton_dist_tpu.kernels.all_to_all import fast_all_to_all_shard
 
@@ -95,33 +147,41 @@ def hier_all_to_all_shard(send, splits, *, slow_axis: str, fast_axis: str,
     assert world == d_ * t_, (world, d_, t_)
 
     # Stage 1 (slow): bundle by destination slice; peer p along the slow
-    # axis is chip (p, j_me) — the same-lane chip on slice p.
-    # Bundled rows are NOT prefix-contiguous (each bundle interleaves the
-    # inner segments' padding), so the splits-proportional block DMA of
-    # the flat kernel cannot skip rows here: declare every bundle row
-    # valid and move full segments.  Making the two-tier path
-    # splits-proportional needs a compacting repack before stage 1 —
-    # future work; the flat kernel (the latency-critical single-slice
-    # path) and the EP layer are proportional today.
+    # axis is chip (p, j_me) — the same-lane chip on slice p.  Bundled
+    # rows interleave the inner segments' padding, so each bundle is
+    # COMPACTED (valid rows to a prefix) before the shuffle: the flat
+    # kernel's splits-proportional block DMAs then move bytes ∝ the
+    # actual token counts across the slow wire (r3; round 2 shipped full
+    # bundles).  The receiver scatters the prefix back into the padded
+    # layout using the inner splits that ride the xla side-channel —
+    # padding rows come out ZERO (defined, unlike the flat kernel's
+    # undefined tail).
+    inner1 = splits.reshape(d_, t_).astype(jnp.int32)
     bundles = send.reshape(d_, t_ * tokens, hidden)
-    s1, _ = fast_all_to_all_shard(
-        bundles, jnp.full((d_,), t_ * tokens, jnp.int32), axis=slow_axis,
+    comp1, bsplits1 = _compact_bundles(bundles, inner1, tokens)
+    s1c, _ = fast_all_to_all_shard(
+        comp1, bsplits1, axis=slow_axis,
         impl=impl, interpret=interpret, collective_id=collective_ids[0])
     sp1, _ = fast_all_to_all_shard(
         splits.reshape(d_, t_, 1).astype(jnp.int32),
         jnp.zeros((d_,), jnp.int32), axis=slow_axis, impl="xla",
         interpret=interpret)
+    s1 = _uncompact_bundles(s1c, sp1[:, :, 0], tokens)
 
     # s1[p] = tokens from chip (p, j_me) for every lane of MY slice:
-    # [d_, t_lane, T, H] → regroup by destination lane for stage 2.
+    # [d_, t_lane, T, H] → regroup by destination lane for stage 2, and
+    # compact again for the fast-axis hop.
     s1 = s1.reshape(d_, t_, tokens, hidden)
     stage2 = jnp.moveaxis(s1, 1, 0).reshape(t_, d_ * tokens, hidden)
-    s2, _ = fast_all_to_all_shard(
-        stage2, jnp.full((t_,), d_ * tokens, jnp.int32), axis=fast_axis,
+    inner2 = jnp.moveaxis(sp1[:, :, 0], 1, 0)               # [t_, d_]
+    comp2, bsplits2 = _compact_bundles(stage2, inner2, tokens)
+    s2c, _ = fast_all_to_all_shard(
+        comp2, bsplits2, axis=fast_axis,
         impl=impl, interpret=interpret, collective_id=collective_ids[1])
     sp2, _ = fast_all_to_all_shard(
         jnp.moveaxis(sp1, 1, 0), jnp.zeros((t_,), jnp.int32),
         axis=fast_axis, impl="xla", interpret=interpret)
+    s2 = _uncompact_bundles(s2c, sp2[:, :, 0], tokens)
 
     # s2[q][p] = tokens from chip (p, q) → flat source order p * t_ + q.
     recv = jnp.moveaxis(s2.reshape(t_, d_, tokens, hidden), 1, 0)
