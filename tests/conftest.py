@@ -98,6 +98,7 @@ _FAST_GATE_MODULES = {
 # the gate keeps one representative of each behavior.
 _FAST_GATE_EXCLUDES = {
     "test_torus_gemm_rs_int8_exact",
+    "test_torus3d_gemm_rs_fused",
     "test_torus_gemm_rs_fused_epilogue[mesh2x4]",
     "test_torus_gemm_rs_fused_epilogue[mesh4x2]",
     "test_gemm_rs_pallas_matches_xla[bfloat16]",

@@ -432,3 +432,25 @@ def test_torus_gemm_rs_fused_small(key):
     c = gemm_rs(a, b, ctx)
     np.testing.assert_allclose(np.asarray(c), np.asarray(a) @ np.asarray(b),
                                rtol=2e-3, atol=2e-3)
+
+
+def test_torus3d_gemm_rs_fused(mesh2x2x2, key):
+    """Six-path fused 3-axis GEMM-RS == psum_scatter(A @ B) in natural
+    axes-major order (the kernel's phase-0 GEMM producer + two
+    accumulating sub-band ring phases)."""
+    from triton_dist_tpu.kernels.gemm_reduce_scatter import (
+        GEMMReduceScatterContext,
+        gemm_rs,
+    )
+
+    M, K, N = 64, 1024, 768  # rows = M/8 = 8, k_loc = 128: the fused
+    # kernel runs (M=32 gives rows=4, failing pallas_shapes_ok and
+    # silently routing to the fallback composition)
+    ks = jax.random.split(key, 2)
+    a = jax.random.normal(ks[0], (M, K), jnp.float32)
+    b = jax.random.normal(ks[1], (K, N), jnp.float32) / np.sqrt(K)
+    ctx = GEMMReduceScatterContext(mesh=mesh2x2x2, axis=("x", "y", "z"),
+                                   impl="pallas", interpret=True)
+    c = gemm_rs(a, b, ctx)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(a) @ np.asarray(b),
+                               rtol=2e-3, atol=2e-3)

@@ -43,6 +43,7 @@ from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
@@ -197,53 +198,57 @@ def _gemm_rs_kernel(
 
 
 def _torus_gemm_rs_kernel(
-    a_ref,      # [M, k_loc]                 ANY
-    b_ref,      # [k_loc, N]                 ANY
-    out_ref,    # [rows, N]                  ANY: my band, flat axes-major
-    acc0,       # [4, wfree_max, rows, cmax] ANY output scratch (phase 1)
-    rcv0,       # same                       ANY landing (phase 1)
-    acc1,       # [4, rows, cmax]            ANY output scratch (phase 2)
-    rcv1,       # same                       ANY landing (phase 2)
-    send_sem, recv_sem,   # DMA [4, 2] (path, phase)
-    credit_sem,           # REGULAR [4, 2]
-    copy_sem,
-    gacc,                 # VMEM (bm, bn) accumulator
-    *,
+    a_ref,      # [M, k_loc]                   ANY
+    b_ref,      # [k_loc, N]                   ANY
+    out_ref,    # [rows, N]                    ANY: my band, flat axes-major
+    *bufs_and_sems,
     axes, sizes, rows, paths, bm, bn, bk,
 ):
-    """Fused 2-axis torus GEMM-ReduceScatter: the MXU pipeline is the
-    PRODUCER inside the four-path torus RS schedule, so both axes' link
+    """Fused 2-/3-axis torus GEMM-ReduceScatter: the MXU pipeline is the
+    PRODUCER inside the 2n-path torus RS schedule, so every axis's link
     directions stay busy through the whole epilogue (VERDICT r2 missing
-    #3: the previous 2-axis path ran the fused ring on one axis and a
-    wire-only second ring on the other, idling half the links).
+    #3: the round-2 2-axis path ran the fused ring on one axis and a
+    wire-only second ring on the other, idling half the links; 3-axis
+    meshes get the six-path cyclic schedule).
 
     Reference analog: the multi-node threadblock swizzle that makes the
     reference's RS fabric-matched end-to-end
     (gemm_rs_threadblock_swizzle.py).
 
-    Paths split the N COLUMNS into four parts with the torus flavor set
-    (x→y ±, y→x ±) — column parts keep every phase-1 ring group a set of
-    whole C row-blocks, so the producer is a clean [rows, cln] GEMM per
-    slot.  Per path (order (r1, r2), direction d):
+    Paths split the N COLUMNS into 2n parts with the torus flavor set
+    (cyclic axis orders × directions) — column parts keep every ring
+    group a set of whole C row-blocks, so the producer is a clean
+    [rows, cln] GEMM per slot.  Per path (order, d):
 
-    * Phase 1 rings, along r1, the row-groups of slots sharing an r1
-      coordinate: at step s the path GEMMs its partial for group
-      ``(my1 - d(1+s)) mod w1`` (one [rows, cln] GEMM per r2 slot),
-      folds the partial arriving from upstream, and forwards — the GEMMs
-      hide the in-flight DMAs exactly like the 1-axis kernel.
-    * Phase 2 rings, along r2, the single-slot sub-bands of the phase-1
-      result; the final fold writes my fully-reduced [rows, cln] stripe
-      of ``out_ref`` directly.
+    * Phase 0 rings, along order[0], the row-groups of slots sharing an
+      order[0] coordinate: at step s the path GEMMs its partial for ring
+      group ``(my - d(1+s)) mod w`` (one [rows, cln] GEMM per free
+      slot), folds the partial arriving from upstream, and forwards —
+      the GEMMs hide the in-flight DMAs exactly like the 1-axis kernel.
+    * Phase l >= 1 rings, along order[l], the order-major sub-bands of
+      the previous phase's accumulator (free-slot index space is
+      order-major, so each sub-band is one contiguous ``pl.ds`` slice);
+      the final phase's last fold writes my fully-reduced [rows, cln]
+      stripe of ``out_ref`` directly.
 
-    Output band = flat AXES-MAJOR rank (i * wy + j), so the host
-    reassembles C with natural-order out_specs ``P(axes)``.
-    Flow control per (path, phase): single landing buffer + credit
-    semaphore (ring depth 1), sends drained before their acc is reused.
+    Output band = flat AXES-MAJOR rank, so the host reassembles C with
+    natural-order out_specs ``P(axes)``.  Flow control per (path,
+    phase): single landing buffer + credit semaphore (ring depth 1),
+    sends drained before their acc is reused.
     """
-    lbls = ("x", "y")
+    from triton_dist_tpu.kernels.torus import _LBL
+
+    n = len(axes)
+    lbls = _LBL[:n]
+    # bufs: (acc_l, rcv_l) for l in 0..n-1, then sems + gacc.
+    accs = bufs_and_sems[0:2 * n:2]
+    rcvs = bufs_and_sems[1:2 * n:2]
+    (send_sem, recv_sem, credit_sem, copy_sem,
+     gacc) = bufs_and_sems[2 * n:]
     coords = {l: jax.lax.axis_index(a) for l, a in zip(lbls, axes)}
     size = dict(zip(lbls, sizes))
     mesh_ax = dict(zip(lbls, axes))
+    stride = {lbls[i]: int(np.prod(sizes[i + 1:])) for i in range(n)}
     k_loc = a_ref.shape[1]
 
     for a in axes:
@@ -273,158 +278,179 @@ def _torus_gemm_rs_kernel(
              if cln > 0}
     active = [(q, pa) for q, pa in enumerate(paths) if pa[1] > 0]
 
-    # ------------------------------------------------------------------
-    # Phase 1: ring-RS of r1 row-groups, GEMM as the producer.
-    # ------------------------------------------------------------------
-    n1 = max(size[pa[2][0]] for _, pa in active)
+    from triton_dist_tpu.kernels.torus import free_slot_count
 
-    def p1_step(s, _):
+    def gsize(order, l):
+        return free_slot_count(order, size, l)
+
+    # ------------------------------------------------------------------
+    # Phase 0: ring-RS of order[0] row-groups, GEMM as the producer.
+    # ------------------------------------------------------------------
+    n0 = max(size[pa[2][0]] for _, pa in active)
+
+    def p0_step(s, _):
         for q, (coff, cln, order, d) in active:
-            r1, r2 = order
-            w1, wfree = size[r1], size[r2]
-            my1 = coords[r1]
-            peer = jax.lax.rem(my1 + d + w1, w1)
-            prev = jax.lax.rem(my1 - d + w1, w1)
+            r = order[0]
+            w = size[r]
+            gs = gsize(order, 0)
+            my = coords[r]
+            peer = jax.lax.rem(my + d + w, w)
+            prev = jax.lax.rem(my - d + w, w)
             gemm, add = pipes[q]
-            grp = acc0.at[q, pl.ds(0, wfree), :, pl.ds(0, cln)]
+            grp = accs[0].at[q, pl.ds(0, gs), :, pl.ds(0, cln)]
 
-            @pl.when(s < w1)
-            def _(q=q, coff=coff, cln=cln, r1=r1, r2=r2, w1=w1,
-                  wfree=wfree, my1=my1, d=d, peer=peer, prev=prev,
-                  gemm=gemm, add=add, grp=grp):
+            @pl.when(s < w)
+            def _(q=q, coff=coff, cln=cln, order=order, d=d, r=r, w=w,
+                  gs=gs, my=my, peer=peer, prev=prev, gemm=gemm, add=add,
+                  grp=grp):
                 # Drain my previous send before overwriting the group.
                 @pl.when(s > 0)
                 def _():
                     pltpu.make_async_copy(grp, grp, send_sem.at[q, 0]).wait()
 
-                # Producer: one [rows, cln] partial GEMM per r2 slot of
-                # ring group (my1 - d(1+s)) — final step s = w1-1 lands
-                # on my own group (idx == my1).
-                idx = jax.lax.rem(my1 - d * (1 + s) + (1 + s) * w1 + w1, w1)
-                for f in range(wfree):
-                    flat = (idx * size["y"] + f if r1 == "x"
-                            else f * size["y"] + idx)
+                # Producer: one [rows, cln] partial GEMM per free slot of
+                # ring group (my - d(1+s)) — final step s = w-1 lands on
+                # my own group.
+                idx = jax.lax.rem(my - d * (1 + s) + (1 + s) * w + w, w)
+                for f in range(gs):
+                    # Decompose the order-major free index into pending-
+                    # axis coords, then flatten to the storage rank.
+                    flat = idx * stride[r]
+                    rem_f = f
+                    for a in reversed(order[1:]):
+                        rem_f, c = divmod(rem_f, size[a])
+                        flat = flat + c * stride[a]
                     gemm(a_ref.at[pl.ds(flat * rows, rows)],
                          b_ref.at[:, pl.ds(coff, cln)],
-                         acc0.at[q, f, :, pl.ds(0, cln)],
+                         accs[0].at[q, f, :, pl.ds(0, cln)],
                          scratches=(gacc,))
 
                 @pl.when(s > 0)
                 def _():
                     # Fold the upstream partial that rode under the GEMMs.
                     pltpu.make_async_copy(grp, grp, recv_sem.at[q, 0]).wait()
-                    for f in range(wfree):
-                        add(rcv0.at[q, f, :, pl.ds(0, cln)],
-                            acc0.at[q, f, :, pl.ds(0, cln)],
-                            acc0.at[q, f, :, pl.ds(0, cln)])
+                    for f in range(gs):
+                        add(rcvs[0].at[q, f, :, pl.ds(0, cln)],
+                            accs[0].at[q, f, :, pl.ds(0, cln)],
+                            accs[0].at[q, f, :, pl.ds(0, cln)])
                     pltpu.semaphore_signal(
                         credit_sem.at[q, 0], inc=1,
-                        device_id={mesh_ax[r1]: prev},
+                        device_id={mesh_ax[r]: prev},
                         device_id_type=pltpu.DeviceIdType.MESH)
 
-                @pl.when(s < w1 - 1)
+                @pl.when(s < w - 1)
                 def _():
                     @pl.when(s > 0)
                     def _():
                         pltpu.semaphore_wait(credit_sem.at[q, 0], 1)
                     dl.remote_copy(grp,
-                                   rcv0.at[q, pl.ds(0, wfree), :,
-                                           pl.ds(0, cln)],
+                                   rcvs[0].at[q, pl.ds(0, gs), :,
+                                              pl.ds(0, cln)],
                                    send_sem.at[q, 0], recv_sem.at[q, 0],
-                                   mesh_ax[r1], peer).start()
+                                   mesh_ax[r], peer).start()
         return 0
 
-    jax.lax.fori_loop(0, n1, p1_step, 0)
+    jax.lax.fori_loop(0, n0, p0_step, 0)
 
     # ------------------------------------------------------------------
-    # Phase 2: ring-RS of the r2 sub-bands of my phase-1 group.
+    # Phases 1..n-1: ring-RS of order-major sub-bands of the previous
+    # accumulator; the final phase's last fold writes out_ref.
     # ------------------------------------------------------------------
-    n2 = max(size[pa[2][1]] for _, pa in active)
+    for l in range(1, n):
+        final = l == n - 1
+        n_l = max(size[pa[2][l]] for _, pa in active)
 
-    def p2_step(t, _):
-        for q, (coff, cln, order, d) in active:
-            r1, r2 = order
-            w2 = size[r2]
-            my2 = coords[r2]
-            peer = jax.lax.rem(my2 + d + w2, w2)
-            prev = jax.lax.rem(my2 - d + w2, w2)
-            _, add = pipes[q]
-            band = acc1.at[q, :, pl.ds(0, cln)]
+        def pl_step(t, _, l=l, final=final):
+            for q, (coff, cln, order, d) in active:
+                r = order[l]
+                w = size[r]
+                gs = gsize(order, l)
+                my = coords[r]
+                peer = jax.lax.rem(my + d + w, w)
+                prev = jax.lax.rem(my - d + w, w)
+                _, add = pipes[q]
+                band = accs[l].at[q, pl.ds(0, gs), :, pl.ds(0, cln)]
 
-            @pl.when(t < w2)
-            def _(q=q, coff=coff, cln=cln, r2=r2, w2=w2, my2=my2, d=d,
-                  peer=peer, prev=prev, add=add, band=band):
-                @pl.when(t > 0)
-                def _():
-                    pltpu.make_async_copy(band, band,
-                                          send_sem.at[q, 1]).wait()
-
-                idx = jax.lax.rem(my2 - d * (1 + t) + (1 + t) * w2 + w2, w2)
-                src = acc0.at[q, idx, :, pl.ds(0, cln)]
-
-                @pl.when(t == 0)
-                def _():
-                    # First hop: my contribution alone (nothing arrived).
-                    cp = pltpu.make_async_copy(src, band, copy_sem)
-                    cp.start()
-                    cp.wait()
-
-                @pl.when(jnp.logical_and(t > 0, t < w2 - 1))
-                def _():
-                    pltpu.make_async_copy(band, band,
-                                          recv_sem.at[q, 1]).wait()
-                    add(src, rcv1.at[q, :, pl.ds(0, cln)], band)
-                    pltpu.semaphore_signal(
-                        credit_sem.at[q, 1], inc=1,
-                        device_id={mesh_ax[r2]: prev},
-                        device_id_type=pltpu.DeviceIdType.MESH)
-
-                @pl.when(t == w2 - 1)
-                def _():
-                    # Final fold writes my stripe of the output directly.
-                    pltpu.make_async_copy(band, band,
-                                          recv_sem.at[q, 1]).wait()
-                    add(src, rcv1.at[q, :, pl.ds(0, cln)],
-                        out_ref.at[:, pl.ds(coff, cln)])
-                    pltpu.semaphore_signal(
-                        credit_sem.at[q, 1], inc=1,
-                        device_id={mesh_ax[r2]: prev},
-                        device_id_type=pltpu.DeviceIdType.MESH)
-
-                @pl.when(t < w2 - 1)
-                def _():
+                @pl.when(t < w)
+                def _(q=q, coff=coff, cln=cln, order=order, d=d, r=r, w=w,
+                      gs=gs, my=my, peer=peer, prev=prev, add=add,
+                      band=band):
                     @pl.when(t > 0)
                     def _():
-                        pltpu.semaphore_wait(credit_sem.at[q, 1], 1)
-                    dl.remote_copy(band, rcv1.at[q, :, pl.ds(0, cln)],
-                                   send_sem.at[q, 1], recv_sem.at[q, 1],
-                                   mesh_ax[r2], peer).start()
-        return 0
+                        pltpu.make_async_copy(band, band,
+                                              send_sem.at[q, l]).wait()
 
-    jax.lax.fori_loop(0, n2, p2_step, 0)
+                    idx = jax.lax.rem(my - d * (1 + t) + (1 + t) * w + w, w)
+                    src = accs[l - 1].at[q, pl.ds(idx * gs, gs), :,
+                                         pl.ds(0, cln)]
+
+                    @pl.when(t == 0)
+                    def _():
+                        # First hop: my contribution alone.
+                        cp = pltpu.make_async_copy(src, band, copy_sem)
+                        cp.start()
+                        cp.wait()
+
+                    def fold(dst_f):
+                        pltpu.make_async_copy(band, band,
+                                              recv_sem.at[q, l]).wait()
+                        for f in range(gs):
+                            add(accs[l - 1].at[q, idx * gs + f, :,
+                                               pl.ds(0, cln)],
+                                rcvs[l].at[q, f, :, pl.ds(0, cln)],
+                                dst_f(f))
+                        pltpu.semaphore_signal(
+                            credit_sem.at[q, l], inc=1,
+                            device_id={mesh_ax[r]: prev},
+                            device_id_type=pltpu.DeviceIdType.MESH)
+
+                    if final:
+                        @pl.when(jnp.logical_and(t > 0, t < w - 1))
+                        def _():
+                            fold(lambda f: accs[l].at[q, f, :,
+                                                      pl.ds(0, cln)])
+
+                        @pl.when(t == w - 1)
+                        def _():
+                            # Last fold writes my output stripe directly.
+                            fold(lambda f: out_ref.at[:, pl.ds(coff, cln)])
+                    else:
+                        @pl.when(t > 0)
+                        def _():
+                            fold(lambda f: accs[l].at[q, f, :,
+                                                      pl.ds(0, cln)])
+
+                    @pl.when(t < w - 1)
+                    def _():
+                        @pl.when(t > 0)
+                        def _():
+                            pltpu.semaphore_wait(credit_sem.at[q, l], 1)
+                        dl.remote_copy(band,
+                                       rcvs[l].at[q, pl.ds(0, gs), :,
+                                                  pl.ds(0, cln)],
+                                       send_sem.at[q, l], recv_sem.at[q, l],
+                                       mesh_ax[r], peer).start()
+            return 0
+
+        jax.lax.fori_loop(0, n_l, pl_step, 0)
 
     # Zero the leftover credit (one un-waited signal per path per phase).
-    # Sends are already drained: phase 1 posts w1-1 and waits at
-    # s=1..w1-1, phase 2 posts w2-1 and waits at t=1..w2-1 — an extra
-    # drain here would wait for a send that never happens (deadlock).
+    # Sends are already drained: every phase posts w-1 and waits at steps
+    # 1..w-1 — an extra drain here would deadlock.
     for q, (coff, cln, order, d) in active:
-        pltpu.semaphore_wait(credit_sem.at[q, 0], 1)
-        pltpu.semaphore_wait(credit_sem.at[q, 1], 1)
-
-
-_TORUS_PATH_FLAVORS = (("x", "y"), ("y", "x"))
+        for l in range(n):
+            pltpu.semaphore_wait(credit_sem.at[q, l], 1)
 
 
 def _torus_gemm_rs_shard(a_shard, b_shard, *, axes, impl, bm, bn, bk,
                          interpret):
-    """2-axis fused torus GEMM-RS (see kernel docstring).  Output band =
-    flat AXES-MAJOR rank; host out_specs = P(axes)."""
-    from triton_dist_tpu.kernels.torus import _split_parts
+    """2-/3-axis fused torus GEMM-RS (see kernel docstring).  Output band
+    = flat AXES-MAJOR rank; host out_specs = P(axes)."""
+    from triton_dist_tpu.kernels.torus import _path_flavors, _split_parts
 
-    ax, ay = axes
-    wx = jax.lax.axis_size(ax)
-    wy = jax.lax.axis_size(ay)
-    world = wx * wy
+    n = len(axes)
+    sizes = tuple(jax.lax.axis_size(a) for a in axes)
+    world = int(np.prod(sizes))
     M, k_loc = a_shard.shape
     N = b_shard.shape[1]
     assert M % world == 0, (M, world)
@@ -433,57 +459,65 @@ def _torus_gemm_rs_shard(a_shard, b_shard, *, axes, impl, bm, bn, bk,
     out_dtype = jnp.int32 if quantized else a_shard.dtype
     acc_dtype = jnp.int32 if quantized else jnp.float32
     impl = resolve_impl(impl, interpret)
+    npaths = 2 * n
 
-    # Column parts in 128-lane units with the four torus flavors.
+    # Column parts in 128-lane units with the 2n torus flavors.
     ok = (N % 128 == 0 and impl != "xla"
           and pallas_shapes_ok(rows, min(N, 128), k_loc))
     if ok:
-        units = _split_parts(N // 128, 4)
+        units = _split_parts(N // 128, npaths)
         paths = tuple((off * 128, ln * 128, order, d)
                       for (off, ln), (order, d) in zip(
-                          units, ((o, d) for o in _TORUS_PATH_FLAVORS
-                                  for d in (1, -1))))
+                          units, _path_flavors(n)))
         clns = [ln for _, ln, _, _ in paths if ln > 0]
         cgcd = math.gcd(*clns)
         bm = largest_divisor_block(rows, bm, 8)
         bn = largest_divisor_block(cgcd, bn, 128)
         bk = largest_divisor_block(k_loc, bk, 128)
     if not ok:
-        # Shapes the fused four-path kernel cannot tile (N or k_loc not
-        # 128-aligned, tiny rows): fall back to the overlapped
-        # composition — the 1-axis fused GEMM-RS over ``ax`` then a ring
-        # RS over ``ay`` (its internals degrade further to XLA where even
-        # 1-axis tiling fails).  ax-first keeps the band order flat
-        # AXES-MAJOR (i * wy + j), matching the fused kernel's contract.
-        from triton_dist_tpu.kernels.collective_ids import GEMM_RS_SECOND
+        # Shapes the fused kernel cannot tile: fall back to the
+        # overlapped composition — the 1-axis fused GEMM-RS over axes[0]
+        # then ring RS over the rest (internals degrade further to XLA
+        # where even 1-axis tiling fails).  axes[0]-first keeps the band
+        # order flat AXES-MAJOR, matching the fused kernel's contract.
+        from triton_dist_tpu.kernels.collective_ids import (
+            GEMM_RS_SECOND,
+            TORUS_RS_FALLBACK,
+        )
         from triton_dist_tpu.kernels.reduce_scatter import (
             reduce_scatter_shard,
         )
 
-        part = gemm_rs_shard(a_shard, b_shard, axis=ax, impl=impl,
+        part = gemm_rs_shard(a_shard, b_shard, axis=axes[0], impl=impl,
                              bm=bm, bn=bn, bk=bk, interpret=interpret)
-        return reduce_scatter_shard(part, ay, interpret=interpret,
-                                    collective_id=GEMM_RS_SECOND)
+        for a, fid in zip(axes[1:], (GEMM_RS_SECOND, TORUS_RS_FALLBACK)):
+            part = reduce_scatter_shard(part, a, interpret=interpret,
+                                        collective_id=fid)
+        return part
 
-    wfree_max = max(wx, wy)
+    from triton_dist_tpu.kernels.torus import _LBL, free_slot_count
+
     cmax = max(clns)
+    flavors = _path_flavors(n)
+    size_by_lbl = dict(zip(_LBL[:n], sizes))
+    gmaxes = [max(free_slot_count(order, size_by_lbl, l)
+                  for order, _ in flavors) for l in range(n)]
+    buf_shapes = []
+    for l in range(n):
+        shp = jax.ShapeDtypeStruct((npaths, gmaxes[l], rows, cmax),
+                                   out_dtype)
+        buf_shapes += [shp, shp]  # acc_l, rcv_l
     out, *_scratch = pl.pallas_call(
         functools.partial(_torus_gemm_rs_kernel, axes=axes,
-                          sizes=(wx, wy), rows=rows, paths=paths,
+                          sizes=sizes, rows=rows, paths=paths,
                           bm=bm, bn=bn, bk=bk),
-        out_shape=[
-            jax.ShapeDtypeStruct((rows, N), out_dtype),
-            jax.ShapeDtypeStruct((4, wfree_max, rows, cmax), out_dtype),
-            jax.ShapeDtypeStruct((4, wfree_max, rows, cmax), out_dtype),
-            jax.ShapeDtypeStruct((4, rows, cmax), out_dtype),
-            jax.ShapeDtypeStruct((4, rows, cmax), out_dtype),
-        ],
+        out_shape=[jax.ShapeDtypeStruct((rows, N), out_dtype)] + buf_shapes,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
-        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 5,
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (1 + 2 * n),
         scratch_shapes=[
-            pltpu.SemaphoreType.DMA((4, 2)),
-            pltpu.SemaphoreType.DMA((4, 2)),
-            pltpu.SemaphoreType.REGULAR((4, 2)),
+            pltpu.SemaphoreType.DMA((npaths, n)),
+            pltpu.SemaphoreType.DMA((npaths, n)),
+            pltpu.SemaphoreType.REGULAR((npaths, n)),
             pltpu.SemaphoreType.DMA,
             pltpu.VMEM((bm, bn), acc_dtype),
         ],
@@ -511,14 +545,13 @@ def gemm_rs_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
     bm, bn, bk = bm or _cfg.block_m, bn or _cfg.block_n, bk or _cfg.block_k
     if isinstance(axis, (tuple, list)) and len(axis) > 1:
         axes = tuple(axis)
-        if len(axes) != 2:
-            raise ValueError(f"gemm_rs supports 1 or 2 axes, got {axes}")
-        ax, ay = axes
-        sizes = (jax.lax.axis_size(ax), jax.lax.axis_size(ay))
-        if 1 in sizes:
-            axis = axes[sizes.index(max(sizes))]
+        if len(axes) not in (2, 3):
+            raise ValueError(f"gemm_rs supports 1-3 axes, got {axes}")
+        real = tuple(a for a in axes if jax.lax.axis_size(a) > 1)
+        if len(real) <= 1:
+            axis = real[0] if real else axes[0]
         else:
-            return _torus_gemm_rs_shard(a_shard, b_shard, axes=axes,
+            return _torus_gemm_rs_shard(a_shard, b_shard, axes=real,
                                         impl=impl, bm=bm, bn=bn, bk=bk,
                                         interpret=interpret)
     axis = axis[0] if isinstance(axis, (tuple, list)) else axis
@@ -587,10 +620,10 @@ def gemm_rs_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
 
 def gemm_rs(a, b, ctx: GEMMReduceScatterContext):
     """C = reduce_scatter(A_loc @ B_loc, axis), overlapped.  Host entry
-    (reference: ``gemm_rs`` gemm_reduce_scatter.py:547).  With a 2-tuple
-    ``ctx.axis`` the fused four-path torus kernel runs; bands come out
-    flat axes-major, so natural ``P(axes)`` out_specs reassemble C in row
-    order."""
+    (reference: ``gemm_rs`` gemm_reduce_scatter.py:547).  With a 2- or
+    3-tuple ``ctx.axis`` the fused 2n-path torus kernel runs (four paths
+    on 2 axes, six on 3); bands come out flat axes-major, so natural
+    ``P(axes)`` out_specs reassemble C in row order."""
     cfg = ctx.config
     axis = ctx.axis
     if isinstance(axis, (tuple, list)) and len(axis) > 1:

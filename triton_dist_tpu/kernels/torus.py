@@ -77,6 +77,16 @@ def _path_flavors(n: int):
     return tuple((order, d) for order in orders for d in (1, -1))
 
 
+def free_slot_count(order, sizes_by_lbl, l):
+    """Free-slot count after phase ``l`` of a cyclic-order path: the
+    product of the pending axes' sizes (shared by the torus RS/GEMM-RS
+    kernels AND their hosts' buffer sizing — one rule, one place)."""
+    g = 1
+    for a in order[l + 1:]:
+        g *= sizes_by_lbl[a]
+    return g
+
+
 def _paths_for(rows: int, n: int):
     return tuple((off, ln, order, d)
                  for (off, ln), (order, d) in zip(_split_parts(rows, 2 * n),
