@@ -93,10 +93,10 @@ def main():
           f"(predicted {rs1 / rs2:.2f}x)")
     print(f"  fused 3D six-path RS     : {fmt(rs3)}   (32 chips, same "
           "bytes)")
-    # GEMM-RS epilogue: the fused four-path kernel keeps both axes' links
-    # busy — its wire floor IS the fused 2D RS number above; the round-2
-    # composition (1-axis fused + wire-only second ring) serialized a
-    # second phase on half the links.
+    # GEMM-RS epilogue: the fused 2n-path kernel (2- AND 3-axis) keeps
+    # every axis's links busy — its wire floor IS the fused RS number
+    # above; the round-2 composition (1-axis fused + wire-only second
+    # ring) serialized a second phase on half the links.
     old = estimate_torus_reduce_scatter_time_ms(
         a_shard_bytes * TP, (4,), bw_gbps=V5P_AXIS_GBPS) + \
         estimate_torus_reduce_scatter_time_ms(
