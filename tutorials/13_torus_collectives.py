@@ -1,0 +1,108 @@
+"""Tutorial 13: Fused multi-axis torus collectives — use every link.
+
+A TPU slice is a 2D/3D torus: every chip has 2 links (4-6 directions)
+per mesh axis.  A single bidirectional ring saturates only one axis's
+two directions; during a sequential per-axis composition the other
+axis's links idle.  The fused torus schedules (kernels/torus.py) split
+the payload into 2n parts — one per (cyclic axis order, direction)
+flavor — so ALL 2n link directions carry traffic in every phase:
+
+* four-path 2D AG/RS: ~2x the bidirectional ring on a 4x4 plane,
+* six-path 3D AG/RS: ~3x on a 4x4x2 torus (the v5p-32 north star),
+* the same schedules thread under the overlapped kernels: `ag_gemm`
+  with a tuple axis runs the torus segment producer, and `gemm_rs`
+  runs the MXU pipeline INSIDE the torus RS schedule so the epilogue
+  never idles an axis.
+
+Reference analog: the fabric-matched AllGather variant breadth
+(allgather.py:194-258, 470-591; push-3D low_latency_allgather.py:570-607)
+— the reference hand-places transfers per fabric tier; on TPU the fabric
+is the mesh, so one n-ary schedule covers 2D and 3D.
+
+This tutorial runs, on the virtual CPU mesh:
+  1. fused 2D AG == lax.all_gather over the joint axes,
+  2. fused 3D RS == psum_scatter on a 2x2x2 torus,
+  3. 2-axis fused torus GEMM-RS == reduce_scatter(A @ B),
+  4. the analytic speedup predictions the first real multi-chip run
+     must falsify (docs/multichip_predictions.md).
+
+Run: python tutorials/13_torus_collectives.py
+"""
+
+import _common  # noqa: F401  (must be first: sets up the virtual mesh)
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from _common import INTERPRET
+from triton_dist_tpu.kernels.gemm_reduce_scatter import (
+    GEMMReduceScatterContext,
+    gemm_rs,
+)
+from triton_dist_tpu.kernels.perf_model import (
+    estimate_torus_allgather_time_ms,
+)
+from triton_dist_tpu.kernels.torus import (
+    torus_all_gather_shard,
+    torus_reduce_scatter_shard,
+)
+
+
+def main():
+    key = jax.random.key(0)
+
+    # -- 1. fused 2D AG on a 2x4 plane -------------------------------
+    mesh2d = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("x", "y"))
+    x = jax.random.normal(key, (64, 128), jnp.float32)
+    full = jax.jit(jax.shard_map(
+        functools.partial(torus_all_gather_shard, axes=("x", "y"),
+                          interpret=INTERPRET),
+        mesh=mesh2d, in_specs=P(("x", "y")), out_specs=P(),
+        check_vma=False))(x)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(x), rtol=1e-6)
+    print("fused 2D torus AG (4 paths)        : == lax.all_gather  OK")
+
+    # -- 2. fused 3D RS on a 2x2x2 torus -----------------------------
+    mesh3d = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                  ("x", "y", "z"))
+    part = jax.random.normal(jax.random.fold_in(key, 1), (48, 128),
+                             jnp.float32)
+    red = jax.jit(jax.shard_map(
+        functools.partial(torus_reduce_scatter_shard, axes=("x", "y", "z"),
+                          interpret=INTERPRET),
+        mesh=mesh3d, in_specs=P(), out_specs=P(("x", "y", "z")),
+        check_vma=False))(part)
+    np.testing.assert_allclose(np.asarray(red), 8 * np.asarray(part),
+                               rtol=1e-5)
+    print("fused 3D torus RS (6 paths)        : == psum_scatter    OK")
+
+    # -- 3. fused torus GEMM-RS (MXU inside the RS schedule) ---------
+    ks = jax.random.split(jax.random.fold_in(key, 2), 2)
+    M, K, N = 64, 1024, 512
+    a = jax.random.normal(ks[0], (M, K), jnp.float32)
+    b = jax.random.normal(ks[1], (K, N), jnp.float32) / np.sqrt(K)
+    ctx = GEMMReduceScatterContext(mesh=mesh2d, axis=("x", "y"),
+                                   impl="pallas", interpret=INTERPRET)
+    c = gemm_rs(a, b, ctx)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(a) @ np.asarray(b),
+                               rtol=2e-3, atol=2e-3)
+    print("fused torus GEMM-RS epilogue       : == RS(A @ B)       OK")
+
+    # -- 4. the falsifiable speedup claims ---------------------------
+    S, bw = 64 << 20, 100.0
+    bidir16 = estimate_torus_allgather_time_ms(S, (16,), bw_gbps=bw)
+    plane = estimate_torus_allgather_time_ms(S, (4, 4), bw_gbps=bw)
+    bidir32 = estimate_torus_allgather_time_ms(S, (32,), bw_gbps=bw)
+    fused3d = estimate_torus_allgather_time_ms(S, (4, 4, 2), bw_gbps=bw)
+    print(f"predicted: 2D plane {bidir16 / plane:.1f}x bidir ring, "
+          f"3D six-path {bidir32 / fused3d:.1f}x "
+          f"(docs/multichip_predictions.md freezes the numbers the first "
+          f"real multi-chip run must falsify)")
+
+
+if __name__ == "__main__":
+    main()
