@@ -1,0 +1,144 @@
+"""Experiment (round 4): decompose the world-1 ring AG-GEMM gap.
+
+VERDICT r3 weak#1: the ring kernel at world-1 reads ~146 TFLOPS vs 190
+for the dense pallas_call kernel, and at world-1 there is zero
+communication.  Candidate causes:
+
+  (a) nested ``emit_pipeline`` (sequential fori_loop schedule, no
+      dimension_semantics) vs the native Mosaic grid of ``pallas_call``;
+  (b) the A-staging DMA (full [M, K] read+write) contending with the
+      pipeline's own HBM streams;
+  (c) ring bookkeeping (semaphores, barrier) — should be ~0 at world-1.
+
+Three structurally identical chains in ONE rotated trial loop (benchlib
+protocol; shared return-projection + serializing feedback cancel in the
+comparisons):
+
+  dense : matmul (pallas_call grid, dimension_semantics)   — expect ~190
+  nested: the same GEMM as emit_pipeline inside an ANY-space
+          pallas_call, nothing else                        — isolates (a)
+  ring  : ag_gemm_shard impl="pallas" world-1              — adds (b)+(c)
+
+Run on the real chip: python scripts/exp_ring_schedule.py [--trials 12]
+"""
+
+import argparse
+import functools
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+import bench  # repo-root: _feedback
+from scripts.benchlib import RUN_SEED, rotated_paired_bench
+from triton_dist_tpu.kernels.allgather_gemm import ag_gemm_shard
+from triton_dist_tpu.kernels.gemm import (
+    MatmulConfig, gemm_pipeline_body, matmul)
+
+M, K, N = 8192, 8192, 3584
+BM, BN, BK = 2048, 512, 512
+
+
+def _nested_gemm_kernel(a_ref, b_ref, out_ref, acc_ref, *, bm, bn, bk):
+    n_m, n_n, n_k = M // bm, N // bn, K // bk
+    inner = pltpu.emit_pipeline(
+        functools.partial(gemm_pipeline_body, n_k=n_k,
+                          out_dtype=jnp.bfloat16),
+        grid=(n_m, n_n, n_k),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+        ],
+        out_specs=[pl.BlockSpec((bm, bn), lambda i, j, k: (i, j))],
+    )
+    inner(a_ref, b_ref, out_ref, scratches=(acc_ref,))
+
+
+def nested_gemm(a, b, bm=BM, bn=BN, bk=BK):
+    return pl.pallas_call(
+        functools.partial(_nested_gemm_kernel, bm=bm, bn=bn, bk=bk),
+        out_shape=jax.ShapeDtypeStruct((M, N), jnp.bfloat16),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+    )(a, b)
+
+
+def make_chain(mesh, n, variant):
+    def body_fn(x, b1, b2):
+        def body(i, x):
+            if variant == "dense":
+                c = matmul(x, b1, config=MatmulConfig(BM, BN, BK))
+            elif variant == "nested":
+                c = nested_gemm(x, b1)
+            elif variant == "wire":
+                # int8 wire mode forced at world-1: measures quantize
+                # pass + in-body dequant overhead vs the plain ring.
+                _, c = ag_gemm_shard(x, b1, axis="tp", impl="pallas",
+                                     wire_dtype="int8", interpret=False)
+            else:  # ring
+                _, c = ag_gemm_shard(x, b1, axis="tp", impl="pallas",
+                                     interpret=False)
+            nxt = matmul(c, b2, config=MatmulConfig(BM, BN, BK))
+            return bench._feedback(nxt, i)
+        return jax.lax.fori_loop(0, n, body, x)[0, 0]
+
+    return jax.jit(jax.shard_map(
+        body_fn, mesh=mesh,
+        in_specs=(P("tp", None), P(None, "tp"), P(None, None)),
+        out_specs=P(), check_vma=False))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=12)
+    ap.add_argument("--variants", type=str,
+                    default="dense,nested,ring")
+    args = ap.parse_args()
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    kw = jax.random.split(jax.random.key(RUN_SEED), 3)
+    b1 = jax.random.normal(kw[1], (K, N), jnp.bfloat16) * 0.02
+    b2 = jax.random.normal(kw[2], (N, K), jnp.bfloat16) * 0.02
+
+    n_long = 9
+    chains = {}
+    for v in args.variants.split(","):
+        chains[v] = (make_chain(mesh, 1, v), make_chain(mesh, n_long, v),
+                     (b1, b2))
+
+    def fresh(t):
+        return jax.random.normal(jax.random.key(RUN_SEED + t), (M, K),
+                                 jnp.bfloat16)
+
+    x0 = fresh(-1)
+    for c1, cn, extra in chains.values():
+        float(c1(x0, *extra))
+        float(cn(x0, *extra))
+
+    res = rotated_paired_bench(chains, fresh, n_extra=n_long - 1,
+                               trials=args.trials)
+    flops = 2.0 * M * N * K
+    base = res.get("dense", (None, None))[0]
+    for v, (t, iqr) in res.items():
+        line = f"{v:8s} pair {t * 1e3:7.2f} ms (IQR {iqr * 1e3:5.2f})"
+        if base is not None and v != "dense":
+            # variant GEMM time = dense GEMM time + (pair delta); dense
+            # GEMM at its documented 190 TFLOPS
+            t_dense = flops / 190e12
+            t_var = t_dense + (t - base)
+            line += (f"  delta vs dense {(t - base) * 1e3:+6.2f} ms"
+                     f"  -> ~{flops / t_var / 1e12:5.1f} TFLOPS")
+        print(line)
+
+
+if __name__ == "__main__":
+    main()

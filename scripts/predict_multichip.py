@@ -80,6 +80,21 @@ def main():
           f"({'compute' if gemm_v5p > torus else 'wire'}-bound; fused "
           "kernel time ~= max of the two)")
 
+    print("\n## AG-GEMM int8 wire mode (r4: wire_dtype='int8')")
+    # Per-row int8 payload + [m_loc, 128] f32 scale plane vs bf16 verbatim:
+    # bytes halve, plus 128 f32 lanes per row (= 512/K/2 of the bf16
+    # payload).  Recomputed through the same torus-AG estimator.
+    wire_bytes = (M // TP) * K * 1 + (M // TP) * 128 * 4
+    torus_wire = estimate_torus_allgather_time_ms(wire_bytes, (4, 4),
+                                                  bw_gbps=V5P_AXIS_GBPS)
+    eff_w = gemm_v5p / max(gemm_v5p, torus_wire)
+    print(f"  bf16 wire (above)        : {fmt(torus)}")
+    print(f"  int8 wire + scale plane  : {fmt(torus_wire)}   "
+          f"(predicted {torus / torus_wire:.2f}x fewer wire-µs)")
+    print(f"  predicted overlap eff.   : {eff_w:.0%} (widens the "
+          "compute-bound margin; the win is headroom for smaller M or "
+          "faster chips, not end-to-end time when already compute-bound)")
+
     print("\n## ReduceScatter (same bytes)")
     rs1 = estimate_torus_reduce_scatter_time_ms(a_shard_bytes * TP, (TP,),
                                                 bw_gbps=V5P_AXIS_GBPS)

@@ -80,6 +80,12 @@ def main():
         ag_gemm_shard, mesh, 2, axis="tp", impl="pallas",
         interpret=False)(a, b))
 
+    # 3b. AG-GEMM world-1 int8 WIRE mode (aliased wire planes + dequant
+    # at the MXU feed — r4)
+    check("ag_gemm_wire(w1)", lambda: _shard1(
+        ag_gemm_shard, mesh, 2, axis="tp", impl="pallas",
+        wire_dtype="int8", interpret=False)(a, b))
+
     # 4. GEMM-RS world-1
     from triton_dist_tpu.kernels.gemm_reduce_scatter import gemm_rs_shard
     check("gemm_rs(w1)", lambda: _shard1(
@@ -114,6 +120,14 @@ def main():
     check("flash_decode", lambda: _shard1(
         gqa_decode_shard, mesh, 4, impl="pallas",
         interpret=False)(q, kc, vc, lens))
+
+    # 7b. int8-KV decode kernel (lane-packed scale planes — r4)
+    from triton_dist_tpu.kernels.flash_decode import quantize_kv
+    kq8, ks8 = quantize_kv(kc.astype(jnp.float32))
+    vq8, vs8 = quantize_kv(vc.astype(jnp.float32))
+    check("flash_decode_i8", lambda: _shard1(
+        gqa_decode_shard, mesh, 4, impl="pallas", interpret=False,
+        k_scale=ks8, v_scale=vs8)(q, kq8, vq8, lens))
 
     # 8. ring attention world-1 (pallas kernel, VMEM staging)
     from triton_dist_tpu.kernels.ring_attention import ring_attention_shard

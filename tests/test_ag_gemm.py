@@ -31,9 +31,10 @@ def _make_inputs(mesh, key, m, n, k, dtype):
 
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
 def test_ag_gemm_pallas_matches_xla(mesh8, key, dtype):
-    # Interpret-mode tile invocations are expensive; keep one tile per ring
-    # step so the 8-device run stays fast.
-    m, n, k = 128, 128, 128
+    # Per-shard n_loc must be a full 128 lane tile (pallas_shapes_ok) or
+    # the strict-pallas gate raises: n = world * 128.  One tile per ring
+    # step keeps the 8-device interpret run fast.
+    m, n, k = 128, 8 * 128, 128
     a, b = _make_inputs(mesh8, key, m, n, k, dtype)
     ctx = create_ag_gemm_context(
         mesh8, impl="pallas", interpret=True,
@@ -49,7 +50,7 @@ def test_ag_gemm_pallas_matches_xla(mesh8, key, dtype):
 
 
 def test_ag_gemm_returns_gathered_a(mesh4, key):
-    m, n, k = 64, 256, 128
+    m, n, k = 64, 4 * 128, 128
     a, b = _make_inputs(mesh4, key, m, n, k, jnp.float32)
     ctx = create_ag_gemm_context(
         mesh4, impl="pallas", interpret=True,
@@ -75,7 +76,7 @@ def test_ag_gemm_rerandomized_iterations(mesh4, key):
         config=MatmulConfig(block_m=16, block_n=128, block_k=128),
     )
     for i in range(3):
-        a, b = _make_inputs(mesh4, jax.random.fold_in(key, i), 64, 128, 256,
+        a, b = _make_inputs(mesh4, jax.random.fold_in(key, i), 64, 512, 256,
                             jnp.float32)
         assert_allclose(ag_gemm(a, b, ctx), jnp.dot(a, b), atol=1e-5, rtol=1e-5)
 
@@ -88,7 +89,7 @@ def test_ag_gemm_int8_exact(mesh4, key):
     from triton_dist_tpu.kernels.allgather_gemm import (
         create_ag_gemm_context, ag_gemm_gathered)
 
-    world, M, K, N = 4, 64, 128, 256
+    world, M, K, N = 4, 64, 128, 512
     rng = np.random.default_rng(0)
     a = jnp.asarray(rng.integers(-127, 128, (M, K), dtype=np.int8))
     b = jnp.asarray(rng.integers(-127, 128, (K, N), dtype=np.int8))
@@ -102,3 +103,68 @@ def test_ag_gemm_int8_exact(mesh4, key):
     np.testing.assert_array_equal(np.asarray(a_full), np.asarray(a))
     ref = np.asarray(a, np.int32) @ np.asarray(b, np.int32)
     np.testing.assert_array_equal(np.asarray(c), ref)
+
+
+def test_ag_gemm_chunked_forward_matches(mesh4, key):
+    """VERDICT r3 #9: ring-forward sub-chunking (chunks=2/4) is wire-
+    transparent — byte-counted semaphores make the receiver agnostic to
+    how many DMAs carried the segment."""
+    m, n, k = 64, 4 * 128, 128
+    a, b = _make_inputs(mesh4, key, m, n, k, jnp.float32)
+    want = None
+    for chunks in (1, 2, 4):
+        ctx = create_ag_gemm_context(
+            mesh4, impl="pallas", interpret=True, chunks=chunks,
+            config=MatmulConfig(block_m=16, block_n=128, block_k=128))
+        out = ag_gemm(a, b, ctx)
+        if want is None:
+            want = np.asarray(out)
+        else:
+            np.testing.assert_array_equal(np.asarray(out), want)
+    ref = np.asarray(a, np.float32) @ np.asarray(b, np.float32)
+    np.testing.assert_allclose(want, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_ag_gemm_int8_wire_mode_matches_xla(mesh4, key):
+    """VERDICT r3 #3: wire_dtype='int8' ships quantized ring segments +
+    scale plane and dequantizes at the MXU feed.  The XLA impl applies
+    the identical quantize->dequantize locally, so the two impls agree
+    tightly; vs the UNQUANTIZED product only int8 noise separates them."""
+    m, n, k = 64, 4 * 128, 256
+    a, b = _make_inputs(mesh4, key, m, n, k, jnp.float32)
+    ctx_w = create_ag_gemm_context(
+        mesh4, impl="pallas", interpret=True, wire_dtype="int8",
+        config=MatmulConfig(block_m=16, block_n=128, block_k=128))
+    af_w, c_w = ag_gemm_gathered(a, b, ctx_w)
+    ctx_x = create_ag_gemm_context(mesh4, impl="xla", wire_dtype="int8")
+    af_x, c_x = ag_gemm_gathered(a, b, ctx_x)
+    # Same quantization noise on both impls -> near-exact agreement.
+    np.testing.assert_allclose(np.asarray(af_w), np.asarray(af_x),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(c_w), np.asarray(c_x),
+                               rtol=1e-4, atol=1e-4)
+    # vs the unquantized product: bounded by per-row int8 noise.
+    ref = np.asarray(a, np.float32) @ np.asarray(b, np.float32)
+    err = np.median(np.abs(np.asarray(c_w) - ref) / (np.abs(ref) + 1e-3))
+    assert err < 0.02, err
+
+
+def test_ag_gemm_int8_wire_world1_aliases(key):
+    """World-1 wire mode: the wire planes alias the inputs (no staging);
+    gathered A reconstructs from them."""
+    from jax.sharding import Mesh
+
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    m, n, k = 32, 128, 256
+    a, b = _make_inputs(mesh1, key, m, n, k, jnp.float32)
+    ctx = create_ag_gemm_context(
+        mesh1, impl="pallas", interpret=True, wire_dtype="int8",
+        config=MatmulConfig(block_m=16, block_n=128, block_k=128))
+    af, c = ag_gemm_gathered(a, b, ctx)
+    ref = np.asarray(a, np.float32) @ np.asarray(b, np.float32)
+    err = np.median(np.abs(np.asarray(c) - ref) / (np.abs(ref) + 1e-3))
+    assert err < 0.02, err
+    # Reconstruction error of gathered A is per-row int8 quantization.
+    arr = np.asarray(a, np.float32)
+    scale = np.abs(arr).max(axis=1, keepdims=True) / 127.0
+    np.testing.assert_allclose(np.asarray(af), arr, atol=scale.max() * 0.51)

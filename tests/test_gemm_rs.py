@@ -84,7 +84,7 @@ def test_gemm_rs_int8_exact(mesh4, key):
     from triton_dist_tpu.kernels.gemm_reduce_scatter import (
         create_gemm_rs_context, gemm_rs)
 
-    M, K, N = 64, 128, 256
+    M, K, N = 64, 4 * 128, 256  # k_loc = 128 per device (strict pallas)
     rng = np.random.default_rng(0)
     a = jnp.asarray(rng.integers(-64, 64, (M, K), dtype=np.int8))
     b = jnp.asarray(rng.integers(-64, 64, (K, N), dtype=np.int8))

@@ -16,11 +16,14 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 @pytest.mark.parametrize("impl", ["xla", "pallas"])
 def test_w8a8_forward_close_to_float(impl, mesh4, key):
-    cfg = llama.LlamaConfig(vocab=128, dim=64, n_layers=2, n_heads=4,
-                            n_kv_heads=4, ffn_dim=128, max_seq=64,
+    # Per-shard pallas-legal on tp=4 (strict impl='pallas' gate): every
+    # projection leaves n%128 / k%128 per device, and S*B/4 rows stay
+    # %32 for the int8 MXU path.
+    cfg = llama.LlamaConfig(vocab=512, dim=512, n_layers=2, n_heads=4,
+                            n_kv_heads=4, ffn_dim=512, max_seq=64,
                             dtype=jnp.float32)
     host = llama.init_params(cfg, key)
-    S, B = 16, 2
+    S, B = 32, 4
     tokens = jax.device_put(
         jax.random.randint(key, (S, B), 0, cfg.vocab, jnp.int32),
         NamedSharding(mesh4, P("tp")))

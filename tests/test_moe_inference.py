@@ -32,7 +32,7 @@ def _dense_ref(x, w, weights, experts):
 
 
 def _make(mesh, key, *, dtype, impl="xla", interpret=False, topk=2,
-          T=32, H=64, F=32, E=8, max_tokens=None):
+          T=32, H=128, F=128, E=8, max_tokens=None):
     world = mesh.shape["tp"]
     t_loc = T // world
     layer = DistributedMoELayer(
@@ -124,7 +124,7 @@ def test_forward_w8a8_close_to_float(impl, mesh4, key):
 def test_forward_cross_slice_two_tier(impl, mesh2d, key):
     """EP serving over a 2x4 (dcn-like x ici-like) mesh: the dispatch
     rides the two-tier AllToAll; matches the dense reference."""
-    T, H, F, E, topk = 32, 64, 32, 8, 2
+    T, H, F, E, topk = 32, 128, 128, 8, 2  # H/F: full 128 tiles (strict pallas)
     world = 8
     layer = DistributedMoELayer(
         mesh=mesh2d, n_experts=E, topk=topk, hidden=H, intermediate=F,

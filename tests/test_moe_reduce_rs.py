@@ -52,7 +52,8 @@ def _make_case(key, mesh, T, D, F, E, topk, block_m, dtype=jnp.float32):
 
 @pytest.mark.parametrize("impl", ["xla", "pallas"])
 def test_moe_reduce_rs_matches_dense(impl, mesh4, key):
-    T, D, E, topk, block_m = 64, 128, 4, 2, 8
+    # f_loc = D/4 must be a full 128-lane tile (strict pallas)
+    T, D, E, topk, block_m = 64, 4 * 128, 4, 2, 8
     h, w, weights, experts, ref = _make_case(
         key, mesh4, T, D, D, E, topk, block_m)
     ctx = create_moe_rs_context(

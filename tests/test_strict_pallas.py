@@ -1,0 +1,89 @@
+"""Explicit ``impl='pallas'`` must never silently reroute to XLA.
+
+VERDICT r3 #2: every reference test runs the Triton kernel or crashes; a
+silent shape-guard fallback once hid a fused-kernel deadlock behind green
+tests here.  ``kernels.gemm.use_fallback`` now raises ``PallasShapeError``
+whenever an explicit pallas request hits a failing shape guard — which
+turns EVERY ``impl='pallas'`` test in this suite into a kernel-reach
+assertion: shrink its shapes below ``pallas_shapes_ok`` and it fails
+loudly instead of passing on the XLA path.
+
+This module pins the contract for each guarded dispatcher.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.kernels.gemm import PallasShapeError
+from triton_dist_tpu.kernels.allgather_gemm import (
+    ag_gemm,
+    create_ag_gemm_context,
+)
+from triton_dist_tpu.kernels.gemm_reduce_scatter import (
+    create_gemm_rs_context,
+    gemm_rs,
+)
+
+
+def _ab(mesh, key, m, n, k, a_spec, b_spec):
+    ka, kb = jax.random.split(key)
+    a = jax.device_put(jax.random.normal(ka, (m, k), jnp.float32),
+                       NamedSharding(mesh, a_spec))
+    b = jax.device_put(jax.random.normal(kb, (k, n), jnp.float32),
+                       NamedSharding(mesh, b_spec))
+    return a, b
+
+
+def test_ag_gemm_explicit_pallas_raises_on_ragged_shard(mesh4, key):
+    # n_loc = 120/4 = 30: fails n%128 on the per-device shard — auto may
+    # fall back, explicit pallas must raise.
+    a, b = _ab(mesh4, key, 128, 4 * 120, 128, P("tp", None), P(None, "tp"))
+    ctx = create_ag_gemm_context(mesh4, impl="pallas", interpret=True)
+    with pytest.raises(PallasShapeError):
+        ag_gemm(a, b, ctx)
+    auto = create_ag_gemm_context(mesh4, impl="auto", interpret=True)
+    out = ag_gemm(a, b, auto)  # auto keeps its fallback freedom
+    assert out.shape == (128, 4 * 120)
+
+
+def test_gemm_rs_explicit_pallas_raises_on_ragged_shard(mesh4, key):
+    # k_loc = 120: fails k%128 per shard.
+    a, b = _ab(mesh4, key, 128, 128, 4 * 120, P(None, "tp"), P("tp", None))
+    ctx = create_gemm_rs_context(mesh4, impl="pallas", interpret=True)
+    with pytest.raises(PallasShapeError):
+        gemm_rs(a, b, ctx)
+
+
+def test_group_gemm_explicit_pallas_raises(key):
+    from triton_dist_tpu.kernels.group_gemm import group_gemm
+
+    x = jax.random.normal(key, (256, 120), jnp.float32)  # K=120 ragged
+    w = jax.random.normal(key, (2, 120, 128), jnp.float32)
+    te = jnp.zeros((2,), jnp.int32)
+    with pytest.raises(PallasShapeError):
+        group_gemm(x, w, te, block_m=128, impl="pallas", interpret=True)
+
+
+def test_matmul_i8_explicit_pallas_raises(key):
+    from triton_dist_tpu.kernels.quant import matmul_i8
+
+    a = jnp.ones((48, 256), jnp.int8)  # m=48: fails m%32... 48%32=16
+    b = jnp.ones((256, 128), jnp.int8)
+    with pytest.raises(PallasShapeError):
+        matmul_i8(a, b, impl="pallas", interpret=True)
+
+
+def test_flash_decode_explicit_pallas_raises(key):
+    from triton_dist_tpu.kernels.flash_decode import gqa_decode_shard
+
+    B, Hq, Hkv, S, D = 2, 4, 2, 120, 128  # S=120 ragged
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (B, Hq, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, Hkv, S, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, Hkv, S, D), jnp.float32)
+    lens = jnp.full((B,), S, jnp.int32)
+    with pytest.raises(PallasShapeError):
+        gqa_decode_shard(q, k, v, lens, impl="pallas", interpret=True)

@@ -236,7 +236,7 @@ def test_torus_ag_gemm(mesh2x4, key):
         ag_gemm_gathered,
     )
 
-    M, K, N = 64, 128, 256
+    M, K, N = 64, 128, 8 * 128  # n_loc = 128 per device (strict pallas)
     ks = jax.random.split(key, 2)
     a = jax.random.normal(ks[0], (M, K), jnp.float32)
     b = jax.random.normal(ks[1], (K, N), jnp.float32)
@@ -254,7 +254,7 @@ def test_torus_ag_gemm_bf16(mesh4x2, key):
         ag_gemm,
     )
 
-    M, K, N = 64, 128, 256
+    M, K, N = 64, 128, 8 * 128  # n_loc = 128 per device (strict pallas)
     ks = jax.random.split(key, 2)
     a = jax.random.normal(ks[0], (M, K), jnp.bfloat16)
     b = jax.random.normal(ks[1], (K, N), jnp.bfloat16)
@@ -274,7 +274,7 @@ def test_torus_gemm_rs(mesh2x4, key):
         gemm_rs,
     )
 
-    M, K, N = 64, 256, 128
+    M, K, N = 64, 8 * 128, 128  # k_loc = 128 per device (strict pallas)
     ks = jax.random.split(key, 2)
     a = jax.random.normal(ks[0], (M, K), jnp.float32)
     b = jax.random.normal(ks[1], (K, N), jnp.float32)
@@ -401,7 +401,7 @@ def test_torus3d_ag_gemm(mesh2x2x2, key):
         ag_gemm,
     )
 
-    M, K, N = 64, 128, 256
+    M, K, N = 64, 128, 8 * 128  # n_loc = 128 per device (strict pallas)
     ks = jax.random.split(key, 2)
     a = jax.random.normal(ks[0], (M, K), jnp.bfloat16)
     b = jax.random.normal(ks[1], (K, N), jnp.bfloat16)

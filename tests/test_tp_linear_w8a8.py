@@ -21,7 +21,7 @@ def _rel_err(y, ref):
 
 @pytest.mark.parametrize("impl", ["xla", "pallas"])
 def test_column_parallel_w8a8(impl, mesh4, key):
-    M, K, N = 64, 128, 256
+    M, K, N = 64, 4 * 128, 4 * 128  # per-shard 128-aligned (strict pallas)
     k1, k2 = jax.random.split(key)
     a = jax.random.normal(k1, (M, K), jnp.float32)
     w = jax.random.normal(k2, (K, N), jnp.float32) / 8.0
@@ -44,7 +44,7 @@ def test_column_parallel_w8a8(impl, mesh4, key):
 
 @pytest.mark.parametrize("impl", ["xla", "pallas"])
 def test_row_parallel_w8a8(impl, mesh4, key):
-    M, K, N = 64, 128, 256
+    M, K, N = 64, 4 * 128, 4 * 128  # per-shard 128-aligned (strict pallas)
     k1, k2 = jax.random.split(key)
     a = jax.random.normal(k1, (M, K), jnp.float32)
     w = jax.random.normal(k2, (K, N), jnp.float32) / 8.0

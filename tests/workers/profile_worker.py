@@ -1,0 +1,51 @@
+"""Worker for the cross-host trace-gather test (run via scripts/launch.py).
+
+Each process writes its profiler trace to a process-PRIVATE base dir
+(simulating multi-host local disks — no shared filesystem view), then
+``group_profile(gather=True)`` ships rank 1's trace files to rank 0 over
+the jax.distributed fabric and rank 0 merges one timeline containing BOTH
+ranks' events (reference: utils.py:417-501 gathers over the torch process
+group).
+"""
+
+import gzip
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import jax  # noqa: E402
+
+from triton_dist_tpu.runtime.bootstrap import initialize_distributed  # noqa: E402
+
+initialize_distributed()
+
+import jax.numpy as jnp  # noqa: E402
+
+from triton_dist_tpu.runtime.profiling import group_profile  # noqa: E402
+
+root = sys.argv[1]
+rank = jax.process_index()
+# Process-private base dir: the other rank's traces are NOT visible here
+# by filesystem — only the gather can deliver them.
+base = os.path.join(root, f"local{rank}")
+
+with group_profile("job", do_prof=True, base_dir=base, merge=True,
+                   gather=True) as gp:
+    x = jnp.ones((256, 256), jnp.float32)
+    y = jax.jit(lambda a: a @ a)(x)
+    y.block_until_ready()
+
+if rank == 0:
+    assert gp.merged_path is not None, "merge produced nothing"
+    assert os.path.exists(gp.merged_path), gp.merged_path
+    with gzip.open(gp.merged_path, "rt") as f:
+        events = json.load(f)["traceEvents"]
+    pids = {ev.get("pid", 0) for ev in events}
+    # rank r's events are re-namespaced into pid range r*10_000_000.
+    assert any(p >= 10_000_000 for p in pids), (
+        "no rank-1 events in the merged timeline", sorted(pids)[:5])
+    assert any(0 < p < 10_000_000 for p in pids), "no rank-0 events"
+print(f"PROFILE_WORKER_OK rank={rank}")

@@ -107,8 +107,8 @@ def _a2a_wire_block(max_tokens: int, cap: int | None = None) -> int:
 
 def _a2a_kernel(send_ref, splits_any, splits_smem, recv_ref, recv_splits_ref,
                 send_sem, recv_sem, ssend_sem, srecv_sem, copy_sem,
-                rsplit_smem,
-                *, axis, world, block):
+                rsplit_smem, *poison_ref,
+                axis, world, block):
     """One-shot full-mesh token shuffle with splits-PROPORTIONAL transfers.
 
     Wire bytes scale with the actual token counts, not the worst-case
@@ -221,9 +221,38 @@ def _a2a_kernel(send_ref, splits_any, splits_smem, recv_ref, recv_splits_ref,
 
     jax.lax.fori_loop(0, nblocks_in, _drain_recv, 0)
 
+    if poison_ref:
+        # Debug poison (VERDICT r3 #7): never-shipped recv blocks (rows
+        # >= ceil(recv_splits[p]/block)*block, remote peers) are written
+        # with a sentinel — NaN for float payloads, iinfo.max for ints —
+        # so a consumer that misses the recv_splits mask fails as loudly
+        # on hardware as interpret-mode NaN-fill makes it fail on the
+        # CPU mesh (where unwritten buffer rows are NaN already; for int
+        # payloads the sentinel is observable under interpret too).
+        # Enabled via debug_poison=True / TDT_A2A_POISON=1; costs extra
+        # HBM writes, debug only.
+        (pz,) = poison_ref
+        dt = recv_ref.dtype
+        val = jnp.nan if jnp.issubdtype(dt, jnp.inexact) else jnp.iinfo(dt).max
+        pz[...] = jnp.full(pz.shape, val, dt)
+        for i in range(1, world):
+            peer = jax.lax.rem(me + i, world)
+            rs_c = jnp.minimum(rsplit_smem[peer, 0], max_tokens)
+            shipped = ((rs_c + block - 1) // block) * block
+            for b in range(nblk):
+
+                @pl.when(jnp.int32(b * block) >= shipped)
+                def _(b=b, peer=peer):
+                    w = pltpu.make_async_copy(
+                        pz, recv_ref.at[peer, pl.ds(b * block, block)],
+                        copy_sem)
+                    w.start()
+                    w.wait()
+
 
 def fast_all_to_all_shard(send, splits, *, axis, impl, interpret,
-                          collective_id=A2A_COLLECTIVE_ID, wire_block=None):
+                          collective_id=A2A_COLLECTIVE_ID, wire_block=None,
+                          debug_poison=None):
     """Shard-level entry.  send: [world, max_tokens, H]; splits: [world] i32.
     Returns (recv [world, max_tokens, H], recv_splits [world]).
     ``collective_id`` must differ between a2a kernels composed in one
@@ -268,6 +297,12 @@ def fast_all_to_all_shard(send, splits, *, axis, impl, interpret,
         raise ValueError(f"wire_block={block} must divide max_tokens="
                          f"{max_tokens} (uniform blocks keep the DMA "
                          "byte-accounting exact)")
+    if debug_poison is None:
+        import os
+
+        debug_poison = os.environ.get("TDT_A2A_POISON", "0") == "1"
+    poison_scratch = (
+        [pltpu.VMEM((block, hidden), send.dtype)] if debug_poison else [])
     recv, recv_splits_row = pl.pallas_call(
         functools.partial(_a2a_kernel, axis=axis, world=world, block=block),
         out_shape=[
@@ -285,7 +320,7 @@ def fast_all_to_all_shard(send, splits, *, axis, impl, interpret,
             pltpu.SemaphoreType.DMA,   # splits recv
             pltpu.SemaphoreType.DMA,   # local copies / SMEM staging
             pltpu.SMEM((world, 128), jnp.int32),
-        ],
+        ] + poison_scratch,
         compiler_params=dl.collective_compiler_params(
             world, collective_id),
         interpret=maybe_interpret(interpret),

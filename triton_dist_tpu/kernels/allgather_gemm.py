@@ -39,6 +39,7 @@ from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
@@ -51,6 +52,8 @@ from triton_dist_tpu.kernels.gemm import (
     matmul,
     pallas_shapes_ok,
     resolve_impl,
+    use_fallback,
+    wire_gemm_pipeline_body,
 )
 from triton_dist_tpu.language.interpret import maybe_interpret
 from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
@@ -68,6 +71,19 @@ class AllGatherGEMMContext:
     axis: str = "tp"
     impl: str = "auto"  # "auto" | "xla" | "pallas"
     config: MatmulConfig = field(default_factory=MatmulConfig)
+    # Ring-forward sub-chunking (VERDICT r3 #9): each segment's forward
+    # DMA is split into ``chunks`` row-chunks.  The receiver's byte-
+    # counted recv wait is unchanged (c chunk DMAs carry the same total
+    # bytes), but chunked sends give the DMA scheduler smaller units to
+    # interleave with the pipeline's own HBM streams — the TPU analog of
+    # the reference's SM budgeting, which ``perf_model.
+    # overlap_chunk_budget`` models and the autotune space now sweeps.
+    chunks: int = 1
+    # "int8" ships the ring's A segments per-row-quantized with an f32
+    # scale plane and dequantizes at the MXU feed (VERDICT r3 #3): ~2x
+    # fewer allgather wire bytes for bf16 models; the gathered A comes
+    # back as the dequantized reconstruction.  None ships A verbatim.
+    wire_dtype: str | None = None
     interpret: bool = False
 
     @property
@@ -76,87 +92,201 @@ class AllGatherGEMMContext:
 
 
 def create_ag_gemm_context(mesh, axis="tp", impl="auto", config=None,
+                           chunks=1, wire_dtype=None,
                            interpret=False) -> AllGatherGEMMContext:
     return AllGatherGEMMContext(
         mesh=mesh, axis=axis, impl=impl,
-        config=config or MatmulConfig(), interpret=interpret,
+        config=config or MatmulConfig(), chunks=chunks,
+        wire_dtype=wire_dtype, interpret=interpret,
     )
 
 
 def _ag_gemm_kernel(
-    a_ref,      # [m_loc, K]      ANY (HBM)
-    b_ref,      # [K, n_loc]      ANY
-    ag_ref,     # [world*m_loc, K] ANY, output: gathered A
-    out_ref,    # [world*m_loc, n_loc] ANY, output: C shard
-    send_sem, recv_sem, copy_sem,
-    acc_ref,    # VMEM (bm, bn) f32 scratch for the inner pipeline
-    *,
-    axis, world, m_loc, bm, bn, bk, out_dtype,
+    *refs,
+    axis, world, m_loc, bm, bn, bk, out_dtype, chunks=1, wire=False,
 ):
-    me = jax.lax.axis_index(axis)
-    right = jax.lax.rem(me + 1, world)
-    left = jax.lax.rem(me + world - 1, world)
+    """Ring producer + ONE persistent MXU pipeline across all ring steps.
 
-    # Stage local segment into the gathered-A output (reference:
-    # local_copy_and_barrier_all, allgather_gemm.py:100-116) — but only
-    # START it: step 0 computes and ring-forwards directly from a_ref, so
-    # the staging DMA (a full read+write of the local A) hides behind the
-    # first segment's GEMM instead of serializing ahead of everything
-    # (~7% at the bench shape).  The wait is at kernel exit, for the
-    # validity of the gathered-A output.
-    cp = pltpu.make_async_copy(a_ref, ag_ref.at[pl.ds(me * m_loc, m_loc)], copy_sem)
-    cp.start()
+    refs (``wire=False``):
+      a_ref [m_loc, K] ANY, b_ref [K, n_loc] ANY,
+      ag_ref [world*m_loc, K] out, out_ref [world*m_loc, n_loc] out,
+      send_sem, recv_sem, copy_sem, acc_ref (VMEM (bm, bn)).
+    refs (``wire=True`` — int8 wire mode, VERDICT r3 #3): an int8
+    payload ``a_ref`` plus a per-row scale plane ``s_ref`` [m_loc, 128]
+    f32 (scale in column 0 — the minimum Mosaic wire unit) replace the
+    bf16 A; both ride the ring, and the inner pipeline dequantizes at
+    the MXU feed (``wire_gemm_pipeline_body``).  Wire bytes drop ~2x
+    for bf16 models (plus a 128-lane scale plane, ~K/128 overhead).
+    The gathered outputs are the RAW wire planes; the host
+    reconstructs bf16 A lazily outside the kernel (XLA DCEs it when
+    unused).  Reference: fp8 payloads in its headline kernel
+    (low_latency_all_to_all.py:76-88); int8 here because v5e fp8
+    matmuls run at bf16 rate (docs/perf.md fp8 probe).
 
-    if world > 1:
-        # Neighbor barrier before any remote write (same role as the entry
-        # barrier_all: nobody writes into a peer that hasn't entered the
-        # kernel).
-        barrier = pltpu.get_barrier_semaphore()
-        pltpu.semaphore_signal(barrier, inc=1, device_id={axis: left},
-                               device_id_type=pltpu.DeviceIdType.MESH)
-        pltpu.semaphore_signal(barrier, inc=1, device_id={axis: right},
-                               device_id_type=pltpu.DeviceIdType.MESH)
-        pltpu.semaphore_wait(barrier, 2)
+    The inner Mosaic pipeline is invoked once per ring step but shares its
+    VMEM allocations across steps (``make_pipeline_allocations`` +
+    ``first_cycle``/``last_cycle``), and each step's LAST inner iteration
+    prefetches the NEXT segment's first tiles — with the recv-semaphore
+    wait folded into that prefetch callback.  This is the TPU rendering of
+    the reference's persistent consumer GEMM spinning on per-rank signals
+    (allgather_gemm.py:133-254): no pipeline fill/drain bubble between
+    segments, the cross-step double buffering the per-step re-entry lost.
+
+    The ring-forward DMA for the segment being consumed launches just
+    before its pipeline cycle, so the wire transfer rides under that
+    whole step's compute (not inside a postyeet callback — starting a
+    remote DMA inside the pipeline callbacks deadlocks the Mosaic
+    interpreter; a semaphore wait inside prefetch is fine).
+
+    World-1: the host aliases A into the gathered-A output
+    (``input_output_aliases``), so the kernel is a single pipeline cycle
+    with no staging DMA and no semaphores — measured at parity with the
+    dense kernel (scripts/exp_ring_schedule.py: ring-minus-dense delta
+    +0.02..0.22 ms on an ~2.5 ms GEMM; the old per-step code's documented
+    146 TFLOPS was protocol bias plus the staging DMA).
+    """
+    if wire:
+        (a_ref, s_ref, b_ref, ag_ref, ag_s_ref, out_ref,
+         send_sem, recv_sem, copy_sem, acc_ref) = refs
+    else:
+        (a_ref, b_ref, ag_ref, out_ref,
+         send_sem, recv_sem, copy_sem, acc_ref) = refs
+        s_ref = ag_s_ref = None
 
     K = a_ref.shape[1]
     n_loc = b_ref.shape[1]
     n_m, n_n, n_k = m_loc // bm, n_loc // bn, K // bk
+    grid = (n_m, n_n, n_k)
+    a_spec = pl.BlockSpec((bm, bk), lambda i, j, k: (i, k))
+    s_spec = pl.BlockSpec((bm, 128), lambda i, j, k: (i, 0))
+    b_spec = pl.BlockSpec((bk, bn), lambda i, j, k: (k, j))
+    in_specs = ([a_spec, s_spec, b_spec] if wire else [a_spec, b_spec])
+    out_specs = [pl.BlockSpec((bm, bn), lambda i, j, k: (i, j))]
+    body = wire_gemm_pipeline_body if wire else gemm_pipeline_body
 
     inner = pltpu.emit_pipeline(
-        functools.partial(gemm_pipeline_body, n_k=n_k, out_dtype=out_dtype),
-        grid=(n_m, n_n, n_k),
-        in_specs=[
-            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
-            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
-        ],
-        out_specs=[pl.BlockSpec((bm, bn), lambda i, j, k: (i, j))],
+        functools.partial(body, n_k=n_k, out_dtype=out_dtype),
+        grid=grid, in_specs=in_specs, out_specs=out_specs,
     )
 
-    for s in range(world):
-        slot = jax.lax.rem(me - s + world, world)
-        seg = ag_ref.at[pl.ds(slot * m_loc, m_loc)]
-        # Step 0's segment is the local one — read it from a_ref (the
-        # staging copy into ag_ref may still be in flight).
-        src = a_ref if s == 0 else seg
-        if s > 0:
-            # Segment for this step was DMA'd by the left neighbor during the
-            # previous step's compute; recv_sem completion == data landed
-            # (the reference's dl.wait on the per-rank signal).
-            pltpu.make_async_copy(seg, seg, recv_sem).wait()
-        if s < world - 1:
-            # Forward the segment along the ring while we compute on it
-            # (the peer's landing slot is its ag_ref at this slot).
-            dl.remote_copy(src, seg, send_sem, recv_sem, axis, right).start()
+    def planes(srcs):
+        """A-plane refs for a cycle: payload [+ scale plane]."""
+        return srcs if wire else srcs[:1]
 
-        # Consume the segment: C[slot block, :] = A_seg @ B_loc on the MXU.
-        inner(src, b_ref, out_ref.at[pl.ds(slot * m_loc, m_loc)],
+    if world == 1:
+        # Gathered A IS A (aliased by the host) — nothing to stage or
+        # forward; run the one pipeline cycle.
+        inner(*planes((a_ref, s_ref)), b_ref, out_ref,
               scratches=(acc_ref,))
+        return
 
-        if s < world - 1:
-            pltpu.make_async_copy(src, src, send_sem).wait()
+    me = jax.lax.axis_index(axis)
+    right = jax.lax.rem(me + 1, world)
+    left = jax.lax.rem(me + world - 1, world)
 
-    # Gathered-A output validity (consumers read ag_ref after the kernel).
-    cp.wait()
+    # Stage local segment(s) into the gathered output (reference:
+    # local_copy_and_barrier_all, allgather_gemm.py:100-116) — but only
+    # START them: step 0 computes and ring-forwards directly from the
+    # inputs, so the staging DMA hides behind the first segment's GEMM.
+    # The wait is at kernel exit, for gathered-output validity.
+    cps = [pltpu.make_async_copy(
+        a_ref, ag_ref.at[pl.ds(me * m_loc, m_loc)], copy_sem)]
+    if wire:
+        cps.append(pltpu.make_async_copy(
+            s_ref, ag_s_ref.at[pl.ds(me * m_loc, m_loc)], copy_sem))
+    for cp in cps:
+        cp.start()
+
+    # Neighbor barrier before any remote write (same role as the entry
+    # barrier_all: nobody writes into a peer that hasn't entered the
+    # kernel).
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(barrier, inc=1, device_id={axis: left},
+                           device_id_type=pltpu.DeviceIdType.MESH)
+    pltpu.semaphore_signal(barrier, inc=1, device_id={axis: right},
+                           device_id_type=pltpu.DeviceIdType.MESH)
+    pltpu.semaphore_wait(barrier, 2)
+
+    def seg(s):
+        slot = jax.lax.rem(me - s + world, world)
+        sl = pl.ds(slot * m_loc, m_loc)
+        return slot, ag_ref.at[sl], (ag_s_ref.at[sl] if wire else None)
+
+    def run(allocs):
+        for s in range(world):
+            slot, sg, ssg = seg(s)
+            # Step 0's segment is the local one — read it from the inputs
+            # (the staging copies into the gathered buffers may still be
+            # in flight).
+            srcs = (a_ref, s_ref) if s == 0 else (sg, ssg)
+            out = out_ref.at[pl.ds(slot * m_loc, m_loc)]
+
+            if s < world - 1:
+                # Launch the ring-forward of this step's segment before
+                # entering its pipeline cycle, so the wire transfer rides
+                # under the whole cycle's compute.  (Its recv wait
+                # happened in the previous cycle's prefetch, so the data
+                # is valid; issuing a remote DMA *inside* a
+                # prefetch/postyeet callback deadlocks the Mosaic
+                # interpreter, so it stays out here.)  sg/ssg are the
+                # landing slots on the peer (SPMD addressing: slot(s) is
+                # the same index on every device).  The payload goes as
+                # ``chunks`` row-chunk DMAs; byte-counted send/recv
+                # waits are chunk-agnostic.
+                rows_c = m_loc // chunks
+                for q in range(chunks):
+                    dl.remote_copy(
+                        srcs[0].at[pl.ds(q * rows_c, rows_c)],
+                        sg.at[pl.ds(q * rows_c, rows_c)],
+                        send_sem, recv_sem, axis, right).start()
+                if wire:
+                    dl.remote_copy(srcs[1], ssg, send_sem, recv_sem,
+                                   axis, right).start()
+
+            def prefetch(*brefs_and_sched, s=s):
+                # Last inner iteration of step s: the reference's dl.wait
+                # on the per-rank signal, folded into the prefetch of the
+                # next segment's first tiles — recv_sem completion means
+                # the left neighbor's forward landed.
+                *in_brefs, _o, scheduler = brefs_and_sched
+                _, nsg, nssg = seg(s + 1)
+                pltpu.make_async_copy(nsg, nsg, recv_sem).wait()
+                if wire:
+                    pltpu.make_async_copy(nssg, nssg, recv_sem).wait()
+                    scheduler.prefetch(in_brefs[0], nsg)
+                    scheduler.prefetch(in_brefs[1], nssg)
+                    scheduler.prefetch(in_brefs[2], b_ref)
+                else:
+                    scheduler.prefetch(in_brefs[0], nsg)
+                    scheduler.prefetch(in_brefs[1], b_ref)
+
+            inner(*planes(srcs), b_ref, out, scratches=(acc_ref,),
+                  allocations=allocs,
+                  first_cycle=s == 0, last_cycle=s == world - 1,
+                  prefetch=prefetch if s < world - 1 else None)
+
+            if s < world - 1:
+                # Drain this cycle's forward(s) (completed during the
+                # cycle's compute) so send_sem stays at zero per step.
+                pltpu.make_async_copy(srcs[0], srcs[0], send_sem).wait()
+                if wire:
+                    pltpu.make_async_copy(srcs[1], srcs[1],
+                                          send_sem).wait()
+
+    alloc_refs = planes((a_ref, s_ref)) + (b_ref,)
+    pl.run_scoped(
+        run,
+        pltpu.make_pipeline_allocations(
+            *alloc_refs, out_ref.at[pl.ds(0, m_loc)],
+            in_specs=in_specs, out_specs=out_specs,
+            # must match out_specs' pytree structure (emit_pipeline
+            # broadcasts this itself; the direct call does not)
+            should_accumulate_out=(False,), grid=grid),
+    )
+
+    # Gathered-output validity (consumers read them after the kernel).
+    for cp in cps:
+        cp.wait()
 
 
 def _torus_ag_gemm_kernel(
@@ -264,8 +394,8 @@ def _torus_ag_gemm_kernel(
         pltpu.make_async_copy(blk, blk, send_z).wait()
 
 
-def _torus_ag_gemm_shard(a_shard, b_shard, *, axes, impl, bm, bn, bk,
-                         interpret):
+def _torus_ag_gemm_shard(a_shard, b_shard, *, axes, impl, raw_impl, bm, bn,
+                         bk, interpret):
     """Per-device 2-/3-axis torus AG-GEMM (see kernel docstring).  Gathered
     A comes back flat axes-major, C as the matching [W*m_loc, n_loc]."""
     ax, ay = axes[0], axes[1]
@@ -280,7 +410,8 @@ def _torus_ag_gemm_shard(a_shard, b_shard, *, axes, impl, bm, bn, bk,
     out_dtype = jnp.int32 if quantized else a_shard.dtype
     acc_dtype = jnp.int32 if quantized else jnp.float32
 
-    if impl == "xla" or not pallas_shapes_ok(m_loc, n_loc, K):
+    if use_fallback(raw_impl, impl, pallas_shapes_ok(m_loc, n_loc, K),
+                    "ag_gemm(torus)", f"per-shard ({m_loc}, {n_loc}, {K})"):
         a_full = jax.lax.all_gather(a_shard, axes, axis=0, tiled=True)
         pref = jnp.int32 if quantized else jnp.float32
         return a_full, jnp.dot(
@@ -322,12 +453,20 @@ def _torus_ag_gemm_shard(a_shard, b_shard, *, axes, impl, bm, bn, bk,
 
 
 def ag_gemm_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
-                  bk=None, interpret=False):
+                  bk=None, chunks=1, wire_dtype=None, interpret=False):
     """Per-device AG-GEMM; call inside shard_map.  Returns (A_full, C_shard).
     Block sizes default to the swept MatmulConfig (gemm.py).  ``axis`` may
     be a tuple of 2-3 mesh axes — A's rows sharded over the axes-major
     joint axes — routing to the torus schedule (phase-interleaved multi-
-    axis ring producer, ``_torus_ag_gemm_kernel``)."""
+    axis ring producer, ``_torus_ag_gemm_kernel``).
+
+    ``wire_dtype="int8"`` (float A only): the ring ships per-row-quantized
+    int8 segments + an f32 scale plane and dequantizes at the MXU feed —
+    ~2x fewer allgather wire bytes for unquantized models; the returned
+    A_full is the dequantized reconstruction (quantization noise applies,
+    so compare with tolerance).  Ignored on the XLA fallback path only in
+    the sense that the same quantize→dequantize noise is applied locally
+    there, keeping the two impls numerically equivalent."""
     _cfg = MatmulConfig()
     bm, bn, bk = bm or _cfg.block_m, bn or _cfg.block_n, bk or _cfg.block_k
     raw_impl = impl
@@ -340,8 +479,15 @@ def ag_gemm_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
         if len(real) <= 1:  # degenerate: at most one real axis
             axis = real[0] if real else axes[0]
         else:
+            if wire_dtype is not None:
+                raise NotImplementedError(
+                    "wire_dtype is implemented for the 1-D ring schedule; "
+                    "the torus schedule ships bf16 (its per-phase "
+                    "line/plane DMAs would each need the scale plane "
+                    "threaded through — tracked for a future round)")
             return _torus_ag_gemm_shard(a_shard, b_shard, axes=real,
-                                        impl=impl, bm=bm, bn=bn, bk=bk,
+                                        impl=impl, raw_impl=raw_impl,
+                                        bm=bm, bn=bn, bk=bk,
                                         interpret=interpret)
     axis = axis[0] if isinstance(axis, (tuple, list)) else axis
     world = jax.lax.axis_size(axis)
@@ -352,14 +498,31 @@ def ag_gemm_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
     quantized = a_shard.dtype == jnp.int8
     out_dtype = jnp.int32 if quantized else a_shard.dtype
     acc_dtype = jnp.int32 if quantized else jnp.float32
+    wire = wire_dtype is not None
+    if wire:
+        if wire_dtype != "int8":
+            raise ValueError(f"wire_dtype must be 'int8' or None, got "
+                             f"{wire_dtype!r} (fp8 matmuls run at bf16 "
+                             "rate on v5e — docs/perf.md fp8 probe)")
+        if quantized:
+            wire = False  # int8 A already IS the wire format
 
-    if impl == "xla" or not pallas_shapes_ok(m_loc, n_loc, K):
+    if use_fallback(raw_impl, impl, pallas_shapes_ok(m_loc, n_loc, K),
+                    "ag_gemm", f"per-shard ({m_loc}, {n_loc}, {K})"):
+        if wire:
+            # Same quantization noise as the wire kernel, applied
+            # locally, so xla/pallas stay numerically equivalent.
+            from triton_dist_tpu.kernels.quant import quantize_rowwise
+
+            aq, ascale = quantize_rowwise(a_shard)
+            a_shard = (aq.astype(jnp.float32)
+                       * ascale[:, None]).astype(a_shard.dtype)
         a_full = jax.lax.all_gather(a_shard, axis, axis=0, tiled=True)
         pref = jnp.int32 if quantized else jnp.float32
         return a_full, jnp.dot(
             a_full, b_shard, preferred_element_type=pref).astype(out_dtype)
 
-    if world == 1 and raw_impl == "auto" and not interpret:
+    if world == 1 and raw_impl == "auto" and not interpret and not wire:
         # Degenerate world under auto dispatch: there is nothing to gather,
         # and skipping the ring kernel's A-staging DMA (a full extra read +
         # write of A) is worth ~7% at the bench shape (182 → 190 TFLOPS).
@@ -375,11 +538,52 @@ def ag_gemm_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
     bm = largest_divisor_block(m_loc, bm, 8)
     bn = largest_divisor_block(n_loc, bn, 128)
     bk = largest_divisor_block(K, bk, 128)
+    # Sub-chunk rows must stay sublane-aligned; clamp to a divisor.
+    while chunks > 1 and (m_loc % chunks or (m_loc // chunks) % 8):
+        chunks -= 1
+
+    if wire:
+        from triton_dist_tpu.kernels.quant import quantize_rowwise
+
+        aq, ascale = quantize_rowwise(a_shard)       # i8, [m_loc] f32
+        s_plane = jnp.zeros((m_loc, 128), jnp.float32).at[:, 0].set(ascale)
+        ag_w, ag_s, c = pl.pallas_call(
+            functools.partial(
+                _ag_gemm_kernel, axis=axis, world=world, m_loc=m_loc,
+                bm=bm, bn=bn, bk=bk, out_dtype=out_dtype, chunks=chunks,
+                wire=True,
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((world * m_loc, K), jnp.int8),
+                jax.ShapeDtypeStruct((world * m_loc, 128), jnp.float32),
+                jax.ShapeDtypeStruct((world * m_loc, n_loc), out_dtype),
+            ],
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+            out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA,
+                pltpu.SemaphoreType.DMA,
+                pltpu.SemaphoreType.DMA,
+                pltpu.VMEM((bm, bn), acc_dtype),
+            ],
+            # World-1: the wire planes ARE the inputs.
+            input_output_aliases={0: 0, 1: 1} if world == 1 else {},
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                collective_id=AG_GEMM_COLLECTIVE_ID if world > 1 else None,
+            ),
+            interpret=maybe_interpret(interpret),
+        )(aq, s_plane, b_shard)
+        # Lazy bf16 reconstruction of gathered A — XLA DCEs this when the
+        # caller only uses C.
+        a_full = (ag_w.astype(jnp.float32)
+                  * ag_s[:, :1]).astype(a_shard.dtype)
+        return a_full, c
 
     return pl.pallas_call(
         functools.partial(
             _ag_gemm_kernel, axis=axis, world=world, m_loc=m_loc,
-            bm=bm, bn=bn, bk=bk, out_dtype=out_dtype,
+            bm=bm, bn=bn, bk=bk, out_dtype=out_dtype, chunks=chunks,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((world * m_loc, K), a_shard.dtype),
@@ -395,6 +599,10 @@ def ag_gemm_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
             pltpu.SemaphoreType.DMA,
             pltpu.VMEM((bm, bn), acc_dtype),
         ],
+        # World-1: gathered A IS A — alias instead of staging (the
+        # staging DMA's full [m_loc, K] read+write costs ~8% of the GEMM
+        # at the bench shape; exp_ring_schedule.py).
+        input_output_aliases={0: 0} if world == 1 else {},
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True,
             collective_id=AG_GEMM_COLLECTIVE_ID if world > 1 else None,
@@ -412,6 +620,8 @@ def ag_gemm(a, b, ctx: AllGatherGEMMContext):
 def ag_gemm_gathered(a, b, ctx: AllGatherGEMMContext):
     """Like :func:`ag_gemm` but also returns the gathered A (the reference
     keeps it in ``ctx`` for reuse by subsequent ops)."""
+    from triton_dist_tpu.runtime.profiling import annotate
+
     cfg = ctx.config
     fn = cached_shard_jit(
         ag_gemm_shard,
@@ -420,9 +630,22 @@ def ag_gemm_gathered(a, b, ctx: AllGatherGEMMContext):
         (P(None, None), P(None, ctx.axis)),
         axis=ctx.axis, impl=ctx.impl,
         bm=cfg.block_m, bn=cfg.block_n, bk=cfg.block_k,
+        chunks=ctx.chunks, wire_dtype=ctx.wire_dtype,
         interpret=ctx.interpret,
     )
-    return fn(a, b)
+    # Launch metadata (reference: GEMMs report name/flops/bytes to the
+    # profiler, allgather_gemm.py:120-130).  Per-device: full [M, K] x
+    # local [K, n_loc] MXU work; bytes = ring wire (the whole gathered A
+    # arrives once) + B read + C write.
+    axes = (tuple(ctx.axis) if isinstance(ctx.axis, (tuple, list))
+            else (ctx.axis,))
+    world = int(np.prod([ctx.mesh.shape[ax] for ax in axes]))
+    M, K = a.shape
+    n_loc = b.shape[1] // max(world, 1)
+    el = jnp.dtype(a.dtype).itemsize
+    with annotate("ag_gemm", flops=2 * M * n_loc * K,
+                  bytes_accessed=(M * K + K * n_loc + M * n_loc) * el):
+        return fn(a, b)
 
 
 # ---------------------------------------------------------------------------
@@ -432,22 +655,32 @@ def ag_gemm_gathered(a, b, ctx: AllGatherGEMMContext):
 
 from triton_dist_tpu.autotuner import Config as _Cfg, autotune as _autotune
 
-# Block space for the ring/torus AG-GEMM producer: the dense sweep's
-# winners plus tall/deep alternatives (chunk granularity is the ring
-# segment itself — fixed by the sharding — so blocks are the free knobs).
-AG_GEMM_TUNE_SPACE = [
+# Block space shared with the GEMM-RS sweep (a new winner from the next
+# on-chip session lands in both): the dense sweep's winners plus
+# tall/deep alternatives.
+OVERLAP_BLOCK_SPACE = [
     _Cfg(bm=512, bn=512, bk=512),
     _Cfg(bm=1024, bn=1024, bk=512),
     _Cfg(bm=1024, bn=512, bk=1024),
     _Cfg(bm=2048, bn=512, bk=512),
 ]
 
+# AG-GEMM adds the ring-forward sub-chunk axis (VERDICT r3 #9 — the
+# schedule knob ``perf_model.overlap_chunk_budget`` models; c > 1 splits
+# each segment's wire DMA into c row-chunks).
+AG_GEMM_TUNE_SPACE = (
+    [_Cfg(**c, chunks=1) for c in OVERLAP_BLOCK_SPACE]
+    + [_Cfg(bm=2048, bn=512, bk=512, chunks=2),
+       _Cfg(bm=2048, bn=512, bk=512, chunks=4)]
+)
+
 
 @_autotune(configs=AG_GEMM_TUNE_SPACE, key=())
-def _ag_gemm_tunable(a, b, *, ctx, bm=None, bn=None, bk=None):
+def _ag_gemm_tunable(a, b, *, ctx, bm=None, bn=None, bk=None, chunks=1):
     tuned = AllGatherGEMMContext(
         mesh=ctx.mesh, axis=ctx.axis, impl=ctx.impl,
-        config=MatmulConfig(bm, bn, bk), interpret=ctx.interpret)
+        config=MatmulConfig(bm, bn, bk), chunks=chunks,
+        wire_dtype=ctx.wire_dtype, interpret=ctx.interpret)
     return ag_gemm(a, b, tuned)
 
 

@@ -86,6 +86,32 @@ def resolve_impl(impl: str, interpret: bool) -> str:
     return impl
 
 
+class PallasShapeError(ValueError):
+    """Raised when ``impl='pallas'`` is requested explicitly but a shape
+    guard would silently reroute to the XLA fallback."""
+
+
+def use_fallback(raw_impl: str, resolved_impl: str, ok: bool, what: str,
+                 detail: str = "") -> bool:
+    """Shared dispatcher gate: True -> take the XLA fallback path.
+
+    Under EXPLICIT ``impl='pallas'`` a failing shape guard RAISES instead
+    of rerouting (VERDICT r3 #2): the reference cannot have this bug class
+    — its tests run the Triton kernel or crash — whereas a silent
+    fallback once hid a fused-kernel deadlock behind green tests.  With
+    this gate, every ``impl='pallas'`` test IS a kernel-reach assertion:
+    shrinking its shapes below ``pallas_shapes_ok`` fails loudly.
+    ``impl='auto'`` keeps its fallback freedom (that is its purpose).
+    """
+    if raw_impl == "pallas" and not ok:
+        raise PallasShapeError(
+            f"{what}: impl='pallas' requested but {detail or 'the shape'} "
+            f"fails the MXU tiling contract (pallas_shapes_ok: per-shard "
+            f"m%8 == n%128 == k%128 == 0); pass impl='auto' to permit the "
+            f"XLA fallback")
+    return resolved_impl == "xla" or not ok
+
+
 def gemm_pipeline_body(a_blk, b_blk, out_blk, acc_ref, *, n_k, out_dtype):
     """Shared emit_pipeline body for nested MXU matmuls inside overlapped
     kernels: one (bm, bn, bk) tile accumulated over the k grid.  The
@@ -98,6 +124,32 @@ def gemm_pipeline_body(a_blk, b_blk, out_blk, acc_ref, *, n_k, out_dtype):
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     acc_ref[:] += jnp.dot(a_blk[:], b_blk[:],
+                          preferred_element_type=acc_ref.dtype)
+
+    @pl.when(k == n_k - 1)
+    def _():
+        out_blk[:] = acc_ref[:].astype(out_dtype)
+
+
+def wire_gemm_pipeline_body(a_blk, s_blk, b_blk, out_blk, acc_ref, *,
+                            n_k, out_dtype):
+    """int8-WIRE variant of :func:`gemm_pipeline_body`: the A block
+    arrives as the int8 wire payload plus a per-row scale plane (column 0
+    of a 128-lane f32 block — the minimum Mosaic wire unit), and is
+    dequantized at the MXU feed; the math stays in B's dtype with f32
+    accumulation.  (Reference ships fp8 payloads in its headline kernel,
+    low_latency_all_to_all.py:76-88; on this chip int8 is the 2x wire
+    format and fp8 would run the MXU at bf16 rate anyway — docs/perf.md
+    fp8 probe.)"""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    a_deq = (a_blk[:].astype(jnp.float32) * s_blk[:, :1]).astype(
+        b_blk.dtype)
+    acc_ref[:] += jnp.dot(a_deq, b_blk[:],
                           preferred_element_type=acc_ref.dtype)
 
     @pl.when(k == n_k - 1)

@@ -56,6 +56,7 @@ from triton_dist_tpu.kernels.gemm import (
     matmul,
     pallas_shapes_ok,
     resolve_impl,
+    use_fallback,
 )
 from triton_dist_tpu.language.interpret import maybe_interpret
 from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
@@ -568,7 +569,8 @@ def gemm_rs_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
     out_dtype = jnp.int32 if quantized else a_shard.dtype
     acc_dtype = jnp.int32 if quantized else jnp.float32
 
-    if impl == "xla" or not pallas_shapes_ok(m_loc, N, k_loc):
+    if use_fallback(raw_impl, impl, pallas_shapes_ok(m_loc, N, k_loc),
+                    "gemm_rs", f"per-shard ({m_loc}, {N}, {k_loc})"):
         pref = jnp.int32 if quantized else jnp.float32
         partial = jnp.dot(a_shard, b_shard, preferred_element_type=pref)
         return jax.lax.psum_scatter(
@@ -639,7 +641,20 @@ def gemm_rs(a, b, ctx: GEMMReduceScatterContext):
         bm=cfg.block_m, bn=cfg.block_n, bk=cfg.block_k,
         interpret=ctx.interpret,
     )
-    return fn(a, b)
+    # Launch metadata (reference: launch_metadata hooks report flops/bytes,
+    # gemm_reduce_scatter.py).  Per-device: [M, k_loc] x [k_loc, N] MXU
+    # work; bytes = A/B reads + ring partial traffic (~M*N through HBM).
+    from triton_dist_tpu.runtime.profiling import annotate
+
+    axes = tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
+    world = int(np.prod([ctx.mesh.shape[ax] for ax in axes]))
+    M = a.shape[0]
+    N = b.shape[1]
+    k_loc = a.shape[1] // max(world, 1)
+    el = jnp.dtype(a.dtype).itemsize
+    with annotate("gemm_rs", flops=2 * M * N * k_loc,
+                  bytes_accessed=(M * k_loc + k_loc * N + M * N) * el):
+        return fn(a, b)
 
 
 # ---------------------------------------------------------------------------
@@ -648,9 +663,11 @@ def gemm_rs(a, b, ctx: GEMMReduceScatterContext):
 
 from triton_dist_tpu.autotuner import autotune as _autotune
 # One shared block space for both overlapped kernels: a new winner from
-# the next on-chip session lands in both sweeps.
+# the next on-chip session lands in both sweeps.  (The AG side
+# additionally crosses in its ring-forward chunk axis, which GEMM-RS
+# does not have.)
 from triton_dist_tpu.kernels.allgather_gemm import (
-    AG_GEMM_TUNE_SPACE as GEMM_RS_TUNE_SPACE,
+    OVERLAP_BLOCK_SPACE as GEMM_RS_TUNE_SPACE,
 )
 
 

@@ -33,6 +33,7 @@ from triton_dist_tpu.kernels.gemm import (
     largest_divisor_block,
     pallas_shapes_ok,
     resolve_impl,
+    use_fallback,
 )
 from triton_dist_tpu.language.interpret import maybe_interpret
 
@@ -54,6 +55,26 @@ def group_gemm_xla(x_sorted, w_stack, tile_expert, block_m: int, out_dtype=None)
                     preferred_element_type=(jnp.int32 if quantized
                                             else jnp.float32))
     return yt.astype(out_dtype).reshape(m_pad, w_stack.shape[-1])
+
+
+def load_aware_block_m(total_rows: int, n_experts: int,
+                       floor: int = 128) -> int:
+    """Load-aware sort/GEMM row-tile size (VERDICT r3 #4).
+
+    The real-chip sweep (docs/perf.md "Grouped GEMM MFU") says tile
+    height is the whole game: 128-row tiles reach 42-54% MFU, 512-row
+    tiles ~87% — but a 512 tile on a sparsely-loaded expert is mostly
+    sort padding (wasted rows ≈ E * block_m/2).  Rule: the largest of
+    {128, 256, 512} not exceeding the *balanced* per-expert load
+    ``total_rows / n_experts`` — dense prefill gets the 512 MFU winner,
+    sparse serving degrades toward the padding-lean 128.
+    """
+    per_expert = max(total_rows // max(n_experts, 1), 1)
+    best = floor
+    for b in (256, 512):
+        if per_expert >= b:
+            best = b
+    return best
 
 
 @functools.partial(
@@ -149,8 +170,10 @@ def _group_gemm_fwd_impl(x_sorted, w_stack, tile_expert, block_m, bn, bk,
     out_dtype = out_dtype or (jnp.int32 if quantized else x_sorted.dtype)
     acc_dtype = jnp.int32 if quantized else jnp.float32
 
+    raw_impl = impl
     impl = resolve_impl(impl, interpret)
-    if impl == "xla" or not pallas_shapes_ok(block_m, n_dim, k_dim):
+    if use_fallback(raw_impl, impl, pallas_shapes_ok(block_m, n_dim, k_dim),
+                    "group_gemm", f"(block_m={block_m}, N={n_dim}, K={k_dim})"):
         return group_gemm_xla(x_sorted, w_stack, tile_expert, block_m, out_dtype)
 
     bn = largest_divisor_block(n_dim, bn, 128)

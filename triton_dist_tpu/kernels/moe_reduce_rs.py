@@ -54,6 +54,7 @@ from triton_dist_tpu.kernels.gemm import (
     largest_divisor_block,
     pallas_shapes_ok,
     resolve_impl,
+    use_fallback,
 )
 from triton_dist_tpu.kernels.group_gemm import group_gemm_xla
 from triton_dist_tpu.kernels.moe_utils import combine_topk
@@ -73,7 +74,12 @@ class MoEReduceRSContext:
     n_experts: int
     topk: int
     axis: str = "tp"
-    block_m: int = 128
+    # None = derive load-aware at the host entry (dense loads get the
+    # measured 512 MFU winner; group_gemm.load_aware_block_m).  NOTE the
+    # input ``h`` must be built with the SAME block_m (its sorted layout
+    # depends on it) — callers composing with ag_group_gemm should share
+    # one context or one explicit block_m.
+    block_m: int | None = None
     impl: str = "auto"
     config: MatmulConfig = field(default_factory=MatmulConfig)
     interpret: bool = False
@@ -83,7 +89,7 @@ class MoEReduceRSContext:
         return self.mesh.shape[self.axis]
 
 
-def create_moe_rs_context(mesh, n_experts, topk, axis="tp", block_m=128,
+def create_moe_rs_context(mesh, n_experts, topk, axis="tp", block_m=None,
                           impl="auto", config=None,
                           interpret=False) -> MoEReduceRSContext:
     return MoEReduceRSContext(
@@ -195,6 +201,7 @@ def moe_reduce_rs_shard(h_loc, w_stack, weights_loc, experts_loc, *,
     Returns the local token shard's combined, fully-reduced outputs
     [t_loc, D].
     """
+    raw_impl = impl
     impl = resolve_impl(impl, interpret)
     world = jax.lax.axis_size(axis)
     f_loc = h_loc.shape[1]
@@ -205,7 +212,9 @@ def moe_reduce_rs_shard(h_loc, w_stack, weights_loc, experts_loc, *,
     dest_all, te_all, m_pad = _segment_plans(experts_all, n_experts, block_m)
     assert h_loc.shape[0] == world * m_pad, (h_loc.shape, world, m_pad)
 
-    if impl == "xla" or not pallas_shapes_ok(block_m, D, f_loc):
+    if use_fallback(raw_impl, impl, pallas_shapes_ok(block_m, D, f_loc),
+                    "moe_reduce_rs",
+                    f"(block_m={block_m}, D={D}, f_loc={f_loc})"):
         ys = group_gemm_xla(h_loc, w_stack, te_all.reshape(-1), block_m)
         ys_me = jax.lax.psum_scatter(ys, axis, scatter_dimension=0, tiled=True)
     else:
@@ -248,7 +257,11 @@ def moe_reduce_rs_shard(h_loc, w_stack, weights_loc, experts_loc, *,
 def moe_reduce_rs(h, w_stack, weights, experts, ctx: MoEReduceRSContext):
     """out[T, D] = reduce_scatter(GroupGEMM(h) topk-combined), overlapped.
     Host entry (reference ``moe_reduce_rs`` moe_reduce_rs.py:882-1020)."""
+    from triton_dist_tpu.kernels.group_gemm import load_aware_block_m
+
     cfg = ctx.config
+    block_m = ctx.block_m or load_aware_block_m(
+        weights.shape[0] * ctx.topk, ctx.n_experts)
     fn = cached_shard_jit(
         moe_reduce_rs_shard,
         ctx.mesh,
@@ -256,7 +269,55 @@ def moe_reduce_rs(h, w_stack, weights, experts, ctx: MoEReduceRSContext):
          P(ctx.axis, None), P(ctx.axis, None)),
         P(ctx.axis, None),
         axis=ctx.axis, n_experts=ctx.n_experts, topk=ctx.topk,
-        block_m=ctx.block_m, bn=cfg.block_n, bk=cfg.block_k,
+        block_m=block_m, bn=cfg.block_n, bk=cfg.block_k,
         impl=ctx.impl, interpret=ctx.interpret,
     )
-    return fn(h, w_stack, weights, experts)
+    # Launch metadata: grouped GEMM over all sorted rows against the
+    # local F shard, plus the ring partial traffic (~rows*D).
+    from triton_dist_tpu.runtime.profiling import annotate
+
+    rows = h.shape[0]
+    f_loc = h.shape[1] // max(ctx.world, 1)
+    D = w_stack.shape[2]
+    el = jnp.dtype(h.dtype).itemsize
+    with annotate("moe_reduce_rs", flops=2 * rows * f_loc * D,
+                  bytes_accessed=(rows * f_loc + rows * D) * el
+                  + w_stack.size // max(ctx.world, 1) * el):
+        return fn(h, w_stack, weights, experts)
+
+
+# ---------------------------------------------------------------------------
+# Autotuned entry (VERDICT r3 #4, twin of ag_group_gemm_autotuned).
+# ---------------------------------------------------------------------------
+
+from triton_dist_tpu.autotuner import Config as _Cfg, autotune as _autotune
+
+# NOTE: block_m is NOT swept here — the input ``h`` arrives already in the
+# block_m-dependent sorted layout (its m_pad is fixed by the producer), so
+# the tile height is chosen by the producer side (ag_group_gemm's sweep /
+# load-aware default) and this sweep covers the MXU blocks.
+MOE_RS_TUNE_SPACE = [
+    _Cfg(bn=512, bk=512),
+    _Cfg(bn=512, bk=1024),   # bf16 grouped winner
+    _Cfg(bn=1024, bk=1024),  # int8 grouped winner
+]
+
+
+@_autotune(configs=MOE_RS_TUNE_SPACE, key=())
+def _moe_reduce_rs_tunable(h, w_stack, weights, experts, *, ctx,
+                           bn=None, bk=None):
+    tuned = MoEReduceRSContext(
+        mesh=ctx.mesh, n_experts=ctx.n_experts, topk=ctx.topk,
+        axis=ctx.axis, block_m=ctx.block_m, impl=ctx.impl,
+        config=MatmulConfig(ctx.config.block_m, bn, bk),
+        interpret=ctx.interpret)
+    return moe_reduce_rs(h, w_stack, weights, experts, tuned)
+
+
+def moe_reduce_rs_autotuned(h, w_stack, weights, experts,
+                            ctx: MoEReduceRSContext):
+    """:func:`moe_reduce_rs` with (bn, bk) selected by the autotuner (each
+    config re-traces the whole overlapped ring program).  Same
+    lockstep/is_dist rules as ``ag_gemm_autotuned``; on the tunnel chip
+    use scripts/autotune_onchip.py's chain measure instead."""
+    return _moe_reduce_rs_tunable(h, w_stack, weights, experts, ctx=ctx)

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from triton_dist_tpu.kernels.gemm import resolve_impl
+from triton_dist_tpu.kernels.gemm import resolve_impl, use_fallback
 
 
 @dataclass(frozen=True)
@@ -78,12 +78,14 @@ def matmul_i8(a: jax.Array, b: jax.Array,
     m, k = a.shape
     k2, n = b.shape
     assert k == k2, (a.shape, b.shape)
+    raw_impl = impl
     impl = resolve_impl(impl, interpret)
     cfg = (config or Int8MatmulConfig()).for_shape(m, n, k)
     bm, bn, bk = cfg.block_m, cfg.block_n, cfg.block_k
     ok = m % bm == 0 and n % bn == 0 and k % bk == 0 and m % 32 == 0
 
-    if impl == "xla" or not ok:
+    if use_fallback(raw_impl, impl, ok, "matmul_i8",
+                    f"({m}, {n}, {k}) vs blocks ({bm}, {bn}, {bk}), m%32"):
         return jax.lax.dot_general(
             a, b, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.int32)

@@ -79,8 +79,11 @@ def _path_flavors(n: int):
 
 def free_slot_count(order, sizes_by_lbl, l):
     """Free-slot count after phase ``l`` of a cyclic-order path: the
-    product of the pending axes' sizes (shared by the torus RS/GEMM-RS
-    kernels AND their hosts' buffer sizing — one rule, one place)."""
+    product of the pending axes' sizes.  Used by the fused torus GEMM-RS
+    kernel and its host's buffer sizing (gemm_reduce_scatter.py) — one
+    rule, one place for THAT pair.  The torus RS kernel here does NOT
+    call it: ``_torus_rs_kernel`` folds over full-rank group dims
+    instead of shrinking per phase."""
     g = 1
     for a in order[l + 1:]:
         g *= sizes_by_lbl[a]
@@ -507,10 +510,19 @@ def _torus_rs(x_shard, *, axis_names, sizes, interpret, collective_id):
     cells = world
     tile_c = max(budget // max(4 * cells * ln_max * itemsize, 1), 1)
     tile_c = min(cols, max(128 * (tile_c // 128), min(cols, 128)))
-    if 4 * cells * ln_max * tile_c * itemsize > 2 * budget:
-        # Even one 128-column tile over budget (enormous rows): compose
-        # the per-axis ring RS kernels sequentially — correct at any
-        # shape, loses the 2n-path fusion.
+    # Mosaic's scoped-VMEM compile ceiling is ~16 MiB per kernel
+    # invocation (the round-2 failure the HBM-staged rewrite fixed).
+    # tile_c is normally sized inside ``budget``, but line above forces
+    # at least one 128-column tile — shapes that land in the
+    # (budget, ceiling] window would previously compile only by luck, and
+    # anything above the ceiling must route to the fallback, not fail on
+    # hardware (ADVICE r3: the old ``2 * budget`` guard left a
+    # (16, 20] MiB window that interpret-mode tests cannot catch).
+    mosaic_vmem_ceiling = 15 * 2 ** 20
+    if 4 * cells * ln_max * tile_c * itemsize > mosaic_vmem_ceiling:
+        # Even one 128-column tile over the ceiling (enormous rows):
+        # compose the per-axis ring RS kernels sequentially — correct at
+        # any shape, loses the 2n-path fusion.
         from triton_dist_tpu.kernels.reduce_scatter import (
             ReduceScatterMethod,
             reduce_scatter_shard,

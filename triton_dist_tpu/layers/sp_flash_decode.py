@@ -88,7 +88,7 @@ class SpGQAFlashDecodeAttention:
         out = layer(q, k_cache, v_cache, lens + 1)
     """
 
-    def __init__(self, mesh: Mesh, axis: str = "sp", block_s: int = 2048,
+    def __init__(self, mesh: Mesh, axis: str = "sp", block_s: int | None = None,
                  impl: str = "auto", interpret: bool = False,
                  check_bounds: bool = True, kv_dtype=None):
         self.ctx: SpDecodeContext = create_sp_decode_context(

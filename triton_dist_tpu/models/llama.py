@@ -88,9 +88,12 @@ class LlamaConfig:
 
     @staticmethod
     def tiny(dtype=jnp.float32) -> "LlamaConfig":
-        """CPU-mesh test size; every dim still tiles the MXU legally."""
-        return LlamaConfig(vocab=512, dim=256, n_layers=2, n_heads=8,
-                           n_kv_heads=4, ffn_dim=512, max_seq=256,
+        """CPU-mesh test size; every PER-SHARD dim on a tp=4 mesh still
+        tiles the MXU legally (n%128, k%128 of the shard — the strict
+        impl='pallas' gate enforces it): kv-proj N = n_kv_heads*head_dim
+        = 512 and o-proj K = dim = 1024 both leave 128+ per device."""
+        return LlamaConfig(vocab=512, dim=1024, n_layers=2, n_heads=8,
+                           n_kv_heads=4, ffn_dim=1024, max_seq=256,
                            dtype=dtype)
 
 

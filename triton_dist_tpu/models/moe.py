@@ -103,9 +103,12 @@ class MoEConfig:
     @staticmethod
     def tiny(dtype=jnp.float32) -> "MoEConfig":
         """CPU-mesh test size (block_m small enough for tiny token counts)."""
-        return MoEConfig(vocab=256, dim=128, n_layers=2, n_heads=8,
+        # Per-shard pallas-legal on a tp=4 mesh (strict impl='pallas'
+        # gate): head_dim 128 keeps kv/o projections at n%128/k%128 per
+        # device; expert_ffn 512 leaves f_loc = 128.
+        return MoEConfig(vocab=512, dim=512, n_layers=2, n_heads=4,
                          n_kv_heads=4, n_experts=8, topk=2,
-                         expert_ffn_dim=256, max_seq=128, block_m=8,
+                         expert_ffn_dim=512, max_seq=128, block_m=8,
                          dtype=dtype)
 
 

@@ -34,11 +34,19 @@ class group_profile:
     """
 
     def __init__(self, name: str = "trace", do_prof: bool = True,
-                 base_dir: str = "prof", merge: bool = True):
+                 base_dir: str = "prof", merge: bool = True,
+                 gather: bool = False):
         self.name = name
         self.do_prof = do_prof
         self.base_dir = base_dir
         self.merge = merge
+        # ``gather=True``: ship every rank's trace files to rank 0 over
+        # the jax.distributed fabric before merging — for multi-host
+        # deployments where ranks write LOCAL disks (the reference
+        # gathers over the torch process group for the same reason,
+        # utils.py:417-501).  Off by default: single-host and shared-FS
+        # jobs see every rank dir already.
+        self.gather = gather
         self.merged_path = None
         self._cm = None
 
@@ -62,6 +70,9 @@ class group_profile:
 
                     multihost_utils.sync_global_devices(
                         "group_profile_merge")
+                    if self.gather:
+                        gather_rank_traces(
+                            os.path.join(self.base_dir, self.name))
                 if jax.process_index() == 0:
                     try:
                         self.merged_path = merge_rank_traces(
@@ -69,6 +80,68 @@ class group_profile:
                     except Exception:
                         self.merged_path = None  # per-rank dirs remain
         return False
+
+
+def gather_rank_traces(job_dir: str) -> None:
+    """Ship every rank's local trace dir to rank 0 over jax.distributed.
+
+    Reference analog: ``group_profile`` gathers per-rank trace files to
+    rank 0 over the torch process group (utils.py:417-501).  Here each
+    process tars its own ``{job_dir}/rank{i}`` in memory, the tars ride a
+    padded uint8 ``process_allgather`` (host collective over DCN), and
+    rank 0 extracts the other ranks' tars under its local ``job_dir`` so
+    :func:`merge_rank_traces` sees all of them.  No shared filesystem
+    required; a no-op at process_count() == 1.
+    """
+    import io
+    import tarfile
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    if jax.process_count() == 1:
+        return
+    me = jax.process_index()
+    rank_dir = os.path.join(job_dir, f"rank{me}")
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        if os.path.isdir(rank_dir):
+            tar.add(rank_dir, arcname=f"rank{me}")
+    blob = np.frombuffer(buf.getvalue(), np.uint8)
+
+    sizes = multihost_utils.process_allgather(
+        np.asarray([blob.size], np.int64))
+    pad = int(sizes.max())
+    # Chunked gather: allgather is the only host collective available,
+    # and a single max-padded allgather would materialize
+    # process_count * max_tar bytes on EVERY host (profiler tars run to
+    # hundreds of MB).  Fixed 64 MiB slices bound the peak at
+    # process_count * chunk regardless of tar size; ranks != 0 drop
+    # each slice immediately.
+    chunk = 64 * 2 ** 20
+    parts = [io.BytesIO() for _ in range(jax.process_count())]
+    for off in range(0, pad, chunk):
+        ln = min(chunk, pad - off)
+        piece = np.zeros((ln,), np.uint8)
+        if off < blob.size:
+            n = min(ln, blob.size - off)
+            piece[:n] = blob[off:off + n]
+        gathered = multihost_utils.process_allgather(piece)
+        if me == 0:
+            for r in range(jax.process_count()):
+                parts[r].write(bytes(np.asarray(gathered[r])))
+        del gathered
+
+    if me != 0:
+        return
+    for r in range(jax.process_count()):
+        if r == 0:
+            continue
+        data = parts[r].getvalue()[:int(sizes[r][0])]
+        with tarfile.open(fileobj=io.BytesIO(data), mode="r") as tar:
+            # 'data' filter: strips absolute paths/symlinks — the tars
+            # are self-produced, but stay safe anyway.
+            tar.extractall(job_dir, filter="data")
 
 
 def merge_rank_traces(job_dir: str) -> str | None:
@@ -126,7 +199,40 @@ def merge_rank_traces(job_dir: str) -> str | None:
 
 
 @contextlib.contextmanager
-def annotate(name: str):
-    """Named trace span (reference analog: launch_metadata proton hooks)."""
-    with jax.profiler.TraceAnnotation(name):
+def annotate(name: str, *, flops: int | None = None,
+             bytes_accessed: int | None = None):
+    """Named trace span carrying launch metadata (reference analog: the
+    launch_metadata proton hooks — GEMMs report name/flops/bytes to the
+    profiler, allgather_gemm.py:120-130).
+
+    ``flops``/``bytes_accessed`` are per-device totals for the spanned
+    op; they are embedded in the span label together with the derived
+    roofline time (max of MXU-bound and HBM-bound, from the same chip
+    tables ``kernels/perf_model`` estimates with, via ``topology``), so a
+    profiler timeline read against the span directly yields
+    achieved-vs-attainable.  The label
+    rides BOTH ``TraceAnnotation`` (host timeline) and ``jax.named_scope``
+    (baked into HLO op metadata at trace time → device timeline).
+    """
+    label = name
+    if flops is not None or bytes_accessed is not None:
+        parts = [name]
+        if flops is not None:
+            parts.append(f"flops={flops}")
+        if bytes_accessed is not None:
+            parts.append(f"bytes={bytes_accessed}")
+        try:
+            from triton_dist_tpu.runtime import topology
+
+            tf = topology.peak_bf16_tflops()
+            gbps = topology.hbm_bandwidth_gbps()
+            sol_ms = max(
+                (flops or 0) / (tf * 1e9),
+                (bytes_accessed or 0) / (gbps * 1e6)) if (tf and gbps) else 0.0
+            if sol_ms:
+                parts.append(f"sol_ms={sol_ms:.3f}")
+        except Exception:
+            pass
+        label = "#".join(parts)
+    with jax.profiler.TraceAnnotation(label), jax.named_scope(label):
         yield

@@ -30,7 +30,7 @@ from triton_dist_tpu.runtime.bootstrap import initialize_distributed
 
 def main():
     mesh = initialize_distributed(axis_names=("tp",), mesh_shape=(8,))
-    M, K, N = 512, 256, 256  # per-chip K-shard; tiny for interpret mode
+    M, K, N = 512, 8 * 128, 256  # per-chip K-shard = one full 128 tile
 
     # A row-replicated/K-sharded, B K-sharded: each chip computes a partial
     # [M, N] and the sum is scattered so chip r keeps rows r*M/8...

@@ -24,7 +24,7 @@ from triton_dist_tpu.runtime.bootstrap import initialize_distributed
 def main():
     mesh = initialize_distributed(axis_names=("dcn", "tp"),
                                   mesh_shape=(2, 4))
-    M, K, N = 256, 512, 256
+    M, K, N = 256, 8 * 128, 256  # per-chip K-shard = one full 128 tile
 
     a = jax.random.normal(jax.random.key(0), (M, K), jnp.float32)
     b = jax.random.normal(jax.random.key(1), (K, N), jnp.float32)
