@@ -227,6 +227,14 @@ def _bench_contention_sentinel():
 
     Returns (sentinel_tflops, suspect: bool) — suspect when even a
     fresh-seeded retry stays below 85% of the measured ceiling.
+
+    Reading the value: only a LOW sentinel is meaningful (contention).
+    The absolute number routinely OVERSTATES the dot rate (meas. up to
+    ~250 "TFLOPS" > the 197 peak): XLA fuses part of the feedback churn
+    into the dots' prologue/epilogue, so the churn-only twin chain
+    over-measures the backout.  The same fusion is why the world-1 auto
+    path uses jnp.dot (allgather_gemm.py) — it is a real wall-clock win
+    for users' chains even though the per-op TFLOPS attribution blurs.
     """
     from scripts.benchlib import backout_pair
     from triton_dist_tpu.runtime.topology import measured_dot_ceiling_tflops

@@ -75,7 +75,11 @@ def nested_gemm(a, b, bm=BM, bn=BN, bk=BK):
 def make_chain(mesh, n, variant):
     def body_fn(x, b1, b2):
         def body(i, x):
-            if variant == "dense":
+            if variant == "xdot":
+                c = jnp.dot(x, b1,
+                            preferred_element_type=jnp.float32).astype(
+                                jnp.bfloat16)
+            elif variant == "dense":
                 c = matmul(x, b1, config=MatmulConfig(BM, BN, BK))
             elif variant == "nested":
                 c = nested_gemm(x, b1)

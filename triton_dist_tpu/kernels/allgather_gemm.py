@@ -411,7 +411,7 @@ def _torus_ag_gemm_shard(a_shard, b_shard, *, axes, impl, raw_impl, bm, bn,
     acc_dtype = jnp.int32 if quantized else jnp.float32
 
     if use_fallback(raw_impl, impl, pallas_shapes_ok(m_loc, n_loc, K),
-                    "ag_gemm(torus)", f"per-shard ({m_loc}, {n_loc}, {K})"):
+                    "ag_gemm(torus)", f"per-shard ({m_loc}, {n_loc}, {K}); needs m%8, n%128, k%128"):
         a_full = jax.lax.all_gather(a_shard, axes, axis=0, tiled=True)
         pref = jnp.int32 if quantized else jnp.float32
         return a_full, jnp.dot(
@@ -508,7 +508,7 @@ def ag_gemm_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
             wire = False  # int8 A already IS the wire format
 
     if use_fallback(raw_impl, impl, pallas_shapes_ok(m_loc, n_loc, K),
-                    "ag_gemm", f"per-shard ({m_loc}, {n_loc}, {K})"):
+                    "ag_gemm", f"per-shard ({m_loc}, {n_loc}, {K}); needs m%8, n%128, k%128"):
         if wire:
             # Same quantization noise as the wire kernel, applied
             # locally, so xla/pallas stay numerically equivalent.
@@ -523,16 +523,23 @@ def ag_gemm_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
             a_full, b_shard, preferred_element_type=pref).astype(out_dtype)
 
     if world == 1 and raw_impl == "auto" and not interpret and not wire:
-        # Degenerate world under auto dispatch: there is nothing to gather,
-        # and skipping the ring kernel's A-staging DMA (a full extra read +
-        # write of A) is worth ~7% at the bench shape (182 → 190 TFLOPS).
-        # Explicit impl="pallas" still runs the ring kernel (what the
-        # hardware smoke exercises); interpret mode keeps it too.
+        # Degenerate world under auto dispatch: there is nothing to
+        # gather.  Float inputs take XLA's dot, NOT the pallas matmul:
+        # in real op CHAINS XLA fuses the neighboring elementwise work
+        # (casts, feedback transforms) into the dot's prologue/epilogue,
+        # saving whole HBM passes that a custom-call pallas kernel
+        # cannot — measured 0.7 ms/pair faster at the bench shape in the
+        # same rotated trial loop (exp_ring_schedule.py 'xdot' vs
+        # 'dense'; standalone rates are equal at ~190).  int8 keeps the
+        # pallas double-rate kernel (358 vs ~280 TOPS through XLA's
+        # path).  Explicit impl="pallas" still runs the ring kernel
+        # (what the hardware smoke exercises); interpret mode keeps it
+        # too.
         if quantized:
             from triton_dist_tpu.kernels.quant import matmul_i8
             return a_shard, matmul_i8(a_shard, b_shard)
-        c = matmul(a_shard, b_shard, config=MatmulConfig(bm, bn, bk),
-                   out_dtype=out_dtype)
+        c = jnp.dot(a_shard, b_shard,
+                    preferred_element_type=jnp.float32).astype(out_dtype)
         return a_shard, c
 
     bm = largest_divisor_block(m_loc, bm, 8)

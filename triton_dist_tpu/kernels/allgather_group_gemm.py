@@ -205,7 +205,7 @@ def ag_group_gemm_shard(x_loc, weights_loc, experts_loc, w_stack, *,
 
     if use_fallback(raw_impl, impl, pallas_shapes_ok(block_m, f_loc, d_model),
                     "ag_group_gemm",
-                    f"(block_m={block_m}, f_loc={f_loc}, d={d_model})"):
+                    f"(block_m={block_m}, f_loc={f_loc}, d={d_model}); needs m%8, n%128, k%128"):
         xs_all = jax.lax.all_gather(xs_loc, axis, axis=0, tiled=True)
         ys = group_gemm_xla(xs_all, w_stack, te_all.reshape(-1), block_m)
     else:

@@ -360,15 +360,17 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
         # kernel's measured sweet spot anyway (docs/perf.md).
         bs = next((c for c in range(bs, S, 128)
                    if S % c == 0 and (c // 128) % 8 == 0), S)
-    if quantized and bs * 512 > 12 * 2 ** 20:
+    if quantized and 4 * bs * D > 12 * 2 ** 20:
         # bs == S was the only legal tile but its double-buffered K/V
-        # blocks (bs * 512 bytes) blow the ~16 MiB Mosaic VMEM budget:
-        # this S cannot tile the int8 kernel at all.
+        # blocks (2 tensors x 2 buffers x bs x D int8 bytes) blow the
+        # ~16 MiB Mosaic VMEM budget: this S cannot tile the int8
+        # kernel at all.
         if raw_impl == "pallas":
             raise PallasShapeError(
-                f"flash_decode int8-KV: S={S} has no scale-plane-legal "
-                f"KV block that fits VMEM (needs a divisor of S that is "
-                f"a multiple of 1024, or S*512 <= 12 MiB)")
+                f"flash_decode int8-KV: S={S}, D={D} has no "
+                f"scale-plane-legal KV block that fits VMEM (needs a "
+                f"divisor of S that is a multiple of 1024, or "
+                f"4*S*D <= 12 MiB)")
         return _local_decode_xla(q, k, v, local_lens, scale=scale,
                                  k_scale=k_scale, v_scale=v_scale)
     n_s = S // bs

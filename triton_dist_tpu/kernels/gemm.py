@@ -104,11 +104,13 @@ def use_fallback(raw_impl: str, resolved_impl: str, ok: bool, what: str,
     ``impl='auto'`` keeps its fallback freedom (that is its purpose).
     """
     if raw_impl == "pallas" and not ok:
+        # The specific alignment contract varies by caller (dense GEMMs:
+        # per-shard m%8/n%128/k%128; matmul_i8: m%32 + block divisors;
+        # flash_decode: D%128/S%128) — ``detail`` carries it.
         raise PallasShapeError(
             f"{what}: impl='pallas' requested but {detail or 'the shape'} "
-            f"fails the MXU tiling contract (pallas_shapes_ok: per-shard "
-            f"m%8 == n%128 == k%128 == 0); pass impl='auto' to permit the "
-            f"XLA fallback")
+            f"fails this kernel's MXU tiling contract; pass impl='auto' "
+            f"to permit the XLA fallback")
     return resolved_impl == "xla" or not ok
 
 

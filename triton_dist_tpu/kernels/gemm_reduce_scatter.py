@@ -570,7 +570,7 @@ def gemm_rs_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
     acc_dtype = jnp.int32 if quantized else jnp.float32
 
     if use_fallback(raw_impl, impl, pallas_shapes_ok(m_loc, N, k_loc),
-                    "gemm_rs", f"per-shard ({m_loc}, {N}, {k_loc})"):
+                    "gemm_rs", f"per-shard ({m_loc}, {N}, {k_loc}); needs m%8, n%128, k%128"):
         pref = jnp.int32 if quantized else jnp.float32
         partial = jnp.dot(a_shard, b_shard, preferred_element_type=pref)
         return jax.lax.psum_scatter(
@@ -579,12 +579,14 @@ def gemm_rs_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
 
     if world == 1 and raw_impl == "auto" and not interpret:
         # Degenerate world under auto dispatch: no scatter, no partial
-        # rotation — the plain MXU matmul (see ag_gemm_shard's twin path).
+        # rotation — XLA's dot for float (chain-fusion win, see
+        # ag_gemm_shard's twin path), the pallas double-rate kernel for
+        # int8.
         if quantized:
             from triton_dist_tpu.kernels.quant import matmul_i8
             return matmul_i8(a_shard, b_shard)
-        return matmul(a_shard, b_shard, config=MatmulConfig(bm, bn, bk),
-                      out_dtype=out_dtype)
+        return jnp.dot(a_shard, b_shard,
+                       preferred_element_type=jnp.float32).astype(out_dtype)
 
     bm = largest_divisor_block(m_loc, bm, 8)
     bn = largest_divisor_block(N, bn, 128)

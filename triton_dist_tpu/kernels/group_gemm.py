@@ -173,7 +173,7 @@ def _group_gemm_fwd_impl(x_sorted, w_stack, tile_expert, block_m, bn, bk,
     raw_impl = impl
     impl = resolve_impl(impl, interpret)
     if use_fallback(raw_impl, impl, pallas_shapes_ok(block_m, n_dim, k_dim),
-                    "group_gemm", f"(block_m={block_m}, N={n_dim}, K={k_dim})"):
+                    "group_gemm", f"(block_m={block_m}, N={n_dim}, K={k_dim}); needs m%8, n%128, k%128"):
         return group_gemm_xla(x_sorted, w_stack, tile_expert, block_m, out_dtype)
 
     bn = largest_divisor_block(n_dim, bn, 128)

@@ -214,7 +214,7 @@ def moe_reduce_rs_shard(h_loc, w_stack, weights_loc, experts_loc, *,
 
     if use_fallback(raw_impl, impl, pallas_shapes_ok(block_m, D, f_loc),
                     "moe_reduce_rs",
-                    f"(block_m={block_m}, D={D}, f_loc={f_loc})"):
+                    f"(block_m={block_m}, D={D}, f_loc={f_loc}); needs m%8, n%128, k%128"):
         ys = group_gemm_xla(h_loc, w_stack, te_all.reshape(-1), block_m)
         ys_me = jax.lax.psum_scatter(ys, axis, scatter_dimension=0, tiled=True)
     else:

@@ -128,16 +128,19 @@ def gather_rank_traces(job_dir: str) -> None:
             piece[:n] = blob[off:off + n]
         gathered = multihost_utils.process_allgather(piece)
         if me == 0:
-            for r in range(jax.process_count()):
-                parts[r].write(bytes(np.asarray(gathered[r])))
+            for r in range(1, jax.process_count()):
+                # Keep only each rank's REAL bytes (skip rank 0's own
+                # tar and the zero padding past sizes[r]) so rank 0's
+                # accumulation is sum(tar sizes), not P * max_tar.
+                keep = min(ln, max(int(sizes[r][0]) - off, 0))
+                if keep:
+                    parts[r].write(bytes(np.asarray(gathered[r][:keep])))
         del gathered
 
     if me != 0:
         return
-    for r in range(jax.process_count()):
-        if r == 0:
-            continue
-        data = parts[r].getvalue()[:int(sizes[r][0])]
+    for r in range(1, jax.process_count()):
+        data = parts[r].getvalue()
         with tarfile.open(fileobj=io.BytesIO(data), mode="r") as tar:
             # 'data' filter: strips absolute paths/symlinks — the tars
             # are self-produced, but stay safe anyway.
