@@ -139,3 +139,25 @@ def test_i8_pallas_ragged_s_attends_full_cache(key):
                                rtol=1e-3, atol=1e-3)
     np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x),
                                rtol=2e-2, atol=2e-2)
+
+
+def test_i8_pallas_large_d_shrinks_block(key):
+    """Regression (r4 review): D=512, S=2048 — the default full-S block
+    blows the VMEM budget; the dispatcher must shrink to a smaller legal
+    divisor (1024) instead of raising / silently degrading to XLA."""
+    from triton_dist_tpu.kernels.flash_decode import gqa_decode_shard
+
+    B, Hq, Hkv, S, D = 1, 2, 1, 2048, 512
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (B, Hq, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, Hkv, S, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, Hkv, S, D), jnp.float32)
+    kq, ksc = quantize_kv(k)
+    vq, vsc = quantize_kv(v)
+    lens = jnp.full((B,), S, jnp.int32)
+    out_p, _ = gqa_decode_shard(q, kq, vq, lens, impl="pallas",
+                                interpret=True, k_scale=ksc, v_scale=vsc)
+    out_x, _ = gqa_decode_shard(q, kq, vq, lens, impl="xla",
+                                k_scale=ksc, v_scale=vsc)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x),
+                               rtol=2e-2, atol=2e-2)

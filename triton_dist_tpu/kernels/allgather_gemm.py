@@ -314,6 +314,14 @@ def _torus_ag_gemm_kernel(
     axis; the reference's own 3D analog is the push-3D warp-specialized
     AG (low_latency_allgather.py:570-607).  ``wz == 1`` degenerates to
     the 2-axis schedule (phase 3 vanishes).
+
+    r4: the MXU pipeline is persistent (shared allocations, as in
+    ``_ag_gemm_kernel``) — phase 1 chains its wx cycles with the recv_x
+    wait folded into the prefetch callback; each phase-2/3 step chains
+    its wx (or wx*wy) slot-GEMMs into one pipeline run (all data
+    resident after the line/plane recv, so those prefetches are pure
+    next-slot fetches).  Chains break only at step boundaries, where
+    the line/plane recv wait must precede the first tile fetch.
     """
     i = jax.lax.axis_index(ax)
     j = jax.lax.axis_index(ay)
@@ -335,63 +343,106 @@ def _torus_ag_gemm_kernel(
     K = a_ref.shape[1]
     n_loc = b_ref.shape[1]
     n_m, n_n, n_k = m_loc // bm, n_loc // bn, K // bk
+    grid = (n_m, n_n, n_k)
+    in_specs = [
+        pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+        pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+    ]
+    out_specs = [pl.BlockSpec((bm, bn), lambda i, j, k: (i, j))]
 
     inner = pltpu.emit_pipeline(
         functools.partial(gemm_pipeline_body, n_k=n_k, out_dtype=out_dtype),
-        grid=(n_m, n_n, n_k),
-        in_specs=[
-            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
-            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
-        ],
-        out_specs=[pl.BlockSpec((bm, bn), lambda i, j, k: (i, j))],
+        grid=grid, in_specs=in_specs, out_specs=out_specs,
     )
 
-    # ---- Phase 1: x-ring over my line (j, k), one slot per step. ----
-    for s in range(wx):
-        slot = jax.lax.rem(i - s + wx, wx)
-        seg = ag_ref.at[slot, j, k]
-        src = a_ref if s == 0 else seg
-        if s > 0:
-            pltpu.make_async_copy(seg, seg, recv_x).wait()
-        if s < wx - 1:
-            dl.remote_copy(src, seg, send_x, recv_x, ax, right).start()
-        inner(src, b_ref, out_ref.at[slot, j, k], scratches=(acc_ref,))
-        if s < wx - 1:
-            pltpu.make_async_copy(src, src, send_x).wait()
+    def run(allocs):
+        # ---- Phase 1: x-ring over my line (j, k), one slot per step,
+        # chained into ONE persistent pipeline (as in _ag_gemm_kernel:
+        # shared allocations, recv_x wait folded into the prefetch of
+        # the last inner iteration; forwards launch outside the calls —
+        # a DMA start inside the callbacks deadlocks the interpreter).
+        def xseg(s):
+            slot = jax.lax.rem(i - s + wx, wx)
+            return slot, ag_ref.at[slot, j, k]
 
-    # Phase 2's first shipped line (j) contains the staged slot, and the
-    # gathered-A output must be valid at kernel exit either way — the
-    # staging DMA has had phase 1's wx GEMMs to hide behind.
-    cp.wait()
+        for s in range(wx):
+            slot, seg = xseg(s)
+            src = a_ref if s == 0 else seg
+            if s < wx - 1:
+                dl.remote_copy(src, seg, send_x, recv_x, ax, right).start()
 
-    # ---- Phase 2: y-ring over whole lines, wx slot-GEMMs per step. ----
-    for t in range(wy - 1):
-        line_send = jax.lax.rem(j - t + wy, wy)
-        blk = ag_ref.at[:, line_send, k]
-        dl.remote_copy(blk, blk, send_y, recv_y, ay, down).start()
+            def prefetch_x(lhs, rhs, o, scheduler, s=s):
+                del o
+                _, nseg = xseg(s + 1)
+                pltpu.make_async_copy(nseg, nseg, recv_x).wait()
+                scheduler.prefetch(lhs, nseg)
+                scheduler.prefetch(rhs, b_ref)
 
-        line_recv = jax.lax.rem(j - t - 1 + wy, wy)
-        rblk = ag_ref.at[:, line_recv, k]
-        pltpu.make_async_copy(rblk, rblk, recv_y).wait()
-        for ii in range(wx):
-            inner(ag_ref.at[ii, line_recv, k], b_ref,
-                  out_ref.at[ii, line_recv, k], scratches=(acc_ref,))
-        pltpu.make_async_copy(blk, blk, send_y).wait()
+            inner(src, b_ref, out_ref.at[slot, j, k], scratches=(acc_ref,),
+                  allocations=allocs,
+                  first_cycle=s == 0, last_cycle=s == wx - 1,
+                  prefetch=prefetch_x if s < wx - 1 else None)
+            if s < wx - 1:
+                pltpu.make_async_copy(src, src, send_x).wait()
 
-    # ---- Phase 3: z-ring over whole planes, wx*wy slot-GEMMs each. ----
-    for u in range(wz - 1):
-        plane_send = jax.lax.rem(k - u + wz, wz)
-        blk = ag_ref.at[:, :, plane_send]
-        dl.remote_copy(blk, blk, send_z, recv_z, az, back).start()
+        # Phase 2's first shipped line (j) contains the staged slot, and
+        # the gathered-A output must be valid at kernel exit either way —
+        # the staging DMA has had phase 1's wx GEMMs to hide behind.
+        cp.wait()
 
-        plane_recv = jax.lax.rem(k - u - 1 + wz, wz)
-        rblk = ag_ref.at[:, :, plane_recv]
-        pltpu.make_async_copy(rblk, rblk, recv_z).wait()
-        for ii in range(wx):
-            for jj in range(wy):
-                inner(ag_ref.at[ii, jj, plane_recv], b_ref,
-                      out_ref.at[ii, jj, plane_recv], scratches=(acc_ref,))
-        pltpu.make_async_copy(blk, blk, send_z).wait()
+        def chained_slots(srcs_outs):
+            """Run a step's slot-GEMMs as one persistent chain: all data
+            is already resident (the step waited its line/plane recv), so
+            the prefetch callbacks are pure next-slot prefetches and the
+            per-slot fill/drain bubble disappears."""
+            n = len(srcs_outs)
+            for c, (sg, og) in enumerate(srcs_outs):
+
+                def prefetch_c(lhs, rhs, o, scheduler, c=c):
+                    del o
+                    scheduler.prefetch(lhs, srcs_outs[c + 1][0])
+                    scheduler.prefetch(rhs, b_ref)
+
+                inner(sg, b_ref, og, scratches=(acc_ref,),
+                      allocations=allocs,
+                      first_cycle=c == 0, last_cycle=c == n - 1,
+                      prefetch=prefetch_c if c < n - 1 else None)
+
+        # ---- Phase 2: y-ring over whole lines, wx slot-GEMMs per step.
+        for t in range(wy - 1):
+            line_send = jax.lax.rem(j - t + wy, wy)
+            blk = ag_ref.at[:, line_send, k]
+            dl.remote_copy(blk, blk, send_y, recv_y, ay, down).start()
+
+            line_recv = jax.lax.rem(j - t - 1 + wy, wy)
+            rblk = ag_ref.at[:, line_recv, k]
+            pltpu.make_async_copy(rblk, rblk, recv_y).wait()
+            chained_slots([(ag_ref.at[ii, line_recv, k],
+                            out_ref.at[ii, line_recv, k])
+                           for ii in range(wx)])
+            pltpu.make_async_copy(blk, blk, send_y).wait()
+
+        # ---- Phase 3: z-ring over whole planes, wx*wy slot-GEMMs each.
+        for u in range(wz - 1):
+            plane_send = jax.lax.rem(k - u + wz, wz)
+            blk = ag_ref.at[:, :, plane_send]
+            dl.remote_copy(blk, blk, send_z, recv_z, az, back).start()
+
+            plane_recv = jax.lax.rem(k - u - 1 + wz, wz)
+            rblk = ag_ref.at[:, :, plane_recv]
+            pltpu.make_async_copy(rblk, rblk, recv_z).wait()
+            chained_slots([(ag_ref.at[ii, jj, plane_recv],
+                            out_ref.at[ii, jj, plane_recv])
+                           for ii in range(wx) for jj in range(wy)])
+            pltpu.make_async_copy(blk, blk, send_z).wait()
+
+    pl.run_scoped(
+        run,
+        pltpu.make_pipeline_allocations(
+            a_ref, b_ref, out_ref.at[0, 0, 0],
+            in_specs=in_specs, out_specs=out_specs,
+            should_accumulate_out=(False,), grid=grid),
+    )
 
 
 def _torus_ag_gemm_shard(a_shard, b_shard, *, axes, impl, raw_impl, bm, bn,

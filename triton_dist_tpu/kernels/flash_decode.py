@@ -360,19 +360,24 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
         # kernel's measured sweet spot anyway (docs/perf.md).
         bs = next((c for c in range(bs, S, 128)
                    if S % c == 0 and (c // 128) % 8 == 0), S)
-    if quantized and 4 * bs * D > 12 * 2 ** 20:
-        # bs == S was the only legal tile but its double-buffered K/V
-        # blocks (2 tensors x 2 buffers x bs x D int8 bytes) blow the
-        # ~16 MiB Mosaic VMEM budget: this S cannot tile the int8
-        # kernel at all.
-        if raw_impl == "pallas":
-            raise PallasShapeError(
-                f"flash_decode int8-KV: S={S}, D={D} has no "
-                f"scale-plane-legal KV block that fits VMEM (needs a "
-                f"divisor of S that is a multiple of 1024, or "
-                f"4*S*D <= 12 MiB)")
-        return _local_decode_xla(q, k, v, local_lens, scale=scale,
-                                 k_scale=k_scale, v_scale=v_scale)
+    vmem_budget = 12 * 2 ** 20  # double-buffered K+V blocks: 4 * bs * D
+    if quantized and 4 * bs * D > vmem_budget:
+        # Over budget (large D and/or bs == S): try the LARGEST legal
+        # smaller divisor that fits (e.g. S=8192 D=512: bs 8192 -> 1024)
+        # before concluding this shape cannot tile the int8 kernel.
+        fit = max((c for c in range(1024, bs, 128)
+                   if S % c == 0 and (c // 128) % 8 == 0
+                   and 4 * c * D <= vmem_budget), default=None)
+        if fit is None:
+            if raw_impl == "pallas":
+                raise PallasShapeError(
+                    f"flash_decode int8-KV: S={S}, D={D} has no "
+                    f"scale-plane-legal KV block that fits VMEM (needs "
+                    f"a multiple-of-1024 divisor of S with 4*bs*D <= "
+                    f"12 MiB)")
+            return _local_decode_xla(q, k, v, local_lens, scale=scale,
+                                     k_scale=k_scale, v_scale=v_scale)
+        bs = fit
     n_s = S // bs
 
     qg = q.reshape(B, Hkv, g, D)
