@@ -129,6 +129,16 @@ def main():
         gqa_decode_shard, mesh, 4, impl="pallas", interpret=False,
         k_scale=ks8, v_scale=vs8)(q, kq8, vq8, lens))
 
+    # 7c. flash prefill (blockwise causal GQA, scalar-prefetch offsets)
+    from triton_dist_tpu.kernels.flash_attention import flash_attention
+    qp = jax.random.normal(key, (2, 8, 1024, 128), jnp.bfloat16)
+    kp = jax.random.normal(key, (2, 2, 1024, 128), jnp.bfloat16)
+    check("flash_prefill", lambda: jax.jit(functools.partial(
+        flash_attention, causal=True, impl="pallas"))(qp, kp, kp))
+    check("flash_prefill_off", lambda: jax.jit(functools.partial(
+        flash_attention, causal=True, impl="pallas",
+        return_lse=True))(qp[:, :, :128], kp, kp, q_offset=jnp.int32(512)))
+
     # 8. ring attention world-1 (pallas kernel, VMEM staging)
     from triton_dist_tpu.kernels.ring_attention import ring_attention_shard
     qr = jax.random.normal(key, (256, 2, 8, 128), jnp.bfloat16)

@@ -56,6 +56,10 @@ from triton_dist_tpu.kernels.flash_decode import (  # noqa: F401
     sp_gqa_decode,
     create_sp_decode_context,
 )
+from triton_dist_tpu.kernels.flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_gqa_attention,
+)
 from triton_dist_tpu.kernels.moe_utils import (  # noqa: F401
     topk_routing,
     sort_align,

@@ -30,7 +30,6 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_dist_tpu.kernels.all_to_all import fast_all_to_all_shard_diff
-from triton_dist_tpu.kernels.attention import dense_gqa_attention
 from triton_dist_tpu.kernels.gemm import resolve_impl
 from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
 
@@ -110,7 +109,15 @@ def ulysses_attention_shard(q, k, v, *, axis, causal=True, scale=None,
     full = recv.reshape(world * s_loc, b, tot_loc, hd)
     qh, kh, vh = jnp.split(full, [hq_loc, hq_loc + hkv_loc], axis=2)
 
-    oh = dense_gqa_attention(qh, kh, vh, causal=causal, scale=float(scale))
+    # Local attention on scattered heads rides the flash prefill kernel
+    # when shapes allow (head_dim % 128 etc.); ``impl`` here is already
+    # resolved and governs the A2As — explicit "xla" keeps attention
+    # dense too (the differentiation-golden path).
+    from triton_dist_tpu.kernels.flash_attention import flash_gqa_attention
+
+    oh = flash_gqa_attention(qh, kh, vh, causal=causal, scale=float(scale),
+                             impl="xla" if impl == "xla" else "auto",
+                             interpret=interpret)
     return _a2a_heads_to_seq(oh, axis=axis, impl=impl, interpret=interpret)
 
 

@@ -68,11 +68,19 @@ class Generator:
             check_bounds=False,  # Generator guards lengths itself (below)
             kv_dtype=kv_dtype)   # jnp.int8 = quantized KV cache
         self._prefill_jit = jax.jit(functools.partial(
-            _prompt_forward, cfg=cfg))
+            _prompt_forward, cfg=cfg, impl=impl, interpret=interpret))
         # caches are donated: each chunk's dynamic-update happens in place
         # instead of copying every layer's full-size cache per chunk.
+        # Chunk attention reads the mesh-SHARDED cache: at world > 1 a
+        # local pallas kernel cannot live in that partitioned program
+        # (and would be wrong — each device holds a KV slice; the flash
+        # path needs the per-shard + LSE-combine treatment), so the
+        # chunked path keeps XLA attention there.
         self._chunk_jit = jax.jit(
-            functools.partial(_chunk_forward, cfg=cfg),
+            functools.partial(
+                _chunk_forward, cfg=cfg,
+                impl="xla" if mesh.shape[axis] > 1 else impl,
+                interpret=interpret),
             static_argnames=("quantized", "extent"),
             donate_argnums=(2,))
         self._step_jit = jax.jit(self._step_impl)
@@ -229,14 +237,25 @@ class Generator:
 
 
 def _attend_prefix(q, k_all, v_all, prefix_len, *, k_scale=None,
-                   v_scale=None):
+                   v_scale=None, impl="auto", interpret=False):
     """Chunk attention against the cache prefix + itself.
 
     q [B, c, Hq, hd]; k/v_all [B, Hkv, S, hd] (the full cache, chunk rows
     already written at [prefix, prefix+c)); position j is visible to chunk
     row i iff j <= prefix + i.  Scores are [c, S] — the bounded-memory
     core of chunked prefill.  Optional scales dequantize an int8 cache.
+
+    The bf16 cache path rides the flash prefill kernel (``prefix_len`` is
+    traced — it enters as scalar prefetch, one trace per extent); the
+    int8-cache path keeps the dense program with fused dequant.
     """
+    if k_scale is None and impl != "xla":
+        from triton_dist_tpu.kernels.flash_attention import flash_attention
+
+        out = flash_attention(
+            q.transpose(0, 2, 1, 3), k_all, v_all, causal=True,
+            q_offset=prefix_len, impl="auto", interpret=interpret)
+        return out.transpose(0, 2, 1, 3).astype(jnp.float32)
     B, c, Hq, hd = q.shape
     _, Hkv, S, _ = k_all.shape
     g = Hq // Hkv
@@ -274,7 +293,8 @@ def _write_chunk(cache, new, prefix_len, quantized):
 
 
 def _chunk_forward(params, chunk, caches, prefix_len, *, cfg: LlamaConfig,
-                   quantized: bool, ffn=None, extent: int | None = None):
+                   quantized: bool, ffn=None, extent: int | None = None,
+                   impl: str = "auto", interpret: bool = False):
     """One prompt chunk [B, c] against the cached prefix; returns
     (new_caches, logits [B, c, V] — position i predicts the token after
     chunk[:, i]).  The chunk's own K/V are written to the cache first
@@ -315,7 +335,7 @@ def _chunk_forward(params, chunk, caches, prefix_len, *, cfg: LlamaConfig,
                                v_scale=v_c["s"][:, :, :ext])
         else:
             o = _attend_prefix(q, k_c[:, :, :ext], v_c[:, :, :ext],
-                               prefix_len)
+                               prefix_len, impl=impl, interpret=interpret)
         o = o.reshape(B * c, cfg.n_heads * hd).astype(cfg.dtype)
         x = x + (o @ layer["wo"]).reshape(B, c, cfg.dim)
         h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps).reshape(
@@ -333,14 +353,15 @@ def _dense_prompt_ffn(h2, layer):
     return act @ layer["wdown"]
 
 
-def _prompt_forward(params, tokens, *, cfg: LlamaConfig, ffn=None):
+def _prompt_forward(params, tokens, *, cfg: LlamaConfig, ffn=None,
+                    impl: str = "auto", interpret: bool = False):
     """Full-sequence forward on replicated weights that also returns the
     per-layer K/V (post-RoPE, cache layout [B, Hkv, S, hd]) and logits.
 
     ``ffn(h2, layer) -> [B*S, D]`` swaps the MLP — the MoE family
     (generate_moe.py) reuses the whole attention/cache body this way.
     """
-    from triton_dist_tpu.kernels.attention import dense_gqa_attention
+    from triton_dist_tpu.kernels.flash_attention import flash_gqa_attention
 
     if ffn is None:
         ffn = _dense_prompt_ffn
@@ -360,8 +381,10 @@ def _prompt_forward(params, tokens, *, cfg: LlamaConfig, ffn=None):
         k = _rope(k.transpose(1, 0, 2, 3), positions, cfg.rope_theta)
         v = v.transpose(1, 0, 2, 3)
         kvs.append((k.transpose(1, 2, 0, 3), v.transpose(1, 2, 0, 3)))
-        o = dense_gqa_attention(q, k, v, causal=True,
-                                scale=1.0 / np.sqrt(hd))
+        o = flash_gqa_attention(q, k, v, causal=True,
+                                scale=1.0 / np.sqrt(hd),
+                                impl="xla" if impl == "xla" else "auto",
+                                interpret=interpret)
         o = o.transpose(1, 0, 2, 3).reshape(B * S, cfg.n_heads * hd)
         x = x + (o @ layer["wo"]).reshape(B, S, cfg.dim)
         h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps).reshape(

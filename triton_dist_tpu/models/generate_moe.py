@@ -108,7 +108,8 @@ def _moe_prompt_ffn(h2, layer, cfg: MoEConfig):
     return y.astype(h2.dtype)
 
 
-def _moe_prompt_forward(params, tokens, *, cfg: MoEConfig):
+def _moe_prompt_forward(params, tokens, *, cfg: MoEConfig,
+                        impl: str = "auto", interpret: bool = False):
     """Full-prompt forward returning per-layer (K, V) caches + logits —
     generate._prompt_forward's attention/cache body with the MoE FFN
     swapped in via its ``ffn`` hook."""
@@ -116,7 +117,8 @@ def _moe_prompt_forward(params, tokens, *, cfg: MoEConfig):
 
     return _prompt_forward(
         params, tokens, cfg=cfg,
-        ffn=functools.partial(_moe_prompt_ffn, cfg=cfg))
+        ffn=functools.partial(_moe_prompt_ffn, cfg=cfg),
+        impl=impl, interpret=interpret)
 
 
 class MoEGenerator(Generator):
@@ -134,12 +136,14 @@ class MoEGenerator(Generator):
         super().__init__(cfg, mesh, axis=axis, max_seq=max_seq, impl=impl,
                          interpret=interpret, kv_dtype=kv_dtype)
         self._prefill_jit = jax.jit(functools.partial(
-            _moe_prompt_forward, cfg=cfg))
+            _moe_prompt_forward, cfg=cfg, impl=impl, interpret=interpret))
         from triton_dist_tpu.models.generate import _chunk_forward
         self._chunk_jit = jax.jit(
             functools.partial(_chunk_forward, cfg=cfg,
                               ffn=functools.partial(_moe_prompt_ffn,
-                                                    cfg=cfg)),
+                                                    cfg=cfg),
+                              impl="xla" if mesh.shape[axis] > 1 else impl,
+                              interpret=interpret),
             static_argnames=("quantized", "extent"),
             donate_argnums=(2,))
 

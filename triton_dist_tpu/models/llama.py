@@ -180,13 +180,22 @@ def _rope(x, positions, theta):
     return out.astype(x.dtype)
 
 
-def _attention(q, k, v, cfg: LlamaConfig):
+def _attention(q, k, v, cfg: LlamaConfig, *, impl="auto", interpret=False):
     """Causal GQA attention on local heads.  q: [S, B, Hq_loc, hd],
-    k/v: [S, B, Hkv_loc, hd].  Full sequence, local heads (TP over heads)."""
-    from triton_dist_tpu.kernels.attention import dense_gqa_attention
+    k/v: [S, B, Hkv_loc, hd].  Full sequence, local heads (TP over heads).
 
-    return dense_gqa_attention(q, k, v, causal=True,
-                               scale=1.0 / math.sqrt(cfg.head_dim))
+    Routed through the flash prefill kernel (O(S) memory, blockwise
+    online softmax) whenever shapes allow; the dense path only remains
+    for ragged shapes / head_dim < 128.  The model-level ``impl``
+    contract is about the collective kernels, so anything but an explicit
+    ``"xla"`` leaves attention dispatch at ``"auto"`` (flash's strict
+    mode is exercised by its own tests — tests/test_flash_attention.py)."""
+    from triton_dist_tpu.kernels.flash_attention import flash_gqa_attention
+
+    return flash_gqa_attention(q, k, v, causal=True,
+                               scale=1.0 / math.sqrt(cfg.head_dim),
+                               impl="xla" if impl == "xla" else "auto",
+                               interpret=interpret)
 
 
 def attention_block_shard(x, layer, cfg: LlamaConfig, *, axis, impl,
@@ -217,7 +226,8 @@ def attention_block_shard(x, layer, cfg: LlamaConfig, *, axis, impl,
     q = _rope(q.reshape(-1, b, hq_loc, hd), full_positions, cfg.rope_theta)
     k = _rope(k.reshape(-1, b, hkv_loc, hd), full_positions, cfg.rope_theta)
     v = v.reshape(-1, b, hkv_loc, hd)
-    o = _attention(q, k, v, cfg)  # [S, B, Hq_loc, hd]
+    o = _attention(q, k, v, cfg, impl=impl,
+                   interpret=interpret)  # [S, B, Hq_loc, hd]
     o = o.reshape(world * s_loc * b, hq_loc * hd)
     return x + lin_r(o, layer["wo"]).reshape(s_loc, b, cfg.dim)
 
