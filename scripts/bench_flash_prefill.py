@@ -6,9 +6,11 @@ hoisted or elided), (t_long - t_short)/extra cancels dispatch + tunnel
 RTT, config order rotates per trial so drift hits every config equally,
 pooled median over trials.
 
-The dense XLA path materializes [B, Hq, S, S] f32 logits — at S = 8192,
-Hq = 32 that is 8.6 GB/step and does not fit; flash is benched alone
-there (the capability win IS the point).
+The dense XLA path materializes [B, Hq, S, S] f32 logits — 8.6 GB/step
+at S = 8192, Hq = 32, B = 1.  That still fits this chip's HBM (the bench
+measures it at ~38 ms), but it is the scaling wall: one more doubling of
+S or B OOMs, while flash stays O(S) — configs that exceed memory are
+reported as SKIP rather than crashing the sweep.
 
 Usage: python scripts/bench_flash_prefill.py [--seq 2048 4096] [--trials 9]
 """

@@ -185,3 +185,25 @@ def test_sp_combine_kernel_matches_epilogue(mesh4, key):
     want = combine_partials(outs, lses)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_vmem_fit_shrink(key):
+    """Large-D bf16 caches shrink the KV block to fit VMEM instead of
+    raising (r4 review: the shrink floor was the int8 1024, wrongly
+    rejecting legal bf16 blocks below it).  S=1024, D=2048 bf16 needs
+    16 MiB at the full-shard default; the 512 divisor (8 MiB) is legal."""
+    from triton_dist_tpu.kernels.flash_decode import gqa_decode_shard
+
+    B, Hq, Hkv, D, S = 1, 2, 1, 2048, 1024
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (B, Hq, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, Hkv, S, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, Hkv, S, D), jnp.bfloat16)
+    lens = jnp.full((B,), S, jnp.int32)
+    out, lse = gqa_decode_shard(q, k, v, lens, impl="pallas",
+                                interpret=True)
+    ref, ref_lse = gqa_decode_shard(q, k, v, lens, impl="xla")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=5e-2, atol=5e-2)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                               rtol=1e-2, atol=1e-2)

@@ -338,12 +338,13 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
                                  k_scale=k_scale, v_scale=v_scale)
 
     if block_s is None:
-        # dtype-aware default (real-chip sweeps, docs/perf.md): bf16 wants
-        # 2048 (360 µs winner); the int8 kernel wants the whole shard in
-        # one chunk — block_s=8192 reads 168 µs ~= the HBM floor vs 208
-        # at 2048 (fewer online-softmax chunk boundaries; the cast+scale
-        # epilogue amortizes over a longer MXU stream).
-        block_s = min(S, 8192) if k_scale is not None else 2048
+        # Full-shard default, both dtypes (real-chip sweeps, docs/perf.md):
+        # fewer online-softmax chunk boundaries and one long MXU stream
+        # put the kernel at the HBM floor — int8 168 µs vs 208 at bs=2048;
+        # bf16 B=8 ~285-319 µs vs ~354-361 at bs=2048 across two sessions
+        # (B=32 is a wash — the r4 re-sweep that retired the old 2048
+        # bf16 default).  VMEM fit-shrink below handles large D.
+        block_s = min(S, 8192)
     bs = block_s
     while S % bs:
         bs //= 2
@@ -360,21 +361,31 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
         # kernel's measured sweet spot anyway (docs/perf.md).
         bs = next((c for c in range(bs, S, 128)
                    if S % c == 0 and (c // 128) % 8 == 0), S)
-    vmem_budget = 12 * 2 ** 20  # double-buffered K+V blocks: 4 * bs * D
-    if quantized and 4 * bs * D > vmem_budget:
+    # Double-buffered K+V blocks: 4 * bs * D * itemsize must fit VMEM.
+    vmem_budget = 12 * 2 ** 20
+    itemsize = jnp.dtype(k.dtype).itemsize
+    if 4 * bs * D * itemsize > vmem_budget:
         # Over budget (large D and/or bs == S): try the LARGEST legal
-        # smaller divisor that fits (e.g. S=8192 D=512: bs 8192 -> 1024)
-        # before concluding this shape cannot tile the int8 kernel.
-        fit = max((c for c in range(1024, bs, 128)
-                   if S % c == 0 and (c // 128) % 8 == 0
-                   and 4 * c * D <= vmem_budget), default=None)
+        # smaller divisor that fits (e.g. int8 S=8192 D=512: 8192 -> 1024)
+        # before concluding this shape cannot tile the kernel.  int8
+        # additionally needs the lane-packed scale-plane constraint.
+        def legal(c):
+            return S % c == 0 and (not quantized or (c // 128) % 8 == 0)
+
+        # int8's lane-packed scale planes need (c//128)%8 == 0, i.e. a
+        # multiple of 1024; plain caches may shrink all the way to 128.
+        floor = 1024 if quantized else 128
+        fit = max((c for c in range(floor, bs, 128)
+                   if legal(c) and 4 * c * D * itemsize <= vmem_budget),
+                  default=None)
         if fit is None:
             if raw_impl == "pallas":
+                need = ("a multiple-of-1024 divisor of S"
+                        if quantized else "a multiple-of-128 divisor of S")
                 raise PallasShapeError(
-                    f"flash_decode int8-KV: S={S}, D={D} has no "
-                    f"scale-plane-legal KV block that fits VMEM (needs "
-                    f"a multiple-of-1024 divisor of S with 4*bs*D <= "
-                    f"12 MiB)")
+                    f"flash_decode{' int8-KV' if quantized else ''}: S={S},"
+                    f" D={D} has no legal KV block that fits VMEM (needs "
+                    f"{need} with 4*bs*D*itemsize <= 12 MiB)")
             return _local_decode_xla(q, k, v, local_lens, scale=scale,
                                      k_scale=k_scale, v_scale=v_scale)
         bs = fit
@@ -571,7 +582,7 @@ class SpDecodeContext:
 
     mesh: Mesh
     axis: str = "sp"
-    block_s: int | None = None  # None = dtype-aware (bf16 2048 / int8 full-shard)
+    block_s: int | None = None  # None = full-shard chunk (min(S, 8192))
     impl: str = "auto"
     interpret: bool = False
 
