@@ -31,12 +31,20 @@ from triton_dist_tpu.kernels.flash_attention import flash_attention
 B, HQ, HKV, D = 1, 32, 8, 128
 
 
-def make_chain(n_iters, impl, bq, bk):
+def make_chain(n_iters, impl, bq, bk, grad=False):
+    def step(qq, k, v):
+        return flash_attention(qq, k, v, causal=True, impl=impl,
+                               block_q=bq, block_k=bk)
+
     @jax.jit
     def chain(q, k, v):
         def body(_, qq):
-            out = flash_attention(qq, k, v, causal=True, impl=impl,
-                                  block_q=bq, block_k=bk)
+            if grad:
+                # fwd + flash bwd per step; dq feeds the next step.
+                out = jax.grad(lambda q_: jnp.sum(
+                    step(q_, k, v).astype(jnp.float32) ** 2))(qq)
+            else:
+                out = step(qq, k, v)
             return out.astype(qq.dtype)
 
         return jnp.sum(jax.lax.fori_loop(0, n_iters, body, q)
@@ -45,7 +53,7 @@ def make_chain(n_iters, impl, bq, bk):
     return chain
 
 
-def bench_seq(S, configs, n_short=4, n_long=20, trials=9):
+def bench_seq(S, configs, n_short=4, n_long=20, trials=9, grad=False):
     ks = jax.random.split(jax.random.key(0), 3)
     k = jax.random.normal(ks[1], (B, HKV, S, D), jnp.bfloat16)
     v = jax.random.normal(ks[2], (B, HKV, S, D), jnp.bfloat16)
@@ -53,8 +61,8 @@ def bench_seq(S, configs, n_short=4, n_long=20, trials=9):
 
     chains = {}
     for label, impl, bq, bk in configs:
-        short = make_chain(n_short, impl, bq, bk)
-        long = make_chain(n_long, impl, bq, bk)
+        short = make_chain(n_short, impl, bq, bk, grad=grad)
+        long = make_chain(n_long, impl, bq, bk, grad=grad)
         try:
             float(short(q0, k, v))  # warmup/compile
             float(long(q0, k, v))
@@ -62,6 +70,9 @@ def bench_seq(S, configs, n_short=4, n_long=20, trials=9):
             print(f"  {label:28s} SKIP ({type(e).__name__})", flush=True)
             continue
         chains[label] = (short, long, (k, v))
+
+    if not chains:  # every config SKIPped (e.g. absurd S): no sweep
+        return {}
 
     def fresh_q(t):
         return jax.random.normal(jax.random.key(RUN_SEED + t),
@@ -81,6 +92,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seq", nargs="*", type=int, default=[2048, 4096, 8192])
     ap.add_argument("--trials", type=int, default=9)
+    ap.add_argument("--grad", action="store_true",
+                    help="bench fwd+bwd per step (the flash VJP kernels)")
     args = ap.parse_args()
 
     configs = [
@@ -89,12 +102,15 @@ def main():
         ("flash bq=512 bk=512", "pallas", 512, 512),
         ("flash bq=512 bk=1024", "pallas", 512, 1024),
     ]
+    mode = "fwd+bwd" if args.grad else "fwd"
     for S in args.seq:
-        print(f"\nS={S} (B={B} Hq={HQ} Hkv={HKV} D={D}, causal):")
-        for label, (ms, iqr, tf) in bench_seq(S, configs,
+        print(f"\nS={S} (B={B} Hq={HQ} Hkv={HKV} D={D}, causal, {mode}):")
+        for label, (ms, iqr, tf) in bench_seq(S, configs, grad=args.grad,
                                               trials=args.trials).items():
+            # --grad TFLOPS uses the fwd flop count: interpret as a
+            # relative number only (bwd is ~2.5x the fwd flops).
             print(f"  {label:28s} {ms:8.2f} ms/step (IQR {iqr:.2f})  "
-                  f"{tf:6.1f} TFLOPS", flush=True)
+                  f"{tf:6.1f} TFLOPS(fwd-equiv)", flush=True)
 
 
 if __name__ == "__main__":

@@ -139,6 +139,12 @@ def main():
         flash_attention, causal=True, impl="pallas",
         return_lse=True))(qp[:, :, :128], kp, kp, q_offset=jnp.int32(512)))
 
+    # 7d. flash backward (dq + dkv kernels through the custom VJP)
+    check("flash_bwd", lambda: jax.jit(jax.grad(
+        lambda q_: jnp.sum(flash_attention(
+            q_, kp, kp, causal=True, impl="pallas").astype(jnp.float32))))
+        (qp))
+
     # 8. ring attention world-1 (pallas kernel, VMEM staging)
     from triton_dist_tpu.kernels.ring_attention import ring_attention_shard
     qr = jax.random.normal(key, (256, 2, 8, 128), jnp.bfloat16)

@@ -176,3 +176,73 @@ def test_flash_gqa_wrapper_layout(key):
     ref = dense_gqa_attention(q, k, v, causal=True)
     assert out.shape == ref.shape
     assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_backward_matches_xla(key, causal):
+    """The blockwise flash gradient (dq + dkv kernels, P recomputed from
+    lse) equals the dense path's VJP."""
+    b, hkv, g, s, d = 1, 2, 2, 256, 128
+    q, k, v = _mk(key, b, hkv * g, hkv, s, s, d, jnp.float32)
+    w = jax.random.normal(jax.random.fold_in(key, 7), (b, hkv * g, s, d),
+                          jnp.float32)
+
+    def loss(fn):
+        def f(q_, k_, v_):
+            return jnp.sum(fn(q_, k_, v_) * w)  # non-uniform cotangent
+        return jax.grad(f, argnums=(0, 1, 2))
+
+    gp = loss(lambda q_, k_, v_: flash_attention(
+        q_, k_, v_, causal=causal, impl="pallas", interpret=True))(q, k, v)
+    gx = loss(lambda q_, k_, v_: _flash_xla(
+        q_, k_, v_, causal=causal, scale=1.0 / np.sqrt(d), q_offset=0,
+        kv_offset=0)[0])(q, k, v)
+    for got, want, name in zip(gp, gx, "qkv"):
+        assert_allclose(got, want, atol=5e-5, rtol=5e-5)
+
+
+def test_flash_backward_block_invariance(key):
+    """Gradients are identical whatever (bq, bk) the forward used (the
+    backward picks its own blocks; both recompute the same P)."""
+    b, hkv, g, s, d = 1, 1, 4, 512, 128
+    q, k, v = _mk(key, b, hkv * g, hkv, s, s, d, jnp.float32)
+
+    def g1(bq, bk):
+        f = lambda q_: jnp.sum(flash_attention(
+            q_, k, v, causal=True, block_q=bq, block_k=bk, impl="pallas",
+            interpret=True) ** 2)
+        return jax.grad(f)(q)
+
+    assert_allclose(g1(128, 512), g1(256, 128), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_backward_bf16(key):
+    b, hkv, g, s, d = 1, 2, 2, 256, 128
+    q, k, v = _mk(key, b, hkv * g, hkv, s, s, d, jnp.bfloat16)
+
+    def f(fn):
+        return jax.grad(lambda q_: jnp.sum(
+            fn(q_).astype(jnp.float32) ** 2))(q)
+
+    gp = f(lambda q_: flash_attention(q_, k, v, causal=True,
+                                      impl="pallas", interpret=True))
+    gx = f(lambda q_: flash_attention(q_, k, v, causal=True, impl="xla"))
+    assert gp.dtype == jnp.bfloat16
+    assert_allclose(gp.astype(jnp.float32), gx.astype(jnp.float32),
+                    atol=1e-1, rtol=1e-1)
+
+
+def test_flash_backward_masked_rows_finite(key):
+    """Fully-masked q rows (lse = NEG_INF) must produce zero — not NaN —
+    gradients (the exp(s - NEG_INF) = inf lanes are mask-discarded)."""
+    b, hkv, g, s, d = 1, 1, 1, 128, 128
+    q, k, v = _mk(key, b, hkv * g, hkv, s, s, d, jnp.float32)
+
+    # kv_offset puts every key in the future of every query.
+    grads = jax.grad(
+        lambda q_, k_, v_: jnp.sum(flash_attention(
+            q_, k_, v_, causal=True, kv_offset=4096, impl="pallas",
+            interpret=True)), argnums=(0, 1, 2))(q, k, v)
+    for gr in grads:
+        assert not bool(jnp.any(jnp.isnan(gr)))
+        assert bool(jnp.all(gr == 0.0))

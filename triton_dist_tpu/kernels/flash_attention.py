@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import functools
 import math
+import operator
 
 import jax
 import jax.numpy as jnp
@@ -141,6 +142,201 @@ def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
 
 
 # ---------------------------------------------------------------------------
+# Backward kernels (flash gradient — no S^2 materialization)
+# ---------------------------------------------------------------------------
+#
+# Standard flash-attention backward split into two kernels so each output
+# has one sequential accumulation axis:
+#   dq kernel : grid (B, Hkv, nQ, nK) — KV innermost, dq block in scratch
+#   dkv kernel: grid (B, Hkv, nK, nQ) — Q innermost, dk/dv blocks in scratch
+# Both recompute P from (q, k, lse) blockwise:
+#   p_ij  = exp(scale * q_i k_j - lse_i)          (0 where causally masked)
+#   dv_j  = sum_i p_ij do_i
+#   dp_ij = do_i . v_j
+#   ds_ij = p_ij * (dp_ij - delta_i) * scale,  delta_i = sum(do_i * out_i)
+#   dq_i  = sum_j ds_ij k_j ;  dk_j = sum_i ds_ij q_i
+# delta is a cheap elementwise rowsum computed in XLA before the kernels.
+
+
+def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, q_start,
+                    k_start, *, causal, scale, group, bq, bk):
+    """Shared backward block math: recompute P from (q, k, lse) and form
+    dS — the one place the masking/NEG_INF rules live for both backward
+    kernels.  Returns (p, ds) [G, bq, bk] f32 plus the flat q/do views.
+
+    exp may produce inf in lanes the mask discards (fully-masked rows
+    carry lse = NEG_INF); the where keeps them out of the matmuls.
+    """
+    q = q_ref[0, 0].reshape(group * bq, -1)               # [G*bq, D]
+    k = k_ref[0, 0]                                       # [bk, D]
+    v = v_ref[0, 0]
+    do = do_ref[0, 0].reshape(group * bq, -1)             # [G*bq, D]
+    lse = lse_ref[0, 0]                                   # [G, bq]
+    dl = dl_ref[0, 0]                                     # [G, bq]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32).reshape(group, bq, bk) * scale
+    e = jnp.exp(s - lse[..., None])
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (group, bq, bk), 1)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (group, bq, bk), 2)
+        p = jnp.where((q_start + rows) >= (k_start + cols), e, 0.0)
+    else:
+        p = e
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32).reshape(group, bq, bk)
+    ds = p * (dp - dl[..., None]) * scale                 # [G, bq, bk]
+    return p, ds, q, do
+
+
+def _flash_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         dl_ref, dq_ref, acc_ref, *, bq, bk, n_k, causal,
+                         scale, group):
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    iq = pl.program_id(2)
+    q_start = offs_ref[0] + iq * bq
+    k_start = offs_ref[1] + ik * bk
+
+    def body():
+        k = k_ref[0, 0]                                   # [bk, D]
+        _, ds, _, _ = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, q_start,
+            k_start, causal=causal, scale=scale, group=group, bq=bq, bk=bk)
+        upd = jax.lax.dot_general(
+            ds.reshape(group * bq, bk).astype(k.dtype), k,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [G*bq, D]
+        acc_ref[:] = acc_ref[:] + upd.reshape(group, bq, -1)
+
+    if causal:
+        pl.when(k_start <= q_start + (bq - 1))(body)
+    else:
+        body()
+
+    @pl.when(ik == n_k - 1)
+    def _():
+        dq_ref[0, 0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                          dl_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, bq,
+                          bk, n_q, causal, scale, group):
+    iq = pl.program_id(3)
+
+    @pl.when(iq == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    ikb = pl.program_id(2)
+    q_start = offs_ref[0] + iq * bq
+    k_start = offs_ref[1] + ikb * bk
+
+    def body():
+        p, ds, q, do = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, q_start,
+            k_start, causal=causal, scale=scale, group=group, bq=bq, bk=bk)
+        # dv_j = sum_i p_ij do_i  — contract over the G*bq row axis.
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p.reshape(group * bq, bk).astype(do.dtype), do,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bk, D]
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds.reshape(group * bq, bk).astype(q.dtype), q,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bk, D]
+
+    if causal:
+        # This KV block gets gradient only from q rows at positions
+        # >= k_start; skip inner q blocks entirely before it.
+        pl.when(q_start + (bq - 1) >= k_start)(body)
+    else:
+        body()
+
+    @pl.when(iq == n_q - 1)
+    def _():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, do, q_offset, kv_offset, causal,
+                      scale, interpret):
+    """Blockwise gradients (dq, dk, dv) in the primal dtypes."""
+    B, Hq, Sq, D = q.shape
+    Hkv, Sk = k.shape[1], k.shape[2]
+    g = Hq // Hkv
+    bq = largest_divisor_block(Sq, 128, 128)
+    bk = largest_divisor_block(Sk, 512, 128)
+    n_q, n_k = Sq // bq, Sk // bk
+
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                               # [B, Hq, Sq]
+    qg = q.reshape(B, Hkv, g, Sq, D)
+    dog = do.reshape(B, Hkv, g, Sq, D)
+    lseg = lse.reshape(B, Hkv, g, Sq)
+    dlg = delta.reshape(B, Hkv, g, Sq)
+    offs = jnp.array([q_offset, kv_offset], jnp.int32)
+
+    q_spec = pl.BlockSpec((1, 1, g, bq, D),
+                          lambda b, h, i, j, offs: (b, h, 0, i, 0))
+    row_spec = pl.BlockSpec((1, 1, g, bq),
+                            lambda b, h, i, j, offs: (b, h, 0, i))
+    kv_spec = pl.BlockSpec((1, 1, bk, D),
+                           lambda b, h, i, j, offs: (b, h, j, 0))
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, bq=bq, bk=bk, n_k=n_k,
+                          causal=causal, scale=float(scale), group=g),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, Hkv, n_q, n_k),
+            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+            out_specs=[q_spec],
+            scratch_shapes=[pltpu.VMEM((g, bq, D), jnp.float32)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((B, Hkv, g, Sq, D), q.dtype)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=maybe_interpret(interpret),
+    )(offs, qg, k, v, dog, lseg, dlg)[0]
+
+    # dkv: Q axis innermost/sequential; note the (i, j) grid roles swap.
+    q_spec2 = pl.BlockSpec((1, 1, g, bq, D),
+                           lambda b, h, j, i, offs: (b, h, 0, i, 0))
+    row_spec2 = pl.BlockSpec((1, 1, g, bq),
+                             lambda b, h, j, i, offs: (b, h, 0, i))
+    kv_spec2 = pl.BlockSpec((1, 1, bk, D),
+                            lambda b, h, j, i, offs: (b, h, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, bq=bq, bk=bk, n_q=n_q,
+                          causal=causal, scale=float(scale), group=g),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, Hkv, n_k, n_q),
+            in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2,
+                      row_spec2],
+            out_specs=[kv_spec2, kv_spec2],
+            scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                            pltpu.VMEM((bk, D), jnp.float32)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((B, Hkv, Sk, D), k.dtype),
+                   jax.ShapeDtypeStruct((B, Hkv, Sk, D), v.dtype)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=maybe_interpret(interpret),
+    )(offs, qg, k, v, dog, lseg, dlg)
+    return dq.reshape(B, Hq, Sq, D), dk, dv
+
+
+# ---------------------------------------------------------------------------
 # Dense fallback (XLA) — same contract incl. offsets and lse
 # ---------------------------------------------------------------------------
 
@@ -226,13 +422,21 @@ def flash_attention(q, k, v, *, causal=True, scale=None, q_offset=0,
     bq = largest_divisor_block(Sq, want_q, 128)
     bk = largest_divisor_block(Sk, block_k or 1024, 128)
 
-    if (not return_lse and isinstance(q_offset, int)
-            and isinstance(kv_offset, int)):
-        # Static offsets (model forward paths): differentiable wrapper —
-        # the backward recomputes through the XLA path's VJP (same math;
-        # the pallas backward kernels replace it for the flash memory
-        # profile in training).
-        return _flash_diff(q, k, v, q_offset, kv_offset, causal,
+    def _static_int(x):
+        """Any index-like (int, np.integer, concrete 0-d array) → int;
+        traced offsets → None (they ride scalar prefetch, raw path)."""
+        try:
+            return operator.index(x)
+        except TypeError:
+            return None
+
+    qo, ko = _static_int(q_offset), _static_int(kv_offset)
+    if not return_lse and qo is not None and ko is not None:
+        # Static offsets (model forward paths): differentiable wrapper.
+        # The backward is the blockwise flash gradient (dq + dkv pallas
+        # kernels recomputing P from the saved lse) — O(S) memory on
+        # both passes.
+        return _flash_diff(q, k, v, qo, ko, causal,
                            float(scale), bq, bk, interpret)
     out, lse = _flash_pallas(q, k, v, q_offset, kv_offset, causal,
                              float(scale), bq, bk, interpret)
@@ -300,19 +504,16 @@ def _flash_diff(q, k, v, q_offset, kv_offset, causal, scale, bq, bk,
 
 def _flash_diff_fwd(q, k, v, q_offset, kv_offset, causal, scale, bq, bk,
                     interpret):
-    out = _flash_diff(q, k, v, q_offset, kv_offset, causal, scale, bq, bk,
-                      interpret)
-    return out, (q, k, v)
+    out, lse = _flash_pallas(q, k, v, q_offset, kv_offset, causal, scale,
+                             bq, bk, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_diff_bwd(q_offset, kv_offset, causal, scale, bq, bk, interpret,
                     res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _flash_xla(q_, k_, v_, causal=causal,
-                                      scale=scale, q_offset=q_offset,
-                                      kv_offset=kv_offset)[0], q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd_pallas(q, k, v, out, lse, g, q_offset, kv_offset,
+                             causal, scale, interpret)
 
 
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)

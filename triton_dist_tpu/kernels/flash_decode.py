@@ -337,7 +337,8 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
         return _local_decode_xla(q, k, v, local_lens, scale=scale,
                                  k_scale=k_scale, v_scale=v_scale)
 
-    if block_s is None:
+    defaulted = block_s is None
+    if defaulted:
         # Full-shard default, both dtypes (real-chip sweeps, docs/perf.md):
         # fewer online-softmax chunk boundaries and one long MXU stream
         # put the kernel at the HBM floor — int8 168 µs vs 208 at bs=2048;
@@ -362,9 +363,12 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
         bs = next((c for c in range(bs, S, 128)
                    if S % c == 0 and (c // 128) % 8 == 0), S)
     # Double-buffered K+V blocks: 4 * bs * D * itemsize must fit VMEM.
+    # Only a DEFAULTED block shrinks silently; an explicit block_s that
+    # does not fit keeps its loud failure (the strict-pallas principle —
+    # a sweep must never report a block size the kernel didn't run).
     vmem_budget = 12 * 2 ** 20
     itemsize = jnp.dtype(k.dtype).itemsize
-    if 4 * bs * D * itemsize > vmem_budget:
+    if defaulted and 4 * bs * D * itemsize > vmem_budget:
         # Over budget (large D and/or bs == S): try the LARGEST legal
         # smaller divisor that fits (e.g. int8 S=8192 D=512: 8192 -> 1024)
         # before concluding this shape cannot tile the kernel.  int8
