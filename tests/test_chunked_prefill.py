@@ -131,10 +131,14 @@ def test_chunked_flash_path_reached(key, monkeypatch):
                                np.asarray(ref.last_logits),
                                rtol=1e-4, atol=1e-4)
 
-    # world-2: SP-sharded cache keeps the dense chunk path; same answer.
+    # world-2: the SP path — per-shard flash inside shard_map + LSE
+    # combine (sp_flash_attention_shard) — must ALSO reach the kernel
+    # and agree with the world-1 answer.
+    n_before = calls["n"]
     mesh2 = Mesh(np.array(jax.devices()[:2]), ("sp",))
     gen2 = Generator(cfg, mesh2, max_seq=512, interpret=True)
     got2 = gen2.prefill_chunked(params, tokens, chunk_size=128)
+    assert calls["n"] > n_before, "SP chunked prefill never reached flash"
     np.testing.assert_allclose(np.asarray(got2.last_logits),
                                np.asarray(ref.last_logits),
                                rtol=1e-4, atol=1e-4)

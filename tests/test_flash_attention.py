@@ -246,3 +246,30 @@ def test_flash_backward_masked_rows_finite(key):
     for gr in grads:
         assert not bool(jnp.any(jnp.isnan(gr)))
         assert bool(jnp.all(gr == 0.0))
+
+
+def test_sp_flash_attention_shard(mesh4, key):
+    """Per-shard flash + LSE combine over a sequence-sharded KV equals
+    single-device flash — the SP-prefill building block (decode's
+    sp_gqa_decode_shard recipe applied to prefill), incl. a traced
+    q_offset (the chunked-prefill caller)."""
+    import functools
+    from jax.sharding import PartitionSpec as P
+    from triton_dist_tpu.kernels.flash_attention import (
+        sp_flash_attention_shard)
+
+    b, hkv, g, sq, sk, d = 1, 2, 2, 128, 512, 128
+    q, k, v = _mk(key, b, hkv * g, hkv, sq, sk, d, jnp.float32)
+
+    sp = jax.jit(jax.shard_map(
+        lambda q_, k_, v_, o_: sp_flash_attention_shard(
+            q_, k_, v_, axis="tp", causal=True, q_offset=o_,
+            interpret=True),
+        mesh=mesh4, in_specs=(P(), P(None, None, "tp"),
+                              P(None, None, "tp"), P()),
+        out_specs=P(), check_vma=False))
+    for off in (0, 256):  # traced offset covers the static case too
+        got = sp(q, k, v, jnp.int32(off))
+        ref = flash_attention(q, k, v, causal=True, q_offset=off,
+                              impl="xla")
+        assert_allclose(got, ref, atol=2e-5, rtol=2e-5)

@@ -519,6 +519,43 @@ def _flash_diff_bwd(q_offset, kv_offset, causal, scale, bq, bk, interpret,
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 
+def sp_flash_attention_shard(q, k_shard, v_shard, *, axis, causal=True,
+                             scale=None, q_offset=0, impl="auto",
+                             interpret=False):
+    """Sequence-parallel prefill attention; call inside shard_map.
+
+    q [B, Hq, Sq, D] replicated (the current chunk's queries); k/v_shard
+    [B, Hkv, S_loc, D] sequence-sharded over ``axis``.  Each device runs
+    flash over its KV shard at its global offset, then the per-shard
+    (out, lse) partials LSE-merge — the decode SP recipe
+    (flash_decode.sp_gqa_decode_shard) applied to prefill.  ``q_offset``
+    may be traced (chunked prefill's ``prefix_len``).
+
+    Under ``impl="auto"`` each shard's local attention takes the flash
+    kernel when shapes allow and the dense fallback otherwise — both
+    yield (out, lse) partials, so the combine is impl-agnostic.
+    """
+    world = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    s_loc = k_shard.shape[2]
+    out, lse = flash_attention(
+        q, k_shard, v_shard, causal=causal, scale=scale,
+        q_offset=q_offset, kv_offset=me * s_loc, impl=impl,
+        interpret=interpret, return_lse=True)
+    if world == 1:
+        return out
+    # Weighted-REDUCE combine (combine_partials' math as collectives):
+    # pmax of the small lse plane, then two psums — the payload crosses
+    # the wire once as a reduction instead of materializing W gathered
+    # copies per device.  All-masked rows (lse = NEG_INF everywhere):
+    # m = NEG, w = exp(0) = 1, out = 0 → psum(0)/W = 0, never NaN.
+    m = jax.lax.pmax(lse, axis)                           # [B, Hq, Sq]
+    w = jnp.exp(lse - m)
+    num = jax.lax.psum(out.astype(jnp.float32) * w[..., None], axis)
+    denom = jax.lax.psum(w, axis)
+    return (num / denom[..., None]).astype(q.dtype)
+
+
 def flash_gqa_attention(q, k, v, *, causal=True, scale=None, impl="auto",
                         interpret=False):
     """Drop-in for ``attention.dense_gqa_attention`` — the model families'

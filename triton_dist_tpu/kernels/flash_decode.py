@@ -363,9 +363,12 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
         bs = next((c for c in range(bs, S, 128)
                    if S % c == 0 and (c // 128) % 8 == 0), S)
     # Double-buffered K+V blocks: 4 * bs * D * itemsize must fit VMEM.
-    # Only a DEFAULTED block shrinks silently; an explicit block_s that
-    # does not fit keeps its loud failure (the strict-pallas principle —
-    # a sweep must never report a block size the kernel didn't run).
+    # Only a DEFAULTED block shrinks for PERF reasons; an explicit
+    # block_s that does not fit keeps its loud failure (a sweep must
+    # never report a block size the kernel didn't run for tuning
+    # reasons).  The LEGALITY normalizations above (divisor halving,
+    # int8 scale-plane snap-up) still apply to explicit values — they
+    # are documented contracts, not silent tuning.
     vmem_budget = 12 * 2 ** 20
     itemsize = jnp.dtype(k.dtype).itemsize
     if defaulted and 4 * bs * D * itemsize > vmem_budget:

@@ -71,16 +71,12 @@ class Generator:
             _prompt_forward, cfg=cfg, impl=impl, interpret=interpret))
         # caches are donated: each chunk's dynamic-update happens in place
         # instead of copying every layer's full-size cache per chunk.
-        # Chunk attention reads the mesh-SHARDED cache: at world > 1 a
-        # local pallas kernel cannot live in that partitioned program
-        # (and would be wrong — each device holds a KV slice; the flash
-        # path needs the per-shard + LSE-combine treatment), so the
-        # chunked path keeps XLA attention there.
+        # Chunk attention at world > 1 enters shard_map over the
+        # sequence-SHARDED cache (per-shard flash + LSE combine, the
+        # decode SP recipe on prefill) — mesh/axis carry the topology in.
         self._chunk_jit = jax.jit(
-            functools.partial(
-                _chunk_forward, cfg=cfg,
-                impl="xla" if mesh.shape[axis] > 1 else impl,
-                interpret=interpret),
+            functools.partial(_chunk_forward, cfg=cfg, impl=impl,
+                              interpret=interpret, mesh=mesh, axis=axis),
             static_argnames=("quantized", "extent"),
             donate_argnums=(2,))
         self._step_jit = jax.jit(self._step_impl)
@@ -237,7 +233,8 @@ class Generator:
 
 
 def _attend_prefix(q, k_all, v_all, prefix_len, *, k_scale=None,
-                   v_scale=None, impl="auto", interpret=False):
+                   v_scale=None, impl="auto", interpret=False,
+                   mesh=None, axis=None):
     """Chunk attention against the cache prefix + itself.
 
     q [B, c, Hq, hd]; k/v_all [B, Hkv, S, hd] (the full cache, chunk rows
@@ -245,17 +242,54 @@ def _attend_prefix(q, k_all, v_all, prefix_len, *, k_scale=None,
     row i iff j <= prefix + i.  Scores are [c, S] — the bounded-memory
     core of chunked prefill.  Optional scales dequantize an int8 cache.
 
-    The bf16 cache path rides the flash prefill kernel (``prefix_len`` is
-    traced — it enters as scalar prefetch, one trace per extent); the
-    int8-cache path keeps the dense program with fused dequant.
+    The plain-cache path rides the flash prefill kernel (``prefix_len``
+    is traced — it enters as scalar prefetch, one trace per extent).
+    With ``mesh``/``axis`` given and world > 1, the cache stays
+    sequence-SHARDED: each device runs flash over its KV shard and the
+    partials LSE-merge (``sp_flash_attention_shard`` — the decode SP
+    recipe on prefill; r4).  The int8-cache path keeps the dense program
+    with fused dequant.
+
+    Dispatch note: attention here always runs ``impl="auto"`` — the
+    model-level ``impl`` contract is about the COLLECTIVE kernels
+    (models/llama.py:_attention records the same design), so
+    ``impl="pallas"`` does not force flash onto shapes it cannot tile
+    (head_dim < 128, non-divisible extents); only explicit ``"xla"``
+    pins the dense program.  Flash's own strict-dispatch mode is
+    exercised by tests/test_flash_attention.py and the kernel-reach spy
+    in tests/test_chunked_prefill.py.
     """
     if k_scale is None and impl != "xla":
-        from triton_dist_tpu.kernels.flash_attention import flash_attention
+        from triton_dist_tpu.kernels.flash_attention import (
+            flash_attention,
+            sp_flash_attention_shard,
+        )
 
-        out = flash_attention(
-            q.transpose(0, 2, 1, 3), k_all, v_all, causal=True,
-            q_offset=prefix_len, impl="auto", interpret=interpret)
-        return out.transpose(0, 2, 1, 3).astype(jnp.float32)
+        qt = q.transpose(0, 2, 1, 3)                  # [B, Hq, c, hd]
+        world = 1 if mesh is None else mesh.shape[axis]
+        if world == 1:
+            out = flash_attention(
+                qt, k_all, v_all, causal=True, q_offset=prefix_len,
+                impl="auto", interpret=interpret)
+            return out.transpose(0, 2, 1, 3).astype(jnp.float32)
+        if k_all.shape[2] % world == 0:
+            from jax.sharding import PartitionSpec as P
+
+            def sp(qt_, k_, v_, off):
+                return sp_flash_attention_shard(
+                    qt_, k_, v_, axis=axis, causal=True, q_offset=off,
+                    impl="auto", interpret=interpret)
+
+            out = jax.shard_map(
+                sp, mesh=mesh,
+                in_specs=(P(), P(None, None, axis), P(None, None, axis),
+                          P()),
+                out_specs=P(), check_vma=False,
+            )(qt, k_all, v_all, prefix_len)
+            return out.transpose(0, 2, 1, 3).astype(jnp.float32)
+        # world > 1 with a non-divisible extent: the dense program below
+        # is the only path that can live in the partitioned jit (a plain
+        # pallas call cannot).
     B, c, Hq, hd = q.shape
     _, Hkv, S, _ = k_all.shape
     g = Hq // Hkv
@@ -294,7 +328,8 @@ def _write_chunk(cache, new, prefix_len, quantized):
 
 def _chunk_forward(params, chunk, caches, prefix_len, *, cfg: LlamaConfig,
                    quantized: bool, ffn=None, extent: int | None = None,
-                   impl: str = "auto", interpret: bool = False):
+                   impl: str = "auto", interpret: bool = False,
+                   mesh=None, axis=None):
     """One prompt chunk [B, c] against the cached prefix; returns
     (new_caches, logits [B, c, V] — position i predicts the token after
     chunk[:, i]).  The chunk's own K/V are written to the cache first
@@ -335,7 +370,8 @@ def _chunk_forward(params, chunk, caches, prefix_len, *, cfg: LlamaConfig,
                                v_scale=v_c["s"][:, :, :ext])
         else:
             o = _attend_prefix(q, k_c[:, :, :ext], v_c[:, :, :ext],
-                               prefix_len, impl=impl, interpret=interpret)
+                               prefix_len, impl=impl, interpret=interpret,
+                               mesh=mesh, axis=axis)
         o = o.reshape(B * c, cfg.n_heads * hd).astype(cfg.dtype)
         x = x + (o @ layer["wo"]).reshape(B, c, cfg.dim)
         h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps).reshape(

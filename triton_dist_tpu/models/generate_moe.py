@@ -142,8 +142,8 @@ class MoEGenerator(Generator):
             functools.partial(_chunk_forward, cfg=cfg,
                               ffn=functools.partial(_moe_prompt_ffn,
                                                     cfg=cfg),
-                              impl="xla" if mesh.shape[axis] > 1 else impl,
-                              interpret=interpret),
+                              impl=impl, interpret=interpret,
+                              mesh=mesh, axis=axis),
             static_argnames=("quantized", "extent"),
             donate_argnums=(2,))
 
