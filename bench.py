@@ -190,7 +190,9 @@ def _bench_decode_us(trials=9):
     _sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
     from scripts.bench_decode import bench_batch
 
-    res = bench_batch(8, [("auto", "auto", 2048)], trials=trials)
+    # block_s=None → the dtype-uniform full-shard default (r4: reads at
+    # the HBM floor; the pinned 2048 measured the retired r3 default).
+    res = bench_batch(8, [("auto", "auto", None)], trials=trials)
     return res["auto"][0]
 
 

@@ -153,6 +153,24 @@ def main():
         ring_attention_shard, mesh, 3, axis="tp", causal=True,
         impl="pallas", interpret=False)(qr, kr, kr))
 
+    # 8b. flash ring world-1 (r4: per-block flash + LSE merge) and its
+    # gradient (the reverse flash ring over the bwd kernels)
+    check("ring_flash(w1)", lambda: _shard1(
+        ring_attention_shard, mesh, 3, axis="tp", causal=True,
+        impl="flash", interpret=False)(qr, kr, kr))
+
+    def _ring_flash_grad():
+        fn = jax.jit(jax.shard_map(
+            lambda q_, k_, v_: jax.grad(lambda qq: jnp.sum(
+                ring_attention_shard(qq, k_, v_, axis="tp", causal=True,
+                                     impl="flash", interpret=False)
+                .astype(jnp.float32)))(q_),
+            mesh=mesh, in_specs=(jax.sharding.PartitionSpec("tp"),) * 3,
+            out_specs=jax.sharding.PartitionSpec("tp"), check_vma=False))
+        return fn(qr, kr, kr)
+
+    check("ring_flash_grad(w1)", _ring_flash_grad)
+
     # 9. ulysses world-1 (a2a + dense attention)
     from triton_dist_tpu.kernels.ulysses_attention import (
         ulysses_attention_shard)

@@ -9,21 +9,26 @@ device folds every block into a running online-softmax accumulator (the
 same LSE-merge math as the reference's inter-rank decode combine, applied
 blockwise instead of once).
 
-Two implementations:
+Three implementations:
 
+* ``flash`` (r4; the ``auto`` choice when S_loc % 128 == hd % 128 == 0) —
+  ``lax.scan`` ring whose per-step block update is the flash-attention
+  KERNEL (kernels/flash_attention.py) and whose backward is a reverse
+  ring over the flash backward kernels — O(block) memory on both passes,
+  the only impl that scales to arbitrary S_loc.
 * ``xla`` — ``lax.scan`` over ring steps with ``jax.lax.ppermute`` KV
-  rotation.  XLA overlaps the collective-permute with the next block's
-  compute on TPU, and the whole thing is differentiable (the backward
-  pipeline is scan+ppermute transposed — a reverse-direction ring).
+  rotation and a dense per-step block update ([G, S_loc, S_loc] logits).
+  XLA overlaps the collective-permute with the next block's compute on
+  TPU, and the whole thing is differentiable (the backward pipeline is
+  scan+ppermute transposed — a reverse-direction ring).  The
+  differentiation-golden reference.
 * ``pallas`` — one kernel per device: double-buffered KV slots in HBM;
   at step s the kernel remote-DMAs the current block to the right
   neighbor's next slot while the MXU computes this block's flash update
   (the ag_gemm overlap structure applied to attention).  Whole [S_loc]
   blocks are staged through VMEM, so S_loc × (B·H·hd) must fit VMEM —
-  fine for long-context configs, which keep B·H small precisely because S
-  is huge.  Differentiable via custom VJP whose backward is the VJP of the
-  (numerically identical) xla path — i.e. flash-style recompute, a second
-  ring pass.
+  the low-latency choice for moderate S_loc.  Differentiable via custom
+  VJP whose backward is the VJP of the (numerically identical) xla path.
 
 Causality: KV block from rank j attends to local queries with the global
 positions mask; blocks entirely in the future contribute nothing (their
@@ -116,7 +121,7 @@ def _ring_attention_xla(q, k, v, *, axis, causal, scale):
     b, hq, hd = q.shape[1], q.shape[2], q.shape[3]
     group = hq // k.shape[2]
     q_off = me * s_loc
-    perm = [(i, (i + 1) % world) for i in range(world)]
+    perm = _ring_perm(world)
     upd = functools.partial(_block_update, causal=causal, scale=scale,
                             group=group)
 
@@ -137,7 +142,7 @@ def _ring_attention_xla(q, k, v, *, axis, causal, scale):
         k_blk, v_blk, m, l, acc = carry
         k_blk = jax.lax.ppermute(k_blk, axis, perm)
         v_blk = jax.lax.ppermute(v_blk, axis, perm)
-        src = jax.lax.rem(me - s + world, world)
+        src = _src_rank(me, s, world)
         m, l, acc = upd(qg, k_blk, v_blk, m, l, acc, q_off, src * s_loc)
         return (k_blk, v_blk, m, l, acc), None
 
@@ -146,6 +151,148 @@ def _ring_attention_xla(q, k, v, *, axis, causal, scale):
     out = acc / jnp.maximum(l, 1e-30)[..., None]          # [G, S, hd]
     return (out.reshape(b, hq, s_loc, hd).transpose(2, 0, 1, 3)
             .astype(q.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Flash ring — the scalable long-context path (r4)
+# ---------------------------------------------------------------------------
+#
+# The two original impls both carry an S_loc^2 term: the xla ring
+# materializes [G, S_loc, S_loc] logits per step, and the fused pallas
+# kernel stages whole [G, S_loc, hd] KV blocks in VMEM (its own docstring's
+# scalability bound).  The flash ring replaces the per-step dense update
+# with the flash-attention kernel (O(block) memory, KV streamed from HBM)
+# and its backward with the flash backward kernels — per-step partials
+# (out_j, lse_j) LSE-merge across ring steps exactly like the decode
+# combine, and the backward's per-block P-recompute against the GLOBAL lse
+# is mathematically the full softmax gradient restricted to that block, so
+# the second (reverse) ring just sums block contributions.  Every device
+# runs the kernel every step, SPMD-uniform (same rule as the other impls:
+# future blocks contribute nothing but still ride the ring) — the
+# kernel's internal whole-block causal skip prunes the dead MXU work, and
+# a per-device lax.cond around the call would deadlock the interpreter's
+# cross-device pallas barrier anyway.
+
+
+def _ring_perm(world):
+    """The one ring direction, shared by every impl: device i → i + 1."""
+    return [(i, (i + 1) % world) for i in range(world)]
+
+
+def _src_rank(me, s, world):
+    """Owner of the block a device consumes at ring step ``s`` (blocks
+    flow with the ring, so step s sees rank me - s's block)."""
+    return jax.lax.rem(me - s + world, world)
+
+
+def _merge_partial(acc, denom, m_run, o_j, l_j):
+    """Fold one normalized partial (o_j, lse_j) into the running
+    (acc, denom, m_run): true out = acc/denom, LSE = m_run + log(denom).
+    Dead partials (lse = NEG) are exact no-ops."""
+    m = jnp.maximum(m_run, l_j)
+    r1 = jnp.exp(m_run - m)
+    r2 = jnp.exp(l_j - m)
+    acc = acc * r1[..., None] + o_j.astype(jnp.float32) * r2[..., None]
+    return acc, denom * r1 + r2, m
+
+
+def _ring_attention_flash_fwd(q, k, v, *, axis, causal, scale, interpret):
+    """Returns (out [S_loc, B, Hq, hd] in q.dtype, lse [B, Hq, S_loc] f32)."""
+    from triton_dist_tpu.kernels.flash_attention import flash_attention
+
+    world = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    s_loc, b, hq, hd = q.shape
+    q4 = q.transpose(1, 2, 0, 3)                       # [B, Hq, S, hd]
+    k4 = k.transpose(1, 2, 0, 3)
+    v4 = v.transpose(1, 2, 0, 3)
+    q_off = me * s_loc
+
+    def partial_for(k_blk, v_blk, src):
+        # Traced offsets -> the raw (non-diff) kernel path; the ring's own
+        # custom VJP owns differentiation.
+        return flash_attention(
+            q4, k_blk, v_blk, causal=causal, scale=scale,
+            q_offset=q_off, kv_offset=src * s_loc, impl="pallas",
+            interpret=interpret, return_lse=True)
+
+    o0, l0 = partial_for(k4, v4, me)                   # local block
+    acc, denom, m_run = (o0.astype(jnp.float32),
+                         jnp.ones_like(l0), l0)
+
+    def step(carry, s):
+        k_blk, v_blk, acc, denom, m_run = carry
+        perm = _ring_perm(world)
+        k_blk = jax.lax.ppermute(k_blk, axis, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis, perm)
+        o_j, l_j = partial_for(k_blk, v_blk, _src_rank(me, s, world))
+        acc, denom, m_run = _merge_partial(acc, denom, m_run, o_j, l_j)
+        return (k_blk, v_blk, acc, denom, m_run), None
+
+    if world > 1:
+        (_, _, acc, denom, m_run), _ = jax.lax.scan(
+            step, (k4, v4, acc, denom, m_run), jnp.arange(1, world))
+    out4 = (acc / denom[..., None]).astype(q.dtype)    # [B, Hq, S, hd]
+    lse = m_run + jnp.log(denom)                       # [B, Hq, S]
+    return out4.transpose(2, 0, 1, 3), lse
+
+
+def _ring_attention_flash_bwd(q, k, v, out, lse, do, *, axis, causal,
+                              scale, interpret):
+    """Reverse ring: per visiting block run the flash backward kernels
+    against the GLOBAL lse; dk/dv accumulators rotate with the blocks and
+    take one final hop home."""
+    from triton_dist_tpu.kernels.flash_attention import _flash_bwd_pallas
+
+    world = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    s_loc = q.shape[0]
+    q4 = q.transpose(1, 2, 0, 3)
+    k4 = k.transpose(1, 2, 0, 3)
+    v4 = v.transpose(1, 2, 0, 3)
+    out4 = out.transpose(1, 2, 0, 3)
+    do4 = do.transpose(1, 2, 0, 3)
+    q_off = me * s_loc
+
+    def block_grads(k_blk, v_blk, src):
+        return _flash_bwd_pallas(q4, k_blk, v_blk, out4, lse, do4,
+                                 q_off, src * s_loc, causal, scale,
+                                 interpret)
+
+    dq, dk0, dv0 = block_grads(k4, v4, me)
+    # All three accumulators carry f32 across the ring — rotating dk/dv
+    # in the storage dtype would round the partial sums W times (the wire
+    # cost of the f32 rotation is the price of a consistent gradient).
+    dq = dq.astype(jnp.float32)
+    dk_blk = dk0.astype(jnp.float32)
+    dv_blk = dv0.astype(jnp.float32)
+
+    def step(carry, s):
+        k_blk, v_blk, dk_blk, dv_blk, dq_acc = carry
+        perm = _ring_perm(world)
+        k_blk = jax.lax.ppermute(k_blk, axis, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis, perm)
+        dk_blk = jax.lax.ppermute(dk_blk, axis, perm)
+        dv_blk = jax.lax.ppermute(dv_blk, axis, perm)
+        dq_c, dk_c, dv_c = block_grads(k_blk, v_blk,
+                                       _src_rank(me, s, world))
+        return (k_blk, v_blk, dk_blk + dk_c.astype(jnp.float32),
+                dv_blk + dv_c.astype(jnp.float32),
+                dq_acc + dq_c.astype(jnp.float32)), None
+
+    if world > 1:
+        (_, _, dk_blk, dv_blk, dq), _ = jax.lax.scan(
+            step, (k4, v4, dk_blk, dv_blk, dq), jnp.arange(1, world))
+        # After W-1 rotations the accumulators hold the gradients of rank
+        # me+1's block; one more hop delivers them home.
+        perm = _ring_perm(world)
+        dk_blk = jax.lax.ppermute(dk_blk, axis, perm)
+        dv_blk = jax.lax.ppermute(dv_blk, axis, perm)
+
+    dq_out = dq.astype(q.dtype).transpose(2, 0, 1, 3)
+    dk_out = dk_blk.astype(k.dtype).transpose(2, 0, 1, 3)
+    dv_out = dv_blk.astype(v.dtype).transpose(2, 0, 1, 3)
+    return dq_out, dk_out, dv_out
 
 
 # ---------------------------------------------------------------------------
@@ -215,7 +362,7 @@ def _ring_attn_kernel(q_ref, k_ref, v_ref, o_ref, kring_ref, vring_ref,
         g_kv = k_ref.shape[0]
         k_blk = k_vmem[...].reshape(g_kv, s_loc, hd)
         v_blk = v_vmem[...].reshape(g_kv, s_loc, hd)
-        src = jax.lax.rem(me - s + world, world)
+        src = _src_rank(me, s, world)
         m, l, acc = _block_update(q, k_blk, v_blk, m, l, acc, q_off,
                                   src * s_loc, causal=causal, scale=scale,
                                   group=group)
@@ -284,6 +431,9 @@ def _ring_attention_pallas_fwd(q, k, v, *, axis, causal, scale, interpret):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _ring_attention_diff(q, k, v, axis, causal, scale, impl, interpret):
+    if impl == "flash":
+        return _ring_attention_flash_fwd(q, k, v, axis=axis, causal=causal,
+                                         scale=scale, interpret=interpret)[0]
     if impl == "pallas":
         return _ring_attention_pallas_fwd(q, k, v, axis=axis, causal=causal,
                                           scale=scale, interpret=interpret)
@@ -291,14 +441,25 @@ def _ring_attention_diff(q, k, v, axis, causal, scale, impl, interpret):
 
 
 def _ring_diff_fwd(q, k, v, axis, causal, scale, impl, interpret):
+    if impl == "flash":
+        out, lse = _ring_attention_flash_fwd(
+            q, k, v, axis=axis, causal=causal, scale=scale,
+            interpret=interpret)
+        return out, (q, k, v, out, lse)
     out = _ring_attention_diff(q, k, v, axis, causal, scale, impl, interpret)
-    return out, (q, k, v)
+    return out, (q, k, v, None, None)
 
 
 def _ring_diff_bwd(axis, causal, scale, impl, interpret, res, dout):
+    q, k, v, out, lse = res
+    if impl == "flash":
+        # Reverse ring over the flash backward kernels with the global
+        # lse — O(block) memory end to end.
+        return _ring_attention_flash_bwd(
+            q, k, v, out, lse, dout, axis=axis, causal=causal, scale=scale,
+            interpret=interpret)
     # Backward = VJP of the numerically-identical xla ring (flash-style
     # recompute; the transposed scan runs the ring in reverse).
-    q, k, v = res
     _, vjp = jax.vjp(
         functools.partial(_ring_attention_xla, axis=axis, causal=causal,
                           scale=scale), q, k, v)
@@ -313,11 +474,30 @@ def ring_attention_shard(q, k, v, *, axis, causal=True, scale=None,
     """Shard-level causal GQA ring attention; call inside shard_map.
 
     q [S_loc, B, Hq, hd]; k/v [S_loc, B, Hkv, hd] — sequence sharded over
-    ``axis``.  Returns [S_loc, B, Hq, hd].  Differentiable on both impls.
+    ``axis``.  Returns [S_loc, B, Hq, hd].  Differentiable on all impls.
+
+    ``impl``: ``"flash"`` (the scalable default under ``auto`` when
+    S_loc % 128 == hd % 128 == 0) rides the flash-attention kernels
+    through the ring — O(block) memory both passes; ``"pallas"`` is the
+    fused comm-overlap kernel (whole-shard VMEM staging — the
+    low-latency choice for moderate S_loc); ``"xla"`` the dense scan
+    reference.
     """
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
+    from triton_dist_tpu.kernels.flash_attention import flash_shapes_ok
+    from triton_dist_tpu.kernels.gemm import PallasShapeError
+
+    s_loc, hd = q.shape[0], q.shape[3]
+    legal = flash_shapes_ok(s_loc, s_loc, hd)
+    raw = impl
     impl = resolve_impl(impl, interpret)
+    if raw == "auto" and impl == "pallas" and legal:
+        impl = "flash"
+    if raw == "flash" and not legal:
+        raise PallasShapeError(
+            f"ring_attention impl='flash': (S_loc={s_loc}, hd={hd}) needs "
+            f"S_loc % 128 == hd % 128 == 0")
     return _ring_attention_diff(q, k, v, axis, causal, float(scale), impl,
                                 interpret)
 
