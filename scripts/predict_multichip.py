@@ -144,6 +144,24 @@ def main():
     print(f"  wire                     : {fmt(dec)}  (vs ~350 µs local "
           "attention: negligible)")
 
+    print("\n## Flash ring attention (r4; S_global=128k over world=8, "
+          "B=1 Hq=32 Hkv=8 hd=128 bf16)")
+    # Per ring step: rotate one KV block a single ICI hop while the flash
+    # kernel consumes the previous block.  Compute efficiency prior: the
+    # measured single-chip flash rate (~54% MXU at D=128, docs/perf.md),
+    # applied to v5p peak.
+    s_loc = 128 * 1024 // 8
+    blk_flops = 4 * 32 * s_loc * s_loc * 128           # one full block pair
+    step_ms = blk_flops / (459e12 * 0.54) * 1e3
+    wire_ms = 2 * 8 * s_loc * 128 * 2 / (V5P_AXIS_GBPS * 1e9) * 1e3
+    print(f"  per-step flash compute   : {fmt(step_ms)}")
+    print(f"  per-step KV rotation     : {fmt(wire_ms)}  "
+          f"({wire_ms / step_ms * 100:.1f}% of compute)")
+    print("  predicted ring overhead  : <2% (deeply compute-bound; XLA "
+          "overlaps the ppermute)")
+    print("  falsifier: if measured step time exceeds compute by >5%, "
+          "the scan is not overlapping the permute")
+
 
 if __name__ == "__main__":
     main()
