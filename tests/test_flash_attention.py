@@ -273,3 +273,24 @@ def test_sp_flash_attention_shard(mesh4, key):
         ref = flash_attention(q, k, v, causal=True, q_offset=off,
                               impl="xla")
         assert_allclose(got, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_attention_autotuned(key):
+    """The autotuned entry sweeps FLASH_TUNE_SPACE and returns the same
+    values as a direct call (winner cached per shape)."""
+    from triton_dist_tpu.kernels.flash_attention import (
+        flash_attention_autotuned)
+
+    b, hkv, g, s, d = 1, 1, 2, 256, 128
+    q, k, v = _mk(key, b, hkv * g, hkv, s, s, d, jnp.float32)
+    out = flash_attention_autotuned(q, k, v, interpret=True)
+    ref = flash_attention(q, k, v, impl="xla")
+    assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_prefill_aot_registered():
+    import triton_dist_tpu.kernels.flash_attention  # noqa: F401
+    from triton_dist_tpu.tools import compile_aot
+
+    regs = compile_aot.registered_kernels()
+    assert "flash_prefill" in regs

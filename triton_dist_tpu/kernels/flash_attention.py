@@ -519,6 +519,77 @@ def _flash_diff_bwd(q_offset, kv_offset, causal, scale, bq, bk, interpret,
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 
+# ---------------------------------------------------------------------------
+# Autotuned entry + AOT registration (tooling parity with the GEMM family)
+# ---------------------------------------------------------------------------
+
+from triton_dist_tpu.autotuner import Config as _Cfg, autotune as _autotune
+
+# Real-chip sweep (docs/perf.md): bq=128/bk=1024 wins causal prefill by
+# ~25% over bq=512 (finer causal-skip granularity); the space brackets it.
+FLASH_TUNE_SPACE = (
+    _Cfg(block_q=128, block_k=1024),
+    _Cfg(block_q=128, block_k=512),
+    _Cfg(block_q=256, block_k=1024),
+    _Cfg(block_q=512, block_k=512),
+)
+
+
+@_autotune(configs=FLASH_TUNE_SPACE, key=())
+def _flash_tunable(q, k, v, *, causal, scale, interpret, block_q=None,
+                   block_k=None):
+    return flash_attention(q, k, v, causal=causal, scale=scale,
+                           block_q=block_q, block_k=block_k,
+                           impl="pallas", interpret=interpret)
+
+
+def flash_attention_autotuned(q, k, v, *, causal=True, scale=None,
+                              interpret=False):
+    """:func:`flash_attention` with (block_q, block_k) selected by the
+    autotuner — same lockstep/``is_dist`` rules as ``ag_gemm_autotuned``
+    (winners cached per shape/dtype; on the tunnel chip use
+    scripts/autotune_onchip.py's chain measure instead)."""
+    return _flash_tunable(q, k, v, causal=causal, scale=scale,
+                          interpret=interpret)
+
+
+def _register_flash_aot():
+    """AOT export spaces for the prefill kernel (serving shapes: GQA
+    32/8, head_dim 128 — the bench/serving point of docs/perf.md)."""
+    from triton_dist_tpu.tools.compile_aot import aot_compile_spaces
+
+    b, hq, hkv, d = 1, 32, 8, 128
+    sig = [
+        [((b, hq, 4096, d), "bfloat16"), ((b, hkv, 4096, d), "bfloat16"),
+         ((b, hkv, 4096, d), "bfloat16")],
+        [((b, hq, 512, d), "float32"), ((b, hkv, 512, d), "float32"),
+         ((b, hkv, 512, d), "float32")],
+    ]
+
+    def algos(platforms):
+        out = [{"impl": "xla"}]
+        if "tpu" in platforms:
+            out += [{"block_q": 128, "block_k": 1024, "impl": "pallas"},
+                    {"block_q": 512, "block_k": 512, "impl": "pallas"}]
+        return out
+
+    return aot_compile_spaces({
+        "flash_prefill": {
+            "signature": sig,
+            "algo_infos": algos,
+        },
+    })
+
+
+@_register_flash_aot()
+def flash_prefill_aot(q, k, v, *, impl="auto", block_q=None, block_k=None,
+                      interpret=False):
+    """AOT-exportable causal prefill entry (fixed causal=True surface —
+    the serving path; the full API is :func:`flash_attention`)."""
+    return flash_attention(q, k, v, causal=True, block_q=block_q,
+                           block_k=block_k, impl=impl, interpret=interpret)
+
+
 def sp_flash_attention_shard(q, k_shard, v_shard, *, axis, causal=True,
                              scale=None, q_offset=0, impl="auto",
                              interpret=False):
