@@ -139,6 +139,13 @@ def main():
         flash_attention, causal=True, impl="pallas",
         return_lse=True))(qp[:, :, :128], kp, kp, q_offset=jnp.int32(512)))
 
+    # 7c'. int8-KV flash prefill (scales fused in the block loop — r4)
+    from triton_dist_tpu.kernels.flash_decode import quantize_kv as _qkv
+    kp8, kps = _qkv(kp.astype(jnp.float32))
+    check("flash_prefill_i8", lambda: jax.jit(functools.partial(
+        flash_attention, causal=True, impl="pallas"))(
+            qp, kp8, kp8, k_scale=kps, v_scale=kps))
+
     # 7d. flash backward (dq + dkv kernels through the custom VJP)
     check("flash_bwd", lambda: jax.jit(jax.grad(
         lambda q_: jnp.sum(flash_attention(

@@ -142,3 +142,46 @@ def test_chunked_flash_path_reached(key, monkeypatch):
     np.testing.assert_allclose(np.asarray(got2.last_logits),
                                np.asarray(ref.last_logits),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_chunked_int8_flash_path(key, monkeypatch):
+    """int8-cache chunked prefill rides the fused int8 flash kernel at
+    head_dim 128 (world 1 and the SP path at world 2), reach-asserted,
+    and matches the float generator closely."""
+    import sys
+
+    import triton_dist_tpu.kernels.flash_attention  # noqa: F401
+    from jax.sharding import Mesh
+
+    fa = sys.modules["triton_dist_tpu.kernels.flash_attention"]
+    calls = {"n": 0}
+    real = fa._flash_pallas
+
+    def spy(*a, **kw):
+        if kw.get("k_scale") is not None or len(a) > 10:
+            calls["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(fa, "_flash_pallas", spy)
+
+    cfg = LlamaConfig(vocab=64, dim=256, n_layers=1, n_heads=2,
+                      n_kv_heads=1, ffn_dim=128, max_seq=512,
+                      dtype=jnp.float32)
+    params = init_params(cfg, key)
+    tokens = jax.random.randint(key, (1, 256), 0, cfg.vocab, jnp.int32)
+
+    ref = None
+    for world in (1, 2):
+        mesh = Mesh(np.array(jax.devices()[:world]), ("sp",))
+        gen_f = Generator(cfg, mesh, max_seq=512, interpret=True)
+        gen_q = Generator(cfg, mesh, max_seq=512, interpret=True,
+                          kv_dtype=jnp.int8)
+        n0 = calls["n"]
+        got = gen_q.prefill_chunked(params, tokens, chunk_size=128)
+        assert calls["n"] > n0, f"world={world}: int8 flash not reached"
+        if ref is None:
+            ref = gen_f.prefill_chunked(params, tokens, chunk_size=128)
+        # int8 rounding: loose tolerance vs the float path
+        np.testing.assert_allclose(np.asarray(got.last_logits),
+                                   np.asarray(ref.last_logits),
+                                   rtol=0.2, atol=0.2)

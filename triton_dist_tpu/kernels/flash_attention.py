@@ -141,6 +141,73 @@ def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
             l > 0.0, m_ref[:] + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
 
 
+def _flash_kernel_i8(offs_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                     out_ref, lse_ref, acc_ref, m_ref, l_ref, *, bq, bk,
+                     n_k, causal, scale, group):
+    """int8-KV twin of :func:`_flash_kernel` (the decode `_decode_kernel_i8`
+    recipe applied to prefill): K/V stream as int8 with per-position f32
+    scales riding LANE-PACKED [B, Hkv, Sk/128, 128] planes — K's scale
+    rescales the logit columns after the QK matmul, V's folds into P
+    before the PV matmul; both matmuls stay on the MXU in q's dtype."""
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    iq = pl.program_id(2)
+    q_start = offs_ref[0] + iq * bq
+    k_start = offs_ref[1] + ik * bk
+
+    def body():
+        q = q_ref[0, 0].reshape(group * bq, -1)           # [G*bq, D]
+        k = k_ref[0, 0].astype(q.dtype)                   # [bk, D] i8→q
+        v = v_ref[0, 0].astype(q.dtype)
+        ksc = ks_ref[0, 0].reshape(-1)                    # [bk] f32
+        vsc = vs_ref[0, 0].reshape(-1)
+
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        logits = (logits * (ksc[None, :] * scale)).reshape(group, bq, bk)
+
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (group, bq, bk), 1)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (group, bq, bk), 2)
+            mask = (q_start + rows) >= (k_start + cols)
+            logits = jnp.where(mask, logits, NEG_INF)
+
+        m_cur = m_ref[:]
+        m_new = jnp.maximum(m_cur, jnp.max(logits, axis=-1))
+        p = jnp.exp(logits - m_new[..., None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_cur - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1)
+        pv = jax.lax.dot_general(
+            (p.reshape(group * bq, bk) * vsc[None, :]).astype(v.dtype), v,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[:] = (acc_ref[:] * alpha[..., None]
+                      + pv.reshape(group, bq, -1))
+
+    if causal:
+        pl.when(k_start <= q_start + (bq - 1))(body)
+    else:
+        body()
+
+    @pl.when(ik == n_k - 1)
+    def _():
+        l = l_ref[:]
+        out = acc_ref[:] / jnp.maximum(l, 1e-30)[..., None]
+        out_ref[0, 0] = out.astype(out_ref.dtype)
+        lse_ref[0, 0] = jnp.where(
+            l > 0.0, m_ref[:] + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
+
+
 # ---------------------------------------------------------------------------
 # Backward kernels (flash gradient — no S^2 materialization)
 # ---------------------------------------------------------------------------
@@ -341,15 +408,19 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, q_offset, kv_offset, causal,
 # ---------------------------------------------------------------------------
 
 
-def _flash_xla(q, k, v, *, causal, scale, q_offset, kv_offset):
+def _flash_xla(q, k, v, *, causal, scale, q_offset, kv_offset,
+               k_scale=None, v_scale=None):
     """O(S^2)-memory reference path: out [B, Hq, Sq, D] in q.dtype,
-    lse [B, Hq, Sq] f32."""
+    lse [B, Hq, Sq] f32.  Optional ``k/v_scale`` [B, Hkv, Sk] dequantize
+    an int8 K/V (the decode `_local_decode_xla` recipe)."""
     B, Hq, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
     g = Hq // Hkv
     qf = q.astype(jnp.float32).reshape(B, Hkv, g, Sq, D)
     logits = jnp.einsum("bhgsd,bhtd->bhgst", qf,
                         k.astype(jnp.float32)) * scale
+    if k_scale is not None:
+        logits = logits * k_scale[:, :, None, None, :]
     if causal:
         rows = q_offset + jnp.arange(Sq)[:, None]
         cols = kv_offset + jnp.arange(Sk)[None, :]
@@ -361,6 +432,8 @@ def _flash_xla(q, k, v, *, causal, scale, q_offset, kv_offset):
     if causal:
         p = jnp.where(mask[None, None, None], p, 0.0)
     l = jnp.sum(p, axis=-1)
+    if v_scale is not None:
+        p = p * v_scale[:, :, None, None, :]
     out = jnp.einsum("bhgst,bhtd->bhgsd", p, v.astype(jnp.float32))
     out = jnp.where(nonempty[..., None],
                     out / jnp.where(nonempty, l, 1.0)[..., None], 0.0)
@@ -384,7 +457,8 @@ def flash_shapes_ok(sq: int, sk: int, d: int) -> bool:
 
 def flash_attention(q, k, v, *, causal=True, scale=None, q_offset=0,
                     kv_offset=0, block_q=None, block_k=None, impl="auto",
-                    interpret=False, return_lse=False):
+                    interpret=False, return_lse=False, k_scale=None,
+                    v_scale=None):
     """Blockwise GQA attention: q [B, Hq, Sq, D], k/v [B, Hkv, Sk, D] →
     out [B, Hq, Sq, D] in q.dtype (+ lse [B, Hq, Sq] f32 when
     ``return_lse``).
@@ -393,6 +467,11 @@ def flash_attention(q, k, v, *, causal=True, scale=None, q_offset=0,
     row 0 (python ints or traced scalars — they ride scalar prefetch, so
     chunked prefill reuses one trace across chunks).  The causal rule is
     ``q_offset + i >= kv_offset + j``.
+
+    ``k_scale``/``v_scale`` [B, Hkv, Sk] f32 dequantize an int8 K/V
+    (the serving int8-KV cache): the pallas path fuses the scales into
+    the block loop (``_flash_kernel_i8``), the fallback into the dense
+    stream.  The quantized path is forward-only (serving).
     """
     B, Hq, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
@@ -402,13 +481,15 @@ def flash_attention(q, k, v, *, causal=True, scale=None, q_offset=0,
         scale = 1.0 / math.sqrt(D)
     raw_impl = impl
     impl = resolve_impl(impl, interpret)
+    quantized = k_scale is not None
 
     if use_fallback(raw_impl, impl, flash_shapes_ok(Sq, Sk, D),
                     "flash_attention",
                     f"(Sq={Sq}, Sk={Sk}, D={D}) needs Sq%128 == Sk%128 == "
                     f"D%128 == 0"):
         out, lse = _flash_xla(q, k, v, causal=causal, scale=scale,
-                              q_offset=q_offset, kv_offset=kv_offset)
+                              q_offset=q_offset, kv_offset=kv_offset,
+                              k_scale=k_scale, v_scale=v_scale)
         return (out, lse) if return_lse else out
 
     # Block defaults from the real-chip sweep (docs/perf.md): SMALL q
@@ -421,6 +502,19 @@ def flash_attention(q, k, v, *, causal=True, scale=None, q_offset=0,
     want_q = block_q or max(128, (512 // g) // 128 * 128)
     bq = largest_divisor_block(Sq, want_q, 128)
     bk = largest_divisor_block(Sk, block_k or 1024, 128)
+
+    if quantized:
+        # Lane-packed scale planes need (bk//128) % 8 == 0 or bk == Sk
+        # (the decode kernel's constraint); bump to the smallest legal
+        # divisor.  Forward-only — serving reads an int8 cache; training
+        # does not quantize K/V.
+        if (bk // 128) % 8 and bk != Sk:
+            bk = next((c for c in range(bk, Sk, 128)
+                       if Sk % c == 0 and (c // 128) % 8 == 0), Sk)
+        out, lse = _flash_pallas(q, k, v, q_offset, kv_offset, causal,
+                                 float(scale), bq, bk, interpret,
+                                 k_scale=k_scale, v_scale=v_scale)
+        return (out, lse) if return_lse else out
 
     def _static_int(x):
         """Any index-like (int, np.integer, concrete 0-d array) → int;
@@ -444,7 +538,7 @@ def flash_attention(q, k, v, *, causal=True, scale=None, q_offset=0,
 
 
 def _flash_pallas(q, k, v, q_offset, kv_offset, causal, scale, bq, bk,
-                  interpret):
+                  interpret, k_scale=None, v_scale=None):
     """The raw pallas_call: out [B, Hq, Sq, D] in q.dtype, lse f32."""
     B, Hq, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
@@ -453,21 +547,38 @@ def _flash_pallas(q, k, v, q_offset, kv_offset, causal, scale, bq, bk,
 
     qg = q.reshape(B, Hkv, g, Sq, D)
     offs = jnp.array([q_offset, kv_offset], jnp.int32)
-    kern = functools.partial(_flash_kernel, bq=bq, bk=bk, n_k=n_k,
-                             causal=causal, scale=float(scale), group=g)
+    quantized = k_scale is not None
+    if quantized:
+        kern = functools.partial(_flash_kernel_i8, bq=bq, bk=bk, n_k=n_k,
+                                 causal=causal, scale=float(scale), group=g)
+    else:
+        kern = functools.partial(_flash_kernel, bq=bq, bk=bk, n_k=n_k,
+                                 causal=causal, scale=float(scale), group=g)
+    in_specs = [
+        pl.BlockSpec((1, 1, g, bq, D),
+                     lambda b, h, i, j, offs: (b, h, 0, i, 0)),
+        pl.BlockSpec((1, 1, bk, D),
+                     lambda b, h, i, j, offs: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, bk, D),
+                     lambda b, h, i, j, offs: (b, h, j, 0)),
+    ]
+    args = [offs, qg, k, v]
+    if quantized:
+        # Lane-packed [B, Hkv, Sk//128, 128] scale planes: each block's
+        # bk scales are ONE dense [bk//128, 128] f32 transfer (the
+        # decode kernel's layout — a [bk, 1] plane DMAs thousands of
+        # strided 4-byte rows and measured 9x slower).
+        sc_spec = pl.BlockSpec((1, 1, bk // 128, 128),
+                               lambda b, h, i, j, offs: (b, h, j, 0))
+        in_specs += [sc_spec, sc_spec]
+        args += [k_scale.reshape(B, Hkv, Sk // 128, 128),
+                 v_scale.reshape(B, Hkv, Sk // 128, 128)]
     out, lse = pl.pallas_call(
         kern,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B, Hkv, n_q, n_k),
-            in_specs=[
-                pl.BlockSpec((1, 1, g, bq, D),
-                             lambda b, h, i, j, offs: (b, h, 0, i, 0)),
-                pl.BlockSpec((1, 1, bk, D),
-                             lambda b, h, i, j, offs: (b, h, j, 0)),
-                pl.BlockSpec((1, 1, bk, D),
-                             lambda b, h, i, j, offs: (b, h, j, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((1, 1, g, bq, D),
                              lambda b, h, i, j, offs: (b, h, 0, i, 0)),
@@ -491,7 +602,7 @@ def _flash_pallas(q, k, v, q_offset, kv_offset, causal, scale, bq, bk,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=maybe_interpret(interpret),
-    )(offs, qg, k, v)
+    )(*args)
     return out.reshape(B, Hq, Sq, D), lse.reshape(B, Hq, Sq)
 
 
@@ -592,7 +703,7 @@ def flash_prefill_aot(q, k, v, *, impl="auto", block_q=None, block_k=None,
 
 def sp_flash_attention_shard(q, k_shard, v_shard, *, axis, causal=True,
                              scale=None, q_offset=0, impl="auto",
-                             interpret=False):
+                             interpret=False, k_scale=None, v_scale=None):
     """Sequence-parallel prefill attention; call inside shard_map.
 
     q [B, Hq, Sq, D] replicated (the current chunk's queries); k/v_shard
@@ -612,7 +723,8 @@ def sp_flash_attention_shard(q, k_shard, v_shard, *, axis, causal=True,
     out, lse = flash_attention(
         q, k_shard, v_shard, causal=causal, scale=scale,
         q_offset=q_offset, kv_offset=me * s_loc, impl=impl,
-        interpret=interpret, return_lse=True)
+        interpret=interpret, return_lse=True, k_scale=k_scale,
+        v_scale=v_scale)
     if world == 1:
         return out
     # Weighted-REDUCE combine (combine_partials' math as collectives):

@@ -242,13 +242,14 @@ def _attend_prefix(q, k_all, v_all, prefix_len, *, k_scale=None,
     row i iff j <= prefix + i.  Scores are [c, S] — the bounded-memory
     core of chunked prefill.  Optional scales dequantize an int8 cache.
 
-    The plain-cache path rides the flash prefill kernel (``prefix_len``
-    is traced — it enters as scalar prefetch, one trace per extent).
+    Both cache dtypes ride the flash prefill kernel (``prefix_len`` is
+    traced — it enters as scalar prefetch, one trace per extent); an
+    int8 cache's scales fuse into the block loop (``_flash_kernel_i8``).
     With ``mesh``/``axis`` given and world > 1, the cache stays
     sequence-SHARDED: each device runs flash over its KV shard and the
     partials LSE-merge (``sp_flash_attention_shard`` — the decode SP
-    recipe on prefill; r4).  The int8-cache path keeps the dense program
-    with fused dequant.
+    recipe on prefill; r4).  The dense program below remains for
+    ``impl="xla"`` and the non-divisible-extent world>1 corner.
 
     Dispatch note: attention here always runs ``impl="auto"`` — the
     model-level ``impl`` contract is about the COLLECTIVE kernels
@@ -259,7 +260,7 @@ def _attend_prefix(q, k_all, v_all, prefix_len, *, k_scale=None,
     exercised by tests/test_flash_attention.py and the kernel-reach spy
     in tests/test_chunked_prefill.py.
     """
-    if k_scale is None and impl != "xla":
+    if impl != "xla":
         from triton_dist_tpu.kernels.flash_attention import (
             flash_attention,
             sp_flash_attention_shard,
@@ -270,22 +271,29 @@ def _attend_prefix(q, k_all, v_all, prefix_len, *, k_scale=None,
         if world == 1:
             out = flash_attention(
                 qt, k_all, v_all, causal=True, q_offset=prefix_len,
-                impl="auto", interpret=interpret)
+                impl="auto", interpret=interpret, k_scale=k_scale,
+                v_scale=v_scale)
             return out.transpose(0, 2, 1, 3).astype(jnp.float32)
         if k_all.shape[2] % world == 0:
             from jax.sharding import PartitionSpec as P
 
-            def sp(qt_, k_, v_, off):
+            def sp(qt_, k_, v_, off, *scs):
+                ksc, vsc = scs if scs else (None, None)
                 return sp_flash_attention_shard(
                     qt_, k_, v_, axis=axis, causal=True, q_offset=off,
-                    impl="auto", interpret=interpret)
+                    impl="auto", interpret=interpret, k_scale=ksc,
+                    v_scale=vsc)
 
+            seq_spec = P(None, None, axis)
+            args = [qt, k_all, v_all, prefix_len]
+            specs = [P(), seq_spec, seq_spec, P()]
+            if k_scale is not None:
+                args += [k_scale, v_scale]
+                specs += [seq_spec, seq_spec]
             out = jax.shard_map(
-                sp, mesh=mesh,
-                in_specs=(P(), P(None, None, axis), P(None, None, axis),
-                          P()),
+                sp, mesh=mesh, in_specs=tuple(specs),
                 out_specs=P(), check_vma=False,
-            )(qt, k_all, v_all, prefix_len)
+            )(*args)
             return out.transpose(0, 2, 1, 3).astype(jnp.float32)
         # world > 1 with a non-divisible extent: the dense program below
         # is the only path that can live in the partitioned jit (a plain
@@ -367,7 +375,9 @@ def _chunk_forward(params, chunk, caches, prefix_len, *, cfg: LlamaConfig,
             o = _attend_prefix(q, k_c["q"][:, :, :ext],
                                v_c["q"][:, :, :ext], prefix_len,
                                k_scale=k_c["s"][:, :, :ext],
-                               v_scale=v_c["s"][:, :, :ext])
+                               v_scale=v_c["s"][:, :, :ext],
+                               impl=impl, interpret=interpret,
+                               mesh=mesh, axis=axis)
         else:
             o = _attend_prefix(q, k_c[:, :, :ext], v_c[:, :, :ext],
                                prefix_len, impl=impl, interpret=interpret,
