@@ -129,6 +129,18 @@ def main():
         gqa_decode_shard, mesh, 4, impl="pallas", interpret=False,
         k_scale=ks8, v_scale=vs8)(q, kq8, vq8, lens))
 
+    # 7b'. paged decode (block_table via scalar-prefetch index_map — r4)
+    from triton_dist_tpu.kernels.flash_decode import gqa_decode_paged_shard
+    n_pages = S // 256
+    pool_k = (kc.reshape(B, Hkv, n_pages, 256, hd)
+              .transpose(0, 2, 1, 3, 4).reshape(B * n_pages, Hkv, 256, hd))
+    pool_v = (vc.reshape(B, Hkv, n_pages, 256, hd)
+              .transpose(0, 2, 1, 3, 4).reshape(B * n_pages, Hkv, 256, hd))
+    tabl = jnp.arange(B * n_pages, dtype=jnp.int32).reshape(B, n_pages)
+    check("paged_decode", lambda: _shard1(
+        gqa_decode_paged_shard, mesh, 5, impl="pallas",
+        interpret=False)(q, pool_k, pool_v, tabl, lens))
+
     # 7c. flash prefill (blockwise causal GQA, scalar-prefetch offsets)
     from triton_dist_tpu.kernels.flash_attention import flash_attention
     qp = jax.random.normal(key, (2, 8, 1024, 128), jnp.bfloat16)

@@ -53,7 +53,9 @@ from triton_dist_tpu.kernels.all_to_all import (  # noqa: F401
 )
 from triton_dist_tpu.kernels.flash_decode import (  # noqa: F401
     gqa_decode_shard,
+    gqa_decode_paged_shard,
     sp_gqa_decode,
+    sp_gqa_decode_paged_shard,
     create_sp_decode_context,
 )
 from triton_dist_tpu.kernels.flash_attention import (  # noqa: F401

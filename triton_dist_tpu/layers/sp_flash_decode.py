@@ -203,8 +203,35 @@ class SpGQAFlashDecodeAttention:
         )
         return fn(k_cache, v_cache, new_k, new_v, kv_lens)
 
-    def __call__(self, q, k_cache, v_cache, kv_lens):
-        """q [B, Hq, D] -> attention output [B, Hq, D] (replicated)."""
+    def __call__(self, q, k_cache, v_cache, kv_lens, block_table=None):
+        """q [B, Hq, D] -> attention output [B, Hq, D] (replicated).
+
+        With ``block_table`` [B, world * n_local] the caches are PAGED
+        pools [world * N_loc, Hkv, page, D] (reference analog: the
+        ``block_table`` argument of ``SpGQAFlashDecodeAttention.forward``,
+        sp_flash_decode_layer.py:78): logical page i of batch b lives at
+        pool row ``block_table[b, i]``, and rank r owns logical pages
+        [r*n_local, (r+1)*n_local) whose entries must point into its pool
+        shard [r*N_loc, (r+1)*N_loc).
+        """
+        if block_table is not None:
+            assert not self.quantized, "paged int8 cache not supported yet"
+            assert block_table.shape[1] % self.world == 0, (
+                f"block_table columns {block_table.shape[1]} must divide "
+                f"by world {self.world} (trailing logical pages would be "
+                f"silently dropped)")
+            assert k_cache.shape[0] % self.world == 0, (
+                k_cache.shape, self.world)
+            n_loc_pool = k_cache.shape[0] // self.world
+            fn = cached_shard_jit(
+                _sp_decode_paged_shard,
+                self.mesh,
+                (P(), P(self.ctx.axis), P(self.ctx.axis), P(), P()),
+                P(),
+                axis=self.ctx.axis, impl=self.ctx.impl,
+                interpret=self.ctx.interpret, n_loc_pool=n_loc_pool,
+            )
+            return fn(q, k_cache, v_cache, block_table, kv_lens)
         assert isinstance(k_cache, dict) == self.quantized, (
             "cache/layer mismatch (see append_kv)")
         if isinstance(k_cache, dict):
@@ -220,3 +247,53 @@ class SpGQAFlashDecodeAttention:
             return fn(q, k_cache["q"], k_cache["s"], v_cache["q"],
                       v_cache["s"], kv_lens)
         return sp_gqa_decode(q, k_cache, v_cache, kv_lens, self.ctx)
+
+    # -- paged cache (block_table) ---------------------------------------
+
+    def pool_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(self.ctx.axis))
+
+    def init_paged_cache(self, batch: int, n_kv_heads: int, page: int,
+                         pages_per_seq: int, head_dim: int,
+                         dtype=jnp.bfloat16):
+        """Zeroed page pools + a valid per-sequence block table.
+
+        ``pages_per_seq`` (must divide by world) logical pages per
+        sequence; each rank's pool shard holds ``batch * pages_per_seq /
+        world`` pages so every (sequence, logical page) pair gets a
+        DISTINCT pool row.  Returns (k_pool, v_pool, table): pools
+        [world * N_loc, Hkv, page, D] sharded on the page axis, table
+        [batch, pages_per_seq] int32 laid out so rank r owns logical
+        pages [r*n/w, (r+1)*n/w) in its own shard rows.  A serving
+        allocator may permute rows freely within each rank's ownership
+        range."""
+        assert pages_per_seq % self.world == 0, (pages_per_seq, self.world)
+        n_seq_loc = pages_per_seq // self.world
+        n_loc = batch * n_seq_loc
+        shape = (self.world * n_loc, n_kv_heads, page, head_dim)
+        sh = self.pool_sharding()
+        pool_k = jax.device_put(jnp.zeros(shape, dtype), sh)
+        pool_v = jax.device_put(jnp.zeros(shape, dtype), sh)
+        # table[b, i] with i = r*n_seq_loc + j  ->  r*n_loc + b*n_seq_loc + j
+        r = jnp.arange(pages_per_seq, dtype=jnp.int32) // n_seq_loc
+        j = jnp.arange(pages_per_seq, dtype=jnp.int32) % n_seq_loc
+        b = jnp.arange(batch, dtype=jnp.int32)[:, None]
+        table = r[None] * n_loc + b * n_seq_loc + j[None]
+        return pool_k, pool_v, table
+
+
+def _sp_decode_paged_shard(q, k_pool, v_pool, table, kv_lens, *, axis,
+                           impl, interpret, n_loc_pool):
+    """Shard body: slice this rank's table columns and rebase its entries
+    into local pool coordinates."""
+    from triton_dist_tpu.kernels.flash_decode import (
+        sp_gqa_decode_paged_shard)
+
+    me = jax.lax.axis_index(axis)
+    n_local = table.shape[1] // jax.lax.axis_size(axis)
+    local = jax.lax.dynamic_slice(
+        table, (0, me * n_local), (table.shape[0], n_local))
+    local = local - me * n_loc_pool
+    return sp_gqa_decode_paged_shard(q, k_pool, v_pool, local, kv_lens,
+                                     axis=axis, impl=impl,
+                                     interpret=interpret)
