@@ -348,3 +348,30 @@ def test_flash_int8_kv_sp_shard(mesh4, key):
     ref = flash_attention(q, deq_k, deq_v, causal=True, q_offset=384,
                           impl="xla")
     assert_allclose(got, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_soft_cap_fwd_bwd(key):
+    """Logit soft-capping through the prefill kernel AND its backward
+    (the tanh derivative chains into dS) vs jax.grad of the capped dense
+    program."""
+    b, hkv, g, s, d, cap = 1, 1, 2, 256, 128, 20.0
+    q, k, v = _mk(key, b, hkv * g, hkv, s, s, d, jnp.float32)
+    q = q * 4  # push logits into the capping regime
+
+    out = flash_attention(q, k, v, causal=True, impl="pallas",
+                          interpret=True, soft_cap=cap)
+    ref = flash_attention(q, k, v, causal=True, impl="xla", soft_cap=cap)
+    assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    out0 = flash_attention(q, k, v, causal=True, impl="xla")
+    assert float(jnp.max(jnp.abs(ref - out0))) > 1e-3  # cap is active
+
+    def loss(fn):
+        return jax.grad(lambda q_: jnp.sum(fn(q_) ** 2), argnums=0)
+
+    gp = loss(lambda q_: flash_attention(q_, k, v, causal=True,
+                                         impl="pallas", interpret=True,
+                                         soft_cap=cap))(q)
+    gx = loss(lambda q_: _flash_xla(q_, k, v, causal=True,
+                                    scale=1.0 / np.sqrt(d), q_offset=0,
+                                    kv_offset=0, soft_cap=cap)[0])(q)
+    assert_allclose(gp, gx, atol=5e-5, rtol=5e-5)

@@ -207,3 +207,82 @@ def test_bf16_vmem_fit_shrink(key):
                                rtol=5e-2, atol=5e-2)
     np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
                                rtol=1e-2, atol=1e-2)
+
+
+def test_soft_cap_decode(key):
+    """Gemma-2 logit capping through every decode variant (bf16, int8,
+    paged) vs a direct dense computation with the cap applied."""
+    from triton_dist_tpu.kernels.flash_decode import (
+        gqa_decode_paged_shard,
+        quantize_kv,
+    )
+
+    B, Hq, Hkv, D, S, cap = 1, 2, 1, 128, 512, 30.0
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (B, Hq, D), jnp.float32) * 4  # big logits
+    k = jax.random.normal(ks[1], (B, Hkv, S, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, Hkv, S, D), jnp.float32)
+    lens = jnp.full((B,), S, jnp.int32)
+
+    # direct dense oracle
+    g = Hq // Hkv
+    logits = jnp.einsum("bhgd,bhsd->bhgs",
+                        q.reshape(B, Hkv, g, D), k) / np.sqrt(D)
+    logits = cap * jnp.tanh(logits / cap)
+    p = jax.nn.softmax(logits, axis=-1)
+    want = jnp.einsum("bhgs,bhsd->bhgd", p, v).reshape(B, Hq, D)
+
+    out, _ = gqa_decode_shard(q, k, v, lens, impl="pallas", interpret=True,
+                              soft_cap=cap)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    # capping must actually change the answer at this logit magnitude
+    out0, _ = gqa_decode_shard(q, k, v, lens, impl="pallas", interpret=True)
+    assert float(jnp.max(jnp.abs(out - out0))) > 1e-3
+
+    kq8, ksc = quantize_kv(k)
+    vq8, vsc = quantize_kv(v)
+    out_i8, _ = gqa_decode_shard(q, kq8, vq8, lens, impl="pallas",
+                                 interpret=True, k_scale=ksc, v_scale=vsc,
+                                 soft_cap=cap)
+    np.testing.assert_allclose(np.asarray(out_i8), np.asarray(want),
+                               rtol=2e-2, atol=2e-2)
+
+    page = 128
+    n = S // page
+    pool_k = (k.reshape(B, Hkv, n, page, D).transpose(0, 2, 1, 3, 4)
+              .reshape(B * n, Hkv, page, D))
+    pool_v = (v.reshape(B, Hkv, n, page, D).transpose(0, 2, 1, 3, 4)
+              .reshape(B * n, Hkv, page, D))
+    table = jnp.arange(B * n, dtype=jnp.int32).reshape(B, n)
+    out_p, _ = gqa_decode_paged_shard(q, pool_k, pool_v, table, lens,
+                                      impl="pallas", interpret=True,
+                                      soft_cap=cap)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_soft_cap_xla_fallback(key):
+    """Regression (r4 review): the xla/non-pallas dispatch branches must
+    cap too — impl='xla' bf16, int8-under-xla, and a ragged shape all
+    agree with the capped pallas result."""
+    from triton_dist_tpu.kernels.flash_decode import quantize_kv
+
+    B, Hq, Hkv, D, S, cap = 1, 2, 1, 128, 256, 15.0
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (B, Hq, D), jnp.float32) * 4
+    k = jax.random.normal(ks[1], (B, Hkv, S, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, Hkv, S, D), jnp.float32)
+    lens = jnp.full((B,), S, jnp.int32)
+
+    want, _ = gqa_decode_shard(q, k, v, lens, impl="pallas",
+                               interpret=True, soft_cap=cap)
+    got, _ = gqa_decode_shard(q, k, v, lens, impl="xla", soft_cap=cap)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    kq8, ksc = quantize_kv(k)
+    vq8, vsc = quantize_kv(v)
+    got_i8, _ = gqa_decode_shard(q, kq8, vq8, lens, impl="xla",
+                                 k_scale=ksc, v_scale=vsc, soft_cap=cap)
+    np.testing.assert_allclose(np.asarray(got_i8), np.asarray(want),
+                               rtol=2e-2, atol=2e-2)

@@ -50,6 +50,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from triton_dist_tpu.kernels.gemm import (
+    apply_soft_cap,
     largest_divisor_block,
     resolve_impl,
     use_fallback,
@@ -66,7 +67,7 @@ NEG_INF = -1.0e30  # finite -inf proxy: survives exp/log without NaNs
 
 def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
                   acc_ref, m_ref, l_ref, *, bq, bk, n_k, causal, scale,
-                  group):
+                  group, soft_cap=0.0):
     """Grid (B, Hkv, nQ, nK); one (batch, kv-head, q-block) accumulates
     across the sequential KV-block axis.
 
@@ -97,6 +98,7 @@ def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32).reshape(
                 group, bq, bk) * scale                    # [G, bq, bk]
+        logits = apply_soft_cap(logits, soft_cap)
 
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (group, bq, bk), 1)
@@ -143,7 +145,7 @@ def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
 
 def _flash_kernel_i8(offs_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
                      out_ref, lse_ref, acc_ref, m_ref, l_ref, *, bq, bk,
-                     n_k, causal, scale, group):
+                     n_k, causal, scale, group, soft_cap=0.0):
     """int8-KV twin of :func:`_flash_kernel` (the decode `_decode_kernel_i8`
     recipe applied to prefill): K/V stream as int8 with per-position f32
     scales riding LANE-PACKED [B, Hkv, Sk/128, 128] planes — K's scale
@@ -172,6 +174,7 @@ def _flash_kernel_i8(offs_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         logits = (logits * (ksc[None, :] * scale)).reshape(group, bq, bk)
+        logits = apply_soft_cap(logits, soft_cap)
 
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (group, bq, bk), 1)
@@ -226,7 +229,8 @@ def _flash_kernel_i8(offs_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
 
 
 def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, q_start,
-                    k_start, *, causal, scale, group, bq, bk):
+                    k_start, *, causal, scale, group, bq, bk,
+                    soft_cap=0.0):
     """Shared backward block math: recompute P from (q, k, lse) and form
     dS — the one place the masking/NEG_INF rules live for both backward
     kernels.  Returns (p, ds) [G, bq, bk] f32 plus the flat q/do views.
@@ -241,9 +245,16 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, q_start,
     lse = lse_ref[0, 0]                                   # [G, bq]
     dl = dl_ref[0, 0]                                     # [G, bq]
 
-    s = jax.lax.dot_general(
+    s_raw = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32).reshape(group, bq, bk) * scale
+    if soft_cap:
+        t = jnp.tanh(s_raw / soft_cap)
+        s = soft_cap * t
+        dcap = 1.0 - t * t          # d(cap*tanh(x/cap))/dx
+    else:
+        s = s_raw
+        dcap = None
     e = jnp.exp(s - lse[..., None])
     if causal:
         rows = jax.lax.broadcasted_iota(jnp.int32, (group, bq, bk), 1)
@@ -255,12 +266,14 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, q_start,
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32).reshape(group, bq, bk)
     ds = p * (dp - dl[..., None]) * scale                 # [G, bq, bk]
+    if dcap is not None:
+        ds = ds * dcap              # chain rule through the capping tanh
     return p, ds, q, do
 
 
 def _flash_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                          dl_ref, dq_ref, acc_ref, *, bq, bk, n_k, causal,
-                         scale, group):
+                         scale, group, soft_cap=0.0):
     ik = pl.program_id(3)
 
     @pl.when(ik == 0)
@@ -275,7 +288,8 @@ def _flash_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         k = k_ref[0, 0]                                   # [bk, D]
         _, ds, _, _ = _recompute_p_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, q_start,
-            k_start, causal=causal, scale=scale, group=group, bq=bq, bk=bk)
+            k_start, causal=causal, scale=scale, group=group, bq=bq, bk=bk,
+            soft_cap=soft_cap)
         upd = jax.lax.dot_general(
             ds.reshape(group * bq, bk).astype(k.dtype), k,
             (((1,), (0,)), ((), ())),
@@ -294,7 +308,7 @@ def _flash_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 def _flash_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                           dl_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, bq,
-                          bk, n_q, causal, scale, group):
+                          bk, n_q, causal, scale, group, soft_cap=0.0):
     iq = pl.program_id(3)
 
     @pl.when(iq == 0)
@@ -309,7 +323,8 @@ def _flash_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     def body():
         p, ds, q, do = _recompute_p_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, q_start,
-            k_start, causal=causal, scale=scale, group=group, bq=bq, bk=bk)
+            k_start, causal=causal, scale=scale, group=group, bq=bq, bk=bk,
+            soft_cap=soft_cap)
         # dv_j = sum_i p_ij do_i  — contract over the G*bq row axis.
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p.reshape(group * bq, bk).astype(do.dtype), do,
@@ -334,7 +349,7 @@ def _flash_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _flash_bwd_pallas(q, k, v, out, lse, do, q_offset, kv_offset, causal,
-                      scale, interpret):
+                      scale, interpret, soft_cap=0.0):
     """Blockwise gradients (dq, dk, dv) in the primal dtypes."""
     B, Hq, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
@@ -359,7 +374,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, q_offset, kv_offset, causal,
                            lambda b, h, i, j, offs: (b, h, j, 0))
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, bq=bq, bk=bk, n_k=n_k,
-                          causal=causal, scale=float(scale), group=g),
+                          causal=causal, scale=float(scale), group=g,
+                          soft_cap=soft_cap),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B, Hkv, n_q, n_k),
@@ -383,7 +399,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, q_offset, kv_offset, causal,
                             lambda b, h, j, i, offs: (b, h, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, bq=bq, bk=bk, n_q=n_q,
-                          causal=causal, scale=float(scale), group=g),
+                          causal=causal, scale=float(scale), group=g,
+                          soft_cap=soft_cap),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B, Hkv, n_k, n_q),
@@ -409,7 +426,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, q_offset, kv_offset, causal,
 
 
 def _flash_xla(q, k, v, *, causal, scale, q_offset, kv_offset,
-               k_scale=None, v_scale=None):
+               k_scale=None, v_scale=None, soft_cap=0.0):
     """O(S^2)-memory reference path: out [B, Hq, Sq, D] in q.dtype,
     lse [B, Hq, Sq] f32.  Optional ``k/v_scale`` [B, Hkv, Sk] dequantize
     an int8 K/V (the decode `_local_decode_xla` recipe)."""
@@ -421,6 +438,7 @@ def _flash_xla(q, k, v, *, causal, scale, q_offset, kv_offset,
                         k.astype(jnp.float32)) * scale
     if k_scale is not None:
         logits = logits * k_scale[:, :, None, None, :]
+    logits = apply_soft_cap(logits, soft_cap)
     if causal:
         rows = q_offset + jnp.arange(Sq)[:, None]
         cols = kv_offset + jnp.arange(Sk)[None, :]
@@ -458,7 +476,7 @@ def flash_shapes_ok(sq: int, sk: int, d: int) -> bool:
 def flash_attention(q, k, v, *, causal=True, scale=None, q_offset=0,
                     kv_offset=0, block_q=None, block_k=None, impl="auto",
                     interpret=False, return_lse=False, k_scale=None,
-                    v_scale=None):
+                    v_scale=None, soft_cap=0.0):
     """Blockwise GQA attention: q [B, Hq, Sq, D], k/v [B, Hkv, Sk, D] →
     out [B, Hq, Sq, D] in q.dtype (+ lse [B, Hq, Sq] f32 when
     ``return_lse``).
@@ -489,7 +507,8 @@ def flash_attention(q, k, v, *, causal=True, scale=None, q_offset=0,
                     f"D%128 == 0"):
         out, lse = _flash_xla(q, k, v, causal=causal, scale=scale,
                               q_offset=q_offset, kv_offset=kv_offset,
-                              k_scale=k_scale, v_scale=v_scale)
+                              k_scale=k_scale, v_scale=v_scale,
+                              soft_cap=soft_cap)
         return (out, lse) if return_lse else out
 
     # Block defaults from the real-chip sweep (docs/perf.md): SMALL q
@@ -513,7 +532,8 @@ def flash_attention(q, k, v, *, causal=True, scale=None, q_offset=0,
                        if Sk % c == 0 and (c // 128) % 8 == 0), Sk)
         out, lse = _flash_pallas(q, k, v, q_offset, kv_offset, causal,
                                  float(scale), bq, bk, interpret,
-                                 k_scale=k_scale, v_scale=v_scale)
+                                 k_scale=k_scale, v_scale=v_scale,
+                                 soft_cap=soft_cap)
         return (out, lse) if return_lse else out
 
     def _static_int(x):
@@ -531,14 +551,15 @@ def flash_attention(q, k, v, *, causal=True, scale=None, q_offset=0,
         # kernels recomputing P from the saved lse) — O(S) memory on
         # both passes.
         return _flash_diff(q, k, v, qo, ko, causal,
-                           float(scale), bq, bk, interpret)
+                           float(scale), bq, bk, interpret, soft_cap)
     out, lse = _flash_pallas(q, k, v, q_offset, kv_offset, causal,
-                             float(scale), bq, bk, interpret)
+                             float(scale), bq, bk, interpret,
+                             soft_cap=soft_cap)
     return (out, lse) if return_lse else out
 
 
 def _flash_pallas(q, k, v, q_offset, kv_offset, causal, scale, bq, bk,
-                  interpret, k_scale=None, v_scale=None):
+                  interpret, k_scale=None, v_scale=None, soft_cap=0.0):
     """The raw pallas_call: out [B, Hq, Sq, D] in q.dtype, lse f32."""
     B, Hq, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
@@ -550,10 +571,12 @@ def _flash_pallas(q, k, v, q_offset, kv_offset, causal, scale, bq, bk,
     quantized = k_scale is not None
     if quantized:
         kern = functools.partial(_flash_kernel_i8, bq=bq, bk=bk, n_k=n_k,
-                                 causal=causal, scale=float(scale), group=g)
+                                 causal=causal, scale=float(scale), group=g,
+                                 soft_cap=soft_cap)
     else:
         kern = functools.partial(_flash_kernel, bq=bq, bk=bk, n_k=n_k,
-                                 causal=causal, scale=float(scale), group=g)
+                                 causal=causal, scale=float(scale), group=g,
+                                 soft_cap=soft_cap)
     in_specs = [
         pl.BlockSpec((1, 1, g, bq, D),
                      lambda b, h, i, j, offs: (b, h, 0, i, 0)),
@@ -606,25 +629,26 @@ def _flash_pallas(q, k, v, q_offset, kv_offset, causal, scale, bq, bk,
     return out.reshape(B, Hq, Sq, D), lse.reshape(B, Hq, Sq)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def _flash_diff(q, k, v, q_offset, kv_offset, causal, scale, bq, bk,
-                interpret):
+                interpret, soft_cap=0.0):
     return _flash_pallas(q, k, v, q_offset, kv_offset, causal, scale, bq,
-                         bk, interpret)[0]
+                         bk, interpret, soft_cap=soft_cap)[0]
 
 
 def _flash_diff_fwd(q, k, v, q_offset, kv_offset, causal, scale, bq, bk,
-                    interpret):
+                    interpret, soft_cap=0.0):
     out, lse = _flash_pallas(q, k, v, q_offset, kv_offset, causal, scale,
-                             bq, bk, interpret)
+                             bq, bk, interpret, soft_cap=soft_cap)
     return out, (q, k, v, out, lse)
 
 
 def _flash_diff_bwd(q_offset, kv_offset, causal, scale, bq, bk, interpret,
-                    res, g):
+                    soft_cap, res, g):
     q, k, v, out, lse = res
     return _flash_bwd_pallas(q, k, v, out, lse, g, q_offset, kv_offset,
-                             causal, scale, interpret)
+                             causal, scale, interpret, soft_cap=soft_cap)
 
 
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
@@ -703,7 +727,8 @@ def flash_prefill_aot(q, k, v, *, impl="auto", block_q=None, block_k=None,
 
 def sp_flash_attention_shard(q, k_shard, v_shard, *, axis, causal=True,
                              scale=None, q_offset=0, impl="auto",
-                             interpret=False, k_scale=None, v_scale=None):
+                             interpret=False, k_scale=None, v_scale=None,
+                             soft_cap=0.0):
     """Sequence-parallel prefill attention; call inside shard_map.
 
     q [B, Hq, Sq, D] replicated (the current chunk's queries); k/v_shard
@@ -724,7 +749,7 @@ def sp_flash_attention_shard(q, k_shard, v_shard, *, axis, causal=True,
         q, k_shard, v_shard, causal=causal, scale=scale,
         q_offset=q_offset, kv_offset=me * s_loc, impl=impl,
         interpret=interpret, return_lse=True, k_scale=k_scale,
-        v_scale=v_scale)
+        v_scale=v_scale, soft_cap=soft_cap)
     if world == 1:
         return out
     # Weighted-REDUCE combine (combine_partials' math as collectives):

@@ -54,6 +54,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 import triton_dist_tpu.language as dl
 from triton_dist_tpu.kernels.gemm import (
     PallasShapeError,
+    apply_soft_cap,
     resolve_impl,
     use_fallback,
 )
@@ -76,7 +77,8 @@ from triton_dist_tpu.kernels.collective_ids import SP_DECODE as SP_DECODE_COLLEC
 
 
 def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
-                   acc_ref, m_ref, l_ref, *, block_s, n_s, scale):
+                   acc_ref, m_ref, l_ref, *, block_s, n_s, scale,
+                   soft_cap=0.0):
     """Grid (B, Hkv, n_s); one (batch, kv-head) pair accumulates across the
     sequential KV-chunk axis.
 
@@ -112,6 +114,7 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale        # [G, bs]
+        logits = apply_soft_cap(logits, soft_cap)
         pos = s * block_s + jax.lax.broadcasted_iota(
             jnp.int32, logits.shape, 1)
         valid = pos < llen
@@ -143,7 +146,7 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
 
 def _decode_kernel_i8(lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
                       out_ref, lse_ref, acc_ref, m_ref, l_ref,
-                      *, block_s, n_s, scale):
+                      *, block_s, n_s, scale, soft_cap=0.0):
     """int8-KV twin of :func:`_decode_kernel` (VERDICT r3 #5): the cache
     streams from HBM as int8 (half the bytes — decode is bandwidth-bound,
     so that is the whole win) with per-position f32 scales riding as two
@@ -181,6 +184,7 @@ def _decode_kernel_i8(lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         logits = logits * (ksc[None, :] * scale)         # [G, bs]
+        logits = apply_soft_cap(logits, soft_cap)
         pos = s * block_s + jax.lax.broadcasted_iota(
             jnp.int32, logits.shape, 1)
         valid = pos < llen
@@ -211,7 +215,7 @@ def _decode_kernel_i8(lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
 
 
 def _local_decode_xla(q, k, v, local_lens, *, scale, k_scale=None,
-                      v_scale=None):
+                      v_scale=None, soft_cap=0.0):
     """Dense fallback for ragged shapes / non-TPU (reference analog: the
     non-TMA dispatch path).  Same (out, lse) contract as the Pallas kernel.
 
@@ -228,6 +232,7 @@ def _local_decode_xla(q, k, v, local_lens, *, scale, k_scale=None,
     logits = jnp.einsum("bhgd,bhsd->bhgs", qf, k.astype(jnp.float32)) * scale
     if k_scale is not None:
         logits = logits * k_scale[:, :, None, :]
+    logits = apply_soft_cap(logits, soft_cap)
     valid = jnp.arange(S)[None, :] < local_lens[:, None]        # [B, S]
     logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
     m = jnp.max(logits, axis=-1)                                # [B, Hkv, g]
@@ -295,7 +300,8 @@ def quantize_kv(x):
 
 @_register_aot()
 def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
-                     interpret=False, k_scale=None, v_scale=None):
+                     interpret=False, k_scale=None, v_scale=None,
+                     soft_cap=0.0):
     """Single-shard GQA decode: q [B, Hq, D], k/v [B, Hkv, S_loc, D],
     local_lens [B] (valid rows in this shard).  Returns float32 partials
     (out [B, Hq, D], lse [B, Hq]).
@@ -335,7 +341,8 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
         # runs the fused int8 split-KV kernel below (r4; it was an XLA
         # reroute before the kernel existed).
         return _local_decode_xla(q, k, v, local_lens, scale=scale,
-                                 k_scale=k_scale, v_scale=v_scale)
+                                 k_scale=k_scale, v_scale=v_scale,
+                                 soft_cap=soft_cap)
 
     defaulted = block_s is None
     if defaulted:
@@ -394,7 +401,8 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
                     f" D={D} has no legal KV block that fits VMEM (needs "
                     f"{need} with 4*bs*D*itemsize <= 12 MiB)")
             return _local_decode_xla(q, k, v, local_lens, scale=scale,
-                                     k_scale=k_scale, v_scale=v_scale)
+                                     k_scale=k_scale, v_scale=v_scale,
+                                     soft_cap=soft_cap)
         bs = fit
     n_s = S // bs
 
@@ -408,14 +416,14 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
         sc_spec = pl.BlockSpec((1, 1, bs // 128, 128),
                                lambda b, h, s, lens: (b, h, s, 0))
         kern = functools.partial(_decode_kernel_i8, block_s=bs, n_s=n_s,
-                                 scale=scale)
+                                 scale=scale, soft_cap=soft_cap)
         in_specs = [q_spec, kv_spec, kv_spec, sc_spec, sc_spec]
         args = (local_lens, qg, k, v,
                 k_scale.reshape(B, Hkv, S // 128, 128),
                 v_scale.reshape(B, Hkv, S // 128, 128))
     else:
         kern = functools.partial(_decode_kernel, block_s=bs, n_s=n_s,
-                                 scale=scale)
+                                 scale=scale, soft_cap=soft_cap)
         in_specs = [q_spec, kv_spec, kv_spec]
         args = (local_lens, qg, k, v)
     out, lse = pl.pallas_call(
@@ -473,7 +481,7 @@ def _paged_gather(pool, table):
 
 
 def gqa_decode_paged_shard(q, k_pool, v_pool, block_table, local_lens, *,
-                           impl="auto", interpret=False):
+                           impl="auto", interpret=False, soft_cap=0.0):
     """Single-shard GQA decode over a PAGED KV cache.
 
     q [B, Hq, D]; k/v_pool [N_pages, Hkv, page, D] (the physical page
@@ -501,12 +509,13 @@ def gqa_decode_paged_shard(q, k_pool, v_pool, block_table, local_lens, *,
                     f"double-buffered K+V page blocks within 12 MiB VMEM"):
         return _local_decode_xla(q, _paged_gather(k_pool, block_table),
                                  _paged_gather(v_pool, block_table),
-                                 local_lens, scale=scale)
+                                 local_lens, scale=scale,
+                                 soft_cap=soft_cap)
 
     qg = q.reshape(B, Hkv, g, D)
     grid = (B, Hkv, n_pages)
     kern = functools.partial(_decode_kernel_paged, block_s=Pg,
-                             n_s=n_pages, scale=scale)
+                             n_s=n_pages, scale=scale, soft_cap=soft_cap)
     out, lse = pl.pallas_call(
         kern,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -548,18 +557,19 @@ def gqa_decode_paged_shard(q, k_pool, v_pool, block_table, local_lens, *,
 
 def _decode_kernel_paged(lens_ref, table_ref, q_ref, k_ref, v_ref, out_ref,
                          lse_ref, acc_ref, m_ref, l_ref, *, block_s, n_s,
-                         scale):
+                         scale, soft_cap=0.0):
     """Thin shim: the paged kernel IS :func:`_decode_kernel` — paging
     lives entirely in the BlockSpec index maps; ``table_ref`` is consumed
     there, not in the body."""
     del table_ref
     return _decode_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
                           acc_ref, m_ref, l_ref, block_s=block_s, n_s=n_s,
-                          scale=scale)
+                          scale=scale, soft_cap=soft_cap)
 
 
 def sp_gqa_decode_paged_shard(q, k_pool, v_pool, block_table, kv_lens, *,
-                              axis, impl="auto", interpret=False):
+                              axis, impl="auto", interpret=False,
+                              soft_cap=0.0):
     """Per-device SP decode over a paged cache: each rank's pool holds
     the pages of ITS sequence shard and ``block_table`` [B, n_local]
     holds local pool indices for the rank's logical pages.  ``kv_lens``
@@ -572,7 +582,8 @@ def sp_gqa_decode_paged_shard(q, k_pool, v_pool, block_table, kv_lens, *,
 
     out, lse = gqa_decode_paged_shard(q, k_pool, v_pool, block_table,
                                       local_lens, impl=impl,
-                                      interpret=interpret)
+                                      interpret=interpret,
+                                      soft_cap=soft_cap)
     return _combine_across_ranks(out, lse, q.dtype, axis=axis, impl=impl,
                                  interpret=interpret)
 
@@ -689,7 +700,7 @@ def combine_partials(outs, lses):
 
 def sp_gqa_decode_shard(q, k_shard, v_shard, kv_lens, *, axis, block_s=None,
                         impl="auto", interpret=False, k_scale=None,
-                        v_scale=None):
+                        v_scale=None, soft_cap=0.0):
     """Per-device SP decode: local split-KV partials -> comm-fused combine
     (``sp_combine_shard``; the XLA-only mode falls back to LL gather +
     epilogue).  ``kv_lens`` are GLOBAL lengths; the shard
@@ -708,7 +719,7 @@ def sp_gqa_decode_shard(q, k_shard, v_shard, kv_lens, *, axis, block_s=None,
     out, lse = gqa_decode_shard(q, k_shard, v_shard, local_lens,
                                 block_s=block_s, impl=impl,
                                 interpret=interpret, k_scale=k_scale,
-                                v_scale=v_scale)
+                                v_scale=v_scale, soft_cap=soft_cap)
     # Comm-fused combine kernel by default — remote DMA of the (out, lse)
     # partial planes and the LSE merge in ONE Pallas kernel (VERDICT
     # round-1 missing #2); xla mode keeps the packed LL gather + epilogue.
@@ -726,6 +737,7 @@ class SpDecodeContext:
     block_s: int | None = None  # None = full-shard chunk (min(S, 8192))
     impl: str = "auto"
     interpret: bool = False
+    soft_cap: float = 0.0  # Gemma-2 logit capping; 0 = off
 
     @property
     def world(self) -> int:
@@ -733,9 +745,10 @@ class SpDecodeContext:
 
 
 def create_sp_decode_context(mesh, axis="sp", block_s=None, impl="auto",
-                             interpret=False) -> SpDecodeContext:
+                             interpret=False,
+                             soft_cap=0.0) -> SpDecodeContext:
     return SpDecodeContext(mesh=mesh, axis=axis, block_s=block_s, impl=impl,
-                           interpret=interpret)
+                           interpret=interpret, soft_cap=soft_cap)
 
 
 def sp_gqa_decode(q, k_cache, v_cache, kv_lens, ctx: SpDecodeContext):
@@ -752,6 +765,6 @@ def sp_gqa_decode(q, k_cache, v_cache, kv_lens, ctx: SpDecodeContext):
         (P(), P(None, None, ctx.axis), P(None, None, ctx.axis), P()),
         P(),
         axis=ctx.axis, block_s=ctx.block_s, impl=ctx.impl,
-        interpret=ctx.interpret,
+        interpret=ctx.interpret, soft_cap=ctx.soft_cap,
     )
     return fn(q, k_cache, v_cache, kv_lens)

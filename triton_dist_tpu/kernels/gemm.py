@@ -86,6 +86,17 @@ def resolve_impl(impl: str, interpret: bool) -> str:
     return impl
 
 
+def apply_soft_cap(logits, soft_cap):
+    """Gemma-2-style logit soft-capping: ``cap * tanh(logits / cap)``.
+    ``soft_cap`` is a STATIC float; 0/None is the identity (compile-time
+    branch — no tanh in the hot loop unless capping is on).  Reference
+    analog: the ``soft_cap`` argument threaded through its decode stack
+    (sp_flash_decode_layer.py:46, flash_decode.py:103)."""
+    if not soft_cap:
+        return logits
+    return soft_cap * jnp.tanh(logits / soft_cap)
+
+
 class PallasShapeError(ValueError):
     """Raised when ``impl='pallas'`` is requested explicitly but a shape
     guard would silently reroute to the XLA fallback."""
