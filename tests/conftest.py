@@ -92,12 +92,32 @@ _FAST_GATE_MODULES = {
     "test_language", "test_allgather", "test_fast_allgather",
     "test_reduce_scatter", "test_torus", "test_all_to_all",
     "test_hierarchical", "test_ag_gemm", "test_gemm_rs", "test_gemm",
+    "test_flash_attention", "test_paged_decode",
 }
 
 # Heavy tests inside core modules whose coverage is duplicated by a
 # cheaper sibling (orientation/dtype/protocol variants): slow-marked so
 # the gate keeps one representative of each behavior.
 _FAST_GATE_EXCLUDES = {
+    # flash-attention gate keeps one fwd, one bwd, strict dispatch, and
+    # the paged/SP representatives; sweeps/tuning/dtype twins run in the
+    # full suite.
+    "test_flash_attention_autotuned",
+    "test_flash_backward_block_invariance",
+    "test_flash_offsets_chunked_prefill",
+    "test_flash_soft_cap_fwd_bwd",
+    "test_flash_block_sweep",
+    "test_flash_gqa_wrapper_layout",
+    "test_flash_backward_bf16",
+    "test_flash_backward_matches_xla[False]",
+    "test_flash_lse_merges_like_ring",
+    "test_flash_bf16",
+    "test_flash_backward_masked_rows_finite",
+    "test_flash_matches_dense[4-True]",
+    "test_flash_matches_dense[4-False]",
+    "test_flash_matches_dense[1-False]",
+    "test_flash_int8_kv_sp_shard",
+    "test_paged_layer_sp",
     "test_torus_gemm_rs_int8_exact",
     "test_torus3d_gemm_rs_fused",
     "test_torus_gemm_rs_fused_epilogue[mesh2x4]",
