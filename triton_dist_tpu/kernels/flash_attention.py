@@ -349,13 +349,19 @@ def _flash_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _flash_bwd_pallas(q, k, v, out, lse, do, q_offset, kv_offset, causal,
-                      scale, interpret, soft_cap=0.0):
-    """Blockwise gradients (dq, dk, dv) in the primal dtypes."""
+                      scale, interpret, soft_cap=0.0, block_q=None,
+                      block_k=None):
+    """Blockwise gradients (dq, dk, dv) in the primal dtypes.
+
+    Default blocks (bq=128, bk=512) from the r4 chip sweep
+    (bench_flash_prefill --grad --bwd-blocks); both kernels keep more
+    operands resident than the forward (q, k, v, do + two accumulators),
+    so the forward's bk=1024 does NOT transfer."""
     B, Hq, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
     g = Hq // Hkv
-    bq = largest_divisor_block(Sq, 128, 128)
-    bk = largest_divisor_block(Sk, 512, 128)
+    bq = largest_divisor_block(Sq, block_q or 128, 128)
+    bk = largest_divisor_block(Sk, block_k or 512, 128)
     n_q, n_k = Sq // bq, Sk // bk
 
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
