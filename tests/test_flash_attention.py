@@ -375,3 +375,50 @@ def test_flash_soft_cap_fwd_bwd(key):
                                     scale=1.0 / np.sqrt(d), q_offset=0,
                                     kv_offset=0, soft_cap=cap)[0])(q)
     assert_allclose(gp, gx, atol=5e-5, rtol=5e-5)
+
+
+def test_flash_sliding_window(key):
+    """Sliding-window attention (Mistral-style): kernel vs a directly
+    windowed dense oracle, incl. offsets and the window block-skip."""
+    b, hkv, g, s, d, w = 1, 1, 2, 512, 128, 160
+    q, k, v = _mk(key, b, hkv * g, hkv, s, s, d, jnp.float32)
+
+    logits = jnp.einsum("bhgsd,bhtd->bhgst",
+                        q.reshape(b, hkv, g, s, d), k) / np.sqrt(d)
+    rows = jnp.arange(s)[:, None]
+    cols = jnp.arange(s)[None, :]
+    mask = (rows >= cols) & (rows - cols < w)
+    logits = jnp.where(mask[None, None, None], logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    want = jnp.einsum("bhgst,bhtd->bhgsd", p, v).reshape(b, hkv * g, s, d)
+
+    out = flash_attention(q, k, v, causal=True, window=w, impl="pallas",
+                          interpret=True)
+    assert_allclose(out, want, atol=2e-5, rtol=2e-5)
+    # window must actually bite
+    out_nw = flash_attention(q, k, v, causal=True, impl="xla")
+    assert float(jnp.max(jnp.abs(out - out_nw))) > 1e-3
+    # chunked offsets compose with the window
+    off = 256
+    oc = flash_attention(q[:, :, off:off + 128], k, v, causal=True,
+                         window=w, q_offset=off, impl="pallas",
+                         interpret=True)
+    assert_allclose(oc, want[:, :, off:off + 128], atol=2e-5, rtol=2e-5)
+
+
+def test_flash_sliding_window_backward(key):
+    """Window gradients: flash bwd kernels vs jax.grad of the windowed
+    dense program."""
+    b, hkv, g, s, d, w = 1, 1, 2, 256, 128, 96
+    q, k, v = _mk(key, b, hkv * g, hkv, s, s, d, jnp.float32)
+
+    def loss(fn):
+        return jax.grad(lambda q_: jnp.sum(fn(q_) ** 2), argnums=0)
+
+    gp = loss(lambda q_: flash_attention(
+        q_, k, v, causal=True, window=w, impl="pallas",
+        interpret=True))(q)
+    gx = loss(lambda q_: _flash_xla(
+        q_, k, v, causal=True, scale=1.0 / np.sqrt(d), q_offset=0,
+        kv_offset=0, window=w)[0])(q)
+    assert_allclose(gp, gx, atol=5e-5, rtol=5e-5)

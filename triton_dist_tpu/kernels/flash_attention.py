@@ -65,9 +65,39 @@ NEG_INF = -1.0e30  # finite -inf proxy: survives exp/log without NaNs
 # ---------------------------------------------------------------------------
 
 
+def _visibility_mask(q_start, k_start, *, causal, window, group, bq, bk):
+    """THE masking rule, shared by the forward/int8/backward kernels so
+    they can never diverge: key at kpos is visible to the query at qpos
+    iff (not causal or qpos >= kpos) and (not window or
+    qpos - kpos < window).  Returns a [G, bq, bk] bool mask (only called
+    when causal or window is set)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (group, bq, bk), 1)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (group, bq, bk), 2)
+    qpos = q_start + rows
+    kpos = k_start + cols
+    if causal and window:
+        return (qpos >= kpos) & (qpos - kpos < window)
+    if causal:
+        return qpos >= kpos
+    return qpos - kpos < window
+
+
+def _block_live(q_start, k_start, *, causal, window, bq, bk):
+    """Whole-block skip predicate matching :func:`_visibility_mask`:
+    False when no (qpos, kpos) pair in the block is visible."""
+    live = True
+    if causal:
+        # block entirely in the future of every q row
+        live = k_start <= q_start + (bq - 1)
+    if window:
+        # block entirely past every q row's window
+        live = live & (k_start + (bk - 1) > q_start - window)
+    return live
+
+
 def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
                   acc_ref, m_ref, l_ref, *, bq, bk, n_k, causal, scale,
-                  group, soft_cap=0.0):
+                  group, soft_cap=0.0, window=0):
     """Grid (B, Hkv, nQ, nK); one (batch, kv-head, q-block) accumulates
     across the sequential KV-block axis.
 
@@ -100,10 +130,10 @@ def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
                 group, bq, bk) * scale                    # [G, bq, bk]
         logits = apply_soft_cap(logits, soft_cap)
 
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (group, bq, bk), 1)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (group, bq, bk), 2)
-            mask = (q_start + rows) >= (k_start + cols)
+        if causal or window:
+            mask = _visibility_mask(q_start, k_start, causal=causal,
+                                    window=window, group=group, bq=bq,
+                                    bk=bk)
             logits = jnp.where(mask, logits, NEG_INF)
 
         m_cur = m_ref[:]                                  # [G, bq]
@@ -111,7 +141,7 @@ def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
         # m only grows; rows with nothing visible yet stay at NEG_INF and
         # exp(NEG - NEG) = 1 would poison them — mask p explicitly.
         p = jnp.exp(logits - m_new[..., None])            # [G, bq, bk]
-        if causal:
+        if causal or window:
             p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_cur - m_new)                    # [G, bq]
         m_ref[:] = m_new
@@ -123,11 +153,11 @@ def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
         acc_ref[:] = (acc_ref[:] * alpha[..., None]
                       + pv.reshape(group, bq, -1))
 
-    if causal:
-        # Causal skip: a KV block entirely in the future of every q row
-        # in this block contributes nothing — skip its matmuls (the DMA
-        # already streamed; compute is the prefill bottleneck).
-        pl.when(k_start <= q_start + (bq - 1))(body)
+    if causal or window:
+        # Skip blocks with no visible (qpos, kpos) pair — their DMAs
+        # already streamed; compute is the prefill bottleneck.
+        pl.when(_block_live(q_start, k_start, causal=causal,
+                            window=window, bq=bq, bk=bk))(body)
     else:
         body()
 
@@ -145,7 +175,7 @@ def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
 
 def _flash_kernel_i8(offs_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
                      out_ref, lse_ref, acc_ref, m_ref, l_ref, *, bq, bk,
-                     n_k, causal, scale, group, soft_cap=0.0):
+                     n_k, causal, scale, group, soft_cap=0.0, window=0):
     """int8-KV twin of :func:`_flash_kernel` (the decode `_decode_kernel_i8`
     recipe applied to prefill): K/V stream as int8 with per-position f32
     scales riding LANE-PACKED [B, Hkv, Sk/128, 128] planes — K's scale
@@ -176,16 +206,16 @@ def _flash_kernel_i8(offs_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
         logits = (logits * (ksc[None, :] * scale)).reshape(group, bq, bk)
         logits = apply_soft_cap(logits, soft_cap)
 
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (group, bq, bk), 1)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (group, bq, bk), 2)
-            mask = (q_start + rows) >= (k_start + cols)
+        if causal or window:
+            mask = _visibility_mask(q_start, k_start, causal=causal,
+                                    window=window, group=group, bq=bq,
+                                    bk=bk)
             logits = jnp.where(mask, logits, NEG_INF)
 
         m_cur = m_ref[:]
         m_new = jnp.maximum(m_cur, jnp.max(logits, axis=-1))
         p = jnp.exp(logits - m_new[..., None])
-        if causal:
+        if causal or window:
             p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_cur - m_new)
         m_ref[:] = m_new
@@ -197,8 +227,9 @@ def _flash_kernel_i8(offs_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
         acc_ref[:] = (acc_ref[:] * alpha[..., None]
                       + pv.reshape(group, bq, -1))
 
-    if causal:
-        pl.when(k_start <= q_start + (bq - 1))(body)
+    if causal or window:
+        pl.when(_block_live(q_start, k_start, causal=causal,
+                            window=window, bq=bq, bk=bk))(body)
     else:
         body()
 
@@ -230,7 +261,7 @@ def _flash_kernel_i8(offs_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
 
 def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, q_start,
                     k_start, *, causal, scale, group, bq, bk,
-                    soft_cap=0.0):
+                    soft_cap=0.0, window=0):
     """Shared backward block math: recompute P from (q, k, lse) and form
     dS — the one place the masking/NEG_INF rules live for both backward
     kernels.  Returns (p, ds) [G, bq, bk] f32 plus the flat q/do views.
@@ -256,10 +287,10 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, q_start,
         s = s_raw
         dcap = None
     e = jnp.exp(s - lse[..., None])
-    if causal:
-        rows = jax.lax.broadcasted_iota(jnp.int32, (group, bq, bk), 1)
-        cols = jax.lax.broadcasted_iota(jnp.int32, (group, bq, bk), 2)
-        p = jnp.where((q_start + rows) >= (k_start + cols), e, 0.0)
+    if causal or window:
+        p = jnp.where(_visibility_mask(q_start, k_start, causal=causal,
+                                       window=window, group=group, bq=bq,
+                                       bk=bk), e, 0.0)
     else:
         p = e
     dp = jax.lax.dot_general(
@@ -273,7 +304,7 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, q_start,
 
 def _flash_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                          dl_ref, dq_ref, acc_ref, *, bq, bk, n_k, causal,
-                         scale, group, soft_cap=0.0):
+                         scale, group, soft_cap=0.0, window=0):
     ik = pl.program_id(3)
 
     @pl.when(ik == 0)
@@ -289,15 +320,16 @@ def _flash_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         _, ds, _, _ = _recompute_p_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, q_start,
             k_start, causal=causal, scale=scale, group=group, bq=bq, bk=bk,
-            soft_cap=soft_cap)
+            soft_cap=soft_cap, window=window)
         upd = jax.lax.dot_general(
             ds.reshape(group * bq, bk).astype(k.dtype), k,
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # [G*bq, D]
         acc_ref[:] = acc_ref[:] + upd.reshape(group, bq, -1)
 
-    if causal:
-        pl.when(k_start <= q_start + (bq - 1))(body)
+    if causal or window:
+        pl.when(_block_live(q_start, k_start, causal=causal,
+                            window=window, bq=bq, bk=bk))(body)
     else:
         body()
 
@@ -308,7 +340,8 @@ def _flash_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 def _flash_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                           dl_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, bq,
-                          bk, n_q, causal, scale, group, soft_cap=0.0):
+                          bk, n_q, causal, scale, group, soft_cap=0.0,
+                          window=0):
     iq = pl.program_id(3)
 
     @pl.when(iq == 0)
@@ -324,7 +357,7 @@ def _flash_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         p, ds, q, do = _recompute_p_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, q_start,
             k_start, causal=causal, scale=scale, group=group, bq=bq, bk=bk,
-            soft_cap=soft_cap)
+            soft_cap=soft_cap, window=window)
         # dv_j = sum_i p_ij do_i  — contract over the G*bq row axis.
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p.reshape(group * bq, bk).astype(do.dtype), do,
@@ -335,10 +368,16 @@ def _flash_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # [bk, D]
 
+    live = True
     if causal:
         # This KV block gets gradient only from q rows at positions
         # >= k_start; skip inner q blocks entirely before it.
-        pl.when(q_start + (bq - 1) >= k_start)(body)
+        live = q_start + (bq - 1) >= k_start
+    if window:
+        # ...and only from q rows whose window still reaches it.
+        live = live & (q_start < k_start + (bk - 1) + window)
+    if causal or window:
+        pl.when(live)(body)
     else:
         body()
 
@@ -350,7 +389,7 @@ def _flash_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 def _flash_bwd_pallas(q, k, v, out, lse, do, q_offset, kv_offset, causal,
                       scale, interpret, soft_cap=0.0, block_q=None,
-                      block_k=None):
+                      block_k=None, window=0):
     """Blockwise gradients (dq, dk, dv) in the primal dtypes.
 
     Default blocks (bq=128, bk=512) from the r4 chip sweep
@@ -381,7 +420,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, q_offset, kv_offset, causal,
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, bq=bq, bk=bk, n_k=n_k,
                           causal=causal, scale=float(scale), group=g,
-                          soft_cap=soft_cap),
+                          soft_cap=soft_cap, window=window),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B, Hkv, n_q, n_k),
@@ -406,7 +445,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, q_offset, kv_offset, causal,
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, bq=bq, bk=bk, n_q=n_q,
                           causal=causal, scale=float(scale), group=g,
-                          soft_cap=soft_cap),
+                          soft_cap=soft_cap, window=window),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B, Hkv, n_k, n_q),
@@ -432,7 +471,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, q_offset, kv_offset, causal,
 
 
 def _flash_xla(q, k, v, *, causal, scale, q_offset, kv_offset,
-               k_scale=None, v_scale=None, soft_cap=0.0):
+               k_scale=None, v_scale=None, soft_cap=0.0, window=0):
     """O(S^2)-memory reference path: out [B, Hq, Sq, D] in q.dtype,
     lse [B, Hq, Sq] f32.  Optional ``k/v_scale`` [B, Hkv, Sk] dequantize
     an int8 K/V (the decode `_local_decode_xla` recipe)."""
@@ -445,15 +484,18 @@ def _flash_xla(q, k, v, *, causal, scale, q_offset, kv_offset,
     if k_scale is not None:
         logits = logits * k_scale[:, :, None, None, :]
     logits = apply_soft_cap(logits, soft_cap)
-    if causal:
+    if causal or window:
         rows = q_offset + jnp.arange(Sq)[:, None]
         cols = kv_offset + jnp.arange(Sk)[None, :]
-        mask = rows >= cols                               # [Sq, Sk]
+        mask = (rows >= cols) if causal else jnp.ones(
+            (Sq, Sk), bool)                               # [Sq, Sk]
+        if window:
+            mask = mask & (rows - cols < window)
         logits = jnp.where(mask[None, None, None], logits, NEG_INF)
     m = jnp.max(logits, axis=-1)                          # [B,Hkv,g,Sq]
     nonempty = m > NEG_INF / 2
     p = jnp.exp(logits - m[..., None])
-    if causal:
+    if causal or window:
         p = jnp.where(mask[None, None, None], p, 0.0)
     l = jnp.sum(p, axis=-1)
     if v_scale is not None:
@@ -482,7 +524,7 @@ def flash_shapes_ok(sq: int, sk: int, d: int) -> bool:
 def flash_attention(q, k, v, *, causal=True, scale=None, q_offset=0,
                     kv_offset=0, block_q=None, block_k=None, impl="auto",
                     interpret=False, return_lse=False, k_scale=None,
-                    v_scale=None, soft_cap=0.0):
+                    v_scale=None, soft_cap=0.0, window=0):
     """Blockwise GQA attention: q [B, Hq, Sq, D], k/v [B, Hkv, Sk, D] →
     out [B, Hq, Sq, D] in q.dtype (+ lse [B, Hq, Sq] f32 when
     ``return_lse``).
@@ -496,6 +538,12 @@ def flash_attention(q, k, v, *, causal=True, scale=None, q_offset=0,
     (the serving int8-KV cache): the pallas path fuses the scales into
     the block loop (``_flash_kernel_i8``), the fallback into the dense
     stream.  The quantized path is forward-only (serving).
+
+    ``window`` (sliding-window attention, Mistral-style): key at kpos is
+    visible iff ``qpos - kpos < window`` (the current token counts, so
+    position qpos attends to [qpos - window + 1, qpos]); composes with
+    the offsets and with ``causal``, and blocks wholly outside the
+    window skip their compute — differentiable like the causal path.
     """
     B, Hq, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
@@ -514,7 +562,7 @@ def flash_attention(q, k, v, *, causal=True, scale=None, q_offset=0,
         out, lse = _flash_xla(q, k, v, causal=causal, scale=scale,
                               q_offset=q_offset, kv_offset=kv_offset,
                               k_scale=k_scale, v_scale=v_scale,
-                              soft_cap=soft_cap)
+                              soft_cap=soft_cap, window=window)
         return (out, lse) if return_lse else out
 
     # Block defaults from the real-chip sweep (docs/perf.md): SMALL q
@@ -539,7 +587,7 @@ def flash_attention(q, k, v, *, causal=True, scale=None, q_offset=0,
         out, lse = _flash_pallas(q, k, v, q_offset, kv_offset, causal,
                                  float(scale), bq, bk, interpret,
                                  k_scale=k_scale, v_scale=v_scale,
-                                 soft_cap=soft_cap)
+                                 soft_cap=soft_cap, window=window)
         return (out, lse) if return_lse else out
 
     def _static_int(x):
@@ -557,15 +605,17 @@ def flash_attention(q, k, v, *, causal=True, scale=None, q_offset=0,
         # kernels recomputing P from the saved lse) — O(S) memory on
         # both passes.
         return _flash_diff(q, k, v, qo, ko, causal,
-                           float(scale), bq, bk, interpret, soft_cap)
+                           float(scale), bq, bk, interpret, soft_cap,
+                           window)
     out, lse = _flash_pallas(q, k, v, q_offset, kv_offset, causal,
                              float(scale), bq, bk, interpret,
-                             soft_cap=soft_cap)
+                             soft_cap=soft_cap, window=window)
     return (out, lse) if return_lse else out
 
 
 def _flash_pallas(q, k, v, q_offset, kv_offset, causal, scale, bq, bk,
-                  interpret, k_scale=None, v_scale=None, soft_cap=0.0):
+                  interpret, k_scale=None, v_scale=None, soft_cap=0.0,
+                  window=0):
     """The raw pallas_call: out [B, Hq, Sq, D] in q.dtype, lse f32."""
     B, Hq, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
@@ -578,11 +628,11 @@ def _flash_pallas(q, k, v, q_offset, kv_offset, causal, scale, bq, bk,
     if quantized:
         kern = functools.partial(_flash_kernel_i8, bq=bq, bk=bk, n_k=n_k,
                                  causal=causal, scale=float(scale), group=g,
-                                 soft_cap=soft_cap)
+                                 soft_cap=soft_cap, window=window)
     else:
         kern = functools.partial(_flash_kernel, bq=bq, bk=bk, n_k=n_k,
                                  causal=causal, scale=float(scale), group=g,
-                                 soft_cap=soft_cap)
+                                 soft_cap=soft_cap, window=window)
     in_specs = [
         pl.BlockSpec((1, 1, g, bq, D),
                      lambda b, h, i, j, offs: (b, h, 0, i, 0)),
@@ -636,25 +686,28 @@ def _flash_pallas(q, k, v, q_offset, kv_offset, causal, scale, bq, bk,
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
 def _flash_diff(q, k, v, q_offset, kv_offset, causal, scale, bq, bk,
-                interpret, soft_cap=0.0):
+                interpret, soft_cap=0.0, window=0):
     return _flash_pallas(q, k, v, q_offset, kv_offset, causal, scale, bq,
-                         bk, interpret, soft_cap=soft_cap)[0]
+                         bk, interpret, soft_cap=soft_cap,
+                         window=window)[0]
 
 
 def _flash_diff_fwd(q, k, v, q_offset, kv_offset, causal, scale, bq, bk,
-                    interpret, soft_cap=0.0):
+                    interpret, soft_cap=0.0, window=0):
     out, lse = _flash_pallas(q, k, v, q_offset, kv_offset, causal, scale,
-                             bq, bk, interpret, soft_cap=soft_cap)
+                             bq, bk, interpret, soft_cap=soft_cap,
+                             window=window)
     return out, (q, k, v, out, lse)
 
 
 def _flash_diff_bwd(q_offset, kv_offset, causal, scale, bq, bk, interpret,
-                    soft_cap, res, g):
+                    soft_cap, window, res, g):
     q, k, v, out, lse = res
     return _flash_bwd_pallas(q, k, v, out, lse, g, q_offset, kv_offset,
-                             causal, scale, interpret, soft_cap=soft_cap)
+                             causal, scale, interpret, soft_cap=soft_cap,
+                             window=window)
 
 
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
@@ -734,7 +787,7 @@ def flash_prefill_aot(q, k, v, *, impl="auto", block_q=None, block_k=None,
 def sp_flash_attention_shard(q, k_shard, v_shard, *, axis, causal=True,
                              scale=None, q_offset=0, impl="auto",
                              interpret=False, k_scale=None, v_scale=None,
-                             soft_cap=0.0):
+                             soft_cap=0.0, window=0):
     """Sequence-parallel prefill attention; call inside shard_map.
 
     q [B, Hq, Sq, D] replicated (the current chunk's queries); k/v_shard
@@ -755,7 +808,7 @@ def sp_flash_attention_shard(q, k_shard, v_shard, *, axis, causal=True,
         q, k_shard, v_shard, causal=causal, scale=scale,
         q_offset=q_offset, kv_offset=me * s_loc, impl=impl,
         interpret=interpret, return_lse=True, k_scale=k_scale,
-        v_scale=v_scale, soft_cap=soft_cap)
+        v_scale=v_scale, soft_cap=soft_cap, window=window)
     if world == 1:
         return out
     # Weighted-REDUCE combine (combine_partials' math as collectives):
