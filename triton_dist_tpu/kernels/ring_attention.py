@@ -33,6 +33,11 @@ Three implementations:
 Causality: KV block from rank j attends to local queries with the global
 positions mask; blocks entirely in the future contribute nothing (their
 exp-weights are 0) but still ride the ring — SPMD uniformity.
+
+Not yet threaded here: ``soft_cap``/``window`` (the flash kernels accept
+both — see kernels/flash_attention.py — so the flash impl needs only
+parameter plumbing through the ring custom-VJP; the xla/pallas impls
+would need the same additions in ``_block_update``/the fused kernel).
 """
 
 from __future__ import annotations
