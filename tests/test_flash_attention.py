@@ -422,3 +422,25 @@ def test_flash_sliding_window_backward(key):
         q_, k, v, causal=True, scale=1.0 / np.sqrt(d), q_offset=0,
         kv_offset=0, window=w)[0])(q)
     assert_allclose(gp, gx, atol=5e-5, rtol=5e-5)
+
+
+def test_sp_flash_window(mesh4, key):
+    """Windowed SP prefill: the window mask is global-position based, so
+    per-shard flash + LSE combine equals unsharded windowed flash."""
+    import functools
+    from jax.sharding import PartitionSpec as P
+    from triton_dist_tpu.kernels.flash_attention import (
+        sp_flash_attention_shard)
+
+    b, hkv, g, sq, sk, w = 1, 1, 2, 128, 512, 160
+    q, k, v = _mk(key, b, hkv * g, hkv, sq, sk, 128, jnp.float32)
+    got = jax.jit(jax.shard_map(
+        functools.partial(sp_flash_attention_shard, axis="tp",
+                          causal=True, q_offset=384, window=w,
+                          interpret=True),
+        mesh=mesh4, in_specs=(P(), P(None, None, "tp"),
+                              P(None, None, "tp")),
+        out_specs=P(), check_vma=False))(q, k, v)
+    ref = flash_attention(q, k, v, causal=True, q_offset=384, window=w,
+                          impl="xla")
+    assert_allclose(got, ref, atol=2e-5, rtol=2e-5)

@@ -824,7 +824,7 @@ def sp_flash_attention_shard(q, k_shard, v_shard, *, axis, causal=True,
 
 
 def flash_gqa_attention(q, k, v, *, causal=True, scale=None, impl="auto",
-                        interpret=False):
+                        interpret=False, window=0, soft_cap=0.0):
     """Drop-in for ``attention.dense_gqa_attention`` — the model families'
     [S, B, H, D] layout.  q [S, B, Hq, D]; k/v [S, B, Hkv, D]; returns
     [S, B, Hq, D] in q's dtype."""
@@ -832,5 +832,6 @@ def flash_gqa_attention(q, k, v, *, causal=True, scale=None, impl="auto",
     kt = k.transpose(1, 2, 0, 3)
     vt = v.transpose(1, 2, 0, 3)
     out = flash_attention(qt, kt, vt, causal=causal, scale=scale,
-                          impl=impl, interpret=interpret)
+                          impl=impl, interpret=interpret, window=window,
+                          soft_cap=soft_cap)
     return out.transpose(2, 0, 1, 3)

@@ -597,7 +597,7 @@ def _decode_kernel_paged(lens_ref, table_ref, q_ref, k_ref, v_ref, out_ref,
 
 def sp_gqa_decode_paged_shard(q, k_pool, v_pool, block_table, kv_lens, *,
                               axis, impl="auto", interpret=False,
-                              soft_cap=0.0):
+                              soft_cap=0.0, window=0):
     """Per-device SP decode over a paged cache: each rank's pool holds
     the pages of ITS sequence shard and ``block_table`` [B, n_local]
     holds local pool indices for the rank's logical pages.  ``kv_lens``
@@ -611,7 +611,7 @@ def sp_gqa_decode_paged_shard(q, k_pool, v_pool, block_table, kv_lens, *,
     out, lse = gqa_decode_paged_shard(q, k_pool, v_pool, block_table,
                                       local_lens, impl=impl,
                                       interpret=interpret,
-                                      soft_cap=soft_cap)
+                                      soft_cap=soft_cap, window=window)
     return _combine_across_ranks(out, lse, q.dtype, axis=axis, impl=impl,
                                  interpret=interpret)
 
@@ -728,7 +728,7 @@ def combine_partials(outs, lses):
 
 def sp_gqa_decode_shard(q, k_shard, v_shard, kv_lens, *, axis, block_s=None,
                         impl="auto", interpret=False, k_scale=None,
-                        v_scale=None, soft_cap=0.0):
+                        v_scale=None, soft_cap=0.0, window=0):
     """Per-device SP decode: local split-KV partials -> comm-fused combine
     (``sp_combine_shard``; the XLA-only mode falls back to LL gather +
     epilogue).  ``kv_lens`` are GLOBAL lengths; the shard
@@ -747,7 +747,8 @@ def sp_gqa_decode_shard(q, k_shard, v_shard, kv_lens, *, axis, block_s=None,
     out, lse = gqa_decode_shard(q, k_shard, v_shard, local_lens,
                                 block_s=block_s, impl=impl,
                                 interpret=interpret, k_scale=k_scale,
-                                v_scale=v_scale, soft_cap=soft_cap)
+                                v_scale=v_scale, soft_cap=soft_cap,
+                                window=window)
     # Comm-fused combine kernel by default — remote DMA of the (out, lse)
     # partial planes and the LSE merge in ONE Pallas kernel (VERDICT
     # round-1 missing #2); xla mode keeps the packed LL gather + epilogue.
@@ -766,6 +767,7 @@ class SpDecodeContext:
     impl: str = "auto"
     interpret: bool = False
     soft_cap: float = 0.0  # Gemma-2 logit capping; 0 = off
+    window: int = 0  # sliding window (single-shard contract; 0 = off)
 
     @property
     def world(self) -> int:
@@ -773,10 +775,15 @@ class SpDecodeContext:
 
 
 def create_sp_decode_context(mesh, axis="sp", block_s=None, impl="auto",
-                             interpret=False,
-                             soft_cap=0.0) -> SpDecodeContext:
+                             interpret=False, soft_cap=0.0,
+                             window=0) -> SpDecodeContext:
+    if window and mesh.shape[axis] > 1:
+        raise ValueError(
+            "window decode is single-shard by contract (the window is "
+            "relative to the shard's local length); use a world-1 axis")
     return SpDecodeContext(mesh=mesh, axis=axis, block_s=block_s, impl=impl,
-                           interpret=interpret, soft_cap=soft_cap)
+                           interpret=interpret, soft_cap=soft_cap,
+                           window=window)
 
 
 def sp_gqa_decode(q, k_cache, v_cache, kv_lens, ctx: SpDecodeContext):
@@ -793,6 +800,6 @@ def sp_gqa_decode(q, k_cache, v_cache, kv_lens, ctx: SpDecodeContext):
         (P(), P(None, None, ctx.axis), P(None, None, ctx.axis), P()),
         P(),
         axis=ctx.axis, block_s=ctx.block_s, impl=ctx.impl,
-        interpret=ctx.interpret, soft_cap=ctx.soft_cap,
+        interpret=ctx.interpret, soft_cap=ctx.soft_cap, window=ctx.window,
     )
     return fn(q, k_cache, v_cache, kv_lens)

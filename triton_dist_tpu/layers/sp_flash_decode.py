@@ -30,13 +30,13 @@ from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
 
 
 def _sp_decode_q_shard(q, kq, ks, vq, vs, kv_lens, *, axis, block_s, impl,
-                       interpret, soft_cap=0.0):
+                       interpret, soft_cap=0.0, window=0):
     """Shard-level SP decode over an int8 cache (positional scales for
     shard_map)."""
     return sp_gqa_decode_shard(q, kq, vq, kv_lens, axis=axis,
                                block_s=block_s, impl=impl,
                                interpret=interpret, k_scale=ks, v_scale=vs,
-                               soft_cap=soft_cap)
+                               soft_cap=soft_cap, window=window)
 
 
 def append_kv_shard_q(kq, ks, vq, vs, new_k, new_v, kv_lens, *, axis):
@@ -92,12 +92,14 @@ class SpGQAFlashDecodeAttention:
     def __init__(self, mesh: Mesh, axis: str = "sp", block_s: int | None = None,
                  impl: str = "auto", interpret: bool = False,
                  check_bounds: bool = True, kv_dtype=None,
-                 soft_cap: float = 0.0):
-        # ``soft_cap``: Gemma-2 logit capping, threaded to every decode
-        # path (reference analog: sp_flash_decode_layer.py:46).
+                 soft_cap: float = 0.0, window: int = 0):
+        # ``soft_cap``: Gemma-2 logit capping; ``window``: sliding-window
+        # attention (single-shard contract — create_sp_decode_context
+        # raises for world > 1).  Threaded to every decode path
+        # (reference analog: sp_flash_decode_layer.py:46).
         self.ctx: SpDecodeContext = create_sp_decode_context(
             mesh, axis=axis, block_s=block_s, impl=impl, interpret=interpret,
-            soft_cap=soft_cap)
+            soft_cap=soft_cap, window=window)
         # The append overflow guard costs a host sync per step (it reads
         # max(kv_lens)); hot decode loops tracking lengths host-side can
         # disable it.
@@ -235,7 +237,7 @@ class SpGQAFlashDecodeAttention:
                 P(),
                 axis=self.ctx.axis, impl=self.ctx.impl,
                 interpret=self.ctx.interpret, n_loc_pool=n_loc_pool,
-                soft_cap=self.ctx.soft_cap,
+                soft_cap=self.ctx.soft_cap, window=self.ctx.window,
             )
             return fn(q, k_cache, v_cache, block_table, kv_lens)
         assert isinstance(k_cache, dict) == self.quantized, (
@@ -249,7 +251,7 @@ class SpGQAFlashDecodeAttention:
                 P(),
                 axis=self.ctx.axis, block_s=self.ctx.block_s,
                 impl=self.ctx.impl, interpret=self.ctx.interpret,
-                soft_cap=self.ctx.soft_cap,
+                soft_cap=self.ctx.soft_cap, window=self.ctx.window,
             )
             return fn(q, k_cache["q"], k_cache["s"], v_cache["q"],
                       v_cache["s"], kv_lens)
@@ -290,7 +292,8 @@ class SpGQAFlashDecodeAttention:
 
 
 def _sp_decode_paged_shard(q, k_pool, v_pool, table, kv_lens, *, axis,
-                           impl, interpret, n_loc_pool, soft_cap=0.0):
+                           impl, interpret, n_loc_pool, soft_cap=0.0,
+                           window=0):
     """Shard body: slice this rank's table columns and rebase its entries
     into local pool coordinates."""
     from triton_dist_tpu.kernels.flash_decode import (
@@ -304,4 +307,4 @@ def _sp_decode_paged_shard(q, k_pool, v_pool, table, kv_lens, *, axis,
     return sp_gqa_decode_paged_shard(q, k_pool, v_pool, local, kv_lens,
                                      axis=axis, impl=impl,
                                      interpret=interpret,
-                                     soft_cap=soft_cap)
+                                     soft_cap=soft_cap, window=window)

@@ -63,10 +63,16 @@ class Generator:
         self.mesh = mesh
         self.axis = axis
         self.max_seq = max_seq or cfg.max_seq
+        if cfg.attn_window and mesh.shape[axis] > 1:
+            raise ValueError(
+                f"attn_window={cfg.attn_window} needs a world-1 mesh: "
+                "windowed decode is single-shard by contract (a window "
+                "bounds the live cache — shard something else)")
         self.attn = SpGQAFlashDecodeAttention(
             mesh, axis=axis, impl=impl, interpret=interpret,
             check_bounds=False,  # Generator guards lengths itself (below)
-            kv_dtype=kv_dtype)   # jnp.int8 = quantized KV cache
+            kv_dtype=kv_dtype,   # jnp.int8 = quantized KV cache
+            soft_cap=cfg.attn_soft_cap, window=cfg.attn_window)
         self._prefill_jit = jax.jit(functools.partial(
             _prompt_forward, cfg=cfg, impl=impl, interpret=interpret))
         # caches are donated: each chunk's dynamic-update happens in place
@@ -234,7 +240,7 @@ class Generator:
 
 def _attend_prefix(q, k_all, v_all, prefix_len, *, k_scale=None,
                    v_scale=None, impl="auto", interpret=False,
-                   mesh=None, axis=None):
+                   mesh=None, axis=None, window=0, soft_cap=0.0):
     """Chunk attention against the cache prefix + itself.
 
     q [B, c, Hq, hd]; k/v_all [B, Hkv, S, hd] (the full cache, chunk rows
@@ -272,17 +278,21 @@ def _attend_prefix(q, k_all, v_all, prefix_len, *, k_scale=None,
             out = flash_attention(
                 qt, k_all, v_all, causal=True, q_offset=prefix_len,
                 impl="auto", interpret=interpret, k_scale=k_scale,
-                v_scale=v_scale)
+                v_scale=v_scale, window=window, soft_cap=soft_cap)
             return out.transpose(0, 2, 1, 3).astype(jnp.float32)
         if k_all.shape[2] % world == 0:
             from jax.sharding import PartitionSpec as P
 
             def sp(qt_, k_, v_, off, *scs):
                 ksc, vsc = scs if scs else (None, None)
+                # The prefill kernel's window mask is GLOBAL-position
+                # based (qpos = q_offset + i, kpos = me*s_loc + j), so
+                # windowed SP chunked prefill just works — only DECODE's
+                # window is single-shard (its rule is llen-relative).
                 return sp_flash_attention_shard(
                     qt_, k_, v_, axis=axis, causal=True, q_offset=off,
                     impl="auto", interpret=interpret, k_scale=ksc,
-                    v_scale=vsc)
+                    v_scale=vsc, soft_cap=soft_cap, window=window)
 
             seq_spec = P(None, None, axis)
             args = [qt, k_all, v_all, prefix_len]
@@ -306,9 +316,13 @@ def _attend_prefix(q, k_all, v_all, prefix_len, *, k_scale=None,
                         k_all.astype(jnp.float32)) / np.sqrt(hd)
     if k_scale is not None:
         logits = logits * k_scale[:, :, None, None, :]
+    if soft_cap:
+        logits = soft_cap * jnp.tanh(logits / soft_cap)
     pos = jnp.arange(S)[None, :]                     # [1, S]
     limit = prefix_len + jnp.arange(c)[:, None]      # [c, 1]
     mask = pos <= limit                              # [c, S]
+    if window:
+        mask = mask & (limit - pos < window)
     logits = jnp.where(mask[None, None, None], logits, -1e30)
     p = jax.nn.softmax(logits, axis=-1)
     if v_scale is not None:
@@ -377,11 +391,15 @@ def _chunk_forward(params, chunk, caches, prefix_len, *, cfg: LlamaConfig,
                                k_scale=k_c["s"][:, :, :ext],
                                v_scale=v_c["s"][:, :, :ext],
                                impl=impl, interpret=interpret,
-                               mesh=mesh, axis=axis)
+                               mesh=mesh, axis=axis,
+                               window=cfg.attn_window,
+                               soft_cap=cfg.attn_soft_cap)
         else:
             o = _attend_prefix(q, k_c[:, :, :ext], v_c[:, :, :ext],
                                prefix_len, impl=impl, interpret=interpret,
-                               mesh=mesh, axis=axis)
+                               mesh=mesh, axis=axis,
+                               window=cfg.attn_window,
+                               soft_cap=cfg.attn_soft_cap)
         o = o.reshape(B * c, cfg.n_heads * hd).astype(cfg.dtype)
         x = x + (o @ layer["wo"]).reshape(B, c, cfg.dim)
         h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps).reshape(
@@ -430,7 +448,9 @@ def _prompt_forward(params, tokens, *, cfg: LlamaConfig, ffn=None,
         o = flash_gqa_attention(q, k, v, causal=True,
                                 scale=1.0 / np.sqrt(hd),
                                 impl="xla" if impl == "xla" else "auto",
-                                interpret=interpret)
+                                interpret=interpret,
+                                window=cfg.attn_window,
+                                soft_cap=cfg.attn_soft_cap)
         o = o.transpose(1, 0, 2, 3).reshape(B * S, cfg.n_heads * hd)
         x = x + (o @ layer["wo"]).reshape(B, S, cfg.dim)
         h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps).reshape(

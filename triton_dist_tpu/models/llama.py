@@ -49,6 +49,11 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
     dtype: object = jnp.float32
+    # Attention variants (r4): sliding window (Mistral) and logit
+    # soft-capping (Gemma-2), threaded to the flash kernels by every
+    # model path.  0 = off.
+    attn_window: int = 0
+    attn_soft_cap: float = 0.0
 
     @property
     def head_dim(self) -> int:
@@ -76,6 +81,11 @@ class LlamaConfig:
 
     @staticmethod
     def mistral_7b() -> "LlamaConfig":
+        # NOTE: the presets mirror the reference's GEMM-shape table, so
+        # attention variants stay off by default; Mistral's real sliding
+        # window is ``replace(cfg, attn_window=4096)`` — windowed DECODE
+        # requires a world-1 mesh (Generator raises otherwise), windowed
+        # prefill/training work on any mesh.
         return LlamaConfig(vocab=32000, dim=4096, n_layers=32, n_heads=32,
                            n_kv_heads=8, ffn_dim=14336, rope_theta=1e6,
                            dtype=jnp.bfloat16)
@@ -195,7 +205,9 @@ def _attention(q, k, v, cfg: LlamaConfig, *, impl="auto", interpret=False):
     return flash_gqa_attention(q, k, v, causal=True,
                                scale=1.0 / math.sqrt(cfg.head_dim),
                                impl="xla" if impl == "xla" else "auto",
-                               interpret=interpret)
+                               interpret=interpret,
+                               window=cfg.attn_window,
+                               soft_cap=cfg.attn_soft_cap)
 
 
 def attention_block_shard(x, layer, cfg: LlamaConfig, *, axis, impl,

@@ -70,6 +70,9 @@ class MoEConfig:
     # (t_loc * topk, every local assignment bound for one rank).
     max_tokens: int | None = None
     dtype: object = jnp.float32
+    # Attention variants (r4), same semantics as LlamaConfig.
+    attn_window: int = 0
+    attn_soft_cap: float = 0.0
 
     @property
     def head_dim(self) -> int:
@@ -82,7 +85,8 @@ class MoEConfig:
             n_heads=self.n_heads, n_kv_heads=self.n_kv_heads,
             ffn_dim=self.expert_ffn_dim, max_seq=self.max_seq,
             rope_theta=self.rope_theta, norm_eps=self.norm_eps,
-            dtype=self.dtype)
+            dtype=self.dtype, attn_window=self.attn_window,
+            attn_soft_cap=self.attn_soft_cap)
 
     @staticmethod
     def mixtral_8x7b() -> "MoEConfig":
