@@ -65,6 +65,44 @@ def test_cp_with_dp(key):
     assert np.isfinite(float(l1)) and float(l1) < float(l0)
 
 
+@pytest.mark.parametrize("attn", ["ring", "ulysses"])
+def test_cp_window_softcap_matches_unsharded(mesh_cp, key, attn):
+    """Mistral/Gemma-2 knobs under context parallelism (the r4 advisor
+    finding: CP used to silently drop them): sharded forward == world-1."""
+    import dataclasses
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), attn_window=24,
+                              attn_soft_cap=8.0)
+    params = init_params(cfg, key)
+    tokens = jax.random.randint(jax.random.key(4), (64, 2), 0, cfg.vocab)
+
+    fwd = CP.make_cp_forward(cfg, mesh_cp, attn=attn, impl="xla",
+                             interpret=True)
+    got = np.asarray(fwd(CP.place_cp_params(params, cfg, mesh_cp), tokens))
+    want = _unsharded_logits(params, tokens, cfg)
+    np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-4)
+
+
+def test_cp_window_softcap_train_matches_unsharded(mesh_cp, key):
+    """Two SGD steps with window+cap: world-4 CP losses == world-1 losses
+    (same function, same grads — the full backward honors the knobs)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), attn_window=24,
+                              attn_soft_cap=8.0)
+    tokens = jax.random.randint(jax.random.key(5), (64, 2), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=0)
+    losses = {}
+    for mesh in (mesh_cp, Mesh(np.array(jax.devices()[:1]), ("cp",))):
+        params = CP.place_cp_params(init_params(cfg, key), cfg, mesh)
+        step, _ = CP.make_cp_train_step(cfg, mesh, attn="ring", impl="xla",
+                                        interpret=True, lr=0.1)
+        params, l0 = step(params, tokens, targets)
+        _, l1 = step(params, tokens, targets)
+        losses[mesh.shape["cp"]] = (float(l0), float(l1))
+    np.testing.assert_allclose(losses[4], losses[1], rtol=2e-4)
+
+
 def test_cp_remat_matches_no_remat(mesh_cp, key):
     """jax.checkpoint changes memory, not math: losses across two steps
     (hence gradients too) must match the non-remat path."""

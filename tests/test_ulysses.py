@@ -71,3 +71,19 @@ def test_ulysses_rejects_indivisible_heads(mesh4, key):
     ctx = create_ulysses_context(mesh4, axis="tp", impl="xla", interpret=True)
     with pytest.raises(AssertionError, match="ring attention"):
         ulysses_attention(q, k, v, ctx)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_ulysses_window_softcap_matches_dense(mesh4, key, impl):
+    """Mistral window + Gemma-2 soft-cap through the head scatter: the
+    local attention sees the full sequence, so positions must stay global
+    after the A2A for the window rule to hold."""
+    q, k, v = _qkv(key, S=32, Hq=8, Hkv=4)
+    window, cap = 19, 7.0
+    ctx = create_ulysses_context(mesh4, axis="tp", causal=True, impl=impl,
+                                 interpret=True, window=window,
+                                 soft_cap=cap)
+    got = np.asarray(ulysses_attention(q, k, v, ctx))
+    want = np.asarray(_dense_reference(q, k, v, True, window=window,
+                                       soft_cap=cap))
+    np.testing.assert_allclose(got, want, atol=3e-5, rtol=3e-5)

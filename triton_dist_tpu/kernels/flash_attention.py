@@ -389,8 +389,10 @@ def _flash_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 def _flash_bwd_pallas(q, k, v, out, lse, do, q_offset, kv_offset, causal,
                       scale, interpret, soft_cap=0.0, block_q=None,
-                      block_k=None, window=0):
-    """Blockwise gradients (dq, dk, dv) in the primal dtypes.
+                      block_k=None, window=0, grad_dtype=None):
+    """Blockwise gradients (dq, dk, dv) in the primal dtypes, or in
+    ``grad_dtype`` when set (the ring caller asks for f32 so its cross-ring
+    accumulation never rounds per-block summands to bf16).
 
     Default blocks (bq=128, bk=512) from the r4 chip sweep
     (bench_flash_prefill --grad --bwd-blocks); both kernels keep more
@@ -402,6 +404,9 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, q_offset, kv_offset, causal,
     bq = largest_divisor_block(Sq, block_q or 128, 128)
     bk = largest_divisor_block(Sk, block_k or 512, 128)
     n_q, n_k = Sq // bq, Sk // bk
+    dq_dtype = grad_dtype or q.dtype
+    dk_dtype = grad_dtype or k.dtype
+    dv_dtype = grad_dtype or v.dtype
 
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)                               # [B, Hq, Sq]
@@ -428,7 +433,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, q_offset, kv_offset, causal,
             out_specs=[q_spec],
             scratch_shapes=[pltpu.VMEM((g, bq, D), jnp.float32)],
         ),
-        out_shape=[jax.ShapeDtypeStruct((B, Hkv, g, Sq, D), q.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((B, Hkv, g, Sq, D), dq_dtype)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
@@ -455,8 +460,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, q_offset, kv_offset, causal,
             scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                             pltpu.VMEM((bk, D), jnp.float32)],
         ),
-        out_shape=[jax.ShapeDtypeStruct((B, Hkv, Sk, D), k.dtype),
-                   jax.ShapeDtypeStruct((B, Hkv, Sk, D), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((B, Hkv, Sk, D), dk_dtype),
+                   jax.ShapeDtypeStruct((B, Hkv, Sk, D), dv_dtype)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),

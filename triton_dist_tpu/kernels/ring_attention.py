@@ -34,10 +34,14 @@ Causality: KV block from rank j attends to local queries with the global
 positions mask; blocks entirely in the future contribute nothing (their
 exp-weights are 0) but still ride the ring — SPMD uniformity.
 
-Not yet threaded here: ``soft_cap``/``window`` (the flash kernels accept
-both — see kernels/flash_attention.py — so the flash impl needs only
-parameter plumbing through the ring custom-VJP; the xla/pallas impls
-would need the same additions in ``_block_update``/the fused kernel).
+``soft_cap``/``window`` (the Gemma-2 / Mistral knobs) thread through all
+three impls: the flash impl forwards them to the flash kernels (which
+already own the masking rule), and the xla/pallas impls apply the same
+rule in ``_block_update`` — key at kpos visible iff (not causal or
+qpos >= kpos) and (not window or qpos - kpos < window), logits capped by
+``soft_cap * tanh(logits / soft_cap)`` before masking.  Dead ring steps
+(blocks wholly outside every query's window) contribute lse = NEG
+partials, which the LSE merge treats as exact no-ops.
 """
 
 from __future__ import annotations
@@ -53,7 +57,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
-from triton_dist_tpu.kernels.gemm import resolve_impl
+from triton_dist_tpu.kernels.gemm import apply_soft_cap, resolve_impl
 from triton_dist_tpu.language.interpret import maybe_interpret
 from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
 
@@ -68,6 +72,8 @@ class RingAttentionContext:
     causal: bool = True
     impl: str = "auto"
     interpret: bool = False
+    window: int = 0
+    soft_cap: float = 0.0
 
     @property
     def world(self) -> int:
@@ -75,13 +81,15 @@ class RingAttentionContext:
 
 
 def create_ring_attention_context(mesh, axis="sp", causal=True, impl="auto",
-                                  interpret=False) -> RingAttentionContext:
+                                  interpret=False, window=0,
+                                  soft_cap=0.0) -> RingAttentionContext:
     return RingAttentionContext(mesh=mesh, axis=axis, causal=causal,
-                                impl=impl, interpret=interpret)
+                                impl=impl, interpret=interpret,
+                                window=window, soft_cap=soft_cap)
 
 
 def _block_update(q, k_blk, v_blk, m, l, acc, q_off, k_off, *, causal,
-                  scale, group):
+                  scale, group, window=0, soft_cap=0.0):
     """One flash/online-softmax fold of a KV block into the running stats.
 
     GROUPED, batch-LEADING layout — (batch, head) folded into one axis
@@ -92,24 +100,35 @@ def _block_update(q, k_blk, v_blk, m, l, acc, q_off, k_off, *, causal,
 
     Returns updated (m, l, acc).  This is the same merge the reference's
     decode combine does with per-rank LSEs (flash_decode.py:512-526), done
-    blockwise.
+    blockwise.  ``window``/``soft_cap`` follow the flash kernels' rule
+    (flash_attention._visibility_mask / apply_soft_cap) exactly.
     """
     kr = jnp.repeat(k_blk, group, axis=0)
     vr = jnp.repeat(v_blk, group, axis=0)
     logits = jnp.einsum("gsd,gtd->gst", q, kr,
                         preferred_element_type=jnp.float32) * scale
-    if causal:
+    logits = apply_soft_cap(logits, soft_cap)
+    masked = causal or window
+    if masked:
         # 2-D iota (Mosaic rejects rank-1 iota on hardware; fine under XLA).
         sq, sk = q.shape[1], k_blk.shape[1]
         rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        mask = (q_off + rows) >= (k_off + cols)
+        qpos, kpos = q_off + rows, k_off + cols
+        # Three static branches, mirroring _visibility_mask (no all-true
+        # bool array through Mosaic).
+        if causal and window:
+            mask = (qpos >= kpos) & (qpos - kpos < window)
+        elif causal:
+            mask = qpos >= kpos
+        else:
+            mask = qpos - kpos < window
         logits = jnp.where(mask[None], logits, _NEG)
     m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
     # Rows with no visible keys yet keep m = _NEG; exp(logits - m) would be
     # exp(0) = 1 for masked entries, so clamp the rescale instead.
     p = jnp.exp(logits - m_new[..., None])
-    if causal:
+    if masked:
         p = jnp.where(mask[None], p, 0.0)
     rescale = jnp.exp(jnp.minimum(m - m_new, 0.0))
     l_new = l * rescale + jnp.sum(p, axis=-1)
@@ -119,7 +138,8 @@ def _block_update(q, k_blk, v_blk, m, l, acc, q_off, k_off, *, causal,
     return m_new, l_new, acc_new
 
 
-def _ring_attention_xla(q, k, v, *, axis, causal, scale):
+def _ring_attention_xla(q, k, v, *, axis, causal, scale, window=0,
+                        soft_cap=0.0):
     world = jax.lax.axis_size(axis)
     me = jax.lax.axis_index(axis)
     s_loc = q.shape[0]
@@ -128,7 +148,7 @@ def _ring_attention_xla(q, k, v, *, axis, causal, scale):
     q_off = me * s_loc
     perm = _ring_perm(world)
     upd = functools.partial(_block_update, causal=causal, scale=scale,
-                            group=group)
+                            group=group, window=window, soft_cap=soft_cap)
 
     qg = q.transpose(1, 2, 0, 3).reshape(b * hq, s_loc, hd)
     kg = k.transpose(1, 2, 0, 3).reshape(b * k.shape[2], s_loc, hd)
@@ -201,7 +221,8 @@ def _merge_partial(acc, denom, m_run, o_j, l_j):
     return acc, denom * r1 + r2, m
 
 
-def _ring_attention_flash_fwd(q, k, v, *, axis, causal, scale, interpret):
+def _ring_attention_flash_fwd(q, k, v, *, axis, causal, scale, interpret,
+                              window=0, soft_cap=0.0):
     """Returns (out [S_loc, B, Hq, hd] in q.dtype, lse [B, Hq, S_loc] f32)."""
     from triton_dist_tpu.kernels.flash_attention import flash_attention
 
@@ -219,7 +240,8 @@ def _ring_attention_flash_fwd(q, k, v, *, axis, causal, scale, interpret):
         return flash_attention(
             q4, k_blk, v_blk, causal=causal, scale=scale,
             q_offset=q_off, kv_offset=src * s_loc, impl="pallas",
-            interpret=interpret, return_lse=True)
+            interpret=interpret, return_lse=True, window=window,
+            soft_cap=soft_cap)
 
     o0, l0 = partial_for(k4, v4, me)                   # local block
     acc, denom, m_run = (o0.astype(jnp.float32),
@@ -243,7 +265,7 @@ def _ring_attention_flash_fwd(q, k, v, *, axis, causal, scale, interpret):
 
 
 def _ring_attention_flash_bwd(q, k, v, out, lse, do, *, axis, causal,
-                              scale, interpret):
+                              scale, interpret, window=0, soft_cap=0.0):
     """Reverse ring: per visiting block run the flash backward kernels
     against the GLOBAL lse; dk/dv accumulators rotate with the blocks and
     take one final hop home."""
@@ -260,17 +282,20 @@ def _ring_attention_flash_bwd(q, k, v, out, lse, do, *, axis, causal,
     q_off = me * s_loc
 
     def block_grads(k_blk, v_blk, src):
+        # grad_dtype=f32: per-block summands stay f32 all the way into the
+        # ring accumulation — casting to bf16 per block would round each
+        # of the W contributions before the f32 sum.
         return _flash_bwd_pallas(q4, k_blk, v_blk, out4, lse, do4,
                                  q_off, src * s_loc, causal, scale,
-                                 interpret)
+                                 interpret, window=window,
+                                 soft_cap=soft_cap,
+                                 grad_dtype=jnp.float32)
 
-    dq, dk0, dv0 = block_grads(k4, v4, me)
-    # All three accumulators carry f32 across the ring — rotating dk/dv
-    # in the storage dtype would round the partial sums W times (the wire
-    # cost of the f32 rotation is the price of a consistent gradient).
-    dq = dq.astype(jnp.float32)
-    dk_blk = dk0.astype(jnp.float32)
-    dv_blk = dv0.astype(jnp.float32)
+    dq, dk_blk, dv_blk = block_grads(k4, v4, me)
+    # All three accumulators (and every per-block summand, see
+    # block_grads) carry f32 across the ring — rounding the partials to
+    # the storage dtype would lose bits W times (the wire cost of the f32
+    # rotation is the price of a consistent gradient).
 
     def step(carry, s):
         k_blk, v_blk, dk_blk, dv_blk, dq_acc = carry
@@ -281,9 +306,8 @@ def _ring_attention_flash_bwd(q, k, v, out, lse, do, *, axis, causal,
         dv_blk = jax.lax.ppermute(dv_blk, axis, perm)
         dq_c, dk_c, dv_c = block_grads(k_blk, v_blk,
                                        _src_rank(me, s, world))
-        return (k_blk, v_blk, dk_blk + dk_c.astype(jnp.float32),
-                dv_blk + dv_c.astype(jnp.float32),
-                dq_acc + dq_c.astype(jnp.float32)), None
+        return (k_blk, v_blk, dk_blk + dk_c, dv_blk + dv_c,
+                dq_acc + dq_c), None
 
     if world > 1:
         (_, _, dk_blk, dv_blk, dq), _ = jax.lax.scan(
@@ -308,7 +332,8 @@ def _ring_attention_flash_bwd(q, k, v, out, lse, do, *, axis, causal,
 def _ring_attn_kernel(q_ref, k_ref, v_ref, o_ref, kring_ref, vring_ref,
                       q_vmem, k_vmem, v_vmem,
                       send_sem, recv_sem, copy_sem, credit_sem,
-                      *, axis, world, causal, scale, hq, hkv, hd):
+                      *, axis, world, causal, scale, hq, hkv, hd,
+                      window=0, soft_cap=0.0):
     """Double-buffered ring: slot s%2 is consumed while being forwarded to
     the right neighbor's slot (s+1)%2.  kring/vring: [2, G_kv, S_loc*hd] HBM;
     blocks stage through VMEM scratch for the VPU/MXU compute.
@@ -370,7 +395,8 @@ def _ring_attn_kernel(q_ref, k_ref, v_ref, o_ref, kring_ref, vring_ref,
         src = _src_rank(me, s, world)
         m, l, acc = _block_update(q, k_blk, v_blk, m, l, acc, q_off,
                                   src * s_loc, causal=causal, scale=scale,
-                                  group=group)
+                                  group=group, window=window,
+                                  soft_cap=soft_cap)
 
         if s < world - 1:
             # Drain both sends before overwriting/reusing the slot.
@@ -392,7 +418,8 @@ def _ring_attn_kernel(q_ref, k_ref, v_ref, o_ref, kring_ref, vring_ref,
     co.start(); co.wait()
 
 
-def _ring_attention_pallas_fwd(q, k, v, *, axis, causal, scale, interpret):
+def _ring_attention_pallas_fwd(q, k, v, *, axis, causal, scale, interpret,
+                               window=0, soft_cap=0.0):
     world = jax.lax.axis_size(axis)
     s_loc, b, hq, hd = q.shape
     hkv = k.shape[2]
@@ -405,7 +432,8 @@ def _ring_attention_pallas_fwd(q, k, v, *, axis, causal, scale, interpret):
 
     out, _, _ = pl.pallas_call(
         functools.partial(_ring_attn_kernel, axis=axis, world=world,
-                          causal=causal, scale=scale, hq=hq, hkv=hkv, hd=hd),
+                          causal=causal, scale=scale, hq=hq, hkv=hkv,
+                          hd=hd, window=window, soft_cap=soft_cap),
         out_shape=[
             jax.ShapeDtypeStruct(q2.shape, q.dtype),
             jax.ShapeDtypeStruct((2,) + k2.shape, k.dtype),  # k ring slots
@@ -434,40 +462,50 @@ def _ring_attention_pallas_fwd(q, k, v, *, axis, causal, scale, interpret):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _ring_attention_diff(q, k, v, axis, causal, scale, impl, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _ring_attention_diff(q, k, v, axis, causal, scale, impl, interpret,
+                         window, soft_cap):
     if impl == "flash":
         return _ring_attention_flash_fwd(q, k, v, axis=axis, causal=causal,
-                                         scale=scale, interpret=interpret)[0]
+                                         scale=scale, interpret=interpret,
+                                         window=window,
+                                         soft_cap=soft_cap)[0]
     if impl == "pallas":
         return _ring_attention_pallas_fwd(q, k, v, axis=axis, causal=causal,
-                                          scale=scale, interpret=interpret)
-    return _ring_attention_xla(q, k, v, axis=axis, causal=causal, scale=scale)
+                                          scale=scale, interpret=interpret,
+                                          window=window, soft_cap=soft_cap)
+    return _ring_attention_xla(q, k, v, axis=axis, causal=causal,
+                               scale=scale, window=window,
+                               soft_cap=soft_cap)
 
 
-def _ring_diff_fwd(q, k, v, axis, causal, scale, impl, interpret):
+def _ring_diff_fwd(q, k, v, axis, causal, scale, impl, interpret, window,
+                   soft_cap):
     if impl == "flash":
         out, lse = _ring_attention_flash_fwd(
             q, k, v, axis=axis, causal=causal, scale=scale,
-            interpret=interpret)
+            interpret=interpret, window=window, soft_cap=soft_cap)
         return out, (q, k, v, out, lse)
-    out = _ring_attention_diff(q, k, v, axis, causal, scale, impl, interpret)
+    out = _ring_attention_diff(q, k, v, axis, causal, scale, impl,
+                               interpret, window, soft_cap)
     return out, (q, k, v, None, None)
 
 
-def _ring_diff_bwd(axis, causal, scale, impl, interpret, res, dout):
+def _ring_diff_bwd(axis, causal, scale, impl, interpret, window, soft_cap,
+                   res, dout):
     q, k, v, out, lse = res
     if impl == "flash":
         # Reverse ring over the flash backward kernels with the global
         # lse — O(block) memory end to end.
         return _ring_attention_flash_bwd(
             q, k, v, out, lse, dout, axis=axis, causal=causal, scale=scale,
-            interpret=interpret)
+            interpret=interpret, window=window, soft_cap=soft_cap)
     # Backward = VJP of the numerically-identical xla ring (flash-style
     # recompute; the transposed scan runs the ring in reverse).
     _, vjp = jax.vjp(
         functools.partial(_ring_attention_xla, axis=axis, causal=causal,
-                          scale=scale), q, k, v)
+                          scale=scale, window=window, soft_cap=soft_cap),
+        q, k, v)
     return vjp(dout)
 
 
@@ -475,7 +513,8 @@ _ring_attention_diff.defvjp(_ring_diff_fwd, _ring_diff_bwd)
 
 
 def ring_attention_shard(q, k, v, *, axis, causal=True, scale=None,
-                         impl="auto", interpret=False):
+                         impl="auto", interpret=False, window=0,
+                         soft_cap=0.0):
     """Shard-level causal GQA ring attention; call inside shard_map.
 
     q [S_loc, B, Hq, hd]; k/v [S_loc, B, Hkv, hd] — sequence sharded over
@@ -487,6 +526,10 @@ def ring_attention_shard(q, k, v, *, axis, causal=True, scale=None,
     fused comm-overlap kernel (whole-shard VMEM staging — the
     low-latency choice for moderate S_loc); ``"xla"`` the dense scan
     reference.
+
+    ``window``/``soft_cap`` (Mistral sliding window / Gemma-2 logit cap)
+    apply the flash kernels' visibility rule across the ring; all impls
+    and both passes honor them.
     """
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
@@ -504,7 +547,7 @@ def ring_attention_shard(q, k, v, *, axis, causal=True, scale=None,
             f"ring_attention impl='flash': (S_loc={s_loc}, hd={hd}) needs "
             f"S_loc % 128 == hd % 128 == 0")
     return _ring_attention_diff(q, k, v, axis, causal, float(scale), impl,
-                                interpret)
+                                interpret, int(window), float(soft_cap))
 
 
 def ring_attention(q, k, v, ctx: RingAttentionContext):
@@ -515,6 +558,6 @@ def ring_attention(q, k, v, ctx: RingAttentionContext):
         (P(ctx.axis), P(ctx.axis), P(ctx.axis)),
         P(ctx.axis),
         axis=ctx.axis, causal=ctx.causal, impl=ctx.impl,
-        interpret=ctx.interpret,
+        interpret=ctx.interpret, window=ctx.window, soft_cap=ctx.soft_cap,
     )
     return fn(q, k, v)

@@ -41,6 +41,8 @@ class UlyssesContext:
     causal: bool = True
     impl: str = "auto"
     interpret: bool = False
+    window: int = 0
+    soft_cap: float = 0.0
 
     @property
     def world(self) -> int:
@@ -48,9 +50,11 @@ class UlyssesContext:
 
 
 def create_ulysses_context(mesh, axis="sp", causal=True, impl="auto",
-                           interpret=False) -> UlyssesContext:
+                           interpret=False, window=0,
+                           soft_cap=0.0) -> UlyssesContext:
     return UlyssesContext(mesh=mesh, axis=axis, causal=causal, impl=impl,
-                          interpret=interpret)
+                          interpret=interpret, window=window,
+                          soft_cap=soft_cap)
 
 
 def _a2a_blocks(send, *, axis, impl, interpret):
@@ -77,13 +81,19 @@ def _a2a_heads_to_seq(x, *, axis, impl, interpret):
 
 
 def ulysses_attention_shard(q, k, v, *, axis, causal=True, scale=None,
-                            impl="auto", interpret=False):
+                            impl="auto", interpret=False, window=0,
+                            soft_cap=0.0):
     """Shard-level Ulysses attention; call inside shard_map.
 
     q [S_loc, B, Hq, hd]; k/v [S_loc, B, Hkv, hd], sequence sharded over
     ``axis``.  Returns [S_loc, B, Hq, hd].  Differentiable on both impls
     (the A2As carry custom VJPs / native transposes).  Q/K/V travel in ONE
     fused A2A (per-peer head chunks concatenated), the output in a second.
+
+    ``window``/``soft_cap`` pass straight to the local full-sequence
+    attention (after the head scatter each device sees the WHOLE sequence
+    for its heads, so the Mistral/Gemma-2 rules need no cross-shard
+    bookkeeping here).
     """
     world = jax.lax.axis_size(axis)
     s_loc, b, hq, hd = q.shape
@@ -117,7 +127,8 @@ def ulysses_attention_shard(q, k, v, *, axis, causal=True, scale=None,
 
     oh = flash_gqa_attention(qh, kh, vh, causal=causal, scale=float(scale),
                              impl="xla" if impl == "xla" else "auto",
-                             interpret=interpret)
+                             interpret=interpret, window=window,
+                             soft_cap=soft_cap)
     return _a2a_heads_to_seq(oh, axis=axis, impl=impl, interpret=interpret)
 
 
@@ -129,6 +140,6 @@ def ulysses_attention(q, k, v, ctx: UlyssesContext):
         (P(ctx.axis), P(ctx.axis), P(ctx.axis)),
         P(ctx.axis),
         axis=ctx.axis, causal=ctx.causal, impl=ctx.impl,
-        interpret=ctx.interpret,
+        interpret=ctx.interpret, window=ctx.window, soft_cap=ctx.soft_cap,
     )
     return fn(q, k, v)

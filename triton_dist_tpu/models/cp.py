@@ -60,7 +60,8 @@ def _cp_attention_block(x, layer, cfg: LlamaConfig, *, axis, attn, impl,
     attn_fn = (ring_attention_shard if attn == "ring"
                else ulysses_attention_shard)
     o = attn_fn(q, k, v, axis=axis, causal=True, impl=impl,
-                interpret=interpret)
+                interpret=interpret, window=cfg.attn_window,
+                soft_cap=cfg.attn_soft_cap)
     o2 = o.reshape(s_loc * b, cfg.n_heads * hd)
     return x + (o2 @ layer["wo"]).reshape(s_loc, b, cfg.dim)
 
