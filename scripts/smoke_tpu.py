@@ -190,6 +190,29 @@ def main():
 
     check("ring_flash_grad(w1)", _ring_flash_grad)
 
+    # 8c. zigzag layout (r5): segmented per-block offset vectors through
+    # the flash kernels (two position runs per shard) + the windowed twin
+    check("ring_zigzag(w1)", lambda: _shard1(
+        ring_attention_shard, mesh, 3, axis="tp", causal=True,
+        impl="flash", interpret=False, zigzag=True)(qr, kr, kr))
+    check("ring_zigzag_win(w1)", lambda: _shard1(
+        ring_attention_shard, mesh, 3, axis="tp", causal=True,
+        impl="flash", interpret=False, zigzag=True, window=100,
+        soft_cap=30.0)(qr, kr, kr))
+
+    def _ring_zigzag_grad():
+        fn = jax.jit(jax.shard_map(
+            lambda q_, k_, v_: jax.grad(lambda qq: jnp.sum(
+                ring_attention_shard(qq, k_, v_, axis="tp", causal=True,
+                                     impl="flash", interpret=False,
+                                     zigzag=True)
+                .astype(jnp.float32)))(q_),
+            mesh=mesh, in_specs=(jax.sharding.PartitionSpec("tp"),) * 3,
+            out_specs=jax.sharding.PartitionSpec("tp"), check_vma=False))
+        return fn(qr, kr, kr)
+
+    check("ring_zigzag_grad(w1)", _ring_zigzag_grad)
+
     # 9. ulysses world-1 (a2a + dense attention)
     from triton_dist_tpu.kernels.ulysses_attention import (
         ulysses_attention_shard)

@@ -78,3 +78,19 @@ def test_topology_detects_cpu_mesh():
     topo = topology.detect_topology()
     assert topo.n_devices >= 1
     assert topo.bf16_tflops > 0
+
+
+def test_zigzag_ring_schedule_balance():
+    """Zigzag: constant half-block work per step; contiguous: full block
+    every step after the first.  Speedup closed form 2 - 1/w."""
+    from triton_dist_tpu.kernels.perf_model import (
+        ring_causal_speedup,
+        ring_causal_step_work,
+    )
+
+    for w in (2, 4, 8, 16):
+        zig = ring_causal_step_work(w, True)
+        naive = ring_causal_step_work(w, False)
+        assert zig == [0.5] * w
+        assert naive == [0.5] + [1.0] * (w - 1)
+        assert abs(ring_causal_speedup(w) - (2 - 1 / w)) < 1e-12

@@ -185,6 +185,77 @@ def test_ring_attention_window_softcap_grads(mesh4, key, impl, S):
                                    atol=5e-4, rtol=5e-4, err_msg=name)
 
 
+@pytest.mark.parametrize("impl,S", [("xla", 32), ("pallas", 32),
+                                    ("flash", 1024)])
+def test_ring_attention_zigzag_matches_dense(mesh4, key, impl, S):
+    """Zigzag layout (rank i holds chunks i and 2w-1-i): exact same math
+    as the contiguous layout, re-indexed — compare against dense through
+    the to_zigzag/from_zigzag permutations."""
+    from triton_dist_tpu.kernels.ring_attention import from_zigzag, to_zigzag
+
+    q, k, v = _qkv(key, S=S)
+    ctx = create_ring_attention_context(mesh4, axis="tp", causal=True,
+                                        impl=impl, interpret=True,
+                                        zigzag=True)
+    qz, kz, vz = (to_zigzag(x, 4) for x in (q, k, v))
+    got = np.asarray(from_zigzag(ring_attention(qz, kz, vz, ctx), 4))
+    want = np.asarray(_dense_reference(q, k, v, True))
+    np.testing.assert_allclose(got, want, atol=3e-5, rtol=3e-5)
+
+
+@pytest.mark.parametrize("impl,S,window,cap",
+                         [("xla", 16, 0, 0.0), ("xla", 32, 19, 7.0),
+                          ("flash", 1024, 0, 0.0),
+                          ("flash", 1024, 600, 7.0)])
+def test_ring_attention_zigzag_grads(mesh4, key, impl, S, window, cap):
+    """Zigzag backward (the reverse ring's dk/dv blocks ride home to
+    zigzag shards) vs dense autodiff, with and without window/cap."""
+    from triton_dist_tpu.kernels.ring_attention import from_zigzag, to_zigzag
+
+    hd = 64 if impl == "xla" else 128
+    q, k, v = _qkv(key, S=S, hd=hd)
+    ctx = create_ring_attention_context(mesh4, axis="tp", causal=True,
+                                        impl=impl, interpret=True,
+                                        zigzag=True, window=window,
+                                        soft_cap=cap)
+
+    def loss_ring(q_, k_, v_):
+        out = ring_attention(to_zigzag(q_, 4), to_zigzag(k_, 4),
+                             to_zigzag(v_, 4), ctx)
+        return jnp.sum(from_zigzag(out, 4) ** 2)
+
+    def loss_dense(q_, k_, v_):
+        return jnp.sum(_dense_reference(q_, k_, v_, True, window=window,
+                                        soft_cap=cap) ** 2)
+
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in zip(gr, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=5e-4, rtol=5e-4, err_msg=name)
+
+
+def test_zigzag_refuses_non_causal(mesh4, key):
+    q, k, v = _qkv(key, S=32)
+    ctx = create_ring_attention_context(mesh4, axis="tp", causal=False,
+                                        impl="xla", interpret=True,
+                                        zigzag=True)
+    with pytest.raises(ValueError, match="CAUSAL"):
+        ring_attention(q, k, v, ctx)
+
+
+def test_zigzag_indices_roundtrip():
+    from triton_dist_tpu.kernels.ring_attention import from_zigzag, to_zigzag
+
+    x = jnp.arange(48)
+    for w in (2, 4):
+        np.testing.assert_array_equal(np.asarray(from_zigzag(
+            to_zigzag(x, w), w)), np.asarray(x))
+    # shard 0 of world 4 holds chunks 0 and 7
+    z = np.asarray(to_zigzag(jnp.arange(64), 4))
+    np.testing.assert_array_equal(z[:16], np.r_[0:8, 56:64])
+
+
 def test_ring_attention_auto_prefers_flash(mesh4, key, monkeypatch):
     """``auto`` with flash-legal shapes resolves to the flash ring."""
     import sys

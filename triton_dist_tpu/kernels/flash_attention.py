@@ -95,9 +95,9 @@ def _block_live(q_start, k_start, *, causal, window, bq, bk):
     return live
 
 
-def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
-                  acc_ref, m_ref, l_ref, *, bq, bk, n_k, causal, scale,
-                  group, soft_cap=0.0, window=0):
+def _flash_kernel(qoffs_ref, koffs_ref, q_ref, k_ref, v_ref, out_ref,
+                  lse_ref, acc_ref, m_ref, l_ref, *, bq, bk, n_k, causal,
+                  scale, group, soft_cap=0.0, window=0):
     """Grid (B, Hkv, nQ, nK); one (batch, kv-head, q-block) accumulates
     across the sequential KV-block axis.
 
@@ -106,6 +106,11 @@ def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
     3D/2D per-row state so every reshape in the kernel only splits or
     collapses LEADING dims (free in Mosaic; lane-changing reshapes are
     relayouts).
+
+    ``qoffs/koffs`` [nQ]/[nK] scalar-prefetch vectors give each BLOCK its
+    global start position — contiguous layouts get an arithmetic ramp;
+    segmented layouts (the zigzag CP shard: two position runs per device)
+    get per-run ramps.  Rows within one block are always contiguous.
     """
     ik = pl.program_id(3)
 
@@ -116,8 +121,8 @@ def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
 
     iq = pl.program_id(2)
-    q_start = offs_ref[0] + iq * bq       # global position of q row 0
-    k_start = offs_ref[1] + ik * bk       # global position of k row 0
+    q_start = qoffs_ref[iq]               # global position of q row 0
+    k_start = koffs_ref[ik]               # global position of k row 0
 
     def body():
         q = q_ref[0, 0].reshape(group * bq, -1)           # [G*bq, D]
@@ -173,9 +178,10 @@ def _flash_kernel(offs_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
             l > 0.0, m_ref[:] + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
 
 
-def _flash_kernel_i8(offs_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
-                     out_ref, lse_ref, acc_ref, m_ref, l_ref, *, bq, bk,
-                     n_k, causal, scale, group, soft_cap=0.0, window=0):
+def _flash_kernel_i8(qoffs_ref, koffs_ref, q_ref, k_ref, v_ref, ks_ref,
+                     vs_ref, out_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+                     bq, bk, n_k, causal, scale, group, soft_cap=0.0,
+                     window=0):
     """int8-KV twin of :func:`_flash_kernel` (the decode `_decode_kernel_i8`
     recipe applied to prefill): K/V stream as int8 with per-position f32
     scales riding LANE-PACKED [B, Hkv, Sk/128, 128] planes — K's scale
@@ -190,8 +196,8 @@ def _flash_kernel_i8(offs_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
 
     iq = pl.program_id(2)
-    q_start = offs_ref[0] + iq * bq
-    k_start = offs_ref[1] + ik * bk
+    q_start = qoffs_ref[iq]
+    k_start = koffs_ref[ik]
 
     def body():
         q = q_ref[0, 0].reshape(group * bq, -1)           # [G*bq, D]
@@ -302,9 +308,10 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, q_start,
     return p, ds, q, do
 
 
-def _flash_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                         dl_ref, dq_ref, acc_ref, *, bq, bk, n_k, causal,
-                         scale, group, soft_cap=0.0, window=0):
+def _flash_bwd_dq_kernel(qoffs_ref, koffs_ref, q_ref, k_ref, v_ref,
+                         do_ref, lse_ref, dl_ref, dq_ref, acc_ref, *, bq,
+                         bk, n_k, causal, scale, group, soft_cap=0.0,
+                         window=0):
     ik = pl.program_id(3)
 
     @pl.when(ik == 0)
@@ -312,8 +319,8 @@ def _flash_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     iq = pl.program_id(2)
-    q_start = offs_ref[0] + iq * bq
-    k_start = offs_ref[1] + ik * bk
+    q_start = qoffs_ref[iq]
+    k_start = koffs_ref[ik]
 
     def body():
         k = k_ref[0, 0]                                   # [bk, D]
@@ -338,10 +345,10 @@ def _flash_bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_ref[0, 0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                          dl_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, bq,
-                          bk, n_q, causal, scale, group, soft_cap=0.0,
-                          window=0):
+def _flash_bwd_dkv_kernel(qoffs_ref, koffs_ref, q_ref, k_ref, v_ref,
+                          do_ref, lse_ref, dl_ref, dk_ref, dv_ref, dk_acc,
+                          dv_acc, *, bq, bk, n_q, causal, scale, group,
+                          soft_cap=0.0, window=0):
     iq = pl.program_id(3)
 
     @pl.when(iq == 0)
@@ -350,8 +357,8 @@ def _flash_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     ikb = pl.program_id(2)
-    q_start = offs_ref[0] + iq * bq
-    k_start = offs_ref[1] + ikb * bk
+    q_start = qoffs_ref[iq]
+    k_start = koffs_ref[ikb]
 
     def body():
         p, ds, q, do = _recompute_p_ds(
@@ -387,12 +394,44 @@ def _flash_bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _as_starts(starts_or_offset):
+    """Normalize an offset-like argument to a tuple of run starts: a
+    scalar offset means ONE contiguous run."""
+    if isinstance(starts_or_offset, (tuple, list)):
+        return tuple(starts_or_offset)
+    return (starts_or_offset,)
+
+
+def _block_starts(starts, total, blk):
+    """[n_blocks] int32 per-block global start positions: ``total`` rows
+    split evenly over ``len(starts)`` runs, each run split into ``blk``-row
+    blocks.  Works for python ints and traced scalars alike (the result
+    rides scalar prefetch)."""
+    n_runs = len(starts)
+    run = total // n_runs
+    assert run % blk == 0, (total, n_runs, blk)
+    ramp = jnp.arange(run // blk, dtype=jnp.int32) * blk
+    return (jnp.stack([jnp.asarray(s, jnp.int32) for s in starts])[:, None]
+            + ramp[None, :]).reshape(-1)
+
+
+def _bwd_blocks(Sq, Sk, n_runs_q, n_runs_k, block_q, block_k):
+    """Backward block sizes, clamped to the RUN length so every block's
+    rows are position-contiguous (segmented layouts)."""
+    bq = largest_divisor_block(Sq // n_runs_q, block_q or 128, 128)
+    bk = largest_divisor_block(Sk // n_runs_k, block_k or 512, 128)
+    return bq, bk
+
+
 def _flash_bwd_pallas(q, k, v, out, lse, do, q_offset, kv_offset, causal,
                       scale, interpret, soft_cap=0.0, block_q=None,
                       block_k=None, window=0, grad_dtype=None):
     """Blockwise gradients (dq, dk, dv) in the primal dtypes, or in
     ``grad_dtype`` when set (the ring caller asks for f32 so its cross-ring
     accumulation never rounds per-block summands to bf16).
+
+    ``q_offset``/``kv_offset`` may each be a scalar (one contiguous run)
+    or a tuple of run starts (segmented layout — the zigzag CP shard).
 
     Default blocks (bq=128, bk=512) from the r4 chip sweep
     (bench_flash_prefill --grad --bwd-blocks); both kernels keep more
@@ -401,8 +440,10 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, q_offset, kv_offset, causal,
     B, Hq, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
     g = Hq // Hkv
-    bq = largest_divisor_block(Sq, block_q or 128, 128)
-    bk = largest_divisor_block(Sk, block_k or 512, 128)
+    q_starts = _as_starts(q_offset)
+    kv_starts = _as_starts(kv_offset)
+    bq, bk = _bwd_blocks(Sq, Sk, len(q_starts), len(kv_starts), block_q,
+                         block_k)
     n_q, n_k = Sq // bq, Sk // bk
     dq_dtype = grad_dtype or q.dtype
     dk_dtype = grad_dtype or k.dtype
@@ -414,20 +455,21 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, q_offset, kv_offset, causal,
     dog = do.reshape(B, Hkv, g, Sq, D)
     lseg = lse.reshape(B, Hkv, g, Sq)
     dlg = delta.reshape(B, Hkv, g, Sq)
-    offs = jnp.array([q_offset, kv_offset], jnp.int32)
+    qoffs = _block_starts(q_starts, Sq, bq)
+    koffs = _block_starts(kv_starts, Sk, bk)
 
     q_spec = pl.BlockSpec((1, 1, g, bq, D),
-                          lambda b, h, i, j, offs: (b, h, 0, i, 0))
+                          lambda b, h, i, j, qo, ko: (b, h, 0, i, 0))
     row_spec = pl.BlockSpec((1, 1, g, bq),
-                            lambda b, h, i, j, offs: (b, h, 0, i))
+                            lambda b, h, i, j, qo, ko: (b, h, 0, i))
     kv_spec = pl.BlockSpec((1, 1, bk, D),
-                           lambda b, h, i, j, offs: (b, h, j, 0))
+                           lambda b, h, i, j, qo, ko: (b, h, j, 0))
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, bq=bq, bk=bk, n_k=n_k,
                           causal=causal, scale=float(scale), group=g,
                           soft_cap=soft_cap, window=window),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=(B, Hkv, n_q, n_k),
             in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
             out_specs=[q_spec],
@@ -438,21 +480,21 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, q_offset, kv_offset, causal,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=maybe_interpret(interpret),
-    )(offs, qg, k, v, dog, lseg, dlg)[0]
+    )(qoffs, koffs, qg, k, v, dog, lseg, dlg)[0]
 
     # dkv: Q axis innermost/sequential; note the (i, j) grid roles swap.
     q_spec2 = pl.BlockSpec((1, 1, g, bq, D),
-                           lambda b, h, j, i, offs: (b, h, 0, i, 0))
+                           lambda b, h, j, i, qo, ko: (b, h, 0, i, 0))
     row_spec2 = pl.BlockSpec((1, 1, g, bq),
-                             lambda b, h, j, i, offs: (b, h, 0, i))
+                             lambda b, h, j, i, qo, ko: (b, h, 0, i))
     kv_spec2 = pl.BlockSpec((1, 1, bk, D),
-                            lambda b, h, j, i, offs: (b, h, j, 0))
+                            lambda b, h, j, i, qo, ko: (b, h, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, bq=bq, bk=bk, n_q=n_q,
                           causal=causal, scale=float(scale), group=g,
                           soft_cap=soft_cap, window=window),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=(B, Hkv, n_k, n_q),
             in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2,
                       row_spec2],
@@ -466,7 +508,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, q_offset, kv_offset, causal,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=maybe_interpret(interpret),
-    )(offs, qg, k, v, dog, lseg, dlg)
+    )(qoffs, koffs, qg, k, v, dog, lseg, dlg)
     return dq.reshape(B, Hq, Sq, D), dk, dv
 
 
@@ -475,11 +517,22 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, q_offset, kv_offset, causal,
 # ---------------------------------------------------------------------------
 
 
+def _run_positions(starts, total):
+    """[total] int32 global positions for ``total`` rows split evenly over
+    the runs in ``starts`` (scalar offset ≡ one run)."""
+    starts = _as_starts(starts)
+    run = total // len(starts)
+    ramp = jnp.arange(run, dtype=jnp.int32)
+    return (jnp.stack([jnp.asarray(s, jnp.int32) for s in starts])[:, None]
+            + ramp[None, :]).reshape(-1)
+
+
 def _flash_xla(q, k, v, *, causal, scale, q_offset, kv_offset,
                k_scale=None, v_scale=None, soft_cap=0.0, window=0):
     """O(S^2)-memory reference path: out [B, Hq, Sq, D] in q.dtype,
     lse [B, Hq, Sq] f32.  Optional ``k/v_scale`` [B, Hkv, Sk] dequantize
-    an int8 K/V (the decode `_local_decode_xla` recipe)."""
+    an int8 K/V (the decode `_local_decode_xla` recipe).  Offsets may be
+    run-start tuples (segmented layouts)."""
     B, Hq, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
     g = Hq // Hkv
@@ -490,8 +543,8 @@ def _flash_xla(q, k, v, *, causal, scale, q_offset, kv_offset,
         logits = logits * k_scale[:, :, None, None, :]
     logits = apply_soft_cap(logits, soft_cap)
     if causal or window:
-        rows = q_offset + jnp.arange(Sq)[:, None]
-        cols = kv_offset + jnp.arange(Sk)[None, :]
+        rows = _run_positions(q_offset, Sq)[:, None]
+        cols = _run_positions(kv_offset, Sk)[None, :]
         mask = (rows >= cols) if causal else jnp.ones(
             (Sq, Sk), bool)                               # [Sq, Sk]
         if window:
@@ -519,11 +572,14 @@ def _flash_xla(q, k, v, *, causal, scale, q_offset, kv_offset,
 # ---------------------------------------------------------------------------
 
 
-def flash_shapes_ok(sq: int, sk: int, d: int) -> bool:
+def flash_shapes_ok(sq: int, sk: int, d: int, n_runs_q: int = 1,
+                    n_runs_k: int = 1) -> bool:
     """Lane/sublane legality for the flash tiles: q/k blocks need 128-lane
     D, and the lse output block's lane dim is the q-block (so Sq must tile
-    by 128); Sk tiles by 128 for the KV blocks."""
-    return d % 128 == 0 and sq % 128 == 0 and sk % 128 == 0
+    by 128); Sk tiles by 128 for the KV blocks.  Segmented layouts need
+    each RUN to tile by 128 (blocks never straddle a run boundary)."""
+    return (d % 128 == 0 and sq % n_runs_q == 0 and sk % n_runs_k == 0
+            and (sq // n_runs_q) % 128 == 0 and (sk // n_runs_k) % 128 == 0)
 
 
 def flash_attention(q, k, v, *, causal=True, scale=None, q_offset=0,
@@ -549,6 +605,12 @@ def flash_attention(q, k, v, *, causal=True, scale=None, q_offset=0,
     position qpos attends to [qpos - window + 1, qpos]); composes with
     the offsets and with ``causal``, and blocks wholly outside the
     window skip their compute — differentiable like the causal path.
+
+    SEGMENTED layouts: ``q_offset``/``kv_offset`` may each be a TUPLE of
+    run starts — the rows then consist of len(tuple) equal-length
+    position-contiguous runs (the zigzag CP shard holds chunks i and
+    2w-1-i).  Blocks never straddle runs; each run must tile by 128 for
+    the pallas path.
     """
     B, Hq, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
@@ -559,11 +621,15 @@ def flash_attention(q, k, v, *, causal=True, scale=None, q_offset=0,
     raw_impl = impl
     impl = resolve_impl(impl, interpret)
     quantized = k_scale is not None
+    n_runs_q = len(_as_starts(q_offset))
+    n_runs_k = len(_as_starts(kv_offset))
+    seg_q, seg_k = Sq // max(n_runs_q, 1), Sk // max(n_runs_k, 1)
 
-    if use_fallback(raw_impl, impl, flash_shapes_ok(Sq, Sk, D),
+    if use_fallback(raw_impl, impl,
+                    flash_shapes_ok(Sq, Sk, D, n_runs_q, n_runs_k),
                     "flash_attention",
-                    f"(Sq={Sq}, Sk={Sk}, D={D}) needs Sq%128 == Sk%128 == "
-                    f"D%128 == 0"):
+                    f"(Sq={Sq}, Sk={Sk}, D={D}, runs={n_runs_q}/{n_runs_k})"
+                    f" needs each run %128 == 0 and D%128 == 0"):
         out, lse = _flash_xla(q, k, v, causal=causal, scale=scale,
                               q_offset=q_offset, kv_offset=kv_offset,
                               k_scale=k_scale, v_scale=v_scale,
@@ -578,17 +644,31 @@ def flash_attention(q, k, v, *, causal=True, scale=None, q_offset=0,
     # 512 (longer MXU streams per grid step) and 2048+ (VMEM pressure
     # crowds the pipeline).  G*bq ~ 512 MXU rows balances group sizes.
     want_q = block_q or max(128, (512 // g) // 128 * 128)
-    bq = largest_divisor_block(Sq, want_q, 128)
-    bk = largest_divisor_block(Sk, block_k or 1024, 128)
+    # Blocks fit the RUN (== the whole axis for contiguous layouts).
+    bq = largest_divisor_block(seg_q, want_q, 128)
+    bk = largest_divisor_block(seg_k, block_k or 1024, 128)
 
     if quantized:
         # Lane-packed scale planes need (bk//128) % 8 == 0 or bk == Sk
-        # (the decode kernel's constraint); bump to the smallest legal
-        # divisor.  Forward-only — serving reads an int8 cache; training
-        # does not quantize K/V.
+        # (the decode kernel's constraint — the bk == Sk escape is
+        # WHOLE-ARRAY-block legality, so it does not apply to a segmented
+        # run); bump to the smallest legal divisor of the run.
+        # Forward-only — serving reads an int8 cache; training does not
+        # quantize K/V.
         if (bk // 128) % 8 and bk != Sk:
-            bk = next((c for c in range(bk, Sk, 128)
-                       if Sk % c == 0 and (c // 128) % 8 == 0), Sk)
+            legal = next((c for c in range(bk, seg_k + 1, 128)
+                          if seg_k % c == 0 and (c // 128) % 8 == 0), None)
+            if legal is None and n_runs_k == 1:
+                legal = Sk          # whole-array-block escape
+            if legal is None:
+                # Segmented run with no lane-pack-legal block: dense path.
+                out, lse = _flash_xla(
+                    q, k, v, causal=causal, scale=scale,
+                    q_offset=q_offset, kv_offset=kv_offset,
+                    k_scale=k_scale, v_scale=v_scale, soft_cap=soft_cap,
+                    window=window)
+                return (out, lse) if return_lse else out
+            bk = legal
         out, lse = _flash_pallas(q, k, v, q_offset, kv_offset, causal,
                                  float(scale), bq, bk, interpret,
                                  k_scale=k_scale, v_scale=v_scale,
@@ -597,8 +677,12 @@ def flash_attention(q, k, v, *, causal=True, scale=None, q_offset=0,
 
     def _static_int(x):
         """Any index-like (int, np.integer, concrete 0-d array) → int;
-        traced offsets → None (they ride scalar prefetch, raw path)."""
+        run-start tuples → tuple of ints (hashable for the custom-VJP
+        nondiff slot); traced offsets → None (they ride scalar prefetch,
+        raw path)."""
         try:
+            if isinstance(x, (tuple, list)):
+                return tuple(operator.index(e) for e in x)
             return operator.index(x)
         except TypeError:
             return None
@@ -621,14 +705,17 @@ def flash_attention(q, k, v, *, causal=True, scale=None, q_offset=0,
 def _flash_pallas(q, k, v, q_offset, kv_offset, causal, scale, bq, bk,
                   interpret, k_scale=None, v_scale=None, soft_cap=0.0,
                   window=0):
-    """The raw pallas_call: out [B, Hq, Sq, D] in q.dtype, lse f32."""
+    """The raw pallas_call: out [B, Hq, Sq, D] in q.dtype, lse f32.
+    ``q_offset``/``kv_offset``: scalar or tuple of run starts (segmented
+    layouts — the caller guarantees the run length divides by the block)."""
     B, Hq, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
     g = Hq // Hkv
     n_q, n_k = Sq // bq, Sk // bk
 
     qg = q.reshape(B, Hkv, g, Sq, D)
-    offs = jnp.array([q_offset, kv_offset], jnp.int32)
+    qoffs = _block_starts(_as_starts(q_offset), Sq, bq)
+    koffs = _block_starts(_as_starts(kv_offset), Sk, bk)
     quantized = k_scale is not None
     if quantized:
         kern = functools.partial(_flash_kernel_i8, bq=bq, bk=bk, n_k=n_k,
@@ -640,34 +727,34 @@ def _flash_pallas(q, k, v, q_offset, kv_offset, causal, scale, bq, bk,
                                  soft_cap=soft_cap, window=window)
     in_specs = [
         pl.BlockSpec((1, 1, g, bq, D),
-                     lambda b, h, i, j, offs: (b, h, 0, i, 0)),
+                     lambda b, h, i, j, qo, ko: (b, h, 0, i, 0)),
         pl.BlockSpec((1, 1, bk, D),
-                     lambda b, h, i, j, offs: (b, h, j, 0)),
+                     lambda b, h, i, j, qo, ko: (b, h, j, 0)),
         pl.BlockSpec((1, 1, bk, D),
-                     lambda b, h, i, j, offs: (b, h, j, 0)),
+                     lambda b, h, i, j, qo, ko: (b, h, j, 0)),
     ]
-    args = [offs, qg, k, v]
+    args = [qoffs, koffs, qg, k, v]
     if quantized:
         # Lane-packed [B, Hkv, Sk//128, 128] scale planes: each block's
         # bk scales are ONE dense [bk//128, 128] f32 transfer (the
         # decode kernel's layout — a [bk, 1] plane DMAs thousands of
         # strided 4-byte rows and measured 9x slower).
         sc_spec = pl.BlockSpec((1, 1, bk // 128, 128),
-                               lambda b, h, i, j, offs: (b, h, j, 0))
+                               lambda b, h, i, j, qo, ko: (b, h, j, 0))
         in_specs += [sc_spec, sc_spec]
         args += [k_scale.reshape(B, Hkv, Sk // 128, 128),
                  v_scale.reshape(B, Hkv, Sk // 128, 128)]
     out, lse = pl.pallas_call(
         kern,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=(B, Hkv, n_q, n_k),
             in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((1, 1, g, bq, D),
-                             lambda b, h, i, j, offs: (b, h, 0, i, 0)),
+                             lambda b, h, i, j, qo, ko: (b, h, 0, i, 0)),
                 pl.BlockSpec((1, 1, g, bq),
-                             lambda b, h, i, j, offs: (b, h, 0, i)),
+                             lambda b, h, i, j, qo, ko: (b, h, 0, i)),
             ],
             scratch_shapes=[
                 pltpu.VMEM((g, bq, D), jnp.float32),

@@ -304,3 +304,52 @@ def overlap_chunk_budget(M: int, N: int, K: int, world_size: int,
     ratio = ag_ms / max(gemm_ms, 1e-6)
     chunks = world_size if ratio >= 1.0 else max(2, round(world_size * ratio))
     return int(min(max_chunks, max(1, chunks)))
+
+
+# ---------------------------------------------------------------------------
+# Causal ring-attention schedules (zigzag balance, r5)
+# ---------------------------------------------------------------------------
+
+def ring_causal_step_work(world: int, zigzag: bool) -> list:
+    """Per-ring-step MXU work of the SLOWEST device (step time is the max
+    across devices — the ring is bulk-synchronous), in units of one full
+    S_loc x S_loc block pair.  Causal masking only; brute-force count of
+    (q-chunk, kv-chunk) visibility.
+
+    Contiguous layout: shard i = chunk i; at step s > 0 every device with
+    me >= s holds a strictly-past block -> full work 1.0, so EVERY step
+    costs a full block while the mean useful work is (w+1)/2w.
+
+    Zigzag layout: shard i = chunks (i, 2w-1-i) of half size; late
+    chunks are invisible to every early q chunk (2w-1-j >= w > i), and
+    exactly two of the remaining pair classes are live at every
+    (device, step) -> constant 0.5 per step, 100% chunk-granular balance.
+    """
+    chunks = ([(i, 2 * world - 1 - i) for i in range(world)] if zigzag
+              else [(i,) for i in range(world)])
+    per = len(chunks[0])
+    unit = 1.0 / per ** 2
+    out = []
+    for s in range(world):
+        worst = 0.0
+        for i in range(world):
+            j = (i - s) % world
+            w = 0.0
+            for qc in chunks[i]:
+                for kc in chunks[j]:
+                    if qc > kc:
+                        w += unit
+                    elif qc == kc:
+                        w += unit / 2
+            worst = max(worst, w)
+        out.append(worst)
+    return out
+
+
+def ring_causal_speedup(world: int) -> float:
+    """Predicted causal ring step-time speedup of zigzag over contiguous
+    (compute-bound regime): sum of per-step maxima.  Closed form
+    (w - 1/2) / (w/2) = 2 - 1/w -> 2x asymptotically."""
+    naive = sum(ring_causal_step_work(world, False))
+    zig = sum(ring_causal_step_work(world, True))
+    return naive / zig

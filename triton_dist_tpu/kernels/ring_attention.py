@@ -42,6 +42,21 @@ qpos >= kpos) and (not window or qpos - kpos < window), logits capped by
 ``soft_cap * tanh(logits / soft_cap)`` before masking.  Dead ring steps
 (blocks wholly outside every query's window) contribute lse = NEG
 partials, which the LSE merge treats as exact no-ops.
+
+ZIGZAG layout (``zigzag=True``, r5): causal ring attention with the
+naive contiguous layout is ~2x unbalanced — at ring step s, devices
+me >= s do FULL-block work while devices me < s consume wholly-future
+(dead) blocks, so every step costs a full block and utilization is
+(w+1)/2w.  The zigzag layout splits the global sequence into 2w chunks
+and gives rank i chunks (i, 2w-1-i) — one early, one late.  Late chunks
+are never visible to any early query chunk (2w-1-j >= w > i), and of
+the remaining three (q-chunk, kv-chunk) pair classes exactly two are
+live at EVERY (device, step): per-step work is a constant half-block,
+step time halves, and chunk-granular utilization is 100% for all
+world >= 2 (the standard zigzag/striped CP schedule; see
+docs/multichip_predictions.md).  Implemented via the flash kernels'
+segmented-offset support (each shard is two position runs riding the
+scalar-prefetch block-offset vectors) — same math, re-indexed.
 """
 
 from __future__ import annotations
@@ -74,6 +89,7 @@ class RingAttentionContext:
     interpret: bool = False
     window: int = 0
     soft_cap: float = 0.0
+    zigzag: bool = False
 
     @property
     def world(self) -> int:
@@ -81,11 +97,25 @@ class RingAttentionContext:
 
 
 def create_ring_attention_context(mesh, axis="sp", causal=True, impl="auto",
-                                  interpret=False, window=0,
-                                  soft_cap=0.0) -> RingAttentionContext:
+                                  interpret=False, window=0, soft_cap=0.0,
+                                  zigzag=False) -> RingAttentionContext:
     return RingAttentionContext(mesh=mesh, axis=axis, causal=causal,
                                 impl=impl, interpret=interpret,
-                                window=window, soft_cap=soft_cap)
+                                window=window, soft_cap=soft_cap,
+                                zigzag=zigzag)
+
+
+def _seg_positions(starts, idx, total):
+    """Global positions for row indices ``idx`` (int32 array, any shape)
+    of an axis made of len(starts) equal runs.  Pure arithmetic + where —
+    Mosaic-safe inside the fused ring kernel (no rank-1 iota, no gathers).
+    """
+    starts = starts if isinstance(starts, (tuple, list)) else (starts,)
+    run = total // len(starts)
+    pos = starts[0] + idx
+    for t in range(1, len(starts)):
+        pos = jnp.where(idx >= t * run, starts[t] + (idx - t * run), pos)
+    return pos
 
 
 def _block_update(q, k_blk, v_blk, m, l, acc, q_off, k_off, *, causal,
@@ -96,7 +126,8 @@ def _block_update(q, k_blk, v_blk, m, l, acc, q_off, k_off, *, causal,
     because Mosaic's matmul supports at most one batch dim, and placed
     first because it must be the leading dim: q [G, Sq, hd] with G = B*Hq;
     k/v [Gk, Sk, hd] (G = group*Gk); m/l [G, Sq]; acc [G, Sq, hd] f32;
-    q_off/k_off: global position of the first query/key row.
+    q_off/k_off: global position of the first query/key row — a scalar
+    (contiguous) or a tuple of run starts (zigzag: two runs per shard).
 
     Returns updated (m, l, acc).  This is the same merge the reference's
     decode combine does with per-rank LSEs (flash_decode.py:512-526), done
@@ -114,7 +145,8 @@ def _block_update(q, k_blk, v_blk, m, l, acc, q_off, k_off, *, causal,
         sq, sk = q.shape[1], k_blk.shape[1]
         rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        qpos, kpos = q_off + rows, k_off + cols
+        qpos = _seg_positions(q_off, rows, sq)
+        kpos = _seg_positions(k_off, cols, sk)
         # Three static branches, mirroring _visibility_mask (no all-true
         # bool array through Mosaic).
         if causal and window:
@@ -139,13 +171,13 @@ def _block_update(q, k_blk, v_blk, m, l, acc, q_off, k_off, *, causal,
 
 
 def _ring_attention_xla(q, k, v, *, axis, causal, scale, window=0,
-                        soft_cap=0.0):
+                        soft_cap=0.0, zigzag=False):
     world = jax.lax.axis_size(axis)
     me = jax.lax.axis_index(axis)
     s_loc = q.shape[0]
     b, hq, hd = q.shape[1], q.shape[2], q.shape[3]
     group = hq // k.shape[2]
-    q_off = me * s_loc
+    q_off = _shard_starts(me, s_loc, world, zigzag)
     perm = _ring_perm(world)
     upd = functools.partial(_block_update, causal=causal, scale=scale,
                             group=group, window=window, soft_cap=soft_cap)
@@ -168,7 +200,8 @@ def _ring_attention_xla(q, k, v, *, axis, causal, scale, window=0,
         k_blk = jax.lax.ppermute(k_blk, axis, perm)
         v_blk = jax.lax.ppermute(v_blk, axis, perm)
         src = _src_rank(me, s, world)
-        m, l, acc = upd(qg, k_blk, v_blk, m, l, acc, q_off, src * s_loc)
+        m, l, acc = upd(qg, k_blk, v_blk, m, l, acc, q_off,
+                        _shard_starts(src, s_loc, world, zigzag))
         return (k_blk, v_blk, m, l, acc), None
 
     (_, _, _, l, acc), _ = jax.lax.scan(
@@ -204,6 +237,46 @@ def _ring_perm(world):
     return [(i, (i + 1) % world) for i in range(world)]
 
 
+def _shard_starts(rank, s_loc, world, zigzag):
+    """Run starts of ``rank``'s sequence shard: one contiguous run, or
+    the zigzag pair — chunks ``rank`` and ``2w-1-rank``, each of length
+    s_loc//2.  ``rank`` may be traced (the tuple entries then are)."""
+    if not zigzag:
+        return (rank * s_loc,)
+    c = s_loc // 2
+    return (rank * c, (2 * world - 1 - rank) * c)
+
+
+def zigzag_indices(S, world):
+    """Global row permutation for the zigzag layout: position p of the
+    returned index array names the natural-order row that lands at p
+    when shards are laid out [shard0 | shard1 | ...] with shard i =
+    [chunk i | chunk 2w-1-i].  ``x[zigzag_indices(S, w)]`` re-orders a
+    natural-order array for zigzag sharding; the inverse permutation
+    (argsort) restores natural order."""
+    c = S // (2 * world)
+    if 2 * world * c != S:
+        raise ValueError(f"zigzag needs S % (2*world) == 0, got S={S}, "
+                         f"world={world}")
+    idx = []
+    for i in range(world):
+        idx.extend(range(i * c, (i + 1) * c))
+        j = 2 * world - 1 - i
+        idx.extend(range(j * c, (j + 1) * c))
+    return np.asarray(idx, np.int32)
+
+
+def to_zigzag(x, world, axis=0):
+    """Re-order a natural-order global array for zigzag sharding."""
+    return jnp.take(x, zigzag_indices(x.shape[axis], world), axis=axis)
+
+
+def from_zigzag(x, world, axis=0):
+    """Inverse of :func:`to_zigzag`."""
+    inv = np.argsort(zigzag_indices(x.shape[axis], world)).astype(np.int32)
+    return jnp.take(x, inv, axis=axis)
+
+
 def _src_rank(me, s, world):
     """Owner of the block a device consumes at ring step ``s`` (blocks
     flow with the ring, so step s sees rank me - s's block)."""
@@ -222,7 +295,7 @@ def _merge_partial(acc, denom, m_run, o_j, l_j):
 
 
 def _ring_attention_flash_fwd(q, k, v, *, axis, causal, scale, interpret,
-                              window=0, soft_cap=0.0):
+                              window=0, soft_cap=0.0, zigzag=False):
     """Returns (out [S_loc, B, Hq, hd] in q.dtype, lse [B, Hq, S_loc] f32)."""
     from triton_dist_tpu.kernels.flash_attention import flash_attention
 
@@ -232,16 +305,18 @@ def _ring_attention_flash_fwd(q, k, v, *, axis, causal, scale, interpret,
     q4 = q.transpose(1, 2, 0, 3)                       # [B, Hq, S, hd]
     k4 = k.transpose(1, 2, 0, 3)
     v4 = v.transpose(1, 2, 0, 3)
-    q_off = me * s_loc
+    q_off = _shard_starts(me, s_loc, world, zigzag)
 
     def partial_for(k_blk, v_blk, src):
         # Traced offsets -> the raw (non-diff) kernel path; the ring's own
-        # custom VJP owns differentiation.
+        # custom VJP owns differentiation.  Zigzag shards ride the
+        # kernels' segmented-offset vectors (two runs per side).
         return flash_attention(
             q4, k_blk, v_blk, causal=causal, scale=scale,
-            q_offset=q_off, kv_offset=src * s_loc, impl="pallas",
-            interpret=interpret, return_lse=True, window=window,
-            soft_cap=soft_cap)
+            q_offset=q_off,
+            kv_offset=_shard_starts(src, s_loc, world, zigzag),
+            impl="pallas", interpret=interpret, return_lse=True,
+            window=window, soft_cap=soft_cap)
 
     o0, l0 = partial_for(k4, v4, me)                   # local block
     acc, denom, m_run = (o0.astype(jnp.float32),
@@ -265,7 +340,8 @@ def _ring_attention_flash_fwd(q, k, v, *, axis, causal, scale, interpret,
 
 
 def _ring_attention_flash_bwd(q, k, v, out, lse, do, *, axis, causal,
-                              scale, interpret, window=0, soft_cap=0.0):
+                              scale, interpret, window=0, soft_cap=0.0,
+                              zigzag=False):
     """Reverse ring: per visiting block run the flash backward kernels
     against the GLOBAL lse; dk/dv accumulators rotate with the blocks and
     take one final hop home."""
@@ -279,15 +355,16 @@ def _ring_attention_flash_bwd(q, k, v, out, lse, do, *, axis, causal,
     v4 = v.transpose(1, 2, 0, 3)
     out4 = out.transpose(1, 2, 0, 3)
     do4 = do.transpose(1, 2, 0, 3)
-    q_off = me * s_loc
+    q_off = _shard_starts(me, s_loc, world, zigzag)
 
     def block_grads(k_blk, v_blk, src):
         # grad_dtype=f32: per-block summands stay f32 all the way into the
         # ring accumulation — casting to bf16 per block would round each
         # of the W contributions before the f32 sum.
         return _flash_bwd_pallas(q4, k_blk, v_blk, out4, lse, do4,
-                                 q_off, src * s_loc, causal, scale,
-                                 interpret, window=window,
+                                 q_off,
+                                 _shard_starts(src, s_loc, world, zigzag),
+                                 causal, scale, interpret, window=window,
                                  soft_cap=soft_cap,
                                  grad_dtype=jnp.float32)
 
@@ -333,7 +410,7 @@ def _ring_attn_kernel(q_ref, k_ref, v_ref, o_ref, kring_ref, vring_ref,
                       q_vmem, k_vmem, v_vmem,
                       send_sem, recv_sem, copy_sem, credit_sem,
                       *, axis, world, causal, scale, hq, hkv, hd,
-                      window=0, soft_cap=0.0):
+                      window=0, soft_cap=0.0, zigzag=False):
     """Double-buffered ring: slot s%2 is consumed while being forwarded to
     the right neighbor's slot (s+1)%2.  kring/vring: [2, G_kv, S_loc*hd] HBM;
     blocks stage through VMEM scratch for the VPU/MXU compute.
@@ -361,7 +438,7 @@ def _ring_attn_kernel(q_ref, k_ref, v_ref, o_ref, kring_ref, vring_ref,
 
     g_q = q_ref.shape[0]
     q = q_vmem[...].reshape(g_q, s_loc, hd)
-    q_off = me * s_loc
+    q_off = _shard_starts(me, s_loc, world, zigzag)
 
     m = jnp.full((g_q, s_loc), _NEG, jnp.float32)
     l = jnp.zeros((g_q, s_loc), jnp.float32)
@@ -394,7 +471,8 @@ def _ring_attn_kernel(q_ref, k_ref, v_ref, o_ref, kring_ref, vring_ref,
         v_blk = v_vmem[...].reshape(g_kv, s_loc, hd)
         src = _src_rank(me, s, world)
         m, l, acc = _block_update(q, k_blk, v_blk, m, l, acc, q_off,
-                                  src * s_loc, causal=causal, scale=scale,
+                                  _shard_starts(src, s_loc, world, zigzag),
+                                  causal=causal, scale=scale,
                                   group=group, window=window,
                                   soft_cap=soft_cap)
 
@@ -419,7 +497,7 @@ def _ring_attn_kernel(q_ref, k_ref, v_ref, o_ref, kring_ref, vring_ref,
 
 
 def _ring_attention_pallas_fwd(q, k, v, *, axis, causal, scale, interpret,
-                               window=0, soft_cap=0.0):
+                               window=0, soft_cap=0.0, zigzag=False):
     world = jax.lax.axis_size(axis)
     s_loc, b, hq, hd = q.shape
     hkv = k.shape[2]
@@ -433,7 +511,8 @@ def _ring_attention_pallas_fwd(q, k, v, *, axis, causal, scale, interpret,
     out, _, _ = pl.pallas_call(
         functools.partial(_ring_attn_kernel, axis=axis, world=world,
                           causal=causal, scale=scale, hq=hq, hkv=hkv,
-                          hd=hd, window=window, soft_cap=soft_cap),
+                          hd=hd, window=window, soft_cap=soft_cap,
+                          zigzag=zigzag),
         out_shape=[
             jax.ShapeDtypeStruct(q2.shape, q.dtype),
             jax.ShapeDtypeStruct((2,) + k2.shape, k.dtype),  # k ring slots
@@ -462,49 +541,54 @@ def _ring_attention_pallas_fwd(q, k, v, *, axis, causal, scale, interpret,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def _ring_attention_diff(q, k, v, axis, causal, scale, impl, interpret,
-                         window, soft_cap):
+                         window, soft_cap, zigzag):
     if impl == "flash":
         return _ring_attention_flash_fwd(q, k, v, axis=axis, causal=causal,
                                          scale=scale, interpret=interpret,
-                                         window=window,
-                                         soft_cap=soft_cap)[0]
+                                         window=window, soft_cap=soft_cap,
+                                         zigzag=zigzag)[0]
     if impl == "pallas":
         return _ring_attention_pallas_fwd(q, k, v, axis=axis, causal=causal,
                                           scale=scale, interpret=interpret,
-                                          window=window, soft_cap=soft_cap)
+                                          window=window, soft_cap=soft_cap,
+                                          zigzag=zigzag)
     return _ring_attention_xla(q, k, v, axis=axis, causal=causal,
                                scale=scale, window=window,
-                               soft_cap=soft_cap)
+                               soft_cap=soft_cap, zigzag=zigzag)
 
 
 def _ring_diff_fwd(q, k, v, axis, causal, scale, impl, interpret, window,
-                   soft_cap):
+                   soft_cap, zigzag):
     if impl == "flash":
         out, lse = _ring_attention_flash_fwd(
             q, k, v, axis=axis, causal=causal, scale=scale,
-            interpret=interpret, window=window, soft_cap=soft_cap)
+            interpret=interpret, window=window, soft_cap=soft_cap,
+            zigzag=zigzag)
         return out, (q, k, v, out, lse)
     out = _ring_attention_diff(q, k, v, axis, causal, scale, impl,
-                               interpret, window, soft_cap)
+                               interpret, window, soft_cap, zigzag)
     return out, (q, k, v, None, None)
 
 
 def _ring_diff_bwd(axis, causal, scale, impl, interpret, window, soft_cap,
-                   res, dout):
+                   zigzag, res, dout):
     q, k, v, out, lse = res
     if impl == "flash":
         # Reverse ring over the flash backward kernels with the global
         # lse — O(block) memory end to end.
         return _ring_attention_flash_bwd(
             q, k, v, out, lse, dout, axis=axis, causal=causal, scale=scale,
-            interpret=interpret, window=window, soft_cap=soft_cap)
+            interpret=interpret, window=window, soft_cap=soft_cap,
+            zigzag=zigzag)
     # Backward = VJP of the numerically-identical xla ring (flash-style
     # recompute; the transposed scan runs the ring in reverse).
     _, vjp = jax.vjp(
         functools.partial(_ring_attention_xla, axis=axis, causal=causal,
-                          scale=scale, window=window, soft_cap=soft_cap),
+                          scale=scale, window=window, soft_cap=soft_cap,
+                          zigzag=zigzag),
         q, k, v)
     return vjp(dout)
 
@@ -514,7 +598,7 @@ _ring_attention_diff.defvjp(_ring_diff_fwd, _ring_diff_bwd)
 
 def ring_attention_shard(q, k, v, *, axis, causal=True, scale=None,
                          impl="auto", interpret=False, window=0,
-                         soft_cap=0.0):
+                         soft_cap=0.0, zigzag=False):
     """Shard-level causal GQA ring attention; call inside shard_map.
 
     q [S_loc, B, Hq, hd]; k/v [S_loc, B, Hkv, hd] — sequence sharded over
@@ -530,6 +614,13 @@ def ring_attention_shard(q, k, v, *, axis, causal=True, scale=None,
     ``window``/``soft_cap`` (Mistral sliding window / Gemma-2 logit cap)
     apply the flash kernels' visibility rule across the ring; all impls
     and both passes honor them.
+
+    ``zigzag=True``: the shard holds chunks ``me`` and ``2w-1-me`` of a
+    2w-chunk global split (use :func:`to_zigzag` on the global sequence
+    before sharding) — balances causal work so every ring step costs a
+    half block (~2x step time at world >= 4; see module docstring).
+    Requires ``causal=True`` and an even S_loc; flash legality then needs
+    S_loc % 256 == 0 (each run tiles by 128).
     """
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
@@ -537,17 +628,25 @@ def ring_attention_shard(q, k, v, *, axis, causal=True, scale=None,
     from triton_dist_tpu.kernels.gemm import PallasShapeError
 
     s_loc, hd = q.shape[0], q.shape[3]
-    legal = flash_shapes_ok(s_loc, s_loc, hd)
+    if zigzag:
+        if not causal:
+            raise ValueError("zigzag layout only balances CAUSAL ring "
+                             "attention; use the contiguous layout")
+        if s_loc % 2:
+            raise ValueError(f"zigzag needs an even S_loc, got {s_loc}")
+    n_runs = 2 if zigzag else 1
+    legal = flash_shapes_ok(s_loc, s_loc, hd, n_runs, n_runs)
     raw = impl
     impl = resolve_impl(impl, interpret)
     if raw == "auto" and impl == "pallas" and legal:
         impl = "flash"
     if raw == "flash" and not legal:
         raise PallasShapeError(
-            f"ring_attention impl='flash': (S_loc={s_loc}, hd={hd}) needs "
-            f"S_loc % 128 == hd % 128 == 0")
+            f"ring_attention impl='flash': (S_loc={s_loc}, hd={hd}, "
+            f"zigzag={zigzag}) needs (S_loc/runs) % 128 == hd % 128 == 0")
     return _ring_attention_diff(q, k, v, axis, causal, float(scale), impl,
-                                interpret, int(window), float(soft_cap))
+                                interpret, int(window), float(soft_cap),
+                                bool(zigzag))
 
 
 def ring_attention(q, k, v, ctx: RingAttentionContext):
@@ -559,5 +658,6 @@ def ring_attention(q, k, v, ctx: RingAttentionContext):
         P(ctx.axis),
         axis=ctx.axis, causal=ctx.causal, impl=ctx.impl,
         interpret=ctx.interpret, window=ctx.window, soft_cap=ctx.soft_cap,
+        zigzag=ctx.zigzag,
     )
     return fn(q, k, v)

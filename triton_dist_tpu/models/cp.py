@@ -7,13 +7,19 @@ activations stay ``[S_loc, B, D]`` through every block, weights are
 replicated, and attention crosses the shards through either SP scheme:
 
 * ``attn="ring"``   — KV blocks rotate the ring (kernels/ring_attention.py);
-  memory-light, works for any head count.
+  memory-light, works for any head count.  Defaults to the ZIGZAG
+  sequence layout (rank i holds chunks i and 2w-1-i) whenever
+  S % (2*world) == 0 — the causal work balancer that halves ring step
+  time (ring_attention.py module docstring); tokens/targets are
+  permuted into zigzag order at the jit boundary and logits permuted
+  back, so the public contract stays natural-order.
 * ``attn="ulysses"`` — head-scatter AllToAll (kernels/ulysses_attention.py);
   communication independent of world size, needs heads % world == 0.
 
 Composes with a ``dp`` axis the usual way (batch sharding + gradient
-psum).  RoPE uses global positions (each shard offsets by its rank), so
-the sharded model is bit-for-bit the same function as the unsharded one.
+psum).  RoPE uses global positions (each shard offsets by its rank — the
+zigzag shard offsets each of its two chunks), so the sharded model is
+bit-for-bit the same function as the unsharded one.
 """
 
 from __future__ import annotations
@@ -22,9 +28,14 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from triton_dist_tpu.kernels.ring_attention import ring_attention_shard
+from triton_dist_tpu.kernels.ring_attention import (
+    from_zigzag,
+    ring_attention_shard,
+    to_zigzag,
+)
 from triton_dist_tpu.kernels.ulysses_attention import ulysses_attention_shard
 from triton_dist_tpu.models.llama import (
     LlamaConfig,
@@ -42,12 +53,20 @@ def cp_param_specs(cfg: LlamaConfig) -> dict:
 
 
 def _cp_attention_block(x, layer, cfg: LlamaConfig, *, axis, attn, impl,
-                        interpret):
+                        interpret, zigzag=False):
     """Attention with sequence-sharded activations and replicated weights."""
     s_loc, b, _ = x.shape
     me = jax.lax.axis_index(axis)
     hd = cfg.head_dim
-    positions = me * s_loc + jnp.arange(s_loc, dtype=jnp.int32)
+    if zigzag:
+        # Shard = chunks (me, 2w-1-me): RoPE positions follow the layout.
+        c = s_loc // 2
+        world = jax.lax.axis_size(axis)
+        base = jnp.arange(c, dtype=jnp.int32)
+        positions = jnp.concatenate(
+            [me * c + base, (2 * world - 1 - me) * c + base])
+    else:
+        positions = me * s_loc + jnp.arange(s_loc, dtype=jnp.int32)
 
     h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
     h2 = h.reshape(s_loc * b, cfg.dim)
@@ -57,20 +76,26 @@ def _cp_attention_block(x, layer, cfg: LlamaConfig, *, axis, attn, impl,
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
 
-    attn_fn = (ring_attention_shard if attn == "ring"
-               else ulysses_attention_shard)
-    o = attn_fn(q, k, v, axis=axis, causal=True, impl=impl,
-                interpret=interpret, window=cfg.attn_window,
-                soft_cap=cfg.attn_soft_cap)
+    if attn == "ring":
+        o = ring_attention_shard(q, k, v, axis=axis, causal=True, impl=impl,
+                                 interpret=interpret, window=cfg.attn_window,
+                                 soft_cap=cfg.attn_soft_cap, zigzag=zigzag)
+    else:
+        assert not zigzag, "zigzag layout applies to attn='ring' only"
+        o = ulysses_attention_shard(q, k, v, axis=axis, causal=True,
+                                    impl=impl, interpret=interpret,
+                                    window=cfg.attn_window,
+                                    soft_cap=cfg.attn_soft_cap)
     o2 = o.reshape(s_loc * b, cfg.n_heads * hd)
     return x + (o2 @ layer["wo"]).reshape(s_loc, b, cfg.dim)
 
 
-def _cp_layer(x, layer, cfg: LlamaConfig, *, axis, attn, impl, interpret):
+def _cp_layer(x, layer, cfg: LlamaConfig, *, axis, attn, impl, interpret,
+              zigzag=False):
     """One decoder layer (SP attention + local MLP) on x [S_loc, B, D]."""
     s_loc, b, _ = x.shape
     x = _cp_attention_block(x, layer, cfg, axis=axis, attn=attn,
-                            impl=impl, interpret=interpret)
+                            impl=impl, interpret=interpret, zigzag=zigzag)
     h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
     h2 = h.reshape(s_loc * b, cfg.dim)
     act = (jax.nn.silu((h2 @ layer["wgate"]).astype(jnp.float32))
@@ -80,15 +105,17 @@ def _cp_layer(x, layer, cfg: LlamaConfig, *, axis, attn, impl, interpret):
 
 def cp_forward_shard(params, tokens_shard, cfg: LlamaConfig, *, axis,
                      attn="ring", impl="auto", interpret=False,
-                     remat=False):
-    """tokens_shard [S_loc, B] (sequence sharded).  Local MLP, SP attention.
+                     remat=False, zigzag=False):
+    """tokens_shard [S_loc, B] (sequence sharded; zigzag chunk order when
+    ``zigzag``).  Local MLP, SP attention.
 
     ``remat=True`` wraps each layer in ``jax.checkpoint``: the backward
     pass recomputes the layer (including its ring/Ulysses communication)
     instead of stashing activations — the standard memory/FLOPs trade for
     long-context training, where per-layer activations dominate HBM."""
     layer_fn = functools.partial(_cp_layer, cfg=cfg, axis=axis, attn=attn,
-                                 impl=impl, interpret=interpret)
+                                 impl=impl, interpret=interpret,
+                                 zigzag=zigzag)
     if remat:
         layer_fn = jax.checkpoint(layer_fn)
     x = params["embed"][tokens_shard]
@@ -98,55 +125,109 @@ def cp_forward_shard(params, tokens_shard, cfg: LlamaConfig, *, axis,
     return jnp.dot(x, params["lm_head"], preferred_element_type=jnp.float32)
 
 
+def _pick_zigzag(zigzag, attn, S, world):
+    """Auto rule (``zigzag=None``): zigzag whenever it applies — ring
+    attention, causal, and S splitting into 2*world chunks.  world 1 gains
+    nothing, so skip the permutation there.  Explicit ``zigzag=True`` is
+    validated here (a ValueError, not a traced assert)."""
+    if zigzag is None:
+        return attn == "ring" and world > 1 and S % (2 * world) == 0
+    if zigzag:
+        if attn != "ring":
+            raise ValueError("zigzag layout applies to attn='ring' only "
+                             f"(got attn={attn!r})")
+        if S % (2 * world):
+            raise ValueError(f"zigzag needs S % (2*world) == 0, got "
+                             f"S={S}, world={world}")
+    return bool(zigzag)
+
+
 def make_cp_train_step(cfg: LlamaConfig, mesh: Mesh, *, axis="cp",
                        dp_axis=None, attn="ring", impl="auto",
-                       interpret=False, lr=1e-3, remat=False):
+                       interpret=False, lr=1e-3, remat=False, zigzag=None):
     """SGD step for the CP mode.  Gradients: every leaf is replicated, so
-    psum over the cp axis (each shard saw only its sequence chunk) and dp."""
+    psum over the cp axis (each shard saw only its sequence chunk) and dp.
+
+    ``zigzag`` (default auto): ring CP uses the load-balanced zigzag
+    sequence layout; tokens/targets are permuted at the jit boundary
+    (cross-entropy is permutation-invariant, so the loss and gradients
+    are bit-for-bit those of the natural order)."""
     specs = cp_param_specs(cfg)
     batch_spec = P(axis, dp_axis) if dp_axis else P(axis)
     all_axes = (axis,) if dp_axis is None else (axis, dp_axis)
+    world = mesh.shape[axis]
 
-    def loss_shard(params, tokens, targets):
-        logits = cp_forward_shard(params, tokens, cfg, axis=axis, attn=attn,
-                                  impl=impl, interpret=interpret,
-                                  remat=remat)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(
-            logp, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
-        denom = ll.size * jax.lax.axis_size(axis)
-        if dp_axis is not None:
-            denom = denom * jax.lax.axis_size(dp_axis)
-        return -jnp.sum(ll) / denom
+    def build(zz):
+        def loss_shard(params, tokens, targets):
+            logits = cp_forward_shard(params, tokens, cfg, axis=axis,
+                                      attn=attn, impl=impl,
+                                      interpret=interpret, remat=remat,
+                                      zigzag=zz)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ll = jnp.take_along_axis(
+                logp, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
+            denom = ll.size * jax.lax.axis_size(axis)
+            if dp_axis is not None:
+                denom = denom * jax.lax.axis_size(dp_axis)
+            return -jnp.sum(ll) / denom
 
-    def step_shard(params, tokens, targets):
-        local_loss, grads = jax.value_and_grad(loss_shard)(
-            params, tokens, targets)
-        loss = jax.lax.psum(local_loss, all_axes)
-        grads = jax.tree.map(lambda g: jax.lax.psum(g, all_axes), grads)
-        new_params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype),
-                                  params, grads)
-        return new_params, loss
+        def step_shard(params, tokens, targets):
+            local_loss, grads = jax.value_and_grad(loss_shard)(
+                params, tokens, targets)
+            loss = jax.lax.psum(local_loss, all_axes)
+            grads = jax.tree.map(lambda g: jax.lax.psum(g, all_axes), grads)
+            new_params = jax.tree.map(
+                lambda p, g: p - lr * g.astype(p.dtype), params, grads)
+            return new_params, loss
 
-    fn = jax.shard_map(
-        step_shard, mesh=mesh,
-        in_specs=(specs, batch_spec, batch_spec),
-        out_specs=(specs, P()),
-        check_vma=False,
-    )
-    return jax.jit(fn), specs
+        return jax.shard_map(
+            step_shard, mesh=mesh,
+            in_specs=(specs, batch_spec, batch_spec),
+            out_specs=(specs, P()),
+            check_vma=False,
+        )
+
+    fns = {}
+
+    def step(params, tokens, targets):
+        zz = _pick_zigzag(zigzag, attn, tokens.shape[0], world)
+        if zz not in fns:
+            fns[zz] = build(zz)
+        if zz:
+            tokens = to_zigzag(tokens, world)
+            targets = to_zigzag(targets, world)
+        return fns[zz](params, tokens, targets)
+
+    return jax.jit(step), specs
 
 
 def make_cp_forward(cfg: LlamaConfig, mesh: Mesh, *, axis="cp", attn="ring",
-                    impl="auto", interpret=False):
+                    impl="auto", interpret=False, zigzag=None):
+    """Full-sequence logits in NATURAL order (any zigzag permutation is
+    applied to tokens and inverted on the logits inside the jit)."""
     specs = cp_param_specs(cfg)
-    fn = jax.shard_map(
-        functools.partial(cp_forward_shard, cfg=cfg, axis=axis, attn=attn,
-                          impl=impl, interpret=interpret),
-        mesh=mesh, in_specs=(specs, P(axis)), out_specs=P(axis),
-        check_vma=False,
-    )
-    return jax.jit(fn)
+    world = mesh.shape[axis]
+
+    def build(zz):
+        return jax.shard_map(
+            functools.partial(cp_forward_shard, cfg=cfg, axis=axis,
+                              attn=attn, impl=impl, interpret=interpret,
+                              zigzag=zz),
+            mesh=mesh, in_specs=(specs, P(axis)), out_specs=P(axis),
+            check_vma=False,
+        )
+
+    fns = {}
+
+    def fwd(params, tokens):
+        zz = _pick_zigzag(zigzag, attn, tokens.shape[0], world)
+        if zz not in fns:
+            fns[zz] = build(zz)
+        if not zz:
+            return fns[zz](params, tokens)
+        return from_zigzag(fns[zz](params, to_zigzag(tokens, world)), world)
+
+    return jax.jit(fwd)
 
 
 def place_cp_params(params, cfg: LlamaConfig, mesh: Mesh) -> dict:
