@@ -121,6 +121,12 @@ def main():
         gqa_decode_shard, mesh, 4, impl="pallas",
         interpret=False)(q, kc, vc, lens))
 
+    # 7a'. windowed decode — the [2, B] lens prefetch layout (r5: the SP
+    # window_lens plumbing) on hardware
+    check("flash_decode_win", lambda: _shard1(
+        gqa_decode_shard, mesh, 4, impl="pallas", interpret=False,
+        window=300)(q, kc, vc, lens))
+
     # 7b. int8-KV decode kernel (lane-packed scale planes — r4)
     from triton_dist_tpu.kernels.flash_decode import quantize_kv
     kq8, ks8 = quantize_kv(kc.astype(jnp.float32))

@@ -36,14 +36,17 @@ def test_cp_forward_matches_unsharded(mesh_cp, key, attn):
     np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-4)
 
 
-@pytest.mark.parametrize("attn", ["ring", "ulysses"])
-def test_cp_train_step_learns(mesh_cp, key, attn):
+@pytest.mark.parametrize("attn,zigzag", [("ring", None), ("ring", True),
+                                         ("ulysses", None)])
+def test_cp_train_step_learns(mesh_cp, key, attn, zigzag):
+    """zigzag=True forces the balanced layout (the auto rule reserves it
+    for flash-viable shapes; correctness holds on every impl)."""
     cfg = LlamaConfig.tiny()
     params = CP.place_cp_params(init_params(cfg, key), cfg, mesh_cp)
     tokens = jax.random.randint(jax.random.key(2), (64, 2), 0, cfg.vocab)
     targets = jnp.roll(tokens, -1, axis=0)
     step, _ = CP.make_cp_train_step(cfg, mesh_cp, attn=attn, impl="xla",
-                                    interpret=True, lr=0.5)
+                                    interpret=True, lr=0.5, zigzag=zigzag)
     losses = []
     for _ in range(4):
         params, loss = step(params, tokens, targets)
@@ -68,7 +71,9 @@ def test_cp_with_dp(key):
 @pytest.mark.parametrize("attn", ["ring", "ulysses"])
 def test_cp_window_softcap_matches_unsharded(mesh_cp, key, attn):
     """Mistral/Gemma-2 knobs under context parallelism (the r4 advisor
-    finding: CP used to silently drop them): sharded forward == world-1."""
+    finding: CP used to silently drop them): sharded forward == world-1.
+    Ring runs the ZIGZAG layout explicitly so window+cap are exercised
+    across the re-indexed shards too."""
     import dataclasses
 
     cfg = dataclasses.replace(LlamaConfig.tiny(), attn_window=24,
@@ -77,7 +82,8 @@ def test_cp_window_softcap_matches_unsharded(mesh_cp, key, attn):
     tokens = jax.random.randint(jax.random.key(4), (64, 2), 0, cfg.vocab)
 
     fwd = CP.make_cp_forward(cfg, mesh_cp, attn=attn, impl="xla",
-                             interpret=True)
+                             interpret=True,
+                             zigzag=True if attn == "ring" else None)
     got = np.asarray(fwd(CP.place_cp_params(params, cfg, mesh_cp), tokens))
     want = _unsharded_logits(params, tokens, cfg)
     np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-4)

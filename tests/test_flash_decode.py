@@ -288,6 +288,71 @@ def test_soft_cap_xla_fallback(key):
                                rtol=2e-2, atol=2e-2)
 
 
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_sliding_window_sp_decode(impl, key):
+    """r5: the GLOBAL window rule under SP sharding (world 4).  Lengths
+    chosen so the window straddles a shard boundary on row 0 and leaves
+    shard 0 FULLY outside on row 1 (its partial must no-op in the
+    combine); shards past the length stay all-masked as before."""
+    from triton_dist_tpu.layers.sp_flash_decode import (
+        SpGQAFlashDecodeAttention)
+
+    W = 4
+    mesh = Mesh(np.array(jax.devices()[:W]), ("sp",))
+    B, Hq, Hkv, D, w = 2, 4, 2, 128, 160
+    S = W * 128
+    q, k, v = make_inputs(jax.random.key(7), B, Hq, Hkv, S, D)
+    lens = jnp.array([S, 300], jnp.int32)
+    # row 0: window [352, 512) — shard 2 partial, shard 3 live
+    # row 1: window [140, 300) — shard 0 wholly outside, 1 partial,
+    #        2 partial-by-length, 3 wholly past the length
+
+    g = Hq // Hkv
+    logits = jnp.einsum("bhgd,bhsd->bhgs",
+                        q.reshape(B, Hkv, g, D), k) / np.sqrt(D)
+    pos = jnp.arange(S)[None, :]
+    valid = (pos < lens[:, None]) & (pos >= lens[:, None] - w)
+    logits = jnp.where(valid[:, None, None], logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    want = jnp.einsum("bhgs,bhsd->bhgd", p, v).reshape(B, Hq, D)
+
+    ctx = create_sp_decode_context(mesh, axis="sp", block_s=128, impl=impl,
+                                   interpret=(impl == "pallas"), window=w)
+    sh = NamedSharding(mesh, P(None, None, "sp"))
+    out = sp_gqa_decode(q, jax.device_put(k, sh), jax.device_put(v, sh),
+                        lens, ctx)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    # int8 cache through the layer (window + SP + quantized combine)
+    layer = SpGQAFlashDecodeAttention(mesh, axis="sp", impl=impl,
+                                      interpret=(impl == "pallas"),
+                                      kv_dtype=jnp.int8, window=w)
+    kc, vc = layer.init_cache(B, Hkv, S, D, dtype=jnp.float32,
+                              k_init=k, v_init=v)
+    out_i8 = layer(q, kc, vc, lens)
+    np.testing.assert_allclose(np.asarray(out_i8), np.asarray(want),
+                               rtol=2e-2, atol=2e-2)
+
+    # paged pools (window + SP + block_table)
+    layer_p = SpGQAFlashDecodeAttention(mesh, axis="sp", impl=impl,
+                                        interpret=(impl == "pallas"),
+                                        window=w)
+    pk, pv, table = layer_p.init_paged_cache(B, Hkv, 128, S // 128, D,
+                                             dtype=jnp.float32)
+    # fill pools through the table layout: logical page i of batch b
+    for b in range(B):
+        for i in range(S // 128):
+            row = int(table[b, i])
+            pk = pk.at[row].set(k[b, :, i * 128:(i + 1) * 128])
+            pv = pv.at[row].set(v[b, :, i * 128:(i + 1) * 128])
+    out_pg = layer_p(q, jax.device_put(pk, layer_p.pool_sharding()),
+                     jax.device_put(pv, layer_p.pool_sharding()),
+                     lens, block_table=table)
+    np.testing.assert_allclose(np.asarray(out_pg), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_sliding_window_decode(key):
     """Window decode across bf16/int8/paged variants vs a directly
     windowed dense oracle (query at llen-1 sees the last `window` keys;

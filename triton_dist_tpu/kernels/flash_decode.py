@@ -95,14 +95,25 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    llen = lens_ref[b]  # valid KV rows in *this shard* for batch b
+    # With a window the lens operand is [2, B]: row 0 the CLIPPED valid
+    # rows of this shard, row 1 the UNCLIPPED local end position
+    # (kv_len - shard offset) whose last ``window`` rows are visible —
+    # the global window rule evaluated in shard coordinates (r5: windowed
+    # decode composes with SP; a shard wholly outside the window masks
+    # everything and its lse = NEG partial no-ops in the combine).
+    if window:
+        llen = lens_ref[0, b]
+        wlen = lens_ref[1, b]
+    else:
+        llen = lens_ref[b]  # valid KV rows in *this shard* for batch b
+        wlen = llen
 
     # Chunks entirely past the valid length — or, with a sliding window,
     # entirely before it — are compute-skipped (their DMAs still stream
     # in; the pipeline cannot be shortened data-dependently).
     live = s * block_s < llen
     if window:
-        live = live & ((s + 1) * block_s > llen - window)
+        live = live & ((s + 1) * block_s > wlen - window)
 
     @pl.when(live)
     def _():
@@ -124,9 +135,9 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
             jnp.int32, logits.shape, 1)
         valid = pos < llen
         if window:
-            # the decode query sits at position llen-1: only the last
-            # ``window`` keys are visible
-            valid = valid & (pos >= llen - window)
+            # the decode query sits at global end-1 (local wlen-1): only
+            # the last ``window`` keys are visible
+            valid = valid & (pos >= wlen - window)
         logits = jnp.where(valid, logits, NEG_INF)
 
         m_cur = m_ref[:]                                        # [G, 128]
@@ -175,10 +186,15 @@ def _decode_kernel_i8(lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    llen = lens_ref[b]
+    if window:  # [2, B] lens layout — see _decode_kernel
+        llen = lens_ref[0, b]
+        wlen = lens_ref[1, b]
+    else:
+        llen = lens_ref[b]
+        wlen = llen
     live = s * block_s < llen
     if window:
-        live = live & ((s + 1) * block_s > llen - window)
+        live = live & ((s + 1) * block_s > wlen - window)
 
     @pl.when(live)
     def _():
@@ -201,7 +217,7 @@ def _decode_kernel_i8(lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
             jnp.int32, logits.shape, 1)
         valid = pos < llen
         if window:
-            valid = valid & (pos >= llen - window)
+            valid = valid & (pos >= wlen - window)
         logits = jnp.where(valid, logits, NEG_INF)
 
         m_cur = m_ref[:]
@@ -229,7 +245,8 @@ def _decode_kernel_i8(lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
 
 
 def _local_decode_xla(q, k, v, local_lens, *, scale, k_scale=None,
-                      v_scale=None, soft_cap=0.0, window=0):
+                      v_scale=None, soft_cap=0.0, window=0,
+                      window_lens=None):
     """Dense fallback for ragged shapes / non-TPU (reference analog: the
     non-TMA dispatch path).  Same (out, lse) contract as the Pallas kernel.
 
@@ -249,8 +266,8 @@ def _local_decode_xla(q, k, v, local_lens, *, scale, k_scale=None,
     logits = apply_soft_cap(logits, soft_cap)
     valid = jnp.arange(S)[None, :] < local_lens[:, None]        # [B, S]
     if window:
-        valid = valid & (jnp.arange(S)[None, :]
-                         >= local_lens[:, None] - window)
+        wl = local_lens if window_lens is None else window_lens
+        valid = valid & (jnp.arange(S)[None, :] >= wl[:, None] - window)
     logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
     m = jnp.max(logits, axis=-1)                                # [B, Hkv, g]
     # All-masked rows: keep everything finite, flag via lse = NEG_INF.
@@ -318,7 +335,7 @@ def quantize_kv(x):
 @_register_aot()
 def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
                      interpret=False, k_scale=None, v_scale=None,
-                     soft_cap=0.0, window=0):
+                     soft_cap=0.0, window=0, window_lens=None):
     """Single-shard GQA decode: q [B, Hq, D], k/v [B, Hkv, S_loc, D],
     local_lens [B] (valid rows in this shard).  Returns float32 partials
     (out [B, Hq, D], lse [B, Hq]).
@@ -328,10 +345,12 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
 
     ``window`` (sliding-window attention, Mistral-style): only the last
     ``window`` keys are visible to the decode query; chunks wholly
-    outside the window are compute-skipped.  SINGLE-SHARD semantics —
-    the window is relative to this shard's ``local_lens`` (a window
-    bounds the live cache, which is precisely when sequence-parallel KV
-    sharding is unnecessary).
+    outside the window are compute-skipped.  ``window_lens`` [B] gives
+    the UNCLIPPED local end position (kv_len - shard offset) so an SP
+    caller evaluates the GLOBAL window in shard coordinates (rows
+    >= window_lens - window are visible; default: local_lens — the
+    world-1 rule).  A shard wholly outside the window reports
+    lse = NEG_INF partials, which the inter-rank combine ignores.
 
     ``impl`` note: decode is HBM-bandwidth-bound (stream the KV cache
     once).  Since round 2's kernel tuning (K/V fed to the MXU in their
@@ -366,7 +385,8 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
         # reroute before the kernel existed).
         return _local_decode_xla(q, k, v, local_lens, scale=scale,
                                  k_scale=k_scale, v_scale=v_scale,
-                                 soft_cap=soft_cap, window=window)
+                                 soft_cap=soft_cap, window=window,
+                                 window_lens=window_lens)
 
     defaulted = block_s is None
     if defaulted:
@@ -426,10 +446,17 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
                     f"{need} with 4*bs*D*itemsize <= 12 MiB)")
             return _local_decode_xla(q, k, v, local_lens, scale=scale,
                                      k_scale=k_scale, v_scale=v_scale,
-                                     soft_cap=soft_cap, window=window)
+                                     soft_cap=soft_cap, window=window,
+                                     window_lens=window_lens)
         bs = fit
     n_s = S // bs
 
+    if window:
+        wl = local_lens if window_lens is None else window_lens
+        lens_arg = jnp.stack([local_lens.astype(jnp.int32),
+                              wl.astype(jnp.int32)])        # [2, B]
+    else:
+        lens_arg = local_lens
     qg = q.reshape(B, Hkv, g, D)
     grid = (B, Hkv, n_s)
     q_spec = pl.BlockSpec((1, 1, g, D), lambda b, h, s, lens: (b, h, 0, 0))
@@ -443,7 +470,7 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
                                  scale=scale, soft_cap=soft_cap,
                                  window=window)
         in_specs = [q_spec, kv_spec, kv_spec, sc_spec, sc_spec]
-        args = (local_lens, qg, k, v,
+        args = (lens_arg, qg, k, v,
                 k_scale.reshape(B, Hkv, S // 128, 128),
                 v_scale.reshape(B, Hkv, S // 128, 128))
     else:
@@ -451,7 +478,7 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
                                  scale=scale, soft_cap=soft_cap,
                                  window=window)
         in_specs = [q_spec, kv_spec, kv_spec]
-        args = (local_lens, qg, k, v)
+        args = (lens_arg, qg, k, v)
     out, lse = pl.pallas_call(
         kern,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -508,7 +535,7 @@ def _paged_gather(pool, table):
 
 def gqa_decode_paged_shard(q, k_pool, v_pool, block_table, local_lens, *,
                            impl="auto", interpret=False, soft_cap=0.0,
-                           window=0):
+                           window=0, window_lens=None):
     """Single-shard GQA decode over a PAGED KV cache.
 
     q [B, Hq, D]; k/v_pool [N_pages, Hkv, page, D] (the physical page
@@ -537,8 +564,15 @@ def gqa_decode_paged_shard(q, k_pool, v_pool, block_table, local_lens, *,
         return _local_decode_xla(q, _paged_gather(k_pool, block_table),
                                  _paged_gather(v_pool, block_table),
                                  local_lens, scale=scale,
-                                 soft_cap=soft_cap, window=window)
+                                 soft_cap=soft_cap, window=window,
+                                 window_lens=window_lens)
 
+    if window:
+        wl = local_lens if window_lens is None else window_lens
+        lens_arg = jnp.stack([local_lens.astype(jnp.int32),
+                              wl.astype(jnp.int32)])        # [2, B]
+    else:
+        lens_arg = local_lens
     qg = q.reshape(B, Hkv, g, D)
     grid = (B, Hkv, n_pages)
     kern = functools.partial(_decode_kernel_paged, block_s=Pg,
@@ -579,7 +613,7 @@ def gqa_decode_paged_shard(q, k_pool, v_pool, block_table, local_lens, *,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=maybe_interpret(interpret),
-    )(local_lens, block_table, qg, k_pool, v_pool)
+    )(lens_arg, block_table, qg, k_pool, v_pool)
     return out.reshape(B, Hq, D), lse[..., 0].reshape(B, Hq)
 
 
@@ -606,12 +640,14 @@ def sp_gqa_decode_paged_shard(q, k_pool, v_pool, block_table, kv_lens, *,
     n_local = block_table.shape[1]
     s_loc = n_local * k_pool.shape[2]
     me = jax.lax.axis_index(axis)
-    local_lens = jnp.clip(kv_lens - me * s_loc, 0, s_loc).astype(jnp.int32)
+    ends = (kv_lens - me * s_loc).astype(jnp.int32)
+    local_lens = jnp.clip(ends, 0, s_loc)
 
     out, lse = gqa_decode_paged_shard(q, k_pool, v_pool, block_table,
                                       local_lens, impl=impl,
                                       interpret=interpret,
-                                      soft_cap=soft_cap, window=window)
+                                      soft_cap=soft_cap, window=window,
+                                      window_lens=ends if window else None)
     return _combine_across_ranks(out, lse, q.dtype, axis=axis, impl=impl,
                                  interpret=interpret)
 
@@ -742,13 +778,15 @@ def sp_gqa_decode_shard(q, k_shard, v_shard, kv_lens, *, axis, block_s=None,
     S_loc = k_shard.shape[2]
     me = jax.lax.axis_index(axis)
     world = jax.lax.axis_size(axis)
-    local_lens = jnp.clip(kv_lens - me * S_loc, 0, S_loc).astype(jnp.int32)
+    ends = (kv_lens - me * S_loc).astype(jnp.int32)  # unclipped local end
+    local_lens = jnp.clip(ends, 0, S_loc)
 
     out, lse = gqa_decode_shard(q, k_shard, v_shard, local_lens,
                                 block_s=block_s, impl=impl,
                                 interpret=interpret, k_scale=k_scale,
                                 v_scale=v_scale, soft_cap=soft_cap,
-                                window=window)
+                                window=window,
+                                window_lens=ends if window else None)
     # Comm-fused combine kernel by default — remote DMA of the (out, lse)
     # partial planes and the LSE merge in ONE Pallas kernel (VERDICT
     # round-1 missing #2); xla mode keeps the packed LL gather + epilogue.
@@ -767,7 +805,7 @@ class SpDecodeContext:
     impl: str = "auto"
     interpret: bool = False
     soft_cap: float = 0.0  # Gemma-2 logit capping; 0 = off
-    window: int = 0  # sliding window (single-shard contract; 0 = off)
+    window: int = 0  # sliding window (global rule, any world; 0 = off)
 
     @property
     def world(self) -> int:
@@ -777,10 +815,10 @@ class SpDecodeContext:
 def create_sp_decode_context(mesh, axis="sp", block_s=None, impl="auto",
                              interpret=False, soft_cap=0.0,
                              window=0) -> SpDecodeContext:
-    if window and mesh.shape[axis] > 1:
-        raise ValueError(
-            "window decode is single-shard by contract (the window is "
-            "relative to the shard's local length); use a world-1 axis")
+    # ``window`` composes with SP sharding (r5): each shard intersects
+    # the global window [kv_len - window, kv_len) with its own range via
+    # the unclipped ``window_lens``; shards wholly outside contribute
+    # lse = NEG_INF partials that the combine ignores.
     return SpDecodeContext(mesh=mesh, axis=axis, block_s=block_s, impl=impl,
                            interpret=interpret, soft_cap=soft_cap,
                            window=window)

@@ -94,8 +94,10 @@ class SpGQAFlashDecodeAttention:
                  check_bounds: bool = True, kv_dtype=None,
                  soft_cap: float = 0.0, window: int = 0):
         # ``soft_cap``: Gemma-2 logit capping; ``window``: sliding-window
-        # attention (single-shard contract — create_sp_decode_context
-        # raises for world > 1).  Threaded to every decode path
+        # attention — the GLOBAL window rule at any world size (r5: each
+        # shard intersects [kv_len - window, kv_len) with its range via
+        # the unclipped window_lens; fully-outside shards emit lse = NEG
+        # partials the combine ignores).  Threaded to every decode path
         # (reference analog: sp_flash_decode_layer.py:46).
         self.ctx: SpDecodeContext = create_sp_decode_context(
             mesh, axis=axis, block_s=block_s, impl=impl, interpret=interpret,

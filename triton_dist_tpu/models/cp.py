@@ -28,7 +28,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from triton_dist_tpu.kernels.ring_attention import (
@@ -125,13 +124,19 @@ def cp_forward_shard(params, tokens_shard, cfg: LlamaConfig, *, axis,
     return jnp.dot(x, params["lm_head"], preferred_element_type=jnp.float32)
 
 
-def _pick_zigzag(zigzag, attn, S, world):
-    """Auto rule (``zigzag=None``): zigzag whenever it applies — ring
-    attention, causal, and S splitting into 2*world chunks.  world 1 gains
-    nothing, so skip the permutation there.  Explicit ``zigzag=True`` is
-    validated here (a ValueError, not a traced assert)."""
+def _pick_zigzag(zigzag, attn, S, world, impl, head_dim):
+    """Auto rule (``zigzag=None``): zigzag only where it PAYS — the flash
+    ring's block-level skip prunes the dead chunk-pairs; the dense
+    xla/pallas updates compute full blocks regardless of mask, and a
+    zigzag run must tile by 128 for the flash kernels (S_loc % 256).
+    Flash-illegal or explicitly-xla configs keep the contiguous layout
+    (zigzag would force them OFF the flash ring).  world 1 gains nothing.
+    Explicit ``zigzag=True`` is validated here (a ValueError, not a
+    traced assert) and overrides the pay-off heuristic."""
     if zigzag is None:
-        return attn == "ring" and world > 1 and S % (2 * world) == 0
+        return (attn == "ring" and world > 1 and S % (2 * world) == 0
+                and impl in ("auto", "flash")
+                and (S // world) % 256 == 0 and head_dim % 128 == 0)
     if zigzag:
         if attn != "ring":
             raise ValueError("zigzag layout applies to attn='ring' only "
@@ -190,7 +195,8 @@ def make_cp_train_step(cfg: LlamaConfig, mesh: Mesh, *, axis="cp",
     fns = {}
 
     def step(params, tokens, targets):
-        zz = _pick_zigzag(zigzag, attn, tokens.shape[0], world)
+        zz = _pick_zigzag(zigzag, attn, tokens.shape[0], world,
+                          impl, cfg.head_dim)
         if zz not in fns:
             fns[zz] = build(zz)
         if zz:
@@ -220,7 +226,8 @@ def make_cp_forward(cfg: LlamaConfig, mesh: Mesh, *, axis="cp", attn="ring",
     fns = {}
 
     def fwd(params, tokens):
-        zz = _pick_zigzag(zigzag, attn, tokens.shape[0], world)
+        zz = _pick_zigzag(zigzag, attn, tokens.shape[0], world,
+                          impl, cfg.head_dim)
         if zz not in fns:
             fns[zz] = build(zz)
         if not zz:

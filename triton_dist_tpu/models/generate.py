@@ -63,11 +63,6 @@ class Generator:
         self.mesh = mesh
         self.axis = axis
         self.max_seq = max_seq or cfg.max_seq
-        if cfg.attn_window and mesh.shape[axis] > 1:
-            raise ValueError(
-                f"attn_window={cfg.attn_window} needs a world-1 mesh: "
-                "windowed decode is single-shard by contract (a window "
-                "bounds the live cache — shard something else)")
         self.attn = SpGQAFlashDecodeAttention(
             mesh, axis=axis, impl=impl, interpret=interpret,
             check_bounds=False,  # Generator guards lengths itself (below)
@@ -287,8 +282,8 @@ def _attend_prefix(q, k_all, v_all, prefix_len, *, k_scale=None,
                 ksc, vsc = scs if scs else (None, None)
                 # The prefill kernel's window mask is GLOBAL-position
                 # based (qpos = q_offset + i, kpos = me*s_loc + j), so
-                # windowed SP chunked prefill just works — only DECODE's
-                # window is single-shard (its rule is llen-relative).
+                # windowed SP chunked prefill just works; decode's window
+                # is global too since r5 (unclipped window_lens per shard).
                 return sp_flash_attention_shard(
                     qt_, k_, v_, axis=axis, causal=True, q_offset=off,
                     impl="auto", interpret=interpret, k_scale=ksc,

@@ -83,9 +83,8 @@ class LlamaConfig:
     def mistral_7b() -> "LlamaConfig":
         # NOTE: the presets mirror the reference's GEMM-shape table, so
         # attention variants stay off by default; Mistral's real sliding
-        # window is ``replace(cfg, attn_window=4096)`` — windowed DECODE
-        # requires a world-1 mesh (Generator raises otherwise), windowed
-        # prefill/training work on any mesh.
+        # window is ``replace(cfg, attn_window=4096)`` — windowed
+        # prefill, training, and (since r5) SP decode work on any mesh.
         return LlamaConfig(vocab=32000, dim=4096, n_layers=32, n_heads=32,
                            n_kv_heads=8, ffn_dim=14336, rope_theta=1e6,
                            dtype=jnp.bfloat16)
