@@ -1,0 +1,105 @@
+"""Speculative-verify attention step benchmark (r5 VERDICT task 4).
+
+The verify step scores k draft tokens against an S-token cache.  Routes:
+
+* ``decode``  — the r5 multi-token decode kernel (q_lens path): the k
+  queries ride as k*G block rows of the split-KV kernel; the cache
+  streams once in bf16 at the decode kernel's HBM-floor blocks.
+* ``dense``   — the incumbent: ``_attend_prefix``'s pre-r5 behavior at
+  small c was ``flash_attention`` falling back to the DENSE program
+  (c % 128 != 0 cannot tile the prefill kernel), materializing [c, S]
+  f32 scores.
+* ``padded``  — the prefill KERNEL forced by padding the chunk to 128
+  rows (what a naive prefill-kernel verify costs: >90% dead q rows).
+
+Protocol: scripts/bench_decode.py's dependent-iteration chains in one
+jit, (t_long - t_short)/extra, round-robin trials (docs/perf.md).
+
+Usage: python scripts/bench_verify.py [--k 4 8] [--trials 9]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from scripts.benchlib import RUN_SEED, rotated_paired_bench
+from triton_dist_tpu.kernels.flash_attention import flash_attention
+from triton_dist_tpu.kernels.flash_decode import gqa_decode_shard
+
+HQ, HKV, D, S = 32, 8, 128, 8192
+
+
+def make_chain(n_iters, route, k_tok):
+    @jax.jit
+    def chain(q, kc, vc, lens):
+        def body(_, qq):
+            if route == "decode":
+                out, _ = gqa_decode_shard(qq, kc, vc, lens, impl="pallas")
+            elif route == "dense":
+                out = flash_attention(
+                    qq.transpose(0, 2, 1, 3), kc, vc, causal=True,
+                    q_offset=S - k_tok, impl="xla").transpose(0, 2, 1, 3)
+            else:  # padded prefill kernel
+                pad = jnp.zeros((qq.shape[0], HQ, 128 - k_tok, D), qq.dtype)
+                qp = jnp.concatenate(
+                    [qq.transpose(0, 2, 1, 3), pad], axis=2)
+                out = flash_attention(
+                    qp, kc, vc, causal=True, q_offset=S - k_tok,
+                    impl="pallas")[:, :, :k_tok].transpose(0, 2, 1, 3)
+            return out.astype(qq.dtype)
+
+        return jnp.sum(jax.lax.fori_loop(0, n_iters, body, q)
+                       .astype(jnp.float32))
+
+    return chain
+
+
+def bench_k(k_tok, trials, B, n_short=32, n_long=288):
+    ks = jax.random.split(jax.random.key(0), 3)
+    kc = jax.random.normal(ks[1], (B, HKV, S, D), jnp.bfloat16)
+    vc = jax.random.normal(ks[2], (B, HKV, S, D), jnp.bfloat16)
+    lens = jnp.full((B,), S, jnp.int32)
+    q0 = jax.random.normal(ks[0], (B, k_tok, HQ, D), jnp.bfloat16)
+
+    chains = {}
+    for route in ("decode", "dense", "padded"):
+        short = make_chain(n_short, route, k_tok)
+        long = make_chain(n_long, route, k_tok)
+        float(short(q0, kc, vc, lens))
+        float(long(q0, kc, vc, lens))
+        chains[route] = (short, long, (kc, vc, lens))
+
+    def fresh_q(t):
+        return jax.random.normal(jax.random.key(RUN_SEED + t),
+                                 (B, k_tok, HQ, D), jnp.bfloat16)
+
+    res = rotated_paired_bench(chains, fresh_q, n_long - n_short,
+                               trials=trials)
+    return {r: (med * 1e6, iqr * 1e6) for r, (med, iqr) in res.items()}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--k", type=int, nargs="+", default=[4, 8])
+    ap.add_argument("--batch", type=int, nargs="+", default=[1, 8])
+    ap.add_argument("--trials", type=int, default=9)
+    args = ap.parse_args()
+    print(f"verify attention step, Hq={HQ} Hkv={HKV} D={D} S={S}")
+    for Bv in args.batch:
+      for k_tok in args.k:
+        res = bench_k(k_tok, args.trials, Bv)
+        print(f"B={Bv} k={k_tok}:")
+        for route, (med, iqr) in res.items():
+            print(f"  {route:8s}: {med:8.1f} us/step  (iqr {iqr:.1f})")
+        print(f"  decode vs dense : {res['dense'][0] / res['decode'][0]:.2f}x"
+              f"   decode vs padded: "
+              f"{res['padded'][0] / res['decode'][0]:.2f}x")
+
+
+if __name__ == "__main__":
+    main()

@@ -127,6 +127,13 @@ def main():
         gqa_decode_shard, mesh, 4, impl="pallas", interpret=False,
         window=300)(q, kc, vc, lens))
 
+    # 7a''. multi-token (q_lens) verify decode — [3, B] lens layout +
+    # T*G-row q block (r5)
+    qm = jax.random.normal(key, (B, 4, Hq, hd), jnp.bfloat16)
+    check("flash_decode_multitok", lambda: _shard1(
+        gqa_decode_shard, mesh, 4, impl="pallas", interpret=False,
+        q_lens=jnp.array([4, 3, 4, 2], jnp.int32))(qm, kc, vc, lens))
+
     # 7b. int8-KV decode kernel (lane-packed scale planes — r4)
     from triton_dist_tpu.kernels.flash_decode import quantize_kv
     kq8, ks8 = quantize_kv(kc.astype(jnp.float32))

@@ -405,3 +405,150 @@ def test_sliding_window_decode(key):
     out_x, _ = gqa_decode_shard(q, k, v, lens, impl="xla", window=w)
     np.testing.assert_allclose(np.asarray(out_x), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_multitoken_decode(impl, key):
+    """r5 q_lens verify decode: T query tokens ride the kernel as T*G
+    block rows; per-request q_lens marks dead padding rows (lse=NEG).
+    Oracle: dense attention with the per-token causal rule
+    pos < end - (q_lens-1-t), with and without window+cap."""
+    B, T, Hq, Hkv, D, S = 2, 4, 4, 2, 128, 512
+    ks = jax.random.split(jax.random.key(11), 3)
+    q = jax.random.normal(ks[0], (B, T, Hq, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, Hkv, S, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, Hkv, S, D), jnp.float32)
+    lens = jnp.array([S, 300], jnp.int32)
+    qlens = jnp.array([4, 3], jnp.int32)
+    g = Hq // Hkv
+
+    def dense(window=0, cap=0.0):
+        logits = jnp.einsum("bthgd,bhsd->bhtgs",
+                            q.reshape(B, T, Hkv, g, D), k) / np.sqrt(D)
+        if cap:
+            logits = cap * jnp.tanh(logits / cap)
+        pos = jnp.arange(S)[None, None, :]
+        d = qlens[:, None] - 1 - jnp.arange(T)[None, :]
+        valid = ((pos < lens[:, None, None]) & (d[..., None] >= 0)
+                 & (pos < (lens[:, None] - d)[..., None]))
+        if window:
+            valid = valid & (pos >= (lens[:, None] - d)[..., None] - window)
+        logits = jnp.where(valid[:, None, :, None, :], logits, -1e30)
+        p = jnp.where(valid[:, None, :, None, :],
+                      jax.nn.softmax(logits, axis=-1), 0.0)
+        return jnp.einsum("bhtgs,bhsd->bthgd", p, v).reshape(B, T, Hq, D)
+
+    live = (jnp.arange(T)[None, :] < qlens[:, None])[..., None, None]
+    for win, cap in [(0, 0.0), (160, 5.0)]:
+        want = dense(win, cap)
+        out, lse = gqa_decode_shard(q, k, v, lens, impl=impl,
+                                    interpret=(impl == "pallas"),
+                                    q_lens=qlens, window=win,
+                                    soft_cap=cap, block_s=128)
+        np.testing.assert_allclose(np.asarray(out * live),
+                                   np.asarray(want * live),
+                                   atol=2e-5, rtol=2e-5)
+        assert bool(jnp.all(lse[1, 3] < -1e29)), "dead row lse must be NEG"
+    # int8 cache twin
+    from triton_dist_tpu.kernels.flash_decode import quantize_kv
+    kq8, ksc = quantize_kv(k)
+    vq8, vsc = quantize_kv(v)
+    out_i8, _ = gqa_decode_shard(q, kq8, vq8, lens, impl=impl,
+                                 interpret=(impl == "pallas"),
+                                 k_scale=ksc, v_scale=vsc, q_lens=qlens)
+    np.testing.assert_allclose(np.asarray(out_i8 * live),
+                               np.asarray(dense() * live),
+                               rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_multitoken_sp_decode(impl, key):
+    """Multi-token verify over a SHARDED cache (world 4): the T queries'
+    partials combine per (b, t) like a B*T decode batch."""
+    W = 4
+    mesh = Mesh(np.array(jax.devices()[:W]), ("sp",))
+    B, T, Hq, Hkv, D = 2, 4, 4, 2, 128
+    S = W * 128
+    ks = jax.random.split(jax.random.key(12), 3)
+    q = jax.random.normal(ks[0], (B, T, Hq, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, Hkv, S, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, Hkv, S, D), jnp.float32)
+    lens = jnp.array([S, 300], jnp.int32)
+    g = Hq // Hkv
+
+    logits = jnp.einsum("bthgd,bhsd->bhtgs",
+                        q.reshape(B, T, Hkv, g, D), k) / np.sqrt(D)
+    pos = jnp.arange(S)[None, None, :]
+    d = T - 1 - jnp.arange(T)[None, :]
+    valid = (pos < (lens[:, None] - d)[..., None])
+    logits = jnp.where(valid[:, None, :, None, :], logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    want = jnp.einsum("bhtgs,bhsd->bthgd", p, v).reshape(B, T, Hq, D)
+
+    import functools
+
+    from triton_dist_tpu.kernels.flash_decode import sp_gqa_decode_shard
+    from jax.sharding import PartitionSpec as P
+
+    fn = jax.jit(jax.shard_map(
+        functools.partial(sp_gqa_decode_shard, axis="sp", impl=impl,
+                          interpret=(impl == "pallas")),
+        mesh=mesh,
+        in_specs=(P(), P(None, None, "sp"), P(None, None, "sp"), P()),
+        out_specs=P(), check_vma=False))
+    out = fn(q, k, v, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_speculative_verify_reaches_decode_kernel(key, monkeypatch):
+    """The k-token verify chunk must ride the multi-token DECODE kernel
+    (r5), not the padded prefill path: spy on gqa_decode_shard through
+    the generate module."""
+    import sys
+
+    import triton_dist_tpu.models.generate  # noqa: F401
+    from triton_dist_tpu.kernels import flash_decode as fd
+    from triton_dist_tpu.models.generate import Generator
+    from triton_dist_tpu.models.llama import LlamaConfig, init_params
+
+    calls = {"n": 0, "T": None}
+    real = fd.gqa_decode_shard
+
+    def spy(q, *a, **kw):
+        if q.ndim == 4:
+            calls["n"] += 1
+            calls["T"] = q.shape[1]
+        return real(q, *a, **kw)
+
+    monkeypatch.setattr(fd, "gqa_decode_shard", spy)
+    cfg = LlamaConfig(vocab=64, dim=256, n_layers=2, n_heads=2,
+                      n_kv_heads=1, ffn_dim=128, max_seq=256,
+                      dtype=jnp.float32)
+    params = init_params(cfg, key)
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    gen = Generator(cfg, mesh1, max_seq=256, interpret=True)
+    st = gen.prefill(params, jax.random.randint(key, (1, 64), 0, 64))
+    chunk = jnp.zeros((1, 4), jnp.int32)  # a k=4 verify chunk
+    gen._chunk_jit(params, chunk, st.caches, jnp.int32(64),
+                   quantized=False, extent=128)
+    assert calls["n"] > 0 and calls["T"] == 4, calls
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_qlens_dead_slot_single_token(impl):
+    """q_lens with T == 1 marks dead batch slots (mixed batches where a
+    request has no query this step): both impls must return out = 0 and
+    lse = NEG for the dead row — the review-caught divergence."""
+    B, Hq, Hkv, D, S = 2, 4, 2, 128, 256
+    q, k, v = make_inputs(jax.random.key(13), B, Hq, Hkv, S, D)
+    lens = jnp.array([S, S], jnp.int32)
+    qlens = jnp.array([1, 0], jnp.int32)  # row 1 dead
+    out, lse = gqa_decode_shard(q[:, None], k, v, lens, impl=impl,
+                                interpret=(impl == "pallas"),
+                                q_lens=qlens)
+    ref = dense_reference(q, k, v, lens)
+    np.testing.assert_allclose(np.asarray(out[0, 0]), np.asarray(ref[0]),
+                               rtol=2e-5, atol=2e-5)
+    assert np.all(np.asarray(out[1]) == 0.0)
+    assert np.all(np.asarray(lse[1]) < -1e29)

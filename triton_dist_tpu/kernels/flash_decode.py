@@ -76,15 +76,61 @@ from triton_dist_tpu.kernels.collective_ids import SP_DECODE as SP_DECODE_COLLEC
 # ---------------------------------------------------------------------------
 
 
+def _read_lens(lens_ref, b, *, window, use_qlens):
+    """Decode the lens prefetch operand (layout depends on the STATIC
+    window/use_qlens flags):
+
+    * plain decode — [B]: clipped local lens only;
+    * windowed (r5 SP window) — [2, B]: + the UNCLIPPED local end
+      position (kv_len - shard offset), whose last ``window`` rows are
+      visible: the global window rule in shard coordinates;
+    * q_lens mode (r5 multi-token verify, incl. T == 1 with dead batch
+      slots) — [3, B]: + the per-batch live query count (q rows
+      t >= qlen are dead padding).
+
+    Returns (llen, wlen, qlen); qlen is None unless use_qlens.
+    """
+    if use_qlens:
+        return lens_ref[0, b], lens_ref[1, b], lens_ref[2, b]
+    if window:
+        return lens_ref[0, b], lens_ref[1, b], None
+    llen = lens_ref[b]
+    return llen, llen, None
+
+
+def _chunk_valid(pos, llen, wlen, qlen, *, window, n_tok, group):
+    """Visibility of cache position ``pos`` [R, bs] to decode-query row
+    r = t * group + g (token t's query sits at global end - (qlen-1-t)):
+    THE masking rule shared by the bf16/int8 kernels and the XLA
+    fallback.  Without q_lens (qlen None) this degenerates to the
+    classic decode rule; dead rows (t >= qlen) mask everything and
+    surface lse = NEG_INF."""
+    valid = pos < llen
+    if qlen is not None:
+        t = jax.lax.broadcasted_iota(jnp.int32, pos.shape, 0) // group
+        d = qlen - 1 - t                       # distance from the last q
+        valid = valid & (d >= 0) & (pos < wlen - d)
+        if window:
+            valid = valid & (pos >= wlen - d - window)
+    elif window:
+        valid = valid & (pos >= wlen - window)
+    return valid
+
+
 def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
                    acc_ref, m_ref, l_ref, *, block_s, n_s, scale,
-                   soft_cap=0.0, window=0):
+                   soft_cap=0.0, window=0, n_tok=1, use_qlens=False):
     """Grid (B, Hkv, n_s); one (batch, kv-head) pair accumulates across the
     sequential KV-chunk axis.
 
     Reference analog: ``kernel_gqa_fwd_batch_decode_split_kv``
     (flash_decode.py:129-280) — the Triton version parallelizes over splits
     and re-merges; here the s axis is sequential so the merge is the loop.
+
+    ``n_tok`` > 1 (r5): the q block carries T tokens' queries as
+    R = T * G rows (reference analog: the ``q_lens`` batch-verify entry,
+    flash_decode.py:763,847) — mixed speculative-verify/decode batches
+    ride ONE kernel with the causal rule ``pos < wlen - (qlen-1-t)``.
     """
     b = pl.program_id(0)
     s = pl.program_id(2)
@@ -95,25 +141,17 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # With a window the lens operand is [2, B]: row 0 the CLIPPED valid
-    # rows of this shard, row 1 the UNCLIPPED local end position
-    # (kv_len - shard offset) whose last ``window`` rows are visible —
-    # the global window rule evaluated in shard coordinates (r5: windowed
-    # decode composes with SP; a shard wholly outside the window masks
-    # everything and its lse = NEG partial no-ops in the combine).
-    if window:
-        llen = lens_ref[0, b]
-        wlen = lens_ref[1, b]
-    else:
-        llen = lens_ref[b]  # valid KV rows in *this shard* for batch b
-        wlen = llen
+    llen, wlen, qlen = _read_lens(lens_ref, b, window=window,
+                                  use_qlens=use_qlens)
 
     # Chunks entirely past the valid length — or, with a sliding window,
     # entirely before it — are compute-skipped (their DMAs still stream
-    # in; the pipeline cannot be shortened data-dependently).
+    # in; the pipeline cannot be shortened data-dependently).  The window
+    # tail bound is conservative for multi-token (earliest query's
+    # window reaches back n_tok-1 more rows).
     live = s * block_s < llen
     if window:
-        live = live & ((s + 1) * block_s > wlen - window)
+        live = live & ((s + 1) * block_s > wlen - (n_tok - 1) - window)
 
     @pl.when(live)
     def _():
@@ -123,27 +161,24 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
         # the exp math).  P is cast DOWN to the V dtype for the PV matmul
         # — the standard flash-attention practice, and what keeps both
         # matmuls on the MXU's double-rate path.
-        q = q_ref[0, 0]                              # [G, D]
+        q = q_ref[0, 0]                              # [R, D], R = n_tok*G
         k = k_ref[0, 0]                              # [bs, D]
         v = v_ref[0, 0]                              # [bs, D]
 
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale        # [G, bs]
+            preferred_element_type=jnp.float32) * scale        # [R, bs]
         logits = apply_soft_cap(logits, soft_cap)
         pos = s * block_s + jax.lax.broadcasted_iota(
             jnp.int32, logits.shape, 1)
-        valid = pos < llen
-        if window:
-            # the decode query sits at global end-1 (local wlen-1): only
-            # the last ``window`` keys are visible
-            valid = valid & (pos >= wlen - window)
+        valid = _chunk_valid(pos, llen, wlen, qlen, window=window,
+                             n_tok=n_tok, group=q.shape[0] // n_tok)
         logits = jnp.where(valid, logits, NEG_INF)
 
-        m_cur = m_ref[:]                                        # [G, 128]
-        row_max = jnp.max(logits, axis=-1, keepdims=True)       # [G, 1]
-        m_new = jnp.maximum(m_cur, row_max)                     # [G, 128]
-        alpha = jnp.exp(m_cur[:, :1] - m_new[:, :1])            # [G, 1]
+        m_cur = m_ref[:]                                        # [R, 128]
+        row_max = jnp.max(logits, axis=-1, keepdims=True)       # [R, 1]
+        m_new = jnp.maximum(m_cur, row_max)                     # [R, 128]
+        alpha = jnp.exp(m_cur[:, :1] - m_new[:, :1])            # [R, 1]
         p = jnp.where(valid, jnp.exp(logits - m_new[:, :1]), 0.0)
         m_ref[:] = m_new
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
@@ -153,11 +188,11 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
 
     @pl.when(s == n_s - 1)
     def _():
-        l = l_ref[:]                                            # [G, 128]
+        l = l_ref[:]                                            # [R, 128]
         nonempty = l > 0.0  # rank's shard may be wholly past kv_len
         out_ref[0, 0] = jnp.where(nonempty[:, :1], acc_ref[:] / jnp.where(
             nonempty[:, :1], l[:, :1], 1.0), 0.0)
-        # lse rides a full-lane [G, 128] buffer (every lane the same value):
+        # lse rides a full-lane [R, 128] buffer (every lane the same value):
         # Mosaic requires output block lane dims of 128 or the full array dim.
         lse_ref[0, 0] = jnp.where(
             nonempty, m_ref[:] + jnp.log(jnp.where(nonempty, l, 1.0)),
@@ -166,7 +201,8 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
 
 def _decode_kernel_i8(lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
                       out_ref, lse_ref, acc_ref, m_ref, l_ref,
-                      *, block_s, n_s, scale, soft_cap=0.0, window=0):
+                      *, block_s, n_s, scale, soft_cap=0.0, window=0,
+                      n_tok=1, use_qlens=False):
     """int8-KV twin of :func:`_decode_kernel` (VERDICT r3 #5): the cache
     streams from HBM as int8 (half the bytes — decode is bandwidth-bound,
     so that is the whole win) with per-position f32 scales riding as two
@@ -186,19 +222,15 @@ def _decode_kernel_i8(lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    if window:  # [2, B] lens layout — see _decode_kernel
-        llen = lens_ref[0, b]
-        wlen = lens_ref[1, b]
-    else:
-        llen = lens_ref[b]
-        wlen = llen
+    llen, wlen, qlen = _read_lens(lens_ref, b, window=window,
+                                  use_qlens=use_qlens)
     live = s * block_s < llen
     if window:
-        live = live & ((s + 1) * block_s > wlen - window)
+        live = live & ((s + 1) * block_s > wlen - (n_tok - 1) - window)
 
     @pl.when(live)
     def _():
-        q = q_ref[0, 0]                                  # [G, D] bf16/f32
+        q = q_ref[0, 0]                          # [R, D] bf16/f32, R=n_tok*G
         k = k_ref[0, 0].astype(q.dtype)                  # [bs, D] i8→q dtype
         # Scales ride LANE-PACKED [B, Hkv, S//128, 128] (row r, lane l =
         # position r*128+l): each chunk's bs scales are ONE dense
@@ -215,9 +247,8 @@ def _decode_kernel_i8(lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
         logits = apply_soft_cap(logits, soft_cap)
         pos = s * block_s + jax.lax.broadcasted_iota(
             jnp.int32, logits.shape, 1)
-        valid = pos < llen
-        if window:
-            valid = valid & (pos >= wlen - window)
+        valid = _chunk_valid(pos, llen, wlen, qlen, window=window,
+                             n_tok=n_tok, group=q.shape[0] // n_tok)
         logits = jnp.where(valid, logits, NEG_INF)
 
         m_cur = m_ref[:]
@@ -246,7 +277,7 @@ def _decode_kernel_i8(lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
 
 def _local_decode_xla(q, k, v, local_lens, *, scale, k_scale=None,
                       v_scale=None, soft_cap=0.0, window=0,
-                      window_lens=None):
+                      window_lens=None, q_lens=None):
     """Dense fallback for ragged shapes / non-TPU (reference analog: the
     non-TMA dispatch path).  Same (out, lse) contract as the Pallas kernel.
 
@@ -255,33 +286,51 @@ def _local_decode_xla(q, k, v, local_lens, *, scale, k_scale=None,
     scale applies *after* the QK matmul / *before* the PV matmul, so XLA
     streams the cache from HBM as int8 — decode is bandwidth-bound, and
     halving the cache bytes is the point.
+
+    ``q`` may be [B, Hq, D] (one decode token) or [B, T, Hq, D]
+    (multi-token verify; ``q_lens`` [B] live query counts, default T).
     """
-    B, Hq, D = q.shape
+    multi = q.ndim == 4
+    if not multi:
+        q = q[:, None]                                 # T = 1
+    B, T, Hq, D = q.shape
     _, Hkv, S, _ = k.shape
     g = Hq // Hkv
-    qf = q.astype(jnp.float32).reshape(B, Hkv, g, D)
-    logits = jnp.einsum("bhgd,bhsd->bhgs", qf, k.astype(jnp.float32)) * scale
+    qf = q.astype(jnp.float32).reshape(B, T, Hkv, g, D)
+    logits = jnp.einsum("bthgd,bhsd->bhtgs", qf,
+                        k.astype(jnp.float32)) * scale
     if k_scale is not None:
-        logits = logits * k_scale[:, :, None, :]
+        logits = logits * k_scale[:, :, None, None, :]
     logits = apply_soft_cap(logits, soft_cap)
-    valid = jnp.arange(S)[None, :] < local_lens[:, None]        # [B, S]
+    wl = local_lens if window_lens is None else window_lens
+    ql = (jnp.full((B,), T, jnp.int32) if q_lens is None
+          else q_lens.astype(jnp.int32))
+    pos = jnp.arange(S)[None, None, :]                          # [1, 1, S]
+    d = ql[:, None] - 1 - jnp.arange(T)[None, :]                # [B, T]
+    valid = ((pos < local_lens[:, None, None])
+             & (d[..., None] >= 0)
+             & (pos < (wl[:, None] - d)[..., None]))            # [B, T, S]
     if window:
-        wl = local_lens if window_lens is None else window_lens
-        valid = valid & (jnp.arange(S)[None, :] >= wl[:, None] - window)
-    logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
-    m = jnp.max(logits, axis=-1)                                # [B, Hkv, g]
+        valid = valid & (pos >= (wl[:, None] - d)[..., None] - window)
+    logits = jnp.where(valid[:, None, :, None, :], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1)                             # [B,Hkv,T,g]
     # All-masked rows: keep everything finite, flag via lse = NEG_INF.
     nonempty = m > NEG_INF / 2
-    p = jnp.where(valid[:, None, None, :],
+    p = jnp.where(valid[:, None, :, None, :],
                   jnp.exp(logits - m[..., None]), 0.0)
     l = jnp.sum(p, axis=-1)
-    pv = p if v_scale is None else p * v_scale[:, :, None, :]
-    out = jnp.einsum("bhgs,bhsd->bhgd", pv, v.astype(jnp.float32))
-    out = jnp.where(nonempty[..., None],
-                    out / jnp.where(nonempty, l, 1.0)[..., None], 0.0)
+    pv = p if v_scale is None else p * v_scale[:, :, None, None, :]
+    out = jnp.einsum("bhtgs,bhsd->bthgd", pv, v.astype(jnp.float32))
+    out = jnp.where(nonempty.transpose(0, 2, 1, 3)[..., None],
+                    out / jnp.where(nonempty, l, 1.0)
+                    .transpose(0, 2, 1, 3)[..., None], 0.0)
     lse = jnp.where(nonempty, m + jnp.log(jnp.where(nonempty, l, 1.0)),
-                    NEG_INF)
-    return out.reshape(B, Hq, D), lse.reshape(B, Hq)
+                    NEG_INF).transpose(0, 2, 1, 3)              # [B,T,Hkv,g]
+    out = out.reshape(B, T, Hq, D)
+    lse = lse.reshape(B, T, Hq)
+    if not multi:
+        return out[:, 0], lse[:, 0]
+    return out, lse
 
 
 def _register_aot():
@@ -335,10 +384,24 @@ def quantize_kv(x):
 @_register_aot()
 def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
                      interpret=False, k_scale=None, v_scale=None,
-                     soft_cap=0.0, window=0, window_lens=None):
+                     soft_cap=0.0, window=0, window_lens=None,
+                     q_lens=None):
     """Single-shard GQA decode: q [B, Hq, D], k/v [B, Hkv, S_loc, D],
     local_lens [B] (valid rows in this shard).  Returns float32 partials
     (out [B, Hq, D], lse [B, Hq]).
+
+    MULTI-TOKEN (r5): q may be [B, T, Hq, D] — T query tokens per request
+    whose K/V already sit in the cache at the last T valid positions
+    (speculative verify / mixed decode-verify batches; reference analog:
+    the per-request ``q_lens`` of its decode entry, flash_decode.py:763,
+    847).  ``q_lens`` [B] (optional, <= T, default T) gives each
+    request's LIVE query count: rows t >= q_lens[b] are padding and
+    return lse = NEG_INF.  Query t of request b sits at global position
+    ``end_b - (q_lens[b] - t)`` where end_b is the cache length.
+    Returns (out [B, T, Hq, D], lse [B, T, Hq]).  The queries ride the
+    kernel as T*G extra block rows — decode stays HBM-bound, so a
+    k-token verify costs ~the same cache stream as one decode step
+    (vs the chunked-prefill verify's 128-row padded q blocks).
 
     Reference analog: ``gqa_fwd_batch_decode_intra_rank``
     (flash_decode.py:763-860) minus the separate combine launch.
@@ -364,7 +427,9 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
     serving shape.  ``impl='xla'`` keeps the XLA program (dequant fused
     into the attention stream).
     """
-    B, Hq, D = q.shape
+    multi = q.ndim == 4
+    n_tok = q.shape[1] if multi else 1
+    B, Hq, D = q.shape[0], q.shape[-2], q.shape[-1]
     _, Hkv, S, _ = k.shape
     assert Hq % Hkv == 0, (Hq, Hkv)
     g = Hq // Hkv
@@ -386,7 +451,7 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
         return _local_decode_xla(q, k, v, local_lens, scale=scale,
                                  k_scale=k_scale, v_scale=v_scale,
                                  soft_cap=soft_cap, window=window,
-                                 window_lens=window_lens)
+                                 window_lens=window_lens, q_lens=q_lens)
 
     defaulted = block_s is None
     if defaulted:
@@ -447,19 +512,33 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
             return _local_decode_xla(q, k, v, local_lens, scale=scale,
                                      k_scale=k_scale, v_scale=v_scale,
                                      soft_cap=soft_cap, window=window,
-                                     window_lens=window_lens)
+                                     window_lens=window_lens,
+                                     q_lens=q_lens)
         bs = fit
     n_s = S // bs
 
-    if window:
-        wl = local_lens if window_lens is None else window_lens
+    wl = local_lens if window_lens is None else window_lens
+    use_qlens = n_tok > 1 or q_lens is not None
+    if use_qlens:
+        ql = (jnp.full((B,), n_tok, jnp.int32) if q_lens is None
+              else q_lens.astype(jnp.int32))
+        lens_arg = jnp.stack([local_lens.astype(jnp.int32),
+                              wl.astype(jnp.int32), ql])    # [3, B]
+    elif window:
         lens_arg = jnp.stack([local_lens.astype(jnp.int32),
                               wl.astype(jnp.int32)])        # [2, B]
     else:
         lens_arg = local_lens
-    qg = q.reshape(B, Hkv, g, D)
+    rows = n_tok * g
+    if multi:
+        # [B, T, Hq, D] -> [B, Hkv, T*g, D], row r = t*g + head-group g
+        qg = (q.reshape(B, n_tok, Hkv, g, D).transpose(0, 2, 1, 3, 4)
+              .reshape(B, Hkv, rows, D))
+    else:
+        qg = q.reshape(B, Hkv, rows, D)
     grid = (B, Hkv, n_s)
-    q_spec = pl.BlockSpec((1, 1, g, D), lambda b, h, s, lens: (b, h, 0, 0))
+    q_spec = pl.BlockSpec((1, 1, rows, D),
+                          lambda b, h, s, lens: (b, h, 0, 0))
     kv_spec = pl.BlockSpec((1, 1, bs, D), lambda b, h, s, lens: (b, h, s, 0))
     if quantized:
         # Scale layout: position p lives at (row p//128, lane p%128) —
@@ -468,7 +547,8 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
                                lambda b, h, s, lens: (b, h, s, 0))
         kern = functools.partial(_decode_kernel_i8, block_s=bs, n_s=n_s,
                                  scale=scale, soft_cap=soft_cap,
-                                 window=window)
+                                 window=window, n_tok=n_tok,
+                                 use_qlens=use_qlens)
         in_specs = [q_spec, kv_spec, kv_spec, sc_spec, sc_spec]
         args = (lens_arg, qg, k, v,
                 k_scale.reshape(B, Hkv, S // 128, 128),
@@ -476,7 +556,8 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
     else:
         kern = functools.partial(_decode_kernel, block_s=bs, n_s=n_s,
                                  scale=scale, soft_cap=soft_cap,
-                                 window=window)
+                                 window=window, n_tok=n_tok,
+                                 use_qlens=use_qlens)
         in_specs = [q_spec, kv_spec, kv_spec]
         args = (lens_arg, qg, k, v)
     out, lse = pl.pallas_call(
@@ -486,19 +567,20 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
             grid=grid,
             in_specs=in_specs,
             out_specs=[
-                pl.BlockSpec((1, 1, g, D), lambda b, h, s, lens: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, g, 128),
+                pl.BlockSpec((1, 1, rows, D),
+                             lambda b, h, s, lens: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, rows, 128),
                              lambda b, h, s, lens: (b, h, 0, 0)),
             ],
             scratch_shapes=[
-                pltpu.VMEM((g, D), jnp.float32),
-                pltpu.VMEM((g, 128), jnp.float32),
-                pltpu.VMEM((g, 128), jnp.float32),
+                pltpu.VMEM((rows, D), jnp.float32),
+                pltpu.VMEM((rows, 128), jnp.float32),
+                pltpu.VMEM((rows, 128), jnp.float32),
             ],
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((B, Hkv, g, D), jnp.float32),
-            jax.ShapeDtypeStruct((B, Hkv, g, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hkv, rows, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hkv, rows, 128), jnp.float32),
         ],
         # (b, h) blocks are independent; only the KV-chunk axis carries the
         # online-softmax accumulator.  Telling Mosaic so lets it pipeline
@@ -507,6 +589,12 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=maybe_interpret(interpret),
     )(*args)
+    if multi:
+        out = (out.reshape(B, Hkv, n_tok, g, D).transpose(0, 2, 1, 3, 4)
+               .reshape(B, n_tok, Hq, D))
+        lse = (lse[..., 0].reshape(B, Hkv, n_tok, g)
+               .transpose(0, 2, 1, 3).reshape(B, n_tok, Hq))
+        return out, lse
     return out.reshape(B, Hq, D), lse[..., 0].reshape(B, Hq)
 
 
@@ -764,7 +852,7 @@ def combine_partials(outs, lses):
 
 def sp_gqa_decode_shard(q, k_shard, v_shard, kv_lens, *, axis, block_s=None,
                         impl="auto", interpret=False, k_scale=None,
-                        v_scale=None, soft_cap=0.0, window=0):
+                        v_scale=None, soft_cap=0.0, window=0, q_lens=None):
     """Per-device SP decode: local split-KV partials -> comm-fused combine
     (``sp_combine_shard``; the XLA-only mode falls back to LL gather +
     epilogue).  ``kv_lens`` are GLOBAL lengths; the shard
@@ -774,7 +862,8 @@ def sp_gqa_decode_shard(q, k_shard, v_shard, kv_lens, *, axis, block_s=None,
     Reference analog: ``SpGQAFlashDecodeAttention.forward``
     (sp_flash_decode_layer.py:78-184).
     """
-    B, Hq, D = q.shape
+    B, Hq, D = q.shape[0], q.shape[-2], q.shape[-1]
+    multi = q.ndim == 4
     S_loc = k_shard.shape[2]
     me = jax.lax.axis_index(axis)
     world = jax.lax.axis_size(axis)
@@ -786,10 +875,20 @@ def sp_gqa_decode_shard(q, k_shard, v_shard, kv_lens, *, axis, block_s=None,
                                 interpret=interpret, k_scale=k_scale,
                                 v_scale=v_scale, soft_cap=soft_cap,
                                 window=window,
-                                window_lens=ends if window else None)
+                                window_lens=ends if (window or multi)
+                                else None,
+                                q_lens=q_lens)
     # Comm-fused combine kernel by default — remote DMA of the (out, lse)
     # partial planes and the LSE merge in ONE Pallas kernel (VERDICT
     # round-1 missing #2); xla mode keeps the packed LL gather + epilogue.
+    if multi:
+        # [B, T, ...] partials combine like a B*T batch; dead rows carry
+        # lse = NEG on every rank and merge to 0.
+        T = out.shape[1]
+        c = _combine_across_ranks(out.reshape(B * T, Hq, D),
+                                  lse.reshape(B * T, Hq), q.dtype,
+                                  axis=axis, impl=impl, interpret=interpret)
+        return c.reshape(B, T, Hq, D)
     return _combine_across_ranks(out, lse, q.dtype, axis=axis, impl=impl,
                                  interpret=interpret)
 

@@ -266,15 +266,57 @@ def _attend_prefix(q, k_all, v_all, prefix_len, *, k_scale=None,
             flash_attention,
             sp_flash_attention_shard,
         )
+        from triton_dist_tpu.kernels.flash_decode import (
+            gqa_decode_shard,
+            sp_gqa_decode_shard,
+        )
 
         qt = q.transpose(0, 2, 1, 3)                  # [B, Hq, c, hd]
         world = 1 if mesh is None else mesh.shape[axis]
+        B, c = q.shape[0], q.shape[1]
+        S_all = k_all.shape[2]
+        # Small chunks (speculative verify: k draft tokens) ride the
+        # MULTI-TOKEN DECODE kernel (r5): the queries are c*G block rows
+        # instead of a 128-row-padded prefill q block, and the cache
+        # streams once at the decode kernel's HBM-floor blocks.  The
+        # prefill kernel keeps the large-chunk path (its q tiling wins
+        # when c itself is MXU-sized).
+        use_decode = c <= 32
         if world == 1:
+            if use_decode:
+                lens = jnp.full((B,), c, jnp.int32) + prefix_len
+                out, _ = gqa_decode_shard(
+                    q, k_all, v_all, lens, impl="auto",
+                    interpret=interpret, k_scale=k_scale, v_scale=v_scale,
+                    soft_cap=soft_cap, window=window)
+                return out.astype(jnp.float32)
             out = flash_attention(
                 qt, k_all, v_all, causal=True, q_offset=prefix_len,
                 impl="auto", interpret=interpret, k_scale=k_scale,
                 v_scale=v_scale, window=window, soft_cap=soft_cap)
             return out.transpose(0, 2, 1, 3).astype(jnp.float32)
+        if use_decode and S_all % world == 0:
+            from jax.sharding import PartitionSpec as P
+
+            def spd(q_, k_, v_, lens_, *scs):
+                ksc, vsc = scs if scs else (None, None)
+                return sp_gqa_decode_shard(
+                    q_, k_, v_, lens_, axis=axis, impl="auto",
+                    interpret=interpret, k_scale=ksc, v_scale=vsc,
+                    soft_cap=soft_cap, window=window)
+
+            seq_spec = P(None, None, axis)
+            lens = jnp.full((B,), c, jnp.int32) + prefix_len
+            args = [q, k_all, v_all, lens]
+            specs = [P(), seq_spec, seq_spec, P()]
+            if k_scale is not None:
+                args += [k_scale, v_scale]
+                specs += [seq_spec, seq_spec]
+            out = jax.shard_map(
+                spd, mesh=mesh, in_specs=tuple(specs), out_specs=P(),
+                check_vma=False,
+            )(*args)
+            return out.astype(jnp.float32)
         if k_all.shape[2] % world == 0:
             from jax.sharding import PartitionSpec as P
 
