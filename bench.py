@@ -179,11 +179,15 @@ def _bench_moe_a2a_us(n_extra=16384):
 
 
 def _bench_decode_us(trials=9):
-    """GQA decode step time at the serving shape (B=8, Hq=32, Hkv=8,
-    S=8192 bf16; pallas split-KV under auto).  Delegates to the decode
-    bench's protocol — it additionally feeds a FRESH query per trial,
-    without which the tunnel elides repeated chain calls and the long
-    chain under-measures."""
+    """GQA decode at the serving shape (B=8, Hq=32, Hkv=8, S=8192 bf16):
+    the pallas split-KV kernel AND the XLA fused program interleaved in
+    ONE rotated trial loop (VERDICT r4 next#1b: `decode_step_us` alone is
+    dispatch-sensitive — 353-361 across sessions — so the PAIRED ratio is
+    the field that can resolve a kernel change; both legs see identical
+    drift and it cancels in the quotient).
+
+    Returns (auto_us, decode_vs_xla_ratio) — ratio > 1 means the repo's
+    kernel beats XLA's fused decode at the same shape."""
     import os as _os
     import sys as _sys
 
@@ -192,8 +196,53 @@ def _bench_decode_us(trials=9):
 
     # block_s=None → the dtype-uniform full-shard default (r4: reads at
     # the HBM floor; the pinned 2048 measured the retired r3 default).
-    res = bench_batch(8, [("auto", "auto", None)], trials=trials)
-    return res["auto"][0]
+    # At this shape ``auto`` resolves to the pallas kernel, so the pallas
+    # leg IS the served path — benching a separate auto leg would time
+    # the identical kernel a third time.
+    res = bench_batch(8, [("pallas", "pallas", None),
+                          ("xla", "xla", None)], trials=trials)
+    ratio = (res["xla"][0] / res["pallas"][0]
+             if res["pallas"][0] > 0 else 0.0)
+    return res["pallas"][0], ratio
+
+
+def _bench_ring_vs_dense(trials=12):
+    """Ring-kernel quality ratio (VERDICT r4 next#1a): the dense
+    pallas_call GEMM and the FULL world-1 ring AG-GEMM kernel (producer
+    loop, semaphores, input_output_aliases — zero actual communication)
+    in ONE rotated trial loop — the r4 decomposition protocol
+    (scripts/exp_ring_schedule.py) promoted into the driver artifact.
+
+    ratio = dense_pair_time / ring_pair_time.  >= 0.97 means the ring
+    schedule costs <= ~3% over the bare kernel; a drop below is a real
+    schedule regression (both legs share the back-matmul + feedback and
+    the tunnel drift, which cancel in the quotient)."""
+    from scripts.benchlib import rotated_paired_bench
+    from scripts.exp_ring_schedule import make_chain as exp_chain
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    kw = jax.random.split(jax.random.key(RUN_SEED + 1234), 3)
+    b1 = jax.random.normal(kw[1], (K, N_PER_CHIP), jnp.bfloat16) * 0.02
+    b2 = jax.random.normal(kw[2], (N_PER_CHIP, K), jnp.bfloat16) * 0.02
+    n_long = 9
+    chains = {
+        v: (exp_chain(mesh, 1, v), exp_chain(mesh, n_long, v), (b1, b2))
+        for v in ("dense", "ring")
+    }
+
+    def fresh(t):
+        return jax.random.normal(jax.random.key(RUN_SEED + 30_000 + t),
+                                 (M, K), jnp.bfloat16)
+
+    x0 = fresh(-1)
+    for c1, cn, extra in chains.values():
+        float(c1(x0, *extra))
+        float(cn(x0, *extra))
+    res = rotated_paired_bench(chains, fresh, n_extra=n_long - 1,
+                               trials=trials)
+    if res["ring"][0] <= 0:
+        return 0.0
+    return res["dense"][0] / res["ring"][0]
 
 
 def _make_dot_chain(mesh, n_iters):
@@ -345,7 +394,8 @@ def main():
     sentinel_tflops, contended = _bench_contention_sentinel()
     tflops, ag_suspect = _bench_ag_gemm_tflops()
     moe_a2a_us, a2a_suspect = _bench_moe_a2a_us()
-    decode_us = _bench_decode_us()
+    decode_us, decode_ratio = _bench_decode_us()
+    ring_ratio = _bench_ring_vs_dense()
 
     peak = peak_bf16_tflops()
     vs = (tflops / peak) / REF_UTILIZATION if peak else 0.0
@@ -359,6 +409,17 @@ def main():
         # (B=8 Hq=32 Hkv=8 S=8192 bf16, pallas under auto).
         "moe_a2a_floor_us": round(moe_a2a_us, 2),
         "decode_step_us": round(decode_us, 1),
+        # PAIRED-DELTA kernel-quality ratios (r5, VERDICT r4 next#1):
+        # tunnel drift cancels in each quotient, so these resolve kernel
+        # changes that the absolute fields cannot.  ring_vs_dense_ratio:
+        # dense pallas GEMM pair-time / world-1 ring AG-GEMM pair-time,
+        # target >= 0.97 (ring schedule overhead <= ~3%).
+        # decode_vs_xla_ratio: XLA fused decode / pallas split-KV decode
+        # at B=8 S=8192, > 1 = the repo's kernel wins.  Variance: each
+        # leg's IQR runs 5-15% of its median across sessions (perf.md);
+        # the paired quotient's session spread measured ~±0.05.
+        "ring_vs_dense_ratio": round(ring_ratio, 3),
+        "decode_vs_xla_ratio": round(decode_ratio, 3),
         # Known-cost reference op (bare XLA dot, measured ceiling 189.7):
         # a depressed sentinel means the HOST was contended during this
         # session and `value` is a lower bound, not a regression.
@@ -376,6 +437,7 @@ def main():
     print(f"# chip peak {peak} TFLOPS, utilization "
           f"{tflops / peak:.1%}, shape M={M} K={K} N/chip={N_PER_CHIP}; "
           f"moe_a2a floor {moe_a2a_us:.2f} us; decode {decode_us:.1f} us; "
+          f"ring/dense {ring_ratio:.3f}; decode/xla {decode_ratio:.3f}; "
           f"sentinel dot {sentinel_tflops:.1f} TFLOPS"
           + (" (CONTENDED)" if contended else ""),
           file=sys.stderr)

@@ -98,7 +98,7 @@ def _read_lens(lens_ref, b, *, window, use_qlens):
     return llen, llen, None
 
 
-def _chunk_valid(pos, llen, wlen, qlen, *, window, n_tok, group):
+def _chunk_valid(pos, llen, wlen, qlen, *, window, group):
     """Visibility of cache position ``pos`` [R, bs] to decode-query row
     r = t * group + g (token t's query sits at global end - (qlen-1-t)):
     THE masking rule shared by the bf16/int8 kernels and the XLA
@@ -172,7 +172,7 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
         pos = s * block_s + jax.lax.broadcasted_iota(
             jnp.int32, logits.shape, 1)
         valid = _chunk_valid(pos, llen, wlen, qlen, window=window,
-                             n_tok=n_tok, group=q.shape[0] // n_tok)
+                             group=q.shape[0] // n_tok)
         logits = jnp.where(valid, logits, NEG_INF)
 
         m_cur = m_ref[:]                                        # [R, 128]
@@ -248,7 +248,7 @@ def _decode_kernel_i8(lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
         pos = s * block_s + jax.lax.broadcasted_iota(
             jnp.int32, logits.shape, 1)
         valid = _chunk_valid(pos, llen, wlen, qlen, window=window,
-                             n_tok=n_tok, group=q.shape[0] // n_tok)
+                             group=q.shape[0] // n_tok)
         logits = jnp.where(valid, logits, NEG_INF)
 
         m_cur = m_ref[:]
