@@ -62,7 +62,8 @@ def test_dryrun_multichip_self_pins_cpu_mesh():
             "dryrun_multichip(8)\n")
     r = _run(code, _hostile_env())
     assert r.returncode == 0, r.stderr[-2000:]
-    assert "dp=2 pp=2 tp=2" in r.stdout, r.stdout
+    assert "dcn=2 pp=2 tp=2" in r.stdout, r.stdout
+    assert "DCN axis" in r.stdout, r.stdout
 
 
 def test_dryrun_multichip_fails_loudly_when_backend_preinitialized():

@@ -171,3 +171,77 @@ def test_hier_all_to_all_matches_flat(impl, mesh2d, key):
         np.testing.assert_allclose(r_got[b, :k], r_ref[b, :k],
                                    rtol=0, atol=0)
         np.testing.assert_array_equal(r_got[b, k:], 0.0)
+
+
+def test_hier_all_reduce_matches_psum(mesh2x4, key):
+    """RS[fast] -> psum[slow] -> AG[fast] == a flat psum over both axes."""
+    from triton_dist_tpu.kernels.hierarchical import hier_all_reduce_shard
+
+    x = jax.random.normal(key, (2, 4, 32, 128), jnp.float32)
+
+    def shard_fn(parts):
+        i = jax.lax.axis_index("dcn")
+        j = jax.lax.axis_index("tp")
+        mine = parts[i, j]
+        hier = hier_all_reduce_shard(mine, slow_axis="dcn", fast_axis="tp",
+                                     interpret=True)
+        flat = jax.lax.psum(mine, ("dcn", "tp"))
+        return hier, flat
+
+    got, want = jax.jit(jax.shard_map(
+        shard_fn, mesh=mesh2x4, in_specs=P(), out_specs=(P(), P()),
+        check_vma=False))(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_hier_grad_allreduce_tree(mesh2x4, key):
+    """Tree bucketing: ragged leaf shapes/dtypes, one banded reduction."""
+    from triton_dist_tpu.kernels.hierarchical import hier_grad_allreduce
+
+    ks = jax.random.split(key, 3)
+    tree = {
+        "w": jax.random.normal(ks[0], (2, 4, 17, 5), jnp.float32),
+        "b": jax.random.normal(ks[1], (2, 4, 3), jnp.float32),
+        "e": jax.random.normal(ks[2], (2, 4, 2, 2, 7), jnp.bfloat16),
+    }
+
+    def shard_fn(parts):
+        i = jax.lax.axis_index("dcn")
+        j = jax.lax.axis_index("tp")
+        mine = jax.tree.map(lambda p: p[i, j], parts)
+        hier = hier_grad_allreduce(mine, slow_axis="dcn", fast_axis="tp",
+                                   interpret=True)
+        flat = jax.tree.map(lambda g: jax.lax.psum(g, ("dcn", "tp")), mine)
+        return hier, flat
+
+    got, want = jax.jit(jax.shard_map(
+        shard_fn, mesh=mesh2x4, in_specs=(P(),), out_specs=(P(), P()),
+        check_vma=False))(tree)
+    for name in tree:
+        np.testing.assert_allclose(np.asarray(got[name], dtype=np.float32),
+                                   np.asarray(want[name], dtype=np.float32),
+                                   rtol=1e-2, atol=1e-2, err_msg=name)
+
+
+def test_pp_hybrid_hier_dp_matches_plain(key):
+    """The hybrid dcn x pp x tp MoE step with the hierarchical dp grad
+    path == the plain psum dp step (same function, re-bracketed sums)."""
+    from triton_dist_tpu.models import moe as MoE
+    from triton_dist_tpu.models import pp as PP
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("dcn", "pp", "tp"))
+    cfg = MoE.MoEConfig.tiny()
+    tokens = jax.random.randint(jax.random.key(7), (16, 8), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=0)
+    losses = {}
+    for hier in (None, "tp"):
+        params = PP.place_pp_params(PP.init_pp_params(cfg, key), cfg, mesh)
+        step, _ = PP.make_pp_train_step(
+            cfg, mesh, dp_axis="dcn", n_micro=2, impl="xla",
+            interpret=True, lr=0.3, hier_dp_fast_axis=hier)
+        params, l0 = step(params, tokens, targets)
+        _, l1 = step(params, tokens, targets)
+        losses[hier] = (float(l0), float(l1))
+    np.testing.assert_allclose(losses["tp"], losses[None], rtol=2e-4)

@@ -32,7 +32,9 @@ from triton_dist_tpu.kernels.reduce_scatter import (
 
 __all__ = [
     "hier_all_gather_shard",
+    "hier_all_reduce_shard",
     "hier_all_to_all_shard",
+    "hier_grad_allreduce",
     "hier_reduce_scatter_shard",
     "hier_rs_band_index",
 ]
@@ -204,3 +206,70 @@ def hier_reduce_scatter_shard(x, *, slow_axis: str, fast_axis: str,
     x = reduce_scatter_shard(x, slow_axis, method=slow_method,
                              interpret=interpret, collective_id=cid.HIER_STAGE2)
     return x
+
+
+def hier_all_reduce_shard(x, *, slow_axis: str, fast_axis: str,
+                          fast_rs=ReduceScatterMethod.AUTO,
+                          fast_ag=AllGatherMethod.AUTO,
+                          interpret: bool = False):
+    """Two-tier AllReduce — the DCN-optimal gradient reduction.
+
+    RS over the FAST (ICI) tier first, psum over the SLOW (DCN) tier on
+    the 1/T band, AG over the fast tier: each chip ships rows/T bytes
+    across DCN instead of the full tensor (reference analog: its
+    inter-node gradient path reduces intra-node before touching IB,
+    reduce_scatter.py:842-860).  ``x`` [rows, ...] with rows % T == 0 is
+    every chip's full-size partial; returns the total sum, replicated.
+    """
+    from triton_dist_tpu.kernels.reduce_scatter import resolve_method
+    from triton_dist_tpu.runtime import topology
+
+    # Platform-resolve AUTO here: the shard-level kernels assume a Mosaic
+    # target (or interpret mode); a plain-CPU jit (the multichip gate
+    # without interpret) takes the XLA methods.
+    on_mosaic = topology.is_tpu() or interpret
+    if fast_rs is ReduceScatterMethod.AUTO:
+        fast_rs = resolve_method(interpret)
+    if fast_ag is AllGatherMethod.AUTO and not on_mosaic:
+        fast_ag = AllGatherMethod.XLA
+
+    t = jax.lax.axis_size(fast_axis)
+    if t > 1:
+        x = reduce_scatter_shard(x, fast_axis, method=fast_rs,
+                                 interpret=interpret,
+                                 collective_id=cid.HIER_STAGE1)
+    x = jax.lax.psum(x, slow_axis)
+    if t > 1:
+        x = all_gather_shard(x, axis=fast_axis, method=fast_ag,
+                             interpret=interpret,
+                             collective_id=cid.HIER_STAGE2)
+    return x
+
+
+def hier_grad_allreduce(grads, *, slow_axis: str, fast_axis: str,
+                        interpret: bool = False):
+    """Tree-wide two-tier gradient allreduce for dp-over-DCN training.
+
+    Leaves are flattened and concatenated into ONE [n, 128] plane (padded
+    to T*128) so the whole tree crosses DCN as a single banded reduction
+    — the bucketing every production DDP does, in two tiers.  Leaves keep
+    their dtypes via a f32 wire plane (gradient sums want f32 anyway).
+    """
+    t = jax.lax.axis_size(fast_axis)
+    leaves, treedef = jax.tree.flatten(grads)
+    sizes = [int(l.size) for l in leaves]
+    flat = jnp.concatenate(
+        [l.reshape(-1).astype(jnp.float32) for l in leaves])
+    n = flat.shape[0]
+    row = 128 * t
+    pad = (-n) % row
+    plane = jnp.pad(flat, (0, pad)).reshape(-1, 128)
+    plane = hier_all_reduce_shard(plane, slow_axis=slow_axis,
+                                  fast_axis=fast_axis, interpret=interpret)
+    flat = plane.reshape(-1)[:n]
+    out, off = [], 0
+    for leaf, size in zip(leaves, sizes):
+        out.append(flat[off:off + size].reshape(leaf.shape)
+                   .astype(leaf.dtype))
+        off += size
+    return jax.tree.unflatten(treedef, out)

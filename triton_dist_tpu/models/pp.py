@@ -73,7 +73,8 @@ def _block(layer, carry, cfg, *, tp_axis, impl, interpret):
 
 def make_pp_train_step(cfg, mesh: Mesh, *, tp_axis="tp", pp_axis="pp",
                        dp_axis=None, n_micro=4, impl="auto",
-                       interpret=False, lr=1e-3, remat=False):
+                       interpret=False, lr=1e-3, remat=False,
+                       hier_dp_fast_axis=None):
     """SGD step over a (dp ×) pp × tp mesh with GPipe microbatching.
 
     Input tokens/targets: [S, B] (sequence sharded over tp, batch over dp);
@@ -81,6 +82,14 @@ def make_pp_train_step(cfg, mesh: Mesh, *, tp_axis="tp", pp_axis="pp",
     (jitted step, specs).  Gradient sync rule: every leaf is psum'd over
     each mesh axis its spec does NOT mention (pipeline masking zeroes the
     contributions of stages that don't own a replicated leaf's compute).
+
+    ``hier_dp_fast_axis`` (r5, dp-over-DCN training): when the dp axis
+    rides the slow DCN tier, set this to an ICI axis — the dp gradient
+    reduction of every leaf REPLICATED over that axis is bucketed through
+    ``kernels/hierarchical.hier_grad_allreduce`` (RS over ICI → psum over
+    DCN on the 1/T band → AG over ICI: each chip ships 1/T of the
+    gradient bytes across DCN).  Leaves sharded over the fast axis keep
+    the direct dp psum (they are already 1/T-sized).
     """
     specs = pp_param_specs(cfg, tp_axis=tp_axis, pp_axis=pp_axis)
     mesh_axes = tuple(a for a in (tp_axis, pp_axis, dp_axis) if a)
@@ -127,12 +136,58 @@ def make_pp_train_step(cfg, mesh: Mesh, *, tp_axis="tp", pp_axis="pp",
             params, tokens_m, targets_m)
         loss = jax.lax.psum(local_loss, mesh_axes)
 
-        def _reduce(g, spec):
-            axes = tuple(a for a in mesh_axes if a not in spec)
-            return jax.lax.psum(g, axes) if axes else g
+        if hier_dp_fast_axis is None:
+            def _reduce(g, spec):
+                axes = tuple(a for a in mesh_axes if a not in spec)
+                return jax.lax.psum(g, axes) if axes else g
 
-        grads = jax.tree.map(_reduce, grads, specs,
-                             is_leaf=lambda x: isinstance(x, P))
+            grads = jax.tree.map(_reduce, grads, specs,
+                                 is_leaf=lambda x: isinstance(x, P))
+        else:
+            from triton_dist_tpu.kernels.hierarchical import (
+                hier_grad_allreduce)
+
+            assert dp_axis is not None, "hier_dp_fast_axis needs dp_axis"
+            fast = hier_dp_fast_axis
+
+            def _mentions(spec, axis):
+                for e in spec:
+                    if isinstance(e, (tuple, list)):
+                        if axis in e:
+                            return True
+                    elif e == axis:
+                        return True
+                return False
+
+            leaves, treedef = jax.tree.flatten(grads)
+            spec_leaves = jax.tree.flatten(
+                specs, is_leaf=lambda x: isinstance(x, P))[0]
+            # Bucketed leaves (fast-replicated): their fast-axis masking
+            # psum FUSES into the two-tier reduction — the hier pass IS
+            # sum over (fast, dp), so _pre must not pre-sum fast (doing
+            # both double-counts by a factor of T).  Fast-sharded leaves
+            # (already 1/T bytes) psum straight across dp.
+            bucket_set = {i for i, s in enumerate(spec_leaves)
+                          if not _mentions(s, fast)}
+
+            def _pre(i, g, spec):
+                skip = ({dp_axis, fast} if i in bucket_set else {dp_axis})
+                axes = tuple(a for a in mesh_axes
+                             if not _mentions(spec, a) and a not in skip)
+                return jax.lax.psum(g, axes) if axes else g
+
+            leaves = [_pre(i, g, s) for i, (g, s)
+                      in enumerate(zip(leaves, spec_leaves))]
+            bucket_ix = sorted(bucket_set)
+            if bucket_ix:
+                bucket = hier_grad_allreduce(
+                    [leaves[i] for i in bucket_ix], slow_axis=dp_axis,
+                    fast_axis=fast, interpret=interpret)
+                for i, g in zip(bucket_ix, bucket):
+                    leaves[i] = g
+            leaves = [g if i in bucket_set else jax.lax.psum(g, dp_axis)
+                      for i, g in enumerate(leaves)]
+            grads = jax.tree.unflatten(treedef, leaves)
         new_params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype),
                                   params, grads)
         return new_params, loss

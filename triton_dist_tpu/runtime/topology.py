@@ -128,7 +128,7 @@ def n_slices() -> int:
 
 
 def create_hybrid_mesh(ici_axes: dict[str, int] | None = None,
-                       dcn_axis: str = "dcn"):
+                       dcn_axis: str = "dcn", n_slow: int | None = None):
     """Build a (dcn, *ici) mesh where the leading axis crosses slices.
 
     Real multi-slice TPU: delegates to ``mesh_utils.create_hybrid_device_mesh``
@@ -136,6 +136,11 @@ def create_hybrid_mesh(ici_axes: dict[str, int] | None = None,
     process boundary plays the slice boundary (processes are connected by
     gRPC/gloo, the test-world DCN), falling back to a plain split when
     single-process.
+
+    ``n_slow`` overrides the slow-tier width — single-process virtual
+    rigs (the driver's multichip gate) use it to SIMULATE a 2-slice
+    deployment: the mesh then has the hybrid SHAPE and the hierarchical
+    programs compile against it, with the actual slow wire absent.
 
     Reference analog: the nnodes x local_world topology of launch.sh +
     NVSHMEM teams; here it is just a mesh whose leading axis is the slow
@@ -151,7 +156,9 @@ def create_hybrid_mesh(ici_axes: dict[str, int] | None = None,
     # boundary plays that role (gRPC/gloo between procs).  A single-slice
     # multi-host TPU pod has NO slow tier — all hosts share one ICI fabric —
     # so n_slow collapses to 1 there (keeps axis_is_dcn consistent).
-    if slices > 1:
+    if n_slow is not None:
+        pass  # caller-pinned (virtual-rig simulation)
+    elif slices > 1:
         n_slow = slices
     elif devices[0].platform != "tpu":
         n_slow = max(n_proc, 1)
@@ -170,9 +177,17 @@ def create_hybrid_mesh(ici_axes: dict[str, int] | None = None,
             devices=devices)
         dev_array = dev_array.reshape((n_slow,) + tuple(ici_axes.values()))
     else:
-        # process-major ordering: jax.devices() already groups by process
-        assert n_slow * n_fast == len(devices), (n_slow, n_fast, len(devices))
-        dev_array = np.asarray(devices).reshape(
+        # process-major ordering: jax.devices() already groups by process.
+        # A prefix is only safe on a SINGLE-process virtual rig (the
+        # driver gate's 2x interpreter-starvation headroom); in a real
+        # multi-process world a short prefix would silently drop whole
+        # processes from the mesh — keep the loud exact-match there.
+        n_need = n_slow * n_fast
+        if n_proc <= 1:
+            assert n_need <= len(devices), (n_slow, n_fast, len(devices))
+        else:
+            assert n_need == len(devices), (n_slow, n_fast, len(devices))
+        dev_array = np.asarray(devices[:n_need]).reshape(
             (n_slow,) + tuple(ici_axes.values()))
     return Mesh(dev_array, (dcn_axis,) + tuple(ici_axes.keys()))
 
