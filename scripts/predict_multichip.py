@@ -164,6 +164,47 @@ def main():
     print("  falsifier: if measured step time exceeds compute by >5%, "
           "the scan is not overlapping the permute")
 
+    print("\n## Bidirectional fused 1-axis producers (r5; ring_mode='bidir')")
+    # WIRE-bound decode-time TP shape: tiny M AND modest n_loc — the
+    # per-step GEMM (HBM-bound: B re-streams every step) must be cheaper
+    # than the segment's one-direction wire time, else the ring hides
+    # either way.  Uni ring drives ONE link direction (BW/2 of the
+    # axis); bidir splits each segment's halves across both.
+    M_dec, N_dec, TP1 = 256, 1024, 8
+    m_l, n_l = M_dec // TP1, N_dec // TP1
+    seg_bytes = m_l * K * 2
+    b_bytes = K * n_l * 2
+    uni_step = seg_bytes / (V5P_AXIS_GBPS / 2 * 1e9) * 1e3   # one direction
+    bidir_step = (seg_bytes / 2) / (V5P_AXIS_GBPS / 2 * 1e9) * 1e3
+    # Per-step GEMM floors: at tiny m_loc both kernels run one row-block
+    # per pipeline invocation, so B re-streams ONCE per invocation — the
+    # bidir step's TWO half-GEMMs pay B twice (the honest B-restream
+    # term; at large m_loc the row-block counts equalize and the factor
+    # vanishes).
+    gemm_uni = max(2 * m_l * n_l * K / (V5P_TFLOPS * 1e12),
+                   (m_l * K + b_bytes + m_l * n_l * 2) / 2765e9) * 1e3
+    gemm_bid = max(2 * m_l * n_l * K / (V5P_TFLOPS * 1e12),
+                   (m_l * K + 2 * b_bytes + m_l * n_l * 2) / 2765e9) * 1e3
+    uni_tot = max(uni_step, gemm_uni)
+    bid_tot = max(bidir_step, gemm_bid)
+    print(f"  shape: M={M_dec} (decode microbatch), K={K}, N={N_dec}, "
+          f"TP={TP1}, 1 axis")
+    print(f"  per-step wire, uni ring  : {fmt(uni_step)}  (one direction)")
+    print(f"  per-step wire, bidir     : {fmt(bidir_step)}   (2.00x — "
+          "both directions)")
+    print(f"  per-step GEMM floor      : {fmt(gemm_uni)} uni / "
+          f"{fmt(gemm_bid)} bidir (bidir's two half-GEMMs re-stream B "
+          "twice at tiny m_loc)")
+    print(f"  predicted step time      : {fmt(uni_tot)} uni -> "
+          f"{fmt(bid_tot)} bidir ({uni_tot / bid_tot:.2f}x end-to-end; "
+          "needs wire >> the B-restream-doubled GEMM floor, i.e. "
+          "n_loc small — larger N flips bidir to a LOSS at tiny M)")
+    print("  world-1 overhead         : nil by construction (bidir "
+          "dispatches to the aliased world-1 path)")
+    print("  falsifier: paired uni/bidir at this shape reading < 1.5x "
+          "means the directions' DMAs serialize on the engine; a loss "
+          "at LARGE N tiny M is the B-restream term, not the links")
+
     print("\n## Zigzag causal ring layout (r5; same shape, world=8)")
     # Step time follows the SLOWEST device (bulk-synchronous ring); work
     # units = one full S_loc x S_loc block pair.

@@ -97,3 +97,36 @@ def test_gemm_rs_int8_exact(mesh4, key):
     assert c.dtype == jnp.int32
     ref = np.asarray(a, np.int32) @ np.asarray(b, np.int32)
     np.testing.assert_array_equal(np.asarray(c), ref)
+
+
+@pytest.mark.parametrize("world_fix", ["mesh4", "mesh8"])
+def test_gemm_rs_bidir_matches_xla(world_fix, key, request):
+    """r5 bidirectional ring: mirrored half-column ring reductions in
+    opposite directions == the uni ring / XLA at world 4 and 8."""
+    mesh = request.getfixturevalue(world_fix)
+    w = mesh.shape["tp"]
+    m, n, k = 16 * w, 256, 128 * w
+    a, b = _make_inputs(mesh, key, m, n, k, jnp.float32)
+    ctx = create_gemm_rs_context(
+        mesh, impl="pallas", interpret=True, ring_mode="bidir",
+        config=MatmulConfig(block_m=8, block_n=128, block_k=128),
+    )
+    c = gemm_rs(a, b, ctx)
+    assert_allclose(c, _ref(a, b, jnp.float32), atol=1e-4, rtol=1e-4)
+
+
+def test_gemm_rs_bidir_under_comm_noise(mesh4, key):
+    """Both directions' slot/credit flow control under adversarial comm
+    timing."""
+    import triton_dist_tpu.language as dl
+
+    m, n, k = 64, 256, 512
+    a, b = _make_inputs(mesh4, key, m, n, k, jnp.float32)
+    ctx = create_gemm_rs_context(
+        mesh4, impl="pallas", interpret=True, ring_mode="bidir",
+        config=MatmulConfig(block_m=8, block_n=128, block_k=128),
+    )
+    clean = np.asarray(gemm_rs(a, b, ctx))
+    with dl.for_correctness():
+        noisy = np.asarray(gemm_rs(a, b, ctx))
+    np.testing.assert_array_equal(clean, noisy)

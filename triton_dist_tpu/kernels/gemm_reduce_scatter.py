@@ -75,6 +75,8 @@ class GEMMReduceScatterContext:
     axis: str = "tp"
     impl: str = "auto"
     config: MatmulConfig = field(default_factory=MatmulConfig)
+    # "bidir" (r5): mirrored half-column rings in both link directions.
+    ring_mode: str = "uni"
     interpret: bool = False
 
     @property
@@ -83,10 +85,12 @@ class GEMMReduceScatterContext:
 
 
 def create_gemm_rs_context(mesh, axis="tp", impl="auto", config=None,
+                           ring_mode="uni",
                            interpret=False) -> GEMMReduceScatterContext:
     return GEMMReduceScatterContext(
         mesh=mesh, axis=axis, impl=impl,
-        config=config or MatmulConfig(), interpret=interpret,
+        config=config or MatmulConfig(), ring_mode=ring_mode,
+        interpret=interpret,
     )
 
 
@@ -196,6 +200,127 @@ def _gemm_rs_kernel(
         n_credit_waits = max(world - 3, 0)
         pltpu.semaphore_wait(credit_sem, (world - 1) - n_credit_waits)
 
+
+
+def _gemm_rs_bidir_kernel(
+    a_ref,        # [M, k_loc]            ANY
+    b_ref,        # [k_loc, N]            ANY
+    out_ref,      # [m_loc, N]            ANY, output: reduced C chunk
+    send_r_ref,   # [2, m_loc, N/2]       ANY, scratch (rightward ring)
+    recv_r_ref,   # [2, m_loc, N/2]
+    send_l_ref,   # [2, m_loc, N/2]       (leftward ring)
+    recv_l_ref,
+    send_sem_r, recv_sem_r, send_sem_l, recv_sem_l,
+    credit_r, credit_l,
+    acc_ref,      # VMEM (bm, bn) f32
+    *,
+    axis, world, m_loc, bm, bn, bk,
+):
+    """Bidirectional ring GEMM-RS (r5, VERDICT r4 next#5): the N columns
+    split in half and each half runs the proven 1-D ring-RS schedule in
+    OPPOSITE directions — column half 0's partials travel rightward
+    (chunk (me-1-s), fold from the left) and half 1's leftward (the
+    mirror: chunk (me+1+s), fold from the right) — so both ICI link
+    directions carry [m_loc, N/2] per step: per-step wire halves on a
+    1-axis mesh.  Per-direction staging/landing slots, DMA semaphores,
+    and credit semaphores keep the two rings' flow control independent
+    (a shared semaphore could let one direction's completion satisfy the
+    other's wait).  Reference analog: its bidirectional/2D producer
+    variants (allgather.py:194-258) applied to the RS consumer.
+    """
+    me = jax.lax.axis_index(axis)
+    right = jax.lax.rem(me + 1, world)
+    left = jax.lax.rem(me + world - 1, world)
+
+    k_loc = a_ref.shape[1]
+    N = b_ref.shape[1]
+    nh = N // 2
+    n_m, n_n, n_k = m_loc // bm, nh // bn, k_loc // bk
+
+    inner_gemm = pltpu.emit_pipeline(
+        functools.partial(gemm_pipeline_body, n_k=n_k,
+                          out_dtype=out_ref.dtype),
+        grid=(n_m, n_n, n_k),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+        ],
+        out_specs=[pl.BlockSpec((bm, bn), lambda i, j, k: (i, j))],
+    )
+    inner_add = pltpu.emit_pipeline(
+        _add_body,
+        grid=(n_m, n_n),
+        in_specs=[
+            pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+            pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        ],
+        out_specs=[pl.BlockSpec((bm, bn), lambda i, j: (i, j))],
+    )
+
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(barrier, inc=1, device_id={axis: left},
+                           device_id_type=pltpu.DeviceIdType.MESH)
+    pltpu.semaphore_signal(barrier, inc=1, device_id={axis: right},
+                           device_id_type=pltpu.DeviceIdType.MESH)
+    pltpu.semaphore_wait(barrier, 2)
+
+    # Per-direction ring state: (send_ref, recv_ref, send_sem, recv_sem,
+    # credit_sem, dst neighbor, credit peer, column offset, chunk sign).
+    dirs = (
+        (send_r_ref, recv_r_ref, send_sem_r, recv_sem_r, credit_r,
+         right, left, 0, -1),
+        (send_l_ref, recv_l_ref, send_sem_l, recv_sem_l, credit_l,
+         left, right, nh, +1),
+    )
+
+    def chunk_of(s, sign):
+        # sign -1: rightward schedule (me-1-s); +1: leftward (me+1+s).
+        if s == world - 1:
+            return me
+        return jax.lax.rem(me + sign * (1 + s) + 2 * world, world)
+
+    for s in range(world):
+        p = s % 2
+        last = s == world - 1
+
+        dsts = []
+        for (snd, rcv, ssem, rsem, credit, nbr, peer, coff, sign) in dirs:
+            if s >= 2:
+                pltpu.make_async_copy(snd.at[p], snd.at[p],
+                                      ssem.at[p]).wait()
+            chunk = chunk_of(s, sign)
+            dst = (out_ref.at[:, pl.ds(coff, nh)] if last
+                   else snd.at[p])
+            # Partial GEMM for this direction's chunk and column half —
+            # overlaps both directions' in-flight recv DMAs.
+            inner_gemm(a_ref.at[pl.ds(chunk * m_loc, m_loc)],
+                       b_ref.at[:, pl.ds(coff, nh)], dst,
+                       scratches=(acc_ref,))
+            dsts.append(dst)
+
+        for di, (snd, rcv, ssem, rsem, credit, nbr, peer, coff,
+                 sign) in enumerate(dirs):
+            if s >= 1:
+                pltpu.make_async_copy(rcv.at[p], rcv.at[p],
+                                      rsem.at[p]).wait()
+                inner_add(rcv.at[p], dsts[di], dsts[di])
+                pltpu.semaphore_signal(
+                    credit, inc=1, device_id={axis: peer},
+                    device_id_type=pltpu.DeviceIdType.MESH)
+            if not last:
+                if s >= 2:
+                    pltpu.semaphore_wait(credit, 1)
+                dl.remote_copy(snd.at[p], rcv.at[(s + 1) % 2],
+                               ssem.at[p], rsem.at[(s + 1) % 2],
+                               axis, nbr).start()
+
+    # Final drains, per direction (mirrors _gemm_rs_kernel's epilogue).
+    pfin = (world - 2) % 2
+    n_credit_waits = max(world - 3, 0)
+    for (snd, rcv, ssem, rsem, credit, nbr, peer, coff, sign) in dirs:
+        pltpu.make_async_copy(snd.at[pfin], snd.at[pfin],
+                              ssem.at[pfin]).wait()
+        pltpu.semaphore_wait(credit, (world - 1) - n_credit_waits)
 
 
 def _torus_gemm_rs_kernel(
@@ -530,7 +655,7 @@ def _torus_gemm_rs_shard(a_shard, b_shard, *, axes, impl, bm, bn, bk,
 
 
 def gemm_rs_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
-                  bk=None, interpret=False):
+                  bk=None, ring_mode="uni", interpret=False):
     """Per-device GEMM-RS; call inside shard_map.  Returns the reduced chunk.
     Block sizes default to the swept MatmulConfig (gemm.py).
 
@@ -541,6 +666,11 @@ def gemm_rs_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
     second ring idled half the links).  Device (i, j) ends with flat band
     ``i * wy + j`` (axes-major), so the host reassembles C with natural
     ``P(axes)`` out_specs (see :func:`gemm_rs`).
+
+    ``ring_mode="bidir"`` (r5): the two column halves run mirrored ring
+    reductions in opposite directions — both 1-axis link directions busy,
+    ~2x per-step wire (``_gemm_rs_bidir_kernel``); falls back to "uni"
+    when N/2 cannot tile by 128.
     """
     _cfg = MatmulConfig()
     bm, bn, bk = bm or _cfg.block_m, bn or _cfg.block_n, bk or _cfg.block_k
@@ -587,6 +717,43 @@ def gemm_rs_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
             return matmul_i8(a_shard, b_shard)
         return jnp.dot(a_shard, b_shard,
                        preferred_element_type=jnp.float32).astype(out_dtype)
+
+    if (ring_mode == "bidir" and world > 1
+            and N % 2 == 0 and (N // 2) % 128 == 0):
+        nh = N // 2
+        bm_h = largest_divisor_block(m_loc, bm, 8)
+        bn_h = largest_divisor_block(nh, bn, 128)
+        bk_h = largest_divisor_block(k_loc, bk, 128)
+        out, _, _, _, _ = pl.pallas_call(
+            functools.partial(
+                _gemm_rs_bidir_kernel, axis=axis, world=world,
+                m_loc=m_loc, bm=bm_h, bn=bn_h, bk=bk_h,
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((m_loc, N), out_dtype),
+                jax.ShapeDtypeStruct((2, m_loc, nh), out_dtype),
+                jax.ShapeDtypeStruct((2, m_loc, nh), out_dtype),
+                jax.ShapeDtypeStruct((2, m_loc, nh), out_dtype),
+                jax.ShapeDtypeStruct((2, m_loc, nh), out_dtype),
+            ],
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
+            out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 5,
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.REGULAR,
+                pltpu.SemaphoreType.REGULAR,
+                pltpu.VMEM((bm_h, bn_h), acc_dtype),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                collective_id=GEMM_RS_COLLECTIVE_ID,
+            ),
+            interpret=maybe_interpret(interpret),
+        )(a_shard, b_shard)
+        return out
 
     bm = largest_divisor_block(m_loc, bm, 8)
     bn = largest_divisor_block(N, bn, 128)
@@ -641,7 +808,7 @@ def gemm_rs(a, b, ctx: GEMMReduceScatterContext):
         out_spec,
         axis=tuple(axis) if isinstance(axis, list) else axis, impl=ctx.impl,
         bm=cfg.block_m, bn=cfg.block_n, bk=cfg.block_k,
-        interpret=ctx.interpret,
+        ring_mode=ctx.ring_mode, interpret=ctx.interpret,
     )
     # Launch metadata (reference: launch_metadata hooks report flops/bytes,
     # gemm_reduce_scatter.py).  Per-device: [M, k_loc] x [k_loc, N] MXU
@@ -669,15 +836,25 @@ from triton_dist_tpu.autotuner import autotune as _autotune
 # additionally crosses in its ring-forward chunk axis, which GEMM-RS
 # does not have.)
 from triton_dist_tpu.kernels.allgather_gemm import (
-    OVERLAP_BLOCK_SPACE as GEMM_RS_TUNE_SPACE,
+    OVERLAP_BLOCK_SPACE as _OVERLAP_BLOCK_SPACE,
+)
+from triton_dist_tpu.autotuner import Config as _RsCfg
+
+# The shared block space plus the r5 bidirectional ring alternative.
+GEMM_RS_TUNE_SPACE = (
+    list(_OVERLAP_BLOCK_SPACE)
+    + [_RsCfg(bm=1024, bn=512, bk=512, ring_mode="bidir"),
+       _RsCfg(bm=512, bn=512, bk=512, ring_mode="bidir")]
 )
 
 
 @_autotune(configs=GEMM_RS_TUNE_SPACE, key=())
-def _gemm_rs_tunable(a, b, *, ctx, bm=None, bn=None, bk=None):
+def _gemm_rs_tunable(a, b, *, ctx, bm=None, bn=None, bk=None,
+                     ring_mode="uni"):
     tuned = GEMMReduceScatterContext(
         mesh=ctx.mesh, axis=ctx.axis, impl=ctx.impl,
-        config=MatmulConfig(bm, bn, bk), interpret=ctx.interpret)
+        config=MatmulConfig(bm, bn, bk), ring_mode=ring_mode,
+        interpret=ctx.interpret)
     return gemm_rs(a, b, tuned)
 
 
