@@ -1,0 +1,76 @@
+"""HBM stream-ceiling probe at the decode working set (r5, VERDICT r4
+next#7: floor-prove the B=32 decode "wash").
+
+Streams the exact KV byte set of a decode step through a bare two-einsum
+XLA program (per-iteration GEMV against a value-dependent query — no
+softmax, no PV weighting, nothing the decode kernel does beyond reading):
+the time is the machine's ACHIEVABLE stream rate for this access
+pattern, against which the decode kernels' "gap to the 819 GB/s
+theoretical floor" must be judged.
+
+r5 measurement (B=32 Hq=32 Hkv=8 S=8192 bf16, docs/perf.md):
+  probe 1517.8 us (707 GB/s)  >  pallas decode 1420.3 us (756 GB/s)
+— the decode kernel out-streams a bare XLA reduction over the same
+bytes; the residual ~8% to the theoretical floor is the memory system's
+efficiency ceiling, not kernel overhead.
+
+Run: python scripts/bench_stream_probe.py [--batch 32] [--trials 9]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from scripts.benchlib import RUN_SEED, rotated_paired_bench
+
+HKV, S, D = 8, 8192, 128
+
+
+def make_chain(n, k, v):
+    @jax.jit
+    def chain(q, k_, v_):
+        def body(i, qq):
+            qh = qq[:, 0].astype(jnp.bfloat16)               # [B, D]
+            a = jnp.einsum("bd,bhsd->bhs", qh, k_,
+                           preferred_element_type=jnp.float32)
+            b2 = jnp.einsum("bd,bhsd->bhs", qh, v_,
+                            preferred_element_type=jnp.float32)
+            red = jnp.sum(a + b2, axis=2)                    # [B, HKV]
+            return qq * 0.999 + (red[:, :4, None] * 1e-8).astype(qq.dtype)
+        return jnp.sum(jax.lax.fori_loop(0, n, body, q).astype(jnp.float32))
+    return chain
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--trials", type=int, default=9)
+    args = ap.parse_args()
+    B = args.batch
+    k = jax.random.normal(jax.random.key(1), (B, HKV, S, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(2), (B, HKV, S, D), jnp.bfloat16)
+    q0 = jax.random.normal(jax.random.key(0), (B, 4, D), jnp.bfloat16)
+    short, long = make_chain(32, k, v), make_chain(288, k, v)
+    float(short(q0, k, v))
+    float(long(q0, k, v))
+    chains = {"stream": (short, long, (k, v))}
+
+    def fresh(t):
+        return jax.random.normal(jax.random.key(RUN_SEED + t), (B, 4, D),
+                                 jnp.bfloat16)
+
+    res = rotated_paired_bench(chains, fresh, 256, trials=args.trials)
+    us = res["stream"][0] * 1e6
+    gb = 2 * B * HKV * S * D * 2 / 1e9
+    print(f"B={B}: pure KV stream+GEMV {us:.1f} us/pass "
+          f"(iqr {res['stream'][1] * 1e6:.1f}) -> "
+          f"{gb / (us / 1e6):.0f} GB/s achieved of 819 peak")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,173 @@
+"""Experiment (r5, VERDICT r4 next#8): bound the flash-prefill ceiling.
+
+The r4 claim: causal flash prefill runs 102-107 TFLOPS (~55% MXU) and
+that is "the expected ceiling for D=128 attention".  This experiment
+tests the claim instead of asserting it: a TWIN of ``_flash_kernel``
+with the SAME grid, block specs, causal whole-block skip, and BOTH MXU
+matmuls (QK^T and P@V) — but NO softmax (P is the raw logits cast back
+to bf16; no row max, no exp, no l/m updates, no rescale).  The twin's
+rate is the MXU-feed ceiling of this block structure; the gap between
+it and the real kernel is the VPU-softmax interleave cost.
+
+  twin >> real kernel  ->  VPU softmax stalls the MXU: block headroom
+  twin ~= real kernel  ->  the 55% IS the feed ceiling (rank-128
+                           contractions cannot keep the MXU busier)
+
+Both run in ONE rotated trial loop (benchlib protocol).
+
+Run on the real chip: python scripts/exp_prefill_ceiling.py [--trials 9]
+"""
+
+import argparse
+import functools
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from scripts.benchlib import RUN_SEED, rotated_paired_bench
+from triton_dist_tpu.kernels.flash_attention import (
+    _block_live,
+    flash_attention,
+)
+from triton_dist_tpu.language.interpret import maybe_interpret
+
+B, HQ, HKV, D = 1, 32, 8, 128
+BQ, BK = 128, 1024  # the shipped defaults (docs/perf.md)
+
+
+def _nosoftmax_kernel(qoffs_ref, koffs_ref, q_ref, k_ref, v_ref, out_ref,
+                      acc_ref, *, bq, bk, n_k, scale, group):
+    """_flash_kernel with the VPU softmax deleted: same grid, same specs,
+    same causal block skip, both matmuls — P = raw logits cast to bf16."""
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    iq = pl.program_id(2)
+    q_start = qoffs_ref[iq]
+    k_start = koffs_ref[ik]
+
+    def body():
+        q = q_ref[0, 0].reshape(group * bq, -1)
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        pv = jax.lax.dot_general(
+            logits.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] + pv.reshape(group, bq, -1)
+
+    pl.when(_block_live(q_start, k_start, causal=True, window=0,
+                        bq=bq, bk=bk))(body)
+
+    @pl.when(ik == n_k - 1)
+    def _():
+        out_ref[0, 0] = acc_ref[:].astype(out_ref.dtype)
+
+
+def nosoftmax_attention(q, k, v):
+    Bq, Hq, Sq, Dd = q.shape
+    Hkv, Sk = k.shape[1], k.shape[2]
+    g = Hq // Hkv
+    n_q, n_k = Sq // BQ, Sk // BK
+    qg = q.reshape(Bq, Hkv, g, Sq, Dd)
+    qoffs = jnp.arange(n_q, dtype=jnp.int32) * BQ
+    koffs = jnp.arange(n_k, dtype=jnp.int32) * BK
+    out = pl.pallas_call(
+        functools.partial(_nosoftmax_kernel, bq=BQ, bk=BK, n_k=n_k,
+                          scale=1.0 / Dd ** 0.5, group=g),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(Bq, Hkv, n_q, n_k),
+            in_specs=[
+                pl.BlockSpec((1, 1, g, BQ, Dd),
+                             lambda b, h, i, j, qo, ko: (b, h, 0, i, 0)),
+                pl.BlockSpec((1, 1, BK, Dd),
+                             lambda b, h, i, j, qo, ko: (b, h, j, 0)),
+                pl.BlockSpec((1, 1, BK, Dd),
+                             lambda b, h, i, j, qo, ko: (b, h, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, g, BQ, Dd),
+                             lambda b, h, i, j, qo, ko: (b, h, 0, i, 0)),
+            ],
+            scratch_shapes=[pltpu.VMEM((g, BQ, Dd), jnp.float32)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((Bq, Hkv, g, Sq, Dd), q.dtype)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=maybe_interpret(False),
+    )(qoffs, koffs, qg, k, v)[0]
+    return out.reshape(Bq, Hq, Sq, Dd)
+
+
+def make_chain(n_iters, variant):
+    @jax.jit
+    def chain(q, k, v):
+        def body(_, qq):
+            if variant == "real":
+                out = flash_attention(qq, k, v, causal=True,
+                                      impl="pallas", block_q=BQ,
+                                      block_k=BK)
+            else:
+                out = nosoftmax_attention(qq, k, v)
+            # Magnitude control: raw-logit P grows values fast; rescale.
+            return (out * 1e-3).astype(qq.dtype)
+
+        return jnp.sum(jax.lax.fori_loop(0, n_iters, body, q)
+                       .astype(jnp.float32))
+
+    return chain
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=8192)
+    ap.add_argument("--trials", type=int, default=9)
+    args = ap.parse_args()
+    S = args.seq
+
+    ks = jax.random.split(jax.random.key(0), 3)
+    q0 = jax.random.normal(ks[0], (B, HQ, S, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, HKV, S, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, HKV, S, D), jnp.bfloat16)
+
+    n_short, n_long = 4, 20
+    chains = {}
+    for variant in ("real", "nosoftmax"):
+        short = make_chain(n_short, variant)
+        long = make_chain(n_long, variant)
+        float(short(q0, k, v))
+        float(long(q0, k, v))
+        chains[variant] = (short, long, (k, v))
+
+    def fresh_q(t):
+        return jax.random.normal(jax.random.key(RUN_SEED + t),
+                                 (B, HQ, S, D), jnp.bfloat16)
+
+    res = rotated_paired_bench(chains, fresh_q, n_long - n_short,
+                               trials=args.trials)
+    # Causal live FLOPs: two matmuls over ~half the (q, k) block pairs.
+    flops = 2 * 2 * B * HQ * S * S * D / 2
+    print(f"S={S} B={B} Hq={HQ} Hkv={HKV} D={D}, blocks bq={BQ} bk={BK}:")
+    for variant, (t, iqr) in res.items():
+        print(f"  {variant:10s}: {t * 1e3:7.2f} ms/step (IQR "
+              f"{iqr * 1e3:5.2f}) -> {flops / t / 1e12:6.1f} TFLOPS")
+    ratio = res["real"][0] / res["nosoftmax"][0]
+    print(f"  real/nosoftmax time ratio: {ratio:.3f} — "
+          f"{'VPU softmax stalls the MXU (headroom)' if ratio > 1.15 else 'the feed ceiling is real (softmax rides under the matmuls)'}")
+
+
+if __name__ == "__main__":
+    main()

@@ -658,9 +658,13 @@ def ag_gemm_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
 
     ``ring_mode="bidir"`` (r5): segment halves ring both directions
     concurrently (``_ag_gemm_bidir_kernel``) — ~2x per-step wire on a
-    1-axis mesh; falls back to "uni" when the half-segment cannot tile
-    (m_loc/2 % 8) and is mutually exclusive with ``wire_dtype``/
-    ``chunks > 1`` (the half split IS the sub-chunking)."""
+    1-axis mesh.  Mutually exclusive with ``wire_dtype``/``chunks > 1``
+    (loud ValueError: the half split IS the sub-chunking).  Falls back
+    to the uni/torus schedule SILENTLY when the mode cannot apply:
+    half-segment untileable (m_loc/2 % 8), int8 inputs (the i32 ring
+    epilogue is not half-split), multi-axis meshes (the torus schedule
+    already drives every link direction — bidir would be a downgrade),
+    and world 1 (the aliased path; overhead nil)."""
     _cfg = MatmulConfig()
     bm, bn, bk = bm or _cfg.block_m, bn or _cfg.block_n, bk or _cfg.block_k
     if ring_mode == "bidir" and (wire_dtype is not None or chunks > 1):

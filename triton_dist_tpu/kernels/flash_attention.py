@@ -95,6 +95,24 @@ def _block_live(q_start, k_start, *, causal, window, bq, bk):
     return live
 
 
+def _block_full(q_start, k_start, *, causal, window, bq, bk):
+    """Whole-block FULL-visibility predicate matching
+    :func:`_visibility_mask`: True when EVERY (qpos, kpos) pair in the
+    block is visible — such blocks route to a mask-free kernel body (r5:
+    the ceiling experiment showed the per-element mask build, not the
+    MXU feed, bounds the causal prefill; at bq=128/bk=1024 ~7 of 8 live
+    causal blocks qualify).  Shared by the bf16/int8 kernels so the
+    routing can never diverge from the mask itself."""
+    full = True
+    if causal:
+        # every row's last visible key covers the whole block
+        full = q_start >= k_start + (bk - 1)
+    if window:
+        # ...and the earliest row's window still reaches column 0
+        full = full & ((q_start + (bq - 1)) - k_start < window)
+    return full
+
+
 def _flash_kernel(qoffs_ref, koffs_ref, q_ref, k_ref, v_ref, out_ref,
                   lse_ref, acc_ref, m_ref, l_ref, *, bq, bk, n_k, causal,
                   scale, group, soft_cap=0.0, window=0):
@@ -124,7 +142,7 @@ def _flash_kernel(qoffs_ref, koffs_ref, q_ref, k_ref, v_ref, out_ref,
     q_start = qoffs_ref[iq]               # global position of q row 0
     k_start = koffs_ref[ik]               # global position of k row 0
 
-    def body():
+    def body(masked):
         q = q_ref[0, 0].reshape(group * bq, -1)           # [G*bq, D]
         k = k_ref[0, 0]                                   # [bk, D]
         v = v_ref[0, 0]                                   # [bk, D]
@@ -134,8 +152,11 @@ def _flash_kernel(qoffs_ref, koffs_ref, q_ref, k_ref, v_ref, out_ref,
             preferred_element_type=jnp.float32).reshape(
                 group, bq, bk) * scale                    # [G, bq, bk]
         logits = apply_soft_cap(logits, soft_cap)
+        # (A base-2 exp fold — exp2 with log2e in the scale — measured
+        # NO gain here: Mosaic already lowers exp that way.  r5 ceiling
+        # experiment, scripts/exp_prefill_ceiling.py.)
 
-        if causal or window:
+        if masked:
             mask = _visibility_mask(q_start, k_start, causal=causal,
                                     window=window, group=group, bq=bq,
                                     bk=bk)
@@ -146,7 +167,7 @@ def _flash_kernel(qoffs_ref, koffs_ref, q_ref, k_ref, v_ref, out_ref,
         # m only grows; rows with nothing visible yet stay at NEG_INF and
         # exp(NEG - NEG) = 1 would poison them — mask p explicitly.
         p = jnp.exp(logits - m_new[..., None])            # [G, bq, bk]
-        if causal or window:
+        if masked:
             p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_cur - m_new)                    # [G, bq]
         m_ref[:] = m_new
@@ -160,11 +181,18 @@ def _flash_kernel(qoffs_ref, koffs_ref, q_ref, k_ref, v_ref, out_ref,
 
     if causal or window:
         # Skip blocks with no visible (qpos, kpos) pair — their DMAs
-        # already streamed; compute is the prefill bottleneck.
-        pl.when(_block_live(q_start, k_start, causal=causal,
-                            window=window, bq=bq, bk=bk))(body)
+        # already streamed; compute is the prefill bottleneck.  Among
+        # the LIVE blocks, route fully-visible ones to the MASK-FREE
+        # body (the r5 ceiling fix, scripts/exp_prefill_ceiling.py:
+        # +7.5% paired; see _block_full).
+        live = _block_live(q_start, k_start, causal=causal,
+                           window=window, bq=bq, bk=bk)
+        full = _block_full(q_start, k_start, causal=causal,
+                           window=window, bq=bq, bk=bk)
+        pl.when(live & full)(functools.partial(body, False))
+        pl.when(live & jnp.logical_not(full))(functools.partial(body, True))
     else:
-        body()
+        body(False)
 
     @pl.when(ik == n_k - 1)
     def _():
@@ -199,7 +227,7 @@ def _flash_kernel_i8(qoffs_ref, koffs_ref, q_ref, k_ref, v_ref, ks_ref,
     q_start = qoffs_ref[iq]
     k_start = koffs_ref[ik]
 
-    def body():
+    def body(masked):
         q = q_ref[0, 0].reshape(group * bq, -1)           # [G*bq, D]
         k = k_ref[0, 0].astype(q.dtype)                   # [bk, D] i8→q
         v = v_ref[0, 0].astype(q.dtype)
@@ -212,7 +240,7 @@ def _flash_kernel_i8(qoffs_ref, koffs_ref, q_ref, k_ref, v_ref, ks_ref,
         logits = (logits * (ksc[None, :] * scale)).reshape(group, bq, bk)
         logits = apply_soft_cap(logits, soft_cap)
 
-        if causal or window:
+        if masked:
             mask = _visibility_mask(q_start, k_start, causal=causal,
                                     window=window, group=group, bq=bq,
                                     bk=bk)
@@ -221,7 +249,7 @@ def _flash_kernel_i8(qoffs_ref, koffs_ref, q_ref, k_ref, v_ref, ks_ref,
         m_cur = m_ref[:]
         m_new = jnp.maximum(m_cur, jnp.max(logits, axis=-1))
         p = jnp.exp(logits - m_new[..., None])
-        if causal or window:
+        if masked:
             p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_cur - m_new)
         m_ref[:] = m_new
@@ -234,10 +262,15 @@ def _flash_kernel_i8(qoffs_ref, koffs_ref, q_ref, k_ref, v_ref, ks_ref,
                       + pv.reshape(group, bq, -1))
 
     if causal or window:
-        pl.when(_block_live(q_start, k_start, causal=causal,
-                            window=window, bq=bq, bk=bk))(body)
+        # Mask-free routing for fully-visible blocks (see _block_full).
+        live = _block_live(q_start, k_start, causal=causal,
+                           window=window, bq=bq, bk=bk)
+        full = _block_full(q_start, k_start, causal=causal,
+                           window=window, bq=bq, bk=bk)
+        pl.when(live & full)(functools.partial(body, False))
+        pl.when(live & jnp.logical_not(full))(functools.partial(body, True))
     else:
-        body()
+        body(False)
 
     @pl.when(ik == n_k - 1)
     def _():

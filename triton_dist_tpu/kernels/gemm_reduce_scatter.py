@@ -669,8 +669,10 @@ def gemm_rs_shard(a_shard, b_shard, *, axis, impl, bm=None, bn=None,
 
     ``ring_mode="bidir"`` (r5): the two column halves run mirrored ring
     reductions in opposite directions — both 1-axis link directions busy,
-    ~2x per-step wire (``_gemm_rs_bidir_kernel``); falls back to "uni"
-    when N/2 cannot tile by 128.
+    ~2x per-step wire (``_gemm_rs_bidir_kernel``).  Falls back to the
+    uni/torus schedule SILENTLY when the mode cannot apply: N/2 not
+    lane-tileable (% 128), multi-axis meshes (the torus schedule already
+    drives every link direction), and world 1.
     """
     _cfg = MatmulConfig()
     bm, bn, bk = bm or _cfg.block_m, bn or _cfg.block_n, bk or _cfg.block_k
