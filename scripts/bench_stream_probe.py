@@ -31,7 +31,7 @@ from scripts.benchlib import RUN_SEED, rotated_paired_bench
 HKV, S, D = 8, 8192, 128
 
 
-def make_chain(n, k, v):
+def make_chain(n):
     @jax.jit
     def chain(q, k_, v_):
         def body(i, qq):
@@ -55,7 +55,7 @@ def main():
     k = jax.random.normal(jax.random.key(1), (B, HKV, S, D), jnp.bfloat16)
     v = jax.random.normal(jax.random.key(2), (B, HKV, S, D), jnp.bfloat16)
     q0 = jax.random.normal(jax.random.key(0), (B, 4, D), jnp.bfloat16)
-    short, long = make_chain(32, k, v), make_chain(288, k, v)
+    short, long = make_chain(32), make_chain(288)
     float(short(q0, k, v))
     float(long(q0, k, v))
     chains = {"stream": (short, long, (k, v))}

@@ -171,6 +171,17 @@ def main():
         flash_attention, causal=True, impl="pallas"))(
             qp, kp8, kp8, k_scale=kps, v_scale=kps))
 
+    # 7c''. int8 scale-plane WHOLE-ARRAY escape (r5, ADVICE r4): bk == Sk
+    # with (Sk//128) % 8 != 0 gives a [2, 128] f32 scale block — legal
+    # only as a whole-array block, which interpret mode cannot validate.
+    ks256 = jax.random.normal(key, (2, 2, 256, 128), jnp.float32)
+    kq256, ksc256 = _qkv(ks256)
+    q256 = jax.random.normal(key, (2, 4, 128, 128), jnp.bfloat16)
+    check("flash_prefill_i8_smallS", lambda: jax.jit(functools.partial(
+        flash_attention, causal=True, impl="pallas",
+        q_offset=128))(q256, kq256, kq256, k_scale=ksc256,
+                       v_scale=ksc256))
+
     # 7d. flash backward (dq + dkv kernels through the custom VJP)
     check("flash_bwd", lambda: jax.jit(jax.grad(
         lambda q_: jnp.sum(flash_attention(
