@@ -300,13 +300,16 @@ def _flash_kernel_i8(qoffs_ref, koffs_ref, q_ref, k_ref, v_ref, ks_ref,
 
 def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, q_start,
                     k_start, *, causal, scale, group, bq, bk,
-                    soft_cap=0.0, window=0):
+                    soft_cap=0.0, window=0, masked=True):
     """Shared backward block math: recompute P from (q, k, lse) and form
     dS — the one place the masking/NEG_INF rules live for both backward
     kernels.  Returns (p, ds) [G, bq, bk] f32 plus the flat q/do views.
 
     exp may produce inf in lanes the mask discards (fully-masked rows
     carry lse = NEG_INF); the where keeps them out of the matmuls.
+    ``masked=False`` (r5): the caller proved the whole block fully
+    visible (`_block_full`) — skip the per-element mask build, the same
+    routing as the forward kernels.
     """
     q = q_ref[0, 0].reshape(group * bq, -1)               # [G*bq, D]
     k = k_ref[0, 0]                                       # [bk, D]
@@ -326,7 +329,7 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, q_start,
         s = s_raw
         dcap = None
     e = jnp.exp(s - lse[..., None])
-    if causal or window:
+    if masked and (causal or window):
         p = jnp.where(_visibility_mask(q_start, k_start, causal=causal,
                                        window=window, group=group, bq=bq,
                                        bk=bk), e, 0.0)
@@ -355,12 +358,12 @@ def _flash_bwd_dq_kernel(qoffs_ref, koffs_ref, q_ref, k_ref, v_ref,
     q_start = qoffs_ref[iq]
     k_start = koffs_ref[ik]
 
-    def body():
+    def body(masked):
         k = k_ref[0, 0]                                   # [bk, D]
         _, ds, _, _ = _recompute_p_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, q_start,
             k_start, causal=causal, scale=scale, group=group, bq=bq, bk=bk,
-            soft_cap=soft_cap, window=window)
+            soft_cap=soft_cap, window=window, masked=masked)
         upd = jax.lax.dot_general(
             ds.reshape(group * bq, bk).astype(k.dtype), k,
             (((1,), (0,)), ((), ())),
@@ -368,10 +371,14 @@ def _flash_bwd_dq_kernel(qoffs_ref, koffs_ref, q_ref, k_ref, v_ref,
         acc_ref[:] = acc_ref[:] + upd.reshape(group, bq, -1)
 
     if causal or window:
-        pl.when(_block_live(q_start, k_start, causal=causal,
-                            window=window, bq=bq, bk=bk))(body)
+        live = _block_live(q_start, k_start, causal=causal,
+                           window=window, bq=bq, bk=bk)
+        full = _block_full(q_start, k_start, causal=causal,
+                           window=window, bq=bq, bk=bk)
+        pl.when(live & full)(functools.partial(body, False))
+        pl.when(live & jnp.logical_not(full))(functools.partial(body, True))
     else:
-        body()
+        body(False)
 
     @pl.when(ik == n_k - 1)
     def _():
@@ -393,11 +400,11 @@ def _flash_bwd_dkv_kernel(qoffs_ref, koffs_ref, q_ref, k_ref, v_ref,
     q_start = qoffs_ref[iq]
     k_start = koffs_ref[ikb]
 
-    def body():
+    def body(masked):
         p, ds, q, do = _recompute_p_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, q_start,
             k_start, causal=causal, scale=scale, group=group, bq=bq, bk=bk,
-            soft_cap=soft_cap, window=window)
+            soft_cap=soft_cap, window=window, masked=masked)
         # dv_j = sum_i p_ij do_i  — contract over the G*bq row axis.
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p.reshape(group * bq, bk).astype(do.dtype), do,
@@ -417,9 +424,12 @@ def _flash_bwd_dkv_kernel(qoffs_ref, koffs_ref, q_ref, k_ref, v_ref,
         # ...and only from q rows whose window still reaches it.
         live = live & (q_start < k_start + (bk - 1) + window)
     if causal or window:
-        pl.when(live)(body)
+        full = _block_full(q_start, k_start, causal=causal,
+                           window=window, bq=bq, bk=bk)
+        pl.when(live & full)(functools.partial(body, False))
+        pl.when(live & jnp.logical_not(full))(functools.partial(body, True))
     else:
-        body()
+        body(False)
 
     @pl.when(iq == n_q - 1)
     def _():
