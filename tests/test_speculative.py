@@ -115,3 +115,74 @@ def test_sampler_reproducible_and_in_vocab(mesh4, key):
     assert a.shape == (1, 8)
     assert 0 <= int(jnp.min(a)) and int(jnp.max(a)) < tcfg.vocab
     assert 0.0 <= stats["accept_rate"] <= 1.0
+
+
+def test_batched_speculative_is_exact_greedy(key):
+    """r5 batched loop: B rows with an independent draft — every row's
+    output equals the target's own greedy decode (per-row accept counts
+    diverge the cache lengths; the batched verify pass scores each row
+    against its OWN length through the q_lens decode kernel)."""
+    from jax.sharding import Mesh
+
+    tcfg, dcfg = _target_cfg(), _draft_cfg()
+    k1, k2 = jax.random.split(key)
+    t_params = init_params(tcfg, k1)
+    d_params = init_params(dcfg, k2)
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    tgt = Generator(tcfg, mesh1, axis="tp", max_seq=64)
+    drf = Generator(dcfg, mesh1, axis="tp", max_seq=64)
+    B = 3
+    prompt = jax.random.randint(key, (B, 5), 0, tcfg.vocab, jnp.int32)
+
+    ref, _ = tgt.generate(t_params, tgt.prefill(t_params, prompt), 10)
+
+    spec = SpeculativeGenerator(tgt, drf, k=3)
+    toks, stats = spec.generate(t_params, d_params, prompt, 10)
+    assert toks.shape == (B, 10)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+    assert stats["proposed"] > 0 and stats["target_passes"] >= 1
+
+
+def test_batched_speculative_identical_draft(key):
+    """Draft == target at B > 1: every proposal accepted on every row."""
+    from jax.sharding import Mesh
+
+    cfg = _target_cfg()
+    params = init_params(cfg, key)
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    tgt = Generator(cfg, mesh1, axis="tp", max_seq=64)
+    drf = Generator(cfg, mesh1, axis="tp", max_seq=64)
+    prompt = jax.random.randint(key, (2, 6), 0, cfg.vocab, jnp.int32)
+
+    ref, _ = tgt.generate(params, tgt.prefill(params, prompt), 12)
+    spec = SpeculativeGenerator(tgt, drf, k=4)
+    toks, stats = spec.generate(params, params, prompt, 12)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+    assert stats["accept_rate"] == 1.0, stats
+
+
+def test_batched_speculative_moe_target(key):
+    """MoE target at B > 1: the cached _verify_jit carries the MoE ffn
+    hook — output equals the MoE generator's own greedy decode."""
+    from jax.sharding import Mesh
+
+    from triton_dist_tpu.models import moe
+    from triton_dist_tpu.models.generate_moe import MoEGenerator
+
+    mcfg = moe.MoEConfig(vocab=64, dim=64, n_layers=2, n_heads=4,
+                         n_kv_heads=2, n_experts=4, topk=2,
+                         expert_ffn_dim=32, max_seq=64, block_m=8,
+                         dtype=jnp.float32)
+    dcfg = _draft_cfg()
+    k1, k2 = jax.random.split(key)
+    t_params = moe.init_params(mcfg, k1)
+    d_params = init_params(dcfg, k2)
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    tgt = MoEGenerator(mcfg, mesh1, axis="tp", max_seq=64)
+    drf = Generator(dcfg, mesh1, axis="tp", max_seq=64)
+    prompt = jax.random.randint(key, (2, 5), 0, mcfg.vocab, jnp.int32)
+
+    ref, _ = tgt.generate(t_params, tgt.prefill(t_params, prompt), 8)
+    spec = SpeculativeGenerator(tgt, drf, k=3)
+    toks, _ = spec.generate(t_params, d_params, prompt, 8)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))

@@ -15,7 +15,7 @@ TUTORIALS = sorted(glob.glob(os.path.join(REPO, "tutorials", "[0-9]*.py")))
 
 
 def test_tutorials_exist():
-    assert len(TUTORIALS) == 15
+    assert len(TUTORIALS) == 16
 
 
 @pytest.mark.parametrize("path", TUTORIALS,

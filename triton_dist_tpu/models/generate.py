@@ -80,6 +80,14 @@ class Generator:
                               interpret=interpret, mesh=mesh, axis=axis),
             static_argnames=("quantized", "extent"),
             donate_argnums=(2,))
+        # Batched speculative-verify pass (r5): per-row cache lengths
+        # through the multi-token decode kernel; cached here so serving
+        # loops don't recompile per generate() call.  MoEGenerator
+        # rebuilds it with its ffn hook.
+        self._verify_jit = jax.jit(
+            functools.partial(_verify_forward, cfg=cfg, impl=impl,
+                              interpret=interpret),
+            donate_argnums=(2,))
         self._step_jit = jax.jit(self._step_impl)
 
     # -- prefill ----------------------------------------------------------
@@ -442,6 +450,78 @@ def _chunk_forward(params, chunk, caches, prefix_len, *, cfg: LlamaConfig,
         h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps).reshape(
             B * c, cfg.dim)
         x = x + ffn(h2, layer).reshape(B, c, cfg.dim)
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return new_caches, jnp.dot(x, params["lm_head"],
+                               preferred_element_type=jnp.float32)
+
+
+def _rope_rows(x, pos, theta):
+    """RoPE with PER-ROW positions: x [B, T, H, hd]; pos [B, T] int32."""
+    hd = x.shape[-1]
+    freqs = 1.0 / (theta ** (np.arange(0, hd, 2, dtype=np.float32) / hd))
+    ang = pos[..., None].astype(jnp.float32) * freqs      # [B, T, hd/2]
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
+def _write_rows(cache, new, offs):
+    """Per-row chunk write: cache [B, Hkv, S, D] <- new [B, Hkv, T, D] at
+    row offsets offs [B] (each request's own cache length)."""
+    def per(c, n, o):
+        return jax.lax.dynamic_update_slice(c, n.astype(c.dtype), (0, o, 0))
+
+    return jax.vmap(per)(cache, new, offs)
+
+
+def _verify_forward(params, chunk, caches, kv_lens, *, cfg: LlamaConfig,
+                    impl: str = "auto", interpret: bool = False,
+                    ffn=None):
+    """Batched speculative-verify forward (r5): score chunk [B, T] draft
+    tokens against PER-ROW cache lengths ``kv_lens`` [B] in one pass.
+
+    The per-row machinery `_chunk_forward` cannot express (its
+    ``prefix_len`` is one scalar): RoPE at positions kv_lens[b] + t,
+    K/V written at per-row offsets, and attention through the
+    MULTI-TOKEN decode kernel (q_lens path — query t of row b sits at
+    global position kv_lens[b] + t, exactly the kernel's
+    ``pos < wlen - (T-1-t)`` rule).  Returns (new_caches,
+    logits [B, T, V]).  World-1, float caches (the batch-1 path keeps
+    full SP + int8 support via `_chunk_forward`).
+    """
+    from triton_dist_tpu.kernels.flash_decode import gqa_decode_shard
+
+    if ffn is None:
+        ffn = _dense_prompt_ffn
+    B, T = chunk.shape
+    hd = cfg.head_dim
+    x = params["embed"][chunk]                        # [B, T, D]
+    pos = kv_lens[:, None] + jnp.arange(T, dtype=jnp.int32)[None]
+    new_caches = []
+    for li, layer in enumerate(params["layers"]):
+        k_c, v_c = caches[li]
+        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        h2 = h.reshape(B * T, cfg.dim)
+        q = (h2 @ layer["wq"]).reshape(B, T, cfg.n_heads, hd)
+        k = (h2 @ layer["wk"]).reshape(B, T, cfg.n_kv_heads, hd)
+        v = (h2 @ layer["wv"]).reshape(B, T, cfg.n_kv_heads, hd)
+        q = _rope_rows(q, pos, cfg.rope_theta)
+        k = _rope_rows(k, pos, cfg.rope_theta)
+        k_c = _write_rows(k_c, k.transpose(0, 2, 1, 3), kv_lens)
+        v_c = _write_rows(v_c, v.transpose(0, 2, 1, 3), kv_lens)
+        new_caches.append((k_c, v_c))
+        o, _ = gqa_decode_shard(q, k_c, v_c, kv_lens + T, impl=impl,
+                                interpret=interpret,
+                                soft_cap=cfg.attn_soft_cap,
+                                window=cfg.attn_window)
+        o = o.reshape(B * T, cfg.n_heads * hd).astype(cfg.dtype)
+        x = x + (o @ layer["wo"]).reshape(B, T, cfg.dim)
+        h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps).reshape(
+            B * T, cfg.dim)
+        x = x + ffn(h2, layer).reshape(B, T, cfg.dim)
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     return new_caches, jnp.dot(x, params["lm_head"],
                                preferred_element_type=jnp.float32)

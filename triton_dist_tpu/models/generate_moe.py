@@ -137,7 +137,10 @@ class MoEGenerator(Generator):
                          interpret=interpret, kv_dtype=kv_dtype)
         self._prefill_jit = jax.jit(functools.partial(
             _moe_prompt_forward, cfg=cfg, impl=impl, interpret=interpret))
-        from triton_dist_tpu.models.generate import _chunk_forward
+        from triton_dist_tpu.models.generate import (
+            _chunk_forward,
+            _verify_forward,
+        )
         self._chunk_jit = jax.jit(
             functools.partial(_chunk_forward, cfg=cfg,
                               ffn=functools.partial(_moe_prompt_ffn,
@@ -145,6 +148,12 @@ class MoEGenerator(Generator):
                               impl=impl, interpret=interpret,
                               mesh=mesh, axis=axis),
             static_argnames=("quantized", "extent"),
+            donate_argnums=(2,))
+        self._verify_jit = jax.jit(
+            functools.partial(_verify_forward, cfg=cfg,
+                              ffn=functools.partial(_moe_prompt_ffn,
+                                                    cfg=cfg),
+                              impl=impl, interpret=interpret),
             donate_argnums=(2,))
 
     def _ffn(self, x, layer):

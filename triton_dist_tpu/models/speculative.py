@@ -79,20 +79,27 @@ def speculative_accept_chain(pis, rhos, proposals, bonus_pi, key):
 
 @jax.jit
 def greedy_accept_chain(proposals, st_logits, logits_all):
-    """Greedy accept ON DEVICE: expected[i] is the target argmax at
-    position i (independent of acceptance), m = length of the matching
-    prefix, tokens[:m+1] = accepted prefix + the correct greedy token at
-    position m.  One transfer per round, bit-identical to the host loop.
-    """
-    k = proposals.shape[0]
-    expected = jnp.concatenate([
-        _greedy(st_logits),                       # position 0
-        _greedy(logits_all[0, :k]),               # positions 1..k
-    ])                                            # [k+1]
-    matches = (proposals == expected[:k]).astype(jnp.int32)
-    m = jnp.sum(jnp.cumprod(matches))
-    toks = jnp.where(jnp.arange(k + 1) == m, expected,
-                     jnp.concatenate([proposals, proposals[-1:]]))
+    """Greedy accept ON DEVICE — the B=1 view of
+    :func:`greedy_accept_chain_batched` (ONE accept rule, two shapes):
+    proposals [k], st_logits [1, V], logits_all [1, k, V]; returns
+    (m scalar, toks [k+1])."""
+    m, toks = greedy_accept_chain_batched(proposals[None], st_logits,
+                                          logits_all)
+    return m[0], toks[0]
+
+
+@jax.jit
+def greedy_accept_chain_batched(proposals, st_logits, logits_all):
+    """Per-row greedy accept (r5 batched verify): proposals [B, k],
+    st_logits [B, V] (pre-round), logits_all [B, k, V].  Returns
+    (m [B], toks [B, k+1]) — row b emits toks[b, :m[b]+1]."""
+    B, k = proposals.shape
+    expected = jnp.concatenate(
+        [_greedy(st_logits)[:, None], _greedy(logits_all)], axis=1)
+    matches = (proposals == expected[:, :k]).astype(jnp.int32)
+    m = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)     # [B]
+    ext = jnp.concatenate([proposals, proposals[:, -1:]], axis=1)
+    toks = jnp.where(jnp.arange(k + 1)[None] == m[:, None], expected, ext)
     return m, toks
 
 
@@ -142,9 +149,17 @@ class _SpeculativeBase:
         self.k = int(k)
 
     def generate(self, t_params, d_params, prompt, n_new: int, key=None):
-        """Decode ``n_new`` tokens for ``prompt`` [1, S0].  Returns
-        (tokens [1, n_new], stats with target_passes / accept_rate)."""
-        assert prompt.shape[0] == 1, "speculative v1 is batch-1"
+        """Decode ``n_new`` tokens for ``prompt`` [B, S0].  Returns
+        (tokens [B, n_new], stats with target_passes / accept_rate).
+
+        B > 1 (r5): greedy verification only — per-row accept counts
+        diverge the cache lengths, and the batched verify pass scores
+        every row's k drafts against its OWN length in one multi-token
+        decode call (`generate._verify_forward` + the q_lens kernel).
+        World-1 float caches; the batch-1 path keeps full SP + int8."""
+        if prompt.shape[0] > 1:
+            return self._generate_batched(t_params, d_params, prompt,
+                                          n_new, key)
         st = self.target.prefill(t_params, prompt)
         sd = self.draft.prefill(d_params, prompt)
 
@@ -209,11 +224,98 @@ class _SpeculativeBase:
         }
         return tokens, stats
 
+    def _generate_batched(self, t_params, d_params, prompt, n_new, key):
+        raise NotImplementedError(
+            "batched speculative decoding is greedy-only "
+            "(SpeculativeGenerator); rejection sampling remains batch-1")
+
 
 class SpeculativeGenerator(_SpeculativeBase):
     """Greedy verifier: output is bit-identical to the target's greedy
     decode; the draft only changes how many target passes are needed
     (up to k+1 tokens per pass when the draft agrees)."""
+
+    def _generate_batched(self, t_params, d_params, prompt, n_new, key):
+        """Batched greedy speculative loop (r5): rows propose in
+        lockstep, ONE multi-token verify pass scores all rows against
+        their own (diverging) cache lengths, accepts apply per row."""
+        del key  # greedy
+        tgt, drf = self.target, self.draft
+        assert tgt.attn.world == 1 and drf.attn.world == 1, (
+            "batched speculative verify is world-1 (batch-1 keeps SP)")
+        assert not tgt.attn.quantized, (
+            "batched speculative verify needs a float target cache")
+        B = prompt.shape[0]
+        st = tgt.prefill(t_params, prompt)
+        sd = drf.prefill(d_params, prompt)
+        verify = tgt._verify_jit  # cached on the Generator (no
+        # per-call recompile; carries the Generator's impl + ffn hook)
+
+        out = [[] for _ in range(B)]
+        n_target_passes = n_proposed = n_accepted = 0
+        while min(len(o) for o in out) < n_new:
+            top = int(jnp.max(st.kv_lens))
+            k = min(self.k, tgt.max_seq - 1 - top,
+                    drf.max_seq - 1 - int(jnp.max(sd.kv_lens)))
+            if k <= 0:
+                token = _greedy(st.last_logits)           # [B]
+                for b, t in enumerate(np.asarray(token)):
+                    out[b].append(int(t))
+                if min(len(o) for o in out) < n_new:
+                    st = tgt.step(t_params, st, token)
+                    n_target_passes += 1
+                continue
+
+            # 1. Draft proposes k tokens for every row (its cache and
+            # lengths advance per row).
+            props = []
+            for _ in range(k):
+                tok = _greedy(sd.last_logits)             # [B]
+                sd = drf.step(d_params, sd, tok)
+                props.append(tok)
+            proposals = jnp.stack(props, axis=1)          # [B, k]
+            n_proposed += B * k
+
+            # 2. ONE batched verify pass at per-row lengths.
+            L = st.kv_lens
+            new_caches, logits_all = verify(t_params, proposals,
+                                            st.caches, L)
+            n_target_passes += 1
+
+            # 3. Per-row greedy accept; emit toks[b, :m_b+1].
+            m_dev, toks = greedy_accept_chain_batched(
+                proposals, st.last_logits, logits_all)
+            m_np, toks_np = jax.device_get((m_dev, toks))
+            for b in range(B):
+                out[b].extend(int(t) for t in
+                              toks_np[b, :int(m_np[b]) + 1])
+            n_accepted += int(m_np.sum())
+
+            # 4. Roll both models to the per-row accepted lengths and
+            # consume each row's round-closing token via a regular step.
+            closing = jnp.take_along_axis(
+                toks, m_dev[:, None], axis=1)[:, 0]       # [B]
+            last = jnp.where(
+                (m_dev > 0)[:, None],
+                jnp.take_along_axis(
+                    logits_all, jnp.maximum(m_dev - 1, 0)[:, None, None],
+                    axis=1)[:, 0],
+                st.last_logits)
+            st = GenerationState(caches=new_caches, kv_lens=L + m_dev,
+                                 last_logits=last)
+            st = tgt.step(t_params, st, closing)
+            sd = GenerationState(caches=sd.caches, kv_lens=L + m_dev,
+                                 last_logits=sd.last_logits)
+            sd = drf.step(d_params, sd, closing)
+
+        tokens = jnp.asarray([o[:n_new] for o in out], jnp.int32)
+        stats = {
+            "target_passes": n_target_passes,
+            "proposed": n_proposed,
+            "accepted": n_accepted,
+            "accept_rate": n_accepted / max(n_proposed, 1),
+        }
+        return tokens, stats
 
     def _propose(self, d_params, sd, k, key):
         proposals = []
