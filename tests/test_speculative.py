@@ -186,3 +186,82 @@ def test_batched_speculative_moe_target(key):
     spec = SpeculativeGenerator(tgt, drf, k=3)
     toks, _ = spec.generate(t_params, d_params, prompt, 8)
     np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+
+
+def test_batched_sampler_identical_draft_accepts_all(key):
+    """Rejection sampling at B > 1 with draft == target: pi == rho so
+    every proposal accepts on every row (ratio = 1), and the loop's
+    per-row bookkeeping holds."""
+    from jax.sharding import Mesh
+
+    from triton_dist_tpu.models.speculative import SpeculativeSampler
+
+    cfg = _target_cfg()
+    params = init_params(cfg, key)
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    tgt = Generator(cfg, mesh1, axis="tp", max_seq=64)
+    drf = Generator(cfg, mesh1, axis="tp", max_seq=64)
+    prompt = jax.random.randint(key, (3, 5), 0, cfg.vocab, jnp.int32)
+
+    spec = SpeculativeSampler(tgt, drf, k=3, temperature=0.8, top_k=20)
+    toks, stats = spec.generate(params, params, prompt, 10,
+                                key=jax.random.key(7))
+    toks = np.asarray(toks)
+    assert toks.shape == (3, 10)
+    assert ((0 <= toks) & (toks < cfg.vocab)).all()
+    assert stats["accept_rate"] == 1.0, stats
+
+
+def test_batched_sampler_independent_draft_runs(key):
+    """Independent draft at B > 1: valid tokens, sane stats (the
+    distributional identity is the vmapped per-step rule, unit-tested
+    by Monte Carlo in test_sampling)."""
+    from jax.sharding import Mesh
+
+    from triton_dist_tpu.models.speculative import SpeculativeSampler
+
+    tcfg, dcfg = _target_cfg(), _draft_cfg()
+    k1, k2 = jax.random.split(key)
+    t_params = init_params(tcfg, k1)
+    d_params = init_params(dcfg, k2)
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    tgt = Generator(tcfg, mesh1, axis="tp", max_seq=64)
+    drf = Generator(dcfg, mesh1, axis="tp", max_seq=64)
+    prompt = jax.random.randint(key, (2, 4), 0, tcfg.vocab, jnp.int32)
+
+    spec = SpeculativeSampler(tgt, drf, k=3, temperature=1.0)
+    toks, stats = spec.generate(t_params, d_params, prompt, 8,
+                                key=jax.random.key(11))
+    toks = np.asarray(toks)
+    assert toks.shape == (2, 8)
+    assert ((0 <= toks) & (toks < tcfg.vocab)).all()
+    assert 0.0 <= stats["accept_rate"] <= 1.0
+
+
+def test_batched_tight_max_seq_no_overflow(key):
+    """The review-caught crash: max_seq provisioned for exactly
+    S0 + n_new must survive lockstep rounds where fast rows would
+    otherwise out-run their budget while a slow row catches up —
+    per-row retirement freezes finished rows' caches and emission
+    clamps to remaining room."""
+    from jax.sharding import Mesh
+
+    tcfg = LlamaConfig(vocab=64, dim=64, n_layers=2, n_heads=4,
+                       n_kv_heads=2, ffn_dim=128, max_seq=21,
+                       dtype=jnp.float32)
+    dcfg = LlamaConfig(vocab=64, dim=32, n_layers=1, n_heads=2,
+                       n_kv_heads=2, ffn_dim=32, max_seq=21,
+                       dtype=jnp.float32)
+    k1, k2 = jax.random.split(key)
+    t_params = init_params(tcfg, k1)
+    d_params = init_params(dcfg, k2)
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    S0, n_new = 5, 16                       # max_seq == S0 + n_new
+    tgt = Generator(tcfg, mesh1, axis="tp", max_seq=S0 + n_new)
+    drf = Generator(dcfg, mesh1, axis="tp", max_seq=S0 + n_new)
+    prompt = jax.random.randint(key, (3, S0), 0, tcfg.vocab, jnp.int32)
+
+    ref, _ = tgt.generate(t_params, tgt.prefill(t_params, prompt), n_new)
+    spec = SpeculativeGenerator(tgt, drf, k=4)
+    toks, _ = spec.generate(t_params, d_params, prompt, n_new)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))

@@ -142,26 +142,40 @@ class Generator:
 
     # -- decode -----------------------------------------------------------
 
-    def step(self, params, state: GenerationState, token) -> GenerationState:
+    def step(self, params, state: GenerationState, token,
+             active=None) -> GenerationState:
         """One decode step: token [B] int32 → next state.
+
+        ``active`` [B] bool (optional, r5): rows with ``active[b] ==
+        False`` are FROZEN — their cache length does not advance (the
+        dummy K/V write lands in the dead slot at ``kv_lens[b]``, masked
+        by length; at ``kv_lens[b] == max_seq`` the owner check makes it
+        a no-op).  The batched speculative loop retires finished rows
+        this way so lockstep rounds cannot overflow a tightly
+        provisioned cache.
 
         Raises on cache overflow when lengths are concrete (a dropped
         append would silently leave attention reading stale zero rows);
         jit-traced callers must bound steps themselves (``generate`` does).
         """
         if not isinstance(state.kv_lens, jax.core.Tracer):
-            top = int(jnp.max(state.kv_lens))
+            lens = state.kv_lens
+            if active is not None:
+                lens = jnp.where(active, lens, -1)  # frozen rows exempt
+            top = int(jnp.max(lens))
             if top >= self.max_seq:
                 raise ValueError(
                     f"KV cache overflow: decode at position {top} but "
                     f"max_seq={self.max_seq}")
         new_caches, kv_lens, logits = self._step_jit(
-            params, state.caches, state.kv_lens, token)
+            params, state.caches, state.kv_lens, token, active)
         return GenerationState(caches=new_caches, kv_lens=kv_lens,
                                last_logits=logits)
 
-    def _step_impl(self, params, caches, kv_lens, token):
+    def _step_impl(self, params, caches, kv_lens, token, active=None):
         cfg = self.cfg
+        inc = (jnp.ones_like(kv_lens) if active is None
+               else active.astype(kv_lens.dtype))
         new_caches = []
         x = params["embed"][token]  # [B, D]
         for li, layer in enumerate(params["layers"]):
@@ -173,7 +187,7 @@ class Generator:
             q = _rope_at(q, kv_lens, cfg.rope_theta)
             k = _rope_at(k, kv_lens, cfg.rope_theta)
             k_c, v_c = self.attn.append_kv(k_c, v_c, k, v, kv_lens)
-            o = self.attn(q, k_c, v_c, kv_lens + 1)  # [B, Hq, hd]
+            o = self.attn(q, k_c, v_c, kv_lens + inc)  # [B, Hq, hd]
             x = x + (o.reshape(o.shape[0], -1).astype(cfg.dtype)
                      @ layer["wo"])
             h = _rms_norm(x[:, None], layer["mlp_norm"], cfg.norm_eps)[:, 0]
@@ -184,7 +198,7 @@ class Generator:
         x = _rms_norm(x[:, None], params["final_norm"], cfg.norm_eps)[:, 0]
         logits = jnp.dot(x, params["lm_head"],
                          preferred_element_type=jnp.float32)
-        return new_caches, kv_lens + 1, logits
+        return new_caches, kv_lens + inc, logits
 
     def generate(self, params, state: GenerationState, n_new: int,
                  sample=None, key=None, eos_id: int | None = None):

@@ -170,8 +170,10 @@ class MoEGenerator(Generator):
         return fn(x, layer["router"], layer["w_gate"], layer["w_up"],
                   layer["w_down"])
 
-    def _step_impl(self, params, caches, kv_lens, token):
+    def _step_impl(self, params, caches, kv_lens, token, active=None):
         cfg = self.cfg
+        inc = (jnp.ones_like(kv_lens) if active is None
+               else active.astype(kv_lens.dtype))
         new_caches = []
         x = params["embed"][token]  # [B, D]
         for li, layer in enumerate(params["layers"]):
@@ -183,7 +185,7 @@ class MoEGenerator(Generator):
             q = _rope_at(q, kv_lens, cfg.rope_theta)
             k = _rope_at(k, kv_lens, cfg.rope_theta)
             k_c, v_c = self.attn.append_kv(k_c, v_c, k, v, kv_lens)
-            o = self.attn(q, k_c, v_c, kv_lens + 1)  # [B, Hq, hd]
+            o = self.attn(q, k_c, v_c, kv_lens + inc)  # [B, Hq, hd]
             x = x + (o.reshape(o.shape[0], -1).astype(cfg.dtype)
                      @ layer["wo"])
             h = _rms_norm(x[:, None], layer["mlp_norm"], cfg.norm_eps)[:, 0]
@@ -192,4 +194,4 @@ class MoEGenerator(Generator):
         x = _rms_norm(x[:, None], params["final_norm"], cfg.norm_eps)[:, 0]
         logits = jnp.dot(x, params["lm_head"],
                          preferred_element_type=jnp.float32)
-        return new_caches, kv_lens + 1, logits
+        return new_caches, kv_lens + inc, logits

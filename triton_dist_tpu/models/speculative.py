@@ -152,11 +152,12 @@ class _SpeculativeBase:
         """Decode ``n_new`` tokens for ``prompt`` [B, S0].  Returns
         (tokens [B, n_new], stats with target_passes / accept_rate).
 
-        B > 1 (r5): greedy verification only — per-row accept counts
-        diverge the cache lengths, and the batched verify pass scores
-        every row's k drafts against its OWN length in one multi-token
-        decode call (`generate._verify_forward` + the q_lens kernel).
-        World-1 float caches; the batch-1 path keeps full SP + int8."""
+        B > 1 (r5): per-row accept counts diverge the cache lengths; the
+        batched verify pass scores every row's k drafts against its OWN
+        length in one multi-token decode call (`generate._verify_forward`
+        + the q_lens kernel).  Both strategies: greedy stays bit-exact
+        per row; rejection sampling vmaps the accept chain with per-row
+        subkeys.  World-1 float caches; batch-1 keeps full SP + int8."""
         if prompt.shape[0] > 1:
             return self._generate_batched(t_params, d_params, prompt,
                                           n_new, key)
@@ -224,22 +225,30 @@ class _SpeculativeBase:
         }
         return tokens, stats
 
+    # -- batched (B > 1) strategy hooks --------------------------------
+    # Contract mirrors the batch-1 one, row-vectorized:
+    # - ``_propose_batched(d_params, sd, k, key, active) ->
+    #   (proposals [B, k], aux, sd, key)`` — ``active`` [B] bool rides
+    #   into the draft steps so frozen rows' caches stay frozen
+    # - ``_verify_batched(st_logits [B, V], logits_all [B, k, V],
+    #   proposals, aux, key) -> (m [B] device, toks [B, k+1] device,
+    #   key)`` — row b emits toks[b, :m_b+1]
+    # - ``_fallback_batched(logits [B, V], key) -> (tokens [B], key)``
+
+    def _propose_batched(self, d_params, sd, k, key, active=None):
+        raise NotImplementedError
+
+    def _verify_batched(self, st_logits, logits_all, proposals, aux, key):
+        raise NotImplementedError
+
+    def _fallback_batched(self, logits, key):
+        raise NotImplementedError
+
     def _generate_batched(self, t_params, d_params, prompt, n_new, key):
-        raise NotImplementedError(
-            "batched speculative decoding is greedy-only "
-            "(SpeculativeGenerator); rejection sampling remains batch-1")
-
-
-class SpeculativeGenerator(_SpeculativeBase):
-    """Greedy verifier: output is bit-identical to the target's greedy
-    decode; the draft only changes how many target passes are needed
-    (up to k+1 tokens per pass when the draft agrees)."""
-
-    def _generate_batched(self, t_params, d_params, prompt, n_new, key):
-        """Batched greedy speculative loop (r5): rows propose in
-        lockstep, ONE multi-token verify pass scores all rows against
-        their own (diverging) cache lengths, accepts apply per row."""
-        del key  # greedy
+        """Batched speculative loop (r5): rows propose in lockstep, ONE
+        multi-token verify pass (`generate._verify_forward` + the q_lens
+        decode kernel) scores all rows against their own (diverging)
+        cache lengths, accepts apply per row."""
         tgt, drf = self.target, self.draft
         assert tgt.attn.world == 1 and drf.attn.world == 1, (
             "batched speculative verify is world-1 (batch-1 keeps SP)")
@@ -254,27 +263,36 @@ class SpeculativeGenerator(_SpeculativeBase):
         out = [[] for _ in range(B)]
         n_target_passes = n_proposed = n_accepted = 0
         while min(len(o) for o in out) < n_new:
-            top = int(jnp.max(st.kv_lens))
+            # Per-row RETIREMENT: finished rows freeze (cache length
+            # stops advancing, emissions stop) so a fast row cannot
+            # overflow a cache provisioned for exactly n_new while the
+            # lockstep loop waits on a slow row; active rows' emissions
+            # clamp to their remaining room for the same reason —
+            # emitted tokens and consumed cache slots stay 1:1 per row.
+            room = np.array([n_new - len(o) for o in out])
+            act_np = room > 0
+            active = jnp.asarray(act_np)
+            n_act = int(act_np.sum())
+            top = int(jnp.max(jnp.where(active, st.kv_lens, -1)))
             k = min(self.k, tgt.max_seq - 1 - top,
-                    drf.max_seq - 1 - int(jnp.max(sd.kv_lens)))
+                    drf.max_seq - 1
+                    - int(jnp.max(jnp.where(active, sd.kv_lens, -1))))
             if k <= 0:
-                token = _greedy(st.last_logits)           # [B]
+                token, key = self._fallback_batched(st.last_logits, key)
                 for b, t in enumerate(np.asarray(token)):
-                    out[b].append(int(t))
+                    if act_np[b]:
+                        out[b].append(int(t))
                 if min(len(o) for o in out) < n_new:
-                    st = tgt.step(t_params, st, token)
+                    st = tgt.step(t_params, st, token, active=active)
                     n_target_passes += 1
                 continue
 
             # 1. Draft proposes k tokens for every row (its cache and
-            # lengths advance per row).
-            props = []
-            for _ in range(k):
-                tok = _greedy(sd.last_logits)             # [B]
-                sd = drf.step(d_params, sd, tok)
-                props.append(tok)
-            proposals = jnp.stack(props, axis=1)          # [B, k]
-            n_proposed += B * k
+            # lengths advance per row; frozen rows' drafts are ignored
+            # and rolled back below).
+            proposals, aux, sd, key = self._propose_batched(
+                d_params, sd, k, key, active)
+            n_proposed += n_act * k
 
             # 2. ONE batched verify pass at per-row lengths.
             L = st.kv_lens
@@ -282,31 +300,37 @@ class SpeculativeGenerator(_SpeculativeBase):
                                             st.caches, L)
             n_target_passes += 1
 
-            # 3. Per-row greedy accept; emit toks[b, :m_b+1].
-            m_dev, toks = greedy_accept_chain_batched(
-                proposals, st.last_logits, logits_all)
+            # 3. Per-row accept, clamped to each row's remaining room
+            # (the emitted prefix of the accept chain stays valid under
+            # truncation: every kept token was accepted).
+            m_dev, toks, key = self._verify_batched(
+                st.last_logits, logits_all, proposals, aux, key)
             m_np, toks_np = jax.device_get((m_dev, toks))
+            m_used = np.where(act_np,
+                              np.minimum(np.asarray(m_np), room - 1), 0)
             for b in range(B):
-                out[b].extend(int(t) for t in
-                              toks_np[b, :int(m_np[b]) + 1])
-            n_accepted += int(m_np.sum())
+                if act_np[b]:
+                    out[b].extend(int(t) for t in
+                                  toks_np[b, :int(m_used[b]) + 1])
+            # Stats count RAW accepts (draft quality); emission/cache use
+            # the room-clamped m_used.
+            n_accepted += int(np.where(act_np, np.asarray(m_np), 0).sum())
 
-            # 4. Roll both models to the per-row accepted lengths and
-            # consume each row's round-closing token via a regular step.
+            # 4. Roll both models to the per-row accepted lengths
+            # (frozen rows roll back fully) and consume each active
+            # row's round-closing token via a frozen-aware step.
+            m_used_dev = jnp.asarray(m_used.astype(np.int32))
             closing = jnp.take_along_axis(
-                toks, m_dev[:, None], axis=1)[:, 0]       # [B]
-            last = jnp.where(
-                (m_dev > 0)[:, None],
-                jnp.take_along_axis(
-                    logits_all, jnp.maximum(m_dev - 1, 0)[:, None, None],
-                    axis=1)[:, 0],
-                st.last_logits)
-            st = GenerationState(caches=new_caches, kv_lens=L + m_dev,
-                                 last_logits=last)
-            st = tgt.step(t_params, st, closing)
-            sd = GenerationState(caches=sd.caches, kv_lens=L + m_dev,
-                                 last_logits=sd.last_logits)
-            sd = drf.step(d_params, sd, closing)
+                toks, m_used_dev[:, None], axis=1)[:, 0]  # [B]
+            st = GenerationState(caches=new_caches,
+                                 kv_lens=L + m_used_dev,
+                                 last_logits=st.last_logits)  # stale;
+            # refreshed by the step below (never read in between)
+            st = tgt.step(t_params, st, closing, active=active)
+            sd = GenerationState(caches=sd.caches,
+                                 kv_lens=L + m_used_dev,
+                                 last_logits=sd.last_logits)  # stale too
+            sd = drf.step(d_params, sd, closing, active=active)
 
         tokens = jnp.asarray([o[:n_new] for o in out], jnp.int32)
         stats = {
@@ -317,13 +341,33 @@ class SpeculativeGenerator(_SpeculativeBase):
         }
         return tokens, stats
 
-    def _propose(self, d_params, sd, k, key):
-        proposals = []
+
+class SpeculativeGenerator(_SpeculativeBase):
+    """Greedy verifier: output is bit-identical to the target's greedy
+    decode; the draft only changes how many target passes are needed
+    (up to k+1 tokens per pass when the draft agrees)."""
+
+    def _propose_batched(self, d_params, sd, k, key, active=None):
+        props = []
         for _ in range(k):
-            tok = _greedy(sd.last_logits)   # stays on device: no sync
-            sd = self.draft.step(d_params, sd, tok)
-            proposals.append(tok[0])
-        return jnp.stack(proposals), None, sd, key
+            tok = _greedy(sd.last_logits)                 # [B]
+            sd = self.draft.step(d_params, sd, tok, active=active)
+            props.append(tok)
+        return jnp.stack(props, axis=1), None, sd, key
+
+    def _verify_batched(self, st_logits, logits_all, proposals, aux, key):
+        m_dev, toks = greedy_accept_chain_batched(
+            proposals, st_logits, logits_all)
+        return m_dev, toks, key
+
+    def _fallback_batched(self, logits, key):
+        return _greedy(logits), key
+
+    def _propose(self, d_params, sd, k, key):
+        # The B=1 view of the batched propose loop (one loop, two shapes).
+        proposals, aux, sd, key = self._propose_batched(d_params, sd, k,
+                                                        key)
+        return proposals[0], aux, sd, key
 
     def _verify(self, st_logits, logits_all, proposals, aux, key):
         m_dev, toks = greedy_accept_chain(proposals, st_logits, logits_all)
@@ -351,17 +395,45 @@ class SpeculativeSampler(_SpeculativeBase):
         key, sub = jax.random.split(key)
         return int(jax.random.categorical(sub, jnp.log(pi + 1e-30))), key
 
-    def _propose(self, d_params, sd, k, key):
-        proposals, rhos = [], []
+    def _propose_batched(self, d_params, sd, k, key, active=None):
+        props, rhos = [], []
         for _ in range(k):
-            rho = self._probs(sd.last_logits[0])          # [V]
+            rho = self._probs(sd.last_logits)             # [B, V]
             key, sub = jax.random.split(key)
             tok = jax.random.categorical(
-                sub, jnp.log(rho + 1e-30)).astype(jnp.int32)
+                sub, jnp.log(rho + 1e-30)).astype(jnp.int32)  # [B]
             rhos.append(rho)
-            sd = self.draft.step(d_params, sd, tok[None])  # no host sync
-            proposals.append(tok)
-        return jnp.stack(proposals), jnp.stack(rhos), sd, key
+            sd = self.draft.step(d_params, sd, tok, active=active)
+            props.append(tok)
+        return (jnp.stack(props, axis=1),                 # [B, k]
+                jnp.stack(rhos, axis=1), sd, key)         # [B, k, V]
+
+    def _verify_batched(self, st_logits, logits_all, proposals, rhos, key):
+        # Per-row rejection sampling: the batch-1 accept chain vmapped
+        # over rows with independent subkeys — each row's emitted stream
+        # keeps the exact target-sampling distribution (the per-step
+        # identity is row-local).
+        B, k = proposals.shape
+        all_pi = self._probs(jnp.concatenate(
+            [st_logits[:, None], logits_all], axis=1))    # [B, k+1, V]
+        pis, bonus_pi = all_pi[:, :k], all_pi[:, k]
+        key, sub = jax.random.split(key)
+        row_keys = jax.random.split(sub, B)
+        m, toks = jax.vmap(speculative_accept_chain)(
+            pis, rhos, proposals, bonus_pi, row_keys)
+        return m, toks, key
+
+    def _fallback_batched(self, logits, key):
+        key, sub = jax.random.split(key)
+        tok = jax.random.categorical(
+            sub, jnp.log(self._probs(logits) + 1e-30)).astype(jnp.int32)
+        return tok, key
+
+    def _propose(self, d_params, sd, k, key):
+        # The B=1 view of the batched propose loop (one loop, two shapes).
+        proposals, rhos, sd, key = self._propose_batched(d_params, sd, k,
+                                                         key)
+        return proposals[0], rhos[0], sd, key
 
     def _verify(self, st_logits, logits_all, proposals, rhos, key):
         # Whole-round accept chain on device (speculative_accept_chain):
