@@ -51,6 +51,12 @@ def parse_args():
                    help="int8 KV cache (half the memory, ~1.55x decode)")
     p.add_argument("--chunk-prefill", type=int, default=None, metavar="C",
                    help="prefill in C-token chunks (bounded memory)")
+    p.add_argument("--speculative", type=int, default=None, metavar="K",
+                   help="speculative decoding with a K-token draft (a "
+                        "small same-vocab draft model; greedy at "
+                        "temperature 0, rejection sampling otherwise; "
+                        "batch > 1 rides the q_lens multi-token verify "
+                        "kernel and needs a world-1 mesh)")
     return p.parse_args()
 
 
@@ -95,16 +101,61 @@ def main():
     if args.chunk_prefill is not None and args.chunk_prefill <= 0:
         raise SystemExit(f"--chunk-prefill must be positive, got "
                          f"{args.chunk_prefill}")
-    t0 = time.perf_counter()
-    if args.chunk_prefill:
-        state = gen.prefill_chunked(params, prompt,
-                                    chunk_size=args.chunk_prefill)
-    else:
-        state = gen.prefill(params, prompt)
-    jax.block_until_ready(state.last_logits)
-    dist_print(f"prefill {args.prompt_len} tokens x{args.batch}"
-               f"{f' (chunks of {args.chunk_prefill})' if args.chunk_prefill else ''}: "
-               f"{(time.perf_counter() - t0) * 1e3:.1f} ms")
+    if not args.speculative:
+        # Speculative runs its own prefill inside spec.generate — a
+        # standalone one here would double the prompt work and hold a
+        # dead cache set alive.
+        t0 = time.perf_counter()
+        if args.chunk_prefill:
+            state = gen.prefill_chunked(params, prompt,
+                                        chunk_size=args.chunk_prefill)
+        else:
+            state = gen.prefill(params, prompt)
+        jax.block_until_ready(state.last_logits)
+        dist_print(f"prefill {args.prompt_len} tokens x{args.batch}"
+                   f"{f' (chunks of {args.chunk_prefill})' if args.chunk_prefill else ''}: "
+                   f"{(time.perf_counter() - t0) * 1e3:.1f} ms")
+
+    if args.speculative:
+        if args.model != "llama":
+            raise SystemExit("--speculative drafts the dense family only")
+        if args.batch > 1 and n > 1:
+            raise SystemExit("--speculative with batch > 1 needs a "
+                             "world-1 mesh (the batched q_lens verify)")
+        if args.batch > 1 and args.kv_int8:
+            raise SystemExit("--speculative with batch > 1 needs a float "
+                             "target cache (drop --kv-int8)")
+        from triton_dist_tpu.models.speculative import (
+            SpeculativeGenerator,
+            SpeculativeSampler,
+        )
+        dcfg = llama.LlamaConfig(vocab=cfg.vocab, dim=cfg.dim // 2,
+                                 n_layers=1, n_heads=max(cfg.n_heads // 2,
+                                                         1),
+                                 n_kv_heads=max(cfg.n_kv_heads // 2, 1),
+                                 ffn_dim=cfg.ffn_dim // 2,
+                                 max_seq=max_seq, dtype=cfg.dtype)
+        d_params = llama.init_params(dcfg, jax.random.fold_in(key, 2))
+        draft = Generator(dcfg, mesh, axis="sp", max_seq=max_seq)
+        if args.temperature > 0:
+            spec = SpeculativeSampler(gen, draft, k=args.speculative,
+                                      temperature=args.temperature,
+                                      top_k=args.top_k, top_p=args.top_p)
+            skey = jax.random.fold_in(key, 1)
+        else:
+            spec = SpeculativeGenerator(gen, draft, k=args.speculative)
+            skey = None
+        t0 = time.perf_counter()
+        tokens, stats = spec.generate(params, d_params, prompt,
+                                      args.new_tokens, key=skey)
+        jax.block_until_ready(tokens)
+        dt = time.perf_counter() - t0
+        dist_print(f"speculative decode k={args.speculative}: "
+                   f"{dt * 1e3:.1f} ms, target passes "
+                   f"{stats['target_passes']}, accept rate "
+                   f"{stats['accept_rate']:.2f}")
+        dist_print(f"tokens:\n{np.asarray(tokens)}")
+        return
 
     sampler = None
     skey = None

@@ -8,13 +8,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, "examples", "serve.py")
 
 
-def _run(*extra):
+def _run(*extra, devices=8, new_tokens=4):
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devices}"
     out = subprocess.run(
-        [sys.executable, SCRIPT, "--new-tokens", "4", *extra],
+        [sys.executable, SCRIPT, "--new-tokens", str(new_tokens), *extra],
         capture_output=True, text=True, env=env, timeout=600)
     assert out.returncode == 0, out.stderr[-2000:]
     return out.stdout
@@ -30,3 +30,11 @@ def test_serve_llama_sampled_w8a8():
 def test_serve_moe_greedy():
     out = _run("--model", "moe")
     assert "decode 4 steps" in out and "done" in out
+
+
+def test_serve_speculative_batched():
+    """--speculative on a world-1 mesh at batch 3 (the r5 batched q_lens
+    verify path end-to-end through the CLI)."""
+    out = _run("--batch", "3", "--speculative", "3", devices=1,
+               new_tokens=6)
+    assert "speculative decode k=3" in out, out

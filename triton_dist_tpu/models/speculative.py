@@ -284,6 +284,16 @@ class _SpeculativeBase:
                         out[b].append(int(t))
                 if min(len(o) for o in out) < n_new:
                     st = tgt.step(t_params, st, token, active=active)
+                    # Keep the DRAFT in lockstep too: retirement can
+                    # re-open speculation (a fast row freezing drops the
+                    # active top), and a draft that missed the fallback
+                    # tokens would propose from stale state — the accept
+                    # rate silently collapses.  Skip only when the draft
+                    # itself has no headroom; k then stays <= 0 and
+                    # speculation never resumes, so the desync is moot.
+                    if (int(jnp.max(jnp.where(active, sd.kv_lens, -1)))
+                            < drf.max_seq):
+                        sd = drf.step(d_params, sd, token, active=active)
                     n_target_passes += 1
                 continue
 
