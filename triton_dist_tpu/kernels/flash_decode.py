@@ -117,6 +117,50 @@ def _chunk_valid(pos, llen, wlen, qlen, *, window, group):
     return valid
 
 
+def _pack_lens_arg(local_lens, window_lens, q_lens, *, n_tok, window):
+    """Build the lens prefetch operand — THE one place the [B]/[2,B]/
+    [3,B] layout is encoded (``_read_lens`` is its reader); shared by the
+    contiguous and paged wrappers so they can never desynchronize.
+    Returns (lens_arg, use_qlens)."""
+    wl = local_lens if window_lens is None else window_lens
+    use_qlens = n_tok > 1 or q_lens is not None
+    if use_qlens:
+        ql = (jnp.full(local_lens.shape, n_tok, jnp.int32)
+              if q_lens is None else q_lens.astype(jnp.int32))
+        return jnp.stack([local_lens.astype(jnp.int32),
+                          wl.astype(jnp.int32), ql]), True     # [3, B]
+    if window:
+        return jnp.stack([local_lens.astype(jnp.int32),
+                          wl.astype(jnp.int32)]), False        # [2, B]
+    return local_lens, False
+
+
+def _fold_q_rows(q, n_tok, Hkv):
+    """[B, (T,) Hq, D] → [B, Hkv, T*g, D], row r = t*g + head-group g —
+    the kernel's q-block layout (its inverse is :func:`_unfold_out`)."""
+    B, Hq, D = q.shape[0], q.shape[-2], q.shape[-1]
+    g = Hq // Hkv
+    if q.ndim == 4:
+        return (q.reshape(B, n_tok, Hkv, g, D).transpose(0, 2, 1, 3, 4)
+                .reshape(B, Hkv, n_tok * g, D))
+    return q.reshape(B, Hkv, g, D)
+
+
+def _unfold_out(out, lse, multi, n_tok, Hq):
+    """Kernel outputs [B, Hkv, T*g, D] / [B, Hkv, T*g, 128] → the public
+    (out, lse) shapes ([B, T, Hq, D]/[B, T, Hq] when multi)."""
+    B, Hkv = out.shape[0], out.shape[1]
+    D = out.shape[-1]
+    g = Hq // Hkv
+    if multi:
+        o = (out.reshape(B, Hkv, n_tok, g, D).transpose(0, 2, 1, 3, 4)
+             .reshape(B, n_tok, Hq, D))
+        s = (lse[..., 0].reshape(B, Hkv, n_tok, g)
+             .transpose(0, 2, 1, 3).reshape(B, n_tok, Hq))
+        return o, s
+    return out.reshape(B, Hq, D), lse[..., 0].reshape(B, Hq)
+
+
 def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
                    acc_ref, m_ref, l_ref, *, block_s, n_s, scale,
                    soft_cap=0.0, window=0, n_tok=1, use_qlens=False):
@@ -517,25 +561,10 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
         bs = fit
     n_s = S // bs
 
-    wl = local_lens if window_lens is None else window_lens
-    use_qlens = n_tok > 1 or q_lens is not None
-    if use_qlens:
-        ql = (jnp.full((B,), n_tok, jnp.int32) if q_lens is None
-              else q_lens.astype(jnp.int32))
-        lens_arg = jnp.stack([local_lens.astype(jnp.int32),
-                              wl.astype(jnp.int32), ql])    # [3, B]
-    elif window:
-        lens_arg = jnp.stack([local_lens.astype(jnp.int32),
-                              wl.astype(jnp.int32)])        # [2, B]
-    else:
-        lens_arg = local_lens
+    lens_arg, use_qlens = _pack_lens_arg(local_lens, window_lens, q_lens,
+                                         n_tok=n_tok, window=window)
     rows = n_tok * g
-    if multi:
-        # [B, T, Hq, D] -> [B, Hkv, T*g, D], row r = t*g + head-group g
-        qg = (q.reshape(B, n_tok, Hkv, g, D).transpose(0, 2, 1, 3, 4)
-              .reshape(B, Hkv, rows, D))
-    else:
-        qg = q.reshape(B, Hkv, rows, D)
+    qg = _fold_q_rows(q, n_tok, Hkv)
     grid = (B, Hkv, n_s)
     q_spec = pl.BlockSpec((1, 1, rows, D),
                           lambda b, h, s, lens: (b, h, 0, 0))
@@ -589,13 +618,7 @@ def gqa_decode_shard(q, k, v, local_lens, *, block_s=None, impl="auto",
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=maybe_interpret(interpret),
     )(*args)
-    if multi:
-        out = (out.reshape(B, Hkv, n_tok, g, D).transpose(0, 2, 1, 3, 4)
-               .reshape(B, n_tok, Hq, D))
-        lse = (lse[..., 0].reshape(B, Hkv, n_tok, g)
-               .transpose(0, 2, 1, 3).reshape(B, n_tok, Hq))
-        return out, lse
-    return out.reshape(B, Hq, D), lse[..., 0].reshape(B, Hq)
+    return _unfold_out(out, lse, multi, n_tok, Hq)
 
 
 # ---------------------------------------------------------------------------
@@ -623,15 +646,22 @@ def _paged_gather(pool, table):
 
 def gqa_decode_paged_shard(q, k_pool, v_pool, block_table, local_lens, *,
                            impl="auto", interpret=False, soft_cap=0.0,
-                           window=0, window_lens=None):
+                           window=0, window_lens=None, q_lens=None):
     """Single-shard GQA decode over a PAGED KV cache.
 
     q [B, Hq, D]; k/v_pool [N_pages, Hkv, page, D] (the physical page
     pool); block_table [B, n_pages] int32 — logical page i of batch b
     lives at pool row ``block_table[b, i]``; local_lens [B] valid rows.
     Returns float32 partials (out [B, Hq, D], lse [B, Hq]).
+
+    MULTI-TOKEN (r5, same contract as :func:`gqa_decode_shard`): q may
+    be [B, T, Hq, D] with optional per-request ``q_lens`` [B] — the
+    k-token verify over a PAGED cache (mixed decode/verify batches);
+    returns (out [B, T, Hq, D], lse [B, T, Hq]).
     """
-    B, Hq, D = q.shape
+    multi = q.ndim == 4
+    n_tok = q.shape[1] if multi else 1
+    B, Hq, D = q.shape[0], q.shape[-2], q.shape[-1]
     N, Hkv, Pg, _ = k_pool.shape
     n_pages = block_table.shape[1]
     assert Hq % Hkv == 0, (Hq, Hkv)
@@ -653,26 +683,24 @@ def gqa_decode_paged_shard(q, k_pool, v_pool, block_table, local_lens, *,
                                  _paged_gather(v_pool, block_table),
                                  local_lens, scale=scale,
                                  soft_cap=soft_cap, window=window,
-                                 window_lens=window_lens)
+                                 window_lens=window_lens, q_lens=q_lens)
 
-    if window:
-        wl = local_lens if window_lens is None else window_lens
-        lens_arg = jnp.stack([local_lens.astype(jnp.int32),
-                              wl.astype(jnp.int32)])        # [2, B]
-    else:
-        lens_arg = local_lens
-    qg = q.reshape(B, Hkv, g, D)
+    lens_arg, use_qlens = _pack_lens_arg(local_lens, window_lens, q_lens,
+                                         n_tok=n_tok, window=window)
+    rows = n_tok * g
+    qg = _fold_q_rows(q, n_tok, Hkv)
     grid = (B, Hkv, n_pages)
     kern = functools.partial(_decode_kernel_paged, block_s=Pg,
                              n_s=n_pages, scale=scale, soft_cap=soft_cap,
-                             window=window)
+                             window=window, n_tok=n_tok,
+                             use_qlens=use_qlens)
     out, lse = pl.pallas_call(
         kern,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,  # (local_lens, block_table)
+            num_scalar_prefetch=2,  # (lens, block_table)
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1, 1, g, D),
+                pl.BlockSpec((1, 1, rows, D),
                              lambda b, h, s, lens, tab: (b, h, 0, 0)),
                 # THE paging trick: the pool block's leading index comes
                 # from the prefetched table — logical page s of batch b
@@ -683,38 +711,40 @@ def gqa_decode_paged_shard(q, k_pool, v_pool, block_table, local_lens, *,
                              lambda b, h, s, lens, tab: (tab[b, s], h, 0, 0)),
             ],
             out_specs=[
-                pl.BlockSpec((1, 1, g, D),
+                pl.BlockSpec((1, 1, rows, D),
                              lambda b, h, s, lens, tab: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, g, 128),
+                pl.BlockSpec((1, 1, rows, 128),
                              lambda b, h, s, lens, tab: (b, h, 0, 0)),
             ],
             scratch_shapes=[
-                pltpu.VMEM((g, D), jnp.float32),
-                pltpu.VMEM((g, 128), jnp.float32),
-                pltpu.VMEM((g, 128), jnp.float32),
+                pltpu.VMEM((rows, D), jnp.float32),
+                pltpu.VMEM((rows, 128), jnp.float32),
+                pltpu.VMEM((rows, 128), jnp.float32),
             ],
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((B, Hkv, g, D), jnp.float32),
-            jax.ShapeDtypeStruct((B, Hkv, g, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hkv, rows, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hkv, rows, 128), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=maybe_interpret(interpret),
     )(lens_arg, block_table, qg, k_pool, v_pool)
-    return out.reshape(B, Hq, D), lse[..., 0].reshape(B, Hq)
+    return _unfold_out(out, lse, multi, n_tok, Hq)
 
 
 def _decode_kernel_paged(lens_ref, table_ref, q_ref, k_ref, v_ref, out_ref,
                          lse_ref, acc_ref, m_ref, l_ref, *, block_s, n_s,
-                         scale, soft_cap=0.0, window=0):
+                         scale, soft_cap=0.0, window=0, n_tok=1,
+                         use_qlens=False):
     """Thin shim: the paged kernel IS :func:`_decode_kernel` — paging
     lives entirely in the BlockSpec index maps; ``table_ref`` is consumed
     there, not in the body."""
     del table_ref
     return _decode_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
                           acc_ref, m_ref, l_ref, block_s=block_s, n_s=n_s,
-                          scale=scale, soft_cap=soft_cap, window=window)
+                          scale=scale, soft_cap=soft_cap, window=window,
+                          n_tok=n_tok, use_qlens=use_qlens)
 
 
 def sp_gqa_decode_paged_shard(q, k_pool, v_pool, block_table, kv_lens, *,
