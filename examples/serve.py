@@ -57,7 +57,117 @@ def parse_args():
                         "temperature 0, rejection sampling otherwise; "
                         "batch > 1 rides the q_lens multi-token verify "
                         "kernel and needs a world-1 mesh)")
+    p.add_argument("--engine", action="store_true",
+                   help="continuous-batching serving engine "
+                        "(triton_dist_tpu/serve): staggered multi-"
+                        "request traffic over a paged KV cache with "
+                        "iteration-level scheduling; dense family, "
+                        "world-1 (docs/serving.md)")
+    p.add_argument("--requests", type=int, default=8,
+                   help="engine mode: number of requests to drive")
+    p.add_argument("--stagger", type=int, default=2,
+                   help="engine mode: submit a new request every "
+                        "S engine steps")
+    p.add_argument("--max-batch", type=int, default=4,
+                   help="engine mode: decode batch slots")
+    p.add_argument("--page-size", type=int, default=16,
+                   help="engine mode: KV page size (tokens per block)")
+    p.add_argument("--num-blocks", type=int, default=None,
+                   help="engine mode: KV pool blocks (default: sized "
+                        "to ~half the offered load, exercising "
+                        "queueing)")
     return p.parse_args()
+
+
+def run_engine(args, key):
+    """--engine: staggered multi-request traffic through the
+    continuous-batching engine (serve/engine.py)."""
+    import numpy as np
+
+    from triton_dist_tpu.models import llama
+    from triton_dist_tpu.models.generate import Generator
+    from triton_dist_tpu.runtime import dist_print
+    from triton_dist_tpu.serve import Request, SamplingParams, ServeEngine
+
+    if args.model != "llama":
+        raise SystemExit("--engine serves the dense family only")
+    # the engine is world-1 (per-row block tables are host-managed)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    rng = np.random.default_rng(args.seed)
+    lens = rng.integers(max(2, args.prompt_len // 2),
+                        2 * args.prompt_len + 1, size=args.requests)
+    max_seq = int(max(lens)) + args.new_tokens
+    max_seq += (-max_seq) % args.page_size
+
+    cfg = llama.LlamaConfig(vocab=256, dim=32, n_layers=2, n_heads=2,
+                            n_kv_heads=2, ffn_dim=64, max_seq=max_seq,
+                            dtype=jnp.float32)
+    params = llama.init_params(cfg, key)
+    gen = Generator(cfg, mesh, axis="sp", max_seq=max_seq)
+    draft = d_params = None
+    if args.speculative:
+        dcfg = llama.LlamaConfig(vocab=cfg.vocab, dim=cfg.dim // 2,
+                                 n_layers=1, n_heads=1, n_kv_heads=1,
+                                 ffn_dim=cfg.ffn_dim // 2, max_seq=max_seq,
+                                 dtype=cfg.dtype)
+        d_params = llama.init_params(dcfg, jax.random.fold_in(key, 2))
+        draft = Generator(dcfg, mesh, axis="sp", max_seq=max_seq)
+
+    page = args.page_size
+    per_req = -(-max_seq // page)
+    num_blocks = args.num_blocks or (1 + per_req * max(2, args.requests
+                                                       // 2))
+    engine = ServeEngine(
+        gen, params, num_blocks=num_blocks, page_size=page,
+        max_batch=args.max_batch, prefill_chunk=max(8, page),
+        draft=draft, draft_params=d_params,
+        spec_k=args.speculative or 0)
+    dist_print(f"engine: {args.requests} requests, pool {num_blocks} "
+               f"blocks x{page} tokens, batch {args.max_batch}"
+               f"{f', speculative k={args.speculative}' if args.speculative else ''}")
+
+    params_s = SamplingParams(max_new_tokens=args.new_tokens,
+                              temperature=args.temperature,
+                              top_k=args.top_k, top_p=args.top_p,
+                              seed=args.seed)
+    reqs = [Request(f"req-{i}",
+                    rng.integers(0, cfg.vocab, size=int(lens[i]))
+                    .astype(np.int32), params_s)
+            for i in range(args.requests)]
+
+    t0 = time.perf_counter()
+    submitted = step = 0
+    finished = []
+    while engine.has_work() or submitted < len(reqs):
+        if step % max(args.stagger, 1) == 0 and submitted < len(reqs):
+            engine.submit(reqs[submitted])
+            submitted += 1
+        finished.extend(engine.step())
+        step += 1
+    dt = time.perf_counter() - t0
+
+    total_tokens = sum(len(o.token_ids) for o in finished)
+    for o in sorted(finished, key=lambda o: o.request_id):
+        dist_print(f"{o.request_id}: prompt {len(o.prompt)} -> "
+                   f"{len(o.token_ids)} tokens ({o.finish_reason.value}), "
+                   f"ttft {o.metrics.ttft * 1e3:.1f} ms")
+    s = engine.metrics.summary()
+    dist_print(f"engine: {total_tokens} tokens / {args.requests} requests "
+               f"in {dt * 1e3:.1f} ms over {s['steps']} iterations "
+               f"({s['decode_steps']} decode, {s['verify_rounds']} verify)")
+
+    def ms(x):  # aggregates are None when no request had >= 2 tokens
+        return f"{x * 1e3:.2f} ms" if x is not None else "n/a"
+
+    dist_print(f"engine metrics: mean ttft {ms(s['mean_ttft'])}, "
+               f"mean itl {ms(s['mean_itl'])}, max queue depth "
+               f"{s['max_queue_depth']}, peak kv util "
+               f"{s['peak_kv_utilization']:.2f}, preemptions "
+               f"{s['preemptions']}")
+    dumped = engine.metrics.maybe_dump()
+    if dumped:
+        dist_print(f"engine metrics dumped to {dumped}")
+    dist_print("done")
 
 
 def main():
@@ -66,6 +176,8 @@ def main():
     from triton_dist_tpu.runtime import dist_print, initialize_distributed
 
     initialize_distributed()
+    if args.engine:
+        return run_engine(args, jax.random.key(args.seed))
     n = jax.device_count()
     mesh = Mesh(np.array(jax.devices()), ("sp",))
     key = jax.random.key(args.seed)

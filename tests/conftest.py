@@ -93,6 +93,10 @@ _FAST_GATE_MODULES = {
     "test_reduce_scatter", "test_torus", "test_all_to_all",
     "test_hierarchical", "test_ag_gemm", "test_gemm_rs", "test_gemm",
     "test_flash_attention", "test_paged_decode",
+    # serving engine: the pure-index machinery (block manager, scheduler,
+    # metrics) + the r5 regression fixes run in the gate; the end-to-end
+    # engine-vs-oracle tests carry explicit @pytest.mark.slow.
+    "test_serve_engine",
 }
 
 # Heavy tests inside core modules whose coverage is duplicated by a

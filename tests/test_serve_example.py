@@ -38,3 +38,20 @@ def test_serve_speculative_batched():
     out = _run("--batch", "3", "--speculative", "3", devices=1,
                new_tokens=6)
     assert "speculative decode k=3" in out, out
+
+
+def test_serve_engine_mode():
+    """--engine: continuous-batching over the paged KV cache through the
+    CLI (staggered traffic, metrics summary)."""
+    out = _run("--engine", "--requests", "5", "--stagger", "2",
+               "--max-batch", "3", "--page-size", "8", devices=1,
+               new_tokens=5)
+    assert "engine: 25 tokens / 5 requests" in out, out
+    assert "mean ttft" in out and "done" in out
+
+
+def test_serve_engine_speculative():
+    out = _run("--engine", "--requests", "3", "--speculative", "2",
+               devices=1, new_tokens=4)
+    assert "engine: 12 tokens / 3 requests" in out, out
+    assert "verify)" in out and "done" in out

@@ -42,6 +42,12 @@ Design stance (TPU-first, not a port):
 
 __version__ = "0.1.0"
 
+# Version shims first: everything below (and every later submodule import)
+# assumes the jax>=0.6 names (jax.shard_map, pltpu.CompilerParams).
+from triton_dist_tpu.runtime import compat as _compat
+
+_compat.apply()
+
 from triton_dist_tpu.runtime import (  # noqa: F401
     initialize_distributed,
     get_mesh,

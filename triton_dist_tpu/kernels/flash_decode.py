@@ -755,6 +755,11 @@ def sp_gqa_decode_paged_shard(q, k_pool, v_pool, block_table, kv_lens, *,
     holds local pool indices for the rank's logical pages.  ``kv_lens``
     are GLOBAL lengths; shard ownership follows n_local * page rows per
     rank (the contiguous-cache rule with S_loc = n_local * page)."""
+    assert q.ndim == 3, (
+        f"sp_gqa_decode_paged_shard takes single-token q [B, Hq, D], got "
+        f"shape {q.shape}; the multi-token q / q_lens verify contract is "
+        "only wired up for the contiguous SP path (sp_gqa_decode_shard) — "
+        "its inter-rank combine does not handle [B, T, Hq, D] partials")
     n_local = block_table.shape[1]
     s_loc = n_local * k_pool.shape[2]
     me = jax.lax.axis_index(axis)

@@ -484,11 +484,22 @@ def _rope_rows(x, pos, theta):
 
 def _write_rows(cache, new, offs):
     """Per-row chunk write: cache [B, Hkv, S, D] <- new [B, Hkv, T, D] at
-    row offsets offs [B] (each request's own cache length)."""
-    def per(c, n, o):
-        return jax.lax.dynamic_update_slice(c, n.astype(c.dtype), (0, o, 0))
+    row offsets offs [B] (each request's own cache length).
 
-    return jax.vmap(per)(cache, new, offs)
+    Rows whose write would overflow the cache (offs[b] + T > S) are
+    SKIPPED, not clamped: dynamic_update_slice would clamp the offset and
+    silently overwrite still-valid rows.  Retired rows in the batched
+    speculative loop (and the serving engine) sit exactly there — their
+    outputs are discarded, but their caches must stay intact (ADVICE r5
+    finding #2)."""
+    T = new.shape[2]
+    ok = offs + T <= cache.shape[2]                   # [B] bool
+
+    def per(c, n, o, keep):
+        upd = jax.lax.dynamic_update_slice(c, n.astype(c.dtype), (0, o, 0))
+        return jnp.where(keep, upd, c)
+
+    return jax.vmap(per)(cache, new, offs, ok)
 
 
 def _verify_forward(params, chunk, caches, kv_lens, *, cfg: LlamaConfig,

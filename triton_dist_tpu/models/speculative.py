@@ -262,6 +262,7 @@ class _SpeculativeBase:
 
         out = [[] for _ in range(B)]
         n_target_passes = n_proposed = n_accepted = 0
+        draft_dead = False  # latched when the draft-step skip fires
         while min(len(o) for o in out) < n_new:
             # Per-row RETIREMENT: finished rows freeze (cache length
             # stops advancing, emissions stop) so a fast row cannot
@@ -277,6 +278,15 @@ class _SpeculativeBase:
             k = min(self.k, tgt.max_seq - 1 - top,
                     drf.max_seq - 1
                     - int(jnp.max(jnp.where(active, sd.kv_lens, -1))))
+            if draft_dead:
+                # Once the draft-step skip has fired the draft cache is
+                # behind the emitted stream; retiring the row that pinned
+                # the draft at max_seq can re-open k > 0 here, but
+                # resuming would overwrite sd.kv_lens with the target
+                # length and propose over uninitialized K/V rows (ADVICE
+                # r5 finding #3).  Speculation stays off for the rest of
+                # the call.
+                k = 0
             if k <= 0:
                 token, key = self._fallback_batched(st.last_logits, key)
                 for b, t in enumerate(np.asarray(token)):
@@ -289,11 +299,17 @@ class _SpeculativeBase:
                     # active top), and a draft that missed the fallback
                     # tokens would propose from stale state — the accept
                     # rate silently collapses.  Skip only when the draft
-                    # itself has no headroom; k then stays <= 0 and
-                    # speculation never resumes, so the desync is moot.
-                    if (int(jnp.max(jnp.where(active, sd.kv_lens, -1)))
-                            < drf.max_seq):
+                    # itself has no headroom — and LATCH the skip: from
+                    # that point the draft cache is permanently behind,
+                    # so ``draft_dead`` pins k = 0 above and speculation
+                    # never resumes (re-opening it after a retirement
+                    # would propose over uninitialized K/V).
+                    if (not draft_dead
+                            and int(jnp.max(jnp.where(active, sd.kv_lens,
+                                                      -1))) < drf.max_seq):
                         sd = drf.step(d_params, sd, token, active=active)
+                    else:
+                        draft_dead = True
                     n_target_passes += 1
                 continue
 

@@ -1,0 +1,76 @@
+"""JAX version-compatibility shims.
+
+The codebase targets the jax>=0.6 surface (``jax.shard_map``,
+``pltpu.CompilerParams``).  Older runtimes (0.4.x) carry the same
+functionality under the pre-stabilization names — ``jax.experimental.
+shard_map.shard_map`` (with ``check_rep`` instead of ``check_vma``) and
+``pltpu.TPUCompilerParams``.  :func:`apply` installs forward-compatible
+aliases so one source tree runs on both; on a current jax it is a no-op.
+
+Imported (and applied) from the package ``__init__`` — nothing here may
+initialize a JAX backend (the late-CPU-pinning rule of runtime/testenv.py):
+only module attributes are touched.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def _shim_shard_map(jax):
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @functools.wraps(_shard_map)
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)
+
+    jax.shard_map = shard_map
+
+
+def _shim_axis_size(jax):
+    if hasattr(jax.lax, "axis_size"):
+        return
+
+    def axis_size(axis_name):
+        # psum of a Python literal constant-folds to the static axis size
+        # (a concrete int) under shard_map tracing on 0.4.x.
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = axis_size
+
+
+def _shim_pallas_tpu():
+    import dataclasses
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    if not hasattr(pltpu, "CompilerParams"):
+        base = pltpu.TPUCompilerParams
+        known = {f.name for f in dataclasses.fields(base)}
+
+        def CompilerParams(**kw):
+            # Fields the old dataclass lacks (e.g. has_side_effects) are
+            # dropped: on 0.4.x the flag either has a different spelling
+            # or no effect on the paths this tree exercises.
+            return base(**{k: v for k, v in kw.items() if k in known})
+
+        pltpu.CompilerParams = CompilerParams
+
+
+def apply() -> None:
+    """Install all shims (idempotent; no-op on jax>=0.6)."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        # jax >= 0.6 surface: every shimmed name already exists.  Early
+        # out before _shim_pallas_tpu, whose pallas import costs ~0.3 s
+        # of package-import time.
+        return
+    _shim_shard_map(jax)
+    _shim_axis_size(jax)
+    _shim_pallas_tpu()

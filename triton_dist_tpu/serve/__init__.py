@@ -1,0 +1,36 @@
+"""Continuous-batching serving engine over the paged-KV decode kernels.
+
+The serving-side system the kernel layer was built for: iteration-level
+continuous batching (Orca, OSDI '22) over a paged KV cache (vLLM's
+PagedAttention, SOSP '23), orchestrating the primitives that already
+exist below it — `kernels/flash_decode.gqa_decode_paged_shard` (block
+tables ride scalar prefetch), `Generator.prefill_chunked` (bounded-memory
+prompt streaming), the per-row ``active`` masks and multi-token ``q_lens``
+verify contract (r5).
+
+Layout:
+
+- ``request``    — request/response dataclasses + sampling params
+- ``block_manager`` — the paged KV block allocator (free list, per-request
+  block tables, utilization accounting)
+- ``scheduler``  — iteration-level FCFS admission + chunked-prefill token
+  budget + LIFO preemption policy
+- ``engine``     — the step loop: admit → prefill chunks → one batched
+  decode (or speculative verify round) per iteration
+- ``metrics``    — TTFT / inter-token latency / queue depth / KV-block
+  utilization / preemptions, exported through runtime/dump.py
+"""
+
+from triton_dist_tpu.serve.request import (  # noqa: F401
+    FinishReason,
+    Request,
+    RequestOutput,
+    SamplingParams,
+)
+from triton_dist_tpu.serve.block_manager import BlockManager  # noqa: F401
+from triton_dist_tpu.serve.scheduler import FCFSScheduler  # noqa: F401
+from triton_dist_tpu.serve.metrics import (  # noqa: F401
+    RequestMetrics,
+    ServeMetrics,
+)
+from triton_dist_tpu.serve.engine import ServeEngine  # noqa: F401

@@ -1,0 +1,118 @@
+"""Paged-KV block allocator: free list + per-request block tables.
+
+The physical cache is a pool of ``num_blocks`` pages of ``page_size``
+token rows each (per layer, per K/V — the pools live in the engine; this
+class owns only the *index* arithmetic, so it is trivially unit-testable
+and the engine's device arrays follow it).
+
+Block 0 is RESERVED as the null block: retired/inactive batch rows
+redirect their dummy K/V writes there, and dead block-table entries
+(logical pages past a request's allocation) point at it — so a pool row
+freed and re-allocated to another request can never be corrupted by a
+stale writer, and every table entry always indexes a valid pool row (the
+paged kernel DMAs dead entries too; see kernels/flash_decode.py).
+
+Contract with `kernels/flash_decode.gqa_decode_paged_shard`: logical page
+``i`` of a request lives at pool row ``table(rid)[i]``; entries past the
+allocation hold the null block and are masked by the sequence length.
+"""
+
+from __future__ import annotations
+
+
+class BlockExhausted(Exception):
+    """Raised by :meth:`BlockManager.allocate` /
+    :meth:`BlockManager.ensure` when the free list cannot cover the
+    request (the scheduler turns this into queueing or preemption)."""
+
+
+class BlockManager:
+    def __init__(self, num_blocks: int, page_size: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is the reserved null "
+                f"block), got {num_blocks}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.num_blocks = num_blocks
+        self.page_size = page_size
+        self.null_block = 0
+        # LIFO free list: recently-freed (cache-warm) blocks are reused
+        # first.  Block 0 never enters it.
+        self._free: list[int] = list(range(num_blocks - 1, 0, -1))
+        self._tables: dict[str, list[int]] = {}
+
+    # -- accounting -------------------------------------------------------
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_allocatable(self) -> int:
+        return self.num_blocks - 1
+
+    @property
+    def utilization(self) -> float:
+        """Fraction of allocatable blocks currently held by requests."""
+        used = self.num_allocatable - self.num_free
+        return used / self.num_allocatable
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Pages needed to hold ``n_tokens`` cache rows."""
+        return -(-n_tokens // self.page_size)
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        return self.blocks_for(n_tokens) <= self.num_free
+
+    # -- allocate / extend / free ----------------------------------------
+
+    def allocate(self, rid: str, n_tokens: int) -> list[int]:
+        """Allocate blocks covering ``n_tokens`` for a NEW request."""
+        if rid in self._tables:
+            raise ValueError(f"request {rid!r} already has blocks")
+        need = self.blocks_for(n_tokens)
+        if need > self.num_free:
+            raise BlockExhausted(
+                f"{rid}: need {need} blocks for {n_tokens} tokens, "
+                f"only {self.num_free} free")
+        self._tables[rid] = [self._free.pop() for _ in range(need)]
+        return list(self._tables[rid])
+
+    def ensure(self, rid: str, n_tokens: int) -> list[int]:
+        """Extend ``rid``'s allocation to cover ``n_tokens`` (no-op when
+        it already does).  Returns the blocks appended."""
+        table = self._tables[rid]
+        need = self.blocks_for(n_tokens) - len(table)
+        if need <= 0:
+            return []
+        if need > self.num_free:
+            raise BlockExhausted(
+                f"{rid}: extension to {n_tokens} tokens needs {need} more "
+                f"blocks, only {self.num_free} free")
+        fresh = [self._free.pop() for _ in range(need)]
+        table.extend(fresh)
+        return fresh
+
+    def free(self, rid: str) -> None:
+        """Return all of ``rid``'s blocks to the free list."""
+        for b in reversed(self._tables.pop(rid)):
+            self._free.append(b)
+
+    # -- tables -----------------------------------------------------------
+
+    def table(self, rid: str) -> list[int]:
+        return list(self._tables[rid])
+
+    def padded_table(self, rid: str, width: int) -> list[int]:
+        """The request's block table padded to ``width`` logical pages
+        with the null block (the engine's fixed-width device row)."""
+        t = self._tables[rid]
+        if len(t) > width:
+            raise ValueError(
+                f"{rid}: {len(t)} blocks exceed table width {width}")
+        return t + [self.null_block] * (width - len(t))
+
+    def capacity_tokens(self, rid: str) -> int:
+        """Cache rows the request's current allocation can hold."""
+        return len(self._tables[rid]) * self.page_size

@@ -1,0 +1,664 @@
+"""The continuous-batching step loop over a paged KV cache.
+
+Each :meth:`ServeEngine.step` is one scheduler iteration (Orca's
+iteration-level scheduling):
+
+1. **Admit** waiting requests into free batch slots while the block
+   manager can cover their prompt (+1 decode block of headroom).
+2. **Prefill** admitted prompts in chunks against a per-request scratch
+   cache (``Generator._chunk_jit`` — the chunked-prefill machinery),
+   metered by the scheduler's token budget so long prompts interleave
+   with in-flight decode; a completed prompt's K/V scatter into the
+   request's pool pages and the request joins the decode batch.
+3. **Decode** all running rows in ONE batched forward through
+   ``kernels/flash_decode.gqa_decode_paged_shard`` — per-row lengths,
+   per-row block tables, the r5 ``active`` mask semantics (retired/free
+   rows freeze; their dummy K/V writes redirect to the reserved null
+   block so freed pages can never be corrupted — the paged twin of the
+   ``_write_rows`` overflow rule).  With a draft model attached, the
+   decode step becomes a speculative round: the draft proposes ``k``
+   tokens per row and ONE multi-token verify pass scores every row at
+   its own length (the r5 ``q_lens`` batched-verify contract), greedy
+   accepts applying per row.
+
+Requests retire individually (their blocks free immediately); when a
+running request cannot extend its allocation, the scheduler preempts the
+latest-admitted request (recompute-style: emitted tokens are kept and the
+victim re-prefills ``prompt + generated``).
+
+v1 scope: world-1 mesh, float KV pools, dense-Llama-family ``Generator``
+(the same envelope as the r5 batched speculative verify; batch-1 SP +
+int8 serving keeps the contiguous `Generator.generate` path).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_dist_tpu.kernels.flash_decode import gqa_decode_paged_shard
+from triton_dist_tpu.models.generate import (
+    GenerationState,
+    Generator,
+    _rms_norm,
+    _rope_at,
+    _rope_rows,
+)
+from triton_dist_tpu.models.sampling import sample_logits
+from triton_dist_tpu.models.speculative import greedy_accept_chain_batched
+from triton_dist_tpu.serve.block_manager import BlockExhausted, BlockManager
+from triton_dist_tpu.serve.metrics import RequestMetrics, ServeMetrics
+from triton_dist_tpu.serve.request import (
+    FinishReason,
+    Request,
+    RequestOutput,
+)
+from triton_dist_tpu.serve.scheduler import FCFSScheduler, ReqState, Status
+
+
+# ---------------------------------------------------------------------------
+# Paged model forwards (jitted once per engine; dense Llama family)
+# ---------------------------------------------------------------------------
+
+
+def _page_slots(tables, kv_lens, active, *, page):
+    """Physical (pool row, in-page row) for each batch row's next write.
+    Inactive rows redirect to the null block (pool row 0, row 0): their
+    table entries may be stale — a freed page can already belong to
+    another request, and a clamped write there would corrupt it."""
+    n_pages = tables.shape[1]
+    logical = jnp.minimum(kv_lens // page, n_pages - 1)[:, None]
+    pool_row = jnp.take_along_axis(tables, logical, axis=1)[:, 0]
+    in_page = kv_lens % page
+    return (jnp.where(active, pool_row, 0),
+            jnp.where(active, in_page, 0))
+
+
+def _paged_decode_forward(params, pools, tables, kv_lens, token, active, *,
+                          cfg, page, impl, interpret):
+    """One decode token for every batch row over the paged pools.
+
+    Mirrors ``Generator._step_impl`` exactly (same math per row — the
+    greedy stream must be bit-identical to the contiguous oracle), with
+    the contiguous append swapped for a pool-page scatter and attention
+    through the paged block-table kernel.
+    """
+    inc = active.astype(kv_lens.dtype)
+    pool_row, in_page = _page_slots(tables, kv_lens, active, page=page)
+    new_pools = []
+    x = params["embed"][token]  # [B, D]
+    for li, layer in enumerate(params["layers"]):
+        k_pool, v_pool = pools[li]
+        h = _rms_norm(x[:, None], layer["attn_norm"], cfg.norm_eps)[:, 0]
+        q = (h @ layer["wq"]).reshape(-1, cfg.n_heads, cfg.head_dim)
+        k = (h @ layer["wk"]).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ layer["wv"]).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+        q = _rope_at(q, kv_lens, cfg.rope_theta)
+        k = _rope_at(k, kv_lens, cfg.rope_theta)
+        k_pool = k_pool.at[pool_row, :, in_page, :].set(
+            k.astype(k_pool.dtype))
+        v_pool = v_pool.at[pool_row, :, in_page, :].set(
+            v.astype(v_pool.dtype))
+        o, _ = gqa_decode_paged_shard(
+            q, k_pool, v_pool, tables, kv_lens + inc, impl=impl,
+            interpret=interpret, soft_cap=cfg.attn_soft_cap,
+            window=cfg.attn_window)
+        x = x + (o.reshape(o.shape[0], -1).astype(cfg.dtype)
+                 @ layer["wo"])
+        h = _rms_norm(x[:, None], layer["mlp_norm"], cfg.norm_eps)[:, 0]
+        act = (jax.nn.silu((h @ layer["wgate"]).astype(jnp.float32))
+               .astype(cfg.dtype) * (h @ layer["wup"]))
+        x = x + act @ layer["wdown"]
+        new_pools.append((k_pool, v_pool))
+    x = _rms_norm(x[:, None], params["final_norm"], cfg.norm_eps)[:, 0]
+    logits = jnp.dot(x, params["lm_head"],
+                     preferred_element_type=jnp.float32)
+    return new_pools, logits
+
+
+def _paged_verify_forward(params, pools, tables, kv_lens, chunk, active, *,
+                          cfg, page, impl, interpret):
+    """Score ``chunk`` [B, T] draft tokens per row at PER-ROW lengths over
+    the paged pools — ``models/generate._verify_forward`` re-addressed
+    through block tables (K/V rows scatter into each request's pages, the
+    multi-token decode kernel reads them back through the table).
+    Returns (new_pools, logits [B, T, V])."""
+    B, T = chunk.shape
+    hd = cfg.head_dim
+    n_pages = tables.shape[1]
+    pos = kv_lens[:, None] + jnp.arange(T, dtype=jnp.int32)[None]  # [B, T]
+    logical = jnp.minimum(pos // page, n_pages - 1)
+    pool_row = jnp.take_along_axis(tables, logical, axis=1)       # [B, T]
+    in_page = pos % page
+    pool_row = jnp.where(active[:, None], pool_row, 0)
+    in_page = jnp.where(active[:, None], in_page, 0)
+    x = params["embed"][chunk]                                    # [B, T, D]
+    new_pools = []
+    for li, layer in enumerate(params["layers"]):
+        k_pool, v_pool = pools[li]
+        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        h2 = h.reshape(B * T, cfg.dim)
+        q = (h2 @ layer["wq"]).reshape(B, T, cfg.n_heads, hd)
+        k = (h2 @ layer["wk"]).reshape(B, T, cfg.n_kv_heads, hd)
+        v = (h2 @ layer["wv"]).reshape(B, T, cfg.n_kv_heads, hd)
+        q = _rope_rows(q, pos, cfg.rope_theta)
+        k = _rope_rows(k, pos, cfg.rope_theta)
+        k_pool = k_pool.at[pool_row, :, in_page, :].set(
+            k.astype(k_pool.dtype))
+        v_pool = v_pool.at[pool_row, :, in_page, :].set(
+            v.astype(v_pool.dtype))
+        o, _ = gqa_decode_paged_shard(
+            q, k_pool, v_pool, tables, kv_lens + T, impl=impl,
+            interpret=interpret, soft_cap=cfg.attn_soft_cap,
+            window=cfg.attn_window)
+        o = o.reshape(B * T, cfg.n_heads * hd).astype(cfg.dtype)
+        x = x + (o @ layer["wo"]).reshape(B, T, cfg.dim)
+        h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps).reshape(
+            B * T, cfg.dim)
+        act = (jax.nn.silu((h2 @ layer["wgate"]).astype(jnp.float32))
+               .astype(cfg.dtype) * (h2 @ layer["wup"]))
+        x = x + (act @ layer["wdown"]).reshape(B, T, cfg.dim)
+        new_pools.append((k_pool, v_pool))
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.dot(x, params["lm_head"],
+                     preferred_element_type=jnp.float32)
+    return new_pools, logits
+
+
+def _fill_pool_pages(pools, scratch, block_ids, *, page):
+    """Scatter a completed prefill's K/V (contiguous scratch caches
+    [1, Hkv, n*page, D] per layer) into the request's pool pages."""
+    n = block_ids.shape[0]
+    new_pools = []
+    for (k_pool, v_pool), (kc, vc) in zip(pools, scratch):
+        def as_pages(c):
+            Hkv, S_ext, D = c.shape[1:]
+            return c[0].reshape(Hkv, n, page, D).transpose(1, 0, 2, 3)
+
+        k_pool = k_pool.at[block_ids].set(as_pages(kc).astype(k_pool.dtype))
+        v_pool = v_pool.at[block_ids].set(as_pages(vc).astype(v_pool.dtype))
+        new_pools.append((k_pool, v_pool))
+    return new_pools
+
+
+# ---------------------------------------------------------------------------
+# The engine
+# ---------------------------------------------------------------------------
+
+
+class ServeEngine:
+    """Continuous-batching serving over one :class:`Generator`.
+
+    Usage::
+
+        engine = ServeEngine(gen, params, num_blocks=64, page_size=16,
+                             max_batch=8)
+        engine.submit(Request("r0", prompt_tokens,
+                              SamplingParams(max_new_tokens=32)))
+        outputs = engine.run()          # step() until drained
+
+    ``draft``/``draft_params`` + ``spec_k`` turn every decode step into a
+    speculative round (greedy requests only): up to ``spec_k + 1`` tokens
+    per row per verify pass, same emitted stream as plain greedy.
+    """
+
+    def __init__(self, gen: Generator, params, *, num_blocks: int,
+                 page_size: int, max_batch: int = 8,
+                 prefill_chunk: int = 64,
+                 prefill_budget: Optional[int] = None,
+                 draft: Optional[Generator] = None, draft_params=None,
+                 spec_k: int = 0, clock=time.monotonic):
+        assert gen.attn.world == 1, (
+            "ServeEngine is world-1 (the per-row block tables are host-"
+            "managed); multi-chip serving keeps Generator.generate's SP "
+            "path")
+        assert not gen.attn.quantized, (
+            "paged int8 pools not supported yet (layer-level paged decode "
+            "has the same limit)")
+        cfg = gen.cfg
+        if gen.max_seq % page_size:
+            raise ValueError(
+                f"max_seq {gen.max_seq} must divide by page_size "
+                f"{page_size} (the block table is fixed-width)")
+        if spec_k:
+            assert draft is not None and draft_params is not None, (
+                "spec_k needs draft + draft_params")
+            assert draft.max_seq >= gen.max_seq, (
+                "draft max_seq must cover the target's")
+        self.gen = gen
+        self.cfg = cfg
+        self.params = params
+        self.page = page_size
+        self.max_batch = max_batch
+        self.n_pages_max = gen.max_seq // page_size
+        self.bm = BlockManager(num_blocks, page_size)
+        self.scheduler = FCFSScheduler(
+            self.bm,
+            prefill_budget=prefill_budget or 4 * prefill_chunk,
+            prefill_chunk=prefill_chunk)
+        self.metrics = ServeMetrics()
+        self.draft = draft
+        self.draft_params = draft_params
+        self.spec_k = int(spec_k)
+        self._clock = clock
+
+        impl = gen.attn.ctx.impl
+        interpret = gen.attn.ctx.interpret
+        self._pools = [
+            (jnp.zeros((num_blocks, cfg.n_kv_heads, page_size,
+                        cfg.head_dim), cfg.dtype),
+             jnp.zeros((num_blocks, cfg.n_kv_heads, page_size,
+                        cfg.head_dim), cfg.dtype))
+            for _ in range(cfg.n_layers)]
+        self._decode_fn = jax.jit(functools.partial(
+            _paged_decode_forward, cfg=cfg, page=page_size, impl=impl,
+            interpret=interpret), donate_argnums=(1,))
+        self._verify_fn = jax.jit(functools.partial(
+            _paged_verify_forward, cfg=cfg, page=page_size, impl=impl,
+            interpret=interpret), donate_argnums=(1,))
+        # scratch is not donatable (the page reshape transposes it);
+        # pools are — the scatter updates them in place.
+        self._fill_fn = jax.jit(functools.partial(
+            _fill_pool_pages, page=page_size), donate_argnums=(0,))
+
+        self.slots: list[Optional[ReqState]] = [None] * max_batch
+        self._states: dict[str, ReqState] = {}
+        self._outputs: dict[str, RequestOutput] = {}
+        # speculative-mode device state ([B]-shaped, slot-indexed)
+        if self.spec_k:
+            self._last_logits = jnp.zeros((max_batch, cfg.vocab),
+                                          jnp.float32)
+            dcfg = draft.cfg
+            self._draft_state = GenerationState(
+                caches=[(jnp.zeros((max_batch, dcfg.n_kv_heads,
+                                    draft.max_seq, dcfg.head_dim),
+                                   dcfg.dtype),
+                         jnp.zeros((max_batch, dcfg.n_kv_heads,
+                                    draft.max_seq, dcfg.head_dim),
+                                   dcfg.dtype))
+                        for _ in range(dcfg.n_layers)],
+                kv_lens=jnp.zeros((max_batch,), jnp.int32),
+                last_logits=jnp.zeros((max_batch, dcfg.vocab),
+                                      jnp.float32))
+
+    # -- submission -------------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        if req.request_id in self._states:
+            raise ValueError(f"duplicate request id {req.request_id!r}")
+        total = int(req.prompt.shape[0]) + req.params.max_new_tokens
+        if total > self.gen.max_seq:
+            raise ValueError(
+                f"{req.request_id}: prompt + max_new_tokens = {total} "
+                f"exceeds max_seq {self.gen.max_seq}")
+        if self.bm.blocks_for(total) > self.bm.num_allocatable:
+            raise ValueError(
+                f"{req.request_id}: needs {self.bm.blocks_for(total)} "
+                f"blocks, pool has {self.bm.num_allocatable}")
+        if self.spec_k and not req.params.greedy:
+            raise ValueError(
+                "speculative engine mode serves greedy requests only")
+        if req.arrival_time is None:
+            req.arrival_time = self._clock()
+        rs = ReqState(req=req,
+                      metrics=RequestMetrics(arrival_time=req.arrival_time))
+        self._states[req.request_id] = rs
+        self.scheduler.add(rs)
+
+    def abort(self, request_id: str) -> Optional[RequestOutput]:
+        """Cancel a request wherever it is; returns its (partial) output."""
+        rs = self._states.get(request_id)
+        if rs is None or rs.status is Status.FINISHED:
+            return self._outputs.get(request_id)
+        if rs.status is Status.WAITING:
+            self.scheduler.waiting.remove(rs)
+        else:
+            self.bm.free(request_id)
+            self.slots[rs.slot] = None
+            rs.scratch = None
+        return self._retire(rs, FinishReason.ABORT, free=False)
+
+    def has_work(self) -> bool:
+        return bool(self.scheduler.waiting) or any(
+            s is not None for s in self.slots)
+
+    # -- the iteration ----------------------------------------------------
+
+    def step(self) -> list[RequestOutput]:
+        """One scheduler iteration; returns requests that finished."""
+        now = self._clock()
+        finished: list[RequestOutput] = []
+
+        free = [i for i, s in enumerate(self.slots) if s is None]
+        for rs in self.scheduler.admit(free, now):
+            self.slots[rs.slot] = rs
+            self._start_prefill(rs)
+
+        prefilling = [s for s in self.slots
+                      if s is not None and s.status is Status.PREFILL]
+        for rs, n in self.scheduler.prefill_plan(prefilling):
+            out = self._run_prefill(rs, n, now)
+            if out is not None:
+                finished.append(out)
+
+        running = [s for s in self.slots
+                   if s is not None and s.status is Status.RUNNING]
+        if running:
+            if self.spec_k:
+                finished.extend(self._spec_round(running))
+            else:
+                finished.extend(self._decode_once(running))
+
+        self.metrics.observe_step(
+            queue_depth=self.scheduler.queue_depth,
+            running=len([s for s in self.slots if s is not None]),
+            kv_utilization=self.bm.utilization)
+        return finished
+
+    def run(self, max_steps: int = 100_000) -> dict[str, RequestOutput]:
+        """Step until drained; returns {request_id: output}."""
+        steps = 0
+        while self.has_work():
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(f"engine not drained after {max_steps} "
+                                   "steps")
+        return dict(self._outputs)
+
+    # -- prefill ----------------------------------------------------------
+
+    def _start_prefill(self, rs: ReqState) -> None:
+        cfg = self.cfg
+        n_prompt = int(rs.prompt_tokens.shape[0])
+        s_ext = self.bm.blocks_for(n_prompt) * self.page
+        rs.s_ext = s_ext
+        rs.scratch = [
+            (jnp.zeros((1, cfg.n_kv_heads, s_ext, cfg.head_dim),
+                       cfg.dtype),
+             jnp.zeros((1, cfg.n_kv_heads, s_ext, cfg.head_dim),
+                       cfg.dtype))
+            for _ in range(cfg.n_layers)]
+
+    def _run_prefill(self, rs: ReqState, n_tokens: int,
+                     now: float) -> Optional[RequestOutput]:
+        prompt = rs.prompt_tokens
+        S0 = int(prompt.shape[0])
+        end = min(rs.prefill_pos + n_tokens, S0)
+        logits = None
+        while rs.prefill_pos < end:
+            c = min(self.scheduler.prefill_chunk, end - rs.prefill_pos)
+            chunk = jnp.asarray(
+                prompt[None, rs.prefill_pos:rs.prefill_pos + c])
+            rs.scratch, logits = self.gen._chunk_jit(
+                self.params, chunk, rs.scratch, jnp.int32(rs.prefill_pos),
+                quantized=False, extent=rs.s_ext)
+            rs.prefill_pos += c
+            self.metrics.prefill_tokens += c
+        if rs.prefill_pos < S0:
+            return None
+        return self._finish_prefill(rs, logits, now)
+
+    def _finish_prefill(self, rs: ReqState, logits,
+                        now: float) -> Optional[RequestOutput]:
+        rid = rs.req.request_id
+        S0 = int(rs.prompt_tokens.shape[0])
+        n_prompt_pages = self.bm.blocks_for(S0)
+        ids = jnp.asarray(self.bm.table(rid)[:n_prompt_pages], jnp.int32)
+        self._pools = self._fill_fn(self._pools, rs.scratch, ids)
+        rs.scratch = None
+        rs.kv_len = S0
+        rs.status = Status.RUNNING
+        last = logits[:, -1]                               # [1, V]
+        if self.spec_k:
+            self._last_logits = self._last_logits.at[rs.slot].set(last[0])
+            self._join_draft(rs)
+            return None  # first token emitted by the next verify round
+        token = self._choose_token(rs, last[0])
+        return self._commit_token(rs, token)
+
+    def _join_draft(self, rs: ReqState) -> None:
+        """Prefill the draft model for a joining row (spec mode)."""
+        dstate = self.draft.prefill(self.draft_params,
+                                    jnp.asarray(rs.prompt_tokens[None]))
+        sd = self._draft_state
+        caches = []
+        for (kb, vb), (k1, v1) in zip(sd.caches, dstate.caches):
+            caches.append((kb.at[rs.slot].set(k1[0]),
+                           vb.at[rs.slot].set(v1[0])))
+        self._draft_state = GenerationState(
+            caches=caches,
+            kv_lens=sd.kv_lens.at[rs.slot].set(dstate.kv_lens[0]),
+            last_logits=sd.last_logits.at[rs.slot].set(
+                dstate.last_logits[0]))
+
+    # -- token choice / emission -----------------------------------------
+
+    def _choose_token(self, rs: ReqState, logits_row) -> int:
+        p = rs.req.params
+        if p.greedy:
+            return int(np.argmax(np.asarray(logits_row)))
+        # Per-token PRNG stream keyed by (seed, emission index): a
+        # preempted-and-recomputed request keeps drawing the same stream.
+        key = jax.random.fold_in(jax.random.key(p.seed),
+                                 len(rs.generated))
+        tok = sample_logits(jnp.asarray(logits_row)[None], key,
+                            temperature=p.temperature, top_k=p.top_k,
+                            top_p=p.top_p)
+        return int(tok[0])
+
+    def _commit_token(self, rs: ReqState,
+                      token: int) -> Optional[RequestOutput]:
+        """Emit one token; retire the request when it finishes.  The
+        token stays ``pending`` (not yet in the cache) until the next
+        decode step consumes it.  Timestamps are taken HERE (not at the
+        step boundary) so TTFT/ITL separate tokens emitted within one
+        iteration (prefill completion + same-step decode)."""
+        now = self._clock()
+        rs.generated.append(token)
+        rs.pending_token = token
+        rs.metrics.on_token(now)
+        if rs.req.on_token is not None:
+            rs.req.on_token(rs.req.request_id, token)
+        p = rs.req.params
+        if p.eos_id is not None and token == p.eos_id:
+            return self._retire(rs, FinishReason.EOS)
+        if len(rs.generated) >= p.max_new_tokens:
+            return self._retire(rs, FinishReason.LENGTH)
+        return None
+
+    def _retire(self, rs: ReqState, reason: FinishReason, *,
+                free: bool = True) -> RequestOutput:
+        now = self._clock()
+        if free:
+            self.bm.free(rs.req.request_id)
+            self.slots[rs.slot] = None
+        rs.status = Status.FINISHED
+        rs.slot = None
+        rs.metrics.finish_time = now
+        out = RequestOutput(request_id=rs.req.request_id,
+                            prompt=rs.req.prompt,
+                            token_ids=list(rs.generated),
+                            finish_reason=reason, metrics=rs.metrics)
+        self._outputs[rs.req.request_id] = out
+        self.metrics.observe_finish(rs.req.request_id, rs.metrics)
+        return out
+
+    # -- capacity / preemption -------------------------------------------
+
+    def _ensure_capacity(self, rs: ReqState, n_tokens: int) -> None:
+        """Grow ``rs``'s allocation to ``n_tokens`` rows, preempting
+        later-admitted slot holders (running OR mid-prefill — both hold
+        blocks) until it fits.  Victims never include ``rs`` itself;
+        when none remain the pool is genuinely too small for this
+        request and the engine raises."""
+        while True:
+            try:
+                self.bm.ensure(rs.req.request_id, n_tokens)
+                return
+            except BlockExhausted:
+                victim = self.scheduler.pick_victim(
+                    [s for s in self.slots if s is not None
+                     and s.status in (Status.RUNNING, Status.PREFILL)],
+                    rs)
+                if victim is None:
+                    raise RuntimeError(
+                        f"{rs.req.request_id}: cannot extend to "
+                        f"{n_tokens} tokens and no preemption victim "
+                        f"remains — the block pool ({self.bm.num_blocks}"
+                        " blocks) is too small for this request")
+                self._preempt(victim)
+
+    def _preempt(self, victim: ReqState) -> None:
+        self.slots[victim.slot] = None
+        victim.scratch = None
+        self.scheduler.preempt(victim)
+        self.metrics.preemptions += 1
+
+    # -- plain decode -----------------------------------------------------
+
+    def _decode_once(self,
+                     running: list[ReqState]) -> list[RequestOutput]:
+        for rs in sorted(running, key=lambda r: r.seq):
+            if rs.status is Status.RUNNING:  # may get preempted below
+                self._ensure_capacity(rs, rs.kv_len + 1)
+        live = [r for r in running if r.status is Status.RUNNING]
+        if not live:
+            return []
+
+        B = self.max_batch
+        tokens = np.zeros((B,), np.int32)
+        lens = np.zeros((B,), np.int32)
+        active = np.zeros((B,), bool)
+        tables = np.zeros((B, self.n_pages_max), np.int32)
+        for rs in live:
+            b = rs.slot
+            tokens[b] = rs.pending_token
+            lens[b] = rs.kv_len
+            active[b] = True
+            tables[b] = self.bm.padded_table(rs.req.request_id,
+                                             self.n_pages_max)
+        self._pools, logits = self._decode_fn(
+            self.params, self._pools, jnp.asarray(tables),
+            jnp.asarray(lens), jnp.asarray(tokens), jnp.asarray(active))
+        self.metrics.decode_steps += 1
+
+        logits_np = np.asarray(logits)
+        finished = []
+        for rs in live:
+            rs.kv_len += 1
+            rs.pending_token = None
+            token = self._choose_token(rs, logits_np[rs.slot])
+            out = self._commit_token(rs, token)
+            if out is not None:
+                finished.append(out)
+        return finished
+
+    # -- speculative rounds ----------------------------------------------
+
+    def _spec_round(self,
+                    running: list[ReqState]) -> list[RequestOutput]:
+        """One speculative round (greedy): draft proposes ``k`` per row,
+        one paged multi-token verify scores all rows at their own
+        lengths, accepts apply per row, the closing token is consumed by
+        a regular paged step — `speculative._generate_batched` re-hosted
+        on the paged cache with per-request retirement."""
+        sd = self._draft_state
+        live = [r for r in running if r.status is Status.RUNNING]
+        top = max(r.kv_len for r in live)
+        k = min(self.spec_k, self.gen.max_seq - 1 - top,
+                self.draft.max_seq - 1 - top)
+        for rs in sorted(live, key=lambda r: r.seq):
+            if rs.status is Status.RUNNING:
+                # Capacity capped at the request's admitted total:
+                # emissions are clamped to remaining_new anyway, and
+                # draft rows the verify writes past the allocation land
+                # in the null block (dead padded-table entries) — never
+                # read by an emission-eligible query.  Without the cap a
+                # request that submit() admitted could demand blocks it
+                # can never use and crash/preempt near its end.
+                self._ensure_capacity(
+                    rs, min(rs.kv_len + max(k, 0) + 1, rs.total_tokens))
+        live = [r for r in live if r.status is Status.RUNNING]
+        if not live:
+            return []
+
+        B = self.max_batch
+        lens = np.zeros((B,), np.int32)
+        active = np.zeros((B,), bool)
+        tables = np.zeros((B, self.n_pages_max), np.int32)
+        for rs in live:
+            lens[rs.slot] = rs.kv_len
+            active[rs.slot] = True
+            tables[rs.slot] = self.bm.padded_table(rs.req.request_id,
+                                                   self.n_pages_max)
+        lens_d = jnp.asarray(lens)
+        active_d = jnp.asarray(active)
+        tables_d = jnp.asarray(tables)
+        # Draft lengths track the target's committed lengths.
+        sd = GenerationState(caches=sd.caches, kv_lens=lens_d,
+                             last_logits=sd.last_logits)
+
+        if k <= 0:
+            # No headroom to speculate (the last cache slots): one plain
+            # greedy token via the accept machinery's fallback.
+            toks_np = np.argmax(np.asarray(self._last_logits), axis=-1)
+            closing = jnp.asarray(toks_np.astype(np.int32))
+            emitted = {rs.slot: [int(toks_np[rs.slot])] for rs in live}
+        else:
+            props = []
+            for _ in range(k):
+                tok = jnp.argmax(sd.last_logits, axis=-1).astype(jnp.int32)
+                sd = self.draft.step(self.draft_params, sd, tok,
+                                     active=active_d)
+                props.append(tok)
+            proposals = jnp.stack(props, axis=1)            # [B, k]
+            self._pools, logits_all = self._verify_fn(
+                self.params, self._pools, tables_d, lens_d, proposals,
+                active_d)
+            m_dev, toks = greedy_accept_chain_batched(
+                proposals, self._last_logits, logits_all)
+            m_np, toks_np = jax.device_get((m_dev, toks))
+            emitted = {}
+            closing_np = np.zeros((B,), np.int32)
+            for rs in live:
+                b = rs.slot
+                m_used = min(int(m_np[b]), rs.remaining_new - 1)
+                emitted[b] = [int(t) for t in toks_np[b, :m_used + 1]]
+                closing_np[b] = toks_np[b, m_used]
+                rs.kv_len += m_used
+                lens[b] = rs.kv_len
+            closing = jnp.asarray(closing_np)
+            lens_d = jnp.asarray(lens)
+            # Draft rolls back to the per-row accepted lengths too.
+            sd = GenerationState(caches=sd.caches, kv_lens=lens_d,
+                                 last_logits=sd.last_logits)
+        self.metrics.verify_rounds += 1
+
+        # Consume each row's closing token: one paged decode step (also
+        # refreshes last_logits for the next round) + the draft's step.
+        self._pools, logits = self._decode_fn(
+            self.params, self._pools, tables_d, lens_d, closing, active_d)
+        self.metrics.decode_steps += 1
+        self._last_logits = logits
+        sd = self.draft.step(self.draft_params, sd, closing,
+                             active=active_d)
+        self._draft_state = sd
+
+        finished = []
+        for rs in sorted(live, key=lambda r: r.seq):
+            rs.kv_len += 1
+            out = None
+            for t in emitted[rs.slot]:
+                out = self._commit_token(rs, t)
+                if out is not None:
+                    break  # retired mid-round; rest of the chain dropped
+            rs.pending_token = None  # spec mode: cache already consumed it
+            if out is not None:
+                finished.append(out)
+        return finished

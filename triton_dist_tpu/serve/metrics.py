@@ -1,0 +1,143 @@
+"""Serving metrics: per-request latencies + engine-level gauges.
+
+Per request: TTFT (arrival → first emitted token), inter-token latencies,
+queue wait (arrival → first scheduled).  Per engine step: queue depth,
+running batch occupancy, KV-block utilization; counters for preemptions,
+prefill tokens, decode/verify passes.
+
+Export rides the existing observability path (``runtime/dump.py``): with
+``TDT_DUMP_IR=<dir>`` set, :meth:`ServeMetrics.maybe_dump` writes
+``<dir>/<name>.json`` next to the kernel IR dumps — one switch arms both.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from triton_dist_tpu.runtime import dump
+
+
+@dataclass
+class RequestMetrics:
+    """Timestamps (engine clock) and derived latencies for one request."""
+
+    arrival_time: float
+    first_scheduled_time: Optional[float] = None
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    token_times: list[float] = field(default_factory=list)
+    n_preemptions: int = 0
+
+    def on_scheduled(self, now: float) -> None:
+        if self.first_scheduled_time is None:
+            self.first_scheduled_time = now
+
+    def on_token(self, now: float) -> None:
+        if self.first_token_time is None:
+            self.first_token_time = now
+        self.token_times.append(now)
+
+    @property
+    def ttft(self) -> Optional[float]:
+        """Time to first token (arrival → first emission)."""
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.arrival_time
+
+    @property
+    def queue_time(self) -> Optional[float]:
+        if self.first_scheduled_time is None:
+            return None
+        return self.first_scheduled_time - self.arrival_time
+
+    @property
+    def inter_token_latencies(self) -> list[float]:
+        t = self.token_times
+        return [b - a for a, b in zip(t, t[1:])]
+
+    @property
+    def mean_itl(self) -> Optional[float]:
+        itl = self.inter_token_latencies
+        return sum(itl) / len(itl) if itl else None
+
+    def to_dict(self) -> dict:
+        return {
+            "arrival_time": self.arrival_time,
+            "ttft": self.ttft,
+            "queue_time": self.queue_time,
+            "mean_itl": self.mean_itl,
+            "n_tokens": len(self.token_times),
+            "n_preemptions": self.n_preemptions,
+            "finish_time": self.finish_time,
+        }
+
+
+@dataclass
+class ServeMetrics:
+    """Engine-level counters + per-step gauge series."""
+
+    # counters
+    steps: int = 0
+    decode_steps: int = 0
+    verify_rounds: int = 0
+    prefill_tokens: int = 0
+    preemptions: int = 0
+    completed: int = 0
+    # per-step gauge series (appended by the engine each iteration)
+    queue_depth: list[int] = field(default_factory=list)
+    running: list[int] = field(default_factory=list)
+    kv_utilization: list[float] = field(default_factory=list)
+    # retired requests' metrics, keyed by request id
+    requests: dict = field(default_factory=dict)
+
+    def observe_step(self, *, queue_depth: int, running: int,
+                     kv_utilization: float) -> None:
+        self.steps += 1
+        self.queue_depth.append(queue_depth)
+        self.running.append(running)
+        self.kv_utilization.append(kv_utilization)
+
+    def observe_finish(self, request_id: str, rm: RequestMetrics) -> None:
+        self.completed += 1
+        self.requests[request_id] = rm
+
+    def summary(self) -> dict:
+        """Aggregate view (what the CLI prints and maybe_dump writes)."""
+        ttfts = [m.ttft for m in self.requests.values()
+                 if m.ttft is not None]
+        itls = [x for m in self.requests.values()
+                for x in m.inter_token_latencies]
+        return {
+            "steps": self.steps,
+            "decode_steps": self.decode_steps,
+            "verify_rounds": self.verify_rounds,
+            "prefill_tokens": self.prefill_tokens,
+            "preemptions": self.preemptions,
+            "completed": self.completed,
+            "max_queue_depth": max(self.queue_depth, default=0),
+            "mean_running": (sum(self.running) / len(self.running)
+                             if self.running else 0.0),
+            "peak_kv_utilization": max(self.kv_utilization, default=0.0),
+            "mean_kv_utilization": (sum(self.kv_utilization)
+                                    / len(self.kv_utilization)
+                                    if self.kv_utilization else 0.0),
+            "mean_ttft": sum(ttfts) / len(ttfts) if ttfts else None,
+            "max_ttft": max(ttfts, default=None) if ttfts else None,
+            "mean_itl": sum(itls) / len(itls) if itls else None,
+            "requests": {rid: m.to_dict()
+                         for rid, m in self.requests.items()},
+        }
+
+    def maybe_dump(self, name: str = "serve_metrics") -> Optional[str]:
+        """Write the summary as JSON under the IR-dump dir when
+        ``TDT_DUMP_IR`` is set (runtime/dump.py — one observability
+        switch for kernels AND serving); no-op otherwise."""
+        directory = dump.dump_dir()
+        if directory is None:
+            return None
+        path = os.path.join(directory, dump._safe(name) + ".json")
+        dump._write(path, json.dumps(self.summary(), indent=2))
+        return path

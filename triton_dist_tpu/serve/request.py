@@ -1,0 +1,99 @@
+"""Request/response surface of the serving engine.
+
+A :class:`Request` is what a frontend submits: prompt tokens, sampling
+knobs, an arrival timestamp, and an optional streaming callback fired as
+each token is emitted.  A :class:`RequestOutput` is what the engine
+returns at retirement: the emitted tokens, why generation stopped, and
+the request's latency metrics (TTFT / inter-token gaps; serve/metrics.py).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+
+class FinishReason(enum.Enum):
+    LENGTH = "length"  # hit max_new_tokens
+    EOS = "eos"        # emitted params.eos_id (included in the output)
+    ABORT = "abort"    # cancelled by the caller
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling knobs (the serving twin of
+    ``models.sampling.make_sampler``).
+
+    ``temperature == 0`` is greedy argmax; otherwise tokens draw from the
+    temperature → top-k → top-p filtered distribution with a per-request
+    PRNG stream (``seed``), folded per emitted token — so a preempted and
+    recomputed request keeps drawing the SAME stream where it left off.
+    """
+
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_id: Optional[int] = None
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+        if self.temperature < 0:
+            raise ValueError(
+                f"temperature must be >= 0, got {self.temperature}")
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature == 0.0
+
+
+@dataclass
+class Request:
+    """One generation request.
+
+    ``on_token(request_id, token)`` (optional) streams each emitted token
+    the moment the engine commits it — before the request retires.
+    ``arrival_time`` defaults to the engine clock at ``submit()``.
+    """
+
+    request_id: str
+    prompt: np.ndarray  # [S0] int32 token ids
+    params: SamplingParams = field(default_factory=SamplingParams)
+    arrival_time: Optional[float] = None
+    on_token: Optional[Callable[[str, int], None]] = None
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError(f"request {self.request_id}: empty prompt")
+
+
+@dataclass
+class RequestOutput:
+    """The engine's answer: emitted tokens + why it stopped + latencies."""
+
+    request_id: str
+    prompt: np.ndarray
+    token_ids: list[int]
+    finish_reason: FinishReason
+    metrics: "RequestMetrics"  # serve/metrics.py (quoted: no import cycle)
+
+    @property
+    def n_generated(self) -> int:
+        return len(self.token_ids)
+
+
+def make_requests(prompts: Sequence[Sequence[int]], *,
+                  params: SamplingParams | None = None,
+                  prefix: str = "req") -> list[Request]:
+    """Convenience: wrap raw prompt token lists into numbered requests."""
+    params = params or SamplingParams()
+    return [Request(request_id=f"{prefix}-{i}",
+                    prompt=np.asarray(p, np.int32), params=params)
+            for i, p in enumerate(prompts)]

@@ -1,0 +1,180 @@
+"""Iteration-level FCFS scheduler with a chunked-prefill token budget.
+
+Orca-style continuous batching: scheduling decisions happen every engine
+iteration, not per request — new prompts are admitted the moment a batch
+slot AND enough KV blocks exist, prompt prefill is metered in chunks so a
+long prompt cannot starve in-flight decode (the budget), and decode rows
+retire individually.
+
+Preemption (vLLM-style recompute): when a running request cannot extend
+its KV allocation, the LATEST-admitted running request is evicted — its
+blocks free immediately, its emitted tokens are kept, and it re-queues at
+the FRONT of the waiting line with ``prompt + generated`` as the new
+prompt (greedy recompute is deterministic, and sampled requests keep
+their per-token PRNG stream, so the emission is unchanged).
+"""
+
+from __future__ import annotations
+
+import enum
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from triton_dist_tpu.serve.block_manager import BlockManager
+from triton_dist_tpu.serve.metrics import RequestMetrics
+from triton_dist_tpu.serve.request import Request
+
+
+class Status(enum.Enum):
+    WAITING = "waiting"    # queued, no slot/blocks yet
+    PREFILL = "prefill"    # admitted, prompt streaming through chunks
+    RUNNING = "running"    # in the decode batch
+    FINISHED = "finished"
+
+
+@dataclass
+class ReqState:
+    """Engine-side state of one request (the scheduler moves it between
+    queues; the engine owns its device-facing fields)."""
+
+    req: Request
+    metrics: RequestMetrics
+    status: Status = Status.WAITING
+    slot: Optional[int] = None      # decode-batch row while admitted
+    kv_len: int = 0                 # committed cache rows
+    prefill_pos: int = 0            # prompt tokens already prefilled
+    generated: list[int] = field(default_factory=list)
+    pending_token: Optional[int] = None  # emitted, not yet consumed
+    seq: int = 0                    # admission order (preemption victim)
+    # recompute prompt: original prompt + tokens generated before a
+    # preemption (rebuilt by the scheduler on eviction)
+    work_prompt: Optional[np.ndarray] = None
+    # chunked-prefill scratch (engine-owned): per-layer contiguous K/V
+    # [1, Hkv, s_ext, D] the prompt streams into before the page scatter
+    scratch: Optional[list] = None
+    s_ext: int = 0
+
+    @property
+    def prompt_tokens(self) -> np.ndarray:
+        return (self.work_prompt if self.work_prompt is not None
+                else self.req.prompt)
+
+    @property
+    def remaining_new(self) -> int:
+        return self.req.params.max_new_tokens - len(self.generated)
+
+    @property
+    def total_tokens(self) -> int:
+        """The request's admitted cache ceiling (prompt + max_new):
+        invariant under preemption/recompute — the recompute prompt
+        absorbs generated tokens 1:1 from the remaining budget."""
+        return int(self.req.prompt.shape[0]) + self.req.params.max_new_tokens
+
+
+class FCFSScheduler:
+    """First-come-first-served admission + prefill metering + LIFO
+    preemption, all against one :class:`BlockManager`."""
+
+    def __init__(self, block_manager: BlockManager, *,
+                 prefill_budget: int, prefill_chunk: int):
+        assert prefill_chunk >= 1 and prefill_budget >= 1
+        self.bm = block_manager
+        # Batch-slot capacity lives with the ENGINE (admit() is bounded
+        # by the free_slots list it passes in) — one source of truth.
+        # tokens of prompt prefill allowed per engine iteration; at least
+        # one chunk always proceeds so prefill cannot livelock
+        self.prefill_budget = prefill_budget
+        self.prefill_chunk = prefill_chunk
+        self.waiting: deque[ReqState] = deque()
+        self._seq = 0
+
+    # -- queue ------------------------------------------------------------
+
+    def add(self, rs: ReqState, *, front: bool = False) -> None:
+        (self.waiting.appendleft if front else self.waiting.append)(rs)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    # -- admission --------------------------------------------------------
+
+    def admit(self, free_slots: list[int], now: float) -> list[ReqState]:
+        """Pop waiting requests while a slot and their prompt's blocks
+        (plus one decode-headroom block) are available.  FCFS: the head
+        blocking keeps everyone behind it queued — no starvation."""
+        admitted = []
+        while self.waiting and free_slots:
+            rs = self.waiting[0]
+            n_prompt = int(rs.prompt_tokens.shape[0])
+            # +1 token of headroom: admission must leave room to decode
+            # at least one token past the prompt, or the request would
+            # immediately preempt something.
+            if not self.bm.can_allocate(n_prompt + 1):
+                break
+            self.waiting.popleft()
+            rs.slot = free_slots.pop(0)
+            rs.status = Status.PREFILL
+            rs.prefill_pos = 0
+            rs.kv_len = 0
+            rs.seq = self._seq
+            self._seq += 1
+            self.bm.allocate(rs.req.request_id, n_prompt + 1)
+            rs.metrics.on_scheduled(now)
+            admitted.append(rs)
+        return admitted
+
+    # -- chunked-prefill metering ----------------------------------------
+
+    def prefill_plan(self, prefilling: list[ReqState]) -> list[tuple]:
+        """Assign this iteration's prompt-token budget to PREFILL-state
+        requests (admission order).  Returns [(rs, n_tokens)]; the first
+        assignment always gets at least one chunk (progress guarantee)."""
+        plan = []
+        budget = self.prefill_budget
+        for rs in sorted(prefilling, key=lambda r: r.seq):
+            remaining = int(rs.prompt_tokens.shape[0]) - rs.prefill_pos
+            if remaining <= 0:
+                continue
+            if not plan:
+                # Head of line: at least one chunk even when budget <
+                # chunk (otherwise a budget smaller than the chunk size
+                # would stall prefill forever).
+                n = min(remaining, max(budget, self.prefill_chunk))
+            elif budget <= 0:
+                break
+            else:
+                n = min(remaining, budget)
+            plan.append((rs, n))
+            budget -= n
+        return plan
+
+    # -- preemption -------------------------------------------------------
+
+    def pick_victim(self, running: list[ReqState],
+                    needy: ReqState) -> Optional[ReqState]:
+        """LIFO eviction: the latest-admitted running request other than
+        ``needy`` (evicting the one that still needs blocks would free
+        nothing it can use — its own blocks come back to it)."""
+        candidates = [r for r in running if r is not needy]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda r: r.seq)
+
+    def preempt(self, rs: ReqState) -> None:
+        """Evict ``rs``: free its blocks and re-queue it (front) for
+        recompute — the new prompt is everything already committed, so
+        emitted tokens stay emitted."""
+        self.bm.free(rs.req.request_id)
+        rs.work_prompt = np.concatenate(
+            [rs.req.prompt, np.asarray(rs.generated, np.int32)])
+        rs.status = Status.WAITING
+        rs.slot = None
+        rs.kv_len = 0
+        rs.prefill_pos = 0
+        rs.pending_token = None
+        rs.metrics.n_preemptions += 1
+        self.add(rs, front=True)
