@@ -65,6 +65,16 @@ def parse_args():
                         "world-1 (docs/serving.md)")
     p.add_argument("--requests", type=int, default=8,
                    help="engine mode: number of requests to drive")
+    p.add_argument("--mixed", action="store_true",
+                   help="engine mode: sweep prompt lengths across the "
+                        "shape-bucket ladder (one short/one long per "
+                        "rung) instead of sampling them — demos that "
+                        "O(ladder) compiled programs cover every "
+                        "length; prints trace-cache stats")
+    p.add_argument("--warmup", action="store_true",
+                   help="engine mode: engine.warmup() before traffic "
+                        "(pre-compiles the bucket ladder; steady-state "
+                        "serving then never compiles)")
     p.add_argument("--stagger", type=int, default=2,
                    help="engine mode: submit a new request every "
                         "S engine steps")
@@ -94,9 +104,16 @@ def run_engine(args, key):
     # the engine is world-1 (per-row block tables are host-managed)
     mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
     rng = np.random.default_rng(args.seed)
-    lens = rng.integers(max(2, args.prompt_len // 2),
-                        2 * args.prompt_len + 1, size=args.requests)
-    max_seq = int(max(lens)) + args.new_tokens
+    if args.mixed:
+        # Lengths picked AFTER the engine exists, swept across its
+        # bucket ladder (below); size the model for the longest.
+        lens = None
+        hi = max(4, 2 * args.prompt_len)
+        max_seq = hi + args.new_tokens
+    else:
+        lens = rng.integers(max(2, args.prompt_len // 2),
+                            2 * args.prompt_len + 1, size=args.requests)
+        max_seq = int(max(lens)) + args.new_tokens
     max_seq += (-max_seq) % args.page_size
 
     cfg = llama.LlamaConfig(vocab=256, dim=32, n_layers=2, n_heads=2,
@@ -125,6 +142,24 @@ def run_engine(args, key):
     dist_print(f"engine: {args.requests} requests, pool {num_blocks} "
                f"blocks x{page} tokens, batch {args.max_batch}"
                f"{f', speculative k={args.speculative}' if args.speculative else ''}")
+    if args.mixed:
+        # One just-under-a-rung and one just-over-half-a-rung length per
+        # ladder rung: every bucket gets traffic, no length repeats a
+        # shape the engine would have to retrace on.
+        cand = sorted({min(hi, max(2, v)) for r in engine.ladder
+                       for v in (r // 2 + 1, r - 1)})
+        lens = np.array([cand[i % len(cand)]
+                         for i in range(args.requests)])
+        dist_print(f"mixed traffic: ladder {engine.ladder}, "
+                   f"prompt lengths {sorted(set(int(x) for x in lens))}")
+    if args.warmup:
+        w = engine.warmup()
+        caveat = (" (spec mode: the draft's per-length prefill still "
+                  "compiles at admission — see the draft_prefill counter)"
+                  if args.speculative else "")
+        dist_print(f"warmup: {w['programs']} programs compiled in "
+                   f"{w['seconds'] * 1e3:.0f} ms — steady-state serving "
+                   f"is compile-free{caveat}")
 
     params_s = SamplingParams(max_new_tokens=args.new_tokens,
                               temperature=args.temperature,
@@ -164,6 +199,13 @@ def run_engine(args, key):
                f"{s['max_queue_depth']}, peak kv util "
                f"{s['peak_kv_utilization']:.2f}, preemptions "
                f"{s['preemptions']}")
+    comp = s["compilation"]
+    per = ", ".join(f"{n} {c['misses']}c/{c['hits']}h"
+                    for n, c in comp["programs"].items())
+    dist_print(f"trace cache (compiles/hits): {per}")
+    dist_print(f"compile stalls: {comp['total_compile_time_s'] * 1e3:.0f} "
+               f"ms total, {comp['warmup_compiles']} programs "
+               f"({comp['warmup_time_s'] * 1e3:.0f} ms) during warmup")
     dumped = engine.metrics.maybe_dump()
     if dumped:
         dist_print(f"engine metrics dumped to {dumped}")

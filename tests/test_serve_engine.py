@@ -120,13 +120,33 @@ def test_scheduler_prefill_budget_assignment():
     assert [(r.req.request_id, n) for r, n in plan] == [("r1", 8)]
     rs1.prefill_pos = 18                        # 2 tokens left
     plan = sched.prefill_plan([rs1, rs2, rs3])
+    # r1's residual is CHARGED as a full (padded) chunk, so r2 gets the
+    # one remaining chunk of budget — never a partial mid-prompt chunk
+    # (every _chunk_jit call must be the one fixed shape).
     assert [(r.req.request_id, n) for r, n in plan] == [("r1", 2),
-                                                        ("r2", 6)]
+                                                        ("r2", 4)]
     # head-of-line progress: budget below one chunk still prefills
     sched.prefill_budget = 2
     rs1.prefill_pos = 0
     plan = sched.prefill_plan([rs1])
     assert plan == [(rs1, 4)]                   # one full chunk, not 2
+
+
+def test_scheduler_prefill_plan_full_chunks_only():
+    """Every plan assignment is a whole-chunk multiple except a prompt's
+    final residual — the engine pads that one up to the fixed chunk
+    shape, so mid-prompt partial chunks must never be scheduled."""
+    sched, bm = _sched(budget=10, chunk=4, num_blocks=33, page=4)
+    rs1, rs2 = _rs("r1", 19), _rs("r2", 19)
+    sched.add(rs1)
+    sched.add(rs2)
+    sched.admit([0, 1], now=0.0)
+    for start in range(0, 19, 4):
+        rs1.prefill_pos = start
+        rs2.prefill_pos = 0
+        for rs, n in sched.prefill_plan([rs1, rs2]):
+            remaining = 19 - rs.prefill_pos
+            assert n % 4 == 0 or n == remaining, (rs.req.request_id, n)
 
 
 def test_scheduler_preempt_requeues_front_for_recompute():
@@ -183,6 +203,212 @@ def test_metrics_latency_math():
     assert s["peak_kv_utilization"] == 0.5
     assert s["mean_ttft"] == 5.0 and s["completed"] == 1
     assert s["requests"]["r"]["n_tokens"] == 3
+
+
+# ---------------------------------------------------------------------------
+# fast tier: shape-bucketed trace cache (the compile-stall killer)
+# ---------------------------------------------------------------------------
+
+
+def test_build_bucket_ladder():
+    from triton_dist_tpu.serve.engine import build_bucket_ladder
+
+    assert build_bucket_ladder(8, 63, 8) == [8, 16, 32, 64]
+    assert build_bucket_ladder(16, 16, 8) == [16]
+    assert build_bucket_ladder(4, 100, 8) == [8, 16, 32, 64, 104]
+    ladder = build_bucket_ladder(5, 1000, 4)   # base rounds up to page
+    assert ladder[0] == 8 and ladder[-1] == 1000
+    assert all(r % 4 == 0 for r in ladder)
+    assert all(a < b for a, b in zip(ladder, ladder[1:]))
+    with pytest.raises(ValueError):
+        build_bucket_ladder(0, 64, 8)
+
+
+def test_counting_jit_hits_misses():
+    from triton_dist_tpu.runtime.jit_cache import CountingJit
+
+    cj = CountingJit(jax.jit(lambda x: x * 2), "dbl")
+    cj(jnp.ones((4,)))
+    cj(jnp.ones((4,)))                  # same shape: hit
+    cj(jnp.ones((8,)))                  # new shape: miss
+    assert cj.misses == 2 and cj.hits == 1
+    assert cj.compile_time > 0
+    s = cj.stats()
+    assert s["misses"] == 2 and s["cache_size"] in (2, None)
+
+
+def test_jit_cache_stats_counts_shard_jit_builds():
+    from jax.sharding import PartitionSpec
+    from triton_dist_tpu.runtime import jit_cache
+
+    before = jit_cache.cache_stats()
+    assert set(before) == {"hits", "misses", "currsize", "maxsize"}
+    jit_cache.cached_shard_jit(_echo_builder, _MESH1, (PartitionSpec(),),
+                               PartitionSpec())
+    mid = jit_cache.cache_stats()
+    assert mid["misses"] == before["misses"] + 1      # fresh build
+    jit_cache.cached_shard_jit(_echo_builder, _MESH1, (PartitionSpec(),),
+                               PartitionSpec())
+    after = jit_cache.cache_stats()
+    assert after["hits"] == mid["hits"] + 1           # memoized
+    assert after["currsize"] == mid["currsize"]
+
+
+def _echo_builder(x):
+    return x
+
+
+_MESH1 = Mesh(np.array(jax.devices()[:1]), ("x",))
+
+
+def _tiny_model():
+    """1-layer toy small enough for the tier-1 gate to compile twice."""
+    cfg = llama.LlamaConfig(vocab=64, dim=16, n_layers=1, n_heads=2,
+                            n_kv_heads=1, ffn_dim=32, max_seq=64,
+                            dtype=jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    params = llama.init_params(cfg, jax.random.key(3))
+    gen = Generator(cfg, mesh, axis="sp", max_seq=64)
+    return cfg, params, gen
+
+
+def _drive(eng, prompts, n_new, stagger=2):
+    reqs = [Request(f"r{i}", p, SamplingParams(max_new_tokens=n_new))
+            for i, p in enumerate(prompts)]
+    submitted = step = 0
+    outs = {}
+    while eng.has_work() or submitted < len(reqs):
+        if step % stagger == 0 and submitted < len(reqs):
+            eng.submit(reqs[submitted])
+            submitted += 1
+        for o in eng.step():
+            outs[o.request_id] = o
+        step += 1
+        assert step < 2000
+    return outs
+
+
+def test_engine_bounded_compilation_and_warmup():
+    """THE tentpole acceptance test (tier-1): staggered traffic over >= 8
+    DISTINCT prompt lengths compiles O(bucket-ladder) programs, not
+    O(distinct shapes); a warmed engine then serves the same traffic with
+    the compile-miss counter flat; and the padded/bucketed streams stay
+    bit-identical to the per-request oracle."""
+    cfg, params, gen = _tiny_model()
+    # 10 distinct lengths: not multiples of the chunk (4) or page (4),
+    # rung boundaries, rung+1, and the sub-chunk minimum.
+    lens = [3, 4, 5, 7, 9, 13, 16, 17, 23, 31]
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, cfg.vocab, size=n).astype(np.int32)
+               for n in lens]
+    n_new = 3
+
+    eng = ServeEngine(gen, params, num_blocks=40, page_size=4,
+                      max_batch=2, prefill_chunk=4, clock=_Tick())
+    outs = _drive(eng, prompts, n_new)
+    assert sorted(outs) == sorted(f"r{i}" for i in range(len(lens)))
+
+    n_rungs = len(eng.ladder)             # [4, 8, 16, 32, 64] here
+    assert len(set(lens)) >= 8 > n_rungs - 1
+    chunk_stats = eng._chunk_fn.stats()
+    assert chunk_stats["misses"] <= n_rungs, (eng.ladder, chunk_stats)
+    assert eng._fill_fn.misses <= n_rungs
+    assert eng._decode_fn.misses == 1     # one fixed decode shape
+    # the counters ride the metrics summary / TDT_DUMP_IR path
+    comp = eng.metrics.summary()["compilation"]
+    assert comp["programs"]["prefill_chunk"]["misses"] <= n_rungs
+    assert comp["total_misses"] == eng.metrics.compile_misses
+    assert comp["total_compile_time_s"] > 0
+    assert "cached_shard_jit" in comp
+
+    # padded-final-chunk + bucketed-s_ext bit-exactness vs the oracle
+    # (3 = sub-chunk, 13 = not a multiple of chunk/page, 16 = exact rung)
+    for i in (0, 5, 6):
+        want = _oracle(gen, params, prompts[i], n_new)
+        assert outs[f"r{i}"].token_ids == want, f"r{i} (len {lens[i]})"
+
+    # A fresh warmed engine: same traffic, zero post-warmup compiles.
+    cfg2, params2, gen2 = _tiny_model()
+    eng2 = ServeEngine(gen2, params2, num_blocks=40, page_size=4,
+                       max_batch=2, prefill_chunk=4, clock=_Tick())
+    w = eng2.warmup()
+    assert w["programs"] == eng2.metrics.compile_misses > 0
+    assert eng2.metrics.warmup_compiles == w["programs"]
+    flat = eng2.metrics.compile_misses
+    outs2 = _drive(eng2, prompts, n_new)
+    assert eng2.metrics.compile_misses == flat, (
+        "steady-state serving compiled after warmup: "
+        f"{eng2.metrics.summary()['compilation']}")
+    for rid, o in outs.items():           # same params key -> same streams
+        assert outs2[rid].token_ids == o.token_ids
+
+
+def test_engine_warmup_covers_top_rung_odd_chunk():
+    """Regression: with a chunk that divides neither page nor max_seq
+    (page 16, chunk 7, max_seq 16 -> ladder [16, 32]), the top rung is
+    only reachable by near-max-length prompts; warmup's per-rung prompt
+    picker must invert _scratch_need exactly or that rung stays cold and
+    a 15-token prompt compiles on the admission path post-warmup."""
+    cfg = llama.LlamaConfig(vocab=64, dim=16, n_layers=1, n_heads=2,
+                            n_kv_heads=1, ffn_dim=32, max_seq=16,
+                            dtype=jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    params = llama.init_params(cfg, jax.random.key(3))
+    gen = Generator(cfg, mesh, axis="sp", max_seq=16)
+    eng = ServeEngine(gen, params, num_blocks=8, page_size=16,
+                      max_batch=1, prefill_chunk=7, clock=_Tick())
+    assert eng.ladder == [16, 32]
+    assert eng._bucket_s_ext(15) == 32      # roundup(15, 7) = 21 > 16
+    eng.warmup()
+    flat = eng.metrics.compile_misses
+    p = np.arange(15, dtype=np.int32) % cfg.vocab
+    eng.submit(Request("top", p, SamplingParams(max_new_tokens=1)))
+    outs = eng.run()
+    assert eng.metrics.compile_misses == flat, (
+        eng.metrics.summary()["compilation"])
+    assert outs["top"].token_ids == _oracle(gen, params, p, 1)
+
+
+def test_engine_warmup_tight_pool_falls_back_to_admissible_dummy():
+    """Regression: warmup's rung-16 dummy at full length + max_new=2
+    (18 tokens -> 5 blocks) exceeds a 4-block pool, but a production
+    request reaching that rung (prompt 15, max_new=1 -> 4 blocks) is
+    still admittable — warmup must fall back to a smaller dummy rather
+    than leave the rung cold."""
+    cfg = llama.LlamaConfig(vocab=64, dim=16, n_layers=1, n_heads=2,
+                            n_kv_heads=1, ffn_dim=32, max_seq=32,
+                            dtype=jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    params = llama.init_params(cfg, jax.random.key(3))
+    gen = Generator(cfg, mesh, axis="sp", max_seq=32)
+    eng = ServeEngine(gen, params, num_blocks=5, page_size=4,
+                      max_batch=1, prefill_chunk=4, clock=_Tick())
+    assert 16 in eng.ladder
+    eng.warmup()
+    flat = eng.metrics.compile_misses
+    p = np.arange(15, dtype=np.int32) % cfg.vocab
+    eng.submit(Request("tight", p, SamplingParams(max_new_tokens=1)))
+    outs = eng.run()
+    assert eng.metrics.compile_misses == flat, (
+        eng.metrics.summary()["compilation"])
+    assert outs["tight"].token_ids == _oracle(gen, params, p, 1)
+
+
+def test_engine_custom_bucket_ladder_validated():
+    cfg, params, gen = _tiny_model()
+    with pytest.raises(ValueError, match="bucket_ladder"):
+        ServeEngine(gen, params, num_blocks=8, page_size=4, max_batch=1,
+                    prefill_chunk=4, bucket_ladder=[6])   # not a page mult
+    with pytest.raises(ValueError, match="bucket_ladder"):
+        ServeEngine(gen, params, num_blocks=8, page_size=4, max_batch=1,
+                    prefill_chunk=8, bucket_ladder=[4])   # < one chunk
+    eng = ServeEngine(gen, params, num_blocks=8, page_size=4, max_batch=1,
+                      prefill_chunk=4, bucket_ladder=[8, 24])
+    assert eng.ladder == [8, 24, 64]      # cap appended to cover max_seq
+    assert eng._bucket_s_ext(5) == 8
+    assert eng._bucket_s_ext(9) == 24
+    assert eng._bucket_s_ext(25) == 64
+    assert eng._bucket_s_ext(63) == 64
 
 
 # ---------------------------------------------------------------------------
@@ -465,6 +691,72 @@ def test_engine_spec_capacity_capped_at_admitted_total(model):
     outs = eng.run()
     assert outs["cap"].token_ids == _oracle(gen, params, p, 16)
     assert eng.metrics.preemptions == 0
+
+
+@pytest.mark.slow
+def test_engine_warmup_padded_buckets_oracle(model):
+    """Warmed engine + tight pool: mixed non-multiple prompt lengths ride
+    the padded-final-chunk and bucketed-s_ext paths through queueing AND
+    preemption-recompute, stay bit-exact, and never compile after
+    warmup."""
+    cfg, params, gen = model
+    rng = np.random.default_rng(21)
+    lens = [1, 5, 7, 9, 13, 15, 17, 21]     # none a multiple of chunk=4
+    prompts = [rng.integers(0, cfg.vocab, size=n).astype(np.int32)
+               for n in lens]
+    n_new = 6
+    # 11 allocatable blocks of 8 ≈ two max-size requests -> queueing and
+    # decode-time extension pressure.
+    eng = ServeEngine(gen, params, num_blocks=12, page_size=8,
+                      max_batch=3, prefill_chunk=4, prefill_budget=8,
+                      clock=_Tick())
+    eng.warmup()
+    flat = eng.metrics.compile_misses
+    outs = _drive(eng, prompts, n_new)
+    assert eng.metrics.compile_misses == flat, (
+        eng.metrics.summary()["compilation"])
+    for i, p in enumerate(prompts):
+        assert outs[f"r{i}"].token_ids == _oracle(gen, params, p, n_new), (
+            f"r{i} (len {lens[i]}) diverged")
+
+
+@pytest.mark.slow
+def test_engine_speculative_warmup_compile_free(model):
+    """Speculative engine mode: warmup covers the verify pass + draft
+    step too — the four paged engine programs stay compile-free under
+    traffic.  The draft's own per-prompt-length prefill still compiles
+    at admission (ROADMAP follow-up), but it must be VISIBLE in the
+    compile metrics, not silent."""
+    cfg, params, gen = model
+    dcfg = llama.LlamaConfig(vocab=cfg.vocab, dim=16, n_layers=1,
+                             n_heads=1, n_kv_heads=1, ffn_dim=32,
+                             max_seq=64, dtype=jnp.float32)
+    d_params = llama.init_params(dcfg, jax.random.key(5))
+    draft = Generator(dcfg, gen.mesh, axis="sp", max_seq=64)
+    rng = np.random.default_rng(22)
+    prompts = [rng.integers(0, cfg.vocab, size=n).astype(np.int32)
+               for n in (3, 6, 11, 13)]
+    n_new = 6
+
+    def paged_misses(e):
+        return sum(c.misses for c in e.metrics.compiled_fns
+                   if not c.name.startswith("draft_"))
+
+    eng = ServeEngine(gen, params, num_blocks=40, page_size=8,
+                      max_batch=2, prefill_chunk=4, draft=draft,
+                      draft_params=d_params, spec_k=3, clock=_Tick())
+    eng.warmup()
+    flat = paged_misses(eng)
+    outs = _drive(eng, prompts, n_new)
+    assert eng.metrics.verify_rounds >= 1
+    assert paged_misses(eng) == flat, (
+        eng.metrics.summary()["compilation"])
+    comp = eng.metrics.summary()["compilation"]["programs"]
+    # draft-side stalls are counted, not hidden (4 fresh prompt lengths)
+    assert comp["draft_prefill"]["misses"] >= 4
+    assert "draft_step" in comp
+    for i, p in enumerate(prompts):
+        assert outs[f"r{i}"].token_ids == _oracle(gen, params, p, n_new)
 
 
 @pytest.mark.slow

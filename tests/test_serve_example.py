@@ -55,3 +55,21 @@ def test_serve_engine_speculative():
                devices=1, new_tokens=4)
     assert "engine: 12 tokens / 3 requests" in out, out
     assert "verify)" in out and "done" in out
+
+
+def test_serve_engine_mixed_warmup():
+    """--mixed --warmup: lengths swept across the bucket ladder compile
+    only during warmup; the trace-cache report proves traffic itself was
+    compile-free (0 extra compiles beyond warmup's)."""
+    out = _run("--engine", "--mixed", "--warmup", "--requests", "6",
+               "--prompt-len", "10", "--page-size", "8", devices=1,
+               new_tokens=4)
+    assert "mixed traffic: ladder" in out, out
+    assert "warmup:" in out and "compile-free" in out
+    assert "trace cache (compiles/hits):" in out
+    # every program the traffic compiled was compiled during warmup
+    import re
+    warm = int(re.search(r"warmup: (\d+) programs", out).group(1))
+    compiles = sum(int(c) for c in
+                   re.findall(r"\w+ (\d+)c/\d+h", out))
+    assert compiles == warm, out

@@ -172,32 +172,25 @@ class Generator:
         return GenerationState(caches=new_caches, kv_lens=kv_lens,
                                last_logits=logits)
 
+    def _ffn_decode(self, h, layer):
+        """Decode-step FFN hook: ``h`` [B, D] -> [B, D].  MoEGenerator
+        overrides with the EP masked-expert path."""
+        return _dense_prompt_ffn(h, layer)
+
     def _step_impl(self, params, caches, kv_lens, token, active=None):
-        cfg = self.cfg
         inc = (jnp.ones_like(kv_lens) if active is None
                else active.astype(kv_lens.dtype))
-        new_caches = []
-        x = params["embed"][token]  # [B, D]
-        for li, layer in enumerate(params["layers"]):
-            k_c, v_c = caches[li]
-            h = _rms_norm(x[:, None], layer["attn_norm"], cfg.norm_eps)[:, 0]
-            q = (h @ layer["wq"]).reshape(-1, cfg.n_heads, cfg.head_dim)
-            k = (h @ layer["wk"]).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
-            v = (h @ layer["wv"]).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
-            q = _rope_at(q, kv_lens, cfg.rope_theta)
-            k = _rope_at(k, kv_lens, cfg.rope_theta)
-            k_c, v_c = self.attn.append_kv(k_c, v_c, k, v, kv_lens)
-            o = self.attn(q, k_c, v_c, kv_lens + inc)  # [B, Hq, hd]
-            x = x + (o.reshape(o.shape[0], -1).astype(cfg.dtype)
-                     @ layer["wo"])
-            h = _rms_norm(x[:, None], layer["mlp_norm"], cfg.norm_eps)[:, 0]
-            act = (jax.nn.silu((h @ layer["wgate"]).astype(jnp.float32))
-                   .astype(cfg.dtype) * (h @ layer["wup"]))
-            x = x + act @ layer["wdown"]
-            new_caches.append((k_c, v_c))
-        x = _rms_norm(x[:, None], params["final_norm"], cfg.norm_eps)[:, 0]
-        logits = jnp.dot(x, params["lm_head"],
-                         preferred_element_type=jnp.float32)
+
+        def write_kv(li, cache, k, v):
+            k_c, v_c = cache
+            return self.attn.append_kv(k_c, v_c, k, v, kv_lens)
+
+        def attend(li, q, cache):
+            return self.attn(q, cache[0], cache[1], kv_lens + inc)
+
+        new_caches, logits = _token_forward(
+            params, caches, token, kv_lens, cfg=self.cfg,
+            write_kv=write_kv, attend=attend, ffn=self._ffn_decode)
         return new_caches, kv_lens + inc, logits
 
     def generate(self, params, state: GenerationState, n_new: int,
@@ -253,6 +246,86 @@ class Generator:
                            eos_id, jnp.int32)
             tokens = jnp.concatenate([tokens, pad], axis=1)
         return tokens, state
+
+
+def _token_forward(params, caches, token, pos, *, cfg: LlamaConfig,
+                   write_kv, attend, ffn=None):
+    """ONE copy of the single-token decode layer math, parameterized by
+    the cache addressing (ROADMAP: the shared (write_kv, attend) pair):
+
+    - ``write_kv(li, cache, k, v) -> cache'`` appends the token's K/V
+      ([B, Hkv, hd] each) into layer ``li``'s cache;
+    - ``attend(li, q, cache) -> [B, Hq, hd]`` scores the query against
+      the updated cache.
+
+    ``Generator._step_impl`` (contiguous append + SP flash decode) and
+    ``serve.engine._paged_decode_forward`` (pool-page scatter + the
+    block-table kernel) are both this function with different pairs —
+    the serve-engine oracle tests lock their bit-exactness.  ``pos``
+    [B] int32 carries the RoPE positions (each row's cache length)."""
+    if ffn is None:
+        ffn = _dense_prompt_ffn
+    new_caches = []
+    x = params["embed"][token]  # [B, D]
+    for li, layer in enumerate(params["layers"]):
+        h = _rms_norm(x[:, None], layer["attn_norm"], cfg.norm_eps)[:, 0]
+        q = (h @ layer["wq"]).reshape(-1, cfg.n_heads, cfg.head_dim)
+        k = (h @ layer["wk"]).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ layer["wv"]).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
+        q = _rope_at(q, pos, cfg.rope_theta)
+        k = _rope_at(k, pos, cfg.rope_theta)
+        cache = write_kv(li, caches[li], k, v)
+        o = attend(li, q, cache)  # [B, Hq, hd]
+        x = x + (o.reshape(o.shape[0], -1).astype(cfg.dtype)
+                 @ layer["wo"])
+        h = _rms_norm(x[:, None], layer["mlp_norm"], cfg.norm_eps)[:, 0]
+        x = x + ffn(h, layer)
+        new_caches.append(cache)
+    x = _rms_norm(x[:, None], params["final_norm"], cfg.norm_eps)[:, 0]
+    logits = jnp.dot(x, params["lm_head"],
+                     preferred_element_type=jnp.float32)
+    return new_caches, logits
+
+
+def _multitoken_forward(params, caches, chunk, pos, *, cfg: LlamaConfig,
+                        write_kv, attend, ffn=None):
+    """ONE copy of the multi-token (speculative-verify) layer math,
+    parameterized like :func:`_token_forward`:
+
+    - ``write_kv(li, cache, k, v) -> cache'`` writes [B, T, Hkv, hd]
+      rows at each row's own offset;
+    - ``attend(li, q, cache) -> [B, T, Hq, hd]`` scores T queries per
+      row through the multi-token decode kernel (the q_lens contract).
+
+    ``_verify_forward`` (contiguous per-row writes) and
+    ``serve.engine._paged_verify_forward`` (block-table addressing)
+    share it.  ``pos`` [B, T] int32: global position of query t of row
+    b (``kv_lens[b] + t``)."""
+    if ffn is None:
+        ffn = _dense_prompt_ffn
+    B, T = chunk.shape
+    hd = cfg.head_dim
+    x = params["embed"][chunk]                        # [B, T, D]
+    new_caches = []
+    for li, layer in enumerate(params["layers"]):
+        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        h2 = h.reshape(B * T, cfg.dim)
+        q = (h2 @ layer["wq"]).reshape(B, T, cfg.n_heads, hd)
+        k = (h2 @ layer["wk"]).reshape(B, T, cfg.n_kv_heads, hd)
+        v = (h2 @ layer["wv"]).reshape(B, T, cfg.n_kv_heads, hd)
+        q = _rope_rows(q, pos, cfg.rope_theta)
+        k = _rope_rows(k, pos, cfg.rope_theta)
+        cache = write_kv(li, caches[li], k, v)
+        o = attend(li, q, cache)                      # [B, T, Hq, hd]
+        o = o.reshape(B * T, cfg.n_heads * hd).astype(cfg.dtype)
+        x = x + (o @ layer["wo"]).reshape(B, T, cfg.dim)
+        h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps).reshape(
+            B * T, cfg.dim)
+        x = x + ffn(h2, layer).reshape(B, T, cfg.dim)
+        new_caches.append(cache)
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return new_caches, jnp.dot(x, params["lm_head"],
+                               preferred_element_type=jnp.float32)
 
 
 def _attend_prefix(q, k_all, v_all, prefix_len, *, k_scale=None,
@@ -409,7 +482,7 @@ def _write_chunk(cache, new, prefix_len, quantized):
 
 def _chunk_forward(params, chunk, caches, prefix_len, *, cfg: LlamaConfig,
                    quantized: bool, ffn=None, extent: int | None = None,
-                   impl: str = "auto", interpret: bool = False,
+                   n_valid=None, impl: str = "auto", interpret: bool = False,
                    mesh=None, axis=None):
     """One prompt chunk [B, c] against the cached prefix; returns
     (new_caches, logits [B, c, V] — position i predicts the token after
@@ -419,13 +492,27 @@ def _chunk_forward(params, chunk, caches, prefix_len, *, cfg: LlamaConfig,
     K/V, matching the decode path's behavior.  Speculative verification
     (models/speculative.py) consumes the full per-position logits.
     ``extent`` (static) bounds the cache rows attention reads — scores
-    stay [c, extent] instead of [c, max_seq]."""
+    stay [c, extent] instead of [c, max_seq].
+
+    ``n_valid`` (traced scalar, optional) marks chunk rows >= n_valid as
+    PADDING: their K/V write to the cache as exact zeros, so a final
+    prompt chunk padded up to a fixed shape leaves the cache bit-identical
+    to an unpadded run (pad rows match the zero-init rows it never wrote).
+    Padded QUERY rows need no mask — causality already hides rows >=
+    n_valid from every valid query (row i attends to positions <=
+    prefix + i < prefix + n_valid), and their own logits are garbage the
+    caller discards.  One trace serves every residual chunk length — the
+    serving engine's admission path never retraces on prompt shape
+    (docs/serving.md: the bucket ladder)."""
     if ffn is None:
         ffn = _dense_prompt_ffn
     B, c = chunk.shape
     hd = cfg.head_dim
     x = params["embed"][chunk]                       # [B, c, D]
     positions = prefix_len + jnp.arange(c, dtype=jnp.int32)
+    pad_mask = (None if n_valid is None else
+                (jnp.arange(c, dtype=jnp.int32) < n_valid)[None, :, None,
+                                                           None])
     new_caches = []
     for li, layer in enumerate(params["layers"]):
         k_c, v_c = caches[li]
@@ -438,6 +525,9 @@ def _chunk_forward(params, chunk, caches, prefix_len, *, cfg: LlamaConfig,
                   cfg.rope_theta).transpose(1, 0, 2, 3)
         k = _rope(k.transpose(1, 0, 2, 3), positions,
                   cfg.rope_theta).transpose(1, 0, 2, 3)
+        if pad_mask is not None:
+            k = jnp.where(pad_mask, k, jnp.zeros((), k.dtype))
+            v = jnp.where(pad_mask, v, jnp.zeros((), v.dtype))
         k_c = _write_chunk(k_c, k.transpose(0, 2, 1, 3), prefix_len,
                            quantized)
         v_c = _write_chunk(v_c, v.transpose(0, 2, 1, 3), prefix_len,
@@ -519,37 +609,23 @@ def _verify_forward(params, chunk, caches, kv_lens, *, cfg: LlamaConfig,
     """
     from triton_dist_tpu.kernels.flash_decode import gqa_decode_shard
 
-    if ffn is None:
-        ffn = _dense_prompt_ffn
-    B, T = chunk.shape
-    hd = cfg.head_dim
-    x = params["embed"][chunk]                        # [B, T, D]
+    T = chunk.shape[1]
     pos = kv_lens[:, None] + jnp.arange(T, dtype=jnp.int32)[None]
-    new_caches = []
-    for li, layer in enumerate(params["layers"]):
-        k_c, v_c = caches[li]
-        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        h2 = h.reshape(B * T, cfg.dim)
-        q = (h2 @ layer["wq"]).reshape(B, T, cfg.n_heads, hd)
-        k = (h2 @ layer["wk"]).reshape(B, T, cfg.n_kv_heads, hd)
-        v = (h2 @ layer["wv"]).reshape(B, T, cfg.n_kv_heads, hd)
-        q = _rope_rows(q, pos, cfg.rope_theta)
-        k = _rope_rows(k, pos, cfg.rope_theta)
-        k_c = _write_rows(k_c, k.transpose(0, 2, 1, 3), kv_lens)
-        v_c = _write_rows(v_c, v.transpose(0, 2, 1, 3), kv_lens)
-        new_caches.append((k_c, v_c))
-        o, _ = gqa_decode_shard(q, k_c, v_c, kv_lens + T, impl=impl,
-                                interpret=interpret,
+
+    def write_kv(li, cache, k, v):
+        k_c, v_c = cache
+        return (_write_rows(k_c, k.transpose(0, 2, 1, 3), kv_lens),
+                _write_rows(v_c, v.transpose(0, 2, 1, 3), kv_lens))
+
+    def attend(li, q, cache):
+        o, _ = gqa_decode_shard(q, cache[0], cache[1], kv_lens + T,
+                                impl=impl, interpret=interpret,
                                 soft_cap=cfg.attn_soft_cap,
                                 window=cfg.attn_window)
-        o = o.reshape(B * T, cfg.n_heads * hd).astype(cfg.dtype)
-        x = x + (o @ layer["wo"]).reshape(B, T, cfg.dim)
-        h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps).reshape(
-            B * T, cfg.dim)
-        x = x + ffn(h2, layer).reshape(B, T, cfg.dim)
-    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
-    return new_caches, jnp.dot(x, params["lm_head"],
-                               preferred_element_type=jnp.float32)
+        return o
+
+    return _multitoken_forward(params, caches, chunk, pos, cfg=cfg,
+                               write_kv=write_kv, attend=attend, ffn=ffn)
 
 
 def _dense_prompt_ffn(h2, layer):

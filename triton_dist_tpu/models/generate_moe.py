@@ -28,8 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from triton_dist_tpu.kernels.moe_utils import topk_routing
-from triton_dist_tpu.models.generate import Generator, _rope_at
-from triton_dist_tpu.models.llama import _rms_norm
+from triton_dist_tpu.models.generate import Generator
 from triton_dist_tpu.models.moe import MoEConfig
 from triton_dist_tpu.runtime.jit_cache import cached_shard_jit
 
@@ -156,8 +155,10 @@ class MoEGenerator(Generator):
                               impl=impl, interpret=interpret),
             donate_argnums=(2,))
 
-    def _ffn(self, x, layer):
-        """Decode-step FFN: EP masked-expert compute + psum."""
+    def _ffn_decode(self, h, layer):
+        """Decode-step FFN hook (generate._token_forward): EP
+        masked-expert compute + psum.  The attention/cache body of
+        ``_step_impl`` is inherited — one copy of the math."""
         cfg: MoEConfig = self.cfg
         fn = cached_shard_jit(
             moe_ffn_decode_shard,
@@ -167,31 +168,5 @@ class MoEGenerator(Generator):
             P(),
             axis=self.axis, n_experts=cfg.n_experts, topk=cfg.topk,
         )
-        return fn(x, layer["router"], layer["w_gate"], layer["w_up"],
+        return fn(h, layer["router"], layer["w_gate"], layer["w_up"],
                   layer["w_down"])
-
-    def _step_impl(self, params, caches, kv_lens, token, active=None):
-        cfg = self.cfg
-        inc = (jnp.ones_like(kv_lens) if active is None
-               else active.astype(kv_lens.dtype))
-        new_caches = []
-        x = params["embed"][token]  # [B, D]
-        for li, layer in enumerate(params["layers"]):
-            k_c, v_c = caches[li]
-            h = _rms_norm(x[:, None], layer["attn_norm"], cfg.norm_eps)[:, 0]
-            q = (h @ layer["wq"]).reshape(-1, cfg.n_heads, cfg.head_dim)
-            k = (h @ layer["wk"]).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
-            v = (h @ layer["wv"]).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
-            q = _rope_at(q, kv_lens, cfg.rope_theta)
-            k = _rope_at(k, kv_lens, cfg.rope_theta)
-            k_c, v_c = self.attn.append_kv(k_c, v_c, k, v, kv_lens)
-            o = self.attn(q, k_c, v_c, kv_lens + inc)  # [B, Hq, hd]
-            x = x + (o.reshape(o.shape[0], -1).astype(cfg.dtype)
-                     @ layer["wo"])
-            h = _rms_norm(x[:, None], layer["mlp_norm"], cfg.norm_eps)[:, 0]
-            x = x + self._ffn(h, layer)
-            new_caches.append((k_c, v_c))
-        x = _rms_norm(x[:, None], params["final_norm"], cfg.norm_eps)[:, 0]
-        logits = jnp.dot(x, params["lm_head"],
-                         preferred_element_type=jnp.float32)
-        return new_caches, kv_lens + inc, logits

@@ -5,14 +5,98 @@ closures; a fresh closure per call would defeat jit's trace cache and
 recompile every step.  ``cached_shard_jit`` memoizes the jitted callable on
 the (builder, mesh, specs, opts) key so repeated calls hit the compiled
 executable.
+
+Observability: :func:`cache_stats` exposes the memo cache's hit/miss/size
+counters, and :class:`CountingJit` wraps any jitted callable with
+per-call-site trace-cache accounting (hits, misses, cumulative time spent
+inside miss calls — i.e. compile stalls).  The serving engine threads both
+through ``serve.metrics.ServeMetrics`` onto the ``TDT_DUMP_IR`` dump path,
+so "how many programs did this traffic compile" is a counter, not a guess.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Callable
+import time
+from typing import Callable, Optional
 
 import jax
+
+
+def cache_stats() -> dict:
+    """Hit/miss/size counters of the process-wide shard-jit memo cache
+    (``functools.lru_cache`` on :func:`_build`).  A *miss* here means a
+    fresh ``jax.jit(shard_map(...))`` closure was built — i.e. a new
+    program family entered the process."""
+    info = _build.cache_info()
+    return {"hits": info.hits, "misses": info.misses,
+            "currsize": info.currsize, "maxsize": info.maxsize}
+
+
+class CountingJit:
+    """Wrap a jitted callable with trace-cache hit/miss accounting.
+
+    A *miss* is a call that grew the wrapped jit's executable cache
+    (``_cache_size()`` — a new (shapes, dtypes, statics) signature was
+    traced AND compiled); everything else is a hit.  The wall time of
+    miss calls accumulates in ``compile_time`` — on the serving admission
+    path that IS the compile stall a request would have eaten.  When the
+    runtime lacks ``_cache_size`` the wrapper falls back to hashing the
+    call signature host-side (shapes/dtypes of array leaves, ``repr`` of
+    everything else), which over-counts only if an outer cache already
+    held the executable.
+
+    Transparent otherwise: ``__call__`` forwards args/kwargs verbatim, so
+    donation and traced-kwarg behavior of the wrapped jit are unchanged.
+    """
+
+    def __init__(self, fn: Callable, name: str):
+        self.fn = fn
+        self.name = name
+        self.hits = 0
+        self.misses = 0
+        self.compile_time = 0.0
+        self._keys: set = set()
+        self._sized = hasattr(fn, "_cache_size")
+
+    @staticmethod
+    def _sig(args, kwargs) -> tuple:
+        def leaf(x):
+            if hasattr(x, "shape") and hasattr(x, "dtype"):
+                return ("arr", tuple(x.shape), str(x.dtype))
+            return ("obj", repr(x))
+
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        return (str(treedef), tuple(leaf(x) for x in leaves))
+
+    def __call__(self, *args, **kwargs):
+        before = self.fn._cache_size() if self._sized else None
+        t0 = time.perf_counter()
+        out = self.fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        if self._sized:
+            fresh = self.fn._cache_size() > before
+        else:
+            key = self._sig(args, kwargs)
+            fresh = key not in self._keys
+            self._keys.add(key)
+        if fresh:
+            self.misses += 1
+            self.compile_time += dt
+        else:
+            self.hits += 1
+        return out
+
+    @property
+    def cache_size(self) -> Optional[int]:
+        """Distinct compiled programs behind this wrapper (None when the
+        runtime can't report it)."""
+        return self.fn._cache_size() if self._sized else None
+
+    def stats(self) -> dict:
+        return {"hits": self.hits, "misses": self.misses,
+                "compile_time_s": self.compile_time,
+                "cache_size": self.cache_size}
 
 
 @functools.lru_cache(maxsize=256)

@@ -26,6 +26,13 @@ running request cannot extend its allocation, the scheduler preempts the
 latest-admitted request (recompute-style: emitted tokens are kept and the
 victim re-prefills ``prompt + generated``).
 
+Compilation is BOUNDED and observable (PR 2): prefill always runs the
+one fixed ``prefill_chunk`` shape (final residual padded, its K/V writes
+zero-masked via ``n_valid``), scratch extents and the page scatter
+bucket to a powers-of-two ladder, :meth:`ServeEngine.warmup`
+pre-compiles the lot, and every program's trace-cache hit/miss/stall
+counters ride ``ServeMetrics`` (docs/serving.md "bucket ladder").
+
 v1 scope: world-1 mesh, float KV pools, dense-Llama-family ``Generator``
 (the same envelope as the r5 batched speculative verify; batch-1 SP +
 int8 serving keeps the contiguous `Generator.generate` path).
@@ -45,18 +52,19 @@ from triton_dist_tpu.kernels.flash_decode import gqa_decode_paged_shard
 from triton_dist_tpu.models.generate import (
     GenerationState,
     Generator,
-    _rms_norm,
-    _rope_at,
-    _rope_rows,
+    _multitoken_forward,
+    _token_forward,
 )
 from triton_dist_tpu.models.sampling import sample_logits
 from triton_dist_tpu.models.speculative import greedy_accept_chain_batched
+from triton_dist_tpu.runtime.jit_cache import CountingJit
 from triton_dist_tpu.serve.block_manager import BlockExhausted, BlockManager
 from triton_dist_tpu.serve.metrics import RequestMetrics, ServeMetrics
 from triton_dist_tpu.serve.request import (
     FinishReason,
     Request,
     RequestOutput,
+    SamplingParams,
 )
 from triton_dist_tpu.serve.scheduler import FCFSScheduler, ReqState, Status
 
@@ -79,57 +87,51 @@ def _page_slots(tables, kv_lens, active, *, page):
             jnp.where(active, in_page, 0))
 
 
+def _scatter_kv(pool, k, v, pool_row, in_page):
+    """The ONE paged K/V write: scatter new rows into pool pages at
+    (pool_row, in_page) — [B] indices for a decode token, [B, T] for a
+    verify chunk.  Both paged forwards use it, so the write can never
+    diverge between decode and verify."""
+    k_pool, v_pool = pool
+    return (k_pool.at[pool_row, :, in_page, :].set(k.astype(k_pool.dtype)),
+            v_pool.at[pool_row, :, in_page, :].set(v.astype(v_pool.dtype)))
+
+
 def _paged_decode_forward(params, pools, tables, kv_lens, token, active, *,
                           cfg, page, impl, interpret):
     """One decode token for every batch row over the paged pools.
 
-    Mirrors ``Generator._step_impl`` exactly (same math per row — the
-    greedy stream must be bit-identical to the contiguous oracle), with
+    ``generate._token_forward`` (the same math as ``_step_impl`` — the
+    greedy stream must be bit-identical to the contiguous oracle) with
     the contiguous append swapped for a pool-page scatter and attention
     through the paged block-table kernel.
     """
     inc = active.astype(kv_lens.dtype)
     pool_row, in_page = _page_slots(tables, kv_lens, active, page=page)
-    new_pools = []
-    x = params["embed"][token]  # [B, D]
-    for li, layer in enumerate(params["layers"]):
-        k_pool, v_pool = pools[li]
-        h = _rms_norm(x[:, None], layer["attn_norm"], cfg.norm_eps)[:, 0]
-        q = (h @ layer["wq"]).reshape(-1, cfg.n_heads, cfg.head_dim)
-        k = (h @ layer["wk"]).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
-        v = (h @ layer["wv"]).reshape(-1, cfg.n_kv_heads, cfg.head_dim)
-        q = _rope_at(q, kv_lens, cfg.rope_theta)
-        k = _rope_at(k, kv_lens, cfg.rope_theta)
-        k_pool = k_pool.at[pool_row, :, in_page, :].set(
-            k.astype(k_pool.dtype))
-        v_pool = v_pool.at[pool_row, :, in_page, :].set(
-            v.astype(v_pool.dtype))
+
+    def write_kv(li, pool, k, v):
+        return _scatter_kv(pool, k, v, pool_row, in_page)
+
+    def attend(li, q, pool):
         o, _ = gqa_decode_paged_shard(
-            q, k_pool, v_pool, tables, kv_lens + inc, impl=impl,
+            q, pool[0], pool[1], tables, kv_lens + inc, impl=impl,
             interpret=interpret, soft_cap=cfg.attn_soft_cap,
             window=cfg.attn_window)
-        x = x + (o.reshape(o.shape[0], -1).astype(cfg.dtype)
-                 @ layer["wo"])
-        h = _rms_norm(x[:, None], layer["mlp_norm"], cfg.norm_eps)[:, 0]
-        act = (jax.nn.silu((h @ layer["wgate"]).astype(jnp.float32))
-               .astype(cfg.dtype) * (h @ layer["wup"]))
-        x = x + act @ layer["wdown"]
-        new_pools.append((k_pool, v_pool))
-    x = _rms_norm(x[:, None], params["final_norm"], cfg.norm_eps)[:, 0]
-    logits = jnp.dot(x, params["lm_head"],
-                     preferred_element_type=jnp.float32)
-    return new_pools, logits
+        return o
+
+    return _token_forward(params, pools, token, kv_lens, cfg=cfg,
+                          write_kv=write_kv, attend=attend)
 
 
 def _paged_verify_forward(params, pools, tables, kv_lens, chunk, active, *,
                           cfg, page, impl, interpret):
     """Score ``chunk`` [B, T] draft tokens per row at PER-ROW lengths over
-    the paged pools — ``models/generate._verify_forward`` re-addressed
-    through block tables (K/V rows scatter into each request's pages, the
-    multi-token decode kernel reads them back through the table).
-    Returns (new_pools, logits [B, T, V])."""
-    B, T = chunk.shape
-    hd = cfg.head_dim
+    the paged pools — ``generate._multitoken_forward`` (the same math as
+    ``_verify_forward``) re-addressed through block tables (K/V rows
+    scatter into each request's pages, the multi-token decode kernel
+    reads them back through the table).  Returns (new_pools,
+    logits [B, T, V])."""
+    T = chunk.shape[1]
     n_pages = tables.shape[1]
     pos = kv_lens[:, None] + jnp.arange(T, dtype=jnp.int32)[None]  # [B, T]
     logical = jnp.minimum(pos // page, n_pages - 1)
@@ -137,42 +139,30 @@ def _paged_verify_forward(params, pools, tables, kv_lens, chunk, active, *,
     in_page = pos % page
     pool_row = jnp.where(active[:, None], pool_row, 0)
     in_page = jnp.where(active[:, None], in_page, 0)
-    x = params["embed"][chunk]                                    # [B, T, D]
-    new_pools = []
-    for li, layer in enumerate(params["layers"]):
-        k_pool, v_pool = pools[li]
-        h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        h2 = h.reshape(B * T, cfg.dim)
-        q = (h2 @ layer["wq"]).reshape(B, T, cfg.n_heads, hd)
-        k = (h2 @ layer["wk"]).reshape(B, T, cfg.n_kv_heads, hd)
-        v = (h2 @ layer["wv"]).reshape(B, T, cfg.n_kv_heads, hd)
-        q = _rope_rows(q, pos, cfg.rope_theta)
-        k = _rope_rows(k, pos, cfg.rope_theta)
-        k_pool = k_pool.at[pool_row, :, in_page, :].set(
-            k.astype(k_pool.dtype))
-        v_pool = v_pool.at[pool_row, :, in_page, :].set(
-            v.astype(v_pool.dtype))
+
+    def write_kv(li, pool, k, v):
+        return _scatter_kv(pool, k, v, pool_row, in_page)
+
+    def attend(li, q, pool):
         o, _ = gqa_decode_paged_shard(
-            q, k_pool, v_pool, tables, kv_lens + T, impl=impl,
+            q, pool[0], pool[1], tables, kv_lens + T, impl=impl,
             interpret=interpret, soft_cap=cfg.attn_soft_cap,
             window=cfg.attn_window)
-        o = o.reshape(B * T, cfg.n_heads * hd).astype(cfg.dtype)
-        x = x + (o @ layer["wo"]).reshape(B, T, cfg.dim)
-        h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps).reshape(
-            B * T, cfg.dim)
-        act = (jax.nn.silu((h2 @ layer["wgate"]).astype(jnp.float32))
-               .astype(cfg.dtype) * (h2 @ layer["wup"]))
-        x = x + (act @ layer["wdown"]).reshape(B, T, cfg.dim)
-        new_pools.append((k_pool, v_pool))
-    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.dot(x, params["lm_head"],
-                     preferred_element_type=jnp.float32)
-    return new_pools, logits
+        return o
+
+    return _multitoken_forward(params, pools, chunk, pos, cfg=cfg,
+                               write_kv=write_kv, attend=attend)
 
 
 def _fill_pool_pages(pools, scratch, block_ids, *, page):
     """Scatter a completed prefill's K/V (contiguous scratch caches
-    [1, Hkv, n*page, D] per layer) into the request's pool pages."""
+    [1, Hkv, n*page, D] per layer) into the request's pool pages.
+
+    ``block_ids`` covers EVERY scratch page (n = s_ext // page): entries
+    past the prompt's allocation hold the null block, so a bucketed
+    scratch scatters its zero-masked padding pages into block 0 (written
+    by every inactive row anyway) instead of forcing one trace per
+    prompt-page count — the trace is keyed by the s_ext bucket alone."""
     n = block_ids.shape[0]
     new_pools = []
     for (k_pool, v_pool), (kc, vc) in zip(pools, scratch):
@@ -184,6 +174,24 @@ def _fill_pool_pages(pools, scratch, block_ids, *, page):
         v_pool = v_pool.at[block_ids].set(as_pages(vc).astype(v_pool.dtype))
         new_pools.append((k_pool, v_pool))
     return new_pools
+
+
+def build_bucket_ladder(base: int, cap: int, page: int) -> list[int]:
+    """The powers-of-two scratch-extent ladder: rungs double from
+    ``base`` (rounded up to a page multiple) until ``cap`` (the largest
+    extent any admissible prompt needs), which always closes the ladder.
+    Every rung is a multiple of ``page`` so a bucketed scratch reshapes
+    cleanly into pool pages."""
+    if base < 1 or cap < 1:
+        raise ValueError(f"ladder needs base, cap >= 1; got {base}, {cap}")
+    cap = -(-cap // page) * page
+    rungs = []
+    r = -(-base // page) * page
+    while r < cap:
+        rungs.append(r)
+        r *= 2
+    rungs.append(cap)
+    return rungs
 
 
 # ---------------------------------------------------------------------------
@@ -198,6 +206,7 @@ class ServeEngine:
 
         engine = ServeEngine(gen, params, num_blocks=64, page_size=16,
                              max_batch=8)
+        engine.warmup()                 # pre-compile the bucket ladder
         engine.submit(Request("r0", prompt_tokens,
                               SamplingParams(max_new_tokens=32)))
         outputs = engine.run()          # step() until drained
@@ -205,12 +214,22 @@ class ServeEngine:
     ``draft``/``draft_params`` + ``spec_k`` turn every decode step into a
     speculative round (greedy requests only): up to ``spec_k + 1`` tokens
     per row per verify pass, same emitted stream as plain greedy.
+
+    **Shape bucketing** (docs/serving.md): prefill always runs the ONE
+    fixed ``prefill_chunk`` shape (the final residual pads, its K/V
+    writes zero-masked by ``n_valid``), and each prompt's scratch extent
+    rounds up a powers-of-two ``bucket_ladder`` — so O(len(ladder))
+    compiled programs cover EVERY prompt length, and :meth:`warmup`
+    pre-compiles them all so steady-state serving never compiles.
+    Trace-cache hit/miss/compile-stall counters live in
+    ``metrics.summary()["compilation"]``.
     """
 
     def __init__(self, gen: Generator, params, *, num_blocks: int,
                  page_size: int, max_batch: int = 8,
                  prefill_chunk: int = 64,
                  prefill_budget: Optional[int] = None,
+                 bucket_ladder: Optional[list] = None,
                  draft: Optional[Generator] = None, draft_params=None,
                  spec_k: int = 0, clock=time.monotonic):
         assert gen.attn.world == 1, (
@@ -247,6 +266,29 @@ class ServeEngine:
         self.spec_k = int(spec_k)
         self._clock = clock
 
+        # The scratch-extent bucket ladder: every prefill's s_ext (and
+        # with it the _chunk_jit extent and the _fill_fn table width)
+        # rounds up to a rung, so O(len(ladder)) traces cover every
+        # prompt length instead of one per distinct shape.  The cap is
+        # the largest extent an admissible prompt can need (submit()
+        # holds prompt <= max_seq - 1).
+        cap = self._scratch_need(gen.max_seq - 1)
+        if bucket_ladder is None:
+            self.ladder = build_bucket_ladder(
+                max(page_size, prefill_chunk), cap, page_size)
+        else:
+            rungs = sorted({int(r) for r in bucket_ladder})
+            bad = [r for r in rungs
+                   if r % page_size or r < prefill_chunk]
+            if bad:
+                raise ValueError(
+                    f"bucket_ladder rungs must be multiples of page_size "
+                    f"{page_size} and hold one prefill_chunk "
+                    f"{prefill_chunk}; got {bad}")
+            if rungs[-1] < cap:
+                rungs.append(-(-cap // page_size) * page_size)
+            self.ladder = rungs
+
         impl = gen.attn.ctx.impl
         interpret = gen.attn.ctx.interpret
         self._pools = [
@@ -255,22 +297,46 @@ class ServeEngine:
              jnp.zeros((num_blocks, cfg.n_kv_heads, page_size,
                         cfg.head_dim), cfg.dtype))
             for _ in range(cfg.n_layers)]
-        self._decode_fn = jax.jit(functools.partial(
+        # Every jitted program is wrapped for trace-cache accounting
+        # (runtime/jit_cache.CountingJit): hit/miss/compile-stall
+        # counters ride ServeMetrics onto the TDT_DUMP_IR dump path.
+        self._decode_fn = CountingJit(jax.jit(functools.partial(
             _paged_decode_forward, cfg=cfg, page=page_size, impl=impl,
-            interpret=interpret), donate_argnums=(1,))
-        self._verify_fn = jax.jit(functools.partial(
+            interpret=interpret), donate_argnums=(1,)), "paged_decode")
+        self._verify_fn = CountingJit(jax.jit(functools.partial(
             _paged_verify_forward, cfg=cfg, page=page_size, impl=impl,
-            interpret=interpret), donate_argnums=(1,))
+            interpret=interpret), donate_argnums=(1,)), "paged_verify")
         # scratch is not donatable (the page reshape transposes it);
         # pools are — the scatter updates them in place.
-        self._fill_fn = jax.jit(functools.partial(
-            _fill_pool_pages, page=page_size), donate_argnums=(0,))
+        self._fill_fn = CountingJit(jax.jit(functools.partial(
+            _fill_pool_pages, page=page_size), donate_argnums=(0,)),
+            "fill_pages")
+        # The Generator's chunked-prefill program; the trace cache lives
+        # on the Generator (shared with prefill_chunked/speculative), the
+        # counters here see this engine's calls.
+        self._chunk_fn = CountingJit(gen._chunk_jit, "prefill_chunk")
+        for c in (self._chunk_fn, self._fill_fn, self._decode_fn,
+                  self._verify_fn):
+            self.metrics.register_compiled(c)
 
         self.slots: list[Optional[ReqState]] = [None] * max_batch
         self._states: dict[str, ReqState] = {}
         self._outputs: dict[str, RequestOutput] = {}
         # speculative-mode device state ([B]-shaped, slot-indexed)
         if self.spec_k:
+            # Count the draft's programs too: its per-prompt-length
+            # prefill is the one remaining admission-path compile after
+            # warmup (ROADMAP follow-up) — it must at least be VISIBLE
+            # in the compile metrics.  Wrap-once: a draft shared across
+            # engines keeps one counter (re-registered here).
+            if not isinstance(draft._prefill_jit, CountingJit):
+                draft._prefill_jit = CountingJit(draft._prefill_jit,
+                                                 "draft_prefill")
+            if not isinstance(draft._step_jit, CountingJit):
+                draft._step_jit = CountingJit(draft._step_jit,
+                                              "draft_step")
+            self.metrics.register_compiled(draft._prefill_jit)
+            self.metrics.register_compiled(draft._step_jit)
             self._last_logits = jnp.zeros((max_batch, cfg.vocab),
                                           jnp.float32)
             dcfg = draft.cfg
@@ -371,12 +437,132 @@ class ServeEngine:
                                    "steps")
         return dict(self._outputs)
 
+    # -- warmup -----------------------------------------------------------
+
+    def warmup(self) -> dict:
+        """Pre-compile every program steady-state serving can hit, so no
+        request ever eats an XLA compile stall on the admission path.
+
+        Warmup drives REAL dummy traffic — one max-length request per
+        bucket-ladder rung — through the production step loop, so every
+        program compiles against exactly the buffers steady state will
+        hand it (the executable cache keys on more than shapes: layouts
+        and donation lineage matter, so hand-built dummy calls can leave
+        the first production step compiling anyway).  The sweep repeats
+        until a full round compiles nothing new (a compile fixed point,
+        reached on the second round at the latest in practice), then all
+        dummy bookkeeping is scrubbed: outputs, request states, and the
+        step/latency metrics the dummies generated (the compile counters
+        keep accumulating — they are the point).  KV pool pages touched
+        by dummies are freed and fully overwritten by the next scatter
+        before any read, so no request-visible state leaks.
+
+        Call BEFORE submitting traffic (asserted).  A rung is skipped
+        only when no admissible request can reach it (shorter prompts
+        and max_new=1 are tried before giving up) — then production
+        cannot hit it either.  Spec mode: the draft model's own
+        per-prompt-length prefill still compiles per new length
+        (ROADMAP follow-up), visible as the ``draft_prefill`` counter;
+        the four paged engine programs are covered.
+
+        Returns ``{"programs": <fresh compiles>, "seconds": <wall>}``;
+        the same numbers accumulate in ``metrics.warmup_compiles`` /
+        ``metrics.warmup_time`` and ride the ``TDT_DUMP_IR`` dump.
+        """
+        assert not self.has_work(), "warmup() must run before traffic"
+        t0 = time.perf_counter()
+        misses0 = self.metrics.compile_misses
+        chunk = self.scheduler.prefill_chunk
+        # dummy traffic must not pollute serving metrics; the CountingJit
+        # wrappers are shared so compile accounting continues
+        saved, self.metrics = self.metrics, ServeMetrics()
+        self.metrics.compiled_fns = saved.compiled_fns
+        try:
+            prev, round_ = -1, 0
+            while self.metrics.compile_misses != prev and round_ < 4:
+                prev = self.metrics.compile_misses
+                for i, rung in enumerate(self.ladder):
+                    # Longest prompt whose _scratch_need fits this rung:
+                    # n <= rung keeps the pool pages in, and n <=
+                    # (rung // chunk) * chunk keeps the padded final
+                    # chunk in.  If even that n buckets LOWER, no
+                    # admissible prompt can reach this rung — skip it
+                    # (production can't hit it either).
+                    n_max = min(rung, (rung // chunk) * chunk,
+                                self.gen.max_seq - 1)
+                    if n_max < 1 or self._bucket_s_ext(n_max) != rung:
+                        continue
+                    # Fall back to smaller totals before giving up on
+                    # the rung: the pool may reject n_max + 2 while a
+                    # production request (shorter prompt or max_new=1)
+                    # bucketing to the same rung is still admittable.
+                    # n_min is the shortest prompt reaching this rung
+                    # (one past what the rung below can hold); blocks_for
+                    # is monotone, so if n_min + 1 doesn't fit, nothing
+                    # reaching this rung does.
+                    if i == 0:
+                        n_min = 1
+                    else:
+                        below = self.ladder[i - 1]
+                        n_min = 1 + max(0, min(below,
+                                               (below // chunk) * chunk))
+                    # Candidate order: longest first (covers the rung's
+                    # full extent), max_new=2 before 1 (a 2-token dummy
+                    # runs a decode step; a 1-token dummy retires on its
+                    # prefill logits and would leave _decode_fn cold).
+                    for j, (n, new) in enumerate(
+                            ((n_max, min(2, self.gen.max_seq - n_max)),
+                             (n_max, 1),
+                             (n_min, min(2, self.gen.max_seq - n_min)),
+                             (n_min, 1))):
+                        req = Request(f"__warmup_{round_}_{i}_{j}",
+                                      np.zeros((n,), np.int32),
+                                      SamplingParams(max_new_tokens=new))
+                        try:
+                            self.submit(req)
+                            break
+                        except ValueError:
+                            continue
+                self.run()
+                for rid in [r for r in self._outputs
+                            if r.startswith("__warmup_")]:
+                    del self._outputs[rid]
+                    del self._states[rid]
+                round_ += 1
+        finally:
+            self.metrics = saved
+        dt = time.perf_counter() - t0
+        fresh = self.metrics.compile_misses - misses0
+        self.metrics.warmup_time += dt
+        self.metrics.warmup_compiles += fresh
+        return {"programs": fresh, "seconds": dt}
+
     # -- prefill ----------------------------------------------------------
+
+    def _scratch_need(self, n_prompt: int) -> int:
+        """Unbucketed scratch extent an ``n_prompt``-token prefill needs:
+        its pool pages, OR the padded final chunk's write rounded up to
+        prefill_chunk (dynamic_update_slice must never clamp), whichever
+        is larger.  THE sizing formula — the ladder cap, the bucket
+        lookup, and warmup's per-rung prompt picker all derive from it."""
+        chunk = self.scheduler.prefill_chunk
+        return max(self.bm.blocks_for(n_prompt) * self.page,
+                   -(-n_prompt // chunk) * chunk)
+
+    def _bucket_s_ext(self, n_prompt: int) -> int:
+        """Scratch extent for an ``n_prompt``-token prefill, bucketed up
+        the ladder."""
+        need = self._scratch_need(n_prompt)
+        for r in self.ladder:
+            if r >= need:
+                return r
+        raise AssertionError(
+            f"bucket ladder {self.ladder} cannot cover scratch extent "
+            f"{need} (prompt {n_prompt})")
 
     def _start_prefill(self, rs: ReqState) -> None:
         cfg = self.cfg
-        n_prompt = int(rs.prompt_tokens.shape[0])
-        s_ext = self.bm.blocks_for(n_prompt) * self.page
+        s_ext = self._bucket_s_ext(int(rs.prompt_tokens.shape[0]))
         rs.s_ext = s_ext
         rs.scratch = [
             (jnp.zeros((1, cfg.n_kv_heads, s_ext, cfg.head_dim),
@@ -390,31 +576,45 @@ class ServeEngine:
         prompt = rs.prompt_tokens
         S0 = int(prompt.shape[0])
         end = min(rs.prefill_pos + n_tokens, S0)
+        chunk_sz = self.scheduler.prefill_chunk
         logits = None
+        n_last = 0
         while rs.prefill_pos < end:
-            c = min(self.scheduler.prefill_chunk, end - rs.prefill_pos)
-            chunk = jnp.asarray(
-                prompt[None, rs.prefill_pos:rs.prefill_pos + c])
-            rs.scratch, logits = self.gen._chunk_jit(
-                self.params, chunk, rs.scratch, jnp.int32(rs.prefill_pos),
-                quantized=False, extent=rs.s_ext)
+            c = min(chunk_sz, end - rs.prefill_pos)
+            # Every call is the ONE fixed chunk shape: the final residual
+            # pads with zeros and n_valid masks its K/V writes, so the
+            # trace is keyed by (chunk_sz, s_ext bucket) only — varied
+            # prompt lengths never compile on the admission path.
+            buf = np.zeros((1, chunk_sz), np.int32)
+            buf[0, :c] = prompt[rs.prefill_pos:rs.prefill_pos + c]
+            rs.scratch, logits = self._chunk_fn(
+                self.params, jnp.asarray(buf), rs.scratch,
+                jnp.int32(rs.prefill_pos), quantized=False,
+                extent=rs.s_ext, n_valid=jnp.int32(c))
             rs.prefill_pos += c
+            n_last = c
             self.metrics.prefill_tokens += c
         if rs.prefill_pos < S0:
             return None
-        return self._finish_prefill(rs, logits, now)
+        return self._finish_prefill(rs, logits, n_last, now)
 
-    def _finish_prefill(self, rs: ReqState, logits,
+    def _finish_prefill(self, rs: ReqState, logits, n_last: int,
                         now: float) -> Optional[RequestOutput]:
         rid = rs.req.request_id
         S0 = int(rs.prompt_tokens.shape[0])
         n_prompt_pages = self.bm.blocks_for(S0)
-        ids = jnp.asarray(self.bm.table(rid)[:n_prompt_pages], jnp.int32)
-        self._pools = self._fill_fn(self._pools, rs.scratch, ids)
+        # One table entry per SCRATCH page (trace keyed by the s_ext
+        # bucket, not the prompt's page count); pages past the prompt's
+        # allocation scatter their zero-masked padding into the null
+        # block.
+        ids = np.zeros((rs.s_ext // self.page,), np.int32)
+        ids[:n_prompt_pages] = self.bm.table(rid)[:n_prompt_pages]
+        self._pools = self._fill_fn(self._pools, rs.scratch,
+                                    jnp.asarray(ids))
         rs.scratch = None
         rs.kv_len = S0
         rs.status = Status.RUNNING
-        last = logits[:, -1]                               # [1, V]
+        last = logits[:, n_last - 1]                       # [1, V]
         if self.spec_k:
             self._last_logits = self._last_logits.at[rs.slot].set(last[0])
             self._join_draft(rs)

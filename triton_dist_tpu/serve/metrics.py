@@ -3,7 +3,12 @@
 Per request: TTFT (arrival → first emitted token), inter-token latencies,
 queue wait (arrival → first scheduled).  Per engine step: queue depth,
 running batch occupancy, KV-block utilization; counters for preemptions,
-prefill tokens, decode/verify passes.
+prefill tokens, decode/verify passes.  Compilation observability: the
+engine registers its ``jit_cache.CountingJit``-wrapped programs here, so
+trace-cache hits/misses, cumulative compile-stall time, warmup coverage,
+and the process-wide ``cached_shard_jit`` stats all land in
+:meth:`ServeMetrics.summary` under ``"compilation"`` (docs/serving.md
+"Reading the compile metrics").
 
 Export rides the existing observability path (``runtime/dump.py``): with
 ``TDT_DUMP_IR=<dir>`` set, :meth:`ServeMetrics.maybe_dump` writes
@@ -86,6 +91,11 @@ class ServeMetrics:
     prefill_tokens: int = 0
     preemptions: int = 0
     completed: int = 0
+    # compilation observability: CountingJit wrappers the engine
+    # registers (runtime/jit_cache.py) + warmup accounting
+    compiled_fns: list = field(default_factory=list, repr=False)
+    warmup_time: float = 0.0
+    warmup_compiles: int = 0
     # per-step gauge series (appended by the engine each iteration)
     queue_depth: list[int] = field(default_factory=list)
     running: list[int] = field(default_factory=list)
@@ -103,6 +113,37 @@ class ServeMetrics:
     def observe_finish(self, request_id: str, rm: RequestMetrics) -> None:
         self.completed += 1
         self.requests[request_id] = rm
+
+    # -- compilation observability ---------------------------------------
+
+    def register_compiled(self, counter) -> None:
+        """Track a ``jit_cache.CountingJit``-wrapped program; its
+        hit/miss/compile-time counters appear in :meth:`summary` under
+        ``compilation`` (and on the ``TDT_DUMP_IR`` dump path)."""
+        self.compiled_fns.append(counter)
+
+    @property
+    def compile_misses(self) -> int:
+        """Total trace-cache misses (compiles) across engine programs —
+        the bounded-compilation tests watch this stay flat after
+        ``engine.warmup()``."""
+        return sum(c.misses for c in self.compiled_fns)
+
+    def compile_stats(self) -> dict:
+        """Per-program trace-cache counters + the process-wide
+        ``cached_shard_jit`` memo stats (runtime/jit_cache.py)."""
+        from triton_dist_tpu.runtime import jit_cache
+
+        return {
+            "programs": {c.name: c.stats() for c in self.compiled_fns},
+            "total_misses": self.compile_misses,
+            "total_hits": sum(c.hits for c in self.compiled_fns),
+            "total_compile_time_s": sum(c.compile_time
+                                        for c in self.compiled_fns),
+            "warmup_time_s": self.warmup_time,
+            "warmup_compiles": self.warmup_compiles,
+            "cached_shard_jit": jit_cache.cache_stats(),
+        }
 
     def summary(self) -> dict:
         """Aggregate view (what the CLI prints and maybe_dump writes)."""
@@ -127,6 +168,7 @@ class ServeMetrics:
             "mean_ttft": sum(ttfts) / len(ttfts) if ttfts else None,
             "max_ttft": max(ttfts, default=None) if ttfts else None,
             "mean_itl": sum(itls) / len(itls) if itls else None,
+            "compilation": self.compile_stats(),
             "requests": {rid: m.to_dict()
                          for rid, m in self.requests.items()},
         }

@@ -132,9 +132,18 @@ class FCFSScheduler:
     def prefill_plan(self, prefilling: list[ReqState]) -> list[tuple]:
         """Assign this iteration's prompt-token budget to PREFILL-state
         requests (admission order).  Returns [(rs, n_tokens)]; the first
-        assignment always gets at least one chunk (progress guarantee)."""
+        assignment always gets at least one chunk (progress guarantee).
+
+        Assignments are quantized to WHOLE ``prefill_chunk`` multiples
+        (except a prompt's final residual, which the engine pads up to a
+        full chunk): every ``_chunk_jit`` call then has the one fixed
+        chunk shape, so prefill never retraces on prompt length — the
+        trace-cache contract of docs/serving.md's bucket ladder.  A
+        padded final chunk is charged as a full chunk of budget (it
+        costs a full chunk of compute)."""
         plan = []
         budget = self.prefill_budget
+        chunk = self.prefill_chunk
         for rs in sorted(prefilling, key=lambda r: r.seq):
             remaining = int(rs.prompt_tokens.shape[0]) - rs.prefill_pos
             if remaining <= 0:
@@ -143,13 +152,14 @@ class FCFSScheduler:
                 # Head of line: at least one chunk even when budget <
                 # chunk (otherwise a budget smaller than the chunk size
                 # would stall prefill forever).
-                n = min(remaining, max(budget, self.prefill_chunk))
-            elif budget <= 0:
+                n_chunks = max(1, budget // chunk)
+            elif budget < chunk:
                 break
             else:
-                n = min(remaining, budget)
-            plan.append((rs, n))
-            budget -= n
+                n_chunks = budget // chunk
+            n_chunks = min(n_chunks, -(-remaining // chunk))
+            plan.append((rs, min(remaining, n_chunks * chunk)))
+            budget -= n_chunks * chunk
         return plan
 
     # -- preemption -------------------------------------------------------
