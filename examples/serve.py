@@ -86,6 +86,21 @@ def parse_args():
                    help="engine mode: KV pool blocks (default: sized "
                         "to ~half the offered load, exercising "
                         "queueing)")
+    p.add_argument("--chaos", action="store_true",
+                   help="engine mode: drive a seeded FaultInjector "
+                        "(runtime/faults.py) through the traffic — "
+                        "random forward/callback/block-alloc faults "
+                        "plus a bounded queue — and print the failure-"
+                        "containment accounting (every request still "
+                        "retires: LENGTH, ERROR, SHED or DEADLINE)")
+    p.add_argument("--deadline", type=float, default=None,
+                   help="engine mode: per-request TTL in seconds "
+                        "(WAITING/PREFILL requests past it retire "
+                        "with finish reason 'deadline')")
+    p.add_argument("--max-queue", type=int, default=None,
+                   help="engine mode: waiting-queue bound; arrivals "
+                        "beyond it are shed at submit() (chaos mode "
+                        "defaults this to requests // 2)")
     return p.parse_args()
 
 
@@ -134,14 +149,28 @@ def run_engine(args, key):
     per_req = -(-max_seq // page)
     num_blocks = args.num_blocks or (1 + per_req * max(2, args.requests
                                                        // 2))
+    faults = None
+    max_queue = args.max_queue
+    if args.chaos:
+        from triton_dist_tpu.runtime.faults import FaultInjector
+        faults = (FaultInjector(seed=args.seed)
+                  .inject("forward", rate=0.04, error="chaos: forward")
+                  .inject("callback", rate=0.1, error="chaos: callback")
+                  .inject("block_alloc", rate=0.05,
+                          error="chaos: alloc"))
+        if max_queue is None:
+            max_queue = max(2, args.requests // 2)
     engine = ServeEngine(
         gen, params, num_blocks=num_blocks, page_size=page,
         max_batch=args.max_batch, prefill_chunk=max(8, page),
         draft=draft, draft_params=d_params,
-        spec_k=args.speculative or 0)
+        spec_k=args.speculative or 0,
+        faults=faults, max_queue=max_queue, fault_retries=1)
     dist_print(f"engine: {args.requests} requests, pool {num_blocks} "
                f"blocks x{page} tokens, batch {args.max_batch}"
-               f"{f', speculative k={args.speculative}' if args.speculative else ''}")
+               f"{f', speculative k={args.speculative}' if args.speculative else ''}"
+               f"{f', chaos seed {args.seed}' if args.chaos else ''}"
+               f"{f', max_queue {max_queue}' if max_queue is not None else ''}")
     if args.mixed:
         # One just-under-a-rung and one just-over-half-a-rung length per
         # ladder rung: every bucket gets traffic, no length repeats a
@@ -154,8 +183,9 @@ def run_engine(args, key):
                    f"prompt lengths {sorted(set(int(x) for x in lens))}")
     if args.warmup:
         w = engine.warmup()
-        caveat = (" (spec mode: the draft's per-length prefill still "
-                  "compiles at admission — see the draft_prefill counter)"
+        caveat = (" (spec mode: the draft's padded chunked prefill + "
+                  "join ride their own extent ladder — see the "
+                  "draft_prefill/draft_join counters)"
                   if args.speculative else "")
         dist_print(f"warmup: {w['programs']} programs compiled in "
                    f"{w['seconds'] * 1e3:.0f} ms — steady-state serving "
@@ -164,10 +194,13 @@ def run_engine(args, key):
     params_s = SamplingParams(max_new_tokens=args.new_tokens,
                               temperature=args.temperature,
                               top_k=args.top_k, top_p=args.top_p,
-                              seed=args.seed)
+                              seed=args.seed, deadline_s=args.deadline)
+    # chaos mode attaches a no-op streaming callback so the injector's
+    # callback faults have a seam to fire at
+    on_token = (lambda rid, tok: None) if args.chaos else None
     reqs = [Request(f"req-{i}",
                     rng.integers(0, cfg.vocab, size=int(lens[i]))
-                    .astype(np.int32), params_s)
+                    .astype(np.int32), params_s, on_token=on_token)
             for i in range(args.requests)]
 
     t0 = time.perf_counter()
@@ -175,7 +208,9 @@ def run_engine(args, key):
     finished = []
     while engine.has_work() or submitted < len(reqs):
         if step % max(args.stagger, 1) == 0 and submitted < len(reqs):
-            engine.submit(reqs[submitted])
+            shed = engine.submit(reqs[submitted])
+            if shed is not None:        # bounded admission said no
+                finished.append(shed)
             submitted += 1
         finished.extend(engine.step())
         step += 1
@@ -183,9 +218,11 @@ def run_engine(args, key):
 
     total_tokens = sum(len(o.token_ids) for o in finished)
     for o in sorted(finished, key=lambda o: o.request_id):
+        ttft = (f"ttft {o.metrics.ttft * 1e3:.1f} ms"
+                if o.metrics.ttft is not None else "no token emitted")
         dist_print(f"{o.request_id}: prompt {len(o.prompt)} -> "
                    f"{len(o.token_ids)} tokens ({o.finish_reason.value}), "
-                   f"ttft {o.metrics.ttft * 1e3:.1f} ms")
+                   f"{ttft}")
     s = engine.metrics.summary()
     dist_print(f"engine: {total_tokens} tokens / {args.requests} requests "
                f"in {dt * 1e3:.1f} ms over {s['steps']} iterations "
@@ -199,6 +236,15 @@ def run_engine(args, key):
                f"{s['max_queue_depth']}, peak kv util "
                f"{s['peak_kv_utilization']:.2f}, preemptions "
                f"{s['preemptions']}")
+    if args.chaos or args.deadline or max_queue is not None:
+        f = s["failures"]
+        dist_print(f"failure containment: {f['shed']} shed, "
+                   f"{f['deadline_expired']} expired, "
+                   f"{f['quarantined']} quarantined, "
+                   f"{f['callback_errors']} callback errors, "
+                   f"{f['forward_retries']} retries / "
+                   f"{f['forward_bisections']} bisections, "
+                   f"finish reasons {f['finish_reasons']}")
     comp = s["compilation"]
     per = ", ".join(f"{n} {c['misses']}c/{c['hits']}h"
                     for n, c in comp["programs"].items())

@@ -97,6 +97,12 @@ _FAST_GATE_MODULES = {
     # metrics) + the r5 regression fixes run in the gate; the end-to-end
     # engine-vs-oracle tests carry explicit @pytest.mark.slow.
     "test_serve_engine",
+    # failure containment: the deterministic chaos drain (fixed
+    # FaultInjector schedule -> exact SHED/DEADLINE/ERROR accounting,
+    # bit-exact untouched streams, whole free list) + watchdog/heartbeat
+    # gate every containment path; the randomized soak and speculative
+    # bailout carry explicit @pytest.mark.slow.
+    "test_serve_faults",
 }
 
 # Heavy tests inside core modules whose coverage is duplicated by a

@@ -57,6 +57,23 @@ def test_serve_engine_speculative():
     assert "verify)" in out and "done" in out
 
 
+def test_serve_engine_chaos():
+    """--chaos: seeded fault injection through the engine traffic — the
+    run drains, every request retires with a reason, and the failure-
+    containment accounting prints."""
+    out = _run("--engine", "--chaos", "--requests", "6", "--seed", "3",
+               "--page-size", "8", "--max-batch", "2", devices=1,
+               new_tokens=4)
+    assert "failure containment:" in out, out
+    assert "/ 6 requests" in out and "done" in out
+    # every request printed a retirement line with a known reason
+    import re
+    reasons = re.findall(r"req-\d+: prompt \d+ -> \d+ tokens \((\w+)\)",
+                         out)
+    assert len(reasons) == 6, out
+    assert set(reasons) <= {"length", "error", "shed", "deadline"}
+
+
 def test_serve_engine_mixed_warmup():
     """--mixed --warmup: lengths swept across the bucket ladder compile
     only during warmup; the trace-cache report proves traffic itself was

@@ -45,3 +45,7 @@ from triton_dist_tpu.runtime.watchdog import (  # noqa: F401
     block_until_ready_with_timeout,
     run_with_watchdog,
 )
+from triton_dist_tpu.runtime.faults import (  # noqa: F401
+    FaultInjector,
+    InjectedFault,
+)

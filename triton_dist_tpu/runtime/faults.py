@@ -1,0 +1,175 @@
+"""Deterministic fault injection for the failure-containment layer.
+
+The serving engine's containment paths (docs/serving.md "Failure
+containment") are only trustworthy if they are *exercised*: a quarantine
+path that no test can reach is a crash waiting for production.  This
+module provides the chaos half of that contract — a seeded
+:class:`FaultInjector` whose hooks are threaded through the engine and
+block-manager seams, so every containment path can be driven
+deterministically by tier-1 tests (fixed schedules) and probabilistically
+by the slow chaos soak (seeded rates).
+
+Fault points the serving stack instruments (``fire(point, **ctx)``):
+
+==============  =======================  ================================
+point           context                  seam
+==============  =======================  ================================
+``forward``     ``op=<program>, rids``   every engine device dispatch
+                                         (``ServeEngine._device_call``)
+``block_alloc`` ``rid``                  ``BlockManager.ensure`` grow path
+``callback``    ``rid``                  the ``on_token`` invocation seam
+``clock``       —                        each reading of a
+                                         ``wrap_clock()``-wrapped clock
+==============  =======================  ================================
+
+Actions: ``error=`` raises :class:`InjectedFault` at the point;
+``stall_s=`` sleeps there (inside the engine's watchdog-watched thunk, so
+an injected stall trips the step watchdog exactly like a wedged device);
+``skew_s=`` jumps the wrapped clock forward (expires request deadlines).
+
+A spec fires when its filters match: ``at_call`` pins the nth *enabled*
+arrival at the point, ``rid`` / ``op`` restrict to one request / program,
+``rate`` draws from the seeded stream (deterministic given an identical
+call sequence).  ``at_call`` faults are one-shot by default; everything
+else fires every match (``max_fires`` overrides either).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+
+class InjectedFault(RuntimeError):
+    """The error an armed fault point raises.  Deliberately NOT a
+    :class:`serve.block_manager.BlockExhausted`: an injected allocation
+    fault must exercise the engine's quarantine path, not the ordinary
+    preemption machinery."""
+
+
+@dataclass
+class _FaultSpec:
+    point: str
+    error: Optional[str] = None
+    stall_s: float = 0.0
+    skew_s: float = 0.0
+    at_call: Optional[int] = None
+    rate: float = 1.0
+    rid: Optional[str] = None
+    op: Optional[str] = None
+    max_fires: Optional[int] = None
+    fires: int = 0
+
+
+class FaultInjector:
+    """Seeded, deterministic fault injection (see module docstring).
+
+    Usage::
+
+        inj = FaultInjector(seed=7)
+        inj.inject("forward", rid="r3", op="paged_decode", error="boom")
+        inj.inject("forward", at_call=5, stall_s=2.0)       # one-shot
+        inj.inject("callback", rate=0.1, error="flaky ui")  # seeded
+        inj.inject("clock", at_call=9, skew_s=120.0)
+        engine = ServeEngine(..., faults=inj)
+
+    ``fired`` is the audit log — ``(point, call_index, kind, who)``
+    tuples in firing order — so a test can assert exactly which faults
+    a run hit.  ``disabled()`` gates everything off (engine warmup runs
+    under it: dummy traffic must not eat injected faults, and call
+    counts stay aligned with production traffic whether or not warmup
+    ran).
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._rng = np.random.default_rng(seed)
+        self._specs: list[_FaultSpec] = []
+        self.calls: dict[str, int] = {}   # per-point enabled arrivals
+        self.fired: list[tuple] = []      # (point, call#, kind, who)
+        self._skew = 0.0
+        self._enabled = True
+
+    # -- arming -----------------------------------------------------------
+
+    def inject(self, point: str, *, error: Optional[str] = None,
+               stall_s: float = 0.0, skew_s: float = 0.0,
+               at_call: Optional[int] = None, rate: float = 1.0,
+               rid: Optional[str] = None, op: Optional[str] = None,
+               max_fires: Optional[int] = None) -> "FaultInjector":
+        """Arm one fault spec; returns ``self`` so specs chain."""
+        if error is None and not stall_s and not skew_s:
+            raise ValueError(
+                "a fault needs an action: error=, stall_s= or skew_s=")
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError(f"rate must be in [0, 1], got {rate}")
+        if max_fires is None and at_call is not None:
+            max_fires = 1
+        self._specs.append(_FaultSpec(
+            point, error, stall_s, skew_s, at_call, rate, rid, op,
+            max_fires))
+        return self
+
+    @contextlib.contextmanager
+    def disabled(self):
+        """Every fault point no-ops inside (arrivals are not counted)."""
+        prev, self._enabled = self._enabled, False
+        try:
+            yield
+        finally:
+            self._enabled = prev
+
+    # -- the fault points -------------------------------------------------
+
+    def fire(self, point: str, *, rid: Optional[str] = None,
+             rids: tuple = (), op: Optional[str] = None) -> None:
+        """Called by an instrumented seam each time execution passes
+        ``point``; may raise :class:`InjectedFault`, sleep, or no-op."""
+        if not self._enabled:
+            return
+        n = self.calls[point] = self.calls.get(point, 0) + 1
+        for f in self._specs:
+            if f.point != point:
+                continue
+            if f.max_fires is not None and f.fires >= f.max_fires:
+                continue
+            if f.rid is not None and f.rid != rid and f.rid not in rids:
+                continue
+            if f.op is not None and f.op != op:
+                continue
+            if f.at_call is not None:
+                if f.at_call != n:
+                    continue
+            elif f.rate < 1.0 and self._rng.random() >= f.rate:
+                continue
+            f.fires += 1
+            kind = ("error" if f.error is not None
+                    else "stall" if f.stall_s else "skew")
+            who = rid or (f.rid if f.rid in rids else None) or op
+            self.fired.append((point, n, kind, who))
+            if f.skew_s:
+                self._skew += f.skew_s
+            if f.stall_s:
+                time.sleep(f.stall_s)
+            if f.error is not None:
+                raise InjectedFault(
+                    f"injected {point} fault #{n}"
+                    f"{f' ({who})' if who else ''}: {f.error}")
+
+    def wrap_clock(self, clock):
+        """Wrap an engine clock: each reading passes the ``clock`` fault
+        point (arm ``skew_s=`` specs there — never ``error=``) and adds
+        the accumulated skew."""
+        def skewed():
+            self.fire("clock")
+            return clock() + self._skew
+        return skewed
+
+    # -- accounting -------------------------------------------------------
+
+    def fire_count(self, point: Optional[str] = None) -> int:
+        return sum(1 for x in self.fired if point is None or x[0] == point)

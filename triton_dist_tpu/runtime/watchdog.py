@@ -34,7 +34,7 @@ class WatchdogTimeout(TimeoutError):
     """A watched computation exceeded its deadline."""
 
 
-def run_with_watchdog(fn: Callable[[], Any], timeout_s: float, *,
+def run_with_watchdog(fn: Callable[[], Any], timeout_s: float | None, *,
                       name: str = "computation",
                       dump_stacks: bool = True) -> Any:
     """Run ``fn()`` and return its result, raising :class:`WatchdogTimeout`
@@ -42,8 +42,12 @@ def run_with_watchdog(fn: Callable[[], Any], timeout_s: float, *,
 
     ``fn`` runs in a daemon thread; on timeout the thread is left running
     (device work is not cancellable) but the caller regains control.  Any
-    exception ``fn`` raises is re-raised here.
+    exception ``fn`` raises is re-raised here.  ``timeout_s=None`` runs
+    ``fn`` inline with no watchdog — callers with an *optional* stall
+    budget (the serving engine's ``step_timeout_s``) need no branch.
     """
+    if timeout_s is None:
+        return fn()
     result: list[Any] = []
     error: list[BaseException] = []
     done = threading.Event()

@@ -15,10 +15,14 @@ Layout:
   block tables, utilization accounting)
 - ``scheduler``  — iteration-level FCFS admission + chunked-prefill token
   budget + LIFO preemption policy
-- ``engine``     — the step loop: admit → prefill chunks → one batched
-  decode (or speculative verify round) per iteration
+- ``engine``     — the step loop: deadline sweep → admit → prefill
+  chunks → one batched decode (or speculative verify round) per
+  iteration, with failure containment throughout (poison-request
+  quarantine, watchdog-guarded dispatches, heartbeat;
+  docs/serving.md "Failure containment")
 - ``metrics``    — TTFT / inter-token latency / queue depth / KV-block
-  utilization / preemptions, exported through runtime/dump.py
+  utilization / preemptions / failure counters, exported through
+  runtime/dump.py
 """
 
 from triton_dist_tpu.serve.request import (  # noqa: F401
@@ -33,4 +37,7 @@ from triton_dist_tpu.serve.metrics import (  # noqa: F401
     RequestMetrics,
     ServeMetrics,
 )
-from triton_dist_tpu.serve.engine import ServeEngine  # noqa: F401
+from triton_dist_tpu.serve.engine import (  # noqa: F401
+    QueueFull,
+    ServeEngine,
+)

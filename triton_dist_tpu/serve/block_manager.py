@@ -27,7 +27,7 @@ class BlockExhausted(Exception):
 
 
 class BlockManager:
-    def __init__(self, num_blocks: int, page_size: int):
+    def __init__(self, num_blocks: int, page_size: int, *, faults=None):
         if num_blocks < 2:
             raise ValueError(
                 f"num_blocks must be >= 2 (block 0 is the reserved null "
@@ -37,6 +37,10 @@ class BlockManager:
         self.num_blocks = num_blocks
         self.page_size = page_size
         self.null_block = 0
+        # runtime.faults.FaultInjector (optional): the mid-grow alloc is
+        # a fault point — an injected failure exercises the engine's
+        # quarantine path without a genuinely exhausted pool.
+        self._faults = faults
         # LIFO free list: recently-freed (cache-warm) blocks are reused
         # first.  Block 0 never enters it.
         self._free: list[int] = list(range(num_blocks - 1, 0, -1))
@@ -86,6 +90,12 @@ class BlockManager:
         need = self.blocks_for(n_tokens) - len(table)
         if need <= 0:
             return []
+        if self._faults is not None:
+            # Fires BEFORE the free list is touched: an injected alloc
+            # failure (InjectedFault, not BlockExhausted) leaves the pool
+            # intact and bypasses the preemption machinery, so it lands
+            # on the engine's quarantine path.
+            self._faults.fire("block_alloc", rid=rid)
         if need > self.num_free:
             raise BlockExhausted(
                 f"{rid}: extension to {n_tokens} tokens needs {need} more "

@@ -33,6 +33,20 @@ bucket to a powers-of-two ladder, :meth:`ServeEngine.warmup`
 pre-compiles the lot, and every program's trace-cache hit/miss/stall
 counters ride ``ServeMetrics`` (docs/serving.md "bucket ladder").
 
+Failures are CONTAINED (PR 3, docs/serving.md "Failure containment"):
+requests carry optional deadlines (expired WAITING/PREFILL requests are
+swept each step), ``submit()`` enforces an optional queue bound with a
+shed-or-raise policy, a poison request — a raising ``on_token``
+callback, a failing forward, a failed mid-decode block grow — is
+quarantined (retired ``FinishReason.ERROR``, blocks freed) while its
+slot-mates keep decoding (batched-forward failures bisect over the
+batch to isolate the poison row), every device dispatch runs under an
+optional step watchdog, and the step loop drives a synchronous
+:class:`runtime.watchdog.Heartbeat` so an external supervisor sees a
+wedged engine as a stale file.  A ``runtime.faults.FaultInjector``
+threads through the engine/block-manager seams so every containment
+path is exercised by deterministic chaos tests.
+
 v1 scope: world-1 mesh, float KV pools, dense-Llama-family ``Generator``
 (the same envelope as the r5 batched speculative verify; batch-1 SP +
 int8 serving keeps the contiguous `Generator.generate` path).
@@ -40,7 +54,9 @@ int8 serving keeps the contiguous `Generator.generate` path).
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import sys
 import time
 from typing import Optional
 
@@ -57,7 +73,13 @@ from triton_dist_tpu.models.generate import (
 )
 from triton_dist_tpu.models.sampling import sample_logits
 from triton_dist_tpu.models.speculative import greedy_accept_chain_batched
+from triton_dist_tpu.runtime.faults import FaultInjector
 from triton_dist_tpu.runtime.jit_cache import CountingJit
+from triton_dist_tpu.runtime.watchdog import (
+    Heartbeat,
+    WatchdogTimeout,
+    run_with_watchdog,
+)
 from triton_dist_tpu.serve.block_manager import BlockExhausted, BlockManager
 from triton_dist_tpu.serve.metrics import RequestMetrics, ServeMetrics
 from triton_dist_tpu.serve.request import (
@@ -67,6 +89,19 @@ from triton_dist_tpu.serve.request import (
     SamplingParams,
 )
 from triton_dist_tpu.serve.scheduler import FCFSScheduler, ReqState, Status
+
+
+class QueueFull(RuntimeError):
+    """``submit()`` rejected a request: the waiting queue is at
+    ``max_queue`` and the engine runs the ``"raise"`` overload policy
+    (the ``"shed"`` policy retires the request ``FinishReason.SHED``
+    instead of raising)."""
+
+
+# Exceptions containment must NEVER swallow: a tripped step watchdog is
+# an engine-level stall (the caller decides whether to checkpoint or
+# abort), and interrupts/exits belong to the process.
+_FATAL = (WatchdogTimeout, KeyboardInterrupt, SystemExit)
 
 
 # ---------------------------------------------------------------------------
@@ -176,6 +211,24 @@ def _fill_pool_pages(pools, scratch, block_ids, *, page):
     return new_pools
 
 
+def _splice_draft_rows(bcaches, blens, blogits, tcaches, slot, s0, last):
+    """Splice one freshly-prefilled draft row into the slot-indexed batch
+    state: ``tcaches`` are extent-wide per-layer ``[1, Hkv, ext, D]``
+    temp caches from the padded chunked draft prefill (rows >= ``s0``
+    are exact zeros — ``n_valid``-masked — and land in the dead region
+    past the row's cache length, so copying the FULL extent keeps the
+    trace keyed by the draft-ladder rung alone, never the prompt
+    length).  ``slot``/``s0``/``last`` are traced, so joins at any slot
+    or length share one program per rung."""
+    out = []
+    for (kb, vb), (kt, vt) in zip(bcaches, tcaches):
+        w = min(kt.shape[2], kb.shape[2])
+        kb = kb.at[slot, :, :w, :].set(kt[0, :, :w, :].astype(kb.dtype))
+        vb = vb.at[slot, :, :w, :].set(vt[0, :, :w, :].astype(vb.dtype))
+        out.append((kb, vb))
+    return out, blens.at[slot].set(s0), blogits.at[slot].set(last)
+
+
 def build_bucket_ladder(base: int, cap: int, page: int) -> list[int]:
     """The powers-of-two scratch-extent ladder: rungs double from
     ``base`` (rounded up to a page multiple) until ``cap`` (the largest
@@ -231,7 +284,13 @@ class ServeEngine:
                  prefill_budget: Optional[int] = None,
                  bucket_ladder: Optional[list] = None,
                  draft: Optional[Generator] = None, draft_params=None,
-                 spec_k: int = 0, clock=time.monotonic):
+                 spec_k: int = 0, clock=time.monotonic,
+                 max_queue: Optional[int] = None, overload: str = "shed",
+                 step_timeout_s: Optional[float] = None,
+                 heartbeat: Optional[str] = None,
+                 heartbeat_interval_s: float = 10.0,
+                 faults: Optional[FaultInjector] = None,
+                 fault_retries: int = 1):
         assert gen.attn.world == 1, (
             "ServeEngine is world-1 (the per-row block tables are host-"
             "managed); multi-chip serving keeps Generator.generate's SP "
@@ -249,13 +308,18 @@ class ServeEngine:
                 "spec_k needs draft + draft_params")
             assert draft.max_seq >= gen.max_seq, (
                 "draft max_seq must cover the target's")
+        if overload not in ("shed", "raise"):
+            raise ValueError(
+                f"overload must be 'shed' or 'raise', got {overload!r}")
+        if max_queue is not None and max_queue < 0:
+            raise ValueError(f"max_queue must be >= 0, got {max_queue}")
         self.gen = gen
         self.cfg = cfg
         self.params = params
         self.page = page_size
         self.max_batch = max_batch
         self.n_pages_max = gen.max_seq // page_size
-        self.bm = BlockManager(num_blocks, page_size)
+        self.bm = BlockManager(num_blocks, page_size, faults=faults)
         self.scheduler = FCFSScheduler(
             self.bm,
             prefill_budget=prefill_budget or 4 * prefill_chunk,
@@ -264,6 +328,19 @@ class ServeEngine:
         self.draft = draft
         self.draft_params = draft_params
         self.spec_k = int(spec_k)
+        # failure containment (docs/serving.md "Failure containment")
+        self.max_queue = max_queue
+        self.overload = overload
+        self.step_timeout_s = step_timeout_s
+        self.faults = faults
+        self.fault_retries = int(fault_retries)
+        self.heartbeat = (Heartbeat(heartbeat,
+                                    interval_s=heartbeat_interval_s)
+                          if heartbeat is not None else None)
+        self._last_beat = float("-inf")
+        self._spec_off = False  # latched by a failed speculative round
+        if faults is not None:
+            clock = faults.wrap_clock(clock)
         self._clock = clock
 
         # The scratch-extent bucket ladder: every prefill's s_ext (and
@@ -324,18 +401,30 @@ class ServeEngine:
         self._outputs: dict[str, RequestOutput] = {}
         # speculative-mode device state ([B]-shaped, slot-indexed)
         if self.spec_k:
-            # Count the draft's programs too: its per-prompt-length
-            # prefill is the one remaining admission-path compile after
-            # warmup (ROADMAP follow-up) — it must at least be VISIBLE
-            # in the compile metrics.  Wrap-once: a draft shared across
-            # engines keeps one counter (re-registered here).
-            if not isinstance(draft._prefill_jit, CountingJit):
-                draft._prefill_jit = CountingJit(draft._prefill_jit,
-                                                 "draft_prefill")
+            # The draft joins through the SAME padded fixed-chunk
+            # machinery as the target (its own _chunk_jit + an extent
+            # ladder of chunk multiples), so spec-mode admission is
+            # fully compile-free after warmup — the ROADMAP follow-up
+            # that used to leave draft.prefill compiling per prompt
+            # length.  _splice_draft_rows lands the prefilled row in
+            # the slot-indexed batch caches (traced slot/length: one
+            # program per rung).
+            self._draft_ladder = build_bucket_ladder(
+                prefill_chunk, gen.max_seq - 1, prefill_chunk)
+            self._draft_chunk_fn = CountingJit(draft._chunk_jit,
+                                               "draft_prefill")
+            # temp caches (arg 3) are NOT donatable: the splice reads a
+            # sliced view of them into the batch caches
+            self._draft_join_fn = CountingJit(
+                jax.jit(_splice_draft_rows, donate_argnums=(0, 1, 2)),
+                "draft_join")
             if not isinstance(draft._step_jit, CountingJit):
+                # Wrap-once: a draft shared across engines keeps one
+                # counter (re-registered here).
                 draft._step_jit = CountingJit(draft._step_jit,
                                               "draft_step")
-            self.metrics.register_compiled(draft._prefill_jit)
+            self.metrics.register_compiled(self._draft_chunk_fn)
+            self.metrics.register_compiled(self._draft_join_fn)
             self.metrics.register_compiled(draft._step_jit)
             self._last_logits = jnp.zeros((max_batch, cfg.vocab),
                                           jnp.float32)
@@ -354,7 +443,17 @@ class ServeEngine:
 
     # -- submission -------------------------------------------------------
 
-    def submit(self, req: Request) -> None:
+    def submit(self, req: Request) -> Optional[RequestOutput]:
+        """Queue a request.  Returns ``None`` on acceptance; under the
+        ``"shed"`` overload policy a request arriving with the waiting
+        queue at ``max_queue`` is retired immediately with
+        ``FinishReason.SHED`` and its output returned (the ``"raise"``
+        policy raises :class:`QueueFull` instead — backpressure the
+        frontend can propagate)."""
+        return self._submit(req, bounded=True)
+
+    def _submit(self, req: Request,
+                bounded: bool = True) -> Optional[RequestOutput]:
         if req.request_id in self._states:
             raise ValueError(f"duplicate request id {req.request_id!r}")
         total = int(req.prompt.shape[0]) + req.params.max_new_tokens
@@ -373,21 +472,34 @@ class ServeEngine:
             req.arrival_time = self._clock()
         rs = ReqState(req=req,
                       metrics=RequestMetrics(arrival_time=req.arrival_time))
+        if (bounded and self.max_queue is not None
+                and self.scheduler.queue_depth >= self.max_queue):
+            # Bounded admission: shedding at submit() keeps an overload
+            # from growing an unbounded queue of requests that would
+            # only expire later — the caller learns immediately.
+            msg = (f"queue at bound ({self.scheduler.queue_depth} >= "
+                   f"max_queue {self.max_queue})")
+            if self.overload == "raise":
+                raise QueueFull(f"{req.request_id}: {msg}")
+            self._states[req.request_id] = rs
+            self.metrics.shed += 1
+            return self._retire(rs, FinishReason.SHED, free=False,
+                                error=msg)
         self._states[req.request_id] = rs
         self.scheduler.add(rs)
+        return None
 
     def abort(self, request_id: str) -> Optional[RequestOutput]:
-        """Cancel a request wherever it is; returns its (partial) output."""
+        """Cancel a request wherever it is; returns its (partial) output.
+        Safe mid-step (e.g. from an ``on_token`` callback): the commit
+        loops skip rows that retired under them."""
         rs = self._states.get(request_id)
         if rs is None or rs.status is Status.FINISHED:
             return self._outputs.get(request_id)
         if rs.status is Status.WAITING:
             self.scheduler.waiting.remove(rs)
-        else:
-            self.bm.free(request_id)
-            self.slots[rs.slot] = None
-            rs.scratch = None
-        return self._retire(rs, FinishReason.ABORT, free=False)
+            return self._retire(rs, FinishReason.ABORT, free=False)
+        return self._retire(rs, FinishReason.ABORT)
 
     def has_work(self) -> bool:
         return bool(self.scheduler.waiting) or any(
@@ -396,9 +508,28 @@ class ServeEngine:
     # -- the iteration ----------------------------------------------------
 
     def step(self) -> list[RequestOutput]:
-        """One scheduler iteration; returns requests that finished."""
+        """One scheduler iteration; returns requests that finished.
+
+        Failure containment: a request whose prefill or commit fails is
+        quarantined (``FinishReason.ERROR``, blocks freed) without
+        unwinding the step; batched decode failures retry then bisect
+        (:meth:`_forward_contained`); a failed speculative round latches
+        speculation off and degrades to plain decode.  Only ``_FATAL``
+        (watchdog trips, interrupts) escapes."""
+        self._beat()
         now = self._clock()
         finished: list[RequestOutput] = []
+
+        # Deadline sweep BEFORE admission: expired WAITING/PREFILL
+        # requests retire (DEADLINE) and their slots/blocks free for
+        # live traffic this same iteration.  Rows already decoding run
+        # to completion — their prefill is paid for.
+        for rs in self.scheduler.pop_expired(now):
+            finished.append(self._expire(rs, now, free=False))
+        for rs in list(self.slots):
+            if (rs is not None and rs.status is Status.PREFILL
+                    and rs.expired(now)):
+                finished.append(self._expire(rs, now, free=True))
 
         free = [i for i, s in enumerate(self.slots) if s is None]
         for rs in self.scheduler.admit(free, now):
@@ -408,14 +539,27 @@ class ServeEngine:
         prefilling = [s for s in self.slots
                       if s is not None and s.status is Status.PREFILL]
         for rs, n in self.scheduler.prefill_plan(prefilling):
-            out = self._run_prefill(rs, n, now)
+            if rs.status is not Status.PREFILL:
+                continue  # aborted mid-step (e.g. from an on_token
+            try:          # callback fired earlier in this plan)
+                out = self._run_prefill(rs, n, now)
+            except _FATAL:
+                raise
+            except Exception as e:
+                if not self._state_intact():
+                    raise  # fill_pages donated the pools: engine-fatal
+                # Prefill is already per-request (own scratch, own
+                # chunk stream) — the poison is isolated by
+                # construction; no retry or bisection needed.
+                finished.append(self._quarantine(rs, f"prefill: {e!r}"))
+                continue
             if out is not None:
                 finished.append(out)
 
         running = [s for s in self.slots
                    if s is not None and s.status is Status.RUNNING]
         if running:
-            if self.spec_k:
+            if self.spec_k and not self._spec_off:
                 finished.extend(self._spec_round(running))
             else:
                 finished.extend(self._decode_once(running))
@@ -427,7 +571,11 @@ class ServeEngine:
         return finished
 
     def run(self, max_steps: int = 100_000) -> dict[str, RequestOutput]:
-        """Step until drained; returns {request_id: output}."""
+        """Step until drained; returns {request_id: output}.  Drives the
+        heartbeat (one beat per iteration via :meth:`step`); raises
+        ``RuntimeError`` when ``max_steps`` iterations don't drain the
+        queue — the backstop against a scheduling livelock."""
+        self._beat()
         steps = 0
         while self.has_work():
             self.step()
@@ -460,10 +608,13 @@ class ServeEngine:
         Call BEFORE submitting traffic (asserted).  A rung is skipped
         only when no admissible request can reach it (shorter prompts
         and max_new=1 are tried before giving up) — then production
-        cannot hit it either.  Spec mode: the draft model's own
-        per-prompt-length prefill still compiles per new length
-        (ROADMAP follow-up), visible as the ``draft_prefill`` counter;
-        the four paged engine programs are covered.
+        cannot hit it either.  Spec mode: the draft prefills through
+        its own padded chunk + extent ladder (``draft_prefill`` /
+        ``draft_join`` counters), and warmup sweeps THAT ladder too —
+        spec-mode admission is fully compile-free after warmup.  An
+        attached ``FaultInjector`` is disabled for the duration (dummy
+        traffic must not eat injected faults) and the queue bound does
+        not apply to warmup dummies.
 
         Returns ``{"programs": <fresh compiles>, "seconds": <wall>}``;
         the same numbers accumulate in ``metrics.warmup_compiles`` /
@@ -477,58 +628,56 @@ class ServeEngine:
         # wrappers are shared so compile accounting continues
         saved, self.metrics = self.metrics, ServeMetrics()
         self.metrics.compiled_fns = saved.compiled_fns
+        guard = (self.faults.disabled() if self.faults is not None
+                 else contextlib.nullcontext())
         try:
-            prev, round_ = -1, 0
-            while self.metrics.compile_misses != prev and round_ < 4:
-                prev = self.metrics.compile_misses
-                for i, rung in enumerate(self.ladder):
-                    # Longest prompt whose _scratch_need fits this rung:
-                    # n <= rung keeps the pool pages in, and n <=
-                    # (rung // chunk) * chunk keeps the padded final
-                    # chunk in.  If even that n buckets LOWER, no
-                    # admissible prompt can reach this rung — skip it
-                    # (production can't hit it either).
-                    n_max = min(rung, (rung // chunk) * chunk,
-                                self.gen.max_seq - 1)
-                    if n_max < 1 or self._bucket_s_ext(n_max) != rung:
-                        continue
-                    # Fall back to smaller totals before giving up on
-                    # the rung: the pool may reject n_max + 2 while a
-                    # production request (shorter prompt or max_new=1)
-                    # bucketing to the same rung is still admittable.
-                    # n_min is the shortest prompt reaching this rung
-                    # (one past what the rung below can hold); blocks_for
-                    # is monotone, so if n_min + 1 doesn't fit, nothing
-                    # reaching this rung does.
-                    if i == 0:
-                        n_min = 1
-                    else:
-                        below = self.ladder[i - 1]
-                        n_min = 1 + max(0, min(below,
-                                               (below // chunk) * chunk))
-                    # Candidate order: longest first (covers the rung's
-                    # full extent), max_new=2 before 1 (a 2-token dummy
-                    # runs a decode step; a 1-token dummy retires on its
-                    # prefill logits and would leave _decode_fn cold).
-                    for j, (n, new) in enumerate(
-                            ((n_max, min(2, self.gen.max_seq - n_max)),
-                             (n_max, 1),
-                             (n_min, min(2, self.gen.max_seq - n_min)),
-                             (n_min, 1))):
-                        req = Request(f"__warmup_{round_}_{i}_{j}",
-                                      np.zeros((n,), np.int32),
-                                      SamplingParams(max_new_tokens=new))
-                        try:
-                            self.submit(req)
-                            break
-                        except ValueError:
+            with guard:
+                prev, round_ = -1, 0
+                while self.metrics.compile_misses != prev and round_ < 4:
+                    prev = self.metrics.compile_misses
+                    for i, rung in enumerate(self.ladder):
+                        # Longest prompt whose _scratch_need fits this
+                        # rung: n <= rung keeps the pool pages in, and
+                        # n <= (rung // chunk) * chunk keeps the padded
+                        # final chunk in.  If even that n buckets LOWER,
+                        # no admissible prompt can reach this rung —
+                        # skip it (production can't hit it either).
+                        n_max = min(rung, (rung // chunk) * chunk,
+                                    self.gen.max_seq - 1)
+                        if n_max < 1 or self._bucket_s_ext(n_max) != rung:
                             continue
-                self.run()
-                for rid in [r for r in self._outputs
-                            if r.startswith("__warmup_")]:
-                    del self._outputs[rid]
-                    del self._states[rid]
-                round_ += 1
+                        # n_min is the shortest prompt reaching this
+                        # rung (one past what the rung below can hold);
+                        # blocks_for is monotone, so if n_min + 1
+                        # doesn't fit, nothing reaching this rung does.
+                        if i == 0:
+                            n_min = 1
+                        else:
+                            below = self.ladder[i - 1]
+                            n_min = 1 + max(0, min(below,
+                                                   (below // chunk)
+                                                   * chunk))
+                        self._warmup_try(f"w{round_}_{i}", n_max, n_min)
+                    if self.spec_k:
+                        # Sweep the DRAFT extent ladder too: its rungs
+                        # (chunk multiples) need not align with the
+                        # engine's scratch rungs, and a cold draft rung
+                        # would compile on the admission path.
+                        for i, rung in enumerate(self._draft_ladder):
+                            n_max = min(rung, self.gen.max_seq - 1)
+                            if (n_max < 1
+                                    or self._draft_bucket(n_max) != rung):
+                                continue
+                            n_min = (1 if i == 0
+                                     else self._draft_ladder[i - 1] + 1)
+                            self._warmup_try(f"wd{round_}_{i}", n_max,
+                                             n_min)
+                    self.run()
+                    for rid in [r for r in self._outputs
+                                if r.startswith("__warmup_")]:
+                        del self._outputs[rid]
+                        del self._states[rid]
+                    round_ += 1
         finally:
             self.metrics = saved
         dt = time.perf_counter() - t0
@@ -536,6 +685,27 @@ class ServeEngine:
         self.metrics.warmup_time += dt
         self.metrics.warmup_compiles += fresh
         return {"programs": fresh, "seconds": dt}
+
+    def _warmup_try(self, tag: str, n_max: int, n_min: int) -> None:
+        """Queue ONE warmup dummy for a rung, falling back to smaller
+        totals before giving up: the pool may reject n_max + 2 while a
+        production request (shorter prompt or max_new=1) reaching the
+        same rung is still admittable.  Candidate order: longest first
+        (covers the rung's full extent), max_new=2 before 1 (a 2-token
+        dummy runs a decode step; a 1-token dummy retires on its
+        prefill logits and would leave the decode program cold)."""
+        for j, (n, new) in enumerate(
+                ((n_max, min(2, self.gen.max_seq - n_max)),
+                 (n_max, 1),
+                 (n_min, min(2, self.gen.max_seq - n_min)),
+                 (n_min, 1))):
+            req = Request(f"__warmup_{tag}_{j}", np.zeros((n,), np.int32),
+                          SamplingParams(max_new_tokens=new))
+            try:
+                self._submit(req, bounded=False)
+                return
+            except ValueError:
+                continue
 
     # -- prefill ----------------------------------------------------------
 
@@ -558,6 +728,18 @@ class ServeEngine:
                 return r
         raise AssertionError(
             f"bucket ladder {self.ladder} cannot cover scratch extent "
+            f"{need} (prompt {n_prompt})")
+
+    def _draft_bucket(self, n_prompt: int) -> int:
+        """Draft-side prefill extent for an ``n_prompt``-token prompt,
+        bucketed up the draft's chunk-multiple ladder."""
+        chunk = self.scheduler.prefill_chunk
+        need = -(-n_prompt // chunk) * chunk
+        for r in self._draft_ladder:
+            if r >= need:
+                return r
+        raise AssertionError(
+            f"draft ladder {self._draft_ladder} cannot cover extent "
             f"{need} (prompt {n_prompt})")
 
     def _start_prefill(self, rs: ReqState) -> None:
@@ -587,7 +769,8 @@ class ServeEngine:
             # prompt lengths never compile on the admission path.
             buf = np.zeros((1, chunk_sz), np.int32)
             buf[0, :c] = prompt[rs.prefill_pos:rs.prefill_pos + c]
-            rs.scratch, logits = self._chunk_fn(
+            rs.scratch, logits = self._device_call(
+                "prefill_chunk", (rs.req.request_id,), self._chunk_fn,
                 self.params, jnp.asarray(buf), rs.scratch,
                 jnp.int32(rs.prefill_pos), quantized=False,
                 extent=rs.s_ext, n_valid=jnp.int32(c))
@@ -609,13 +792,14 @@ class ServeEngine:
         # block.
         ids = np.zeros((rs.s_ext // self.page,), np.int32)
         ids[:n_prompt_pages] = self.bm.table(rid)[:n_prompt_pages]
-        self._pools = self._fill_fn(self._pools, rs.scratch,
-                                    jnp.asarray(ids))
+        self._pools = self._device_call(
+            "fill_pages", (rid,), self._fill_fn, self._pools, rs.scratch,
+            jnp.asarray(ids))
         rs.scratch = None
         rs.kv_len = S0
         rs.status = Status.RUNNING
         last = logits[:, n_last - 1]                       # [1, V]
-        if self.spec_k:
+        if self.spec_k and not self._spec_off:
             self._last_logits = self._last_logits.at[rs.slot].set(last[0])
             self._join_draft(rs)
             return None  # first token emitted by the next verify round
@@ -623,19 +807,46 @@ class ServeEngine:
         return self._commit_token(rs, token)
 
     def _join_draft(self, rs: ReqState) -> None:
-        """Prefill the draft model for a joining row (spec mode)."""
-        dstate = self.draft.prefill(self.draft_params,
-                                    jnp.asarray(rs.prompt_tokens[None]))
+        """Prefill the draft model for a joining row (spec mode) through
+        the SAME padded fixed-chunk machinery as the target: every chunk
+        call is the one ``prefill_chunk`` shape (final residual padded,
+        K/V zero-masked by ``n_valid``) against a temp cache whose
+        extent buckets up the draft ladder, then one traced-slot splice
+        lands the row in the batch caches — O(len(draft ladder))
+        programs cover every prompt length, so spec-mode admission
+        never compiles after warmup (the old ``draft.prefill`` path
+        compiled per distinct length)."""
+        rid = rs.req.request_id
+        prompt = np.asarray(rs.prompt_tokens)
+        S0 = int(prompt.shape[0])
+        chunk = self.scheduler.prefill_chunk
+        dcfg = self.draft.cfg
+        ext = self._draft_bucket(S0)
+        caches = [
+            (jnp.zeros((1, dcfg.n_kv_heads, ext, dcfg.head_dim),
+                       dcfg.dtype),
+             jnp.zeros((1, dcfg.n_kv_heads, ext, dcfg.head_dim),
+                       dcfg.dtype))
+            for _ in range(dcfg.n_layers)]
+        logits = None
+        n_last = 0
+        for off in range(0, S0, chunk):
+            c = min(chunk, S0 - off)
+            buf = np.zeros((1, chunk), np.int32)
+            buf[0, :c] = prompt[off:off + c]
+            caches, logits = self._device_call(
+                "draft_prefill", (rid,), self._draft_chunk_fn,
+                self.draft_params, jnp.asarray(buf), caches,
+                jnp.int32(off), quantized=False, extent=ext,
+                n_valid=jnp.int32(c))
+            n_last = c
         sd = self._draft_state
-        caches = []
-        for (kb, vb), (k1, v1) in zip(sd.caches, dstate.caches):
-            caches.append((kb.at[rs.slot].set(k1[0]),
-                           vb.at[rs.slot].set(v1[0])))
+        new_caches, kv_lens, last_logits = self._device_call(
+            "draft_join", (rid,), self._draft_join_fn, sd.caches,
+            sd.kv_lens, sd.last_logits, caches, jnp.int32(rs.slot),
+            jnp.int32(S0), logits[0, n_last - 1])
         self._draft_state = GenerationState(
-            caches=caches,
-            kv_lens=sd.kv_lens.at[rs.slot].set(dstate.kv_lens[0]),
-            last_logits=sd.last_logits.at[rs.slot].set(
-                dstate.last_logits[0]))
+            caches=new_caches, kv_lens=kv_lens, last_logits=last_logits)
 
     # -- token choice / emission -----------------------------------------
 
@@ -658,13 +869,36 @@ class ServeEngine:
         token stays ``pending`` (not yet in the cache) until the next
         decode step consumes it.  Timestamps are taken HERE (not at the
         step boundary) so TTFT/ITL separate tokens emitted within one
-        iteration (prefill completion + same-step decode)."""
+        iteration (prefill completion + same-step decode).
+
+        The ``on_token`` callback is CONTAINED: a raising frontend
+        callback used to propagate out of ``step()`` with the token
+        already committed, corrupting mid-step state — now it is logged
+        once, the request's callback is disabled, and serving
+        continues.  A callback may also ``abort()`` requests (including
+        this one): commit re-checks status afterwards so a retired
+        request is never retired twice."""
+        if rs.status is Status.FINISHED:  # aborted mid-step by a callback
+            return self._outputs.get(rs.req.request_id)
         now = self._clock()
         rs.generated.append(token)
         rs.pending_token = token
         rs.metrics.on_token(now)
-        if rs.req.on_token is not None:
-            rs.req.on_token(rs.req.request_id, token)
+        if rs.req.on_token is not None and not rs.callback_disabled:
+            try:
+                if self.faults is not None:
+                    self.faults.fire("callback", rid=rs.req.request_id)
+                rs.req.on_token(rs.req.request_id, token)
+            except _FATAL:
+                raise
+            except Exception as e:
+                rs.callback_disabled = True
+                self.metrics.callback_errors += 1
+                print(f"[serve] {rs.req.request_id}: on_token callback "
+                      f"raised ({e!r}); callback disabled, request "
+                      f"keeps serving", file=sys.stderr)
+        if rs.status is Status.FINISHED:  # callback aborted this request
+            return self._outputs.get(rs.req.request_id)
         p = rs.req.params
         if p.eos_id is not None and token == p.eos_id:
             return self._retire(rs, FinishReason.EOS)
@@ -673,21 +907,138 @@ class ServeEngine:
         return None
 
     def _retire(self, rs: ReqState, reason: FinishReason, *,
-                free: bool = True) -> RequestOutput:
+                free: bool = True, error: Optional[str] = None
+                ) -> RequestOutput:
         now = self._clock()
         if free:
             self.bm.free(rs.req.request_id)
             self.slots[rs.slot] = None
         rs.status = Status.FINISHED
         rs.slot = None
+        rs.scratch = None
+        rs.pending_token = None
         rs.metrics.finish_time = now
         out = RequestOutput(request_id=rs.req.request_id,
                             prompt=rs.req.prompt,
                             token_ids=list(rs.generated),
-                            finish_reason=reason, metrics=rs.metrics)
+                            finish_reason=reason, metrics=rs.metrics,
+                            error=error)
         self._outputs[rs.req.request_id] = out
-        self.metrics.observe_finish(rs.req.request_id, rs.metrics)
+        self.metrics.observe_finish(rs.req.request_id, rs.metrics, reason)
         return out
+
+    # -- failure containment ---------------------------------------------
+
+    def _beat(self) -> None:
+        """Synchronous heartbeat — deliberately not Heartbeat's daemon
+        thread: a wedged forward must STOP the beats so an external
+        supervisor sees the stall as a stale file.  Throttled to a
+        quarter of the supervisor cadence (wall clock, independent of
+        the — possibly fake — engine clock) so fast step loops don't
+        pay a file write per iteration."""
+        if self.heartbeat is None:
+            return
+        t = time.monotonic()
+        if t - self._last_beat >= self.heartbeat.interval_s / 4:
+            self.heartbeat.beat()
+            self._last_beat = t
+
+    def _state_intact(self) -> bool:
+        """Containment precondition: the shared KV pools survived the
+        failure.  The batched forwards DONATE the pools — an exception
+        raised after dispatch (a genuine device error, as opposed to a
+        pre-dispatch injector/seam failure) may have consumed them, and
+        a retry over deleted buffers would cascade the fault onto every
+        request while the engine kept reporting healthy steps.  When
+        the pools are gone, containment escalates to the caller
+        instead — a lost pool is an engine-level failure, like a
+        tripped watchdog."""
+        return not any(getattr(x, "is_deleted", lambda: False)()
+                       for x in jax.tree_util.tree_leaves(self._pools))
+
+    def _expire(self, rs: ReqState, now: float,
+                *, free: bool) -> RequestOutput:
+        """Retire a deadline-expired WAITING/PREFILL request."""
+        self.metrics.deadline_expired += 1
+        waited = now - (rs.req.arrival_time or now)
+        return self._retire(
+            rs, FinishReason.DEADLINE, free=free,
+            error=(f"deadline {rs.req.params.deadline_s}s exceeded "
+                   f"({waited:.3f}s since arrival, status "
+                   f"{rs.status.value})"))
+
+    def _quarantine(self, rs: ReqState, msg: str) -> RequestOutput:
+        """Retire a poison request (``FinishReason.ERROR``): its blocks
+        free immediately so the pool stays whole, its partial output is
+        preserved, and the rest of the batch keeps serving."""
+        self.metrics.quarantined += 1
+        print(f"[serve] {rs.req.request_id}: quarantined — {msg}",
+              file=sys.stderr)
+        return self._retire(rs, FinishReason.ERROR,
+                            free=rs.slot is not None, error=msg)
+
+    def _device_call(self, op: str, rids: tuple, fn, *args, **kwargs):
+        """The ONE guarded device-dispatch seam: the ``forward`` fault
+        point fires inside the watched thunk (an injected stall trips
+        the watchdog exactly like a wedged device), and with
+        ``step_timeout_s`` set the result is forced to ready under
+        ``runtime.watchdog`` so a hung forward raises
+        :class:`WatchdogTimeout` instead of wedging ``run()`` forever
+        (the heartbeat file goes stale — the beats are synchronous)."""
+        def call():
+            if self.faults is not None:
+                self.faults.fire("forward", op=op, rids=rids)
+            out = fn(*args, **kwargs)
+            return (jax.block_until_ready(out)
+                    if self.step_timeout_s is not None else out)
+        if self.step_timeout_s is None:
+            return call()
+        try:
+            return run_with_watchdog(call, self.step_timeout_s, name=op)
+        except WatchdogTimeout:
+            self.metrics.watchdog_trips += 1
+            raise
+
+    def _forward_contained(self, rows: list[ReqState], runner, kind: str,
+                           finished: list) -> None:
+        """Run ``runner(rows)`` — ONE batched forward plus its per-row
+        commits — containing failures: the whole set retries up to
+        ``fault_retries`` times (transient faults), then bisects to
+        isolate the poison row(s); a single row that still fails is
+        quarantined and its slot-mates re-run clean.  ``runner`` must
+        keep all engine-state mutation AFTER its device sync, so a
+        failed attempt leaves nothing committed and the retry is safe
+        (per-row commit errors are contained inside ``runner`` itself
+        and never escape it).  Precondition for every retry: the
+        donated pools survived (:meth:`_state_intact`) — a genuine
+        post-dispatch device failure escalates instead of cascading
+        over deleted buffers."""
+        err = None
+        for attempt in range(1 + max(self.fault_retries, 0)):
+            try:
+                runner(rows)
+                return
+            except _FATAL:
+                raise
+            except Exception as e:
+                if not self._state_intact():
+                    raise  # donated pools consumed: engine-fatal
+                err = e
+                if attempt < self.fault_retries:
+                    self.metrics.forward_retries += 1
+        if len(rows) == 1:
+            rs = rows[0]
+            if rs.status is Status.RUNNING:
+                finished.append(self._quarantine(
+                    rs, f"{kind} forward failed after "
+                        f"{1 + self.fault_retries} attempts: {err!r}"))
+            return
+        self.metrics.forward_bisections += 1
+        mid = len(rows) // 2
+        for half in (rows[:mid], rows[mid:]):
+            live = [r for r in half if r.status is Status.RUNNING]
+            if live:
+                self._forward_contained(live, runner, kind, finished)
 
     # -- capacity / preemption -------------------------------------------
 
@@ -724,40 +1075,68 @@ class ServeEngine:
 
     def _decode_once(self,
                      running: list[ReqState]) -> list[RequestOutput]:
+        finished: list[RequestOutput] = []
         for rs in sorted(running, key=lambda r: r.seq):
             if rs.status is Status.RUNNING:  # may get preempted below
-                self._ensure_capacity(rs, rs.kv_len + 1)
+                try:
+                    self._ensure_capacity(rs, rs.kv_len + 1)
+                except _FATAL:
+                    raise
+                except Exception as e:
+                    # No-victim RuntimeError or an injected alloc fault:
+                    # this request cannot grow — quarantine it (its
+                    # blocks come back) instead of unwinding the step.
+                    finished.append(self._quarantine(
+                        rs, f"kv grow to {rs.kv_len + 1} rows: {e!r}"))
         live = [r for r in running if r.status is Status.RUNNING]
-        if not live:
-            return []
+        if live:
+            self._forward_contained(
+                live, lambda rows: self._decode_rows(rows, finished),
+                "decode", finished)
+        return finished
 
+    def _decode_rows(self, rows: list[ReqState], finished: list) -> None:
+        """ONE batched decode for ``rows`` (other slots inactive — their
+        writes redirect to the null block) + per-row commits.  All
+        engine-state mutation happens after the logits sync, so a
+        failed dispatch leaves nothing committed and
+        :meth:`_forward_contained` can retry or bisect safely."""
         B = self.max_batch
         tokens = np.zeros((B,), np.int32)
         lens = np.zeros((B,), np.int32)
         active = np.zeros((B,), bool)
         tables = np.zeros((B, self.n_pages_max), np.int32)
-        for rs in live:
+        for rs in rows:
             b = rs.slot
             tokens[b] = rs.pending_token
             lens[b] = rs.kv_len
             active[b] = True
             tables[b] = self.bm.padded_table(rs.req.request_id,
                                              self.n_pages_max)
-        self._pools, logits = self._decode_fn(
-            self.params, self._pools, jnp.asarray(tables),
-            jnp.asarray(lens), jnp.asarray(tokens), jnp.asarray(active))
+        pools, logits = self._device_call(
+            "paged_decode", tuple(r.req.request_id for r in rows),
+            self._decode_fn, self.params, self._pools,
+            jnp.asarray(tables), jnp.asarray(lens), jnp.asarray(tokens),
+            jnp.asarray(active))
+        logits_np = np.asarray(logits)  # sync BEFORE committing pools
+        self._pools = pools
         self.metrics.decode_steps += 1
 
-        logits_np = np.asarray(logits)
-        finished = []
-        for rs in live:
+        for rs in rows:
+            if rs.status is not Status.RUNNING:
+                continue  # aborted mid-loop by a slot-mate's callback
             rs.kv_len += 1
             rs.pending_token = None
-            token = self._choose_token(rs, logits_np[rs.slot])
-            out = self._commit_token(rs, token)
+            try:
+                token = self._choose_token(rs, logits_np[rs.slot])
+                out = self._commit_token(rs, token)
+            except _FATAL:
+                raise
+            except Exception as e:
+                finished.append(self._quarantine(rs, f"commit: {e!r}"))
+                continue
             if out is not None:
                 finished.append(out)
-        return finished
 
     # -- speculative rounds ----------------------------------------------
 
@@ -767,8 +1146,18 @@ class ServeEngine:
         one paged multi-token verify scores all rows at their own
         lengths, accepts apply per row, the closing token is consumed by
         a regular paged step — `speculative._generate_batched` re-hosted
-        on the paged cache with per-request retirement."""
+        on the paged cache with per-request retirement.
+
+        Containment: capacity growth quarantines per request (like plain
+        decode); a device failure anywhere in the round bails out via
+        :meth:`_spec_bailout` — the round's device calls are too
+        entangled across rows (shared draft state, one verify, one
+        closing decode) for mid-round bisection, so the engine commits
+        whatever tokens the round had already proven, latches
+        speculation OFF, and degrades to plain decode, which has full
+        retry/bisect containment."""
         sd = self._draft_state
+        finished: list[RequestOutput] = []
         live = [r for r in running if r.status is Status.RUNNING]
         top = max(r.kv_len for r in live)
         k = min(self.spec_k, self.gen.max_seq - 1 - top,
@@ -782,11 +1171,18 @@ class ServeEngine:
                 # read by an emission-eligible query.  Without the cap a
                 # request that submit() admitted could demand blocks it
                 # can never use and crash/preempt near its end.
-                self._ensure_capacity(
-                    rs, min(rs.kv_len + max(k, 0) + 1, rs.total_tokens))
+                try:
+                    self._ensure_capacity(
+                        rs, min(rs.kv_len + max(k, 0) + 1,
+                                rs.total_tokens))
+                except _FATAL:
+                    raise
+                except Exception as e:
+                    finished.append(self._quarantine(
+                        rs, f"kv grow (spec round): {e!r}"))
         live = [r for r in live if r.status is Status.RUNNING]
         if not live:
-            return []
+            return finished
 
         B = self.max_batch
         lens = np.zeros((B,), np.int32)
@@ -800,65 +1196,131 @@ class ServeEngine:
         lens_d = jnp.asarray(lens)
         active_d = jnp.asarray(active)
         tables_d = jnp.asarray(tables)
+        rids = tuple(r.req.request_id for r in live)
         # Draft lengths track the target's committed lengths.
         sd = GenerationState(caches=sd.caches, kv_lens=lens_d,
                              last_logits=sd.last_logits)
 
-        if k <= 0:
-            # No headroom to speculate (the last cache slots): one plain
-            # greedy token via the accept machinery's fallback.
-            toks_np = np.argmax(np.asarray(self._last_logits), axis=-1)
-            closing = jnp.asarray(toks_np.astype(np.int32))
-            emitted = {rs.slot: [int(toks_np[rs.slot])] for rs in live}
-        else:
-            props = []
-            for _ in range(k):
-                tok = jnp.argmax(sd.last_logits, axis=-1).astype(jnp.int32)
-                sd = self.draft.step(self.draft_params, sd, tok,
-                                     active=active_d)
-                props.append(tok)
-            proposals = jnp.stack(props, axis=1)            # [B, k]
-            self._pools, logits_all = self._verify_fn(
-                self.params, self._pools, tables_d, lens_d, proposals,
-                active_d)
-            m_dev, toks = greedy_accept_chain_batched(
-                proposals, self._last_logits, logits_all)
-            m_np, toks_np = jax.device_get((m_dev, toks))
-            emitted = {}
-            closing_np = np.zeros((B,), np.int32)
-            for rs in live:
-                b = rs.slot
-                m_used = min(int(m_np[b]), rs.remaining_new - 1)
-                emitted[b] = [int(t) for t in toks_np[b, :m_used + 1]]
-                closing_np[b] = toks_np[b, m_used]
-                rs.kv_len += m_used
-                lens[b] = rs.kv_len
-            closing = jnp.asarray(closing_np)
-            lens_d = jnp.asarray(lens)
-            # Draft rolls back to the per-row accepted lengths too.
-            sd = GenerationState(caches=sd.caches, kv_lens=lens_d,
-                                 last_logits=sd.last_logits)
+        # Phase 1 — propose + verify + accept.  Engine-state mutation
+        # (kv_len, emitted) happens only after the device_get sync, so a
+        # failure anywhere here leaves every row exactly as the round
+        # found it: the bailout emits one plain greedy token per row
+        # from the round-opening logits (what a verify would have
+        # emitted first anyway — streams stay bit-exact).
+        try:
+            if k <= 0:
+                # No headroom to speculate (the last cache slots): one
+                # plain greedy token via the accept machinery's fallback.
+                toks_np = np.argmax(np.asarray(self._last_logits),
+                                    axis=-1)
+                closing = jnp.asarray(toks_np.astype(np.int32))
+                emitted = {rs.slot: [int(toks_np[rs.slot])]
+                           for rs in live}
+            else:
+                props = []
+                for _ in range(k):
+                    tok = jnp.argmax(sd.last_logits,
+                                     axis=-1).astype(jnp.int32)
+                    sd = self._device_call(
+                        "draft_step", rids, self.draft.step,
+                        self.draft_params, sd, tok, active=active_d)
+                    props.append(tok)
+                proposals = jnp.stack(props, axis=1)        # [B, k]
+                self._pools, logits_all = self._device_call(
+                    "paged_verify", rids, self._verify_fn, self.params,
+                    self._pools, tables_d, lens_d, proposals, active_d)
+                m_dev, toks = greedy_accept_chain_batched(
+                    proposals, self._last_logits, logits_all)
+                m_np, toks_np = jax.device_get((m_dev, toks))
+                emitted = {}
+                closing_np = np.zeros((B,), np.int32)
+                for rs in live:
+                    b = rs.slot
+                    m_used = min(int(m_np[b]), rs.remaining_new - 1)
+                    emitted[b] = [int(t) for t in toks_np[b, :m_used + 1]]
+                    closing_np[b] = toks_np[b, m_used]
+                    rs.kv_len += m_used
+                    lens[b] = rs.kv_len
+                closing = jnp.asarray(closing_np)
+                lens_d = jnp.asarray(lens)
+                # Draft rolls back to the per-row accepted lengths too.
+                sd = GenerationState(caches=sd.caches, kv_lens=lens_d,
+                                     last_logits=sd.last_logits)
+        except _FATAL:
+            raise
+        except Exception as e:
+            if not self._state_intact():
+                raise  # donated pools consumed: engine-fatal
+            return finished + self._spec_bailout(live, None, e)
         self.metrics.verify_rounds += 1
 
-        # Consume each row's closing token: one paged decode step (also
-        # refreshes last_logits for the next round) + the draft's step.
-        self._pools, logits = self._decode_fn(
-            self.params, self._pools, tables_d, lens_d, closing, active_d)
-        self.metrics.decode_steps += 1
-        self._last_logits = logits
-        sd = self.draft.step(self.draft_params, sd, closing,
-                             active=active_d)
-        self._draft_state = sd
+        # Phase 2 — consume each row's closing token: one paged decode
+        # step (also refreshes last_logits for the next round) + the
+        # draft's step.  On failure the accepted chains are already
+        # proven: the bailout commits them, the closing token stays
+        # pending, and the next plain decode writes its K/V (an
+        # idempotent overwrite when this decode had already landed it).
+        try:
+            self._pools, logits = self._device_call(
+                "paged_decode", rids, self._decode_fn, self.params,
+                self._pools, tables_d, lens_d, closing, active_d)
+            self.metrics.decode_steps += 1
+            self._last_logits = logits
+            sd = self._device_call("draft_step", rids, self.draft.step,
+                                   self.draft_params, sd, closing,
+                                   active=active_d)
+            self._draft_state = sd
+        except _FATAL:
+            raise
+        except Exception as e:
+            if not self._state_intact():
+                raise  # donated pools consumed: engine-fatal
+            return finished + self._spec_bailout(live, emitted, e)
 
-        finished = []
         for rs in sorted(live, key=lambda r: r.seq):
+            if rs.status is not Status.RUNNING:
+                continue  # aborted mid-loop by a slot-mate's callback
             rs.kv_len += 1
             out = None
             for t in emitted[rs.slot]:
                 out = self._commit_token(rs, t)
-                if out is not None:
+                if out is not None or rs.status is not Status.RUNNING:
                     break  # retired mid-round; rest of the chain dropped
             rs.pending_token = None  # spec mode: cache already consumed it
+            if out is not None:
+                finished.append(out)
+        return finished
+
+    def _spec_bailout(self, live: list[ReqState], emitted, err
+                      ) -> list[RequestOutput]:
+        """A speculative round failed mid-flight: latch speculation OFF
+        (the shared draft state can no longer be trusted) and convert
+        the live rows to plain-decode state — commit the tokens the
+        round had already proven (the accepted chains when the verify
+        completed, else one greedy token from the round-opening
+        logits), leaving each row's last token PENDING so the next
+        plain step writes its K/V.  From here the engine serves through
+        :meth:`_decode_once` (full retry/bisect containment) and
+        joining prompts take the plain prefill path; emitted streams
+        stay bit-exact with the fault-free run."""
+        self._spec_off = True
+        self.metrics.spec_bailouts += 1
+        print(f"[serve] speculative round failed ({err!r}); speculation "
+              f"latched off, serving degrades to plain decode",
+              file=sys.stderr)
+        finished = []
+        last_np = (np.argmax(np.asarray(self._last_logits), axis=-1)
+                   if emitted is None else None)
+        for rs in sorted(live, key=lambda r: r.seq):
+            if rs.status is not Status.RUNNING:
+                continue
+            chain = (emitted[rs.slot] if emitted is not None
+                     else [int(last_np[rs.slot])])
+            out = None
+            for t in chain:
+                out = self._commit_token(rs, t)
+                if out is not None or rs.status is not Status.RUNNING:
+                    break
             if out is not None:
                 finished.append(out)
         return finished

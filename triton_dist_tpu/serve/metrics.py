@@ -91,6 +91,20 @@ class ServeMetrics:
     prefill_tokens: int = 0
     preemptions: int = 0
     completed: int = 0
+    # failure-containment counters (docs/serving.md "Failure
+    # containment"): every non-healthy retirement and every recovery
+    # action is a counter, so overload and poison traffic are visible
+    # in the same summary as latency.
+    shed: int = 0                 # submit() rejections (queue at bound)
+    deadline_expired: int = 0     # WAITING/PREFILL TTL sweeps
+    quarantined: int = 0          # requests retired FinishReason.ERROR
+    callback_errors: int = 0      # on_token raised; callback disabled
+    forward_retries: int = 0      # batched-forward retry attempts
+    forward_bisections: int = 0   # batch splits isolating a poison row
+    watchdog_trips: int = 0       # step watchdog timeouts (re-raised)
+    spec_bailouts: int = 0        # speculative rounds latched off
+    # retirements by FinishReason.value
+    finish_reasons: dict = field(default_factory=dict)
     # compilation observability: CountingJit wrappers the engine
     # registers (runtime/jit_cache.py) + warmup accounting
     compiled_fns: list = field(default_factory=list, repr=False)
@@ -110,9 +124,27 @@ class ServeMetrics:
         self.running.append(running)
         self.kv_utilization.append(kv_utilization)
 
-    def observe_finish(self, request_id: str, rm: RequestMetrics) -> None:
+    def observe_finish(self, request_id: str, rm: RequestMetrics,
+                       reason=None) -> None:
         self.completed += 1
         self.requests[request_id] = rm
+        if reason is not None:
+            key = getattr(reason, "value", str(reason))
+            self.finish_reasons[key] = self.finish_reasons.get(key, 0) + 1
+
+    def failure_stats(self) -> dict:
+        """The containment counters as one dict (summary()["failures"])."""
+        return {
+            "shed": self.shed,
+            "deadline_expired": self.deadline_expired,
+            "quarantined": self.quarantined,
+            "callback_errors": self.callback_errors,
+            "forward_retries": self.forward_retries,
+            "forward_bisections": self.forward_bisections,
+            "watchdog_trips": self.watchdog_trips,
+            "spec_bailouts": self.spec_bailouts,
+            "finish_reasons": dict(self.finish_reasons),
+        }
 
     # -- compilation observability ---------------------------------------
 
@@ -168,6 +200,7 @@ class ServeMetrics:
             "mean_ttft": sum(ttfts) / len(ttfts) if ttfts else None,
             "max_ttft": max(ttfts, default=None) if ttfts else None,
             "mean_itl": sum(itls) / len(itls) if itls else None,
+            "failures": self.failure_stats(),
             "compilation": self.compile_stats(),
             "requests": {rid: m.to_dict()
                          for rid, m in self.requests.items()},

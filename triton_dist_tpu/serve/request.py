@@ -17,9 +17,12 @@ import numpy as np
 
 
 class FinishReason(enum.Enum):
-    LENGTH = "length"  # hit max_new_tokens
-    EOS = "eos"        # emitted params.eos_id (included in the output)
-    ABORT = "abort"    # cancelled by the caller
+    LENGTH = "length"      # hit max_new_tokens
+    EOS = "eos"            # emitted params.eos_id (included in the output)
+    ABORT = "abort"        # cancelled by the caller
+    DEADLINE = "deadline"  # params.deadline_s passed before decode began
+    SHED = "shed"          # rejected at submit(): waiting queue at bound
+    ERROR = "error"        # quarantined (RequestOutput.error says why)
 
 
 @dataclass(frozen=True)
@@ -31,6 +34,12 @@ class SamplingParams:
     temperature → top-k → top-p filtered distribution with a per-request
     PRNG stream (``seed``), folded per emitted token — so a preempted and
     recomputed request keeps drawing the SAME stream where it left off.
+
+    ``deadline_s`` is a TTL against the engine clock: a request still
+    WAITING or mid-PREFILL ``deadline_s`` seconds after arrival is swept
+    and retired with ``FinishReason.DEADLINE`` (once decoding, it runs
+    to completion — abandoning in-flight work would waste the prefill it
+    already paid for).
     """
 
     max_new_tokens: int = 16
@@ -39,6 +48,7 @@ class SamplingParams:
     top_p: Optional[float] = None
     eos_id: Optional[int] = None
     seed: int = 0
+    deadline_s: Optional[float] = None
 
     def __post_init__(self):
         if self.max_new_tokens < 1:
@@ -47,6 +57,9 @@ class SamplingParams:
         if self.temperature < 0:
             raise ValueError(
                 f"temperature must be >= 0, got {self.temperature}")
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0, got {self.deadline_s}")
 
     @property
     def greedy(self) -> bool:
@@ -76,13 +89,18 @@ class Request:
 
 @dataclass
 class RequestOutput:
-    """The engine's answer: emitted tokens + why it stopped + latencies."""
+    """The engine's answer: emitted tokens + why it stopped + latencies.
+
+    ``error`` carries the failure string for quarantined (``ERROR``),
+    shed (``SHED``) and expired (``DEADLINE``) retirements; ``None`` on
+    the healthy finish reasons."""
 
     request_id: str
     prompt: np.ndarray
     token_ids: list[int]
     finish_reason: FinishReason
     metrics: "RequestMetrics"  # serve/metrics.py (quoted: no import cycle)
+    error: Optional[str] = None
 
     @property
     def n_generated(self) -> int:

@@ -56,6 +56,15 @@ class ReqState:
     # [1, Hkv, s_ext, D] the prompt streams into before the page scatter
     scratch: Optional[list] = None
     s_ext: int = 0
+    # failure containment (engine-owned): a request whose on_token
+    # callback raised keeps serving with the callback off (logged once)
+    callback_disabled: bool = False
+
+    def expired(self, now: float) -> bool:
+        """Past its deadline TTL (``params.deadline_s`` from arrival)."""
+        d = self.req.params.deadline_s
+        return (d is not None and self.req.arrival_time is not None
+                and now - self.req.arrival_time > d)
 
     @property
     def prompt_tokens(self) -> np.ndarray:
@@ -99,6 +108,16 @@ class FCFSScheduler:
     @property
     def queue_depth(self) -> int:
         return len(self.waiting)
+
+    def pop_expired(self, now: float) -> list[ReqState]:
+        """Drop WAITING requests whose deadline TTL has passed (the
+        engine retires them with ``FinishReason.DEADLINE``).  Swept
+        every iteration BEFORE admission, so an expired head of line
+        frees its queue position for live requests behind it."""
+        expired = [rs for rs in self.waiting if rs.expired(now)]
+        for rs in expired:
+            self.waiting.remove(rs)
+        return expired
 
     # -- admission --------------------------------------------------------
 
