@@ -390,12 +390,33 @@ def _bench_ag_gemm_tflops():
     return tflops, False
 
 
+def _bench_serve_engine():
+    """Serving-engine decode throughput at decode horizon H=8 vs H=1
+    (scripts/bench_serve.py — the PAIRED-quotient protocol again: both
+    configurations drive the identical warmed workload, so host/tunnel
+    drift cancels in the speedup ratio while `serve_toks_per_s` carries
+    the absolute H=8 number).  A tiny world-1 model: the field measures
+    the ENGINE's dispatch economics (per-token host round trips vs fused
+    horizons + async pipelining), not model FLOPS — the kernel-side
+    decode cost is already `decode_step_us`.
+
+    Returns (h8_decode_toks_per_s, h8_vs_h1_speedup)."""
+    from scripts.bench_serve import bench_engine
+
+    r1 = bench_engine(1, batch=4, prompt_len=16, new_tokens=48, dim=32)
+    r8 = bench_engine(8, batch=4, prompt_len=16, new_tokens=48, dim=32)
+    speedup = (r8["decode_toks_per_s"] / r1["decode_toks_per_s"]
+               if r1["decode_toks_per_s"] > 0 else 0.0)
+    return r8["decode_toks_per_s"], speedup
+
+
 def main():
     sentinel_tflops, contended = _bench_contention_sentinel()
     tflops, ag_suspect = _bench_ag_gemm_tflops()
     moe_a2a_us, a2a_suspect = _bench_moe_a2a_us()
     decode_us, decode_ratio = _bench_decode_us()
     ring_ratio = _bench_ring_vs_dense()
+    serve_tps, serve_speedup = _bench_serve_engine()
 
     peak = peak_bf16_tflops()
     vs = (tflops / peak) / REF_UTILIZATION if peak else 0.0
@@ -420,6 +441,12 @@ def main():
         # the paired quotient's session spread measured ~±0.05.
         "ring_vs_dense_ratio": round(ring_ratio, 3),
         "decode_vs_xla_ratio": round(decode_ratio, 3),
+        # Serving-engine decode throughput (tiny world-1 model, warmed):
+        # tokens/s at decode horizon H=8 with async pipelining, and the
+        # paired H=8 / H=1 speedup — the dispatch-economics field the
+        # decode horizon exists to move (scripts/bench_serve.py).
+        "serve_toks_per_s": round(serve_tps, 1),
+        "serve_horizon_speedup": round(serve_speedup, 2),
         # Known-cost reference op (bare XLA dot, measured ceiling 189.7):
         # a depressed sentinel means the HOST was contended during this
         # session and `value` is a lower bound, not a regression.
@@ -438,6 +465,7 @@ def main():
           f"{tflops / peak:.1%}, shape M={M} K={K} N/chip={N_PER_CHIP}; "
           f"moe_a2a floor {moe_a2a_us:.2f} us; decode {decode_us:.1f} us; "
           f"ring/dense {ring_ratio:.3f}; decode/xla {decode_ratio:.3f}; "
+          f"serve {serve_tps:.0f} tok/s (H8/H1 {serve_speedup:.2f}x); "
           f"sentinel dot {sentinel_tflops:.1f} TFLOPS"
           + (" (CONTENDED)" if contended else ""),
           file=sys.stderr)

@@ -75,6 +75,17 @@ def parse_args():
                    help="engine mode: engine.warmup() before traffic "
                         "(pre-compiles the bucket ladder; steady-state "
                         "serving then never compiles)")
+    p.add_argument("--horizon", type=int, default=1, metavar="H",
+                   help="engine mode: fuse up to H decode steps into one "
+                        "device dispatch with on-device sampling (the "
+                        "decode horizon, docs/serving.md — streams stay "
+                        "bit-identical to H=1; watch dispatches/token "
+                        "drop in the decode stats line)")
+    p.add_argument("--pipeline", type=int, default=2, metavar="N",
+                   help="engine mode: chain N horizon dispatches with a "
+                        "device-resident carry so the host commits "
+                        "horizon k's tokens while the device runs "
+                        "horizon k+1 (only engages at --horizon > 1)")
     p.add_argument("--stagger", type=int, default=2,
                    help="engine mode: submit a new request every "
                         "S engine steps")
@@ -163,11 +174,13 @@ def run_engine(args, key):
     engine = ServeEngine(
         gen, params, num_blocks=num_blocks, page_size=page,
         max_batch=args.max_batch, prefill_chunk=max(8, page),
+        horizon=args.horizon, pipeline=args.pipeline,
         draft=draft, draft_params=d_params,
         spec_k=args.speculative or 0,
         faults=faults, max_queue=max_queue, fault_retries=1)
     dist_print(f"engine: {args.requests} requests, pool {num_blocks} "
                f"blocks x{page} tokens, batch {args.max_batch}"
+               f"{f', horizon {args.horizon} (pipeline {args.pipeline})' if args.horizon > 1 else ''}"
                f"{f', speculative k={args.speculative}' if args.speculative else ''}"
                f"{f', chaos seed {args.seed}' if args.chaos else ''}"
                f"{f', max_queue {max_queue}' if max_queue is not None else ''}")
@@ -236,6 +249,12 @@ def run_engine(args, key):
                f"{s['max_queue_depth']}, peak kv util "
                f"{s['peak_kv_utilization']:.2f}, preemptions "
                f"{s['preemptions']}")
+    d = s["decode"]
+    dist_print(f"decode horizon: {d['dispatches']} dispatches / "
+               f"{d['host_syncs']} host syncs for {d['decode_tokens']} "
+               f"tokens ({d['decode_steps']} device steps) — "
+               f"{d['tokens_per_dispatch']:.2f} tokens/dispatch, "
+               f"{d['dispatches_per_token']:.3f} dispatches/token")
     if args.chaos or args.deadline or max_queue is not None:
         f = s["failures"]
         dist_print(f"failure containment: {f['shed']} shed, "

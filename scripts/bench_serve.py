@@ -1,0 +1,131 @@
+"""Engine-level serving throughput: decode tokens/s and dispatches/token
+at decode horizons H in {1, 8} (or ``--horizons``).
+
+The decode horizon (docs/serving.md) removes the per-token dispatch +
+sync + host-sample tax from the serving engine's decode loop; this
+benchmark measures exactly that tax.  Each configuration drives the SAME
+steady decode-only workload — ``--batch`` greedy requests submitted up
+front, all slots busy, no admission churn — through a warmed engine, so
+the wall-clock difference between H=1 and H=8 is dispatch economics, not
+compilation or scheduling noise.  ``dispatches/token`` comes from the
+``ServeMetrics.summary()["decode"]`` counters: ~1/batch at H=1 (one
+dispatch per step, a token per active row) and ~1/(batch·H) fused — the
+batch amortizes rows either way; the horizon's contribution is the
+/H.
+
+Emitted streams are bit-identical across horizons (the engine's oracle
+tests pin this), so the configurations are directly comparable.
+
+Runs anywhere (TPU or CPU):
+
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+      python scripts/bench_serve.py --batch 4 --new-tokens 64
+
+Prints one JSON line per horizon plus a summary; ``bench.py`` embeds the
+H=8 decode tokens/s as ``serve_toks_per_s`` in the driver artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+
+def bench_engine(horizon: int, *, batch: int = 4, prompt_len: int = 16,
+                 new_tokens: int = 64, pipeline: int = 2, dim: int = 64,
+                 n_layers: int = 2, vocab: int = 256, page_size: int = 16,
+                 seed: int = 0, warmup: bool = True) -> dict:
+    """One configuration: a warmed engine drains a steady decode-only
+    batch; returns wall time, decode tokens/s, and the dispatch counters.
+    A fresh engine per call — the trace caches must not leak between
+    horizon configurations."""
+    from triton_dist_tpu.models import llama
+    from triton_dist_tpu.models.generate import Generator
+    from triton_dist_tpu.serve import Request, SamplingParams, ServeEngine
+
+    max_seq = prompt_len + new_tokens
+    max_seq += (-max_seq) % page_size
+    cfg = llama.LlamaConfig(vocab=vocab, dim=dim, n_layers=n_layers,
+                            n_heads=2, n_kv_heads=2, ffn_dim=2 * dim,
+                            max_seq=max_seq, dtype=jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    params = llama.init_params(cfg, jax.random.key(seed))
+    gen = Generator(cfg, mesh, axis="sp", max_seq=max_seq)
+    per_req = -(-max_seq // page_size)
+    eng = ServeEngine(gen, params, num_blocks=1 + per_req * batch,
+                      page_size=page_size, max_batch=batch,
+                      prefill_chunk=max(8, page_size), horizon=horizon,
+                      pipeline=pipeline)
+    if warmup:
+        eng.warmup()
+    rng = np.random.default_rng(seed)
+    for i in range(batch):
+        eng.submit(Request(
+            f"b{i}", rng.integers(0, vocab, size=prompt_len)
+            .astype(np.int32), SamplingParams(max_new_tokens=new_tokens)))
+    t0 = time.perf_counter()
+    outs = eng.run()
+    dt = time.perf_counter() - t0
+    assert all(len(o.token_ids) == new_tokens for o in outs.values())
+    d = eng.metrics.summary()["decode"]
+    return {
+        "horizon": horizon,
+        "pipeline": pipeline if horizon > 1 else 1,
+        "batch": batch,
+        "new_tokens": new_tokens,
+        "wall_s": round(dt, 4),
+        "decode_tokens": d["decode_tokens"],
+        "decode_toks_per_s": round(d["decode_tokens"] / dt, 1),
+        "dispatches": d["dispatches"],
+        "host_syncs": d["host_syncs"],
+        "tokens_per_dispatch": round(d["tokens_per_dispatch"], 3),
+        "dispatches_per_token": round(d["dispatches_per_token"], 4),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--horizons", default="1,8",
+                   help="comma-separated decode horizons to compare")
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--prompt-len", type=int, default=16)
+    p.add_argument("--new-tokens", type=int, default=64)
+    p.add_argument("--pipeline", type=int, default=2)
+    p.add_argument("--dim", type=int, default=64)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--no-warmup", action="store_true")
+    args = p.parse_args()
+    results = {}
+    for h in (int(x) for x in args.horizons.split(",")):
+        r = bench_engine(h, batch=args.batch, prompt_len=args.prompt_len,
+                         new_tokens=args.new_tokens,
+                         pipeline=args.pipeline, dim=args.dim,
+                         n_layers=args.layers, page_size=args.page_size,
+                         seed=args.seed, warmup=not args.no_warmup)
+        results[f"h{h}"] = r
+        print(json.dumps(r))
+    hs = sorted(results, key=lambda k: results[k]["horizon"])
+    if len(hs) >= 2:
+        lo, hi = results[hs[0]], results[hs[-1]]
+        print(f"# H={hi['horizon']} vs H={lo['horizon']}: "
+              f"{hi['decode_toks_per_s']:.1f} vs "
+              f"{lo['decode_toks_per_s']:.1f} decode tokens/s "
+              f"({hi['decode_toks_per_s'] / max(lo['decode_toks_per_s'], 1e-9):.2f}x), "
+              f"dispatches/token {hi['dispatches_per_token']:.3f} vs "
+              f"{lo['dispatches_per_token']:.3f}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -103,6 +103,11 @@ _FAST_GATE_MODULES = {
     # gate every containment path; the randomized soak and speculative
     # bailout carry explicit @pytest.mark.slow.
     "test_serve_faults",
+    # decode horizon: the H in {1, 4, 16} greedy oracle, host-vs-device
+    # sampler equality, dispatch-economics bound, and horizon-granular
+    # fault containment gate the fused decode path; preemption/spec
+    # interactions and the wall-clock bench carry @pytest.mark.slow.
+    "test_serve_horizon",
 }
 
 # Heavy tests inside core modules whose coverage is duplicated by a

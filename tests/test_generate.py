@@ -193,3 +193,47 @@ def test_windowed_capped_model_e2e(key):
         np.testing.assert_allclose(np.asarray(st4b.last_logits),
                                    np.asarray(st_ref4.last_logits),
                                    rtol=2e-3, atol=2e-3)
+
+
+def test_generate_onchip_matches_generate(mesh2, key):
+    """Device-resident decode (ONE traced scan, on-device sampling) must
+    emit exactly what the host loop emits: greedy, sampled (same key →
+    same split-per-step stream), and eos-latched rows alike."""
+    from triton_dist_tpu.models.sampling import make_sampler
+
+    cfg = LlamaConfig(vocab=64, dim=32, n_layers=1, n_heads=4,
+                      n_kv_heads=2, ffn_dim=64, max_seq=32,
+                      dtype=jnp.float32)
+    params = init_params(cfg, key)
+    gen = Generator(cfg, mesh2, axis="tp", max_seq=32, impl="xla",
+                    interpret=True)
+    prompt = jax.random.randint(key, (2, 5), 0, cfg.vocab, jnp.int32)
+    st = gen.prefill(params, prompt)
+
+    ref, _ = gen.generate(params, st, 8)
+    on, st_on = gen.generate_onchip(params, st, 8)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(on))
+    assert np.asarray(st_on.kv_lens).tolist() == [13, 13]
+
+    skey = jax.random.fold_in(key, 1)
+    sampler = make_sampler(temperature=0.8, top_k=16, top_p=0.95)
+    sref, _ = gen.generate(params, st, 8, sample=sampler, key=skey)
+    son, _ = gen.generate_onchip(params, st, 8, temperature=0.8,
+                                 top_k=16, top_p=0.95, key=skey)
+    np.testing.assert_array_equal(np.asarray(sref), np.asarray(son))
+    # key with DEFAULT knobs must match generate's default sampler
+    # (sample_logits at temperature 1.0), not silently decode greedy
+    dref, _ = gen.generate(params, st, 8, key=skey)
+    don, _ = gen.generate_onchip(params, st, 8, key=skey)
+    np.testing.assert_array_equal(np.asarray(dref), np.asarray(don))
+
+    eos = int(np.asarray(ref)[0, 2])          # fires mid-stream for row 0
+    eref, _ = gen.generate(params, st, 8, eos_id=eos)
+    eon, _ = gen.generate_onchip(params, st, 8, eos_id=eos)
+    np.testing.assert_array_equal(np.asarray(eref), np.asarray(eon))
+
+    with pytest.raises(ValueError, match="overflow"):
+        gen.generate_onchip(params, st, 64)
+    # one compiled scan per (n_new, sampler knobs) signature — eos rides
+    # the greedy program as a traced argument, not a new trace
+    assert len(gen._onchip_cache) == 3

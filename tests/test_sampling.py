@@ -103,3 +103,64 @@ def test_zero_temperature_guard(key):
     tok = sample_logits(logits, key, temperature=0.0)  # greedy path still OK
     np.testing.assert_array_equal(np.asarray(tok),
                                   np.asarray(jnp.argmax(logits, -1)))
+
+
+def test_rowwise_sampler_matches_host_path(key):
+    """THE host/device dedup pin (serve engine): for every row,
+    `sample_logits_rowwise` (the traced per-row sampler the decode
+    horizon runs on device) must emit the SAME token as the scalar
+    `sample_logits` host fallback with that row's knobs and key — across
+    greedy, plain-temperature, top-k, top-p, and filters-off rows in one
+    mixed batch, under the engine's fold_in(key(seed), emission) stream."""
+    from triton_dist_tpu.models.sampling import sample_logits_rowwise
+
+    logits = _logits(key, B=6, V=48)
+    seeds = jnp.array([3, 11, 11, 7, 5, 9], jnp.int32)
+    counts = jnp.array([0, 4, 9, 2, 0, 31], jnp.int32)
+    temps = jnp.array([1.0, 0.8, 1.5, 0.5, 1.0, 0.9], jnp.float32)
+    top_ks = jnp.array([0, 16, 5, 0, 0, 48], jnp.int32)     # 48 = off (=V)
+    top_ps = jnp.array([1.0, 0.9, 1.0, 0.6, 1.0, 0.95], jnp.float32)
+    greedy = jnp.array([True, False, False, False, False, False])
+
+    keys = jax.vmap(jax.random.fold_in)(jax.vmap(jax.random.key)(seeds),
+                                        counts)
+    dev = jax.jit(lambda lo, ks: sample_logits_rowwise(
+        lo, ks, temperature=temps, top_k=top_ks, top_p=top_ps,
+        greedy=greedy))(logits, keys)
+    for b in range(6):
+        if bool(greedy[b]):
+            want = int(np.argmax(np.asarray(logits[b])))
+        else:
+            k_host = jax.random.fold_in(jax.random.key(int(seeds[b])),
+                                        int(counts[b]))
+            tk = int(top_ks[b]) or None
+            tp = float(top_ps[b])
+            want = int(sample_logits(
+                logits[b:b + 1], k_host, temperature=float(temps[b]),
+                top_k=tk, top_p=tp if tp < 1.0 else None)[0])
+        assert int(dev[b]) == want, f"row {b}: device {int(dev[b])} != host {want}"
+
+
+def test_rowwise_sampler_filters_respected(key):
+    """Rowwise top-k/top-p draws stay inside their row's allowed set."""
+    from triton_dist_tpu.models.sampling import sample_logits_rowwise
+
+    logits = _logits(key, B=2, V=32)
+    allowed = set(int(i) for i in np.argsort(np.asarray(logits[0]))[-4:])
+    temps = jnp.array([1.5, 1.5], jnp.float32)
+    top_ks = jnp.array([4, 0], jnp.int32)
+    top_ps = jnp.array([1.0, 0.5], jnp.float32)
+    greedy = jnp.zeros((2,), bool)
+    probs = np.asarray(jax.nn.softmax(logits[1] / 1.5))
+    order = np.argsort(-probs)
+    nucleus = set(order[:int(np.searchsorted(np.cumsum(probs[order]),
+                                             0.5) + 1)].tolist())
+    for i in range(40):
+        keys = jax.vmap(jax.random.fold_in)(
+            jax.vmap(jax.random.key)(jnp.array([i, i], jnp.int32)),
+            jnp.array([0, 0], jnp.int32))
+        tok = sample_logits_rowwise(logits, keys, temperature=temps,
+                                    top_k=top_ks, top_p=top_ps,
+                                    greedy=greedy)
+        assert int(tok[0]) in allowed
+        assert int(tok[1]) in nucleus

@@ -90,3 +90,19 @@ def test_serve_engine_mixed_warmup():
     compiles = sum(int(c) for c in
                    re.findall(r"\w+ (\d+)c/\d+h", out))
     assert compiles == warm, out
+
+
+def test_serve_engine_horizon():
+    """--horizon: fused multi-step decode through the CLI — the decode
+    stats line proves the dispatch economics (well under one dispatch
+    per token), and every request still retires with its full stream."""
+    out = _run("--engine", "--horizon", "8", "--pipeline", "2",
+               "--requests", "4", "--stagger", "1", "--max-batch", "4",
+               "--page-size", "8", devices=1, new_tokens=12)
+    assert "horizon 8 (pipeline 2)" in out, out
+    assert "engine: 48 tokens / 4 requests" in out, out
+    import re
+    m = re.search(r"([\d.]+) dispatches/token", out)
+    assert m, out
+    assert float(m.group(1)) < 0.5, out
+    assert "done" in out

@@ -89,6 +89,9 @@ class Generator:
                               interpret=interpret),
             donate_argnums=(2,))
         self._step_jit = jax.jit(self._step_impl)
+        # generate_onchip programs, keyed by (n_new, sampled, knobs) —
+        # one compiled scan per distinct call signature.
+        self._onchip_cache: dict = {}
 
     # -- prefill ----------------------------------------------------------
 
@@ -246,6 +249,80 @@ class Generator:
                            eos_id, jnp.int32)
             tokens = jnp.concatenate([tokens, pad], axis=1)
         return tokens, state
+
+    def generate_onchip(self, params, state: GenerationState, n_new: int,
+                        *, temperature: float = 1.0,
+                        top_k: int | None = None,
+                        top_p: float | None = None, key=None,
+                        eos_id: int | None = None):
+        """Device-resident decode: all ``n_new`` steps run as ONE traced
+        ``lax.scan`` with on-device token choice — the host dispatches
+        once and fetches a ``[B, n_new]`` buffer, instead of paying a
+        dispatch + logits sync + host argmax/sample round trip per token
+        (:meth:`generate`'s loop).  This is the single-model form of the
+        serving engine's decode horizon (docs/serving.md).
+
+        Emitted tokens are IDENTICAL to :meth:`generate` with the same
+        arguments: greedy (no ``key``) is per-step argmax; with ``key``
+        the scan splits it per step and draws through
+        ``sampling.sample_logits`` exactly like the host loop, so the
+        stream matches token for token — the sampler knobs default to
+        ``sample_logits``'s own defaults (temperature 1.0), matching
+        ``generate(key=k)``'s default sampler, and apply only when
+        ``key`` is given.  ``eos_id`` rows keep emitting
+        ``eos_id`` once they hit it — but the scan cannot break early, so
+        the returned state always reflects ``n_new`` steps (the host loop
+        stops stepping once every row is done; only the post-done cache
+        tail differs, never a token)."""
+        if not isinstance(state.kv_lens, jax.core.Tracer):
+            top = int(jnp.max(state.kv_lens))
+            if top + n_new > self.max_seq:
+                raise ValueError(
+                    f"generate_onchip({n_new}) from position {top} would "
+                    f"overflow max_seq={self.max_seq}")
+        sampled = key is not None
+        sig = (int(n_new), sampled, float(temperature), top_k, top_p)
+        fn = self._onchip_cache.get(sig)
+        if fn is None:
+            fn = self._build_onchip(int(n_new), sampled,
+                                    float(temperature), top_k, top_p)
+            self._onchip_cache[sig] = fn
+        if key is None:
+            key = jax.random.key(0)  # untraced-by-choice: greedy ignores it
+        caches, kv_lens, logits, toks = fn(
+            params, state.caches, state.kv_lens, state.last_logits, key,
+            jnp.int32(-1 if eos_id is None else eos_id))
+        return toks, GenerationState(caches=caches, kv_lens=kv_lens,
+                                     last_logits=logits)
+
+    def _build_onchip(self, n_new, sampled, temperature, top_k, top_p):
+        from triton_dist_tpu.models.sampling import sample_logits
+
+        def run(params, caches, kv_lens, last_logits, key, eos):
+            has_eos = eos >= 0
+
+            def step(carry, _):
+                caches, kv_lens, logits, key, done = carry
+                if sampled:
+                    key, sub = jax.random.split(key)
+                    token = sample_logits(logits, sub,
+                                          temperature=temperature,
+                                          top_k=top_k, top_p=top_p)
+                else:
+                    token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                token = jnp.where(done, eos, token)
+                done = done | (has_eos & (token == eos))
+                caches, kv_lens, logits = self._step_impl(
+                    params, caches, kv_lens, token, None)
+                return (caches, kv_lens, logits, key, done), token
+
+            done0 = jnp.zeros(kv_lens.shape, bool)
+            (caches, kv_lens, logits, _, _), toks = jax.lax.scan(
+                step, (caches, kv_lens, last_logits, key, done0), None,
+                length=n_new)
+            return caches, kv_lens, logits, toks.T
+
+        return jax.jit(run)
 
 
 def _token_forward(params, caches, token, pos, *, cfg: LlamaConfig,

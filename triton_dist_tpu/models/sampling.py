@@ -26,9 +26,14 @@ def _apply_top_k(logits, top_k: int):
     return jnp.where(logits < kth, NEG_INF, logits)
 
 
-def _apply_top_p(logits, top_p: float):
+def _apply_top_p(logits, top_p):
     """Nucleus filtering: keep the smallest prefix of the probability-sorted
-    vocab whose total mass reaches ``top_p`` (the top token always stays)."""
+    vocab whose total mass reaches ``top_p`` (the top token always stays).
+
+    ``top_p`` may be a python float (the static scalar path) or a
+    broadcastable ``[..., 1]`` array (the per-row traced path of
+    :func:`sample_logits_rowwise`) — the masking rule is THE one copy of
+    the nucleus math either way."""
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
@@ -92,6 +97,49 @@ def sample_logits(logits, key, *, temperature: float = 1.0,
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     x = _filtered_logits(logits, temperature, top_k, top_p)
     return jax.random.categorical(key, x, axis=-1).astype(jnp.int32)
+
+
+def sample_logits_rowwise(logits, keys, *, temperature, top_k, top_p,
+                          greedy) -> jax.Array:
+    """Fully-traceable PER-ROW sampler: every knob is a ``[B]`` array, so
+    one compiled program serves a batch mixing greedy and sampled requests
+    with different temperatures/filters — the sampler the serving engine's
+    device-resident decode horizon runs *inside* its fused multi-step scan
+    (`serve/engine.py`), where a host round trip per token is exactly what
+    it exists to avoid.
+
+    - ``logits`` [B, V] f32, ``keys`` [B] typed PRNG keys;
+    - ``temperature`` [B] f32 (> 0 for sampled rows; greedy rows ignore it),
+      ``top_k`` [B] int32 (0 disables), ``top_p`` [B] f32 (1.0 disables),
+      ``greedy`` [B] bool (argmax, no randomness consumed).
+
+    Row ``b``'s draw is BIT-IDENTICAL to the host fallback
+    ``sample_logits(logits[b:b+1], keys[b], temperature=t_b, ...)`` —
+    there is one copy of the filter math (temperature scale, the k-th
+    largest value cut, :func:`_apply_top_p`), and the per-row draw is the
+    same ``jax.random.categorical`` under ``vmap``
+    (tests/test_sampling.py pins the equality, so the engine's H=1 host
+    path and H>1 device path emit the same streams)."""
+    gr = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    V = logits.shape[-1]
+    # Greedy rows divide by a dummy 1.0 (their draw is discarded by the
+    # final select) — temperature 0 must never reach the division.
+    t = jnp.where(greedy, jnp.float32(1.0), temperature.astype(jnp.float32))
+    x = logits.astype(jnp.float32) / t[:, None]
+    # top-k: mask below the k-th largest VALUE per row (what lax.top_k
+    # gives the static path); rows with the filter off keep x untouched,
+    # exactly like the static path's skip.
+    k = jnp.clip(jnp.where(top_k > 0, top_k, V), 1, V)
+    srt = jnp.sort(x, axis=-1)[..., ::-1]
+    kth = jnp.take_along_axis(srt, (k - 1)[:, None], axis=-1)
+    x = jnp.where(((top_k > 0) & (top_k < V))[:, None],
+                  jnp.where(x < kth, NEG_INF, x), x)
+    x = jnp.where((top_p < 1.0)[:, None],
+                  _apply_top_p(x, top_p[:, None].astype(jnp.float32)), x)
+    drawn = jax.vmap(
+        lambda kk, row: jax.random.categorical(kk, row[None], axis=-1)[0]
+    )(keys, x).astype(jnp.int32)
+    return jnp.where(greedy, gr, drawn)
 
 
 def make_sampler(*, temperature: float = 1.0, top_k: int | None = None,

@@ -23,6 +23,41 @@ from typing import Callable, Optional
 import jax
 
 
+def pow2_ladder(cap: int) -> list[int]:
+    """Powers-of-two rungs ``[1, 2, 4, ...]`` closing at ``cap`` — the
+    generic bucket ladder for STATIC trace parameters.  The serving
+    engine keys its decode-horizon scan length to these rungs so a
+    horizon clamped mid-generation (a row near its max-token end) reuses
+    a compiled program instead of tracing one per residual length; the
+    page-aligned scratch-extent variant is
+    ``serve.engine.build_bucket_ladder``."""
+    if cap < 1:
+        raise ValueError(f"ladder cap must be >= 1, got {cap}")
+    rungs = []
+    r = 1
+    while r < cap:
+        rungs.append(r)
+        r *= 2
+    rungs.append(cap)
+    return rungs
+
+
+def bucket_down(ladder: list[int], value: int) -> int:
+    """Largest rung <= ``value`` (``ladder`` ascending, ``value >=
+    ladder[0]``).  Static trace parameters bucket DOWN, not up: a rung
+    above the need would run dead iterations that still pay full compute
+    (a scan step is a whole batched forward), while a rung below just
+    costs one more dispatch for the residual."""
+    if value < ladder[0]:
+        raise ValueError(f"value {value} below ladder base {ladder[0]}")
+    best = ladder[0]
+    for r in ladder:
+        if r > value:
+            break
+        best = r
+    return best
+
+
 def cache_stats() -> dict:
     """Hit/miss/size counters of the process-wide shard-jit memo cache
     (``functools.lru_cache`` on :func:`_build`).  A *miss* here means a

@@ -16,10 +16,11 @@ Layout:
 - ``scheduler``  — iteration-level FCFS admission + chunked-prefill token
   budget + LIFO preemption policy
 - ``engine``     — the step loop: deadline sweep → admit → prefill
-  chunks → one batched decode (or speculative verify round) per
-  iteration, with failure containment throughout (poison-request
-  quarantine, watchdog-guarded dispatches, heartbeat;
-  docs/serving.md "Failure containment")
+  chunks → one batched decode (a fused multi-step decode horizon with
+  on-device sampling when ``horizon > 1``, or a speculative verify
+  round) per iteration, with failure containment throughout
+  (poison-request quarantine, watchdog-guarded dispatches, heartbeat;
+  docs/serving.md "Failure containment" / "Decode horizon")
 - ``metrics``    — TTFT / inter-token latency / queue depth / KV-block
   utilization / preemptions / failure counters, exported through
   runtime/dump.py
@@ -38,6 +39,7 @@ from triton_dist_tpu.serve.metrics import (  # noqa: F401
     ServeMetrics,
 )
 from triton_dist_tpu.serve.engine import (  # noqa: F401
+    ChainCommitted,
     QueueFull,
     ServeEngine,
 )

@@ -10,7 +10,12 @@ iteration-level scheduling):
    metered by the scheduler's token budget so long prompts interleave
    with in-flight decode; a completed prompt's K/V scatter into the
    request's pool pages and the request joins the decode batch.
-3. **Decode** all running rows in ONE batched forward through
+3. **Decode** all running rows — with a decode ``horizon`` H > 1, up to
+   H steps FUSE into one device dispatch (``_paged_decode_horizon``: a
+   traced scan with on-device sampling and KV commit, pipelined so the
+   host commits horizon N's token burst while the device runs horizon
+   N+1 — docs/serving.md "Decode horizon"); at H=1, one batched forward
+   per step through
    ``kernels/flash_decode.gqa_decode_paged_shard`` — per-row lengths,
    per-row block tables, the r5 ``active`` mask semantics (retired/free
    rows freeze; their dummy K/V writes redirect to the reserved null
@@ -71,10 +76,17 @@ from triton_dist_tpu.models.generate import (
     _multitoken_forward,
     _token_forward,
 )
-from triton_dist_tpu.models.sampling import sample_logits
+from triton_dist_tpu.models.sampling import (
+    sample_logits,
+    sample_logits_rowwise,
+)
 from triton_dist_tpu.models.speculative import greedy_accept_chain_batched
 from triton_dist_tpu.runtime.faults import FaultInjector
-from triton_dist_tpu.runtime.jit_cache import CountingJit
+from triton_dist_tpu.runtime.jit_cache import (
+    CountingJit,
+    bucket_down,
+    pow2_ladder,
+)
 from triton_dist_tpu.runtime.watchdog import (
     Heartbeat,
     WatchdogTimeout,
@@ -96,6 +108,13 @@ class QueueFull(RuntimeError):
     ``max_queue`` and the engine runs the ``"raise"`` overload policy
     (the ``"shed"`` policy retires the request ``FinishReason.SHED``
     instead of raising)."""
+
+
+class ChainCommitted(RuntimeError):
+    """A pipelined decode-horizon chain failed AFTER some of its token
+    bursts were already committed: the retry/bisect machinery must NOT
+    re-run it (a retry would double-emit the committed bursts), so it
+    escalates out of ``step()`` like a consumed-pool failure."""
 
 
 # Exceptions containment must NEVER swallow: a tripped step watchdog is
@@ -189,6 +208,71 @@ def _paged_verify_forward(params, pools, tables, kv_lens, chunk, active, *,
                                write_kv=write_kv, attend=attend)
 
 
+def _paged_decode_horizon(params, pools, tables, kv_lens, token, active,
+                          eos_done, limits, counts, base_keys, temps,
+                          top_ks, top_ps, greedy, eos_ids, *, H,
+                          all_greedy, cfg, page, impl, interpret):
+    """Up to ``H`` decode steps for every batch row in ONE traced program:
+    a ``lax.scan`` over :func:`_paged_decode_forward` (bit-identical
+    per-step math) with ON-DEVICE sampling and on-device KV/length
+    commit.  The host dispatches once and drains a ``[B, H]`` token burst
+    instead of paying a dispatch + logits sync + host sample per token —
+    the per-token fixed tax the decode horizon exists to remove
+    (docs/serving.md "Decode horizon").
+
+    Per-row early exit rides the masks, never the scan length: row ``b``
+    executes ``min(limits[b], steps-to-EOS)`` steps, then freezes exactly
+    like an inactive row (K/V writes redirect to the null block, length
+    pinned) while its slot-mates run the full horizon.  ``limits`` is the
+    host's per-row step budget — remaining max-tokens AND the allocated
+    page capacity (the page-boundary early exit: a row may never write
+    past the blocks the host reserved for it).  ``eos_done`` carries
+    ACROSS chained dispatches: the async pipeline launches horizon N+1
+    before horizon N drains, so the device itself must remember who
+    already hit EOS.
+
+    Token choice matches the host path bit for bit: greedy rows argmax;
+    sampled rows draw through ``sampling.sample_logits_rowwise`` with a
+    ``fold_in(key(seed), emitted_index)`` stream — the same stream
+    ``_choose_token`` folds on host, so a preempted-and-recomputed or
+    H=1-served request emits identical tokens.  ``all_greedy`` (static)
+    drops the sampling machinery from the trace for greedy-only batches.
+
+    Returns ``(pools, tokens [B, H], emitted [B, H] bool, kv_lens,
+    last_token, eos_done, counts)`` — the trailing carries re-enter the
+    next chained dispatch without touching the host.
+    """
+    # ``base_keys`` are HOST-built per-row typed keys (the engine stacks
+    # jax.random.key(p.seed) — the exact call `_choose_token` makes, so
+    # any seed the host path accepts, e.g. >= 2**31, streams identically
+    # here instead of overflowing an int32 seed array).
+    has_eos = eos_ids >= 0
+
+    def step(carry, t):
+        pools, kv_lens, token, eos_done, counts = carry
+        live = active & ~eos_done & (t < limits)
+        pools, logits = _paged_decode_forward(
+            params, pools, tables, kv_lens, token, live, cfg=cfg,
+            page=page, impl=impl, interpret=interpret)
+        kv_lens = kv_lens + live.astype(kv_lens.dtype)
+        if all_greedy:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            keys = jax.vmap(jax.random.fold_in)(base_keys, counts)
+            nxt = sample_logits_rowwise(logits, keys, temperature=temps,
+                                        top_k=top_ks, top_p=top_ps,
+                                        greedy=greedy)
+        nxt = jnp.where(live, nxt, token)
+        counts = counts + live.astype(counts.dtype)
+        eos_done = eos_done | (live & has_eos & (nxt == eos_ids))
+        return (pools, kv_lens, nxt, eos_done, counts), (nxt, live)
+
+    carry = (pools, kv_lens, token, eos_done, counts)
+    (pools, kv_lens, token, eos_done, counts), (toks, mask) = jax.lax.scan(
+        step, carry, jnp.arange(H, dtype=jnp.int32))
+    return (pools, toks.T, mask.T, kv_lens, token, eos_done, counts)
+
+
 def _fill_pool_pages(pools, scratch, block_ids, *, page):
     """Scatter a completed prefill's K/V (contiguous scratch caches
     [1, Hkv, n*page, D] per layer) into the request's pool pages.
@@ -268,6 +352,15 @@ class ServeEngine:
     speculative round (greedy requests only): up to ``spec_k + 1`` tokens
     per row per verify pass, same emitted stream as plain greedy.
 
+    ``horizon=H`` fuses up to H decode steps into ONE device dispatch
+    (on-device sampling, per-row EOS/max-token/page-boundary early exit)
+    and ``pipeline=N`` chains N such dispatches with a device-resident
+    carry — the host drains token bursts instead of paying a round trip
+    per token.  Streams are bit-identical at every H (docs/serving.md
+    "Decode horizon"); the scheduler clamps fused decode back to
+    single-step whenever prefill interleaving, waiting-queue deadlines,
+    or speculative rounds need iteration-level scheduling.
+
     **Shape bucketing** (docs/serving.md): prefill always runs the ONE
     fixed ``prefill_chunk`` shape (the final residual pads, its K/V
     writes zero-masked by ``n_valid``), and each prompt's scratch extent
@@ -283,6 +376,7 @@ class ServeEngine:
                  prefill_chunk: int = 64,
                  prefill_budget: Optional[int] = None,
                  bucket_ladder: Optional[list] = None,
+                 horizon: int = 1, pipeline: int = 2,
                  draft: Optional[Generator] = None, draft_params=None,
                  spec_k: int = 0, clock=time.monotonic,
                  max_queue: Optional[int] = None, overload: str = "shed",
@@ -313,6 +407,10 @@ class ServeEngine:
                 f"overload must be 'shed' or 'raise', got {overload!r}")
         if max_queue is not None and max_queue < 0:
             raise ValueError(f"max_queue must be >= 0, got {max_queue}")
+        if horizon < 1:
+            raise ValueError(f"horizon must be >= 1, got {horizon}")
+        if pipeline < 1:
+            raise ValueError(f"pipeline must be >= 1, got {pipeline}")
         self.gen = gen
         self.cfg = cfg
         self.params = params
@@ -328,6 +426,14 @@ class ServeEngine:
         self.draft = draft
         self.draft_params = draft_params
         self.spec_k = int(spec_k)
+        # decode horizon (docs/serving.md "Decode horizon"): up to
+        # `horizon` decode steps fuse into one device dispatch with
+        # on-device sampling; `pipeline` chains that many dispatches
+        # back-to-back with a device-resident carry, so the host commits
+        # horizon N's burst while the device executes horizon N+1.
+        self.horizon = int(horizon)
+        self.pipeline = int(pipeline)
+        self.h_ladder = pow2_ladder(self.horizon) if self.horizon > 1 else [1]
         # failure containment (docs/serving.md "Failure containment")
         self.max_queue = max_queue
         self.overload = overload
@@ -383,6 +489,16 @@ class ServeEngine:
         self._verify_fn = CountingJit(jax.jit(functools.partial(
             _paged_verify_forward, cfg=cfg, page=page_size, impl=impl,
             interpret=interpret), donate_argnums=(1,)), "paged_verify")
+        if self.horizon > 1:
+            # One program per (horizon rung, greedy-or-mixed): the scan
+            # length is static, so the ladder bounds the trace count and
+            # warmup() sweeps every rung (the prompt-extent ladder's twin
+            # for the decode side).
+            self._horizon_fn = CountingJit(jax.jit(functools.partial(
+                _paged_decode_horizon, cfg=cfg, page=page_size, impl=impl,
+                interpret=interpret),
+                static_argnames=("H", "all_greedy"),
+                donate_argnums=(1,)), "decode_horizon")
         # scratch is not donatable (the page reshape transposes it);
         # pools are — the scatter updates them in place.
         self._fill_fn = CountingJit(jax.jit(functools.partial(
@@ -395,6 +511,8 @@ class ServeEngine:
         for c in (self._chunk_fn, self._fill_fn, self._decode_fn,
                   self._verify_fn):
             self.metrics.register_compiled(c)
+        if self.horizon > 1:
+            self.metrics.register_compiled(self._horizon_fn)
 
         self.slots: list[Optional[ReqState]] = [None] * max_batch
         self._states: dict[str, ReqState] = {}
@@ -608,7 +726,11 @@ class ServeEngine:
         Call BEFORE submitting traffic (asserted).  A rung is skipped
         only when no admissible request can reach it (shorter prompts
         and max_new=1 are tried before giving up) — then production
-        cannot hit it either.  Spec mode: the draft prefills through
+        cannot hit it either.  With a decode ``horizon`` the sweep also
+        drains one dummy per HORIZON rung (greedy and sampled variants,
+        serially — co-scheduled rung dummies would all bucket to the
+        largest limit), so fused decode never compiles under traffic
+        either.  Spec mode: the draft prefills through
         its own padded chunk + extent ladder (``draft_prefill`` /
         ``draft_join`` counters), and warmup sweeps THAT ladder too —
         spec-mode admission is fully compile-free after warmup.  An
@@ -673,6 +795,20 @@ class ServeEngine:
                             self._warmup_try(f"wd{round_}_{i}", n_max,
                                              n_min)
                     self.run()
+                    if self.horizon > 1 and not self.spec_k:
+                        # Horizon rungs compile one program per (scan
+                        # length, greedy-or-mixed sampler).  Each rung
+                        # drains SERIALLY: co-scheduled rung dummies
+                        # would all bucket to the largest limit in the
+                        # batch and leave the smaller rungs cold for the
+                        # tail of every request's generation.
+                        for r in self.h_ladder:
+                            if r <= 1:
+                                continue
+                            for ti, temp in enumerate((0.0, 1.0)):
+                                self._warmup_horizon_try(
+                                    f"wh{round_}_{r}_{ti}", r, temp)
+                                self.run()
                     for rid in [r for r in self._outputs
                                 if r.startswith("__warmup_")]:
                         del self._outputs[rid]
@@ -706,6 +842,26 @@ class ServeEngine:
                 return
             except ValueError:
                 continue
+
+    def _warmup_horizon_try(self, tag: str, rung: int,
+                            temperature: float) -> None:
+        """Queue ONE warmup dummy reaching horizon rung ``rung``: a
+        1-token prompt with ``max_new = rung + 1`` — after the
+        prefill-path first token its remaining budget is exactly
+        ``rung``, so the planner's bucketed horizon lands on the rung.
+        A pool that cannot hold ``2 + rung`` tokens cannot admit ANY
+        request able to reach the rung (remaining >= rung needs
+        ``max_new >= rung + 1`` on top of a >= 1-token prompt), so a
+        rejected dummy means production cannot hit it either.
+        ``temperature`` 0/1 sweeps the greedy and mixed-sampler variants
+        of the trace."""
+        req = Request(f"__warmup_{tag}", np.zeros((1,), np.int32),
+                      SamplingParams(max_new_tokens=rung + 1,
+                                     temperature=temperature))
+        try:
+            self._submit(req, bounded=False)
+        except ValueError:
+            pass
 
     # -- prefill ----------------------------------------------------------
 
@@ -851,6 +1007,14 @@ class ServeEngine:
     # -- token choice / emission -----------------------------------------
 
     def _choose_token(self, rs: ReqState, logits_row) -> int:
+        """HOST-side token choice — the prefill-first-token and
+        single-step (H=1 / spec-verify fallback) path only; the fused
+        decode horizon samples ON DEVICE through
+        ``sampling.sample_logits_rowwise``, which is pinned bit-identical
+        to this path (same filter math, same ``fold_in(key(seed),
+        emission_index)`` stream — tests/test_sampling.py), so a stream
+        may cross between the two mid-request (preemption, horizon
+        clamps) without a token ever differing."""
         p = rs.req.params
         if p.greedy:
             return int(np.argmax(np.asarray(logits_row)))
@@ -863,13 +1027,17 @@ class ServeEngine:
                             top_p=p.top_p)
         return int(tok[0])
 
-    def _commit_token(self, rs: ReqState,
-                      token: int) -> Optional[RequestOutput]:
+    def _commit_token(self, rs: ReqState, token: int,
+                      now: Optional[float] = None
+                      ) -> Optional[RequestOutput]:
         """Emit one token; retire the request when it finishes.  The
         token stays ``pending`` (not yet in the cache) until the next
         decode step consumes it.  Timestamps are taken HERE (not at the
         step boundary) so TTFT/ITL separate tokens emitted within one
-        iteration (prefill completion + same-step decode).
+        iteration (prefill completion + same-step decode); a horizon
+        burst commit passes explicit ``now`` values paced by the DEVICE
+        step cadence (``RequestMetrics.burst_times``), since its tokens
+        were produced steps apart but drain together.
 
         The ``on_token`` callback is CONTAINED: a raising frontend
         callback used to propagate out of ``step()`` with the token
@@ -880,7 +1048,8 @@ class ServeEngine:
         request is never retired twice."""
         if rs.status is Status.FINISHED:  # aborted mid-step by a callback
             return self._outputs.get(rs.req.request_id)
-        now = self._clock()
+        if now is None:
+            now = self._clock()
         rs.generated.append(token)
         rs.pending_token = token
         rs.metrics.on_token(now)
@@ -977,17 +1146,36 @@ class ServeEngine:
         return self._retire(rs, FinishReason.ERROR,
                             free=rs.slot is not None, error=msg)
 
-    def _device_call(self, op: str, rids: tuple, fn, *args, **kwargs):
+    # Decode-loop device programs: their dispatches count toward
+    # metrics.dispatches (summary()["decode"] — the denominator of
+    # tokens_per_dispatch).  Admission-path programs (prefill, page
+    # scatter, draft join) do not.
+    _DECODE_OPS = frozenset({"paged_decode", "paged_verify", "draft_step",
+                             "decode_horizon"})
+
+    def _device_call(self, op: str, rids: tuple, fn, *args,
+                     fire_injector: bool = True, **kwargs):
         """The ONE guarded device-dispatch seam: the ``forward`` fault
         point fires inside the watched thunk (an injected stall trips
         the watchdog exactly like a wedged device), and with
         ``step_timeout_s`` set the result is forced to ready under
         ``runtime.watchdog`` so a hung forward raises
         :class:`WatchdogTimeout` instead of wedging ``run()`` forever
-        (the heartbeat file goes stale — the beats are synchronous)."""
+        (the heartbeat file goes stale — the beats are synchronous).
+
+        ``fire_injector=False`` skips the fault seam: links 2..N of a
+        pipelined horizon chain dispatch through it — an injected fault
+        AFTER link 1 donated the pools would otherwise leave a
+        retry-looking state whose retry double-commits link 1's burst
+        (the chain fires the injector exactly once, at its head)."""
         def call():
-            if self.faults is not None:
+            if fire_injector and self.faults is not None:
                 self.faults.fire("forward", op=op, rids=rids)
+            # Counted AFTER the injector seam: an injector-aborted
+            # attempt never reached the device and must not inflate
+            # dispatches_per_token under chaos.
+            if op in self._DECODE_OPS:
+                self.metrics.dispatches += 1
             out = fn(*args, **kwargs)
             return (jax.block_until_ready(out)
                     if self.step_timeout_s is not None else out)
@@ -1020,6 +1208,8 @@ class ServeEngine:
                 return
             except _FATAL:
                 raise
+            except ChainCommitted:
+                raise  # bursts already committed: a retry double-emits
             except Exception as e:
                 if not self._state_intact():
                     raise  # donated pools consumed: engine-fatal
@@ -1075,11 +1265,29 @@ class ServeEngine:
 
     def _decode_once(self,
                      running: list[ReqState]) -> list[RequestOutput]:
+        """One decode pass for the running rows: a single per-token step
+        (the PR-1 path) or, with ``horizon > 1`` and the scheduler's
+        blessing, a fused multi-step horizon dispatch (pipelined when
+        ``pipeline > 1``).  Capacity for the WHOLE planned horizon is
+        reserved up front — a row that cannot grow quarantines here, per
+        row, exactly like the single-step path."""
         finished: list[RequestOutput] = []
+        h_plan = self.scheduler.plan_horizon(
+            self.horizon,
+            prefilling=any(s is not None and s.status is Status.PREFILL
+                           for s in self.slots),
+            spec=bool(self.spec_k),
+            deadline_waiting=any(
+                w.req.params.deadline_s is not None
+                for w in self.scheduler.waiting))
+        links = self.pipeline if h_plan > 1 else 1
         for rs in sorted(running, key=lambda r: r.seq):
             if rs.status is Status.RUNNING:  # may get preempted below
+                want = rs.kv_len + min(max(h_plan, 1) * links,
+                                       rs.remaining_new)
+                want = min(want, rs.total_tokens)
                 try:
-                    self._ensure_capacity(rs, rs.kv_len + 1)
+                    self._ensure_capacity(rs, want)
                 except _FATAL:
                     raise
                 except Exception as e:
@@ -1087,12 +1295,29 @@ class ServeEngine:
                     # this request cannot grow — quarantine it (its
                     # blocks come back) instead of unwinding the step.
                     finished.append(self._quarantine(
-                        rs, f"kv grow to {rs.kv_len + 1} rows: {e!r}"))
+                        rs, f"kv grow to {want} rows: {e!r}"))
         live = [r for r in running if r.status is Status.RUNNING]
-        if live:
+        if not live:
+            return finished
+        h_eff = 1
+        if h_plan > 1:
+            # The scan length is a STATIC trace parameter: bucket the
+            # planned horizon down the ladder so tail-of-generation
+            # batches reuse compiled rungs instead of tracing one
+            # program per residual length.
+            h_eff = bucket_down(
+                self.h_ladder,
+                min(h_plan, max(r.remaining_new for r in live)))
+        if h_eff <= 1:
             self._forward_contained(
                 live, lambda rows: self._decode_rows(rows, finished),
                 "decode", finished)
+        else:
+            self._forward_contained(
+                live,
+                lambda rows: self._decode_horizon_rows(rows, h_eff,
+                                                       finished),
+                "decode horizon", finished)
         return finished
 
     def _decode_rows(self, rows: list[ReqState], finished: list) -> None:
@@ -1121,6 +1346,7 @@ class ServeEngine:
         logits_np = np.asarray(logits)  # sync BEFORE committing pools
         self._pools = pools
         self.metrics.decode_steps += 1
+        self.metrics.host_syncs += 1
 
         for rs in rows:
             if rs.status is not Status.RUNNING:
@@ -1135,10 +1361,171 @@ class ServeEngine:
             except Exception as e:
                 finished.append(self._quarantine(rs, f"commit: {e!r}"))
                 continue
+            self.metrics.decode_tokens += 1
             if out is not None:
                 finished.append(out)
 
-    # -- speculative rounds ----------------------------------------------
+    def _decode_horizon_rows(self, rows: list[ReqState], h: int,
+                             finished: list) -> None:
+        """Fused multi-step decode for ``rows``: up to ``pipeline``
+        chained ``_paged_decode_horizon`` dispatches of ``h`` steps each,
+        then an in-order drain committing each link's token burst.
+
+        The async pipeline is the point of the chaining: every link's
+        carry (kv lengths, last token, EOS marks, PRNG counters) stays
+        DEVICE-RESIDENT, so link N+1 dispatches before link N's results
+        ever reach the host, and the host commits link N's burst (token
+        bookkeeping, ``on_token`` callbacks) while the device executes
+        link N+1 — ``block_until_ready`` is deferred to each link's drain
+        point.  (With ``step_timeout_s`` set the watchdog forces every
+        link ready at dispatch, so the links serialize and only the
+        step-fusion win remains — stall detection and dispatch overlap
+        are mutually exclusive by construction.)  A row that hits EOS
+        mid-link is frozen by the device for the rest of the chain
+        (``eos_done`` carry); its retire, block free, and the discard of
+        any later-link output all happen at drain, guarded by the same
+        status checks as the single-step path.
+
+        Containment mirrors :meth:`_decode_rows`: nothing host-side
+        mutates before the first drain, the injector seam fires once at
+        the chain head (see ``_device_call(fire_injector=...)``), so
+        :meth:`_forward_contained` can retry/bisect a failed chain whose
+        pools survived; once any burst has committed, failures escalate
+        as :class:`ChainCommitted` instead (a retry would double-emit)."""
+        B = self.max_batch
+        tokens = np.zeros((B,), np.int32)
+        lens = np.zeros((B,), np.int32)
+        active = np.zeros((B,), bool)
+        tables = np.zeros((B, self.n_pages_max), np.int32)
+        counts = np.zeros((B,), np.int32)
+        temps = np.ones((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        top_ps = np.ones((B,), np.float32)
+        greedy = np.ones((B,), bool)
+        eos_ids = np.full((B,), -1, np.int32)
+        rem = np.zeros((B,), np.int32)
+        for rs in rows:
+            b = rs.slot
+            p = rs.req.params
+            tokens[b] = rs.pending_token
+            lens[b] = rs.kv_len
+            active[b] = True
+            tables[b] = self.bm.padded_table(rs.req.request_id,
+                                             self.n_pages_max)
+            counts[b] = len(rs.generated)
+            temps[b] = p.temperature if not p.greedy else 1.0
+            top_ks[b] = p.top_k or 0
+            top_ps[b] = p.top_p if p.top_p is not None else 1.0
+            greedy[b] = p.greedy
+            eos_ids[b] = p.eos_id if p.eos_id is not None else -1
+            # Per-row step budget: remaining max-tokens AND the pages the
+            # host actually reserved (the page-boundary early exit).
+            rem[b] = min(rs.remaining_new,
+                         self.bm.capacity_tokens(rs.req.request_id)
+                         - rs.kv_len)
+        all_greedy = bool(greedy[active].all())
+        rids = tuple(r.req.request_id for r in rows)
+
+        # Host link plan: link j runs min(h, what's left after j-1) steps
+        # per row; the device masks enforce it, EOS exits ride the carry.
+        # Each link's scan length buckets DOWN the ladder from its own
+        # max budget — a tail link covering a 2-step residual runs the
+        # warmed H=2 program, not h-2 dead full-batch forwards on the
+        # H=h one (every rung is warmup-swept, so no new traces).
+        budgets = []
+        left = rem.copy()
+        for _ in range(max(self.pipeline, 1)):
+            need = int(left[active].max()) if active.any() else 0
+            if need <= 1:
+                # A 1-step residual is NOT worth a link: warmup never
+                # compiles the H=1 horizon variant (the planner routes
+                # single steps to the legacy `_decode_rows` program), so
+                # the next iteration picks it up there — same dispatch
+                # count, no cold trace under traffic.
+                break
+            h_link = bucket_down(self.h_ladder, min(h, need))
+            lim = np.minimum(left, h_link).astype(np.int32)
+            budgets.append((h_link, lim))
+            left = left - lim
+
+        # Dispatch every link before draining any (async pipelining);
+        # the carry arrays never touch the host between links.
+        kv_d = jnp.asarray(lens)
+        tok_d = jnp.asarray(tokens)
+        done_d = jnp.zeros((B,), bool)
+        cnt_d = jnp.asarray(counts)
+        tables_d = jnp.asarray(tables)
+        active_d = jnp.asarray(active)
+        # Host-built per-row base keys — the SAME jax.random.key(p.seed)
+        # call `_choose_token` makes, so seeds the int32 array route
+        # would overflow (>= 2**31) stream identically at every H.
+        key_rows = [jax.random.key(0)] * B
+        if not all_greedy:
+            for rs in rows:
+                if not rs.req.params.greedy:
+                    key_rows[rs.slot] = jax.random.key(rs.req.params.seed)
+        samp = (jnp.stack(key_rows), jnp.asarray(temps),
+                jnp.asarray(top_ks), jnp.asarray(top_ps),
+                jnp.asarray(greedy), jnp.asarray(eos_ids))
+        outs = []
+        t_prev = self._clock()
+        for j, (h_link, lim) in enumerate(budgets):
+            (pools, toks, mask, kv_d, tok_d, done_d,
+             cnt_d) = self._device_call(
+                "decode_horizon", rids, self._horizon_fn, self.params,
+                self._pools, tables_d, kv_d, tok_d, active_d, done_d,
+                jnp.asarray(lim), cnt_d, *samp, H=int(h_link),
+                all_greedy=all_greedy, fire_injector=(j == 0))
+            self._pools = pools
+            outs.append((toks, mask))
+
+        # Drain in order: committing link j's burst overlaps the device
+        # executing links > j (nothing here forces their results).
+        committed = False
+        try:
+            for toks, mask in outs:
+                toks_np, mask_np = jax.device_get((toks, mask))
+                self.metrics.host_syncs += 1
+                now = self._clock()
+                steps = int(mask_np.any(axis=0).sum())
+                self.metrics.decode_steps += steps
+                step_s = (now - t_prev) / max(steps, 1)
+                t_prev = now
+                for rs in sorted(rows, key=lambda r: r.seq):
+                    if rs.status is not Status.RUNNING:
+                        continue  # retired mid-drain (EOS/abort/length)
+                    b = rs.slot
+                    n = int(mask_np[b].sum())
+                    if n == 0:
+                        continue
+                    rs.kv_len += n  # the device already wrote these rows
+                    times = rs.metrics.burst_times(now, n, step_s)
+                    out = None
+                    try:
+                        for i in range(n):
+                            out = self._commit_token(
+                                rs, int(toks_np[b, i]), now=times[i])
+                            committed = True
+                            self.metrics.decode_tokens += 1
+                            if (out is not None
+                                    or rs.status is not Status.RUNNING):
+                                break  # retired; rest of burst discarded
+                    except _FATAL:
+                        raise
+                    except Exception as e:
+                        finished.append(self._quarantine(
+                            rs, f"commit: {e!r}"))
+                        continue
+                    if out is not None:
+                        finished.append(out)
+        except (*_FATAL, ChainCommitted):
+            raise
+        except Exception as e:
+            if committed:
+                raise ChainCommitted(
+                    f"horizon chain failed after committing tokens: "
+                    f"{e!r}") from e
+            raise
 
     def _spec_round(self,
                     running: list[ReqState]) -> list[RequestOutput]:
@@ -1213,6 +1600,7 @@ class ServeEngine:
                 # plain greedy token via the accept machinery's fallback.
                 toks_np = np.argmax(np.asarray(self._last_logits),
                                     axis=-1)
+                self.metrics.host_syncs += 1
                 closing = jnp.asarray(toks_np.astype(np.int32))
                 emitted = {rs.slot: [int(toks_np[rs.slot])]
                            for rs in live}
@@ -1232,6 +1620,7 @@ class ServeEngine:
                 m_dev, toks = greedy_accept_chain_batched(
                     proposals, self._last_logits, logits_all)
                 m_np, toks_np = jax.device_get((m_dev, toks))
+                self.metrics.host_syncs += 1
                 emitted = {}
                 closing_np = np.zeros((B,), np.int32)
                 for rs in live:
@@ -1284,6 +1673,7 @@ class ServeEngine:
             out = None
             for t in emitted[rs.slot]:
                 out = self._commit_token(rs, t)
+                self.metrics.decode_tokens += 1
                 if out is not None or rs.status is not Status.RUNNING:
                     break  # retired mid-round; rest of the chain dropped
             rs.pending_token = None  # spec mode: cache already consumed it

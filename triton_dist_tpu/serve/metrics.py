@@ -45,6 +45,16 @@ class RequestMetrics:
             self.first_token_time = now
         self.token_times.append(now)
 
+    def burst_times(self, now: float, n: int, step_s: float) -> list[float]:
+        """Timestamps for ``n`` tokens committed in ONE decode-horizon
+        drain: spaced backwards from ``now`` by the DEVICE step cadence
+        (``step_s`` = horizon wall time / device steps) instead of
+        collapsing onto the drain instant.  Burst commits would otherwise
+        read as ITL 0 inside a burst and a full horizon between bursts —
+        the per-token latency a client streaming from the engine actually
+        sees is the device's, and this reconstructs it."""
+        return [now - i * step_s for i in range(n - 1, -1, -1)]
+
     @property
     def ttft(self) -> Optional[float]:
         """Time to first token (arrival → first emission)."""
@@ -91,6 +101,15 @@ class ServeMetrics:
     prefill_tokens: int = 0
     preemptions: int = 0
     completed: int = 0
+    # decode-loop dispatch accounting (docs/serving.md "Decode horizon"):
+    # how many device dispatches and host sync points the decode path
+    # paid per emitted token.  At horizon H=1 every token costs one
+    # dispatch + one sync; the fused horizon amortizes both — the
+    # dispatches_per_token quotient is THE metric the horizon exists to
+    # shrink.
+    decode_tokens: int = 0        # tokens committed by the decode loop
+    dispatches: int = 0           # decode-path device dispatches
+    host_syncs: int = 0           # decode-path host sync points
     # failure-containment counters (docs/serving.md "Failure
     # containment"): every non-healthy retirement and every recovery
     # action is a counter, so overload and poison traffic are visible
@@ -144,6 +163,25 @@ class ServeMetrics:
             "watchdog_trips": self.watchdog_trips,
             "spec_bailouts": self.spec_bailouts,
             "finish_reasons": dict(self.finish_reasons),
+        }
+
+    def decode_stats(self) -> dict:
+        """The decode-loop dispatch economics (summary()["decode"]).
+        ``dispatches_per_token`` is ~1/batch for per-token decode (one
+        dispatch per STEP emits a token per active row) and ~1/(batch·H)
+        on a steady fused-horizon batch — the horizon amortizes steps,
+        the batch amortizes rows, and only the former is the decode
+        horizon's doing; ``host_syncs`` counts the blocking device→host
+        fetches the loop paid."""
+        return {
+            "decode_steps": self.decode_steps,
+            "decode_tokens": self.decode_tokens,
+            "dispatches": self.dispatches,
+            "host_syncs": self.host_syncs,
+            "tokens_per_dispatch": (self.decode_tokens / self.dispatches
+                                    if self.dispatches else 0.0),
+            "dispatches_per_token": (self.dispatches / self.decode_tokens
+                                     if self.decode_tokens else 0.0),
         }
 
     # -- compilation observability ---------------------------------------
@@ -200,6 +238,7 @@ class ServeMetrics:
             "mean_ttft": sum(ttfts) / len(ttfts) if ttfts else None,
             "max_ttft": max(ttfts, default=None) if ttfts else None,
             "mean_itl": sum(itls) / len(itls) if itls else None,
+            "decode": self.decode_stats(),
             "failures": self.failure_stats(),
             "compilation": self.compile_stats(),
             "requests": {rid: m.to_dict()

@@ -181,6 +181,37 @@ class FCFSScheduler:
             budget -= n_chunks * chunk
         return plan
 
+    # -- decode-horizon planning -----------------------------------------
+
+    def plan_horizon(self, horizon: int, *, prefilling: bool, spec: bool,
+                     deadline_waiting: bool) -> int:
+        """Decode steps ONE device dispatch may fuse this iteration (the
+        engine buckets the result down its horizon ladder and enforces
+        per-row budgets on device — docs/serving.md "Decode horizon").
+
+        Fusing trades scheduling granularity for dispatch economy, so the
+        plan clamps back to ITERATION-LEVEL decode (1) whenever a fused
+        horizon would break a per-step contract:
+
+        - ``spec``: speculative rounds are already multi-token per
+          dispatch and share device state across rows; they keep their
+          own round machinery (this also keeps a post-bailout engine on
+          the warmed single-step program).
+        - ``prefilling``: mid-prefill rows are owed chunk budget every
+          iteration — a fused horizon would freeze their TTFT for its
+          whole duration.
+        - ``deadline_waiting``: WAITING deadlines are swept at step
+          boundaries; fusing would delay the sweep (and the blocks it
+          frees) by the horizon's wall time.
+
+        A non-empty waiting queue WITHOUT deadlines does not clamp:
+        admission runs before decode each step, so anything still queued
+        at decode time could not be admitted now anyway, and retirements
+        that unblock it only land at the horizon's drain regardless."""
+        if horizon <= 1 or spec or prefilling or deadline_waiting:
+            return 1
+        return horizon
+
     # -- preemption -------------------------------------------------------
 
     def pick_victim(self, running: list[ReqState],
