@@ -112,6 +112,31 @@ def parse_args():
                    help="engine mode: waiting-queue bound; arrivals "
                         "beyond it are shed at submit() (chaos mode "
                         "defaults this to requests // 2)")
+    p.add_argument("--snapshot-dir", default=None, metavar="DIR",
+                   help="engine mode: crash recovery — append every "
+                        "submit/token/retire to DIR's token journal and "
+                        "snapshot the paged KV + engine state there "
+                        "(docs/serving.md 'Crash recovery')")
+    p.add_argument("--snapshot-every", type=int, default=8, metavar="N",
+                   help="engine mode: KV snapshot cadence in engine "
+                        "steps (the journal appends per token commit "
+                        "regardless; only with --snapshot-dir)")
+    p.add_argument("--resume", action="store_true",
+                   help="engine mode: restore from --snapshot-dir "
+                        "before serving (fresh start when the dir is "
+                        "empty); already-journaled requests are not "
+                        "re-submitted and resumed streams are bit-"
+                        "identical to an uninterrupted run")
+    p.add_argument("--heartbeat", default=None, metavar="PATH",
+                   help="engine mode: beat a liveness file each step "
+                        "(scripts/serve_supervisor.py polls it)")
+    p.add_argument("--hb-interval", type=float, default=5.0,
+                   help="engine mode: heartbeat cadence in seconds")
+    p.add_argument("--kill-at-step", type=int, default=None, metavar="K",
+                   help="engine mode, chaos/demo: os._exit mid-run at "
+                        "engine step K — once (a marker in "
+                        "--snapshot-dir gates re-kills), so a "
+                        "supervisor restart runs to completion")
     return p.parse_args()
 
 
@@ -171,13 +196,37 @@ def run_engine(args, key):
                           error="chaos: alloc"))
         if max_queue is None:
             max_queue = max(2, args.requests // 2)
-    engine = ServeEngine(
-        gen, params, num_blocks=num_blocks, page_size=page,
-        max_batch=args.max_batch, prefill_chunk=max(8, page),
-        horizon=args.horizon, pipeline=args.pipeline,
-        draft=draft, draft_params=d_params,
-        spec_k=args.speculative or 0,
-        faults=faults, max_queue=max_queue, fault_retries=1)
+    kw = dict(num_blocks=num_blocks, page_size=page,
+              max_batch=args.max_batch, prefill_chunk=max(8, page),
+              horizon=args.horizon, pipeline=args.pipeline,
+              draft=draft, draft_params=d_params,
+              spec_k=args.speculative or 0,
+              faults=faults, max_queue=max_queue, fault_retries=1,
+              heartbeat=args.heartbeat,
+              heartbeat_interval_s=args.hb_interval)
+    from triton_dist_tpu.serve.recovery import has_restorable_state
+
+    # An empty journal the constructor touched before the process died
+    # is NOT resumable — restore would find nothing and a supervisor
+    # retrying --resume would never recover; start fresh instead.
+    snap_dir = args.snapshot_dir
+    resumable = snap_dir is not None and has_restorable_state(snap_dir)
+    if args.resume and resumable:
+        kw.pop("spec_k")  # restore keys speculation off the draft args
+        engine = ServeEngine.restore(
+            snap_dir, gen, params, snapshot_every=args.snapshot_every,
+            **kw)
+        r = engine.metrics.recovery_stats()
+        dist_print(f"resumed from snapshot: "
+                   f"{r['restored_in_place']} in place, "
+                   f"{r['restored_requeued']} requeued "
+                   f"({r['restored_tokens']} journal tokens carried), "
+                   f"{engine.metrics.completed} already finished")
+    else:
+        engine = ServeEngine(
+            gen, params, snapshot_dir=snap_dir,
+            snapshot_every=args.snapshot_every if snap_dir else None,
+            **kw)
     dist_print(f"engine: {args.requests} requests, pool {num_blocks} "
                f"blocks x{page} tokens, batch {args.max_batch}"
                f"{f', horizon {args.horizon} (pipeline {args.pipeline})' if args.horizon > 1 else ''}"
@@ -194,7 +243,13 @@ def run_engine(args, key):
                          for i in range(args.requests)])
         dist_print(f"mixed traffic: ladder {engine.ladder}, "
                    f"prompt lengths {sorted(set(int(x) for x in lens))}")
-    if args.warmup:
+    resumed_engine = args.resume and resumable
+    if args.warmup and resumed_engine:
+        # warmup() requires an idle engine; a restored one already
+        # holds re-queued work.  Programs compile on demand instead.
+        dist_print("warmup skipped on --resume (restored work in "
+                   "flight; programs compile on demand)")
+    elif args.warmup:
         w = engine.warmup()
         caveat = (" (spec mode: the draft's padded chunked prefill + "
                   "join ride their own extent ladder — see the "
@@ -216,15 +271,32 @@ def run_engine(args, key):
                     .astype(np.int32), params_s, on_token=on_token)
             for i in range(args.requests)]
 
+    kill_marker = (os.path.join(snap_dir, "killed.marker")
+                   if snap_dir else None)
     t0 = time.perf_counter()
     submitted = step = 0
-    finished = []
+    finished = [engine._outputs[rid] for rid in sorted(engine._outputs)]
     while engine.has_work() or submitted < len(reqs):
         if step % max(args.stagger, 1) == 0 and submitted < len(reqs):
-            shed = engine.submit(reqs[submitted])
-            if shed is not None:        # bounded admission said no
-                finished.append(shed)
-            submitted += 1
+            if engine.has_request(reqs[submitted].request_id):
+                submitted += 1  # resumed: already in the journal
+            else:
+                shed = engine.submit(reqs[submitted])
+                if shed is not None:    # bounded admission said no
+                    finished.append(shed)
+                submitted += 1
+        if (args.kill_at_step is not None and step == args.kill_at_step
+                and kill_marker is not None
+                and not os.path.exists(kill_marker)):
+            # Simulated process death (demo / supervisor test): durable
+            # state is the journal + snapshots only — no cleanup, like
+            # a real SIGKILL.  The marker keeps the restarted run alive.
+            with open(kill_marker, "w") as f:
+                f.write("killed once\n")
+            dist_print(f"killing engine process at step {step} "
+                       f"(os._exit; restart with --resume)")
+            sys.stdout.flush()
+            os._exit(17)
         finished.extend(engine.step())
         step += 1
     dt = time.perf_counter() - t0
@@ -264,6 +336,14 @@ def run_engine(args, key):
                    f"{f['forward_retries']} retries / "
                    f"{f['forward_bisections']} bisections, "
                    f"finish reasons {f['finish_reasons']}")
+    if snap_dir is not None:
+        r = s["recovery"]
+        dist_print(f"crash recovery: {r['snapshots']} snapshots "
+                   f"(last {r['snapshot_ms_last']:.1f} ms), "
+                   f"{r['journal_records']} journal records "
+                   f"({r['journal_bytes']} bytes), "
+                   f"{r['restored_in_place']} resumed in place / "
+                   f"{r['restored_requeued']} requeued")
     comp = s["compilation"]
     per = ", ".join(f"{n} {c['misses']}c/{c['hits']}h"
                     for n, c in comp["programs"].items())

@@ -77,6 +77,16 @@ def bench_engine(horizon: int, *, batch: int = 4, prompt_len: int = 16,
     outs = eng.run()
     dt = time.perf_counter() - t0
     assert all(len(o.token_ids) == new_tokens for o in outs.values())
+    # Snapshot latency on the drained engine (pool size dominates the
+    # Orbax write, and the pool is identical drained or mid-flight) —
+    # the serving-side cost of each incremental crash-recovery capture.
+    import shutil
+    import tempfile
+    snap_dir = tempfile.mkdtemp(prefix="bench_snap_")
+    try:
+        snapshot_ms = eng.snapshot(snap_dir)["ms"]
+    finally:
+        shutil.rmtree(snap_dir, ignore_errors=True)
     d = eng.metrics.summary()["decode"]
     return {
         "horizon": horizon,
@@ -90,6 +100,7 @@ def bench_engine(horizon: int, *, batch: int = 4, prompt_len: int = 16,
         "host_syncs": d["host_syncs"],
         "tokens_per_dispatch": round(d["tokens_per_dispatch"], 3),
         "dispatches_per_token": round(d["dispatches_per_token"], 4),
+        "snapshot_ms": round(snapshot_ms, 2),
     }
 
 

@@ -1,0 +1,127 @@
+"""Supervisor loop for a crash-resilient serving process.
+
+The end-to-end consumer of the engine's snapshot/restore layer
+(docs/serving.md "Crash recovery"): run the serving command as a child
+process, watch two liveness signals, and restart from the latest
+snapshot when either says the engine is gone:
+
+- **process liveness** — the child exited nonzero (OOM-kill, TPU
+  preemption, a crash, an injected ``os._exit``);
+- **heartbeat staleness** — the child is alive but wedged: the engine
+  beats its ``runtime.watchdog.Heartbeat`` file synchronously from the
+  step loop, so ``Heartbeat.is_stalled`` going true means steps stopped
+  (a hung device dispatch, a deadlocked host thread).  The supervisor
+  SIGKILLs the wedged child — in-flight state is already durable in the
+  token journal, so killing loses nothing a restart can't replay.
+
+On restart the supervisor re-runs the same command with the resume flag
+appended (``examples/serve.py --engine --snapshot-dir D`` understands
+``--resume``: restore from D, re-queue what recompute needs, keep
+serving).  A child that exits 0 ends the loop.
+
+    python scripts/serve_supervisor.py \
+        --snapshot-dir /tmp/serve-snap --heartbeat /tmp/serve-snap/hb \
+        --hb-interval 2 --max-restarts 3 -- \
+        python examples/serve.py --engine --requests 16 \
+            --snapshot-dir /tmp/serve-snap --snapshot-every 8 \
+            --heartbeat /tmp/serve-snap/hb --hb-interval 2
+
+Exercised end-to-end (with a child that kills itself mid-run) by
+tests/test_serve_example.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from triton_dist_tpu.runtime.watchdog import Heartbeat  # noqa: E402
+
+
+def parse_args():
+    p = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--snapshot-dir", required=True,
+                   help="the child's snapshot directory (informational; "
+                        "the resume flag makes the child restore from it)")
+    p.add_argument("--heartbeat", default=None,
+                   help="heartbeat file the child beats each engine step; "
+                        "stale => the child is wedged and gets SIGKILLed")
+    p.add_argument("--hb-interval", type=float, default=5.0,
+                   help="the child's heartbeat cadence in seconds "
+                        "(stall = 3x this with no beat)")
+    p.add_argument("--grace-s", type=float, default=30.0,
+                   help="seconds after (re)start before stall detection "
+                        "arms (model init + warmup beat nothing)")
+    p.add_argument("--poll-s", type=float, default=0.5)
+    p.add_argument("--max-restarts", type=int, default=3)
+    p.add_argument("--resume-flag", default="--resume",
+                   help="appended to the command on every restart "
+                        "('' to disable)")
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="the serving command, after --")
+    args = p.parse_args()
+    args.cmd = [c for c in args.cmd if c != "--"]
+    if not args.cmd:
+        p.error("no child command given (pass it after --)")
+    return args
+
+
+def run_once(cmd: list[str], hb: str | None, hb_interval: float,
+             grace_s: float, poll_s: float) -> tuple[int, bool]:
+    """One child lifetime.  Returns (returncode, was_stalled)."""
+    # Drop a stale heartbeat from the previous life: its age must not
+    # trip the stall detector before the new child's first beat.
+    if hb is not None and os.path.exists(hb):
+        os.unlink(hb)
+    proc = subprocess.Popen(cmd)
+    started = time.monotonic()
+    while True:
+        rc = proc.poll()
+        if rc is not None:
+            return rc, False
+        armed = time.monotonic() - started > grace_s
+        if (hb is not None and armed
+                and Heartbeat.is_stalled(hb, interval_s=hb_interval)):
+            print(f"[supervisor] heartbeat {hb} stale "
+                  f"(> {3 * hb_interval:.1f}s): killing wedged child "
+                  f"pid {proc.pid}", flush=True)
+            proc.send_signal(signal.SIGKILL)
+            proc.wait()
+            return -signal.SIGKILL, True
+        time.sleep(poll_s)
+
+
+def main() -> int:
+    args = parse_args()
+    cmd = list(args.cmd)
+    restarts = 0
+    while True:
+        label = "starting" if restarts == 0 else f"restart {restarts}"
+        print(f"[supervisor] {label}: {' '.join(cmd)}", flush=True)
+        rc, stalled = run_once(cmd, args.heartbeat, args.hb_interval,
+                               args.grace_s, args.poll_s)
+        if rc == 0:
+            print(f"[supervisor] child completed cleanly after "
+                  f"{restarts} restart(s)", flush=True)
+            return 0
+        why = "stalled" if stalled else f"exited {rc}"
+        restarts += 1
+        if restarts > args.max_restarts:
+            print(f"[supervisor] child {why}; restart budget "
+                  f"({args.max_restarts}) exhausted", flush=True)
+            return 1
+        print(f"[supervisor] child {why}; restarting from the latest "
+              f"snapshot under {args.snapshot_dir}", flush=True)
+        if args.resume_flag and args.resume_flag not in cmd:
+            cmd = cmd + [args.resume_flag]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

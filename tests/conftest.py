@@ -108,6 +108,12 @@ _FAST_GATE_MODULES = {
     # fault containment gate the fused decode path; preemption/spec
     # interactions and the wall-clock bench carry @pytest.mark.slow.
     "test_serve_horizon",
+    # crash recovery: the journal replay, snapshot/restore round trip,
+    # kill/restart chaos sweep (every injected kill point -> bit-exact
+    # restarted streams + whole free list), exactly-once crash-window
+    # accounting, and geometry-override restores gate the recovery
+    # layer; the randomized kill soak carries @pytest.mark.slow.
+    "test_serve_recovery",
 }
 
 # Heavy tests inside core modules whose coverage is duplicated by a

@@ -5,6 +5,8 @@ down the framework's own story: sharded round-trip fidelity, retention,
 mesh re-layout on restore, and bit-exact training resume.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -98,3 +100,92 @@ def test_incomplete_save_is_invisible(mesh4, tmp_path):
     (tmp_path / "crash" / "9.tmp").mkdir()
     assert mgr.all_steps() == [3]
     assert mgr.latest_step() == 3
+
+
+def test_killed_save_mid_write_recovers(mesh4, tmp_path, monkeypatch):
+    """Crash-window regression: a save killed mid-tensorstore-write
+    leaves only a .tmp — the latest resumable step is untouched, and the
+    next manager to open the directory garbage-collects the orphan (it
+    used to leak forever: all_steps() ignores .tmp and the step number
+    may never be saved again)."""
+    d = tmp_path / "killed"
+    mgr = ck.CheckpointManager(d, max_to_keep=3)
+    tree = _tree(mesh4)
+    mgr.save(3, tree)
+
+    class Killed(BaseException):  # like a SIGKILL: nothing may catch it
+        pass
+
+    def dying_save(path, t, **kw):
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "partial"), "w") as f:
+            f.write("torn mid-write")
+        raise Killed()
+
+    monkeypatch.setattr(ck, "save", dying_save)
+    with pytest.raises(Killed):
+        mgr.save(7, tree)
+    monkeypatch.undo()
+    assert mgr.all_steps() == [3]            # nothing torn is visible
+    assert os.path.isdir(d / "7.tmp")        # the orphan is on disk ...
+    mgr2 = ck.CheckpointManager(d, max_to_keep=3)
+    assert not os.path.exists(d / "7.tmp")   # ... until a manager opens
+    step, out = mgr2.restore_latest(like=tree)
+    assert step == 3 and bitwise_equal(out["w"], tree["w"])
+
+    # killed between the full tmp write and the rename (the
+    # on_before_finalize seam the serving snapshot uses): same story
+    with pytest.raises(Killed):
+        mgr2.save(8, tree, on_before_finalize=lambda p: (_ for _ in ()
+                                                         ).throw(Killed()))
+    assert mgr2.all_steps() == [3]
+    assert ck.CheckpointManager(d, max_to_keep=3).all_steps() == [3]
+    assert not os.path.exists(d / "8.tmp")
+
+
+def test_reader_manager_leaves_live_tmp_alone(tmp_path):
+    """A read-only consumer (clean_tmp=False, the restore path) must
+    not GC ``.tmp`` — it may be a LIVE writer's in-flight save, not an
+    orphan; only a writer-opened manager reclaims it."""
+    d = tmp_path / "reader"
+    ck.CheckpointManager(d, max_to_keep=3)
+    (d / "5.tmp").mkdir()
+    ck.CheckpointManager(d, max_to_keep=3, clean_tmp=False)
+    assert (d / "5.tmp").is_dir()            # reader left it alone
+    ck.CheckpointManager(d, max_to_keep=3)
+    assert not (d / "5.tmp").exists()        # writer reclaimed it
+
+
+def test_save_extras_publish_atomically(mesh4, tmp_path):
+    """extras= files land inside the rename barrier: visible exactly
+    when the step is, never in a half-published state."""
+    d = tmp_path / "extras"
+    mgr = ck.CheckpointManager(d, max_to_keep=2)
+    tree = _tree(mesh4)
+    mgr.save(1, tree, extras={"meta.json": '{"k": 1}'})
+    assert (d / "1" / "meta.json").read_text() == '{"k": 1}'
+    out = ck.restore(d / "1", like=tree)     # extras don't break orbax
+    assert bitwise_equal(out["w"], tree["w"])
+
+
+def test_prune_spares_reader_grace_and_restore_falls_back(mesh4, tmp_path):
+    """Pruning runs BEFORE the rename and always spares the newest
+    existing step, so a concurrent restore_latest that just listed it
+    never reads mid-rmtree (disk holds max(max_to_keep, 2) dirs after a
+    save); and restore_latest walks past a torn step to a readable one."""
+    d = tmp_path / "grace"
+    mgr = ck.CheckpointManager(d, max_to_keep=1)
+    tree = _tree(mesh4)
+    mgr.save(1, tree)
+    mgr.save(2, tree)
+    # the previous latest (1) survives the save that superseded it
+    assert mgr.all_steps() == [1, 2]
+    mgr.save(3, tree)
+    assert mgr.all_steps() == [2, 3]         # 1 pruned one save later
+
+    # a torn step (crash left garbage that passes the name filter but
+    # fails to restore) falls back to the newest readable one
+    (d / "9").mkdir()
+    assert mgr.latest_step() == 9
+    step, out = mgr.restore_latest(like=tree)
+    assert step == 3 and bitwise_equal(out["w"], tree["w"])

@@ -1,4 +1,4 @@
-"""examples/serve.py: the serving CLI."""
+"""examples/serve.py: the serving CLI (+ scripts/serve_supervisor.py)."""
 
 import os
 import subprocess
@@ -6,17 +6,23 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, "examples", "serve.py")
+SUPERVISOR = os.path.join(REPO, "scripts", "serve_supervisor.py")
 
 
-def _run(*extra, devices=8, new_tokens=4):
+def _env(devices):
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devices}"
+    return env
+
+
+def _run(*extra, devices=8, new_tokens=4, expect_rc=0):
     out = subprocess.run(
         [sys.executable, SCRIPT, "--new-tokens", str(new_tokens), *extra],
-        capture_output=True, text=True, env=env, timeout=600)
-    assert out.returncode == 0, out.stderr[-2000:]
+        capture_output=True, text=True, env=_env(devices), timeout=600)
+    assert out.returncode == expect_rc, (out.returncode,
+                                         out.stderr[-2000:])
     return out.stdout
 
 
@@ -90,6 +96,56 @@ def test_serve_engine_mixed_warmup():
     compiles = sum(int(c) for c in
                    re.findall(r"\w+ (\d+)c/\d+h", out))
     assert compiles == warm, out
+
+
+def test_serve_engine_snapshot_kill_resume(tmp_path):
+    """--snapshot-dir + --kill-at-step + --resume: the first run dies
+    mid-flight (os._exit — a real process death), the second restores
+    from the journal + snapshot and finishes every stream; the token
+    total matches a run that never crashed."""
+    d = str(tmp_path / "snap")
+    base = ("--engine", "--requests", "4", "--stagger", "2",
+            "--max-batch", "2", "--page-size", "8",
+            "--snapshot-dir", d, "--snapshot-every", "3")
+    out = _run(*base, "--kill-at-step", "7", devices=1, new_tokens=6,
+               expect_rc=17)
+    assert "killing engine process at step 7" in out, out
+    assert os.path.exists(os.path.join(d, "journal.jsonl"))
+
+    out = _run(*base, "--kill-at-step", "7", "--resume", devices=1,
+               new_tokens=6)          # the kill marker gates a re-kill
+    assert "resumed from snapshot:" in out, out
+    assert "engine: 24 tokens / 4 requests" in out, out
+    assert "crash recovery:" in out and "done" in out
+    import re
+    reasons = re.findall(r"req-\d+: prompt \d+ -> (\d+) tokens \((\w+)\)",
+                         out)
+    assert len(reasons) == 4 and all(r == ("6", "length")
+                                     for r in reasons), out
+
+
+def test_serve_supervisor_restarts(tmp_path):
+    """scripts/serve_supervisor.py end-to-end: the child serve process
+    kills itself mid-run; the supervisor notices the death, restarts it
+    with --resume, and the restarted child drains cleanly from the
+    snapshot (satellite: the supervisor is the tentpole's consumer)."""
+    d = str(tmp_path / "sup")
+    hb = os.path.join(d, "hb")
+    child = [sys.executable, SCRIPT, "--engine", "--requests", "4",
+             "--stagger", "2", "--max-batch", "2", "--page-size", "8",
+             "--new-tokens", "6", "--snapshot-dir", d,
+             "--snapshot-every", "3", "--heartbeat", hb,
+             "--hb-interval", "2", "--kill-at-step", "7"]
+    out = subprocess.run(
+        [sys.executable, SUPERVISOR, "--snapshot-dir", d,
+         "--heartbeat", hb, "--hb-interval", "2", "--grace-s", "120",
+         "--max-restarts", "2", "--", *child],
+        capture_output=True, text=True, env=_env(1), timeout=600)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert "child exited 17; restarting" in out.stdout, out.stdout
+    assert "resumed from snapshot:" in out.stdout, out.stdout
+    assert "engine: 24 tokens / 4 requests" in out.stdout, out.stdout
+    assert "completed cleanly after 1 restart(s)" in out.stdout, out.stdout
 
 
 def test_serve_engine_horizon():

@@ -78,6 +78,62 @@ def test_injector_rate_seeded_and_deterministic():
     assert draw(8) != a                  # seed matters
 
 
+def test_injector_stall_and_skew_direct():
+    """Satellite: the stall and skew actions covered directly (only
+    `raise` was exercised by the chaos drain).  A stall sleeps at the
+    fault point for its full budget; a skew jumps every subsequent
+    reading of the wrapped clock by the accumulated amount."""
+    inj = FaultInjector()
+    inj.inject("forward", at_call=2, stall_s=0.15)
+    t0 = time.perf_counter()
+    inj.fire("forward")                          # call 1: no stall
+    assert time.perf_counter() - t0 < 0.1
+    t0 = time.perf_counter()
+    inj.fire("forward")                          # call 2: stalls
+    assert time.perf_counter() - t0 >= 0.15
+    inj.fire("forward")                          # one-shot spent
+    assert [x[2] for x in inj.fired] == ["stall"]
+
+    inj2 = FaultInjector()
+    inj2.inject("clock", skew_s=10.0, max_fires=2)
+    clk = inj2.wrap_clock(lambda: 5.0)
+    assert clk() == 15.0                         # +10
+    assert clk() == 25.0                         # +10 again (cumulative)
+    assert clk() == 25.0                         # max_fires: skew frozen
+    assert [x[2] for x in inj2.fired] == ["skew", "skew"]
+
+
+def test_audit_log_records_step_index(tiny):
+    """Satellite: every audit entry carries the engine's monotonic step
+    index (set_step, driven by ServeEngine.step), so a chaos schedule
+    replays deterministically post-mortem: (step, point, call) pins each
+    firing to one seam arrival of one iteration."""
+    inj = FaultInjector()
+    inj.set_step(4)
+    inj.inject("forward", at_call=1, error="x")
+    with pytest.raises(InjectedFault):
+        inj.fire("forward")
+    assert inj.fired == [("forward", 1, "error", None, 4)]
+
+    # engine-driven: the fired steps are the steps the engine executed,
+    # nondecreasing, and consistent with when the poison row decoded
+    cfg, params, gen = tiny
+    rng = np.random.default_rng(12)
+    p = rng.integers(0, cfg.vocab, size=5).astype(np.int32)
+    inj2 = FaultInjector()
+    inj2.inject("forward", rid="r", op="paged_decode", error="boom",
+                max_fires=2)
+    eng = _engine(gen, params, faults=inj2, fault_retries=1,
+                  clock=_Tick())
+    eng.submit(Request("r", p, SamplingParams(max_new_tokens=4)))
+    eng.run()
+    assert len(inj2.fired) == 2                  # first try + retry
+    steps = [x[4] for x in inj2.fired]
+    assert steps == sorted(steps)                # monotonic step index
+    assert all(0 <= s <= eng.metrics.steps for s in steps)
+    assert all(x[3] == "r" for x in inj2.fired)
+
+
 def test_injector_disabled_and_clock_skew():
     inj = FaultInjector()
     inj.inject("forward", at_call=1, error="x")

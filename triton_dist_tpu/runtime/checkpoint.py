@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import os
 import shutil
+import sys
 from typing import Any
 
 import jax
@@ -113,10 +114,27 @@ class CheckpointManager:
     directory, so a fresh process can resume with no side state.
     """
 
-    def __init__(self, directory: str | os.PathLike, *, max_to_keep: int = 3):
+    def __init__(self, directory: str | os.PathLike, *, max_to_keep: int = 3,
+                 clean_tmp: bool = True):
         self.directory = os.path.abspath(os.fspath(directory))
         self.max_to_keep = int(max_to_keep)
         os.makedirs(self.directory, exist_ok=True)
+        # Crash-window GC: a save killed between the tensorstore write
+        # and the rename leaves an orphaned ``<step>.tmp`` that nothing
+        # would ever reclaim (all_steps() ignores it, and the same step
+        # number may never be saved again).  Only a WRITER may reclaim
+        # it (``clean_tmp=True``, the default): a writer opening the
+        # directory is by contract the only live writer, so any .tmp it
+        # finds is garbage from a dead process.  A read-only consumer
+        # (e.g. a standby loading the latest snapshot) must pass
+        # ``clean_tmp=False`` — rmtree-ing here would tear a live
+        # writer's in-flight save out from under it.
+        if clean_tmp and _is_primary():
+            for name in os.listdir(self.directory):
+                if name.endswith(".tmp"):
+                    shutil.rmtree(os.path.join(self.directory, name),
+                                  ignore_errors=True)
+        _sync_hosts("tdt:ckpt:init")
 
     # -- discovery ---------------------------------------------------------
     def all_steps(self) -> list[int]:
@@ -138,16 +156,34 @@ class CheckpointManager:
         return os.path.join(self.directory, str(int(step)))
 
     # -- save / restore ----------------------------------------------------
-    def save(self, step: int, tree: Any) -> str:
+    def save(self, step: int, tree: Any, *,
+             extras: dict[str, str] | None = None,
+             on_before_finalize=None) -> str:
         """Durably write ``tree`` as checkpoint ``step``; prune old steps.
 
         The orbax write goes to ``<step>.tmp`` and is renamed into place
         only after it completes, so a preemption mid-save never corrupts
-        the latest resumable checkpoint.  The orbax write itself is
-        collective (every process must call this); the surrounding
-        directory mutations (clean / rename / prune) run on process 0
-        only, bracketed by cross-host syncs, since all processes share
-        one checkpoint directory.
+        the latest resumable checkpoint.  ``extras`` maps extra file
+        names to string contents written into the tmp directory before
+        the rename — host-side metadata (e.g. the serving engine's
+        snapshot manifest) publishes atomically WITH the arrays, never
+        before or after them.  ``on_before_finalize(tmp_path)`` runs
+        last before the rename (the chaos tests inject a kill there to
+        land exactly in the torn-snapshot window).
+
+        Pruning runs BEFORE the rename barrier and always spares the
+        current newest step: with the old prune-after ordering, a
+        concurrent ``restore_latest`` that had just listed the previous
+        latest could find its directory mid-``rmtree`` right after the
+        new step appeared.  Now the step a reader can have picked stays
+        on disk through the save that supersedes it; counting the
+        incoming step, disk holds ``max(max_to_keep, 2)`` directories —
+        the grace copy only exceeds ``max_to_keep`` when it is 1.
+
+        The orbax write itself is collective (every process must call
+        this); the surrounding directory mutations (clean / extras /
+        prune / rename) run on process 0 only, bracketed by cross-host
+        syncs, since all processes share one checkpoint directory.
         """
         final = self._step_path(step)
         tmp = final + ".tmp"
@@ -159,8 +195,15 @@ class CheckpointManager:
         _sync_hosts("tdt:ckpt:pre_save")
         save(tmp, tree)
         if _is_primary():
-            os.replace(tmp, final)
+            for name, content in (extras or {}).items():
+                with open(os.path.join(tmp, name), "w") as f:
+                    f.write(content)
+                    f.flush()
+                    os.fsync(f.fileno())
+            if on_before_finalize is not None:
+                on_before_finalize(tmp)
             self._prune()
+            os.replace(tmp, final)
         _sync_hosts("tdt:ckpt:post_save")
         return final
 
@@ -168,15 +211,41 @@ class CheckpointManager:
         return restore(self._step_path(step), like)
 
     def restore_latest(self, like: Any) -> tuple[int, Any] | None:
-        """(step, tree) for the newest checkpoint, or None if empty."""
-        step = self.latest_step()
-        if step is None:
-            return None
-        return step, self.restore(step, like)
+        """(step, tree) for the newest readable checkpoint, or None if
+        empty.  Walks newest → oldest: a step that fails to read (torn
+        by a crash, or pruned by a concurrent writer between the listing
+        and the read) falls back to the next-older one instead of
+        failing a resume that an older intact checkpoint could serve.
+        Raises only when steps exist but none restores."""
+        steps = self.all_steps()
+        err: Exception | None = None
+        for step in reversed(steps):
+            try:
+                return step, self.restore(step, like)
+            except Exception as e:  # noqa: BLE001 — fall back, re-raised
+                err = e             # below when nothing was readable
+                # Loud fallback: resuming from an older step silently
+                # would hide a rollback (a transient read error on the
+                # newest step costs real progress — the operator must
+                # be able to tell it happened from the logs).
+                print(f"[checkpoint] step {step} under {self.directory} "
+                      f"failed to restore ({e!r}); falling back to the "
+                      f"next older step", file=sys.stderr)
+        if err is not None:
+            raise err
+        return None
 
     def _prune(self) -> None:
+        """Remove steps beyond retention.  Called BEFORE the rename
+        barrier publishes the incoming step, and always keeps the
+        current newest existing step (the one a concurrent reader can
+        have picked as latest) — with the incoming step, disk holds
+        ``max(max_to_keep, 2)`` directories after a save."""
+        if self.max_to_keep <= 0:
+            return
         steps = self.all_steps()
-        for s in steps[:-self.max_to_keep] if self.max_to_keep > 0 else []:
+        keep = max(self.max_to_keep - 1, 1)
+        for s in steps[:-keep]:
             shutil.rmtree(self._step_path(s), ignore_errors=True)
 
     def wait(self) -> None:

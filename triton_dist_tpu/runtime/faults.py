@@ -25,13 +25,23 @@ point           context                  seam
 Actions: ``error=`` raises :class:`InjectedFault` at the point;
 ``stall_s=`` sleeps there (inside the engine's watchdog-watched thunk, so
 an injected stall trips the step watchdog exactly like a wedged device);
-``skew_s=`` jumps the wrapped clock forward (expires request deadlines).
+``skew_s=`` jumps the wrapped clock forward (expires request deadlines);
+``kill=True`` raises :class:`InjectedKill` — a BaseException standing in
+for process death, which no containment path may swallow (the crash-
+recovery tests catch it at the harness level, abandon the engine object
+like the OS would, and restart from disk).
 
 A spec fires when its filters match: ``at_call`` pins the nth *enabled*
 arrival at the point, ``rid`` / ``op`` restrict to one request / program,
 ``rate`` draws from the seeded stream (deterministic given an identical
 call sequence).  ``at_call`` faults are one-shot by default; everything
 else fires every match (``max_fires`` overrides either).
+
+Every audit-log entry records the engine's monotonic step index
+(``set_step``, driven by ``ServeEngine.step``) alongside the per-point
+call index, so a post-mortem can replay a chaos schedule
+deterministically: the (step, point, call) triple pins each firing to
+one seam arrival of one engine iteration.
 """
 
 from __future__ import annotations
@@ -51,6 +61,15 @@ class InjectedFault(RuntimeError):
     preemption machinery."""
 
 
+class InjectedKill(BaseException):
+    """Simulated process death (``inject(..., kill=True)``).  Derives
+    from :class:`BaseException` so every ``except Exception`` containment
+    path lets it through untouched — exactly like a SIGKILL, the only
+    party that may handle it is the harness standing in for the OS
+    (which abandons the engine object and restarts from the snapshot +
+    token journal on disk; docs/serving.md "Crash recovery")."""
+
+
 @dataclass
 class _FaultSpec:
     point: str
@@ -62,6 +81,7 @@ class _FaultSpec:
     rid: Optional[str] = None
     op: Optional[str] = None
     max_fires: Optional[int] = None
+    kill: bool = False
     fires: int = 0
 
 
@@ -77,12 +97,14 @@ class FaultInjector:
         inj.inject("clock", at_call=9, skew_s=120.0)
         engine = ServeEngine(..., faults=inj)
 
-    ``fired`` is the audit log — ``(point, call_index, kind, who)``
-    tuples in firing order — so a test can assert exactly which faults
-    a run hit.  ``disabled()`` gates everything off (engine warmup runs
-    under it: dummy traffic must not eat injected faults, and call
-    counts stay aligned with production traffic whether or not warmup
-    ran).
+    ``fired`` is the audit log — ``(point, call_index, kind, who,
+    step)`` tuples in firing order (``step`` is the engine iteration
+    index fed through :meth:`set_step`) — so a test or a post-mortem can
+    assert exactly which faults a run hit, at which seam arrival, on
+    which engine step, and replay the schedule deterministically.
+    ``disabled()`` gates everything off (engine warmup runs under it:
+    dummy traffic must not eat injected faults, and call counts stay
+    aligned with production traffic whether or not warmup ran).
     """
 
     def __init__(self, seed: int = 0):
@@ -90,7 +112,8 @@ class FaultInjector:
         self._rng = np.random.default_rng(seed)
         self._specs: list[_FaultSpec] = []
         self.calls: dict[str, int] = {}   # per-point enabled arrivals
-        self.fired: list[tuple] = []      # (point, call#, kind, who)
+        self.fired: list[tuple] = []      # (point, call#, kind, who, step)
+        self.step = 0                     # engine step index (set_step)
         self._skew = 0.0
         self._enabled = True
 
@@ -98,21 +121,28 @@ class FaultInjector:
 
     def inject(self, point: str, *, error: Optional[str] = None,
                stall_s: float = 0.0, skew_s: float = 0.0,
+               kill: bool = False,
                at_call: Optional[int] = None, rate: float = 1.0,
                rid: Optional[str] = None, op: Optional[str] = None,
                max_fires: Optional[int] = None) -> "FaultInjector":
         """Arm one fault spec; returns ``self`` so specs chain."""
-        if error is None and not stall_s and not skew_s:
-            raise ValueError(
-                "a fault needs an action: error=, stall_s= or skew_s=")
+        if error is None and not stall_s and not skew_s and not kill:
+            raise ValueError("a fault needs an action: error=, stall_s=, "
+                             "skew_s= or kill=")
         if not 0.0 <= rate <= 1.0:
             raise ValueError(f"rate must be in [0, 1], got {rate}")
         if max_fires is None and at_call is not None:
             max_fires = 1
         self._specs.append(_FaultSpec(
             point, error, stall_s, skew_s, at_call, rate, rid, op,
-            max_fires))
+            max_fires, kill))
         return self
+
+    def set_step(self, step: int) -> None:
+        """Record the engine's monotonic iteration index; every audit
+        entry from here on carries it (the serving engine calls this at
+        the top of each ``step()``)."""
+        self.step = int(step)
 
     @contextlib.contextmanager
     def disabled(self):
@@ -147,14 +177,18 @@ class FaultInjector:
             elif f.rate < 1.0 and self._rng.random() >= f.rate:
                 continue
             f.fires += 1
-            kind = ("error" if f.error is not None
+            kind = ("kill" if f.kill else "error" if f.error is not None
                     else "stall" if f.stall_s else "skew")
             who = rid or (f.rid if f.rid in rids else None) or op
-            self.fired.append((point, n, kind, who))
+            self.fired.append((point, n, kind, who, self.step))
             if f.skew_s:
                 self._skew += f.skew_s
             if f.stall_s:
                 time.sleep(f.stall_s)
+            if f.kill:
+                raise InjectedKill(
+                    f"injected kill at {point} #{n} (step {self.step})"
+                    f"{f' ({who})' if who else ''}")
             if f.error is not None:
                 raise InjectedFault(
                     f"injected {point} fault #{n}"

@@ -24,6 +24,9 @@ Layout:
 - ``metrics``    — TTFT / inter-token latency / queue depth / KV-block
   utilization / preemptions / failure counters, exported through
   runtime/dump.py
+- ``recovery``   — crash resilience: engine snapshot/restore over the
+  runtime/checkpoint Orbax path + the append-per-commit token journal
+  with exactly-once resumption (docs/serving.md "Crash recovery")
 """
 
 from triton_dist_tpu.serve.request import (  # noqa: F401
@@ -37,6 +40,11 @@ from triton_dist_tpu.serve.scheduler import FCFSScheduler  # noqa: F401
 from triton_dist_tpu.serve.metrics import (  # noqa: F401
     RequestMetrics,
     ServeMetrics,
+)
+from triton_dist_tpu.serve.recovery import (  # noqa: F401
+    TokenJournal,
+    has_restorable_state,
+    replay_journal,
 )
 from triton_dist_tpu.serve.engine import (  # noqa: F401
     ChainCommitted,

@@ -104,6 +104,32 @@ class BlockManager:
         table.extend(fresh)
         return fresh
 
+    def adopt(self, rid: str, blocks: list[int]) -> None:
+        """Impose a block table restored from a snapshot: claim exactly
+        ``blocks`` (in order) for ``rid``, removing them from the free
+        list.  The restore-time twin of :meth:`allocate` — the snapshot
+        already decided WHICH physical pages hold the request's KV, so
+        the allocator must adopt that mapping rather than hand out fresh
+        pages the restored pools never wrote."""
+        if rid in self._tables:
+            raise ValueError(f"request {rid!r} already has blocks")
+        blocks = [int(b) for b in blocks]
+        bad = [b for b in blocks
+               if b == self.null_block or not 0 < b < self.num_blocks]
+        if bad:
+            raise ValueError(f"{rid}: cannot adopt blocks {bad} "
+                             f"(null or outside pool {self.num_blocks})")
+        if len(set(blocks)) != len(blocks):
+            raise ValueError(f"{rid}: duplicate blocks in {blocks}")
+        missing = set(blocks) - set(self._free)
+        if missing:
+            raise ValueError(
+                f"{rid}: blocks {sorted(missing)} already owned — the "
+                f"snapshot tables overlap")
+        taken = set(blocks)
+        self._free = [b for b in self._free if b not in taken]
+        self._tables[rid] = blocks
+
     def free(self, rid: str) -> None:
         """Return all of ``rid``'s blocks to the free list."""
         for b in reversed(self._tables.pop(rid)):

@@ -52,6 +52,16 @@ wedged engine as a stale file.  A ``runtime.faults.FaultInjector``
 threads through the engine/block-manager seams so every containment
 path is exercised by deterministic chaos tests.
 
+The process itself is EXPENDABLE (PR 5, docs/serving.md "Crash
+recovery"): with ``snapshot_dir=`` every submit/commit/retire appends
+to a durable token journal and ``snapshot_every=N`` captures the paged
+KV pools + a state manifest through the ``runtime/checkpoint`` Orbax
+path; :meth:`ServeEngine.restore` rebuilds a fresh engine whose every
+resumed stream is bit-identical to the uninterrupted run — tokens are
+emitted exactly once across the crash (journal-matching rows resume in
+place, journal-ahead rows replay through the exact-recompute
+preemption path; serve/recovery.py holds the argument).
+
 v1 scope: world-1 mesh, float KV pools, dense-Llama-family ``Generator``
 (the same envelope as the r5 batched speculative verify; batch-1 SP +
 int8 serving keeps the contiguous `Generator.generate` path).
@@ -61,6 +71,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import os
 import sys
 import time
 from typing import Optional
@@ -94,6 +105,11 @@ from triton_dist_tpu.runtime.watchdog import (
 )
 from triton_dist_tpu.serve.block_manager import BlockExhausted, BlockManager
 from triton_dist_tpu.serve.metrics import RequestMetrics, ServeMetrics
+from triton_dist_tpu.serve.recovery import (
+    JOURNAL_NAME,
+    TokenJournal,
+    has_restorable_state,
+)
 from triton_dist_tpu.serve.request import (
     FinishReason,
     Request,
@@ -384,7 +400,10 @@ class ServeEngine:
                  heartbeat: Optional[str] = None,
                  heartbeat_interval_s: float = 10.0,
                  faults: Optional[FaultInjector] = None,
-                 fault_retries: int = 1):
+                 fault_retries: int = 1,
+                 snapshot_dir: Optional[str] = None,
+                 snapshot_every: Optional[int] = None,
+                 journal_fsync: bool = False):
         assert gen.attn.world == 1, (
             "ServeEngine is world-1 (the per-row block tables are host-"
             "managed); multi-chip serving keeps Generator.generate's SP "
@@ -448,6 +467,37 @@ class ServeEngine:
         if faults is not None:
             clock = faults.wrap_clock(clock)
         self._clock = clock
+        # crash recovery (docs/serving.md "Crash recovery"): with a
+        # snapshot_dir, every submit/commit/retire appends to the token
+        # journal, and snapshot_every=N captures the KV pools + manifest
+        # each N steps (the journal may run AHEAD of the KV snapshot;
+        # restore replays the journal-ahead suffix through recompute).
+        if snapshot_every is not None and snapshot_every < 1:
+            raise ValueError(
+                f"snapshot_every must be >= 1, got {snapshot_every}")
+        self.snapshot_dir = snapshot_dir
+        self.snapshot_every = snapshot_every
+        self._snap_seq = 0
+        self._last_snap_step = 0
+        self._in_warmup = False
+        self._journal: Optional[TokenJournal] = None
+        self._snap_mgr = None  # CheckpointManager, cached per directory
+        if snapshot_dir is not None:
+            os.makedirs(snapshot_dir, exist_ok=True)
+            jpath = os.path.join(snapshot_dir, JOURNAL_NAME)
+            if has_restorable_state(snapshot_dir):
+                # A FRESH engine appending a second life to an existing
+                # journal would interleave reused request ids with the
+                # previous run's records — replay keeps first
+                # occurrences, so a later restore would resurrect OLD
+                # prompts under new ids.  Only restore() may reopen a
+                # populated directory.
+                raise ValueError(
+                    f"snapshot_dir {snapshot_dir!r} already holds "
+                    f"serving state from a previous life; resume it "
+                    f"with ServeEngine.restore(...) or point the fresh "
+                    f"engine at a clean directory")
+            self._journal = TokenJournal(jpath, fsync=journal_fsync)
 
         # The scratch-extent bucket ladder: every prefill's s_ext (and
         # with it the _chunk_jit extent and the _fill_fn table width)
@@ -588,17 +638,27 @@ class ServeEngine:
                 "speculative engine mode serves greedy requests only")
         if req.arrival_time is None:
             req.arrival_time = self._clock()
-        rs = ReqState(req=req,
-                      metrics=RequestMetrics(arrival_time=req.arrival_time))
-        if (bounded and self.max_queue is not None
-                and self.scheduler.queue_depth >= self.max_queue):
+        overloaded = (bounded and self.max_queue is not None
+                      and self.scheduler.queue_depth >= self.max_queue)
+        if overloaded:
             # Bounded admission: shedding at submit() keeps an overload
             # from growing an unbounded queue of requests that would
             # only expire later — the caller learns immediately.
             msg = (f"queue at bound ({self.scheduler.queue_depth} >= "
                    f"max_queue {self.max_queue})")
             if self.overload == "raise":
+                # Raised BEFORE any journal record exists: the frontend
+                # was told this request never entered the engine, so a
+                # restore must not resurrect and serve it.
                 raise QueueFull(f"{req.request_id}: {msg}")
+        if self._journal_on(req.request_id):
+            # Journaled before the shed retirement below: a shed writes
+            # its finish record right after, so restore accounts it.
+            self._journal.submit(req)
+            self._note_journal()
+        rs = ReqState(req=req,
+                      metrics=RequestMetrics(arrival_time=req.arrival_time))
+        if overloaded:
             self._states[req.request_id] = rs
             self.metrics.shed += 1
             return self._retire(rs, FinishReason.SHED, free=False,
@@ -623,6 +683,64 @@ class ServeEngine:
         return bool(self.scheduler.waiting) or any(
             s is not None for s in self.slots)
 
+    def has_request(self, request_id: str) -> bool:
+        """True when the engine knows this id (queued, running, or
+        finished) — a resuming frontend uses it to skip re-submitting
+        requests the restored journal already carries."""
+        return request_id in self._states
+
+    # -- crash recovery ---------------------------------------------------
+
+    def _journal_on(self, rid: str) -> bool:
+        return self._journal is not None and not rid.startswith("__warmup_")
+
+    def _note_journal(self) -> None:
+        self.metrics.journal_records = self._journal.records
+        self.metrics.journal_bytes = self._journal.bytes
+
+    def snapshot(self, directory: Optional[str] = None) -> dict:
+        """Durably capture the FULL serving state — paged KV pools +
+        block tables (via the ``runtime/checkpoint`` Orbax path) and
+        per-request journal records (prompt, sampling params + PRNG
+        stream position, emitted tokens, kv_lens, status, deadline
+        timestamps) — such that :meth:`restore` rebuilds an engine whose
+        every resumed stream is bit-identical to the uninterrupted run.
+
+        Call between steps (the engine auto-snapshots there with
+        ``snapshot_every=N``).  ``directory`` defaults to the engine's
+        ``snapshot_dir``.  Returns ``{"step", "ms"}``; latency and
+        journal overhead ride ``metrics.summary()["recovery"]``.
+        See serve/recovery.py for the format and the exactly-once
+        argument; docs/serving.md "Crash recovery" for the recipe."""
+        from triton_dist_tpu.serve import recovery
+
+        d = directory or self.snapshot_dir
+        if d is None:
+            raise ValueError("snapshot() needs a directory: pass one or "
+                             "construct the engine with snapshot_dir=")
+        info = recovery.snapshot_engine(self, d)
+        # A one-shot capture to a foreign directory must not delay the
+        # next periodic home-directory snapshot.
+        if (self.snapshot_dir is not None
+                and os.path.abspath(d) == os.path.abspath(self.snapshot_dir)):
+            self._last_snap_step = self.metrics.steps
+        return info
+
+    @classmethod
+    def restore(cls, directory, gen, params, **kwargs) -> "ServeEngine":
+        """Rebuild an engine from :meth:`snapshot` state (plus the token
+        journal) under ``directory``.  Requests whose journal matches
+        the KV snapshot resume IN PLACE (pools, block table, pending
+        token); journal-ahead or non-fitting requests re-queue through
+        admission and replay via the exact-recompute preemption path —
+        either way every resumed stream is bit-identical to the
+        uninterrupted run.  See :func:`serve.recovery.restore_engine`
+        for the knobs (``on_token=`` re-attachment, ``replay_tokens=``,
+        geometry overrides)."""
+        from triton_dist_tpu.serve import recovery
+
+        return recovery.restore_engine(directory, gen, params, **kwargs)
+
     # -- the iteration ----------------------------------------------------
 
     def step(self) -> list[RequestOutput]:
@@ -635,6 +753,10 @@ class ServeEngine:
         speculation off and degrades to plain decode.  Only ``_FATAL``
         (watchdog trips, interrupts) escapes."""
         self._beat()
+        if self.faults is not None:
+            # The audit log stamps every firing with the engine step so
+            # a chaos schedule replays deterministically post-mortem.
+            self.faults.set_step(self.metrics.steps)
         now = self._clock()
         finished: list[RequestOutput] = []
 
@@ -686,6 +808,16 @@ class ServeEngine:
             queue_depth=self.scheduler.queue_depth,
             running=len([s for s in self.slots if s is not None]),
             kv_utilization=self.bm.utilization)
+        if (self.snapshot_every is not None
+                and self.snapshot_dir is not None
+                and not self._in_warmup
+                and self.metrics.steps - self._last_snap_step
+                >= self.snapshot_every):
+            # Incremental capture at the step boundary (no dispatch in
+            # flight).  A snapshot failure ESCALATES — durability is the
+            # contract, and serving on while silently not snapshotting
+            # would turn the next crash into unbounded recompute.
+            self.snapshot()
         return finished
 
     def run(self, max_steps: int = 100_000) -> dict[str, RequestOutput]:
@@ -752,6 +884,7 @@ class ServeEngine:
         self.metrics.compiled_fns = saved.compiled_fns
         guard = (self.faults.disabled() if self.faults is not None
                  else contextlib.nullcontext())
+        self._in_warmup = True  # dummy traffic must not trigger snapshots
         try:
             with guard:
                 prev, round_ = -1, 0
@@ -815,6 +948,7 @@ class ServeEngine:
                         del self._states[rid]
                     round_ += 1
         finally:
+            self._in_warmup = False
             self.metrics = saved
         dt = time.perf_counter() - t0
         fresh = self.metrics.compile_misses - misses0
@@ -1053,6 +1187,15 @@ class ServeEngine:
         rs.generated.append(token)
         rs.pending_token = token
         rs.metrics.on_token(now)
+        if self._journal_on(rs.req.request_id):
+            # The journal append PRECEDES the on_token callback: a crash
+            # in between re-derives nothing (the token is durable) and
+            # re-delivers nothing (restore resumes past it) — the stream
+            # is exactly-once; callback delivery for this one token is
+            # at-most-once (restore(replay_tokens=True) flips that).
+            self._journal.token(rs.req.request_id,
+                                len(rs.generated) - 1, token, now)
+            self._note_journal()
         if rs.req.on_token is not None and not rs.callback_disabled:
             try:
                 if self.faults is not None:
@@ -1087,6 +1230,10 @@ class ServeEngine:
         rs.scratch = None
         rs.pending_token = None
         rs.metrics.finish_time = now
+        if self._journal_on(rs.req.request_id):
+            self._journal.finish(rs.req.request_id, reason.value, error,
+                                 len(rs.generated), now)
+            self._note_journal()
         out = RequestOutput(request_id=rs.req.request_id,
                             prompt=rs.req.prompt,
                             token_ids=list(rs.generated),

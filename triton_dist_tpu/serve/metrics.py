@@ -124,6 +124,19 @@ class ServeMetrics:
     spec_bailouts: int = 0        # speculative rounds latched off
     # retirements by FinishReason.value
     finish_reasons: dict = field(default_factory=dict)
+    # crash-recovery counters (docs/serving.md "Crash recovery"):
+    # snapshot latency + journal overhead on the serving side, restore
+    # provenance on the resume side (how much state came back in place
+    # vs through exact recompute).
+    snapshots: int = 0            # engine.snapshot() captures
+    snapshot_ms_last: float = 0.0
+    snapshot_ms_total: float = 0.0
+    journal_records: int = 0      # journal appends by this engine
+    journal_bytes: int = 0
+    restores: int = 0             # 1 on an engine built by restore()
+    restored_in_place: int = 0    # requests resumed with live KV
+    restored_requeued: int = 0    # requests re-queued for recompute
+    restored_tokens: int = 0      # journal tokens carried across
     # compilation observability: CountingJit wrappers the engine
     # registers (runtime/jit_cache.py) + warmup accounting
     compiled_fns: list = field(default_factory=list, repr=False)
@@ -163,6 +176,20 @@ class ServeMetrics:
             "watchdog_trips": self.watchdog_trips,
             "spec_bailouts": self.spec_bailouts,
             "finish_reasons": dict(self.finish_reasons),
+        }
+
+    def recovery_stats(self) -> dict:
+        """Snapshot/journal/restore counters (summary()["recovery"])."""
+        return {
+            "snapshots": self.snapshots,
+            "snapshot_ms_last": self.snapshot_ms_last,
+            "snapshot_ms_total": self.snapshot_ms_total,
+            "journal_records": self.journal_records,
+            "journal_bytes": self.journal_bytes,
+            "restores": self.restores,
+            "restored_in_place": self.restored_in_place,
+            "restored_requeued": self.restored_requeued,
+            "restored_tokens": self.restored_tokens,
         }
 
     def decode_stats(self) -> dict:
@@ -240,6 +267,7 @@ class ServeMetrics:
             "mean_itl": sum(itls) / len(itls) if itls else None,
             "decode": self.decode_stats(),
             "failures": self.failure_stats(),
+            "recovery": self.recovery_stats(),
             "compilation": self.compile_stats(),
             "requests": {rid: m.to_dict()
                          for rid, m in self.requests.items()},

@@ -1,0 +1,879 @@
+"""Crash-resilient serving: engine snapshot/restore + the token journal.
+
+PR 3 contained faults *within* a live engine process; this module makes
+the process itself expendable.  A TPU preemption, an OOM-kill, or a host
+crash used to lose every in-flight request and every block of paged KV —
+here the full serving state becomes durable and a fresh process resumes
+every stream **bit-identically** to the uninterrupted run (the MegaScale
+/ Llumnix primitive: snapshot + exactly-once replay).
+
+Two cooperating artifacts live under one snapshot directory:
+
+``journal.jsonl``
+    An append-only token journal.  ``submit`` records (prompt, sampling
+    params — including the PRNG seed whose per-token ``fold_in`` stream
+    makes sampled recompute deterministic), one ``tok`` record per
+    committed token (appended the moment the engine commits, BEFORE the
+    ``on_token`` callback fires), and a ``fin`` record per retirement.
+    The journal is flushed per record, so it is never behind the tokens
+    the engine has emitted by more than the record being written.
+
+``kv/<step>/``
+    Orbax KV snapshots via :class:`runtime.checkpoint.CheckpointManager`
+    (tmp-dir + rename: a kill mid-snapshot leaves the previous snapshot
+    intact).  Each step dir holds the paged K/V pools plus a
+    ``meta.json`` manifest written into the SAME rename barrier: engine
+    geometry, block tables + free-list implied state, and per-request
+    device state (kv_lens, pending token, slot, deadline-relevant
+    timestamps).  The manifest also embeds each request's prompt,
+    params, and emitted tokens, so a snapshot is self-contained even
+    without the journal.
+
+**The exactly-once argument.**  The journal is the source of truth for
+*emission*; the KV snapshot is only an accelerator.  A token is emitted
+iff it is journaled; generation is deterministic given (prompt, params,
+emission index) — greedy by argmax, sampled via the per-request
+``fold_in(key(seed), index)`` stream — so on restore:
+
+- tokens **in** the journal are restored into ``generated`` and never
+  re-derived → never double-emitted, even when the crash landed between
+  the device KV commit and the journal append (the device-side token
+  simply recomputes to the identical value);
+- tokens the device committed but the journal never saw are re-derived
+  bit-identically through the exact-recompute preemption path
+  (``work_prompt = prompt + generated``) → never dropped.
+
+When the KV snapshot lags the journal (incremental mode:
+``snapshot_every=N`` steps while the journal appends per commit), the
+journal-ahead suffix replays through that same recompute path; a request
+whose journal count matches the snapshot resumes *in place* — pools,
+block table, pending token — with zero recompute.  Restore onto a
+DIFFERENT engine geometry degrades the same way: requests whose blocks
+no longer fit re-queue through admission and recompute, and streams stay
+bit-exact because the per-request token function never depended on the
+geometry.  Quarantined (ERROR), shed, and expired requests restore as
+*finished* — a poisoned request is never resurrected.
+
+Callback delivery across the crash is at-most-once for the single
+in-flight token (journaled, then the process died before its
+``on_token`` ran); ``restore(..., replay_tokens=True)`` flips that to
+at-least-once by re-firing callbacks for every journaled token.  The
+emitted *stream* is exactly-once either way.
+
+See docs/serving.md "Crash recovery"; chaos coverage lives in
+tests/test_serve_recovery.py (kill/restart at every crash window).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Union
+
+import jax
+import numpy as np
+
+from triton_dist_tpu.runtime import checkpoint as ck
+from triton_dist_tpu.serve.metrics import RequestMetrics
+from triton_dist_tpu.serve.request import (
+    FinishReason,
+    Request,
+    RequestOutput,
+    SamplingParams,
+)
+from triton_dist_tpu.serve.scheduler import ReqState, Status
+
+SNAPSHOT_FORMAT = 1
+JOURNAL_NAME = "journal.jsonl"
+KV_SUBDIR = "kv"
+META_NAME = "meta.json"
+
+
+# ---------------------------------------------------------------------------
+# The token journal
+# ---------------------------------------------------------------------------
+
+
+class TokenJournal:
+    """Append-only JSONL journal of submissions, token commits, and
+    retirements.  Flushed per record (optionally fsynced with
+    ``fsync=True`` — the engine's ``journal_fsync``); :meth:`sync`
+    forces durability at snapshot barriers regardless."""
+
+    def __init__(self, path: str | os.PathLike, *, fsync: bool = False):
+        self.path = os.path.abspath(os.fspath(path))
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._heal_torn_tail()
+        self._f = open(self.path, "a", encoding="utf-8")
+        self.fsync = bool(fsync)
+        self.records = 0   # appended by THIS process (not the file total)
+        self.bytes = 0
+
+    def _heal_torn_tail(self) -> None:
+        """Truncate a partial final line before appending: a crash
+        mid-append leaves a torn record, and appending to it would glue
+        the NEXT record onto the garbage — corrupting a healthy commit,
+        not just the already-lost one.  Scans backward in windows, so a
+        torn record of ANY size (a submit with a very long prompt can
+        exceed one window) truncates to the last complete line rather
+        than taking healthy earlier records with it."""
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb+") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            if not size:
+                return
+            pos = size
+            while pos > 0:
+                back = min(pos, 1 << 16)
+                f.seek(pos - back)
+                chunk = f.read(back)
+                if pos == size and chunk.endswith(b"\n"):
+                    return            # tail is whole
+                cut = chunk.rfind(b"\n")
+                if cut >= 0:
+                    f.truncate(pos - back + cut + 1)
+                    return
+                pos -= back
+            f.truncate(0)             # a single torn line was the file
+
+    def append(self, rec: dict) -> None:
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        self._f.write(line)
+        self._f.flush()
+        if self.fsync:
+            os.fsync(self._f.fileno())
+        self.records += 1
+        self.bytes += len(line)
+
+    def submit(self, req: Request) -> None:
+        self.append({"t": "submit", "rid": req.request_id,
+                     "prompt": [int(x) for x in req.prompt],
+                     "params": req.params.to_dict(),
+                     "ts": req.arrival_time})
+
+    def token(self, rid: str, index: int, tok: int, ts: float) -> None:
+        self.append({"t": "tok", "rid": rid, "i": int(index),
+                     "tok": int(tok), "ts": ts})
+
+    def finish(self, rid: str, reason: str, error: Optional[str],
+               n_tokens: int, ts: float) -> None:
+        self.append({"t": "fin", "rid": rid, "reason": reason,
+                     "err": error, "n": int(n_tokens), "ts": ts})
+
+    def sync(self) -> None:
+        """Force everything appended so far to disk (snapshot barrier)."""
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except Exception:  # noqa: BLE001 — crash-path best effort
+            pass
+
+
+@dataclass
+class JournalRequest:
+    """One request's journal view after :func:`replay_journal`."""
+
+    rid: str
+    prompt: Optional[np.ndarray] = None
+    params: Optional[SamplingParams] = None
+    arrival: Optional[float] = None
+    tokens: dict = field(default_factory=dict)   # index -> (tok, ts)
+    finish: Optional[dict] = None                # {"reason","err","n","ts"}
+
+    def token_list(self) -> list[int]:
+        """Emitted tokens in order (the contiguous prefix from 0 — a gap
+        means a corrupt journal and truncates the replay there)."""
+        out = []
+        i = 0
+        while i in self.tokens:
+            out.append(self.tokens[i][0])
+            i += 1
+        return out
+
+    def token_times(self) -> list[float]:
+        out = []
+        i = 0
+        while i in self.tokens:
+            out.append(self.tokens[i][1])
+            i += 1
+        return out
+
+
+def replay_journal(path: str | os.PathLike) -> dict[str, JournalRequest]:
+    """Parse a journal into per-request state, in submit order.
+
+    Tolerant of exactly the damage a crash can cause: a torn final line
+    (the process died mid-append) is skipped, and a duplicate record
+    keeps its first occurrence.  Returns ``{}`` when no journal exists.
+    """
+    out: dict[str, JournalRequest] = {}
+    if not os.path.exists(path):
+        return out
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn by the crash mid-append
+            rid = rec.get("rid")
+            if rid is None:
+                continue
+            jr = out.setdefault(rid, JournalRequest(rid=rid))
+            t = rec.get("t")
+            if t == "submit" and jr.prompt is None:
+                jr.prompt = np.asarray(rec["prompt"], np.int32)
+                jr.params = SamplingParams.from_dict(rec["params"])
+                jr.arrival = rec.get("ts")
+            elif t == "tok":
+                jr.tokens.setdefault(int(rec["i"]),
+                                     (int(rec["tok"]), rec.get("ts")))
+            elif t == "fin" and jr.finish is None:
+                jr.finish = {"reason": rec["reason"],
+                             "err": rec.get("err"),
+                             "n": rec.get("n"), "ts": rec.get("ts")}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Snapshot
+# ---------------------------------------------------------------------------
+
+
+def _pool_tree(engine) -> dict:
+    """The paged pools as a flat dict orbax round-trips losslessly."""
+    tree = {}
+    for i, (k, v) in enumerate(engine._pools):
+        tree[f"l{i}_k"] = k
+        tree[f"l{i}_v"] = v
+    return tree
+
+
+def _capture_meta(engine, now: float, *, journal_here: bool) -> dict:
+    reqs = {}
+    for rid, rs in engine._states.items():
+        if rid.startswith("__warmup_") or rs.status is Status.FINISHED:
+            continue
+        reqs[rid] = {
+            "status": rs.status.value,
+            "slot": rs.slot,
+            "kv_len": rs.kv_len,
+            "gen": [int(t) for t in rs.generated],
+            "pending": (int(rs.pending_token)
+                        if rs.pending_token is not None else None),
+            "seq": rs.seq,
+            "cb_off": rs.callback_disabled,
+            "arrival": rs.req.arrival_time,
+            "prompt": [int(x) for x in np.asarray(rs.req.prompt)],
+            "params": rs.req.params.to_dict(),
+            "first_sched": rs.metrics.first_scheduled_time,
+            "first_tok": rs.metrics.first_token_time,
+            "token_times": list(rs.metrics.token_times),
+            "n_preempt": rs.metrics.n_preemptions,
+        }
+    # Finished requests ride the manifest only when this directory has
+    # no co-located journal to carry them (a one-shot snapshot to a
+    # foreign dir): with the journal here, every retirement already has
+    # its submit/tok/fin records (restore backfills prior lives), and
+    # re-serializing the full served history into every capture would
+    # make the snapshot hot-path cost grow with total requests served.
+    outs = {}
+    if not journal_here:
+        for rid, out in engine._outputs.items():
+            if rid.startswith("__warmup_"):
+                continue
+            outs[rid] = {
+                "prompt": [int(x) for x in np.asarray(out.prompt)],
+                "tokens": [int(t) for t in out.token_ids],
+                "reason": out.finish_reason.value,
+                "error": out.error,
+                "arrival": out.metrics.arrival_time,
+            }
+    cfg = engine.cfg
+    return {
+        "format": SNAPSHOT_FORMAT,
+        "clock": now,
+        "engine": {
+            "num_blocks": engine.bm.num_blocks,
+            "page_size": engine.page,
+            "max_batch": engine.max_batch,
+            "max_seq": engine.gen.max_seq,
+            "prefill_chunk": engine.scheduler.prefill_chunk,
+            "prefill_budget": engine.scheduler.prefill_budget,
+            "horizon": engine.horizon,
+            "pipeline": engine.pipeline,
+            "spec_k": engine.spec_k,
+            "snapshot_every": engine.snapshot_every,
+            "n_layers": cfg.n_layers,
+            "n_kv_heads": cfg.n_kv_heads,
+            "head_dim": cfg.head_dim,
+            "kv_dtype": str(np.dtype(cfg.dtype)),
+        },
+        "spec_off": engine._spec_off,
+        "seq_counter": engine.scheduler._seq,
+        "waiting": [rs.req.request_id for rs in engine.scheduler.waiting
+                    if not rs.req.request_id.startswith("__warmup_")],
+        "tables": {rid: list(t) for rid, t in engine.bm._tables.items()
+                   if not rid.startswith("__warmup_")},
+        "requests": reqs,
+        "outputs": outs,
+    }
+
+
+def snapshot_engine(engine, directory: str | os.PathLike) -> dict:
+    """Durably capture ``engine``'s full serving state under
+    ``directory`` (called between steps — no dispatch may be in
+    flight).  Returns ``{"step", "ms"}``; latency and counts land in
+    ``engine.metrics`` (``summary()["recovery"]``).
+
+    Ordering is the correctness contract: the journal syncs FIRST (the
+    KV snapshot may lag the journal, never the reverse), then pools +
+    manifest publish atomically through the checkpoint manager's
+    tmp-dir + rename barrier.  The ``snapshot`` fault point fires twice
+    per capture — before the KV write (call 2k+1) and inside the
+    tmp-written-but-unrenamed window (call 2k+2) — so the chaos tests
+    can land a kill in either crash window.
+    """
+    t0 = time.perf_counter()
+    directory = os.path.abspath(os.fspath(directory))
+    os.makedirs(directory, exist_ok=True)
+    now = engine._clock()
+    journal_here = (engine._journal is not None
+                    and os.path.dirname(engine._journal.path) == directory)
+    if engine._journal is not None:
+        engine._journal.sync()
+    meta = _capture_meta(engine, now, journal_here=journal_here)
+    if engine.faults is not None:
+        engine.faults.fire("snapshot")
+    # The home-directory manager is cached on the engine: its init
+    # scans the directory (stale-.tmp GC + cross-host sync) — once is
+    # enough on the periodic capture path that snapshot_ms meters.  A
+    # one-shot snapshot to a FOREIGN directory must not disturb the
+    # home state: it gets its own manager and step numbering, and the
+    # engine's periodic cadence (_snap_seq, cached manager) is
+    # untouched.
+    kvdir = os.path.abspath(os.path.join(directory, KV_SUBDIR))
+    home = (engine.snapshot_dir is not None
+            and os.path.abspath(engine.snapshot_dir) == directory)
+    mgr = engine._snap_mgr if home else None
+    if mgr is None or mgr.directory != kvdir:
+        mgr = ck.CheckpointManager(kvdir, max_to_keep=2)
+        if home:
+            engine._snap_mgr = mgr
+    hook = None
+    if engine.faults is not None:
+        def hook(tmp_path, _f=engine.faults):
+            _f.fire("snapshot")
+    if home:
+        step = engine._snap_seq
+    else:
+        last = mgr.latest_step()
+        step = 0 if last is None else last + 1
+    mgr.save(step, _pool_tree(engine),
+             extras={META_NAME: json.dumps(meta)},
+             on_before_finalize=hook)
+    if home:
+        engine._snap_seq = step + 1
+    ms = (time.perf_counter() - t0) * 1e3
+    m = engine.metrics
+    m.snapshots += 1
+    m.snapshot_ms_last = ms
+    m.snapshot_ms_total += ms
+    return {"step": step, "ms": ms}
+
+
+def has_restorable_state(directory: str | os.PathLike) -> bool:
+    """True when :func:`restore_engine` has anything to rebuild from: a
+    non-empty journal or at least one PUBLISHED KV snapshot step.  A
+    bare ``journal.jsonl`` the constructor touched before the process
+    died carries no state — resuming from it would fail, and a fresh
+    engine may safely reopen the directory."""
+    d = os.fspath(directory)
+    j = os.path.join(d, JOURNAL_NAME)
+    if os.path.exists(j) and os.path.getsize(j) > 0:
+        return True
+    kvdir = os.path.join(d, KV_SUBDIR)
+    if not os.path.isdir(kvdir):
+        return False
+    return any(name.isdigit() for name in os.listdir(kvdir))
+
+
+def _load_latest_snapshot(directory: str) -> Optional[tuple]:
+    """(step, meta, pools dict) for the newest READABLE snapshot, or
+    None.  Walks newest → oldest like ``restore_latest`` — a snapshot
+    torn by a concurrent kill falls back to the previous one.  Opens
+    the manager read-only (``clean_tmp=False``): restore may run while
+    another process is mid-snapshot (a standby peeking at a live
+    engine's directory), and GC-ing ``.tmp`` here would tear that
+    writer's save; orphans are reclaimed by the next WRITER instead
+    (the restored engine's first snapshot)."""
+    kvdir = os.path.join(directory, KV_SUBDIR)
+    if not os.path.isdir(kvdir):
+        return None
+    mgr = ck.CheckpointManager(kvdir, max_to_keep=2, clean_tmp=False)
+    for step in reversed(mgr.all_steps()):
+        step_dir = os.path.join(kvdir, str(step))
+        try:
+            with open(os.path.join(step_dir, META_NAME)) as f:
+                meta = json.load(f)
+        except Exception:  # noqa: BLE001 — torn snapshot: fall back
+            continue
+        # A format mismatch is a code/snapshot version skew, not a torn
+        # write — raise it instead of silently walking past (the
+        # fallback would otherwise resume from a stale snapshot or fail
+        # later with an unrelated journal-only error).
+        if meta.get("format") != SNAPSHOT_FORMAT:
+            raise ValueError(
+                f"snapshot {step_dir} has format {meta.get('format')}; "
+                f"this build reads format {SNAPSHOT_FORMAT}")
+        try:
+            e = meta["engine"]
+            dtype = np.dtype(e["kv_dtype"])
+            shape = (e["num_blocks"], e["n_kv_heads"], e["page_size"],
+                     e["head_dim"])
+            like = {}
+            for i in range(e["n_layers"]):
+                like[f"l{i}_k"] = jax.ShapeDtypeStruct(shape, dtype)
+                like[f"l{i}_v"] = jax.ShapeDtypeStruct(shape, dtype)
+            pools = ck.restore(step_dir, like)
+            return step, meta, pools
+        except Exception:  # noqa: BLE001 — torn snapshot: fall back
+            continue
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Restore
+# ---------------------------------------------------------------------------
+
+
+def _resolve_callback(on_token, rid: str) -> Optional[Callable]:
+    if on_token is None:
+        return None
+    if callable(on_token):
+        return on_token
+    return on_token.get(rid)
+
+
+def _shift(ts: Optional[float], offset: float) -> Optional[float]:
+    return None if ts is None else ts + offset
+
+
+_META_KW = ("num_blocks", "page_size", "max_batch", "prefill_chunk",
+            "prefill_budget", "horizon", "pipeline", "snapshot_every")
+
+
+def restore_engine(directory: str | os.PathLike, gen, params, *,
+                   draft=None, draft_params=None,
+                   clock=time.monotonic,
+                   on_token: Union[None, Callable, dict] = None,
+                   replay_tokens: bool = False,
+                   faults=None, journal_fsync: bool = False,
+                   **overrides):
+    """Rebuild a :class:`ServeEngine` from the snapshot + journal under
+    ``directory`` (the implementation of ``ServeEngine.restore``).
+
+    ``gen``/``params`` (and ``draft``/``draft_params`` for speculative
+    engines) are the caller's — model weights are not snapshotted, like
+    any serving deployment they come from the model store.  Engine
+    geometry defaults to the snapshot manifest's; any ``overrides``
+    (``num_blocks=``, ``max_batch=``, ``horizon=``, ...) win, and
+    requests that no longer fit the overridden geometry re-queue through
+    admission and recompute (streams stay bit-exact — see the module
+    docstring).  ``on_token`` re-attaches streaming callbacks (one
+    callable for all requests, or a ``{rid: callable}`` map);
+    ``replay_tokens=True`` re-fires them for every journaled token
+    (at-least-once delivery for the crash-window token instead of the
+    default at-most-once).
+    """
+    from triton_dist_tpu.serve.engine import ServeEngine
+
+    directory = os.path.abspath(os.fspath(directory))
+    snap = _load_latest_snapshot(directory)
+    journal = replay_journal(os.path.join(directory, JOURNAL_NAME))
+    if snap is None and not journal:
+        raise FileNotFoundError(
+            f"no restorable snapshot or journal under {directory}")
+    step, meta, pools_raw = snap if snap is not None else (None, None, None)
+
+    kw: dict[str, Any] = {}
+    if meta is not None:
+        for k in _META_KW:
+            kw[k] = meta["engine"][k]
+        if draft is not None:
+            kw["spec_k"] = meta["engine"]["spec_k"]
+    kw.update(overrides)
+    if "num_blocks" not in kw or "page_size" not in kw:
+        raise ValueError(
+            "journal-only restore (no KV snapshot) needs explicit engine "
+            "geometry: pass num_blocks=, page_size=, ... as overrides")
+    snap_every = kw.pop("snapshot_every", None)
+    if snap_every is not None and snap_every < 1:
+        raise ValueError(f"snapshot_every must be >= 1, got {snap_every}")
+    # Constructed journal-less, then wired by hand: the engine refuses
+    # a populated snapshot_dir at construction (a FRESH life appending
+    # there would corrupt replay) — restore is the one sanctioned way
+    # to reopen it.
+    engine = ServeEngine(gen, params, draft=draft,
+                         draft_params=draft_params, clock=clock,
+                         faults=faults, **kw)
+    engine.snapshot_dir = directory
+    engine.snapshot_every = snap_every
+    engine._journal = TokenJournal(os.path.join(directory, JOURNAL_NAME),
+                                   fsync=journal_fsync)
+    if meta is not None:
+        engine._snap_seq = step + 1
+        engine._spec_off = bool(meta.get("spec_off", False))
+
+    # -- pools: reusable iff the per-page geometry survived ---------------
+    pools_ok = False
+    if pools_raw is not None:
+        e = meta["engine"]
+        cfg = engine.cfg
+        same_geom = (e["page_size"] == engine.page
+                     and e["n_layers"] == cfg.n_layers
+                     and e["n_kv_heads"] == cfg.n_kv_heads
+                     and e["head_dim"] == cfg.head_dim
+                     and e["kv_dtype"] == str(np.dtype(cfg.dtype)))
+        if same_geom:
+            import jax.numpy as jnp
+
+            n_copy = min(e["num_blocks"], engine.bm.num_blocks)
+            new_pools = []
+            for i, (k, v) in enumerate(engine._pools):
+                ko, vo = pools_raw[f"l{i}_k"], pools_raw[f"l{i}_v"]
+                if ko.shape == k.shape:
+                    new_pools.append((jnp.asarray(ko), jnp.asarray(vo)))
+                else:
+                    # Different block count: the overlapping pool rows
+                    # carry over; requests whose tables reach past them
+                    # recompute instead of resuming in place.
+                    new_pools.append(
+                        (k.at[:n_copy].set(jnp.asarray(ko)[:n_copy]),
+                         v.at[:n_copy].set(jnp.asarray(vo)[:n_copy])))
+            engine._pools = new_pools
+            pools_ok = True
+
+    # -- merge journal over manifest --------------------------------------
+    m_reqs = meta["requests"] if meta is not None else {}
+    m_outs = meta["outputs"] if meta is not None else {}
+    m_tables = meta["tables"] if meta is not None else {}
+
+    resolved: dict[str, dict] = {}
+    order: list[str] = []
+
+    def slot_for(rid) -> dict:
+        if rid not in resolved:
+            resolved[rid] = {"rid": rid}
+            order.append(rid)
+        return resolved[rid]
+
+    for rid in list(m_reqs) + [r for r in m_outs if r not in m_reqs]:
+        r = slot_for(rid)
+        src = m_reqs.get(rid) or m_outs[rid]
+        r["prompt"] = np.asarray(src["prompt"], np.int32)
+        r["params"] = (SamplingParams.from_dict(src["params"])
+                       if "params" in src else SamplingParams())
+        r["arrival"] = src.get("arrival")
+        if rid in m_reqs:
+            r["tokens"] = list(m_reqs[rid]["gen"])
+            r["tok_ts"] = list(m_reqs[rid].get("token_times", []))
+        else:
+            r["tokens"] = list(m_outs[rid]["tokens"])
+            r["tok_ts"] = []
+        if rid in m_outs:
+            r["finish"] = {"reason": m_outs[rid]["reason"],
+                           "err": m_outs[rid]["error"], "ts": None}
+    for rid, jr in journal.items():
+        r = slot_for(rid)
+        if jr.prompt is not None:
+            r.setdefault("prompt", jr.prompt)
+            r.setdefault("params", jr.params)
+            r.setdefault("arrival", jr.arrival)
+        toks = jr.token_list()
+        # The journal syncs before every snapshot, so it is a superset
+        # of the manifest's token view — prefer it whenever longer (the
+        # journal-ahead suffix is what recompute replays).
+        if len(toks) >= len(r.get("tokens", [])):
+            r["tokens"] = toks
+            r["tok_ts"] = jr.token_times()
+        if jr.finish is not None:
+            r["finish"] = jr.finish
+    # A rid only ever seen as a finish/token record (its submit line was
+    # torn away with the crash) cannot be rebuilt — drop it.
+    order = [rid for rid in order if resolved[rid].get("prompt") is not None]
+
+    if meta is not None:
+        old_now = meta["clock"]
+    else:
+        # Journal-only restore: the newest old-clock timestamp anywhere
+        # in the journal (token commit, submit, or finish) stands in for
+        # the snapshot clock.  Token times alone are not enough — a kill
+        # before the first commit would leave old_now at 0, pushing every
+        # re-based arrival into the future and deadline TTLs with it.
+        old_now = max(
+            [ts for jr in journal.values()
+             for _, ts in jr.tokens.values() if ts is not None] +
+            [jr.arrival for jr in journal.values()
+             if jr.arrival is not None] +
+            [jr.finish["ts"] for jr in journal.values()
+             if jr.finish is not None and jr.finish.get("ts") is not None],
+            default=0.0)
+    offset = engine._clock() - (old_now or 0.0)
+
+    # -- rebuild finished requests (accounting only; never re-queued) -----
+    m = engine.metrics
+
+    def finish_restored(rid: str, reason: FinishReason,
+                        finish_ts: Optional[float],
+                        err: Optional[str] = None) -> ReqState:
+        # Every timestamp lands on the new clock base (shifted by
+        # offset, like build_state's live rows) so restored durations
+        # never mix clock lives.
+        r = resolved[rid]
+        rm = RequestMetrics(
+            arrival_time=_shift(r["arrival"], offset) or 0.0)
+        rm.token_times = [_shift(t, offset)
+                          for t in (r.get("tok_ts") or []) if t is not None]
+        if rm.token_times:
+            rm.first_token_time = rm.token_times[0]
+        rm.finish_time = finish_ts
+        req = Request(rid, r["prompt"], r["params"],
+                      arrival_time=rm.arrival_time)
+        rs = ReqState(req=req, metrics=rm, status=Status.FINISHED)
+        rs.generated = list(r["tokens"])
+        out = RequestOutput(request_id=rid, prompt=req.prompt,
+                            token_ids=list(r["tokens"]),
+                            finish_reason=reason, metrics=rm, error=err)
+        engine._states[rid] = rs
+        engine._outputs[rid] = out
+        m.observe_finish(rid, rm, reason)
+        return rs
+
+    inflight: list[str] = []
+    for rid in order:
+        r = resolved[rid]
+        if r.get("finish") is None:
+            inflight.append(rid)
+            continue
+        reason = FinishReason(r["finish"]["reason"])
+        finish_restored(rid, reason, _shift(r["finish"].get("ts"), offset),
+                        err=r["finish"].get("err"))
+        if reason is FinishReason.SHED:
+            m.shed += 1
+        elif reason is FinishReason.DEADLINE:
+            m.deadline_expired += 1
+        elif reason is FinishReason.ERROR:
+            m.quarantined += 1
+
+    # -- close the commit→retire crash window -----------------------------
+    # A kill can land after a token's journal append but before the
+    # retire that token triggers (its EOS, or the max_new_tokens
+    # boundary).  The journal then shows a COMPLETE stream with no fin
+    # record; re-queueing it would generate past the request's budget.
+    # Finish it here — bit-identical to the retire the crash swallowed.
+    def stream_done(rid: str) -> Optional[FinishReason]:
+        r = resolved[rid]
+        p = r["params"]
+        if (p.eos_id is not None and r["tokens"]
+                and r["tokens"][-1] == p.eos_id):
+            return FinishReason.EOS
+        if len(r["tokens"]) >= p.max_new_tokens:
+            return FinishReason.LENGTH
+        return None
+
+    still = []
+    window_finished: list[str] = []
+    for rid in inflight:
+        reason = stream_done(rid)
+        if reason is None:
+            still.append(rid)
+            continue
+        rs = finish_restored(rid, reason, engine._clock())
+        m.restored_tokens += len(rs.generated)
+        window_finished.append(rid)
+        # the swallowed retire's fin record lands via the journal
+        # backfill below (the single fin writer at restore)
+    inflight = still
+
+    # -- classify in-flight requests: resume in place vs recompute --------
+    def resumable(rid: str) -> bool:
+        mr = m_reqs.get(rid)
+        if not (pools_ok and mr is not None
+                and mr["status"] == Status.RUNNING.value
+                and mr["pending"] is not None
+                and not engine.spec_k and not meta["engine"]["spec_k"]):
+            return False
+        r = resolved[rid]
+        if len(r["tokens"]) != len(mr["gen"]):
+            return False  # journal ran ahead of the KV snapshot
+        table = m_tables.get(rid)
+        if table is None or len(table) > engine.n_pages_max:
+            return False
+        if any(b >= engine.bm.num_blocks for b in table):
+            return False  # shrunk pool: those rows don't exist any more
+        total = int(r["prompt"].shape[0]) + r["params"].max_new_tokens
+        return total <= engine.gen.max_seq
+
+    resume = [rid for rid in inflight if resumable(rid)]
+    resume.sort(key=lambda rid: m_reqs[rid]["seq"])
+    resume_set = set(resume)
+    requeue = [rid for rid in inflight if rid not in resume_set]
+    # Re-queue order: previously admitted rows first (admission order),
+    # then the old waiting line, then post-snapshot journal-only
+    # arrivals in submit order — FCFS fairness survives the crash.
+    requeue_set = set(requeue)
+    admitted = sorted((rid for rid in requeue if rid in m_reqs
+                       and m_reqs[rid]["status"] != Status.WAITING.value),
+                      key=lambda rid: m_reqs[rid]["seq"])
+    waiting = [rid for rid in meta["waiting"] if rid in requeue_set] \
+        if meta is not None else []
+    placed = set(admitted) | set(waiting)
+    rest = [rid for rid in requeue if rid not in placed]
+    requeue = admitted + waiting + rest
+
+    free_slots = [i for i in range(engine.max_batch)]
+
+    def build_state(rid: str) -> ReqState:
+        r = resolved[rid]
+        mr = m_reqs.get(rid, {})
+        rm = RequestMetrics(
+            arrival_time=_shift(r["arrival"], offset) or engine._clock())
+        rm.first_scheduled_time = _shift(mr.get("first_sched"), offset)
+        rm.first_token_time = _shift(mr.get("first_tok"), offset)
+        rm.token_times = [_shift(t, offset) for t in (r.get("tok_ts") or [])
+                          if t is not None]
+        if rm.token_times and rm.first_token_time is None:
+            rm.first_token_time = rm.token_times[0]
+        rm.n_preemptions = mr.get("n_preempt", 0)
+        req = Request(rid, r["prompt"], r["params"],
+                      arrival_time=rm.arrival_time,
+                      on_token=_resolve_callback(on_token, rid))
+        rs = ReqState(req=req, metrics=rm)
+        rs.generated = list(r["tokens"])
+        rs.journal_base = len(rs.generated)
+        rs.callback_disabled = bool(mr.get("cb_off", False))
+        return rs
+
+    resumed: list[str] = []
+    for rid in resume:
+        mr = m_reqs[rid]
+        slot = mr["slot"] if mr["slot"] in free_slots else (
+            free_slots[0] if free_slots else None)
+        if slot is None:  # geometry shrank under us: recompute instead
+            requeue.insert(0, rid)
+            continue
+        free_slots.remove(slot)
+        rs = build_state(rid)
+        engine.bm.adopt(rid, m_tables[rid])
+        rs.status = Status.RUNNING
+        rs.slot = slot
+        rs.kv_len = mr["kv_len"]
+        rs.pending_token = mr["pending"]
+        rs.seq = mr["seq"]
+        engine.slots[slot] = rs
+        engine._states[rid] = rs
+        resumed.append(rid)
+        m.restored_in_place += 1
+        m.restored_tokens += len(rs.generated)
+
+    for rid in requeue:
+        r = resolved[rid]
+        total = int(r["prompt"].shape[0]) + r["params"].max_new_tokens
+        rs = build_state(rid)
+        if (total > engine.gen.max_seq
+                or engine.bm.blocks_for(total) > engine.bm.num_allocatable):
+            # The restored geometry can NEVER serve this request; parking
+            # it in the queue would wedge FCFS admission forever.
+            rs.status = Status.FINISHED
+            msg = (f"restored engine cannot serve {total} tokens "
+                   f"(max_seq {engine.gen.max_seq}, "
+                   f"{engine.bm.num_allocatable} allocatable blocks)")
+            rm2 = rs.metrics
+            rm2.finish_time = engine._clock()
+            out = RequestOutput(request_id=rid, prompt=rs.req.prompt,
+                                token_ids=list(rs.generated),
+                                finish_reason=FinishReason.ERROR,
+                                metrics=rm2, error=msg)
+            engine._states[rid] = rs
+            engine._outputs[rid] = out
+            m.quarantined += 1
+            m.observe_finish(rid, rm2, FinishReason.ERROR)
+            # fin record lands via the backfill below; its tokens were
+            # NOT carried anywhere, so restored_tokens excludes them
+            continue
+        if rs.generated:
+            rs.work_prompt = np.concatenate(
+                [rs.req.prompt, np.asarray(rs.generated, np.int32)])
+        rs.status = Status.WAITING
+        engine._states[rid] = rs
+        engine.scheduler.add(rs)
+        m.restored_requeued += 1
+        m.restored_tokens += len(rs.generated)
+
+    seqs = [s.seq for s in engine.slots if s is not None]
+    engine.scheduler._seq = max(
+        [meta["seq_counter"] if meta is not None else 0] +
+        [s + 1 for s in seqs])
+
+    # -- journal backfill: keep the journal self-contained ----------------
+    # A restored engine appends future commits at index journal_base;
+    # when the state came from a manifest the journal never saw (a
+    # snapshot taken by an engine without a journal, or a journal lost
+    # with its disk), those earlier indices would be a GAP — and a
+    # second crash would replay a truncated stream.  Backfill the
+    # missing submit/token/finish records now, so every life leaves a
+    # journal any later restore can trust on its own.
+    if engine._journal is not None:
+        for rid, rs in engine._states.items():
+            jr = journal.get(rid)
+            if jr is None or jr.prompt is None:
+                engine._journal.submit(rs.req)
+            have = len(jr.token_list()) if jr is not None else 0
+            times = rs.metrics.token_times
+            for i in range(have, len(rs.generated)):
+                ts = times[i] if i < len(times) else engine._clock()
+                engine._journal.token(rid, i, rs.generated[i], ts)
+            if (rs.status is Status.FINISHED
+                    and (jr is None or jr.finish is None)):
+                out = engine._outputs[rid]
+                engine._journal.finish(
+                    rid, out.finish_reason.value, out.error,
+                    len(out.token_ids),
+                    rs.metrics.finish_time or engine._clock())
+        engine._note_journal()
+
+    if replay_tokens and on_token is not None:
+        for rid in resumed + requeue:
+            rs = engine._states[rid]
+            cb = rs.req.on_token
+            if (cb is None or rs.callback_disabled
+                    or rs.status is Status.FINISHED):
+                continue  # finished-at-restore rows don't re-stream
+            for tok in rs.generated[:rs.journal_base]:
+                cb(rid, tok)
+        # A stream that completed exactly at the crash (fin record
+        # swallowed) still owes its in-flight callback — a fin record
+        # on disk proves the pre-crash retire (and with it every
+        # callback) ran, its absence proves nothing.  Re-fire the whole
+        # journaled stream: same at-least-once contract as live rows.
+        for rid in window_finished:
+            cb = _resolve_callback(on_token, rid)
+            if cb is None or m_reqs.get(rid, {}).get("cb_off", False):
+                continue
+            for tok in engine._states[rid].generated:
+                cb(rid, tok)
+
+    m.restores += 1
+    return engine

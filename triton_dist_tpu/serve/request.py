@@ -65,6 +65,25 @@ class SamplingParams:
     def greedy(self) -> bool:
         return self.temperature == 0.0
 
+    def to_dict(self) -> dict:
+        """JSON-safe form for the recovery token journal — everything
+        deterministic replay needs, notably ``seed`` (the per-token
+        ``fold_in`` stream) and ``deadline_s`` (restore re-bases the
+        remaining TTL onto the new engine clock)."""
+        return {
+            "max_new_tokens": self.max_new_tokens,
+            "temperature": self.temperature,
+            "top_k": self.top_k,
+            "top_p": self.top_p,
+            "eos_id": self.eos_id,
+            "seed": self.seed,
+            "deadline_s": self.deadline_s,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SamplingParams":
+        return cls(**d)
+
 
 @dataclass
 class Request:

@@ -59,6 +59,14 @@ class ReqState:
     # failure containment (engine-owned): a request whose on_token
     # callback raised keeps serving with the callback off (logged once)
     callback_disabled: bool = False
+    # crash recovery (engine-owned): number of tokens restored from the
+    # durable journal when this state was rebuilt (0 on a fresh
+    # request).  Post-restore commits continue at len(generated), which
+    # starts AT this index — the pre-populated `generated` list is what
+    # keeps a restored stream from re-journaling or re-delivering a
+    # pre-crash token; this field records that provenance and bounds
+    # the restore(replay_tokens=True) redelivery
+    journal_base: int = 0
 
     def expired(self, now: float) -> bool:
         """Past its deadline TTL (``params.deadline_s`` from arrival)."""
