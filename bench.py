@@ -410,6 +410,42 @@ def _bench_serve_engine():
     return r8["decode_toks_per_s"], speedup
 
 
+def check_floors(out: dict, floors: dict) -> tuple[dict, list]:
+    """Per-metric guardrail (PERF_FLOORS.json, ROADMAP #5b): for each
+    floor whose metric is present in ``out``, a ``vs_floor`` ratio
+    normalized so >= 1.0 always means "at or above the floor" —
+    ``value/min`` for higher-is-better metrics, ``max/value`` for
+    latency-style ceilings.  Returns (ratios, names below floor).  Pure
+    (unit-tested in tests/test_serve_prefix.py); the floors themselves
+    are set below the honest session ranges because the absolute chain
+    numbers are dispatch-sensitive — docs/perf.md 'Bench trajectory'."""
+    ratios, below = {}, []
+    for name, spec in floors.items():
+        v = out.get(name)
+        if v is None:
+            continue
+        if "min" in spec:
+            r = v / spec["min"] if spec["min"] > 0 else 0.0
+        else:
+            r = spec["max"] / v if v > 0 else 0.0
+        ratios[name] = round(r, 3)
+        if r < 1.0:
+            below.append(name)
+    return ratios, below
+
+
+def _load_floors() -> dict:
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "PERF_FLOORS.json")
+    try:
+        with open(path) as f:
+            return json.load(f)["floors"]
+    except Exception:  # noqa: BLE001 — a missing/torn floors file must
+        return {}      # never block the bench artifact
+
+
 def main():
     sentinel_tflops, contended = _bench_contention_sentinel()
     tflops, ag_suspect = _bench_ag_gemm_tflops()
@@ -452,6 +488,17 @@ def main():
         # session and `value` is a lower bound, not a regression.
         "sentinel_dot_tflops": round(sentinel_tflops, 1),
     }
+    # Guardrail floors (PERF_FLOORS.json, ROADMAP #5b): vs_floor >= 1.0
+    # per metric means at-or-above its floor; below_floor lists the
+    # violations.  Read together with suspect_contention — a depressed
+    # sentinel says the HOST was busy, and an ag_gemm floor miss in the
+    # same session is environment, not regression (the paired ratios
+    # are the kernel-regression fields either way).
+    vs_floor, below = check_floors(out, _load_floors())
+    if vs_floor:
+        out["vs_floor"] = vs_floor
+    if below:
+        out["below_floor"] = below
     if contended:
         out["suspect_contention"] = True
     if ag_suspect or a2a_suspect:
@@ -469,6 +516,13 @@ def main():
           f"sentinel dot {sentinel_tflops:.1f} TFLOPS"
           + (" (CONTENDED)" if contended else ""),
           file=sys.stderr)
+    if below:
+        print(f"# BELOW FLOOR: {below} (PERF_FLOORS.json; see "
+              f"docs/perf.md 'Bench trajectory' before reading this as "
+              f"a kernel regression"
+              + (" — sentinel says this session was contended)"
+                 if contended else ")"),
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -137,7 +137,23 @@ def parse_args():
                         "engine step K — once (a marker in "
                         "--snapshot-dir gates re-kills), so a "
                         "supervisor restart runs to completion")
-    return p.parse_args()
+    p.add_argument("--shared-prompt", action="store_true",
+                   help="engine mode: every request shares one system-"
+                        "prompt prefix (plus a distinct suffix) — the "
+                        "first commits its pages to the prefix cache, "
+                        "the rest map them read-only and prefill only "
+                        "the residual (docs/serving.md 'Prefix "
+                        "caching'; watch the prefix-cache stats line)")
+    p.add_argument("--sessions", type=int, default=None, metavar="T",
+                   help="engine mode: after the first drain, run T-1 "
+                        "follow-up turns per request — each turn's "
+                        "prompt is the full previous conversation plus "
+                        "a fresh user message, so turns >= 1 hit the "
+                        "prefix cache for their whole history")
+    args = p.parse_args()
+    if args.sessions is not None and args.sessions < 1:
+        p.error(f"--sessions must be >= 1, got {args.sessions}")
+    return args
 
 
 def run_engine(args, key):
@@ -156,6 +172,9 @@ def run_engine(args, key):
     mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
     rng = np.random.default_rng(args.seed)
     if args.mixed:
+        if args.shared_prompt or args.sessions:
+            raise SystemExit("--mixed is exclusive with --shared-prompt/"
+                             "--sessions (ladder sweep vs prefix demo)")
         # Lengths picked AFTER the engine exists, swept across its
         # bucket ladder (below); size the model for the longest.
         lens = None
@@ -165,6 +184,18 @@ def run_engine(args, key):
         lens = rng.integers(max(2, args.prompt_len // 2),
                             2 * args.prompt_len + 1, size=args.requests)
         max_seq = int(max(lens)) + args.new_tokens
+    shared_base = None
+    if args.shared_prompt:
+        # The shared "system prompt": long enough to span several pages
+        # so warm admissions map a real block-aligned prefix.
+        shared_base = rng.integers(
+            0, 256, size=max(2 * args.page_size, args.prompt_len)
+        ).astype(np.int32)
+        max_seq += int(shared_base.shape[0])
+    if args.sessions:
+        # Each follow-up turn appends (answer + fresh user message).
+        max_seq += (args.sessions - 1) * (args.new_tokens
+                                          + max(4, args.prompt_len))
     max_seq += (-max_seq) % args.page_size
 
     cfg = llama.LlamaConfig(vocab=256, dim=32, n_layers=2, n_heads=2,
@@ -266,9 +297,13 @@ def run_engine(args, key):
     # chaos mode attaches a no-op streaming callback so the injector's
     # callback faults have a seam to fire at
     on_token = (lambda rid, tok: None) if args.chaos else None
-    reqs = [Request(f"req-{i}",
-                    rng.integers(0, cfg.vocab, size=int(lens[i]))
-                    .astype(np.int32), params_s, on_token=on_token)
+    def _prompt(i):
+        own = rng.integers(0, cfg.vocab, size=int(lens[i])).astype(np.int32)
+        if shared_base is None:
+            return own
+        return np.concatenate([shared_base, own])
+
+    reqs = [Request(f"req-{i}", _prompt(i), params_s, on_token=on_token)
             for i in range(args.requests)]
 
     kill_marker = (os.path.join(snap_dir, "killed.marker")
@@ -299,6 +334,41 @@ def run_engine(args, key):
             os._exit(17)
         finished.extend(engine.step())
         step += 1
+
+    if args.sessions:
+        # Follow-up turns: each turn's prompt is the FULL previous
+        # conversation (prompt + answer) plus a fresh user message —
+        # the prefix cache serves the whole history from its pages, so
+        # only the new message prefills (the stats line shows it).
+        history = {o.request_id: np.concatenate(
+            [np.asarray(o.prompt, np.int32),
+             np.asarray(o.token_ids, np.int32)])
+            for o in finished if not o.error}
+        for turn in range(1, args.sessions):
+            turn_reqs = []
+            for rid in sorted(history):
+                history[rid] = np.concatenate(
+                    [history[rid],
+                     rng.integers(0, cfg.vocab,
+                                  size=max(4, args.prompt_len))
+                     .astype(np.int32)])
+                turn_reqs.append(Request(f"{rid}.t{turn}", history[rid],
+                                         params_s, on_token=on_token))
+            for r in turn_reqs:
+                shed = engine.submit(r)
+                if shed is not None:
+                    finished.append(shed)
+            outs = engine.run()
+            for r in turn_reqs:
+                o = outs.get(r.request_id)
+                if o is None:
+                    continue
+                finished.append(o)
+                base = r.request_id.rsplit(".t", 1)[0]
+                if not o.error:
+                    history[base] = np.concatenate(
+                        [np.asarray(o.prompt, np.int32),
+                         np.asarray(o.token_ids, np.int32)])
     dt = time.perf_counter() - t0
 
     total_tokens = sum(len(o.token_ids) for o in finished)
@@ -327,6 +397,16 @@ def run_engine(args, key):
                f"tokens ({d['decode_steps']} device steps) — "
                f"{d['tokens_per_dispatch']:.2f} tokens/dispatch, "
                f"{d['dispatches_per_token']:.3f} dispatches/token")
+    if engine.prefix_cache:
+        pc = s["prefix_cache"]
+        ratio = (f", warm/cold ttft {pc['ttft_warm_over_cold']:.2f}x"
+                 if pc["ttft_warm_over_cold"] is not None else "")
+        dist_print(f"prefix cache: {pc['lookup_hits']}/{pc['lookups']} "
+                   f"lookups hit, {pc['prefix_skipped_tokens']} prefill "
+                   f"tokens skipped, {pc['cached_blocks']} cached / "
+                   f"{pc['shared_blocks']} shared blocks, "
+                   f"{pc['cow_copies']} COW, {pc['evictions']} "
+                   f"evictions{ratio}")
     if args.chaos or args.deadline or max_queue is not None:
         f = s["failures"]
         dist_print(f"failure containment: {f['shed']} shed, "
@@ -365,6 +445,9 @@ def main():
     initialize_distributed()
     if args.engine:
         return run_engine(args, jax.random.key(args.seed))
+    if args.shared_prompt or args.sessions:
+        raise SystemExit("--shared-prompt/--sessions are engine-mode "
+                         "flags: add --engine")
     n = jax.device_count()
     mesh = Mesh(np.array(jax.devices()), ("sp",))
     key = jax.random.key(args.seed)

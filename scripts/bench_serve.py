@@ -104,6 +104,159 @@ def bench_engine(horizon: int, *, batch: int = 4, prompt_len: int = 16,
     }
 
 
+def _prefix_engine(*, batch, max_seq, page_size, prefill_chunk, dim,
+                   n_layers, vocab, seed, num_blocks, horizon=1):
+    from triton_dist_tpu.models import llama
+    from triton_dist_tpu.models.generate import Generator
+    from triton_dist_tpu.serve import ServeEngine
+
+    cfg = llama.LlamaConfig(vocab=vocab, dim=dim, n_layers=n_layers,
+                            n_heads=2, n_kv_heads=2, ffn_dim=2 * dim,
+                            max_seq=max_seq, dtype=jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    params = llama.init_params(cfg, jax.random.key(seed))
+    gen = Generator(cfg, mesh, axis="sp", max_seq=max_seq)
+    eng = ServeEngine(gen, params, num_blocks=num_blocks,
+                      page_size=page_size, max_batch=batch,
+                      prefill_chunk=prefill_chunk, horizon=horizon)
+    return eng, cfg
+
+
+def bench_prefix(*, batch: int = 4, prompt_len: int = 256,
+                 suffix_len: int = 16, new_tokens: int = 8,
+                 n_cold: int = 4, n_warm: int = 4, dim: int = 64,
+                 n_layers: int = 2, vocab: int = 256, page_size: int = 16,
+                 prefill_chunk: int = 32, seed: int = 0,
+                 warmup: bool = True, horizon: int = 1) -> dict:
+    """Shared-prompt traffic (docs/serving.md "Prefix caching"): a cold
+    phase of distinct prompts, one seeder that commits the shared
+    prompt's pages, then warm requests = shared prompt + a distinct
+    per-request suffix.  Warm TTFT pays only the residual chunks past
+    the cached block-aligned prefix — the number this mode exists to
+    collapse (the acceptance gate holds warm/cold <= 0.35)."""
+    from triton_dist_tpu.serve import Request, SamplingParams
+
+    total = prompt_len + suffix_len + new_tokens
+    max_seq = total + (-total) % page_size
+    per_req = -(-max_seq // page_size)
+    eng, cfg = _prefix_engine(
+        batch=batch, max_seq=max_seq, page_size=page_size,
+        prefill_chunk=prefill_chunk, dim=dim, n_layers=n_layers,
+        vocab=vocab, seed=seed,
+        num_blocks=1 + per_req * (max(n_cold, n_warm) + 1),
+        horizon=horizon)
+    if warmup:
+        eng.warmup()
+    rng = np.random.default_rng(seed)
+    sp = SamplingParams(max_new_tokens=new_tokens)
+    L = prompt_len + suffix_len
+
+    def drain(reqs):
+        for r in reqs:
+            eng.submit(r)
+        outs = eng.run()
+        assert all(len(outs[r.request_id].token_ids) == new_tokens
+                   for r in reqs)
+
+    t0 = time.perf_counter()
+    drain([Request(f"cold{i}",
+                   rng.integers(0, vocab, size=L).astype(np.int32), sp)
+           for i in range(n_cold)])
+    shared = rng.integers(0, vocab, size=prompt_len).astype(np.int32)
+    drain([Request("seed0", np.concatenate(
+        [shared, rng.integers(0, vocab, size=suffix_len)
+         .astype(np.int32)]), sp)])
+    drain([Request(f"warm{i}", np.concatenate(
+        [shared, rng.integers(0, vocab, size=suffix_len)
+         .astype(np.int32)]), sp) for i in range(n_warm)])
+    dt = time.perf_counter() - t0
+
+    s = eng.metrics.summary()["prefix_cache"]
+    return {
+        "mode": "prefix",
+        "batch": batch, "prompt_len": prompt_len,
+        "suffix_len": suffix_len,
+        "wall_s": round(dt, 4),
+        "warm_requests": s["warm_requests"],
+        "cold_requests": s["cold_requests"],
+        "ttft_cold_ms": round(s["mean_ttft_cold"] * 1e3, 2),
+        "ttft_warm_ms": round(s["mean_ttft_warm"] * 1e3, 2),
+        "ttft_warm_over_cold": round(s["ttft_warm_over_cold"], 3),
+        "hit_rate": round(s["hit_rate"], 3),
+        "hit_tokens": s["hit_tokens"],
+        "prefix_skipped_tokens": s["prefix_skipped_tokens"],
+        "cached_blocks": s["cached_blocks"],
+        "evictions": s["evictions"],
+        "cow_copies": s["cow_copies"],
+    }
+
+
+def bench_sessions(*, n_sessions: int = 3, n_turns: int = 4,
+                   turn_user: int = 32, new_tokens: int = 8,
+                   dim: int = 64, n_layers: int = 2, vocab: int = 256,
+                   page_size: int = 16, prefill_chunk: int = 32,
+                   seed: int = 0, warmup: bool = True) -> dict:
+    """Multi-turn session traffic: turn t's prompt is the FULL previous
+    conversation (prompt + assistant tokens) plus a fresh user message —
+    the dominant production shape prefix reuse exists for.  Every turn
+    past the first should hit the cache for the whole history (generated
+    tokens commit too, as their pages fill), so per-turn TTFT stays
+    ~flat while the prompt grows linearly."""
+    from triton_dist_tpu.serve import Request, SamplingParams
+
+    if n_sessions < 1 or n_turns < 1:
+        raise ValueError(f"need n_sessions >= 1 and n_turns >= 1, got "
+                         f"{n_sessions}/{n_turns}")
+
+    total = n_turns * (turn_user + new_tokens)
+    max_seq = total + (-total) % page_size
+    per_req = -(-max_seq // page_size)
+    eng, cfg = _prefix_engine(
+        batch=n_sessions, max_seq=max_seq, page_size=page_size,
+        prefill_chunk=prefill_chunk, dim=dim, n_layers=n_layers,
+        vocab=vocab, seed=seed,
+        num_blocks=1 + per_req * (n_sessions + 1))
+    if warmup:
+        eng.warmup()
+    rng = np.random.default_rng(seed)
+    sp = SamplingParams(max_new_tokens=new_tokens)
+    history = {s: rng.integers(0, vocab, size=turn_user)
+               .astype(np.int32) for s in range(n_sessions)}
+    turn_ttft, turn_hit = [], []
+    t0 = time.perf_counter()
+    for turn in range(n_turns):
+        rids = []
+        for s in range(n_sessions):
+            rid = f"s{s}t{turn}"
+            eng.submit(Request(rid, history[s], sp))
+            rids.append((s, rid))
+        outs = eng.run()
+        ttfts, hits = [], 0
+        for s, rid in rids:
+            o = outs[rid]
+            ttfts.append(o.metrics.ttft)
+            hits += o.metrics.cached_prefix_tokens > 0
+            history[s] = np.concatenate(
+                [history[s], np.asarray(o.token_ids, np.int32),
+                 rng.integers(0, vocab, size=turn_user)
+                 .astype(np.int32)])
+        turn_ttft.append(round(sum(ttfts) / len(ttfts) * 1e3, 2))
+        turn_hit.append(hits / n_sessions)
+    dt = time.perf_counter() - t0
+    s = eng.metrics.summary()["prefix_cache"]
+    return {
+        "mode": "sessions",
+        "sessions": n_sessions, "turns": n_turns,
+        "wall_s": round(dt, 4),
+        "ttft_by_turn_ms": turn_ttft,
+        "hit_rate_by_turn": turn_hit,
+        "hit_rate": round(s["hit_rate"], 3),
+        "prefix_skipped_tokens": s["prefix_skipped_tokens"],
+        "cached_blocks": s["cached_blocks"],
+        "evictions": s["evictions"],
+    }
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--horizons", default="1,8",
@@ -117,7 +270,44 @@ def main():
     p.add_argument("--page-size", type=int, default=16)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--no-warmup", action="store_true")
+    p.add_argument("--shared-prompt", action="store_true",
+                   help="prefix-cache mode: cold vs warm shared-prompt "
+                        "TTFT + hit rate (docs/serving.md 'Prefix "
+                        "caching') instead of the horizon sweep")
+    p.add_argument("--sessions", type=int, default=None, metavar="N",
+                   help="prefix-cache mode: N multi-turn sessions "
+                        "(growing conversation prompts; per-turn TTFT "
+                        "should stay flat while prompts grow)")
+    p.add_argument("--turns", type=int, default=4,
+                   help="--sessions: turns per session")
     args = p.parse_args()
+    if args.sessions is not None and args.sessions < 1:
+        p.error(f"--sessions must be >= 1, got {args.sessions}")
+    if args.sessions is not None and args.turns < 1:
+        p.error(f"--turns must be >= 1, got {args.turns}")
+    if args.shared_prompt:
+        r = bench_prefix(batch=args.batch,
+                         prompt_len=max(args.prompt_len, 128),
+                         new_tokens=args.new_tokens, dim=args.dim,
+                         n_layers=args.layers, page_size=args.page_size,
+                         seed=args.seed, warmup=not args.no_warmup,
+                         horizon=max(int(args.horizons.split(",")[0]), 1))
+        print(json.dumps(r))
+        print(f"# warm TTFT {r['ttft_warm_ms']:.2f} ms vs cold "
+              f"{r['ttft_cold_ms']:.2f} ms "
+              f"({r['ttft_warm_over_cold']:.3f}x), hit rate "
+              f"{r['hit_rate']:.2f}", file=sys.stderr)
+        return
+    if args.sessions is not None:
+        r = bench_sessions(n_sessions=args.sessions, n_turns=args.turns,
+                           new_tokens=args.new_tokens, dim=args.dim,
+                           n_layers=args.layers,
+                           page_size=args.page_size, seed=args.seed,
+                           warmup=not args.no_warmup)
+        print(json.dumps(r))
+        print(f"# per-turn TTFT {r['ttft_by_turn_ms']} ms, per-turn hit "
+              f"rate {r['hit_rate_by_turn']}", file=sys.stderr)
+        return
     results = {}
     for h in (int(x) for x in args.horizons.split(",")):
         r = bench_engine(h, batch=args.batch, prompt_len=args.prompt_len,

@@ -114,6 +114,13 @@ _FAST_GATE_MODULES = {
     # accounting, and geometry-override restores gate the recovery
     # layer; the randomized kill soak carries @pytest.mark.slow.
     "test_serve_recovery",
+    # prefix reuse: the content-addressed index units (chains, collision
+    # safety, id-reuse orphaning, LRU eviction, COW splits), the
+    # warm≡cold≡Generator.generate oracles (greedy/sampled/horizon-fused),
+    # session hits over generated pages, eviction×preemption, warm-cache
+    # snapshot/restore, journal rotation, and the bench floor helper all
+    # run in the gate (the whole file is the fast tier).
+    "test_serve_prefix",
 }
 
 # Heavy tests inside core modules whose coverage is duplicated by a

@@ -162,3 +162,34 @@ def test_serve_engine_horizon():
     assert m, out
     assert float(m.group(1)) < 0.5, out
     assert "done" in out
+
+
+def test_serve_engine_shared_prompt():
+    """--shared-prompt: every request carries one shared system-prompt
+    prefix — the prefix-cache stats line must show hits and skipped
+    prefill tokens (docs/serving.md 'Prefix caching')."""
+    out = _run("--engine", "--shared-prompt", "--requests", "4",
+               "--prompt-len", "24", "--max-batch", "2", "--page-size",
+               "8", devices=1, new_tokens=4)
+    assert "engine: 16 tokens / 4 requests" in out, out
+    import re
+    m = re.search(r"prefix cache: (\d+)/(\d+) lookups hit, (\d+) "
+                  r"prefill tokens skipped", out)
+    assert m, out
+    assert int(m.group(1)) >= 1 and int(m.group(3)) > 0, out
+    assert "done" in out
+
+
+def test_serve_engine_sessions():
+    """--sessions: multi-turn conversations — turns >= 1 re-admit their
+    whole history through the prefix cache (hits on the stats line),
+    and every turn's requests retire."""
+    out = _run("--engine", "--sessions", "3", "--requests", "2",
+               "--prompt-len", "8", "--max-batch", "2", "--page-size",
+               "8", devices=1, new_tokens=4)
+    import re
+    m = re.search(r"prefix cache: (\d+)/(\d+) lookups hit", out)
+    assert m and int(m.group(1)) >= 2, out     # turns 2-3 hit history
+    # 2 base requests + 2 turns x 2 follow-ups, 4 tokens each
+    assert re.search(r"req-0\.t2: prompt \d+ -> 4 tokens", out), out
+    assert "done" in out

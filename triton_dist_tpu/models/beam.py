@@ -7,11 +7,24 @@ continuation) pairs survive.  Beam reordering gathers the KV caches along
 the batch axis — a [beams, H, S, D] take per layer, which XLA fuses with
 the step's cache update.
 
+:func:`beam_search` physically replicates the prompt KV ``num_beams``
+times (and re-gathers whole caches on every reorder) — the contiguous
+SP/int8-capable baseline.  :func:`beam_search_paged` replaces both
+copies with **shared paged blocks**: every beam's block table maps the
+prompt's pages read-only (refcount = beams), divergence copy-on-writes
+exactly the one partially-filled tail page, and a reorder is a table
+remap (surviving beams share their parent's pages; only the tail splits
+again) — prompt KV memory is paid once regardless of beam width, the
+prefix-cache sharing machinery of ``serve/block_manager.py`` applied to
+N-best decoding (docs/serving.md "Prefix caching").
+
 Scoring is the standard sum of token log-probs (no length normalization —
 see ``beam_search``'s docstring for why the knob is deliberately absent).
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -76,5 +89,139 @@ def beam_search(gen: Generator, params, prompt, n_new: int, *,
             last_logits=state.last_logits[beam_idx])
     # The final selected tokens are never consumed — no trailing step.
 
+    best = int(jnp.argmax(scores))
+    return jnp.asarray(seqs[best][None], jnp.int32), float(scores[best])
+
+
+def beam_search_paged(gen: Generator, params, prompt, n_new: int, *,
+                      num_beams: int = 4, page_size: int = 16,
+                      stats: dict | None = None):
+    """:func:`beam_search` over shared paged KV blocks: the prompt's
+    pages are written ONCE and mapped read-only into every beam's block
+    table; beams copy-on-write only the page they actually diverge in.
+
+    Identical search to :func:`beam_search` (same expansion, scoring,
+    and reorder rule — the paged decode forward computes the same layer
+    math as ``Generator.step``), returning the same ``(tokens [1,
+    n_new], score)``.  What changes is memory: prompt KV is held once —
+    refcounted, not replicated — so wide beams over long prompts stop
+    paying ``num_beams ×`` prompt cache (the ``test_beam.py`` paged
+    tests pin both the oracle equality and the block accounting).
+
+    World-1, float KV (the paged decode kernel's envelope — the
+    contiguous :func:`beam_search` remains the SP / int8 path)."""
+    from triton_dist_tpu.serve.block_manager import BlockManager
+    from triton_dist_tpu.serve.engine import (
+        _copy_pool_block,
+        _fill_pool_pages,
+        _paged_decode_forward,
+    )
+
+    assert prompt.shape[0] == 1, "beam search takes a single prompt"
+    assert gen.attn.world == 1, "paged beams are world-1 (block tables)"
+    assert not gen.attn.quantized, "paged beams need float KV pools"
+    B = num_beams
+    cfg = gen.cfg
+    page = int(page_size)
+    S0 = int(prompt.shape[1])
+    total = S0 + n_new
+    assert total <= gen.max_seq, "prompt + n_new exceeds max_seq"
+    n_pages = -(-total // page)
+    prompt_pages = -(-S0 // page)
+    full_prompt = S0 // page             # pages every beam shares forever
+    # Pool budget: the shared prompt + each beam's own suffix pages + one
+    # transient block per beam for the in-flight copy-on-write split.
+    num_blocks = 1 + prompt_pages + B * (n_pages - full_prompt + 1)
+    bm = BlockManager(num_blocks, page)
+    pools = [
+        (jnp.zeros((num_blocks, cfg.n_kv_heads, page, cfg.head_dim),
+                   cfg.dtype),
+         jnp.zeros((num_blocks, cfg.n_kv_heads, page, cfg.head_dim),
+                   cfg.dtype))
+        for _ in range(cfg.n_layers)]
+
+    # Prefill ONCE; scatter the prompt K/V into its pool pages, then map
+    # those pages into every beam's table (refcount = num_beams — the
+    # physical replication beam_search pays is gone).
+    s1 = gen.prefill(params, prompt)
+    fill = jax.jit(functools.partial(_fill_pool_pages, page=page),
+                   donate_argnums=(0,))
+    scratch = [(k[:, :, :prompt_pages * page, :],
+                v[:, :, :prompt_pages * page, :]) for k, v in s1.caches]
+    prefix = bm.allocate("__prefix__", S0)
+    pools = fill(pools, scratch, jnp.asarray(np.asarray(prefix, np.int32)))
+    beams = [f"beam{b}" for b in range(B)]
+    for rid in beams:
+        bm.share(rid, prefix)
+    bm.free("__prefix__")                # beams now hold the only refs
+
+    impl = gen.attn.ctx.impl
+    interpret = gen.attn.ctx.interpret
+    decode = jax.jit(functools.partial(
+        _paged_decode_forward, cfg=cfg, page=page, impl=impl,
+        interpret=interpret), donate_argnums=(1,))
+    cow_copy = jax.jit(_copy_pool_block, donate_argnums=(0,))
+    active = jnp.ones((B,), bool)
+
+    def tables_now():
+        t = np.zeros((B, n_pages), np.int32)
+        for b, rid in enumerate(beams):
+            row = bm.table(rid)
+            t[b, :len(row)] = row
+        return jnp.asarray(t)
+
+    def make_writable(pools, pos):
+        """Every beam must own the page ``pos`` writes: extend tables to
+        cover it and split any still-shared page (the divergence COW —
+        fires for the partially-filled prompt tail on the first step and
+        for the reorder-shared tail after every reorder)."""
+        for rid in beams:
+            bm.ensure(rid, pos + 1)
+            logical = pos // page
+            if bm.ref_of(bm.table(rid)[logical]) > 1:
+                old, new = bm.cow(rid, logical)
+                pools = cow_copy(pools, jnp.int32(old), jnp.int32(new))
+        return pools
+
+    logprobs = jax.nn.log_softmax(s1.last_logits, axis=-1)   # [1, V]
+    V = logprobs.shape[-1]
+    first = jax.lax.top_k(logprobs[0], B)
+    scores = first[0]
+    seqs = np.asarray(first[1]).reshape(B, 1)
+    token = first[1].astype(jnp.int32)                       # [B]
+    kv_lens = jnp.full((B,), S0, jnp.int32)
+    peak_used = num_blocks - 1 - bm.num_free
+
+    for step in range(n_new - 1):
+        pos = S0 + step
+        pools = make_writable(pools, pos)
+        peak_used = max(peak_used, num_blocks - 1 - bm.num_free)
+        pools, logits = decode(params, pools, tables_now(), kv_lens,
+                               token, active)
+        kv_lens = kv_lens + 1
+        logprobs = jax.nn.log_softmax(logits, axis=-1)       # [B, V]
+        total_lp = scores[:, None] + logprobs
+        top = jax.lax.top_k(total_lp.reshape(-1), B)
+        scores = top[0]
+        beam_idx = (top[1] // V).astype(jnp.int32)
+        token = (top[1] % V).astype(jnp.int32)
+        bi = np.asarray(beam_idx)
+        seqs = np.concatenate([seqs[bi], np.asarray(token)[:, None]],
+                              axis=1)
+        # Reorder = TABLE remap, not a cache gather: each child shares
+        # its parent's pages (surviving divergent pages stay where they
+        # are; dead beams' pages free), and the next make_writable
+        # splits only the tail page the children will write.
+        new_tables = [bm.table(beams[int(bi[i])]) for i in range(B)]
+        for rid in beams:
+            bm.free(rid)
+        for rid, tab in zip(beams, new_tables):
+            bm.share(rid, tab)
+    # The final selected tokens are never consumed — no trailing step.
+
+    if stats is not None:
+        stats.update(num_blocks=num_blocks, peak_used=peak_used,
+                     cow_copies=bm.cow_copies,
+                     shared_prompt_pages=full_prompt)
     best = int(jnp.argmax(scores))
     return jnp.asarray(seqs[best][None], jnp.int32), float(scores[best])

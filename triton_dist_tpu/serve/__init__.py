@@ -12,7 +12,10 @@ Layout:
 
 - ``request``    — request/response dataclasses + sampling params
 - ``block_manager`` — the paged KV block allocator (free list, per-request
-  block tables, utilization accounting)
+  block tables, utilization accounting) + the content-addressed prefix
+  cache: ref-counted blocks keyed by ``(parent block, token ids)``
+  chains, copy-on-write sharing, an LRU-evictable warm cache tier
+  (docs/serving.md "Prefix caching")
 - ``scheduler``  — iteration-level FCFS admission + chunked-prefill token
   budget + LIFO preemption policy
 - ``engine``     — the step loop: deadline sweep → admit → prefill

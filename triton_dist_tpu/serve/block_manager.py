@@ -1,4 +1,5 @@
-"""Paged-KV block allocator: free list + per-request block tables.
+"""Paged-KV block allocator: free list, per-request block tables, and a
+content-addressed prefix cache with copy-on-write sharing.
 
 The physical cache is a pool of ``num_blocks`` pages of ``page_size``
 token rows each (per layer, per K/V — the pools live in the engine; this
@@ -15,19 +16,55 @@ paged kernel DMAs dead entries too; see kernels/flash_decode.py).
 Contract with `kernels/flash_decode.gqa_decode_paged_shard`: logical page
 ``i`` of a request lives at pool row ``table(rid)[i]``; entries past the
 allocation hold the null block and are masked by the sequence length.
+
+**Prefix sharing (docs/serving.md "Prefix caching").**  Every block is
+ref-counted, and a FULL block whose token contents are known can be
+*committed* to a content-addressed index keyed by ``(parent block,
+token ids in block)`` — the parent link makes the key a chain, so a hit
+at logical page ``i`` certifies the ENTIRE prefix up to ``i``, not just
+this page's tokens at some position.  ``match_prefix`` walks the chain
+to find the longest cached block-aligned prefix of a prompt, and
+``allocate(..., shared=...)`` maps those blocks read-only into a new
+request's table (refcount++).  Writes into a block with refcount > 1 go
+through :meth:`cow` first (copy-on-write — the caller copies the page
+on device and the table entry swaps to the fresh block).  Freed blocks
+whose contents are committed don't die: they enter an LRU-evictable
+cache tier, reclaimed only under allocation pressure — so
+``num_free``/``num_allocatable`` semantics (and the ``BlockExhausted``
+→ preemption path above them) are unchanged, the cache just keeps warm
+KV alive in pages nobody is using yet.
+
+Hash-collision safety: the index buckets on :func:`_block_hash` but a
+lookup only matches after a FULL ``(parent, token ids)`` compare — a
+colliding hash can never alias two different prefixes (pinned by
+tests/test_serve_prefix.py with a deliberately degenerate hash).
 """
 
 from __future__ import annotations
 
+from typing import Optional, Sequence
+
+_ROOT = 0  # parent sentinel for a request's first block (the null block
+           # can never be committed, so the id is free to mean "no parent")
+
+
+def _block_hash(parent: int, tokens: tuple) -> int:
+    """Bucket key for the content index.  Collisions are SAFE (lookup
+    compares the full (parent, tokens) pair) — tests monkeypatch this to
+    a constant to prove it."""
+    return hash((parent, tokens))
+
 
 class BlockExhausted(Exception):
     """Raised by :meth:`BlockManager.allocate` /
-    :meth:`BlockManager.ensure` when the free list cannot cover the
-    request (the scheduler turns this into queueing or preemption)."""
+    :meth:`BlockManager.ensure` when the free list plus the evictable
+    cache tier cannot cover the request (the scheduler turns this into
+    queueing or preemption)."""
 
 
 class BlockManager:
-    def __init__(self, num_blocks: int, page_size: int, *, faults=None):
+    def __init__(self, num_blocks: int, page_size: int, *, faults=None,
+                 prefix_cache: bool = False):
         if num_blocks < 2:
             raise ValueError(
                 f"num_blocks must be >= 2 (block 0 is the reserved null "
@@ -37,6 +74,7 @@ class BlockManager:
         self.num_blocks = num_blocks
         self.page_size = page_size
         self.null_block = 0
+        self.prefix_cache = bool(prefix_cache)
         # runtime.faults.FaultInjector (optional): the mid-grow alloc is
         # a fault point — an injected failure exercises the engine's
         # quarantine path without a genuinely exhausted pool.
@@ -45,12 +83,47 @@ class BlockManager:
         # first.  Block 0 never enters it.
         self._free: list[int] = list(range(num_blocks - 1, 0, -1))
         self._tables: dict[str, list[int]] = {}
+        # -- sharing / content cache state --------------------------------
+        self._ref: dict[int, int] = {}          # block -> refcount (> 0)
+        # committed blocks: block -> (parent block, token-id tuple);
+        # present while the block is live-shared OR in the cache tier
+        self._meta: dict[int, tuple[int, tuple]] = {}
+        self._index: dict[int, list[int]] = {}  # _block_hash -> blocks
+        self._children: dict[int, set[int]] = {}
+        # LRU cache tier: committed refcount-0 blocks, insertion-ordered
+        # (dict iteration order = admission order = eviction order)
+        self._cached: dict[int, None] = {}
+        # observability (engine surfaces these via metrics.summary())
+        self.lookups = 0          # match_prefix calls
+        self.lookup_hits = 0      # match_prefix calls matching > 0 blocks
+        self.hit_blocks = 0       # blocks mapped read-only into tables
+        self.committed_blocks = 0  # commit_block registrations
+        self.cow_copies = 0       # copy-on-write block splits
+        self.evictions = 0        # cache-tier blocks reclaimed
+        # bumped on every index mutation (_register/_unregister): a
+        # match_prefix result is valid for exactly as long as this is
+        # unchanged, so a blocked head-of-line request can reuse its
+        # match instead of re-walking the chain every engine step
+        self.index_gen = 0
 
     # -- accounting -------------------------------------------------------
 
     @property
     def num_free(self) -> int:
-        return len(self._free)
+        """Blocks an allocation can claim: the free list PLUS the
+        evictable cache tier (cached blocks hold warm KV but belong to
+        nobody — allocation pressure reclaims them LRU-first)."""
+        return len(self._free) + len(self._cached)
+
+    @property
+    def num_cached(self) -> int:
+        """Blocks in the evictable warm-KV cache tier (refcount 0)."""
+        return len(self._cached)
+
+    @property
+    def num_shared(self) -> int:
+        """Blocks currently mapped into more than one table."""
+        return sum(1 for r in self._ref.values() if r > 1)
 
     @property
     def num_allocatable(self) -> int:
@@ -66,22 +139,215 @@ class BlockManager:
         """Pages needed to hold ``n_tokens`` cache rows."""
         return -(-n_tokens // self.page_size)
 
-    def can_allocate(self, n_tokens: int) -> bool:
-        return self.blocks_for(n_tokens) <= self.num_free
+    def can_allocate(self, n_tokens: int,
+                     shared: Sequence[int] = ()) -> bool:
+        """Would :meth:`allocate` succeed?  ``shared`` is the
+        :meth:`match_prefix` hit the allocation will map in: those
+        blocks don't need the free list — but the ones currently
+        sitting in the cache tier must NOT also be counted as
+        evictable supply (they're about to be claimed), so they are
+        subtracted from both sides."""
+        in_cache = sum(1 for b in shared if b in self._cached)
+        avail = len(self._free) + len(self._cached) - in_cache
+        return self.blocks_for(n_tokens) - len(shared) <= avail
+
+    def ref_of(self, block: int) -> int:
+        return self._ref.get(block, 0)
+
+    def prefix_stats(self) -> dict:
+        """The prefix-cache counters + gauges as one dict (the engine's
+        ``metrics.summary()["prefix_cache"]``)."""
+        return {
+            "lookups": self.lookups,
+            "lookup_hits": self.lookup_hits,
+            "hit_rate": (self.lookup_hits / self.lookups
+                         if self.lookups else 0.0),
+            "hit_blocks": self.hit_blocks,
+            "hit_tokens": self.hit_blocks * self.page_size,
+            "committed_blocks": self.committed_blocks,
+            "cow_copies": self.cow_copies,
+            "evictions": self.evictions,
+            "cached_blocks": self.num_cached,
+            "shared_blocks": self.num_shared,
+        }
+
+    # -- the content-addressed index --------------------------------------
+
+    def _find(self, parent: int, tokens: tuple) -> Optional[int]:
+        """Committed block for (parent, tokens) — FULL compare, never the
+        hash alone (collision safety)."""
+        for b in self._index.get(_block_hash(parent, tokens), ()):
+            if self._meta.get(b) == (parent, tokens):
+                return b
+        return None
+
+    def _register(self, block: int, parent: int, tokens: tuple) -> bool:
+        """Enter ``block`` into the content index (idempotent; refuses a
+        duplicate (parent, tokens) key — first committer wins)."""
+        if block in self._meta:
+            return True
+        if self._find(parent, tokens) is not None:
+            return False  # identical content already cached elsewhere
+        self._meta[block] = (parent, tokens)
+        self._index.setdefault(_block_hash(parent, tokens), []).append(block)
+        if parent != _ROOT:
+            self._children.setdefault(parent, set()).add(block)
+        self.committed_blocks += 1
+        self.index_gen += 1
+        return True
+
+    def _unregister(self, block: int) -> None:
+        self.index_gen += 1
+        parent, tokens = self._meta.pop(block)
+        h = _block_hash(parent, tokens)
+        bucket = self._index.get(h)
+        if bucket is not None:
+            bucket.remove(block)
+            if not bucket:
+                del self._index[h]
+        if parent != _ROOT:
+            kids = self._children.get(parent)
+            if kids is not None:
+                kids.discard(block)
+                if not kids:
+                    del self._children[parent]
+
+    def match_prefix(self, tokens: Sequence[int], *,
+                     count: bool = True) -> list[int]:
+        """Longest cached block-aligned prefix of ``tokens``: the chain
+        of committed blocks matching full pages of the prompt, capped at
+        ``len(tokens) - 1`` so at least one token always prefills (the
+        request needs the last prompt token's logits).  Returns the
+        physical blocks, in logical order — pass them to
+        :meth:`allocate`'s ``shared=``.
+
+        ``count=False`` leaves the ``lookups``/``lookup_hits`` gauges
+        alone: a blocked head-of-line request re-matches every engine
+        step until it admits, and counting each retry would deflate
+        ``hit_rate`` into a queue-pressure artifact."""
+        if not self.prefix_cache or len(tokens) < 2:
+            return []
+        if count:
+            self.lookups += 1
+        page = self.page_size
+        limit = (len(tokens) - 1) // page
+        out: list[int] = []
+        parent = _ROOT
+        for i in range(limit):
+            key = tuple(int(t) for t in tokens[i * page:(i + 1) * page])
+            blk = self._find(parent, key)
+            if blk is None:
+                break
+            out.append(blk)
+            parent = blk
+        if out and count:
+            self.lookup_hits += 1
+        return out
+
+    def commit_block(self, rid: str, logical: int,
+                     tokens: Sequence[int]) -> None:
+        """Register ``rid``'s full logical page ``logical`` (its
+        ``page_size`` token ids are ``tokens``) in the content index so
+        later prompts sharing the prefix can map it read-only.  The
+        parent link is the table's previous entry — by induction the
+        whole chain up to this page is certified by the commit.
+        Idempotent; a no-op when the cache is disabled or identical
+        content is already indexed under another block."""
+        if not self.prefix_cache:
+            return
+        if len(tokens) != self.page_size:
+            raise ValueError(
+                f"{rid}: commit_block needs exactly page_size="
+                f"{self.page_size} tokens, got {len(tokens)}")
+        table = self._tables[rid]
+        block = table[logical]
+        parent = table[logical - 1] if logical > 0 else _ROOT
+        self._register(block, parent,
+                       tuple(int(t) for t in tokens))
 
     # -- allocate / extend / free ----------------------------------------
 
-    def allocate(self, rid: str, n_tokens: int) -> list[int]:
-        """Allocate blocks covering ``n_tokens`` for a NEW request."""
+    def _pop_free(self) -> int:
+        """One writable block off the free list, evicting the LRU cached
+        block (plus its now-unreachable cached descendants — a committed
+        child whose parent is gone can never be matched again, and its
+        stale chain link must not survive the parent id's reuse) when
+        the list is empty."""
+        if not self._free:
+            if not self._cached:
+                raise BlockExhausted("no free or evictable blocks")
+            self._evict(next(iter(self._cached)))
+        return self._free.pop()
+
+    def _evict(self, block: int) -> None:
+        """Reclaim a cache-tier block into the free list.  Its committed
+        descendants are orphaned first: the block's id is about to be
+        reusable with different contents, and a child keyed on it could
+        otherwise falsely certify its chain once the id comes back."""
+        if block not in self._cached:
+            return
+        del self._cached[block]
+        self._unregister(block)
+        self._orphan_children(block)
+        self._free.append(block)
+        self.evictions += 1
+
+    def _orphan_children(self, block: int) -> None:
+        """``block`` is returning to the free list: its id can be
+        reallocated with different contents, so no committed child keyed
+        on it may survive — a match walking through the REUSED id would
+        certify a chain the child's KV was never computed under (the
+        block-id-reuse twin of hash-collision safety).  Cached children
+        are reclaimed outright; live-shared children only lose their
+        index entry (their holders' KV stays valid, the chain is just no
+        longer matchable — their own children stay registered and are
+        orphaned in turn when the live child is eventually freed)."""
+        for child in list(self._children.get(block, ())):
+            if child in self._cached:
+                self._evict(child)
+            else:
+                self._unregister(child)
+
+    def _claim_shared(self, block: int) -> None:
+        """Map an existing block into one more table: refcount++ (pulling
+        it out of the cache tier when it sat at refcount 0)."""
+        if block in self._cached:
+            del self._cached[block]
+        self._ref[block] = self._ref.get(block, 0) + 1
+
+    def allocate(self, rid: str, n_tokens: int,
+                 shared: Sequence[int] = ()) -> list[int]:
+        """Allocate blocks covering ``n_tokens`` for a NEW request.
+        ``shared`` (from :meth:`match_prefix`) maps those blocks
+        read-only as the table's head — only the remainder comes off the
+        free list."""
         if rid in self._tables:
             raise ValueError(f"request {rid!r} already has blocks")
         need = self.blocks_for(n_tokens)
-        if need > self.num_free:
+        shared = list(shared)
+        if len(shared) > need:
+            raise ValueError(
+                f"{rid}: {len(shared)} shared blocks exceed the "
+                f"{need}-block allocation for {n_tokens} tokens")
+        # Same availability math as can_allocate: shared blocks sitting
+        # in the cache tier are about to be CLAIMED, so they cannot also
+        # count as evictable supply for the fresh remainder.
+        avail = self.num_free - sum(1 for b in shared if b in self._cached)
+        if need - len(shared) > avail:
             raise BlockExhausted(
-                f"{rid}: need {need} blocks for {n_tokens} tokens, "
-                f"only {self.num_free} free")
-        self._tables[rid] = [self._free.pop() for _ in range(need)]
-        return list(self._tables[rid])
+                f"{rid}: need {need - len(shared)} blocks for {n_tokens} "
+                f"tokens ({len(shared)} shared), only {avail} free")
+        table = []
+        for b in shared:
+            self._claim_shared(b)
+            table.append(b)
+        for _ in range(need - len(shared)):
+            b = self._pop_free()
+            self._ref[b] = 1
+            table.append(b)
+        self._tables[rid] = table
+        self.hit_blocks += len(shared)
+        return list(table)
 
     def ensure(self, rid: str, n_tokens: int) -> list[int]:
         """Extend ``rid``'s allocation to cover ``n_tokens`` (no-op when
@@ -100,17 +366,70 @@ class BlockManager:
             raise BlockExhausted(
                 f"{rid}: extension to {n_tokens} tokens needs {need} more "
                 f"blocks, only {self.num_free} free")
-        fresh = [self._free.pop() for _ in range(need)]
+        fresh = []
+        for _ in range(need):
+            b = self._pop_free()
+            self._ref[b] = 1
+            fresh.append(b)
         table.extend(fresh)
         return fresh
 
-    def adopt(self, rid: str, blocks: list[int]) -> None:
+    def cow(self, rid: str, logical: int) -> tuple[int, int]:
+        """Copy-on-write split of ``rid``'s logical page ``logical``: the
+        shared block's refcount drops, a fresh block takes its table
+        slot, and ``(old, new)`` returns so the caller can copy the page
+        on device BEFORE any write lands.  Raises ``BlockExhausted``
+        when no block (free or evictable) remains."""
+        table = self._tables[rid]
+        old = table[logical]
+        if self._ref.get(old, 0) <= 1:
+            raise ValueError(
+                f"{rid}: block {old} (logical {logical}) is not shared")
+        new = self._pop_free()
+        self._ref[old] -= 1
+        self._ref[new] = 1
+        table[logical] = new
+        self.cow_copies += 1
+        return old, new
+
+    def share(self, rid: str, blocks: Sequence[int]) -> None:
+        """Impose a table for ``rid`` that references ``blocks`` in
+        order, sharing any block another table already owns
+        (refcount++), claiming cache-tier blocks, and taking free-list
+        blocks.  The sharing twin of :meth:`adopt` — beam search maps
+        every beam onto one prefix this way, and restore rebuilds
+        snapshot tables that legitimately overlap."""
+        if rid in self._tables:
+            raise ValueError(f"request {rid!r} already has blocks")
+        blocks = [int(b) for b in blocks]
+        bad = [b for b in blocks
+               if b == self.null_block or not 0 < b < self.num_blocks]
+        if bad:
+            raise ValueError(f"{rid}: cannot claim blocks {bad} "
+                             f"(null or outside pool {self.num_blocks})")
+        if len(set(blocks)) != len(blocks):
+            raise ValueError(f"{rid}: duplicate blocks in {blocks}")
+        free = set(self._free)
+        for b in blocks:
+            if b in free:
+                self._free.remove(b)
+                free.discard(b)
+                self._ref[b] = 1
+            else:
+                self._claim_shared(b)
+        self._tables[rid] = blocks
+
+    def adopt(self, rid: str, blocks: list[int], *,
+              shared_ok: bool = False) -> None:
         """Impose a block table restored from a snapshot: claim exactly
         ``blocks`` (in order) for ``rid``, removing them from the free
         list.  The restore-time twin of :meth:`allocate` — the snapshot
         already decided WHICH physical pages hold the request's KV, so
         the allocator must adopt that mapping rather than hand out fresh
-        pages the restored pools never wrote."""
+        pages the restored pools never wrote.  ``shared_ok=True`` lets
+        blocks another restored table already claimed ride along as
+        shared (refcount++) — snapshot tables legitimately overlap when
+        the snapshotted engine served a shared prefix."""
         if rid in self._tables:
             raise ValueError(f"request {rid!r} already has blocks")
         blocks = [int(b) for b in blocks]
@@ -121,19 +440,61 @@ class BlockManager:
                              f"(null or outside pool {self.num_blocks})")
         if len(set(blocks)) != len(blocks):
             raise ValueError(f"{rid}: duplicate blocks in {blocks}")
-        missing = set(blocks) - set(self._free)
-        if missing:
-            raise ValueError(
-                f"{rid}: blocks {sorted(missing)} already owned — the "
-                f"snapshot tables overlap")
-        taken = set(blocks)
-        self._free = [b for b in self._free if b not in taken]
-        self._tables[rid] = blocks
+        if not shared_ok:
+            missing = set(blocks) - set(self._free)
+            if missing:
+                raise ValueError(
+                    f"{rid}: blocks {sorted(missing)} already owned — the "
+                    f"snapshot tables overlap")
+        self.share(rid, blocks)
+
+    def restore_index(self, entries: Sequence) -> None:
+        """Re-register committed ``(block, parent, tokens)`` entries for
+        LIVE blocks (refcount > 0) — the restore-time twin of
+        :meth:`commit_block`, run after the snapshot's tables were
+        re-adopted.  Entries whose block nobody re-adopted are skipped
+        here; :meth:`admit_cached` is the path for ownerless warm
+        blocks."""
+        if not self.prefix_cache:
+            return
+        for block, parent, tokens in entries:
+            if self._ref.get(int(block), 0) > 0:
+                self._register(int(block), int(parent),
+                               tuple(int(t) for t in tokens))
+
+    def admit_cached(self, block: int, parent: int,
+                     tokens: Sequence[int]) -> bool:
+        """Restore-time cache admission: move a FREE block into the
+        warm-KV cache tier under (parent, tokens) — the
+        ``BlockManager.adopt`` counterpart for blocks nobody owns but
+        whose pool pages still hold committed prefix KV (snapshots carry
+        the warm cache across restarts).  Returns False (no-op) when the
+        block is not free or the key is already indexed."""
+        if block not in self._free or not self.prefix_cache:
+            return False
+        key = tuple(int(t) for t in tokens)
+        if not self._register(block, int(parent), key):
+            return False
+        self._free.remove(block)
+        self._cached[block] = None
+        return True
 
     def free(self, rid: str) -> None:
-        """Return all of ``rid``'s blocks to the free list."""
+        """Drop ``rid``'s claim on its blocks.  A block whose refcount
+        reaches 0 returns to the free list — unless its contents are
+        committed in the prefix index, in which case it enters the LRU
+        cache tier instead (still counted by ``num_free``; reclaimed
+        under allocation pressure)."""
         for b in reversed(self._tables.pop(rid)):
-            self._free.append(b)
+            self._ref[b] -= 1
+            if self._ref[b] > 0:
+                continue
+            del self._ref[b]
+            if b in self._meta:
+                self._cached[b] = None   # warm-KV tier, LRU order
+            else:
+                self._orphan_children(b)
+                self._free.append(b)
 
     # -- tables -----------------------------------------------------------
 

@@ -289,6 +289,42 @@ def _paged_decode_horizon(params, pools, tables, kv_lens, token, active,
     return (pools, toks.T, mask.T, kv_lens, token, eos_done, counts)
 
 
+def _gather_pool_pages(pools, block_ids, *, page):
+    """Inverse of :func:`_fill_pool_pages`: assemble contiguous scratch
+    caches ([1, Hkv, n*page, D] per layer) from pool pages.
+
+    The warm-prefix prefill path (docs/serving.md "Prefix caching")
+    reads the request's SHARED prefix blocks back into its prefill
+    scratch, so the residual chunks attend to the cached K/V exactly as
+    if earlier chunks had computed it — the rows are bit-identical (the
+    pool pages were filled from a scratch of the same dtype), so the
+    stream cannot differ from a cold prefill.  ``block_ids`` covers
+    every scratch page (trace keyed by the s_ext bucket): entries past
+    the cached prefix hold the null block, whose junk rows are all
+    overwritten by the residual chunks or causally masked."""
+    n = block_ids.shape[0]
+    out = []
+    for k_pool, v_pool in pools:
+        def as_scratch(p):
+            pages = p[block_ids]                    # [n, Hkv, page, D]
+            Hkv, D = pages.shape[1], pages.shape[3]
+            return (pages.transpose(1, 0, 2, 3)
+                    .reshape(1, Hkv, n * page, D))
+        out.append((as_scratch(k_pool), as_scratch(v_pool)))
+    return out
+
+
+def _copy_pool_block(pools, src, dst):
+    """Copy one pool page ``src`` → ``dst`` across every layer's K and V
+    — the device half of a copy-on-write split (``BlockManager.cow``
+    swaps the table entry; this lands the bytes before any write)."""
+    out = []
+    for k_pool, v_pool in pools:
+        out.append((k_pool.at[dst].set(k_pool[src]),
+                    v_pool.at[dst].set(v_pool[src])))
+    return out
+
+
 def _fill_pool_pages(pools, scratch, block_ids, *, page):
     """Scatter a completed prefill's K/V (contiguous scratch caches
     [1, Hkv, n*page, D] per layer) into the request's pool pages.
@@ -403,7 +439,11 @@ class ServeEngine:
                  fault_retries: int = 1,
                  snapshot_dir: Optional[str] = None,
                  snapshot_every: Optional[int] = None,
-                 journal_fsync: bool = False):
+                 journal_fsync: bool = False,
+                 journal_fsync_interval_s: Optional[float] = None,
+                 journal_rotate_bytes: Optional[int] = None,
+                 journal_retain_done: Optional[int] = 4096,
+                 prefix_cache: bool = True):
         assert gen.attn.world == 1, (
             "ServeEngine is world-1 (the per-row block tables are host-"
             "managed); multi-chip serving keeps Generator.generate's SP "
@@ -436,7 +476,15 @@ class ServeEngine:
         self.page = page_size
         self.max_batch = max_batch
         self.n_pages_max = gen.max_seq // page_size
-        self.bm = BlockManager(num_blocks, page_size, faults=faults)
+        # prefix cache (docs/serving.md "Prefix caching"): paged blocks
+        # are content-addressed and ref-counted — admission maps a
+        # prompt's longest cached block-aligned prefix in read-only and
+        # chunked prefill starts at the first divergent chunk; freed
+        # committed blocks linger in an LRU cache tier until allocation
+        # pressure reclaims them.
+        self.prefix_cache = bool(prefix_cache)
+        self.bm = BlockManager(num_blocks, page_size, faults=faults,
+                               prefix_cache=self.prefix_cache)
         self.scheduler = FCFSScheduler(
             self.bm,
             prefill_budget=prefill_budget or 4 * prefill_chunk,
@@ -477,6 +525,37 @@ class ServeEngine:
                 f"snapshot_every must be >= 1, got {snapshot_every}")
         self.snapshot_dir = snapshot_dir
         self.snapshot_every = snapshot_every
+        # journal durability/size knobs (docs/serving.md "Crash
+        # recovery"): fsync batching at a configurable interval, and
+        # rotation/compaction at snapshot barriers once the file passes
+        # the byte bound (:meth:`_rotate_journal`).
+        if (journal_fsync_interval_s is not None
+                and journal_fsync_interval_s < 0):
+            raise ValueError(f"journal_fsync_interval_s must be >= 0, "
+                             f"got {journal_fsync_interval_s}")
+        if journal_rotate_bytes is not None and journal_rotate_bytes < 1:
+            raise ValueError(f"journal_rotate_bytes must be >= 1, "
+                             f"got {journal_rotate_bytes}")
+        if journal_retain_done is not None and journal_retain_done < 0:
+            raise ValueError(f"journal_retain_done must be >= 0, "
+                             f"got {journal_retain_done}")
+        self.journal_fsync_interval_s = journal_fsync_interval_s
+        self.journal_rotate_bytes = journal_rotate_bytes
+        # Rotation retention bound: without one, every finished request
+        # ever served would be rewritten as a `done` record at every
+        # rotation — the compacted file (and each rewrite's cost) would
+        # still grow O(total requests), and a floor above
+        # journal_rotate_bytes would re-trigger a full-history rewrite
+        # at every snapshot barrier.  Keeping only the newest N finished
+        # requests (and pruning the older ones from the engine's output
+        # map with them) is what actually bounds a long-lived engine's
+        # journal AND memory; None keeps the full history.
+        self.journal_retain_done = journal_retain_done
+        # file size right after the last rewrite: rotation re-triggers
+        # only once the file at least doubles past it, so rewrite cost
+        # stays amortized O(1) per appended byte even when the retained
+        # floor sits above journal_rotate_bytes.
+        self._journal_floor = 0
         self._snap_seq = 0
         self._last_snap_step = 0
         self._in_warmup = False
@@ -497,7 +576,9 @@ class ServeEngine:
                     f"serving state from a previous life; resume it "
                     f"with ServeEngine.restore(...) or point the fresh "
                     f"engine at a clean directory")
-            self._journal = TokenJournal(jpath, fsync=journal_fsync)
+            self._journal = TokenJournal(
+                jpath, fsync=journal_fsync,
+                fsync_interval_s=journal_fsync_interval_s)
 
         # The scratch-extent bucket ladder: every prefill's s_ext (and
         # with it the _chunk_jit extent and the _fill_fn table width)
@@ -554,6 +635,14 @@ class ServeEngine:
         self._fill_fn = CountingJit(jax.jit(functools.partial(
             _fill_pool_pages, page=page_size), donate_argnums=(0,)),
             "fill_pages")
+        # Prefix-cache device programs: the warm-prefill gather (pools
+        # read back into scratch — NOT donated, the pools live on) keyed
+        # by the s_ext rung like fill_pages, and the one-page COW copy
+        # (traced src/dst: one program total).
+        self._load_fn = CountingJit(jax.jit(functools.partial(
+            _gather_pool_pages, page=page_size)), "load_pages")
+        self._cow_fn = CountingJit(jax.jit(
+            _copy_pool_block, donate_argnums=(0,)), "cow_copy")
         # The Generator's chunked-prefill program; the trace cache lives
         # on the Generator (shared with prefill_chunked/speculative), the
         # counters here see this engine's calls.
@@ -563,6 +652,10 @@ class ServeEngine:
             self.metrics.register_compiled(c)
         if self.horizon > 1:
             self.metrics.register_compiled(self._horizon_fn)
+        if self.prefix_cache:
+            self.metrics.register_compiled(self._load_fn)
+            self.metrics.register_compiled(self._cow_fn)
+        self.metrics.attach_block_manager(self.bm)
 
         self.slots: list[Optional[ReqState]] = [None] * max_batch
         self._states: dict[str, ReqState] = {}
@@ -724,7 +817,82 @@ class ServeEngine:
         if (self.snapshot_dir is not None
                 and os.path.abspath(d) == os.path.abspath(self.snapshot_dir)):
             self._last_snap_step = self.metrics.steps
+            if (self.journal_rotate_bytes is not None
+                    and self._journal is not None
+                    and self._journal.file_bytes
+                    > self.journal_rotate_bytes
+                    and self._journal.file_bytes
+                    >= 2 * self._journal_floor):
+                self._rotate_journal()
         return info
+
+    def _rotate_journal(self) -> None:
+        """Compact the token journal at a snapshot barrier (docs/
+        serving.md "Crash recovery"): each finished request's
+        submit/tok/fin record train collapses into ONE ``done`` line
+        (prompt, params, tokens, finish — everything a restore rebuilds
+        from, so replay semantics are unchanged), and in-flight requests
+        rewrite as fresh submit/tok records.  The rewrite is atomic
+        (tmp + rename), runs only AFTER the barrier's KV snapshot
+        published (a crash mid-rotation leaves a journal some snapshot
+        fully covers), and bounds the file: without it a long-lived
+        engine's journal grows with every token it ever served
+        (ROADMAP #5a).  ``journal_retain_done=N`` caps the rewrite at
+        the N most recently finished requests — the older ones leave
+        the journal AND the engine's request/output maps (so
+        ``get_output`` forgets them; a restore never resurrects a
+        finished request either way)."""
+        if self.journal_retain_done is not None:
+            done = sorted(
+                (rid for rid, rs in self._states.items()
+                 if rs.status is Status.FINISHED
+                 and not rid.startswith("__warmup_")),
+                key=lambda rid: (
+                    self._states[rid].metrics.finish_time or 0.0,
+                    self._states[rid].seq))
+            n_drop = len(done) - self.journal_retain_done
+            for rid in done[:max(0, n_drop)]:
+                del self._states[rid]
+                self._outputs.pop(rid, None)
+                # the per-request metrics map grows with every request
+                # ever retired; pruned history leaves it too, or
+                # summary()/prefix_stats() iteration cost (and RSS)
+                # would still grow O(total requests forever)
+                self.metrics.requests.pop(rid, None)
+        recs = []
+        for rid, rs in self._states.items():
+            if rid.startswith("__warmup_"):
+                continue
+            if rs.status is Status.FINISHED:
+                out = self._outputs.get(rid)
+                if out is None:
+                    continue
+                recs.append({
+                    "t": "done", "rid": rid,
+                    "prompt": [int(x) for x in np.asarray(rs.req.prompt)],
+                    "params": rs.req.params.to_dict(),
+                    "arrival": rs.req.arrival_time,
+                    "toks": [int(t) for t in out.token_ids],
+                    "tts": list(rs.metrics.token_times),
+                    "reason": out.finish_reason.value,
+                    "err": out.error,
+                    "fts": rs.metrics.finish_time,
+                })
+            else:
+                recs.append({
+                    "t": "submit", "rid": rid,
+                    "prompt": [int(x) for x in np.asarray(rs.req.prompt)],
+                    "params": rs.req.params.to_dict(),
+                    "ts": rs.req.arrival_time})
+                times = rs.metrics.token_times
+                for i, t in enumerate(rs.generated):
+                    recs.append({
+                        "t": "tok", "rid": rid, "i": i, "tok": int(t),
+                        "ts": times[i] if i < len(times) else None})
+        self._journal.rewrite(recs)
+        self._journal_floor = self._journal.file_bytes
+        self.metrics.journal_rotations += 1
+        self._note_journal()
 
     @classmethod
     def restore(cls, directory, gen, params, **kwargs) -> "ServeEngine":
@@ -753,6 +921,12 @@ class ServeEngine:
         speculation off and degrades to plain decode.  Only ``_FATAL``
         (watchdog trips, interrupts) escapes."""
         self._beat()
+        if self._journal is not None:
+            # Group-commit deadline sweep: an fsync interval is only
+            # checked inside append(), so a traffic pause would leave
+            # the burst's last record un-fsynced indefinitely without
+            # this per-step nudge.
+            self._journal.maybe_sync()
         if self.faults is not None:
             # The audit log stamps every firing with the engine step so
             # a chaos schedule replays deterministically post-mortem.
@@ -774,7 +948,22 @@ class ServeEngine:
         free = [i for i, s in enumerate(self.slots) if s is None]
         for rs in self.scheduler.admit(free, now):
             self.slots[rs.slot] = rs
-            self._start_prefill(rs)
+            if rs.cached_prefix > 0:
+                self.metrics.prefix_hits += 1
+                self.metrics.prefix_hit_tokens += rs.cached_prefix
+                rs.metrics.cached_prefix_tokens = rs.cached_prefix
+            try:
+                self._start_prefill(rs)
+            except _FATAL:
+                raise
+            except Exception as e:
+                if not self._state_intact():
+                    raise  # pools consumed: engine-fatal
+                # the warm-prefix gather is the only device call here;
+                # it reads (never donates) the pools, so a failure is
+                # per-request by construction — quarantine and serve on
+                finished.append(self._quarantine(rs, f"prefill start: "
+                                                     f"{e!r}"))
 
         prefilling = [s for s in self.slots
                       if s is not None and s.status is Status.PREFILL]
@@ -885,6 +1074,12 @@ class ServeEngine:
         guard = (self.faults.disabled() if self.faults is not None
                  else contextlib.nullcontext())
         self._in_warmup = True  # dummy traffic must not trigger snapshots
+        # Dummy prompts must not seed or match the content index: their
+        # zero-token chains would shadow real traffic's and park dummy
+        # blocks in the cache tier past the scrub.  The load/cow device
+        # programs are warmed by direct dispatch below instead.
+        saved_pc = self.bm.prefix_cache
+        self.bm.prefix_cache = False
         try:
             with guard:
                 prev, round_ = -1, 0
@@ -942,6 +1137,21 @@ class ServeEngine:
                                 self._warmup_horizon_try(
                                     f"wh{round_}_{r}_{ti}", r, temp)
                                 self.run()
+                    if self.prefix_cache:
+                        # Warm-prefix programs: the pool->scratch gather
+                        # (one trace per ladder rung, like fill_pages)
+                        # and the one-page COW copy (traced src/dst: one
+                        # trace total).  All-null ids / the null block
+                        # make the dispatches harmless.
+                        for rung in self.ladder:
+                            self._device_call(
+                                "load_pages", (), self._load_fn,
+                                self._pools,
+                                jnp.asarray(np.zeros(
+                                    (rung // self.page,), np.int32)))
+                        self._pools = self._device_call(
+                            "cow_copy", (), self._cow_fn, self._pools,
+                            jnp.int32(0), jnp.int32(0))
                     for rid in [r for r in self._outputs
                                 if r.startswith("__warmup_")]:
                         del self._outputs[rid]
@@ -949,6 +1159,7 @@ class ServeEngine:
                     round_ += 1
         finally:
             self._in_warmup = False
+            self.bm.prefix_cache = saved_pc
             self.metrics = saved
         dt = time.perf_counter() - t0
         fresh = self.metrics.compile_misses - misses0
@@ -1036,6 +1247,25 @@ class ServeEngine:
         cfg = self.cfg
         s_ext = self._bucket_s_ext(int(rs.prompt_tokens.shape[0]))
         rs.s_ext = s_ext
+        cached = rs.cached_prefix if self.prefix_cache else 0
+        chunk = self.scheduler.prefill_chunk
+        # Warm prefix (docs/serving.md "Prefix caching"): admission
+        # mapped `cached` block-aligned tokens of shared KV into the
+        # table; chunked prefill starts at the chunk FLOOR of that (the
+        # fixed-chunk trace contract needs chunk-aligned starts — the
+        # few tokens between floor and hit recompute bit-identically
+        # over the gathered rows) and only the residual pays compute.
+        start = (cached // chunk) * chunk
+        if start > 0:
+            rs.prefill_pos = start
+            ids = np.zeros((s_ext // self.page,), np.int32)
+            n_hit = cached // self.page
+            ids[:n_hit] = self.bm.table(rs.req.request_id)[:n_hit]
+            rs.scratch = self._device_call(
+                "load_pages", (rs.req.request_id,), self._load_fn,
+                self._pools, jnp.asarray(ids))
+            self.metrics.prefix_skipped_tokens += start
+            return
         rs.scratch = [
             (jnp.zeros((1, cfg.n_kv_heads, s_ext, cfg.head_dim),
                        cfg.dtype),
@@ -1079,15 +1309,21 @@ class ServeEngine:
         # One table entry per SCRATCH page (trace keyed by the s_ext
         # bucket, not the prompt's page count); pages past the prompt's
         # allocation scatter their zero-masked padding into the null
-        # block.
+        # block.  SHARED prefix pages (a warm hit) scatter there too —
+        # their pool pages already hold the exact K/V and are read-only
+        # to this request (never write a block with refcount > 1).
+        n_hit = (rs.cached_prefix // self.page if self.prefix_cache
+                 else 0)
         ids = np.zeros((rs.s_ext // self.page,), np.int32)
-        ids[:n_prompt_pages] = self.bm.table(rid)[:n_prompt_pages]
+        ids[n_hit:n_prompt_pages] = \
+            self.bm.table(rid)[n_hit:n_prompt_pages]
         self._pools = self._device_call(
             "fill_pages", (rid,), self._fill_fn, self._pools, rs.scratch,
             jnp.asarray(ids))
         rs.scratch = None
         rs.kv_len = S0
         rs.status = Status.RUNNING
+        self._commit_full_blocks(rs)
         last = logits[:, n_last - 1]                       # [1, V]
         if self.spec_k and not self._spec_off:
             self._last_logits = self._last_logits.at[rs.slot].set(last[0])
@@ -1384,10 +1620,17 @@ class ServeEngine:
         later-admitted slot holders (running OR mid-prefill — both hold
         blocks) until it fits.  Victims never include ``rs`` itself;
         when none remain the pool is genuinely too small for this
-        request and the engine raises."""
+        request and the engine raises.
+
+        Capacity includes EXCLUSIVITY (docs/serving.md "Prefix
+        caching"): every page the grown request may write must be owned
+        by it alone, so shared pages in the write range copy-on-write
+        split here — under the same preemption loop, since the split
+        needs a fresh block too."""
         while True:
             try:
                 self.bm.ensure(rs.req.request_id, n_tokens)
+                self._cow_writable(rs)
                 return
             except BlockExhausted:
                 victim = self.scheduler.pick_victim(
@@ -1407,6 +1650,55 @@ class ServeEngine:
         victim.scratch = None
         self.scheduler.preempt(victim)
         self.metrics.preemptions += 1
+
+    # -- prefix sharing: copy-on-write + content commits ------------------
+
+    def _cow_writable(self, rs: ReqState) -> None:
+        """Copy-on-write guard (docs/serving.md "Prefix caching"): every
+        logical page from ``rs``'s current length to the end of its
+        allocation — the pages a decode/verify write may touch — must be
+        exclusively owned.  A page still shared (refcount > 1: a
+        partially-filled tail mapped into several tables by beam-style
+        sharing or a restored overlapping snapshot) splits here: the
+        block manager swaps in a fresh block and the device copies the
+        page BEFORE any write can land.  Admission-shared prefix pages
+        are full pages strictly below the write range, so steady-state
+        traffic never pays a copy — the loop is a few dict lookups."""
+        rid = rs.req.request_id
+        table = self.bm.table(rid)
+        for logical in range(rs.kv_len // self.page, len(table)):
+            if self.bm.ref_of(table[logical]) <= 1:
+                continue
+            old, new = self.bm.cow(rid, logical)
+            self._pools = self._device_call(
+                "cow_copy", (rid,), self._cow_fn, self._pools,
+                jnp.int32(old), jnp.int32(new))
+
+    def _commit_full_blocks(self, rs: ReqState) -> None:
+        """Register every newly-FULL logical page of ``rs`` in the
+        content index (``BlockManager.commit_block``) so later prompts —
+        a multi-turn session's next turn, an identical system prompt, a
+        preempted victim's recompute — map it read-only instead of
+        re-prefilling.  Generated tokens commit too, the moment their
+        page fills: cache row ``j`` holds the K/V of ``prompt[j]`` for
+        ``j < S0`` and of ``generated[j - S0]`` past it (a recompute
+        prompt is exactly that concatenation, so the indexing is
+        invariant under preemption).  ``committed_pages`` is the
+        watermark — each page commits once per admission."""
+        if not self.bm.prefix_cache:
+            return
+        full = rs.kv_len // self.page
+        if full <= rs.committed_pages:
+            return
+        rid = rs.req.request_id
+        prompt = rs.req.prompt
+        S0 = int(prompt.shape[0])
+        for logical in range(rs.committed_pages, full):
+            lo = logical * self.page
+            toks = [int(prompt[j]) if j < S0 else rs.generated[j - S0]
+                    for j in range(lo, lo + self.page)]
+            self.bm.commit_block(rid, logical, toks)
+        rs.committed_pages = full
 
     # -- plain decode -----------------------------------------------------
 
@@ -1500,6 +1792,7 @@ class ServeEngine:
                 continue  # aborted mid-loop by a slot-mate's callback
             rs.kv_len += 1
             rs.pending_token = None
+            self._commit_full_blocks(rs)  # the write just landed
             try:
                 token = self._choose_token(rs, logits_np[rs.slot])
                 out = self._commit_token(rs, token)
@@ -1663,6 +1956,10 @@ class ServeEngine:
                         finished.append(self._quarantine(
                             rs, f"commit: {e!r}"))
                         continue
+                    if rs.status is Status.RUNNING:
+                        # the burst's tokens are in `generated` now, so
+                        # any page the device filled this link commits
+                        self._commit_full_blocks(rs)
                     if out is not None:
                         finished.append(out)
         except (*_FATAL, ChainCommitted):
@@ -1824,6 +2121,8 @@ class ServeEngine:
                 if out is not None or rs.status is not Status.RUNNING:
                     break  # retired mid-round; rest of the chain dropped
             rs.pending_token = None  # spec mode: cache already consumed it
+            if rs.status is Status.RUNNING:
+                self._commit_full_blocks(rs)
             if out is not None:
                 finished.append(out)
         return finished

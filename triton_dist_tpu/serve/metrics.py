@@ -35,6 +35,11 @@ class RequestMetrics:
     finish_time: Optional[float] = None
     token_times: list[float] = field(default_factory=list)
     n_preemptions: int = 0
+    # prefix cache (docs/serving.md "Prefix caching"): prompt tokens
+    # covered by shared cached blocks at this request's admission — a
+    # warm request skips that much prefill compute, so its TTFT is the
+    # number the cache exists to collapse
+    cached_prefix_tokens: int = 0
 
     def on_scheduled(self, now: float) -> None:
         if self.first_scheduled_time is None:
@@ -86,6 +91,7 @@ class RequestMetrics:
             "mean_itl": self.mean_itl,
             "n_tokens": len(self.token_times),
             "n_preemptions": self.n_preemptions,
+            "cached_prefix_tokens": self.cached_prefix_tokens,
             "finish_time": self.finish_time,
         }
 
@@ -133,10 +139,19 @@ class ServeMetrics:
     snapshot_ms_total: float = 0.0
     journal_records: int = 0      # journal appends by this engine
     journal_bytes: int = 0
+    journal_rotations: int = 0    # compactions at snapshot barriers
     restores: int = 0             # 1 on an engine built by restore()
     restored_in_place: int = 0    # requests resumed with live KV
     restored_requeued: int = 0    # requests re-queued for recompute
     restored_tokens: int = 0      # journal tokens carried across
+    # prefix-cache counters (docs/serving.md "Prefix caching"): engine-
+    # side admission hits; the block-level gauges (refcounts, cache
+    # tier, COW/eviction counts) live on the attached BlockManager and
+    # merge into summary()["prefix_cache"] via attach_block_manager().
+    prefix_hits: int = 0          # admissions mapping >= 1 shared block
+    prefix_hit_tokens: int = 0    # prompt tokens covered by shared blocks
+    prefix_skipped_tokens: int = 0  # prefill tokens actually skipped
+    block_manager: object = field(default=None, repr=False)
     # compilation observability: CountingJit wrappers the engine
     # registers (runtime/jit_cache.py) + warmup accounting
     compiled_fns: list = field(default_factory=list, repr=False)
@@ -186,11 +201,44 @@ class ServeMetrics:
             "snapshot_ms_total": self.snapshot_ms_total,
             "journal_records": self.journal_records,
             "journal_bytes": self.journal_bytes,
+            "journal_rotations": self.journal_rotations,
             "restores": self.restores,
             "restored_in_place": self.restored_in_place,
             "restored_requeued": self.restored_requeued,
             "restored_tokens": self.restored_tokens,
         }
+
+    def attach_block_manager(self, bm) -> None:
+        """Fold the block manager's prefix-cache gauges into
+        :meth:`summary` (the engine calls this at construction)."""
+        self.block_manager = bm
+
+    def prefix_stats(self) -> dict:
+        """Admission-level hit counters + block-level cache gauges +
+        the warm/cold TTFT split (summary()["prefix_cache"]).  A warm
+        request is one whose admission mapped >= 1 shared block;
+        ``ttft_warm_over_cold`` is the ratio the cache exists to
+        collapse (the bench gate holds it <= 0.35 for a shared-prompt
+        workload)."""
+        warm = [m.ttft for m in self.requests.values()
+                if m.cached_prefix_tokens > 0 and m.ttft is not None]
+        cold = [m.ttft for m in self.requests.values()
+                if m.cached_prefix_tokens == 0 and m.ttft is not None]
+        out = {
+            "prefix_hits": self.prefix_hits,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "prefix_skipped_tokens": self.prefix_skipped_tokens,
+            "warm_requests": len(warm),
+            "cold_requests": len(cold),
+            "mean_ttft_warm": sum(warm) / len(warm) if warm else None,
+            "mean_ttft_cold": sum(cold) / len(cold) if cold else None,
+            "ttft_warm_over_cold": (
+                (sum(warm) / len(warm)) / (sum(cold) / len(cold))
+                if warm and cold and sum(cold) > 0 else None),
+        }
+        if self.block_manager is not None:
+            out.update(self.block_manager.prefix_stats())
+        return out
 
     def decode_stats(self) -> dict:
         """The decode-loop dispatch economics (summary()["decode"]).
@@ -268,6 +316,7 @@ class ServeMetrics:
             "decode": self.decode_stats(),
             "failures": self.failure_stats(),
             "recovery": self.recovery_stats(),
+            "prefix_cache": self.prefix_stats(),
             "compilation": self.compile_stats(),
             "requests": {rid: m.to_dict()
                          for rid, m in self.requests.items()},

@@ -100,18 +100,43 @@ class TokenJournal:
     """Append-only JSONL journal of submissions, token commits, and
     retirements.  Flushed per record (optionally fsynced with
     ``fsync=True`` — the engine's ``journal_fsync``); :meth:`sync`
-    forces durability at snapshot barriers regardless."""
+    forces durability at snapshot barriers regardless.
 
-    def __init__(self, path: str | os.PathLike, *, fsync: bool = False):
+    **Group commit** (``fsync_interval_s=``, ROADMAP #5a): a per-record
+    ``fsync`` costs a disk round trip per token — batching it to at most
+    one fsync per interval keeps the power-loss window bounded by the
+    interval instead of unbounded (flush-only) without paying the
+    per-token sync.  ``sync()`` (the snapshot barrier) always fsyncs,
+    so the KV snapshot can never publish ahead of the journal.
+
+    **Compaction** (:meth:`rewrite`): the engine rewrites the journal at
+    snapshot barriers — finished requests collapse into single ``done``
+    records — through an atomic tmp + rename, so the file stops growing
+    with every token ever served; a crash anywhere during the rewrite
+    leaves either the old or the new journal whole."""
+
+    def __init__(self, path: str | os.PathLike, *, fsync: bool = False,
+                 fsync_interval_s: Optional[float] = None):
         self.path = os.path.abspath(os.fspath(path))
         parent = os.path.dirname(self.path)
         if parent:
             os.makedirs(parent, exist_ok=True)
+        # A tmp file is an aborted rewrite (the process died between
+        # writing it and the rename): the original journal is whole, the
+        # orphan is garbage.
+        try:
+            os.unlink(self.path + ".tmp")
+        except OSError:
+            pass
         self._heal_torn_tail()
         self._f = open(self.path, "a", encoding="utf-8")
         self.fsync = bool(fsync)
+        self.fsync_interval_s = fsync_interval_s
+        self._last_fsync = time.monotonic()
+        self._dirty = False  # flushed-but-not-fsynced tail
         self.records = 0   # appended by THIS process (not the file total)
         self.bytes = 0
+        self.file_bytes = os.path.getsize(self.path)
 
     def _heal_torn_tail(self) -> None:
         """Truncate a partial final line before appending: a crash
@@ -146,10 +171,20 @@ class TokenJournal:
         line = json.dumps(rec, separators=(",", ":")) + "\n"
         self._f.write(line)
         self._f.flush()
+        self._dirty = True
         if self.fsync:
             os.fsync(self._f.fileno())
+            self._last_fsync = time.monotonic()
+            self._dirty = False
+        elif self.fsync_interval_s is not None:
+            now = time.monotonic()
+            if now - self._last_fsync >= self.fsync_interval_s:
+                os.fsync(self._f.fileno())
+                self._last_fsync = now
+                self._dirty = False
         self.records += 1
         self.bytes += len(line)
+        self.file_bytes += len(line)
 
     def submit(self, req: Request) -> None:
         self.append({"t": "submit", "rid": req.request_id,
@@ -170,6 +205,38 @@ class TokenJournal:
         """Force everything appended so far to disk (snapshot barrier)."""
         self._f.flush()
         os.fsync(self._f.fileno())
+        self._last_fsync = time.monotonic()
+        self._dirty = False
+
+    def maybe_sync(self) -> None:
+        """Group-commit deadline sweep — the engine calls this every
+        step.  ``append`` only checks the fsync interval when the NEXT
+        record arrives, so without a sweep the last record of a burst
+        would sit in the OS page cache for as long as traffic pauses —
+        exactly the unbounded power-loss window ``fsync_interval_s``
+        exists to bound."""
+        if (self._dirty and self.fsync_interval_s is not None
+                and time.monotonic() - self._last_fsync
+                >= self.fsync_interval_s):
+            self.sync()
+
+    def rewrite(self, records: list[dict]) -> None:
+        """Atomically replace the journal's contents with ``records``
+        (the engine's snapshot-barrier compaction).  tmp + fsync +
+        rename: readers and a crash at any instant see either the old
+        journal or the complete new one, never a torn mix."""
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for rec in records:
+                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self._f.close()
+        os.replace(tmp, self.path)
+        self._f = open(self.path, "a", encoding="utf-8")
+        self._last_fsync = time.monotonic()
+        self._dirty = False
+        self.file_bytes = os.path.getsize(self.path)
 
     def close(self) -> None:
         try:
@@ -243,6 +310,22 @@ def replay_journal(path: str | os.PathLike) -> dict[str, JournalRequest]:
                 jr.finish = {"reason": rec["reason"],
                              "err": rec.get("err"),
                              "n": rec.get("n"), "ts": rec.get("ts")}
+            elif t == "done":
+                # One-line compacted request (a snapshot-barrier journal
+                # rotation): submit + every tok + fin folded together.
+                if jr.prompt is None:
+                    jr.prompt = np.asarray(rec["prompt"], np.int32)
+                    jr.params = SamplingParams.from_dict(rec["params"])
+                    jr.arrival = rec.get("arrival")
+                tts = rec.get("tts") or []
+                for i, tok in enumerate(rec.get("toks", [])):
+                    jr.tokens.setdefault(
+                        i, (int(tok), tts[i] if i < len(tts) else None))
+                if jr.finish is None:
+                    jr.finish = {"reason": rec["reason"],
+                                 "err": rec.get("err"),
+                                 "n": len(rec.get("toks", [])),
+                                 "ts": rec.get("fts")}
     return out
 
 
@@ -281,6 +364,8 @@ def _capture_meta(engine, now: float, *, journal_here: bool) -> dict:
             "first_tok": rs.metrics.first_token_time,
             "token_times": list(rs.metrics.token_times),
             "n_preempt": rs.metrics.n_preemptions,
+            "cached_prefix": rs.cached_prefix,
+            "committed_pages": rs.committed_pages,
         }
     # Finished requests ride the manifest only when this directory has
     # no co-located journal to carry them (a one-shot snapshot to a
@@ -314,6 +399,7 @@ def _capture_meta(engine, now: float, *, journal_here: bool) -> dict:
             "horizon": engine.horizon,
             "pipeline": engine.pipeline,
             "spec_k": engine.spec_k,
+            "prefix_cache": engine.prefix_cache,
             "snapshot_every": engine.snapshot_every,
             "n_layers": cfg.n_layers,
             "n_kv_heads": cfg.n_kv_heads,
@@ -326,6 +412,16 @@ def _capture_meta(engine, now: float, *, journal_here: bool) -> dict:
                     if not rs.req.request_id.startswith("__warmup_")],
         "tables": {rid: list(t) for rid, t in engine.bm._tables.items()
                    if not rid.startswith("__warmup_")},
+        # Prefix cache (docs/serving.md "Prefix caching"): the content
+        # index [block, parent, tokens-in-block] plus the LRU order of
+        # the warm cache tier — restore re-registers live shared blocks
+        # and re-admits the tier, so the warm cache survives a restart
+        # (admit_cached as cache admission, the ROADMAP #3 design).
+        "prefix": {
+            "index": [[b, p, list(t)] for b, (p, t)
+                      in engine.bm._meta.items()],
+            "cached": [int(b) for b in engine.bm._cached],
+        },
         "requests": reqs,
         "outputs": outs,
     }
@@ -471,7 +567,8 @@ def _shift(ts: Optional[float], offset: float) -> Optional[float]:
 
 
 _META_KW = ("num_blocks", "page_size", "max_batch", "prefill_chunk",
-            "prefill_budget", "horizon", "pipeline", "snapshot_every")
+            "prefill_budget", "horizon", "pipeline", "snapshot_every",
+            "prefix_cache")
 
 
 def restore_engine(directory: str | os.PathLike, gen, params, *,
@@ -480,6 +577,8 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
                    on_token: Union[None, Callable, dict] = None,
                    replay_tokens: bool = False,
                    faults=None, journal_fsync: bool = False,
+                   journal_fsync_interval_s: Optional[float] = None,
+                   journal_rotate_bytes: Optional[int] = None,
                    **overrides):
     """Rebuild a :class:`ServeEngine` from the snapshot + journal under
     ``directory`` (the implementation of ``ServeEngine.restore``).
@@ -510,7 +609,8 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
     kw: dict[str, Any] = {}
     if meta is not None:
         for k in _META_KW:
-            kw[k] = meta["engine"][k]
+            if k in meta["engine"]:  # tolerate pre-prefix-cache manifests
+                kw[k] = meta["engine"][k]
         if draft is not None:
             kw["spec_k"] = meta["engine"]["spec_k"]
     kw.update(overrides)
@@ -530,8 +630,11 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
                          faults=faults, **kw)
     engine.snapshot_dir = directory
     engine.snapshot_every = snap_every
-    engine._journal = TokenJournal(os.path.join(directory, JOURNAL_NAME),
-                                   fsync=journal_fsync)
+    engine.journal_fsync_interval_s = journal_fsync_interval_s
+    engine.journal_rotate_bytes = journal_rotate_bytes
+    engine._journal = TokenJournal(
+        os.path.join(directory, JOURNAL_NAME), fsync=journal_fsync,
+        fsync_interval_s=journal_fsync_interval_s)
     if meta is not None:
         engine._snap_seq = step + 1
         engine._spec_off = bool(meta.get("spec_off", False))
@@ -776,12 +879,19 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
             continue
         free_slots.remove(slot)
         rs = build_state(rid)
-        engine.bm.adopt(rid, m_tables[rid])
+        # shared_ok under the prefix cache: snapshot tables legitimately
+        # overlap on shared prefix blocks (refcounts rebuild from the
+        # overlap itself); without it, overlap still means corruption.
+        engine.bm.adopt(rid, m_tables[rid],
+                        shared_ok=engine.bm.prefix_cache)
         rs.status = Status.RUNNING
         rs.slot = slot
         rs.kv_len = mr["kv_len"]
         rs.pending_token = mr["pending"]
         rs.seq = mr["seq"]
+        rs.cached_prefix = mr.get("cached_prefix", 0)
+        rs.committed_pages = mr.get("committed_pages", 0)
+        rs.metrics.cached_prefix_tokens = rs.cached_prefix
         engine.slots[slot] = rs
         engine._states[rid] = rs
         resumed.append(rid)
@@ -821,6 +931,25 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
         engine.scheduler.add(rs)
         m.restored_requeued += 1
         m.restored_tokens += len(rs.generated)
+
+    # -- prefix cache: index + warm tier survive the restart --------------
+    # Live shared blocks re-register first (their tables were just
+    # re-adopted), then the snapshot's LRU cache tier re-admits in order
+    # — restore's adopt path doubling as cache admission, so a restarted
+    # engine's first warm prompt still skips its prefill.  Gated on
+    # pools_ok: without the restored pool bytes a "warm" block would
+    # certify KV that no longer exists.
+    pfx = meta.get("prefix") if meta is not None else None
+    if pfx and pools_ok and engine.bm.prefix_cache:
+        n_valid = min(meta["engine"]["num_blocks"], engine.bm.num_blocks)
+        index = [(int(b), int(p), t) for b, p, t in pfx.get("index", ())
+                 if 0 < int(b) < n_valid]
+        engine.bm.restore_index(index)
+        by_block = {b: (p, t) for b, p, t in index}
+        for b in pfx.get("cached", ()):
+            if int(b) in by_block:
+                p, t = by_block[int(b)]
+                engine.bm.admit_cached(int(b), p, t)
 
     seqs = [s.seq for s in engine.slots if s is not None]
     engine.scheduler._seq = max(

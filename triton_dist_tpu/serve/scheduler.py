@@ -67,6 +67,26 @@ class ReqState:
     # pre-crash token; this field records that provenance and bounds
     # the restore(replay_tokens=True) redelivery
     journal_base: int = 0
+    # prefix cache (docs/serving.md "Prefix caching"): tokens of this
+    # admission's prompt covered by shared cached blocks (block-aligned;
+    # set by admit(), reset on preemption — the re-admission re-matches).
+    # The engine starts chunked prefill at the chunk floor of this, so a
+    # warm prefix pays ~one residual chunk instead of the whole prompt.
+    cached_prefix: int = 0
+    # full logical pages whose token contents the engine has committed to
+    # the content index (a watermark, monotone within one admission)
+    committed_pages: int = 0
+    # whether this admission attempt already counted toward the block
+    # manager's lookups/lookup_hits gauges (a blocked head re-matches
+    # every step; only the first walk per admission attempt counts, so
+    # hit_rate stays per-request, not per-retry)
+    lookup_counted: bool = False
+    # memoized match_prefix result for THIS admission attempt, valid
+    # while the index generation it was computed under is current — a
+    # capacity-blocked head re-enters admission every engine step, and
+    # without the memo each retry re-pays the O(prompt) chain walk
+    match_cache: Optional[list] = None
+    match_gen: int = -1
 
     def expired(self, now: float) -> bool:
         """Past its deadline TTL (``params.deadline_s`` from arrival)."""
@@ -132,15 +152,42 @@ class FCFSScheduler:
     def admit(self, free_slots: list[int], now: float) -> list[ReqState]:
         """Pop waiting requests while a slot and their prompt's blocks
         (plus one decode-headroom block) are available.  FCFS: the head
-        blocking keeps everyone behind it queued — no starvation."""
+        blocking keeps everyone behind it queued — no starvation.
+
+        With the block manager's prefix cache on, the prompt's longest
+        cached block-aligned prefix maps in as SHARED blocks: only the
+        remainder needs free blocks (so a warm prompt admits under
+        pressure a cold one could not), and ``rs.cached_prefix`` tells
+        the engine where chunked prefill may start.  A recompute prompt
+        (``work_prompt`` after preemption) matches the same way — the
+        victim's own committed blocks usually sit in the cache tier, so
+        preemption recompute collapses too."""
         admitted = []
         while self.waiting and free_slots:
+            # Every admission needs >= 1 fresh block (match_prefix caps
+            # at n_prompt - 1 tokens, so shared pages never cover the
+            # prompt + headroom) — with nothing allocatable, skip the
+            # O(prompt) chain walk entirely.
+            if self.bm.num_free == 0:
+                break
             rs = self.waiting[0]
             n_prompt = int(rs.prompt_tokens.shape[0])
+            # match_prefix caps at n_prompt - 1: at least one prompt
+            # token always prefills (the request needs its logits).
+            if (rs.match_cache is not None
+                    and rs.match_gen == self.bm.index_gen):
+                shared = rs.match_cache
+            else:
+                shared = self.bm.match_prefix(
+                    np.asarray(rs.prompt_tokens),
+                    count=not rs.lookup_counted)
+                rs.lookup_counted = True
+                rs.match_cache = shared
+                rs.match_gen = self.bm.index_gen
             # +1 token of headroom: admission must leave room to decode
             # at least one token past the prompt, or the request would
             # immediately preempt something.
-            if not self.bm.can_allocate(n_prompt + 1):
+            if not self.bm.can_allocate(n_prompt + 1, shared):
                 break
             self.waiting.popleft()
             rs.slot = free_slots.pop(0)
@@ -149,7 +196,11 @@ class FCFSScheduler:
             rs.kv_len = 0
             rs.seq = self._seq
             self._seq += 1
-            self.bm.allocate(rs.req.request_id, n_prompt + 1)
+            self.bm.allocate(rs.req.request_id, n_prompt + 1,
+                             shared=shared)
+            rs.match_cache = None  # consumed
+            rs.cached_prefix = len(shared) * self.bm.page_size
+            rs.committed_pages = len(shared)
             rs.metrics.on_scheduled(now)
             admitted.append(rs)
         return admitted
@@ -244,5 +295,16 @@ class FCFSScheduler:
         rs.kv_len = 0
         rs.prefill_pos = 0
         rs.pending_token = None
+        rs.cached_prefix = 0
+        rs.committed_pages = 0
+        # The recompute admission re-matches (and may land cold): a
+        # request whose TTFT is still pending must be re-classified by
+        # what that admission finds, not by the one that was evicted.
+        # An already-recorded TTFT keeps its warm/cold label.
+        rs.lookup_counted = False
+        rs.match_cache = None  # the recompute prompt is different
+        rs.match_gen = -1
+        if rs.metrics.first_token_time is None:
+            rs.metrics.cached_prefix_tokens = 0
         rs.metrics.n_preemptions += 1
         self.add(rs, front=True)
