@@ -410,6 +410,22 @@ def _bench_serve_engine():
     return r8["decode_toks_per_s"], speedup
 
 
+def _bench_serve_spec():
+    """Fused speculative rounds vs plain fused decode at H=8
+    (scripts/bench_serve.py bench_spec): the tokens-per-dispatch ratio
+    on the identical warmed workload, with a SELF-draft (acceptance ~1)
+    so the quotient isolates the one-dispatch round's economics from
+    draft quality.  >= 1.0 is the ISSUE-7 acceptance bar — a fused
+    round commits ~k+1 tokens per row per dispatch vs the horizon's H —
+    and, as a paired quotient on one host, it is dispatch-drift-immune
+    like ring_vs_dense/decode_vs_xla (docs/perf.md 'Bench
+    trajectory')."""
+    from scripts.bench_serve import bench_spec
+
+    r = bench_spec(k=12, batch=4, prompt_len=16, new_tokens=48, dim=32)
+    return r["spec_vs_plain_tokens_per_dispatch"]
+
+
 def check_floors(out: dict, floors: dict) -> tuple[dict, list]:
     """Per-metric guardrail (PERF_FLOORS.json, ROADMAP #5b): for each
     floor whose metric is present in ``out``, a ``vs_floor`` ratio
@@ -453,6 +469,7 @@ def main():
     decode_us, decode_ratio = _bench_decode_us()
     ring_ratio = _bench_ring_vs_dense()
     serve_tps, serve_speedup = _bench_serve_engine()
+    spec_speedup = _bench_serve_spec()
 
     peak = peak_bf16_tflops()
     vs = (tflops / peak) / REF_UTILIZATION if peak else 0.0
@@ -483,6 +500,11 @@ def main():
         # decode horizon exists to move (scripts/bench_serve.py).
         "serve_toks_per_s": round(serve_tps, 1),
         "serve_horizon_speedup": round(serve_speedup, 2),
+        # Fused speculative rounds vs plain fused decode (H=8), paired
+        # tokens-per-dispatch quotient with a self-draft — the PR 7
+        # one-dispatch spec path's guardrail (>= 1.0 means a spec round
+        # commits at least as many tokens per dispatch as the horizon).
+        "serve_spec_speedup": round(spec_speedup, 2),
         # Known-cost reference op (bare XLA dot, measured ceiling 189.7):
         # a depressed sentinel means the HOST was contended during this
         # session and `value` is a lower bound, not a regression.
@@ -512,7 +534,8 @@ def main():
           f"{tflops / peak:.1%}, shape M={M} K={K} N/chip={N_PER_CHIP}; "
           f"moe_a2a floor {moe_a2a_us:.2f} us; decode {decode_us:.1f} us; "
           f"ring/dense {ring_ratio:.3f}; decode/xla {decode_ratio:.3f}; "
-          f"serve {serve_tps:.0f} tok/s (H8/H1 {serve_speedup:.2f}x); "
+          f"serve {serve_tps:.0f} tok/s (H8/H1 {serve_speedup:.2f}x, "
+          f"spec/plain {spec_speedup:.2f}x t/dispatch); "
           f"sentinel dot {sentinel_tflops:.1f} TFLOPS"
           + (" (CONTENDED)" if contended else ""),
           file=sys.stderr)

@@ -57,6 +57,13 @@ def parse_args():
                         "temperature 0, rejection sampling otherwise; "
                         "batch > 1 rides the q_lens multi-token verify "
                         "kernel and needs a world-1 mesh)")
+    p.add_argument("--spec-adaptive", type=int, default=None,
+                   metavar="W",
+                   help="engine mode with --speculative: adaptive "
+                        "per-row speculation depth from a W-round "
+                        "acceptance window (docs/serving.md "
+                        "'Speculative decoding'; 0 pins k fixed; "
+                        "default: the engine's window of 8)")
     p.add_argument("--engine", action="store_true",
                    help="continuous-batching serving engine "
                         "(triton_dist_tpu/serve): staggered multi-"
@@ -153,6 +160,13 @@ def parse_args():
     args = p.parse_args()
     if args.sessions is not None and args.sessions < 1:
         p.error(f"--sessions must be >= 1, got {args.sessions}")
+    if args.speculative is not None and args.speculative < 1:
+        p.error(f"--speculative must be >= 1, got {args.speculative}")
+    if args.spec_adaptive is not None and args.spec_adaptive < 0:
+        p.error(f"--spec-adaptive must be >= 0 (0 pins k fixed), got "
+                f"{args.spec_adaptive}")
+    if args.spec_adaptive is not None and not args.speculative:
+        p.error("--spec-adaptive needs --speculative")
     return args
 
 
@@ -235,6 +249,8 @@ def run_engine(args, key):
               faults=faults, max_queue=max_queue, fault_retries=1,
               heartbeat=args.heartbeat,
               heartbeat_interval_s=args.hb_interval)
+    if args.spec_adaptive is not None:
+        kw["spec_adaptive"] = args.spec_adaptive
     from triton_dist_tpu.serve.recovery import has_restorable_state
 
     # An empty journal the constructor touched before the process died
@@ -397,6 +413,17 @@ def run_engine(args, key):
                f"tokens ({d['decode_steps']} device steps) — "
                f"{d['tokens_per_dispatch']:.2f} tokens/dispatch, "
                f"{d['dispatches_per_token']:.3f} dispatches/token")
+    if args.speculative:
+        sp = s["spec"]
+        dist_print(f"speculative: {sp['rounds']} fused rounds, accept "
+                   f"rate {sp['accept_rate']:.2f} (rolling "
+                   f"{sp['rolling_accept_rate']:.2f}), chosen k "
+                   f"{sp['chosen_k']}, "
+                   f"{sp['spec_tokens_per_dispatch']:.2f} spec tokens/"
+                   f"dispatch, {sp['bailouts']} bailouts"
+                   + (f", {sp['draft_prefix_skipped_tokens']} draft "
+                      f"prefill tokens skipped"
+                      if sp['draft_prefix_skipped_tokens'] else ""))
     if engine.prefix_cache:
         pc = s["prefix_cache"]
         ratio = (f", warm/cold ttft {pc['ttft_warm_over_cold']:.2f}x"

@@ -104,6 +104,80 @@ def bench_engine(horizon: int, *, batch: int = 4, prompt_len: int = 16,
     }
 
 
+def bench_spec(*, k: int = 12, batch: int = 4, prompt_len: int = 16,
+               new_tokens: int = 64, pipeline: int = 2, dim: int = 64,
+               n_layers: int = 2, vocab: int = 256, page_size: int = 16,
+               seed: int = 0, warmup: bool = True,
+               horizon: int = 8) -> dict:
+    """Fused speculative rounds vs plain fused decode (docs/serving.md
+    "Speculative decoding"): the SAME steady decode-only workload runs
+    through a spec engine (one dispatch per whole round) and through
+    ``bench_engine`` at ``horizon`` (the plain fused-decode champion),
+    and the headline is the tokens-per-dispatch ratio — the ISSUE-7
+    guardrail (spec >= plain at H=8, carried by ``bench.py`` as
+    ``serve_spec_speedup`` with a ``PERF_FLOORS.json`` floor).
+
+    The draft SHARES the target's weights (a self-draft): acceptance is
+    ~1, so the field isolates the fused round's dispatch economics —
+    what the one-dispatch path exists to buy — from draft quality,
+    which this tiny random-weights model could not represent anyway.
+    With acceptance ~1 a round commits ~k+1 tokens per row per
+    dispatch vs the horizon's H."""
+    from triton_dist_tpu.models import llama
+    from triton_dist_tpu.models.generate import Generator
+    from triton_dist_tpu.serve import Request, SamplingParams, ServeEngine
+
+    max_seq = prompt_len + new_tokens
+    max_seq += (-max_seq) % page_size
+    cfg = llama.LlamaConfig(vocab=vocab, dim=dim, n_layers=n_layers,
+                            n_heads=2, n_kv_heads=2, ffn_dim=2 * dim,
+                            max_seq=max_seq, dtype=jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    params = llama.init_params(cfg, jax.random.key(seed))
+    gen = Generator(cfg, mesh, axis="sp", max_seq=max_seq)
+    draft = Generator(cfg, mesh, axis="sp", max_seq=max_seq)
+    per_req = -(-max_seq // page_size)
+    eng = ServeEngine(gen, params, num_blocks=1 + per_req * batch,
+                      page_size=page_size, max_batch=batch,
+                      prefill_chunk=max(8, page_size), draft=draft,
+                      draft_params=params, spec_k=k, pipeline=pipeline)
+    if warmup:
+        eng.warmup()
+    rng = np.random.default_rng(seed)
+    for i in range(batch):
+        eng.submit(Request(
+            f"s{i}", rng.integers(0, vocab, size=prompt_len)
+            .astype(np.int32), SamplingParams(max_new_tokens=new_tokens)))
+    t0 = time.perf_counter()
+    outs = eng.run()
+    dt = time.perf_counter() - t0
+    assert all(len(o.token_ids) == new_tokens for o in outs.values())
+    s = eng.metrics.summary()
+    d, sp = s["decode"], s["spec"]
+    plain = bench_engine(horizon, batch=batch, prompt_len=prompt_len,
+                         new_tokens=new_tokens, pipeline=pipeline,
+                         dim=dim, n_layers=n_layers, vocab=vocab,
+                         page_size=page_size, seed=seed, warmup=warmup)
+    ratio = (d["tokens_per_dispatch"] / plain["tokens_per_dispatch"]
+             if plain["tokens_per_dispatch"] > 0 else 0.0)
+    return {
+        "mode": "spec",
+        "spec_k": k,
+        "pipeline": pipeline,
+        "batch": batch,
+        "new_tokens": new_tokens,
+        "wall_s": round(dt, 4),
+        "spec_toks_per_s": round(d["decode_tokens"] / dt, 1),
+        "plain_toks_per_s": plain["decode_toks_per_s"],
+        "accept_rate": round(sp["accept_rate"], 3),
+        "chosen_k": sp["chosen_k"],
+        "spec_tokens_per_dispatch": round(d["tokens_per_dispatch"], 3),
+        "plain_tokens_per_dispatch": plain["tokens_per_dispatch"],
+        "dispatches_per_token": round(d["dispatches_per_token"], 4),
+        "spec_vs_plain_tokens_per_dispatch": round(ratio, 3),
+    }
+
+
 def _prefix_engine(*, batch, max_seq, page_size, prefill_chunk, dim,
                    n_layers, vocab, seed, num_blocks, horizon=1):
     from triton_dist_tpu.models import llama
@@ -270,6 +344,15 @@ def main():
     p.add_argument("--page-size", type=int, default=16)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--no-warmup", action="store_true")
+    p.add_argument("--spec", action="store_true",
+                   help="speculative mode: fused spec rounds (self-"
+                        "draft, one dispatch per round) vs plain fused "
+                        "decode at H=8 — reports tokens-per-dispatch "
+                        "both ways and their ratio (docs/serving.md "
+                        "'Speculative decoding')")
+    p.add_argument("--spec-k", type=int, default=12,
+                   help="--spec: speculation depth (pow2-ladder "
+                        "bucketed)")
     p.add_argument("--shared-prompt", action="store_true",
                    help="prefix-cache mode: cold vs warm shared-prompt "
                         "TTFT + hit rate (docs/serving.md 'Prefix "
@@ -285,6 +368,23 @@ def main():
         p.error(f"--sessions must be >= 1, got {args.sessions}")
     if args.sessions is not None and args.turns < 1:
         p.error(f"--turns must be >= 1, got {args.turns}")
+    if args.spec:
+        if args.spec_k < 1:
+            p.error(f"--spec-k must be >= 1, got {args.spec_k}")
+        r = bench_spec(k=args.spec_k, batch=args.batch,
+                       prompt_len=args.prompt_len,
+                       new_tokens=args.new_tokens,
+                       pipeline=args.pipeline, dim=args.dim,
+                       n_layers=args.layers, page_size=args.page_size,
+                       seed=args.seed, warmup=not args.no_warmup)
+        print(json.dumps(r))
+        print(f"# spec {r['spec_tokens_per_dispatch']:.2f} vs plain "
+              f"{r['plain_tokens_per_dispatch']:.2f} tokens/dispatch "
+              f"({r['spec_vs_plain_tokens_per_dispatch']:.2f}x), accept "
+              f"rate {r['accept_rate']:.2f}, "
+              f"{r['dispatches_per_token']:.4f} dispatches/token",
+              file=sys.stderr)
+        return
     if args.shared_prompt:
         r = bench_prefix(batch=args.batch,
                          prompt_len=max(args.prompt_len, 128),

@@ -121,6 +121,13 @@ _FAST_GATE_MODULES = {
     # snapshot/restore, journal rotation, and the bench floor helper all
     # run in the gate (the whole file is the fast tier).
     "test_serve_prefix",
+    # one-dispatch speculative decoding: the fused-round oracle (greedy
+    # fused == unfused == Generator.generate; seeded-sampled == the
+    # draft-less engine), k-ladder warmup flatness, adaptive-k
+    # convergence, spec × prefix (draft-side skip included), spec ×
+    # fault bailout-then-bisect, and the spec snapshot/restore chaos
+    # sweep (draft state resumed in place) all run in the gate.
+    "test_serve_spec",
 }
 
 # Heavy tests inside core modules whose coverage is duplicated by a

@@ -632,11 +632,21 @@ def test_engine_speculative_rounds_match_greedy(model):
     for i, p in enumerate(prompts):
         assert outs[f"s{i}"].token_ids == _oracle(gen, params, p, n_new)
     assert eng.metrics.verify_rounds >= 1
-    # sampled requests are rejected in spec mode
+    # sampled requests are rejected only by the UNFUSED round (PR 7's
+    # fused seeded accept chain serves them — tests/test_serve_spec.py)
+    unfused = ServeEngine(gen, params, num_blocks=40, page_size=8,
+                          max_batch=3, prefill_chunk=8, draft=draft,
+                          draft_params=d_params, spec_k=3,
+                          spec_fused=False, clock=_Tick())
     with pytest.raises(ValueError, match="greedy"):
-        eng.submit(Request("bad", prompts[0],
-                           SamplingParams(max_new_tokens=2,
-                                          temperature=0.5)))
+        unfused.submit(Request("bad", prompts[0],
+                               SamplingParams(max_new_tokens=2,
+                                              temperature=0.5)))
+    assert eng.submit(Request("ok", prompts[0],
+                              SamplingParams(max_new_tokens=2,
+                                             temperature=0.5,
+                                             seed=3))) is None
+    eng.run()
 
 
 @pytest.mark.slow

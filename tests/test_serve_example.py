@@ -58,9 +58,23 @@ def test_serve_engine_mode():
 
 def test_serve_engine_speculative():
     out = _run("--engine", "--requests", "3", "--speculative", "2",
-               devices=1, new_tokens=4)
+               "--spec-adaptive", "4", devices=1, new_tokens=4)
     assert "engine: 12 tokens / 3 requests" in out, out
     assert "verify)" in out and "done" in out
+    # PR 7: the fused-round spec stats line (acceptance, chosen-k
+    # histogram, spec tokens/dispatch)
+    assert "speculative:" in out and "fused rounds" in out, out
+    assert "chosen k" in out, out
+
+
+def test_serve_engine_spec_adaptive_validated():
+    """--spec-adaptive is validated like --sessions: a negative window
+    or a use without --speculative is an argparse error, not a silent
+    no-op."""
+    _run("--engine", "--speculative", "2", "--spec-adaptive", "-1",
+         devices=1, expect_rc=2)
+    _run("--engine", "--spec-adaptive", "4", devices=1, expect_rc=2)
+    _run("--engine", "--speculative", "0", devices=1, expect_rc=2)
 
 
 def test_serve_engine_chaos():

@@ -552,9 +552,13 @@ def test_spec_round_bailout_stays_exact(tiny):
     n_new = 6
 
     def drive(inj):
+        # spec_fused=False: this test exercises the UNFUSED round's
+        # phase structure (a verify-phase vs closing-phase failure are
+        # distinct dispatches only there; the fused round is one
+        # program — its bailout is covered by tests/test_serve_spec.py)
         eng = _engine(gen, params, page_size=8, prefill_chunk=8,
                       draft=draft, draft_params=d_params, spec_k=3,
-                      faults=inj, clock=_Tick())
+                      spec_fused=False, faults=inj, clock=_Tick())
         for i, p in enumerate(prompts):
             eng.submit(Request(f"s{i}", p,
                                SamplingParams(max_new_tokens=n_new)))

@@ -142,6 +142,28 @@ def sample_logits_rowwise(logits, keys, *, temperature, top_k, top_p,
     return jnp.where(greedy, gr, drawn)
 
 
+def sample_positions_rowwise(logits, base_keys, counts, *, temperature,
+                             top_k, top_p, greedy) -> jax.Array:
+    """Multi-position view of :func:`sample_logits_rowwise`: ``logits``
+    [B, T, V] → tokens [B, T], where position ``t`` of row ``b`` draws
+    with the key ``fold_in(base_keys[b], counts[b] + t)`` — i.e. exactly
+    the token the engine's per-row stream emits at emission index
+    ``counts[b] + t``, no matter which surface emits it (the host
+    ``_choose_token`` fallback, the fused decode horizon's scan, or a
+    speculative round's accept chain scoring k+1 candidate positions at
+    once).  One draw per (row, emission index) is the invariant that
+    makes every decode path bit-interchangeable mid-request."""
+    def at(t, lg):
+        keys = jax.vmap(jax.random.fold_in)(base_keys, counts + t)
+        return sample_logits_rowwise(lg, keys, temperature=temperature,
+                                     top_k=top_k, top_p=top_p,
+                                     greedy=greedy)
+
+    T = logits.shape[1]
+    return jax.vmap(at, in_axes=(0, 1), out_axes=1)(
+        jnp.arange(T, dtype=counts.dtype), logits)
+
+
 def make_sampler(*, temperature: float = 1.0, top_k: int | None = None,
                  top_p: float | None = None):
     """``sample(logits, key) -> token`` with the knobs baked in (one

@@ -103,6 +103,38 @@ def greedy_accept_chain_batched(proposals, st_logits, logits_all):
     return m, toks
 
 
+def accept_chain_rowwise(proposals, expected, k_rows) -> jax.Array:
+    """Per-row accept count for the serving engine's FUSED speculative
+    round (``serve/engine._spec_round_fused``): ``proposals`` [B, K] are
+    the draft's guesses, ``expected`` [B, K+1] are the TARGET'S OWN
+    next-token choices at the same emission indices (greedy argmax, or
+    the seeded ``sampling.sample_positions_rowwise`` draw — the exact
+    stream ``_choose_token`` / the decode horizon would emit), and
+    ``k_rows`` [B] is each row's speculation budget this round (adaptive
+    k: positions ``>= k_rows[b]`` auto-reject).
+
+    Returns ``m`` [B]: the longest prefix with ``proposals[b, :m] ==
+    expected[b, :m]``.  The round emits ``expected[b, :m+1]`` — every
+    emitted token is the target's own choice, so the emitted stream is
+    DEFINITIONALLY the target's greedy/seeded stream (bit-identical to
+    serving without a draft); speculation only changes how many of those
+    tokens commit per dispatch.  For sampled rows this is rejection
+    sampling under shared randomness: draft and target draw their token
+    at emission index ``i`` from the SAME ``fold_in(key(seed), i)`` key,
+    so when the draft's filtered distribution tracks the target's, the
+    coupled draws coincide with high probability and long chains accept
+    — while a token that differs is replaced by the target's own draw,
+    never resampled from a residual (which would fork the stream from
+    the no-draft engine).  Truncating the chain (per-row budget, page
+    capacity) keeps validity for free: any prefix of the target's own
+    stream is still the target's stream."""
+    K = proposals.shape[1]
+    pos = jnp.arange(K, dtype=jnp.int32)[None]
+    ok = ((proposals == expected[:, :K])
+          & (pos < k_rows[:, None])).astype(jnp.int32)
+    return jnp.sum(jnp.cumprod(ok, axis=1), axis=1)
+
+
 @jax.jit
 def speculative_accept_step(pi, rho, proposal, key):
     """One rejection-sampling step.  pi/rho [V] (target/draft sampling

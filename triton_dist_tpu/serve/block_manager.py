@@ -154,6 +154,15 @@ class BlockManager:
     def ref_of(self, block: int) -> int:
         return self._ref.get(block, 0)
 
+    def block_key(self, block: int) -> Optional[tuple]:
+        """The content-index key ``(parent block, token ids)`` of a
+        committed block, or ``None``.  The engine's draft-side prefix
+        cache tags its draft pool pages with this key and re-validates
+        the tag at read time — a freed-and-reused block's key changes or
+        vanishes, so a stale draft page can never be served (the
+        draft-pool twin of the chain's id-reuse safety)."""
+        return self._meta.get(block)
+
     def prefix_stats(self) -> dict:
         """The prefix-cache counters + gauges as one dict (the engine's
         ``metrics.summary()["prefix_cache"]``)."""

@@ -23,8 +23,13 @@ iteration-level scheduling):
    ``_write_rows`` overflow rule).  With a draft model attached, the
    decode step becomes a speculative round: the draft proposes ``k``
    tokens per row and ONE multi-token verify pass scores every row at
-   its own length (the r5 ``q_lens`` batched-verify contract), greedy
-   accepts applying per row.
+   its own length (the r5 ``q_lens`` batched-verify contract), accepts
+   applying per row.  Since PR 7 the WHOLE round — draft k-step scan,
+   verify, seeded accept, closing decode for both models — fuses into
+   one traced program (``_spec_round_fused``) chained ``pipeline`` deep
+   on a device-resident carry, with adaptive per-row ``k`` bucketed
+   down a pow2 k-ladder; sampled requests ride the same seeded accept
+   chain (docs/serving.md "Speculative decoding").
 
 Requests retire individually (their blocks free immediately); when a
 running request cannot extend its allocation, the scheduler preempts the
@@ -71,6 +76,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import math
 import os
 import sys
 import time
@@ -86,12 +92,17 @@ from triton_dist_tpu.models.generate import (
     Generator,
     _multitoken_forward,
     _token_forward,
+    _write_rows,
 )
 from triton_dist_tpu.models.sampling import (
     sample_logits,
     sample_logits_rowwise,
+    sample_positions_rowwise,
 )
-from triton_dist_tpu.models.speculative import greedy_accept_chain_batched
+from triton_dist_tpu.models.speculative import (
+    accept_chain_rowwise,
+    greedy_accept_chain_batched,
+)
 from triton_dist_tpu.runtime.faults import FaultInjector
 from triton_dist_tpu.runtime.jit_cache import (
     CountingJit,
@@ -289,6 +300,162 @@ def _paged_decode_horizon(params, pools, tables, kv_lens, token, active,
     return (pools, toks.T, mask.T, kv_lens, token, eos_done, counts)
 
 
+def _draft_decode_forward(params, caches, kv_lens, token, active, *,
+                          cfg, impl, interpret):
+    """One draft decode token over the slot-indexed contiguous batch
+    caches — ``Generator._step_impl``'s math (the same
+    ``_token_forward``) with MESH-FREE addressing: the per-row append
+    rides ``_write_rows`` (overflow rows skipped, the dead-slot rule)
+    and attention the bare ``gqa_decode_shard`` kernel.  The fused spec
+    round traces THIS instead of the draft's own ``step`` because the
+    layer path routes through ``cached_shard_jit`` shard_map closures:
+    a world-1 engine gains nothing from the mesh, but mesh-placed
+    program outputs would carry ``NamedSharding`` while host-built
+    round openers carry ``SingleDeviceSharding`` — forking the
+    executable cache into flavors warmup cannot enumerate.  Mesh-free,
+    one program per (K rung, sampler mix) covers every call.  Frozen
+    rows (``active`` False) keep their length; their dummy write lands
+    in the dead row at ``kv_lens[b]``."""
+    from triton_dist_tpu.kernels.flash_decode import gqa_decode_shard
+
+    inc = active.astype(kv_lens.dtype)
+
+    def write_kv(li, cache, k, v):
+        k_c, v_c = cache
+        return (_write_rows(k_c, k[:, :, None, :], kv_lens),
+                _write_rows(v_c, v[:, :, None, :], kv_lens))
+
+    def attend(li, q, cache):
+        o, _ = gqa_decode_shard(q, cache[0], cache[1], kv_lens + inc,
+                                impl=impl, interpret=interpret,
+                                soft_cap=cfg.attn_soft_cap,
+                                window=cfg.attn_window)
+        return o
+
+    new_caches, logits = _token_forward(params, caches, token, kv_lens,
+                                        cfg=cfg, write_kv=write_kv,
+                                        attend=attend)
+    return new_caches, kv_lens + inc, logits
+
+
+def _spec_round_fused(params, draft_params, pools, dcaches, tables,
+                      kv_lens, active, done, last_logits, dlast_logits,
+                      counts, limits, k_rows, base_keys, temps, top_ks,
+                      top_ps, greedy, eos_ids, *, K, all_greedy, cfg,
+                      page, impl, interpret, draft_step):
+    """One WHOLE speculative round in ONE traced program — the spec twin
+    of :func:`_paged_decode_horizon` (docs/serving.md "Speculative
+    decoding").  The unfused round pays 3+k host round trips (k draft
+    steps, the verify, the accept sync, the closing decode); here the
+    draft's k-step ``lax.scan``, the target's multi-token verify, the
+    on-device accept, and the round-closing target+draft decode all run
+    in one dispatch, and the trailing carries re-enter the next chained
+    round without touching the host (``pipeline=N``).
+
+    Acceptance is SEEDED-STREAM matching: ``expected[b, j]`` is the
+    target's OWN next-token choice at emission index ``counts[b] + j``
+    (greedy argmax, or ``sample_positions_rowwise`` — the exact
+    ``fold_in(key(seed), index)`` draw every other decode path makes),
+    and a proposal is accepted iff it EQUALS it
+    (``speculative.accept_chain_rowwise`` holds the correctness
+    argument: the emitted chain is always a prefix of the target's own
+    stream, so spec serving is bit-identical to draft-less serving —
+    sampled requests included, which is what lifts the old greedy-only
+    engine assert).  Draft proposals draw with the SAME per-index keys
+    (rejection sampling under shared randomness), so a draft that
+    tracks the target accepts long chains.
+
+    Per-row adaptive k rides ``k_rows`` as a traced array (positions
+    past a row's budget auto-reject) while the scan length ``K`` is
+    static and buckets down the ``jit_cache.pow2_ladder`` — one trace
+    per (rung, greedy-or-mixed), all swept by ``warmup()``.  ``limits``
+    is each row's remaining emission budget (max-tokens AND reserved
+    page capacity); ``done`` carries EOS/budget exits ACROSS chained
+    dispatches exactly like the horizon's ``eos_done`` (a retired row's
+    pages may be freed at drain time, so the device itself must stop
+    writing them).  Rows frozen by budget (not EOS) still consume their
+    round-closing token on device, keeping the spec-mode cache
+    invariant (``kv_len`` rows hold exactly the emitted history) for
+    the next chain.
+
+    Returns ``(pools, dcaches, toks [B, K+1], n_emit [B], m [B],
+    kv_lens, last_logits, dlast_logits, counts, limits, done)`` — row
+    ``b`` emits ``toks[b, :n_emit[b]]``; ``m`` is the raw accept count
+    feeding the adaptive-k window."""
+    live = active & ~done & (limits > 0)
+    has_eos = eos_ids >= 0
+
+    # 1. Draft k-step scan: propose K tokens per row, consuming each
+    # into the draft's slot-indexed batch cache (frozen rows' dummy
+    # writes land in their dead slot, masked by length).
+    def propose(carry, t):
+        dcaches, dlens, dlogits = carry
+        if all_greedy:
+            tok = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+        else:
+            keys = jax.vmap(jax.random.fold_in)(base_keys, counts + t)
+            tok = sample_logits_rowwise(dlogits, keys, temperature=temps,
+                                        top_k=top_ks, top_p=top_ps,
+                                        greedy=greedy)
+        dcaches, dlens, dlogits = draft_step(draft_params, dcaches,
+                                             dlens, tok, live)
+        return (dcaches, dlens, dlogits), tok
+
+    (dcaches, _, _), props = jax.lax.scan(
+        propose, (dcaches, kv_lens, dlast_logits),
+        jnp.arange(K, dtype=counts.dtype))
+    proposals = props.T                                     # [B, K]
+
+    # 2. ONE multi-token verify scores every row's K proposals at its
+    # own length (writes land in the row's pages; entries past the
+    # allocation are dead padded-table slots pointing at block 0).
+    pools, logits_all = _paged_verify_forward(
+        params, pools, tables, kv_lens, proposals, live, cfg=cfg,
+        page=page, impl=impl, interpret=interpret)
+
+    # 3. On-device accept against the target's own stream.
+    allv = jnp.concatenate([last_logits[:, None], logits_all], axis=1)
+    if all_greedy:
+        expected = jnp.argmax(allv, axis=-1).astype(jnp.int32)
+    else:
+        expected = sample_positions_rowwise(
+            allv, base_keys, counts, temperature=temps, top_k=top_ks,
+            top_p=top_ps, greedy=greedy)
+    m = accept_chain_rowwise(proposals, expected, k_rows)
+    m_used = jnp.clip(jnp.minimum(m, limits - 1), 0, K)
+    idx = jnp.arange(K + 1, dtype=jnp.int32)[None]
+    in_chain = (has_eos[:, None] & (expected == eos_ids[:, None])
+                & (idx <= m_used[:, None]))
+    any_eos = in_chain.any(axis=1)
+    n_emit = jnp.where(any_eos, jnp.argmax(in_chain, axis=1) + 1,
+                       m_used + 1)
+    n_emit = jnp.where(live, n_emit, 0)
+
+    # 4. Consume the round-closing token (toks[m_used] — the first
+    # non-accepted target choice, or the bonus past a full accept) via
+    # one target decode + one draft step at the rolled-back lengths —
+    # refreshing both models' round-opening logits for the next round.
+    # EOS rows skip (they retire at drain); budget-frozen rows do NOT
+    # (their cache must stay consistent with the emitted history).
+    cont = live & ~any_eos
+    kv_mid = kv_lens + jnp.where(live, m_used, 0)
+    closing = jnp.take_along_axis(
+        expected, jnp.where(live, m_used, 0)[:, None], axis=1)[:, 0]
+    pools, t_logits = _paged_decode_forward(
+        params, pools, tables, kv_mid, closing, cont, cfg=cfg,
+        page=page, impl=impl, interpret=interpret)
+    dcaches, _, d_logits = draft_step(draft_params, dcaches, kv_mid,
+                                      closing, cont)
+    last_logits = jnp.where(cont[:, None], t_logits, last_logits)
+    dlast_logits = jnp.where(cont[:, None], d_logits, dlast_logits)
+    kv_lens = kv_lens + n_emit
+    counts = counts + n_emit
+    limits = jnp.maximum(limits - n_emit, 0)
+    done = done | (live & (any_eos | (limits <= 0)))
+    return (pools, dcaches, expected, n_emit, m, kv_lens, last_logits,
+            dlast_logits, counts, limits, done)
+
+
 def _gather_pool_pages(pools, block_ids, *, page):
     """Inverse of :func:`_fill_pool_pages`: assemble contiguous scratch
     caches ([1, Hkv, n*page, D] per layer) from pool pages.
@@ -401,8 +568,14 @@ class ServeEngine:
         outputs = engine.run()          # step() until drained
 
     ``draft``/``draft_params`` + ``spec_k`` turn every decode step into a
-    speculative round (greedy requests only): up to ``spec_k + 1`` tokens
-    per row per verify pass, same emitted stream as plain greedy.
+    speculative round: up to ``spec_k + 1`` tokens per row per round,
+    same emitted stream as serving without the draft (greedy AND seeded
+    sampled — the accept chain scores proposals against the target's own
+    per-index stream).  With ``spec_fused=True`` (default) the whole
+    round is ONE device dispatch chained ``pipeline`` deep, and
+    ``spec_adaptive=W`` picks each row's k from a W-round acceptance
+    window (docs/serving.md "Speculative decoding"); ``spec_fused=False``
+    keeps the unfused PR-1 round (greedy only).
 
     ``horizon=H`` fuses up to H decode steps into ONE device dispatch
     (on-device sampling, per-row EOS/max-token/page-boundary early exit)
@@ -430,7 +603,8 @@ class ServeEngine:
                  bucket_ladder: Optional[list] = None,
                  horizon: int = 1, pipeline: int = 2,
                  draft: Optional[Generator] = None, draft_params=None,
-                 spec_k: int = 0, clock=time.monotonic,
+                 spec_k: int = 0, spec_fused: bool = True,
+                 spec_adaptive: int = 8, clock=time.monotonic,
                  max_queue: Optional[int] = None, overload: str = "shed",
                  step_timeout_s: Optional[float] = None,
                  heartbeat: Optional[str] = None,
@@ -470,6 +644,10 @@ class ServeEngine:
             raise ValueError(f"horizon must be >= 1, got {horizon}")
         if pipeline < 1:
             raise ValueError(f"pipeline must be >= 1, got {pipeline}")
+        if spec_adaptive < 0:
+            raise ValueError(
+                f"spec_adaptive must be >= 0 (0 disables adaptive k), "
+                f"got {spec_adaptive}")
         self.gen = gen
         self.cfg = cfg
         self.params = params
@@ -493,6 +671,15 @@ class ServeEngine:
         self.draft = draft
         self.draft_params = draft_params
         self.spec_k = int(spec_k)
+        # fused speculative rounds (docs/serving.md "Speculative
+        # decoding"): the whole draft-propose / verify / accept /
+        # closing-decode round runs as ONE traced program, chained
+        # `pipeline` deep on a device-resident carry; spec_fused=False
+        # keeps the PR-1 unfused round (greedy-only — the fused path's
+        # bit-exactness oracle).  spec_adaptive is the acceptance-rate
+        # window behind the scheduler's per-row k (0 = fixed k).
+        self.spec_fused = bool(spec_fused)
+        self.spec_adaptive = int(spec_adaptive)
         # decode horizon (docs/serving.md "Decode horizon"): up to
         # `horizon` decode steps fuse into one device dispatch with
         # on-device sampling; `pipeline` chains that many dispatches
@@ -670,8 +857,14 @@ class ServeEngine:
             # length.  _splice_draft_rows lands the prefilled row in
             # the slot-indexed batch caches (traced slot/length: one
             # program per rung).
+            # Rungs are multiples of lcm(chunk, page): one chunked
+            # prefill trace per rung as before, AND the scratch
+            # reshapes cleanly into DRAFT pool pages (the draft-side
+            # prefix cache below).
             self._draft_ladder = build_bucket_ladder(
-                prefill_chunk, gen.max_seq - 1, prefill_chunk)
+                prefill_chunk, gen.max_seq - 1,
+                prefill_chunk * page_size
+                // math.gcd(prefill_chunk, page_size))
             self._draft_chunk_fn = CountingJit(draft._chunk_jit,
                                                "draft_prefill")
             # temp caches (arg 3) are NOT donatable: the splice reads a
@@ -701,6 +894,60 @@ class ServeEngine:
                 kv_lens=jnp.zeros((max_batch,), jnp.int32),
                 last_logits=jnp.zeros((max_batch, dcfg.vocab),
                                       jnp.float32))
+            # One-dispatch fused rounds (docs/serving.md "Speculative
+            # decoding"): the k-ladder is the verify scan's static-K
+            # bucket set (one trace per rung x {greedy, mixed}, swept
+            # by warmup); pools (arg 2) and the draft batch caches
+            # (arg 3) are donated like every decode-path program.
+            self._k_ladder = pow2_ladder(self.spec_k)
+            if self.spec_fused:
+                # The draft steps inside the trace through the
+                # MESH-FREE _draft_decode_forward (see its docstring:
+                # shard_map-placed carries would fork the executable
+                # cache into flavors warmup cannot enumerate).
+                draft_fwd = functools.partial(
+                    _draft_decode_forward, cfg=dcfg,
+                    impl=draft.attn.ctx.impl,
+                    interpret=draft.attn.ctx.interpret)
+                self._spec_fused_fn = CountingJit(jax.jit(
+                    functools.partial(
+                        _spec_round_fused, cfg=cfg, page=page_size,
+                        impl=impl, interpret=interpret,
+                        draft_step=draft_fwd),
+                    static_argnames=("K", "all_greedy"),
+                    donate_argnums=(2, 3)), "spec_round")
+                self.metrics.register_compiled(self._spec_fused_fn)
+                # The k<=0 tail's closing draft step — the same
+                # mesh-free forward, standalone (going through
+                # draft.step would hand the next chain NamedSharding
+                # draft caches and recompile every rung).
+                self._draft_tail_fn = CountingJit(jax.jit(
+                    draft_fwd, donate_argnums=(1,)), "draft_tail_step")
+                self.metrics.register_compiled(self._draft_tail_fn)
+            # Draft-side prefix cache (the ISSUE-7 warm-admit fix): the
+            # draft's prompt K/V pages live in draft-geometry pools
+            # UNDER THE SAME BLOCK IDS as the target's, validated
+            # against the content index key at read time — a warm
+            # target admit then skips the draft's already-known prefix
+            # too instead of re-prefilling the full prompt draft-side.
+            self._draft_pools = None
+            self._draft_page_key: dict[int, tuple] = {}
+            if self.prefix_cache:
+                self._draft_pools = [
+                    (jnp.zeros((num_blocks, dcfg.n_kv_heads, page_size,
+                                dcfg.head_dim), dcfg.dtype),
+                     jnp.zeros((num_blocks, dcfg.n_kv_heads, page_size,
+                                dcfg.head_dim), dcfg.dtype))
+                    for _ in range(dcfg.n_layers)]
+                self._draft_fill_fn = CountingJit(jax.jit(
+                    functools.partial(_fill_pool_pages, page=page_size),
+                    donate_argnums=(0,)), "draft_fill_pages")
+                self._draft_load_fn = CountingJit(jax.jit(
+                    functools.partial(_gather_pool_pages,
+                                      page=page_size)),
+                    "draft_load_pages")
+                self.metrics.register_compiled(self._draft_fill_fn)
+                self.metrics.register_compiled(self._draft_load_fn)
 
     # -- submission -------------------------------------------------------
 
@@ -726,9 +973,13 @@ class ServeEngine:
             raise ValueError(
                 f"{req.request_id}: needs {self.bm.blocks_for(total)} "
                 f"blocks, pool has {self.bm.num_allocatable}")
-        if self.spec_k and not req.params.greedy:
+        if self.spec_k and not self.spec_fused and not req.params.greedy:
+            # The fused round serves sampled rows through the seeded
+            # accept chain (docs/serving.md "Speculative decoding");
+            # only the legacy unfused PR-1 round is greedy-only.
             raise ValueError(
-                "speculative engine mode serves greedy requests only")
+                "unfused speculative mode (spec_fused=False) serves "
+                "greedy requests only")
         if req.arrival_time is None:
             req.arrival_time = self._clock()
         overloaded = (bounded and self.max_queue is not None
@@ -989,7 +1240,10 @@ class ServeEngine:
                    if s is not None and s.status is Status.RUNNING]
         if running:
             if self.spec_k and not self._spec_off:
-                finished.extend(self._spec_round(running))
+                if self.spec_fused:
+                    finished.extend(self._spec_chain(running))
+                else:
+                    finished.extend(self._spec_round(running))
             else:
                 finished.extend(self._decode_once(running))
 
@@ -1137,6 +1391,43 @@ class ServeEngine:
                                 self._warmup_horizon_try(
                                     f"wh{round_}_{r}_{ti}", r, temp)
                                 self.run()
+                    if self.spec_k and self.spec_fused:
+                        # Fused spec-round rungs: one program per
+                        # (K rung, greedy-or-mixed).  The dummy traffic
+                        # above only reaches the rung its adaptive k
+                        # lands on, so the remaining rungs warm by
+                        # direct dispatch over an ALL-INACTIVE batch —
+                        # every write redirects to the null block /
+                        # dead draft slots, and the donated pools +
+                        # draft caches are reassigned exactly like a
+                        # production call (same donation lineage).
+                        for r in self._k_ladder:
+                            for ag in (True, False):
+                                self._warmup_spec_rung(r, ag)
+                        if self._draft_pools is not None:
+                            # Draft-side prefix programs: the draft
+                            # pool gather + scatter per draft-ladder
+                            # rung (all-null ids -> block 0 only).
+                            dcfg = self.draft.cfg
+                            for rung in self._draft_ladder:
+                                ids = jnp.asarray(np.zeros(
+                                    (rung // self.page,), np.int32))
+                                self._device_call(
+                                    "draft_load_pages", (),
+                                    self._draft_load_fn,
+                                    self._draft_pools, ids)
+                                scratch = [
+                                    (jnp.zeros((1, dcfg.n_kv_heads,
+                                                rung, dcfg.head_dim),
+                                               dcfg.dtype),
+                                     jnp.zeros((1, dcfg.n_kv_heads,
+                                                rung, dcfg.head_dim),
+                                               dcfg.dtype))
+                                    for _ in range(dcfg.n_layers)]
+                                self._draft_pools = self._device_call(
+                                    "draft_fill_pages", (),
+                                    self._draft_fill_fn,
+                                    self._draft_pools, scratch, ids)
                     if self.prefix_cache:
                         # Warm-prefix programs: the pool->scratch gather
                         # (one trace per ladder rung, like fill_pages)
@@ -1207,6 +1498,34 @@ class ServeEngine:
             self._submit(req, bounded=False)
         except ValueError:
             pass
+
+    def _warmup_spec_rung(self, rung: int, all_greedy: bool) -> None:
+        """Compile one fused spec-round variant (static K=``rung``,
+        ``all_greedy``) by direct dispatch over an all-inactive batch:
+        no row is live, so every K/V write redirects to the null block
+        (target) or a dead slot row (draft) and no engine state can
+        change — but the call's shapes, dtypes, and donation lineage
+        (pools + draft caches donated, reassigned) match production
+        exactly, so the executable cache key does too."""
+        B = self.max_batch
+        z32 = jnp.zeros((B,), jnp.int32)
+        zb = jnp.zeros((B,), bool)
+        sd = self._draft_state
+        out = self._device_call(
+            "spec_round", (), self._spec_fused_fn, self.params,
+            self.draft_params, self._pools, sd.caches,
+            jnp.zeros((B, self.n_pages_max), jnp.int32), z32, zb, zb,
+            self._last_logits, sd.last_logits, z32, z32,
+            jnp.ones((B,), jnp.int32),
+            jnp.stack([jax.random.key(0)] * B),
+            jnp.ones((B,), jnp.float32), z32,
+            jnp.ones((B,), jnp.float32), jnp.ones((B,), bool),
+            jnp.full((B,), -1, jnp.int32), K=int(rung),
+            all_greedy=all_greedy)
+        self._pools = out[0]
+        self._draft_state = GenerationState(
+            caches=out[1], kv_lens=sd.kv_lens,
+            last_logits=sd.last_logits)
 
     # -- prefill ----------------------------------------------------------
 
@@ -1341,22 +1660,58 @@ class ServeEngine:
         lands the row in the batch caches — O(len(draft ladder))
         programs cover every prompt length, so spec-mode admission
         never compiles after warmup (the old ``draft.prefill`` path
-        compiled per distinct length)."""
+        compiled per distinct length).
+
+        Warm prefix (docs/serving.md "Speculative decoding"): the
+        draft's K/V for every FULL prompt page is also scattered into
+        draft-geometry pools under the request's block ids, each page
+        tagged with the block's content-index key.  A later warm admit
+        whose target prefix hit covers blocks with matching tags skips
+        the draft prefill for them too — the gathered draft pages feed
+        the residual chunks exactly like the target's warm path — so a
+        shared system prompt no longer re-prefills the full prompt on
+        the DRAFT side.  Tag validation is reuse-safe by construction:
+        a reused block id's content-index key changes or vanishes, and
+        the tag compare fails."""
         rid = rs.req.request_id
         prompt = np.asarray(rs.prompt_tokens)
         S0 = int(prompt.shape[0])
         chunk = self.scheduler.prefill_chunk
+        page = self.page
         dcfg = self.draft.cfg
         ext = self._draft_bucket(S0)
-        caches = [
-            (jnp.zeros((1, dcfg.n_kv_heads, ext, dcfg.head_dim),
-                       dcfg.dtype),
-             jnp.zeros((1, dcfg.n_kv_heads, ext, dcfg.head_dim),
-                       dcfg.dtype))
-            for _ in range(dcfg.n_layers)]
+        table = (self.bm.table(rid) if self._draft_pools is not None
+                 else [])
+        d_skip = 0
+        if self._draft_pools is not None and rs.cached_prefix > 0:
+            for logical in range(rs.cached_prefix // page):
+                b = table[logical]
+                key = self.bm.block_key(b)
+                if key is None or self._draft_page_key.get(b) != key:
+                    break
+                d_skip += page
+        start = (d_skip // chunk) * chunk
+        if start > 0:
+            # Gather the draft's cached prefix pages into the prefill
+            # scratch; tokens between the chunk floor and the hit
+            # recompute bit-identically over the gathered rows (the
+            # target warm path's argument, draft-side).
+            ids = np.zeros((ext // page,), np.int32)
+            ids[:d_skip // page] = table[:d_skip // page]
+            caches = self._device_call(
+                "draft_load_pages", (rid,), self._draft_load_fn,
+                self._draft_pools, jnp.asarray(ids))
+            self.metrics.draft_prefix_skipped_tokens += start
+        else:
+            caches = [
+                (jnp.zeros((1, dcfg.n_kv_heads, ext, dcfg.head_dim),
+                           dcfg.dtype),
+                 jnp.zeros((1, dcfg.n_kv_heads, ext, dcfg.head_dim),
+                           dcfg.dtype))
+                for _ in range(dcfg.n_layers)]
         logits = None
         n_last = 0
-        for off in range(0, S0, chunk):
+        for off in range(start, S0, chunk):
             c = min(chunk, S0 - off)
             buf = np.zeros((1, chunk), np.int32)
             buf[0, :c] = prompt[off:off + c]
@@ -1366,6 +1721,23 @@ class ServeEngine:
                 jnp.int32(off), quantized=False, extent=ext,
                 n_valid=jnp.int32(c))
             n_last = c
+        if self._draft_pools is not None:
+            # Commit the draft's prompt pages (before the splice — the
+            # join donates nothing of ``caches``, this fill only reads
+            # it).  Shared blocks rewrite too: their draft content is a
+            # deterministic function of the certified chain, so the
+            # overwrite is idempotent.  Only FULL pages get a reuse tag.
+            n_prompt_pages = self.bm.blocks_for(S0)
+            ids = np.zeros((ext // page,), np.int32)
+            lo = d_skip // page
+            ids[lo:n_prompt_pages] = table[lo:n_prompt_pages]
+            self._draft_pools = self._device_call(
+                "draft_fill_pages", (rid,), self._draft_fill_fn,
+                self._draft_pools, caches, jnp.asarray(ids))
+            for logical in range(min(S0 // page, len(table))):
+                key = self.bm.block_key(table[logical])
+                if key is not None:
+                    self._draft_page_key[table[logical]] = key
         sd = self._draft_state
         new_caches, kv_lens, last_logits = self._device_call(
             "draft_join", (rid,), self._draft_join_fn, sd.caches,
@@ -1534,7 +1906,8 @@ class ServeEngine:
     # tokens_per_dispatch).  Admission-path programs (prefill, page
     # scatter, draft join) do not.
     _DECODE_OPS = frozenset({"paged_decode", "paged_verify", "draft_step",
-                             "decode_horizon"})
+                             "decode_horizon", "spec_round",
+                             "draft_tail_step"})
 
     def _device_call(self, op: str, rids: tuple, fn, *args,
                      fire_injector: bool = True, **kwargs):
@@ -1970,6 +2343,367 @@ class ServeEngine:
                     f"horizon chain failed after committing tokens: "
                     f"{e!r}") from e
             raise
+
+    # -- fused speculative rounds (docs/serving.md "Speculative
+    # decoding") ----------------------------------------------------------
+
+    def _spec_chain(self,
+                    running: list[ReqState]) -> list[RequestOutput]:
+        """Up to ``pipeline`` chained ``_spec_round_fused`` dispatches —
+        ONE device dispatch per whole speculative round (draft k-scan,
+        verify, accept, closing decode) with the carry (kv lengths, both
+        models' round-opening logits, emission counters, EOS/budget
+        exits) staying device-resident between rounds, then an in-order
+        drain committing each round's accepted burst.  The spec twin of
+        :meth:`_decode_horizon_rows`: round j+1 dispatches before round
+        j's results reach the host, and the host commits round j's
+        tokens while the device runs j+1.
+
+        Adaptive k: each row's depth comes from the scheduler's windowed
+        acceptance estimate (``choose_spec_k``), the batch max buckets
+        down the pow2 k-ladder (static scan length — one warmed trace
+        per rung), and per-row depths ride the traced ``k_rows`` array.
+
+        Containment keeps the PR-3 contract: capacity growth
+        quarantines per request; a device failure latches speculation
+        OFF via :meth:`_spec_bailout_fused` — already-drained tokens
+        stand, undrained rows emit exactly what the round would have
+        emitted first — and the engine degrades to plain decode
+        bit-exactly."""
+        finished: list[RequestOutput] = []
+        live = [r for r in running if r.status is Status.RUNNING]
+        top = max(r.kv_len for r in live)
+        k_cap = min(self.spec_k, self.gen.max_seq - 1 - top,
+                    self.draft.max_seq - 1 - top)
+        if k_cap <= 0:
+            return self._spec_tail(live)
+        links = self.scheduler.plan_spec(
+            self.pipeline,
+            prefilling=any(s is not None and s.status is Status.PREFILL
+                           for s in self.slots),
+            deadline_waiting=any(
+                w.req.params.deadline_s is not None
+                for w in self.scheduler.waiting))
+        # Capacity for the WHOLE chain up front (capped at the admitted
+        # total; writes past the allocation land in dead padded-table
+        # entries -> the null block, never a live page).
+        for rs in sorted(live, key=lambda r: r.seq):
+            if rs.status is Status.RUNNING:
+                want = min(rs.kv_len + links * (k_cap + 1),
+                           rs.total_tokens)
+                try:
+                    self._ensure_capacity(rs, want)
+                except _FATAL:
+                    raise
+                except Exception as e:
+                    finished.append(self._quarantine(
+                        rs, f"kv grow (spec chain): {e!r}"))
+        live = [r for r in live if r.status is Status.RUNNING]
+        if not live:
+            return finished
+
+        B = self.max_batch
+        lens = np.zeros((B,), np.int32)
+        active = np.zeros((B,), bool)
+        tables = np.zeros((B, self.n_pages_max), np.int32)
+        counts = np.zeros((B,), np.int32)
+        limits = np.zeros((B,), np.int32)
+        k_rows = np.ones((B,), np.int32)
+        temps = np.ones((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        top_ps = np.ones((B,), np.float32)
+        greedy = np.ones((B,), bool)
+        eos_ids = np.full((B,), -1, np.int32)
+        key_rows = [jax.random.key(0)] * B
+        for rs in live:
+            b = rs.slot
+            p = rs.req.params
+            lens[b] = rs.kv_len
+            active[b] = True
+            tables[b] = self.bm.padded_table(rs.req.request_id,
+                                             self.n_pages_max)
+            counts[b] = len(rs.generated)
+            # Per-row emission budget: remaining max-tokens AND the
+            # reserved page capacity (never binds after a successful
+            # _ensure_capacity — kept as the device-side safety net).
+            limits[b] = min(rs.remaining_new,
+                            self.bm.capacity_tokens(rs.req.request_id)
+                            - rs.kv_len)
+            k_rows[b] = (self.scheduler.choose_spec_k(
+                             rs, k_cap, window=self.spec_adaptive)
+                         if self.spec_adaptive else k_cap)
+            temps[b] = p.temperature if not p.greedy else 1.0
+            top_ks[b] = p.top_k or 0
+            top_ps[b] = p.top_p if p.top_p is not None else 1.0
+            greedy[b] = p.greedy
+            eos_ids[b] = p.eos_id if p.eos_id is not None else -1
+            if not p.greedy:
+                # Host-built typed keys, like the horizon: any seed the
+                # host path accepts (>= 2**31 included) streams
+                # identically on device.
+                key_rows[b] = jax.random.key(p.seed)
+        all_greedy = bool(greedy[active].all())
+        k_rung = bucket_down(self._k_ladder, int(k_rows[active].max()))
+        chain_k = {rs.slot: min(int(k_rows[rs.slot]), k_rung)
+                   for rs in live}
+        rids = tuple(r.req.request_id for r in live)
+        # A round emits >= 1 token per live row, so rounds beyond the
+        # widest per-row budget would dispatch dead full-batch work.
+        links = max(1, min(links, int(limits[active].max())))
+
+        kv_d = jnp.asarray(lens)
+        act_d = jnp.asarray(active)
+        done_d = jnp.zeros((B,), bool)
+        tables_d = jnp.asarray(tables)
+        cnt_d = jnp.asarray(counts)
+        lim_d = jnp.asarray(limits)
+        k_rows_d = jnp.asarray(k_rows)
+        samp = (jnp.stack(key_rows), jnp.asarray(temps),
+                jnp.asarray(top_ks), jnp.asarray(top_ps),
+                jnp.asarray(greedy), jnp.asarray(eos_ids))
+        # The PRE-CHAIN round-opening logits: every live row's next
+        # emission comes from these until its first burst commits, so
+        # any bailout with uncommitted rows must sample HERE — never
+        # from the chain's advanced carry (which already consumed
+        # device-emitted tokens the host never saw).
+        opening = self._last_logits
+        last_d = opening
+        dcaches = self._draft_state.caches
+        dlast_d = self._draft_state.last_logits
+        outs = []
+        t_prev = self._clock()
+        try:
+            for j in range(links):
+                (pools, dcaches, toks, n_emit, m_acc, kv_d, last_d,
+                 dlast_d, cnt_d, lim_d, done_d) = self._device_call(
+                    "spec_round", rids, self._spec_fused_fn,
+                    self.params, self.draft_params, self._pools,
+                    dcaches, tables_d, kv_d, act_d, done_d, last_d,
+                    dlast_d, cnt_d, lim_d, k_rows_d, *samp,
+                    K=int(k_rung), all_greedy=all_greedy,
+                    fire_injector=(j == 0))
+                self._pools = pools
+                # Re-anchor the draft state per link: a LATER link's
+                # dispatch failure must not leave _draft_state pointing
+                # at buffers this link's donation already consumed (the
+                # spec_off snapshot guard in recovery covers the
+                # failed-dispatch-itself case).
+                self._draft_state = GenerationState(
+                    caches=dcaches, kv_lens=kv_d, last_logits=dlast_d)
+                self.metrics.spec_dispatches += 1
+                outs.append((toks, n_emit, m_acc))
+        except _FATAL:
+            raise
+        except Exception as e:
+            if not self._state_intact():
+                raise  # donated pools consumed: engine-fatal
+            # Nothing drained: the pre-chain opening logits are what
+            # every live row's accept would have emitted from.
+            return finished + self._spec_bailout_fused(live, set(), e,
+                                                       opening)
+        # The chain's final carry opens the next step's round.
+        self._last_logits = last_d
+
+        # Drain in order; committing round j overlaps rounds > j on
+        # device.  Status checks guard every commit (abort/EOS/quarantine
+        # mid-drain), exactly like the horizon drain.
+        committed: set[int] = set()
+        try:
+            for toks, n_emit, m_acc in outs:
+                toks_np, n_np, m_np = jax.device_get(
+                    (toks, n_emit, m_acc))
+                self.metrics.host_syncs += 1
+                now = self._clock()
+                burst = int(n_np.max())
+                step_s = (now - t_prev) / max(burst, 1)
+                t_prev = now
+                round_live = False
+                for rs in sorted(live, key=lambda r: r.seq):
+                    if rs.status is not Status.RUNNING:
+                        continue
+                    b = rs.slot
+                    n = int(n_np[b])
+                    if n == 0:
+                        continue
+                    round_live = True
+                    prop = chain_k[b]
+                    acc = min(int(m_np[b]), prop)
+                    rs.spec_window.append((prop, acc))
+                    # keep at least the configured adaptive window
+                    del rs.spec_window[:-max(32, self.spec_adaptive)]
+                    self.metrics.observe_spec_row(prop, acc, prop)
+                    rs.kv_len += n  # the device already wrote the rows
+                    times = rs.metrics.burst_times(now, n, step_s)
+                    out = None
+                    try:
+                        for i in range(n):
+                            out = self._commit_token(
+                                rs, int(toks_np[b, i]), now=times[i])
+                            committed.add(b)
+                            self.metrics.decode_tokens += 1
+                            self.metrics.spec_tokens += 1
+                            if (out is not None
+                                    or rs.status is not Status.RUNNING):
+                                break  # retired; rest of burst dropped
+                    except _FATAL:
+                        raise
+                    except Exception as e:
+                        finished.append(self._quarantine(
+                            rs, f"commit: {e!r}"))
+                        continue
+                    if rs.status is Status.RUNNING:
+                        # spec-mode invariant: the round's closing decode
+                        # already consumed the burst's last token — there
+                        # is no pending token (commit_token set one)
+                        rs.pending_token = None
+                        self._commit_full_blocks(rs)
+                    if out is not None:
+                        finished.append(out)
+                if round_live:
+                    self.metrics.verify_rounds += 1
+                    self.metrics.spec_rounds += 1
+        except _FATAL:
+            raise
+        except Exception as e:
+            if not self._state_intact():
+                raise
+            # Rows with a drained burst re-open their last token as
+            # pending; rows without one (only possible when the FIRST
+            # drain failed) sample from the pre-chain opening logits.
+            return finished + self._spec_bailout_fused(live, committed,
+                                                       e, opening)
+        return finished
+
+    def _spec_tail(self, live: list[ReqState]) -> list[RequestOutput]:
+        """No headroom to speculate (the last cache slots): one plain
+        target token per row via the host sampler, consumed by one paged
+        decode (which also refreshes the round-opening logits) with the
+        draft stepping along — the fused path's k<=0 fallback,
+        generalized from the unfused round's greedy-only one to sampled
+        rows (:meth:`_choose_token` serves both).  This must never
+        under-serve a draft-less engine."""
+        finished: list[RequestOutput] = []
+        for rs in sorted(live, key=lambda r: r.seq):
+            if rs.status is Status.RUNNING:
+                try:
+                    self._ensure_capacity(
+                        rs, min(rs.kv_len + 1, rs.total_tokens))
+                except _FATAL:
+                    raise
+                except Exception as e:
+                    finished.append(self._quarantine(
+                        rs, f"kv grow (spec tail): {e!r}"))
+        live = [r for r in live if r.status is Status.RUNNING]
+        if not live:
+            return finished
+        B = self.max_batch
+        lens = np.zeros((B,), np.int32)
+        active = np.zeros((B,), bool)
+        tables = np.zeros((B, self.n_pages_max), np.int32)
+        toks_np = np.zeros((B,), np.int32)
+        last_np = np.asarray(self._last_logits)
+        self.metrics.host_syncs += 1
+        for rs in live:
+            b = rs.slot
+            lens[b] = rs.kv_len
+            active[b] = True
+            tables[b] = self.bm.padded_table(rs.req.request_id,
+                                             self.n_pages_max)
+            toks_np[b] = self._choose_token(rs, last_np[b])
+        rids = tuple(r.req.request_id for r in live)
+        closing = jnp.asarray(toks_np)
+        lens_d = jnp.asarray(lens)
+        active_d = jnp.asarray(active)
+        opening = self._last_logits  # the logits the tokens came from
+        try:
+            self._pools, logits = self._device_call(
+                "paged_decode", rids, self._decode_fn, self.params,
+                self._pools, jnp.asarray(tables), lens_d, closing,
+                active_d)
+            self.metrics.decode_steps += 1
+            sd = self._draft_state
+            dcaches, dlens, dlogits = self._device_call(
+                "draft_tail_step", rids, self._draft_tail_fn,
+                self.draft_params, sd.caches, lens_d, closing, active_d)
+            # Commit the carry only once BOTH dispatches succeeded: a
+            # draft-step failure bails out below, and the bailout must
+            # re-derive each row's token from the ROUND-OPENING logits
+            # — overwriting _last_logits first would hand it the
+            # post-consumption logits and fork the stream.
+            self._last_logits = logits
+            self._draft_state = GenerationState(
+                caches=dcaches, kv_lens=dlens, last_logits=dlogits)
+        except _FATAL:
+            raise
+        except Exception as e:
+            if not self._state_intact():
+                raise
+            # Nothing committed: the bailout re-derives the SAME token
+            # per row from the still-intact round-opening logits.
+            return finished + self._spec_bailout_fused(live, set(), e,
+                                                       opening)
+        for rs in sorted(live, key=lambda r: r.seq):
+            if rs.status is not Status.RUNNING:
+                continue
+            rs.kv_len += 1
+            out = None
+            try:
+                out = self._commit_token(rs, int(toks_np[rs.slot]))
+                self.metrics.decode_tokens += 1
+            except _FATAL:
+                raise
+            except Exception as e:
+                finished.append(self._quarantine(rs, f"commit: {e!r}"))
+                continue
+            rs.pending_token = None  # the decode above consumed it
+            if rs.status is Status.RUNNING:
+                self._commit_full_blocks(rs)
+            if out is not None:
+                finished.append(out)
+        return finished
+
+    def _spec_bailout_fused(self, live: list[ReqState], committed: set,
+                            err, opening) -> list[RequestOutput]:
+        """A fused speculative chain failed mid-flight: latch
+        speculation OFF (the device-resident carry and draft state can
+        no longer be trusted) and convert every live row to plain-decode
+        state, bit-exactly:
+
+        - a row that already committed tokens from this chain keeps
+          them and re-opens its LAST token as pending (``kv_len`` steps
+          back one row): the next plain decode re-writes that token's
+          K/V — an idempotent overwrite, the device already landed it —
+          and re-derives the logits the chain was carrying on device;
+        - a row that committed nothing emits one token from ``opening``
+          — the caller's snapshot of the PRE-CHAIN round-opening logits
+          (never the advanced device carry, which has already consumed
+          tokens the host never saw) — via the host sampler: exactly
+          what the round's accept chain would have emitted first
+          (``expected[0]`` is the target's own choice at this emission
+          index), so the stream cannot differ from the fault-free run.
+
+        From here the engine serves through :meth:`_decode_once` (full
+        retry/bisect containment) and joining prompts take the plain
+        prefill path."""
+        self._spec_off = True
+        self.metrics.spec_bailouts += 1
+        print(f"[serve] fused speculative chain failed ({err!r}); "
+              f"speculation latched off, serving degrades to plain "
+              f"decode", file=sys.stderr)
+        finished: list[RequestOutput] = []
+        last_np = np.asarray(opening)
+        for rs in sorted(live, key=lambda r: r.seq):
+            if rs.status is not Status.RUNNING:
+                continue
+            if rs.slot in committed:
+                rs.pending_token = rs.generated[-1]
+                rs.kv_len -= 1
+                continue
+            out = self._commit_token(
+                rs, self._choose_token(rs, last_np[rs.slot]))
+            if out is not None:
+                finished.append(out)
+        return finished
 
     def _spec_round(self,
                     running: list[ReqState]) -> list[RequestOutput]:

@@ -128,6 +128,21 @@ class ServeMetrics:
     forward_bisections: int = 0   # batch splits isolating a poison row
     watchdog_trips: int = 0       # step watchdog timeouts (re-raised)
     spec_bailouts: int = 0        # speculative rounds latched off
+    # speculative-decoding counters (docs/serving.md "Speculative
+    # decoding"): acceptance is the number that decides whether
+    # speculation pays — proposed/accepted feed the overall and rolling
+    # rates, chosen_k histograms the adaptive per-row depth, and
+    # spec_tokens/spec_dispatches give tokens-per-dispatch for the fused
+    # round alone (the ISSUE-7 guardrail: >= plain fused decode).
+    spec_rounds: int = 0          # fused rounds that emitted something
+    spec_proposed: int = 0        # draft tokens proposed (per-row budget)
+    spec_accepted: int = 0        # proposals the target's stream matched
+    spec_tokens: int = 0          # tokens committed by spec rounds
+    spec_dispatches: int = 0      # fused spec-round dispatches
+    spec_recent: list = field(default_factory=list, repr=False)
+    spec_chosen_k: dict = field(default_factory=dict)
+    draft_prefix_skipped_tokens: int = 0  # draft prefill skipped via the
+    #                               draft-side page cache (warm admits)
     # retirements by FinishReason.value
     finish_reasons: dict = field(default_factory=dict)
     # crash-recovery counters (docs/serving.md "Crash recovery"):
@@ -191,6 +206,42 @@ class ServeMetrics:
             "watchdog_trips": self.watchdog_trips,
             "spec_bailouts": self.spec_bailouts,
             "finish_reasons": dict(self.finish_reasons),
+        }
+
+    def observe_spec_row(self, proposed: int, accepted: int,
+                         chosen_k: int) -> None:
+        """One row's share of one fused speculative round (the engine
+        calls this at each round's drain)."""
+        self.spec_proposed += proposed
+        self.spec_accepted += accepted
+        self.spec_recent.append((proposed, accepted))
+        del self.spec_recent[:-64]
+        self.spec_chosen_k[chosen_k] = \
+            self.spec_chosen_k.get(chosen_k, 0) + 1
+
+    def spec_stats(self) -> dict:
+        """Speculative-decoding observability (summary()["spec"]):
+        per-round proposed/accepted counters, the overall and ROLLING
+        (last 64 row-rounds) acceptance rates, the chosen-k histogram
+        the adaptive policy produced, and spec tokens-per-dispatch —
+        the economics field the fused round exists to move."""
+        rp = sum(p for p, _ in self.spec_recent)
+        ra = sum(a for _, a in self.spec_recent)
+        return {
+            "rounds": self.spec_rounds,
+            "proposed": self.spec_proposed,
+            "accepted": self.spec_accepted,
+            "accept_rate": (self.spec_accepted / self.spec_proposed
+                            if self.spec_proposed else 0.0),
+            "rolling_accept_rate": (ra / rp if rp else 0.0),
+            "chosen_k": dict(sorted(self.spec_chosen_k.items())),
+            "spec_tokens": self.spec_tokens,
+            "spec_dispatches": self.spec_dispatches,
+            "spec_tokens_per_dispatch": (
+                self.spec_tokens / self.spec_dispatches
+                if self.spec_dispatches else 0.0),
+            "bailouts": self.spec_bailouts,
+            "draft_prefix_skipped_tokens": self.draft_prefix_skipped_tokens,
         }
 
     def recovery_stats(self) -> dict:
@@ -314,6 +365,7 @@ class ServeMetrics:
             "max_ttft": max(ttfts, default=None) if ttfts else None,
             "mean_itl": sum(itls) / len(itls) if itls else None,
             "decode": self.decode_stats(),
+            "spec": self.spec_stats(),
             "failures": self.failure_stats(),
             "recovery": self.recovery_stats(),
             "prefix_cache": self.prefix_stats(),

@@ -335,11 +335,30 @@ def replay_journal(path: str | os.PathLike) -> dict[str, JournalRequest]:
 
 
 def _pool_tree(engine) -> dict:
-    """The paged pools as a flat dict orbax round-trips losslessly."""
+    """The paged pools as a flat dict orbax round-trips losslessly.
+
+    Spec engines (PR 7) also carry the DRAFT's device state: the
+    slot-indexed batch caches, its lengths/logits, and the target's
+    round-opening logits — everything a restored spec engine needs to
+    resume rounds IN PLACE instead of re-prefilling every draft row
+    through the preemption path (the recorded PR 5 follow-up).  A
+    BAILED-OUT engine (``_spec_off``) snapshots pools-only: its draft
+    state is untrusted by definition — and may reference buffers a
+    failed chain's donation consumed, which orbax could not serialize
+    anyway (the manifest omits ``draft`` in lockstep, so the reader
+    never expects the keys)."""
     tree = {}
     for i, (k, v) in enumerate(engine._pools):
         tree[f"l{i}_k"] = k
         tree[f"l{i}_v"] = v
+    if engine.spec_k and not engine._spec_off:
+        sd = engine._draft_state
+        for i, (k, v) in enumerate(sd.caches):
+            tree[f"d{i}_k"] = k
+            tree[f"d{i}_v"] = v
+        tree["draft_kv_lens"] = sd.kv_lens
+        tree["draft_last_logits"] = sd.last_logits
+        tree["spec_last_logits"] = engine._last_logits
     return tree
 
 
@@ -386,26 +405,45 @@ def _capture_meta(engine, now: float, *, journal_here: bool) -> dict:
                 "arrival": out.metrics.arrival_time,
             }
     cfg = engine.cfg
+    eng_meta = {
+        "num_blocks": engine.bm.num_blocks,
+        "page_size": engine.page,
+        "max_batch": engine.max_batch,
+        "max_seq": engine.gen.max_seq,
+        "prefill_chunk": engine.scheduler.prefill_chunk,
+        "prefill_budget": engine.scheduler.prefill_budget,
+        "horizon": engine.horizon,
+        "pipeline": engine.pipeline,
+        "spec_k": engine.spec_k,
+        "spec_fused": engine.spec_fused,
+        "prefix_cache": engine.prefix_cache,
+        "snapshot_every": engine.snapshot_every,
+        "n_layers": cfg.n_layers,
+        "n_kv_heads": cfg.n_kv_heads,
+        "head_dim": cfg.head_dim,
+        "vocab": cfg.vocab,
+        "kv_dtype": str(np.dtype(cfg.dtype)),
+    }
+    if engine.spec_k and not engine._spec_off:
+        # Draft-state geometry: the snapshot reader needs it to build
+        # abstract targets for the draft arrays in the pool tree, and
+        # restore checks it against the caller's draft before resuming
+        # spec rows in place (mismatch -> exact-recompute requeue).
+        # Omitted in lockstep with _pool_tree's draft subtree (a
+        # spec_off snapshot is pools-only).
+        dcfg = engine.draft.cfg
+        eng_meta["draft"] = {
+            "n_layers": dcfg.n_layers,
+            "n_kv_heads": dcfg.n_kv_heads,
+            "head_dim": dcfg.head_dim,
+            "max_seq": engine.draft.max_seq,
+            "vocab": dcfg.vocab,
+            "dtype": str(np.dtype(dcfg.dtype)),
+        }
     return {
         "format": SNAPSHOT_FORMAT,
         "clock": now,
-        "engine": {
-            "num_blocks": engine.bm.num_blocks,
-            "page_size": engine.page,
-            "max_batch": engine.max_batch,
-            "max_seq": engine.gen.max_seq,
-            "prefill_chunk": engine.scheduler.prefill_chunk,
-            "prefill_budget": engine.scheduler.prefill_budget,
-            "horizon": engine.horizon,
-            "pipeline": engine.pipeline,
-            "spec_k": engine.spec_k,
-            "prefix_cache": engine.prefix_cache,
-            "snapshot_every": engine.snapshot_every,
-            "n_layers": cfg.n_layers,
-            "n_kv_heads": cfg.n_kv_heads,
-            "head_dim": cfg.head_dim,
-            "kv_dtype": str(np.dtype(cfg.dtype)),
-        },
+        "engine": eng_meta,
         "spec_off": engine._spec_off,
         "seq_counter": engine.scheduler._seq,
         "waiting": [rs.req.request_id for rs in engine.scheduler.waiting
@@ -542,6 +580,24 @@ def _load_latest_snapshot(directory: str) -> Optional[tuple]:
             for i in range(e["n_layers"]):
                 like[f"l{i}_k"] = jax.ShapeDtypeStruct(shape, dtype)
                 like[f"l{i}_v"] = jax.ShapeDtypeStruct(shape, dtype)
+            d = e.get("draft")
+            if e.get("spec_k") and d and "vocab" in e:
+                # Spec snapshots carry the draft's device state in the
+                # same tree (see _pool_tree); the manifest's draft
+                # geometry shapes the abstract targets.  Pre-PR-7
+                # manifests lack "draft" and restore pools-only.
+                ddt = np.dtype(d["dtype"])
+                dshape = (e["max_batch"], d["n_kv_heads"], d["max_seq"],
+                          d["head_dim"])
+                for i in range(d["n_layers"]):
+                    like[f"d{i}_k"] = jax.ShapeDtypeStruct(dshape, ddt)
+                    like[f"d{i}_v"] = jax.ShapeDtypeStruct(dshape, ddt)
+                like["draft_kv_lens"] = jax.ShapeDtypeStruct(
+                    (e["max_batch"],), np.int32)
+                like["draft_last_logits"] = jax.ShapeDtypeStruct(
+                    (e["max_batch"], d["vocab"]), np.float32)
+                like["spec_last_logits"] = jax.ShapeDtypeStruct(
+                    (e["max_batch"], e["vocab"]), np.float32)
             pools = ck.restore(step_dir, like)
             return step, meta, pools
         except Exception:  # noqa: BLE001 — torn snapshot: fall back
@@ -568,7 +624,7 @@ def _shift(ts: Optional[float], offset: float) -> Optional[float]:
 
 _META_KW = ("num_blocks", "page_size", "max_batch", "prefill_chunk",
             "prefill_budget", "horizon", "pipeline", "snapshot_every",
-            "prefix_cache")
+            "prefix_cache", "spec_fused")
 
 
 def restore_engine(directory: str | os.PathLike, gen, params, *,
@@ -667,6 +723,40 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
                          v.at[:n_copy].set(jnp.asarray(vo)[:n_copy])))
             engine._pools = new_pools
             pools_ok = True
+
+    # -- spec device state: draft caches + round-opening logits -----------
+    # Restorable iff the snapshot carried it AND the caller's draft has
+    # the exact geometry (the draft caches are slot-indexed [max_batch]
+    # arrays, so max_batch must match too).  Without it, spec rows
+    # requeue through the exact-recompute path — bit-exact either way.
+    spec_ok = False
+    if (pools_ok and engine.spec_k and not engine._spec_off
+            and meta["engine"].get("spec_k") == engine.spec_k
+            and meta["engine"].get("max_batch") == engine.max_batch
+            and meta["engine"].get("draft")
+            and "draft_kv_lens" in pools_raw):
+        from triton_dist_tpu.models.generate import GenerationState
+
+        d = meta["engine"]["draft"]
+        dcfg = engine.draft.cfg
+        if (d["n_layers"] == dcfg.n_layers
+                and d["n_kv_heads"] == dcfg.n_kv_heads
+                and d["head_dim"] == dcfg.head_dim
+                and d["max_seq"] == engine.draft.max_seq
+                and d["vocab"] == dcfg.vocab
+                and d["dtype"] == str(np.dtype(dcfg.dtype))):
+            import jax.numpy as jnp
+
+            engine._draft_state = GenerationState(
+                caches=[(jnp.asarray(pools_raw[f"d{i}_k"]),
+                         jnp.asarray(pools_raw[f"d{i}_v"]))
+                        for i in range(d["n_layers"])],
+                kv_lens=jnp.asarray(pools_raw["draft_kv_lens"]),
+                last_logits=jnp.asarray(
+                    pools_raw["draft_last_logits"]))
+            engine._last_logits = jnp.asarray(
+                pools_raw["spec_last_logits"])
+            spec_ok = True
 
     # -- merge journal over manifest --------------------------------------
     m_reqs = meta["requests"] if meta is not None else {}
@@ -811,12 +901,27 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
     inflight = still
 
     # -- classify in-flight requests: resume in place vs recompute --------
+    # A RUNNING row resumes in place iff its snapshot invariant matches
+    # how THIS engine will serve it.  Plain serving needs the pending
+    # token (kv_len rows + one emitted-but-unconsumed token); fused spec
+    # serving has no pending token — its round state is the snapshotted
+    # draft caches + logits rows (``spec_ok``), which are SLOT-indexed,
+    # so the row must come back in its original slot.  Rows from a spec
+    # snapshot restored into a plain (or draft-less) engine fail the
+    # pending check and requeue through exact recompute — bit-exact
+    # either way.
+    spec_live = bool(engine.spec_k) and not engine._spec_off
+
     def resumable(rid: str) -> bool:
         mr = m_reqs.get(rid)
         if not (pools_ok and mr is not None
-                and mr["status"] == Status.RUNNING.value
-                and mr["pending"] is not None
-                and not engine.spec_k and not meta["engine"]["spec_k"]):
+                and mr["status"] == Status.RUNNING.value):
+            return False
+        if spec_live:
+            if not spec_ok or mr["pending"] is not None \
+                    or mr.get("slot") is None:
+                return False
+        elif mr["pending"] is None:
             return False
         r = resolved[rid]
         if len(r["tokens"]) != len(mr["gen"]):
@@ -872,8 +977,13 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
     resumed: list[str] = []
     for rid in resume:
         mr = m_reqs[rid]
-        slot = mr["slot"] if mr["slot"] in free_slots else (
-            free_slots[0] if free_slots else None)
+        if spec_live:
+            # The draft caches/logits rows are slot-indexed: a spec row
+            # resumes in ITS slot or not at all.
+            slot = mr["slot"] if mr["slot"] in free_slots else None
+        else:
+            slot = mr["slot"] if mr["slot"] in free_slots else (
+                free_slots[0] if free_slots else None)
         if slot is None:  # geometry shrank under us: recompute instead
             requeue.insert(0, rid)
             continue

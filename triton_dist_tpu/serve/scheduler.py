@@ -17,6 +17,7 @@ their per-token PRNG stream, so the emission is unchanged).
 from __future__ import annotations
 
 import enum
+import math
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
@@ -87,6 +88,13 @@ class ReqState:
     # without the memo each retry re-pays the O(prompt) chain walk
     match_cache: Optional[list] = None
     match_gen: int = -1
+    # speculative decoding (docs/serving.md "Speculative decoding"):
+    # recent (proposed, accepted) pairs, one per fused round this row
+    # took part in — the windowed acceptance estimate behind the
+    # scheduler's adaptive per-row k (choose_spec_k); trimmed by the
+    # engine, survives preemption (acceptance is a property of the
+    # request's text, not of its admission)
+    spec_window: list = field(default_factory=list)
 
     def expired(self, now: float) -> bool:
         """Past its deadline TTL (``params.deadline_s`` from arrival)."""
@@ -270,6 +278,51 @@ class FCFSScheduler:
         if horizon <= 1 or spec or prefilling or deadline_waiting:
             return 1
         return horizon
+
+    # -- speculative planning --------------------------------------------
+
+    def plan_spec(self, pipeline: int, *, prefilling: bool,
+                  deadline_waiting: bool) -> int:
+        """Fused speculative rounds ONE engine step may chain on a
+        device-resident carry (the spec twin of :meth:`plan_horizon` —
+        a chained round is a spec-shaped horizon link).  The same
+        per-step contracts clamp chaining back to one round per step:
+        mid-prefill rows are owed chunk budget every iteration, and
+        WAITING deadlines are swept at step boundaries.  The
+        ``plan_horizon`` spec clamp does NOT apply here — a spec round
+        is already the multi-token dispatch it protects."""
+        if pipeline <= 1 or prefilling or deadline_waiting:
+            return 1
+        return pipeline
+
+    def choose_spec_k(self, rs: ReqState, k_max: int, *, window: int = 8,
+                      floor: float = 0.25) -> int:
+        """Per-row speculation depth from a windowed acceptance-rate
+        estimate: under an i.i.d.-acceptance model with per-token rate
+        ``alpha`` (the window's accepted/proposed), a k-token chain
+        fully accepts with probability ``alpha ** k`` — pick the
+        deepest k that still clears ``floor``, so a well-matched draft
+        speculates the full ``k_max`` while a mismatched one collapses
+        to 1 instead of burning k draft steps per emitted token.
+        Optimistic while the window is still filling (a fresh request
+        starts at full depth); the evidence floor is min(k_max, window)
+        proposals so a COLLAPSED row — whose window holds `window`
+        1-proposal rounds, fewer than k_max proposals — stays collapsed
+        instead of periodically resetting to full depth (and dragging
+        the whole batch's k-rung up with it).  The engine buckets the
+        batch max down the pow2 k-ladder, so the chosen depths never
+        cost fresh traces."""
+        window = max(window, 1)
+        hist = rs.spec_window[-window:]
+        prop = sum(p for p, _ in hist)
+        if k_max <= 1 or prop < min(k_max, window):
+            return max(k_max, 1)
+        alpha = sum(a for _, a in hist) / prop
+        if alpha <= 0.0:
+            return 1
+        if alpha >= 1.0:
+            return k_max
+        return max(1, min(k_max, int(math.log(floor) / math.log(alpha))))
 
     # -- preemption -------------------------------------------------------
 
