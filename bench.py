@@ -426,6 +426,21 @@ def _bench_serve_spec():
     return r["spec_vs_plain_tokens_per_dispatch"]
 
 
+def _bench_serve_trace():
+    """Flight-recorder overhead (scripts/bench_serve.py
+    bench_trace_overhead): the identical warmed decode workload with
+    tracing OFF vs FULL detail, paired tokens/s quotient — dispatch
+    drift cancels like the other paired ratios.  The recorder's
+    hot-path contract (bounded-ring append only: no sync, no I/O, no
+    formatting) is only real if it is measured; the PERF_FLOORS.json
+    ``serve_trace_overhead`` floor (0.95) is the acceptance bar."""
+    from scripts.bench_serve import bench_trace_overhead
+
+    r = bench_trace_overhead(batch=4, prompt_len=16, new_tokens=48,
+                             dim=32)
+    return r["serve_trace_overhead"]
+
+
 def check_floors(out: dict, floors: dict) -> tuple[dict, list]:
     """Per-metric guardrail (PERF_FLOORS.json, ROADMAP #5b): for each
     floor whose metric is present in ``out``, a ``vs_floor`` ratio
@@ -470,6 +485,7 @@ def main():
     ring_ratio = _bench_ring_vs_dense()
     serve_tps, serve_speedup = _bench_serve_engine()
     spec_speedup = _bench_serve_spec()
+    trace_overhead = _bench_serve_trace()
 
     peak = peak_bf16_tflops()
     vs = (tflops / peak) / REF_UTILIZATION if peak else 0.0
@@ -505,6 +521,11 @@ def main():
         # one-dispatch spec path's guardrail (>= 1.0 means a spec round
         # commits at least as many tokens per dispatch as the horizon).
         "serve_spec_speedup": round(spec_speedup, 2),
+        # Flight-recorder overhead: tokens/s with full tracing over
+        # tokens/s with tracing off on the identical workload — the
+        # PR 8 hot-path discipline bar (>= 0.95 means the recorder's
+        # ring appends cost under 5% of serving throughput).
+        "serve_trace_overhead": round(trace_overhead, 3),
         # Known-cost reference op (bare XLA dot, measured ceiling 189.7):
         # a depressed sentinel means the HOST was contended during this
         # session and `value` is a lower bound, not a regression.
@@ -535,7 +556,8 @@ def main():
           f"moe_a2a floor {moe_a2a_us:.2f} us; decode {decode_us:.1f} us; "
           f"ring/dense {ring_ratio:.3f}; decode/xla {decode_ratio:.3f}; "
           f"serve {serve_tps:.0f} tok/s (H8/H1 {serve_speedup:.2f}x, "
-          f"spec/plain {spec_speedup:.2f}x t/dispatch); "
+          f"spec/plain {spec_speedup:.2f}x t/dispatch, "
+          f"trace {trace_overhead:.3f}x); "
           f"sentinel dot {sentinel_tflops:.1f} TFLOPS"
           + (" (CONTENDED)" if contended else ""),
           file=sys.stderr)

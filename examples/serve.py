@@ -144,6 +144,27 @@ def parse_args():
                         "engine step K — once (a marker in "
                         "--snapshot-dir gates re-kills), so a "
                         "supervisor restart runs to completion")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="P",
+                   help="engine mode: serve the live Prometheus text "
+                        "exposition (ServeMetrics.to_prometheus) at "
+                        "http://127.0.0.1:P/metrics from a stdlib-HTTP "
+                        "daemon thread while the engine runs (0 picks a "
+                        "free port; docs/observability.md lists the "
+                        "metric names)")
+    p.add_argument("--stats-every", type=int, default=None, metavar="N",
+                   help="engine mode: log one compact stats line "
+                        "(metrics.format_statline — the same formatter "
+                        "the supervisor's postmortem uses) every N "
+                        "engine steps")
+    p.add_argument("--trace-level", type=int, default=None,
+                   help="engine mode: flight-recorder detail (0 = off, "
+                        "1 = lifecycle + failures [default], 2 = "
+                        "+ per-dispatch events; docs/observability.md)")
+    p.add_argument("--trace-perfetto", default=None, metavar="PATH",
+                   help="engine mode: export the flight recorder's "
+                        "per-request timeline as a Chrome/Perfetto "
+                        "trace at PATH after the run (open in "
+                        "ui.perfetto.dev; .gz suffix gzips)")
     p.add_argument("--shared-prompt", action="store_true",
                    help="engine mode: every request shares one system-"
                         "prompt prefix (plus a distinct suffix) — the "
@@ -167,6 +188,16 @@ def parse_args():
                 f"{args.spec_adaptive}")
     if args.spec_adaptive is not None and not args.speculative:
         p.error("--spec-adaptive needs --speculative")
+    if args.trace_level is not None and args.trace_level < 0:
+        p.error(f"--trace-level must be >= 0, got {args.trace_level}")
+    if args.stats_every is not None and args.stats_every < 1:
+        p.error(f"--stats-every must be >= 1, got {args.stats_every}")
+    for flag, name in ((args.metrics_port, "--metrics-port"),
+                       (args.stats_every, "--stats-every"),
+                       (args.trace_level, "--trace-level"),
+                       (args.trace_perfetto, "--trace-perfetto")):
+        if flag is not None and not args.engine:
+            p.error(f"{name} is an engine-mode flag: add --engine")
     return args
 
 
@@ -248,7 +279,9 @@ def run_engine(args, key):
               spec_k=args.speculative or 0,
               faults=faults, max_queue=max_queue, fault_retries=1,
               heartbeat=args.heartbeat,
-              heartbeat_interval_s=args.hb_interval)
+              heartbeat_interval_s=args.hb_interval,
+              trace_level=(1 if args.trace_level is None
+                           else args.trace_level))
     if args.spec_adaptive is not None:
         kw["spec_adaptive"] = args.spec_adaptive
     from triton_dist_tpu.serve.recovery import has_restorable_state
@@ -306,6 +339,15 @@ def run_engine(args, key):
                    f"{w['seconds'] * 1e3:.0f} ms — steady-state serving "
                    f"is compile-free{caveat}")
 
+    metrics_srv = None
+    if args.metrics_port is not None:
+        from triton_dist_tpu.serve.trace import start_metrics_server
+
+        metrics_srv = start_metrics_server(engine.metrics,
+                                           port=args.metrics_port)
+        dist_print(f"metrics: Prometheus text at http://127.0.0.1:"
+                   f"{metrics_srv.server_address[1]}/metrics")
+
     params_s = SamplingParams(max_new_tokens=args.new_tokens,
                               temperature=args.temperature,
                               top_k=args.top_k, top_p=args.top_p,
@@ -344,12 +386,20 @@ def run_engine(args, key):
             # a real SIGKILL.  The marker keeps the restarted run alive.
             with open(kill_marker, "w") as f:
                 f.write("killed once\n")
+            # the flight recorder's postmortem trail is the ONE thing
+            # worth a syscall on the way down (the supervisor surfaces
+            # it on restart; a real SIGKILL gets the previous flush)
+            engine.flight_flush(f"kill-at-step {step}", force=True)
             dist_print(f"killing engine process at step {step} "
                        f"(os._exit; restart with --resume)")
             sys.stdout.flush()
             os._exit(17)
         finished.extend(engine.step())
         step += 1
+        if args.stats_every is not None and step % args.stats_every == 0:
+            from triton_dist_tpu.serve.metrics import format_statline
+            dist_print("stats: "
+                       + format_statline(engine.metrics.light_summary()))
 
     if args.sessions:
         # Follow-up turns: each turn's prompt is the FULL previous
@@ -398,66 +448,35 @@ def run_engine(args, key):
     dist_print(f"engine: {total_tokens} tokens / {args.requests} requests "
                f"in {dt * 1e3:.1f} ms over {s['steps']} iterations "
                f"({s['decode_steps']} decode, {s['verify_rounds']} verify)")
+    # ONE formatter renders summary() everywhere (serve/metrics.py):
+    # this end-of-run block, the --stats-every one-liner, and the
+    # supervisor's postmortem line can never drift apart.
+    from triton_dist_tpu.serve.metrics import format_stats
 
-    def ms(x):  # aggregates are None when no request had >= 2 tokens
-        return f"{x * 1e3:.2f} ms" if x is not None else "n/a"
-
-    dist_print(f"engine metrics: mean ttft {ms(s['mean_ttft'])}, "
-               f"mean itl {ms(s['mean_itl'])}, max queue depth "
-               f"{s['max_queue_depth']}, peak kv util "
-               f"{s['peak_kv_utilization']:.2f}, preemptions "
-               f"{s['preemptions']}")
-    d = s["decode"]
-    dist_print(f"decode horizon: {d['dispatches']} dispatches / "
-               f"{d['host_syncs']} host syncs for {d['decode_tokens']} "
-               f"tokens ({d['decode_steps']} device steps) — "
-               f"{d['tokens_per_dispatch']:.2f} tokens/dispatch, "
-               f"{d['dispatches_per_token']:.3f} dispatches/token")
-    if args.speculative:
-        sp = s["spec"]
-        dist_print(f"speculative: {sp['rounds']} fused rounds, accept "
-                   f"rate {sp['accept_rate']:.2f} (rolling "
-                   f"{sp['rolling_accept_rate']:.2f}), chosen k "
-                   f"{sp['chosen_k']}, "
-                   f"{sp['spec_tokens_per_dispatch']:.2f} spec tokens/"
-                   f"dispatch, {sp['bailouts']} bailouts"
-                   + (f", {sp['draft_prefix_skipped_tokens']} draft "
-                      f"prefill tokens skipped"
-                      if sp['draft_prefix_skipped_tokens'] else ""))
-    if engine.prefix_cache:
-        pc = s["prefix_cache"]
-        ratio = (f", warm/cold ttft {pc['ttft_warm_over_cold']:.2f}x"
-                 if pc["ttft_warm_over_cold"] is not None else "")
-        dist_print(f"prefix cache: {pc['lookup_hits']}/{pc['lookups']} "
-                   f"lookups hit, {pc['prefix_skipped_tokens']} prefill "
-                   f"tokens skipped, {pc['cached_blocks']} cached / "
-                   f"{pc['shared_blocks']} shared blocks, "
-                   f"{pc['cow_copies']} COW, {pc['evictions']} "
-                   f"evictions{ratio}")
-    if args.chaos or args.deadline or max_queue is not None:
-        f = s["failures"]
-        dist_print(f"failure containment: {f['shed']} shed, "
-                   f"{f['deadline_expired']} expired, "
-                   f"{f['quarantined']} quarantined, "
-                   f"{f['callback_errors']} callback errors, "
-                   f"{f['forward_retries']} retries / "
-                   f"{f['forward_bisections']} bisections, "
-                   f"finish reasons {f['finish_reasons']}")
-    if snap_dir is not None:
-        r = s["recovery"]
-        dist_print(f"crash recovery: {r['snapshots']} snapshots "
-                   f"(last {r['snapshot_ms_last']:.1f} ms), "
-                   f"{r['journal_records']} journal records "
-                   f"({r['journal_bytes']} bytes), "
-                   f"{r['restored_in_place']} resumed in place / "
-                   f"{r['restored_requeued']} requeued")
-    comp = s["compilation"]
-    per = ", ".join(f"{n} {c['misses']}c/{c['hits']}h"
-                    for n, c in comp["programs"].items())
-    dist_print(f"trace cache (compiles/hits): {per}")
-    dist_print(f"compile stalls: {comp['total_compile_time_s'] * 1e3:.0f} "
-               f"ms total, {comp['warmup_compiles']} programs "
-               f"({comp['warmup_time_s'] * 1e3:.0f} ms) during warmup")
+    for line in format_stats(
+            s, spec=bool(args.speculative), prefix=engine.prefix_cache,
+            failures=(args.chaos or args.deadline is not None
+                      or max_queue is not None),
+            recovery=snap_dir is not None):
+        dist_print(line)
+    if metrics_srv is not None:
+        # Self-scrape: prove the live endpoint served parseable text
+        # during the run (what a Prometheus agent would have seen).
+        import urllib.request
+        port = metrics_srv.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            body = r.read().decode()
+        series = sum(1 for ln in body.splitlines()
+                     if ln and not ln.startswith("#"))
+        dist_print(f"metrics endpoint: {len(body)} bytes, "
+                   f"{series} series served")
+        metrics_srv.shutdown()
+    if args.trace_perfetto:
+        path = engine.trace.export_perfetto(args.trace_perfetto)
+        n = len(engine.trace.events())
+        dist_print(f"perfetto trace: {n} events -> {path} "
+                   f"(open in ui.perfetto.dev)")
     dumped = engine.metrics.maybe_dump()
     if dumped:
         dist_print(f"engine metrics dumped to {dumped}")

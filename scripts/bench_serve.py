@@ -44,7 +44,8 @@ from jax.sharding import Mesh
 def bench_engine(horizon: int, *, batch: int = 4, prompt_len: int = 16,
                  new_tokens: int = 64, pipeline: int = 2, dim: int = 64,
                  n_layers: int = 2, vocab: int = 256, page_size: int = 16,
-                 seed: int = 0, warmup: bool = True) -> dict:
+                 seed: int = 0, warmup: bool = True,
+                 trace_level: int = 1) -> dict:
     """One configuration: a warmed engine drains a steady decode-only
     batch; returns wall time, decode tokens/s, and the dispatch counters.
     A fresh engine per call — the trace caches must not leak between
@@ -65,7 +66,7 @@ def bench_engine(horizon: int, *, batch: int = 4, prompt_len: int = 16,
     eng = ServeEngine(gen, params, num_blocks=1 + per_req * batch,
                       page_size=page_size, max_batch=batch,
                       prefill_chunk=max(8, page_size), horizon=horizon,
-                      pipeline=pipeline)
+                      pipeline=pipeline, trace_level=trace_level)
     if warmup:
         eng.warmup()
     rng = np.random.default_rng(seed)
@@ -175,6 +176,50 @@ def bench_spec(*, k: int = 12, batch: int = 4, prompt_len: int = 16,
         "plain_tokens_per_dispatch": plain["tokens_per_dispatch"],
         "dispatches_per_token": round(d["dispatches_per_token"], 4),
         "spec_vs_plain_tokens_per_dispatch": round(ratio, 3),
+    }
+
+
+def bench_trace_overhead(*, batch: int = 4, prompt_len: int = 16,
+                         new_tokens: int = 64, pipeline: int = 2,
+                         dim: int = 64, n_layers: int = 2,
+                         vocab: int = 256, page_size: int = 16,
+                         seed: int = 0, warmup: bool = True,
+                         horizon: int = 8, repeats: int = 3) -> dict:
+    """Flight-recorder overhead (docs/observability.md): the SAME
+    steady decode-only workload runs with tracing OFF (trace_level=0 —
+    ``emit`` returns before touching the ring) and at FULL detail
+    (trace_level=2, per-chunk events included), and the headline is the
+    paired tokens/s quotient — tracing on over tracing off.  The
+    hot-path contract (append to a bounded ring, no sync/IO/formatting)
+    says this must stay ~1.0; ``bench.py`` carries it as
+    ``serve_trace_overhead`` with a ``PERF_FLOORS.json`` floor of 0.95.
+    Each leg takes the best of ``repeats`` runs so a host scheduling
+    blip can't read as recorder overhead."""
+    def best(level):
+        tps = 0.0
+        last = None
+        for i in range(max(repeats, 1)):
+            last = bench_engine(horizon, batch=batch,
+                                prompt_len=prompt_len,
+                                new_tokens=new_tokens,
+                                pipeline=pipeline, dim=dim,
+                                n_layers=n_layers, vocab=vocab,
+                                page_size=page_size, seed=seed + i,
+                                warmup=warmup, trace_level=level)
+            tps = max(tps, last["decode_toks_per_s"])
+        return tps, last
+
+    off_tps, _ = best(0)
+    on_tps, on = best(2)
+    return {
+        "mode": "trace",
+        "horizon": horizon,
+        "batch": batch,
+        "new_tokens": new_tokens,
+        "toks_per_s_trace_off": off_tps,
+        "toks_per_s_trace_on": on_tps,
+        "serve_trace_overhead": round(
+            on_tps / off_tps if off_tps > 0 else 0.0, 3),
     }
 
 
@@ -353,6 +398,12 @@ def main():
     p.add_argument("--spec-k", type=int, default=12,
                    help="--spec: speculation depth (pow2-ladder "
                         "bucketed)")
+    p.add_argument("--trace", action="store_true",
+                   help="flight-recorder overhead mode: the same "
+                        "steady workload with tracing off vs full "
+                        "detail — prints the paired tokens/s quotient "
+                        "(bench.py's serve_trace_overhead; the "
+                        "PERF_FLOORS.json floor holds it >= 0.95)")
     p.add_argument("--shared-prompt", action="store_true",
                    help="prefix-cache mode: cold vs warm shared-prompt "
                         "TTFT + hit rate (docs/serving.md 'Prefix "
@@ -368,6 +419,21 @@ def main():
         p.error(f"--sessions must be >= 1, got {args.sessions}")
     if args.sessions is not None and args.turns < 1:
         p.error(f"--turns must be >= 1, got {args.turns}")
+    if args.trace:
+        r = bench_trace_overhead(batch=args.batch,
+                                 prompt_len=args.prompt_len,
+                                 new_tokens=args.new_tokens,
+                                 pipeline=args.pipeline, dim=args.dim,
+                                 n_layers=args.layers,
+                                 page_size=args.page_size,
+                                 seed=args.seed,
+                                 warmup=not args.no_warmup)
+        print(json.dumps(r))
+        print(f"# tracing on {r['toks_per_s_trace_on']:.1f} vs off "
+              f"{r['toks_per_s_trace_off']:.1f} decode tokens/s "
+              f"({r['serve_trace_overhead']:.3f}x — floor 0.95)",
+              file=sys.stderr)
+        return
     if args.spec:
         if args.spec_k < 1:
             p.error(f"--spec-k must be >= 1, got {args.spec_k}")

@@ -98,6 +98,33 @@ def run_once(cmd: list[str], hb: str | None, hb_interval: float,
         time.sleep(poll_s)
 
 
+def postmortem(snapshot_dir: str) -> None:
+    """Surface the dead child's flight-recorder trail (the engine
+    flushes ``flight_<step>.json`` on fault/kill paths — serve/trace.py;
+    the embedded statline comes from the SAME
+    ``serve.metrics.format_statline`` the CLI's periodic log uses, so
+    the supervisor's view and the engine's can't drift)."""
+    import glob
+    import json
+
+    files = glob.glob(os.path.join(snapshot_dir, "flight_*.json"))
+    if not files:
+        return
+    path = max(files, key=os.path.getmtime)
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        print(f"[supervisor] postmortem {path}: unreadable", flush=True)
+        return
+    line = (f"[supervisor] postmortem {path}: "
+            f"{len(rec.get('events', []))} events at step "
+            f"{rec.get('step')}, reason {rec.get('reason')!r}")
+    if rec.get("statline"):
+        line += f" — {rec['statline']}"
+    print(line, flush=True)
+
+
 def main() -> int:
     args = parse_args()
     cmd = list(args.cmd)
@@ -112,6 +139,7 @@ def main() -> int:
                   f"{restarts} restart(s)", flush=True)
             return 0
         why = "stalled" if stalled else f"exited {rc}"
+        postmortem(args.snapshot_dir)
         restarts += 1
         if restarts > args.max_restarts:
             print(f"[supervisor] child {why}; restart budget "

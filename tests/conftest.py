@@ -121,6 +121,14 @@ _FAST_GATE_MODULES = {
     # snapshot/restore, journal rotation, and the bench floor helper all
     # run in the gate (the whole file is the fast tier).
     "test_serve_prefix",
+    # flight recorder / observability: taxonomy meta-test (every
+    # FinishReason + fault point has a registered event), chaos-drain
+    # event completeness, nested Perfetto spans, histogram-vs-numpy,
+    # Prometheus exposition + live endpoint, bounded-memory regressions,
+    # and the kill -> flight_*.json -> restore-provenance loop; only the
+    # wall-clock overhead gate is @pytest.mark.slow (bench.py enforces
+    # the PERF_FLOORS.json serve_trace_overhead floor).
+    "test_serve_trace",
     # one-dispatch speculative decoding: the fused-round oracle (greedy
     # fused == unfused == Generator.generate; seeded-sampled == the
     # draft-less engine), k-ladder warmup flatness, adaptive-k

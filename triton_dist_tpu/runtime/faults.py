@@ -42,6 +42,13 @@ Every audit-log entry records the engine's monotonic step index
 call index, so a post-mortem can replay a chaos schedule
 deterministically: the (step, point, call) triple pins each firing to
 one seam arrival of one engine iteration.
+
+Flight-recorder contract (serve/trace.py, docs/observability.md): every
+injection point MUST be registered in ``serve.trace.FAULT_POINT_EVENTS``
+— the engine mirrors each audit entry into its event ring, and a tier-1
+meta-test greps the source for ``.fire("<point>"`` seams and fails on an
+unregistered one, so a new failure path cannot silently skip the
+recorder.
 """
 
 from __future__ import annotations

@@ -11,6 +11,15 @@ gzipped timeline with per-rank pid re-namespacing — the same single-
 artifact contract as the reference's merge pipeline, minus its
 gather-to-rank-0 copy step (ranks write a shared filesystem directly).
 The per-rank dirs also remain loadable individually.
+
+The serving engine's flight recorder rides the same merge machinery:
+``serve.trace.FlightRecorder.export_profile(job_dir)`` drops the engine
+timeline as ``rank{i}/engine.trace.json.gz`` (its events claim
+``serve.trace.ENGINE_PID`` — below the Linux pid cap, so the per-rank
+pid re-namespacing in :func:`merge_rank_traces` stays injective), and
+one merged ui.perfetto.dev file then holds the device timeline and the
+engine's request lifecycle spans side by side (docs/observability.md
+has the recipe).
 """
 
 from __future__ import annotations

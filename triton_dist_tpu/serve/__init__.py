@@ -30,6 +30,12 @@ Layout:
 - ``recovery``   — crash resilience: engine snapshot/restore over the
   runtime/checkpoint Orbax path + the append-per-commit token journal
   with exactly-once resumption (docs/serving.md "Crash recovery")
+- ``trace``      — the flight recorder: a bounded ring of typed engine
+  events reconstructing per-request lifecycle spans (Perfetto export,
+  merged with device traces via runtime/profiling.py), log-bucketed
+  SLO histograms, the Prometheus exposition endpoint, and postmortem
+  ``flight_<step>.json`` flushes on fault/crash paths
+  (docs/observability.md)
 """
 
 from triton_dist_tpu.serve.request import (  # noqa: F401
@@ -43,6 +49,13 @@ from triton_dist_tpu.serve.scheduler import FCFSScheduler  # noqa: F401
 from triton_dist_tpu.serve.metrics import (  # noqa: F401
     RequestMetrics,
     ServeMetrics,
+    format_statline,
+    format_stats,
+)
+from triton_dist_tpu.serve.trace import (  # noqa: F401
+    FlightRecorder,
+    LogHistogram,
+    start_metrics_server,
 )
 from triton_dist_tpu.serve.recovery import (  # noqa: F401
     TokenJournal,

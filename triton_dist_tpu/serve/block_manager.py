@@ -94,6 +94,12 @@ class BlockManager:
         # (dict iteration order = admission order = eviction order)
         self._cached: dict[int, None] = {}
         # observability (engine surfaces these via metrics.summary())
+        # on_evict(block): optional hook fired as a cache-tier block is
+        # reclaimed — the engine points it at the flight recorder so
+        # eviction storms land on the request timeline
+        # (docs/observability.md); must never raise (called on the
+        # allocation hot path).
+        self.on_evict = None
         self.lookups = 0          # match_prefix calls
         self.lookup_hits = 0      # match_prefix calls matching > 0 blocks
         self.hit_blocks = 0       # blocks mapped read-only into tables
@@ -300,6 +306,8 @@ class BlockManager:
         self._orphan_children(block)
         self._free.append(block)
         self.evictions += 1
+        if self.on_evict is not None:
+            self.on_evict(block)
 
     def _orphan_children(self, block: int) -> None:
         """``block`` is returning to the free list: its id can be

@@ -103,6 +103,7 @@ from triton_dist_tpu.models.speculative import (
     accept_chain_rowwise,
     greedy_accept_chain_batched,
 )
+from triton_dist_tpu.runtime import dump as ir_dump
 from triton_dist_tpu.runtime.faults import FaultInjector
 from triton_dist_tpu.runtime.jit_cache import (
     CountingJit,
@@ -128,6 +129,7 @@ from triton_dist_tpu.serve.request import (
     SamplingParams,
 )
 from triton_dist_tpu.serve.scheduler import FCFSScheduler, ReqState, Status
+from triton_dist_tpu.serve.trace import FlightRecorder
 
 
 class QueueFull(RuntimeError):
@@ -617,7 +619,8 @@ class ServeEngine:
                  journal_fsync_interval_s: Optional[float] = None,
                  journal_rotate_bytes: Optional[int] = None,
                  journal_retain_done: Optional[int] = 4096,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 trace_level: int = 1, trace_events: int = 4096):
         assert gen.attn.world == 1, (
             "ServeEngine is world-1 (the per-row block tables are host-"
             "managed); multi-chip serving keeps Generator.generate's SP "
@@ -668,6 +671,22 @@ class ServeEngine:
             prefill_budget=prefill_budget or 4 * prefill_chunk,
             prefill_chunk=prefill_chunk)
         self.metrics = ServeMetrics()
+        # flight recorder (docs/observability.md): a bounded ring of
+        # typed engine events — submit/admit/prefill/decode drains, spec
+        # rounds, preemptions, COW splits, faults, retirements — that
+        # exports per-request Perfetto spans, flushes to
+        # flight_<step>.json on any fault/crash path, and rides
+        # snapshots so a restored engine carries its previous life's
+        # trail.  trace_level=0 turns the hot-path appends off entirely
+        # (bench_serve --trace holds the on/off throughput ratio at
+        # >= 0.95 via PERF_FLOORS.json's serve_trace_overhead).
+        if trace_level < 0:
+            raise ValueError(f"trace_level must be >= 0, got {trace_level}")
+        self.trace = FlightRecorder(capacity=trace_events,
+                                    level=trace_level)
+        self.metrics.attach_recorder(self.trace)
+        self._trace_fault_idx = 0   # audit entries already mirrored
+        self._last_flight_step = -1  # flush throttle: one file per step
         self.draft = draft
         self.draft_params = draft_params
         self.spec_k = int(spec_k)
@@ -843,6 +862,11 @@ class ServeEngine:
             self.metrics.register_compiled(self._load_fn)
             self.metrics.register_compiled(self._cow_fn)
         self.metrics.attach_block_manager(self.bm)
+        # cache-tier reclaims happen inside the allocator; the hook puts
+        # them on the flight-recorder timeline (an eviction storm under
+        # allocation pressure is a classic tail-latency culprit)
+        self.bm.on_evict = (
+            lambda b: self.trace.emit("evict", None, block=int(b)))
 
         self.slots: list[Optional[ReqState]] = [None] * max_batch
         self._states: dict[str, ReqState] = {}
@@ -1002,6 +1026,9 @@ class ServeEngine:
             self._note_journal()
         rs = ReqState(req=req,
                       metrics=RequestMetrics(arrival_time=req.arrival_time))
+        self.trace.emit("submit", req.request_id,
+                        prompt=int(req.prompt.shape[0]),
+                        max_new=req.params.max_new_tokens)
         if overloaded:
             self._states[req.request_id] = rs
             self.metrics.shed += 1
@@ -1063,6 +1090,9 @@ class ServeEngine:
             raise ValueError("snapshot() needs a directory: pass one or "
                              "construct the engine with snapshot_dir=")
         info = recovery.snapshot_engine(self, d)
+        self.metrics.hist_snapshot.observe(info["ms"] / 1e3)
+        self.trace.emit("snapshot", None, step=info["step"],
+                        ms=round(info["ms"], 3))
         # A one-shot capture to a foreign directory must not delay the
         # next periodic home-directory snapshot.
         if (self.snapshot_dir is not None
@@ -1123,8 +1153,16 @@ class ServeEngine:
                     "prompt": [int(x) for x in np.asarray(rs.req.prompt)],
                     "params": rs.req.params.to_dict(),
                     "arrival": rs.req.arrival_time,
+                    # carried explicitly: the windowed tts None-pads its
+                    # head on long streams, so "first retained ts" would
+                    # inflate a restored TTFT by the whole decode
+                    "ftt": rs.metrics.first_token_time,
                     "toks": [int(t) for t in out.token_ids],
-                    "tts": list(rs.metrics.token_times),
+                    # time_at: the bounded window's base shifts on long
+                    # streams — never index the raw list (None pads
+                    # forgotten entries, keeping toks[i] <-> tts[i])
+                    "tts": [rs.metrics.time_at(i)
+                            for i in range(len(out.token_ids))],
                     "reason": out.finish_reason.value,
                     "err": out.error,
                     "fts": rs.metrics.finish_time,
@@ -1134,12 +1172,12 @@ class ServeEngine:
                     "t": "submit", "rid": rid,
                     "prompt": [int(x) for x in np.asarray(rs.req.prompt)],
                     "params": rs.req.params.to_dict(),
-                    "ts": rs.req.arrival_time})
-                times = rs.metrics.token_times
+                    "ts": rs.req.arrival_time,
+                    "ftt": rs.metrics.first_token_time})
                 for i, t in enumerate(rs.generated):
                     recs.append({
                         "t": "tok", "rid": rid, "i": i, "tok": int(t),
-                        "ts": times[i] if i < len(times) else None})
+                        "ts": rs.metrics.time_at(i)})
         self._journal.rewrite(recs)
         self._journal_floor = self._journal.file_bytes
         self.metrics.journal_rotations += 1
@@ -1170,7 +1208,31 @@ class ServeEngine:
         unwinding the step; batched decode failures retry then bisect
         (:meth:`_forward_contained`); a failed speculative round latches
         speculation off and degrades to plain decode.  Only ``_FATAL``
-        (watchdog trips, interrupts) escapes."""
+        (watchdog trips, interrupts) escapes.
+
+        Observability wrapper: the step's wall time feeds the SLO
+        histogram, new fault-injector audit entries mirror into the
+        flight recorder each iteration, and ANYTHING escaping the step —
+        an :class:`runtime.faults.InjectedKill` standing in for process
+        death, a watchdog trip, an escalated containment failure — first
+        flushes the ring to ``flight_<step>.json`` so the supervisor and
+        the chaos harness get a postmortem trail (docs/observability.md;
+        the re-raise is unconditional — this is a flight recorder, not a
+        containment path)."""
+        t0 = time.perf_counter()
+        try:
+            out = self._step_inner()
+        except BaseException as e:
+            self._trace_faults()
+            self.trace.emit("fault", None, point="crash",
+                            kind=type(e).__name__)
+            self.flight_flush(f"crash: {type(e).__name__}", force=True)
+            raise
+        self._trace_faults()
+        self.metrics.hist_step.observe(time.perf_counter() - t0)
+        return out
+
+    def _step_inner(self) -> list[RequestOutput]:
         self._beat()
         if self._journal is not None:
             # Group-commit deadline sweep: an fsync interval is only
@@ -1182,6 +1244,7 @@ class ServeEngine:
             # The audit log stamps every firing with the engine step so
             # a chaos schedule replays deterministically post-mortem.
             self.faults.set_step(self.metrics.steps)
+        self.trace.set_step(self.metrics.steps)
         now = self._clock()
         finished: list[RequestOutput] = []
 
@@ -1199,6 +1262,16 @@ class ServeEngine:
         free = [i for i, s in enumerate(self.slots) if s is None]
         for rs in self.scheduler.admit(free, now):
             self.slots[rs.slot] = rs
+            self.trace.emit("admit", rs.req.request_id, slot=rs.slot,
+                            cached_prefix=rs.cached_prefix)
+            # once per request: first_scheduled_time is first-write-wins,
+            # so a preempted request's re-admissions would re-observe the
+            # ORIGINAL wait and inflate the queue SLO exactly under the
+            # overload it exists to diagnose
+            qt = rs.metrics.queue_time
+            if qt is not None and not rs.metrics.queue_observed:
+                rs.metrics.queue_observed = True
+                self.metrics.hist_queue.observe(qt)
             if rs.cached_prefix > 0:
                 self.metrics.prefix_hits += 1
                 self.metrics.prefix_hit_tokens += rs.cached_prefix
@@ -1334,6 +1407,10 @@ class ServeEngine:
         # programs are warmed by direct dispatch below instead.
         saved_pc = self.bm.prefix_cache
         self.bm.prefix_cache = False
+        # dummy traffic must not pollute the flight recorder either —
+        # a production ring starting with __warmup_ lifecycles would
+        # waste its bounded capacity on events nobody can act on
+        saved_lvl, self.trace.level = self.trace.level, 0
         try:
             with guard:
                 prev, round_ = -1, 0
@@ -1451,6 +1528,7 @@ class ServeEngine:
         finally:
             self._in_warmup = False
             self.bm.prefix_cache = saved_pc
+            self.trace.level = saved_lvl
             self.metrics = saved
         dt = time.perf_counter() - t0
         fresh = self.metrics.compile_misses - misses0
@@ -1616,6 +1694,9 @@ class ServeEngine:
             rs.prefill_pos += c
             n_last = c
             self.metrics.prefill_tokens += c
+            if self.trace.level >= 2:
+                self.trace.emit("prefill_chunk", rs.req.request_id,
+                                n=c, pos=rs.prefill_pos)
         if rs.prefill_pos < S0:
             return None
         return self._finish_prefill(rs, logits, n_last, now)
@@ -1642,6 +1723,7 @@ class ServeEngine:
         rs.scratch = None
         rs.kv_len = S0
         rs.status = Status.RUNNING
+        self.trace.emit("prefill_done", rid, kv_len=S0)
         self._commit_full_blocks(rs)
         last = logits[:, n_last - 1]                       # [1, V]
         if self.spec_k and not self._spec_off:
@@ -1794,7 +1876,14 @@ class ServeEngine:
             now = self._clock()
         rs.generated.append(token)
         rs.pending_token = token
-        rs.metrics.on_token(now)
+        first = rs.metrics.first_token_time is None
+        itl = rs.metrics.on_token(now)
+        if first:
+            ttft = rs.metrics.ttft
+            if ttft is not None:
+                self.metrics.hist_ttft.observe(ttft)
+        elif itl is not None:
+            self.metrics.hist_itl.observe(itl)
         if self._journal_on(rs.req.request_id):
             # The journal append PRECEDES the on_token callback: a crash
             # in between re-derives nothing (the token is durable) and
@@ -1849,7 +1938,52 @@ class ServeEngine:
                             error=error)
         self._outputs[rs.req.request_id] = out
         self.metrics.observe_finish(rs.req.request_id, rs.metrics, reason)
+        self.trace.emit("retire", rs.req.request_id,
+                        reason=reason.value, n_tokens=len(rs.generated))
         return out
+
+    # -- flight recorder plumbing ----------------------------------------
+
+    def _trace_faults(self) -> None:
+        """Mirror NEW fault-injector audit entries into the ring (one
+        ``fault`` event per firing, same (point, call, kind, who, step)
+        tuple) — by construction every audit entry has a matching event,
+        which is exactly what the completeness test cross-checks."""
+        if self.faults is None or self.trace.level <= 0:
+            return
+        fired = self.faults.fired
+        for point, call, kind, who, step in fired[self._trace_fault_idx:]:
+            self.trace.emit("fault", who, point=point, call=call,
+                            kind=kind, at_step=step)
+        self._trace_fault_idx = len(fired)
+
+    def flight_flush(self, reason: str,
+                     force: bool = False) -> Optional[str]:
+        """Write the event ring to ``flight_<step>.json`` — the
+        postmortem trail.  Directory preference: the snapshot dir FIRST
+        (the supervisor's postmortem globs exactly there — a
+        ``TDT_DUMP_IR``-first rule would silently divert the trail the
+        moment the IR switch is armed), else ``TDT_DUMP_IR``; no-op
+        without either or with tracing off.  Throttled to one file per engine step so a quarantine
+        storm cannot turn the fault path into an I/O loop.  Best-effort:
+        a failing flush must never mask the fault being recorded."""
+        if self.trace.level <= 0:
+            return None
+        d = self.snapshot_dir or ir_dump.dump_dir()
+        if d is None or (not force
+                         and self.trace.step == self._last_flight_step):
+            return None
+        self._last_flight_step = self.trace.step
+        try:
+            from triton_dist_tpu.serve.metrics import format_statline
+
+            statline = format_statline(self.metrics.light_summary())
+        except Exception:  # noqa: BLE001 — crash-path best effort
+            statline = None
+        try:
+            return self.trace.flush(d, reason=reason, statline=statline)
+        except Exception:  # noqa: BLE001 — crash-path best effort
+            return None
 
     # -- failure containment ---------------------------------------------
 
@@ -1898,8 +2032,11 @@ class ServeEngine:
         self.metrics.quarantined += 1
         print(f"[serve] {rs.req.request_id}: quarantined — {msg}",
               file=sys.stderr)
-        return self._retire(rs, FinishReason.ERROR,
-                            free=rs.slot is not None, error=msg)
+        out = self._retire(rs, FinishReason.ERROR,
+                           free=rs.slot is not None, error=msg)
+        self._trace_faults()
+        self.flight_flush(f"quarantine: {rs.req.request_id}")
+        return out
 
     # Decode-loop device programs: their dispatches count toward
     # metrics.dispatches (summary()["decode"] — the denominator of
@@ -1941,6 +2078,8 @@ class ServeEngine:
             return run_with_watchdog(call, self.step_timeout_s, name=op)
         except WatchdogTimeout:
             self.metrics.watchdog_trips += 1
+            self.trace.emit("fault", None, point="watchdog", op=op)
+            self.flight_flush(f"watchdog: {op}")
             raise
 
     def _forward_contained(self, rows: list[ReqState], runner, kind: str,
@@ -2019,6 +2158,9 @@ class ServeEngine:
                 self._preempt(victim)
 
     def _preempt(self, victim: ReqState) -> None:
+        self.trace.emit("preempt", victim.req.request_id,
+                        kv_len=victim.kv_len,
+                        generated=len(victim.generated))
         self.slots[victim.slot] = None
         victim.scratch = None
         self.scheduler.preempt(victim)
@@ -2043,6 +2185,8 @@ class ServeEngine:
             if self.bm.ref_of(table[logical]) <= 1:
                 continue
             old, new = self.bm.cow(rid, logical)
+            self.trace.emit("cow_split", rid, old=old, new=new,
+                            logical=logical)
             self._pools = self._device_call(
                 "cow_copy", (rid,), self._cow_fn, self._pools,
                 jnp.int32(old), jnp.int32(new))
@@ -2159,6 +2303,7 @@ class ServeEngine:
         self._pools = pools
         self.metrics.decode_steps += 1
         self.metrics.host_syncs += 1
+        toks0 = self.metrics.decode_tokens
 
         for rs in rows:
             if rs.status is not Status.RUNNING:
@@ -2177,6 +2322,8 @@ class ServeEngine:
             self.metrics.decode_tokens += 1
             if out is not None:
                 finished.append(out)
+        self.trace.emit("decode_drain", None, h=1, rows=len(rows),
+                        tokens=self.metrics.decode_tokens - toks0)
 
     def _decode_horizon_rows(self, rows: list[ReqState], h: int,
                              finished: list) -> None:
@@ -2304,6 +2451,7 @@ class ServeEngine:
                 self.metrics.decode_steps += steps
                 step_s = (now - t_prev) / max(steps, 1)
                 t_prev = now
+                toks0 = self.metrics.decode_tokens
                 for rs in sorted(rows, key=lambda r: r.seq):
                     if rs.status is not Status.RUNNING:
                         continue  # retired mid-drain (EOS/abort/length)
@@ -2335,6 +2483,9 @@ class ServeEngine:
                         self._commit_full_blocks(rs)
                     if out is not None:
                         finished.append(out)
+                self.trace.emit(
+                    "decode_drain", None, h=steps,
+                    tokens=self.metrics.decode_tokens - toks0)
         except (*_FATAL, ChainCommitted):
             raise
         except Exception as e:
@@ -2518,6 +2669,7 @@ class ServeEngine:
                 step_s = (now - t_prev) / max(burst, 1)
                 t_prev = now
                 round_live = False
+                toks0 = self.metrics.spec_tokens
                 for rs in sorted(live, key=lambda r: r.seq):
                     if rs.status is not Status.RUNNING:
                         continue
@@ -2562,6 +2714,9 @@ class ServeEngine:
                 if round_live:
                     self.metrics.verify_rounds += 1
                     self.metrics.spec_rounds += 1
+                    self.trace.emit(
+                        "spec_round", None, k=int(k_rung),
+                        tokens=self.metrics.spec_tokens - toks0)
         except _FATAL:
             raise
         except Exception as e:
@@ -2687,6 +2842,9 @@ class ServeEngine:
         prefill path."""
         self._spec_off = True
         self.metrics.spec_bailouts += 1
+        self.trace.emit("bailout", None, err=type(err).__name__,
+                        fused=True)
+        self.flight_flush("spec bailout (fused)")
         print(f"[serve] fused speculative chain failed ({err!r}); "
               f"speculation latched off, serving degrades to plain "
               f"decode", file=sys.stderr)
@@ -2820,6 +2978,8 @@ class ServeEngine:
                 raise  # donated pools consumed: engine-fatal
             return finished + self._spec_bailout(live, None, e)
         self.metrics.verify_rounds += 1
+        self.trace.emit("spec_round", None, k=int(max(k, 0)),
+                        rows=len(live))
 
         # Phase 2 — consume each row's closing token: one paged decode
         # step (also refreshes last_logits for the next round) + the
@@ -2875,6 +3035,9 @@ class ServeEngine:
         stay bit-exact with the fault-free run."""
         self._spec_off = True
         self.metrics.spec_bailouts += 1
+        self.trace.emit("bailout", None, err=type(err).__name__,
+                        fused=False)
+        self.flight_flush("spec bailout")
         print(f"[serve] speculative round failed ({err!r}); speculation "
               f"latched off, serving degrades to plain decode",
               file=sys.stderr)

@@ -10,9 +10,25 @@ and the process-wide ``cached_shard_jit`` stats all land in
 :meth:`ServeMetrics.summary` under ``"compilation"`` (docs/serving.md
 "Reading the compile metrics").
 
-Export rides the existing observability path (``runtime/dump.py``): with
-``TDT_DUMP_IR=<dir>`` set, :meth:`ServeMetrics.maybe_dump` writes
-``<dir>/<name>.json`` next to the kernel IR dumps — one switch arms both.
+Memory is BOUNDED for long-lived engines (docs/observability.md): the
+per-step gauge series are streaming aggregates (last/peak/mean — never
+per-step lists), per-request ``token_times`` keeps a fixed recent
+window, latency distributions live in log-bucketed
+:class:`serve.trace.LogHistogram` fields (TTFT / ITL / queue-time /
+step-time / snapshot-time with p50/p95/p99 in ``summary()``), and the
+retired-request map prunes past ``requests_retain`` — consistent with
+the journal's ``journal_retain_done`` pruning, so neither RSS nor
+``summary()`` cost grows with every request or token ever served.
+
+Export rides three paths: ``TDT_DUMP_IR=<dir>`` +
+:meth:`ServeMetrics.maybe_dump` writes ``<dir>/<name>.json`` next to the
+kernel IR dumps (one switch arms both); :meth:`ServeMetrics.to_prometheus`
+is the text exposition behind ``examples/serve.py --metrics-port``
+(served by ``serve.trace.start_metrics_server``); and
+:func:`format_stats` / :func:`format_statline` are THE human-readable
+renderings — the CLI's end-of-run block, its periodic one-liner, and the
+supervisor's postmortem line all come from here, so the stats can never
+drift between surfaces.
 """
 
 from __future__ import annotations
@@ -23,6 +39,18 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from triton_dist_tpu.runtime import dump
+from triton_dist_tpu.serve.trace import LogHistogram
+
+#: Recent token timestamps one request retains (the bounded window
+#: behind ``inter_token_latencies`` and horizon burst pacing; full
+#: distributions live in the engine-level histograms).
+TOKEN_TIMES_WINDOW = 256
+
+#: Retired requests ``ServeMetrics.requests`` keeps before pruning the
+#: oldest (per-request detail only; the aggregate counters and
+#: histograms keep counting forever).  Matches the journal's
+#: ``journal_retain_done`` default.
+REQUESTS_RETAIN = 4096
 
 
 @dataclass
@@ -33,7 +61,15 @@ class RequestMetrics:
     first_scheduled_time: Optional[float] = None
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
+    # recent token timestamps only (bounded: a long stream must not grow
+    # host memory); times_dropped counts the forgotten prefix, so
+    # n_tokens and index math stay exact
     token_times: list[float] = field(default_factory=list)
+    times_dropped: int = 0
+    # queue-time histogram guard: first_scheduled_time is first-write-
+    # wins, so only the FIRST admission's wait may feed hist_queue (a
+    # preempted request's re-admissions would re-observe the same value)
+    queue_observed: bool = False
     n_preemptions: int = 0
     # prefix cache (docs/serving.md "Prefix caching"): prompt tokens
     # covered by shared cached blocks at this request's admission — a
@@ -45,10 +81,51 @@ class RequestMetrics:
         if self.first_scheduled_time is None:
             self.first_scheduled_time = now
 
-    def on_token(self, now: float) -> None:
+    def on_token(self, now: float) -> Optional[float]:
+        """Record one emission; returns the inter-token latency this
+        token closes (``None`` for the first token) so the engine can
+        feed the ITL histogram without re-deriving it."""
+        itl = (now - self.token_times[-1]) if self.token_times else None
         if self.first_token_time is None:
             self.first_token_time = now
+            itl = None
         self.token_times.append(now)
+        extra = len(self.token_times) - TOKEN_TIMES_WINDOW
+        if extra > 0:
+            del self.token_times[:extra]
+            self.times_dropped += extra
+        return itl
+
+    @property
+    def n_tokens(self) -> int:
+        return self.times_dropped + len(self.token_times)
+
+    def seed_token_times(self, times: list, total: Optional[int] = None
+                         ) -> None:
+        """Restore-time seeding (serve/recovery.py): install journal/
+        manifest timestamps under the same bounded-window invariants
+        ``on_token`` maintains.  ``total`` is the true emission count
+        when timestamps were lost (rotation/window pruning writes
+        ``None`` pads) so ``n_tokens`` stays exact."""
+        times = [t for t in times if t is not None]
+        extra = len(times) - TOKEN_TIMES_WINDOW
+        if extra > 0:
+            del times[:extra]
+        self.token_times = times
+        n = total if total is not None else len(times)
+        self.times_dropped = max(0, n - len(times))
+        if times and self.first_token_time is None:
+            self.first_token_time = times[0]
+
+    def time_at(self, i: int) -> Optional[float]:
+        """Timestamp of emission index ``i``, or ``None`` once the
+        bounded window has dropped it (journal backfill/rotation use
+        this instead of indexing the raw list — the window's base
+        shifts)."""
+        j = i - self.times_dropped
+        if 0 <= j < len(self.token_times):
+            return self.token_times[j]
+        return None
 
     def burst_times(self, now: float, n: int, step_s: float) -> list[float]:
         """Timestamps for ``n`` tokens committed in ONE decode-horizon
@@ -75,6 +152,8 @@ class RequestMetrics:
 
     @property
     def inter_token_latencies(self) -> list[float]:
+        """Gaps within the RECENT window (full distributions live in the
+        engine-level ITL histogram)."""
         t = self.token_times
         return [b - a for a, b in zip(t, t[1:])]
 
@@ -89,7 +168,7 @@ class RequestMetrics:
             "ttft": self.ttft,
             "queue_time": self.queue_time,
             "mean_itl": self.mean_itl,
-            "n_tokens": len(self.token_times),
+            "n_tokens": self.n_tokens,
             "n_preemptions": self.n_preemptions,
             "cached_prefix_tokens": self.cached_prefix_tokens,
             "finish_time": self.finish_time,
@@ -98,7 +177,7 @@ class RequestMetrics:
 
 @dataclass
 class ServeMetrics:
-    """Engine-level counters + per-step gauge series."""
+    """Engine-level counters + streaming per-step gauges."""
 
     # counters
     steps: int = 0
@@ -172,24 +251,60 @@ class ServeMetrics:
     compiled_fns: list = field(default_factory=list, repr=False)
     warmup_time: float = 0.0
     warmup_compiles: int = 0
-    # per-step gauge series (appended by the engine each iteration)
-    queue_depth: list[int] = field(default_factory=list)
-    running: list[int] = field(default_factory=list)
-    kv_utilization: list[float] = field(default_factory=list)
-    # retired requests' metrics, keyed by request id
+    # per-step gauges as STREAMING aggregates (last / peak / running
+    # sums) — never per-step lists, so a long-lived engine's metrics
+    # stay O(1) regardless of how many steps it has served
+    queue_depth_last: int = 0
+    queue_depth_peak: int = 0
+    running_last: int = 0
+    running_sum: int = 0
+    kv_util_last: float = 0.0
+    kv_util_peak: float = 0.0
+    kv_util_sum: float = 0.0
+    # SLO latency histograms (serve/trace.LogHistogram): log-bucketed,
+    # bounded, p50/p95/p99 in summary()["latency"] and the Prometheus
+    # exposition.  TTFT/ITL/queue on the ENGINE clock; step/snapshot on
+    # wall time (the engine clock may be fake under chaos tests).
+    hist_ttft: LogHistogram = field(default_factory=LogHistogram,
+                                    repr=False)
+    hist_itl: LogHistogram = field(default_factory=LogHistogram,
+                                   repr=False)
+    hist_queue: LogHistogram = field(default_factory=LogHistogram,
+                                     repr=False)
+    hist_step: LogHistogram = field(default_factory=LogHistogram,
+                                    repr=False)
+    hist_snapshot: LogHistogram = field(default_factory=LogHistogram,
+                                        repr=False)
+    # flight recorder (serve/trace.FlightRecorder) the engine attaches
+    # so the exposition can report ring occupancy
+    recorder: object = field(default=None, repr=False)
+    # retired requests' metrics, keyed by request id; pruned oldest-first
+    # past requests_retain (None keeps everything — unit-test mode)
     requests: dict = field(default_factory=dict)
+    requests_retain: Optional[int] = REQUESTS_RETAIN
 
     def observe_step(self, *, queue_depth: int, running: int,
                      kv_utilization: float) -> None:
         self.steps += 1
-        self.queue_depth.append(queue_depth)
-        self.running.append(running)
-        self.kv_utilization.append(kv_utilization)
+        self.queue_depth_last = queue_depth
+        if queue_depth > self.queue_depth_peak:
+            self.queue_depth_peak = queue_depth
+        self.running_last = running
+        self.running_sum += running
+        self.kv_util_last = kv_utilization
+        self.kv_util_sum += kv_utilization
+        if kv_utilization > self.kv_util_peak:
+            self.kv_util_peak = kv_utilization
 
     def observe_finish(self, request_id: str, rm: RequestMetrics,
                        reason=None) -> None:
         self.completed += 1
         self.requests[request_id] = rm
+        if self.requests_retain is not None:
+            # dict preserves insertion order: drop the oldest retirement
+            # (O(overflow) per finish — never materialize the whole map)
+            while len(self.requests) > self.requests_retain:
+                del self.requests[next(iter(self.requests))]
         if reason is not None:
             key = getattr(reason, "value", str(reason))
             self.finish_reasons[key] = self.finish_reasons.get(key, 0) + 1
@@ -264,6 +379,11 @@ class ServeMetrics:
         :meth:`summary` (the engine calls this at construction)."""
         self.block_manager = bm
 
+    def attach_recorder(self, recorder) -> None:
+        """Track the engine's flight recorder so the exposition reports
+        ring occupancy/drops alongside the counters."""
+        self.recorder = recorder
+
     def prefix_stats(self) -> dict:
         """Admission-level hit counters + block-level cache gauges +
         the warm/cold TTFT split (summary()["prefix_cache"]).  A warm
@@ -310,6 +430,35 @@ class ServeMetrics:
                                      if self.decode_tokens else 0.0),
         }
 
+    def latency_stats(self) -> dict:
+        """The SLO histograms' percentile view (summary()["latency"]):
+        p50/p95/p99 + mean + count for TTFT, ITL, queue wait, step wall
+        time, and snapshot capture time — the bounded replacement for
+        per-request latency lists (docs/observability.md)."""
+        return {
+            "ttft": self.hist_ttft.stats(),
+            "itl": self.hist_itl.stats(),
+            "queue": self.hist_queue.stats(),
+            "step": self.hist_step.stats(),
+            "snapshot": self.hist_snapshot.stats(),
+        }
+
+    def light_summary(self) -> dict:
+        """Just the fields :func:`format_statline` reads — O(1) scalars
+        and histogram scans, never the per-request map that the full
+        :meth:`summary` materializes (up to ``requests_retain`` dicts).
+        The ``--stats-every`` periodic line and every ``flight_flush``
+        use this, so per-step logging and the quarantine path stay
+        cheap."""
+        return {
+            "steps": self.steps,
+            "completed": self.completed,
+            "max_queue_depth": self.queue_depth_peak,
+            "peak_kv_utilization": self.kv_util_peak,
+            "decode": self.decode_stats(),
+            "latency": self.latency_stats(),
+        }
+
     # -- compilation observability ---------------------------------------
 
     def register_compiled(self, counter) -> None:
@@ -343,10 +492,26 @@ class ServeMetrics:
 
     def summary(self) -> dict:
         """Aggregate view (what the CLI prints and maybe_dump writes)."""
-        ttfts = [m.ttft for m in self.requests.values()
-                 if m.ttft is not None]
-        itls = [x for m in self.requests.values()
-                for x in m.inter_token_latencies]
+        # TTFT/ITL means from the engine-level histograms (exact
+        # sum/count over EVERY request ever served — the requests map
+        # prunes past requests_retain, so deriving from it would
+        # silently turn into a recent-window mean on long-lived
+        # engines); the per-request fallbacks serve metrics objects fed
+        # outside an engine (unit tests, hand-built summaries).
+        if self.hist_ttft.count:
+            mean_ttft = self.hist_ttft.mean
+            max_ttft = self.hist_ttft.max
+        else:
+            ttfts = [m.ttft for m in self.requests.values()
+                     if m.ttft is not None]
+            mean_ttft = sum(ttfts) / len(ttfts) if ttfts else None
+            max_ttft = max(ttfts, default=None) if ttfts else None
+        if self.hist_itl.count:
+            mean_itl = self.hist_itl.mean
+        else:
+            itls = [x for m in self.requests.values()
+                    for x in m.inter_token_latencies]
+            mean_itl = sum(itls) / len(itls) if itls else None
         return {
             "steps": self.steps,
             "decode_steps": self.decode_steps,
@@ -354,16 +519,16 @@ class ServeMetrics:
             "prefill_tokens": self.prefill_tokens,
             "preemptions": self.preemptions,
             "completed": self.completed,
-            "max_queue_depth": max(self.queue_depth, default=0),
-            "mean_running": (sum(self.running) / len(self.running)
-                             if self.running else 0.0),
-            "peak_kv_utilization": max(self.kv_utilization, default=0.0),
-            "mean_kv_utilization": (sum(self.kv_utilization)
-                                    / len(self.kv_utilization)
-                                    if self.kv_utilization else 0.0),
-            "mean_ttft": sum(ttfts) / len(ttfts) if ttfts else None,
-            "max_ttft": max(ttfts, default=None) if ttfts else None,
-            "mean_itl": sum(itls) / len(itls) if itls else None,
+            "max_queue_depth": self.queue_depth_peak,
+            "mean_running": (self.running_sum / self.steps
+                             if self.steps else 0.0),
+            "peak_kv_utilization": self.kv_util_peak,
+            "mean_kv_utilization": (self.kv_util_sum / self.steps
+                                    if self.steps else 0.0),
+            "mean_ttft": mean_ttft,
+            "max_ttft": max_ttft,
+            "mean_itl": mean_itl,
+            "latency": self.latency_stats(),
             "decode": self.decode_stats(),
             "spec": self.spec_stats(),
             "failures": self.failure_stats(),
@@ -373,6 +538,79 @@ class ServeMetrics:
             "requests": {rid: m.to_dict()
                          for rid, m in self.requests.items()},
         }
+
+    # -- Prometheus text exposition ---------------------------------------
+
+    def to_prometheus(self) -> str:
+        """The engine's live state in the Prometheus text format
+        (version 0.0.4) — served by ``serve.trace.start_metrics_server``
+        behind ``examples/serve.py --metrics-port``.  Metric names are
+        documented in docs/observability.md; counters end ``_total``,
+        histograms expose cumulative ``_bucket{le=}`` + ``_sum`` +
+        ``_count``."""
+        L: list[str] = []
+
+        def counter(name, v, help_=None):
+            if help_:
+                L.append(f"# HELP {name} {help_}")
+            L.append(f"# TYPE {name} counter")
+            L.append(f"{name} {v}")
+
+        def gauge(name, v, help_=None):
+            if help_:
+                L.append(f"# HELP {name} {help_}")
+            L.append(f"# TYPE {name} gauge")
+            L.append(f"{name} {v}")
+
+        counter("serve_steps_total", self.steps,
+                "engine scheduler iterations")
+        counter("serve_decode_steps_total", self.decode_steps)
+        counter("serve_decode_tokens_total", self.decode_tokens)
+        counter("serve_prefill_tokens_total", self.prefill_tokens)
+        counter("serve_dispatches_total", self.dispatches,
+                "decode-path device dispatches")
+        counter("serve_host_syncs_total", self.host_syncs)
+        counter("serve_completed_total", self.completed,
+                "requests retired (any reason)")
+        counter("serve_preemptions_total", self.preemptions)
+        counter("serve_shed_total", self.shed)
+        counter("serve_deadline_expired_total", self.deadline_expired)
+        counter("serve_quarantined_total", self.quarantined)
+        counter("serve_callback_errors_total", self.callback_errors)
+        counter("serve_forward_retries_total", self.forward_retries)
+        counter("serve_forward_bisections_total", self.forward_bisections)
+        counter("serve_watchdog_trips_total", self.watchdog_trips)
+        counter("serve_spec_bailouts_total", self.spec_bailouts)
+        counter("serve_spec_proposed_total", self.spec_proposed)
+        counter("serve_spec_accepted_total", self.spec_accepted)
+        counter("serve_snapshots_total", self.snapshots)
+        counter("serve_journal_records_total", self.journal_records)
+        counter("serve_journal_rotations_total", self.journal_rotations)
+        counter("serve_prefix_hits_total", self.prefix_hits)
+        counter("serve_prefix_skipped_tokens_total",
+                self.prefix_skipped_tokens)
+        L.append("# TYPE serve_finished_total counter")
+        for reason, n in sorted(self.finish_reasons.items()):
+            L.append(f'serve_finished_total{{reason="{reason}"}} {n}')
+        gauge("serve_queue_depth", self.queue_depth_last,
+              "waiting requests at the last engine step")
+        gauge("serve_running", self.running_last)
+        gauge("serve_kv_utilization", round(self.kv_util_last, 6))
+        gauge("serve_journal_bytes", self.journal_bytes)
+        gauge("serve_compile_misses", self.compile_misses)
+        if self.recorder is not None:
+            counter("serve_trace_events_total", self.recorder.emitted,
+                    "flight-recorder events emitted")
+            gauge("serve_trace_dropped", self.recorder.dropped,
+                  "events the bounded ring has forgotten")
+        for name, hist in (("serve_ttft_seconds", self.hist_ttft),
+                           ("serve_itl_seconds", self.hist_itl),
+                           ("serve_queue_time_seconds", self.hist_queue),
+                           ("serve_step_time_seconds", self.hist_step),
+                           ("serve_snapshot_seconds",
+                            self.hist_snapshot)):
+            L.extend(hist.prom_lines(name))
+        return "\n".join(L) + "\n"
 
     def maybe_dump(self, name: str = "serve_metrics") -> Optional[str]:
         """Write the summary as JSON under the IR-dump dir when
@@ -384,3 +622,115 @@ class ServeMetrics:
         path = os.path.join(directory, dump._safe(name) + ".json")
         dump._write(path, json.dumps(self.summary(), indent=2))
         return path
+
+
+# ---------------------------------------------------------------------------
+# THE stats renderings (CLI end-of-run block, periodic one-liner,
+# supervisor postmortem) — one formatter, zero drift between surfaces
+# ---------------------------------------------------------------------------
+
+
+def _ms(x) -> str:
+    return f"{x * 1e3:.2f} ms" if x is not None else "n/a"
+
+
+def format_statline(s: dict) -> str:
+    """ONE line of live engine state (the ``--stats-every`` periodic log
+    and the flight-recorder postmortem header): progress, queue
+    pressure, and the SLO percentiles that page an operator."""
+    lat = s.get("latency", {})
+    ttft = lat.get("ttft", {})
+    itl = lat.get("itl", {})
+
+    def p(h, k):
+        v = h.get(k)
+        return f"{v * 1e3:.1f}" if v is not None else "-"
+
+    return (f"step {s['steps']} | {s['completed']} done, "
+            f"{s['decode']['decode_tokens']} decode toks | "
+            f"queue {s.get('max_queue_depth', 0)} peak | "
+            f"kv {s.get('peak_kv_utilization', 0.0):.2f} peak | "
+            f"ttft p50/p95/p99 {p(ttft, 'p50')}/{p(ttft, 'p95')}/"
+            f"{p(ttft, 'p99')} ms | itl p50/p95/p99 {p(itl, 'p50')}/"
+            f"{p(itl, 'p95')}/{p(itl, 'p99')} ms")
+
+
+def format_stats(s: dict, *, spec: bool = False, prefix: bool = False,
+                 failures: bool = False, recovery: bool = False
+                 ) -> list[str]:
+    """The end-of-run stats block ``examples/serve.py`` prints — moved
+    here so every surface (CLI, supervisor, tests) renders ``summary()``
+    identically.  Sections beyond the engine/decode core are opt-in by
+    flag, matching the CLI's feature gates."""
+    lat = s["latency"]
+    lines = [
+        (f"engine metrics: mean ttft {_ms(s['mean_ttft'])}, "
+         f"mean itl {_ms(s['mean_itl'])}, max queue depth "
+         f"{s['max_queue_depth']}, peak kv util "
+         f"{s['peak_kv_utilization']:.2f}, preemptions "
+         f"{s['preemptions']}"),
+        (f"latency slo: ttft p50/p95/p99 "
+         f"{_ms(lat['ttft']['p50'])}/{_ms(lat['ttft']['p95'])}/"
+         f"{_ms(lat['ttft']['p99'])}, itl p50/p95/p99 "
+         f"{_ms(lat['itl']['p50'])}/{_ms(lat['itl']['p95'])}/"
+         f"{_ms(lat['itl']['p99'])}, step p99 "
+         f"{_ms(lat['step']['p99'])}"),
+    ]
+    d = s["decode"]
+    lines.append(
+        f"decode horizon: {d['dispatches']} dispatches / "
+        f"{d['host_syncs']} host syncs for {d['decode_tokens']} "
+        f"tokens ({d['decode_steps']} device steps) — "
+        f"{d['tokens_per_dispatch']:.2f} tokens/dispatch, "
+        f"{d['dispatches_per_token']:.3f} dispatches/token")
+    if spec:
+        sp = s["spec"]
+        lines.append(
+            f"speculative: {sp['rounds']} fused rounds, accept "
+            f"rate {sp['accept_rate']:.2f} (rolling "
+            f"{sp['rolling_accept_rate']:.2f}), chosen k "
+            f"{sp['chosen_k']}, "
+            f"{sp['spec_tokens_per_dispatch']:.2f} spec tokens/"
+            f"dispatch, {sp['bailouts']} bailouts"
+            + (f", {sp['draft_prefix_skipped_tokens']} draft "
+               f"prefill tokens skipped"
+               if sp['draft_prefix_skipped_tokens'] else ""))
+    if prefix:
+        pc = s["prefix_cache"]
+        ratio = (f", warm/cold ttft {pc['ttft_warm_over_cold']:.2f}x"
+                 if pc.get("ttft_warm_over_cold") is not None else "")
+        lines.append(
+            f"prefix cache: {pc['lookup_hits']}/{pc['lookups']} "
+            f"lookups hit, {pc['prefix_skipped_tokens']} prefill "
+            f"tokens skipped, {pc['cached_blocks']} cached / "
+            f"{pc['shared_blocks']} shared blocks, "
+            f"{pc['cow_copies']} COW, {pc['evictions']} "
+            f"evictions{ratio}")
+    if failures:
+        f = s["failures"]
+        lines.append(
+            f"failure containment: {f['shed']} shed, "
+            f"{f['deadline_expired']} expired, "
+            f"{f['quarantined']} quarantined, "
+            f"{f['callback_errors']} callback errors, "
+            f"{f['forward_retries']} retries / "
+            f"{f['forward_bisections']} bisections, "
+            f"finish reasons {f['finish_reasons']}")
+    if recovery:
+        r = s["recovery"]
+        lines.append(
+            f"crash recovery: {r['snapshots']} snapshots "
+            f"(last {r['snapshot_ms_last']:.1f} ms), "
+            f"{r['journal_records']} journal records "
+            f"({r['journal_bytes']} bytes), "
+            f"{r['restored_in_place']} resumed in place / "
+            f"{r['restored_requeued']} requeued")
+    comp = s["compilation"]
+    per = ", ".join(f"{n} {c['misses']}c/{c['hits']}h"
+                    for n, c in comp["programs"].items())
+    lines.append(f"trace cache (compiles/hits): {per}")
+    lines.append(
+        f"compile stalls: {comp['total_compile_time_s'] * 1e3:.0f} "
+        f"ms total, {comp['warmup_compiles']} programs "
+        f"({comp['warmup_time_s'] * 1e3:.0f} ms) during warmup")
+    return lines

@@ -255,6 +255,10 @@ class JournalRequest:
     arrival: Optional[float] = None
     tokens: dict = field(default_factory=dict)   # index -> (tok, ts)
     finish: Optional[dict] = None                # {"reason","err","n","ts"}
+    # first-token timestamp carried by rotation records ("ftt"): the
+    # compacted tts/ts lists None-pad their head past the bounded
+    # token-time window, so the restored TTFT needs this explicitly
+    first_tok: Optional[float] = None
 
     def token_list(self) -> list[int]:
         """Emitted tokens in order (the contiguous prefix from 0 — a gap
@@ -303,6 +307,8 @@ def replay_journal(path: str | os.PathLike) -> dict[str, JournalRequest]:
                 jr.prompt = np.asarray(rec["prompt"], np.int32)
                 jr.params = SamplingParams.from_dict(rec["params"])
                 jr.arrival = rec.get("ts")
+                if jr.first_tok is None:
+                    jr.first_tok = rec.get("ftt")
             elif t == "tok":
                 jr.tokens.setdefault(int(rec["i"]),
                                      (int(rec["tok"]), rec.get("ts")))
@@ -317,6 +323,8 @@ def replay_journal(path: str | os.PathLike) -> dict[str, JournalRequest]:
                     jr.prompt = np.asarray(rec["prompt"], np.int32)
                     jr.params = SamplingParams.from_dict(rec["params"])
                     jr.arrival = rec.get("arrival")
+                if jr.first_tok is None:
+                    jr.first_tok = rec.get("ftt")
                 tts = rec.get("tts") or []
                 for i, tok in enumerate(rec.get("toks", [])):
                     jr.tokens.setdefault(
@@ -462,6 +470,14 @@ def _capture_meta(engine, now: float, *, journal_here: bool) -> dict:
         },
         "requests": reqs,
         "outputs": outs,
+        # flight-recorder tail (serve/trace.py): the newest engine
+        # events ride every snapshot, so a restored engine's ring opens
+        # with its previous life's trail — postmortems after a restart
+        # still see what led up to the crash (tolerated absent by the
+        # reader: pre-PR-8 snapshots restore fine).
+        "flight": (engine.trace.tail(256)
+                   if getattr(engine, "trace", None) is not None
+                   else []),
     }
 
 
@@ -801,6 +817,8 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
         if len(toks) >= len(r.get("tokens", [])):
             r["tokens"] = toks
             r["tok_ts"] = jr.token_times()
+        if jr.first_tok is not None:
+            r.setdefault("first_tok", jr.first_tok)
         if jr.finish is not None:
             r["finish"] = jr.finish
     # A rid only ever seen as a finish/token record (its submit line was
@@ -837,10 +855,14 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
         r = resolved[rid]
         rm = RequestMetrics(
             arrival_time=_shift(r["arrival"], offset) or 0.0)
-        rm.token_times = [_shift(t, offset)
-                          for t in (r.get("tok_ts") or []) if t is not None]
-        if rm.token_times:
-            rm.first_token_time = rm.token_times[0]
+        # explicit first-token stamp BEFORE seeding: a rotated journal's
+        # tts None-pads its head past the bounded window, and seeding
+        # from the first RETAINED stamp would inflate the restored TTFT
+        # by the whole decode (seed_token_times only fills a None)
+        rm.first_token_time = _shift(r.get("first_tok"), offset)
+        rm.seed_token_times(
+            [_shift(t, offset) for t in (r.get("tok_ts") or [])],
+            total=len(r["tokens"]))
         rm.finish_time = finish_ts
         req = Request(rid, r["prompt"], r["params"],
                       arrival_time=rm.arrival_time)
@@ -959,11 +981,13 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
         rm = RequestMetrics(
             arrival_time=_shift(r["arrival"], offset) or engine._clock())
         rm.first_scheduled_time = _shift(mr.get("first_sched"), offset)
-        rm.first_token_time = _shift(mr.get("first_tok"), offset)
-        rm.token_times = [_shift(t, offset) for t in (r.get("tok_ts") or [])
-                          if t is not None]
-        if rm.token_times and rm.first_token_time is None:
-            rm.first_token_time = rm.token_times[0]
+        ft = mr.get("first_tok")
+        if ft is None:
+            ft = r.get("first_tok")   # rotated-journal "ftt" record
+        rm.first_token_time = _shift(ft, offset)
+        rm.seed_token_times(
+            [_shift(t, offset) for t in (r.get("tok_ts") or [])],
+            total=len(r["tokens"]))
         rm.n_preemptions = mr.get("n_preempt", 0)
         req = Request(rid, r["prompt"], r["params"],
                       arrival_time=rm.arrival_time,
@@ -1080,10 +1104,11 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
             if jr is None or jr.prompt is None:
                 engine._journal.submit(rs.req)
             have = len(jr.token_list()) if jr is not None else 0
-            times = rs.metrics.token_times
             for i in range(have, len(rs.generated)):
-                ts = times[i] if i < len(times) else engine._clock()
-                engine._journal.token(rid, i, rs.generated[i], ts)
+                ts = rs.metrics.time_at(i)
+                engine._journal.token(rid, i, rs.generated[i],
+                                      engine._clock() if ts is None
+                                      else ts)
             if (rs.status is Status.FINISHED
                     and (jr is None or jr.finish is None)):
                 out = engine._outputs[rid]
@@ -1114,5 +1139,14 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
             for tok in engine._states[rid].generated:
                 cb(rid, tok)
 
+    # -- flight-recorder provenance ---------------------------------------
+    # The snapshot's ring tail seeds the restored recorder (the previous
+    # life's trail precedes this life's events), and the restore itself
+    # is an event: a later postmortem shows the lineage.
+    if meta is not None and meta.get("flight"):
+        engine.trace.seed(meta["flight"])
+    engine.trace.emit("restore", None, in_place=m.restored_in_place,
+                      requeued=m.restored_requeued,
+                      tokens=m.restored_tokens)
     m.restores += 1
     return engine

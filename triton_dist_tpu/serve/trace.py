@@ -1,0 +1,511 @@
+"""Engine flight recorder: structured event tracing for the serving loop.
+
+Every interesting engine decision — admission, prefill chunking, horizon
+drains, spec rounds, COW splits, preemption, quarantine, bailout,
+snapshot — used to happen invisibly inside the step loop; diagnosing a
+tail-latency spike or a chaos-test failure meant re-running under a
+debugger.  This module makes the engine's timeline a first-class
+artifact, three ways:
+
+- **Ring buffer** (:class:`FlightRecorder`): a bounded deque of typed,
+  timestamped events, each carrying the PR 5 monotonic step index, the
+  request id(s) involved, and a small payload (chunk size, chosen k,
+  accept count, blocks touched).  Hot-path discipline: ``emit`` is an
+  append to a bounded ring — no device sync, no I/O, no string
+  formatting — and a single ``level`` knob gates it off entirely
+  (``bench_serve --trace`` measures the overhead; ``PERF_FLOORS.json``
+  holds ``serve_trace_overhead`` >= 0.95).
+
+- **Perfetto export** (:meth:`FlightRecorder.to_perfetto`): per-request
+  lifecycle *spans* (queue → prefill → decode, re-opened across
+  preemptions) reconstructed from the event stream as a Chrome trace,
+  pid-namespaced so :func:`runtime.profiling.merge_rank_traces` merges
+  the engine timeline with the device profiler's into ONE
+  ui.perfetto.dev view (:meth:`export_profile` drops the file where the
+  merge globs it).
+
+- **Postmortem flush** (:meth:`FlightRecorder.flush`): on any
+  fault/quarantine/watchdog/crash path the engine writes the ring to
+  ``flight_<step>.json`` (under ``TDT_DUMP_IR`` or the snapshot dir) so
+  the PR 5 supervisor and the chaos harness get a trail; the tail of
+  the ring also rides snapshots (serve/recovery.py), so a restored
+  engine carries its previous life's provenance.
+
+The taxonomy is CLOSED over the engine's failure surface: every
+:class:`serve.request.FinishReason` retires through a ``retire`` event
+(:data:`RETIRE_REASONS`), and every ``runtime/faults.py`` injection
+point lands in the ring as a ``fault`` event
+(:data:`FAULT_POINT_EVENTS`) — a meta-test cross-checks both sets
+against the source so a new failure path cannot silently skip the
+recorder.  See docs/observability.md for the event reference and the
+Perfetto recipe.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import math
+import os
+import time
+from collections import deque
+from typing import Optional
+
+# ---------------------------------------------------------------------------
+# Event taxonomy
+# ---------------------------------------------------------------------------
+
+#: Every event type the recorder may emit (docs/observability.md).
+EVENT_TYPES = frozenset({
+    "submit",         # request entered the engine (or was shed at the door)
+    "admit",          # WAITING -> PREFILL: slot + blocks granted
+    "prefill_chunk",  # one chunked-prefill dispatch (level >= 2 only)
+    "prefill_done",   # prompt fully prefilled; row joins the decode batch
+    "decode_drain",   # one decode drain (single-step batch or horizon link)
+    "spec_round",     # one fused speculative round drained
+    "preempt",        # LIFO eviction back to the waiting queue
+    "cow_split",      # copy-on-write block split before a shared-page write
+    "evict",          # prefix-cache tier block reclaimed under pressure
+    "snapshot",       # durable engine capture published
+    "restore",        # engine rebuilt from snapshot + journal
+    "fault",          # an injected/contained/engine-level failure seam fired
+    "bailout",        # speculative chain failed; spec latched off
+    "retire",         # request finished (reason = any FinishReason value)
+})
+
+#: FinishReason values the ``retire`` event is specified over — the
+#: meta-test asserts every ``serve.request.FinishReason`` member is here,
+#: so a new retirement reason must be registered with the recorder.
+RETIRE_REASONS = frozenset({
+    "length", "eos", "abort", "deadline", "shed", "error",
+})
+
+#: Every ``FaultInjector`` point (plus the engine-level seams that fire
+#: without the injector) mapped to the event type that records it.  The
+#: meta-test greps the source tree for ``.fire("<point>"`` calls and
+#: asserts each point appears here.
+FAULT_POINT_EVENTS = {
+    "forward": "fault",       # engine device-dispatch seam
+    "block_alloc": "fault",   # BlockManager.ensure grow path
+    "callback": "fault",      # the on_token invocation seam
+    "clock": "fault",         # wrap_clock readings (skew)
+    "snapshot": "fault",      # the two snapshot crash windows
+    "watchdog": "fault",      # step watchdog trip (engine-level, no
+                              # injector point — WatchdogTimeout)
+    "crash": "fault",         # anything escaping step() (InjectedKill,
+                              # escalations, interrupts)
+}
+
+#: pid the engine timeline claims in exported Chrome traces.  Below the
+#: Linux pid cap (4194304) so :func:`runtime.profiling.merge_rank_traces`'s
+#: per-rank re-namespacing (rank * 10_000_000 + pid) stays injective
+#: against real process pids.
+ENGINE_PID = 3_999_999
+
+
+# ---------------------------------------------------------------------------
+# Log-bucketed histograms (the bounded replacement for per-request
+# latency lists)
+# ---------------------------------------------------------------------------
+
+
+class LogHistogram:
+    """Log-bucketed scalar histogram: O(buckets) memory regardless of
+    sample count, percentiles within one bucket's relative width.
+
+    Buckets span ``[lo, hi)`` with ``per_decade`` buckets per decade
+    (default 24 → ~10% wide, so p50/p95/p99 land within ~5% of numpy's
+    on the same samples — pinned by tests/test_serve_trace.py).  Values
+    below ``lo`` (including 0 and negatives — fake test clocks produce
+    them) land in the underflow bucket; values past ``hi`` in the
+    overflow bucket.  ``sum``/``count``/``min``/``max`` track exact
+    values, so the mean is exact even though percentiles are bucketed.
+    """
+
+    def __init__(self, lo: float = 1e-6, hi: float = 4000.0,
+                 per_decade: int = 24):
+        if lo <= 0 or hi <= lo:
+            raise ValueError(f"need 0 < lo < hi, got {lo}, {hi}")
+        self.lo = lo
+        self.per_decade = per_decade
+        self._log_lo = math.log10(lo)
+        n = int(math.ceil(math.log10(hi / lo) * per_decade))
+        # counts[0] = underflow (< lo); counts[1 + i] covers
+        # [edge(i), edge(i + 1)); counts[-1] = overflow (>= hi)
+        self.counts = [0] * (n + 2)
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def edge(self, i: int) -> float:
+        """Upper edge of bucket ``i`` (0-based over the log range)."""
+        return self.lo * 10.0 ** ((i + 1) / self.per_decade)
+
+    def observe(self, x: float) -> None:
+        x = float(x)
+        self.count += 1
+        self.sum += x
+        if x < self.min:
+            self.min = x
+        if x > self.max:
+            self.max = x
+        if x < self.lo:
+            self.counts[0] += 1
+            return
+        i = 1 + int((math.log10(x) - self._log_lo) * self.per_decade)
+        self.counts[min(i, len(self.counts) - 1)] += 1
+
+    @property
+    def mean(self) -> Optional[float]:
+        return self.sum / self.count if self.count else None
+
+    def percentile(self, p: float) -> Optional[float]:
+        """Approximate p-th percentile: the geometric midpoint of the
+        bucket holding the rank (underflow reports ``min``, overflow
+        ``max`` — both exact)."""
+        if not self.count:
+            return None
+        rank = max(1, int(-(-p / 100.0 * self.count // 1)))  # ceil
+        acc = 0
+        for i, c in enumerate(self.counts):
+            acc += c
+            if acc >= rank:
+                if i == 0:
+                    return self.min
+                if i == len(self.counts) - 1:
+                    return self.max
+                hi = self.edge(i - 1)
+                lo = hi / 10.0 ** (1.0 / self.per_decade)
+                return (lo * hi) ** 0.5
+        return self.max
+
+    def stats(self) -> dict:
+        """The summary() view: count/mean plus the SLO percentiles."""
+        return {
+            "count": self.count,
+            "mean": self.mean,
+            "p50": self.percentile(50),
+            "p95": self.percentile(95),
+            "p99": self.percentile(99),
+            "max": self.max if self.count else None,
+        }
+
+    def prom_lines(self, name: str) -> list[str]:
+        """Prometheus text-exposition lines for this histogram —
+        cumulative ``_bucket{le=}`` (only the buckets traffic reached,
+        plus ``+Inf``), ``_sum`` and ``_count``."""
+        out = [f"# TYPE {name} histogram"]
+        acc = 0
+        for i, c in enumerate(self.counts[:-1]):
+            acc += c
+            if c:
+                le = self.lo if i == 0 else self.edge(i - 1)
+                out.append(f'{name}_bucket{{le="{le:.6g}"}} {acc}')
+        out.append(f'{name}_bucket{{le="+Inf"}} {self.count}')
+        out.append(f"{name}_sum {self.sum:.9g}")
+        out.append(f"{name}_count {self.count}")
+        return out
+
+
+# ---------------------------------------------------------------------------
+# The flight recorder
+# ---------------------------------------------------------------------------
+
+
+class FlightRecorder:
+    """Bounded ring of typed engine events (module docstring).
+
+    ``level`` gates the hot path: 0 records nothing (``emit`` returns
+    before touching the ring), 1 records lifecycle + failure events,
+    2 adds per-dispatch detail (``prefill_chunk``).  ``capacity`` bounds
+    memory — the ring drops its oldest events, ``dropped`` counts them.
+
+    Events are plain tuples ``(ts, step, type, rid, data)``: ``ts`` is
+    wall time (``time.monotonic`` — deliberately NOT the engine clock,
+    which chaos tests fake and the injector's ``clock`` point meters),
+    ``step`` the engine's monotonic iteration index, ``rid`` a request
+    id or ``None`` for engine-scoped events, ``data`` a small dict or
+    ``None``.
+    """
+
+    def __init__(self, capacity: int = 4096, level: int = 1,
+                 clock=time.monotonic):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.level = int(level)
+        self._clock = clock
+        self._ring: deque = deque(maxlen=capacity)
+        self.step = 0
+        self.emitted = 0
+
+    # -- hot path ---------------------------------------------------------
+
+    def set_step(self, step: int) -> None:
+        self.step = step
+
+    def emit(self, etype: str, rid: Optional[str] = None,
+             **data) -> None:
+        """Append one event — ring append only (the hot-path contract)."""
+        if self.level <= 0:
+            return
+        self.emitted += 1
+        self._ring.append((self._clock(), self.step, etype, rid,
+                           data or None))
+
+    @property
+    def dropped(self) -> int:
+        """Events the bounded ring has already forgotten."""
+        return self.emitted - len(self._ring)
+
+    # -- views ------------------------------------------------------------
+
+    def events(self) -> list[tuple]:
+        return list(self._ring)
+
+    def tail(self, n: int = 256) -> list[list]:
+        """The newest ``n`` events, JSON-safe (rides snapshots and the
+        postmortem flush)."""
+        evs = list(self._ring)[-n:]
+        return [[float(ts), int(step), etype, rid, data]
+                for ts, step, etype, rid, data in evs]
+
+    def seed(self, events) -> None:
+        """Re-append events carried across a restore (snapshot tail) —
+        the restored engine's ring then holds its previous life's trail
+        ahead of its own events."""
+        for ev in events:
+            try:
+                ts, step, etype, rid, data = ev
+            except (TypeError, ValueError):
+                continue
+            self.emitted += 1
+            self._ring.append((float(ts), int(step), str(etype), rid,
+                               data))
+
+    # -- per-request lifecycle spans --------------------------------------
+
+    def spans(self, evs: Optional[list] = None) -> dict:
+        """Reconstruct per-request lifecycle spans from the event
+        stream: ``{rid: [(phase, t0, t1), ...]}`` with phases ``queue``
+        (submit→admit, re-opened by preemption), ``prefill``
+        (admit→prefill_done) and ``decode`` (prefill_done→retire).  A
+        phase still open at the newest event closes there (an in-flight
+        request's span is the ring's honest horizon).  ``evs`` lets a
+        caller pass ONE snapshot of the ring (``to_perfetto`` does — the
+        engine may be emitting concurrently, and two reads of the live
+        deque could disagree on which requests exist)."""
+        if evs is None:
+            evs = sorted(self._ring, key=lambda e: (e[0], e[1]))
+        if not evs:
+            return {}
+        end = evs[-1][0]
+        out: dict[str, list] = {}
+        open_: dict[str, tuple] = {}   # rid -> (phase, t0)
+
+        def close(rid, ts):
+            ph = open_.pop(rid, None)
+            if ph is not None:
+                out.setdefault(rid, []).append((ph[0], ph[1], ts))
+
+        for ts, step, etype, rid, data in evs:
+            if rid is None:
+                continue
+            if etype == "submit":
+                close(rid, ts)
+                open_[rid] = ("queue", ts)
+            elif etype == "admit":
+                close(rid, ts)
+                open_[rid] = ("prefill", ts)
+            elif etype == "prefill_done":
+                close(rid, ts)
+                open_[rid] = ("decode", ts)
+            elif etype == "preempt":
+                close(rid, ts)
+                open_[rid] = ("queue", ts)
+            elif etype == "retire":
+                close(rid, ts)
+                out.setdefault(rid, [])
+        for rid in list(open_):
+            close(rid, end)
+        return out
+
+    # -- Perfetto / Chrome trace export -----------------------------------
+
+    def to_perfetto(self) -> dict:
+        """The ring as a Chrome trace (``{"traceEvents": [...]}``):
+        one thread per request carrying its lifecycle phase spans
+        (``ph: "X"``) under a whole-request span, instants (``ph: "i"``)
+        for point events, all on :data:`ENGINE_PID` so
+        ``runtime.profiling.merge_rank_traces`` folds the engine
+        timeline into the device profiler's merged view."""
+        evs = sorted(self._ring, key=lambda e: (e[0], e[1]))
+        trace: list[dict] = [{
+            "ph": "M", "pid": ENGINE_PID, "tid": 0,
+            "name": "process_name",
+            "args": {"name": "serve engine (flight recorder)"},
+        }]
+        tids: dict[str, int] = {}
+
+        def tid_of(rid):
+            if rid not in tids:
+                tids[rid] = len(tids) + 1
+                trace.append({"ph": "M", "pid": ENGINE_PID,
+                              "tid": tids[rid], "name": "thread_name",
+                              "args": {"name": rid}})
+            return tids[rid]
+
+        def us(ts):
+            return ts * 1e6
+
+        # Whole-request spans enclose the phase spans (first event ->
+        # retire / ring horizon).
+        first: dict[str, float] = {}
+        last: dict[str, float] = {}
+        for ts, step, etype, rid, data in evs:
+            if rid is None:
+                continue
+            first.setdefault(rid, ts)
+            last[rid] = ts
+        for rid, phases in self.spans(evs).items():
+            t0, t1 = first[rid], last[rid]
+            trace.append({"ph": "X", "pid": ENGINE_PID,
+                          "tid": tid_of(rid), "cat": "request",
+                          "name": f"request {rid}", "ts": us(t0),
+                          "dur": max(us(t1) - us(t0), 1.0)})
+            for name, p0, p1 in phases:
+                trace.append({"ph": "X", "pid": ENGINE_PID,
+                              "tid": tid_of(rid), "cat": "phase",
+                              "name": name, "ts": us(p0),
+                              "dur": max(us(p1) - us(p0), 1.0)})
+        for ts, step, etype, rid, data in evs:
+            if etype in ("submit", "admit", "prefill_done"):
+                continue  # phase boundaries, already spans
+            args = {"step": step}
+            if data:
+                args.update(data)
+            trace.append({"ph": "i", "s": "t" if rid else "g",
+                          "pid": ENGINE_PID,
+                          "tid": tid_of(rid) if rid else 0,
+                          "cat": "engine", "name": etype, "ts": us(ts),
+                          "args": args})
+        return {"traceEvents": trace}
+
+    def export_perfetto(self, path: str) -> str:
+        """Write :meth:`to_perfetto` to ``path`` (gzipped when the name
+        ends ``.gz`` — the profiler's own trace format)."""
+        doc = json.dumps(self.to_perfetto(), default=str)
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        if path.endswith(".gz"):
+            with gzip.open(path, "wt") as f:
+                f.write(doc)
+        else:
+            with open(path, "w") as f:
+                f.write(doc)
+        return path
+
+    def export_profile(self, job_dir: str, rank: int = 0) -> str:
+        """Drop the engine timeline where
+        :func:`runtime.profiling.merge_rank_traces` globs per-rank
+        traces (``{job_dir}/rank{rank}/engine.trace.json.gz``) — run a
+        ``group_profile`` capture into the same ``job_dir``, call this,
+        then merge: ONE ui.perfetto.dev file holds the device timeline
+        and the engine's side by side (docs/observability.md has the
+        recipe)."""
+        out = os.path.join(job_dir, f"rank{rank}", "engine.trace.json.gz")
+        return self.export_perfetto(out)
+
+    # -- postmortem flush -------------------------------------------------
+
+    def flush(self, directory: str, *, reason: str,
+              statline: Optional[str] = None) -> str:
+        """Write the ring to ``{directory}/flight_<step>.json`` — the
+        postmortem trail for the supervisor and the chaos harness.  Only
+        called OFF the hot path (fault/quarantine/watchdog/crash seams);
+        best-effort durable (flush + fsync) so the file survives the
+        process dying right after."""
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, f"flight_{self.step}.json")
+        doc = {
+            "reason": reason,
+            "step": self.step,
+            "wall": time.time(),
+            "emitted": self.emitted,
+            "dropped": self.dropped,
+            "statline": statline,
+            "events": self.tail(self.capacity),
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f, default=str)
+            f.flush()
+            try:
+                os.fsync(f.fileno())
+            except OSError:
+                pass
+        return path
+
+
+def load_flight(path: str) -> dict:
+    """Read a :meth:`FlightRecorder.flush` postmortem file."""
+    with open(path) as f:
+        return json.load(f)
+
+
+def latest_flight(directory: str) -> Optional[str]:
+    """Newest ``flight_*.json`` under ``directory`` (what the
+    supervisor surfaces after a crash), or ``None``."""
+    try:
+        names = [n for n in os.listdir(directory)
+                 if n.startswith("flight_") and n.endswith(".json")]
+    except OSError:
+        return None
+    if not names:
+        return None
+    paths = [os.path.join(directory, n) for n in names]
+    return max(paths, key=os.path.getmtime)
+
+
+# ---------------------------------------------------------------------------
+# Live metrics endpoint (Prometheus text exposition over stdlib HTTP)
+# ---------------------------------------------------------------------------
+
+
+def start_metrics_server(metrics, port: int = 0, host: str = "127.0.0.1"):
+    """Serve ``metrics.to_prometheus()`` at ``/metrics`` from a daemon
+    thread (``examples/serve.py --metrics-port``).  Returns the server;
+    ``server.server_address[1]`` is the bound port (pass 0 to pick a
+    free one).  Stdlib only — no new dependency rides the image."""
+    import http.server
+    import threading
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — stdlib handler contract
+            if self.path.rstrip("/") in ("", "/metrics".rstrip("/"),
+                                         "/metrics"):
+                try:
+                    body = metrics.to_prometheus().encode()
+                except Exception as e:  # noqa: BLE001 — the endpoint
+                    # must answer even when a gauge source is mid-update
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(repr(e).encode())
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+        def log_message(self, *args):  # quiet: the engine's stdout is
+            pass                       # the serving log
+
+    srv = http.server.ThreadingHTTPServer((host, port), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="serve-metrics")
+    t.start()
+    return srv
