@@ -441,6 +441,23 @@ def _bench_serve_trace():
     return r["serve_trace_overhead"]
 
 
+def _bench_serve_fleet():
+    """Fleet chaos guardrail (scripts/bench_serve.py bench_fleet): N=2
+    replicas behind the router, one killed mid-decode — the fraction of
+    streams finishing bit-identical to the single-engine oracle with an
+    exactly-once delivery record across the kill + migration + restart.
+    A correctness guardrail wearing a bench harness (like
+    serve_spec_speedup's >= 1.0): the PERF_FLOORS.json
+    ``serve_fleet_zero_loss`` floor is 1.0 — anything below it means
+    the fleet lost or duplicated tokens.  Returns (zero_loss,
+    fleet_toks_per_s)."""
+    from scripts.bench_serve import bench_fleet
+
+    r = bench_fleet(n_replicas=2, batch=4, prompt_len=16,
+                    new_tokens=32, dim=32)
+    return r["serve_fleet_zero_loss"], r["fleet_toks_per_s"]
+
+
 def check_floors(out: dict, floors: dict) -> tuple[dict, list]:
     """Per-metric guardrail (PERF_FLOORS.json, ROADMAP #5b): for each
     floor whose metric is present in ``out``, a ``vs_floor`` ratio
@@ -486,6 +503,7 @@ def main():
     serve_tps, serve_speedup = _bench_serve_engine()
     spec_speedup = _bench_serve_spec()
     trace_overhead = _bench_serve_trace()
+    fleet_zero_loss, fleet_tps = _bench_serve_fleet()
 
     peak = peak_bf16_tflops()
     vs = (tflops / peak) / REF_UTILIZATION if peak else 0.0
@@ -526,6 +544,11 @@ def main():
         # PR 8 hot-path discipline bar (>= 0.95 means the recorder's
         # ring appends cost under 5% of serving throughput).
         "serve_trace_overhead": round(trace_overhead, 3),
+        # Fleet chaos zero-loss: exact streams / total after killing one
+        # of two replicas mid-decode (live migration + restart).  1.0 or
+        # the fleet broke exactly-once — the PR 9 robustness bar.
+        "serve_fleet_zero_loss": round(fleet_zero_loss, 4),
+        "serve_fleet_toks_per_s": round(fleet_tps, 1),
         # Known-cost reference op (bare XLA dot, measured ceiling 189.7):
         # a depressed sentinel means the HOST was contended during this
         # session and `value` is a lower bound, not a regression.
@@ -557,7 +580,8 @@ def main():
           f"ring/dense {ring_ratio:.3f}; decode/xla {decode_ratio:.3f}; "
           f"serve {serve_tps:.0f} tok/s (H8/H1 {serve_speedup:.2f}x, "
           f"spec/plain {spec_speedup:.2f}x t/dispatch, "
-          f"trace {trace_overhead:.3f}x); "
+          f"trace {trace_overhead:.3f}x, "
+          f"fleet zero-loss {fleet_zero_loss:.3f}); "
           f"sentinel dot {sentinel_tflops:.1f} TFLOPS"
           + (" (CONTENDED)" if contended else ""),
           file=sys.stderr)

@@ -172,6 +172,18 @@ def parse_args():
                         "the rest map them read-only and prefill only "
                         "the residual (docs/serving.md 'Prefix "
                         "caching'; watch the prefix-cache stats line)")
+    p.add_argument("--fleet", type=int, default=None, metavar="N",
+                   help="engine mode: serve through a FleetController "
+                        "of N in-process engine replicas behind the "
+                        "queue-pressure admission router "
+                        "(docs/serving.md 'Fleet serving'); prints "
+                        "per-request placement and the fleet summary")
+    p.add_argument("--fleet-kill-step", type=int, default=None,
+                   metavar="K",
+                   help="fleet mode chaos: kill replica r0 at fleet "
+                        "step K — its in-flight requests live-migrate "
+                        "to the survivors (journal hand-off) and r0 "
+                        "restarts under exponential backoff")
     p.add_argument("--sessions", type=int, default=None, metavar="T",
                    help="engine mode: after the first drain, run T-1 "
                         "follow-up turns per request — each turn's "
@@ -181,6 +193,17 @@ def parse_args():
     args = p.parse_args()
     if args.sessions is not None and args.sessions < 1:
         p.error(f"--sessions must be >= 1, got {args.sessions}")
+    if args.fleet is not None and not args.engine:
+        p.error("--fleet is an engine-mode flag: add --engine")
+    if args.fleet is not None and args.fleet < 1:
+        p.error(f"--fleet must be >= 1, got {args.fleet}")
+    if args.fleet_kill_step is not None and args.fleet is None:
+        p.error("--fleet-kill-step needs --fleet")
+    if args.fleet is not None and (args.mixed or args.sessions
+                                   or args.shared_prompt
+                                   or args.speculative or args.resume):
+        p.error("--fleet drives plain engine traffic (no --mixed/"
+                "--sessions/--shared-prompt/--speculative/--resume)")
     if args.speculative is not None and args.speculative < 1:
         p.error(f"--speculative must be >= 1, got {args.speculative}")
     if args.spec_adaptive is not None and args.spec_adaptive < 0:
@@ -199,6 +222,108 @@ def parse_args():
         if flag is not None and not args.engine:
             p.error(f"{name} is an engine-mode flag: add --engine")
     return args
+
+
+def run_fleet(args, key):
+    """--fleet N: staggered traffic through a FleetController of N
+    in-process engine replicas — the router places by queue pressure,
+    ``--fleet-kill-step K`` kills replica r0 mid-run and its in-flight
+    requests live-migrate to the survivors (docs/serving.md "Fleet
+    serving")."""
+    import tempfile
+
+    import numpy as np
+
+    from triton_dist_tpu.models import llama
+    from triton_dist_tpu.models.generate import Generator
+    from triton_dist_tpu.runtime import dist_print
+    from triton_dist_tpu.serve import (
+        Request,
+        SamplingParams,
+        ServeEngine,
+    )
+    from triton_dist_tpu.serve.fleet import FleetController
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    rng = np.random.default_rng(args.seed)
+    lens = rng.integers(max(2, args.prompt_len // 2),
+                        2 * args.prompt_len + 1, size=args.requests)
+    max_seq = int(max(lens)) + args.new_tokens
+    max_seq += (-max_seq) % args.page_size
+    cfg = llama.LlamaConfig(vocab=256, dim=32, n_layers=2, n_heads=2,
+                            n_kv_heads=2, ffn_dim=64, max_seq=max_seq,
+                            dtype=jnp.float32)
+    params = llama.init_params(cfg, key)
+    gen = Generator(cfg, mesh, axis="sp", max_seq=max_seq)
+    page = args.page_size
+    per_req = -(-max_seq // page)
+    num_blocks = args.num_blocks or (1 + per_req * max(
+        2, args.requests // max(args.fleet, 1)))
+
+    def factory(d):
+        return ServeEngine(gen, params, num_blocks=num_blocks,
+                           page_size=page, max_batch=args.max_batch,
+                           prefill_chunk=max(8, page),
+                           horizon=args.horizon,
+                           pipeline=args.pipeline,
+                           max_queue=args.max_queue, snapshot_dir=d)
+
+    root = args.snapshot_dir or tempfile.mkdtemp(prefix="fleet_")
+    fc = FleetController(factory, args.fleet, root=root,
+                         backoff_base_s=0.05, backoff_cap_s=2.0,
+                         suspect_after_s=30.0, dead_after_s=120.0,
+                         seed=args.seed)
+    dist_print(f"fleet: {args.fleet} replicas x (pool {num_blocks} "
+               f"blocks, batch {args.max_batch}), {args.requests} "
+               f"requests under {root}")
+    params_s = SamplingParams(max_new_tokens=args.new_tokens,
+                              temperature=args.temperature,
+                              top_k=args.top_k, top_p=args.top_p,
+                              seed=args.seed, deadline_s=args.deadline)
+    reqs = [Request(f"req-{i}",
+                    rng.integers(0, cfg.vocab, size=int(lens[i]))
+                    .astype(np.int32), params_s)
+            for i in range(args.requests)]
+    t0 = time.perf_counter()
+    submitted = step = 0
+    killed = False
+    while fc.has_work() or submitted < len(reqs):
+        if step % max(args.stagger, 1) == 0 and submitted < len(reqs):
+            fc.submit(reqs[submitted])
+            submitted += 1
+        if (args.fleet_kill_step is not None and not killed
+                and step == args.fleet_kill_step):
+            killed = True
+            dist_print(f"chaos: killing replica r0 at fleet step "
+                       f"{step} (in-flight requests live-migrate)")
+            fc.kill_replica("r0", f"--fleet-kill-step {step}")
+        fc.step()
+        step += 1
+    dt = time.perf_counter() - t0
+
+    total = 0
+    for rid in sorted(fc.outputs):
+        o = fc.outputs[rid]
+        total += len(o.token_ids)
+        path = ">".join(fc.history.get(rid, []))
+        dist_print(f"{rid}: prompt {len(o.prompt)} -> "
+                   f"{len(o.token_ids)} tokens "
+                   f"({o.finish_reason.value}) via {path}")
+    s = fc.fleet_summary()
+    dist_print(f"fleet: {total} tokens / {args.requests} requests in "
+               f"{dt * 1e3:.1f} ms over {s['steps']} fleet steps — "
+               f"{s['deaths']} deaths, {s['migrations']} migrations, "
+               f"{s['pending']} pending")
+    for name, r in s["replicas"].items():
+        dist_print(f"  {name}: {r['state']}, life {r['life']} "
+                   f"({r['restarts']} restarts), "
+                   f"{r.get('completed', 0)} completed, "
+                   f"{r.get('migrated_in', 0)} migrated in / "
+                   f"{r.get('migrated_out', 0)} out")
+    moved = [r for r, h in fc.history.items() if len(set(h)) > 1]
+    if moved:
+        dist_print(f"live-migrated requests: {sorted(moved)}")
+    dist_print("done")
 
 
 def run_engine(args, key):
@@ -489,6 +614,8 @@ def main():
     from triton_dist_tpu.runtime import dist_print, initialize_distributed
 
     initialize_distributed()
+    if args.engine and args.fleet is not None:
+        return run_fleet(args, jax.random.key(args.seed))
     if args.engine:
         return run_engine(args, jax.random.key(args.seed))
     if args.shared_prompt or args.sessions:

@@ -376,6 +376,125 @@ def bench_sessions(*, n_sessions: int = 3, n_turns: int = 4,
     }
 
 
+def bench_fleet(*, n_replicas: int = 2, batch: int = 4,
+                prompt_len: int = 16, new_tokens: int = 48,
+                dim: int = 64, n_layers: int = 2, vocab: int = 256,
+                page_size: int = 16, seed: int = 0,
+                warmup: bool = True, kill_at_call: int = 20) -> dict:
+    """Fleet serving (docs/serving.md "Fleet serving"): aggregate
+    decode tokens/s at N replicas behind the router, then the chaos
+    leg — the SAME workload with one replica killed mid-decode — with
+    zero-loss verification against the single-engine oracle.
+
+    ``serve_fleet_zero_loss`` is the headline: the fraction of streams
+    that finish BIT-IDENTICAL to the oracle with an exactly-once
+    delivery record across the kill + migration + restart.  1.0 is the
+    only acceptable reading (PERF_FLOORS.json floors it there — this is
+    a correctness guardrail wearing a bench harness, like
+    serve_spec_speedup's >= 1.0).  ``chaos_recovery_s`` is the
+    wall-clock from the replica death to the fleet fully drained
+    (migration + backoff restart + remaining decode)."""
+    import shutil
+    import tempfile
+
+    from triton_dist_tpu.models import llama
+    from triton_dist_tpu.models.generate import Generator
+    from triton_dist_tpu.runtime.faults import FaultInjector
+    from triton_dist_tpu.serve import Request, SamplingParams, ServeEngine
+    from triton_dist_tpu.serve.fleet import FleetController
+
+    max_seq = prompt_len + new_tokens
+    max_seq += (-max_seq) % page_size
+    cfg = llama.LlamaConfig(vocab=vocab, dim=dim, n_layers=n_layers,
+                            n_heads=2, n_kv_heads=2, ffn_dim=2 * dim,
+                            max_seq=max_seq, dtype=jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    params = llama.init_params(cfg, jax.random.key(seed))
+    gen = Generator(cfg, mesh, axis="sp", max_seq=max_seq)
+    per_req = -(-max_seq // page_size)
+    n_reqs = n_replicas * batch
+    rng = np.random.default_rng(seed)
+    reqs = [(f"f{i}", rng.integers(0, vocab, size=prompt_len)
+             .astype(np.int32)) for i in range(n_reqs)]
+    sp = SamplingParams(max_new_tokens=new_tokens)
+
+    def make_factory(injector):
+        def factory(d):
+            faults = (injector if injector is not None
+                      and (os.sep + "r0" + os.sep) in d
+                      and d.endswith("life1") else None)
+            eng = ServeEngine(
+                gen, params, num_blocks=1 + per_req * batch,
+                page_size=page_size, max_batch=batch,
+                prefill_chunk=max(8, page_size), snapshot_dir=d,
+                faults=faults)
+            if warmup and faults is None:
+                eng.warmup()
+            return eng
+        return factory
+
+    def drive(injector):
+        root = tempfile.mkdtemp(prefix="bench_fleet_")
+        fc = FleetController(make_factory(injector), n_replicas,
+                             root=root, backoff_base_s=0.01,
+                             backoff_cap_s=0.1,
+                             suspect_after_s=1e6, dead_after_s=2e6,
+                             seed=seed)
+        t0 = time.perf_counter()
+        t_death = None
+        for rid, prompt in reqs:
+            fc.submit(Request(rid, prompt, sp))
+        while fc.has_work():
+            fc.step()
+            if t_death is None and fc.deaths:
+                t_death = time.perf_counter()
+        dt = time.perf_counter() - t0
+        toks = sum(len(o.token_ids) for o in fc.outputs.values())
+        recovery = (time.perf_counter() - t_death
+                    if t_death is not None else None)
+        streams = {rid: list(fc.streams[rid]) for rid, _ in reqs}
+        outs = {rid: list(fc.outputs[rid].token_ids)
+                for rid, _ in reqs}
+        shutil.rmtree(root, ignore_errors=True)
+        return dt, toks, fc.deaths, recovery, streams, outs
+
+    # oracle: every stream is per-request deterministic
+    oracle = {}
+    for rid, prompt in reqs:
+        eng = ServeEngine(gen, params, num_blocks=1 + per_req * batch,
+                          page_size=page_size, max_batch=batch,
+                          prefill_chunk=max(8, page_size))
+        eng.submit(Request(rid, prompt, sp))
+        oracle[rid] = list(eng.run()[rid].token_ids)
+
+    dt, toks, deaths, _, streams, outs = drive(None)
+    assert deaths == 0
+    inj = FaultInjector(seed=seed).inject("forward", kill=True,
+                                          at_call=kill_at_call)
+    cdt, ctoks, cdeaths, recovery, cstreams, couts = drive(inj)
+    # the floor is only meaningful if the kill actually landed — a
+    # workload that drains before at_call would read 1.0 vacuously
+    assert cdeaths >= 1, (
+        f"chaos leg never killed a replica (kill_at_call="
+        f"{kill_at_call} not reached); lower it or grow the workload")
+    exact = sum(1 for rid in oracle
+                if couts[rid] == oracle[rid]
+                and cstreams[rid] == oracle[rid])
+    return {
+        "mode": "fleet",
+        "replicas": n_replicas,
+        "requests": n_reqs,
+        "new_tokens": new_tokens,
+        "wall_s": round(dt, 4),
+        "fleet_toks_per_s": round(toks / dt, 1),
+        "chaos_wall_s": round(cdt, 4),
+        "chaos_deaths": cdeaths,
+        "chaos_recovery_s": (round(recovery, 4)
+                             if recovery is not None else None),
+        "serve_fleet_zero_loss": round(exact / len(oracle), 4),
+    }
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--horizons", default="1,8",
@@ -414,11 +533,34 @@ def main():
                         "should stay flat while prompts grow)")
     p.add_argument("--turns", type=int, default=4,
                    help="--sessions: turns per session")
+    p.add_argument("--fleet", type=int, default=None, metavar="N",
+                   help="fleet mode: aggregate tokens/s at N replicas "
+                        "behind the router, plus the chaos leg (one "
+                        "replica killed mid-decode) with zero-loss "
+                        "verification vs the single-engine oracle and "
+                        "the recovery wall time (docs/serving.md "
+                        "'Fleet serving'; PERF_FLOORS.json holds "
+                        "serve_fleet_zero_loss at 1.0)")
     args = p.parse_args()
     if args.sessions is not None and args.sessions < 1:
         p.error(f"--sessions must be >= 1, got {args.sessions}")
     if args.sessions is not None and args.turns < 1:
         p.error(f"--turns must be >= 1, got {args.turns}")
+    if args.fleet is not None and args.fleet < 1:
+        p.error(f"--fleet must be >= 1, got {args.fleet}")
+    if args.fleet is not None:
+        r = bench_fleet(n_replicas=args.fleet, batch=args.batch,
+                        prompt_len=args.prompt_len,
+                        new_tokens=args.new_tokens, dim=args.dim,
+                        n_layers=args.layers,
+                        page_size=args.page_size, seed=args.seed,
+                        warmup=not args.no_warmup)
+        print(json.dumps(r))
+        print(f"# fleet N={r['replicas']}: "
+              f"{r['fleet_toks_per_s']:.1f} tokens/s; chaos kill -> "
+              f"zero-loss {r['serve_fleet_zero_loss']:.3f} (floor 1.0), "
+              f"recovery {r['chaos_recovery_s']}s", file=sys.stderr)
+        return
     if args.trace:
         r = bench_trace_overhead(batch=args.batch,
                                  prompt_len=args.prompt_len,

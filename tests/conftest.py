@@ -136,6 +136,14 @@ _FAST_GATE_MODULES = {
     # fault bailout-then-bisect, and the spec snapshot/restore chaos
     # sweep (draft state resumed in place) all run in the gate.
     "test_serve_spec",
+    # fleet serving: drain/migrate_in mid-stream hand-off (in-place KV
+    # adopt + exact-recompute, mig-receipt non-resurrection, capacity
+    # admission), THE fleet chaos harness (kill a replica mid-decode —
+    # bit-exact streams, zero lost/dup tokens, cross-replica
+    # completion, router-never-routes-dead), SUSPECT circuit breaking,
+    # backoff/router units, and the supervisor arming-boundary +
+    # postmortem-dedup satellites (the whole file is the fast tier).
+    "test_serve_fleet",
 }
 
 # Heavy tests inside core modules whose coverage is duplicated by a

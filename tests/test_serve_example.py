@@ -162,6 +162,30 @@ def test_serve_supervisor_restarts(tmp_path):
     assert "completed cleanly after 1 restart(s)" in out.stdout, out.stdout
 
 
+def test_serve_engine_fleet_cli(tmp_path):
+    """--fleet N with a mid-run replica kill through the CLI: every
+    request retires with its full stream, at least one completes on a
+    different replica than it started on (the placement path printed
+    per request), and the fleet summary shows the death + migration
+    (docs/serving.md "Fleet serving")."""
+    out = _run("--engine", "--fleet", "2", "--requests", "6",
+               "--stagger", "1", "--max-batch", "2", "--page-size", "8",
+               "--fleet-kill-step", "6", "--snapshot-dir",
+               str(tmp_path / "fleet"), devices=1, new_tokens=6)
+    assert "fleet: 2 replicas" in out, out
+    assert "chaos: killing replica r0" in out, out
+    assert "fleet: 36 tokens / 6 requests" in out, out
+    assert "1 deaths" in out, out
+    assert "live-migrated requests:" in out, out
+    import re
+    reasons = re.findall(r"req-\d+: prompt \d+ -> (\d+) tokens "
+                         r"\((\w+)\) via (\S+)", out)
+    assert len(reasons) == 6, out
+    assert all(r[:2] == ("6", "length") for r in reasons), out
+    assert any(">" in r[2] for r in reasons), out
+    assert "done" in out
+
+
 def test_serve_engine_horizon():
     """--horizon: fused multi-step decode through the CLI — the decode
     stats line proves the dispatch economics (well under one dispatch

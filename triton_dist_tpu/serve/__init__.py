@@ -36,6 +36,11 @@ Layout:
   SLO histograms, the Prometheus exposition endpoint, and postmortem
   ``flight_<step>.json`` flushes on fault/crash paths
   (docs/observability.md)
+- ``fleet``      — multi-replica serving: an admission router placing
+  by queue-depth/deadline pressure, per-replica HEALTHY→SUSPECT→DEAD
+  health with circuit breaking and backoff restarts, and live request
+  migration over the journal/snapshot hand-off
+  (docs/serving.md "Fleet serving")
 """
 
 from triton_dist_tpu.serve.request import (  # noqa: F401
@@ -66,4 +71,10 @@ from triton_dist_tpu.serve.engine import (  # noqa: F401
     ChainCommitted,
     QueueFull,
     ServeEngine,
+)
+from triton_dist_tpu.serve.fleet import (  # noqa: F401
+    FleetController,
+    ReplicaState,
+    RestartBackoff,
+    Router,
 )

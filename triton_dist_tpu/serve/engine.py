@@ -1198,6 +1198,274 @@ class ServeEngine:
 
         return recovery.restore_engine(directory, gen, params, **kwargs)
 
+    # -- live migration ---------------------------------------------------
+
+    def drain(self, rids: Optional[list] = None, *,
+              include_kv: bool = True) -> dict:
+        """Migrate-out: remove ``rids`` (default: every unfinished
+        request) from this engine and return a migration manifest a
+        peer replica's :meth:`migrate_in` continues from — the
+        cooperative half of fleet live migration (docs/serving.md
+        "Fleet serving"; serve/fleet.py drives it).
+
+        Call between steps (no dispatch in flight).  Each request's
+        journal-segment view rides the manifest (prompt, params, the
+        emitted token prefix + timestamps); a plain RUNNING row with a
+        pending token additionally carries its live KV pages (gathered
+        through the warm-prefix ``load_pages`` program) so the target
+        adopts it MID-STREAM with zero recompute — the same invariant
+        the restore path's in-place resume checks.  ``include_kv=False``
+        drops the pages (every row then replays through exact recompute
+        on the target — still bit-exact, just not free).
+
+        The source journal gets one ``mig`` record per request — the
+        ownership receipt: a later restore of THIS directory never
+        resurrects a handed-off request, so the cross-replica token
+        union stays exactly-once.  The drained requests leave the
+        engine's maps entirely (they are not retirements — no output,
+        no finish accounting)."""
+        from triton_dist_tpu.serve.recovery import MANIFEST_FORMAT
+
+        if rids is None:
+            rids = [rid for rid, rs in self._states.items()
+                    if rs.status is not Status.FINISHED
+                    and not rid.startswith("__warmup_")]
+        rids = list(dict.fromkeys(rids))  # a duplicate would double-free
+        now = self._clock()
+        spec_live = bool(self.spec_k) and not self._spec_off
+        # Two phases: build EVERY record (validation + KV gather — no
+        # engine mutation, the gather only reads the pools) first, then
+        # journal the receipts and release the state.  A bad rid or a
+        # failed gather must leave the engine exactly as it was — a
+        # partially-drained engine whose receipted requests never made
+        # it into a manifest would lose their streams irrecoverably
+        # (restore skips migrated rids by design).
+        staged = []
+        for rid in rids:
+            rs = self._states.get(rid)
+            if rs is None or rs.status is Status.FINISHED:
+                raise ValueError(f"drain: {rid!r} is not an in-flight "
+                                 f"request of this engine")
+            rec = {
+                "rid": rid,
+                "prompt": [int(x) for x in np.asarray(rs.req.prompt)],
+                "params": rs.req.params.to_dict(),
+                "arrival": rs.req.arrival_time,
+                "tokens": [int(t) for t in rs.generated],
+                "tok_ts": [rs.metrics.time_at(i)
+                           for i in range(len(rs.generated))],
+                "first_tok": rs.metrics.first_token_time,
+                "first_sched": rs.metrics.first_scheduled_time,
+                "n_preempt": rs.metrics.n_preemptions,
+                "cb_off": rs.callback_disabled,
+            }
+            # In-place eligibility is the restore invariant: a plain
+            # RUNNING row between steps holds kv_len committed cache
+            # rows and ONE emitted-but-unconsumed pending token
+            # (kv_len == S0 + len(generated) - 1).  Spec rows have no
+            # pending token (their round state is slot-indexed draft
+            # caches that cannot leave this engine) — they replay.
+            if (include_kv and not spec_live
+                    and rs.status is Status.RUNNING
+                    and rs.pending_token is not None):
+                n_used = self.bm.blocks_for(rs.kv_len)
+                ext = self._bucket_s_ext(rs.kv_len)
+                ids = np.zeros((ext // self.page,), np.int32)
+                ids[:n_used] = self.bm.table(rid)[:n_used]
+                scratch = self._device_call(
+                    "load_pages", (rid,), self._load_fn, self._pools,
+                    jnp.asarray(ids))
+                rec["kv"] = [(np.asarray(k), np.asarray(v))
+                             for k, v in scratch]
+                rec["kv_len"] = rs.kv_len
+                rec["pending"] = int(rs.pending_token)
+                rec["s_ext"] = ext
+            staged.append((rid, rs, rec))
+        reqs = []
+        for rid, rs, rec in staged:
+            if self._journal_on(rid):
+                self._journal.migrate(rid, len(rs.generated), now)
+                self._note_journal()
+            self.trace.emit("migrate_out", rid,
+                            tokens=len(rs.generated),
+                            in_place="kv" in rec)
+            if rs.slot is not None:
+                self.slots[rs.slot] = None
+            if rs.status is Status.WAITING:
+                self.scheduler.waiting.remove(rs)
+            if rid in self.bm._tables:
+                self.bm.free(rid)
+            rs.scratch = None
+            rs.status = Status.FINISHED  # terminal for the old object
+            del self._states[rid]
+            self.metrics.migrated_out += 1
+            reqs.append(rec)
+        cfg = self.cfg
+        return {
+            "format": MANIFEST_FORMAT,
+            "clock": now,
+            "page_size": self.page,
+            "kv_geom": {
+                "n_layers": cfg.n_layers,
+                "n_kv_heads": cfg.n_kv_heads,
+                "head_dim": cfg.head_dim,
+                "dtype": str(np.dtype(cfg.dtype)),
+            },
+            "requests": reqs,
+            "finished": [],
+        }
+
+    def migrate_in(self, manifest: dict, *,
+                   on_token=None, replay_tokens: bool = False) -> dict:
+        """Adopt a migration manifest's requests mid-stream — the target
+        half of fleet live migration (docs/serving.md "Fleet serving").
+
+        CAPACITY ADMISSION first, per request: a request whose
+        ``prompt + max_new_tokens`` cannot ever fit this engine's
+        geometry, whose id this engine already knows, or that would land
+        on a waiting queue at ``max_queue`` is REJECTED (left for the
+        caller to place elsewhere — nothing about it is journaled
+        here).  Accepted requests split two ways:
+
+        - **adopted in place**: the manifest carries live KV + a pending
+          token, the page geometry matches, a batch slot is free, and
+          the blocks fit — the pages scatter into this engine's pools
+          (``fill_pages``), the block table is allocated fresh, and the
+          row resumes RUNNING at its exact stream position (zero
+          recompute; the Llumnix hand-off).
+        - **requeued**: everything else replays through the
+          exact-recompute admission path (``work_prompt = prompt +
+          generated``) — bit-identical by the PR 5 argument, just not
+          free.
+
+        Exactly-once: ``generated`` pre-populates from the manifest's
+        journal segment and ``journal_base`` records the carry, so this
+        engine never re-emits a carried token; the carried submit/token
+        records backfill THIS journal (the single-writer hand-off — the
+        source's journal holds the matching ``mig`` receipts).
+        ``on_token`` re-attaches streaming callbacks (one callable or a
+        ``{rid: callable}`` map); ``replay_tokens=True`` re-fires them
+        for the carried prefix.  Returns ``{"adopted", "requeued",
+        "rejected"}`` (rejected maps rid -> reason)."""
+        from triton_dist_tpu.serve.recovery import (
+            MANIFEST_FORMAT,
+            _resolve_callback,
+            _shift,
+        )
+
+        if manifest.get("format") != MANIFEST_FORMAT:
+            raise ValueError(
+                f"migration manifest format {manifest.get('format')}; "
+                f"this build reads format {MANIFEST_FORMAT}")
+        offset = self._clock() - (manifest.get("clock") or 0.0)
+        spec_live = bool(self.spec_k) and not self._spec_off
+        geom_ok = (manifest.get("page_size") == self.page
+                   and manifest.get("kv_geom") == {
+                       "n_layers": self.cfg.n_layers,
+                       "n_kv_heads": self.cfg.n_kv_heads,
+                       "head_dim": self.cfg.head_dim,
+                       "dtype": str(np.dtype(self.cfg.dtype)),
+                   })
+        adopted, requeued, rejected = [], [], {}
+        for rec in manifest.get("requests", ()):
+            rid = rec["rid"]
+            if rid in self._states:
+                rejected[rid] = "duplicate request id"
+                continue
+            params = SamplingParams.from_dict(rec["params"])
+            prompt = np.asarray(rec["prompt"], np.int32)
+            total = int(prompt.shape[0]) + params.max_new_tokens
+            if total > self.gen.max_seq:
+                rejected[rid] = (f"prompt + max_new_tokens = {total} "
+                                 f"exceeds max_seq {self.gen.max_seq}")
+                continue
+            if self.bm.blocks_for(total) > self.bm.num_allocatable:
+                rejected[rid] = (f"needs {self.bm.blocks_for(total)} "
+                                 f"blocks, pool has "
+                                 f"{self.bm.num_allocatable}")
+                continue
+            if (self.max_queue is not None
+                    and self.scheduler.queue_depth >= self.max_queue):
+                rejected[rid] = (f"queue at bound "
+                                 f"({self.scheduler.queue_depth} >= "
+                                 f"max_queue {self.max_queue})")
+                continue
+            tokens = [int(t) for t in rec.get("tokens", [])]
+            rm = RequestMetrics(
+                arrival_time=_shift(rec.get("arrival"), offset)
+                or self._clock())
+            rm.first_scheduled_time = _shift(rec.get("first_sched"),
+                                             offset)
+            rm.first_token_time = _shift(rec.get("first_tok"), offset)
+            rm.seed_token_times(
+                [_shift(t, offset) for t in (rec.get("tok_ts") or [])],
+                total=len(tokens))
+            rm.n_preemptions = rec.get("n_preempt", 0)
+            # the source already fed its queue-wait into ITS histogram;
+            # observing it again here would double-count the fleet SLO
+            rm.queue_observed = rm.first_scheduled_time is not None
+            req = Request(rid, prompt, params, arrival_time=rm.arrival_time,
+                          on_token=_resolve_callback(on_token, rid))
+            rs = ReqState(req=req, metrics=rm)
+            rs.generated = tokens
+            rs.journal_base = len(tokens)
+            rs.callback_disabled = bool(rec.get("cb_off", False))
+            # journal the carried segment BEFORE serving resumes (the
+            # restore-backfill rule: every life's journal is
+            # self-contained on its own)
+            if self._journal_on(rid):
+                self._journal.submit(req)
+                for i, t in enumerate(tokens):
+                    ts = rm.time_at(i)
+                    self._journal.token(
+                        rid, i, t,
+                        ts if ts is not None else self._clock())
+                self._note_journal()
+            in_place = (geom_ok and not spec_live
+                        and rec.get("pending") is not None
+                        and rec.get("kv") is not None
+                        and None in self.slots
+                        and rec["kv_len"] + 1 <= self.gen.max_seq
+                        and self.bm.can_allocate(rec["kv_len"] + 1))
+            self._states[rid] = rs
+            if in_place:
+                slot = self.slots.index(None)
+                self.bm.allocate(rid, rec["kv_len"] + 1)
+                n_used = self.bm.blocks_for(rec["kv_len"])
+                ids = np.zeros((rec["s_ext"] // self.page,), np.int32)
+                ids[:n_used] = self.bm.table(rid)[:n_used]
+                scratch = [(jnp.asarray(k), jnp.asarray(v))
+                           for k, v in rec["kv"]]
+                self._pools = self._device_call(
+                    "fill_pages", (rid,), self._fill_fn, self._pools,
+                    scratch, jnp.asarray(ids))
+                rs.status = Status.RUNNING
+                rs.slot = slot
+                rs.kv_len = rec["kv_len"]
+                rs.pending_token = rec["pending"]
+                rs.seq = self.scheduler._seq
+                self.scheduler._seq += 1
+                self.slots[slot] = rs
+                self.metrics.migrated_in_place += 1
+                adopted.append(rid)
+            else:
+                if tokens:
+                    rs.work_prompt = np.concatenate(
+                        [prompt, np.asarray(tokens, np.int32)])
+                rs.status = Status.WAITING
+                self.scheduler.add(rs)
+                requeued.append(rid)
+            self.metrics.migrated_in += 1
+            self.metrics.migrated_tokens += len(tokens)
+            self.trace.emit("migrate_in", rid, tokens=len(tokens),
+                            in_place=in_place)
+            if (replay_tokens and req.on_token is not None
+                    and not rs.callback_disabled):
+                for t in tokens:
+                    req.on_token(rid, t)
+        return {"adopted": adopted, "requeued": requeued,
+                "rejected": rejected}
+
     # -- the iteration ----------------------------------------------------
 
     def step(self) -> list[RequestOutput]:

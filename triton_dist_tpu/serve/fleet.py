@@ -1,0 +1,734 @@
+"""Fleet serving: a multi-replica router with live request migration.
+
+PRs 1-8 made ONE engine fast, observable, and crash-resilient — but the
+stack still served from exactly one process, so one wedged replica was a
+full outage.  This module runs N engine replicas behind an admission
+router and makes the PR 5 journal + snapshot + ``BlockManager.adopt``
+machinery do what it always was underneath: a *migration* primitive
+(the Llumnix live-migration / MegaScale fast-hand-off insight — the TPU
+analog of the reference's producer/consumer signal-and-put hand-off,
+SURVEY.md §2.5).
+
+Three cooperating layers:
+
+- :class:`Router` — admission placement by queue-depth / deadline
+  pressure read from each replica's ``ServeMetrics`` (direct engine
+  state in-process; :func:`parse_prometheus` over a ``/metrics`` scrape
+  for subprocess replicas — ``scripts/serve_supervisor.py --fleet``).
+  SUSPECT and DEAD replicas are circuit-broken out of the candidate
+  set, so the router can never place onto a replica that stopped
+  making progress.
+
+- **Health state machine** — per replica HEALTHY → SUSPECT → DEAD,
+  layered on the existing liveness signals (heartbeat staleness,
+  step-progress age, a ``WatchdogTimeout`` or process-death exception
+  escaping ``step``).  A SUSPECT replica stops receiving admissions and
+  recovers to HEALTHY the moment progress resumes; a DEAD one is killed
+  and restarted under :class:`RestartBackoff` (exponential + jitter,
+  healthy-uptime budget reset — shared with the supervisor).
+
+- **Live migration** — a dying replica's in-flight requests move to
+  healthy peers and finish there.  Cooperative path:
+  ``ServeEngine.drain(rids)`` gathers live KV pages + the pending token
+  and the target's ``migrate_in`` adopts the row MID-STREAM (zero
+  recompute).  Crash path: the dead replica's durable token journal is
+  the source of truth — :func:`serve.recovery.manifest_from_journal`
+  rebuilds the journal segment and the target replays the remainder
+  through the exact-recompute path, bit-identical by the PR 5
+  argument.  Either way the source journal records a ``mig`` receipt
+  per request, so the union of all replicas' journals holds every
+  token of every stream EXACTLY ONCE (the fleet chaos harness in
+  tests/test_serve_fleet.py pins this: kill a replica mid-decode under
+  load — every stream finishes bit-identical to the single-engine
+  oracle, zero lost, zero duplicated).
+
+See docs/serving.md "Fleet serving" for the operator recipe.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import random
+import sys
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import numpy as np
+
+from triton_dist_tpu.runtime.watchdog import WatchdogTimeout
+from triton_dist_tpu.serve.metrics import RequestMetrics
+from triton_dist_tpu.serve.request import (
+    FinishReason,
+    Request,
+    RequestOutput,
+)
+from triton_dist_tpu.serve.trace import FlightRecorder
+
+
+class ReplicaState(enum.Enum):
+    HEALTHY = "healthy"   # serving; admissible by the router
+    SUSPECT = "suspect"   # progress stalled past suspect_after_s:
+    #                       circuit-broken (no admissions), not yet dead
+    DEAD = "dead"         # killed or crashed; restarting under backoff
+
+
+# ---------------------------------------------------------------------------
+# Restart backoff (shared by the FleetController and serve_supervisor)
+# ---------------------------------------------------------------------------
+
+
+class RestartBackoff:
+    """Exponential restart backoff with jitter and a healthy-uptime
+    budget reset.
+
+    A crash-looping child used to restart instantly and burn its whole
+    ``max_restarts`` budget in seconds; this paces restarts at
+    ``base_s * 2^(attempt-1)`` capped at ``cap_s``, jittered by up to
+    ``jitter`` of the delay (deterministic under ``seed`` — restarts
+    across a fleet must not synchronize), and FORGIVES the attempt
+    count once a life stays up ``healthy_reset_s`` (a process that ran
+    healthy for an hour and then died is a fresh incident, not attempt
+    #4 of a crash loop).
+
+    Protocol: :meth:`on_start` when the process launches,
+    :meth:`on_death` when it dies — returns the delay to wait before
+    the next restart, or ``None`` when ``max_restarts`` is exhausted.
+    """
+
+    def __init__(self, *, base_s: float = 0.5, cap_s: float = 30.0,
+                 jitter: float = 0.5, healthy_reset_s: float = 60.0,
+                 max_restarts: Optional[int] = None, seed: int = 0):
+        if base_s <= 0 or cap_s < base_s:
+            raise ValueError(f"need 0 < base_s <= cap_s, got "
+                             f"{base_s}, {cap_s}")
+        if not 0 <= jitter <= 1:
+            raise ValueError(f"jitter must be in [0, 1], got {jitter}")
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self.jitter = jitter
+        self.healthy_reset_s = healthy_reset_s
+        self.max_restarts = max_restarts
+        self.attempts = 0
+        self._rng = random.Random(seed)
+        self._started: Optional[float] = None
+
+    def on_start(self, now: float) -> None:
+        self._started = now
+
+    def on_death(self, now: float) -> Optional[float]:
+        """Delay before the next restart, or ``None`` (budget spent)."""
+        if (self._started is not None
+                and now - self._started >= self.healthy_reset_s):
+            self.attempts = 0
+        self.attempts += 1
+        if (self.max_restarts is not None
+                and self.attempts > self.max_restarts):
+            return None
+        d = min(self.cap_s, self.base_s * 2.0 ** (self.attempts - 1))
+        return d * (1.0 + self.jitter * self._rng.random())
+
+
+# ---------------------------------------------------------------------------
+# Router: queue-depth / deadline pressure placement
+# ---------------------------------------------------------------------------
+
+
+def parse_prometheus(text: str) -> dict:
+    """Parse a Prometheus text exposition into ``{series: value}`` —
+    the scrape half of the router's load signal for SUBPROCESS replicas
+    (``ServeMetrics.to_prometheus`` is the other end; labeled series
+    keep their full left-hand side as the key)."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.rsplit(None, 1)
+        if len(parts) != 2:
+            continue
+        try:
+            out[parts[0]] = float(parts[1])
+        except ValueError:
+            continue
+    return out
+
+
+@dataclass
+class ReplicaLoad:
+    """One replica's admission-pressure signal, however it was read
+    (direct engine state in-process, Prometheus scrape out-of-process)."""
+
+    queue_depth: int = 0
+    running: int = 0
+    max_batch: int = 1
+    kv_util: float = 0.0
+
+    @classmethod
+    def from_engine(cls, engine) -> "ReplicaLoad":
+        return cls(queue_depth=engine.scheduler.queue_depth,
+                   running=sum(1 for s in engine.slots if s is not None),
+                   max_batch=engine.max_batch,
+                   kv_util=engine.bm.utilization)
+
+    @classmethod
+    def from_prometheus(cls, text: str,
+                        max_batch: int = 1) -> "ReplicaLoad":
+        """Load from a ``/metrics`` scrape (the subprocess path —
+        docs/observability.md lists the series names)."""
+        g = parse_prometheus(text)
+        return cls(queue_depth=int(g.get("serve_queue_depth", 0)),
+                   running=int(g.get("serve_running", 0)),
+                   max_batch=max_batch,
+                   kv_util=float(g.get("serve_kv_utilization", 0.0)))
+
+
+class Router:
+    """Least-pressure admission placement over HEALTHY replicas.
+
+    Pressure is ``queue_weight * queue_depth + running / max_batch +
+    kv_weight * kv_util`` — queued requests dominate (one queued
+    request outweighs even a fully occupied batch: it is a whole
+    request of delay ahead, where a running batch is already making
+    progress), batch occupancy and KV pressure break the near-ties.  A
+    deadline-carrying request weighs queue depth
+    ``deadline_queue_weight``× harder: its TTL burns while it waits, so
+    it must land on the emptiest queue even when occupancy says
+    otherwise.  Exact pressure ties rotate round-robin so a cold fleet
+    does not pile onto one replica."""
+
+    def __init__(self, *, queue_weight: float = 2.0,
+                 kv_weight: float = 0.5,
+                 deadline_queue_weight: float = 4.0):
+        self.queue_weight = queue_weight
+        self.kv_weight = kv_weight
+        self.deadline_queue_weight = deadline_queue_weight
+        self._rr = 0
+
+    def pressure(self, load: ReplicaLoad, *,
+                 deadline: bool = False) -> float:
+        qw = self.deadline_queue_weight if deadline else self.queue_weight
+        return (qw * load.queue_depth
+                + load.running / max(load.max_batch, 1)
+                + self.kv_weight * load.kv_util)
+
+    def rank(self, candidates: list, *, deadline: bool = False) -> list:
+        """``[(name, load)]`` sorted best-first (the migration placer
+        walks this to find capacity)."""
+        n = max(len(candidates), 1)
+        self._rr += 1
+        scored = sorted(
+            (self.pressure(load, deadline=deadline),
+             (i + self._rr) % n, name)
+            for i, (name, load) in enumerate(candidates))
+        return [name for _, _, name in scored]
+
+    def pick(self, candidates: list, *,
+             deadline: bool = False) -> Optional[str]:
+        """Best HEALTHY replica for one new request, or ``None``."""
+        ranked = self.rank(candidates, deadline=deadline)
+        return ranked[0] if ranked else None
+
+
+# ---------------------------------------------------------------------------
+# In-process replica
+# ---------------------------------------------------------------------------
+
+
+class EngineReplica:
+    """One in-process engine replica under the :class:`FleetController`.
+
+    Each LIFE gets its own snapshot directory (``root/life<N>``): the
+    life's journal is its durable request ownership record, so a crash
+    migrates from the dead life's journal and the restart opens a fresh
+    one — nothing a previous life owned can leak into the next (the
+    handed-off requests carry ``mig`` receipts besides; belt and
+    suspenders)."""
+
+    def __init__(self, name: str, factory: Callable, root: str):
+        self.name = name
+        self._factory = factory
+        self.root = root
+        self.engine = None
+        self.life = 0
+        self.state = ReplicaState.DEAD
+        self.last_progress: Optional[float] = None
+        self.restart_at: Optional[float] = None
+        self.restarts = 0          # lives after the first
+        self.death_reason: Optional[str] = None
+
+    @property
+    def life_dir(self) -> str:
+        return os.path.join(self.root, f"life{self.life}")
+
+    def start(self, now: float) -> None:
+        self.life += 1
+        os.makedirs(self.life_dir, exist_ok=True)
+        self.engine = self._factory(self.life_dir)
+        if self.engine._journal is None:
+            raise ValueError(
+                f"replica {self.name}: the factory must build engines "
+                f"with snapshot_dir=<life dir> — the journal is what "
+                f"crash migration hands off")
+        self.state = ReplicaState.HEALTHY
+        self.last_progress = now
+        self.restart_at = None
+        self.death_reason = None
+
+    def load(self) -> ReplicaLoad:
+        return ReplicaLoad.from_engine(self.engine)
+
+
+# ---------------------------------------------------------------------------
+# The fleet controller
+# ---------------------------------------------------------------------------
+
+
+class FleetController:
+    """N in-process engine replicas behind a :class:`Router`, with
+    health-checked circuit breaking, backoff restarts, and live request
+    migration (module docstring; docs/serving.md "Fleet serving").
+
+    ``factory(snapshot_dir) -> ServeEngine`` builds one replica life
+    (it MUST pass ``snapshot_dir`` through — the journal is the
+    migration substrate).  Drive it like an engine: :meth:`submit` then
+    :meth:`step`/:meth:`run`; finished streams land in
+    :attr:`outputs`, the exactly-once delivery record in
+    :attr:`streams`, and per-request placement history (which replicas
+    served it) in :attr:`history`.
+
+    Exactly-once across the fleet: every token reaches the caller
+    exactly once — live tokens through the wrapped ``on_token``, and on
+    a migration the manifest's journal segment fills exactly the
+    indices the dead replica journaled but never delivered (the
+    commit→callback crash window).  The journal union argument lives in
+    serve/recovery.py; the chaos harness asserts both.
+    """
+
+    def __init__(self, factory: Callable, n_replicas: int, *,
+                 root: str, clock=time.monotonic,
+                 router: Optional[Router] = None,
+                 suspect_after_s: float = 5.0,
+                 dead_after_s: float = 15.0,
+                 probe: Optional[Callable] = None,
+                 backoff_base_s: float = 0.25,
+                 backoff_cap_s: float = 30.0,
+                 backoff_jitter: float = 0.5,
+                 healthy_reset_s: float = 60.0,
+                 max_restarts: Optional[int] = None,
+                 trace_events: int = 2048, seed: int = 0):
+        if n_replicas < 1:
+            raise ValueError(f"need >= 1 replica, got {n_replicas}")
+        if not suspect_after_s < dead_after_s:
+            raise ValueError(
+                f"need suspect_after_s < dead_after_s, got "
+                f"{suspect_after_s}, {dead_after_s}")
+        self._clock = clock
+        self.router = router or Router()
+        self.suspect_after_s = suspect_after_s
+        self.dead_after_s = dead_after_s
+        # progress age in seconds; replaceable so tests (and subprocess
+        # drivers) can layer heartbeat-file staleness in
+        self._probe = probe or (
+            lambda r, now: now - (r.last_progress
+                                  if r.last_progress is not None
+                                  else now))
+        self.trace = FlightRecorder(capacity=trace_events)
+        os.makedirs(root, exist_ok=True)
+        self.root = root
+        now = self._clock()
+        self.replicas: dict[str, EngineReplica] = {}
+        self._backoff: dict[str, RestartBackoff] = {}
+        for i in range(n_replicas):
+            name = f"r{i}"
+            rep = EngineReplica(name, factory, os.path.join(root, name))
+            self.replicas[name] = rep
+            self._backoff[name] = RestartBackoff(
+                base_s=backoff_base_s, cap_s=backoff_cap_s,
+                jitter=backoff_jitter, healthy_reset_s=healthy_reset_s,
+                max_restarts=max_restarts, seed=seed + i)
+            rep.start(now)
+            self._backoff[name].on_start(now)
+        self.steps = 0
+        self.deaths = 0
+        self.migrations = 0        # requests moved between replicas
+        self.outputs: dict[str, RequestOutput] = {}
+        self.streams: dict[str, list] = {}   # exactly-once delivery
+        self.placement: dict[str, str] = {}  # rid -> current replica
+        self.history: dict[str, list] = {}   # rid -> replicas that held it
+        self._cbs: dict[str, Callable] = {}  # rid -> wrapped on_token
+        self._pending_reqs: deque = deque()  # unplaced fresh requests
+        self._pending_recs: deque = deque()  # (header, rec) to re-place
+
+    # -- submission -------------------------------------------------------
+
+    def _make_cb(self, rid: str, orig) -> Callable:
+        stream = self.streams[rid]
+
+        def cb(_rid, tok):
+            stream.append(int(tok))
+            if orig is not None:
+                orig(_rid, tok)
+        return cb
+
+    def submit(self, req: Request) -> None:
+        """Route one request onto the least-pressure HEALTHY replica.
+        Fleet-queued while no healthy replica exists (an outage window
+        is transient — deadlines still sweep the fleet queue); SHED
+        when every healthy replica's waiting queue is at its bound (the
+        PR 3 bounded-admission contract holds fleet-wide: the fleet
+        sheds only when EVERY replica is full)."""
+        rid = req.request_id
+        if rid in self.streams:
+            raise ValueError(f"duplicate request id {rid!r}")
+        if req.arrival_time is None:
+            req.arrival_time = self._clock()  # fleet-queue deadlines
+        self.streams[rid] = []
+        self.history[rid] = []
+        self._cbs[rid] = self._make_cb(rid, req.on_token)
+        req.on_token = self._cbs[rid]
+        if not self._place_request(req):
+            self._pending_reqs.append(req)
+
+    def _healthy(self) -> list:
+        return [(name, r.load()) for name, r in self.replicas.items()
+                if r.state is ReplicaState.HEALTHY]
+
+    def _place_request(self, req: Request) -> bool:
+        from triton_dist_tpu.serve.engine import QueueFull
+
+        healthy = self._healthy()
+        # capacity-aware: never place onto a queue already at its bound
+        # (the engine would shed it; a fleet with room elsewhere must
+        # not)
+        cands = [(n, l) for n, l in healthy
+                 if (self.replicas[n].engine.max_queue is None
+                     or l.queue_depth
+                     < self.replicas[n].engine.max_queue)]
+        deadline = req.params.deadline_s is not None
+        for name in self.router.rank(cands, deadline=deadline):
+            rep = self.replicas[name]
+            try:
+                shed = rep.engine.submit(req)
+            except QueueFull:
+                continue
+            self.trace.emit("route", req.request_id, replica=name,
+                            state=rep.state.value, deadline=deadline)
+            self.placement[req.request_id] = name
+            self.history[req.request_id].append(name)
+            if shed is not None:   # raced to a full queue: final verdict
+                self._finalize(shed, name)
+            return True
+        if healthy:
+            # Healthy replicas exist and EVERY one is at its queue
+            # bound: the fleet is genuinely full — shed now (the
+            # bounded-admission contract, fleet-wide).  Nothing was
+            # journaled anywhere for this request.  With NO healthy
+            # replica the caller queues instead: that is a transient
+            # outage window, not admission pressure.
+            self._shed(req, f"every replica's queue at bound "
+                            f"({len(healthy)} healthy)")
+            return True
+        return False
+
+    def _shed(self, req: Request, msg: str) -> None:
+        rm = RequestMetrics(arrival_time=req.arrival_time
+                            or self._clock())
+        rm.finish_time = self._clock()
+        out = RequestOutput(request_id=req.request_id,
+                            prompt=req.prompt, token_ids=[],
+                            finish_reason=FinishReason.SHED,
+                            metrics=rm, error=msg)
+        self.trace.emit("retire", req.request_id, reason="shed")
+        self._finalize(out, "fleet")
+
+    def _place_rec(self, header: dict, rec: dict,
+                   exclude: frozenset = frozenset()) -> bool:
+        """Place one migration-manifest record onto a healthy replica
+        via ``migrate_in`` (capacity admission: a rejecting replica
+        passes it to the next candidate)."""
+        rid = rec["rid"]
+        cands = [(n, l) for n, l in self._healthy() if n not in exclude]
+        params_deadline = rec.get("params", {}).get("deadline_s")
+        for name in self.router.rank(cands,
+                                     deadline=params_deadline is not None):
+            rep = self.replicas[name]
+            res = rep.engine.migrate_in(
+                {**header, "requests": [rec]},
+                on_token={rid: self._cbs.get(rid)})
+            if rid in res["rejected"]:
+                continue
+            self.migrations += 1
+            self.trace.emit("migrate_in", rid, replica=name,
+                            state=rep.state.value,
+                            in_place=rid in res["adopted"])
+            self.placement[rid] = name
+            self.history[rid].append(name)
+            return True
+        return False
+
+    def _drain_pending(self, exclude: frozenset = frozenset()) -> None:
+        for _ in range(len(self._pending_recs)):
+            header, rec = self._pending_recs.popleft()
+            if not self._place_rec(header, rec, exclude):
+                self._pending_recs.append((header, rec))
+        for _ in range(len(self._pending_reqs)):
+            req = self._pending_reqs.popleft()
+            if not self._place_request(req):
+                self._pending_reqs.append(req)
+
+    # -- the fleet tick ---------------------------------------------------
+
+    def step(self) -> list:
+        """One fleet iteration: due restarts → place pending work →
+        step every live replica (a step that raises is a replica death:
+        migrate + schedule restart) → health sweep.  Returns the
+        requests that finished this tick."""
+        now = self._clock()
+        finished: list[RequestOutput] = []
+        # deadline sweep over the FLEET queue: a request parked here
+        # (no healthy replica when it arrived) is visible to no
+        # engine's sweep, so its TTL must expire here or never
+        for _ in range(len(self._pending_reqs)):
+            req = self._pending_reqs.popleft()
+            d = req.params.deadline_s
+            if (d is not None and req.arrival_time is not None
+                    and now - req.arrival_time > d):
+                rm = RequestMetrics(arrival_time=req.arrival_time)
+                rm.finish_time = now
+                out = RequestOutput(
+                    request_id=req.request_id, prompt=req.prompt,
+                    token_ids=[], finish_reason=FinishReason.DEADLINE,
+                    metrics=rm,
+                    error=f"deadline {d}s exceeded in the fleet queue")
+                self.trace.emit("retire", req.request_id,
+                                reason="deadline")
+                self._finalize(out, "fleet")
+                finished.append(out)
+            else:
+                self._pending_reqs.append(req)
+        for name, rep in self.replicas.items():
+            if (rep.state is ReplicaState.DEAD
+                    and rep.restart_at is not None
+                    and now >= rep.restart_at):
+                rep.start(now)
+                rep.restarts += 1
+                self._backoff[name].on_start(now)
+                self.trace.emit("replica_state", None, replica=name,
+                                state=rep.state.value,
+                                life=rep.life)
+        self._drain_pending()
+        for name, rep in self.replicas.items():
+            if rep.state is ReplicaState.DEAD or rep.engine is None:
+                continue
+            if not rep.engine.has_work():
+                rep.last_progress = now  # idle is not a stall
+                continue
+            try:
+                outs = rep.engine.step()
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except WatchdogTimeout as e:
+                # engine-level stall: the dispatch wedged past its
+                # budget — the process is as good as gone
+                self._on_replica_death(name, f"watchdog: {e}", now)
+                continue
+            except BaseException as e:  # noqa: BLE001 — InjectedKill /
+                # engine-fatal escalations ARE the process-death seam
+                self._on_replica_death(
+                    name, f"{type(e).__name__}: {e}", now)
+                continue
+            rep.last_progress = now
+            if rep.state is ReplicaState.SUSPECT:
+                rep.state = ReplicaState.HEALTHY  # progress: recovered
+                self.trace.emit("replica_state", None, replica=name,
+                                state=rep.state.value)
+            for out in outs:
+                self._finalize(out, name)
+                finished.append(out)
+        # health sweep: probe-driven SUSPECT/DEAD (heartbeat staleness
+        # for subprocess drivers; progress age in-process)
+        for name, rep in self.replicas.items():
+            if rep.state is ReplicaState.DEAD:
+                continue
+            age = self._probe(rep, now)
+            if age > self.dead_after_s:
+                self._on_replica_death(name, f"stalled {age:.1f}s", now)
+            elif (age > self.suspect_after_s
+                  and rep.state is ReplicaState.HEALTHY):
+                rep.state = ReplicaState.SUSPECT
+                self.trace.emit("replica_state", None, replica=name,
+                                state=rep.state.value,
+                                age=round(age, 3))
+            elif (age <= self.suspect_after_s
+                  and rep.state is ReplicaState.SUSPECT):
+                # the probe says healthy again (an IDLE suspect replica
+                # never re-proves itself through a step, so the sweep
+                # must heal too, or it would stay circuit-broken
+                # forever)
+                rep.state = ReplicaState.HEALTHY
+                self.trace.emit("replica_state", None, replica=name,
+                                state=rep.state.value)
+        self.steps += 1
+        return finished
+
+    def has_work(self) -> bool:
+        return (bool(self._pending_reqs) or bool(self._pending_recs)
+                or any(r.engine is not None and r.engine.has_work()
+                       for r in self.replicas.values()))
+
+    def run(self, max_steps: int = 100_000) -> dict:
+        """Step until the fleet drains; returns ``dict(outputs)``.
+        Raises when no replica is live and none will restart (budget
+        exhausted with work pending) — the fleet-level outage."""
+        steps = 0
+        while self.has_work():
+            if not any(r.state is not ReplicaState.DEAD
+                       or r.restart_at is not None
+                       for r in self.replicas.values()):
+                raise RuntimeError(
+                    "fleet outage: every replica is dead with its "
+                    "restart budget exhausted and work is pending")
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"fleet not drained after {max_steps} steps")
+        return dict(self.outputs)
+
+    # -- failure handling + migration -------------------------------------
+
+    def kill_replica(self, name: str, why: str = "killed") -> None:
+        """Declare a replica dead NOW (the chaos / ops hook — the
+        in-process stand-in for SIGKILL): its in-flight requests
+        migrate from the durable journal and a restart is scheduled
+        under backoff."""
+        self._on_replica_death(name, why, self._clock())
+
+    def drain_replica(self, name: str) -> int:
+        """Cooperatively migrate every in-flight request OFF a live
+        replica (maintenance drain / rebalance): ``ServeEngine.drain``
+        hands off live KV + pending tokens, so RUNNING rows resume
+        mid-stream on their new replica with zero recompute.  Returns
+        the number of requests moved."""
+        rep = self.replicas[name]
+        if rep.engine is None:
+            raise ValueError(f"replica {name} is dead; crash migration "
+                             f"already ran")
+        manifest = rep.engine.drain()
+        n = len(manifest["requests"])
+        self._absorb_manifest(manifest, source=name)
+        self._drain_pending(exclude=frozenset((name,)))
+        return n
+
+    def _on_replica_death(self, name: str, why: str,
+                          now: float) -> None:
+        rep = self.replicas[name]
+        if rep.state is ReplicaState.DEAD:
+            return
+        from triton_dist_tpu.serve.recovery import manifest_from_journal
+
+        print(f"[fleet] replica {name} dead ({why}); migrating its "
+              f"in-flight requests", file=sys.stderr)
+        if rep.engine is not None and rep.engine._journal is not None:
+            rep.engine._journal.close()  # single writer for the mark
+        life_dir = rep.life_dir
+        rep.engine = None  # the process is gone; durable state remains
+        rep.state = ReplicaState.DEAD
+        rep.death_reason = why
+        self.deaths += 1
+        self.trace.emit("replica_state", None, replica=name,
+                        state=rep.state.value, why=why)
+        manifest = manifest_from_journal(life_dir, mark=True)
+        # retirements whose outputs the dying step swallowed: the
+        # journal's fin records are the accounting of record
+        for f in manifest["finished"]:
+            if f["rid"] in self.streams and f["rid"] not in self.outputs:
+                self._finalize_from_journal(f, name)
+        self._absorb_manifest(manifest, source=name)
+        self._drain_pending(exclude=frozenset((name,)))
+        delay = self._backoff[name].on_death(now)
+        if delay is None:
+            rep.restart_at = None
+            print(f"[fleet] replica {name}: restart budget exhausted; "
+                  f"staying dead", file=sys.stderr)
+        else:
+            rep.restart_at = now + delay
+
+    def _absorb_manifest(self, manifest: dict, source: str) -> None:
+        """Fold a migration manifest into fleet accounting: fill each
+        stream's delivery record from the journal segment (tokens the
+        source journaled but never delivered — the commit→callback
+        crash window — redeliver HERE, exactly the missing indices),
+        then queue the records for placement."""
+        header = {k: manifest[k] for k in
+                  ("format", "clock", "page_size", "kv_geom")
+                  if k in manifest}
+        for rec in manifest.get("requests", ()):
+            rid = rec["rid"]
+            if rid not in self.streams:
+                continue  # not fleet traffic (foreign journal entry)
+            toks = rec.get("tokens", [])
+            d = len(self.streams[rid])
+            assert d <= len(toks), (
+                f"{rid}: delivered {d} tokens but the journal only "
+                f"holds {len(toks)} — the journal-precedes-callback "
+                f"invariant broke")
+            self.streams[rid].extend(int(t) for t in toks[d:])
+            self.placement.pop(rid, None)
+            self._pending_recs.append((header, rec))
+
+    def _finalize(self, out: RequestOutput, name: str) -> None:
+        rid = out.request_id
+        self.outputs[rid] = out
+        s = self.streams.get(rid)
+        if s is not None and len(s) < len(out.token_ids):
+            # a disabled/raising user callback starves the delivery
+            # record; the retirement's authoritative token list
+            # reconciles it
+            s.extend(out.token_ids[len(s):])
+        self.placement.pop(rid, None)
+
+    def _finalize_from_journal(self, f: dict, name: str) -> None:
+        rm = RequestMetrics(arrival_time=self._clock())
+        out = RequestOutput(
+            request_id=f["rid"],
+            prompt=np.asarray(f.get("prompt", []), np.int32),
+            token_ids=[int(t) for t in f["tokens"]],
+            finish_reason=FinishReason(f["reason"]),
+            metrics=rm, error=f.get("err"))
+        self._finalize(out, name)
+
+    # -- observability ----------------------------------------------------
+
+    def fleet_summary(self) -> dict:
+        """One dict of fleet state: per-replica health/lives/load plus
+        the routing + migration counters (the fleet twin of
+        ``ServeMetrics.summary``)."""
+        reps = {}
+        for name, rep in self.replicas.items():
+            r = {
+                "state": rep.state.value,
+                "life": rep.life,
+                "restarts": rep.restarts,
+                "death_reason": rep.death_reason,
+            }
+            if rep.engine is not None:
+                load = rep.load()
+                r.update(queue_depth=load.queue_depth,
+                         running=load.running,
+                         kv_util=round(load.kv_util, 4),
+                         completed=rep.engine.metrics.completed,
+                         migrated_in=rep.engine.metrics.migrated_in,
+                         migrated_out=rep.engine.metrics.migrated_out)
+            reps[name] = r
+        return {
+            "replicas": reps,
+            "steps": self.steps,
+            "deaths": self.deaths,
+            "migrations": self.migrations,
+            "completed": len(self.outputs),
+            "pending": len(self._pending_reqs) + len(self._pending_recs),
+        }

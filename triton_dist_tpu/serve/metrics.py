@@ -238,6 +238,15 @@ class ServeMetrics:
     restored_in_place: int = 0    # requests resumed with live KV
     restored_requeued: int = 0    # requests re-queued for recompute
     restored_tokens: int = 0      # journal tokens carried across
+    # live-migration counters (docs/serving.md "Fleet serving"): the
+    # hand-off twins of the restore provenance fields — how many
+    # requests left this engine mid-stream (drain) and how many arrived
+    # (migrate_in, split by in-place KV adopt vs exact-recompute
+    # requeue), plus the journal tokens that crossed with them.
+    migrated_out: int = 0         # requests drained to a manifest
+    migrated_in: int = 0          # manifest requests this engine adopted
+    migrated_in_place: int = 0    # adopted WITH live KV (no recompute)
+    migrated_tokens: int = 0      # journal tokens carried by migrations
     # prefix-cache counters (docs/serving.md "Prefix caching"): engine-
     # side admission hits; the block-level gauges (refcounts, cache
     # tier, COW/eviction counts) live on the attached BlockManager and
@@ -372,6 +381,16 @@ class ServeMetrics:
             "restored_in_place": self.restored_in_place,
             "restored_requeued": self.restored_requeued,
             "restored_tokens": self.restored_tokens,
+        }
+
+    def migration_stats(self) -> dict:
+        """Live-migration provenance (summary()["migration"]) — the
+        fleet hand-off counters (docs/serving.md "Fleet serving")."""
+        return {
+            "migrated_out": self.migrated_out,
+            "migrated_in": self.migrated_in,
+            "migrated_in_place": self.migrated_in_place,
+            "migrated_tokens": self.migrated_tokens,
         }
 
     def attach_block_manager(self, bm) -> None:
@@ -533,6 +552,7 @@ class ServeMetrics:
             "spec": self.spec_stats(),
             "failures": self.failure_stats(),
             "recovery": self.recovery_stats(),
+            "migration": self.migration_stats(),
             "prefix_cache": self.prefix_stats(),
             "compilation": self.compile_stats(),
             "requests": {rid: m.to_dict()
@@ -586,6 +606,10 @@ class ServeMetrics:
         counter("serve_snapshots_total", self.snapshots)
         counter("serve_journal_records_total", self.journal_records)
         counter("serve_journal_rotations_total", self.journal_rotations)
+        counter("serve_migrated_out_total", self.migrated_out,
+                "requests drained to a migration manifest")
+        counter("serve_migrated_in_total", self.migrated_in,
+                "manifest requests adopted from another replica")
         counter("serve_prefix_hits_total", self.prefix_hits)
         counter("serve_prefix_skipped_tokens_total",
                 self.prefix_skipped_tokens)
@@ -725,6 +749,13 @@ def format_stats(s: dict, *, spec: bool = False, prefix: bool = False,
             f"({r['journal_bytes']} bytes), "
             f"{r['restored_in_place']} resumed in place / "
             f"{r['restored_requeued']} requeued")
+        mg = s.get("migration")
+        if mg and (mg["migrated_out"] or mg["migrated_in"]):
+            lines.append(
+                f"migration: {mg['migrated_out']} drained out, "
+                f"{mg['migrated_in']} adopted "
+                f"({mg['migrated_in_place']} with live KV), "
+                f"{mg['migrated_tokens']} journal tokens carried")
     comp = s["compilation"]
     per = ", ".join(f"{n} {c['misses']}c/{c['hits']}h"
                     for n, c in comp["programs"].items())

@@ -201,6 +201,16 @@ class TokenJournal:
         self.append({"t": "fin", "rid": rid, "reason": reason,
                      "err": error, "n": int(n_tokens), "ts": ts})
 
+    def migrate(self, rid: str, n_tokens: int, ts: float) -> None:
+        """Record a live-migration hand-off: ``rid`` left this engine
+        for another replica (docs/serving.md "Fleet serving").  The
+        record is the ownership transfer — a restore of THIS journal
+        must never resurrect the request (the target replica's journal
+        now owns its remaining stream), which is exactly what makes the
+        cross-replica token union exactly-once."""
+        self.append({"t": "mig", "rid": rid, "n": int(n_tokens),
+                     "ts": ts})
+
     def sync(self) -> None:
         """Force everything appended so far to disk (snapshot barrier)."""
         self._f.flush()
@@ -255,6 +265,10 @@ class JournalRequest:
     arrival: Optional[float] = None
     tokens: dict = field(default_factory=dict)   # index -> (tok, ts)
     finish: Optional[dict] = None                # {"reason","err","n","ts"}
+    # ownership left this journal via a live-migration hand-off ("mig"
+    # record): restore must not resurrect the request, and the request
+    # is not part of this engine's finish accounting either
+    migrated: bool = False
     # first-token timestamp carried by rotation records ("ftt"): the
     # compacted tts/ts lists None-pad their head past the bounded
     # token-time window, so the restored TTFT needs this explicitly
@@ -316,6 +330,8 @@ def replay_journal(path: str | os.PathLike) -> dict[str, JournalRequest]:
                 jr.finish = {"reason": rec["reason"],
                              "err": rec.get("err"),
                              "n": rec.get("n"), "ts": rec.get("ts")}
+            elif t == "mig":
+                jr.migrated = True
             elif t == "done":
                 # One-line compacted request (a snapshot-barrier journal
                 # rotation): submit + every tok + fin folded together.
@@ -822,8 +838,15 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
         if jr.finish is not None:
             r["finish"] = jr.finish
     # A rid only ever seen as a finish/token record (its submit line was
-    # torn away with the crash) cannot be rebuilt — drop it.
-    order = [rid for rid in order if resolved[rid].get("prompt") is not None]
+    # torn away with the crash) cannot be rebuilt — drop it.  A rid the
+    # journal marks MIGRATED is owned by another replica now (its "mig"
+    # record is the hand-off receipt — docs/serving.md "Fleet serving"):
+    # resurrecting it here would double-serve the stream, even when a
+    # pre-drain KV snapshot still lists it, so it is dropped outright
+    # (the target replica's journal carries its past and its future).
+    order = [rid for rid in order
+             if resolved[rid].get("prompt") is not None
+             and not (rid in journal and journal[rid].migrated)]
 
     if meta is not None:
         old_now = meta["clock"]
@@ -1150,3 +1173,118 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
                       tokens=m.restored_tokens)
     m.restores += 1
     return engine
+
+
+# ---------------------------------------------------------------------------
+# Live migration: journal-segment hand-off between replicas
+# ---------------------------------------------------------------------------
+#
+# A migration MANIFEST is the unit of request hand-off between engine
+# replicas (docs/serving.md "Fleet serving").  It carries, per request,
+# everything a target ``ServeEngine.migrate_in`` needs to continue the
+# stream exactly-once: prompt, sampling params (the per-token PRNG
+# stream), the journaled token prefix with timestamps, and — on the
+# cooperative ``ServeEngine.drain`` path — the live KV pages + pending
+# token so the target resumes mid-stream with zero recompute.  Two
+# producers exist:
+#
+# - ``ServeEngine.drain(rids)`` on a LIVE source: the engine gathers the
+#   per-request KV pages, journals a ``mig`` record per request (the
+#   ownership receipt), and frees its own state.
+# - :func:`manifest_from_journal` on a DEAD replica's directory: the
+#   durable journal is the source of truth for what was emitted, so the
+#   manifest is exact even though the process is gone (no KV rides —
+#   the target replays through the exact-recompute path, bit-identical
+#   by the PR 5 argument).  ``mark=True`` appends the ``mig`` receipts
+#   to the dead journal so a later ``--resume`` of that directory can
+#   never resurrect the handed-off requests.
+
+MANIFEST_FORMAT = 1
+
+
+def manifest_from_journal(directory: str | os.PathLike, *,
+                          mark: bool = False) -> dict:
+    """Build a migration manifest for every UNFINISHED, un-migrated
+    request in ``directory``'s token journal (the crash-path producer —
+    the replica is dead, its journal is what survives).
+
+    Returns ``{"format", "clock", "requests": [...], "finished": [...]}``
+    where ``finished`` lists requests whose ``fin`` record landed but
+    whose output the fleet controller may not have collected (the dying
+    step's retirements) — accounting, never re-served.  ``mark=True``
+    appends a ``mig`` record per handed-off request (safe only once the
+    source process is dead: two writers on one journal corrupt it).
+    """
+    directory = os.path.abspath(os.fspath(directory))
+    journal = replay_journal(os.path.join(directory, JOURNAL_NAME))
+    # Clock re-base (the restore_engine rule): the newest source-clock
+    # stamp anywhere in the journal stands in for "now" on the source.
+    old_now = max(
+        [ts for jr in journal.values()
+         for _, ts in jr.tokens.values() if ts is not None] +
+        [jr.arrival for jr in journal.values() if jr.arrival is not None] +
+        [jr.finish["ts"] for jr in journal.values()
+         if jr.finish is not None and jr.finish.get("ts") is not None],
+        default=0.0)
+    reqs, finished, handed = [], [], []
+    for rid, jr in journal.items():
+        if jr.prompt is None or jr.migrated:
+            continue
+        toks = jr.token_list()
+        if jr.finish is not None:
+            finished.append({
+                "rid": rid,
+                "prompt": [int(x) for x in jr.prompt],
+                "tokens": toks,
+                "reason": jr.finish["reason"],
+                "err": jr.finish.get("err"),
+            })
+            continue
+        reqs.append({
+            "rid": rid,
+            "prompt": [int(x) for x in jr.prompt],
+            "params": jr.params.to_dict(),
+            "arrival": jr.arrival,
+            "tokens": toks,
+            "tok_ts": jr.token_times(),
+            "first_tok": jr.first_tok,
+        })
+        handed.append((rid, len(toks)))
+    if mark and handed:
+        j = TokenJournal(os.path.join(directory, JOURNAL_NAME))
+        try:
+            for rid, n in handed:
+                j.migrate(rid, n, old_now)
+            j.sync()
+        finally:
+            j.close()
+    return {"format": MANIFEST_FORMAT, "clock": old_now,
+            "requests": reqs, "finished": finished}
+
+
+def save_manifest(manifest: dict, path: str | os.PathLike) -> str:
+    """Write a manifest as JSON (atomic tmp + rename) — the subprocess
+    hand-off format (``examples/serve.py --migrate-in``).  KV payloads
+    are dropped: the JSON manifest is the journal-segment crash path,
+    and the target replays through exact recompute."""
+    path = os.path.abspath(os.fspath(path))
+    doc = dict(manifest)
+    doc["requests"] = [{k: v for k, v in r.items() if k not in
+                        ("kv", "kv_len", "pending", "s_ext")}
+                       for r in manifest.get("requests", [])]
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def load_manifest(path: str | os.PathLike) -> dict:
+    with open(path, encoding="utf-8") as f:
+        m = json.load(f)
+    if m.get("format") != MANIFEST_FORMAT:
+        raise ValueError(f"manifest {path} has format {m.get('format')}; "
+                         f"this build reads format {MANIFEST_FORMAT}")
+    return m

@@ -71,6 +71,14 @@ EVENT_TYPES = frozenset({
     "fault",          # an injected/contained/engine-level failure seam fired
     "bailout",        # speculative chain failed; spec latched off
     "retire",         # request finished (reason = any FinishReason value)
+    # fleet serving (serve/fleet.py, docs/serving.md "Fleet serving"):
+    # migration rides the engine ring on BOTH sides of a hand-off, and
+    # the FleetController keeps its own recorder for routing + replica
+    # lifecycle (one timeline per surface, same event vocabulary).
+    "migrate_out",    # request handed off to another replica (drain)
+    "migrate_in",     # request adopted from a migration manifest
+    "route",          # fleet router placed a request on a replica
+    "replica_state",  # replica HEALTHY -> SUSPECT -> DEAD transitions
 })
 
 #: FinishReason values the ``retire`` event is specified over — the
