@@ -458,6 +458,51 @@ def _bench_serve_fleet():
     return r["serve_fleet_zero_loss"], r["fleet_toks_per_s"]
 
 
+def _bench_serve_fleet_trace():
+    """Fleet tracing overhead (scripts/bench_serve.py
+    bench_fleet_trace_overhead): the identical warmed fleet workload
+    with the WHOLE observability stack off (engine rings, controller
+    ring, router decision audit) vs full detail, paired fleet tokens/s
+    quotient — the fleet twin of serve_trace_overhead, same hot-path
+    contract (ring/audit appends only), same 0.95 floor."""
+    from scripts.bench_serve import bench_fleet_trace_overhead
+
+    r = bench_fleet_trace_overhead(n_replicas=2, batch=4,
+                                   prompt_len=16, new_tokens=32,
+                                   dim=32, repeats=2)
+    return r["serve_fleet_trace_overhead"]
+
+
+def _environment_provenance(contended: bool) -> dict:
+    """Environment stamp for the bench artifact (ROADMAP #5b
+    follow-through, docs/perf.md 'Bench trajectory'): the absolute
+    chain numbers are dispatch-sensitive, so every BENCH_r* must carry
+    the evidence needed to audit a swing — jax version, host load, CPU
+    count, and whether the contention sentinel flagged this session.
+    Without this, a future 'did ag_gemm regress?' reading has to guess
+    what machine state produced the number."""
+    import os
+    import platform
+
+    try:
+        load = [round(x, 2) for x in os.getloadavg()]
+    except OSError:
+        load = None
+    import jax
+
+    return {
+        "jax_version": jax.__version__,
+        "python_version": platform.python_version(),
+        "platform": platform.platform(),
+        "cpu_count": os.cpu_count(),
+        "loadavg_1m_5m_15m": load,
+        # the dispatch-sensitivity flag: True means the known-cost
+        # sentinel read low this session, so absolute fields are lower
+        # bounds, not regressions (paired ratios stay trustworthy)
+        "dispatch_sensitive": bool(contended),
+    }
+
+
 def check_floors(out: dict, floors: dict) -> tuple[dict, list]:
     """Per-metric guardrail (PERF_FLOORS.json, ROADMAP #5b): for each
     floor whose metric is present in ``out``, a ``vs_floor`` ratio
@@ -504,6 +549,7 @@ def main():
     spec_speedup = _bench_serve_spec()
     trace_overhead = _bench_serve_trace()
     fleet_zero_loss, fleet_tps = _bench_serve_fleet()
+    fleet_trace_overhead = _bench_serve_fleet_trace()
 
     peak = peak_bf16_tflops()
     vs = (tflops / peak) / REF_UTILIZATION if peak else 0.0
@@ -549,6 +595,11 @@ def main():
         # the fleet broke exactly-once — the PR 9 robustness bar.
         "serve_fleet_zero_loss": round(fleet_zero_loss, 4),
         "serve_fleet_toks_per_s": round(fleet_tps, 1),
+        # Fleet tracing overhead: fleet tokens/s with the full
+        # observability stack (engine rings + controller ring + router
+        # decision audit) over tokens/s with it all off — the
+        # fleet-wide hot-path bar (>= 0.95, like serve_trace_overhead).
+        "serve_fleet_trace_overhead": round(fleet_trace_overhead, 3),
         # Known-cost reference op (bare XLA dot, measured ceiling 189.7):
         # a depressed sentinel means the HOST was contended during this
         # session and `value` is a lower bound, not a regression.
@@ -565,6 +616,10 @@ def main():
         out["vs_floor"] = vs_floor
     if below:
         out["below_floor"] = below
+    # Environment provenance (ROADMAP #5b): the audit trail that lets a
+    # future session read this artifact's absolute numbers against the
+    # host state that produced them (docs/perf.md 'Bench trajectory').
+    out["env"] = _environment_provenance(contended)
     if contended:
         out["suspect_contention"] = True
     if ag_suspect or a2a_suspect:
@@ -581,7 +636,8 @@ def main():
           f"serve {serve_tps:.0f} tok/s (H8/H1 {serve_speedup:.2f}x, "
           f"spec/plain {spec_speedup:.2f}x t/dispatch, "
           f"trace {trace_overhead:.3f}x, "
-          f"fleet zero-loss {fleet_zero_loss:.3f}); "
+          f"fleet zero-loss {fleet_zero_loss:.3f}, "
+          f"fleet trace {fleet_trace_overhead:.3f}x); "
           f"sentinel dot {sentinel_tflops:.1f} TFLOPS"
           + (" (CONTENDED)" if contended else ""),
           file=sys.stderr)

@@ -266,16 +266,29 @@ def run_fleet(args, key):
                            prefill_chunk=max(8, page),
                            horizon=args.horizon,
                            pipeline=args.pipeline,
-                           max_queue=args.max_queue, snapshot_dir=d)
+                           max_queue=args.max_queue, snapshot_dir=d,
+                           trace_level=(1 if args.trace_level is None
+                                        else args.trace_level))
 
     root = args.snapshot_dir or tempfile.mkdtemp(prefix="fleet_")
     fc = FleetController(factory, args.fleet, root=root,
                          backoff_base_s=0.05, backoff_cap_s=2.0,
                          suspect_after_s=30.0, dead_after_s=120.0,
+                         trace_level=(1 if args.trace_level is None
+                                      else args.trace_level),
                          seed=args.seed)
     dist_print(f"fleet: {args.fleet} replicas x (pool {num_blocks} "
                f"blocks, batch {args.max_batch}), {args.requests} "
                f"requests under {root}")
+    srv = None
+    if args.metrics_port is not None:
+        # the FLEET aggregate exposition: serve_* merged across
+        # replicas + the fleet_* controller series
+        from triton_dist_tpu.serve.trace import start_metrics_server
+
+        srv = start_metrics_server(fc, port=args.metrics_port)
+        dist_print(f"fleet /metrics on port {srv.server_address[1]} "
+                   f"(aggregated across replicas)")
     params_s = SamplingParams(max_new_tokens=args.new_tokens,
                               temperature=args.temperature,
                               top_k=args.top_k, top_p=args.top_p,
@@ -320,9 +333,46 @@ def run_fleet(args, key):
                    f"{r.get('completed', 0)} completed, "
                    f"{r.get('migrated_in', 0)} migrated in / "
                    f"{r.get('migrated_out', 0)} out")
+    lat = s["latency"]
+
+    def _p(h, k):
+        v = h.get(k)
+        return f"{v * 1e3:.1f}" if v is not None else "-"
+
+    dist_print(f"fleet latency slo (merged across replicas): ttft "
+               f"p50/p95/p99 {_p(lat['ttft'], 'p50')}/"
+               f"{_p(lat['ttft'], 'p95')}/{_p(lat['ttft'], 'p99')} ms, "
+               f"itl p50/p95/p99 {_p(lat['itl'], 'p50')}/"
+               f"{_p(lat['itl'], 'p95')}/{_p(lat['itl'], 'p99')} ms")
+    slo = s["slo"]
+    dist_print(f"fleet slo burn ({slo['window_s']:.0f}s window): "
+               f"{slo['deadline_miss_window']} deadline misses, "
+               f"{slo['shed_window']} sheds "
+               f"({s['audit']['recorded']} routing decisions audited)")
     moved = [r for r, h in fc.history.items() if len(set(h)) > 1]
     if moved:
         dist_print(f"live-migrated requests: {sorted(moved)}")
+        for rid in sorted(moved)[:1]:
+            hops = [f"{e['kind']}->{e.get('chosen')}"
+                    for e in fc.explain(rid)
+                    if e["kind"] in ("route", "migrate")]
+            dist_print(f"  {rid} journey: {' '.join(hops)}")
+    if args.trace_perfetto:
+        path = fc.export_perfetto(args.trace_perfetto)
+        dist_print(f"fleet perfetto timeline: {path} (controller + "
+                   f"{args.fleet} replica tracks, migration flow "
+                   f"arrows; open in ui.perfetto.dev)")
+    if srv is not None:
+        import urllib.request
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.server_address[1]}/metrics",
+                timeout=10) as r:
+            body = r.read()
+        series = sum(1 for ln in body.decode().splitlines()
+                     if ln and not ln.startswith("#"))
+        dist_print(f"fleet metrics self-scrape: {len(body)} bytes, "
+                   f"{series} series")
+        srv.shutdown()
     dist_print("done")
 
 

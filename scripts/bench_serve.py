@@ -495,6 +495,90 @@ def bench_fleet(*, n_replicas: int = 2, batch: int = 4,
     }
 
 
+def bench_fleet_trace_overhead(*, n_replicas: int = 2, batch: int = 4,
+                               prompt_len: int = 16,
+                               new_tokens: int = 64, dim: int = 64,
+                               n_layers: int = 2, vocab: int = 256,
+                               page_size: int = 16, seed: int = 0,
+                               warmup: bool = True,
+                               repeats: int = 3) -> dict:
+    """Fleet tracing overhead (docs/observability.md "Fleet
+    observability"): the IDENTICAL warmed fleet workload (N replicas
+    behind the router, no chaos) runs with the whole observability
+    stack OFF (engine rings at trace_level=0, controller ring + router
+    decision audit disabled) and at FULL detail (trace_level=2), and
+    the headline is the paired fleet tokens/s quotient — the fleet twin
+    of ``bench_trace_overhead``.  The hot-path contract is the same
+    (ring/audit appends only), so this must stay ~1.0; ``bench.py``
+    carries it as ``serve_fleet_trace_overhead`` with a
+    ``PERF_FLOORS.json`` floor of 0.95.  Best-of-``repeats`` per leg."""
+    import shutil
+    import tempfile
+
+    from triton_dist_tpu.models import llama
+    from triton_dist_tpu.models.generate import Generator
+    from triton_dist_tpu.serve import Request, SamplingParams, ServeEngine
+    from triton_dist_tpu.serve.fleet import FleetController
+
+    max_seq = prompt_len + new_tokens
+    max_seq += (-max_seq) % page_size
+    cfg = llama.LlamaConfig(vocab=vocab, dim=dim, n_layers=n_layers,
+                            n_heads=2, n_kv_heads=2, ffn_dim=2 * dim,
+                            max_seq=max_seq, dtype=jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    params = llama.init_params(cfg, jax.random.key(seed))
+    gen = Generator(cfg, mesh, axis="sp", max_seq=max_seq)
+    per_req = -(-max_seq // page_size)
+    n_reqs = n_replicas * batch
+    rng = np.random.default_rng(seed)
+    reqs = [(f"t{i}", rng.integers(0, vocab, size=prompt_len)
+             .astype(np.int32)) for i in range(n_reqs)]
+    sp = SamplingParams(max_new_tokens=new_tokens)
+
+    def run(level: int) -> float:
+        root = tempfile.mkdtemp(prefix="bench_fleet_trace_")
+
+        def factory(d):
+            eng = ServeEngine(
+                gen, params, num_blocks=1 + per_req * batch,
+                page_size=page_size, max_batch=batch,
+                prefill_chunk=max(8, page_size), snapshot_dir=d,
+                trace_level=level)
+            if warmup:
+                eng.warmup()
+            return eng
+
+        fc = FleetController(factory, n_replicas, root=root,
+                             suspect_after_s=1e6, dead_after_s=2e6,
+                             trace_level=level, seed=seed)
+        for rid, prompt in reqs:
+            fc.submit(Request(rid, prompt, sp))
+        t0 = time.perf_counter()
+        while fc.has_work():
+            fc.step()
+        dt = time.perf_counter() - t0
+        toks = sum(len(o.token_ids) for o in fc.outputs.values())
+        assert toks == n_reqs * new_tokens
+        shutil.rmtree(root, ignore_errors=True)
+        return toks / dt
+
+    def best(level: int) -> float:
+        return max(run(level) for _ in range(max(repeats, 1)))
+
+    off_tps = best(0)
+    on_tps = best(2)
+    return {
+        "mode": "fleet_trace",
+        "replicas": n_replicas,
+        "batch": batch,
+        "new_tokens": new_tokens,
+        "fleet_toks_per_s_trace_off": round(off_tps, 1),
+        "fleet_toks_per_s_trace_on": round(on_tps, 1),
+        "serve_fleet_trace_overhead": round(
+            on_tps / off_tps if off_tps > 0 else 0.0, 3),
+    }
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--horizons", default="1,8",
@@ -522,7 +606,12 @@ def main():
                         "steady workload with tracing off vs full "
                         "detail — prints the paired tokens/s quotient "
                         "(bench.py's serve_trace_overhead; the "
-                        "PERF_FLOORS.json floor holds it >= 0.95)")
+                        "PERF_FLOORS.json floor holds it >= 0.95). "
+                        "Combined with --fleet N: FLEET tracing "
+                        "overhead (engine rings + controller ring + "
+                        "router decision audit off vs full) — "
+                        "bench.py's serve_fleet_trace_overhead, same "
+                        "0.95 floor")
     p.add_argument("--shared-prompt", action="store_true",
                    help="prefix-cache mode: cold vs warm shared-prompt "
                         "TTFT + hit rate (docs/serving.md 'Prefix "
@@ -548,6 +637,19 @@ def main():
         p.error(f"--turns must be >= 1, got {args.turns}")
     if args.fleet is not None and args.fleet < 1:
         p.error(f"--fleet must be >= 1, got {args.fleet}")
+    if args.fleet is not None and args.trace:
+        r = bench_fleet_trace_overhead(
+            n_replicas=args.fleet, batch=args.batch,
+            prompt_len=args.prompt_len, new_tokens=args.new_tokens,
+            dim=args.dim, n_layers=args.layers,
+            page_size=args.page_size, seed=args.seed,
+            warmup=not args.no_warmup)
+        print(json.dumps(r))
+        print(f"# fleet tracing on {r['fleet_toks_per_s_trace_on']:.1f} "
+              f"vs off {r['fleet_toks_per_s_trace_off']:.1f} tokens/s "
+              f"({r['serve_fleet_trace_overhead']:.3f}x — floor 0.95)",
+              file=sys.stderr)
+        return
     if args.fleet is not None:
         r = bench_fleet(n_replicas=args.fleet, batch=args.batch,
                         prompt_len=args.prompt_len,

@@ -122,6 +122,20 @@ def parse_args():
     p.add_argument("--fleet-stats-every", type=float, default=5.0,
                    help="fleet mode: seconds between fleet pressure "
                         "lines (needs --metrics-base-port)")
+    p.add_argument("--aggregate-port", type=int, default=None,
+                   help="fleet mode: serve a FLEET-LEVEL Prometheus "
+                        "aggregate at this port — each GET scrapes "
+                        "every replica's /metrics and merges them "
+                        "(serve.fleet.merge_scrapes: counters summed, "
+                        "SLO histograms bucket-exactly merged); needs "
+                        "--metrics-base-port (docs/observability.md "
+                        "'Fleet observability')")
+    p.add_argument("--fleet-trace-out", default=None, metavar="PATH",
+                   help="fleet mode: at exit, assemble the replicas' "
+                        "flight_*.json postmortems into ONE replica-"
+                        "namespaced Perfetto timeline at PATH "
+                        "(serve.fleet.assemble_fleet_trace; open in "
+                        "ui.perfetto.dev)")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="the serving command, after --")
     args = p.parse_args()
@@ -130,6 +144,15 @@ def parse_args():
         p.error("no child command given (pass it after --)")
     if args.fleet is not None and args.fleet < 1:
         p.error(f"--fleet must be >= 1, got {args.fleet}")
+    if args.aggregate_port is not None and args.fleet is None:
+        p.error("--aggregate-port needs --fleet")
+    if (args.aggregate_port is not None
+            and args.metrics_base_port is None):
+        p.error("--aggregate-port needs --metrics-base-port (the "
+                "aggregate is a scrape-and-merge over the replica "
+                "endpoints)")
+    if args.fleet_trace_out is not None and args.fleet is None:
+        p.error("--fleet-trace-out needs --fleet")
     if (args.metrics_base_port is None
             and any("{port}" in c for c in args.cmd)):
         # substituting the literal "None" would hand every child a
@@ -258,6 +281,11 @@ def postmortem(snapshot_dir: str,
     line = (f"[supervisor] postmortem {path}: "
             f"{len(rec.get('events', []))} events at step "
             f"{rec.get('step')}, reason {rec.get('reason')!r}")
+    if rec.get("audit"):
+        # a FLEET flight file (FleetController.flight_flush) carries the
+        # router decision audit — say so, it answers "why was this
+        # request on that replica" post-hoc
+        line += f", {len(rec['audit'])} routing decisions"
     if rec.get("statline"):
         line += f" — {rec['statline']}"
     print(line, flush=True)
@@ -356,7 +384,8 @@ class _Replica:
         self.state = ReplicaState.HEALTHY
         self.restart_at = None
 
-    def scrape(self) -> Optional[dict]:
+    def scrape_text(self) -> Optional[str]:
+        """Raw /metrics text (the aggregate endpoint merges these)."""
         if self.port is None or self.proc is None:
             return None
         import urllib.request
@@ -364,9 +393,44 @@ class _Replica:
             with urllib.request.urlopen(
                     f"http://127.0.0.1:{self.port}/metrics",
                     timeout=2) as r:
-                return parse_prometheus(r.read().decode())
+                return r.read().decode()
         except Exception:  # noqa: BLE001 — a scrape is best-effort
             return None
+
+    def scrape(self) -> Optional[dict]:
+        text = self.scrape_text()
+        return parse_prometheus(text) if text is not None else None
+
+
+class _ScrapeAggregate:
+    """``to_prometheus()`` adapter for ``serve.trace.start_metrics_server``:
+    each GET scrapes every live replica and merges the texts through
+    ``serve.fleet.merge_scrapes`` — the subprocess fleet's one-stop
+    Prometheus aggregate (counters summed, SLO histograms merged
+    bucket-exactly; docs/observability.md "Fleet observability")."""
+
+    def __init__(self, replicas):
+        self.replicas = replicas
+
+    def to_prometheus(self) -> str:
+        from concurrent.futures import ThreadPoolExecutor
+
+        from triton_dist_tpu.serve.fleet import merge_scrapes
+
+        # concurrent scrapes: each dead replica costs its 2 s timeout,
+        # and paying them SERIALLY would stall this endpoint ~2*N
+        # seconds exactly during the incidents it exists to observe —
+        # wall time must be the max, not the sum
+        with ThreadPoolExecutor(
+                max_workers=max(len(self.replicas), 1)) as ex:
+            scraped = list(ex.map(lambda r: r.scrape_text(),
+                                  self.replicas))
+        texts = [t for t in scraped if t is not None]
+        out = merge_scrapes(texts)
+        return (f"# HELP fleet_scraped_replicas replicas answering "
+                f"this aggregate scrape\n"
+                f"# TYPE fleet_scraped_replicas gauge\n"
+                f"fleet_scraped_replicas {len(texts)}\n" + out)
 
 
 def supervise_fleet(args) -> int:
@@ -375,6 +439,24 @@ def supervise_fleet(args) -> int:
     plus a periodic fleet pressure line from the Prometheus scrape —
     the subprocess half of docs/serving.md "Fleet serving"."""
     replicas = [_Replica(i, args) for i in range(args.fleet)]
+    # heartbeat stall detection only makes sense when the child command
+    # actually BEATS the per-replica file ({hb}): arming it for a child
+    # that never writes would read 'missing file' as 'stalled' once the
+    # grace passes and SIGKILL every healthy replica in a loop until
+    # the whole restart budget burned
+    hb_used = any("{hb}" in c for c in args.cmd)
+    if not hb_used:
+        print("[supervisor] fleet: child command does not use {hb}; "
+              "heartbeat stall detection disabled (process liveness "
+              "only)", flush=True)
+    if args.aggregate_port is not None:
+        from triton_dist_tpu.serve.trace import start_metrics_server
+
+        srv = start_metrics_server(_ScrapeAggregate(replicas),
+                                   port=args.aggregate_port)
+        print(f"[supervisor] fleet aggregate /metrics on port "
+              f"{srv.server_address[1]} (scrape-and-merge over "
+              f"{args.fleet} replicas)", flush=True)
     last_stats = time.monotonic()
     while True:
         now = time.monotonic()
@@ -409,8 +491,9 @@ def supervise_fleet(args) -> int:
                     print(f"[supervisor] {rep.name} exited {rc}; "
                           f"restarting in {delay:.2f}s", flush=True)
                 continue
-            # alive: heartbeat-driven health (armed past the grace)
-            armed = now - rep.started > args.grace_s
+            # alive: heartbeat-driven health (armed past the grace,
+            # and only when the child command beats the file at all)
+            armed = hb_used and now - rep.started > args.grace_s
             age = Heartbeat.age_s(rep.hb)
             if armed and Heartbeat.is_stalled(
                     rep.hb, interval_s=args.hb_interval):
@@ -431,6 +514,16 @@ def supervise_fleet(args) -> int:
                 rep.state = ReplicaState.HEALTHY
                 print(f"[supervisor] {rep.name} recovered", flush=True)
         if all(r.done or r.failed for r in replicas):
+            if args.fleet_trace_out is not None:
+                from triton_dist_tpu.serve.fleet import \
+                    assemble_fleet_trace
+
+                out = assemble_fleet_trace(
+                    [(rep.name, rep.dir) for rep in replicas],
+                    args.fleet_trace_out)
+                print(f"[supervisor] fleet timeline: "
+                      f"{out or 'no flight files to assemble'}",
+                      flush=True)
             failed = [r.name for r in replicas if r.failed]
             if failed:
                 print(f"[supervisor] fleet done; FAILED replicas: "
@@ -442,9 +535,16 @@ def supervise_fleet(args) -> int:
         if (args.metrics_base_port is not None
                 and now - last_stats >= args.fleet_stats_every):
             last_stats = now
+            # concurrent scrapes: a serial walk would block THIS loop —
+            # the one doing stall detection and restart pacing — for up
+            # to 2 s per unreachable replica, exactly mid-incident
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                    max_workers=max(len(replicas), 1)) as ex:
+                scrapes = list(ex.map(lambda r: r.scrape(), replicas))
             parts = []
-            for rep in replicas:
-                g = rep.scrape()
+            for rep, g in zip(replicas, scrapes):
                 if g is None:
                     parts.append(f"{rep.name}[{rep.state.value}]")
                 else:

@@ -811,3 +811,440 @@ def test_supervisor_signal_forwarding(tmp_path):
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 11: fleet-wide distributed tracing + exact SLO aggregation
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_taxonomy_and_series_documented(tiny, tmp_path):
+    """The PR-8 meta-test extended to the fleet: every event type the
+    recorder knows (the controller-side route/migrate/replica_state
+    ones included) and every controller-level Prometheus series
+    (``fleet.FLEET_SERIES``) must appear in docs/observability.md — and
+    the controller must actually emit what FLEET_SERIES declares, so
+    code, doc, and exposition cannot drift apart."""
+    from triton_dist_tpu.serve import trace as trace_mod
+    from triton_dist_tpu.serve.fleet import FLEET_SERIES
+
+    with open(os.path.join(REPO, "docs", "observability.md"),
+              encoding="utf-8") as f:
+        doc = f.read()
+    for ev in sorted(trace_mod.EVENT_TYPES):
+        assert f"`{ev}`" in doc, (
+            f"event type {ev!r} is not documented in "
+            f"docs/observability.md")
+    for name in FLEET_SERIES:
+        assert name in doc, (
+            f"fleet Prometheus series {name!r} is not documented in "
+            f"docs/observability.md")
+    # every controller-side emit() call uses a registered event type
+    import re
+    with open(os.path.join(REPO, "triton_dist_tpu", "serve",
+                           "fleet.py"), encoding="utf-8") as f:
+        emitted = set(re.findall(r'\.emit\(\s*"(\w+)"', f.read()))
+    assert emitted and emitted <= trace_mod.EVENT_TYPES
+    # ...and the exposition emits every declared series
+    cfg, params, gen = tiny
+    clock = _Tick()
+    fc = _fleet(gen, params, tmp_path / "fleet", clock, n=1)
+    text = fc.to_prometheus()
+    for name in FLEET_SERIES:
+        assert name in text, name
+    # histogram min/max gauges are documented too (scrape exactness)
+    assert "_min" in doc and "_max" in doc
+
+
+def test_merge_scrapes_bucket_exact_at_different_depths():
+    """The satellite-2 pin: two replicas whose histograms reached
+    DIFFERENT bucket depths merge through the scrape path
+    (text -> parse -> from_prom -> merge) into exactly the pooled-
+    sample histogram — buckets, count, sum, min/max, and percentiles
+    all bucket-exact — and the merged exposition stays monotone and
+    complete.  Counters sum per series; kv_utilization reports max."""
+    import numpy as _np
+
+    from triton_dist_tpu.serve.fleet import merge_scrapes
+    from triton_dist_tpu.serve.metrics import ServeMetrics
+    from triton_dist_tpu.serve.trace import LogHistogram
+
+    rng = _np.random.default_rng(11)
+    a, b = ServeMetrics(), ServeMetrics()
+    pooled = LogHistogram()
+    for x in rng.lognormal(-7.0, 0.8, size=400):     # µs-range: shallow
+        a.hist_ttft.observe(float(x))
+        pooled.observe(float(x))
+    for x in rng.lognormal(0.5, 1.0, size=300):      # sec-range: deep
+        b.hist_ttft.observe(float(x))
+        pooled.observe(float(x))
+    a.completed, b.completed = 3, 5
+    a.kv_util_last, b.kv_util_last = 0.2, 0.7
+    a.finish_reasons["length"] = 3
+    b.finish_reasons["length"] = 4
+    b.finish_reasons["shed"] = 1
+    merged = merge_scrapes([a.to_prometheus(), b.to_prometheus()])
+    g = parse_prometheus(merged)
+    got = LogHistogram.from_prom(g, "serve_ttft_seconds")
+    assert got.counts == pooled.counts
+    assert got.count == pooled.count
+    assert got.min == pooled.min and got.max == pooled.max
+    assert got.sum == pytest.approx(pooled.sum)
+    for p in (50, 95, 99):
+        assert got.percentile(p) == pooled.percentile(p), p
+    assert g["serve_completed_total"] == 8
+    assert g["serve_kv_utilization"] == 0.7           # max, not sum
+    assert g['serve_finished_total{reason="length"}'] == 7
+    assert g['serve_finished_total{reason="shed"}'] == 1
+    # monotone + complete: cumulative buckets never decrease and +Inf
+    # equals count, even though a and b reached disjoint depths
+    buckets = [(k, v) for k, v in g.items()
+               if k.startswith("serve_ttft_seconds_bucket")]
+    vals = [v for _, v in buckets]
+    assert vals == sorted(vals)
+    assert g['serve_ttft_seconds_bucket{le="+Inf"}'] == \
+        g["serve_ttft_seconds_count"]
+
+
+def test_trace_context_propagates_through_migration(tiny, tmp_path):
+    """Trace-context propagation at the engine level: a drained
+    request's manifest record carries its trace id + hop + ring-event
+    tail; the adopting engine bumps the hop, journals the context, and
+    seeds the carried events ahead of its own — so a crash-path
+    manifest built later from the TARGET's journal still knows the
+    journey."""
+    cfg, params, gen = tiny
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab, size=7).astype(np.int32)
+    sp = SamplingParams(max_new_tokens=8)
+    a = _engine(gen, params, snapshot_dir=str(tmp_path / "A"))
+    b = _engine(gen, params, snapshot_dir=str(tmp_path / "B"))
+    a.submit(Request("a", prompt, sp,
+                     trace={"trace_id": "fleet0/a", "hop": 0}))
+    for _ in range(5):
+        a.step()
+    manifest = a.drain()
+    (rec,) = manifest["requests"]
+    assert rec["trace"] == {"trace_id": "fleet0/a", "hop": 0}
+    assert rec["events"], "the ring tail must ride the manifest"
+    assert any(et == "submit" for _, _, et, _ in rec["events"])
+    # the source's migrate_out named the flow the adopter will close
+    mig_out = [e for e in a.trace.events() if e[2] == "migrate_out"]
+    assert mig_out[0][4]["flow"] == "fleet0/a#1"
+
+    assert b.migrate_in(manifest)["adopted"] == ["a"]
+    assert b._trace_ctx["a"] == {"trace_id": "fleet0/a", "hop": 1}
+    mig_in = [e for e in b.trace.events() if e[2] == "migrate_in"]
+    assert mig_in[0][4]["flow"] == "fleet0/a#1"
+    # carried events precede the adoption in B's ring
+    b_evs = b.trace.events()
+    assert [e[2] for e in b_evs].index("submit") < \
+        [e[2] for e in b_evs].index("migrate_in")
+    # the adopter's journal carries the bumped context: a crash-path
+    # manifest from B's directory continues the journey at hop 1
+    b._journal.sync()
+    jb = replay_journal(tmp_path / "B" / JOURNAL_NAME)
+    assert jb["a"].trace == {"trace_id": "fleet0/a", "hop": 1}
+    m2 = manifest_from_journal(str(tmp_path / "B"))
+    assert m2["requests"][0]["trace"] == {"trace_id": "fleet0/a",
+                                          "hop": 1}
+    assert list(b.run()["a"].token_ids)  # still serves to completion
+
+
+class _RecordingHist:
+    """LogHistogram wrapper capturing raw samples (the pooled-sample
+    oracle for the exact-merge assertions)."""
+
+    def __new__(cls, sink):
+        from triton_dist_tpu.serve.trace import LogHistogram
+
+        class _H(LogHistogram):
+            def observe(self, x):
+                sink.append(float(x))
+                super().observe(x)
+        return _H()
+
+
+def test_fleet_chaos_merged_timeline_and_exact_latency(tiny, tmp_path):
+    """THE ISSUE-11 acceptance gate: kill 1 of 3 replicas mid-decode
+    (live migration, same harness as the PR-9 chaos test), then assert
+    (a) the merged Perfetto export shows the migrated request as
+    connected spans on BOTH replicas with a flow link between them, and
+    (b) fleet_summary()['latency'] percentiles equal the histogram over
+    the POOLED per-replica samples bucket-exactly (dead life's samples
+    included via the death-time carry)."""
+    import json as _json
+
+    from triton_dist_tpu.serve.trace import (
+        FLEET_PID,
+        FLEET_REPLICA_PID_BASE,
+        LogHistogram,
+    )
+
+    cfg, params, gen = tiny
+    clock = _Tick()
+    inj = FaultInjector(seed=0).inject("forward", kill=True, at_call=14)
+    ttft_samples: list = []
+
+    def injector_for(d):
+        if (os.sep + "r0" + os.sep) in d and d.endswith("life1"):
+            return inj
+        return None
+
+    def factory(d):
+        eng = _engine(gen, params, snapshot_dir=d,
+                      faults=injector_for(d), clock=clock)
+        eng.metrics.hist_ttft = _RecordingHist(ttft_samples)
+        return eng
+
+    fc = FleetController(factory, 3, root=str(tmp_path / "fleet"),
+                         clock=clock, seed=0, suspect_after_s=50.0,
+                         dead_after_s=100.0, backoff_base_s=0.01,
+                         backoff_cap_s=0.1)
+    reqs = _mixed_reqs(cfg, 8)
+    oracle = _oracle(gen, params, reqs)
+    _drive_fleet(fc, reqs, stagger=2)
+    assert fc.deaths == 1
+    for rid, toks in oracle.items():
+        assert list(fc.outputs[rid].token_ids) == toks, rid
+    moved = [r for r, h in fc.history.items() if len(set(h)) > 1]
+    assert moved
+
+    # (b) exact latency merge: merged == pooled, bucket-exactly
+    pooled = LogHistogram()
+    for x in ttft_samples:
+        pooled.observe(x)
+    merged = fc.aggregate_metrics().hist_ttft
+    assert pooled.count == len(oracle)       # one TTFT per request
+    assert merged.counts == pooled.counts
+    assert merged.count == pooled.count
+    assert merged.min == pooled.min and merged.max == pooled.max
+    lat = fc.fleet_summary()["latency"]["ttft"]
+    for p, key in ((50, "p50"), (95, "p95"), (99, "p99")):
+        assert lat[key] == pooled.percentile(p), key
+
+    # (a) the merged timeline: one journey across replicas
+    path = fc.export_perfetto(str(tmp_path / "fleet.trace.json"))
+    with open(path) as f:
+        doc = _json.load(f)
+    evs = doc["traceEvents"]
+    rid = moved[0]
+    # the migrated request has a THREAD on >= 2 replica pids...
+    tid_by_pid = {e["pid"]: e["tid"] for e in evs
+                  if e.get("ph") == "M" and e.get("name") == "thread_name"
+                  and e["args"]["name"] == rid
+                  and e["pid"] != FLEET_PID}
+    assert len(tid_by_pid) >= 2, (rid, tid_by_pid)
+    assert all(p >= FLEET_REPLICA_PID_BASE for p in tid_by_pid)
+    # ...with actual SPANS on both sides (not just metadata)
+    for pid, tid in tid_by_pid.items():
+        spans = [e for e in evs if e.get("ph") == "X"
+                 and e["pid"] == pid and e["tid"] == tid]
+        assert spans, (rid, pid)
+    # ...and a flow link (s/f sharing an id) across two replica pids
+    flows = [e for e in evs if e.get("cat") == "migration"
+             and e.get("args", {}).get("rid") == rid]
+    starts = {e["id"]: e["pid"] for e in flows if e["ph"] == "s"}
+    finishes = {e["id"]: e["pid"] for e in flows if e["ph"] == "f"}
+    linked = [fid for fid in starts
+              if fid in finishes and starts[fid] != finishes[fid]]
+    assert linked, (rid, flows)
+    assert fc.fleet_id in linked[0]          # fleet-unique trace id
+    # the controller's own track is present
+    assert any(e.get("pid") == FLEET_PID for e in evs)
+
+
+def test_decision_audit_answers_placement_and_movement(tiny, tmp_path):
+    """The router decision audit: a routed request's entry carries the
+    candidate pressures and the chosen replica; a migration carries the
+    capacity-admission walk; a fleet-full shed is recorded; explain(rid)
+    returns exactly that request's trail; and the audit rides the fleet
+    postmortem flight file where the supervisor's postmortem reports
+    it."""
+    import sys as _sys
+
+    cfg, params, gen = tiny
+    clock = _Tick()
+    fc = _fleet(gen, params, tmp_path / "fleet", clock, n=2)
+    reqs = _mixed_reqs(cfg, 4)
+    for r in reqs:
+        fc.submit(r)
+    for _ in range(4):
+        fc.step()
+    victim = next(name for name, rep in fc.replicas.items()
+                  if any(s is not None for s in rep.engine.slots))
+    moved_rid = next(rid for rid, name in fc.placement.items()
+                     if name == victim)
+    fc.drain_replica(victim)
+    fc.run()
+    trail = fc.explain(moved_rid)
+    kinds = [e["kind"] for e in trail]
+    assert "route" in kinds and "migrate" in kinds
+    route = next(e for e in trail if e["kind"] == "route")
+    assert route["chosen"] == fc.history[moved_rid][0]
+    assert set(route["pressures"]) <= set(fc.replicas)
+    assert all(isinstance(v, float) for v in route["pressures"].values())
+    mig = next(e for e in trail if e["kind"] == "migrate")
+    assert mig["chosen"] == fc.history[moved_rid][-1] != victim
+    # a fleet postmortem carries the audit; the supervisor reports it
+    path = fc.flight_flush("test postmortem")
+    assert path is not None
+    with open(path) as f:
+        rec = json.load(f)
+    assert rec["audit"] and rec["slo"]["window_s"] == fc.slo_window_s
+    _sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from serve_supervisor import postmortem
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert postmortem(str(tmp_path / "fleet")) == path
+    assert "routing decisions" in buf.getvalue()
+
+
+def test_fleet_slo_burn_windows_and_shed_audit(tiny, tmp_path):
+    """Windowed SLO burn: a shed lands in fleet_summary()['slo'] (and
+    the fleet_* exposition) inside the window and ages out of it; the
+    deadline-miss window counts fleet-queue expiries too."""
+    cfg, params, gen = tiny
+    clock = _Tick()
+
+    def factory(d):
+        return _engine(gen, params, snapshot_dir=d, clock=clock,
+                       max_queue=1)
+
+    fc = FleetController(factory, 2, root=str(tmp_path / "fleet"),
+                         clock=clock, suspect_after_s=50.0,
+                         dead_after_s=100.0, backoff_base_s=0.01,
+                         backoff_cap_s=0.1, max_restarts=0,
+                         slo_window_s=20.0, seed=0)
+    rng = np.random.default_rng(0)
+
+    def req(rid, deadline=None):
+        return Request(rid, rng.integers(0, cfg.vocab, size=6)
+                       .astype(np.int32),
+                       SamplingParams(max_new_tokens=4,
+                                      deadline_s=deadline))
+
+    for i in range(2):
+        fc.submit(req(f"fill{i}"))
+    fc.submit(req("over"))
+    assert fc.outputs["over"].finish_reason is FinishReason.SHED
+    s = fc.fleet_summary()["slo"]
+    assert s["shed_window"] == 1 and s["shed_total"] == 1
+    assert s["shed_per_s"] == pytest.approx(1 / 20.0, rel=1e-6)
+    text = fc.to_prometheus()
+    assert "fleet_shed_window 1" in text
+    assert [e for e in fc.audit.entries() if e["kind"] == "shed"]
+    # fleet-queue deadline expiry feeds the deadline window
+    fc.kill_replica("r0", "test")
+    fc.kill_replica("r1", "test")
+    fc.submit(req("ttl", deadline=0.5))
+    clock.t += 5.0
+    fc.step()
+    assert fc.outputs["ttl"].finish_reason is FinishReason.DEADLINE
+    assert fc.fleet_summary()["slo"]["deadline_miss_window"] == 1
+    # the window FORGETS: past slo_window_s both counts age to zero
+    clock.t += 50.0
+    s2 = fc.fleet_summary()["slo"]
+    assert s2["shed_window"] == 0 and s2["deadline_miss_window"] == 0
+    assert s2["shed_total"] == 1          # totals keep counting
+
+
+def test_assemble_fleet_trace_from_flight_files(tmp_path):
+    """Subprocess-fleet timeline assembly (jax-free): per-replica
+    flight_*.json postmortems render under replica-namespaced pids with
+    the migration flow linked across them — what the supervisor's
+    --fleet-trace-out writes at exit."""
+    from triton_dist_tpu.serve.fleet import assemble_fleet_trace
+    from triton_dist_tpu.serve.trace import FLEET_REPLICA_PID_BASE
+
+    r0, r1 = tmp_path / "r0", tmp_path / "r1"
+    os.makedirs(r0)
+    os.makedirs(r1 / "life1")
+    flow = "fleet/q0#1"
+    with open(r0 / "flight_5.json", "w") as f:
+        json.dump({"reason": "kill", "step": 5, "events": [
+            [1.0, 1, "submit", "q0", {"prompt": 5}],
+            [1.5, 2, "admit", "q0", None],
+            [2.0, 3, "prefill_done", "q0", None],
+            [3.0, 5, "fault", None, {"point": "crash"}],
+        ]}, f)
+    with open(r1 / "life1" / "flight_9.json", "w") as f:
+        json.dump({"reason": "drain", "step": 9, "events": [
+            [3.5, 7, "migrate_in", "q0",
+             {"in_place": False, "flow": flow}],
+            [4.0, 8, "retire", "q0", {"reason": "length"}],
+        ]}, f)
+    out = assemble_fleet_trace([("r0", str(r0)), ("r1", str(r1))],
+                               str(tmp_path / "fleet.trace.json"))
+    assert out is not None
+    with open(out) as f:
+        evs = json.load(f)["traceEvents"]
+    pids = {e["pid"] for e in evs}
+    assert {FLEET_REPLICA_PID_BASE, FLEET_REPLICA_PID_BASE + 1} <= pids
+    flows = [e for e in evs if e.get("cat") == "migration"]
+    assert {e["ph"] for e in flows} == {"s", "f"}
+    assert all(e["id"] == flow for e in flows)
+    assert {e["pid"] for e in flows} == {FLEET_REPLICA_PID_BASE,
+                                         FLEET_REPLICA_PID_BASE + 1}
+    # an empty source set yields no file
+    assert assemble_fleet_trace([("rX", str(tmp_path / "nope"))],
+                                str(tmp_path / "none.json")) is None
+
+
+def test_fleet_trace_level_zero_disables_ring_and_audit(tiny, tmp_path):
+    """trace_level=0 on the controller: no controller events, no audit
+    entries, no flight flush — the 'off' leg bench_serve --fleet
+    --trace measures (the PERF_FLOORS serve_fleet_trace_overhead
+    contract)."""
+    cfg, params, gen = tiny
+    clock = _Tick()
+    fc = _fleet(gen, params, tmp_path / "fleet", clock, n=2,
+                trace_level=0)
+    reqs = _mixed_reqs(cfg, 2, new_tokens=4)
+    for r in reqs:
+        fc.submit(r)
+    fc.run()
+    assert len(fc.outputs) == 2
+    assert fc.trace.events() == [] and fc.trace.emitted == 0
+    assert fc.audit.recorded == 0 and fc.audit.entries() == []
+    assert fc.flight_flush("noop") is None
+
+
+def test_floor_file_has_fleet_trace_overhead():
+    with open(os.path.join(REPO, "PERF_FLOORS.json")) as f:
+        floors = json.load(f)["floors"]
+    assert floors["serve_fleet_trace_overhead"]["min"] == 0.95
+
+
+def test_fleet_queue_expires_parked_migration_recs(tiny, tmp_path):
+    """A deadline-carrying request whose migration rec is STRANDED in
+    the fleet queue (full outage: no healthy replica to adopt it) must
+    expire there — engines sweep WAITING rows whatever their carried
+    progress, and a rec no engine can see would otherwise be served
+    arbitrarily long past its TTL once a replica healed (review
+    regression: the sweep only covered fresh _pending_reqs)."""
+    cfg, params, gen = tiny
+    clock = _Tick()
+    fc = _fleet(gen, params, tmp_path / "fleet", clock, n=2,
+                max_restarts=0)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab, size=6).astype(np.int32)
+    fc.submit(Request("d0", prompt,
+                      SamplingParams(max_new_tokens=32, deadline_s=30.0)))
+    for _ in range(6):
+        fc.step()   # decoding: tokens already generated on its replica
+    assert len(fc.streams["d0"]) > 0
+    fc.kill_replica("r0", "test")
+    fc.kill_replica("r1", "test")
+    assert fc._pending_recs, "the rec must be parked (full outage)"
+    carried = list(fc.streams["d0"])
+    clock.t += 100.0          # TTL long gone
+    fc.step()
+    out = fc.outputs["d0"]
+    assert out.finish_reason is FinishReason.DEADLINE
+    assert "fleet queue (migrated)" in out.error
+    assert list(out.token_ids) == carried   # partial stream reported
+    assert not fc._pending_recs and not fc.has_work()
+    assert fc.fleet_summary()["slo"]["deadline_miss_total"] == 1

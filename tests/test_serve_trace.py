@@ -144,6 +144,69 @@ def test_log_histogram_percentiles_match_numpy():
     assert len(h.counts) == n_buckets
 
 
+def test_log_histogram_merge_exact_vs_pooled():
+    """ISSUE 11: merge() of identical bucket schemes is count-wise
+    addition — the merged histogram equals one fed the POOLED samples
+    bucket-exactly (counts, count, sum, min, max, and therefore every
+    percentile), which is what makes fleet p50/p95/p99 honest."""
+    rng = np.random.default_rng(3)
+    a_samples = rng.lognormal(-6.0, 1.0, size=1500)   # ~µs: shallow
+    b_samples = rng.lognormal(-1.0, 1.5, size=700)    # ~sec: deep
+    a, b, pooled = LogHistogram(), LogHistogram(), LogHistogram()
+    for x in a_samples:
+        a.observe(float(x))
+        pooled.observe(float(x))
+    for x in b_samples:
+        b.observe(float(x))
+        pooled.observe(float(x))
+    merged = LogHistogram().merge(a).merge(b)
+    assert merged.counts == pooled.counts
+    assert merged.count == pooled.count
+    assert merged.min == pooled.min and merged.max == pooled.max
+    assert merged.sum == pytest.approx(pooled.sum)
+    for p in (50, 90, 95, 99):
+        assert merged.percentile(p) == pooled.percentile(p), p
+    # a is untouched by being merged FROM
+    assert a.count == len(a_samples)
+    # mismatched schemes must refuse, not corrupt
+    with pytest.raises(ValueError, match="schemes differ"):
+        LogHistogram(per_decade=12).merge(a)
+
+
+def test_log_histogram_prom_round_trip_and_dense_buckets():
+    """The exposition round-trips EXACTLY (from_prom: de-accumulated
+    dense buckets + %.17g sum/min/max gauges), and the bucket lines are
+    dense — every le from underflow through the deepest reached bucket
+    — so cross-replica `sum by (le)` and scrape-and-merge stay monotone
+    and complete at different reached depths (the sparse nonzero-only
+    output broke exactly that)."""
+    from triton_dist_tpu.serve.fleet import parse_prometheus
+
+    rng = np.random.default_rng(4)
+    h = LogHistogram()
+    for x in rng.lognormal(-4.0, 2.0, size=800):
+        h.observe(float(x))
+    h.observe(0.0)      # underflow
+    h.observe(1e9)      # overflow
+    lines = h.prom_lines("x_seconds")
+    series = parse_prometheus("\n".join(lines))
+    h2 = LogHistogram.from_prom(series, "x_seconds")
+    assert h2.counts == h.counts
+    assert h2.count == h.count
+    assert h2.sum == h.sum                      # %.17g: exact
+    assert h2.min == h.min and h2.max == h.max
+    for p in (50, 95, 99):
+        assert h2.percentile(p) == h.percentile(p)
+    # dense: the emitted le set is the FULL prefix of the bucket ladder
+    # (no gaps), so every replica's exposition shares its le set
+    les = [float(k.split('le="', 1)[1][:-2])
+           for k in series if "_bucket{le=" in k and "+Inf" not in k]
+    assert len(les) == len(set(les))
+    edges = [h.lo] + [h.edge(i) for i in range(len(les) - 1)]
+    assert les == sorted(les)
+    assert les == pytest.approx(edges, rel=1e-5)   # %.6g labels
+
+
 def test_log_histogram_edge_cases():
     h = LogHistogram()
     assert h.percentile(50) is None and h.mean is None

@@ -129,7 +129,7 @@ from triton_dist_tpu.serve.request import (
     SamplingParams,
 )
 from triton_dist_tpu.serve.scheduler import FCFSScheduler, ReqState, Status
-from triton_dist_tpu.serve.trace import FlightRecorder
+from triton_dist_tpu.serve.trace import MIGRATE_EVENT_TAIL, FlightRecorder
 
 
 class QueueFull(RuntimeError):
@@ -871,6 +871,13 @@ class ServeEngine:
         self.slots: list[Optional[ReqState]] = [None] * max_batch
         self._states: dict[str, ReqState] = {}
         self._outputs: dict[str, RequestOutput] = {}
+        # distributed-tracing context per live request (docs/
+        # observability.md "Fleet observability"): {"trace_id", "hop"} —
+        # stamped by the fleet controller (or defaulted at submit),
+        # carried by migration manifests and the journal, bumped one hop
+        # per adopting life, so one request's journey is ONE trace
+        # however many replicas serve it.
+        self._trace_ctx: dict[str, dict] = {}
         # speculative-mode device state ([B]-shaped, slot-indexed)
         if self.spec_k:
             # The draft joins through the SAME padded fixed-chunk
@@ -1019,6 +1026,12 @@ class ServeEngine:
                 # was told this request never entered the engine, so a
                 # restore must not resurrect and serve it.
                 raise QueueFull(f"{req.request_id}: {msg}")
+        if req.trace is None:
+            # a bare engine starts the journey itself: the request id is
+            # fleet-unique within any one controller (duplicates are
+            # rejected), and the fleet stamps richer ids before submit
+            req.trace = {"trace_id": req.request_id, "hop": 0}
+        self._trace_ctx[req.request_id] = req.trace
         if self._journal_on(req.request_id):
             # Journaled before the shed retirement below: a shed writes
             # its finish record right after, so restore accounts it.
@@ -1173,7 +1186,11 @@ class ServeEngine:
                     "prompt": [int(x) for x in np.asarray(rs.req.prompt)],
                     "params": rs.req.params.to_dict(),
                     "ts": rs.req.arrival_time,
-                    "ftt": rs.metrics.first_token_time})
+                    "ftt": rs.metrics.first_token_time,
+                    # in-flight rows keep their trace context across
+                    # rotation: a crash-path manifest rebuilt from the
+                    # compacted journal must still carry the journey
+                    "trace": self._trace_ctx.get(rid)})
                 for i, t in enumerate(rs.generated):
                     recs.append({
                         "t": "tok", "rid": rid, "i": i, "tok": int(t),
@@ -1241,6 +1258,17 @@ class ServeEngine:
         # it into a manifest would lose their streams irrecoverably
         # (restore skips migrated rids by design).
         staged = []
+        # per-request ring tails, gathered ONCE (before any migrate_out
+        # event lands in the ring): the manifest carries each request's
+        # recent event trail so the adopting replica's ring continues
+        # the journey — the merged fleet timeline then shows one
+        # connected track across replicas (docs/observability.md
+        # "Fleet observability")
+        tails: dict[str, list] = {}
+        rid_set = set(rids)
+        for ts, step, etype, r, data in self.trace.events():
+            if r in rid_set:
+                tails.setdefault(r, []).append([ts, step, etype, data])
         for rid in rids:
             rs = self._states.get(rid)
             if rs is None or rs.status is Status.FINISHED:
@@ -1258,6 +1286,9 @@ class ServeEngine:
                 "first_sched": rs.metrics.first_scheduled_time,
                 "n_preempt": rs.metrics.n_preemptions,
                 "cb_off": rs.callback_disabled,
+                "trace": dict(self._trace_ctx.get(rid)
+                              or {"trace_id": rid, "hop": 0}),
+                "events": tails.get(rid, [])[-MIGRATE_EVENT_TAIL:],
             }
             # In-place eligibility is the restore invariant: a plain
             # RUNNING row between steps holds kv_len committed cache
@@ -1286,9 +1317,17 @@ class ServeEngine:
             if self._journal_on(rid):
                 self._journal.migrate(rid, len(rs.generated), now)
                 self._note_journal()
+            ctx = rec["trace"]
             self.trace.emit("migrate_out", rid,
                             tokens=len(rs.generated),
-                            in_place="kv" in rec)
+                            in_place="kv" in rec,
+                            trace=ctx["trace_id"], hop=ctx["hop"],
+                            # flow id of the hand-off this record opens:
+                            # the adopting replica's migrate_in closes
+                            # the SAME id (its hop is ours + 1), and the
+                            # merged Perfetto export draws the arrow
+                            flow=f"{ctx['trace_id']}#{ctx['hop'] + 1}")
+            self._trace_ctx.pop(rid, None)
             if rs.slot is not None:
                 self.slots[rs.slot] = None
             if rs.status is Status.WAITING:
@@ -1404,12 +1443,31 @@ class ServeEngine:
             # the source already fed its queue-wait into ITS histogram;
             # observing it again here would double-count the fleet SLO
             rm.queue_observed = rm.first_scheduled_time is not None
+            # trace continuity: same trace id, one hop deeper — this
+            # life's span of the journey.  The hop also names the flow
+            # id the source's migrate_out opened (crash-path manifests
+            # carry the ctx from the journal instead).
+            prev = rec.get("trace") or {"trace_id": rid, "hop": 0}
+            ctx = {"trace_id": prev.get("trace_id", rid),
+                   "hop": int(prev.get("hop", 0)) + 1}
             req = Request(rid, prompt, params, arrival_time=rm.arrival_time,
-                          on_token=_resolve_callback(on_token, rid))
+                          on_token=_resolve_callback(on_token, rid),
+                          trace=ctx)
             rs = ReqState(req=req, metrics=rm)
             rs.generated = tokens
             rs.journal_base = len(tokens)
             rs.callback_disabled = bool(rec.get("cb_off", False))
+            self._trace_ctx[rid] = ctx
+            if self.trace.level > 0 and rec.get("events"):
+                # the carried ring tail precedes this engine's own
+                # events: the adopting ring CONTINUES the journey, so a
+                # postmortem (or the merged fleet timeline) here shows
+                # the source-side lifecycle too.  Timestamps stay on
+                # the source's wall clock — one monotonic domain for
+                # in-process fleets; subprocess domains may skew
+                # (docs/observability.md).
+                self.trace.seed([[ts, step, et, rid, data]
+                                 for ts, step, et, data in rec["events"]])
             # journal the carried segment BEFORE serving resumes (the
             # restore-backfill rule: every life's journal is
             # self-contained on its own)
@@ -1458,7 +1516,9 @@ class ServeEngine:
             self.metrics.migrated_in += 1
             self.metrics.migrated_tokens += len(tokens)
             self.trace.emit("migrate_in", rid, tokens=len(tokens),
-                            in_place=in_place)
+                            in_place=in_place,
+                            trace=ctx["trace_id"], hop=ctx["hop"],
+                            flow=f"{ctx['trace_id']}#{ctx['hop']}")
             if (replay_tokens and req.on_token is not None
                     and not rs.callback_disabled):
                 for t in tokens:
@@ -1792,6 +1852,7 @@ class ServeEngine:
                                 if r.startswith("__warmup_")]:
                         del self._outputs[rid]
                         del self._states[rid]
+                        self._trace_ctx.pop(rid, None)
                     round_ += 1
         finally:
             self._in_warmup = False
@@ -2208,6 +2269,9 @@ class ServeEngine:
         self.metrics.observe_finish(rs.req.request_id, rs.metrics, reason)
         self.trace.emit("retire", rs.req.request_id,
                         reason=reason.value, n_tokens=len(rs.generated))
+        # the journey ends here: the per-request trace context must not
+        # outlive the request (the maps above are pruned; this one is too)
+        self._trace_ctx.pop(rs.req.request_id, None)
         return out
 
     # -- flight recorder plumbing ----------------------------------------

@@ -48,6 +48,7 @@ See docs/serving.md "Fleet serving" for the operator recipe.
 from __future__ import annotations
 
 import enum
+import glob
 import os
 import random
 import sys
@@ -59,13 +60,27 @@ from typing import Callable, Optional
 import numpy as np
 
 from triton_dist_tpu.runtime.watchdog import WatchdogTimeout
-from triton_dist_tpu.serve.metrics import RequestMetrics
+from triton_dist_tpu.serve.metrics import (
+    RequestMetrics,
+    ServeMetrics,
+    WindowedRate,
+)
 from triton_dist_tpu.serve.request import (
     FinishReason,
     Request,
     RequestOutput,
 )
-from triton_dist_tpu.serve.trace import FlightRecorder
+from triton_dist_tpu.serve.trace import (
+    FLEET_PID,
+    FLEET_REPLICA_PID_BASE,
+    FlightRecorder,
+    LogHistogram,
+    events_to_perfetto,
+    latest_flight,
+    link_migration_flows,
+    load_flight,
+    write_trace,
+)
 
 
 class ReplicaState(enum.Enum):
@@ -129,6 +144,77 @@ class RestartBackoff:
             return None
         d = min(self.cap_s, self.base_s * 2.0 ** (self.attempts - 1))
         return d * (1.0 + self.jitter * self._rng.random())
+
+
+# ---------------------------------------------------------------------------
+# Router decision audit: "why did this request land there / why did it
+# move", answerable post-hoc
+# ---------------------------------------------------------------------------
+
+
+class DecisionAudit:
+    """Bounded ring of fleet control decisions (docs/observability.md
+    "Fleet observability").
+
+    The flight recorder answers *what happened*; this ring answers *why
+    the router did it*: every ``route``/``migrate`` placement records
+    the candidate pressures it weighed and the replica it chose, every
+    ``shed`` the reason, every ``replica_state``/``restart`` the health
+    evidence.  Entries are small dicts ``{"ts", "step", "kind", "rid",
+    ...}`` in a ``deque(maxlen=capacity)`` — same hot-path discipline as
+    the recorder (append only, no I/O) and the same bounded-memory
+    contract.  The ring rides the fleet's postmortem flight flush, so a
+    supervisor reading the crash file sees the routing history that led
+    up to it."""
+
+    def __init__(self, capacity: int = 1024, enabled: bool = True):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._ring: deque = deque(maxlen=capacity)
+        self.enabled = enabled
+        self.recorded = 0
+
+    def record(self, ts: float, step: int, kind: str,
+               rid: Optional[str] = None, **data) -> None:
+        if not self.enabled:
+            return
+        self.recorded += 1
+        self._ring.append({"ts": ts, "step": step, "kind": kind,
+                           "rid": rid, **data})
+
+    @property
+    def dropped(self) -> int:
+        return self.recorded - len(self._ring)
+
+    def entries(self) -> list[dict]:
+        return list(self._ring)
+
+    def for_request(self, rid: str) -> list[dict]:
+        """Every decision that touched ``rid`` still in the ring — the
+        post-hoc "why is my request on r2" query
+        (``FleetController.explain``)."""
+        return [e for e in self._ring if e.get("rid") == rid]
+
+
+#: Controller-level Prometheus series ``FleetController.to_prometheus``
+#: emits ON TOP of the aggregated per-engine ``serve_*`` series.  Every
+#: name here must appear in docs/observability.md — enforced by the
+#: tier-1 fleet taxonomy meta-test (tests/test_serve_fleet.py), the
+#: fleet twin of the PR-8 event/fault coverage test.
+FLEET_SERIES = (
+    "fleet_replicas",              # gauge, {state=...}: replica counts
+    "fleet_lives_total",           # counter: replica lives ever started
+    "fleet_deaths_total",          # counter: replica deaths
+    "fleet_migrations_total",      # counter: requests moved between replicas
+    "fleet_completed_total",       # counter: requests retired fleet-wide
+    "fleet_steps_total",           # counter: fleet ticks
+    "fleet_pending",               # gauge: unplaced work (fleet queue)
+    "fleet_deadline_miss_window",  # gauge: deadline misses in the SLO window
+    "fleet_shed_window",           # gauge: sheds in the SLO window
+    "fleet_deadline_miss_per_s",   # gauge: deadline-miss burn rate
+    "fleet_shed_per_s",            # gauge: shed burn rate
+    "fleet_audit_records_total",   # counter: router decisions recorded
+)
 
 
 # ---------------------------------------------------------------------------
@@ -318,13 +404,18 @@ class FleetController:
                  backoff_jitter: float = 0.5,
                  healthy_reset_s: float = 60.0,
                  max_restarts: Optional[int] = None,
-                 trace_events: int = 2048, seed: int = 0):
+                 trace_events: int = 2048, trace_level: int = 1,
+                 audit_events: int = 1024,
+                 slo_window_s: float = 60.0,
+                 fleet_id: Optional[str] = None, seed: int = 0):
         if n_replicas < 1:
             raise ValueError(f"need >= 1 replica, got {n_replicas}")
         if not suspect_after_s < dead_after_s:
             raise ValueError(
                 f"need suspect_after_s < dead_after_s, got "
                 f"{suspect_after_s}, {dead_after_s}")
+        if trace_level < 0:
+            raise ValueError(f"trace_level must be >= 0, got {trace_level}")
         self._clock = clock
         self.router = router or Router()
         self.suspect_after_s = suspect_after_s
@@ -335,9 +426,33 @@ class FleetController:
             lambda r, now: now - (r.last_progress
                                   if r.last_progress is not None
                                   else now))
-        self.trace = FlightRecorder(capacity=trace_events)
+        self.trace = FlightRecorder(capacity=trace_events,
+                                    level=trace_level)
+        # the router decision audit ring (docs/observability.md "Fleet
+        # observability"); gated by the same level knob as the recorder
+        # so bench_serve --fleet --trace measures both off together
+        self.audit = DecisionAudit(capacity=audit_events,
+                                   enabled=trace_level > 0)
         os.makedirs(root, exist_ok=True)
         self.root = root
+        # trace-id namespace: fleet-unique request journeys.  rids are
+        # unique within one controller (duplicate submits raise), so the
+        # fleet id only needs to distinguish controllers sharing a sink.
+        self.fleet_id = fleet_id or (os.path.basename(
+            os.path.abspath(root)) or "fleet")
+        # fleet-level SLO burn windows: deadline misses and sheds over
+        # the trailing slo_window_s, fed at finalization wherever the
+        # retirement happened (an engine's sweep, the fleet queue's, or
+        # an admission shed)
+        self.slo_window_s = slo_window_s
+        self._slo_deadline = WindowedRate(slo_window_s)
+        self._slo_shed = WindowedRate(slo_window_s)
+        # dead lives' metrics, folded in before each engine is
+        # discarded (the in-process stand-in for a final scrape; a
+        # subprocess SIGKILL loses whatever its last scrape missed);
+        # their recorders ride along so trace-event totals survive too
+        self._carry = ServeMetrics()
+        self._carry_recorders: list = []
         now = self._clock()
         self.replicas: dict[str, EngineReplica] = {}
         self._backoff: dict[str, RestartBackoff] = {}
@@ -383,6 +498,11 @@ class FleetController:
         rid = req.request_id
         if rid in self.streams:
             raise ValueError(f"duplicate request id {rid!r}")
+        if req.trace is None:
+            # fleet-unique trace id, hop 0: one journey however many
+            # replicas end up serving it (docs/observability.md
+            # "Fleet observability")
+            req.trace = {"trace_id": f"{self.fleet_id}/{rid}", "hop": 0}
         if req.arrival_time is None:
             req.arrival_time = self._clock()  # fleet-queue deadlines
         self.streams[rid] = []
@@ -408,14 +528,29 @@ class FleetController:
                      or l.queue_depth
                      < self.replicas[n].engine.max_queue)]
         deadline = req.params.deadline_s is not None
+        # candidate pressures, captured BEFORE the walk: the audit
+        # entry answers "why did this request land there" with the
+        # numbers the router actually weighed.  Gated on the audit knob
+        # — the trace_level=0 "off" leg of bench_serve --fleet --trace
+        # must not pay the O(replicas) capture either.
+        pressures = ({n: round(self.router.pressure(l, deadline=deadline),
+                               4) for n, l in cands}
+                     if self.audit.enabled else None)
+        skipped = []
         for name in self.router.rank(cands, deadline=deadline):
             rep = self.replicas[name]
             try:
                 shed = rep.engine.submit(req)
             except QueueFull:
+                skipped.append(name)
                 continue
             self.trace.emit("route", req.request_id, replica=name,
                             state=rep.state.value, deadline=deadline)
+            if self.audit.enabled:
+                self.audit.record(self._clock(), self.steps, "route",
+                                  req.request_id, chosen=name,
+                                  deadline=deadline, pressures=pressures,
+                                  skipped=skipped)
             self.placement[req.request_id] = name
             self.history[req.request_id].append(name)
             if shed is not None:   # raced to a full queue: final verdict
@@ -442,6 +577,8 @@ class FleetController:
                             finish_reason=FinishReason.SHED,
                             metrics=rm, error=msg)
         self.trace.emit("retire", req.request_id, reason="shed")
+        self.audit.record(self._clock(), self.steps, "shed",
+                          req.request_id, why=msg)
         self._finalize(out, "fleet")
 
     def _place_rec(self, header: dict, rec: dict,
@@ -452,18 +589,29 @@ class FleetController:
         rid = rec["rid"]
         cands = [(n, l) for n, l in self._healthy() if n not in exclude]
         params_deadline = rec.get("params", {}).get("deadline_s")
-        for name in self.router.rank(cands,
-                                     deadline=params_deadline is not None):
+        deadline = params_deadline is not None
+        pressures = ({n: round(self.router.pressure(l, deadline=deadline),
+                               4) for n, l in cands}
+                     if self.audit.enabled else None)
+        rejected = {}
+        for name in self.router.rank(cands, deadline=deadline):
             rep = self.replicas[name]
             res = rep.engine.migrate_in(
                 {**header, "requests": [rec]},
                 on_token={rid: self._cbs.get(rid)})
             if rid in res["rejected"]:
+                rejected[name] = res["rejected"][rid]
                 continue
             self.migrations += 1
             self.trace.emit("migrate_in", rid, replica=name,
                             state=rep.state.value,
                             in_place=rid in res["adopted"])
+            if self.audit.enabled:
+                self.audit.record(self._clock(), self.steps, "migrate",
+                                  rid, chosen=name,
+                                  in_place=rid in res["adopted"],
+                                  pressures=pressures,
+                                  rejected=rejected)
             self.placement[rid] = name
             self.history[rid].append(name)
             return True
@@ -471,9 +619,9 @@ class FleetController:
 
     def _drain_pending(self, exclude: frozenset = frozenset()) -> None:
         for _ in range(len(self._pending_recs)):
-            header, rec = self._pending_recs.popleft()
+            header, rec, expires = self._pending_recs.popleft()
             if not self._place_rec(header, rec, exclude):
-                self._pending_recs.append((header, rec))
+                self._pending_recs.append((header, rec, expires))
         for _ in range(len(self._pending_reqs)):
             req = self._pending_reqs.popleft()
             if not self._place_request(req):
@@ -487,6 +635,7 @@ class FleetController:
         migrate + schedule restart) → health sweep.  Returns the
         requests that finished this tick."""
         now = self._clock()
+        self.trace.set_step(self.steps)
         finished: list[RequestOutput] = []
         # deadline sweep over the FLEET queue: a request parked here
         # (no healthy replica when it arrived) is visible to no
@@ -509,6 +658,33 @@ class FleetController:
                 finished.append(out)
             else:
                 self._pending_reqs.append(req)
+        # ...and over the parked MIGRATION records: a deadline-carrying
+        # rec stranded here during an outage is just as invisible to
+        # every engine's sweep (engines expire WAITING rows whatever
+        # their carried progress; the fleet queue must match)
+        for _ in range(len(self._pending_recs)):
+            header, rec, expires = self._pending_recs.popleft()
+            if expires is not None and now > expires:
+                rid = rec["rid"]
+                ttl = rec["params"]["deadline_s"]
+                # expires was arrival(rebased) + ttl: recover the
+                # arrival so the retirement's latency is the >= ttl
+                # wait it actually suffered, not zero
+                rm = RequestMetrics(arrival_time=expires - ttl)
+                rm.finish_time = now
+                out = RequestOutput(
+                    request_id=rid,
+                    prompt=np.asarray(rec.get("prompt", []), np.int32),
+                    token_ids=[int(t) for t in rec.get("tokens", [])],
+                    finish_reason=FinishReason.DEADLINE, metrics=rm,
+                    error=f"deadline "
+                          f"{rec['params']['deadline_s']}s exceeded "
+                          f"in the fleet queue (migrated)")
+                self.trace.emit("retire", rid, reason="deadline")
+                self._finalize(out, "fleet")
+                finished.append(out)
+            else:
+                self._pending_recs.append((header, rec, expires))
         for name, rep in self.replicas.items():
             if (rep.state is ReplicaState.DEAD
                     and rep.restart_at is not None
@@ -519,6 +695,8 @@ class FleetController:
                 self.trace.emit("replica_state", None, replica=name,
                                 state=rep.state.value,
                                 life=rep.life)
+                self.audit.record(now, self.steps, "restart",
+                                  replica=name, life=rep.life)
         self._drain_pending()
         for name, rep in self.replicas.items():
             if rep.state is ReplicaState.DEAD or rep.engine is None:
@@ -545,6 +723,9 @@ class FleetController:
                 rep.state = ReplicaState.HEALTHY  # progress: recovered
                 self.trace.emit("replica_state", None, replica=name,
                                 state=rep.state.value)
+                self.audit.record(now, self.steps, "replica_state",
+                                  replica=name, state=rep.state.value,
+                                  why="progress resumed")
             for out in outs:
                 self._finalize(out, name)
                 finished.append(out)
@@ -562,6 +743,9 @@ class FleetController:
                 self.trace.emit("replica_state", None, replica=name,
                                 state=rep.state.value,
                                 age=round(age, 3))
+                self.audit.record(now, self.steps, "replica_state",
+                                  replica=name, state=rep.state.value,
+                                  age=round(age, 3))
             elif (age <= self.suspect_after_s
                   and rep.state is ReplicaState.SUSPECT):
                 # the probe says healthy again (an IDLE suspect replica
@@ -571,6 +755,9 @@ class FleetController:
                 rep.state = ReplicaState.HEALTHY
                 self.trace.emit("replica_state", None, replica=name,
                                 state=rep.state.value)
+                self.audit.record(now, self.steps, "replica_state",
+                                  replica=name, state=rep.state.value,
+                                  why="probe healthy")
         self.steps += 1
         return finished
 
@@ -634,6 +821,28 @@ class FleetController:
               f"in-flight requests", file=sys.stderr)
         if rep.engine is not None and rep.engine._journal is not None:
             rep.engine._journal.close()  # single writer for the mark
+        if rep.engine is not None:
+            # fold the dying life's metrics into the fleet carry so the
+            # aggregate histograms keep its samples (the in-process
+            # stand-in for a subprocess replica's final scrape — a
+            # SIGKILL there loses whatever the last scrape missed)
+            m = rep.engine.metrics
+            self._carry.merge(m)
+            # ...but NOT its point-in-time gauges: a dead replica's
+            # current queue/batch/KV state is zero, and carrying its
+            # last readings would hold a pressure alert firing forever
+            # (peaks stay — they are history, not state)
+            self._carry.queue_depth_last = 0
+            self._carry.running_last = 0
+            self._carry.kv_util_last = 0.0
+            # compile/trace counters have no additive field to merge
+            # (compile_misses is a property over the registered
+            # CountingJit wrappers; the recorder is an object) — carry
+            # the frozen objects themselves so the in-process aggregate
+            # reports the same totals the scrape path would sum
+            self._carry.compiled_fns.extend(m.compiled_fns)
+            if m.recorder is not None:
+                self._carry_recorders.append(m.recorder)
         life_dir = rep.life_dir
         rep.engine = None  # the process is gone; durable state remains
         rep.state = ReplicaState.DEAD
@@ -641,6 +850,8 @@ class FleetController:
         self.deaths += 1
         self.trace.emit("replica_state", None, replica=name,
                         state=rep.state.value, why=why)
+        self.audit.record(now, self.steps, "replica_state",
+                          replica=name, state=rep.state.value, why=why)
         manifest = manifest_from_journal(life_dir, mark=True)
         # retirements whose outputs the dying step swallowed: the
         # journal's fin records are the accounting of record
@@ -656,6 +867,10 @@ class FleetController:
                   f"staying dead", file=sys.stderr)
         else:
             rep.restart_at = now + delay
+        # fleet postmortem: the controller ring + decision audit land
+        # next to the replica dirs, where the supervisor's postmortem
+        # glob (and any operator) finds them
+        self.flight_flush(f"replica {name} dead: {why}")
 
     def _absorb_manifest(self, manifest: dict, source: str) -> None:
         """Fold a migration manifest into fleet accounting: fill each
@@ -666,6 +881,10 @@ class FleetController:
         header = {k: manifest[k] for k in
                   ("format", "clock", "page_size", "kv_geom")
                   if k in manifest}
+        # re-base the source clock so a parked rec's TTL can expire on
+        # OURS (the fleet-queue deadline sweep covers these too — a rec
+        # stranded by an outage is visible to no engine's sweep)
+        offset = self._clock() - (manifest.get("clock") or 0.0)
         for rec in manifest.get("requests", ()):
             rid = rec["rid"]
             if rid not in self.streams:
@@ -678,10 +897,21 @@ class FleetController:
                 f"invariant broke")
             self.streams[rid].extend(int(t) for t in toks[d:])
             self.placement.pop(rid, None)
-            self._pending_recs.append((header, rec))
+            ttl = rec.get("params", {}).get("deadline_s")
+            arr = rec.get("arrival")
+            expires = (arr + offset + ttl
+                       if ttl is not None and arr is not None else None)
+            self._pending_recs.append((header, rec, expires))
 
     def _finalize(self, out: RequestOutput, name: str) -> None:
         rid = out.request_id
+        # SLO burn windows: every deadline miss / shed fleet-wide feeds
+        # here, whichever layer retired it (engine sweep, fleet-queue
+        # sweep, admission shed)
+        if out.finish_reason is FinishReason.DEADLINE:
+            self._slo_deadline.observe(self._clock())
+        elif out.finish_reason is FinishReason.SHED:
+            self._slo_shed.observe(self._clock())
         self.outputs[rid] = out
         s = self.streams.get(rid)
         if s is not None and len(s) < len(out.token_ids):
@@ -703,10 +933,68 @@ class FleetController:
 
     # -- observability ----------------------------------------------------
 
+    def aggregate_metrics(self) -> ServeMetrics:
+        """The fleet as ONE ``ServeMetrics``: every live replica's
+        metrics plus the dead lives' carry, merged via
+        ``ServeMetrics.merge`` — counters add, the SLO histograms merge
+        bucket-EXACTLY (``LogHistogram.merge``), so
+        ``fleet_summary()["latency"]`` percentiles equal percentiles
+        over the pooled per-replica samples (the chaos test pins the
+        equality).  This is the in-process aggregation path; subprocess
+        fleets get the same numbers from :func:`merge_scrapes` over the
+        per-replica ``/metrics`` texts."""
+        agg = ServeMetrics()
+        agg.merge(self._carry)
+        # compile-stall and trace-event totals ride as object
+        # registries, not counters, so merge() skips them; re-register
+        # dead lives' frozen wrappers + every live engine's so the
+        # in-process exposition reports the same sums the subprocess
+        # scrape-and-merge path would (serve_compile_misses,
+        # serve_trace_events_total, serve_trace_dropped)
+        agg.compiled_fns.extend(self._carry.compiled_fns)
+        recorders = list(self._carry_recorders)
+        for rep in self.replicas.values():
+            if rep.engine is not None:
+                m = rep.engine.metrics
+                agg.merge(m)
+                agg.compiled_fns.extend(m.compiled_fns)
+                if m.recorder is not None:
+                    recorders.append(m.recorder)
+        if recorders:
+            from types import SimpleNamespace
+            agg.recorder = SimpleNamespace(
+                emitted=sum(r.emitted for r in recorders),
+                dropped=sum(r.dropped for r in recorders))
+        return agg
+
+    def explain(self, rid: str) -> list[dict]:
+        """The decision-audit trail for one request — why it landed
+        where it did and why it moved (route/migrate/shed entries still
+        in the bounded ring)."""
+        return self.audit.for_request(rid)
+
+    def slo_stats(self) -> dict:
+        """Windowed SLO burn (fleet_summary()["slo"]): deadline misses
+        and sheds over the trailing ``slo_window_s`` — the burn-rate
+        numbers an alert fires on, next to the all-time totals."""
+        now = self._clock()
+        return {
+            "window_s": self.slo_window_s,
+            "deadline_miss_window": self._slo_deadline.count(now),
+            "shed_window": self._slo_shed.count(now),
+            "deadline_miss_per_s": self._slo_deadline.rate(now),
+            "shed_per_s": self._slo_shed.rate(now),
+            "deadline_miss_total": self._slo_deadline.total,
+            "shed_total": self._slo_shed.total,
+        }
+
     def fleet_summary(self) -> dict:
-        """One dict of fleet state: per-replica health/lives/load plus
-        the routing + migration counters (the fleet twin of
-        ``ServeMetrics.summary``)."""
+        """One dict of fleet state: per-replica health/lives/load, the
+        routing + migration counters, the MERGED SLO latency percentiles
+        (``latency`` — exact histogram merge across replicas, dead lives
+        included), the windowed SLO burn (``slo``), and the decision-
+        audit occupancy (``audit``) — the fleet twin of
+        ``ServeMetrics.summary``."""
         reps = {}
         for name, rep in self.replicas.items():
             r = {
@@ -725,10 +1013,298 @@ class FleetController:
                          migrated_out=rep.engine.metrics.migrated_out)
             reps[name] = r
         return {
+            "fleet_id": self.fleet_id,
             "replicas": reps,
             "steps": self.steps,
             "deaths": self.deaths,
             "migrations": self.migrations,
             "completed": len(self.outputs),
             "pending": len(self._pending_reqs) + len(self._pending_recs),
+            "latency": self.aggregate_metrics().latency_stats(),
+            "slo": self.slo_stats(),
+            "audit": {"recorded": self.audit.recorded,
+                      "dropped": self.audit.dropped},
         }
+
+    def to_prometheus(self) -> str:
+        """The fleet's Prometheus exposition: the per-engine ``serve_*``
+        series AGGREGATED across replicas (counters summed, histograms
+        bucket-exactly merged — :meth:`aggregate_metrics`), plus the
+        controller-level ``fleet_*`` series (:data:`FLEET_SERIES`,
+        documented in docs/observability.md).  Subprocess fleets build
+        the same serve_* aggregate with :func:`merge_scrapes`."""
+        now = self._clock()
+        states: dict[str, int] = {}
+        for rep in self.replicas.values():
+            states[rep.state.value] = states.get(rep.state.value, 0) + 1
+        L = ["# TYPE fleet_replicas gauge"]
+        for state in sorted(states):
+            L.append(f'fleet_replicas{{state="{state}"}} {states[state]}')
+        L.append("# TYPE fleet_lives_total counter")
+        L.append(f"fleet_lives_total "
+                 f"{sum(r.life for r in self.replicas.values())}")
+        L.append("# TYPE fleet_deaths_total counter")
+        L.append(f"fleet_deaths_total {self.deaths}")
+        L.append("# TYPE fleet_migrations_total counter")
+        L.append(f"fleet_migrations_total {self.migrations}")
+        L.append("# TYPE fleet_completed_total counter")
+        L.append(f"fleet_completed_total {len(self.outputs)}")
+        L.append("# TYPE fleet_steps_total counter")
+        L.append(f"fleet_steps_total {self.steps}")
+        L.append("# TYPE fleet_pending gauge")
+        L.append(f"fleet_pending "
+                 f"{len(self._pending_reqs) + len(self._pending_recs)}")
+        L.append("# TYPE fleet_deadline_miss_window gauge")
+        L.append(f"fleet_deadline_miss_window "
+                 f"{self._slo_deadline.count(now)}")
+        L.append("# TYPE fleet_shed_window gauge")
+        L.append(f"fleet_shed_window {self._slo_shed.count(now)}")
+        L.append("# TYPE fleet_deadline_miss_per_s gauge")
+        L.append(f"fleet_deadline_miss_per_s "
+                 f"{self._slo_deadline.rate(now):.6g}")
+        L.append("# TYPE fleet_shed_per_s gauge")
+        L.append(f"fleet_shed_per_s {self._slo_shed.rate(now):.6g}")
+        L.append("# TYPE fleet_audit_records_total counter")
+        L.append(f"fleet_audit_records_total {self.audit.recorded}")
+        return "\n".join(L) + "\n" + self.aggregate_metrics().to_prometheus()
+
+    # -- the merged fleet timeline ----------------------------------------
+
+    def _trace_sources(self) -> list:
+        """``[(name, pid, events), ...]`` — the controller ring plus one
+        entry per replica: the live engine's ring, preceded by every
+        dead life's postmortem flight events (the ring dies with the
+        life; the crash-path ``force=True`` flush is where it
+        survives)."""
+        sources = [("fleet", FLEET_PID, self.trace.events())]
+        for i, (name, rep) in enumerate(self.replicas.items()):
+            evs: list = []
+            for life in range(1, rep.life + 1):
+                if rep.engine is not None and life == rep.life:
+                    continue   # the live ring below covers this life
+                fl = latest_flight(os.path.join(rep.root, f"life{life}"))
+                if fl is None:
+                    continue
+                try:
+                    evs.extend(tuple(e)
+                               for e in load_flight(fl).get("events", ()))
+                except (OSError, ValueError):
+                    continue
+            if rep.engine is not None:
+                evs.extend(rep.engine.trace.events())
+            sources.append((name, FLEET_REPLICA_PID_BASE + i, evs))
+        return sources
+
+    def to_perfetto(self) -> dict:
+        """ONE fleet timeline as a Chrome trace: the controller's
+        routing/health track plus every replica's engine timeline under
+        its own replica-namespaced pid, with Perfetto flow arrows
+        linking each ``migrate_out``→``migrate_in`` pair — a migrated
+        request reads as one continuous journey across replica tracks
+        (docs/observability.md "Fleet observability").  Dead lives'
+        events come from their postmortem flight files; a request's
+        carried ring tail also re-renders on its adopting replica (the
+        same journey seen from both sides — intentional)."""
+        srcs = self._trace_sources()
+        events: list[dict] = []
+        tids: dict[int, dict] = {}
+        for name, pid, evs in srcs:
+            pname = ("fleet controller" if pid == FLEET_PID
+                     else f"replica {name} (serve engine)")
+            tids[pid] = {}
+            events.extend(events_to_perfetto(evs, pid=pid,
+                                             process_name=pname,
+                                             tids_out=tids[pid]))
+        # flows bind replica-side events only: the controller also logs
+        # migrate_in, and anchoring there would draw arrows to the
+        # routing track instead of across replicas
+        events.extend(link_migration_flows(
+            [(pid, evs) for _, pid, evs in srcs if pid != FLEET_PID],
+            tids))
+        return {"traceEvents": events}
+
+    def export_perfetto(self, path: str) -> str:
+        """Write :meth:`to_perfetto` to ``path`` (gzipped on ``.gz``)."""
+        return write_trace(self.to_perfetto(), path)
+
+    def export_profile(self, job_dir: str, rank: int = 0) -> str:
+        """Drop the merged fleet timeline where
+        ``runtime.profiling.merge_rank_traces`` globs per-rank traces
+        (``{job_dir}/rank{rank}/fleet.trace.json.gz``): run a
+        ``group_profile`` capture into the same ``job_dir``, call this,
+        then merge — ONE ui.perfetto.dev file holds the device timeline,
+        the controller, and every replica side by side
+        (docs/observability.md has the recipe)."""
+        out = os.path.join(job_dir, f"rank{rank}", "fleet.trace.json.gz")
+        return write_trace(self.to_perfetto(), out)
+
+    def flight_flush(self, reason: str) -> Optional[str]:
+        """Fleet postmortem: the controller ring + the decision audit to
+        ``{root}/flight_<step>.json`` (the supervisor's postmortem glob
+        and ``load_flight`` both read it).  Deliberately UNthrottled
+        within a step: a second replica death in the same fleet step
+        re-flushes — overwriting the same file with a superset of the
+        ring — instead of silently losing the later death's record;
+        flush volume is bounded by death count anyway.  Best-effort
+        like the engine's."""
+        if self.trace.level <= 0:
+            return None
+        self.trace.set_step(self.steps)
+        try:
+            return self.trace.flush(
+                self.root, reason=reason,
+                extra={"audit": self.audit.entries(),
+                       "slo": self.slo_stats()})
+        except Exception:  # noqa: BLE001 — crash-path best effort
+            return None
+
+
+# ---------------------------------------------------------------------------
+# Subprocess fleets: scrape-and-merge metrics + flight-file timeline
+# assembly (no in-process controller to ask)
+# ---------------------------------------------------------------------------
+
+#: Histogram base names in the ``serve_*`` exposition (the five SLO
+#: histograms ``ServeMetrics.to_prometheus`` emits) — what
+#: :func:`merge_scrapes` reconstructs bucket-exactly instead of summing
+#: raw series.
+SCRAPE_HISTOGRAMS = (
+    "serve_ttft_seconds", "serve_itl_seconds",
+    "serve_queue_time_seconds", "serve_step_time_seconds",
+    "serve_snapshot_seconds",
+)
+
+
+def merge_scrapes(texts: list) -> str:
+    """Merge per-replica ``/metrics`` scrape texts into ONE fleet-level
+    ``serve_*`` exposition — the subprocess twin of
+    ``FleetController.aggregate_metrics`` (docs/observability.md "Fleet
+    observability").
+
+    Counters (and labeled counter families) sum per series; gauges sum
+    except ``serve_kv_utilization`` (a ratio: the merged exposition
+    reports the max — the pressure signal an operator actually wants);
+    the five SLO histograms are REBUILT per scrape
+    (``LogHistogram.from_prom`` de-accumulates the dense cumulative
+    buckets) and merged count-wise, so the merged percentiles equal the
+    pooled-sample histogram bucket-exactly even when replicas reached
+    different bucket depths — summing raw ``_bucket`` series per ``le``
+    would undercount exactly there (the tier-1 merge-vs-pooled test
+    pins this)."""
+    hists = {h: LogHistogram() for h in SCRAPE_HISTOGRAMS}
+    sums: dict[str, float] = {}
+    maxes: dict[str, float] = {}
+    types: dict[str, str] = {}
+    order: list[str] = []
+    for text in texts:
+        g = parse_prometheus(text)
+        for h, acc in hists.items():
+            acc.merge(LogHistogram.from_prom(g, h))
+        for key, v in g.items():
+            base = key.split("{", 1)[0]
+            if any(base == h or base.startswith(h + "_")
+                   for h in SCRAPE_HISTOGRAMS):
+                continue   # histogram series: rebuilt above
+            if key not in sums and key not in maxes:
+                order.append(key)
+            if base == "serve_kv_utilization":
+                maxes[key] = max(maxes.get(key, 0.0), v)
+            else:
+                sums[key] = sums.get(key, 0.0) + v
+        for line in text.splitlines():
+            if line.startswith("# TYPE "):
+                parts = line.split()
+                if len(parts) == 4:
+                    types.setdefault(parts[2], parts[3])
+    L: list[str] = []
+    typed: set = set()
+    for key in order:
+        base = key.split("{", 1)[0]
+        if base in types and base not in typed:
+            typed.add(base)
+            L.append(f"# TYPE {base} {types[base]}")
+        v = maxes.get(key, sums.get(key, 0.0))
+        L.append(f"{key} {v:.17g}")
+    for h, acc in hists.items():
+        L.extend(acc.prom_lines(h))
+    return "\n".join(L) + "\n"
+
+
+def assemble_fleet_trace(sources: list, path: str) -> Optional[str]:
+    """Assemble a merged fleet Perfetto file for a SUBPROCESS fleet from
+    the per-replica artifacts the supervisor already knows: ``sources``
+    is ``[(name, dir_or_path), ...]`` — a replica's snapshot directory
+    (every ``flight_*.json`` under it is read, life subdirectories
+    included, plus any exported ``*.trace.json[.gz]`` engine traces) or
+    one such file directly.
+
+    Flight-file events render under the replica's own pid
+    (``FLEET_REPLICA_PID_BASE + index``) with migration flow arrows
+    linked across replicas, exactly like the in-process
+    ``FleetController.export_perfetto``; already-rendered engine-trace
+    documents pass through re-pid'd onto the same replica pid — the
+    supervisor's ``--fleet-trace-out`` writes this at exit.  Returns
+    the written path, or ``None`` when no source held any events."""
+    import gzip
+    import json
+
+    srcs = []
+    rendered: list[dict] = []
+    for i, (name, src) in enumerate(sources):
+        pid = FLEET_REPLICA_PID_BASE + i
+        flight_paths, trace_paths = [], []
+        if os.path.isdir(src):
+            # newest flight per directory level only (the replica dir
+            # itself + each life subdir): successive flushes of one
+            # life carry OVERLAPPING ring tails, and rendering them all
+            # would duplicate every span — same dedupe rule as the
+            # in-process _trace_sources
+            flight_paths = [p for p in
+                            [latest_flight(src)]
+                            + [latest_flight(d) for d in sorted(
+                                glob.glob(os.path.join(src, "life*")))]
+                            if p is not None]
+            trace_paths = sorted(
+                glob.glob(os.path.join(src, "**", "*.trace.json"),
+                          recursive=True)
+                + glob.glob(os.path.join(src, "**", "*.trace.json.gz"),
+                            recursive=True))
+        elif os.path.exists(src):
+            if src.endswith((".trace.json", ".trace.json.gz")):
+                trace_paths = [src]
+            else:
+                flight_paths = [src]
+        evs: list = []
+        for p in flight_paths:
+            try:
+                evs.extend(tuple(e)
+                           for e in load_flight(p).get("events", ()))
+            except (OSError, ValueError):
+                continue
+        for p in trace_paths:
+            try:
+                opener = gzip.open if p.endswith(".gz") else open
+                with opener(p, "rt") as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue
+            for ev in doc.get("traceEvents", ()):
+                if "pid" in ev:
+                    ev = {**ev, "pid": pid}
+                rendered.append(ev)
+        srcs.append((name, pid, evs))
+    if not any(evs for _, _, evs in srcs) and not rendered:
+        return None
+    events: list[dict] = []
+    tids: dict[int, dict] = {}
+    for name, pid, evs in srcs:
+        if evs:
+            tids[pid] = {}
+            events.extend(events_to_perfetto(
+                evs, pid=pid,
+                process_name=f"replica {name} (serve engine)",
+                tids_out=tids[pid]))
+    events.extend(rendered)
+    events.extend(link_migration_flows(
+        [(pid, evs) for _, pid, evs in srcs], tids))
+    return write_trace({"traceEvents": events}, path)

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import json
 import os
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -51,6 +52,71 @@ TOKEN_TIMES_WINDOW = 256
 #: histograms keep counting forever).  Matches the journal's
 #: ``journal_retain_done`` default.
 REQUESTS_RETAIN = 4096
+
+#: Counter fields :meth:`ServeMetrics.merge` adds across engines — the
+#: fleet aggregation contract (serve/fleet.py): every additive counter
+#: in the exposition sums replica-wise, histograms merge bucket-exactly,
+#: gauges take last-sum/peak-max.  A counter added to ServeMetrics
+#: without joining this tuple silently vanishes from the fleet
+#: aggregate, so keep them in lockstep.
+MERGE_COUNTERS = (
+    "steps", "decode_steps", "verify_rounds", "prefill_tokens",
+    "preemptions", "completed", "decode_tokens", "dispatches",
+    "host_syncs", "shed", "deadline_expired", "quarantined",
+    "callback_errors", "forward_retries", "forward_bisections",
+    "watchdog_trips", "spec_bailouts", "spec_rounds", "spec_proposed",
+    "spec_accepted", "spec_tokens", "spec_dispatches",
+    "draft_prefix_skipped_tokens", "snapshots", "snapshot_ms_total",
+    "journal_records", "journal_bytes", "journal_rotations", "restores",
+    "restored_in_place", "restored_requeued", "restored_tokens",
+    "migrated_out", "migrated_in", "migrated_in_place",
+    "migrated_tokens", "prefix_hits", "prefix_hit_tokens",
+    "prefix_skipped_tokens", "running_sum", "kv_util_sum",
+)
+
+
+class WindowedRate:
+    """Bounded sliding-window event counter — the SLO burn-rate
+    primitive (docs/observability.md "Fleet observability").
+
+    Cumulative counters answer "how many ever"; an SLO burn alert needs
+    "how many in the last W seconds".  ``observe(ts)`` records one
+    event; ``count(now)``/``rate(now)`` report the trailing window.
+    Memory is bounded two ways: expired timestamps drop on every call,
+    and the deque caps at ``max_events`` (saturation flags rather than
+    grows — at that point the rate is "a lot", exactly what the alert
+    needed to know)."""
+
+    def __init__(self, window_s: float = 60.0, max_events: int = 65536):
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        self.window_s = window_s
+        self.max_events = max_events
+        self._ts = deque(maxlen=max_events)
+        self.total = 0
+
+    def observe(self, ts: float, n: int = 1) -> None:
+        self.total += n
+        for _ in range(n):
+            self._ts.append(ts)
+
+    def _trim(self, now: float) -> None:
+        lo = now - self.window_s
+        while self._ts and self._ts[0] < lo:
+            self._ts.popleft()
+
+    def count(self, now: float) -> int:
+        """Events inside ``[now - window_s, now]``."""
+        self._trim(now)
+        return len(self._ts)
+
+    def rate(self, now: float) -> float:
+        """Events per second over the trailing window."""
+        return self.count(now) / self.window_s
+
+    @property
+    def saturated(self) -> bool:
+        return len(self._ts) == self.max_events
 
 
 @dataclass
@@ -392,6 +458,40 @@ class ServeMetrics:
             "migrated_in_place": self.migrated_in_place,
             "migrated_tokens": self.migrated_tokens,
         }
+
+    def merge(self, other: "ServeMetrics") -> "ServeMetrics":
+        """Fold another engine's metrics into this one — the fleet
+        aggregation primitive (serve/fleet.py,
+        ``FleetController.aggregate_metrics``).  Counters add
+        (:data:`MERGE_COUNTERS` — the exposition's additive series),
+        the SLO histograms merge bucket-EXACTLY
+        (:meth:`serve.trace.LogHistogram.merge`: identical schemes add
+        count-wise, so fleet p50/p95/p99 equal percentiles over the
+        pooled per-replica samples), finish-reason tallies add, and
+        gauges take sum-of-last / max-of-peak.  Per-request detail
+        (``requests``), compiled-program registries, and recorder
+        attachments stay local — they name objects, not quantities."""
+        for name in MERGE_COUNTERS:
+            setattr(self, name, getattr(self, name)
+                    + getattr(other, name))
+        self.snapshot_ms_last = max(self.snapshot_ms_last,
+                                    other.snapshot_ms_last)
+        self.queue_depth_last += other.queue_depth_last
+        self.queue_depth_peak = max(self.queue_depth_peak,
+                                    other.queue_depth_peak)
+        self.running_last += other.running_last
+        self.kv_util_last = max(self.kv_util_last, other.kv_util_last)
+        self.kv_util_peak = max(self.kv_util_peak, other.kv_util_peak)
+        for reason, n in other.finish_reasons.items():
+            self.finish_reasons[reason] = \
+                self.finish_reasons.get(reason, 0) + n
+        for mine, theirs in ((self.hist_ttft, other.hist_ttft),
+                             (self.hist_itl, other.hist_itl),
+                             (self.hist_queue, other.hist_queue),
+                             (self.hist_step, other.hist_step),
+                             (self.hist_snapshot, other.hist_snapshot)):
+            mine.merge(theirs)
+        return self
 
     def attach_block_manager(self, bm) -> None:
         """Fold the block manager's prefix-cache gauges into

@@ -187,10 +187,16 @@ class TokenJournal:
         self.file_bytes += len(line)
 
     def submit(self, req: Request) -> None:
-        self.append({"t": "submit", "rid": req.request_id,
-                     "prompt": [int(x) for x in req.prompt],
-                     "params": req.params.to_dict(),
-                     "ts": req.arrival_time})
+        rec = {"t": "submit", "rid": req.request_id,
+               "prompt": [int(x) for x in req.prompt],
+               "params": req.params.to_dict(),
+               "ts": req.arrival_time}
+        if getattr(req, "trace", None):
+            # the distributed-tracing context rides the journal so a
+            # crash-path manifest (manifest_from_journal) hands the
+            # journey — trace id + hop — to the adopting replica
+            rec["trace"] = req.trace
+        self.append(rec)
 
     def token(self, rid: str, index: int, tok: int, ts: float) -> None:
         self.append({"t": "tok", "rid": rid, "i": int(index),
@@ -273,6 +279,10 @@ class JournalRequest:
     # compacted tts/ts lists None-pad their head past the bounded
     # token-time window, so the restored TTFT needs this explicitly
     first_tok: Optional[float] = None
+    # distributed-tracing context from the submit record ({"trace_id",
+    # "hop"}) — crash-path manifests carry it so the journey survives
+    # the replica (docs/observability.md "Fleet observability")
+    trace: Optional[dict] = None
 
     def token_list(self) -> list[int]:
         """Emitted tokens in order (the contiguous prefix from 0 — a gap
@@ -323,6 +333,8 @@ def replay_journal(path: str | os.PathLike) -> dict[str, JournalRequest]:
                 jr.arrival = rec.get("ts")
                 if jr.first_tok is None:
                     jr.first_tok = rec.get("ftt")
+                if jr.trace is None:
+                    jr.trace = rec.get("trace")
             elif t == "tok":
                 jr.tokens.setdefault(int(rec["i"]),
                                      (int(rec["tok"]), rec.get("ts")))
@@ -835,6 +847,8 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
             r["tok_ts"] = jr.token_times()
         if jr.first_tok is not None:
             r.setdefault("first_tok", jr.first_tok)
+        if jr.trace is not None:
+            r.setdefault("trace", jr.trace)
         if jr.finish is not None:
             r["finish"] = jr.finish
     # A rid only ever seen as a finish/token record (its submit line was
@@ -1014,11 +1028,17 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
         rm.n_preemptions = mr.get("n_preempt", 0)
         req = Request(rid, r["prompt"], r["params"],
                       arrival_time=rm.arrival_time,
-                      on_token=_resolve_callback(on_token, rid))
+                      on_token=_resolve_callback(on_token, rid),
+                      trace=r.get("trace")
+                      or {"trace_id": rid, "hop": 0})
         rs = ReqState(req=req, metrics=rm)
         rs.generated = list(r["tokens"])
         rs.journal_base = len(rs.generated)
         rs.callback_disabled = bool(mr.get("cb_off", False))
+        # a restore is the SAME life continuing (same replica, same
+        # journal dir): the journey keeps its hop — only a migration
+        # to another replica bumps it
+        engine._trace_ctx[rid] = req.trace
         return rs
 
     resumed: list[str] = []
@@ -1214,9 +1234,36 @@ def manifest_from_journal(directory: str | os.PathLike, *,
     step's retirements) — accounting, never re-served.  ``mark=True``
     appends a ``mig`` record per handed-off request (safe only once the
     source process is dead: two writers on one journal corrupt it).
+
+    Trace continuity on the crash path: each record carries the
+    journal's trace context, and — when the dying step managed its
+    ``force=True`` flight flush (it does on anything escaping,
+    ``InjectedKill`` included) — the request's ring-event tail recovered
+    from the newest ``flight_*.json``, so the adopting replica's ring
+    and the merged fleet timeline show the dead life's events too
+    (docs/observability.md "Fleet observability").
     """
+    from triton_dist_tpu.serve.trace import (
+        MIGRATE_EVENT_TAIL,
+        latest_flight,
+        load_flight,
+    )
+
     directory = os.path.abspath(os.fspath(directory))
     journal = replay_journal(os.path.join(directory, JOURNAL_NAME))
+    # per-rid event tails from the dead life's postmortem flush (best
+    # effort: a SIGKILL with no flush just means no carried events)
+    tails: dict[str, list] = {}
+    fl = latest_flight(directory)
+    if fl is not None:
+        try:
+            for ev in load_flight(fl).get("events", ()):
+                ts, step, etype, rid, data = ev
+                if rid is not None:
+                    tails.setdefault(rid, []).append(
+                        [ts, step, etype, data])
+        except (OSError, ValueError, json.JSONDecodeError):
+            tails = {}
     # Clock re-base (the restore_engine rule): the newest source-clock
     # stamp anywhere in the journal stands in for "now" on the source.
     old_now = max(
@@ -1248,6 +1295,8 @@ def manifest_from_journal(directory: str | os.PathLike, *,
             "tokens": toks,
             "tok_ts": jr.token_times(),
             "first_tok": jr.first_tok,
+            "trace": jr.trace or {"trace_id": rid, "hop": 0},
+            "events": tails.get(rid, [])[-MIGRATE_EVENT_TAIL:],
         })
         handed.append((rid, len(toks)))
     if mark and handed:

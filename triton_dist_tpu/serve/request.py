@@ -92,6 +92,12 @@ class Request:
     ``on_token(request_id, token)`` (optional) streams each emitted token
     the moment the engine commits it — before the request retires.
     ``arrival_time`` defaults to the engine clock at ``submit()``.
+    ``trace`` is the distributed-tracing context (docs/observability.md
+    "Fleet observability"): ``{"trace_id": <fleet-unique id>, "hop":
+    <0-based life count of the journey>}``.  The fleet controller stamps
+    it at admission; a bare engine defaults it at ``submit()`` — either
+    way it rides migration manifests and the token journal, so a
+    request's journey stays one trace across replicas and restarts.
     """
 
     request_id: str
@@ -99,6 +105,7 @@ class Request:
     params: SamplingParams = field(default_factory=SamplingParams)
     arrival_time: Optional[float] = None
     on_token: Optional[Callable[[str, int], None]] = None
+    trace: Optional[dict] = None
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)

@@ -110,6 +110,21 @@ FAULT_POINT_EVENTS = {
 #: against real process pids.
 ENGINE_PID = 3_999_999
 
+#: Newest ring events a migration manifest carries per request — both
+#: producers share it: the live ``ServeEngine.drain`` gathers the tail
+#: from its ring, the crash-path ``recovery.manifest_from_journal``
+#: recovers it from the dead life's flight file.  Bounded so a manifest
+#: cannot grow with ring capacity (docs/observability.md "Fleet
+#: observability").
+MIGRATE_EVENT_TAIL = 128
+
+#: pid of the fleet controller's own timeline in a merged fleet export
+#: (serve/fleet.py), and the base pid replica ``r<i>`` claims
+#: (``FLEET_REPLICA_PID_BASE + i``).  All below the Linux pid cap for
+#: the same merge-injectivity reason as :data:`ENGINE_PID`.
+FLEET_PID = 3_999_998
+FLEET_REPLICA_PID_BASE = 3_900_000
+
 
 # ---------------------------------------------------------------------------
 # Log-bucketed histograms (the bounded replacement for per-request
@@ -199,21 +214,302 @@ class LogHistogram:
             "max": self.max if self.count else None,
         }
 
+    def merge(self, other: "LogHistogram") -> "LogHistogram":
+        """Fold ``other`` into this histogram EXACTLY: identical bucket
+        schemes add count-wise, so the merged percentiles equal those of
+        a histogram fed the pooled samples bucket-exactly, and
+        sum/count/min/max stay exact — the fleet aggregation primitive
+        (serve/fleet.py; a mean-of-percentiles would be wrong, this is
+        a percentile-of-merged-counts).  Raises on a bucket-scheme
+        mismatch: adding misaligned buckets would silently corrupt the
+        quantiles."""
+        if (self.lo != other.lo or self.per_decade != other.per_decade
+                or len(self.counts) != len(other.counts)):
+            raise ValueError(
+                f"histogram bucket schemes differ: "
+                f"(lo={self.lo}, per_decade={self.per_decade}, "
+                f"n={len(self.counts)}) vs (lo={other.lo}, "
+                f"per_decade={other.per_decade}, n={len(other.counts)})")
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.count += other.count
+        self.sum += other.sum
+        if other.min < self.min:
+            self.min = other.min
+        if other.max > self.max:
+            self.max = other.max
+        return self
+
+    def bucket_index(self, le: float) -> int:
+        """Index of the bucket whose upper edge is ``le`` (inverse of
+        the exposition's edge math; tolerant of float round-trips —
+        buckets are ~10% apart, a ``%.6g`` parse-back is ~1e-6 off)."""
+        if le <= self.lo * 10.0 ** (0.5 / self.per_decade):
+            return 0
+        i = int(round((math.log10(le) - self._log_lo) * self.per_decade))
+        return max(0, min(i, len(self.counts) - 2))
+
+    @classmethod
+    def from_prom(cls, series: dict, name: str, *,
+                  lo: float = 1e-6, hi: float = 4000.0,
+                  per_decade: int = 24) -> "LogHistogram":
+        """Rebuild a histogram from its own text exposition (a
+        ``parse_prometheus`` dict) — the subprocess half of fleet
+        aggregation (scrape-and-merge).  The exposition's cumulative
+        buckets de-accumulate back into per-bucket counts on the SAME
+        scheme, so a scrape-reconstructed histogram merges bucket-
+        exactly with a live one; ``_sum``/``_count`` and the
+        ``_min``/``_max`` gauges restore the exact scalar fields."""
+        h = cls(lo=lo, hi=hi, per_decade=per_decade)
+        h.count = int(series.get(f"{name}_count", 0))
+        h.sum = float(series.get(f"{name}_sum", 0.0))
+        if h.count:
+            h.min = float(series.get(f"{name}_min", float("inf")))
+            h.max = float(series.get(f"{name}_max", float("-inf")))
+        buckets = []
+        prefix = f"{name}_bucket{{le=\""
+        for key, v in series.items():
+            if key.startswith(prefix) and not key.startswith(
+                    f"{name}_bucket{{le=\"+Inf"):
+                buckets.append((float(key[len(prefix):-2]), int(v)))
+        buckets.sort()
+        acc = 0
+        for le, cum in buckets:
+            h.counts[h.bucket_index(le)] = cum - acc
+            acc = cum
+        h.counts[-1] = h.count - acc   # overflow: past the last edge
+        return h
+
     def prom_lines(self, name: str) -> list[str]:
         """Prometheus text-exposition lines for this histogram —
-        cumulative ``_bucket{le=}`` (only the buckets traffic reached,
-        plus ``+Inf``), ``_sum`` and ``_count``."""
+        DENSE cumulative ``_bucket{le=}`` (EVERY bucket edge in the
+        scheme, zero-traffic ones included, plus ``+Inf``), then
+        ``_sum``/``_count`` and exact ``_min``/``_max`` gauges.
+
+        Dense matters for aggregation: every engine shares one bucket
+        scheme, so every replica's exposition carries the IDENTICAL
+        full ``le`` label set — a recording rule's ``sum by (le)`` (and
+        :meth:`from_prom` scrape-and-merge) stays monotone and complete
+        even when the replicas reached different depths.  Sparse
+        nonzero-only buckets broke exactly that: a replica missing an
+        intermediate ``le`` made the cross-instance sum non-monotone,
+        and stopping at each replica's own deepest reached bucket would
+        still drop its total from the deeper sums
+        (tests/test_serve_fleet.py pins the merged-vs-pooled bucket
+        equality).  Cost: ~230 lines per histogram — a few tens of KB
+        per scrape, the price of correct `histogram_quantile` over
+        `sum by (le)`."""
         out = [f"# TYPE {name} histogram"]
         acc = 0
-        for i, c in enumerate(self.counts[:-1]):
-            acc += c
-            if c:
-                le = self.lo if i == 0 else self.edge(i - 1)
-                out.append(f'{name}_bucket{{le="{le:.6g}"}} {acc}')
+        for i in range(len(self.counts) - 1):
+            acc += self.counts[i]
+            le = self.lo if i == 0 else self.edge(i - 1)
+            out.append(f'{name}_bucket{{le="{le:.6g}"}} {acc}')
         out.append(f'{name}_bucket{{le="+Inf"}} {self.count}')
-        out.append(f"{name}_sum {self.sum:.9g}")
+        # .17g: enough digits to round-trip a float64 exactly, so a
+        # scrape reconstruction (from_prom) recovers sum/min/max EXACTLY
+        out.append(f"{name}_sum {self.sum:.17g}")
         out.append(f"{name}_count {self.count}")
+        if self.count:
+            # exact extremes ride as gauges so a scrape reconstruction
+            # (from_prom) merges with exact min/max, not bucket edges
+            out.append(f"# TYPE {name}_min gauge")
+            out.append(f"{name}_min {self.min:.17g}")
+            out.append(f"# TYPE {name}_max gauge")
+            out.append(f"{name}_max {self.max:.17g}")
         return out
+
+
+# ---------------------------------------------------------------------------
+# Event-stream views (module-level so the fleet controller can render
+# ANY event list — a live ring, a flight-file postmortem, a carried
+# migration tail — not just its own recorder's)
+# ---------------------------------------------------------------------------
+
+
+def spans_from_events(evs: list) -> dict:
+    """Per-request lifecycle spans from a SORTED event stream:
+    ``{rid: [(phase, t0, t1), ...]}`` with phases ``queue``
+    (submit→admit, re-opened by preemption), ``prefill``
+    (admit→prefill_done) and ``decode`` (prefill_done→retire).  A phase
+    still open at the newest event closes there (an in-flight request's
+    span is the stream's honest horizon)."""
+    if not evs:
+        return {}
+    end = evs[-1][0]
+    out: dict[str, list] = {}
+    open_: dict[str, tuple] = {}   # rid -> (phase, t0)
+
+    def close(rid, ts):
+        ph = open_.pop(rid, None)
+        if ph is not None:
+            out.setdefault(rid, []).append((ph[0], ph[1], ts))
+
+    for ts, step, etype, rid, data in evs:
+        if rid is None:
+            continue
+        if etype == "submit":
+            close(rid, ts)
+            open_[rid] = ("queue", ts)
+        elif etype == "admit":
+            close(rid, ts)
+            open_[rid] = ("prefill", ts)
+        elif etype == "prefill_done":
+            close(rid, ts)
+            open_[rid] = ("decode", ts)
+        elif etype == "preempt":
+            close(rid, ts)
+            open_[rid] = ("queue", ts)
+        elif etype == "migrate_out":
+            # the request LEFT this timeline: close without reopening,
+            # or the source track would render it active until the
+            # stream horizon — hours after it migrated away
+            close(rid, ts)
+        elif etype == "migrate_in":
+            # the journey continues HERE: the carried tail seeded ahead
+            # of this event holds the source-side phases, and the
+            # adopted row is decoding (in place) or re-queued — either
+            # way a fresh span opens at the adoption instant
+            close(rid, ts)
+            open_[rid] = ("decode" if (data or {}).get("in_place")
+                          else "queue", ts)
+        elif etype == "retire":
+            close(rid, ts)
+            out.setdefault(rid, [])
+    for rid in list(open_):
+        close(rid, end)
+    return out
+
+
+def events_to_perfetto(events: list, *, pid: int = ENGINE_PID,
+                       process_name: str =
+                       "serve engine (flight recorder)",
+                       tids_out: Optional[dict] = None) -> list[dict]:
+    """Render one event stream as Chrome-trace events under ``pid``:
+    a process_name meta, one thread per request with its whole-request
+    span enclosing the lifecycle phase spans, and instants for point
+    events.  The fleet merge (serve/fleet.py) calls this once per
+    replica with a distinct pid, so one file holds every replica's
+    timeline side by side; :meth:`FlightRecorder.to_perfetto` is the
+    single-engine wrapper.  ``tids_out`` (optional dict) is filled with
+    the ``rid -> tid`` assignment so :func:`link_migration_flows` can
+    anchor flow arrows on the request's own thread (a flow event on a
+    slice-less tid would not bind in ui.perfetto.dev)."""
+    evs = sorted(events, key=lambda e: (e[0], e[1]))
+    trace: list[dict] = [{
+        "ph": "M", "pid": pid, "tid": 0,
+        "name": "process_name",
+        "args": {"name": process_name},
+    }]
+    tids: dict[str, int] = {}
+
+    def tid_of(rid):
+        if rid not in tids:
+            tids[rid] = len(tids) + 1
+            trace.append({"ph": "M", "pid": pid,
+                          "tid": tids[rid], "name": "thread_name",
+                          "args": {"name": rid}})
+        return tids[rid]
+
+    def us(ts):
+        return ts * 1e6
+
+    # Whole-request spans enclose the phase spans (first event ->
+    # retire / stream horizon).
+    first: dict[str, float] = {}
+    last: dict[str, float] = {}
+    for ts, step, etype, rid, data in evs:
+        if rid is None:
+            continue
+        first.setdefault(rid, ts)
+        last[rid] = ts
+    for rid, phases in spans_from_events(evs).items():
+        t0, t1 = first[rid], last[rid]
+        trace.append({"ph": "X", "pid": pid,
+                      "tid": tid_of(rid), "cat": "request",
+                      "name": f"request {rid}", "ts": us(t0),
+                      "dur": max(us(t1) - us(t0), 1.0)})
+        for name, p0, p1 in phases:
+            trace.append({"ph": "X", "pid": pid,
+                          "tid": tid_of(rid), "cat": "phase",
+                          "name": name, "ts": us(p0),
+                          "dur": max(us(p1) - us(p0), 1.0)})
+    for ts, step, etype, rid, data in evs:
+        if etype in ("submit", "admit", "prefill_done"):
+            continue  # phase boundaries, already spans
+        args = {"step": step}
+        if data:
+            args.update(data)
+        trace.append({"ph": "i", "s": "t" if rid else "g",
+                      "pid": pid,
+                      "tid": tid_of(rid) if rid else 0,
+                      "cat": "engine", "name": etype, "ts": us(ts),
+                      "args": args})
+    if tids_out is not None:
+        tids_out.update(tids)
+    return trace
+
+
+def link_migration_flows(sources: list,
+                         tids: Optional[dict] = None) -> list[dict]:
+    """Perfetto flow arrows for cross-replica request journeys.
+
+    ``sources`` is ``[(pid, events), ...]`` — one entry per replica
+    timeline already rendered into a merged file; ``tids`` maps
+    ``pid -> {rid: tid}`` (the ``tids_out`` of each
+    :func:`events_to_perfetto` call) so the arrows anchor on the
+    request's own thread, where its slices live — Perfetto binds a
+    flow event to the slice enclosing its timestamp on the same
+    pid/tid, so a slice-less tid would drop the arrow.  For every
+    ``migrate_in`` event, emit a flow-start (``ph: "s"``) anchored at
+    the hand-off point on the SOURCE replica and a flow-finish
+    (``ph: "f"``) at the adoption instant on the target, sharing one
+    flow id — ui.perfetto.dev draws the arrow, making a migrated
+    request ONE connected journey across replica tracks.
+
+    The source anchor prefers the exact ``migrate_out`` twin (the
+    cooperative drain path emits one, carrying the same ``flow`` id);
+    on the crash path the source process died before any
+    ``migrate_out`` could be recorded, so the anchor falls back to the
+    source's newest event for that rid preceding the adoption (the
+    postmortem flight file is where those events survive)."""
+    flows: list[dict] = []
+    # index: flow id -> (pid, ts) of the matching migrate_out
+    out_by_flow: dict = {}
+    # rid -> [(ts, pid)] of every event, for the crash-path fallback
+    rid_events: dict = {}
+    for pid, events in sources:
+        for ev in sorted(events, key=lambda e: (e[0], e[1])):
+            ts, step, etype, rid, data = ev
+            if rid is not None:
+                rid_events.setdefault(rid, []).append((ts, pid))
+            if etype == "migrate_out" and data and data.get("flow"):
+                out_by_flow[data["flow"]] = (pid, ts)
+
+    def emit(ph, pid, rid, ts, fid, **extra):
+        flows.append({"ph": ph, "pid": pid,
+                      "tid": (tids or {}).get(pid, {}).get(rid, 0),
+                      "cat": "migration", "name": "migrate",
+                      "id": fid, "args": {"rid": rid},
+                      "ts": ts * 1e6, **extra})
+
+    for pid, events in sources:
+        for ts, step, etype, rid, data in events:
+            if etype != "migrate_in" or rid is None:
+                continue
+            fid = (data or {}).get("flow") or f"{rid}#?"
+            src = out_by_flow.get(fid)
+            if src is None:
+                # crash path: anchor at the newest source-side event
+                # before the adoption, on a DIFFERENT pid
+                cands = sorted((t, p) for t, p in rid_events.get(rid, ())
+                               if p != pid and t <= ts)
+                src = (cands[-1][1], cands[-1][0]) if cands else None
+            if src is None:
+                continue
+            emit("s", src[0], rid, src[1], fid)
+            emit("f", pid, rid, ts, fid, bp="e")
+    return flows
 
 
 # ---------------------------------------------------------------------------
@@ -306,38 +602,7 @@ class FlightRecorder:
         deque could disagree on which requests exist)."""
         if evs is None:
             evs = sorted(self._ring, key=lambda e: (e[0], e[1]))
-        if not evs:
-            return {}
-        end = evs[-1][0]
-        out: dict[str, list] = {}
-        open_: dict[str, tuple] = {}   # rid -> (phase, t0)
-
-        def close(rid, ts):
-            ph = open_.pop(rid, None)
-            if ph is not None:
-                out.setdefault(rid, []).append((ph[0], ph[1], ts))
-
-        for ts, step, etype, rid, data in evs:
-            if rid is None:
-                continue
-            if etype == "submit":
-                close(rid, ts)
-                open_[rid] = ("queue", ts)
-            elif etype == "admit":
-                close(rid, ts)
-                open_[rid] = ("prefill", ts)
-            elif etype == "prefill_done":
-                close(rid, ts)
-                open_[rid] = ("decode", ts)
-            elif etype == "preempt":
-                close(rid, ts)
-                open_[rid] = ("queue", ts)
-            elif etype == "retire":
-                close(rid, ts)
-                out.setdefault(rid, [])
-        for rid in list(open_):
-            close(rid, end)
-        return out
+        return spans_from_events(evs)
 
     # -- Perfetto / Chrome trace export -----------------------------------
 
@@ -348,70 +613,12 @@ class FlightRecorder:
         for point events, all on :data:`ENGINE_PID` so
         ``runtime.profiling.merge_rank_traces`` folds the engine
         timeline into the device profiler's merged view."""
-        evs = sorted(self._ring, key=lambda e: (e[0], e[1]))
-        trace: list[dict] = [{
-            "ph": "M", "pid": ENGINE_PID, "tid": 0,
-            "name": "process_name",
-            "args": {"name": "serve engine (flight recorder)"},
-        }]
-        tids: dict[str, int] = {}
-
-        def tid_of(rid):
-            if rid not in tids:
-                tids[rid] = len(tids) + 1
-                trace.append({"ph": "M", "pid": ENGINE_PID,
-                              "tid": tids[rid], "name": "thread_name",
-                              "args": {"name": rid}})
-            return tids[rid]
-
-        def us(ts):
-            return ts * 1e6
-
-        # Whole-request spans enclose the phase spans (first event ->
-        # retire / ring horizon).
-        first: dict[str, float] = {}
-        last: dict[str, float] = {}
-        for ts, step, etype, rid, data in evs:
-            if rid is None:
-                continue
-            first.setdefault(rid, ts)
-            last[rid] = ts
-        for rid, phases in self.spans(evs).items():
-            t0, t1 = first[rid], last[rid]
-            trace.append({"ph": "X", "pid": ENGINE_PID,
-                          "tid": tid_of(rid), "cat": "request",
-                          "name": f"request {rid}", "ts": us(t0),
-                          "dur": max(us(t1) - us(t0), 1.0)})
-            for name, p0, p1 in phases:
-                trace.append({"ph": "X", "pid": ENGINE_PID,
-                              "tid": tid_of(rid), "cat": "phase",
-                              "name": name, "ts": us(p0),
-                              "dur": max(us(p1) - us(p0), 1.0)})
-        for ts, step, etype, rid, data in evs:
-            if etype in ("submit", "admit", "prefill_done"):
-                continue  # phase boundaries, already spans
-            args = {"step": step}
-            if data:
-                args.update(data)
-            trace.append({"ph": "i", "s": "t" if rid else "g",
-                          "pid": ENGINE_PID,
-                          "tid": tid_of(rid) if rid else 0,
-                          "cat": "engine", "name": etype, "ts": us(ts),
-                          "args": args})
-        return {"traceEvents": trace}
+        return {"traceEvents": events_to_perfetto(list(self._ring))}
 
     def export_perfetto(self, path: str) -> str:
         """Write :meth:`to_perfetto` to ``path`` (gzipped when the name
         ends ``.gz`` — the profiler's own trace format)."""
-        doc = json.dumps(self.to_perfetto(), default=str)
-        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        if path.endswith(".gz"):
-            with gzip.open(path, "wt") as f:
-                f.write(doc)
-        else:
-            with open(path, "w") as f:
-                f.write(doc)
-        return path
+        return write_trace(self.to_perfetto(), path)
 
     def export_profile(self, job_dir: str, rank: int = 0) -> str:
         """Drop the engine timeline where
@@ -427,12 +634,15 @@ class FlightRecorder:
     # -- postmortem flush -------------------------------------------------
 
     def flush(self, directory: str, *, reason: str,
-              statline: Optional[str] = None) -> str:
+              statline: Optional[str] = None,
+              extra: Optional[dict] = None) -> str:
         """Write the ring to ``{directory}/flight_<step>.json`` — the
         postmortem trail for the supervisor and the chaos harness.  Only
         called OFF the hot path (fault/quarantine/watchdog/crash seams);
         best-effort durable (flush + fsync) so the file survives the
-        process dying right after."""
+        process dying right after.  ``extra`` merges additional JSON-safe
+        sections into the document (the fleet controller rides its
+        router decision audit along — serve/fleet.py)."""
         os.makedirs(directory, exist_ok=True)
         path = os.path.join(directory, f"flight_{self.step}.json")
         doc = {
@@ -444,6 +654,8 @@ class FlightRecorder:
             "statline": statline,
             "events": self.tail(self.capacity),
         }
+        if extra:
+            doc.update(extra)
         with open(path, "w") as f:
             json.dump(doc, f, default=str)
             f.flush()
@@ -452,6 +664,22 @@ class FlightRecorder:
             except OSError:
                 pass
         return path
+
+
+def write_trace(doc: dict, path: str) -> str:
+    """Write a Chrome-trace document to ``path`` (gzipped when the name
+    ends ``.gz`` — the device profiler's own format, so the file lands
+    wherever ``merge_rank_traces`` globs)."""
+    text = json.dumps(doc, default=str)
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    if path.endswith(".gz"):
+        with gzip.open(path, "wt") as f:
+            f.write(text)
+    else:
+        with open(path, "w") as f:
+            f.write(text)
+    return path
 
 
 def load_flight(path: str) -> dict:
