@@ -458,6 +458,23 @@ def _bench_serve_fleet():
     return r["serve_fleet_zero_loss"], r["fleet_toks_per_s"]
 
 
+def _bench_serve_fleet_net():
+    """NETWORK fleet chaos guardrail (scripts/bench_serve.py
+    bench_fleet_net): replicas reachable only over the serve/net.py
+    wire behind RemoteReplica clients, one process killed mid-decode
+    plus a client-side partition of the other (healed once the breaker
+    opens to SUSPECT) — the fraction of streams bit-identical to the
+    single-engine oracle with exactly-once delivery across retries +
+    backoff + journal crash migration.  The cross-process twin of
+    serve_fleet_zero_loss, same 1.0 floor, same contract: below it the
+    network plane lost or duplicated tokens."""
+    from scripts.bench_serve import bench_fleet_net
+
+    r = bench_fleet_net(n_replicas=2, batch=4, prompt_len=16,
+                        new_tokens=32, dim=32)
+    return r["serve_fleet_net_zero_loss"]
+
+
 def _bench_serve_fleet_trace():
     """Fleet tracing overhead (scripts/bench_serve.py
     bench_fleet_trace_overhead): the identical warmed fleet workload
@@ -549,6 +566,7 @@ def main():
     spec_speedup = _bench_serve_spec()
     trace_overhead = _bench_serve_trace()
     fleet_zero_loss, fleet_tps = _bench_serve_fleet()
+    fleet_net_zero_loss = _bench_serve_fleet_net()
     fleet_trace_overhead = _bench_serve_fleet_trace()
 
     peak = peak_bf16_tflops()
@@ -595,6 +613,10 @@ def main():
         # the fleet broke exactly-once — the PR 9 robustness bar.
         "serve_fleet_zero_loss": round(fleet_zero_loss, 4),
         "serve_fleet_toks_per_s": round(fleet_tps, 1),
+        # Network-fleet chaos zero-loss: the same bar with replicas
+        # reachable ONLY over the wire (kill + partition + retries +
+        # journal crash migration) — the ISSUE-12 robustness bar.
+        "serve_fleet_net_zero_loss": round(fleet_net_zero_loss, 4),
         # Fleet tracing overhead: fleet tokens/s with the full
         # observability stack (engine rings + controller ring + router
         # decision audit) over tokens/s with it all off — the

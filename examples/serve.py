@@ -190,6 +190,28 @@ def parse_args():
                         "prompt is the full previous conversation plus "
                         "a fresh user message, so turns >= 1 hit the "
                         "prefix cache for their whole history")
+    p.add_argument("--migrate-in", default=None, metavar="PATH",
+                   help="engine mode: adopt a saved migration-manifest "
+                        "JSON at startup (recovery.save_manifest / "
+                        "manifest_from_journal) and print each "
+                        "request's adopt/requeue placement before "
+                        "serving it to completion")
+    p.add_argument("--serve-port", type=int, default=None, metavar="P",
+                   help="engine mode: NETWORK INGEST instead of local "
+                        "traffic (docs/serving.md 'Network fleet "
+                        "serving') — serve POST /submit, GET /stream, "
+                        "POST /drain, POST /migrate_in, GET /health on "
+                        "port P (0 picks free; published to "
+                        "<snapshot-dir>/net_port)")
+    p.add_argument("--serve-deadline", type=float, default=None,
+                   metavar="S",
+                   help="network mode: hard wall-clock lifetime bound "
+                        "(a wedged replica exits on its own)")
+    p.add_argument("--serve-idle-exit", type=float, default=None,
+                   metavar="S",
+                   help="network mode: exit after S seconds with no "
+                        "work (demo/test hygiene; default: run until "
+                        "POST /shutdown)")
     args = p.parse_args()
     if args.sessions is not None and args.sessions < 1:
         p.error(f"--sessions must be >= 1, got {args.sessions}")
@@ -218,9 +240,20 @@ def parse_args():
     for flag, name in ((args.metrics_port, "--metrics-port"),
                        (args.stats_every, "--stats-every"),
                        (args.trace_level, "--trace-level"),
-                       (args.trace_perfetto, "--trace-perfetto")):
+                       (args.trace_perfetto, "--trace-perfetto"),
+                       (args.migrate_in, "--migrate-in"),
+                       (args.serve_port, "--serve-port")):
         if flag is not None and not args.engine:
             p.error(f"{name} is an engine-mode flag: add --engine")
+    if args.serve_port is not None and (args.mixed or args.sessions
+                                        or args.shared_prompt
+                                        or args.fleet is not None):
+        p.error("--serve-port serves network traffic only (no --mixed/"
+                "--sessions/--shared-prompt/--fleet)")
+    if ((args.serve_deadline is not None
+         or args.serve_idle_exit is not None)
+            and args.serve_port is None):
+        p.error("--serve-deadline/--serve-idle-exit need --serve-port")
     return args
 
 
@@ -403,7 +436,11 @@ def run_engine(args, key):
     else:
         lens = rng.integers(max(2, args.prompt_len // 2),
                             2 * args.prompt_len + 1, size=args.requests)
-        max_seq = int(max(lens)) + args.new_tokens
+        # --requests 0 (e.g. --migrate-in only, or --serve-port): size
+        # the model for the lengths local traffic WOULD have used, so
+        # carried/wire prompts built against the same knobs always fit
+        max_seq = (int(max(lens)) if args.requests
+                   else 2 * args.prompt_len) + args.new_tokens
     shared_base = None
     if args.shared_prompt:
         # The shared "system prompt": long enough to span several pages
@@ -523,6 +560,24 @@ def run_engine(args, key):
         dist_print(f"metrics: Prometheus text at http://127.0.0.1:"
                    f"{metrics_srv.server_address[1]}/metrics")
 
+    if args.migrate_in:
+        # the subprocess hand-off: adopt a saved JSON manifest (KV-
+        # stripped — recovery.save_manifest), print where each request
+        # landed, then serve it to completion below
+        from triton_dist_tpu.serve.recovery import load_manifest
+
+        res = engine.migrate_in(load_manifest(args.migrate_in))
+        for rid in res["adopted"]:
+            dist_print(f"migrate-in {rid}: adopted in place (live KV)")
+        for rid in res["requeued"]:
+            dist_print(f"migrate-in {rid}: requeued (exact recompute)")
+        for rid, why in sorted(res["rejected"].items()):
+            dist_print(f"migrate-in {rid}: REJECTED ({why})")
+        dist_print(f"migrate-in: {len(res['adopted'])} adopted, "
+                   f"{len(res['requeued'])} requeued, "
+                   f"{len(res['rejected'])} rejected "
+                   f"from {args.migrate_in}")
+
     params_s = SamplingParams(max_new_tokens=args.new_tokens,
                               temperature=args.temperature,
                               top_k=args.top_k, top_p=args.top_p,
@@ -542,6 +597,33 @@ def run_engine(args, key):
     kill_marker = (os.path.join(snap_dir, "killed.marker")
                    if snap_dir else None)
     t0 = time.perf_counter()
+    if args.serve_port is not None:
+        # network ingest mode: requests arrive over the wire
+        # (docs/serving.md "Network fleet serving"); the local traffic
+        # generator stands down
+        from triton_dist_tpu.serve.net import (
+            PORT_FILE,
+            ReplicaServer,
+            serve_loop,
+            write_port_file,
+        )
+
+        server = ReplicaServer(engine)
+        server.start(port=args.serve_port)
+        if snap_dir:
+            write_port_file(os.path.join(snap_dir, PORT_FILE),
+                            server.port)
+        dist_print(f"net: replica serving at http://127.0.0.1:"
+                   f"{server.port} (POST /submit, GET /stream, "
+                   f"POST /drain, POST /migrate_in, GET /health)")
+        sys.stdout.flush()
+        steps = serve_loop(engine, server,
+                           deadline_s=args.serve_deadline,
+                           exit_when_idle_s=args.serve_idle_exit)
+        dist_print(f"net: serve loop exited after {steps} steps, "
+                   f"{engine.metrics.completed} requests completed")
+        reqs = []                        # the drain loop below no-ops
+        args.requests = engine.metrics.completed  # honest stats label
     submitted = step = 0
     finished = [engine._outputs[rid] for rid in sorted(engine._outputs)]
     while engine.has_work() or submitted < len(reqs):

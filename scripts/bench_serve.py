@@ -495,6 +495,152 @@ def bench_fleet(*, n_replicas: int = 2, batch: int = 4,
     }
 
 
+def bench_fleet_net(*, n_replicas: int = 2, batch: int = 4,
+                    prompt_len: int = 16, new_tokens: int = 48,
+                    dim: int = 64, n_layers: int = 2, vocab: int = 256,
+                    page_size: int = 16, seed: int = 0,
+                    warmup: bool = True,
+                    step_sleep_s: float = 0.004) -> dict:
+    """NETWORK fleet chaos guardrail (docs/serving.md "Network fleet
+    serving"): N replicas reachable ONLY over the wire
+    (``InProcessReplica``: each engine free-runs its ``serve_loop`` on
+    its own thread, the controller drives ``RemoteReplica`` HTTP
+    clients), then the chaos leg — one replica's process killed
+    mid-decode AND the other cut off by an injected client-side
+    partition that heals once the controller circuit-breaks it to
+    SUSPECT.  ``serve_fleet_net_zero_loss`` is the fraction of streams
+    finishing BIT-IDENTICAL to the single-engine oracle with an
+    exactly-once delivery record across the kill + retries + partition
+    + journal crash migration.  1.0 is the only acceptable reading
+    (PERF_FLOORS.json floors it there — the cross-process twin of
+    ``serve_fleet_zero_loss``)."""
+    import shutil
+    import tempfile
+
+    from triton_dist_tpu.models import llama
+    from triton_dist_tpu.models.generate import Generator
+    from triton_dist_tpu.runtime.faults import FaultInjector
+    from triton_dist_tpu.serve import Request, SamplingParams, ServeEngine
+    from triton_dist_tpu.serve.fleet import (
+        FleetController,
+        RemoteReplica,
+        ReplicaState,
+    )
+    from triton_dist_tpu.serve.net import InProcessReplica
+
+    max_seq = prompt_len + new_tokens
+    max_seq += (-max_seq) % page_size
+    cfg = llama.LlamaConfig(vocab=vocab, dim=dim, n_layers=n_layers,
+                            n_heads=2, n_kv_heads=2, ffn_dim=2 * dim,
+                            max_seq=max_seq, dtype=jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    params = llama.init_params(cfg, jax.random.key(seed))
+    gen = Generator(cfg, mesh, axis="sp", max_seq=max_seq)
+    per_req = -(-max_seq // page_size)
+    n_reqs = n_replicas * batch
+    rng = np.random.default_rng(seed)
+    reqs = [(f"n{i}", rng.integers(0, vocab, size=prompt_len)
+             .astype(np.int32)) for i in range(n_reqs)]
+    sp = SamplingParams(max_new_tokens=new_tokens)
+
+    oracle = {}
+    for rid, prompt in reqs:
+        eng = ServeEngine(gen, params, num_blocks=1 + per_req * n_reqs,
+                          page_size=page_size, max_batch=batch,
+                          prefill_chunk=max(8, page_size))
+        eng.submit(Request(rid, prompt, sp))
+        oracle[rid] = list(eng.run()[rid].token_ids)
+
+    client_inj = FaultInjector(seed=seed)
+    root = tempfile.mkdtemp(prefix="bench_fleet_net_")
+    procs: dict = {}
+
+    def factory(life_dir):
+        name = os.path.basename(os.path.dirname(life_dir))
+        eng = ServeEngine(gen, params,
+                          num_blocks=1 + per_req * n_reqs,
+                          page_size=page_size, max_batch=batch,
+                          prefill_chunk=max(8, page_size),
+                          snapshot_dir=life_dir)
+        if warmup:
+            eng.warmup()
+        rep = InProcessReplica(eng, stall_after_s=5.0,
+                               step_sleep_s=step_sleep_s)
+        procs[name] = rep
+        rr = RemoteReplica(name, rep.url, kill=rep.kill, retries=2,
+                           retry_base_s=0.01, retry_cap_s=0.05,
+                           timeout_s=5.0, faults=client_inj, seed=seed)
+        return rr.wait_ready(60)
+
+    try:
+        fc = FleetController(factory, n_replicas, root=root,
+                             suspect_after_s=0.5, dead_after_s=1.5,
+                             backoff_base_s=0.05, backoff_cap_s=0.1,
+                             max_restarts=0, seed=seed)
+        t0 = time.perf_counter()
+        for rid, prompt in reqs:
+            fc.submit(Request(rid, prompt, sp))
+        kill_name = fc.placement.get(reqs[0][0],
+                                     next(iter(fc.replicas)))
+        part_name = next(n for n in fc.replicas if n != kill_name)
+        killed = partitioned = healed = False
+        t_death = None
+        deadline = time.monotonic() + 300.0
+        while fc.has_work():
+            if time.monotonic() > deadline:
+                raise RuntimeError("bench_fleet_net: fleet not drained "
+                                   "inside the 300s chaos deadline")
+            fc.step()
+            toks = sum(len(s) for s in fc.streams.values())
+            if not killed and toks >= 1:
+                procs[kill_name].kill()
+                client_inj.inject("net", partition=True,
+                                  target=part_name)
+                killed = partitioned = True
+            if (partitioned and not healed
+                    and fc.replicas[part_name].state
+                    is ReplicaState.SUSPECT):
+                # the breaker opened on the partition: heal the link —
+                # the replica must recover to HEALTHY on its next
+                # proven progress, not die (the SIGKILLed one
+                # exercises DEAD)
+                client_inj.heal(target=part_name)
+                healed = True
+            if t_death is None and fc.deaths:
+                t_death = time.perf_counter()
+        dt = time.perf_counter() - t0
+        assert fc.deaths >= 1, "chaos leg never killed a replica"
+        assert healed, "the partition never drove SUSPECT (widen the " \
+                       "workload or shrink suspect_after_s)"
+        retries = sum(1 for e in fc.audit.entries()
+                      if e["kind"] == "net_retry")
+        exact = sum(1 for rid in oracle
+                    if rid in fc.outputs
+                    and list(fc.outputs[rid].token_ids) == oracle[rid]
+                    and fc.streams[rid] == oracle[rid])
+        toks = sum(len(o.token_ids) for o in fc.outputs.values())
+    finally:
+        # a wedged/failed chaos leg must not leak free-running replica
+        # threads into the later bench legs (they'd contend every
+        # subsequent measurement) nor its temp tree onto disk
+        for rep in procs.values():
+            rep.kill()
+        shutil.rmtree(root, ignore_errors=True)
+    return {
+        "mode": "fleet_net",
+        "replicas": n_replicas,
+        "requests": n_reqs,
+        "new_tokens": new_tokens,
+        "chaos_wall_s": round(dt, 4),
+        "net_fleet_toks_per_s": round(toks / dt, 1),
+        "chaos_deaths": fc.deaths,
+        "chaos_recovery_s": (round(time.perf_counter() - t_death, 4)
+                             if t_death is not None else None),
+        "net_retries": retries,
+        "serve_fleet_net_zero_loss": round(exact / len(oracle), 4),
+    }
+
+
 def bench_fleet_trace_overhead(*, n_replicas: int = 2, batch: int = 4,
                                prompt_len: int = 16,
                                new_tokens: int = 64, dim: int = 64,
@@ -630,6 +776,14 @@ def main():
                         "the recovery wall time (docs/serving.md "
                         "'Fleet serving'; PERF_FLOORS.json holds "
                         "serve_fleet_zero_loss at 1.0)")
+    p.add_argument("--net", action="store_true",
+                   help="with --fleet N: the NETWORK chaos leg — "
+                        "replicas reachable only over the serve/net.py "
+                        "wire, one process killed mid-decode plus an "
+                        "injected client-side partition of another "
+                        "(healed at SUSPECT), zero-loss vs the oracle "
+                        "(bench.py's serve_fleet_net_zero_loss, "
+                        "floor 1.0)")
     args = p.parse_args()
     if args.sessions is not None and args.sessions < 1:
         p.error(f"--sessions must be >= 1, got {args.sessions}")
@@ -637,6 +791,23 @@ def main():
         p.error(f"--turns must be >= 1, got {args.turns}")
     if args.fleet is not None and args.fleet < 1:
         p.error(f"--fleet must be >= 1, got {args.fleet}")
+    if args.net and args.fleet is None:
+        p.error("--net needs --fleet N")
+    if args.net and args.trace:
+        p.error("--net and --trace are separate fleet legs")
+    if args.net:
+        r = bench_fleet_net(n_replicas=args.fleet, batch=args.batch,
+                            prompt_len=args.prompt_len,
+                            new_tokens=args.new_tokens, dim=args.dim,
+                            n_layers=args.layers,
+                            page_size=args.page_size, seed=args.seed,
+                            warmup=not args.no_warmup)
+        print(json.dumps(r))
+        print(f"# net fleet N={r['replicas']}: chaos kill+partition -> "
+              f"zero-loss {r['serve_fleet_net_zero_loss']:.3f} "
+              f"(floor 1.0), {r['net_retries']} retries, recovery "
+              f"{r['chaos_recovery_s']}s", file=sys.stderr)
+        return
     if args.fleet is not None and args.trace:
         r = bench_fleet_trace_overhead(
             n_replicas=args.fleet, batch=args.batch,

@@ -427,10 +427,19 @@ class _ScrapeAggregate:
                                   self.replicas))
         texts = [t for t in scraped if t is not None]
         out = merge_scrapes(texts)
+        # per-replica one-hot health state (the fleet.FLEET_SERIES
+        # `fleet_replica_state` series, subprocess edition): alerting
+        # on the aggregate scrape sees WHICH breaker is open, not just
+        # pressure (docs/observability.md "Fleet observability")
+        from triton_dist_tpu.serve.fleet import replica_state_lines
+
+        L = replica_state_lines((rep.name, rep.state)
+                                for rep in self.replicas)
         return (f"# HELP fleet_scraped_replicas replicas answering "
                 f"this aggregate scrape\n"
                 f"# TYPE fleet_scraped_replicas gauge\n"
-                f"fleet_scraped_replicas {len(texts)}\n" + out)
+                f"fleet_scraped_replicas {len(texts)}\n"
+                + "\n".join(L) + "\n" + out)
 
 
 def supervise_fleet(args) -> int:

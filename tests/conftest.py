@@ -144,6 +144,15 @@ _FAST_GATE_MODULES = {
     # backoff/router units, and the supervisor arming-boundary +
     # postmortem-dedup satellites (the whole file is the fast tier).
     "test_serve_fleet",
+    # network serving plane: the net fault point (drop/delay/duplicate/
+    # partition + heal), wire round-trip bit-exactness, the retry-
+    # idempotency units (duplicate submit no-op, drain after a lost
+    # ack, stream-since-index re-delivery), client backoff/ambiguity
+    # semantics, the in-process kill+partition chaos, AND the
+    # subprocess chaos harness (SIGKILL one replica process mid-decode
+    # + partition another, deadline-bounded — the ISSUE-12 acceptance
+    # bar; the whole file is the fast tier).
+    "test_serve_net",
 }
 
 # Heavy tests inside core modules whose coverage is duplicated by a

@@ -231,3 +231,93 @@ def test_serve_engine_sessions():
     # 2 base requests + 2 turns x 2 follow-ups, 4 tokens each
     assert re.search(r"req-0\.t2: prompt \d+ -> 4 tokens", out), out
     assert "done" in out
+
+
+def test_serve_engine_migrate_in_cli(tmp_path):
+    """--migrate-in (the recovery.save_manifest docstring's promise): a
+    killed run's journal becomes a JSON manifest, a fresh CLI process
+    adopts it at startup, prints per-request placement, and serves the
+    carried requests to completion."""
+    d1 = str(tmp_path / "src")
+    # a run that dies mid-stream leaves its journal behind
+    _run("--engine", "--requests", "3", "--stagger", "1", "--max-batch",
+         "2", "--page-size", "8", "--snapshot-dir", d1,
+         "--kill-at-step", "6", devices=1, new_tokens=8, expect_rc=17)
+    from triton_dist_tpu.serve.recovery import (
+        manifest_from_journal,
+        save_manifest,
+    )
+
+    manifest = manifest_from_journal(d1, mark=True)
+    assert manifest["requests"], "kill-at-step left nothing in flight"
+    path = str(tmp_path / "manifest.json")
+    save_manifest(manifest, path)
+    out = _run("--engine", "--requests", "0", "--stagger", "1",
+               "--max-batch", "2", "--page-size", "8",
+               "--migrate-in", path, devices=1, new_tokens=8)
+    import re
+    for rec in manifest["requests"]:
+        # JSON manifests are KV-stripped: every request requeues
+        assert f"migrate-in {rec['rid']}: requeued" in out, out
+        assert re.search(rf"{rec['rid']}: prompt \d+ -> 8 tokens "
+                         rf"\(length\)", out), out
+    assert re.search(r"migrate-in: 0 adopted, \d+ requeued, 0 rejected",
+                     out), out
+    assert "done" in out
+
+
+def test_serve_engine_serve_port_cli(tmp_path):
+    """--serve-port: the network ingest end-to-end through the CLI — a
+    request submitted over POST /submit streams back over GET /stream,
+    and the child exits on --serve-idle-exit."""
+    import json as _json
+    import subprocess as _sp
+    import time as _time
+    import urllib.request
+
+    d = str(tmp_path / "rep")
+    os.makedirs(d, exist_ok=True)
+    proc = _sp.Popen(
+        [sys.executable, SCRIPT, "--engine", "--new-tokens", "6",
+         "--serve-port", "0", "--snapshot-dir", d,
+         "--serve-idle-exit", "8", "--serve-deadline", "240",
+         "--max-batch", "2", "--page-size", "8"],
+        env=_env(1), stdout=_sp.PIPE, stderr=_sp.STDOUT, text=True)
+    try:
+        from triton_dist_tpu.serve.net import PORT_FILE, read_port_file
+        port = read_port_file(os.path.join(d, PORT_FILE),
+                              deadline_s=180.0)
+        url = f"http://127.0.0.1:{port}"
+
+        def post(path, doc):
+            req = urllib.request.Request(
+                url + path, data=_json.dumps(doc).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return _json.loads(r.read().decode())
+
+        resp = post("/submit", {"rid": "wire-0",
+                                "prompt": [5, 6, 7, 8],
+                                "params": {"max_new_tokens": 6}})
+        assert resp.get("ok"), resp
+        t0 = _time.monotonic()
+        while True:
+            with urllib.request.urlopen(
+                    f"{url}/stream?rid=wire-0&since=0",
+                    timeout=30) as r:
+                st = _json.loads(r.read().decode())
+            if st["done"]:
+                break
+            assert _time.monotonic() - t0 < 120
+            _time.sleep(0.05)
+        assert len(st["tokens"]) == 6 and st["reason"] == "length"
+        post("/shutdown", {})
+        out, _ = proc.communicate(timeout=120)
+        assert proc.returncode == 0, out[-2000:]
+        assert "net: replica serving at" in out, out
+        assert "net: serve loop exited" in out, out
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()

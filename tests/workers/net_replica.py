@@ -1,0 +1,74 @@
+"""Replica child for the subprocess network-fleet chaos harness
+(tests/test_serve_net.py).
+
+Builds the SAME seeded tiny model the test fixture builds (so the
+parent's single-engine oracle pins this process's streams bit-exactly),
+opens the network ingest (serve/net.py ``ReplicaServer``), publishes
+its bound port next to the snapshot dir, and runs ``serve_loop`` under
+an EXPLICIT wall-clock deadline — a wedged child exits on its own
+rather than hanging tier-1 (the parent SIGKILLs besides; belt and
+suspenders).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+from triton_dist_tpu.models import llama  # noqa: E402
+from triton_dist_tpu.models.generate import Generator  # noqa: E402
+from triton_dist_tpu.serve import ServeEngine  # noqa: E402
+from triton_dist_tpu.serve.net import (  # noqa: E402
+    PORT_FILE,
+    ReplicaServer,
+    serve_loop,
+    write_port_file,
+)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--snapshot-dir", required=True)
+    p.add_argument("--seed", type=int, default=3)
+    p.add_argument("--max-seq", type=int, default=64)
+    p.add_argument("--num-blocks", type=int, default=60)
+    p.add_argument("--page-size", type=int, default=4)
+    p.add_argument("--max-batch", type=int, default=2)
+    p.add_argument("--prefill-chunk", type=int, default=4)
+    p.add_argument("--deadline-s", type=float, default=240.0)
+    p.add_argument("--step-sleep-s", type=float, default=0.0)
+    p.add_argument("--idle-exit-s", type=float, default=None)
+    args = p.parse_args()
+
+    # the tests/test_serve_net.py `tiny` fixture, exactly — the parent
+    # oracle and this child must disagree on nothing
+    cfg = llama.LlamaConfig(vocab=64, dim=16, n_layers=1, n_heads=2,
+                            n_kv_heads=1, ffn_dim=32,
+                            max_seq=args.max_seq, dtype=jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    params = llama.init_params(cfg, jax.random.key(args.seed))
+    gen = Generator(cfg, mesh, axis="sp", max_seq=args.max_seq)
+    engine = ServeEngine(gen, params, num_blocks=args.num_blocks,
+                         page_size=args.page_size,
+                         max_batch=args.max_batch,
+                         prefill_chunk=args.prefill_chunk,
+                         snapshot_dir=args.snapshot_dir)
+    server = ReplicaServer(engine)
+    server.start(port=0)
+    write_port_file(os.path.join(args.snapshot_dir, PORT_FILE),
+                    server.port)
+    serve_loop(engine, server, deadline_s=args.deadline_s,
+               step_sleep_s=args.step_sleep_s,
+               exit_when_idle_s=args.idle_exit_s)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

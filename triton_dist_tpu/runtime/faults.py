@@ -20,6 +20,12 @@ point           context                  seam
 ``callback``    ``rid``                  the ``on_token`` invocation seam
 ``clock``       —                        each reading of a
                                          ``wrap_clock()``-wrapped clock
+``net``         ``op, target, where``    the network serving plane
+                                         (serve/net.py): every client
+                                         request and both server halves
+                                         (``where`` = ``client`` /
+                                         ``server_recv`` /
+                                         ``server_resp``)
 ==============  =======================  ================================
 
 Actions: ``error=`` raises :class:`InjectedFault` at the point;
@@ -31,8 +37,21 @@ for process death, which no containment path may swallow (the crash-
 recovery tests catch it at the harness level, abandon the engine object
 like the OS would, and restart from disk).
 
+Network actions (the ``net`` point; docs/serving.md "Network fleet
+serving"): ``drop=True`` raises :class:`InjectedNetFault` at the seam —
+the packet is lost (a client seam drop means the request never left; a
+``server_recv`` drop means it never arrived; a ``server_resp`` drop
+means the action LANDED but the ack was lost — the seam idempotent-retry
+tests live on); ``delay_s=`` sleeps the call (drives client timeouts);
+``duplicate=True`` makes the transport send the request twice (the
+server must dedupe); ``partition=True`` is a PERSISTENT drop — every
+matching call raises until :meth:`heal` clears it (the deterministic
+stand-in for a network partition; pair with ``target=`` to cut one
+replica off).
+
 A spec fires when its filters match: ``at_call`` pins the nth *enabled*
 arrival at the point, ``rid`` / ``op`` restrict to one request / program,
+``target`` / ``where`` restrict a ``net`` spec to one peer / seam side,
 ``rate`` draws from the seeded stream (deterministic given an identical
 call sequence).  ``at_call`` faults are one-shot by default; everything
 else fires every match (``max_fires`` overrides either).
@@ -77,6 +96,18 @@ class InjectedKill(BaseException):
     token journal on disk; docs/serving.md "Crash recovery")."""
 
 
+class InjectedNetFault(RuntimeError):
+    """A lost packet (``drop=``) or a severed link (``partition=``) at a
+    ``net`` seam.  The network transport (serve/net.py) is the ONLY
+    party that may catch it — it must treat the firing exactly like a
+    real socket error: the client retries under backoff, the server
+    aborts the connection without answering."""
+
+    def __init__(self, msg: str, action: str):
+        super().__init__(msg)
+        self.action = action
+
+
 @dataclass
 class _FaultSpec:
     point: str
@@ -89,6 +120,10 @@ class _FaultSpec:
     op: Optional[str] = None
     max_fires: Optional[int] = None
     kill: bool = False
+    net: Optional[str] = None       # drop / duplicate / partition
+    target: Optional[str] = None    # net peer filter (replica name)
+    where: Optional[str] = None     # net seam side filter
+    healed: bool = False            # heal() turned this spec off
     fires: int = 0
 
 
@@ -128,22 +163,48 @@ class FaultInjector:
 
     def inject(self, point: str, *, error: Optional[str] = None,
                stall_s: float = 0.0, skew_s: float = 0.0,
-               kill: bool = False,
+               kill: bool = False, drop: bool = False,
+               delay_s: float = 0.0, duplicate: bool = False,
+               partition: bool = False, target: Optional[str] = None,
+               where: Optional[str] = None,
                at_call: Optional[int] = None, rate: float = 1.0,
                rid: Optional[str] = None, op: Optional[str] = None,
                max_fires: Optional[int] = None) -> "FaultInjector":
         """Arm one fault spec; returns ``self`` so specs chain."""
-        if error is None and not stall_s and not skew_s and not kill:
+        net = ("drop" if drop else "duplicate" if duplicate
+               else "partition" if partition else None)
+        if sum((drop, duplicate, partition)) > 1:
+            raise ValueError("drop=, duplicate= and partition= are "
+                             "mutually exclusive net actions")
+        stall_s = stall_s or delay_s
+        if (error is None and not stall_s and not skew_s and not kill
+                and net is None):
             raise ValueError("a fault needs an action: error=, stall_s=, "
-                             "skew_s= or kill=")
+                             "skew_s=, kill=, drop=, delay_s=, "
+                             "duplicate= or partition=")
         if not 0.0 <= rate <= 1.0:
             raise ValueError(f"rate must be in [0, 1], got {rate}")
         if max_fires is None and at_call is not None:
             max_fires = 1
         self._specs.append(_FaultSpec(
             point, error, stall_s, skew_s, at_call, rate, rid, op,
-            max_fires, kill))
+            max_fires, kill, net, target, where))
         return self
+
+    def heal(self, point: str = "net", *,
+             target: Optional[str] = None) -> int:
+        """Deactivate armed specs at ``point`` (optionally only those
+        filtered to ``target``) — the deterministic end of a
+        ``partition=`` window.  Returns how many specs it healed."""
+        n = 0
+        for f in self._specs:
+            if f.point != point or f.healed:
+                continue
+            if target is not None and f.target != target:
+                continue
+            f.healed = True
+            n += 1
+        return n
 
     def set_step(self, step: int) -> None:
         """Record the engine's monotonic iteration index; every audit
@@ -163,14 +224,20 @@ class FaultInjector:
     # -- the fault points -------------------------------------------------
 
     def fire(self, point: str, *, rid: Optional[str] = None,
-             rids: tuple = (), op: Optional[str] = None) -> None:
+             rids: tuple = (), op: Optional[str] = None,
+             target: Optional[str] = None,
+             where: Optional[str] = None) -> Optional[str]:
         """Called by an instrumented seam each time execution passes
-        ``point``; may raise :class:`InjectedFault`, sleep, or no-op."""
+        ``point``; may raise :class:`InjectedFault` /
+        :class:`InjectedNetFault`, sleep, or no-op.  Returns
+        ``"duplicate"`` when a net duplicate spec fired (the transport
+        must then send the request twice), else ``None``."""
         if not self._enabled:
-            return
+            return None
         n = self.calls[point] = self.calls.get(point, 0) + 1
+        result = None
         for f in self._specs:
-            if f.point != point:
+            if f.point != point or f.healed:
                 continue
             if f.max_fires is not None and f.fires >= f.max_fires:
                 continue
@@ -178,15 +245,22 @@ class FaultInjector:
                 continue
             if f.op is not None and f.op != op:
                 continue
+            if f.target is not None and f.target != target:
+                continue
+            if f.where is not None and f.where != where:
+                continue
             if f.at_call is not None:
                 if f.at_call != n:
                     continue
             elif f.rate < 1.0 and self._rng.random() >= f.rate:
                 continue
             f.fires += 1
-            kind = ("kill" if f.kill else "error" if f.error is not None
+            kind = (f.net if f.net is not None
+                    else "kill" if f.kill
+                    else "error" if f.error is not None
                     else "stall" if f.stall_s else "skew")
-            who = rid or (f.rid if f.rid in rids else None) or op
+            who = (rid or (f.rid if f.rid in rids else None) or target
+                   or op)
             self.fired.append((point, n, kind, who, self.step))
             if f.skew_s:
                 self._skew += f.skew_s
@@ -196,10 +270,18 @@ class FaultInjector:
                 raise InjectedKill(
                     f"injected kill at {point} #{n} (step {self.step})"
                     f"{f' ({who})' if who else ''}")
+            if f.net in ("drop", "partition"):
+                raise InjectedNetFault(
+                    f"injected net {f.net} at {point} #{n}"
+                    f"{f' ({who})' if who else ''}"
+                    f"{f' [{where}]' if where else ''}", f.net)
+            if f.net == "duplicate":
+                result = "duplicate"
             if f.error is not None:
                 raise InjectedFault(
                     f"injected {point} fault #{n}"
                     f"{f' ({who})' if who else ''}: {f.error}")
+        return result
 
     def wrap_clock(self, clock):
         """Wrap an engine clock: each reading passes the ``clock`` fault

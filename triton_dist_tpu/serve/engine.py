@@ -1073,6 +1073,16 @@ class ServeEngine:
         requests the restored journal already carries."""
         return request_id in self._states
 
+    def unfinished_rids(self) -> list[str]:
+        """Ids still in flight (WAITING / PREFILL / RUNNING) — what a
+        no-argument :meth:`drain` would hand off.  The network drain
+        endpoint (serve/net.py) filters retried rids through this, so a
+        drain whose first attempt already landed is a no-op, never an
+        error."""
+        return [rid for rid, rs in self._states.items()
+                if rs.status is not Status.FINISHED
+                and not rid.startswith("__warmup_")]
+
     # -- crash recovery ---------------------------------------------------
 
     def _journal_on(self, rid: str) -> bool:
@@ -1244,9 +1254,7 @@ class ServeEngine:
         from triton_dist_tpu.serve.recovery import MANIFEST_FORMAT
 
         if rids is None:
-            rids = [rid for rid, rs in self._states.items()
-                    if rs.status is not Status.FINISHED
-                    and not rid.startswith("__warmup_")]
+            rids = self.unfinished_rids()
         rids = list(dict.fromkeys(rids))  # a duplicate would double-free
         now = self._clock()
         spec_live = bool(self.spec_k) and not self._spec_off

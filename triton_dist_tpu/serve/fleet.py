@@ -65,6 +65,14 @@ from triton_dist_tpu.serve.metrics import (
     ServeMetrics,
     WindowedRate,
 )
+from triton_dist_tpu.serve.net import (
+    NetClient,
+    NetError,
+    NetHTTPError,
+    NetUnreachable,
+    decode_manifest,
+    encode_manifest,
+)
 from triton_dist_tpu.serve.request import (
     FinishReason,
     Request,
@@ -203,6 +211,10 @@ class DecisionAudit:
 #: fleet twin of the PR-8 event/fault coverage test.
 FLEET_SERIES = (
     "fleet_replicas",              # gauge, {state=...}: replica counts
+    "fleet_replica_state",         # gauge, {replica=,state=}: one-hot
+    #                                per-replica health (alerting sees
+    #                                WHICH breaker is open, not just a
+    #                                count)
     "fleet_lives_total",           # counter: replica lives ever started
     "fleet_deaths_total",          # counter: replica deaths
     "fleet_migrations_total",      # counter: requests moved between replicas
@@ -269,6 +281,23 @@ class ReplicaLoad:
                    running=int(g.get("serve_running", 0)),
                    max_batch=max_batch,
                    kv_util=float(g.get("serve_kv_utilization", 0.0)))
+
+
+def replica_state_lines(named_states) -> list[str]:
+    """The ``fleet_replica_state{replica=,state=}`` one-hot exposition
+    (docs/observability.md "Fleet observability") from ``[(name,
+    ReplicaState), ...]`` — ONE renderer shared by
+    ``FleetController.to_prometheus`` and the supervisor's subprocess
+    aggregate, so the two expositions cannot drift.  The full 0/1
+    matrix (not just the current state) keeps a PromQL
+    ``max by (replica)`` well-defined across flips."""
+    L = ["# TYPE fleet_replica_state gauge"]
+    for name, state in named_states:
+        for st in ReplicaState:
+            L.append(f'fleet_replica_state{{replica="{name}",'
+                     f'state="{st.value}"}} '
+                     f'{1 if state is st else 0}')
+    return L
 
 
 class Router:
@@ -364,7 +393,464 @@ class EngineReplica:
         self.death_reason = None
 
     def load(self) -> ReplicaLoad:
+        if hasattr(self.engine, "load"):   # RemoteReplica carries its
+            return self.engine.load()      # own scrape-fed snapshot
         return ReplicaLoad.from_engine(self.engine)
+
+
+# ---------------------------------------------------------------------------
+# Remote replica: the engine protocol over the wire (serve/net.py)
+# ---------------------------------------------------------------------------
+
+
+def _manifest_header(manifest: dict) -> dict:
+    """The placement-relevant manifest envelope (everything but the
+    per-request records) — ONE extraction for every site that re-parks
+    or re-places a rec, so a new header key cannot be silently
+    stripped at one of them."""
+    return {k: manifest[k] for k in
+            ("format", "clock", "page_size", "kv_geom")
+            if k in manifest}
+
+
+class _RemoteKill:
+    """``RemoteReplica._journal``: for a remote replica, "closing the
+    journal" means making sure the remote WRITER is gone — the
+    controller closes it right before the crash-path
+    ``manifest_from_journal(mark=True)``, which must be the single
+    writer on the dead life's journal.  ``kill`` is the SIGKILL hook
+    the spawning factory provides (a subprocess's ``proc.kill()``; an
+    :class:`serve.net.InProcessReplica`'s ``kill()``)."""
+
+    def __init__(self, kill: Optional[Callable]):
+        self._kill = kill
+
+    def close(self) -> None:
+        if self._kill is not None:
+            self._kill()
+
+
+class RemoteReplica:
+    """A replica process over the wire, speaking the SAME protocol the
+    :class:`FleetController` speaks to in-process engines — submit /
+    step / drain / migrate_in / has_work / load — so a fleet of
+    subprocesses drives through the identical controller code path
+    (docs/serving.md "Network fleet serving").
+
+    Fault tolerance is the client's half of the contract:
+
+    - every call has a per-call timeout and bounded retries under
+      jittered exponential backoff (:class:`serve.net.NetClient` on
+      :class:`RestartBackoff`); each retry lands a ``net_retry`` event
+      in this replica's ring and a ``net_retry`` entry in the fleet's
+      :class:`DecisionAudit` (``attach_fleet``);
+    - retries are IDEMPOTENT by protocol: submits key on the rid,
+      drains/migrations on a client-generated idempotency key the
+      server replays from its response cache — a retry whose first
+      attempt landed is a no-op, never a duplicate stream;
+    - a call that fails EVERY retry is ambiguous — it may have landed.
+      The request stays optimistically BOUND to this replica
+      (``_maybe``): the next successful contact re-sends it
+      (idempotent, so landing twice is impossible), and if the replica
+      instead dies, :meth:`unplaced` hands back exactly the ones the
+      dead journal does not cover — the journal is the ownership
+      record, so nothing is ever served from two replicas;
+    - :meth:`step` raising :class:`~serve.net.NetUnreachable` (or
+      :meth:`ping` returning ``False`` while idle) is NOT a death: the
+      controller records no progress and the probe age walks the
+      HEALTHY→SUSPECT→DEAD ladder — a partition is handled by the same
+      machinery as a SIGKILL, just ``dead_after_s`` later.
+    """
+
+    def __init__(self, name: str, url: str, *,
+                 kill: Optional[Callable] = None,
+                 timeout_s: float = 5.0, retries: int = 2,
+                 retry_base_s: float = 0.05, retry_cap_s: float = 2.0,
+                 ping_interval_s: float = 0.2,
+                 faults=None, trace_events: int = 512,
+                 trace_level: int = 1, seed: int = 0):
+        self.name = name
+        self.url = url
+        self.timeout_s = timeout_s
+        self.trace = FlightRecorder(capacity=trace_events,
+                                    level=trace_level)
+        self.audit: Optional[DecisionAudit] = None
+        self.client = NetClient(url, name=name, timeout_s=timeout_s,
+                                retries=retries,
+                                retry_base_s=retry_base_s,
+                                retry_cap_s=retry_cap_s, seed=seed,
+                                faults=faults,
+                                on_retry=self._on_retry)
+        self.metrics = ServeMetrics()   # client-side stub: the fleet
+        #                                 aggregate for subprocesses is
+        #                                 the scrape path (merge_scrapes)
+        self._journal = _RemoteKill(kill)
+        self.max_queue: Optional[int] = None
+        self.last_contact: Optional[float] = None
+        self.ping_interval_s = ping_interval_s
+        self._last_ping: Optional[tuple] = None   # (mono_ts, ok)
+        self._load = ReplicaLoad()
+        self._live: dict[str, dict] = {}
+        self._maybe_reqs: dict[str, dict] = {}
+        self._maybe_migs: list[dict] = []
+        self._bounced: list[tuple] = []   # (header, rec) to re-place
+        self._drains = 0
+        self._migs = 0
+
+    def attach_fleet(self, audit: DecisionAudit) -> None:
+        """Wire this client's retry reporting into the fleet's decision
+        audit (the controller calls it after every ``start``)."""
+        self.audit = audit
+
+    def _on_retry(self, op: str, attempt: int, delay: float,
+                  err: str) -> None:
+        self.trace.emit("net_retry", None, replica=self.name, op=op,
+                        attempt=attempt, delay_s=round(delay, 4),
+                        err=err)
+        if self.audit is not None:
+            self.audit.record(time.monotonic(), -1, "net_retry",
+                              replica=self.name, op=op, attempt=attempt,
+                              delay_s=round(delay, 4))
+
+    # -- liveness / load ---------------------------------------------------
+
+    def _absorb_health(self, h: dict) -> bool:
+        from triton_dist_tpu.serve.net import NET_PROTOCOL
+
+        p = h.get("protocol", NET_PROTOCOL)
+        if p != NET_PROTOCOL:
+            # fail LOUD, not quietly-unhealthy: a wire-version mismatch
+            # is an operator error (stale replica binary), and treating
+            # it as a partition would just burn the restart budget.
+            # Plain RuntimeError deliberately — NetError handlers must
+            # not swallow it.
+            raise RuntimeError(
+                f"replica {self.name} speaks net protocol {p}; this "
+                f"client speaks {NET_PROTOCOL} — mismatched builds")
+        if not h.get("ok"):
+            return False
+        self.last_contact = time.monotonic()
+        self.max_queue = h.get("max_queue")
+        self._load = ReplicaLoad(
+            queue_depth=int(h.get("queue_depth", 0)),
+            running=int(h.get("running", 0)),
+            max_batch=int(h.get("max_batch", 1)),
+            kv_util=float(h.get("kv_util", 0.0)))
+        return True
+
+    def ping(self, force: bool = False) -> bool:
+        """One health probe — a SINGLE short-timeout attempt, no retry
+        ladder, throttled to ``ping_interval_s`` (the health ladder's
+        granularity is ``suspect_after_s``, so the controller's
+        per-tick idle pings need no finer resolution and a blackholed
+        replica must not cost the single-threaded loop a timeout on
+        EVERY tick).  ``False`` means unreachable OR the remote serve
+        loop stopped pumping — either way, no progress to prove."""
+        now = time.monotonic()
+        if (not force and self._last_ping is not None
+                and now - self._last_ping[0] < self.ping_interval_s):
+            return self._last_ping[1]
+        try:
+            h = self.client.call("health", "/health", retries=0,
+                                 timeout_s=min(self.timeout_s, 1.0))
+            ok = self._absorb_health(h)
+        except NetError:
+            ok = False
+        self._last_ping = (time.monotonic(), ok)
+        return ok
+
+    def wait_ready(self, deadline_s: float = 60.0,
+                   poll_s: float = 0.1) -> "RemoteReplica":
+        """Block until the replica answers /health (spawning factories
+        call this so the controller never adopts a half-started child);
+        raises :class:`NetError` past the bounded deadline."""
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline_s:
+            if self.ping():
+                return self
+            time.sleep(poll_s)
+        raise NetError(f"replica {self.name} at {self.url} not ready "
+                       f"within {deadline_s}s")
+
+    def load(self) -> ReplicaLoad:
+        return self._load
+
+    # -- the engine protocol ----------------------------------------------
+
+    def submit(self, req: Request):
+        from triton_dist_tpu.serve.engine import QueueFull
+
+        rid = req.request_id
+        doc = {"rid": rid,
+               "prompt": [int(x) for x in np.asarray(req.prompt)],
+               "params": req.params.to_dict(), "trace": req.trace}
+        self._live[rid] = {"acked": 0, "tokens": [], "cb": req.on_token,
+                           "done": False,
+                           "prompt": np.asarray(req.prompt, np.int32),
+                           "req": req}
+        try:
+            resp = self.client.call("submit", "/submit", method="POST",
+                                    body=doc)
+        except NetHTTPError as e:
+            # the replica ANSWERED with an error: definitive, not
+            # ambiguous — same behavior as an in-process engine
+            # raising at submit()
+            del self._live[rid]
+            raise ValueError(
+                f"replica {self.name} rejected submit: {e}") from e
+        except NetError:
+            # ambiguous: it may have landed.  Bind it here (optimistic)
+            # — reconciliation re-sends idempotently on the next
+            # successful contact, and death resolves through the
+            # journal (unplaced()).  Placing it elsewhere NOW could
+            # serve one stream from two replicas.
+            self._maybe_reqs[rid] = doc
+            return None
+        if resp.get("queue_full"):
+            del self._live[rid]
+            raise QueueFull(resp.get("why",
+                                     f"{self.name}: queue at bound"))
+        if resp.get("rejected"):
+            del self._live[rid]
+            raise ValueError(f"replica {self.name} rejected submit: "
+                             f"{resp.get('why')}")
+        if resp.get("shed"):
+            del self._live[rid]
+            rm = RequestMetrics(arrival_time=time.monotonic())
+            rm.finish_time = rm.arrival_time
+            return RequestOutput(
+                request_id=rid, prompt=req.prompt, token_ids=[],
+                finish_reason=FinishReason(resp["reason"]), metrics=rm,
+                error=resp.get("error"))
+        return None
+
+    def migrate_in(self, manifest: dict, *, on_token=None) -> dict:
+        from triton_dist_tpu.serve.recovery import _resolve_callback
+
+        enc = encode_manifest(manifest)
+        self._migs += 1
+        key = f"{self.name}-mig-{self._migs}"
+        rids = [rec["rid"] for rec in manifest.get("requests", ())]
+        for rec in manifest.get("requests", ()):
+            rid = rec["rid"]
+            toks = [int(t) for t in rec.get("tokens", [])]
+            self._live[rid] = {
+                "acked": len(toks), "tokens": toks,
+                "cb": _resolve_callback(on_token, rid), "done": False,
+                "prompt": np.asarray(rec.get("prompt", []), np.int32),
+                "req": None}
+        try:
+            resp = self.client.call(
+                "migrate_in", "/migrate_in", method="POST",
+                body={"manifest": enc, "key": key},
+                timeout_s=max(self.timeout_s, 30.0))
+        except NetHTTPError as e:
+            # answered-with-error is definitive: nothing was adopted —
+            # report every rec rejected so the placer walks on
+            for rid in rids:
+                self._live.pop(rid, None)
+            return {"adopted": [], "requeued": [],
+                    "rejected": {rid: str(e) for rid in rids}}
+        except NetError:
+            # ambiguous — bound here until reconciled or resolved by
+            # the journal at death (same argument as submit)
+            self._maybe_migs.append({"enc": enc, "key": key,
+                                     "manifest": manifest})
+            return {"adopted": [], "requeued": rids, "rejected": {}}
+        for rid in resp.get("rejected", {}):
+            self._live.pop(rid, None)
+        return {"adopted": resp.get("adopted", []),
+                "requeued": resp.get("requeued", []),
+                "rejected": resp.get("rejected", {})}
+
+    def drain(self, rids: Optional[list] = None, *,
+              include_kv: bool = True) -> dict:
+        """Cooperative migrate-out over the wire.  The idempotency key
+        makes a retried drain return the CACHED manifest — the engine
+        drains once however flaky the ack path was.  Raises
+        :class:`NetError` when the replica is unreachable (a
+        cooperative drain needs a live peer; the crash path is the
+        journal).
+
+        The key advances only on SUCCESS: a drain that raised may have
+        LANDED (receipts written, state released, manifest cached) —
+        the next :meth:`drain` call re-uses the outstanding key, so it
+        recovers exactly that manifest instead of asking a drained
+        engine for its (now empty) in-flight set and stranding the
+        handed-off streams.  (The server keeps the cached response for
+        ``cache_ttl_s`` — retry within it; past that, a dead replica's
+        journal still has the receipts but the cooperative manifest is
+        gone.)"""
+        key = f"{self.name}-drain-{self._drains + 1}"
+        resp = self.client.call(
+            "drain", "/drain", method="POST",
+            body={"rids": rids, "key": key, "include_kv": include_kv},
+            timeout_s=max(self.timeout_s, 30.0))
+        self._drains += 1
+        m = decode_manifest(resp["manifest"])
+        for rec in m.get("requests", ()):
+            self._live.pop(rec["rid"], None)
+        return m
+
+    def has_work(self) -> bool:
+        return (any(not s["done"] for s in self._live.values())
+                or bool(self._maybe_reqs) or bool(self._maybe_migs))
+
+    def _reconcile(self) -> None:
+        """Re-send every ambiguous call on a proven-reachable replica.
+        Idempotent by protocol: a maybe that landed answers ``dup`` /
+        the cached response; one that never arrived lands now."""
+        for rid, doc in list(self._maybe_reqs.items()):
+            try:
+                resp = self.client.call("submit", "/submit",
+                                        method="POST", body=doc)
+            except NetHTTPError:
+                # answered-with-error: definitively not here — hand it
+                # back for re-placement (a genuinely invalid request
+                # then fails at its next placement exactly like an
+                # in-process submit would)
+                s = self._live.pop(rid, None)
+                del self._maybe_reqs[rid]
+                if s is not None and s.get("req") is not None:
+                    self._bounced.append(("req", s["req"]))
+                continue
+            except NetError:
+                return
+            if resp.get("rejected"):
+                s = self._live.pop(rid, None)
+                del self._maybe_reqs[rid]
+                if s is not None and s.get("req") is not None:
+                    self._bounced.append(("req", s["req"]))
+                continue
+            if resp.get("queue_full"):
+                # the replica ANSWERED queue_full, so the ambiguity is
+                # resolved: the request is definitively NOT here (a
+                # landed first attempt would have answered dup).  Hand
+                # it back for fleet re-placement — pinning it to a
+                # persistently-full replica would starve it while
+                # others sit idle.
+                s = self._live.pop(rid, None)
+                del self._maybe_reqs[rid]
+                if s is not None and s.get("req") is not None:
+                    self._bounced.append(("req", s["req"]))
+                continue
+            del self._maybe_reqs[rid]
+        for m in list(self._maybe_migs):
+            try:
+                resp = self.client.call(
+                    "migrate_in", "/migrate_in", method="POST",
+                    body={"manifest": m["enc"], "key": m["key"]},
+                    timeout_s=max(self.timeout_s, 30.0))
+            except NetHTTPError:
+                # definitive: nothing adopted — bounce every rec back
+                # to the controller for re-placement elsewhere
+                self._maybe_migs.remove(m)
+                hdr = _manifest_header(m["manifest"])
+                for rec in m["manifest"].get("requests", ()):
+                    self._live.pop(rec["rid"], None)
+                    self._bounced.append(("rec", hdr, rec))
+                continue
+            except NetError:
+                return
+            self._maybe_migs.remove(m)
+            header = _manifest_header(m["manifest"])
+            for rid, why in resp.get("rejected", {}).items():
+                if "duplicate" in str(why):
+                    continue   # the first attempt landed: a no-op
+                # genuine capacity rejection — hand the rec back to the
+                # controller for re-placement elsewhere
+                self._live.pop(rid, None)
+                for rec in m["manifest"].get("requests", ()):
+                    if rec["rid"] == rid:
+                        self._bounced.append(("rec", header, rec))
+
+    def take_bounced(self) -> list:
+        """Work the replica definitively rejected after an ambiguous
+        window (``("req", Request)`` fresh submits, ``("rec", header,
+        rec)`` migration records) — the controller drains this each
+        tick and re-places them."""
+        out, self._bounced = self._bounced, []
+        return out
+
+    def step(self) -> list:
+        """One controller tick against this replica: prove liveness,
+        reconcile ambiguous calls, poll every live stream since its
+        acknowledged index, deliver the new tokens, and return the
+        retirements.  ONE round trip when there is work — /poll's
+        response carries the health/load snapshot, so a separate ping
+        is only paid when there is nothing to poll.  Raises
+        :class:`~serve.net.NetUnreachable` when the replica answers
+        nothing — the controller counts that as missing progress, not
+        death."""
+        polls = {rid: s["acked"] for rid, s in self._live.items()
+                 if not s["done"] and rid not in self._maybe_reqs}
+        outs: list[RequestOutput] = []
+        if not polls:
+            if not self.ping():
+                raise NetUnreachable(
+                    f"replica {self.name} at {self.url}: "
+                    f"no health answer")
+            self._reconcile()
+            return outs
+        try:
+            resp = self.client.call("poll", "/poll", method="POST",
+                                    body={"streams": polls})
+        except NetError as e:
+            raise NetUnreachable(str(e)) from e
+        if not self._absorb_health(resp.get("health", {"ok": True})):
+            # answered, but the serve loop behind it stopped pumping:
+            # tokens (if any) are still real, progress is not proven
+            raise NetUnreachable(
+                f"replica {self.name} at {self.url}: serve loop "
+                f"not pumping")
+        self._reconcile()
+        now = time.monotonic()
+        for rid, st in resp.get("streams", {}).items():
+            s = self._live.get(rid)
+            if s is None or st.get("missing"):
+                continue
+            for t in st.get("tokens", ()):
+                s["tokens"].append(int(t))
+                if s["cb"] is not None:
+                    try:
+                        s["cb"](rid, int(t))
+                    except Exception:  # noqa: BLE001 — the engine-side
+                        s["cb"] = None  # callback-containment rule
+                # the ack advances only once the token is DELIVERED: a
+                # poll response lost mid-delivery re-serves from here
+                s["acked"] += 1
+            if st.get("done") and not s["done"]:
+                s["done"] = True
+                rm = RequestMetrics(arrival_time=now)
+                rm.finish_time = now
+                outs.append(RequestOutput(
+                    request_id=rid, prompt=s["prompt"],
+                    token_ids=list(s["tokens"]),
+                    finish_reason=FinishReason(st["reason"]),
+                    metrics=rm, error=st.get("error")))
+        for rid in [r for r, s in self._live.items() if s["done"]]:
+            del self._live[rid]
+        return outs
+
+    def unplaced(self) -> tuple[list, list]:
+        """What this client could never confirm landed — called at
+        replica death, AFTER the journal manifest: the controller
+        re-places exactly the rids the dead journal does not cover
+        (anything journaled is owned; anything else never arrived)."""
+        reqs = [self._live[rid]["req"] for rid in self._maybe_reqs
+                if rid in self._live
+                and self._live[rid].get("req") is not None]
+        recs: list[tuple] = []
+        for m in self._maybe_migs:
+            header = _manifest_header(m["manifest"])
+            for rec in m["manifest"].get("requests", ()):
+                recs.append((header, rec))
+        for b in self._bounced:
+            if b[0] == "req":
+                reqs.append(b[1])
+            else:
+                recs.append((b[1], b[2]))
+        return reqs, recs
 
 
 # ---------------------------------------------------------------------------
@@ -465,6 +951,8 @@ class FleetController:
                 jitter=backoff_jitter, healthy_reset_s=healthy_reset_s,
                 max_restarts=max_restarts, seed=seed + i)
             rep.start(now)
+            if hasattr(rep.engine, "attach_fleet"):
+                rep.engine.attach_fleet(self.audit)
             self._backoff[name].on_start(now)
         self.steps = 0
         self.deaths = 0
@@ -690,6 +1178,8 @@ class FleetController:
                     and rep.restart_at is not None
                     and now >= rep.restart_at):
                 rep.start(now)
+                if hasattr(rep.engine, "attach_fleet"):
+                    rep.engine.attach_fleet(self.audit)
                 rep.restarts += 1
                 self._backoff[name].on_start(now)
                 self.trace.emit("replica_state", None, replica=name,
@@ -702,12 +1192,24 @@ class FleetController:
             if rep.state is ReplicaState.DEAD or rep.engine is None:
                 continue
             if not rep.engine.has_work():
-                rep.last_progress = now  # idle is not a stall
+                # idle is not a stall — but an idle REMOTE replica must
+                # still answer a health probe, or a partition of an
+                # idle process would never be noticed until the router
+                # placed onto it
+                ping = getattr(rep.engine, "ping", None)
+                if ping is None or ping():
+                    rep.last_progress = now
                 continue
             try:
                 outs = rep.engine.step()
             except (KeyboardInterrupt, SystemExit):
                 raise
+            except NetUnreachable:
+                # the replica answered nothing this tick: NOT a death —
+                # no progress is recorded, so the probe age walks the
+                # SUSPECT→DEAD ladder (a partition is handled by the
+                # same machinery as a SIGKILL, dead_after_s later)
+                continue
             except WatchdogTimeout as e:
                 # engine-level stall: the dispatch wedged past its
                 # budget — the process is as good as gone
@@ -729,6 +1231,22 @@ class FleetController:
             for out in outs:
                 self._finalize(out, name)
                 finished.append(out)
+            # a remote replica's reconciliation can BOUNCE a migration
+            # rec (genuine capacity rejection discovered late): re-place
+            take = getattr(rep.engine, "take_bounced", None)
+            if take is not None:
+                for b in take():
+                    if b[0] == "req":
+                        req = b[1]
+                        self.placement.pop(req.request_id, None)
+                        if not self._place_request(req):
+                            self._pending_reqs.append(req)
+                    else:
+                        _, header, rec = b
+                        self.placement.pop(rec["rid"], None)
+                        self._pending_recs.append(
+                            (header, rec,
+                             self._rec_expiry(header, rec)))
         # health sweep: probe-driven SUSPECT/DEAD (heartbeat staleness
         # for subprocess drivers; progress age in-process)
         for name, rep in self.replicas.items():
@@ -819,8 +1337,21 @@ class FleetController:
 
         print(f"[fleet] replica {name} dead ({why}); migrating its "
               f"in-flight requests", file=sys.stderr)
+        # remote replicas: calls whose ack was lost and never
+        # reconciled — captured BEFORE the engine ref drops, resolved
+        # against the journal below (anything journaled is owned by the
+        # dead life; anything else never arrived and re-places)
+        lost_reqs: list = []
+        lost_recs: list = []
+        if rep.engine is not None and hasattr(rep.engine, "unplaced"):
+            lost_reqs, lost_recs = rep.engine.unplaced()
         if rep.engine is not None and rep.engine._journal is not None:
             rep.engine._journal.close()  # single writer for the mark
+            #                              (for a RemoteReplica this
+            #                              SIGKILLs the child process —
+            #                              a partitioned zombie must
+            #                              stop writing before the
+            #                              crash path reads)
         if rep.engine is not None:
             # fold the dying life's metrics into the fleet carry so the
             # aggregate histograms keep its samples (the in-process
@@ -859,6 +1390,21 @@ class FleetController:
             if f["rid"] in self.streams and f["rid"] not in self.outputs:
                 self._finalize_from_journal(f, name)
         self._absorb_manifest(manifest, source=name)
+        covered = ({r["rid"] for r in manifest.get("requests", ())}
+                   | {f["rid"] for f in manifest.get("finished", ())})
+        for req in lost_reqs:
+            rid = req.request_id
+            if rid in covered or rid in self.outputs:
+                continue   # the ambiguous call DID land: the journal
+                #            (or a retirement) owns it
+            self.placement.pop(rid, None)
+            self._pending_reqs.append(req)
+        for header, rec in lost_recs:
+            if rec["rid"] in covered or rec["rid"] in self.outputs:
+                continue
+            self.placement.pop(rec["rid"], None)
+            self._pending_recs.append(
+                (header, rec, self._rec_expiry(header, rec)))
         self._drain_pending(exclude=frozenset((name,)))
         delay = self._backoff[name].on_death(now)
         if delay is None:
@@ -872,19 +1418,24 @@ class FleetController:
         # glob (and any operator) finds them
         self.flight_flush(f"replica {name} dead: {why}")
 
+    def _rec_expiry(self, header: dict, rec: dict) -> Optional[float]:
+        """A parked migration rec's TTL, re-based from the source clock
+        (``header["clock"]``) onto OURS — the fleet-queue deadline
+        sweep covers parked recs with it, whatever path parked them
+        (manifest absorption, a capacity bounce, death re-placement)."""
+        ttl = rec.get("params", {}).get("deadline_s")
+        arr = rec.get("arrival")
+        if ttl is None or arr is None:
+            return None
+        return arr + (self._clock() - (header.get("clock") or 0.0)) + ttl
+
     def _absorb_manifest(self, manifest: dict, source: str) -> None:
         """Fold a migration manifest into fleet accounting: fill each
         stream's delivery record from the journal segment (tokens the
         source journaled but never delivered — the commit→callback
         crash window — redeliver HERE, exactly the missing indices),
         then queue the records for placement."""
-        header = {k: manifest[k] for k in
-                  ("format", "clock", "page_size", "kv_geom")
-                  if k in manifest}
-        # re-base the source clock so a parked rec's TTL can expire on
-        # OURS (the fleet-queue deadline sweep covers these too — a rec
-        # stranded by an outage is visible to no engine's sweep)
-        offset = self._clock() - (manifest.get("clock") or 0.0)
+        header = _manifest_header(manifest)
         for rec in manifest.get("requests", ()):
             rid = rec["rid"]
             if rid not in self.streams:
@@ -897,11 +1448,8 @@ class FleetController:
                 f"invariant broke")
             self.streams[rid].extend(int(t) for t in toks[d:])
             self.placement.pop(rid, None)
-            ttl = rec.get("params", {}).get("deadline_s")
-            arr = rec.get("arrival")
-            expires = (arr + offset + ttl
-                       if ttl is not None and arr is not None else None)
-            self._pending_recs.append((header, rec, expires))
+            self._pending_recs.append((header, rec,
+                                       self._rec_expiry(header, rec)))
 
     def _finalize(self, out: RequestOutput, name: str) -> None:
         rid = out.request_id
@@ -1040,6 +1588,12 @@ class FleetController:
         L = ["# TYPE fleet_replicas gauge"]
         for state in sorted(states):
             L.append(f'fleet_replicas{{state="{state}"}} {states[state]}')
+        # per-replica one-hot health: pressure alone can look fine
+        # while a breaker is open — alerting needs to see WHICH replica
+        # is SUSPECT/DEAD
+        L.extend(replica_state_lines(
+            (name, self.replicas[name].state)
+            for name in sorted(self.replicas)))
         L.append("# TYPE fleet_lives_total counter")
         L.append(f"fleet_lives_total "
                  f"{sum(r.life for r in self.replicas.values())}")

@@ -72,6 +72,7 @@ MERGE_COUNTERS = (
     "migrated_out", "migrated_in", "migrated_in_place",
     "migrated_tokens", "prefix_hits", "prefix_hit_tokens",
     "prefix_skipped_tokens", "running_sum", "kv_util_sum",
+    "net_requests", "net_dup_hits", "net_redelivered_tokens",
 )
 
 
@@ -320,6 +321,15 @@ class ServeMetrics:
     prefix_hits: int = 0          # admissions mapping >= 1 shared block
     prefix_hit_tokens: int = 0    # prompt tokens covered by shared blocks
     prefix_skipped_tokens: int = 0  # prefill tokens actually skipped
+    # network serving plane counters (serve/net.py, docs/serving.md
+    # "Network fleet serving"): how often the wire asked, how often
+    # idempotency made a retried call a no-op (duplicate submit, cached
+    # drain/migrate replay), and how many tokens were SERVED again
+    # because a stream poll re-read indices below the high-water mark
+    # (an ack lost to the network re-delivers but never re-derives).
+    net_requests: int = 0         # API calls the replica server answered
+    net_dup_hits: int = 0         # idempotent no-op replays
+    net_redelivered_tokens: int = 0  # tokens re-served below the watermark
     block_manager: object = field(default=None, repr=False)
     # compilation observability: CountingJit wrappers the engine
     # registers (runtime/jit_cache.py) + warmup accounting
@@ -457,6 +467,15 @@ class ServeMetrics:
             "migrated_in": self.migrated_in,
             "migrated_in_place": self.migrated_in_place,
             "migrated_tokens": self.migrated_tokens,
+        }
+
+    def net_stats(self) -> dict:
+        """Network serving plane counters (summary()["net"]) — the wire
+        side of docs/serving.md "Network fleet serving"."""
+        return {
+            "net_requests": self.net_requests,
+            "net_dup_hits": self.net_dup_hits,
+            "net_redelivered_tokens": self.net_redelivered_tokens,
         }
 
     def merge(self, other: "ServeMetrics") -> "ServeMetrics":
@@ -653,6 +672,7 @@ class ServeMetrics:
             "failures": self.failure_stats(),
             "recovery": self.recovery_stats(),
             "migration": self.migration_stats(),
+            "net": self.net_stats(),
             "prefix_cache": self.prefix_stats(),
             "compilation": self.compile_stats(),
             "requests": {rid: m.to_dict()
@@ -713,6 +733,14 @@ class ServeMetrics:
         counter("serve_prefix_hits_total", self.prefix_hits)
         counter("serve_prefix_skipped_tokens_total",
                 self.prefix_skipped_tokens)
+        counter("serve_net_requests_total", self.net_requests,
+                "network serving-plane API calls answered")
+        counter("serve_net_dup_hits_total", self.net_dup_hits,
+                "idempotent no-op replays (duplicate submit, cached "
+                "drain/migrate response)")
+        counter("serve_net_redelivered_tokens_total",
+                self.net_redelivered_tokens,
+                "tokens re-served below a stream's high-water mark")
         L.append("# TYPE serve_finished_total counter")
         for reason, n in sorted(self.finish_reasons.items()):
             L.append(f'serve_finished_total{{reason="{reason}"}} {n}')

@@ -1,0 +1,900 @@
+"""Network serving plane: a replica process as a complete network
+citizen, and the fault-tolerant client that drives it.
+
+PR 9's fleet controller routes, health-checks, and live-migrates only
+IN-PROCESS replicas; the subprocess mode could scrape pressure but not
+place a request or move one off a dead process.  This module closes
+that gap (ROADMAP #4's open follow-up) with a deliberately boring
+transport — HTTP/JSON over the stdlib, in the
+``trace.start_metrics_server`` mold, no new dependency — and a
+deliberately careful protocol: every mutating call is IDEMPOTENT, so a
+retry whose first attempt actually landed is a no-op, never a duplicate
+stream.
+
+Server (:class:`ReplicaServer`, one per engine process):
+
+========================  =================================================
+endpoint                  semantics
+========================  =================================================
+``POST /submit``          submit one request; keyed by ``rid`` — a rid the
+                          replica has ever seen answers ``dup: true``
+                          without touching the engine
+``GET  /stream``          ``?rid=R&since=N``: the delivery log from index
+                          N on + finish state — delivery resumes from the
+                          last index the CLIENT acknowledged, so a lost
+                          response re-delivers but never re-derives
+``POST /poll``            batched ``/stream`` (one round trip per tick)
+``POST /drain``           migrate-out ``rids`` (KV pages ride base64);
+                          carries an idempotency ``key`` — a retry returns
+                          the CACHED manifest (the engine drained once,
+                          the ``mig`` receipts stand), and a fresh drain
+                          of already-receipted rids is EMPTY
+``POST /migrate_in``      adopt a migration manifest; same ``key`` replay
+                          rule, and a duplicate rid is rejected by the
+                          engine's own capacity admission
+``GET  /health``          liveness + load snapshot (the router's signal);
+                          ``ok`` goes false when the serve loop stopped
+                          pumping — a wedged engine thread reads as down
+                          even while the HTTP listener survives
+``GET  /metrics``         the PR-8 Prometheus exposition
+``POST /shutdown``        stop :func:`serve_loop` cleanly
+========================  =================================================
+
+Thread discipline: HTTP handler threads never touch the engine.  Reads
+(`/stream`, `/health`) serve server-maintained state under a lock;
+mutations enqueue a closure that :meth:`ReplicaServer.pump` — called by
+the engine's OWN loop between steps — executes, so the engine stays
+single-threaded exactly as every other driver keeps it.
+
+Client (:class:`NetClient`): per-call timeouts, bounded retries under
+jittered exponential backoff (:class:`serve.fleet.RestartBackoff` — the
+same pacing law as replica restarts), and the deterministic ``net``
+fault seams (``runtime/faults.py``: drop / delay / duplicate /
+partition) on every call.  ``serve.fleet.RemoteReplica`` wraps it in
+the engine protocol the :class:`~serve.fleet.FleetController` already
+speaks.
+
+See docs/serving.md "Network fleet serving" for the protocol, the
+timeout/backoff policy, and the exactly-once-across-the-wire argument.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import queue
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Optional
+
+import numpy as np
+
+from triton_dist_tpu.runtime.faults import InjectedNetFault
+
+#: Wire protocol version — both ends check it, so a stale replica binary
+#: fails loud instead of mis-parsing.
+NET_PROTOCOL = 1
+
+#: Name of the file :func:`write_port_file` drops next to a replica's
+#: snapshot dir so a spawning controller can discover the bound port.
+PORT_FILE = "net_port"
+
+
+class NetError(RuntimeError):
+    """A network call failed after every retry — the transport-level
+    verdict the caller maps onto the replica health ladder."""
+
+
+class NetUnreachable(NetError):
+    """The replica answered NO retry of a liveness-bearing call.  The
+    fleet controller treats this as missing progress (SUSPECT after
+    ``suspect_after_s``, DEAD after ``dead_after_s``) — NOT as an
+    instant replica death: a transient partition must walk the same
+    ladder a stall does."""
+
+
+class NetHTTPError(NetError):
+    """The replica ANSWERED with an HTTP error status — the transport
+    worked, the request was wrong (unknown rid, bad format).  Never
+    retried."""
+
+    def __init__(self, status: int, body: str):
+        super().__init__(f"HTTP {status}: {body[:200]}")
+        self.status = status
+        self.body = body
+
+
+# ---------------------------------------------------------------------------
+# Manifest wire form: KV pages as base64 so live hand-offs cross the wire
+# ---------------------------------------------------------------------------
+
+
+def _enc_arr(a: np.ndarray) -> dict:
+    a = np.ascontiguousarray(a)
+    return {"__nd__": True, "dtype": str(a.dtype), "shape": list(a.shape),
+            "b64": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def _dec_arr(d: dict) -> np.ndarray:
+    return np.frombuffer(base64.b64decode(d["b64"]),
+                         dtype=np.dtype(d["dtype"])).reshape(d["shape"])
+
+
+def encode_manifest(manifest: dict) -> dict:
+    """JSON-safe form of a migration manifest: KV page payloads become
+    base64 blobs (dtype + shape + bytes), everything else is already
+    JSON — the wire twin of ``recovery.save_manifest`` that KEEPS the
+    live pages, so a cross-process hand-off still adopts in place."""
+    doc = dict(manifest)
+    reqs = []
+    for rec in manifest.get("requests", ()):
+        rec = dict(rec)
+        if rec.get("kv") is not None:
+            rec["kv"] = [[_enc_arr(np.asarray(k)), _enc_arr(np.asarray(v))]
+                         for k, v in rec["kv"]]
+        reqs.append(rec)
+    doc["requests"] = reqs
+    return doc
+
+
+def decode_manifest(doc: dict) -> dict:
+    """Inverse of :func:`encode_manifest` (idempotent on an
+    already-decoded manifest)."""
+    m = dict(doc)
+    reqs = []
+    for rec in m.get("requests", ()):
+        rec = dict(rec)
+        kv = rec.get("kv")
+        if kv is not None:
+            rec["kv"] = [
+                (_dec_arr(k) if isinstance(k, dict) and k.get("__nd__")
+                 else np.asarray(k),
+                 _dec_arr(v) if isinstance(v, dict) and v.get("__nd__")
+                 else np.asarray(v))
+                for k, v in kv]
+        reqs.append(rec)
+    m["requests"] = reqs
+    return m
+
+
+def write_port_file(path: str, port: int) -> str:
+    """Atomically publish the bound port (spawners poll for this)."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(f"{port}\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def read_port_file(path: str, *, deadline_s: float = 30.0,
+                   poll_s: float = 0.05) -> int:
+    """Wait for a spawned replica to publish its port; raises
+    :class:`NetError` past ``deadline_s`` (the spawner's readiness
+    check must be bounded — a child that never comes up cannot hang
+    the controller)."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        try:
+            with open(path, encoding="utf-8") as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            time.sleep(poll_s)
+    raise NetError(f"no port published at {path} within {deadline_s}s")
+
+
+# ---------------------------------------------------------------------------
+# The replica server
+# ---------------------------------------------------------------------------
+
+
+class ReplicaServer:
+    """The network ingest of ONE :class:`serve.engine.ServeEngine`
+    (module docstring for the endpoint table and thread discipline).
+
+    ``stall_after_s``: /health reports ``ok: false`` once the serve
+    loop hasn't pumped for this long — the HTTP listener outliving a
+    dead engine thread must not read as a healthy replica.
+    ``faults``: a ``runtime.faults.FaultInjector`` whose ``net`` point
+    fires at ``server_recv`` (before the request is processed — a drop
+    here means it never arrived) and ``server_resp`` (after the action
+    LANDED, before the answer is sent — a drop here is the lost ack the
+    idempotent-retry semantics exist for)."""
+
+    def __init__(self, engine, *, faults=None, stall_after_s: float = 10.0,
+                 cache_entries: int = 32, cache_ttl_s: float = 120.0,
+                 exec_timeout_s: float = 30.0,
+                 streams_retain: int = 4096):
+        self.engine = engine
+        self.faults = faults
+        self.stall_after_s = stall_after_s
+        self.exec_timeout_s = exec_timeout_s
+        self.streams_retain = streams_retain
+        self._lock = threading.Lock()
+        self._streams: dict[str, dict] = {}
+        self._terminal: "OrderedDict[str, None]" = OrderedDict()
+        self._cmds: queue.Queue = queue.Queue()
+        self._cache: OrderedDict = OrderedDict()
+        self._cache_entries = cache_entries
+        self._cache_ttl_s = cache_ttl_s
+        self._load: dict = {"ok": True}
+        self._counts = {"requests": 0, "dups": 0, "redelivered": 0}
+        self._last_pump = time.monotonic()
+        self._shutdown = threading.Event()
+        self._srv = None
+
+    # -- engine-thread side ------------------------------------------------
+
+    def _appender(self, rid: str) -> Callable:
+        """The ``on_token`` the server hands the engine: append to the
+        delivery log.  Fires AFTER the journal append (the PR 5
+        ordering), so the log a client reads is always a prefix of the
+        durable record — re-delivery can never outrun the journal."""
+        def cb(_rid, tok):
+            with self._lock:
+                s = self._streams.get(rid)
+                if s is not None:
+                    s["tokens"].append(int(tok))
+        return cb
+
+    def _register(self, rid: str, tokens=()) -> None:
+        with self._lock:
+            self._terminal.pop(rid, None)   # live again: not prunable
+            self._streams[rid] = {
+                "tokens": [int(t) for t in tokens],
+                "done": False, "reason": None, "error": None,
+                "migrated": False, "served_hi": 0,
+            }
+
+    def _unregister(self, rid: str) -> None:
+        with self._lock:
+            self._streams.pop(rid, None)
+            self._terminal.pop(rid, None)
+
+    def _note_terminal(self, rid: str) -> None:
+        """Bound the delivery-log map (lock held by the caller): done/
+        migrated streams are kept for late re-polls and duplicate
+        detection, but only the newest ``streams_retain`` of them —
+        the engine's ``requests_retain`` twin.  A duplicate of a rid
+        pruned here AND already pruned engine-side would re-serve; the
+        retention window is the same tradeoff the engine already
+        accepts."""
+        self._terminal[rid] = None
+        self._terminal.move_to_end(rid)
+        while len(self._terminal) > self.streams_retain:
+            old, _ = self._terminal.popitem(last=False)
+            self._streams.pop(old, None)
+
+    def publish(self, outs) -> None:
+        """Record finished requests (engine thread, after ``step()``)."""
+        with self._lock:
+            for out in outs:
+                s = self._streams.get(out.request_id)
+                if s is None:
+                    continue
+                # the retirement's token list is authoritative (a
+                # disabled callback starves the append path)
+                if len(out.token_ids) > len(s["tokens"]):
+                    s["tokens"] = [int(t) for t in out.token_ids]
+                s["done"] = True
+                s["reason"] = out.finish_reason.value
+                s["error"] = out.error
+                self._note_terminal(out.request_id)
+
+    def pump(self, max_cmds: int = 64) -> int:
+        """Execute queued mutations on the ENGINE thread (between
+        steps), refresh the load snapshot, and fold the wire counters
+        into the engine's metrics.  The serve loop calls this every
+        iteration; handler threads only ever wait on it."""
+        n = 0
+        while n < max_cmds:
+            try:
+                fn, box = self._cmds.get_nowait()
+            except queue.Empty:
+                break
+            try:
+                box["result"] = fn()
+            except Exception as e:      # handed to the waiting handler
+                box["error"] = e        # thread (a 400/503 answer)
+            except BaseException as e:  # noqa: BLE001 — InjectedKill /
+                # interrupts ARE process death: answer the handler so
+                # it doesn't hang, then let it escape — no containment
+                # path may swallow it (runtime/faults.py contract), so
+                # the serve loop (and the process) dies with it
+                box["error"] = NetError(
+                    f"replica dying: {type(e).__name__}: {e}")
+                box["evt"].set()
+                raise
+            finally:
+                box["evt"].set()
+            n += 1
+        eng = self.engine
+        load = {
+            "ok": True,
+            "protocol": NET_PROTOCOL,
+            "steps": eng.metrics.steps,
+            "completed": eng.metrics.completed,
+            "queue_depth": eng.scheduler.queue_depth,
+            "running": sum(1 for s in eng.slots if s is not None),
+            "max_batch": eng.max_batch,
+            "max_queue": eng.max_queue,
+            "kv_util": round(float(eng.bm.utilization), 6),
+            "unfinished": len(eng.unfinished_rids()),
+        }
+        with self._lock:
+            self._load = load
+            self._last_pump = time.monotonic()
+            eng.metrics.net_requests = self._counts["requests"]
+            eng.metrics.net_dup_hits = self._counts["dups"]
+            eng.metrics.net_redelivered_tokens = self._counts["redelivered"]
+        return n
+
+    # -- handler-thread side ----------------------------------------------
+
+    def _exec(self, fn):
+        """Run ``fn`` on the engine thread via the command queue; the
+        handler thread blocks until :meth:`pump` executes it.  A dead
+        loop answers 503 after ``exec_timeout_s`` — the engine being
+        gone must look like the replica being down, not a hang."""
+        box = {"evt": threading.Event()}
+        self._cmds.put((fn, box))
+        if not box["evt"].wait(self.exec_timeout_s):
+            raise NetError("engine loop not pumping (serve_loop dead "
+                           "or wedged)")
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
+    def _cache_sweep(self) -> None:
+        # TTL besides the count bound: a drain response pins its full
+        # KV payload (base64) in memory, and the useful replay window
+        # is the client's retry ladder (seconds) — a replica that
+        # drains once must not carry that blob for the rest of its life
+        cutoff = time.monotonic() - self._cache_ttl_s
+        while self._cache:
+            k = next(iter(self._cache))
+            if self._cache[k][0] >= cutoff:
+                break
+            del self._cache[k]
+
+    def _cached(self, kind: str, key: Optional[str]):
+        if key is None:
+            return None
+        self._cache_sweep()
+        hit = self._cache.get((kind, key))
+        return hit[1] if hit is not None else None
+
+    def _cache_put(self, kind: str, key: Optional[str], doc: dict) -> None:
+        if key is None:
+            return
+        self._cache_sweep()
+        self._cache[(kind, key)] = (time.monotonic(), doc)
+        while len(self._cache) > self._cache_entries:
+            self._cache.popitem(last=False)
+
+    def handle_submit(self, doc: dict) -> dict:
+        rid = doc["rid"]
+
+        def do():
+            # idempotency by request id: a rid this replica has EVER
+            # seen (delivery log or engine state — the journal's view)
+            # answers dup without re-entering the engine, so a retried
+            # submit whose first attempt landed is a no-op
+            with self._lock:
+                known = rid in self._streams
+            if known or self.engine.has_request(rid):
+                self._counts["dups"] += 1
+                return {"ok": True, "dup": True}
+            from triton_dist_tpu.serve.engine import QueueFull
+            from triton_dist_tpu.serve.request import (
+                Request,
+                SamplingParams,
+            )
+
+            self._register(rid)
+            try:
+                req = Request(
+                    rid, np.asarray(doc["prompt"], np.int32),
+                    SamplingParams.from_dict(doc["params"]),
+                    on_token=self._appender(rid),
+                    trace=doc.get("trace"))
+                shed = self.engine.submit(req)
+            except QueueFull as e:
+                self._unregister(rid)
+                return {"ok": False, "queue_full": True, "why": str(e)}
+            except Exception as e:  # noqa: BLE001 — an engine-rejected
+                # submit (bad geometry, invalid params) must NOT leave
+                # a ghost stream behind: it would answer dup:true to
+                # every retry of a request the engine never accepted
+                self._unregister(rid)
+                return {"ok": False, "rejected": True,
+                        "why": f"{type(e).__name__}: {e}"}
+            if shed is not None:
+                self.publish([shed])
+                return {"ok": True, "shed": True,
+                        "reason": shed.finish_reason.value,
+                        "error": shed.error}
+            return {"ok": True}
+        return self._exec(do)
+
+    def handle_stream(self, rid: str, since: int) -> Optional[dict]:
+        with self._lock:
+            s = self._streams.get(rid)
+            if s is None:
+                return None
+            toks = s["tokens"][since:]
+            redelivered = max(0, min(len(s["tokens"]), s["served_hi"])
+                              - since)
+            if redelivered:
+                self._counts["redelivered"] += redelivered
+            s["served_hi"] = max(s["served_hi"], len(s["tokens"]))
+            return {"tokens": toks, "next": len(s["tokens"]),
+                    "done": s["done"], "reason": s["reason"],
+                    "error": s["error"], "migrated": s["migrated"]}
+
+    def handle_poll(self, doc: dict) -> dict:
+        out = {}
+        for rid, since in doc.get("streams", {}).items():
+            st = self.handle_stream(rid, int(since))
+            out[rid] = st if st is not None else {"missing": True}
+        # the health/load snapshot rides every poll: one round trip per
+        # controller tick proves liveness AND refreshes the router's
+        # pressure signal (a separate /health ping is only needed idle)
+        return {"streams": out, "health": self.handle_health()}
+
+    def handle_drain(self, doc: dict) -> dict:
+        key = doc.get("key")
+
+        def do():
+            cached = self._cached("drain", key)
+            if cached is not None:
+                # the first attempt landed (mig receipts written, state
+                # released) and only the ack was lost: replay the same
+                # manifest — the engine is NOT drained twice
+                self._counts["dups"] += 1
+                return {**cached, "retried": True}
+            present = set(self.engine.unfinished_rids())
+            want = doc.get("rids")
+            rids = [r for r in (want if want is not None
+                                else sorted(present)) if r in present]
+            m = self.engine.drain(rids,
+                                  include_kv=doc.get("include_kv", True))
+            with self._lock:
+                for r in rids:
+                    s = self._streams.get(r)
+                    if s is not None:
+                        s["migrated"] = True
+                        self._note_terminal(r)
+            resp = {"ok": True, "manifest": encode_manifest(m)}
+            self._cache_put("drain", key, resp)
+            return resp
+        return self._exec(do)
+
+    def handle_migrate_in(self, doc: dict) -> dict:
+        key = doc.get("key")
+
+        def do():
+            cached = self._cached("migrate_in", key)
+            if cached is not None:
+                self._counts["dups"] += 1
+                return {**cached, "retried": True}
+            m = decode_manifest(doc["manifest"])
+            fresh, cbs = [], {}
+            for rec in m.get("requests", ()):
+                rid = rec["rid"]
+                cbs[rid] = self._appender(rid)
+                with self._lock:
+                    s = self._streams.get(rid)
+                    # a rid that migrated OUT and is now migrating back
+                    # in restarts from the manifest's (newer) segment —
+                    # its old entry is stale, not a duplicate
+                    known = s is not None and not s["migrated"]
+                if not known:
+                    self._register(rid, tokens=rec.get("tokens", ()))
+                    fresh.append(rid)
+            try:
+                res = self.engine.migrate_in(m, on_token=cbs)
+            except Exception:
+                # an engine-rejected manifest (format mismatch, bad
+                # params) must not leave ghost streams behind — the
+                # same cleanup handle_submit does; the error surfaces
+                # to the client as a definitive 400
+                for rid in fresh:
+                    self._unregister(rid)
+                raise
+            for rid in res["rejected"]:
+                if rid in fresh:
+                    self._unregister(rid)
+            resp = {"ok": True, "adopted": res["adopted"],
+                    "requeued": res["requeued"],
+                    "rejected": res["rejected"]}
+            self._cache_put("migrate_in", key, resp)
+            return resp
+        return self._exec(do)
+
+    def handle_health(self) -> dict:
+        with self._lock:
+            load = dict(self._load)
+            age = time.monotonic() - self._last_pump
+        if age > self.stall_after_s:
+            load["ok"] = False
+            load["why"] = f"serve loop silent {age:.1f}s"
+        return load
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def request_shutdown(self) -> None:
+        self._shutdown.set()
+
+    @property
+    def shutdown_requested(self) -> bool:
+        return self._shutdown.is_set()
+
+    def start(self, port: int = 0, host: str = "127.0.0.1"):
+        """Bind and serve from daemon threads; returns the HTTP server
+        (``.server_address[1]`` is the bound port)."""
+        import http.server
+
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                raw = self.rfile.read(n) if n else b"{}"
+                return json.loads(raw.decode("utf-8"))
+
+            def _reply(self, code: int, doc: dict):
+                if "__raw__" in doc:   # /metrics: exposition TEXT, not
+                    #                    JSON — a Prometheus scraper
+                    #                    reads this body directly
+                    body = doc["__raw__"].encode("utf-8")
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    body = json.dumps(doc).encode("utf-8")
+                    ctype = "application/json"
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _abort(self):
+                # a dropped packet: no response ever leaves — the
+                # client sees the connection die and retries
+                self.close_connection = True
+
+            def _route(self, method: str):
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                op = path.lstrip("/")
+                with outer._lock:
+                    outer._counts["requests"] += 1
+                if outer.faults is not None:
+                    try:
+                        outer.faults.fire("net", op=op,
+                                          where="server_recv")
+                    except InjectedNetFault:
+                        return self._abort()
+                try:
+                    doc, code = self._dispatch(method, path)
+                except NetError as e:
+                    doc, code = {"ok": False, "error": str(e)}, 503
+                except (KeyError, ValueError, TypeError) as e:
+                    doc, code = {"ok": False,
+                                 "error": f"{type(e).__name__}: {e}"}, 400
+                if outer.faults is not None:
+                    try:
+                        outer.faults.fire("net", op=op,
+                                          where="server_resp")
+                    except InjectedNetFault:
+                        return self._abort()   # the action landed; the
+                        #                        ack is lost
+                self._reply(code, doc)
+
+            def _dispatch(self, method: str, path: str):
+                if method == "GET" and path == "/health":
+                    return outer.handle_health(), 200
+                if method == "GET" and path == "/metrics":
+                    # rendered on the ENGINE thread via the pump: the
+                    # exposition iterates live counter maps, and the
+                    # handler-threads-never-touch-the-engine rule is
+                    # what keeps those reads untorn
+                    text = outer._exec(
+                        outer.engine.metrics.to_prometheus)
+                    return {"__raw__": text}, 200
+                if method == "GET" and path == "/stream":
+                    from urllib.parse import parse_qs, urlparse
+                    q = parse_qs(urlparse(self.path).query)
+                    rid = q.get("rid", [None])[0]
+                    since = int(q.get("since", ["0"])[0])
+                    st = outer.handle_stream(rid, since)
+                    if st is None:
+                        return {"ok": False,
+                                "error": f"unknown rid {rid!r}"}, 404
+                    return st, 200
+                if method == "POST" and path == "/poll":
+                    return outer.handle_poll(self._body()), 200
+                if method == "POST" and path == "/submit":
+                    return outer.handle_submit(self._body()), 200
+                if method == "POST" and path == "/drain":
+                    return outer.handle_drain(self._body()), 200
+                if method == "POST" and path == "/migrate_in":
+                    return outer.handle_migrate_in(self._body()), 200
+                if method == "POST" and path == "/shutdown":
+                    outer.request_shutdown()
+                    return {"ok": True}, 200
+                return {"ok": False, "error": f"no route {path}"}, 404
+
+            def do_GET(self):      # noqa: N802 — stdlib contract
+                self._route("GET")
+
+            def do_POST(self):     # noqa: N802
+                self._route("POST")
+
+            def log_message(self, *args):
+                pass
+
+        srv = http.server.ThreadingHTTPServer((host, port), Handler)
+        srv.daemon_threads = True
+        t = threading.Thread(target=srv.serve_forever, daemon=True,
+                             name="serve-net")
+        t.start()
+        self._srv = srv
+        return srv
+
+    @property
+    def port(self) -> int:
+        return self._srv.server_address[1]
+
+    def close(self) -> None:
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+            self._srv = None
+
+
+def serve_loop(engine, server: ReplicaServer, *,
+               idle_sleep_s: float = 0.005,
+               step_sleep_s: float = 0.0,
+               exit_when_idle_s: Optional[float] = None,
+               deadline_s: Optional[float] = None,
+               max_steps: Optional[int] = None) -> int:
+    """Drive one engine behind its :class:`ReplicaServer`: pump queued
+    network mutations, step while there is work, publish retirements,
+    beat the heartbeat while idle.  Returns the step count.
+
+    Exits on ``POST /shutdown``, after ``exit_when_idle_s`` of no work
+    (demo/test hygiene), past ``deadline_s`` of wall clock (the bounded
+    lifetime a chaos harness gives a child so a wedged replica can
+    never outlive its test), or at ``max_steps``.  Anything escaping
+    ``engine.step()`` — ``InjectedKill`` included — propagates: a
+    dying engine takes the loop (and the process) with it, exactly
+    like every other driver."""
+    t0 = time.monotonic()
+    last_work = t0
+    steps = 0
+    while not server.shutdown_requested:
+        now = time.monotonic()
+        if deadline_s is not None and now - t0 > deadline_s:
+            break
+        server.pump()
+        if engine.has_work():
+            outs = engine.step()
+            server.publish(outs)
+            steps += 1
+            last_work = time.monotonic()
+            if max_steps is not None and steps >= max_steps:
+                break
+            if step_sleep_s:
+                # test/bench throttle: a tiny model outruns its own
+                # chaos harness — pacing steps keeps a mid-decode
+                # window open wide enough to kill a replica inside it
+                time.sleep(step_sleep_s)
+        else:
+            engine._beat()  # idle is alive: the supervisor's stall
+            #                 detector must not read "no work" as "wedged"
+            if (exit_when_idle_s is not None
+                    and now - last_work > exit_when_idle_s):
+                break
+            time.sleep(idle_sleep_s)
+    server.pump()   # drain the command queue: late handlers get answers
+    return steps
+
+
+# ---------------------------------------------------------------------------
+# The client transport
+# ---------------------------------------------------------------------------
+
+
+class NetClient:
+    """HTTP/JSON calls with per-call timeouts and bounded retries under
+    jittered exponential backoff (the :class:`serve.fleet.RestartBackoff`
+    pacing law — restarts and retries must not synchronize across a
+    fleet for the same reason).
+
+    Retry discipline: transport failures (refused, reset, timed out,
+    injected drop/partition) retry up to ``retries`` times; HTTP-level
+    errors (the replica ANSWERED: 404, 400) raise
+    :class:`NetHTTPError` immediately — a wrong request does not become
+    right by asking again.  Every retry invokes ``on_retry(op, attempt,
+    delay_s, error)`` so the caller can surface the backoff ladder
+    (``net_retry`` trace events, audit entries).
+
+    The ``net`` fault point fires once per send at the ``client`` seam
+    (``op=`` the endpoint, ``target=`` this client's peer name):
+    ``drop``/``partition`` raise before the request leaves,
+    ``delay_s`` stalls it, ``duplicate`` makes this transport send the
+    request TWICE — the server's idempotency is what keeps that safe.
+    """
+
+    def __init__(self, url: str, *, name: Optional[str] = None,
+                 timeout_s: float = 5.0, retries: int = 3,
+                 retry_base_s: float = 0.05, retry_cap_s: float = 2.0,
+                 retry_jitter: float = 0.5, seed: int = 0,
+                 faults=None, on_retry: Optional[Callable] = None):
+        self.url = url.rstrip("/")
+        self.name = name or self.url
+        self.timeout_s = timeout_s
+        self.retries = retries
+        self.retry_base_s = retry_base_s
+        self.retry_cap_s = retry_cap_s
+        self.retry_jitter = retry_jitter
+        self.seed = seed
+        self.faults = faults
+        self.on_retry = on_retry
+        self._calls = 0
+
+    def _http(self, method: str, path: str,
+              payload: Optional[bytes], timeout_s: float) -> dict:
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.url + path, data=payload, method=method,
+            headers={"Content-Type": "application/json"}
+            if payload is not None else {})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                return json.loads(r.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            body = ""
+            try:
+                body = e.read().decode("utf-8", "replace")
+            except Exception:  # noqa: BLE001 — body is best-effort
+                pass
+            if e.code == 503:
+                raise ConnectionError(f"replica busy/dead: {body[:100]}")
+            raise NetHTTPError(e.code, body)
+
+    def call(self, op: str, path: str, *, method: str = "GET",
+             body: Optional[dict] = None,
+             timeout_s: Optional[float] = None,
+             retries: Optional[int] = None) -> dict:
+        """One logical call, retried to completion or :class:`NetError`.
+        ``retries=0`` makes it a single probe (liveness pings use it:
+        the fleet loop is single-threaded, so a blackholed replica must
+        cost one short timeout per tick, not a whole retry ladder)."""
+        from triton_dist_tpu.serve.fleet import RestartBackoff
+
+        payload = (json.dumps(body).encode("utf-8")
+                   if body is not None else None)
+        timeout_s = timeout_s if timeout_s is not None else self.timeout_s
+        self._calls += 1
+        bo = RestartBackoff(base_s=self.retry_base_s,
+                            cap_s=self.retry_cap_s,
+                            jitter=self.retry_jitter,
+                            max_restarts=(self.retries if retries is None
+                                          else retries),
+                            seed=self.seed + self._calls)
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                action = None
+                if self.faults is not None:
+                    action = self.faults.fire("net", op=op,
+                                              target=self.name,
+                                              where="client")
+                resp = self._http(method, path, payload, timeout_s)
+                if action == "duplicate":
+                    # the network's duplicate delivery: send the SAME
+                    # request again — the server must dedupe, and the
+                    # duplicate's fate is irrelevant to this caller
+                    # (ANY failure of it must not discard the first,
+                    # successful exchange)
+                    try:
+                        self._http(method, path, payload, timeout_s)
+                    except Exception:  # noqa: BLE001
+                        pass
+                return resp
+            except NetHTTPError:
+                raise
+            except (InjectedNetFault, OSError,
+                    json.JSONDecodeError) as e:
+                # OSError covers refused/reset/timeout and the stdlib
+                # http.client exceptions' common transport base cases;
+                # a half-written response parses as JSONDecodeError
+                delay = bo.on_death(time.monotonic())
+                if delay is None:
+                    raise NetError(
+                        f"{op} {self.url}{path}: {attempt} attempts "
+                        f"failed; last: {type(e).__name__}: {e}") from e
+                if self.on_retry is not None:
+                    self.on_retry(op, attempt, delay,
+                                  f"{type(e).__name__}: {e}")
+                time.sleep(delay)
+            except Exception as e:  # noqa: BLE001 — http.client raises
+                # protocol exceptions (RemoteDisconnected,
+                # BadStatusLine) that do not derive from OSError
+                import http.client
+                if not isinstance(e, http.client.HTTPException):
+                    raise
+                delay = bo.on_death(time.monotonic())
+                if delay is None:
+                    raise NetError(
+                        f"{op} {self.url}{path}: {attempt} attempts "
+                        f"failed; last: {type(e).__name__}: {e}") from e
+                if self.on_retry is not None:
+                    self.on_retry(op, attempt, delay,
+                                  f"{type(e).__name__}: {e}")
+                time.sleep(delay)
+
+
+# ---------------------------------------------------------------------------
+# In-process replica: serve_loop on a thread — the subprocess stand-in
+# the bench + fast-gate tests drive (same wire, no spawn cost)
+# ---------------------------------------------------------------------------
+
+
+class InProcessReplica:
+    """One engine + :class:`ReplicaServer` + ``serve_loop`` thread: a
+    replica 'process' that lives in this process but is reachable ONLY
+    through the wire — the unit-test / bench stand-in for a subprocess
+    replica (the chaos harness in tests/test_serve_net.py runs real
+    processes; everything else exercises the identical protocol here).
+
+    ``kill()`` is the SIGKILL analog: stop the loop, join the thread,
+    close the engine's journal (restoring the single-writer invariant
+    the crash-path ``mig`` mark needs), and tear the listener down so
+    clients see connection-refused like a dead process."""
+
+    def __init__(self, engine, *, faults=None,
+                 stall_after_s: float = 10.0, port: int = 0,
+                 step_sleep_s: float = 0.0,
+                 streams_retain: int = 4096):
+        self.engine = engine
+        self.server = ReplicaServer(engine, faults=faults,
+                                    stall_after_s=stall_after_s,
+                                    streams_retain=streams_retain)
+        self.server.start(port=port)
+        self._step_sleep_s = step_sleep_s
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="inproc-replica")
+        self.died: Optional[BaseException] = None
+        self._thread.start()
+
+    def _run(self):
+        try:
+            serve_loop(self.engine, self.server,
+                       step_sleep_s=self._step_sleep_s)
+        except BaseException as e:  # noqa: BLE001 — a dying engine
+            self.died = e           # kills the 'process'; record why
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.server.port}"
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def kill(self) -> None:
+        self.server.request_shutdown()
+        self._thread.join(timeout=10.0)
+        self.server.close()
+        if self.engine._journal is not None:
+            self.engine._journal.close()

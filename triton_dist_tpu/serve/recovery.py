@@ -1250,6 +1250,13 @@ def manifest_from_journal(directory: str | os.PathLike, *,
     )
 
     directory = os.path.abspath(os.fspath(directory))
+    if not os.path.exists(os.path.join(directory, JOURNAL_NAME)):
+        # A replica that died during init (subprocess spawn, model
+        # build) never opened a journal: it owned nothing, so the
+        # hand-off is empty — not an error (the network fleet hits
+        # this when a child is killed before the engine exists).
+        return {"format": MANIFEST_FORMAT, "clock": 0.0,
+                "requests": [], "finished": []}
     journal = replay_journal(os.path.join(directory, JOURNAL_NAME))
     # per-rid event tails from the dead life's postmortem flush (best
     # effort: a SIGKILL with no flush just means no carried events)

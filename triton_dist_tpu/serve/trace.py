@@ -79,6 +79,11 @@ EVENT_TYPES = frozenset({
     "migrate_in",     # request adopted from a migration manifest
     "route",          # fleet router placed a request on a replica
     "replica_state",  # replica HEALTHY -> SUSPECT -> DEAD transitions
+    # network serving plane (serve/net.py, docs/serving.md "Network
+    # fleet serving"): the RemoteReplica client's ring records every
+    # retried call, so a postmortem shows the backoff ladder a
+    # partition actually drove.
+    "net_retry",      # a network call failed and will retry under backoff
 })
 
 #: FinishReason values the ``retire`` event is specified over — the
@@ -102,6 +107,9 @@ FAULT_POINT_EVENTS = {
                               # injector point — WatchdogTimeout)
     "crash": "fault",         # anything escaping step() (InjectedKill,
                               # escalations, interrupts)
+    "net": "fault",           # network serving plane seams (serve/net.py:
+                              # client send, server receive, server
+                              # respond — drop/delay/duplicate/partition)
 }
 
 #: pid the engine timeline claims in exported Chrome traces.  Below the
