@@ -490,6 +490,35 @@ def _bench_serve_fleet_trace():
     return r["serve_fleet_trace_overhead"]
 
 
+def _bench_serve_mesh():
+    """Sharded-engine exactness guardrail (scripts/bench_serve.py
+    bench_mesh): a 2-device kv_shard='heads' engine on the FORCED
+    host-platform mesh serves the identical mixed greedy + seeded-
+    sampled workload; serve_mesh_zero_loss is the fraction of streams
+    bit-identical to the world-1 oracle (floor 1.0 — a correctness
+    bar, not throughput: forced host 'chips' share the bench host's
+    cores, so tokens/s is informational).  Runs as a SUBPROCESS: the
+    device count is fixed at backend init, and this process may be
+    pinned to one real chip."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    from triton_dist_tpu.runtime.testenv import virtual_mesh_env
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = subprocess.run(
+        [_sys.executable, os.path.join(here, "scripts", "bench_serve.py"),
+         "--mesh", "2", "--new-tokens", "48"],
+        capture_output=True, text=True, timeout=1200, cwd=here,
+        env=virtual_mesh_env(n_devices=2))
+    assert out.returncode == 0, out.stderr[-2000:]
+    r = json.loads([ln for ln in out.stdout.splitlines()
+                    if ln.startswith("{")][-1])
+    assert r["mesh_fresh_compiles"] == 0, r
+    return r["serve_mesh_zero_loss"], r["mesh_toks_per_s"]
+
+
 def _environment_provenance(contended: bool) -> dict:
     """Environment stamp for the bench artifact (ROADMAP #5b
     follow-through, docs/perf.md 'Bench trajectory'): the absolute
@@ -568,6 +597,7 @@ def main():
     fleet_zero_loss, fleet_tps = _bench_serve_fleet()
     fleet_net_zero_loss = _bench_serve_fleet_net()
     fleet_trace_overhead = _bench_serve_fleet_trace()
+    mesh_zero_loss, mesh_tps = _bench_serve_mesh()
 
     peak = peak_bf16_tflops()
     vs = (tflops / peak) / REF_UTILIZATION if peak else 0.0
@@ -622,6 +652,14 @@ def main():
         # decision audit) over tokens/s with it all off — the
         # fleet-wide hot-path bar (>= 0.95, like serve_trace_overhead).
         "serve_fleet_trace_overhead": round(fleet_trace_overhead, 3),
+        # Sharded-engine exactness: fraction of mixed greedy + seeded-
+        # sampled streams a 2-device mesh engine (TP weights +
+        # head-sharded paged KV under shard_map) serves bit-identical
+        # to the world-1 oracle on the forced host-platform mesh —
+        # the ISSUE-13 correctness bar (tokens/s informational: forced
+        # host "chips" share this host's cores).
+        "serve_mesh_zero_loss": round(mesh_zero_loss, 4),
+        "serve_mesh_toks_per_s": round(mesh_tps, 1),
         # Known-cost reference op (bare XLA dot, measured ceiling 189.7):
         # a depressed sentinel means the HOST was contended during this
         # session and `value` is a lower bound, not a regression.

@@ -93,6 +93,23 @@ def parse_args():
                         "device-resident carry so the host commits "
                         "horizon k's tokens while the device runs "
                         "horizon k+1 (only engages at --horizon > 1)")
+    p.add_argument("--mesh", type=int, default=None, metavar="N",
+                   help="engine mode: place the engine on an N-device "
+                        "mesh — TP-sharded weights + sharded paged KV "
+                        "under shard_map (docs/serving.md 'Sharded "
+                        "serving'); streams stay bit-identical to the "
+                        "world-1 engine.  Prints a loud SKIP and exits "
+                        "cleanly when the runtime exposes fewer than N "
+                        "devices (force them on CPU with XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=N)")
+    p.add_argument("--kv-shard", choices=("heads", "seq"),
+                   default="heads",
+                   help="--mesh KV layout: 'heads' shards the pools by "
+                        "KV head (Megatron TP attention, full feature "
+                        "set), 'seq' shards by block — each rank owns a "
+                        "contiguous sequence span and attention runs "
+                        "the SP flash-decode combine (long-context "
+                        "scaling; no speculative mode)")
     p.add_argument("--stagger", type=int, default=2,
                    help="engine mode: submit a new request every "
                         "S engine steps")
@@ -421,8 +438,24 @@ def run_engine(args, key):
 
     if args.model != "llama":
         raise SystemExit("--engine serves the dense family only")
-    # the engine is world-1 (per-row block tables are host-managed)
+    # the Generator stays world-1 (it provides the model + chunked
+    # prefill); --mesh places the ENGINE's forwards on a device mesh
     mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    engine_mesh = None
+    if args.mesh:
+        if args.mesh < 1:
+            raise SystemExit("--mesh needs N >= 1")
+        if jax.device_count() < args.mesh:
+            # A loud SKIP, not an error: CI images without forced host
+            # devices (and single-chip hardware) must not fail the CLI.
+            print(f"[serve] SKIP: --mesh {args.mesh} needs {args.mesh} "
+                  f"devices, this runtime exposes {jax.device_count()}."
+                  f"  Re-run under XLA_FLAGS=--xla_force_host_platform_"
+                  f"device_count={args.mesh} (virtual CPU mesh) or on "
+                  f"a {args.mesh}-chip platform to exercise sharded "
+                  f"serving.")
+            return
+        engine_mesh = Mesh(np.array(jax.devices()[:args.mesh]), ("tp",))
     rng = np.random.default_rng(args.seed)
     if args.mixed:
         if args.shared_prompt or args.sessions:
@@ -454,9 +487,20 @@ def run_engine(args, key):
         max_seq += (args.sessions - 1) * (args.new_tokens
                                           + max(4, args.prompt_len))
     max_seq += (-max_seq) % args.page_size
+    n_heads = 2
+    ffn_dim = 64
+    if engine_mesh is not None:
+        # Geometry must divide the mesh (the engine rejects anything
+        # else loudly): whole heads per rank, ffn columns per rank, and
+        # for the seq layout a page count divisible by the world.
+        n_heads = max(2, args.mesh)
+        ffn_dim = -(-64 // args.mesh) * args.mesh
+        if args.kv_shard == "seq":
+            max_seq += (-max_seq) % (args.page_size * args.mesh)
 
-    cfg = llama.LlamaConfig(vocab=256, dim=32, n_layers=2, n_heads=2,
-                            n_kv_heads=2, ffn_dim=64, max_seq=max_seq,
+    cfg = llama.LlamaConfig(vocab=256, dim=16 * n_heads, n_layers=2,
+                            n_heads=n_heads, n_kv_heads=n_heads,
+                            ffn_dim=ffn_dim, max_seq=max_seq,
                             dtype=jnp.float32)
     params = llama.init_params(cfg, key)
     gen = Generator(cfg, mesh, axis="sp", max_seq=max_seq)
@@ -473,6 +517,11 @@ def run_engine(args, key):
     per_req = -(-max_seq // page)
     num_blocks = args.num_blocks or (1 + per_req * max(2, args.requests
                                                        // 2))
+    if (engine_mesh is not None and args.kv_shard == "seq"
+            and args.num_blocks is None):
+        # seq layout: equal per-rank partitions (one null each) sized
+        # so a full-length span still fits its partition
+        num_blocks = -(-(num_blocks + args.mesh) // args.mesh) * args.mesh
     faults = None
     max_queue = args.max_queue
     if args.chaos:
@@ -486,6 +535,7 @@ def run_engine(args, key):
             max_queue = max(2, args.requests // 2)
     kw = dict(num_blocks=num_blocks, page_size=page,
               max_batch=args.max_batch, prefill_chunk=max(8, page),
+              mesh=engine_mesh, kv_shard=args.kv_shard,
               horizon=args.horizon, pipeline=args.pipeline,
               draft=draft, draft_params=d_params,
               spec_k=args.speculative or 0,
@@ -519,8 +569,18 @@ def run_engine(args, key):
             gen, params, snapshot_dir=snap_dir,
             snapshot_every=args.snapshot_every if snap_dir else None,
             **kw)
+    if engine_mesh is not None:
+        layout = ("TP weights + head-sharded paged KV"
+                  if args.kv_shard == "heads" else
+                  "replicated weights + block-sharded paged KV "
+                  "(SP flash-decode)")
+        dist_print(f"mesh serving: {args.mesh} devices over axis 'tp', "
+                   f"kv_shard={args.kv_shard!r} — {layout} under "
+                   f"shard_map; streams are bit-identical to the "
+                   f"world-1 engine")
     dist_print(f"engine: {args.requests} requests, pool {num_blocks} "
                f"blocks x{page} tokens, batch {args.max_batch}"
+               f"{f', mesh {args.mesh} ({args.kv_shard})' if engine_mesh is not None else ''}"
                f"{f', horizon {args.horizon} (pipeline {args.pipeline})' if args.horizon > 1 else ''}"
                f"{f', speculative k={args.speculative}' if args.speculative else ''}"
                f"{f', chaos seed {args.seed}' if args.chaos else ''}"
@@ -746,13 +806,28 @@ def main():
     from triton_dist_tpu.runtime import dist_print, initialize_distributed
 
     initialize_distributed()
+    if args.kv_shard != "heads" and args.mesh is None:
+        # Validated for EVERY mode before dispatch: a non-default
+        # layout without a mesh would serve plain world-1 while the
+        # user believes they exercised sequence sharding.
+        raise SystemExit("--kv-shard needs --mesh N (and --engine)")
     if args.engine and args.fleet is not None:
+        if args.mesh is not None:
+            raise SystemExit("--mesh does not compose with --fleet yet "
+                             "(each replica would need its own device "
+                             "slice); run one sharded engine per "
+                             "process instead")
         return run_fleet(args, jax.random.key(args.seed))
     if args.engine:
         return run_engine(args, jax.random.key(args.seed))
     if args.shared_prompt or args.sessions:
         raise SystemExit("--shared-prompt/--sessions are engine-mode "
                          "flags: add --engine")
+    if args.mesh is not None:
+        raise SystemExit("--mesh is an engine-mode flag: add --engine "
+                         "(sharded ServeEngine serving; the bare "
+                         "generation demo below shards its KV cache "
+                         "over all devices already)")
     n = jax.device_count()
     mesh = Mesh(np.array(jax.devices()), ("sp",))
     key = jax.random.key(args.seed)

@@ -105,6 +105,103 @@ def bench_engine(horizon: int, *, batch: int = 4, prompt_len: int = 16,
     }
 
 
+def bench_mesh(*, n_devices: int = 2, kv_shard: str = "heads",
+               batch: int = 4, prompt_len: int = 16,
+               new_tokens: int = 48, n_layers: int = 2, vocab: int = 256,
+               page_size: int = 8, horizon: int = 8, pipeline: int = 2,
+               seed: int = 0, warmup: bool = True) -> dict:
+    """Sharded-engine serving: a PAIRED world-N vs world-1 run of the
+    identical mixed greedy + seeded-sampled workload (docs/serving.md
+    "Sharded serving").
+
+    The guardrail is ``serve_mesh_zero_loss`` — the fraction of streams
+    the mesh engine serves BIT-IDENTICAL to the world-1 oracle (1.0 or
+    the sharded forwards broke the correctness contract).  Decode
+    tokens/s for both legs is reported informationally only: on the
+    forced host-platform mesh every "chip" shares the same CPU cores,
+    so the mesh leg pays real shard_map orchestration against fake
+    parallel hardware.  ``mesh_fresh_compiles`` must be 0 — the
+    executable-cache fork warmup cannot enumerate is exactly the PR-7
+    failure mode this path closes."""
+    from triton_dist_tpu.models import llama
+    from triton_dist_tpu.models.generate import Generator
+    from triton_dist_tpu.serve import Request, SamplingParams, ServeEngine
+
+    if jax.device_count() < n_devices:
+        raise SystemExit(
+            f"bench_mesh: --mesh {n_devices} needs {n_devices} devices, "
+            f"runtime exposes {jax.device_count()} — re-run under "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{n_devices}")
+    n_heads = max(2, n_devices)
+    max_seq = prompt_len + new_tokens
+    max_seq += (-max_seq) % (page_size * n_devices)
+    cfg = llama.LlamaConfig(vocab=vocab, dim=16 * n_heads,
+                            n_layers=n_layers, n_heads=n_heads,
+                            n_kv_heads=n_heads,
+                            ffn_dim=-(-32 * n_heads // n_devices)
+                            * n_devices,
+                            max_seq=max_seq, dtype=jnp.float32)
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    params = llama.init_params(cfg, jax.random.key(seed))
+    gen = Generator(cfg, mesh1, axis="sp", max_seq=max_seq)
+    engine_mesh = Mesh(np.array(jax.devices()[:n_devices]), ("tp",))
+    per_req = -(-max_seq // page_size)
+    num_blocks = -(-(1 + per_req * batch + n_devices)
+                   // n_devices) * n_devices
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, vocab, size=prompt_len).astype(np.int32)
+               for _ in range(batch)]
+
+    def requests():
+        out = []
+        for i, p in enumerate(prompts):
+            sp = (SamplingParams(max_new_tokens=new_tokens)
+                  if i % 2 == 0 else
+                  SamplingParams(max_new_tokens=new_tokens,
+                                 temperature=0.8, top_k=32,
+                                 seed=seed + 17 * i))
+            out.append(Request(f"m{i}", p, sp))
+        return out
+
+    def leg(mesh):
+        eng = ServeEngine(gen, params, num_blocks=num_blocks,
+                          page_size=page_size, max_batch=batch,
+                          prefill_chunk=max(8, page_size),
+                          horizon=horizon, pipeline=pipeline,
+                          mesh=mesh, kv_shard=kv_shard)
+        if warmup:
+            eng.warmup()
+        flat = eng.metrics.compile_misses
+        for r in requests():
+            eng.submit(r)
+        t0 = time.perf_counter()
+        outs = eng.run()
+        dt = time.perf_counter() - t0
+        d = eng.metrics.summary()["decode"]
+        return ({k: v.token_ids for k, v in outs.items()},
+                d["decode_tokens"] / dt,
+                eng.metrics.compile_misses - flat)
+
+    oracle, w1_tps, _ = leg(None)
+    got, mesh_tps, fresh = leg(engine_mesh)
+    exact = sum(1 for rid in oracle if got.get(rid) == oracle[rid])
+    return {
+        "mode": "mesh",
+        "devices": n_devices,
+        "kv_shard": kv_shard,
+        "batch": batch,
+        "horizon": horizon,
+        "new_tokens": new_tokens,
+        "serve_mesh_zero_loss": round(exact / len(oracle), 4),
+        "world1_toks_per_s": round(w1_tps, 1),
+        "mesh_toks_per_s": round(mesh_tps, 1),
+        "mesh_vs_world1": round(mesh_tps / w1_tps, 3) if w1_tps else 0.0,
+        "mesh_fresh_compiles": fresh,
+    }
+
+
 def bench_spec(*, k: int = 12, batch: int = 4, prompt_len: int = 16,
                new_tokens: int = 64, pipeline: int = 2, dim: int = 64,
                n_layers: int = 2, vocab: int = 256, page_size: int = 16,
@@ -776,6 +873,20 @@ def main():
                         "the recovery wall time (docs/serving.md "
                         "'Fleet serving'; PERF_FLOORS.json holds "
                         "serve_fleet_zero_loss at 1.0)")
+    p.add_argument("--mesh", type=int, default=None, metavar="N",
+                   help="sharded-engine mode: paired world-N vs "
+                        "world-1 decode tokens/s on an N-device mesh "
+                        "(force devices on CPU with XLA_FLAGS=--xla_"
+                        "force_host_platform_device_count=N) plus the "
+                        "serve_mesh_zero_loss exactness fraction — "
+                        "1.0 or the sharded forwards broke "
+                        "bit-exactness (PERF_FLOORS.json floor; "
+                        "tokens/s informational on forced host "
+                        "devices)")
+    p.add_argument("--kv-shard", choices=("heads", "seq"),
+                   default="heads",
+                   help="--mesh KV layout (docs/serving.md 'Sharded "
+                        "serving')")
     p.add_argument("--net", action="store_true",
                    help="with --fleet N: the NETWORK chaos leg — "
                         "replicas reachable only over the serve/net.py "
@@ -795,6 +906,33 @@ def main():
         p.error("--net needs --fleet N")
     if args.net and args.trace:
         p.error("--net and --trace are separate fleet legs")
+    if args.mesh is not None and args.mesh < 1:
+        p.error(f"--mesh must be >= 1, got {args.mesh}")
+    if args.mesh is not None and (args.fleet is not None or args.net
+                                  or args.trace or args.spec
+                                  or args.shared_prompt
+                                  or args.sessions is not None):
+        p.error("--mesh is its own mode: it does not combine with "
+                "--fleet/--net/--trace/--spec/--shared-prompt/"
+                "--sessions")
+    if args.kv_shard != "heads" and args.mesh is None:
+        p.error("--kv-shard needs --mesh N")
+    if args.mesh is not None:
+        r = bench_mesh(n_devices=args.mesh, kv_shard=args.kv_shard,
+                       batch=args.batch, prompt_len=args.prompt_len,
+                       new_tokens=args.new_tokens,
+                       n_layers=args.layers, page_size=args.page_size,
+                       horizon=8, pipeline=args.pipeline,
+                       seed=args.seed, warmup=not args.no_warmup)
+        print(json.dumps(r))
+        print(f"# mesh N={r['devices']} ({r['kv_shard']}): zero-loss "
+              f"{r['serve_mesh_zero_loss']:.3f} (floor 1.0), "
+              f"{r['mesh_toks_per_s']:.1f} vs world-1 "
+              f"{r['world1_toks_per_s']:.1f} tokens/s "
+              f"({r['mesh_vs_world1']:.2f}x, informational on forced "
+              f"host devices), {r['mesh_fresh_compiles']} fresh "
+              f"compiles after warmup", file=sys.stderr)
+        return
     if args.net:
         r = bench_fleet_net(n_replicas=args.fleet, batch=args.batch,
                             prompt_len=args.prompt_len,

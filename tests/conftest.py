@@ -108,6 +108,13 @@ _FAST_GATE_MODULES = {
     # fault containment gate the fused decode path; preemption/spec
     # interactions and the wall-clock bench carry @pytest.mark.slow.
     "test_serve_horizon",
+    # sharded-engine serving: the mesh geometry rejection matrix, the
+    # partitioned block allocator, the mesh-vs-world-1 bit-exactness
+    # oracles (TP heads + SP seq, fused horizon, preemption, prefix
+    # hits) and restore-across-mesh-shapes gate the shard_map serving
+    # path; the spec/horizon sweeps and seq restore legs carry
+    # @pytest.mark.slow.
+    "test_serve_mesh",
     # crash recovery: the journal replay, snapshot/restore round trip,
     # kill/restart chaos sweep (every injected kill point -> bit-exact
     # restarted streams + whole free list), exactly-once crash-window

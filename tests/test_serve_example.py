@@ -67,6 +67,33 @@ def test_serve_engine_speculative():
     assert "chosen k" in out, out
 
 
+def test_serve_engine_mesh():
+    """--engine --mesh N: the sharded engine through the CLI (TP
+    weights + sharded paged KV under shard_map), plus the loud SKIP
+    path when the runtime lacks the devices, plus --kv-shard seq."""
+    out = _run("--engine", "--mesh", "2", "--requests", "3",
+               "--max-batch", "2", "--page-size", "8", devices=2,
+               new_tokens=4)
+    assert "mesh serving: 2 devices" in out, out
+    assert "engine: 12 tokens / 3 requests" in out and "done" in out
+    # not enough devices: a loud SKIP and a CLEAN exit (CI images
+    # without forced host devices must not fail)
+    out = _run("--engine", "--mesh", "4", "--requests", "2", devices=1)
+    assert "SKIP" in out and "--mesh 4 needs 4 devices" in out, out
+    assert "done" not in out
+    # seq layout end to end
+    out = _run("--engine", "--mesh", "2", "--kv-shard", "seq",
+               "--requests", "2", "--max-batch", "2", devices=2,
+               new_tokens=4)
+    assert "kv_shard='seq'" in out and "done" in out, out
+    # --mesh without --engine is rejected, not silently ignored
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--mesh", "2"], capture_output=True,
+        text=True, env=_env(2), timeout=600)
+    assert out.returncode != 0
+    assert "--mesh is an engine-mode flag" in out.stderr
+
+
 def test_serve_engine_spec_adaptive_validated():
     """--spec-adaptive is validated like --sessions: a negative window
     or a use without --speculative is an argparse error, not a silent

@@ -1,0 +1,464 @@
+"""Sharded-engine serving: ServeEngine on a mesh (docs/serving.md
+"Sharded serving").
+
+The acceptance bar (ISSUE 13): a mesh-sharded engine — TP weights +
+head-sharded paged KV (``kv_shard="heads"``) or replicated weights +
+sequence-sharded pools through ``sp_gqa_decode_paged_shard``
+(``kv_shard="seq"``) — serves greedy AND seeded-sampled streams
+bit-identical to the world-1 oracle, including the fused decode
+horizon, preemption recompute, prefix-cache hits, and snapshot/restore
+across DIFFERENT mesh shapes, with a flat compile-miss counter after
+``warmup()``.  Geometry that cannot divide the mesh is rejected loudly
+at construction (the rejection-matrix units), and the partitioned
+block allocator (``kv_shard="seq"``) keeps every logical page in its
+owning rank's partition.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from triton_dist_tpu.models import llama
+from triton_dist_tpu.models.generate import Generator
+from triton_dist_tpu.serve.block_manager import (
+    BlockExhausted,
+    BlockManager,
+)
+from triton_dist_tpu.serve.engine import ServeEngine
+from triton_dist_tpu.serve.request import Request, SamplingParams
+
+
+@pytest.fixture(scope="module")
+def model():
+    # 4 query heads == 4 KV heads: divides mesh2 AND mesh4 (the heads
+    # layout needs whole heads per rank); ffn 64 divides both too.
+    cfg = llama.LlamaConfig(vocab=64, dim=32, n_layers=2, n_heads=4,
+                            n_kv_heads=4, ffn_dim=64, max_seq=64,
+                            dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.key(0))
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    gen = Generator(cfg, mesh1, axis="sp", max_seq=64)
+    return cfg, params, gen
+
+
+def _requests(cfg, lens=(5, 11, 7, 16), n_new=8):
+    """Mixed greedy + seeded-sampled request set (every even index
+    greedy, every odd one a distinct seeded sampler)."""
+    rng = np.random.default_rng(7)
+    out = []
+    for i, n in enumerate(lens):
+        p = rng.integers(0, cfg.vocab, size=n).astype(np.int32)
+        sp = (SamplingParams(max_new_tokens=n_new) if i % 2 == 0 else
+              SamplingParams(max_new_tokens=n_new, temperature=0.8,
+                             top_k=20, seed=123 + i))
+        out.append(Request(f"r{i}", p, sp))
+    return out
+
+
+def _build(gen, params, *, mesh=None, kv_shard="heads", horizon=1,
+           num_blocks=24, page_size=8, **kw):
+    return ServeEngine(gen, params, num_blocks=num_blocks,
+                       page_size=page_size, max_batch=3,
+                       prefill_chunk=4, prefill_budget=8, mesh=mesh,
+                       kv_shard=kv_shard, horizon=horizon, **kw)
+
+
+def _serve(eng, reqs, *, stagger=2):
+    """Staggered submission through the step loop; returns
+    {rid: tokens}."""
+    it = iter(reqs)
+    for r in (next(it), next(it)):
+        eng.submit(r)
+    pending = list(it)
+    step = 0
+    while eng.has_work() or pending:
+        if pending and step % stagger == 0:
+            eng.submit(pending.pop(0))
+        eng.step()
+        step += 1
+        assert step < 500
+    return {rid: out.token_ids for rid, out in eng._outputs.items()
+            if not rid.startswith("__warmup_")}
+
+
+@pytest.fixture(scope="module")
+def oracle(model):
+    """World-1 engine streams for the shared request set — THE
+    bit-exactness reference every mesh configuration must equal."""
+    cfg, params, gen = model
+    eng = _build(gen, params)
+    return _serve(eng, _requests(cfg))
+
+
+# ---------------------------------------------------------------------------
+# Construction-time geometry rejection matrix
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_geometry_rejection_matrix(model, mesh4, mesh2):
+    cfg, params, gen = model
+
+    def build(**kw):
+        base = dict(num_blocks=24, page_size=8, max_batch=2,
+                    prefill_chunk=4)
+        base.update(kw)
+        return ServeEngine(gen, params, **base)
+
+    # unknown axis / unknown layout
+    with pytest.raises(ValueError, match="tp_axis"):
+        build(mesh=mesh4, tp_axis="nope")
+    with pytest.raises(ValueError, match="kv_shard"):
+        build(mesh=mesh4, kv_shard="rows")
+    # heads: whole heads per rank
+    cfg3 = llama.LlamaConfig(vocab=64, dim=48, n_layers=1, n_heads=3,
+                             n_kv_heads=3, ffn_dim=64, max_seq=64,
+                             dtype=jnp.float32)
+    gen3 = Generator(cfg3, Mesh(np.array(jax.devices()[:1]), ("sp",)),
+                     axis="sp", max_seq=64)
+    p3 = llama.init_params(cfg3, jax.random.key(1))
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        ServeEngine(gen3, p3, num_blocks=24, page_size=8, mesh=mesh2,
+                    kv_shard="heads")
+    # heads: ffn divisibility
+    cfg5 = llama.LlamaConfig(vocab=64, dim=32, n_layers=1, n_heads=4,
+                             n_kv_heads=4, ffn_dim=66, max_seq=64,
+                             dtype=jnp.float32)
+    gen5 = Generator(cfg5, Mesh(np.array(jax.devices()[:1]), ("sp",)),
+                     axis="sp", max_seq=64)
+    p5 = llama.init_params(cfg5, jax.random.key(1))
+    with pytest.raises(ValueError, match="ffn_dim"):
+        ServeEngine(gen5, p5, num_blocks=24, page_size=8, mesh=mesh4,
+                    kv_shard="heads")
+    # seq: logical pages / num_blocks must divide the world
+    with pytest.raises(ValueError, match="logical pages"):
+        build(mesh=Mesh(np.array(jax.devices()[:3]), ("tp",)),
+              kv_shard="seq")            # 8 pages % 3
+    with pytest.raises(ValueError, match="num_blocks"):
+        build(mesh=mesh4, kv_shard="seq", num_blocks=26)
+    with pytest.raises(ValueError, match="null"):
+        build(mesh=mesh4, kv_shard="seq", num_blocks=4)
+    # seq x speculative: the single-token combine contract
+    with pytest.raises(ValueError, match="spec"):
+        build(mesh=mesh2, kv_shard="seq", draft=gen, draft_params=params,
+              spec_k=4)
+    # mesh x legacy unfused spec rounds
+    with pytest.raises(ValueError, match="unfused"):
+        build(mesh=mesh2, kv_shard="heads", draft=gen,
+              draft_params=params, spec_k=4, spec_fused=False)
+    # seq: a span that cannot fit its partition is rejected AT SUBMIT,
+    # loudly, not as a shape error inside a traced forward
+    eng = build(mesh=mesh2, kv_shard="seq", num_blocks=8)
+    with pytest.raises(ValueError, match="partition"):
+        eng.submit(Request("long", np.zeros((16,), np.int32),
+                           SamplingParams(max_new_tokens=16)))
+
+
+def test_mesh_block_manager_partitions():
+    """Partitioned allocator units (kv_shard='seq'): placement, the
+    per-partition free walk, COW locality, and the match-prefix
+    partition filter."""
+    bm = BlockManager(16, 4, shards=4, pages_per_shard=2,
+                      prefix_cache=True)
+    assert bm.num_allocatable == 12          # one null per partition
+    assert sorted(bm._nulls) == [0, 4, 8, 12]
+    # logical pages 0-1 -> partition 0, 2-3 -> 1, ...
+    t = bm.allocate("a", 4 * 4 + 1)          # 5 pages
+    assert [bm.part_of_block(b) for b in t] == [0, 0, 1, 1, 2]
+    assert bm.placement_ok(t)
+    assert not bm.placement_ok(list(reversed(t)))
+    # growth stays partition-correct
+    bm.ensure("a", 6 * 4)
+    t = bm.table("a")
+    assert [bm.part_of_block(b) for b in t] == [0, 0, 1, 1, 2, 2]
+    # partition 0 exhausted (2 of 3 held by "a"; 1 left) -> a second
+    # 2-page-span request takes it, a third cannot
+    bm.allocate("b", 2)
+    with pytest.raises(BlockExhausted, match="partition 0"):
+        bm.allocate("c", 2)
+    assert bm.fit_error(8 * 4) is None       # the full 8-page span fits
+    assert bm.fit_error(16 * 4) is not None  # > the pool, ever
+    # a span whose partition share exceeds the partition is impossible
+    tight = BlockManager(8, 4, shards=4, pages_per_shard=2)
+    assert "partition 0" in tight.fit_error(2 * 4)
+    assert bm.can_allocate(2) is False       # partition 0 empty
+    assert bm.can_allocate(4 * 4) is False
+    # COW splits stay in the page's partition
+    bm.free("b")
+    bm.share("s1", [t[0], t[1]])             # overlap with "a" -> shared
+    old, new = bm.cow("s1", 1)
+    assert bm.part_of_block(new) == 0
+    # content-index hits are filtered to placement-compatible chains
+    bm2 = BlockManager(16, 2, shards=4, pages_per_shard=2,
+                       prefix_cache=True)
+    bm2.allocate("x", 8)
+    for logical, toks in enumerate(([1, 2], [3, 4], [5, 6])):
+        bm2.commit_block("x", logical, toks)
+    assert len(bm2.match_prefix([1, 2, 3, 4, 5, 6, 7, 8])) == 3
+    # a block admitted at the WRONG depth for its partition never
+    # certifies a chain (the cross-mesh re-admission guard)
+    tab = bm2.table("x")
+    assert bm2.part_of_block(tab[2]) == 1
+    bm2.free("x")
+    bm3 = BlockManager(16, 2, shards=4, pages_per_shard=2,
+                       prefix_cache=True)
+    # same content, committed under world-1-style placement (all in
+    # partition 0's range is impossible here, so simulate by direct
+    # registration at a misplaced depth)
+    bm3._register(9, 0, (1, 2))              # partition 2 block at depth 0
+    assert bm3.match_prefix([1, 2, 3, 4]) == []
+
+
+# ---------------------------------------------------------------------------
+# THE oracle sweep: mesh-k streams == world-1 streams, bit for bit
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_tp_oracle_h8_flat_misses(model, oracle, mesh4):
+    """kv_shard='heads' on 4 devices, fused horizon H=8 pipelined:
+    greedy + seeded-sampled staggered streams bit-identical to the
+    world-1 oracle, zero fresh compiles after warmup."""
+    cfg, params, gen = model
+    eng = _build(gen, params, mesh=mesh4, kv_shard="heads", horizon=8)
+    eng.warmup()
+    flat = eng.metrics.compile_misses
+    got = _serve(eng, _requests(cfg))
+    assert got == oracle
+    assert eng.metrics.compile_misses == flat, (
+        eng.metrics.summary()["compilation"])
+
+
+def test_mesh_seq_oracle_with_preemption(model, mesh2):
+    """kv_shard='seq': block-sharded pools + sp_gqa_decode_paged_shard,
+    spans crossing rank ownership, preemption recompute — streams
+    bit-identical to world-1, flat misses after warmup."""
+    cfg, params, gen = model
+    rng = np.random.default_rng(2)
+    reqs = [Request("a", rng.integers(0, cfg.vocab, 16).astype(np.int32),
+                    SamplingParams(max_new_tokens=16)),
+            Request("b", rng.integers(0, cfg.vocab, 16).astype(np.int32),
+                    SamplingParams(max_new_tokens=16, temperature=0.9,
+                                   top_k=16, seed=5))]
+    def run(mesh, kv_shard, nb):
+        eng = ServeEngine(gen, params, num_blocks=nb, page_size=8,
+                          max_batch=2, prefill_chunk=8, mesh=mesh,
+                          kv_shard=kv_shard)
+        eng.warmup()
+        flat = eng.metrics.compile_misses
+        for r in reqs:
+            eng.submit(r)
+        outs = eng.run()
+        assert eng.metrics.compile_misses == flat, (
+            eng.metrics.summary()["compilation"])
+        return ({k: v.token_ids for k, v in outs.items()},
+                eng.metrics.preemptions)
+
+    want, _ = run(None, "heads", 24)
+    got, preempts = run(mesh2, "seq", 16)
+    assert got == want
+    # 16 blocks / 2 partitions: both 4-page spans contend for
+    # partition 0's 7 allocatable blocks -> the seq allocator preempts
+    assert preempts >= 1
+
+
+def test_mesh_prefix_cache_warm_hit(model, mesh4):
+    """A shared system prompt hits the content index on a mesh engine
+    exactly like world-1: the second request's prefill skips the cached
+    prefix (gathered through the sharded load_pages program) and the
+    streams stay bit-exact."""
+    cfg, params, gen = model
+    shared = np.arange(24, dtype=np.int32) % cfg.vocab
+    tails = [np.array([1, 2, 3], np.int32), np.array([4, 5, 6], np.int32)]
+    reqs = lambda: [Request(f"s{i}", np.concatenate([shared, t]),
+                            SamplingParams(max_new_tokens=6))
+                    for i, t in enumerate(tails)]
+    def run(mesh):
+        eng = ServeEngine(gen, params, num_blocks=24, page_size=8,
+                          max_batch=1, prefill_chunk=8, mesh=mesh,
+                          kv_shard="heads")
+        eng.warmup()
+        outs = {}
+        for r in reqs():          # serially: s1 admits after s0 commits
+            eng.submit(r)
+            outs.update({k: v.token_ids for k, v in eng.run().items()})
+        return outs, eng.metrics.prefix_hits, \
+            eng.metrics.prefix_skipped_tokens
+
+    want, _, _ = run(None)
+    got, hits, skipped = run(mesh4)
+    assert got == want
+    assert hits >= 1 and skipped >= 8
+
+
+# ---------------------------------------------------------------------------
+# Restore across mesh shapes
+# ---------------------------------------------------------------------------
+
+
+def _snap_crash_restore(model, tmp_path, src_mesh, src_shard, dst_mesh,
+                        dst_shard, tag):
+    cfg, params, gen = model
+    rng = np.random.default_rng(2)
+    p0 = rng.integers(0, cfg.vocab, 16).astype(np.int32)
+    p1 = rng.integers(0, cfg.vocab, 16).astype(np.int32)
+    sp1 = SamplingParams(max_new_tokens=16, temperature=0.9, top_k=16,
+                         seed=5)
+
+    def fresh(mesh, shard, **kw):
+        return ServeEngine(gen, params, num_blocks=24, page_size=8,
+                           max_batch=2, prefill_chunk=8, mesh=mesh,
+                           kv_shard=shard, **kw)
+
+    want_eng = fresh(None, "heads")
+    want_eng.submit(Request("a", p0, SamplingParams(max_new_tokens=16)))
+    want_eng.submit(Request("b", p1, sp1))
+    want = {k: v.token_ids for k, v in want_eng.run().items()}
+
+    d = str(tmp_path / tag)
+    eng = fresh(src_mesh, src_shard, snapshot_dir=d, snapshot_every=2)
+    eng.submit(Request("a", p0, SamplingParams(max_new_tokens=16)))
+    eng.submit(Request("b", p1, sp1))
+    for _ in range(6):
+        eng.step()          # abandoned mid-decode == crash
+    kw = {}
+    if dst_mesh is not None:
+        kw.update(mesh=dst_mesh, kv_shard=dst_shard)
+    restored = ServeEngine.restore(d, gen, params, **kw)
+    got = {k: v.token_ids for k, v in restored.run().items()}
+    assert got == want, tag
+    return restored
+
+
+def test_mesh_restore_world1_to_mesh4(model, tmp_path, mesh4):
+    """A world-1 snapshot restores IN PLACE onto a 4-device heads mesh
+    (pools re-laid-out by one device_put) — resumed streams
+    bit-identical to the uninterrupted run."""
+    r = _snap_crash_restore(model, tmp_path, None, "heads", mesh4,
+                            "heads", "w1_to_m4")
+    assert r.metrics.restored_in_place == 2
+
+
+def test_mesh_restore_mesh4_to_world1(model, tmp_path, mesh4):
+    """And back: a mesh-4 snapshot (orbax holds GLOBAL arrays) restores
+    onto a plain world-1 engine, in place."""
+    r = _snap_crash_restore(model, tmp_path, mesh4, "heads", None,
+                            "heads", "m4_to_w1")
+    assert r.metrics.restored_in_place == 2
+
+
+@pytest.mark.slow
+def test_mesh_restore_seq_shapes_chaos(model, tmp_path, mesh4, mesh2):
+    """The seq legs: seq/4 -> seq/2 adopts in place when the partition
+    placement stays compatible; heads/2 -> seq/4 violates placement and
+    re-queues through exact recompute — bit-exact either way."""
+    r = _snap_crash_restore(model, tmp_path, mesh4, "seq", mesh2, "seq",
+                            "s4_to_s2")
+    assert r.metrics.restored_in_place == 2
+    r = _snap_crash_restore(model, tmp_path, mesh2, "heads", mesh4,
+                            "seq", "h2_to_s4")
+    assert r.metrics.restored_requeued == 2
+    assert r.metrics.restored_in_place == 0
+
+
+# ---------------------------------------------------------------------------
+# Slow tier: spec rounds on a mesh, horizon sweep, live migration
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_mesh_spec_oracle(model, oracle, mesh4):
+    """Fused speculative rounds under shard_map (self-draft): the
+    multi-token verify runs head-sharded TP, the draft replicated, and
+    every stream — greedy and seeded-sampled — is bit-identical to the
+    draft-less world-1 oracle."""
+    cfg, params, gen = model
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    draft = Generator(cfg, mesh1, axis="sp", max_seq=64)
+    eng = _build(gen, params, mesh=mesh4, kv_shard="heads", draft=draft,
+                 draft_params=params, spec_k=4)
+    eng.warmup()
+    flat = eng.metrics.compile_misses
+    got = _serve(eng, _requests(cfg))
+    assert got == oracle
+    assert eng.metrics.compile_misses == flat
+    assert eng.metrics.spec_rounds > 0
+
+
+@pytest.mark.slow
+def test_mesh_horizon_sweep(model, oracle, mesh2):
+    """Horizon in {1, 8} x kv_shard in {heads, seq} all equal the
+    oracle (the H=1 heads case and seq H=8 — the fast tests cover the
+    other diagonal)."""
+    cfg, params, gen = model
+    for kv_shard, horizon in (("heads", 1), ("seq", 8)):
+        eng = _build(gen, params, mesh=mesh2, kv_shard=kv_shard,
+                     horizon=horizon)
+        eng.warmup()
+        got = _serve(eng, _requests(cfg))
+        assert got == oracle, (kv_shard, horizon)
+
+
+@pytest.mark.slow
+def test_mesh_seq_prefix_warm_hit(model, mesh2):
+    """The seq layout's warm-prefix gather: shared pages live in
+    different ranks' partitions, the masked psum assembles the full
+    scratch, and the warm stream stays bit-exact with world-1."""
+    cfg, params, gen = model
+    # 40 shared tokens = 5 pages: at W=2 (4 logical pages per rank) the
+    # cached prefix genuinely SPANS both ranks' partitions
+    shared = np.arange(40, dtype=np.int32) % cfg.vocab
+    tails = [np.array([1, 2, 3], np.int32), np.array([4, 5, 6], np.int32)]
+
+    def run(mesh, kv_shard):
+        eng = ServeEngine(gen, params, num_blocks=24, page_size=8,
+                          max_batch=1, prefill_chunk=8, mesh=mesh,
+                          kv_shard=kv_shard)
+        eng.warmup()
+        outs = {}
+        for i, t in enumerate(tails):
+            eng.submit(Request(f"s{i}", np.concatenate([shared, t]),
+                               SamplingParams(max_new_tokens=6)))
+            outs.update({k: v.token_ids for k, v in eng.run().items()})
+        return outs, eng.metrics.prefix_skipped_tokens
+
+    want, _ = run(None, "heads")
+    got, skipped = run(mesh2, "seq")
+    assert got == want
+    assert skipped >= 8     # the warm admit really skipped prefill
+
+
+@pytest.mark.slow
+def test_mesh_drain_migrates_to_world1(model, mesh4):
+    """Live migration off a mesh: a mesh-4 engine drains mid-stream and
+    a world-1 engine adopts IN PLACE (the gathered pages are global
+    arrays) — the continued stream is bit-exact."""
+    cfg, params, gen = model
+    p = np.arange(14, dtype=np.int32) % cfg.vocab
+    want_eng = _build(gen, params)
+    want_eng.submit(Request("m", p, SamplingParams(max_new_tokens=12)))
+    want = want_eng.run()["m"].token_ids
+
+    src = _build(gen, params, mesh=mesh4, kv_shard="heads")
+    src.submit(Request("m", p, SamplingParams(max_new_tokens=12)))
+    for _ in range(6):
+        src.step()
+    manifest = src.drain(["m"])
+    assert manifest["requests"][0].get("kv") is not None
+    dst = _build(gen, params)
+    res = dst.migrate_in(manifest)
+    assert res["adopted"] == ["m"]
+    got = dst.run()["m"].token_ids
+    assert got == want
+
+
+def test_mesh_floor_present():
+    """PERF_FLOORS.json carries the serve_mesh_zero_loss correctness
+    floor at 1.0 (bench.py's mesh leg gates on it)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    floors = json.load(open(os.path.join(root, "PERF_FLOORS.json")))
+    spec = floors["floors"]["serve_mesh_zero_loss"]
+    assert spec["min"] == 1.0

@@ -325,8 +325,17 @@ class Generator:
         return jax.jit(run)
 
 
+def _default_out_proj(o2, layer):
+    """Attention output projection on replicated weights — the default
+    ``out_proj`` hook of the shared forwards below.  ``o2`` is the
+    flattened attention output ``[rows, Hq*hd]``.  Tensor-parallel
+    instantiations (serve/mesh.py) swap in a row-parallel matmul +
+    ``psum`` over the local head shard."""
+    return o2 @ layer["wo"]
+
+
 def _token_forward(params, caches, token, pos, *, cfg: LlamaConfig,
-                   write_kv, attend, ffn=None):
+                   write_kv, attend, ffn=None, out_proj=None):
     """ONE copy of the single-token decode layer math, parameterized by
     the cache addressing (ROADMAP: the shared (write_kv, attend) pair):
 
@@ -339,9 +348,16 @@ def _token_forward(params, caches, token, pos, *, cfg: LlamaConfig,
     ``serve.engine._paged_decode_forward`` (pool-page scatter + the
     block-table kernel) are both this function with different pairs —
     the serve-engine oracle tests lock their bit-exactness.  ``pos``
-    [B] int32 carries the RoPE positions (each row's cache length)."""
+    [B] int32 carries the RoPE positions (each row's cache length).
+
+    ``out_proj(o2, layer) -> [B, D]`` swaps the attention output
+    projection (with ``ffn``, the two seams a tensor-parallel
+    instantiation must reduce across ranks — serve/mesh.py passes
+    row-parallel matmul + psum hooks and a head-local ``cfg``)."""
     if ffn is None:
         ffn = _dense_prompt_ffn
+    if out_proj is None:
+        out_proj = _default_out_proj
     new_caches = []
     x = params["embed"][token]  # [B, D]
     for li, layer in enumerate(params["layers"]):
@@ -353,8 +369,8 @@ def _token_forward(params, caches, token, pos, *, cfg: LlamaConfig,
         k = _rope_at(k, pos, cfg.rope_theta)
         cache = write_kv(li, caches[li], k, v)
         o = attend(li, q, cache)  # [B, Hq, hd]
-        x = x + (o.reshape(o.shape[0], -1).astype(cfg.dtype)
-                 @ layer["wo"])
+        x = x + out_proj(o.reshape(o.shape[0], -1).astype(cfg.dtype),
+                         layer)
         h = _rms_norm(x[:, None], layer["mlp_norm"], cfg.norm_eps)[:, 0]
         x = x + ffn(h, layer)
         new_caches.append(cache)
@@ -365,7 +381,7 @@ def _token_forward(params, caches, token, pos, *, cfg: LlamaConfig,
 
 
 def _multitoken_forward(params, caches, chunk, pos, *, cfg: LlamaConfig,
-                        write_kv, attend, ffn=None):
+                        write_kv, attend, ffn=None, out_proj=None):
     """ONE copy of the multi-token (speculative-verify) layer math,
     parameterized like :func:`_token_forward`:
 
@@ -377,9 +393,12 @@ def _multitoken_forward(params, caches, chunk, pos, *, cfg: LlamaConfig,
     ``_verify_forward`` (contiguous per-row writes) and
     ``serve.engine._paged_verify_forward`` (block-table addressing)
     share it.  ``pos`` [B, T] int32: global position of query t of row
-    b (``kv_lens[b] + t``)."""
+    b (``kv_lens[b] + t``).  ``out_proj`` as in :func:`_token_forward`
+    (the tensor-parallel reduction seam)."""
     if ffn is None:
         ffn = _dense_prompt_ffn
+    if out_proj is None:
+        out_proj = _default_out_proj
     B, T = chunk.shape
     hd = cfg.head_dim
     x = params["embed"][chunk]                        # [B, T, D]
@@ -395,7 +414,7 @@ def _multitoken_forward(params, caches, chunk, pos, *, cfg: LlamaConfig,
         cache = write_kv(li, caches[li], k, v)
         o = attend(li, q, cache)                      # [B, T, Hq, hd]
         o = o.reshape(B * T, cfg.n_heads * hd).astype(cfg.dtype)
-        x = x + (o @ layer["wo"]).reshape(B, T, cfg.dim)
+        x = x + out_proj(o, layer).reshape(B, T, cfg.dim)
         h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps).reshape(
             B * T, cfg.dim)
         x = x + ffn(h2, layer).reshape(B, T, cfg.dim)
@@ -558,7 +577,8 @@ def _write_chunk(cache, new, prefix_len, quantized):
 
 
 def _chunk_forward(params, chunk, caches, prefix_len, *, cfg: LlamaConfig,
-                   quantized: bool, ffn=None, extent: int | None = None,
+                   quantized: bool, ffn=None, out_proj=None,
+                   extent: int | None = None,
                    n_valid=None, impl: str = "auto", interpret: bool = False,
                    mesh=None, axis=None):
     """One prompt chunk [B, c] against the cached prefix; returns
@@ -580,9 +600,18 @@ def _chunk_forward(params, chunk, caches, prefix_len, *, cfg: LlamaConfig,
     prefix + i < prefix + n_valid), and their own logits are garbage the
     caller discards.  One trace serves every residual chunk length — the
     serving engine's admission path never retraces on prompt shape
-    (docs/serving.md: the bucket ladder)."""
+    (docs/serving.md: the bucket ladder).
+
+    ``out_proj`` as in :func:`_token_forward`: the attention output
+    projection seam a tensor-parallel caller reduces across ranks
+    (serve/mesh.py's head-sharded chunk prefill — there ``mesh``/
+    ``axis`` stay None because the TP caller is already inside its own
+    ``shard_map`` and the per-rank cache is head-local, not
+    sequence-sharded)."""
     if ffn is None:
         ffn = _dense_prompt_ffn
+    if out_proj is None:
+        out_proj = _default_out_proj
     B, c = chunk.shape
     hd = cfg.head_dim
     x = params["embed"][chunk]                       # [B, c, D]
@@ -627,7 +656,7 @@ def _chunk_forward(params, chunk, caches, prefix_len, *, cfg: LlamaConfig,
                                window=cfg.attn_window,
                                soft_cap=cfg.attn_soft_cap)
         o = o.reshape(B * c, cfg.n_heads * hd).astype(cfg.dtype)
-        x = x + (o @ layer["wo"]).reshape(B, c, cfg.dim)
+        x = x + out_proj(o, layer).reshape(B, c, cfg.dim)
         h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps).reshape(
             B * c, cfg.dim)
         x = x + ffn(h2, layer).reshape(B, c, cfg.dim)

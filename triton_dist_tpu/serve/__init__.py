@@ -41,6 +41,11 @@ Layout:
   health with circuit breaking and backoff restarts, and live request
   migration over the journal/snapshot hand-off
   (docs/serving.md "Fleet serving")
+- ``mesh``       — sharded serving: every engine device program as a
+  ``shard_map`` body (TP weights + head-sharded pools, or replicated
+  weights + block-sharded pools through the SP flash-decode combine),
+  with canonical argument placement so the executable cache never
+  forks (docs/serving.md "Sharded serving")
 """
 
 from triton_dist_tpu.serve.request import (  # noqa: F401

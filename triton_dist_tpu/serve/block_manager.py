@@ -64,24 +64,55 @@ class BlockExhausted(Exception):
 
 class BlockManager:
     def __init__(self, num_blocks: int, page_size: int, *, faults=None,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False, shards: int = 1,
+                 pages_per_shard: Optional[int] = None):
         if num_blocks < 2:
             raise ValueError(
                 f"num_blocks must be >= 2 (block 0 is the reserved null "
                 f"block), got {num_blocks}")
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
+        # Sequence-sharded serving (docs/serving.md "Sharded serving"):
+        # with shards=W the block-id space splits into W equal
+        # partitions — rank r's pool holds global blocks
+        # [r*NB/W, (r+1)*NB/W) — and logical page ``i`` of ANY request
+        # must be allocated from partition ``i // pages_per_shard``
+        # (contiguous sequence-span ownership, the
+        # sp_gqa_decode_paged_shard contract).  Each partition reserves
+        # its own null block (its first id): per-rank dummy writes
+        # redirect to LOCAL row 0, so one global null cannot serve
+        # every rank.  shards=1 is the world-1 engine, bit-identical to
+        # the pre-mesh allocator.
+        if shards < 1:
+            raise ValueError(f"shards must be >= 1, got {shards}")
+        if num_blocks % shards:
+            raise ValueError(f"num_blocks {num_blocks} must divide by "
+                             f"shards {shards}")
+        if shards > 1 and num_blocks // shards < 2:
+            raise ValueError(
+                f"num_blocks//shards = {num_blocks // shards}: every "
+                f"partition reserves a null block and still needs an "
+                f"allocatable page")
+        if shards > 1 and not pages_per_shard:
+            raise ValueError("shards > 1 needs pages_per_shard (the "
+                             "logical-page span each rank owns)")
         self.num_blocks = num_blocks
         self.page_size = page_size
+        self.shards = shards
+        self.pages_per_shard = pages_per_shard or num_blocks
+        self._nb_loc = num_blocks // shards
         self.null_block = 0
+        self._nulls = frozenset(r * self._nb_loc for r in range(shards))
         self.prefix_cache = bool(prefix_cache)
         # runtime.faults.FaultInjector (optional): the mid-grow alloc is
         # a fault point — an injected failure exercises the engine's
         # quarantine path without a genuinely exhausted pool.
         self._faults = faults
         # LIFO free list: recently-freed (cache-warm) blocks are reused
-        # first.  Block 0 never enters it.
-        self._free: list[int] = list(range(num_blocks - 1, 0, -1))
+        # first.  Null blocks (block 0; one per partition when sharded)
+        # never enter it.
+        self._free: list[int] = [b for b in range(num_blocks - 1, 0, -1)
+                                 if b not in self._nulls]
         self._tables: dict[str, list[int]] = {}
         # -- sharing / content cache state --------------------------------
         self._ref: dict[int, int] = {}          # block -> refcount (> 0)
@@ -133,7 +164,57 @@ class BlockManager:
 
     @property
     def num_allocatable(self) -> int:
-        return self.num_blocks - 1
+        return self.num_blocks - self.shards
+
+    # -- partition arithmetic (shards > 1: kv_shard="seq") ---------------
+
+    def part_of_block(self, block: int) -> int:
+        """Partition owning physical block ``block``."""
+        return block // self._nb_loc
+
+    def part_of_page(self, logical: int) -> int:
+        """Partition that must hold logical page ``logical`` of any
+        request (contiguous sequence-span ownership)."""
+        return min(logical // self.pages_per_shard, self.shards - 1)
+
+    def placement_ok(self, blocks: Sequence[int]) -> bool:
+        """True when a position-ordered block table satisfies the
+        partition constraint (trivially true unsharded).  The restore
+        path gates in-place adoption on this — a table snapshotted
+        under a different mesh shape re-queues through exact recompute
+        instead of serving junk pages."""
+        if self.shards == 1:
+            return True
+        return all(self.part_of_block(b) == self.part_of_page(i)
+                   and b not in self._nulls
+                   for i, b in enumerate(blocks))
+
+    def _part_free(self, part: int, *, skip_cached: int = 0) -> int:
+        """Free + evictable blocks available in one partition."""
+        lo, hi = part * self._nb_loc, (part + 1) * self._nb_loc
+        return (sum(1 for b in self._free if lo <= b < hi)
+                + sum(1 for b in self._cached if lo <= b < hi)
+                - skip_cached)
+
+    def fit_error(self, n_tokens: int) -> Optional[str]:
+        """Can ``n_tokens`` EVER fit this pool (all blocks free)?
+        Returns None when yes, else the rejection message — per
+        partition when sharded: a long request needs its span's pages
+        in specific partitions, so a global block count is not enough."""
+        need = self.blocks_for(n_tokens)
+        if need > self.num_allocatable:
+            return (f"needs {need} blocks, pool has "
+                    f"{self.num_allocatable}")
+        if self.shards > 1:
+            for p in range(self.shards):
+                in_p = sum(1 for i in range(need)
+                           if self.part_of_page(i) == p)
+                if in_p > self._nb_loc - 1:
+                    return (f"needs {in_p} blocks in partition {p} "
+                            f"(kv_shard='seq' sequence-span "
+                            f"ownership), partition holds "
+                            f"{self._nb_loc - 1}")
+        return None
 
     @property
     def utilization(self) -> float:
@@ -155,7 +236,18 @@ class BlockManager:
         subtracted from both sides."""
         in_cache = sum(1 for b in shared if b in self._cached)
         avail = len(self._free) + len(self._cached) - in_cache
-        return self.blocks_for(n_tokens) - len(shared) <= avail
+        if self.blocks_for(n_tokens) - len(shared) > avail:
+            return False
+        if self.shards > 1:
+            need = self.blocks_for(n_tokens)
+            for p in range(self.shards):
+                need_p = sum(1 for i in range(len(shared), need)
+                             if self.part_of_page(i) == p)
+                skip = sum(1 for b in shared if b in self._cached
+                           and self.part_of_block(b) == p)
+                if need_p > self._part_free(p, skip_cached=skip):
+                    return False
+        return True
 
     def ref_of(self, block: int) -> int:
         return self._ref.get(block, 0)
@@ -253,6 +345,13 @@ class BlockManager:
             blk = self._find(parent, key)
             if blk is None:
                 break
+            if (self.shards > 1
+                    and self.part_of_block(blk) != self.part_of_page(i)):
+                # Sharded pools: a cached block is only usable at the
+                # logical position whose partition physically holds it
+                # (re-admitted warm blocks from a different mesh shape
+                # land here and simply never match).
+                break
             out.append(blk)
             parent = blk
         if out and count:
@@ -282,17 +381,33 @@ class BlockManager:
 
     # -- allocate / extend / free ----------------------------------------
 
-    def _pop_free(self) -> int:
+    def _pop_free(self, part: Optional[int] = None) -> int:
         """One writable block off the free list, evicting the LRU cached
         block (plus its now-unreachable cached descendants — a committed
         child whose parent is gone can never be matched again, and its
         stale chain link must not survive the parent id's reuse) when
-        the list is empty."""
-        if not self._free:
-            if not self._cached:
-                raise BlockExhausted("no free or evictable blocks")
-            self._evict(next(iter(self._cached)))
-        return self._free.pop()
+        the list is empty.  ``part`` (sharded pools) restricts the pop
+        — and any eviction — to one partition."""
+        if part is None or self.shards == 1:
+            if not self._free:
+                if not self._cached:
+                    raise BlockExhausted("no free or evictable blocks")
+                self._evict(next(iter(self._cached)))
+            return self._free.pop()
+        lo, hi = part * self._nb_loc, (part + 1) * self._nb_loc
+        for i in range(len(self._free) - 1, -1, -1):
+            if lo <= self._free[i] < hi:
+                return self._free.pop(i)
+        victim = next((b for b in self._cached if lo <= b < hi), None)
+        if victim is None:
+            raise BlockExhausted(
+                f"no free or evictable blocks in partition {part}")
+        self._evict(victim)
+        for i in range(len(self._free) - 1, -1, -1):
+            if lo <= self._free[i] < hi:
+                return self._free.pop(i)
+        raise BlockExhausted(       # pragma: no cover — _evict freed one
+            f"no free or evictable blocks in partition {part}")
 
     def _evict(self, block: int) -> None:
         """Reclaim a cache-tier block into the free list.  Its committed
@@ -354,12 +469,32 @@ class BlockManager:
             raise BlockExhausted(
                 f"{rid}: need {need - len(shared)} blocks for {n_tokens} "
                 f"tokens ({len(shared)} shared), only {avail} free")
+        if self.shards > 1:
+            # Partitioned placement: every fresh page must come from its
+            # logical position's partition, and the availability check
+            # must hold PER PARTITION (the global count above can pass
+            # while the one partition this span needs is empty).
+            if not self.placement_ok(shared):
+                raise ValueError(
+                    f"{rid}: shared prefix blocks {list(shared)} violate "
+                    f"the partition placement (kv_shard='seq')")
+            for p in range(self.shards):
+                need_p = sum(1 for i in range(len(shared), need)
+                             if self.part_of_page(i) == p)
+                skip = sum(1 for b in shared if b in self._cached
+                           and self.part_of_block(b) == p)
+                if need_p > self._part_free(p, skip_cached=skip):
+                    raise BlockExhausted(
+                        f"{rid}: need {need_p} blocks in partition {p} "
+                        f"for {n_tokens} tokens, only "
+                        f"{self._part_free(p, skip_cached=skip)} free")
         table = []
         for b in shared:
             self._claim_shared(b)
             table.append(b)
-        for _ in range(need - len(shared)):
-            b = self._pop_free()
+        for i in range(len(shared), need):
+            b = self._pop_free(self.part_of_page(i)
+                               if self.shards > 1 else None)
             self._ref[b] = 1
             table.append(b)
         self._tables[rid] = table
@@ -383,9 +518,20 @@ class BlockManager:
             raise BlockExhausted(
                 f"{rid}: extension to {n_tokens} tokens needs {need} more "
                 f"blocks, only {self.num_free} free")
+        base = len(table)
+        if self.shards > 1:
+            for p in range(self.shards):
+                need_p = sum(1 for i in range(base, base + need)
+                             if self.part_of_page(i) == p)
+                if need_p > self._part_free(p):
+                    raise BlockExhausted(
+                        f"{rid}: extension to {n_tokens} tokens needs "
+                        f"{need_p} blocks in partition {p}, only "
+                        f"{self._part_free(p)} free")
         fresh = []
-        for _ in range(need):
-            b = self._pop_free()
+        for i in range(base, base + need):
+            b = self._pop_free(self.part_of_page(i)
+                               if self.shards > 1 else None)
             self._ref[b] = 1
             fresh.append(b)
         table.extend(fresh)
@@ -402,7 +548,10 @@ class BlockManager:
         if self._ref.get(old, 0) <= 1:
             raise ValueError(
                 f"{rid}: block {old} (logical {logical}) is not shared")
-        new = self._pop_free()
+        # The split stays in the logical page's partition (sharded
+        # pools): the device copy is rank-local by construction.
+        new = self._pop_free(self.part_of_page(logical)
+                             if self.shards > 1 else None)
         self._ref[old] -= 1
         self._ref[new] = 1
         table[logical] = new
@@ -420,12 +569,17 @@ class BlockManager:
             raise ValueError(f"request {rid!r} already has blocks")
         blocks = [int(b) for b in blocks]
         bad = [b for b in blocks
-               if b == self.null_block or not 0 < b < self.num_blocks]
+               if b in self._nulls or not 0 <= b < self.num_blocks]
         if bad:
             raise ValueError(f"{rid}: cannot claim blocks {bad} "
                              f"(null or outside pool {self.num_blocks})")
         if len(set(blocks)) != len(blocks):
             raise ValueError(f"{rid}: duplicate blocks in {blocks}")
+        if not self.placement_ok(blocks):
+            raise ValueError(
+                f"{rid}: blocks {blocks} violate the partition "
+                f"placement (kv_shard='seq': logical page i lives in "
+                f"partition i // {self.pages_per_shard})")
         free = set(self._free)
         for b in blocks:
             if b in free:
@@ -451,7 +605,7 @@ class BlockManager:
             raise ValueError(f"request {rid!r} already has blocks")
         blocks = [int(b) for b in blocks]
         bad = [b for b in blocks
-               if b == self.null_block or not 0 < b < self.num_blocks]
+               if b in self._nulls or not 0 <= b < self.num_blocks]
         if bad:
             raise ValueError(f"{rid}: cannot adopt blocks {bad} "
                              f"(null or outside pool {self.num_blocks})")

@@ -67,9 +67,19 @@ emitted exactly once across the crash (journal-matching rows resume in
 place, journal-ahead rows replay through the exact-recompute
 preemption path; serve/recovery.py holds the argument).
 
-v1 scope: world-1 mesh, float KV pools, dense-Llama-family ``Generator``
-(the same envelope as the r5 batched speculative verify; batch-1 SP +
-int8 serving keeps the contiguous `Generator.generate` path).
+The engine is MESH-AWARE (PR 12, docs/serving.md "Sharded serving"):
+``mesh=``/``tp_axis=``/``kv_shard=`` rebuild every device program above
+as a ``shard_map`` body (serve/mesh.py) — TP weights + head-sharded
+pools (``"heads"``: Megatron attention, per-rank paged decode, spec
+rounds included) or replicated weights + block-sharded pools through
+``sp_gqa_decode_paged_shard`` (``"seq"``: SP flash-decode with a
+partitioned block allocator).  The scheduler, block tables, journal,
+and step loop are unchanged host machinery; streams stay bit-identical
+to the world-1 engine and snapshots restore across mesh shapes.
+
+Scope: float KV pools, dense-Llama-family ``Generator`` (the same
+envelope as the r5 batched speculative verify; batch-1 SP + int8
+serving keeps the contiguous `Generator.generate` path).
 """
 
 from __future__ import annotations
@@ -181,14 +191,21 @@ def _scatter_kv(pool, k, v, pool_row, in_page):
 
 
 def _paged_decode_forward(params, pools, tables, kv_lens, token, active, *,
-                          cfg, page, impl, interpret):
+                          cfg, page, impl, interpret, fwd_cfg=None,
+                          ffn=None, out_proj=None):
     """One decode token for every batch row over the paged pools.
 
     ``generate._token_forward`` (the same math as ``_step_impl`` — the
     greedy stream must be bit-identical to the contiguous oracle) with
     the contiguous append swapped for a pool-page scatter and attention
     through the paged block-table kernel.
-    """
+
+    ``fwd_cfg``/``ffn``/``out_proj`` are the tensor-parallel seams
+    (serve/mesh.py): the layer math runs under ``fwd_cfg`` (the
+    local-head shard view) with row-parallel psum hooks, while the page
+    addressing and the attention kernel's soft-cap/window stay on the
+    global ``cfg`` — ONE copy of the block-table addressing serves the
+    world-1 engine and every head-sharded rank."""
     inc = active.astype(kv_lens.dtype)
     pool_row, in_page = _page_slots(tables, kv_lens, active, page=page)
 
@@ -202,18 +219,21 @@ def _paged_decode_forward(params, pools, tables, kv_lens, token, active, *,
             window=cfg.attn_window)
         return o
 
-    return _token_forward(params, pools, token, kv_lens, cfg=cfg,
-                          write_kv=write_kv, attend=attend)
+    return _token_forward(params, pools, token, kv_lens,
+                          cfg=fwd_cfg or cfg, write_kv=write_kv,
+                          attend=attend, ffn=ffn, out_proj=out_proj)
 
 
 def _paged_verify_forward(params, pools, tables, kv_lens, chunk, active, *,
-                          cfg, page, impl, interpret):
+                          cfg, page, impl, interpret, fwd_cfg=None,
+                          ffn=None, out_proj=None):
     """Score ``chunk`` [B, T] draft tokens per row at PER-ROW lengths over
     the paged pools — ``generate._multitoken_forward`` (the same math as
     ``_verify_forward``) re-addressed through block tables (K/V rows
     scatter into each request's pages, the multi-token decode kernel
     reads them back through the table).  Returns (new_pools,
-    logits [B, T, V])."""
+    logits [B, T, V]).  ``fwd_cfg``/``ffn``/``out_proj`` as in
+    :func:`_paged_decode_forward` — the TP seams."""
     T = chunk.shape[1]
     n_pages = tables.shape[1]
     pos = kv_lens[:, None] + jnp.arange(T, dtype=jnp.int32)[None]  # [B, T]
@@ -233,14 +253,17 @@ def _paged_verify_forward(params, pools, tables, kv_lens, chunk, active, *,
             window=cfg.attn_window)
         return o
 
-    return _multitoken_forward(params, pools, chunk, pos, cfg=cfg,
-                               write_kv=write_kv, attend=attend)
+    return _multitoken_forward(params, pools, chunk, pos,
+                               cfg=fwd_cfg or cfg, write_kv=write_kv,
+                               attend=attend, ffn=ffn,
+                               out_proj=out_proj)
 
 
 def _paged_decode_horizon(params, pools, tables, kv_lens, token, active,
                           eos_done, limits, counts, base_keys, temps,
                           top_ks, top_ps, greedy, eos_ids, *, H,
-                          all_greedy, cfg, page, impl, interpret):
+                          all_greedy, cfg, page, impl, interpret,
+                          decode_fwd=None):
     """Up to ``H`` decode steps for every batch row in ONE traced program:
     a ``lax.scan`` over :func:`_paged_decode_forward` (bit-identical
     per-step math) with ON-DEVICE sampling and on-device KV/length
@@ -275,14 +298,22 @@ def _paged_decode_horizon(params, pools, tables, kv_lens, token, active,
     # jax.random.key(p.seed) — the exact call `_choose_token` makes, so
     # any seed the host path accepts, e.g. >= 2**31, streams identically
     # here instead of overflowing an int32 seed array).
+    #
+    # ``decode_fwd`` swaps the per-step forward: the default world-1
+    # paged decode, or serve/mesh.py's TP/SP shard body when the scan
+    # runs inside a mesh engine's shard_map (same signature, sharded
+    # pools) — sampling and the carries stay replicated either way.
+    if decode_fwd is None:
+        decode_fwd = functools.partial(_paged_decode_forward, cfg=cfg,
+                                       page=page, impl=impl,
+                                       interpret=interpret)
     has_eos = eos_ids >= 0
 
     def step(carry, t):
         pools, kv_lens, token, eos_done, counts = carry
         live = active & ~eos_done & (t < limits)
-        pools, logits = _paged_decode_forward(
-            params, pools, tables, kv_lens, token, live, cfg=cfg,
-            page=page, impl=impl, interpret=interpret)
+        pools, logits = decode_fwd(params, pools, tables, kv_lens,
+                                   token, live)
         kv_lens = kv_lens + live.astype(kv_lens.dtype)
         if all_greedy:
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -344,7 +375,8 @@ def _spec_round_fused(params, draft_params, pools, dcaches, tables,
                       kv_lens, active, done, last_logits, dlast_logits,
                       counts, limits, k_rows, base_keys, temps, top_ks,
                       top_ps, greedy, eos_ids, *, K, all_greedy, cfg,
-                      page, impl, interpret, draft_step):
+                      page, impl, interpret, draft_step,
+                      decode_fwd=None, verify_fwd=None):
     """One WHOLE speculative round in ONE traced program — the spec twin
     of :func:`_paged_decode_horizon` (docs/serving.md "Speculative
     decoding").  The unfused round pays 3+k host round trips (k draft
@@ -383,7 +415,20 @@ def _spec_round_fused(params, draft_params, pools, dcaches, tables,
     Returns ``(pools, dcaches, toks [B, K+1], n_emit [B], m [B],
     kv_lens, last_logits, dlast_logits, counts, limits, done)`` — row
     ``b`` emits ``toks[b, :n_emit[b]]``; ``m`` is the raw accept count
-    feeding the adaptive-k window."""
+    feeding the adaptive-k window.
+
+    ``decode_fwd``/``verify_fwd`` swap the target's per-token and
+    multi-token forwards — the world-1 defaults, or serve/mesh.py's
+    head-sharded TP bodies when the round runs inside a mesh engine's
+    shard_map (the draft steps replicated per rank either way)."""
+    if decode_fwd is None:
+        decode_fwd = functools.partial(_paged_decode_forward, cfg=cfg,
+                                       page=page, impl=impl,
+                                       interpret=interpret)
+    if verify_fwd is None:
+        verify_fwd = functools.partial(_paged_verify_forward, cfg=cfg,
+                                       page=page, impl=impl,
+                                       interpret=interpret)
     live = active & ~done & (limits > 0)
     has_eos = eos_ids >= 0
 
@@ -411,9 +456,8 @@ def _spec_round_fused(params, draft_params, pools, dcaches, tables,
     # 2. ONE multi-token verify scores every row's K proposals at its
     # own length (writes land in the row's pages; entries past the
     # allocation are dead padded-table slots pointing at block 0).
-    pools, logits_all = _paged_verify_forward(
-        params, pools, tables, kv_lens, proposals, live, cfg=cfg,
-        page=page, impl=impl, interpret=interpret)
+    pools, logits_all = verify_fwd(params, pools, tables, kv_lens,
+                                   proposals, live)
 
     # 3. On-device accept against the target's own stream.
     allv = jnp.concatenate([last_logits[:, None], logits_all], axis=1)
@@ -443,9 +487,8 @@ def _spec_round_fused(params, draft_params, pools, dcaches, tables,
     kv_mid = kv_lens + jnp.where(live, m_used, 0)
     closing = jnp.take_along_axis(
         expected, jnp.where(live, m_used, 0)[:, None], axis=1)[:, 0]
-    pools, t_logits = _paged_decode_forward(
-        params, pools, tables, kv_mid, closing, cont, cfg=cfg,
-        page=page, impl=impl, interpret=interpret)
+    pools, t_logits = decode_fwd(params, pools, tables, kv_mid,
+                                 closing, cont)
     dcaches, _, d_logits = draft_step(draft_params, dcaches, kv_mid,
                                       closing, cont)
     last_logits = jnp.where(cont[:, None], t_logits, last_logits)
@@ -600,6 +643,8 @@ class ServeEngine:
 
     def __init__(self, gen: Generator, params, *, num_blocks: int,
                  page_size: int, max_batch: int = 8,
+                 mesh=None, tp_axis: str = "tp",
+                 kv_shard: str = "heads",
                  prefill_chunk: int = 64,
                  prefill_budget: Optional[int] = None,
                  bucket_ladder: Optional[list] = None,
@@ -622,13 +667,43 @@ class ServeEngine:
                  prefix_cache: bool = True,
                  trace_level: int = 1, trace_events: int = 4096):
         assert gen.attn.world == 1, (
-            "ServeEngine is world-1 (the per-row block tables are host-"
-            "managed); multi-chip serving keeps Generator.generate's SP "
-            "path")
+            "ServeEngine owns its own mesh placement (pass mesh=/"
+            "tp_axis=/kv_shard= — docs/serving.md 'Sharded serving'); "
+            "the Generator itself must stay world-1 (it only provides "
+            "the model cfg and, off-mesh, the chunked-prefill program)")
         assert not gen.attn.quantized, (
             "paged int8 pools not supported yet (layer-level paged decode "
             "has the same limit)")
         cfg = gen.cfg
+        # mesh serving (docs/serving.md "Sharded serving"): with mesh=,
+        # every device program below is rebuilt as a shard_map over the
+        # tp_axis — TP weights + head-sharded pools (kv_shard="heads")
+        # or replicated weights + block-sharded pools with SP
+        # flash-decode (kv_shard="seq").  Geometry that cannot divide
+        # the mesh is rejected HERE, loudly, instead of as a shape
+        # error inside a traced forward.
+        self.mesh = mesh
+        self.tp_axis = tp_axis
+        self.kv_shard = kv_shard
+        self.mesh_world = 1
+        self._pool_sharding = None
+        if mesh is None and kv_shard not in ("heads", "seq"):
+            # validated even off-mesh: a typo'd layout must not ride
+            # silently until a mesh= is added later
+            raise ValueError(
+                f"kv_shard must be 'heads' or 'seq', got {kv_shard!r}")
+        if mesh is not None:
+            from triton_dist_tpu.serve import mesh as serve_mesh
+
+            self.mesh_world = serve_mesh.validate_mesh_geometry(
+                mesh=mesh, tp_axis=tp_axis, kv_shard=kv_shard, cfg=cfg,
+                max_seq=gen.max_seq, num_blocks=num_blocks,
+                page_size=page_size, spec_k=spec_k)
+            if spec_k and not spec_fused:
+                raise ValueError(
+                    "mesh serving fuses every speculative round into "
+                    "one shard_map dispatch; the legacy unfused round "
+                    "(spec_fused=False) is world-1 only")
         if gen.max_seq % page_size:
             raise ValueError(
                 f"max_seq {gen.max_seq} must divide by page_size "
@@ -664,8 +739,18 @@ class ServeEngine:
         # committed blocks linger in an LRU cache tier until allocation
         # pressure reclaims them.
         self.prefix_cache = bool(prefix_cache)
+        # kv_shard="seq" partitions the block-id space per rank (rank r
+        # owns pool rows [r*NB/W, (r+1)*NB/W) = the pages of its
+        # sequence span); the allocator places every logical page in
+        # its owner's partition and reserves one null block per
+        # partition (serve/block_manager.py).
+        seq_shards = (self.mesh_world
+                      if mesh is not None and kv_shard == "seq" else 1)
         self.bm = BlockManager(num_blocks, page_size, faults=faults,
-                               prefix_cache=self.prefix_cache)
+                               prefix_cache=self.prefix_cache,
+                               shards=seq_shards,
+                               pages_per_shard=self.n_pages_max
+                               // seq_shards)
         self.scheduler = FCFSScheduler(
             self.bm,
             prefill_budget=prefill_budget or 4 * prefill_chunk,
@@ -820,42 +905,90 @@ class ServeEngine:
         # Every jitted program is wrapped for trace-cache accounting
         # (runtime/jit_cache.CountingJit): hit/miss/compile-stall
         # counters ride ServeMetrics onto the TDT_DUMP_IR dump path.
-        self._decode_fn = CountingJit(jax.jit(functools.partial(
-            _paged_decode_forward, cfg=cfg, page=page_size, impl=impl,
-            interpret=interpret), donate_argnums=(1,)), "paged_decode")
-        self._verify_fn = CountingJit(jax.jit(functools.partial(
-            _paged_verify_forward, cfg=cfg, page=page_size, impl=impl,
-            interpret=interpret), donate_argnums=(1,)), "paged_verify")
-        if self.horizon > 1:
-            # One program per (horizon rung, greedy-or-mixed): the scan
-            # length is static, so the ladder bounds the trace count and
-            # warmup() sweeps every rung (the prompt-extent ladder's twin
-            # for the decode side).
-            self._horizon_fn = CountingJit(jax.jit(functools.partial(
-                _paged_decode_horizon, cfg=cfg, page=page_size, impl=impl,
-                interpret=interpret),
-                static_argnames=("H", "all_greedy"),
-                donate_argnums=(1,)), "decode_horizon")
-        # scratch is not donatable (the page reshape transposes it);
-        # pools are — the scatter updates them in place.
-        self._fill_fn = CountingJit(jax.jit(functools.partial(
-            _fill_pool_pages, page=page_size), donate_argnums=(0,)),
-            "fill_pages")
-        # Prefix-cache device programs: the warm-prefill gather (pools
-        # read back into scratch — NOT donated, the pools live on) keyed
-        # by the s_ext rung like fill_pages, and the one-page COW copy
-        # (traced src/dst: one program total).
-        self._load_fn = CountingJit(jax.jit(functools.partial(
-            _gather_pool_pages, page=page_size)), "load_pages")
-        self._cow_fn = CountingJit(jax.jit(
-            _copy_pool_block, donate_argnums=(0,)), "cow_copy")
-        # The Generator's chunked-prefill program; the trace cache lives
-        # on the Generator (shared with prefill_chunked/speculative), the
-        # counters here see this engine's calls.
-        self._chunk_fn = CountingJit(gen._chunk_jit, "prefill_chunk")
+        if mesh is not None:
+            # Mesh placement (docs/serving.md "Sharded serving"): every
+            # program is the SAME traced math rebuilt as a shard_map
+            # body, under the same names/ladders/donation — warmup, the
+            # step loop, and the metrics plumbing below need no mesh
+            # branches.  serve_mesh.ShardedProgram canonicalizes every
+            # argument's sharding at the call seam, so host-built and
+            # device-carried calls share one executable per program
+            # (the PR-7 cache-fork problem, closed for good).
+            from jax.sharding import NamedSharding
+
+            from triton_dist_tpu.serve import mesh as serve_mesh
+
+            progs = serve_mesh.build_programs(
+                mesh=mesh, tp_axis=tp_axis, kv_shard=kv_shard, cfg=cfg,
+                params=params, page_size=page_size,
+                num_blocks=num_blocks, n_pages_max=self.n_pages_max,
+                impl=impl, interpret=interpret, horizon=self.horizon,
+                draft=draft, draft_params=draft_params,
+                spec_fused=bool(spec_k) and self.spec_fused,
+                prefix_cache=self.prefix_cache)
+            self._mesh_progs = progs
+            self._pool_sharding = NamedSharding(mesh, progs["pool_spec"])
+            # Weights live TP-sharded (heads) / replicated (seq) on the
+            # mesh for the engine's lifetime; the pools move onto their
+            # shard layout once, here.
+            self.params = progs["paged_decode"].place(0, params)
+            self._pools = progs["paged_decode"].place(1, self._pools)
+            self._decode_fn = CountingJit(progs["paged_decode"],
+                                          "paged_decode")
+            self._verify_fn = (
+                CountingJit(progs["paged_verify"], "paged_verify")
+                if "paged_verify" in progs else None)
+            if self.horizon > 1:
+                self._horizon_fn = CountingJit(progs["decode_horizon"],
+                                               "decode_horizon")
+            self._fill_fn = CountingJit(progs["fill_pages"],
+                                        "fill_pages")
+            self._load_fn = CountingJit(progs["load_pages"],
+                                        "load_pages")
+            self._cow_fn = CountingJit(progs["cow_copy"], "cow_copy")
+            self._chunk_fn = CountingJit(progs["prefill_chunk"],
+                                         "prefill_chunk")
+        else:
+            self._decode_fn = CountingJit(jax.jit(functools.partial(
+                _paged_decode_forward, cfg=cfg, page=page_size,
+                impl=impl, interpret=interpret), donate_argnums=(1,)),
+                "paged_decode")
+            self._verify_fn = CountingJit(jax.jit(functools.partial(
+                _paged_verify_forward, cfg=cfg, page=page_size,
+                impl=impl, interpret=interpret), donate_argnums=(1,)),
+                "paged_verify")
+            if self.horizon > 1:
+                # One program per (horizon rung, greedy-or-mixed): the
+                # scan length is static, so the ladder bounds the trace
+                # count and warmup() sweeps every rung (the
+                # prompt-extent ladder's twin for the decode side).
+                self._horizon_fn = CountingJit(jax.jit(
+                    functools.partial(
+                        _paged_decode_horizon, cfg=cfg, page=page_size,
+                        impl=impl, interpret=interpret),
+                    static_argnames=("H", "all_greedy"),
+                    donate_argnums=(1,)), "decode_horizon")
+            # scratch is not donatable (the page reshape transposes it);
+            # pools are — the scatter updates them in place.
+            self._fill_fn = CountingJit(jax.jit(functools.partial(
+                _fill_pool_pages, page=page_size), donate_argnums=(0,)),
+                "fill_pages")
+            # Prefix-cache device programs: the warm-prefill gather
+            # (pools read back into scratch — NOT donated, the pools
+            # live on) keyed by the s_ext rung like fill_pages, and the
+            # one-page COW copy (traced src/dst: one program total).
+            self._load_fn = CountingJit(jax.jit(functools.partial(
+                _gather_pool_pages, page=page_size)), "load_pages")
+            self._cow_fn = CountingJit(jax.jit(
+                _copy_pool_block, donate_argnums=(0,)), "cow_copy")
+            # The Generator's chunked-prefill program; the trace cache
+            # lives on the Generator (shared with prefill_chunked/
+            # speculative), the counters here see this engine's calls.
+            self._chunk_fn = CountingJit(gen._chunk_jit, "prefill_chunk")
         for c in (self._chunk_fn, self._fill_fn, self._decode_fn,
                   self._verify_fn):
-            self.metrics.register_compiled(c)
+            if c is not None:
+                self.metrics.register_compiled(c)
         if self.horizon > 1:
             self.metrics.register_compiled(self._horizon_fn)
         if self.prefix_cache:
@@ -896,13 +1029,26 @@ class ServeEngine:
                 prefill_chunk, gen.max_seq - 1,
                 prefill_chunk * page_size
                 // math.gcd(prefill_chunk, page_size))
-            self._draft_chunk_fn = CountingJit(draft._chunk_jit,
-                                               "draft_prefill")
-            # temp caches (arg 3) are NOT donatable: the splice reads a
-            # sliced view of them into the batch caches
-            self._draft_join_fn = CountingJit(
-                jax.jit(_splice_draft_rows, donate_argnums=(0, 1, 2)),
-                "draft_join")
+            if mesh is not None:
+                # On a mesh the draft runs REPLICATED per rank (its
+                # slot-indexed batch caches are whole-batch host-managed
+                # state), but its programs must still be shard_map
+                # bodies so every array stays in one NamedSharding
+                # world — a single-device draft program fed mesh-placed
+                # carries would fork executables and bounce buffers
+                # across placements every round.
+                self._draft_chunk_fn = CountingJit(
+                    self._mesh_progs["draft_prefill"], "draft_prefill")
+                self._draft_join_fn = CountingJit(
+                    self._mesh_progs["draft_join"], "draft_join")
+            else:
+                self._draft_chunk_fn = CountingJit(draft._chunk_jit,
+                                                   "draft_prefill")
+                # temp caches (arg 3) are NOT donatable: the splice
+                # reads a sliced view of them into the batch caches
+                self._draft_join_fn = CountingJit(
+                    jax.jit(_splice_draft_rows, donate_argnums=(0, 1, 2)),
+                    "draft_join")
             if not isinstance(draft._step_jit, CountingJit):
                 # Wrap-once: a draft shared across engines keeps one
                 # counter (re-registered here).
@@ -931,7 +1077,19 @@ class ServeEngine:
             # by warmup); pools (arg 2) and the draft batch caches
             # (arg 3) are donated like every decode-path program.
             self._k_ladder = pow2_ladder(self.spec_k)
-            if self.spec_fused:
+            if self.spec_fused and mesh is not None:
+                # The whole fused round as ONE shard_map body: target
+                # verify/decode legs head-sharded TP, draft replicated,
+                # seeded accept on replicated logits
+                # (serve/mesh.tp_spec_round_shard).
+                self._spec_fused_fn = CountingJit(
+                    self._mesh_progs["spec_round"], "spec_round")
+                self.metrics.register_compiled(self._spec_fused_fn)
+                self._draft_tail_fn = CountingJit(
+                    self._mesh_progs["draft_tail_step"],
+                    "draft_tail_step")
+                self.metrics.register_compiled(self._draft_tail_fn)
+            elif self.spec_fused:
                 # The draft steps inside the trace through the
                 # MESH-FREE _draft_decode_forward (see its docstring:
                 # shard_map-placed carries would fork the executable
@@ -970,13 +1128,22 @@ class ServeEngine:
                      jnp.zeros((num_blocks, dcfg.n_kv_heads, page_size,
                                 dcfg.head_dim), dcfg.dtype))
                     for _ in range(dcfg.n_layers)]
-                self._draft_fill_fn = CountingJit(jax.jit(
-                    functools.partial(_fill_pool_pages, page=page_size),
-                    donate_argnums=(0,)), "draft_fill_pages")
-                self._draft_load_fn = CountingJit(jax.jit(
-                    functools.partial(_gather_pool_pages,
-                                      page=page_size)),
-                    "draft_load_pages")
+                if mesh is not None:
+                    self._draft_fill_fn = CountingJit(
+                        self._mesh_progs["draft_fill_pages"],
+                        "draft_fill_pages")
+                    self._draft_load_fn = CountingJit(
+                        self._mesh_progs["draft_load_pages"],
+                        "draft_load_pages")
+                else:
+                    self._draft_fill_fn = CountingJit(jax.jit(
+                        functools.partial(_fill_pool_pages,
+                                          page=page_size),
+                        donate_argnums=(0,)), "draft_fill_pages")
+                    self._draft_load_fn = CountingJit(jax.jit(
+                        functools.partial(_gather_pool_pages,
+                                          page=page_size)),
+                        "draft_load_pages")
                 self.metrics.register_compiled(self._draft_fill_fn)
                 self.metrics.register_compiled(self._draft_load_fn)
 
@@ -1000,10 +1167,9 @@ class ServeEngine:
             raise ValueError(
                 f"{req.request_id}: prompt + max_new_tokens = {total} "
                 f"exceeds max_seq {self.gen.max_seq}")
-        if self.bm.blocks_for(total) > self.bm.num_allocatable:
-            raise ValueError(
-                f"{req.request_id}: needs {self.bm.blocks_for(total)} "
-                f"blocks, pool has {self.bm.num_allocatable}")
+        fit = self.bm.fit_error(total)
+        if fit is not None:
+            raise ValueError(f"{req.request_id}: {fit}")
         if self.spec_k and not self.spec_fused and not req.params.greedy:
             # The fused round serves sampled rows through the seeded
             # accept chain (docs/serving.md "Speculative decoding");
@@ -1091,6 +1257,18 @@ class ServeEngine:
     def _note_journal(self) -> None:
         self.metrics.journal_records = self._journal.records
         self.metrics.journal_bytes = self._journal.bytes
+
+    def _place_pools(self, pools: list) -> list:
+        """Lay restored/imported pool arrays out on this engine's mesh
+        (no-op off-mesh).  Snapshots hold GLOBAL arrays — orbax
+        assembles them regardless of the writer's mesh — so restore
+        onto a different mesh shape is one ``device_put`` per leaf
+        (docs/serving.md "Sharded serving": recovery across meshes)."""
+        if self._pool_sharding is None:
+            return pools
+        s = self._pool_sharding
+        return [(jax.device_put(k, s), jax.device_put(v, s))
+                for k, v in pools]
 
     def snapshot(self, directory: Optional[str] = None) -> dict:
         """Durably capture the FULL serving state — paged KV pools +
@@ -1426,10 +1604,9 @@ class ServeEngine:
                 rejected[rid] = (f"prompt + max_new_tokens = {total} "
                                  f"exceeds max_seq {self.gen.max_seq}")
                 continue
-            if self.bm.blocks_for(total) > self.bm.num_allocatable:
-                rejected[rid] = (f"needs {self.bm.blocks_for(total)} "
-                                 f"blocks, pool has "
-                                 f"{self.bm.num_allocatable}")
+            fit = self.bm.fit_error(total)
+            if fit is not None:
+                rejected[rid] = fit
                 continue
             if (self.max_queue is not None
                     and self.scheduler.queue_depth >= self.max_queue):

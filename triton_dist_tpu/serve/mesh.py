@@ -1,0 +1,721 @@
+"""Mesh placement for :class:`serve.engine.ServeEngine` — TP weights +
+sharded paged KV under ``shard_map`` (docs/serving.md "Sharded serving").
+
+The engine's device programs (paged decode, multi-token verify, the
+fused decode horizon, chunked prefill, the page scatter/gather/COW
+trio, the fused speculative round) are all parameterized over cache
+addressing and the two weight-reduction seams (``generate._token_forward``
+/ ``_multitoken_forward`` / ``_chunk_forward``'s ``write_kv`` /
+``attend`` / ``ffn`` / ``out_proj`` hooks) — this module instantiates
+them PER-SHARD and wraps each in ``jax.jit(jax.shard_map(...))`` so the
+same engine step loop, scheduler, and block tables drive a multi-chip
+forward.  Two KV layouts:
+
+- ``kv_shard="heads"`` — Megatron-style tensor parallelism: weights
+  shard by ``models.llama.param_specs`` (QKV/up-gate column-parallel,
+  attn-out/down row-parallel + ``psum``), the paged pools shard on the
+  KV-head axis, and each rank runs ``gqa_decode_paged_shard`` over its
+  own heads (attention is head-independent, so no inter-rank combine
+  exists on the attention path).  Supports everything the world-1
+  engine does, speculative rounds included (the draft model runs
+  replicated per rank — its batch caches are slot-indexed host-managed
+  state that must stay whole on every rank).
+- ``kv_shard="seq"`` — SP flash-decode (the reference's headline 1→32
+  scaling, SURVEY.md §5): pools shard on the BLOCK axis, each rank
+  holds the pages of its contiguous sequence span, attention goes
+  through ``sp_gqa_decode_paged_shard`` (per-rank local lengths + the
+  LSE combine) with the rank's slice of the block table rebased to
+  local pool rows.  Weights stay replicated (the decode-serving layout
+  of models/generate.py: the sharded thing is the KV cache).
+  Speculative engines are REJECTED at construction — the paged SP
+  combine only merges single-token partials (the loud assert
+  tests/test_serve_engine.py pins), and a verify chunk is multi-token
+  by definition.
+
+**The executable-cache fork (the PR-7 problem, solved here).**  A
+mesh-placed program's outputs carry ``NamedSharding`` while host-built
+arrays carry single-device placements, and jax's jit cache keys on the
+argument shardings — so one traced program would split into host-built
+vs device-carried executable flavors that ``warmup()`` cannot
+enumerate (the compile-miss counter would tick under traffic).
+:class:`ShardedProgram` therefore CANONICALIZES every argument at the
+call seam: each arg is ``device_put`` onto its declared
+``NamedSharding`` unless it already carries it, so every call of a
+program presents ONE signature and the cache holds exactly one
+executable per (shapes, statics) — ``warmup()`` reaches the same
+compile fixed point as world-1 and the miss counter stays flat.
+
+Bit-exactness note: per-head attention, column-parallel projections and
+the replicated sampling/commit path are arithmetically identical to
+world-1; the row-parallel ``psum`` seams reduce in shard-major order,
+which the oracle tests pin stream-exact on the test models (the same
+standard tests/test_generate.py holds the SP combine to at world 4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.kernels.flash_decode import sp_gqa_decode_paged_shard
+from triton_dist_tpu.models.generate import _chunk_forward, _token_forward
+from triton_dist_tpu.models.llama import param_specs
+
+
+# ---------------------------------------------------------------------------
+# Geometry validation — the loud construction-time rejection matrix
+# ---------------------------------------------------------------------------
+
+
+KV_SHARDS = ("heads", "seq")
+
+
+def validate_mesh_geometry(*, mesh, tp_axis, kv_shard, cfg, max_seq,
+                           num_blocks, page_size, spec_k=0) -> int:
+    """Reject impossible (mesh, engine-geometry) combinations with a
+    loud ``ValueError`` at CONSTRUCTION — the alternative is a shape
+    error deep inside a traced forward, long after the caller can tell
+    which knob was wrong.  Returns the mesh world size along
+    ``tp_axis``."""
+    if tp_axis not in mesh.axis_names:
+        raise ValueError(
+            f"tp_axis {tp_axis!r} is not an axis of the mesh "
+            f"{mesh.axis_names}; ServeEngine shards over exactly one "
+            f"named mesh axis")
+    if kv_shard not in KV_SHARDS:
+        raise ValueError(
+            f"kv_shard must be one of {KV_SHARDS}, got {kv_shard!r}")
+    world = int(mesh.shape[tp_axis])
+    if world < 1:
+        raise ValueError(f"mesh axis {tp_axis!r} has size {world}")
+    if kv_shard == "heads":
+        if cfg.n_kv_heads % world:
+            raise ValueError(
+                f"kv_shard='heads' needs n_kv_heads ({cfg.n_kv_heads}) "
+                f"divisible by the mesh world ({world}) — each rank "
+                f"must own whole KV heads of the paged pools")
+        if cfg.n_heads % world:
+            raise ValueError(
+                f"kv_shard='heads' needs n_heads ({cfg.n_heads}) "
+                f"divisible by the mesh world ({world}) — the "
+                f"column-parallel QKV split assigns whole query heads "
+                f"per rank")
+        if cfg.ffn_dim % world:
+            raise ValueError(
+                f"TP weights need ffn_dim ({cfg.ffn_dim}) divisible by "
+                f"the mesh world ({world}) — wgate/wup shard by "
+                f"columns, wdown by rows")
+    else:  # seq
+        if spec_k:
+            raise ValueError(
+                "kv_shard='seq' cannot serve speculative engines: the "
+                "paged SP decode combine merges SINGLE-token partials "
+                "only (sp_gqa_decode_paged_shard's 3D-q contract), and "
+                "a verify chunk is multi-token by definition — use "
+                "kv_shard='heads' for spec serving on a mesh")
+        n_pages = max_seq // page_size
+        if n_pages % world:
+            raise ValueError(
+                f"kv_shard='seq' needs max_seq/page_size ({n_pages} "
+                f"logical pages) divisible by the mesh world ({world}) "
+                f"— each rank owns a contiguous span of "
+                f"{n_pages}//{world} logical pages")
+        if num_blocks % world:
+            raise ValueError(
+                f"kv_shard='seq' needs num_blocks ({num_blocks}) "
+                f"divisible by the mesh world ({world}) — the pool "
+                f"splits into equal per-rank partitions")
+        if num_blocks // world < 2:
+            raise ValueError(
+                f"kv_shard='seq' needs num_blocks//world >= 2 "
+                f"({num_blocks}//{world} = {num_blocks // world}): "
+                f"every partition reserves its own null block and "
+                f"still needs at least one allocatable page")
+    return world
+
+
+@dataclasses.dataclass(frozen=True)
+class _ShardCfg:
+    """The per-shard config view the shared forwards see under TP:
+    LOCAL head counts with the GLOBAL ``head_dim``/``dim`` — a plain
+    ``dataclasses.replace(cfg, n_heads=...)`` would silently corrupt
+    ``LlamaConfig.head_dim`` (a ``dim // n_heads`` property), so the
+    fields the forwards read are pinned explicitly here."""
+
+    n_heads: int
+    n_kv_heads: int
+    head_dim: int
+    dim: int
+    norm_eps: float
+    rope_theta: float
+    dtype: object
+    attn_window: int
+    attn_soft_cap: float
+
+
+def _local_cfg(cfg, world: int):
+    """The per-shard view of a TP-sharded model: local head counts (the
+    shared forwards reshape QKV by ``cfg.n_heads``/``n_kv_heads``, and
+    each rank's column shards hold exactly ``1/world`` of the heads).
+    Everything else — dim, head_dim, norms, rope — stays global."""
+    return _ShardCfg(n_heads=cfg.n_heads // world,
+                     n_kv_heads=cfg.n_kv_heads // world,
+                     head_dim=cfg.head_dim, dim=cfg.dim,
+                     norm_eps=cfg.norm_eps, rope_theta=cfg.rope_theta,
+                     dtype=cfg.dtype, attn_window=cfg.attn_window,
+                     attn_soft_cap=cfg.attn_soft_cap)
+
+
+# ---------------------------------------------------------------------------
+# The two TP reduction seams (generate.py's ffn / out_proj hooks)
+# ---------------------------------------------------------------------------
+
+
+def _tp_out_proj(o2, layer, *, axis):
+    """Row-parallel attention output projection: each rank contracts its
+    local head columns against its ``wo`` row shard, ``psum`` completes
+    the sum — ``generate._default_out_proj`` with the contraction split
+    across ranks."""
+    return jax.lax.psum(o2 @ layer["wo"], axis)
+
+
+def _tp_ffn(h2, layer, *, axis):
+    """Megatron MLP: column-parallel gate/up on the replicated
+    activations, row-parallel down + ``psum`` — the same SwiGLU math as
+    ``generate._dense_prompt_ffn`` over the local feature shard."""
+    act = (jax.nn.silu((h2 @ layer["wgate"]).astype(jnp.float32))
+           .astype(h2.dtype) * (h2 @ layer["wup"]))
+    return jax.lax.psum(act @ layer["wdown"], axis)
+
+
+# ---------------------------------------------------------------------------
+# Per-shard forward bodies (call inside shard_map)
+# ---------------------------------------------------------------------------
+
+
+def tp_paged_decode_shard(params, pools, tables, kv_lens, token, active,
+                          *, cfg, page, axis, world, impl, interpret):
+    """Head-sharded twin of ``engine._paged_decode_forward``: QKV
+    project onto the rank's head columns, the K/V scatter lands in the
+    rank's pool shard, attention runs ``gqa_decode_paged_shard`` over
+    the local heads (no combine — heads are independent), and the
+    output/FFN row-parallel matmuls ``psum``.  ``tables``/``kv_lens``
+    are replicated (the host-managed index is global); the returned
+    logits are replicated, so sampling and commit stay bit-identical to
+    the world-1 path.  The block-table addressing is the ENGINE's own
+    forward — this only supplies the TP seams (local-head cfg + psum
+    hooks), so the addressing can never diverge between world-1 and
+    mesh."""
+    from triton_dist_tpu.serve.engine import _paged_decode_forward
+
+    return _paged_decode_forward(
+        params, pools, tables, kv_lens, token, active, cfg=cfg,
+        page=page, impl=impl, interpret=interpret,
+        fwd_cfg=_local_cfg(cfg, world),
+        ffn=functools.partial(_tp_ffn, axis=axis),
+        out_proj=functools.partial(_tp_out_proj, axis=axis))
+
+
+def tp_paged_verify_shard(params, pools, tables, kv_lens, chunk, active,
+                          *, cfg, page, axis, world, impl, interpret):
+    """Head-sharded twin of ``engine._paged_verify_forward`` — the
+    multi-token verify under shard_map; like the decode twin, the
+    engine's own forward with the TP seams supplied."""
+    from triton_dist_tpu.serve.engine import _paged_verify_forward
+
+    return _paged_verify_forward(
+        params, pools, tables, kv_lens, chunk, active, cfg=cfg,
+        page=page, impl=impl, interpret=interpret,
+        fwd_cfg=_local_cfg(cfg, world),
+        ffn=functools.partial(_tp_ffn, axis=axis),
+        out_proj=functools.partial(_tp_out_proj, axis=axis))
+
+
+def _rebase_local(ids, *, axis, world, num_blocks):
+    """THE global→local block-id rebase of the seq layout, shared by
+    every per-shard body that touches the pools: rank ``r`` owns global
+    blocks ``[r*nb_loc, (r+1)*nb_loc)``; returns ``(mine, local)``
+    where foreign/padded ids (another rank's blocks, the global null)
+    map to local row 0 — the rank's own reserved null, so a non-owner's
+    write or copy degenerates to a null self-touch exactly like an
+    inactive row's."""
+    nb_loc = num_blocks // world
+    lo = jax.lax.axis_index(axis) * nb_loc
+    mine = (ids >= lo) & (ids < lo + nb_loc)
+    return mine, jnp.where(mine, ids - lo, 0)
+
+
+def sp_paged_decode_shard(params, pools, tables, kv_lens, token, active,
+                          *, cfg, page, axis, world, num_blocks,
+                          n_pages_max, impl, interpret):
+    """Sequence-sharded twin of ``engine._paged_decode_forward``:
+    weights replicated, pools sharded on the BLOCK axis — rank ``r``
+    holds global blocks ``[r*nb_loc, (r+1)*nb_loc)``, which the
+    partitioned :class:`serve.block_manager.BlockManager` dedicates to
+    the logical pages of rank ``r``'s sequence span.  The block table
+    is global; each rank slices its span and rebases the ids to local
+    pool rows (foreign/padded entries — including another rank's
+    blocks and the global null — map to local row 0, the rank's own
+    reserved null).  Attention goes through
+    ``sp_gqa_decode_paged_shard`` (local lengths + LSE combine), so
+    the returned logits are replicated."""
+    from triton_dist_tpu.serve.engine import _page_slots, _scatter_kv
+
+    n_loc = n_pages_max // world
+    inc = active.astype(kv_lens.dtype)
+
+    # The next write's physical slot, rebased: only the owning rank
+    # writes the real row; everyone else's write redirects to ITS null
+    # (local row 0) exactly like an inactive row.
+    pool_row_g, in_page = _page_slots(tables, kv_lens, active, page=page)
+    mine, pool_row = _rebase_local(pool_row_g, axis=axis, world=world,
+                                   num_blocks=num_blocks)
+    mine = mine & active
+    pool_row = jnp.where(mine, pool_row, 0)
+    in_page = jnp.where(mine, in_page, 0)
+
+    def write_kv(li, pool, k, v):
+        return _scatter_kv(pool, k, v, pool_row, in_page)
+
+    me = jax.lax.axis_index(axis)
+    lt = jax.lax.dynamic_slice_in_dim(tables, me * n_loc, n_loc, axis=1)
+    _, lt = _rebase_local(lt, axis=axis, world=world,
+                          num_blocks=num_blocks)
+
+    def attend(li, q, pool):
+        return sp_gqa_decode_paged_shard(
+            q, pool[0], pool[1], lt, kv_lens + inc, axis=axis,
+            impl=impl, interpret=interpret, soft_cap=cfg.attn_soft_cap,
+            window=cfg.attn_window)
+
+    return _token_forward(params, pools, token, kv_lens, cfg=cfg,
+                          write_kv=write_kv, attend=attend)
+
+
+def tp_paged_decode_horizon_shard(params, pools, tables, kv_lens, token,
+                                  active, eos_done, limits, counts,
+                                  base_keys, temps, top_ks, top_ps,
+                                  greedy, eos_ids, *, H, all_greedy, cfg,
+                                  page, axis, world, impl, interpret):
+    """The fused decode horizon under shard_map (heads): the engine's
+    ``_paged_decode_horizon`` scan with the TP per-step forward swapped
+    in — on-device sampling and every carry stay replicated, so the
+    token bursts are bit-identical to the world-1 scan."""
+    from triton_dist_tpu.serve.engine import _paged_decode_horizon
+
+    fwd = functools.partial(tp_paged_decode_shard, cfg=cfg, page=page,
+                            axis=axis, world=world, impl=impl,
+                            interpret=interpret)
+    return _paged_decode_horizon(
+        params, pools, tables, kv_lens, token, active, eos_done, limits,
+        counts, base_keys, temps, top_ks, top_ps, greedy, eos_ids, H=H,
+        all_greedy=all_greedy, cfg=cfg, page=page, impl=impl,
+        interpret=interpret, decode_fwd=fwd)
+
+
+def sp_paged_decode_horizon_shard(params, pools, tables, kv_lens, token,
+                                  active, eos_done, limits, counts,
+                                  base_keys, temps, top_ks, top_ps,
+                                  greedy, eos_ids, *, H, all_greedy, cfg,
+                                  page, axis, world, num_blocks,
+                                  n_pages_max, impl, interpret):
+    """The fused decode horizon over sequence-sharded pools: the same
+    scan with the SP per-step forward (local spans + LSE combine)."""
+    from triton_dist_tpu.serve.engine import _paged_decode_horizon
+
+    fwd = functools.partial(sp_paged_decode_shard, cfg=cfg, page=page,
+                            axis=axis, world=world,
+                            num_blocks=num_blocks,
+                            n_pages_max=n_pages_max, impl=impl,
+                            interpret=interpret)
+    return _paged_decode_horizon(
+        params, pools, tables, kv_lens, token, active, eos_done, limits,
+        counts, base_keys, temps, top_ks, top_ps, greedy, eos_ids, H=H,
+        all_greedy=all_greedy, cfg=cfg, page=page, impl=impl,
+        interpret=interpret, decode_fwd=fwd)
+
+
+def tp_spec_round_shard(params, draft_params, pools, dcaches, tables,
+                        kv_lens, active, done, last_logits, dlast_logits,
+                        counts, limits, k_rows, base_keys, temps, top_ks,
+                        top_ps, greedy, eos_ids, *, K, all_greedy, cfg,
+                        dcfg, page, axis, world, impl, interpret,
+                        dimpl, dinterpret):
+    """The whole fused speculative round under shard_map (heads): the
+    target's verify + decode legs run head-sharded TP, the draft steps
+    REPLICATED per rank (its slot-indexed batch caches are host-managed
+    whole-batch state — sharding them would put the accept chain's
+    inputs behind a gather), and the seeded accept/sampling math runs on
+    replicated logits — bit-identical emissions per rank."""
+    from triton_dist_tpu.serve.engine import (
+        _draft_decode_forward,
+        _spec_round_fused,
+    )
+
+    decode_fwd = functools.partial(tp_paged_decode_shard, cfg=cfg,
+                                   page=page, axis=axis, world=world,
+                                   impl=impl, interpret=interpret)
+    verify_fwd = functools.partial(tp_paged_verify_shard, cfg=cfg,
+                                   page=page, axis=axis, world=world,
+                                   impl=impl, interpret=interpret)
+    draft_step = functools.partial(_draft_decode_forward, cfg=dcfg,
+                                   impl=dimpl, interpret=dinterpret)
+    return _spec_round_fused(
+        params, draft_params, pools, dcaches, tables, kv_lens, active,
+        done, last_logits, dlast_logits, counts, limits, k_rows,
+        base_keys, temps, top_ks, top_ps, greedy, eos_ids, K=K,
+        all_greedy=all_greedy, cfg=cfg, page=page, impl=impl,
+        interpret=interpret, draft_step=draft_step,
+        decode_fwd=decode_fwd, verify_fwd=verify_fwd)
+
+
+def tp_chunk_forward_shard(params, chunk, caches, prefix_len, n_valid, *,
+                           cfg, extent, axis, world, impl, interpret):
+    """Head-sharded chunked prefill: ``generate._chunk_forward`` with
+    the local-head cfg and the TP reduction hooks — each rank computes
+    its head columns of the chunk's K/V into its shard of the prefill
+    scratch, attention runs per-head over the local scratch, and the
+    out-proj/FFN seams ``psum``.  ``mesh``/``axis`` stay None inside:
+    the per-rank scratch is head-local, never sequence-sharded."""
+    return _chunk_forward(
+        params, chunk, caches, prefix_len, cfg=_local_cfg(cfg, world),
+        quantized=False, ffn=functools.partial(_tp_ffn, axis=axis),
+        out_proj=functools.partial(_tp_out_proj, axis=axis),
+        extent=extent, n_valid=n_valid, impl=impl, interpret=interpret)
+
+
+def rep_chunk_forward_shard(params, chunk, caches, prefix_len, n_valid,
+                            *, cfg, extent, impl, interpret):
+    """Replicated chunked prefill (the seq layout, and the draft model
+    under a heads mesh): every rank runs the identical world-1 chunk
+    forward — prefill compute does not shard here, only the page
+    scatter downstream does (kv_shard='seq' exists for the DECODE
+    attention scaling; docs/serving.md records the trade)."""
+    return _chunk_forward(params, chunk, caches, prefix_len, cfg=cfg,
+                          quantized=False, extent=extent, n_valid=n_valid,
+                          impl=impl, interpret=interpret)
+
+
+# -- page scatter / gather / COW over sharded pools -------------------------
+
+
+def sp_fill_pool_pages_shard(pools, scratch, ids, *, page, axis, world,
+                             num_blocks):
+    """Sequence-sharded page scatter: ``ids`` are GLOBAL block ids per
+    scratch page; each rank rebases its own ids to local pool rows and
+    scatters only those pages — foreign and padded entries land in the
+    rank's local null (row 0), exactly where world-1 scatters its
+    padding."""
+    from triton_dist_tpu.serve.engine import _fill_pool_pages
+
+    _, loc = _rebase_local(ids, axis=axis, world=world,
+                           num_blocks=num_blocks)
+    return _fill_pool_pages(pools, scratch, loc, page=page)
+
+
+def sp_gather_pool_pages_shard(pools, ids, *, page, axis, world,
+                               num_blocks):
+    """Sequence-sharded page gather (the warm-prefix / drain read-back):
+    each rank gathers its own pages into the replicated scratch layout,
+    zeroes the rows it does not own, and a ``psum`` assembles the full
+    scratch — every row has exactly one owner, so the sum is exact
+    (adding zeros never perturbs floats)."""
+    from triton_dist_tpu.serve.engine import _gather_pool_pages
+
+    mine, loc = _rebase_local(ids, axis=axis, world=world,
+                              num_blocks=num_blocks)
+    sc = _gather_pool_pages(pools, loc, page=page)
+    rows = jnp.repeat(mine, page)[None, None, :, None]
+    sc = [(jnp.where(rows, k, jnp.zeros((), k.dtype)),
+           jnp.where(rows, v, jnp.zeros((), v.dtype))) for k, v in sc]
+    return jax.lax.psum(sc, axis)
+
+
+def sp_copy_pool_block_shard(pools, src, dst, *, axis, world, num_blocks):
+    """Sequence-sharded COW page copy: the partitioned allocator keeps
+    both halves of a split in one partition, so exactly the owning rank
+    copies (everyone else degenerates to a null→null self-copy)."""
+    from triton_dist_tpu.serve.engine import _copy_pool_block
+
+    _, s = _rebase_local(src, axis=axis, world=world,
+                         num_blocks=num_blocks)
+    # the allocator keeps both halves of a split in one partition, so
+    # dst rebases under the same ownership (foreign ranks get 0 -> 0)
+    _, d = _rebase_local(dst, axis=axis, world=world,
+                         num_blocks=num_blocks)
+    return _copy_pool_block(pools, s, d)
+
+
+# ---------------------------------------------------------------------------
+# ShardedProgram — jit(shard_map) + canonical argument placement
+# ---------------------------------------------------------------------------
+
+
+def _place(x, sharding):
+    """Commit ``x`` onto ``sharding`` unless it already carries it —
+    the one-signature-per-program guarantee (module docstring)."""
+    if isinstance(x, jax.Array) and x.sharding == sharding:
+        return x
+    return jax.device_put(x, sharding)
+
+
+def _shardings_of(mesh, spec_tree):
+    """PartitionSpec tree → NamedSharding tree (specs are pytrees of
+    tuples, so they must be treated as leaves)."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), spec_tree,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+class ShardedProgram:
+    """One engine device program on a mesh: ``jax.jit(jax.shard_map(
+    body))`` with per-argument canonical placement and a bounded
+    static-kwargs ladder.
+
+    - Positional args are pytrees matched leaf-wise against
+      ``in_specs``; every leaf is ``device_put`` to its declared
+      ``NamedSharding`` unless already there — host-built and
+      device-carried calls hit the SAME executable (the PR-7 cache-fork
+      fix; module docstring).
+    - Keyword args are STATIC trace parameters (the horizon's ``H``,
+      the spec round's ``K``, ...): each distinct combination memoizes
+      one jitted closure, exactly like ``static_argnames`` — and
+      ``_cache_size()`` sums the inner caches so ``CountingJit``'s
+      hit/miss accounting (and warmup's fixed-point test) keep working
+      unchanged.
+    - ``donate_argnums`` applies to the placed arrays; the engine
+      already reassigns donated carries from the outputs.
+    """
+
+    def __init__(self, body, mesh, in_specs, out_specs, *,
+                 donate_argnums=()):
+        self.body = body
+        self.mesh = mesh
+        self.in_specs = tuple(in_specs)
+        self.out_specs = out_specs
+        self.donate_argnums = tuple(donate_argnums)
+        self._placements = tuple(_shardings_of(mesh, s)
+                                 for s in self.in_specs)
+        self._jits: dict = {}
+
+    def _prog(self, statics: tuple):
+        prog = self._jits.get(statics)
+        if prog is None:
+            fn = (functools.partial(self.body, **dict(statics))
+                  if statics else self.body)
+            prog = jax.jit(
+                jax.shard_map(fn, mesh=self.mesh, in_specs=self.in_specs,
+                              out_specs=self.out_specs, check_vma=False),
+                donate_argnums=self.donate_argnums)
+            self._jits[statics] = prog
+        return prog
+
+    def place(self, i: int, value):
+        """Canonical placement of argument ``i`` (exposed so the engine
+        can pre-place long-lived carries like the pools at init)."""
+        return jax.tree_util.tree_map(_place, value, self._placements[i])
+
+    def __call__(self, *args, **statics):
+        placed = tuple(
+            jax.tree_util.tree_map(_place, a, p)
+            for a, p in zip(args, self._placements))
+        return self._prog(tuple(sorted(statics.items())))(*placed)
+
+    def _cache_size(self) -> int:
+        # CountingJit keys its miss accounting on this (a fresh static
+        # rung AND a fresh signature within a rung both count — the
+        # same events a plain jit's cache growth reports).
+        return sum(p._cache_size() for p in self._jits.values())
+
+
+class MeshChunkJit:
+    """The mesh chunk-prefill program behind ``Generator._chunk_jit``'s
+    call convention (``(params, buf, scratch, prefix, *, quantized,
+    extent, n_valid)`` with ``quantized``/``extent`` static and
+    ``n_valid`` traced): one :class:`ShardedProgram` per extent rung,
+    ``n_valid`` folded into the positional args."""
+
+    def __init__(self, maker):
+        self._maker = maker     # extent -> ShardedProgram
+        self._progs: dict = {}
+
+    def __call__(self, params, buf, scratch, prefix, *, quantized,
+                 extent, n_valid):
+        assert not quantized, "mesh serving keeps float KV pools"
+        prog = self._progs.get(extent)
+        if prog is None:
+            prog = self._maker(extent)
+            self._progs[extent] = prog
+        return prog(params, buf, scratch, prefix, n_valid)
+
+    def _cache_size(self) -> int:
+        return sum(p._cache_size() for p in self._progs.values())
+
+
+# ---------------------------------------------------------------------------
+# Program construction (the engine's mesh-mode __init__ calls this)
+# ---------------------------------------------------------------------------
+
+
+def replicated_like(tree):
+    """All-``P()`` spec tree matching ``tree``'s structure."""
+    return jax.tree_util.tree_map(lambda _: P(), tree)
+
+
+def build_programs(*, mesh, tp_axis, kv_shard, cfg, params, page_size,
+                   num_blocks, n_pages_max, impl, interpret,
+                   horizon: int, draft=None, draft_params=None,
+                   spec_fused: bool = False,
+                   prefix_cache: bool = False) -> dict:
+    """All mesh device programs for one engine, keyed by the engine's
+    program names (``paged_decode``, ``paged_verify``, ``fill_pages``,
+    ``load_pages``, ``cow_copy``, ``decode_horizon``, ``prefill_chunk``
+    — plus the draft family on spec engines).  Shapes/donation mirror
+    the world-1 programs exactly, so warmup, metrics, and the step loop
+    need no mesh-specific branches past construction."""
+    axis = tp_axis
+    world = int(mesh.shape[axis])
+    heads = kv_shard == "heads"
+    pool_spec = P(None, axis) if heads else P(axis)
+    pools_specs = [(pool_spec, pool_spec)] * cfg.n_layers
+    p_specs = param_specs(cfg, axis) if heads else replicated_like(params)
+    scratch_spec = P(None, axis) if heads else P()
+
+    out = {"pool_spec": pool_spec, "params_specs": p_specs, "world": world}
+
+    if heads:
+        decode_body = functools.partial(
+            tp_paged_decode_shard, cfg=cfg, page=page_size, axis=axis,
+            world=world, impl=impl, interpret=interpret)
+        verify_body = functools.partial(
+            tp_paged_verify_shard, cfg=cfg, page=page_size, axis=axis,
+            world=world, impl=impl, interpret=interpret)
+        horizon_body = functools.partial(
+            tp_paged_decode_horizon_shard, cfg=cfg, page=page_size,
+            axis=axis, world=world, impl=impl, interpret=interpret)
+        fill_body = functools.partial(
+            __import_engine()._fill_pool_pages, page=page_size)
+        load_body = functools.partial(
+            __import_engine()._gather_pool_pages, page=page_size)
+        cow_body = __import_engine()._copy_pool_block
+        chunk_body = functools.partial(
+            tp_chunk_forward_shard, cfg=cfg, axis=axis, world=world,
+            impl=impl, interpret=interpret)
+    else:
+        decode_body = functools.partial(
+            sp_paged_decode_shard, cfg=cfg, page=page_size, axis=axis,
+            world=world, num_blocks=num_blocks, n_pages_max=n_pages_max,
+            impl=impl, interpret=interpret)
+        verify_body = None  # rejected at construction (spec x seq)
+        horizon_body = functools.partial(
+            sp_paged_decode_horizon_shard, cfg=cfg, page=page_size,
+            axis=axis, world=world, num_blocks=num_blocks,
+            n_pages_max=n_pages_max, impl=impl, interpret=interpret)
+        fill_body = functools.partial(
+            sp_fill_pool_pages_shard, page=page_size, axis=axis,
+            world=world, num_blocks=num_blocks)
+        load_body = functools.partial(
+            sp_gather_pool_pages_shard, page=page_size, axis=axis,
+            world=world, num_blocks=num_blocks)
+        cow_body = functools.partial(
+            sp_copy_pool_block_shard, axis=axis, world=world,
+            num_blocks=num_blocks)
+        chunk_body = functools.partial(
+            rep_chunk_forward_shard, cfg=cfg, impl=impl,
+            interpret=interpret)
+
+    # (params, pools, tables, kv_lens, token/chunk, active)
+    fwd_in = (p_specs, pools_specs, P(), P(), P(), P())
+    out["paged_decode"] = ShardedProgram(
+        decode_body, mesh, fwd_in, (pools_specs, P()),
+        donate_argnums=(1,))
+    if verify_body is not None:
+        out["paged_verify"] = ShardedProgram(
+            verify_body, mesh, fwd_in, (pools_specs, P()),
+            donate_argnums=(1,))
+    if horizon > 1:
+        out["decode_horizon"] = ShardedProgram(
+            horizon_body, mesh,
+            (p_specs, pools_specs) + (P(),) * 13,
+            (pools_specs,) + (P(),) * 6, donate_argnums=(1,))
+    out["fill_pages"] = ShardedProgram(
+        fill_body, mesh,
+        (pools_specs, [(scratch_spec, scratch_spec)] * cfg.n_layers, P()),
+        pools_specs, donate_argnums=(0,))
+    out["load_pages"] = ShardedProgram(
+        load_body, mesh, (pools_specs, P()),
+        [(scratch_spec, scratch_spec)] * cfg.n_layers)
+    out["cow_copy"] = ShardedProgram(
+        cow_body, mesh, (pools_specs, P(), P()), pools_specs,
+        donate_argnums=(0,))
+
+    def make_chunk(extent: int) -> ShardedProgram:
+        return ShardedProgram(
+            functools.partial(chunk_body, extent=extent), mesh,
+            (p_specs, P(),
+             [(scratch_spec, scratch_spec)] * cfg.n_layers, P(), P()),
+            ([(scratch_spec, scratch_spec)] * cfg.n_layers, P()),
+            donate_argnums=(2,))
+
+    out["prefill_chunk"] = MeshChunkJit(make_chunk)
+
+    if draft is not None and spec_fused:
+        dcfg = draft.cfg
+        d_specs = replicated_like(draft_params)
+        dpools_specs = [(P(), P())] * dcfg.n_layers
+        spec_body = functools.partial(
+            tp_spec_round_shard, cfg=cfg, dcfg=dcfg, page=page_size,
+            axis=axis, world=world, impl=impl, interpret=interpret,
+            dimpl=draft.attn.ctx.impl, dinterpret=draft.attn.ctx.interpret)
+        out["spec_round"] = ShardedProgram(
+            spec_body, mesh,
+            (p_specs, d_specs, pools_specs, dpools_specs)
+            + (P(),) * 15,
+            (pools_specs, dpools_specs) + (P(),) * 9,
+            donate_argnums=(2, 3))
+        tail_body = functools.partial(
+            __import_engine()._draft_decode_forward, cfg=dcfg,
+            impl=draft.attn.ctx.impl, interpret=draft.attn.ctx.interpret)
+        out["draft_tail_step"] = ShardedProgram(
+            tail_body, mesh, (d_specs, dpools_specs, P(), P(), P()),
+            (dpools_specs, P(), P()), donate_argnums=(1,))
+        out["draft_join"] = ShardedProgram(
+            __import_engine()._splice_draft_rows, mesh,
+            (dpools_specs, P(), P(),
+             [(P(), P())] * dcfg.n_layers, P(), P(), P()),
+            (dpools_specs, P(), P()), donate_argnums=(0, 1, 2))
+        dchunk_body = functools.partial(
+            rep_chunk_forward_shard, cfg=dcfg,
+            impl=draft.attn.ctx.impl, interpret=draft.attn.ctx.interpret)
+
+        def make_draft_chunk(extent: int) -> ShardedProgram:
+            return ShardedProgram(
+                functools.partial(dchunk_body, extent=extent), mesh,
+                (d_specs, P(), [(P(), P())] * dcfg.n_layers, P(), P()),
+                ([(P(), P())] * dcfg.n_layers, P()), donate_argnums=(2,))
+
+        out["draft_prefill"] = MeshChunkJit(make_draft_chunk)
+        if prefix_cache:
+            out["draft_fill_pages"] = ShardedProgram(
+                functools.partial(__import_engine()._fill_pool_pages,
+                                  page=page_size), mesh,
+                (dpools_specs, [(P(), P())] * dcfg.n_layers, P()),
+                dpools_specs, donate_argnums=(0,))
+            out["draft_load_pages"] = ShardedProgram(
+                functools.partial(__import_engine()._gather_pool_pages,
+                                  page=page_size), mesh,
+                (dpools_specs, P()), [(P(), P())] * dcfg.n_layers)
+    return out
+
+
+def __import_engine():
+    """Deferred engine import: engine.py imports this module inside its
+    constructor, so a module-level back-import would be circular."""
+    from triton_dist_tpu.serve import engine
+
+    return engine

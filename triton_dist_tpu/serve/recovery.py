@@ -460,6 +460,22 @@ def _capture_meta(engine, now: float, *, journal_here: bool) -> dict:
         "vocab": cfg.vocab,
         "kv_dtype": str(np.dtype(cfg.dtype)),
     }
+    if engine.mesh is not None:
+        # Mesh/sharding spec (docs/serving.md "Sharded serving"):
+        # recorded so operators (and the fleet controller) can see what
+        # layout produced a snapshot — restore does NOT require it.
+        # Pools are saved as GLOBAL arrays (orbax assembles shards), so
+        # a snapshot restores onto ANY mesh shape: the restoring
+        # engine's own mesh= override decides the new layout, pools are
+        # re-laid-out by one device_put, and block tables that violate
+        # the new partition placement (seq layouts of a different
+        # world) re-queue through exact recompute.  Tolerated absent by
+        # every reader (pre-mesh snapshots restore fine).
+        eng_meta["mesh"] = {
+            "world": engine.mesh_world,
+            "axis": engine.tp_axis,
+            "kv_shard": engine.kv_shard,
+        }
     if engine.spec_k and not engine._spec_off:
         # Draft-state geometry: the snapshot reader needs it to build
         # abstract targets for the draft arrays in the pool tree, and
@@ -765,7 +781,10 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
                     new_pools.append(
                         (k.at[:n_copy].set(jnp.asarray(ko)[:n_copy]),
                          v.at[:n_copy].set(jnp.asarray(vo)[:n_copy])))
-            engine._pools = new_pools
+            # One device_put per leaf lays the (global) restored pools
+            # out on the restoring engine's mesh — restore across mesh
+            # shapes is exactly this re-layout (no-op off-mesh).
+            engine._pools = engine._place_pools(new_pools)
             pools_ok = True
 
     # -- spec device state: draft caches + round-opening logits -----------
@@ -990,6 +1009,12 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
             return False
         if any(b >= engine.bm.num_blocks for b in table):
             return False  # shrunk pool: those rows don't exist any more
+        if not engine.bm.placement_ok(table):
+            # A table snapshotted under a different mesh shape
+            # (kv_shard='seq' partitions moved): the pages' bytes are
+            # in the restored pools but in the WRONG ranks' partitions
+            # — recompute, exactly like a shrunk-geometry restore.
+            return False
         total = int(r["prompt"].shape[0]) + r["params"].max_new_tokens
         return total <= engine.gen.max_seq
 
@@ -1080,7 +1105,7 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
         total = int(r["prompt"].shape[0]) + r["params"].max_new_tokens
         rs = build_state(rid)
         if (total > engine.gen.max_seq
-                or engine.bm.blocks_for(total) > engine.bm.num_allocatable):
+                or engine.bm.fit_error(total) is not None):
             # The restored geometry can NEVER serve this request; parking
             # it in the queue would wedge FCFS admission forever.
             rs.status = Status.FINISHED
