@@ -519,6 +519,39 @@ def _bench_serve_mesh():
     return r["serve_mesh_zero_loss"], r["mesh_toks_per_s"]
 
 
+def _bench_kernel_report():
+    """Kernel overlap scoreboard (scripts/kernel_report.py, ISSUE 14):
+    the ag_gemm fused/compute-only/comm-only legs + phase-sliced
+    per-ring-step replay on a FORCED 2-device host mesh, reporting
+    overlap efficiency ``(T_compute + T_comm) / T_fused`` and the
+    perf_model model-vs-measured ratio.  INFORMATIONAL on CPU (the
+    fused kernel takes its XLA fallback and the model's rate tables
+    describe a TPU) — the artifact records the schedule decomposition
+    so a hardware session reads the same fields against real rates.
+    Runs as a subprocess like the mesh leg: the device count is fixed
+    at backend init.  Returns (overlap_efficiency,
+    model_vs_measured)."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    from triton_dist_tpu.runtime.testenv import virtual_mesh_env
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = subprocess.run(
+        [_sys.executable,
+         os.path.join(here, "scripts", "kernel_report.py"),
+         "--cpu", "2", "--kernel", "ag_gemm", "-M", "512", "-K", "256",
+         "--n-loc", "128"],
+        capture_output=True, text=True, timeout=900, cwd=here,
+        env=virtual_mesh_env(n_devices=2))
+    assert out.returncode == 0, out.stderr[-2000:]
+    r = json.loads([ln for ln in out.stdout.splitlines()
+                    if ln.startswith("{")][-1])
+    k = r["kernels"]["ag_gemm"]
+    return k["overlap_efficiency"], k["model_vs_measured"]
+
+
 def _environment_provenance(contended: bool) -> dict:
     """Environment stamp for the bench artifact (ROADMAP #5b
     follow-through, docs/perf.md 'Bench trajectory'): the absolute
@@ -598,6 +631,7 @@ def main():
     fleet_net_zero_loss = _bench_serve_fleet_net()
     fleet_trace_overhead = _bench_serve_fleet_trace()
     mesh_zero_loss, mesh_tps = _bench_serve_mesh()
+    overlap_eff, model_vs_meas = _bench_kernel_report()
 
     peak = peak_bf16_tflops()
     vs = (tflops / peak) / REF_UTILIZATION if peak else 0.0
@@ -660,6 +694,14 @@ def main():
         # host "chips" share this host's cores).
         "serve_mesh_zero_loss": round(mesh_zero_loss, 4),
         "serve_mesh_toks_per_s": round(mesh_tps, 1),
+        # Kernel overlap scoreboard (scripts/kernel_report.py): the
+        # ag_gemm (T_compute + T_comm) / T_fused ratio and the
+        # perf_model predicted-fused / measured-fused ratio from the
+        # phase-sliced replay.  INFORMATIONAL on CPU (XLA fallback +
+        # TPU rate tables — no floor); a hardware session reads them
+        # as the overlap-quality and speed-of-light-distance fields.
+        "ag_gemm_overlap_efficiency": round(overlap_eff, 4),
+        "ag_gemm_model_vs_measured": round(model_vs_meas, 4),
         # Known-cost reference op (bare XLA dot, measured ceiling 189.7):
         # a depressed sentinel means the HOST was contended during this
         # session and `value` is a lower bound, not a regression.
@@ -698,6 +740,8 @@ def main():
           f"trace {trace_overhead:.3f}x, "
           f"fleet zero-loss {fleet_zero_loss:.3f}, "
           f"fleet trace {fleet_trace_overhead:.3f}x); "
+          f"ag overlap eff {overlap_eff:.3f} "
+          f"(model/meas {model_vs_meas:.3f}); "
           f"sentinel dot {sentinel_tflops:.1f} TFLOPS"
           + (" (CONTENDED)" if contended else ""),
           file=sys.stderr)

@@ -171,8 +171,9 @@ def parse_args():
     p.add_argument("--stats-every", type=int, default=None, metavar="N",
                    help="engine mode: log one compact stats line "
                         "(metrics.format_statline — the same formatter "
-                        "the supervisor's postmortem uses) every N "
-                        "engine steps")
+                        "the supervisor's postmortem uses, incl. the "
+                        "top device program by wall time when "
+                        "--trace-level >= 1) every N engine steps")
     p.add_argument("--trace-level", type=int, default=None,
                    help="engine mode: flight-recorder detail (0 = off, "
                         "1 = lifecycle + failures [default], 2 = "

@@ -160,6 +160,12 @@ _FAST_GATE_MODULES = {
     # + partition another, deadline-bounded — the ISSUE-12 acceptance
     # bar; the whole file is the fast tier).
     "test_serve_net",
+    # kernel-layer observability: the annotation-coverage source-grep
+    # meta-test (every public kernel entry point annotated — the
+    # ISSUE-14 closure gate), the kprobe overlap-scoreboard reports,
+    # and the kprobe-merges-with-engine-trace Perfetto wiring, plus
+    # the original dump/group_profile merge units (all cheap).
+    "test_observability",
 }
 
 # Heavy tests inside core modules whose coverage is duplicated by a

@@ -348,3 +348,26 @@ def test_serve_engine_serve_port_cli(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.wait()
+
+
+def test_serve_engine_program_breakdown():
+    """Per-program wall-time attribution through the CLI (ISSUE 14):
+    the end-of-run stats block renders the shared format_stats
+    "program ms:" line naming the horizon rung actually served, and
+    the --stats-every periodic line carries the top-program fragment
+    from the same light_summary."""
+    out = _run("--engine", "--warmup", "--horizon", "8", "--pipeline",
+               "2", "--requests", "4", "--stagger", "1", "--max-batch",
+               "4", "--page-size", "8", "--stats-every", "2",
+               devices=1, new_tokens=12)
+    import re
+    m = re.search(r"program ms: .*$", out, re.M)
+    assert m, out
+    # new_tokens=12: 11 post-prefill tokens bucket to the H=8 rung
+    # first — the rung the engine actually served must be named
+    assert "decode_horizon[H=8]" in m.group(0), m.group(0)
+    assert "prefill_chunk" in m.group(0), m.group(0)
+    # the periodic statline shares the breakdown (top program by total)
+    assert re.search(r"stats: .*\| top program \S+ p50 [\d.]+ ms",
+                     out), out
+    assert "done" in out

@@ -14,6 +14,7 @@ block allocator (``kv_shard="seq"``) keeps every logical page in its
 owning rank's partition.
 """
 
+import glob
 import json
 import os
 
@@ -462,3 +463,65 @@ def test_mesh_floor_present():
     floors = json.load(open(os.path.join(root, "PERF_FLOORS.json")))
     spec = floors["floors"]["serve_mesh_zero_loss"]
     assert spec["min"] == 1.0
+
+
+def test_heterogeneous_mesh_fleet_chaos(model, mesh2, oracle, tmp_path):
+    """Fleet replicas on DIFFERENT mesh shapes behind one controller
+    (the ROADMAP #1 open follow-up): r0 is a 2-device kv_shard="heads"
+    mesh engine, r1 a plain world-1 engine.  Kill the mesh replica
+    mid-decode: every stream (migrated ones included) finishes
+    bit-identical to the world-1 oracle, the cross-replica token union
+    is exactly-once (single journal ownership, no index with two
+    values — the serve_fleet_zero_loss contract), and the mesh replica
+    restarts healthy."""
+    from triton_dist_tpu.runtime.faults import FaultInjector
+    from triton_dist_tpu.serve.fleet import FleetController
+    from triton_dist_tpu.serve.recovery import JOURNAL_NAME, replay_journal
+
+    cfg, params, gen = model
+    inj = FaultInjector(seed=0).inject("forward", kill=True, at_call=14)
+
+    def factory(d):
+        if (os.sep + "r0" + os.sep) in d:
+            return _build(gen, params, mesh=mesh2, snapshot_dir=d,
+                          faults=inj if d.endswith("life1") else None)
+        return _build(gen, params, snapshot_dir=d)
+
+    fc = FleetController(factory, 2, root=str(tmp_path / "fleet"),
+                         suspect_after_s=50.0, dead_after_s=100.0,
+                         backoff_base_s=0.01, backoff_cap_s=0.1, seed=0)
+    reqs = _requests(cfg)
+    sub = steps = 0
+    while fc.has_work() or sub < len(reqs):
+        if steps % 2 == 0 and sub < len(reqs):
+            fc.submit(reqs[sub])
+            sub += 1
+        fc.step()
+        steps += 1
+        assert steps < 800
+    assert fc.deaths == 1 and inj.fire_count("forward") == 1
+    assert fc.replicas["r0"].restarts == 1
+    assert fc.replicas["r0"].engine.mesh is not None   # restarted AS mesh
+    # every stream bit-identical to the world-1 oracle, exactly-once
+    assert set(fc.outputs) == set(oracle)
+    for rid, toks in oracle.items():
+        assert list(fc.outputs[rid].token_ids) == list(toks), rid
+        assert fc.streams[rid] == list(toks), rid
+    # the kill landed with requests in flight: something migrated
+    moved = [r for r, h in fc.history.items() if len(set(h)) > 1]
+    assert moved, fc.history
+    # cross-journal union: token values agree at every index across
+    # every life's journal, exactly one journal owns each stream
+    owners: dict = {}
+    values: dict = {}
+    for jp in glob.glob(os.path.join(str(tmp_path / "fleet"), "*",
+                                     "life*", JOURNAL_NAME)):
+        for rid, jr in replay_journal(jp).items():
+            for i, (tok, _) in jr.tokens.items():
+                values.setdefault(rid, {}).setdefault(i, set()).add(tok)
+            if not jr.migrated and jr.finish is not None:
+                owners[rid] = owners.get(rid, 0) + 1
+    for rid, toks in oracle.items():
+        assert owners.get(rid) == 1, (rid, owners)
+        assert all(values[rid][i] == {toks[i]}
+                   for i in range(len(toks))), rid

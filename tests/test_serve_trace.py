@@ -694,3 +694,120 @@ def test_bench_trace_overhead_gate():
     # generous CI bound — PERF_FLOORS.json holds the honest 0.95 on the
     # quiet bench host
     assert r["serve_trace_overhead"] >= 0.8, r
+
+
+# ---------------------------------------------------------------------------
+# per-program wall-time attribution (the ISSUE-14 serve-time tentpole:
+# engine step time decomposes by device program)
+# ---------------------------------------------------------------------------
+
+
+def test_program_timing_summary_matches_prometheus(tiny):
+    """summary()["programs"] and the ``serve_program_ms{program=}``
+    exposition agree: every program's histogram round-trips through
+    ``LogHistogram.from_prom`` bucket-exactly, and the horizon rung
+    actually served shows up as its own label."""
+    from triton_dist_tpu.serve.fleet import parse_prometheus
+
+    cfg, params, gen = tiny
+    rng = np.random.default_rng(5)
+    eng = _engine(gen, params, horizon=4)
+    eng.warmup()
+    # warmup's compile stalls must not have polluted the distributions
+    assert not any(h.count for h in eng.metrics.program_hists.values())
+    for i in range(3):
+        eng.submit(Request(f"p{i}", rng.integers(0, cfg.vocab, size=5)
+                           .astype(np.int32),
+                           SamplingParams(max_new_tokens=5)))
+    eng.run()
+    progs = eng.metrics.summary()["programs"]
+    assert "prefill_chunk" in progs and "fill_pages" in progs
+    # the rung the horizon planner actually served is its own label
+    assert any(p.startswith("decode_horizon[H=") for p in progs), progs
+    for st in progs.values():
+        assert st["count"] >= 1 and st["p50"] > 0 and st["p99"] > 0
+    g = parse_prometheus(eng.metrics.to_prometheus())
+    for name, live in eng.metrics.program_hists.items():
+        h = LogHistogram.from_prom(g, "serve_program_ms",
+                                   labels=f'program="{name}"')
+        assert h.counts == live.counts and h.count == live.count
+        assert h.sum == live.sum and h.min == live.min
+        assert h.max == live.max
+    # the shared formatters carry the breakdown
+    line = [ln for ln in format_stats(eng.metrics.summary())
+            if ln.startswith("program ms:")]
+    assert line and "prefill_chunk" in line[0]
+    assert "top program" in format_statline(
+        eng.metrics.light_summary())
+
+
+def test_program_timing_off_at_level_zero(tiny):
+    cfg, params, gen = tiny
+    rng = np.random.default_rng(6)
+    eng = _engine(gen, params, trace_level=0)
+    eng.warmup()
+    eng.submit(Request("q0", rng.integers(0, cfg.vocab, size=5)
+                       .astype(np.int32),
+                       SamplingParams(max_new_tokens=4)))
+    eng.run()
+    assert eng.metrics.program_hists == {}
+    assert eng.metrics.summary()["programs"] == {}
+    assert "serve_program_ms" not in eng.metrics.to_prometheus()
+
+
+def test_program_hists_merge_and_scrapes_bucket_exact():
+    """ServeMetrics.merge and merge_scrapes both aggregate the
+    per-program histograms bucket-exactly against the pooled-sample
+    reference — including a program only one replica ever ran."""
+    from triton_dist_tpu.serve.fleet import merge_scrapes, parse_prometheus
+
+    a, b, pooled = ServeMetrics(), ServeMetrics(), ServeMetrics()
+    for m in (a, b, pooled):
+        m.program_timing = True
+    sa = [0.3, 1.7, 22.0, 0.9]
+    sb = [0.4, 5.0]
+    only_b = [2.5, 2.6]
+    for v in sa:
+        a.observe_program("paged_decode", v)
+        pooled.observe_program("paged_decode", v)
+    for v in sb:
+        b.observe_program("paged_decode", v)
+        pooled.observe_program("paged_decode", v)
+    for v in only_b:
+        b.observe_program("decode_horizon[H=8]", v)
+        pooled.observe_program("decode_horizon[H=8]", v)
+
+    scraped = merge_scrapes([a.to_prometheus(), b.to_prometheus()])
+    g = parse_prometheus(scraped)
+    a.merge(b)   # the in-process path
+    for name, ref in pooled.program_hists.items():
+        assert a.program_hists[name].counts == ref.counts, name
+        h = LogHistogram.from_prom(g, "serve_program_ms",
+                                   labels=f'program="{name}"')
+        assert h.counts == ref.counts and h.count == ref.count, name
+        assert h.sum == ref.sum and h.min == ref.min
+        assert h.max == ref.max
+    # percentiles of the merged equal percentiles of the pooled
+    assert (a.program_hists["paged_decode"].percentile(95)
+            == pooled.program_hists["paged_decode"].percentile(95))
+
+
+def test_program_timer_labels_statics():
+    """CountingJit's timed_statics suffix the label with the static
+    kwargs' values (the rung-laddered programs' per-rung attribution),
+    and MISS calls stay out of the timer — a compile stall is compile
+    accounting, never program wall time."""
+    from triton_dist_tpu.runtime.jit_cache import CountingJit
+
+    seen = []
+    fn = CountingJit(lambda *a, **k: 0, "prog",
+                     timer=lambda label, ms: seen.append(label),
+                     timed_statics=("H",))
+    fn(1, H=8)              # first signature: a miss — not timed
+    assert seen == [] and fn.misses == 1
+    fn(1, H=8)
+    fn(2, H=2)              # miss again (fresh signature)
+    fn(2, H=2)
+    fn(3)
+    fn(3)
+    assert seen == ["prog[H=8]", "prog[H=2]", "prog"]

@@ -392,7 +392,15 @@ def fast_all_to_all(send, splits, ctx: AllToAllContext):
         (P(ctx.axis), P(ctx.axis)),
         axis=ctx.axis, impl=ctx.impl, interpret=ctx.interpret,
     )
-    return fn(send, splits)
+    # Launch metadata (profiling.annotate contract): each device ships
+    # (world - 1) of its world outgoing [max_tokens, H] segments.
+    from triton_dist_tpu.runtime.profiling import annotate
+
+    el = jnp.dtype(send.dtype).itemsize
+    with annotate("fast_all_to_all",
+                  bytes_accessed=max(w - 1, 0) * ctx.max_tokens
+                  * ctx.hidden * el):
+        return fn(send, splits)
 
 
 def all_to_all_post_process(recv, recv_splits):

@@ -298,4 +298,13 @@ def all_gather(x, ctx: AllGatherContext):
         method=method,
         interpret=ctx.interpret,
     )
-    return fn(x)
+    # Launch metadata (reference: the proton launch-metadata hooks —
+    # every kernel entry reports name/bytes to the profiler).  Pure
+    # comm: per-device ring wire = (world - 1) shard payloads.
+    from triton_dist_tpu.runtime.profiling import annotate
+
+    world = int(ctx.mesh.shape[ctx.axis])
+    with annotate("all_gather",
+                  bytes_accessed=x.nbytes // max(world, 1)
+                  * max(world - 1, 0)):
+        return fn(x)

@@ -629,6 +629,34 @@ def flash_attention(q, k, v, *, causal=True, scale=None, q_offset=0,
                     kv_offset=0, block_q=None, block_k=None, impl="auto",
                     interpret=False, return_lse=False, k_scale=None,
                     v_scale=None, soft_cap=0.0, window=0):
+    """Public entry: :func:`_flash_attention_dispatch` under a
+    ``profiling.annotate`` launch-metadata span (name/flops/bytes land
+    in the profiler timeline — the contract every public kernel entry
+    point keeps, enforced by the tests/test_observability.py
+    annotation meta-test).  Causal masking halves the score flops."""
+    from triton_dist_tpu.runtime.profiling import annotate
+
+    B, Hq, Sq, D = q.shape
+    Sk = k.shape[2]
+    el = jnp.dtype(q.dtype).itemsize
+    flops = 4 * B * Hq * Sq * Sk * D // (2 if causal else 1)
+    with annotate("flash_attention", flops=flops,
+                  bytes_accessed=(q.size + k.size + v.size
+                                  + q.size) * el):
+        return _flash_attention_dispatch(
+            q, k, v, causal=causal, scale=scale, q_offset=q_offset,
+            kv_offset=kv_offset, block_q=block_q, block_k=block_k,
+            impl=impl, interpret=interpret, return_lse=return_lse,
+            k_scale=k_scale, v_scale=v_scale, soft_cap=soft_cap,
+            window=window)
+
+
+def _flash_attention_dispatch(q, k, v, *, causal=True, scale=None,
+                              q_offset=0, kv_offset=0, block_q=None,
+                              block_k=None, impl="auto",
+                              interpret=False, return_lse=False,
+                              k_scale=None, v_scale=None, soft_cap=0.0,
+                              window=0):
     """Blockwise GQA attention: q [B, Hq, Sq, D], k/v [B, Hkv, Sk, D] →
     out [B, Hq, Sq, D] in q.dtype (+ lse [B, Hq, Sq] f32 when
     ``return_lse``).

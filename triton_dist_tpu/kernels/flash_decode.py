@@ -974,4 +974,17 @@ def sp_gqa_decode(q, k_cache, v_cache, kv_lens, ctx: SpDecodeContext):
         axis=ctx.axis, block_s=ctx.block_s, impl=ctx.impl,
         interpret=ctx.interpret, soft_cap=ctx.soft_cap, window=ctx.window,
     )
-    return fn(q, k_cache, v_cache, kv_lens)
+    # Launch metadata (profiling.annotate contract): decode is the
+    # HBM-bound KV-shard read per rank; wire = the packed (out ⊕ lse)
+    # partial planes every rank exchanges for the combine.
+    from triton_dist_tpu.runtime.profiling import annotate
+
+    B, Hq, D = q.shape[0], q.shape[-2], q.shape[-1]
+    world = max(ctx.world, 1)
+    el = jnp.dtype(k_cache.dtype).itemsize
+    with annotate("sp_gqa_decode",
+                  flops=4 * B * Hq * (k_cache.shape[2] // world) * D,
+                  bytes_accessed=(k_cache.nbytes + v_cache.nbytes)
+                  // world
+                  + B * Hq * (D + 1) * 4 * (world - 1)):
+        return fn(q, k_cache, v_cache, kv_lens)

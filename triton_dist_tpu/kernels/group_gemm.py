@@ -111,8 +111,18 @@ def group_gemm(
         bn = 1024 if x_sorted.dtype == jnp.int8 else 512
     if bk is None:
         bk = 1024
-    return _group_gemm_core(x_sorted, w_stack, tile_expert, block_m, bn, bk,
-                            out_dtype, impl, interpret)
+    # Launch metadata (profiling.annotate contract): every padded row
+    # tile runs one [block_m, K] x [K, N] expert GEMM.
+    from triton_dist_tpu.runtime.profiling import annotate
+
+    M_pad, K = x_sorted.shape
+    N = w_stack.shape[2]
+    el = jnp.dtype(x_sorted.dtype).itemsize
+    with annotate("group_gemm", flops=2 * M_pad * K * N,
+                  bytes_accessed=(M_pad * K + M_pad * N) * el
+                  + w_stack.size * el):
+        return _group_gemm_core(x_sorted, w_stack, tile_expert, block_m,
+                                bn, bk, out_dtype, impl, interpret)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))

@@ -103,7 +103,17 @@ def fast_allgather(x, ctx: FastAllGatherContext):
         axis=ctx.axis, inter_axis=ctx.inter_axis, impl=ctx.impl,
         interpret=ctx.interpret,
     )
-    return fn(x)
+    # Launch metadata (profiling.annotate contract): push-AG wire =
+    # every device broadcasts its shard to (world - 1) peers.
+    from triton_dist_tpu.runtime.profiling import annotate
+
+    world = int(ctx.mesh.shape[ctx.axis])
+    if ctx.inter_axis:
+        world *= int(ctx.mesh.shape[ctx.inter_axis])
+    with annotate("fast_allgather",
+                  bytes_accessed=x.nbytes // max(world, 1)
+                  * max(world - 1, 0)):
+        return fn(x)
 
 
 # ---------------------------------------------------------------------------

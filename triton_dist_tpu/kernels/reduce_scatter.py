@@ -319,4 +319,10 @@ def reduce_scatter(x, ctx: ReduceScatterContext):
         method=method,
         interpret=ctx.interpret,
     )
-    return fn(x)
+    # Launch metadata (profiling.annotate contract): ring RS moves
+    # ~(world-1)/world of one full partial across the wire per device.
+    from triton_dist_tpu.runtime.profiling import annotate
+
+    with annotate("reduce_scatter",
+                  bytes_accessed=x.nbytes // max(world, 1)):
+        return fn(x)

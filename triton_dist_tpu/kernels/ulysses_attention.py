@@ -142,4 +142,13 @@ def ulysses_attention(q, k, v, ctx: UlyssesContext):
         axis=ctx.axis, causal=ctx.causal, impl=ctx.impl,
         interpret=ctx.interpret, window=ctx.window, soft_cap=ctx.soft_cap,
     )
-    return fn(q, k, v)
+    # Launch metadata (profiling.annotate contract): full attention
+    # flops over the global sequence, causal halved.
+    from triton_dist_tpu.runtime.profiling import annotate
+
+    S, B, H, hd = q.shape
+    flops = 4 * B * H * S * S * hd // (2 if ctx.causal else 1)
+    with annotate("ulysses_attention", flops=flops,
+                  bytes_accessed=(q.nbytes + k.nbytes + v.nbytes)
+                  // max(ctx.world, 1)):
+        return fn(q, k, v)

@@ -83,11 +83,26 @@ class CountingJit:
 
     Transparent otherwise: ``__call__`` forwards args/kwargs verbatim, so
     donation and traced-kwarg behavior of the wrapped jit are unchanged.
+
+    **Per-program wall-time attribution** (docs/observability.md
+    "Kernel observability"): setting ``timer`` to a ``(label, ms)``
+    callable reports every call's wall time under this wrapper's name —
+    the serving engine wires ``ServeMetrics.observe_program`` here
+    behind its ``trace_level`` knob, so engine step time decomposes by
+    device program.  ``timed_statics`` names static kwargs whose values
+    suffix the label (the horizon's ``H``, the spec round's ``K``), so
+    a rung-laddered program attributes per rung
+    (``decode_horizon[H=8]``).  ``timer=None`` (default) keeps the hot
+    path at one attribute check.
     """
 
-    def __init__(self, fn: Callable, name: str):
+    def __init__(self, fn: Callable, name: str,
+                 timer: Optional[Callable] = None,
+                 timed_statics: tuple = ()):
         self.fn = fn
         self.name = name
+        self.timer = timer
+        self.timed_statics = tuple(timed_statics)
         self.hits = 0
         self.misses = 0
         self.compile_time = 0.0
@@ -120,6 +135,18 @@ class CountingJit:
             self.compile_time += dt
         else:
             self.hits += 1
+        timer = self.timer
+        if timer is not None and not fresh:
+            # miss calls are compile stalls — already accounted in
+            # compile_time, and they must never pollute the per-program
+            # wall-time distributions (a no-warmup engine's first call
+            # of each program would otherwise dominate its p99/max)
+            label = self.name
+            for k in self.timed_statics:
+                v = kwargs.get(k)
+                if v is not None:
+                    label = f"{label}[{k}={v}]"
+            timer(label, dt * 1e3)
         return out
 
     @property

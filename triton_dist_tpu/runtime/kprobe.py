@@ -1,0 +1,778 @@
+"""Kernel-layer observability: the overlap scoreboard.
+
+The overlapped kernels (``ag_gemm``, ``gemm_rs``, ``moe_reduce_rs``, the
+SP flash-decode combine) have been observable only as one end-to-end
+bench number — ROADMAP #5b spent three PRs arguing whether a
+99.8%→70.9% utilization slide was real precisely because nothing
+attributed *where inside the kernel* time goes.  This module makes the
+compute/communication overlap — the paper's headline claim — a
+measured, attributable artifact:
+
+- **Three whole-kernel legs.**  The FUSED kernel, its COMPUTE-ONLY leg
+  (the same per-device MXU work with the ring deleted), and its
+  COMM-ONLY leg (the same wire bytes with the MXU work deleted), each
+  host-timed as its own dispatch.  ``overlap_efficiency =
+  (T_compute + T_comm) / T_fused``: 1.0 means the fused kernel costs
+  the serial sum (no overlap), values toward ``(Tc + Tm)/max(Tc, Tm)``
+  mean the shorter phase fully hides under the longer one.
+
+- **Phase-sliced per-ring-step replay.**  The ring schedule replayed
+  one phase at a time — step s's compute tile and step s's wire
+  transfer each dispatched SEPARATELY under ``profiling.annotate``
+  spans (name#flops#bytes land in the device trace on hardware) with
+  host timing.  The slices reconstruct a per-rank per-step
+  compute-vs-comm timeline, name the critical phase per step
+  (``max(compute_ms, comm_ms)`` is what a bulk-synchronous ring step
+  costs), and pair every measured slice with its
+  ``kernels/perf_model`` prediction — the roofline-vs-measured table
+  that turns the next perf-trajectory dispute into reading a report.
+
+- **Artifacts.**  :meth:`OverlapReport.to_dict`/:meth:`save` emit the
+  JSON overlap report; :meth:`OverlapReport.export_profile` drops ONE
+  reconstructed Perfetto track per rank (compute and comm threads
+  under :data:`KPROBE_PID`) where ``profiling.merge_rank_traces``
+  globs, so the scoreboard merges into the same ui.perfetto.dev file
+  as the device, engine, and fleet timelines.  ``scripts/
+  kernel_report.py`` is the CLI driver.
+
+Caveat the report itself records: on a non-TPU backend the fused
+kernels take their XLA fallbacks and the perf-model predictions use
+TPU rate tables, so absolute numbers are structural/informational —
+the report's value there is the schedule decomposition and the
+artifact plumbing, which are exactly what runs on hardware.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import os
+import statistics
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.kernels import perf_model
+from triton_dist_tpu.runtime import profiling
+
+#: pid the per-rank scoreboard tracks claim in exported Chrome traces —
+#: below the Linux pid cap (4194304) so ``merge_rank_traces``'s
+#: per-rank re-namespacing stays injective, and distinct from the
+#: serving plane's ``serve.trace.ENGINE_PID``/``FLEET_PID`` so one
+#: merged file holds device + engine + fleet + kernel tracks.
+KPROBE_PID = 3_999_997
+
+#: Kernels the scoreboard covers (scripts/kernel_report.py --kernel).
+KERNELS = ("ag_gemm", "gemm_rs", "moe_reduce_rs", "sp_decode")
+
+
+# ---------------------------------------------------------------------------
+# Timing
+# ---------------------------------------------------------------------------
+
+
+def _time_ms(fn: Callable, args: tuple, *, label: str,
+             flops: Optional[int] = None,
+             bytes_accessed: Optional[int] = None,
+             trials: int = 3) -> float:
+    """Median wall milliseconds of ``fn(*args)`` over ``trials`` after
+    one untimed warmup call, each trial under a ``profiling.annotate``
+    span (the launch-metadata hook: on hardware the span + name/flops/
+    bytes land in the device trace a ``group_profile`` capture holds).
+    ``block_until_ready`` bounds every trial — host-dispatch time alone
+    would measure nothing on an async backend."""
+    jax.block_until_ready(fn(*args))   # warm: compile outside the clock
+    ts = []
+    for _ in range(max(1, trials)):
+        with profiling.annotate(label, flops=flops,
+                                bytes_accessed=bytes_accessed):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            ts.append((time.perf_counter() - t0) * 1e3)
+    return statistics.median(ts)
+
+
+def _sjit(body, mesh, in_specs, out_specs, **opts):
+    """jit(shard_map(partial(body, **opts))) — the probe legs are built
+    once per report, so no process-wide memo is needed."""
+    return jax.jit(jax.shard_map(
+        functools.partial(body, **opts), mesh=mesh, in_specs=in_specs,
+        out_specs=out_specs, check_vma=False))
+
+
+# ---------------------------------------------------------------------------
+# Report structure
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class StepSlice:
+    """One phase of one ring step, dispatched standalone."""
+
+    step: int
+    phase: str                # "compute" | "comm"
+    measured_ms: float
+    predicted_ms: float       # kernels/perf_model roofline
+    desc: str = ""
+    #: rank -> segment/slot consumed at this step (ring schedules
+    #: consume a different slot per rank; [] when not slot-addressed)
+    slots: list = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class OverlapReport:
+    """The scoreboard for ONE overlapped kernel at one shape."""
+
+    kernel: str
+    world: int
+    shape: dict
+    dtype: str
+    fused_ms: float
+    compute_ms: float         # compute-only leg (whole kernel)
+    comm_ms: float            # comm-only leg (whole kernel)
+    slices: list              # list[StepSlice]
+    backend: str = ""
+    trials: int = 3
+
+    # -- derived ----------------------------------------------------------
+
+    @property
+    def overlap_efficiency(self) -> float:
+        """``(T_compute + T_comm) / T_fused`` — 1.0 = no overlap (the
+        fused kernel costs the serial sum), ``(Tc+Tm)/max(Tc,Tm)`` =
+        perfect overlap (the shorter phase is free)."""
+        if self.fused_ms <= 0:
+            return 0.0
+        return (self.compute_ms + self.comm_ms) / self.fused_ms
+
+    @property
+    def sliced_serial_ms(self) -> float:
+        return sum(s.measured_ms for s in self.slices)
+
+    def _per_step(self) -> dict:
+        steps: dict[int, dict] = {}
+        for s in self.slices:
+            steps.setdefault(s.step, {})[s.phase] = s
+        return steps
+
+    @property
+    def sliced_critical_ms(self) -> float:
+        """Ideal fully-overlapped time of the replayed schedule: each
+        bulk-synchronous ring step costs its slower phase."""
+        return sum(max(ph.measured_ms for ph in by.values())
+                   for by in self._per_step().values())
+
+    def critical_path(self) -> dict:
+        """Which phase the replayed schedule is bound by, step-wise:
+        each step's critical phase is the slower one; the fractions say
+        where an optimization dollar goes."""
+        comp = comm = 0.0
+        for by in self._per_step().values():
+            crit = max(by.values(), key=lambda s: s.measured_ms)
+            if crit.phase == "compute":
+                comp += crit.measured_ms
+            else:
+                comm += crit.measured_ms
+        total = comp + comm
+        return {
+            "compute_ms": round(comp, 4),
+            "comm_ms": round(comm, 4),
+            "compute_frac": round(comp / total, 4) if total else 0.0,
+            "bound": "compute" if comp >= comm else "comm",
+        }
+
+    def model(self) -> dict:
+        """The roofline-vs-measured table's totals: perf_model
+        predictions summed per phase, the predicted fused time (sum of
+        per-step maxima — the overlapped schedule's model), and
+        ``model_vs_measured`` = predicted fused / measured fused (1.0 =
+        the kernel runs at the model's speed of light; informational on
+        non-TPU backends, where the model's rate tables do not describe
+        the host)."""
+        pred_comp = sum(s.predicted_ms for s in self.slices
+                        if s.phase == "compute")
+        pred_comm = sum(s.predicted_ms for s in self.slices
+                        if s.phase == "comm")
+        pred_fused = sum(
+            max(ph.predicted_ms for ph in by.values())
+            for by in self._per_step().values())
+        return {
+            "predicted_compute_ms": round(pred_comp, 4),
+            "predicted_comm_ms": round(pred_comm, 4),
+            "predicted_fused_ms": round(pred_fused, 4),
+            "model_vs_measured": round(pred_fused / self.fused_ms, 4)
+            if self.fused_ms > 0 else 0.0,
+        }
+
+    # -- artifacts --------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "kernel": self.kernel,
+            "world": self.world,
+            "shape": self.shape,
+            "dtype": self.dtype,
+            "backend": self.backend,
+            "trials": self.trials,
+            "timings_ms": {
+                "fused": round(self.fused_ms, 4),
+                "compute_only": round(self.compute_ms, 4),
+                "comm_only": round(self.comm_ms, 4),
+                "sliced_serial": round(self.sliced_serial_ms, 4),
+                "sliced_critical": round(self.sliced_critical_ms, 4),
+            },
+            "overlap_efficiency": round(self.overlap_efficiency, 4),
+            "critical_path": self.critical_path(),
+            "model": self.model(),
+            "steps": [
+                {
+                    "step": s.step, "phase": s.phase,
+                    "measured_ms": round(s.measured_ms, 4),
+                    "predicted_ms": round(s.predicted_ms, 4),
+                    "desc": s.desc,
+                    "slots": s.slots,
+                }
+                for s in sorted(self.slices,
+                                key=lambda s: (s.step, s.phase))
+            ],
+        }
+
+    def save(self, path: str) -> str:
+        os.makedirs(os.path.dirname(os.path.abspath(path)),
+                    exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2)
+        return path
+
+    def perfetto_events(self, rank: int) -> list[dict]:
+        """Rank ``rank``'s reconstructed timeline as Chrome-trace
+        events: a compute thread and a comm thread under
+        :data:`KPROBE_PID`, step phases laid out bulk-synchronously
+        (step boundaries at the running sum of per-step maxima — the
+        ring is bulk-synchronous, so that IS the step cadence) with
+        each span named by step, this rank's consumed slot, and the
+        predicted-vs-measured pair."""
+        tids = {"compute": 1, "comm": 2}
+        trace: list[dict] = [
+            {"ph": "M", "pid": KPROBE_PID, "tid": 0,
+             "name": "process_name",
+             "args": {"name": f"kernel probe ({self.kernel})"}},
+        ]
+        for phase, tid in tids.items():
+            trace.append({"ph": "M", "pid": KPROBE_PID, "tid": tid,
+                          "name": "thread_name",
+                          "args": {"name": f"{self.kernel}.{phase}"}})
+        t = 0.0
+        for step, by in sorted(self._per_step().items()):
+            for phase, s in sorted(by.items()):
+                slot = (s.slots[rank]
+                        if 0 <= rank < len(s.slots) else None)
+                name = f"{self.kernel} step{step}"
+                if slot is not None:
+                    name += f" slot{slot}"
+                trace.append({
+                    "ph": "X", "pid": KPROBE_PID, "tid": tids[phase],
+                    "cat": "kprobe", "name": name,
+                    "ts": t * 1e3,            # ms -> us
+                    "dur": max(s.measured_ms * 1e3, 1.0),
+                    "args": {"phase": phase, "desc": s.desc,
+                             "measured_ms": round(s.measured_ms, 4),
+                             "predicted_ms": round(s.predicted_ms, 4)},
+                })
+            t += max(ph.measured_ms for ph in by.values())
+        return trace
+
+    def export_profile(self, job_dir: str) -> list[str]:
+        """One reconstructed track per rank, dropped where
+        ``profiling.merge_rank_traces`` globs
+        (``{job_dir}/rank{r}/kprobe_{kernel}.trace.json.gz``) — run a
+        ``group_profile`` capture and/or a
+        ``FlightRecorder.export_profile`` into the same ``job_dir``,
+        then merge: ONE ui.perfetto.dev file holds device + engine +
+        kernel-probe timelines (docs/observability.md)."""
+        from triton_dist_tpu.serve.trace import write_trace
+
+        paths = []
+        for r in range(self.world):
+            out = os.path.join(job_dir, f"rank{r}",
+                               f"kprobe_{self.kernel}.trace.json.gz")
+            paths.append(write_trace(
+                {"traceEvents": self.perfetto_events(r)}, out))
+        return paths
+
+
+# ---------------------------------------------------------------------------
+# Probe bodies (module level: shard_map bodies)
+# ---------------------------------------------------------------------------
+
+
+def _dot_leg(a, b, *, out_dtype):
+    return jnp.dot(a, b,
+                   preferred_element_type=jnp.float32).astype(out_dtype)
+
+
+def _ag_leg(a_loc, *, axis):
+    return jax.lax.all_gather(a_loc, axis, axis=0, tiled=True)
+
+
+def _ring_fwd_leg(a_loc, *, axis, world):
+    perm = [(i, (i + 1) % world) for i in range(world)]
+    return jax.lax.ppermute(a_loc, axis, perm=perm)
+
+
+def _own_rows_leg(a_loc, b_loc, *, axis, out_dtype):
+    """Full local partial GEMM, then this rank's row band (the RS
+    compute leg: all the MXU work, none of the wire)."""
+    part = jnp.dot(a_loc, b_loc, preferred_element_type=jnp.float32)
+    me = jax.lax.axis_index(axis)
+    blk = part.shape[0] // jax.lax.axis_size(axis)
+    return jax.lax.dynamic_slice_in_dim(
+        part, me * blk, blk, axis=0).astype(out_dtype)
+
+
+def _rs_leg(p_loc, *, axis):
+    """Reduce-scatter of a per-rank partial (fed as [world, M, N]
+    sharded on the leading axis so every rank's values are distinct)."""
+    return jax.lax.psum_scatter(p_loc[0], axis, scatter_dimension=0,
+                                tiled=True)
+
+
+def _chunk_shift_add_leg(c_loc, *, axis, world):
+    """One RS ring step: ship a chunk to the neighbor and add — the
+    per-step comm slice."""
+    perm = [(i, (i + 1) % world) for i in range(world)]
+    return c_loc + jax.lax.ppermute(c_loc, axis, perm=perm)
+
+
+def _local_decode_leg(q, k_loc, v_loc, kv_lens, *, axis, impl,
+                      interpret):
+    """SP flash-decode compute slice: each rank's local split-KV
+    partials, NO combine (partials stack on a fresh leading axis so
+    per-rank values assemble honestly)."""
+    from triton_dist_tpu.kernels.flash_decode import gqa_decode_shard
+
+    s_loc = k_loc.shape[2]
+    me = jax.lax.axis_index(axis)
+    local_lens = jnp.clip((kv_lens - me * s_loc).astype(jnp.int32),
+                          0, s_loc)
+    out, lse = gqa_decode_shard(q, k_loc, v_loc, local_lens, impl=impl,
+                                interpret=interpret)
+    return out[None], lse[None]
+
+
+def _sp_combine_leg(out_all, lse_all, *, axis, impl, interpret):
+    """SP flash-decode comm slice: the inter-rank LSE combine alone, on
+    per-rank partials fed via a [world, ...] leading axis."""
+    from triton_dist_tpu.kernels.flash_decode import _combine_across_ranks
+
+    return _combine_across_ranks(out_all[0].astype(jnp.float32),
+                                 lse_all[0].astype(jnp.float32),
+                                 out_all.dtype, axis=axis, impl=impl,
+                                 interpret=interpret)
+
+
+def _sp_fused_leg(q, k_loc, v_loc, kv_lens, *, axis, impl, interpret):
+    from triton_dist_tpu.kernels.flash_decode import sp_gqa_decode_shard
+
+    return sp_gqa_decode_shard(q, k_loc, v_loc, kv_lens, axis=axis,
+                               impl=impl, interpret=interpret)
+
+
+def _group_gemm_leg(h_loc, w_loc, te, *, axis, block_m, out_dtype):
+    """MoE compute leg: the grouped GEMM over every sorted row against
+    the local F shard, then this rank's own segment band (all the MXU
+    work, none of the ring)."""
+    from triton_dist_tpu.kernels.group_gemm import group_gemm_xla
+
+    ys = group_gemm_xla(h_loc, w_loc, te, block_m)
+    me = jax.lax.axis_index(axis)
+    blk = ys.shape[0] // jax.lax.axis_size(axis)
+    return jax.lax.dynamic_slice_in_dim(
+        ys, me * blk, blk, axis=0).astype(out_dtype)
+
+
+def _seg_dot_leg(h_seg, w_loc, *, out_dtype):
+    """One ring step's compute tile: the dense-equivalent segment GEMM
+    (the grouped kernel's expert mixing happens inside the fused
+    program; the tile's MXU work — rows x f_loc x D — is identical, and
+    the perf model predicts exactly that)."""
+    return jnp.dot(h_seg, w_loc,
+                   preferred_element_type=jnp.float32).astype(out_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Probes
+# ---------------------------------------------------------------------------
+
+
+def probe_ag_gemm(mesh: Mesh, *, axis: str = "tp", M: int = 512,
+                  K: int = 256, n_loc: int = 128, dtype=jnp.float32,
+                  impl: str = "auto", trials: int = 3,
+                  seed: int = 0) -> OverlapReport:
+    """Scoreboard for the flagship overlapped AllGather-GEMM.
+
+    Legs: fused = ``ag_gemm`` (ring producer + persistent MXU
+    pipeline); compute-only = the gathered [M, K] x [K, n_loc] GEMM
+    with the ring deleted; comm-only = the ring allgather of A with the
+    GEMM deleted.  Sliced replay: step s computes one [m_loc, K] x
+    [K, n_loc] segment GEMM (rank r consumes slot ``(r - s) % world`` —
+    the arrival-order schedule) and, for s < world-1, ring-forwards one
+    [m_loc, K] segment.
+    """
+    from triton_dist_tpu.kernels.allgather_gemm import (
+        ag_gemm, create_ag_gemm_context)
+
+    world = int(mesh.shape[axis])
+    if M % (world or 1):
+        raise ValueError(f"M ({M}) must divide by world ({world})")
+    m_loc = M // world
+    N = n_loc * world
+    el = jnp.dtype(dtype).itemsize
+    k0, k1 = jax.random.split(jax.random.key(seed))
+    a = (jax.random.normal(k0, (M, K), jnp.float32) * 0.1).astype(dtype)
+    b = (jax.random.normal(k1, (K, N), jnp.float32) * 0.1).astype(dtype)
+
+    ctx = create_ag_gemm_context(mesh, axis=axis, impl=impl)
+    fused_ms = _time_ms(lambda: ag_gemm(a, b, ctx), (), trials=trials,
+                        label="kprobe.ag_gemm.fused",
+                        flops=2 * M * n_loc * K,
+                        bytes_accessed=(M * K + K * n_loc
+                                        + M * n_loc) * el)
+
+    comp_fn = _sjit(_dot_leg, mesh, (P(), P(None, axis)),
+                    P(None, axis), out_dtype=dtype)
+    compute_ms = _time_ms(comp_fn, (a, b), trials=trials,
+                          label="kprobe.ag_gemm.compute_only",
+                          flops=2 * M * n_loc * K)
+    comm_fn = _sjit(_ag_leg, mesh, (P(axis, None),), P(), axis=axis)
+    comm_ms = (_time_ms(comm_fn, (a,), trials=trials,
+                        label="kprobe.ag_gemm.comm_only",
+                        bytes_accessed=m_loc * K * el * (world - 1))
+               if world > 1 else 0.0)
+
+    # each rank computes its held segment against its local B columns;
+    # assembly keeps every rank's own [m_loc, n_loc] row band
+    seg_fn = _sjit(_dot_leg, mesh, (P(axis, None), P(None, axis)),
+                   P(axis, None), out_dtype=dtype)
+    fwd_fn = _sjit(_ring_fwd_leg, mesh, (P(axis, None),),
+                   P(axis, None), axis=axis, world=world)
+    pred_comp = perf_model.estimate_gemm_sol_time_ms(
+        m_loc, n_loc, K, dtype)
+    pred_comm = (perf_model.estimate_allgather_time_ms(
+        m_loc * K * el, world) / (world - 1) if world > 1 else 0.0)
+    slices = []
+    for s in range(world):
+        slots = [(r - s) % world for r in range(world)]
+        slices.append(StepSlice(
+            step=s, phase="compute",
+            measured_ms=_time_ms(
+                seg_fn, (a, b), trials=trials,
+                label=f"kprobe.ag_gemm.step{s}.compute",
+                flops=2 * m_loc * n_loc * K),
+            predicted_ms=pred_comp,
+            desc=f"[{m_loc}, {K}] x [{K}, {n_loc}] segment GEMM",
+            slots=slots))
+        if s < world - 1:
+            slices.append(StepSlice(
+                step=s, phase="comm",
+                measured_ms=_time_ms(
+                    fwd_fn, (a,), trials=trials,
+                    label=f"kprobe.ag_gemm.step{s}.comm",
+                    bytes_accessed=m_loc * K * el),
+                predicted_ms=pred_comm,
+                desc=f"ring-forward [{m_loc}, {K}] segment",
+                slots=slots))
+    return OverlapReport(
+        kernel="ag_gemm", world=world,
+        shape={"M": M, "K": K, "N": N, "n_loc": n_loc},
+        dtype=str(jnp.dtype(dtype)), fused_ms=fused_ms,
+        compute_ms=compute_ms, comm_ms=comm_ms, slices=slices,
+        backend=jax.default_backend(), trials=trials)
+
+
+def probe_gemm_rs(mesh: Mesh, *, axis: str = "tp", M: int = 256,
+                  K: int = 256, N: int = 256, dtype=jnp.float32,
+                  impl: str = "auto", trials: int = 3,
+                  seed: int = 0) -> OverlapReport:
+    """Scoreboard for the overlapped GEMM-ReduceScatter: fused =
+    ``gemm_rs``; compute-only = the local [M, k_loc] x [k_loc, N]
+    partial GEMM (own row band kept); comm-only = the ring
+    reduce-scatter of a per-rank partial; sliced replay: one
+    [m_blk, k_loc] x [k_loc, N] chunk GEMM per step + one
+    [m_blk, N] chunk ship-and-add per ring hop."""
+    from triton_dist_tpu.kernels.gemm_reduce_scatter import (
+        create_gemm_rs_context, gemm_rs)
+
+    world = int(mesh.shape[axis])
+    if M % (world or 1) or K % (world or 1):
+        raise ValueError(f"M ({M}) and K ({K}) must divide by world "
+                         f"({world})")
+    k_loc = K // world
+    m_blk = M // world
+    el = jnp.dtype(dtype).itemsize
+    k0, k1, k2 = jax.random.split(jax.random.key(seed), 3)
+    a = (jax.random.normal(k0, (M, K), jnp.float32) * 0.1).astype(dtype)
+    b = (jax.random.normal(k1, (K, N), jnp.float32) * 0.1).astype(dtype)
+    parts = (jax.random.normal(k2, (world, M, N), jnp.float32)
+             * 0.1).astype(dtype)
+    chunk = parts[0]   # one rank-shaped partial, chunk-shipped per step
+
+    ctx = create_gemm_rs_context(mesh, axis=axis, impl=impl)
+    fused_ms = _time_ms(lambda: gemm_rs(a, b, ctx), (), trials=trials,
+                        label="kprobe.gemm_rs.fused",
+                        flops=2 * M * N * k_loc,
+                        bytes_accessed=(M * k_loc + k_loc * N
+                                        + M * N) * el)
+
+    comp_fn = _sjit(_own_rows_leg, mesh, (P(None, axis), P(axis, None)),
+                    P(axis, None), axis=axis, out_dtype=dtype)
+    compute_ms = _time_ms(comp_fn, (a, b), trials=trials,
+                          label="kprobe.gemm_rs.compute_only",
+                          flops=2 * M * N * k_loc)
+    comm_fn = _sjit(_rs_leg, mesh, (P(axis, None, None),),
+                    P(axis, None), axis=axis)
+    comm_ms = (_time_ms(comm_fn, (parts,), trials=trials,
+                        label="kprobe.gemm_rs.comm_only",
+                        bytes_accessed=M * N * el)
+               if world > 1 else 0.0)
+
+    # ONE ring step's compute tile, dispatched standalone: per rank the
+    # [m_blk, k_loc] row band of A against the local [k_loc, N] shard
+    # (each rank's [m_blk, N] partial band assembles distinctly)
+    seg_fn = _sjit(_dot_leg, mesh, (P(None, axis), P(axis, None)),
+                   P(axis, None), out_dtype=dtype)
+    ship_fn = _sjit(_chunk_shift_add_leg, mesh, (P(axis, None),),
+                    P(axis, None), axis=axis, world=world)
+    a_step = a[:m_blk]
+    pred_comp = perf_model.estimate_gemm_sol_time_ms(m_blk, N, k_loc,
+                                                     dtype)
+    pred_comm = (perf_model.estimate_reduce_scatter_time_ms(
+        M * N * el, world) / (world - 1) if world > 1 else 0.0)
+    slices = []
+    for s in range(world):
+        slices.append(StepSlice(
+            step=s, phase="compute",
+            measured_ms=_time_ms(
+                seg_fn, (a_step, b), trials=trials,
+                label=f"kprobe.gemm_rs.step{s}.compute",
+                flops=2 * m_blk * N * k_loc),
+            predicted_ms=pred_comp,
+            desc=f"[{m_blk}, {k_loc}] x [{k_loc}, {N}] chunk GEMM"))
+        if s < world - 1:
+            slices.append(StepSlice(
+                step=s, phase="comm",
+                measured_ms=_time_ms(
+                    ship_fn, (chunk,), trials=trials,
+                    label=f"kprobe.gemm_rs.step{s}.comm",
+                    bytes_accessed=m_blk * N * el),
+                predicted_ms=pred_comm,
+                desc=f"ship + add [{m_blk}, {N}] partial chunk"))
+    return OverlapReport(
+        kernel="gemm_rs", world=world,
+        shape={"M": M, "K": K, "N": N},
+        dtype=str(jnp.dtype(dtype)), fused_ms=fused_ms,
+        compute_ms=compute_ms, comm_ms=comm_ms, slices=slices,
+        backend=jax.default_backend(), trials=trials)
+
+
+def probe_moe_reduce_rs(mesh: Mesh, *, axis: str = "tp", T: int = 32,
+                        D: int = 128, n_experts: int = 4, topk: int = 2,
+                        block_m: int = 8, dtype=jnp.float32,
+                        impl: str = "auto", trials: int = 3,
+                        seed: int = 0) -> OverlapReport:
+    """Scoreboard for the MoE GroupGEMM-ReduceScatter (F == D identity
+    first layer, like tests/test_moe_reduce_rs.py): fused =
+    ``moe_reduce_rs``; compute-only = the grouped GEMM over all sorted
+    rows (own segment band kept); comm-only = the ring reduce-scatter
+    of the per-rank segment partials; sliced replay: one dense-
+    equivalent [m_pad, f_loc] x [f_loc, D] segment GEMM per step + one
+    [m_pad, D] segment ship-and-add per ring hop."""
+    from triton_dist_tpu.kernels.allgather_group_gemm import (
+        _segment_plans)
+    from triton_dist_tpu.kernels.moe_reduce_rs import (
+        create_moe_rs_context, moe_reduce_rs)
+    from triton_dist_tpu.kernels.moe_utils import (
+        gather_sorted, topk_routing)
+
+    world = int(mesh.shape[axis])
+    if T % (world or 1) or D % (world or 1):
+        raise ValueError(f"T ({T}) and D ({D}) must divide by world "
+                         f"({world})")
+    t_loc = T // world
+    f_loc = D // world
+    el = jnp.dtype(dtype).itemsize
+    ks = jax.random.split(jax.random.key(seed), 3)
+    x = (jax.random.normal(ks[0], (T, D), jnp.float32) * 0.1).astype(dtype)
+    w = (jax.random.normal(ks[1], (n_experts, D, D), jnp.float32)
+         / np.sqrt(D)).astype(dtype)
+    logits = jax.random.normal(ks[2], (T, n_experts), jnp.float32)
+    weights, experts = topk_routing(logits, topk)
+    experts_all = experts.reshape(world, t_loc, topk)
+    dest_all, te_all, m_pad = _segment_plans(experts_all, n_experts,
+                                             block_m)
+    xs = jax.vmap(functools.partial(gather_sorted, m_pad=m_pad))(
+        x.reshape(world, t_loc, D), dest_all)
+    h = xs.reshape(world * m_pad, D)
+    rows = h.shape[0]
+
+    ctx = create_moe_rs_context(mesh, n_experts=n_experts, topk=topk,
+                                axis=axis, block_m=block_m, impl=impl)
+    fused_ms = _time_ms(
+        lambda: moe_reduce_rs(h, w, weights, experts, ctx), (),
+        trials=trials, label="kprobe.moe_reduce_rs.fused",
+        flops=2 * rows * f_loc * D,
+        bytes_accessed=(rows * f_loc + rows * D) * el
+        + w.size // max(world, 1) * el)
+
+    te_flat = np.asarray(te_all).reshape(-1)
+    comp_fn = _sjit(_group_gemm_leg, mesh,
+                    (P(None, axis), P(None, axis, None), P()),
+                    P(axis, None), axis=axis, block_m=block_m,
+                    out_dtype=dtype)
+    compute_ms = _time_ms(
+        comp_fn, (h, w, jnp.asarray(te_flat)), trials=trials,
+        label="kprobe.moe_reduce_rs.compute_only",
+        flops=2 * rows * f_loc * D)
+    parts = (jax.random.normal(ks[0], (world, rows, D), jnp.float32)
+             * 0.1).astype(dtype)
+    comm_fn = _sjit(_rs_leg, mesh, (P(axis, None, None),),
+                    P(axis, None), axis=axis)
+    comm_ms = (_time_ms(comm_fn, (parts,), trials=trials,
+                        label="kprobe.moe_reduce_rs.comm_only",
+                        bytes_accessed=rows * D * el)
+               if world > 1 else 0.0)
+
+    seg_fn = _sjit(_seg_dot_leg, mesh, (P(), P(None, axis)),
+                   P(None, axis), out_dtype=dtype)
+    h_seg = h[:m_pad]
+    ship_fn = _sjit(_chunk_shift_add_leg, mesh, (P(axis, None),),
+                    P(axis, None), axis=axis, world=world)
+    seg_chunk = parts[0]   # [world*m_pad, D]: one [m_pad, D] per rank
+    pred_comp = perf_model.estimate_gemm_sol_time_ms(m_pad, D, f_loc,
+                                                     dtype)
+    pred_comm = (perf_model.estimate_reduce_scatter_time_ms(
+        rows * D * el, world) / (world - 1) if world > 1 else 0.0)
+    slices = []
+    for s in range(world):
+        slices.append(StepSlice(
+            step=s, phase="compute",
+            measured_ms=_time_ms(
+                seg_fn, (h_seg, w[0]), trials=trials,
+                label=f"kprobe.moe_reduce_rs.step{s}.compute",
+                flops=2 * m_pad * f_loc * D),
+            predicted_ms=pred_comp,
+            desc=f"dense-equivalent [{m_pad}, {f_loc}] x "
+                 f"[{f_loc}, {D}] segment GEMM"))
+        if s < world - 1:
+            slices.append(StepSlice(
+                step=s, phase="comm",
+                measured_ms=_time_ms(
+                    ship_fn, (seg_chunk,), trials=trials,
+                    label=f"kprobe.moe_reduce_rs.step{s}.comm",
+                    bytes_accessed=m_pad * D * el),
+                predicted_ms=pred_comm,
+                desc=f"ship + add [{m_pad}, {D}] segment partial"))
+    return OverlapReport(
+        kernel="moe_reduce_rs", world=world,
+        shape={"T": T, "D": D, "n_experts": n_experts, "topk": topk,
+               "block_m": block_m, "rows": rows},
+        dtype=str(jnp.dtype(dtype)), fused_ms=fused_ms,
+        compute_ms=compute_ms, comm_ms=comm_ms, slices=slices,
+        backend=jax.default_backend(), trials=trials)
+
+
+def probe_sp_decode(mesh: Mesh, *, axis: str = "sp", B: int = 4,
+                    Hq: int = 8, Hkv: int = 2, S: int = 512,
+                    D: int = 64, dtype=jnp.float32, impl: str = "auto",
+                    trials: int = 3, seed: int = 0) -> OverlapReport:
+    """Scoreboard for the SP flash-decode combine (the serving engine's
+    ``kv_shard="seq"`` attention): fused = ``sp_gqa_decode_shard``
+    (local split-KV partials + inter-rank LSE combine); compute-only =
+    the local partials alone; comm-only = the combine alone on
+    precomputed partials.  The schedule has one step with two phases
+    (local decode, then the partial-plane exchange) — sliced the same
+    way."""
+    world = int(mesh.shape[axis])
+    if S % (world or 1):
+        raise ValueError(f"S ({S}) must divide by world ({world})")
+    s_loc = S // world
+    el = jnp.dtype(dtype).itemsize
+    ks = jax.random.split(jax.random.key(seed), 4)
+    q = (jax.random.normal(ks[0], (B, Hq, D), jnp.float32)
+         * 0.1).astype(dtype)
+    k = (jax.random.normal(ks[1], (B, Hkv, S, D), jnp.float32)
+         * 0.1).astype(dtype)
+    v = (jax.random.normal(ks[2], (B, Hkv, S, D), jnp.float32)
+         * 0.1).astype(dtype)
+    kv_lens = jnp.full((B,), S, jnp.int32)
+    seq = P(None, None, axis)
+
+    fused_fn = _sjit(_sp_fused_leg, mesh, (P(), seq, seq, P()), P(),
+                     axis=axis, impl=impl, interpret=False)
+    kv_bytes = 2 * B * Hkv * s_loc * D * el
+    fused_ms = _time_ms(fused_fn, (q, k, v, kv_lens), trials=trials,
+                        label="kprobe.sp_decode.fused",
+                        flops=4 * B * Hq * s_loc * D,
+                        bytes_accessed=kv_bytes)
+
+    comp_fn = _sjit(_local_decode_leg, mesh, (P(), seq, seq, P()),
+                    (P(axis), P(axis)), axis=axis, impl=impl,
+                    interpret=False)
+    compute_ms = _time_ms(comp_fn, (q, k, v, kv_lens), trials=trials,
+                          label="kprobe.sp_decode.compute_only",
+                          flops=4 * B * Hq * s_loc * D,
+                          bytes_accessed=kv_bytes)
+    out_all, lse_all = comp_fn(q, k, v, kv_lens)
+    comb_fn = _sjit(_sp_combine_leg, mesh, (P(axis), P(axis)), P(),
+                    axis=axis, impl=impl, interpret=False)
+    payload = B * Hq * (D + 1) * 4
+    comm_ms = (_time_ms(comb_fn, (out_all, lse_all), trials=trials,
+                        label="kprobe.sp_decode.comm_only",
+                        bytes_accessed=payload * (world - 1))
+               if world > 1 else 0.0)
+
+    # roofline: decode is HBM-bound (the KV read), the combine is the
+    # partial-plane allgather
+    gbps = perf_model.get_hbm_gbps()
+    pred_comp = kv_bytes / (gbps * 1e6) if gbps else 0.0
+    pred_comm = (perf_model.estimate_allgather_time_ms(payload, world)
+                 if world > 1 else 0.0)
+    slices = [StepSlice(
+        step=0, phase="compute", measured_ms=compute_ms,
+        predicted_ms=pred_comp,
+        desc=f"local split-KV decode over [B={B}, Hkv={Hkv}, "
+             f"S_loc={s_loc}, D={D}]")]
+    if world > 1:
+        slices.append(StepSlice(
+            step=0, phase="comm", measured_ms=comm_ms,
+            predicted_ms=pred_comm,
+            desc="inter-rank LSE combine of (out ⊕ lse) partials"))
+    return OverlapReport(
+        kernel="sp_decode", world=world,
+        shape={"B": B, "Hq": Hq, "Hkv": Hkv, "S": S, "D": D},
+        dtype=str(jnp.dtype(dtype)), fused_ms=fused_ms,
+        compute_ms=compute_ms, comm_ms=comm_ms, slices=slices,
+        backend=jax.default_backend(), trials=trials)
+
+
+PROBES = {
+    "ag_gemm": probe_ag_gemm,
+    "gemm_rs": probe_gemm_rs,
+    "moe_reduce_rs": probe_moe_reduce_rs,
+    "sp_decode": probe_sp_decode,
+}
+
+
+def run_probe(kernel: str, mesh: Mesh, **kw) -> OverlapReport:
+    """Dispatch one scoreboard probe by kernel name (:data:`KERNELS`)."""
+    try:
+        fn = PROBES[kernel]
+    except KeyError:
+        raise ValueError(
+            f"unknown kernel {kernel!r}; choose from {KERNELS}") from None
+    return fn(mesh, **kw)

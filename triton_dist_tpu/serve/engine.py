@@ -770,6 +770,14 @@ class ServeEngine:
         self.trace = FlightRecorder(capacity=trace_events,
                                     level=trace_level)
         self.metrics.attach_recorder(self.trace)
+        # per-program wall-time attribution (docs/observability.md
+        # "Kernel observability"): behind the SAME trace_level knob as
+        # the recorder, register_compiled below wires every program's
+        # CountingJit timer into metrics.observe_program — step time
+        # decomposes by device program (summary()["programs"],
+        # serve_program_ms{program=}), and the bench_serve --trace
+        # overhead gate measures the timers together with the ring.
+        self.metrics.program_timing = trace_level >= 1
         self._trace_fault_idx = 0   # audit entries already mirrored
         self._last_flight_step = -1  # flush throttle: one file per step
         self.draft = draft
@@ -940,7 +948,8 @@ class ServeEngine:
                 if "paged_verify" in progs else None)
             if self.horizon > 1:
                 self._horizon_fn = CountingJit(progs["decode_horizon"],
-                                               "decode_horizon")
+                                               "decode_horizon",
+                                               timed_statics=("H",))
             self._fill_fn = CountingJit(progs["fill_pages"],
                                         "fill_pages")
             self._load_fn = CountingJit(progs["load_pages"],
@@ -967,7 +976,8 @@ class ServeEngine:
                         _paged_decode_horizon, cfg=cfg, page=page_size,
                         impl=impl, interpret=interpret),
                     static_argnames=("H", "all_greedy"),
-                    donate_argnums=(1,)), "decode_horizon")
+                    donate_argnums=(1,)), "decode_horizon",
+                    timed_statics=("H",))
             # scratch is not donatable (the page reshape transposes it);
             # pools are — the scatter updates them in place.
             self._fill_fn = CountingJit(jax.jit(functools.partial(
@@ -1083,7 +1093,8 @@ class ServeEngine:
                 # seeded accept on replicated logits
                 # (serve/mesh.tp_spec_round_shard).
                 self._spec_fused_fn = CountingJit(
-                    self._mesh_progs["spec_round"], "spec_round")
+                    self._mesh_progs["spec_round"], "spec_round",
+                    timed_statics=("K",))
                 self.metrics.register_compiled(self._spec_fused_fn)
                 self._draft_tail_fn = CountingJit(
                     self._mesh_progs["draft_tail_step"],
@@ -1104,7 +1115,8 @@ class ServeEngine:
                         impl=impl, interpret=interpret,
                         draft_step=draft_fwd),
                     static_argnames=("K", "all_greedy"),
-                    donate_argnums=(2, 3)), "spec_round")
+                    donate_argnums=(2, 3)), "spec_round",
+                    timed_statics=("K",))
                 self.metrics.register_compiled(self._spec_fused_fn)
                 # The k<=0 tail's closing draft step — the same
                 # mesh-free forward, standalone (going through
@@ -1924,6 +1936,10 @@ class ServeEngine:
         # a production ring starting with __warmup_ lifecycles would
         # waste its bounded capacity on events nobody can act on
         saved_lvl, self.trace.level = self.trace.level, 0
+        # ... nor the per-program wall-time histograms: warmup calls ARE
+        # compile stalls, and the timers are bound to ``saved`` (the
+        # production metrics object), so pause at the master gate
+        saved_pt, saved.program_timing = saved.program_timing, False
         try:
             with guard:
                 prev, round_ = -1, 0
@@ -2043,6 +2059,7 @@ class ServeEngine:
             self._in_warmup = False
             self.bm.prefix_cache = saved_pc
             self.trace.level = saved_lvl
+            saved.program_timing = saved_pt
             self.metrics = saved
         dt = time.perf_counter() - t0
         fresh = self.metrics.compile_misses - misses0

@@ -1728,6 +1728,21 @@ SCRAPE_HISTOGRAMS = (
     "serve_snapshot_seconds",
 )
 
+#: The labeled per-program wall-time histogram family
+#: (``serve_program_ms{program="..."}``, docs/observability.md "Kernel
+#: observability"): :func:`merge_scrapes` discovers the program labels
+#: per scrape and rebuilds each program's histogram bucket-exactly,
+#: like the unlabeled SLO histograms above.
+PROGRAM_HISTOGRAM = "serve_program_ms"
+
+
+def _scrape_program_labels(series: dict) -> list:
+    """Program names present in one scrape's ``serve_program_ms``
+    family (from the ``_count{program="..."}`` series)."""
+    prefix = PROGRAM_HISTOGRAM + '_count{program="'
+    return [key[len(prefix):-2] for key in series
+            if key.startswith(prefix)]
+
 
 def merge_scrapes(texts: list) -> str:
     """Merge per-replica ``/metrics`` scrape texts into ONE fleet-level
@@ -1746,6 +1761,7 @@ def merge_scrapes(texts: list) -> str:
     would undercount exactly there (the tier-1 merge-vs-pooled test
     pins this)."""
     hists = {h: LogHistogram() for h in SCRAPE_HISTOGRAMS}
+    prog_hists: dict[str, LogHistogram] = {}
     sums: dict[str, float] = {}
     maxes: dict[str, float] = {}
     types: dict[str, str] = {}
@@ -1754,10 +1770,16 @@ def merge_scrapes(texts: list) -> str:
         g = parse_prometheus(text)
         for h, acc in hists.items():
             acc.merge(LogHistogram.from_prom(g, h))
+        # per-program wall-time family: rebuild each labeled member
+        # bucket-exactly (a program only one replica ran still joins)
+        for prog in _scrape_program_labels(g):
+            prog_hists.setdefault(prog, LogHistogram()).merge(
+                LogHistogram.from_prom(g, PROGRAM_HISTOGRAM,
+                                       labels=f'program="{prog}"'))
         for key, v in g.items():
             base = key.split("{", 1)[0]
             if any(base == h or base.startswith(h + "_")
-                   for h in SCRAPE_HISTOGRAMS):
+                   for h in SCRAPE_HISTOGRAMS + (PROGRAM_HISTOGRAM,)):
                 continue   # histogram series: rebuilt above
             if key not in sums and key not in maxes:
                 order.append(key)
@@ -1781,6 +1803,9 @@ def merge_scrapes(texts: list) -> str:
         L.append(f"{key} {v:.17g}")
     for h, acc in hists.items():
         L.extend(acc.prom_lines(h))
+    for i, prog in enumerate(sorted(prog_hists)):
+        L.extend(prog_hists[prog].prom_lines(
+            PROGRAM_HISTOGRAM, labels=f'program="{prog}"', typed=i == 0))
     return "\n".join(L) + "\n"
 
 

@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -490,15 +491,26 @@ class ShardedProgram:
       unchanged.
     - ``donate_argnums`` applies to the placed arrays; the engine
       already reassigns donated carries from the outputs.
+    - ``timer`` (optional ``(label, ms)`` callable, the
+      ``jit_cache.CountingJit`` protocol): every call's wall time —
+      placement included, it is part of what the program costs — is
+      reported under ``name`` suffixed with the ``timed_statics``
+      kwargs' values (``decode_horizon[H=8]``).  The engine wires its
+      CountingJit wrapper's timer instead (one seam for mesh and
+      world-1 programs); this hook serves direct ShardedProgram users.
     """
 
     def __init__(self, body, mesh, in_specs, out_specs, *,
-                 donate_argnums=()):
+                 donate_argnums=(), name=None, timer=None,
+                 timed_statics=()):
         self.body = body
         self.mesh = mesh
         self.in_specs = tuple(in_specs)
         self.out_specs = out_specs
         self.donate_argnums = tuple(donate_argnums)
+        self.name = name or getattr(body, "__name__", "sharded_program")
+        self.timer = timer
+        self.timed_statics = tuple(timed_statics)
         self._placements = tuple(_shardings_of(mesh, s)
                                  for s in self.in_specs)
         self._jits: dict = {}
@@ -521,10 +533,24 @@ class ShardedProgram:
         return jax.tree_util.tree_map(_place, value, self._placements[i])
 
     def __call__(self, *args, **statics):
+        timer = self.timer
+        before = self._cache_size() if timer is not None else 0
+        t0 = time.perf_counter() if timer is not None else 0.0
         placed = tuple(
             jax.tree_util.tree_map(_place, a, p)
             for a, p in zip(args, self._placements))
-        return self._prog(tuple(sorted(statics.items())))(*placed)
+        out = self._prog(tuple(sorted(statics.items())))(*placed)
+        # compile calls (cache grew) stay out of the distributions —
+        # the same rule as CountingJit: stalls are compile accounting,
+        # not program wall time
+        if timer is not None and self._cache_size() == before:
+            label = self.name
+            for k in self.timed_statics:
+                v = statics.get(k)
+                if v is not None:
+                    label = f"{label}[{k}={v}]"
+            timer(label, (time.perf_counter() - t0) * 1e3)
+        return out
 
     def _cache_size(self) -> int:
         # CountingJit keys its miss accounting on this (a fresh static

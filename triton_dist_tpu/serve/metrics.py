@@ -360,6 +360,16 @@ class ServeMetrics:
                                     repr=False)
     hist_snapshot: LogHistogram = field(default_factory=LogHistogram,
                                         repr=False)
+    # per-program wall-time attribution (docs/observability.md "Kernel
+    # observability"): one LogHistogram of per-call wall MILLISECONDS
+    # per device program (paged_decode, decode_horizon[H=8], prefill
+    # chunk, verify, spec rung, page scatter/gather/COW), fed by the
+    # CountingJit/ShardedProgram ``timer`` hook the engine wires when
+    # trace_level >= 1 — engine step time decomposes by program instead
+    # of being one opaque hist_step.  ``program_timing`` is the master
+    # gate (warmup pauses it so compile stalls never pollute p99).
+    program_hists: dict = field(default_factory=dict, repr=False)
+    program_timing: bool = False
     # flight recorder (serve/trace.FlightRecorder) the engine attaches
     # so the exposition can report ring occupancy
     recorder: object = field(default=None, repr=False)
@@ -380,6 +390,35 @@ class ServeMetrics:
         self.kv_util_sum += kv_utilization
         if kv_utilization > self.kv_util_peak:
             self.kv_util_peak = kv_utilization
+
+    # -- per-program wall-time attribution --------------------------------
+
+    def program_hist(self, name: str) -> LogHistogram:
+        """Get-or-create the per-call wall-time histogram (milliseconds)
+        for device program ``name`` — every engine shares one bucket
+        scheme so :meth:`merge` and ``merge_scrapes`` stay bucket-exact
+        across the fleet."""
+        h = self.program_hists.get(name)
+        if h is None:
+            h = self.program_hists[name] = LogHistogram()
+        return h
+
+    def observe_program(self, name: str, ms: float) -> None:
+        """One program call's wall time (the CountingJit/ShardedProgram
+        ``timer`` hook target).  No-op while ``program_timing`` is off —
+        the trace_level gate and warmup's pause both flip this flag, so
+        the hot path stays one attribute check when attribution is
+        disabled and compile stalls never land in the distributions."""
+        if not self.program_timing:
+            return
+        self.program_hist(name).observe(ms)
+
+    def program_stats(self) -> dict:
+        """``summary()["programs"]``: per-program p50/p95/p99/mean/count
+        wall milliseconds — which device program ate a slow step, as a
+        number instead of archaeology."""
+        return {name: self.program_hists[name].stats()
+                for name in sorted(self.program_hists)}
 
     def observe_finish(self, request_id: str, rm: RequestMetrics,
                        reason=None) -> None:
@@ -510,6 +549,10 @@ class ServeMetrics:
                              (self.hist_step, other.hist_step),
                              (self.hist_snapshot, other.hist_snapshot)):
             mine.merge(theirs)
+        # per-program wall-time histograms merge bucket-exactly by name
+        # (a program only one replica ran still joins the aggregate)
+        for name, theirs in other.program_hists.items():
+            self.program_hist(name).merge(theirs)
         return self
 
     def attach_block_manager(self, bm) -> None:
@@ -595,6 +638,7 @@ class ServeMetrics:
             "peak_kv_utilization": self.kv_util_peak,
             "decode": self.decode_stats(),
             "latency": self.latency_stats(),
+            "programs": self.program_stats(),
         }
 
     # -- compilation observability ---------------------------------------
@@ -602,8 +646,15 @@ class ServeMetrics:
     def register_compiled(self, counter) -> None:
         """Track a ``jit_cache.CountingJit``-wrapped program; its
         hit/miss/compile-time counters appear in :meth:`summary` under
-        ``compilation`` (and on the ``TDT_DUMP_IR`` dump path)."""
+        ``compilation`` (and on the ``TDT_DUMP_IR`` dump path).  With
+        ``program_timing`` armed the wrapper's ``timer`` hook is wired
+        here too, so every registered program feeds its per-call wall
+        time into :meth:`observe_program` (docs/observability.md
+        "Kernel observability")."""
         self.compiled_fns.append(counter)
+        if (self.program_timing
+                and getattr(counter, "timer", None) is None):
+            counter.timer = self.observe_program
 
     @property
     def compile_misses(self) -> int:
@@ -667,6 +718,7 @@ class ServeMetrics:
             "max_ttft": max_ttft,
             "mean_itl": mean_itl,
             "latency": self.latency_stats(),
+            "programs": self.program_stats(),
             "decode": self.decode_stats(),
             "spec": self.spec_stats(),
             "failures": self.failure_stats(),
@@ -762,6 +814,14 @@ class ServeMetrics:
                            ("serve_snapshot_seconds",
                             self.hist_snapshot)):
             L.extend(hist.prom_lines(name))
+        # per-program wall-time attribution: ONE labeled histogram
+        # family (dense buckets like the SLO histograms, so fleet
+        # scrape-and-merge stays bucket-exact per program); the TYPE
+        # header rides the first member only
+        for i, name in enumerate(sorted(self.program_hists)):
+            L.extend(self.program_hists[name].prom_lines(
+                "serve_program_ms", labels=f'program="{name}"',
+                typed=i == 0))
         return "\n".join(L) + "\n"
 
     def maybe_dump(self, name: str = "serve_metrics") -> Optional[str]:
@@ -798,13 +858,22 @@ def format_statline(s: dict) -> str:
         v = h.get(k)
         return f"{v * 1e3:.1f}" if v is not None else "-"
 
-    return (f"step {s['steps']} | {s['completed']} done, "
+    line = (f"step {s['steps']} | {s['completed']} done, "
             f"{s['decode']['decode_tokens']} decode toks | "
             f"queue {s.get('max_queue_depth', 0)} peak | "
             f"kv {s.get('peak_kv_utilization', 0.0):.2f} peak | "
             f"ttft p50/p95/p99 {p(ttft, 'p50')}/{p(ttft, 'p95')}/"
             f"{p(ttft, 'p99')} ms | itl p50/p95/p99 {p(itl, 'p50')}/"
             f"{p(itl, 'p95')}/{p(itl, 'p99')} ms")
+    progs = s.get("programs") or {}
+    if progs:
+        # the program eating the most wall time this life (count * mean)
+        top = max(progs, key=lambda n: (progs[n]["count"] or 0)
+                  * (progs[n]["mean"] or 0.0))
+        st = progs[top]
+        line += (f" | top program {top} "
+                 f"p50 {st['p50']:.2f} ms x{st['count']}")
+    return line
 
 
 def format_stats(s: dict, *, spec: bool = False, prefix: bool = False,
@@ -835,6 +904,18 @@ def format_stats(s: dict, *, spec: bool = False, prefix: bool = False,
         f"tokens ({d['decode_steps']} device steps) — "
         f"{d['tokens_per_dispatch']:.2f} tokens/dispatch, "
         f"{d['dispatches_per_token']:.3f} dispatches/token")
+    progs = s.get("programs") or {}
+    if progs:
+        # per-program wall-time attribution (trace_level >= 1), worst
+        # total-time first — the step-time decomposition that replaces
+        # "which program ate the slow step" archaeology
+        by_total = sorted(
+            progs, key=lambda n: (progs[n]["count"] or 0)
+            * (progs[n]["mean"] or 0.0), reverse=True)
+        parts = ", ".join(
+            f"{n} p50/p99 {progs[n]['p50']:.2f}/{progs[n]['p99']:.2f} "
+            f"x{progs[n]['count']}" for n in by_total[:6])
+        lines.append(f"program ms: {parts}")
     if spec:
         sp = s["spec"]
         lines.append(

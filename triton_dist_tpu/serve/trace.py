@@ -259,6 +259,7 @@ class LogHistogram:
 
     @classmethod
     def from_prom(cls, series: dict, name: str, *,
+                  labels: str = "",
                   lo: float = 1e-6, hi: float = 4000.0,
                   per_decade: int = 24) -> "LogHistogram":
         """Rebuild a histogram from its own text exposition (a
@@ -267,18 +268,23 @@ class LogHistogram:
         buckets de-accumulate back into per-bucket counts on the SAME
         scheme, so a scrape-reconstructed histogram merges bucket-
         exactly with a live one; ``_sum``/``_count`` and the
-        ``_min``/``_max`` gauges restore the exact scalar fields."""
+        ``_min``/``_max`` gauges restore the exact scalar fields.
+        ``labels`` selects one series of a labeled histogram family
+        (e.g. ``'program="paged_decode"'`` for ``serve_program_ms`` —
+        the exact label text :meth:`prom_lines` emitted)."""
         h = cls(lo=lo, hi=hi, per_decade=per_decade)
-        h.count = int(series.get(f"{name}_count", 0))
-        h.sum = float(series.get(f"{name}_sum", 0.0))
+        lab = f"{{{labels}}}" if labels else ""
+        h.count = int(series.get(f"{name}_count{lab}", 0))
+        h.sum = float(series.get(f"{name}_sum{lab}", 0.0))
         if h.count:
-            h.min = float(series.get(f"{name}_min", float("inf")))
-            h.max = float(series.get(f"{name}_max", float("-inf")))
+            h.min = float(series.get(f"{name}_min{lab}", float("inf")))
+            h.max = float(series.get(f"{name}_max{lab}", float("-inf")))
         buckets = []
-        prefix = f"{name}_bucket{{le=\""
+        inner = f"{labels}," if labels else ""
+        prefix = f"{name}_bucket{{{inner}le=\""
         for key, v in series.items():
             if key.startswith(prefix) and not key.startswith(
-                    f"{name}_bucket{{le=\"+Inf"):
+                    f"{name}_bucket{{{inner}le=\"+Inf"):
                 buckets.append((float(key[len(prefix):-2]), int(v)))
         buckets.sort()
         acc = 0
@@ -288,11 +294,16 @@ class LogHistogram:
         h.counts[-1] = h.count - acc   # overflow: past the last edge
         return h
 
-    def prom_lines(self, name: str) -> list[str]:
+    def prom_lines(self, name: str, *, labels: str = "",
+                   typed: bool = True) -> list[str]:
         """Prometheus text-exposition lines for this histogram —
         DENSE cumulative ``_bucket{le=}`` (EVERY bucket edge in the
         scheme, zero-traffic ones included, plus ``+Inf``), then
         ``_sum``/``_count`` and exact ``_min``/``_max`` gauges.
+        ``labels`` prepends extra label pairs to every bucket and
+        suffixes the scalar series (the ``serve_program_ms{program=}``
+        family); ``typed=False`` suppresses the ``# TYPE`` header so a
+        labeled family emits it once, on its first member.
 
         Dense matters for aggregation: every engine shares one bucket
         scheme, so every replica's exposition carries the IDENTICAL
@@ -307,24 +318,28 @@ class LogHistogram:
         equality).  Cost: ~230 lines per histogram — a few tens of KB
         per scrape, the price of correct `histogram_quantile` over
         `sum by (le)`."""
-        out = [f"# TYPE {name} histogram"]
+        out = [f"# TYPE {name} histogram"] if typed else []
+        inner = f"{labels}," if labels else ""
+        lab = f"{{{labels}}}" if labels else ""
         acc = 0
         for i in range(len(self.counts) - 1):
             acc += self.counts[i]
             le = self.lo if i == 0 else self.edge(i - 1)
-            out.append(f'{name}_bucket{{le="{le:.6g}"}} {acc}')
-        out.append(f'{name}_bucket{{le="+Inf"}} {self.count}')
+            out.append(f'{name}_bucket{{{inner}le="{le:.6g}"}} {acc}')
+        out.append(f'{name}_bucket{{{inner}le="+Inf"}} {self.count}')
         # .17g: enough digits to round-trip a float64 exactly, so a
         # scrape reconstruction (from_prom) recovers sum/min/max EXACTLY
-        out.append(f"{name}_sum {self.sum:.17g}")
-        out.append(f"{name}_count {self.count}")
+        out.append(f"{name}_sum{lab} {self.sum:.17g}")
+        out.append(f"{name}_count{lab} {self.count}")
         if self.count:
             # exact extremes ride as gauges so a scrape reconstruction
             # (from_prom) merges with exact min/max, not bucket edges
-            out.append(f"# TYPE {name}_min gauge")
-            out.append(f"{name}_min {self.min:.17g}")
-            out.append(f"# TYPE {name}_max gauge")
-            out.append(f"{name}_max {self.max:.17g}")
+            if typed:
+                out.append(f"# TYPE {name}_min gauge")
+            out.append(f"{name}_min{lab} {self.min:.17g}")
+            if typed:
+                out.append(f"# TYPE {name}_max gauge")
+            out.append(f"{name}_max{lab} {self.max:.17g}")
         return out
 
 
