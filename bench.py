@@ -552,6 +552,31 @@ def _bench_kernel_report():
     return k["overlap_efficiency"], k["model_vs_measured"]
 
 
+def _bench_lint() -> dict:
+    """dist-lint verdict for the artifact (ISSUE 15, docs/analysis.md):
+    run the full static-analysis rule registry — annotation coverage,
+    trace-taxonomy closure, unseeded randomness, unique collective
+    ids, and the CommSchedule race/deadlock checker over every ring
+    kernel at worlds 2-32 — and stamp {rules run, violations, waived,
+    stale waivers} so a trajectory audit reads the lint state that
+    shipped with each bench round.  Guarded like the floors loader: a
+    lint crash must never block the bench artifact (it stamps the
+    error instead)."""
+    try:
+        from triton_dist_tpu.analysis import run_rules
+
+        rep = run_rules()
+        return {
+            "rules_run": len(rep["rules_run"]),
+            "violations": len(rep["violations"]),
+            "waived": len(rep["waived"]),
+            "stale_waivers": len(rep["stale_waivers"]),
+            "ok": rep["ok"] and not rep["stale_waivers"],
+        }
+    except Exception as e:  # noqa: BLE001 — stamp, don't block
+        return {"error": f"{type(e).__name__}: {e}", "ok": False}
+
+
 def _environment_provenance(contended: bool) -> dict:
     """Environment stamp for the bench artifact (ROADMAP #5b
     follow-through, docs/perf.md 'Bench trajectory'): the absolute
@@ -632,6 +657,7 @@ def main():
     fleet_trace_overhead = _bench_serve_fleet_trace()
     mesh_zero_loss, mesh_tps = _bench_serve_mesh()
     overlap_eff, model_vs_meas = _bench_kernel_report()
+    lint = _bench_lint()
 
     peak = peak_bf16_tflops()
     vs = (tflops / peak) / REF_UTILIZATION if peak else 0.0
@@ -706,6 +732,12 @@ def main():
         # a depressed sentinel means the HOST was contended during this
         # session and `value` is a lower bound, not a regression.
         "sentinel_dot_tflops": round(sentinel_tflops, 1),
+        # dist-lint verdict (scripts/lint_dist.py, docs/analysis.md):
+        # rule registry size + violation/waiver counts at bench time —
+        # the trajectory-audit field that says whether THIS round's
+        # numbers came from a tree with unexplained static-analysis
+        # violations.
+        "lint": lint,
     }
     # Guardrail floors (PERF_FLOORS.json, ROADMAP #5b): vs_floor >= 1.0
     # per metric means at-or-above its floor; below_floor lists the

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""dist-lint — the static-analysis gate (docs/analysis.md).
+
+Runs the registered source-lint rules (``analysis/rules.py``: annotation
+coverage, trace-taxonomy closure, unseeded randomness, unique collective
+ids, the ring-schedule race/deadlock checker), applies the waiver file,
+writes a JSON report, and exits nonzero on any UNWAIVED violation or any
+stale waiver — so CI and the tier-1 gate read one verdict.
+
+    python scripts/lint_dist.py                      # full rule set
+    python scripts/lint_dist.py --list               # show rules
+    python scripts/lint_dist.py --rules ring-schedules-clean
+    python scripts/lint_dist.py --json /tmp/lint.json
+    python scripts/lint_dist.py --jaxpr              # + engine audit
+    python scripts/lint_dist.py --self-test          # + mutation sweep
+
+``--jaxpr`` additionally builds a tiny world-1 serving engine on the CPU
+backend, warms it, drives a short mixed greedy/sampled workload, and
+runs the jaxpr auditor over its full program registry (slower: it
+compiles real programs).  ``--self-test`` runs the seeded schedule
+mutation sweep (every corruption class must be caught — the checker's
+own acceptance bar).
+
+Waivers: ``LINT_WAIVERS.json`` at the repo root, shape
+``{"waivers": [{"rule": ..., "match": <substring of the violation's
+identity>, "reason": <why this is acceptable>}]}``.  A waiver that no
+longer matches anything is STALE and fails the gate too — fixed code
+sheds its waiver instead of keeping a hole open.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _jaxpr_audit_report() -> dict:
+    """Build + warm + serve a tiny world-1 engine, audit its registry."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from triton_dist_tpu.analysis import audit_engine
+    from triton_dist_tpu.models import llama
+    from triton_dist_tpu.models.generate import Generator
+    from triton_dist_tpu.serve.engine import ServeEngine
+    from triton_dist_tpu.serve.request import Request, SamplingParams
+
+    cfg = llama.LlamaConfig(vocab=64, dim=16, n_layers=1, n_heads=2,
+                            n_kv_heads=1, ffn_dim=32, max_seq=64,
+                            dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.key(0))
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    gen = Generator(cfg, mesh1, axis="sp", max_seq=64)
+    eng = ServeEngine(gen, params, num_blocks=16, page_size=4,
+                      max_batch=2, prefill_chunk=4, prefill_budget=8,
+                      horizon=4)
+    eng.warmup()
+    rng = np.random.default_rng(3)
+    for i, n in enumerate((5, 9)):
+        p = rng.integers(0, cfg.vocab, size=n).astype(np.int32)
+        sp = (SamplingParams(max_new_tokens=4) if i % 2 == 0 else
+              SamplingParams(max_new_tokens=4, temperature=0.7,
+                             top_k=16, seed=11 + i))
+        eng.submit(Request(f"lint{i}", p, sp))
+    steps = 0
+    while eng.has_work():
+        eng.step()
+        steps += 1
+        assert steps < 200, "lint engine wedged"
+    rep = audit_engine(eng)
+    return {
+        "programs": rep["programs"],
+        "audited": rep["audited"],
+        "skipped": rep["skipped"],
+        "findings": [str(f) for f in rep["findings"]],
+    }
+
+
+def main(argv=None) -> int:
+    from triton_dist_tpu.analysis import rules as rules_mod
+
+    ap = argparse.ArgumentParser(
+        description="static race/deadlock + source lint for the "
+                    "distributed kernel library and serving stack")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset (default: all)")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered rules and exit")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="write the JSON report here")
+    ap.add_argument("--waivers", default=None, metavar="PATH",
+                    help=f"waiver file (default {rules_mod.WAIVERS_PATH})")
+    ap.add_argument("--jaxpr", action="store_true",
+                    help="also audit a tiny engine's program registry "
+                         "(compiles real programs — slower)")
+    ap.add_argument("--self-test", action="store_true",
+                    help="also run the seeded schedule-mutation sweep")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in sorted(rules_mod.RULES):
+            doc = (rules_mod.RULES[name].__doc__ or "").strip()
+            print(f"{name}: {doc.splitlines()[0] if doc else ''}")
+        return 0
+
+    names = args.rules.split(",") if args.rules else None
+    report = rules_mod.run_rules(names, waivers_path=args.waivers)
+
+    if args.self_test:
+        from triton_dist_tpu.analysis import mutation_self_test
+
+        try:
+            report["mutation_self_test"] = mutation_self_test()
+        except AssertionError as e:
+            report["mutation_self_test"] = {"error": str(e)}
+            report["ok"] = False
+
+    if args.jaxpr:
+        jrep = _jaxpr_audit_report()
+        report["jaxpr_audit"] = jrep
+        if jrep["findings"]:
+            report["ok"] = False
+
+    rc = 0
+    for v in report["violations"]:
+        print(f"VIOLATION  {v}")
+        rc = 1
+    for w in report["waived"]:
+        print(f"waived     {w['violation']}  ({w['reason']})")
+    for w in report["stale_waivers"]:
+        print(f"STALE WAIVER  {w['rule']} / {w['match']!r} matches "
+              f"nothing — delete it or re-break the code")
+        rc = 1
+    for f in report.get("jaxpr_audit", {}).get("findings", []):
+        print(f"VIOLATION  {f}")
+        rc = 1
+    mst = report.get("mutation_self_test")
+    if isinstance(mst, dict) and "error" in mst:
+        print(f"SELF-TEST HOLE  {mst['error']}")
+        rc = 1
+
+    report["ok"] = rc == 0
+    if args.json:
+        os.makedirs(os.path.dirname(os.path.abspath(args.json)),
+                    exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2)
+    n_rules = len(report["rules_run"])
+    print(f"# lint_dist: {n_rules} rules, "
+          f"{len(report['violations'])} violation(s), "
+          f"{len(report['waived'])} waived, "
+          f"{len(report['stale_waivers'])} stale waiver(s)"
+          + (f", jaxpr audit: {len(report['jaxpr_audit']['audited'])} "
+             f"program(s), {len(report['jaxpr_audit']['findings'])} "
+             f"finding(s)" if args.jaxpr else "")
+          + (" — OK" if rc == 0 else " — FAIL"))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

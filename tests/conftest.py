@@ -166,6 +166,14 @@ _FAST_GATE_MODULES = {
     # and the kprobe-merges-with-engine-trace Perfetto wiring, plus
     # the original dump/group_profile merge units (all cheap).
     "test_observability",
+    # dist-lint static analysis (ISSUE 15): the CommSchedule
+    # race/deadlock checker over every ring kernel at worlds 2-32
+    # (non-pow2 + world=2 edges), the seeded mutation self-test (every
+    # corruption class caught), the jaxpr auditor's synthetic-bad-
+    # program units AND the real engine/mesh registry zero-findings
+    # bar, and the rule-registry/waiver units; only the lint_dist.py
+    # subprocess CLI round-trips carry explicit @pytest.mark.slow.
+    "test_analysis",
 }
 
 # Heavy tests inside core modules whose coverage is duplicated by a

@@ -7,12 +7,10 @@ Reference analog: per-kernel ``dump_ir`` (moe_reduce_rs.py:1009-1015) and
 the single gzipped whole-job timeline (utils.py:282-501).
 """
 
-import ast
 import glob
 import gzip
 import json
 import os
-import re
 
 import jax
 import jax.numpy as jnp
@@ -105,73 +103,33 @@ def test_merge_rank_traces_renames_ranks(tmp_path):
 # the kernel library): every PUBLIC kernel entry point must run under a
 # profiling.annotate launch-metadata span — directly, or by delegating
 # to an annotated entry — so a new kernel cannot silently skip the
-# profiler.
+# profiler.  The assertion logic lives in the analysis rule registry
+# (ISSUE 15: one registry serves this test, scripts/lint_dist.py, and
+# the bench-artifact lint stamp); this test keeps the tier-1 teeth.
 # ---------------------------------------------------------------------------
-
-_KERNELS_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    "triton_dist_tpu", "kernels")
-
-#: Public entry points without a ``ctx: *Context`` parameter that must
-#: still be annotated (the heuristic below cannot discover them).
-_REQUIRED_ENTRIES = {
-    ("flash_attention.py", "flash_attention"),
-    ("group_gemm.py", "group_gemm"),
-    ("flash_decode.py", "sp_gqa_decode"),
-}
-
-
-def _kernel_module_functions():
-    """[(module file, FunctionDef node, source segment)] for every
-    top-level function in triton_dist_tpu/kernels."""
-    out = []
-    for path in sorted(glob.glob(os.path.join(_KERNELS_DIR, "*.py"))):
-        src = open(path).read()
-        for node in ast.parse(src).body:
-            if isinstance(node, ast.FunctionDef):
-                out.append((os.path.basename(path), node,
-                            ast.get_source_segment(src, node) or ""))
-    return out
 
 
 def test_kernel_entry_points_annotated():
-    """Source-grep closure: every public host-level kernel entry (any
-    top-level non-underscore function taking ``ctx: <...>Context``,
-    plus the explicit no-ctx entries) must contain ``with annotate(``
-    or (transitively) call a function that does — the launch-metadata
-    contract the reference keeps via its proton hooks
-    (allgather_gemm.py:120-130)."""
-    funcs = _kernel_module_functions()
-    entries = set(_REQUIRED_ENTRIES)
-    for fname, node, seg in funcs:
-        if node.name.startswith("_"):
-            continue
-        for a in node.args.args + node.args.kwonlyargs:
-            if a.arg == "ctx" and a.annotation is not None and \
-                    "Context" in ast.unparse(a.annotation):
-                entries.add((fname, node.name))
-    assert len(entries) >= 14, sorted(entries)   # the known surface
+    """Source-grep closure via the ``kernel-entry-annotated`` lint rule
+    (analysis/rules.py — the migrated meta-test): every public
+    host-level kernel entry (any top-level non-underscore function
+    taking ``ctx: <...>Context``, plus the registered no-ctx entries)
+    must run under ``with annotate(`` directly or by delegation."""
+    from triton_dist_tpu.analysis import run_rule
+    from triton_dist_tpu.analysis.rules import (
+        ANNOTATE_MIN_ENTRIES,
+        ANNOTATE_REQUIRED_ENTRIES,
+    )
 
-    covered = {node.name for _, node, seg in funcs
-               if "with annotate(" in seg}
-    assert covered, "no annotated kernel entries found at all"
-    for _ in range(8):   # transitive delegation (autotuned -> tunable
-        grew = False     # -> entry is 2 hops)
-        for _, node, seg in funcs:
-            if node.name in covered:
-                continue
-            if any(re.search(rf"\b{re.escape(c)}\(", seg)
-                   for c in covered):
-                covered.add(node.name)
-                grew = True
-        if not grew:
-            break
-    missing = sorted((f, n) for f, n in entries if n not in covered)
-    assert not missing, (
-        f"public kernel entry points without a profiling.annotate "
-        f"launch-metadata span (direct or delegated): {missing} — add "
-        f"`with annotate(name, flops=, bytes_accessed=)` around the "
-        f"dispatch (see ag_gemm_gathered)")
+    # the no-ctx required surface is still registered (a deleted entry
+    # would silently shrink coverage)
+    assert {("flash_attention.py", "flash_attention"),
+            ("group_gemm.py", "group_gemm"),
+            ("flash_decode.py", "sp_gqa_decode")} \
+        <= ANNOTATE_REQUIRED_ENTRIES
+    assert ANNOTATE_MIN_ENTRIES >= 14   # the known surface
+    violations = run_rule("kernel-entry-annotated")
+    assert not violations, "\n".join(str(v) for v in violations)
 
 
 # ---------------------------------------------------------------------------

@@ -91,24 +91,17 @@ def test_taxonomy_covers_finish_reasons_and_fault_points():
     reason, and every ``.fire("<point>"`` seam in the source tree maps
     to a registered fault event — so adding a retirement reason or an
     injection point without registering it here fails tier-1 instead of
-    silently skipping the flight recorder."""
-    for fr in FinishReason:
-        assert fr.value in trace_mod.RETIRE_REASONS, (
-            f"FinishReason.{fr.name} has no registered retire event "
-            f"(add it to serve/trace.RETIRE_REASONS)")
-    src = os.path.join(REPO, "triton_dist_tpu")
-    points = set()
-    for dirpath, _, names in os.walk(src):
-        for name in names:
-            if not name.endswith(".py"):
-                continue
-            with open(os.path.join(dirpath, name), encoding="utf-8") as f:
-                points |= set(re.findall(r'\.fire\(\s*"(\w+)"', f.read()))
-    assert points, "expected at least the PR 3 injection points"
-    missing = points - set(trace_mod.FAULT_POINT_EVENTS)
-    assert not missing, (
-        f"fault points {sorted(missing)} have no registered event type "
-        f"(add them to serve/trace.FAULT_POINT_EVENTS)")
+    silently skipping the flight recorder.  The assertions live in the
+    analysis rule registry (ISSUE 15: ``finish-reasons-registered`` +
+    ``fire-points-registered`` serve this test, scripts/lint_dist.py,
+    and the bench-artifact lint stamp in one place)."""
+    from triton_dist_tpu.analysis import run_rule
+
+    violations = (run_rule("finish-reasons-registered")
+                  + run_rule("fire-points-registered"))
+    assert not violations, "\n".join(str(v) for v in violations)
+    # the registry's taxonomy invariants themselves (belt and braces:
+    # a rule refactor must not drop them)
     assert set(trace_mod.FAULT_POINT_EVENTS.values()) <= \
         trace_mod.EVENT_TYPES
     assert "retire" in trace_mod.EVENT_TYPES

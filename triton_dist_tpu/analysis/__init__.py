@@ -1,0 +1,50 @@
+"""Static analysis for the distributed kernel library and the serving
+stack — runnable device-free on CPU (docs/analysis.md).
+
+Three passes over three layers:
+
+- :mod:`comm_schedule` + :mod:`schedule_check` — a small ``CommSchedule``
+  IR (steps x ranks -> sends/recvs/signals/waits/tiles-written) populated
+  by one builder per overlapped kernel, and a symbolic vector-clock
+  simulator that proves, for every world size 2-32, signal/wait credit
+  balance (no deadlock, no stranded credit), happens-before on every
+  remote read against its producing write, write-once output tiles, and
+  per-step slot-map bijectivity.  A seeded mutation self-test (dropped
+  signal, swapped slot, doubled wait, double-written tile) keeps the
+  checker honest: every corruption class must be caught.
+- :mod:`jaxpr_audit` — traces every registered engine device program
+  (the ``CountingJit``/``ShardedProgram`` registry) and checks no host
+  callbacks in fused hot paths, donated buffers actually consumed,
+  collectives only at declared seams, and statics drawn from declared
+  ladders (the retrace-hazard / executable-cache-fork class).
+- :mod:`rules` — the source-lint rule registry (the grep meta-tests,
+  promoted): annotation coverage, trace-taxonomy closure, no unseeded
+  randomness, unique collective ids, plus the schedule checker as a
+  rule.  ``scripts/lint_dist.py`` is the CLI driver (JSON report,
+  waiver file, nonzero exit on unwaived violation).
+"""
+
+from triton_dist_tpu.analysis.comm_schedule import (  # noqa: F401
+    SCHEDULE_BUILDERS,
+    CommSchedule,
+    Op,
+    arrival_slots,
+    build_schedule,
+)
+from triton_dist_tpu.analysis.schedule_check import (  # noqa: F401
+    MUTATIONS,
+    check_schedule,
+    mutate,
+    mutation_self_test,
+)
+from triton_dist_tpu.analysis.jaxpr_audit import (  # noqa: F401
+    audit_engine,
+    audit_program,
+)
+from triton_dist_tpu.analysis.rules import (  # noqa: F401
+    RULES,
+    Violation,
+    load_waivers,
+    run_rule,
+    run_rules,
+)

@@ -1,0 +1,594 @@
+"""CommSchedule — the IR the ring-schedule checker runs on.
+
+Every overlapped kernel in ``triton_dist_tpu/kernels/`` is, stripped of
+its MXU work, a communication schedule: per ring step and per rank, an
+ordered list of remote DMAs (send + arrival signal fused, the TPU
+semantics of ``dl.remote_copy``), semaphore signals/waits (``dl.notify``
+/ ``dl.wait``, credit backpressure), and buffer tile reads/writes.  The
+real kernels encode that schedule implicitly in Pallas control flow where
+an off-by-one deadlocks or silently reads a stale tile on hardware that
+CPU tier-1 can never exercise.  This module makes the schedule an
+explicit, checkable artifact: one ``build_*`` function per kernel emits
+the kernel's exact op sequence for a given world size, mirroring the
+kernel source line-for-line (each builder's docstring cites the lines it
+transcribes), and :mod:`schedule_check` symbolically executes it.
+
+The IR deliberately models TPU semantics, not NVSHMEM's:
+
+- a ``send`` is ``pltpu.make_async_remote_copy``: the arrival increment
+  on the receiver's ``rsem`` is part of the same transaction as the data
+  (no separate flag-store + fence), and the sender's ``ssem`` counts
+  completion of the source read (drain before source reuse);
+- a ``wait`` is ``pltpu.semaphore_wait`` — a full acquire barrier for
+  DMA'd data (no ``consume_token``);
+- local async copies are sends to self (one completion semaphore).
+
+Payload identity rides every send/write as a ``label`` tuple (e.g.
+``("seg", j)`` — A-segment j of the allgather ring), and every read
+declares the label it must observe — so the checker proves not just
+"some bytes arrived" but "the bytes the schedule owes this step arrived"
+(a swapped landing slot is a label mismatch, not a silent wrong answer).
+
+Slot maps: builders whose consumption order is slot-addressed publish
+``slot_maps[step] = [slot consumed by rank r at this step]`` — for the
+AG ring that is kprobe's arrival-order decomposition
+``slots[r] = (r - s) % world`` (:func:`arrival_slots`, shared with
+``runtime/kprobe.py``'s phase-sliced replay) — and the checker asserts
+each step's map is a bijection on ranks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+#: Builders registered by :func:`schedule_builder`; name -> fn(world).
+SCHEDULE_BUILDERS: dict[str, Callable] = {}
+
+
+def arrival_slots(step: int, world: int) -> list[int]:
+    """The AG ring's arrival-order slot map: at ring step ``s`` rank
+    ``r`` consumes segment slot ``(r - s) % world`` (step 0 is always
+    the local segment — the reference's rank swizzle for free).  Shared
+    contract with ``runtime/kprobe.py``'s phase-sliced replay, which
+    stamps the same map into its per-step report slices."""
+    return [(r - step) % world for r in range(world)]
+
+
+@dataclasses.dataclass
+class Op:
+    """One schedule event on one rank (program order within the rank).
+
+    kind:
+      ``send``    async (remote or to-self) DMA: reads ``(src_buf,
+                  src_slot)`` (must hold ``label``), writes ``(buf,
+                  slot)`` on rank ``dst``, increments ``rsem`` there and
+                  ``ssem`` here on completion.  ``final`` marks the
+                  landing write as an output-tile completion.
+      ``wait``    ``semaphore_wait(sem, count)`` — blocks.
+      ``signal``  ``semaphore_signal(sem, inc=count)`` on rank ``dst``.
+      ``write``   local tile write of ``label`` into ``(buf, slot)``;
+                  ``final`` = this is the tile's completing write.
+      ``read``    local read of ``(buf, slot)``; must observe ``label``
+                  (``None`` = any fully-ordered data).
+    """
+
+    kind: str
+    step: int = -1                 # ring step (-1 = pre/postlude)
+    sem: str = ""                  # wait/signal
+    count: int = 1
+    dst: int = -1                  # send/signal target rank
+    buf: str = ""
+    slot: int = 0
+    src_buf: str = ""
+    src_slot: int = 0
+    rsem: str = ""
+    ssem: str = ""
+    label: Optional[tuple] = None
+    final: bool = False
+    note: str = ""
+
+
+@dataclasses.dataclass
+class CommSchedule:
+    """The whole kernel schedule at one world size."""
+
+    kernel: str
+    world: int
+    #: per-rank program-ordered op list
+    ranks: list
+    #: (rank, buf, slot, label): data resident before the kernel entry
+    init: list = dataclasses.field(default_factory=list)
+    #: buf -> slot count: every slot must receive EXACTLY one final
+    #: write on every rank (the write-once output contract)
+    outputs: dict = dataclasses.field(default_factory=dict)
+    #: step -> per-rank consumed slot (bijectivity-checked when present)
+    slot_maps: dict = dataclasses.field(default_factory=dict)
+    meta: dict = dataclasses.field(default_factory=dict)
+
+    def n_ops(self) -> int:
+        return sum(len(r) for r in self.ranks)
+
+
+def schedule_builder(name: str):
+    def deco(fn):
+        SCHEDULE_BUILDERS[name] = fn
+        return fn
+    return deco
+
+
+def build_schedule(kernel: str, world: int) -> CommSchedule:
+    """Build one kernel's schedule IR at ``world`` ranks (>= 2; the
+    world-1 degenerate paths ship no comm and have nothing to check)."""
+    if world < 2:
+        raise ValueError(f"world must be >= 2, got {world}")
+    try:
+        fn = SCHEDULE_BUILDERS[kernel]
+    except KeyError:
+        raise ValueError(
+            f"unknown kernel {kernel!r}; registered: "
+            f"{sorted(SCHEDULE_BUILDERS)}") from None
+    return fn(world)
+
+
+# ---------------------------------------------------------------------------
+# Shared preludes
+# ---------------------------------------------------------------------------
+
+
+def _neighbor_barrier(ops: list, me: int, world: int) -> None:
+    """The ring kernels' entry barrier (allgather_gemm.py:339-344,
+    gemm_reduce_scatter.py:144-149, moe_reduce_rs.py:136-142): signal
+    both ring neighbors' barrier semaphores, wait for 2."""
+    ops.append(Op("signal", dst=(me - 1) % world, sem="barrier"))
+    ops.append(Op("signal", dst=(me + 1) % world, sem="barrier"))
+    ops.append(Op("wait", sem="barrier", count=2))
+
+
+def _full_barrier(ops: list, me: int, world: int) -> None:
+    """``dl.barrier_all`` (language/primitives.py:270-310): signal every
+    peer, wait for world-1."""
+    for i in range(1, world):
+        ops.append(Op("signal", dst=(me + i) % world, sem="barrier"))
+    ops.append(Op("wait", sem="barrier", count=world - 1))
+
+
+# ---------------------------------------------------------------------------
+# ag_gemm — overlapped AllGather-GEMM ring producer
+# ---------------------------------------------------------------------------
+
+
+@schedule_builder("ag_gemm")
+def build_ag_gemm(world: int) -> CommSchedule:
+    """``_ag_gemm_kernel`` (allgather_gemm.py:240-420, chunks=1): stage
+    the local segment into the gathered buffer (waited at exit), barrier
+    with ring neighbors, then per step ``s``: ring-forward the held
+    segment — slot ``(me - s) % world`` — to the right neighbor, compute
+    its GEMM tile, fold the NEXT segment's recv wait into the pipeline
+    prefetch, and drain the forward's send before the next step.  No
+    credit semaphore: every landing slot is globally unique (each
+    segment visits each rank once), so slots are never reused."""
+    sched = CommSchedule("ag_gemm", world, [[] for _ in range(world)])
+    for me in range(world):
+        sched.init.append((me, "a", 0, ("seg", me)))
+    sched.outputs = {"out": world, "ag": world}
+    for s in range(world):
+        sched.slot_maps[s] = arrival_slots(s, world)
+
+    for me in range(world):
+        ops = sched.ranks[me]
+        right = (me + 1) % world
+        # Staging copy: a -> ag[me] (to-self DMA; waited at kernel exit).
+        ops.append(Op("send", step=-1, dst=me, src_buf="a", src_slot=0,
+                      buf="ag", slot=me, rsem="copy_sem",
+                      label=("seg", me), final=True, note="stage local"))
+        _neighbor_barrier(ops, me, world)
+        for s in range(world):
+            slot = (me - s) % world
+            src_buf, src_slot = ("a", 0) if s == 0 else ("ag", slot)
+            if s < world - 1:
+                # Forward launches BEFORE the step's compute so the wire
+                # rides under the whole cycle (allgather_gemm.py:360-380).
+                ops.append(Op("send", step=s, dst=right, src_buf=src_buf,
+                              src_slot=src_slot, buf="ag", slot=slot,
+                              rsem="recv", ssem="send",
+                              label=("seg", slot), final=True))
+            ops.append(Op("read", step=s, buf=src_buf, slot=src_slot,
+                          label=("seg", slot), note="segment GEMM"))
+            ops.append(Op("write", step=s, buf="out", slot=slot,
+                          label=("tile", slot), final=True))
+            if s < world - 1:
+                # Next segment's arrival, waited inside this cycle's
+                # prefetch callback (allgather_gemm.py:382-397)...
+                ops.append(Op("wait", step=s, sem="recv",
+                              note="prefetch next segment"))
+                # ...then this cycle's forward drains (:404-410).
+                ops.append(Op("wait", step=s, sem="send", note="drain"))
+        ops.append(Op("wait", step=world - 1, sem="copy_sem",
+                      note="staging validity at exit"))
+    return sched
+
+
+# ---------------------------------------------------------------------------
+# gemm_rs / moe_reduce_rs — ring reduce-scatter with credit backpressure
+# ---------------------------------------------------------------------------
+
+
+def _ring_rs(kernel: str, world: int) -> CommSchedule:
+    """The shared GEMM-RS / MoE-RS ring (gemm_reduce_scatter.py:103-201,
+    moe_reduce_rs.py:120-196 — byte-identical schedules; the MoE kernel
+    swaps the inner GEMM for a grouped one).  Per step ``s``: compute
+    the partial for chunk ``(me - 1 - s) % world`` (own chunk ``me`` at
+    the last step) into send slot ``s % 2``, fold the partial arriving
+    from the left (credit the freed landing slot back), and ship the
+    accumulated partial rightward into landing slot ``(s + 1) % 2`` —
+    with per-slot DMA semaphores (a shared one could let the OTHER
+    slot's completion satisfy a drain) and a credit semaphore stopping
+    anyone from DMA-ing into a slot its owner still reads."""
+    sched = CommSchedule(kernel, world, [[] for _ in range(world)])
+    for me in range(world):
+        for c in range(world):
+            sched.init.append((me, "a", c, ("a_chunk", me, c)))
+    sched.outputs = {"out": 1}
+    # chunk consumed per step: the RS ring's slot map (bijective like
+    # the AG ring's — it is the same rotation, phase-shifted).
+    for s in range(world - 1):
+        sched.slot_maps[s] = [(r - 1 - s) % world for r in range(world)]
+    sched.slot_maps[world - 1] = list(range(world))
+
+    for me in range(world):
+        ops = sched.ranks[me]
+        right = (me + 1) % world
+        left = (me - 1) % world
+        _neighbor_barrier(ops, me, world)
+        for s in range(world):
+            p = s % 2
+            last = s == world - 1
+            chunk = me if last else (me - 1 - s) % world
+            dbuf, dslot = ("out", 0) if last else ("send", p)
+            if s >= 2:
+                # send slot p was last DMA'd at step s-2; drain before
+                # the GEMM overwrites it (per-slot semaphore).
+                ops.append(Op("wait", step=s, sem=f"send_sem{p}",
+                              note="reuse send slot"))
+            ops.append(Op("read", step=s, buf="a", slot=chunk,
+                          label=("a_chunk", me, chunk), note="chunk GEMM"))
+            ops.append(Op("write", step=s, buf=dbuf, slot=dslot,
+                          label=("partial", chunk, 1), note="own partial"))
+            if s >= 1:
+                ops.append(Op("wait", step=s, sem=f"recv_sem{p}",
+                              note="partial arrival"))
+                ops.append(Op("read", step=s, buf="recv", slot=p,
+                              label=("partial", chunk, s),
+                              note="fold arriving partial"))
+                ops.append(Op("write", step=s, buf=dbuf, slot=dslot,
+                              label=("partial", chunk, s + 1),
+                              final=last, note="fold"))
+                # Slot p is free for left's step-(s+1) send.
+                ops.append(Op("signal", step=s, dst=left, sem="credit"))
+            elif last:
+                # world == 1 cannot happen here (builders need >= 2);
+                # world == 2's last step still folds above.
+                pass
+            if not last:
+                if s >= 2:
+                    # Right's landing slot (s+1)%2 was consumed at its
+                    # step s-1; collect the credit before overwriting.
+                    ops.append(Op("wait", step=s, sem="credit"))
+                depth = s + 1 if s >= 1 else 1
+                ops.append(Op("send", step=s, dst=right, src_buf=dbuf,
+                              src_slot=dslot, buf="recv",
+                              slot=(s + 1) % 2,
+                              rsem=f"recv_sem{(s + 1) % 2}",
+                              ssem=f"send_sem{p}",
+                              label=("partial", chunk, depth)))
+        # Postlude (gemm_reduce_scatter.py:192-201): drain the final
+        # send (issued at step world-2) and the unconsumed credits.
+        pfin = (world - 2) % 2
+        ops.append(Op("wait", step=world - 1, sem=f"send_sem{pfin}",
+                      note="final send drain"))
+        n_credit_waits = max(world - 3, 0)
+        ops.append(Op("wait", step=world - 1, sem="credit",
+                      count=(world - 1) - n_credit_waits,
+                      note="drain unconsumed credits"))
+    return sched
+
+
+@schedule_builder("gemm_rs")
+def build_gemm_rs(world: int) -> CommSchedule:
+    return _ring_rs("gemm_rs", world)
+
+
+@schedule_builder("moe_reduce_rs")
+def build_moe_reduce_rs(world: int) -> CommSchedule:
+    return _ring_rs("moe_reduce_rs", world)
+
+
+# ---------------------------------------------------------------------------
+# ring_attention — KV-block ring with double-buffered slots + credits
+# ---------------------------------------------------------------------------
+
+
+@schedule_builder("ring_attention")
+def build_ring_attention(world: int) -> CommSchedule:
+    """``_ring_attention_fused_kernel`` (ring_attention.py:410-496): KV
+    blocks ring rightward through two slots.  Step ``s`` waits the k/v
+    arrivals into slot ``s % 2`` (s > 0), forwards them to slot
+    ``(s+1) % 2`` on the right (credit-gated from s >= 1: the slot was
+    consumed at right's step s-1), computes on the block — origin rank
+    ``(me - s) % world``, ring_attention.py:280-283 — drains both sends,
+    and credits the left neighbor once slot ``s % 2`` is free
+    (s < world-2: the last two steps never reuse it)."""
+    sched = CommSchedule("ring_attention", world,
+                         [[] for _ in range(world)])
+    for me in range(world):
+        sched.init.append((me, "k", 0, ("kv_k", me)))
+        sched.init.append((me, "v", 0, ("kv_v", me)))
+    sched.outputs = {"o": 1}
+    for s in range(world):
+        sched.slot_maps[s] = arrival_slots(s, world)
+
+    for me in range(world):
+        ops = sched.ranks[me]
+        right = (me + 1) % world
+        left = (me - 1) % world
+        # Stage local KV into slot 0 (to-self DMAs + waits, :431-434).
+        ops.append(Op("send", step=-1, dst=me, src_buf="k", src_slot=0,
+                      buf="kring", slot=0, rsem="copy",
+                      label=("kv_k", me), note="stage k"))
+        ops.append(Op("send", step=-1, dst=me, src_buf="v", src_slot=0,
+                      buf="vring", slot=0, rsem="copy",
+                      label=("kv_v", me), note="stage v"))
+        ops.append(Op("wait", step=-1, sem="copy", count=2))
+        _full_barrier(ops, me, world)
+        for s in range(world):
+            cur, nxt = s % 2, (s + 1) % 2
+            src = (me - s) % world
+            if s > 0:
+                ops.append(Op("wait", step=s, sem="recv", count=2,
+                              note="k+v arrival"))
+            if s < world - 1:
+                if s >= 1:
+                    ops.append(Op("wait", step=s, sem="credit",
+                                  note="right freed slot nxt"))
+                ops.append(Op("send", step=s, dst=right, src_buf="kring",
+                              src_slot=cur, buf="kring", slot=nxt,
+                              rsem="recv", ssem="send",
+                              label=("kv_k", src)))
+                ops.append(Op("send", step=s, dst=right, src_buf="vring",
+                              src_slot=cur, buf="vring", slot=nxt,
+                              rsem="recv", ssem="send",
+                              label=("kv_v", src)))
+            ops.append(Op("read", step=s, buf="kring", slot=cur,
+                          label=("kv_k", src), note="block update"))
+            ops.append(Op("read", step=s, buf="vring", slot=cur,
+                          label=("kv_v", src), note="block update"))
+            if s < world - 1:
+                ops.append(Op("wait", step=s, sem="send", count=2,
+                              note="drain forwards"))
+            if s < world - 2:
+                ops.append(Op("signal", step=s, dst=left, sem="credit"))
+        ops.append(Op("write", step=world - 1, buf="o", slot=0,
+                      label=("attn_out", me), final=True))
+    return sched
+
+
+# ---------------------------------------------------------------------------
+# all_to_all — full-mesh push with split-count plane
+# ---------------------------------------------------------------------------
+
+
+def _a2a_round(ops: list, me: int, world: int, *, nblk: int, pfx: str,
+               step: int, with_splits: bool) -> None:
+    """One ``_all_to_all_kernel`` round (all_to_all.py:140-222) at full
+    (= ``nblk`` blocks per peer) splits: local segment copied to self,
+    ``barrier_all``, split rows pushed on their own semaphore pair,
+    payload blocks pushed, outgoing drains, then incoming waits for
+    exactly the advertised counts."""
+    # Local segment: send[me] -> recv[me], never touches the wire.
+    for b in range(nblk):
+        ops.append(Op("send", step=step, dst=me, src_buf=f"{pfx}send",
+                      src_slot=me * nblk + b, buf=f"{pfx}recv",
+                      slot=me * nblk + b, rsem=f"{pfx}copy",
+                      label=("tok", me, me, b), final=True,
+                      note="local segment"))
+    if with_splits:
+        ops.append(Op("send", step=step, dst=me, src_buf=f"{pfx}splits",
+                      src_slot=me, buf=f"{pfx}rsplits", slot=me,
+                      rsem=f"{pfx}copy", label=("split", me, me),
+                      final=True))
+    ops.append(Op("wait", step=step, sem=f"{pfx}copy",
+                  count=nblk + (1 if with_splits else 0)))
+    _full_barrier(ops, me, world)
+    if with_splits:
+        # Split counts first, on their own semaphore pair (:162-168).
+        for i in range(1, world):
+            peer = (me + i) % world
+            ops.append(Op("send", step=step, dst=peer,
+                          src_buf=f"{pfx}splits", src_slot=peer,
+                          buf=f"{pfx}rsplits", slot=me,
+                          rsem=f"{pfx}srecv", ssem=f"{pfx}ssend",
+                          label=("split", me, peer), final=True))
+    # Payload blocks (:172-185).
+    for i in range(1, world):
+        peer = (me + i) % world
+        for b in range(nblk):
+            ops.append(Op("send", step=step, dst=peer,
+                          src_buf=f"{pfx}send", src_slot=peer * nblk + b,
+                          buf=f"{pfx}recv", slot=me * nblk + b,
+                          rsem=f"{pfx}recv", ssem=f"{pfx}send",
+                          label=("tok", me, peer, b), final=True))
+    # Outgoing drains (:187-203), then incoming (:205-222).
+    if with_splits:
+        ops.append(Op("wait", step=step, sem=f"{pfx}ssend",
+                      count=world - 1))
+    ops.append(Op("wait", step=step, sem=f"{pfx}send",
+                  count=(world - 1) * nblk))
+    if with_splits:
+        ops.append(Op("wait", step=step, sem=f"{pfx}srecv",
+                      count=world - 1))
+        for p in range(world):
+            if p != me:
+                ops.append(Op("read", step=step, buf=f"{pfx}rsplits",
+                              slot=p, label=("split", p, me)))
+    ops.append(Op("wait", step=step, sem=f"{pfx}recv",
+                  count=(world - 1) * nblk))
+
+
+def _a2a_read_all(ops: list, me: int, world: int, *, nblk: int,
+                  pfx: str, step: int, note: str) -> None:
+    for p in range(world):
+        for b in range(nblk):
+            ops.append(Op("read", step=step, buf=f"{pfx}recv",
+                          slot=p * nblk + b, label=("tok", p, me, b),
+                          note=note))
+
+
+@schedule_builder("all_to_all")
+def build_all_to_all(world: int, nblk: int = 2) -> CommSchedule:
+    """``_all_to_all_kernel`` (all_to_all.py:140-222) at full splits
+    (every peer segment = ``nblk`` blocks; partial splits only shrink
+    the block counts both drain loops derive from the SAME advertised
+    rows, so full splits exercise the complete credit balance)."""
+    sched = CommSchedule("all_to_all", world, [[] for _ in range(world)],
+                         meta={"nblk": nblk})
+    # seed labels: rank me's outgoing segment for peer p, block b
+    for me in range(world):
+        for p in range(world):
+            for b in range(nblk):
+                sched.init.append((me, "send", p * nblk + b,
+                                   ("tok", me, p, b)))
+            sched.init.append((me, "splits", p, ("split", me, p)))
+    sched.outputs = {"recv": world * nblk, "rsplits": world}
+    for me in range(world):
+        ops = sched.ranks[me]
+        _a2a_round(ops, me, world, nblk=nblk, pfx="", step=0,
+                   with_splits=True)
+        _a2a_read_all(ops, me, world, nblk=nblk, pfx="", step=0,
+                      note="post-process consume")
+    return sched
+
+
+# ---------------------------------------------------------------------------
+# low_latency_allgather — one-shot full-mesh push (the fcollect verb)
+# ---------------------------------------------------------------------------
+
+
+@schedule_builder("low_latency_allgather")
+def build_low_latency_allgather(world: int) -> CommSchedule:
+    """``_full_mesh_push_ag_kernel`` (allgather.py:185-230) — the body
+    of ``fast_allgather`` / ``dl.fcollect`` (primitives.py:205-238):
+    stage my shard into my slot (overlapped with the entry barrier),
+    push it to every peer, drain the ``world-1`` sends, then wait for
+    the ``world-1`` incoming slots.  No credits: every slot is written
+    exactly once."""
+    sched = CommSchedule("low_latency_allgather", world,
+                         [[] for _ in range(world)])
+    for me in range(world):
+        sched.init.append((me, "x", 0, ("seg", me)))
+    sched.outputs = {"gath": world}
+    for me in range(world):
+        ops = sched.ranks[me]
+        # Stage starts before the barrier, overlapping kernel entry.
+        ops.append(Op("send", step=0, dst=me, src_buf="x", src_slot=0,
+                      buf="gath", slot=me, rsem="copy",
+                      label=("seg", me), final=True, note="stage"))
+        _full_barrier(ops, me, world)
+        for i in range(1, world):
+            peer = (me + i) % world
+            ops.append(Op("send", step=0, dst=peer, src_buf="x",
+                          src_slot=0, buf="gath", slot=me, rsem="recv",
+                          ssem="send", label=("seg", me), final=True))
+        ops.append(Op("wait", step=0, sem="copy", note="stage done"))
+        ops.append(Op("wait", step=0, sem="send", count=world - 1,
+                      note="drain sends"))
+        ops.append(Op("wait", step=0, sem="recv", count=world - 1,
+                      note="peer slots arrived"))
+        for j in range(world):
+            ops.append(Op("read", step=0, buf="gath", slot=j,
+                          label=("seg", j), note="consume gathered"))
+    return sched
+
+
+# ---------------------------------------------------------------------------
+# ulysses_attention — two fused AllToAlls around local attention
+# ---------------------------------------------------------------------------
+
+
+@schedule_builder("ulysses_attention")
+def build_ulysses_attention(world: int) -> CommSchedule:
+    """``ulysses_attention`` (ulysses_attention.py): exactly two
+    AllToAlls per call — Q/K/V ride ONE fused head-scatter (the
+    ``fast_all_to_all`` kernel, = :func:`build_all_to_all`'s round at
+    equal splits, nblk=1), local attention consumes every arrived head
+    chunk, and the output rides the inverse scatter."""
+    nblk = 1
+    sched = CommSchedule("ulysses_attention", world,
+                         [[] for _ in range(world)],
+                         meta={"nblk": nblk})
+    for me in range(world):
+        for p in range(world):
+            sched.init.append((me, "qkv_send", p, ("tok", me, p, 0)))
+    sched.outputs = {"qkv_recv": world, "o_recv": world}
+    for me in range(world):
+        ops = sched.ranks[me]
+        # A2A #1: head-scatter of the fused QKV (equal splits — no
+        # split plane: the fused scatter ships fixed head chunks).
+        _a2a_round(ops, me, world, nblk=nblk, pfx="qkv_", step=0,
+                   with_splits=False)
+        _a2a_read_all(ops, me, world, nblk=nblk, pfx="qkv_", step=0,
+                      note="local attention")
+        # Local attention writes the per-peer output chunks that ride
+        # the inverse scatter.
+        for p in range(world):
+            ops.append(Op("write", step=1, buf="o_send", slot=p,
+                          label=("tok", me, p, 0), note="attn output"))
+        _a2a_round(ops, me, world, nblk=nblk, pfx="o_", step=2,
+                   with_splits=False)
+        _a2a_read_all(ops, me, world, nblk=nblk, pfx="o_", step=2,
+                      note="restore sequence sharding")
+    return sched
+
+
+# ---------------------------------------------------------------------------
+# sp_decode — the SP flash-decode combine (fcollect + in-kernel merge)
+# ---------------------------------------------------------------------------
+
+
+@schedule_builder("sp_decode")
+def build_sp_decode(world: int) -> CommSchedule:
+    """``_sp_combine_kernel`` (flash_decode.py:804-835): barrier, then
+    the ``dl.fcollect`` gather round of the packed (out ⊕ lse) partial
+    planes — push my plane to every peer's slot ``me``, stage my own,
+    drain, wait arrivals — then the in-kernel LSE merge reads every
+    slot and writes the final combined output."""
+    sched = CommSchedule("sp_decode", world, [[] for _ in range(world)])
+    for me in range(world):
+        sched.init.append((me, "plane", 0, ("partial", me)))
+    sched.outputs = {"gath": world, "final": 1}
+    for me in range(world):
+        ops = sched.ranks[me]
+        _full_barrier(ops, me, world)
+        # fcollect (primitives.py:205-238): peer pushes FIRST (they read
+        # the input ref, independent of the staging copy), then the
+        # local stage, drains, arrivals.
+        for i in range(1, world):
+            peer = (me + i) % world
+            ops.append(Op("send", step=0, dst=peer, src_buf="plane",
+                          src_slot=0, buf="gath", slot=me, rsem="recv",
+                          ssem="send", label=("partial", me),
+                          final=True))
+        ops.append(Op("send", step=0, dst=me, src_buf="plane",
+                      src_slot=0, buf="gath", slot=me, rsem="copy",
+                      label=("partial", me), final=True, note="stage"))
+        ops.append(Op("wait", step=0, sem="copy"))
+        ops.append(Op("wait", step=0, sem="send", count=world - 1,
+                      note="drain (quiet)"))
+        ops.append(Op("wait", step=0, sem="recv", count=world - 1,
+                      note="arrivals"))
+        for j in range(world):
+            ops.append(Op("read", step=0, buf="gath", slot=j,
+                          label=("partial", j), note="LSE merge"))
+        ops.append(Op("write", step=0, buf="final", slot=0,
+                      label=("combined", me), final=True))
+    return sched

@@ -1,0 +1,502 @@
+"""Symbolic execution of a :class:`~.comm_schedule.CommSchedule` —
+the race/deadlock checker.
+
+The simulator runs every rank's op list round-robin with EAGER delivery
+(DMAs land and signals arrive the instant they are issued — the most
+permissive timing, so any blocking it finds is a true deadlock) while
+tracking vector clocks for the adversarial-timing questions eager
+execution alone cannot answer: an event is safe only if a
+happens-before chain *forces* its ordering, not if this particular
+interleaving happened to produce it.
+
+HB edges: program order on each rank; a semaphore wait joins the clock
+of every signal/DMA whose credit it consumed (FIFO per (rank, sem) —
+the byte-counted TPU semantics); a DMA's landing write becomes visible
+only at the wait that consumed its arrival credit.  On top of that:
+
+- **deadlock** — round-robin progress stalls with unfinished ranks;
+- **stranded credit** — any semaphore nonzero at exit, or any send
+  never drained (the ``quiet`` contract);
+- **read races** — a read (or a send's source read) that can observe a
+  write not HB-ordered before it, a never-written slot, or data whose
+  label is not the one the schedule owes that step (a swapped slot is a
+  label mismatch here, not silent corruption on hardware);
+- **write races** — a DMA landing on a slot whose previous write or
+  read is not HB-ordered before the DMA's issue (the credit-semaphore
+  backpressure is exactly what creates these chains);
+- **write-once** — every declared output tile finalized exactly once
+  on every rank;
+- **slot-map bijectivity** — each declared step map is a permutation of
+  ranks.
+
+The seeded **mutation self-test** (:func:`mutation_self_test`) corrupts
+schedules one op at a time — dropped signal, swapped slot, doubled
+wait, double-written tile — and asserts the checker reports each class:
+the checker checks the kernels, the mutations check the checker.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import random
+import zlib
+from collections import deque
+
+from triton_dist_tpu.analysis.comm_schedule import (
+    SCHEDULE_BUILDERS,
+    CommSchedule,
+    Op,
+    build_schedule,
+)
+
+#: Schedule corruption classes the self-test must prove are caught.
+MUTATIONS = ("drop_signal", "swap_slot", "double_wait", "double_write")
+
+
+@dataclasses.dataclass
+class ScheduleViolation:
+    kind: str
+    rank: int
+    detail: str
+
+    def __str__(self):
+        return f"[{self.kind}] rank {self.rank}: {self.detail}"
+
+
+class _Clock:
+    """Vector clock over ``world`` ranks."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, world=None, v=None):
+        self.v = list(v) if v is not None else [0] * world
+
+    def copy(self):
+        return _Clock(v=self.v)
+
+    def tick(self, rank):
+        self.v[rank] += 1
+
+    def join(self, other):
+        self.v = [max(a, b) for a, b in zip(self.v, other.v)]
+
+    def __le__(self, other):
+        return all(a <= b for a, b in zip(self.v, other.v))
+
+
+class _Dma:
+    """One in-flight (issued) DMA."""
+
+    __slots__ = ("src", "dst", "label", "ssem", "issue_clock",
+                 "drained_clock", "op")
+
+    def __init__(self, src, dst, label, ssem, issue_clock, op):
+        self.src = src            # (rank, buf, slot)
+        self.dst = dst            # (rank, buf, slot)
+        self.label = label
+        self.ssem = ssem
+        self.issue_clock = issue_clock
+        self.drained_clock = None  # set by the wait consuming the ssem
+        self.op = op
+
+
+class _WriteEv:
+    __slots__ = ("label", "final", "avail_clock", "issue_clock", "seq",
+                 "via")
+
+    def __init__(self, label, final, avail_clock, issue_clock, seq, via):
+        self.label = label
+        self.final = final
+        #: clock at which the write is ORDERED (local write: the writer
+        #: op's clock; DMA landing: the consuming wait's clock, None
+        #: until consumed)
+        self.avail_clock = avail_clock
+        self.issue_clock = issue_clock
+        self.seq = seq
+        self.via = via            # "local" | "dma"
+
+
+class _Sim:
+    def __init__(self, sched: CommSchedule):
+        self.s = sched
+        w = sched.world
+        self.world = w
+        self.clocks = [_Clock(w) for _ in range(w)]
+        self.pc = [0] * w
+        # (rank, sem) -> deque of credit events (clock, dma | None)
+        self.sems: dict = {}
+        # (rank, buf, slot) -> list[_WriteEv]
+        self.writes: dict = {}
+        # (rank, buf, slot) -> list[(clock, seq)] of reads
+        self.reads: dict = {}
+        # (rank, buf, slot) -> list[_Dma] sourced from there
+        self.src_dmas: dict = {}
+        self.violations: list[ScheduleViolation] = []
+        self.seq = 0
+        zero = _Clock(w)
+        for rank, buf, slot, label in sched.init:
+            self.writes.setdefault((rank, buf, slot), []).append(
+                _WriteEv(label, False, zero.copy(), zero.copy(), -1,
+                         "init"))
+
+    # -- helpers ----------------------------------------------------------
+
+    def _q(self, rank, sem):
+        return self.sems.setdefault((rank, sem), deque())
+
+    def _report(self, kind, rank, detail):
+        self.violations.append(ScheduleViolation(kind, rank, detail))
+
+    def _visible_write(self, rank, buf, slot, clock, op, *, what):
+        """Latest HB-ordered write of (rank, buf, slot); reports races
+        against unordered writes and unwritten slots."""
+        evs = self.writes.get((rank, buf, slot), [])
+        visible = None
+        for ev in evs:
+            if ev.avail_clock is not None and ev.avail_clock <= clock:
+                if visible is None or ev.seq > visible.seq:
+                    visible = ev
+            else:
+                self._report(
+                    "race-read", rank,
+                    f"{what} of {buf}[{slot}] at step {op.step} may "
+                    f"observe an un-ordered in-flight write "
+                    f"({ev.via}, label={ev.label}) — no happens-before "
+                    f"chain orders the write before this read")
+        if visible is None:
+            self._report(
+                "unwritten-read", rank,
+                f"{what} of {buf}[{slot}] at step {op.step} observes no "
+                f"completed write at all")
+        return visible
+
+    def _record_read(self, rank, buf, slot, clock):
+        self.reads.setdefault((rank, buf, slot), []).append(
+            (clock.copy(), self.seq))
+
+    def _apply_write(self, rank, buf, slot, label, final, avail, issue,
+                     via, issuer_rank, op):
+        key = (rank, buf, slot)
+        for ev in self.writes.get(key, []):
+            ordered = (ev.avail_clock is not None
+                       and ev.avail_clock <= issue)
+            if not ordered:
+                self._report(
+                    "race-write", issuer_rank,
+                    f"write into rank {rank} {buf}[{slot}] (step "
+                    f"{op.step}, label={label}) races a prior "
+                    f"{ev.via} write (label={ev.label}): no chain "
+                    f"orders the old write's consumption before the "
+                    f"new write's issue")
+        for (rclock, _rseq) in self.reads.get(key, []):
+            if not rclock <= issue:
+                self._report(
+                    "race-write", issuer_rank,
+                    f"write into rank {rank} {buf}[{slot}] (step "
+                    f"{op.step}, label={label}) races a prior read: "
+                    f"the reader holds no credit chain ordering its "
+                    f"read before this write")
+        ev = _WriteEv(label, final, avail, issue, self.seq, via)
+        self.writes.setdefault(key, []).append(ev)
+        return ev
+
+    # -- one op -----------------------------------------------------------
+
+    def _try_op(self, rank, op: Op) -> bool:
+        """Execute op on rank if possible; False = blocked."""
+        clock = self.clocks[rank]
+        if op.kind == "wait":
+            q = self._q(rank, op.sem)
+            if len(q) < op.count:
+                return False
+            self.seq += 1
+            clock.tick(rank)
+            for _ in range(op.count):
+                cclock, dma = q.popleft()
+                clock.join(cclock)
+                if dma is not None:
+                    if dma.dst is not None and dma.dst[0] == rank and \
+                            op.sem != dma.ssem:
+                        # arrival credit: the landing write becomes
+                        # ordered at this wait
+                        for ev in self.writes.get(dma.dst, []):
+                            if ev.via == "dma" and ev.avail_clock is None \
+                                    and ev.issue_clock is dma.issue_clock:
+                                ev.avail_clock = clock.copy()
+                    if op.sem == dma.ssem and dma.src[0] == rank:
+                        dma.drained_clock = clock.copy()
+            return True
+
+        self.seq += 1
+        clock.tick(rank)
+        if op.kind == "signal":
+            dst = op.dst if op.dst >= 0 else rank
+            q = self._q(dst, op.sem)
+            for _ in range(op.count):
+                q.append((clock.copy(), None))
+        elif op.kind == "write":
+            # a local write must not clobber an in-flight DMA's source
+            for dma in self.src_dmas.get((rank, op.buf, op.slot), []):
+                if dma.ssem and (dma.drained_clock is None
+                                 or not dma.drained_clock <= clock):
+                    self._report(
+                        "race-write", rank,
+                        f"local write of {op.buf}[{op.slot}] at step "
+                        f"{op.step} overwrites the source of an "
+                        f"undrained DMA (label={dma.label})")
+            self._apply_write(rank, op.buf, op.slot, op.label, op.final,
+                              clock.copy(), clock.copy(), "local", rank,
+                              op)
+        elif op.kind == "read":
+            vis = self._visible_write(rank, op.buf, op.slot, clock, op,
+                                      what="read")
+            self._record_read(rank, op.buf, op.slot, clock)
+            if vis is not None and op.label is not None and \
+                    vis.label != op.label:
+                self._report(
+                    "stale-read", rank,
+                    f"read of {op.buf}[{op.slot}] at step {op.step} "
+                    f"expects {op.label} but the slot holds "
+                    f"{vis.label} — wrong tile consumed")
+        elif op.kind == "send":
+            # source read (the DMA engine reads src until drained)
+            vis = self._visible_write(rank, op.src_buf, op.src_slot,
+                                      clock, op, what="DMA source read")
+            self._record_read(rank, op.src_buf, op.src_slot, clock)
+            if vis is not None and op.label is not None and \
+                    vis.label != op.label:
+                self._report(
+                    "stale-read", rank,
+                    f"send from {op.src_buf}[{op.src_slot}] at step "
+                    f"{op.step} ships {vis.label} where the schedule "
+                    f"owes {op.label}")
+            dst_rank = op.dst if op.dst >= 0 else rank
+            issue = clock.copy()
+            dma = _Dma((rank, op.src_buf, op.src_slot),
+                       (dst_rank, op.buf, op.slot), op.label, op.ssem,
+                       issue, op)
+            self.src_dmas.setdefault(
+                (rank, op.src_buf, op.src_slot), []).append(dma)
+            # eager landing: write applied now, ordered only once the
+            # receiver consumes the arrival credit
+            self._apply_write(dst_rank, op.buf, op.slot, op.label,
+                              op.final, None, issue, "dma", rank, op)
+            self._q(dst_rank, op.rsem).append((issue, dma))
+            if op.ssem:
+                self._q(rank, op.ssem).append((issue, dma))
+        else:
+            raise ValueError(f"unknown op kind {op.kind!r}")
+        return True
+
+    # -- drive ------------------------------------------------------------
+
+    def run(self):
+        s = self.s
+        while True:
+            progressed = False
+            done = 0
+            for r in range(self.world):
+                ops = s.ranks[r]
+                while self.pc[r] < len(ops):
+                    if self._try_op(r, ops[self.pc[r]]):
+                        self.pc[r] += 1
+                        progressed = True
+                    else:
+                        break
+                if self.pc[r] >= len(ops):
+                    done += 1
+            if done == self.world:
+                return True
+            if not progressed:
+                for r in range(self.world):
+                    if self.pc[r] < len(s.ranks[r]):
+                        op = s.ranks[r][self.pc[r]]
+                        have = len(self._q(r, op.sem))
+                        self._report(
+                            "deadlock", r,
+                            f"blocked at step {op.step} waiting "
+                            f"{op.count} on '{op.sem}' (holds {have}"
+                            f"{', ' + op.note if op.note else ''})")
+                return False
+
+    def finish_checks(self):
+        s = self.s
+        # stranded credits / undrained sends
+        for (rank, sem), q in sorted(self.sems.items()):
+            if q:
+                self._report(
+                    "stranded-credit", rank,
+                    f"semaphore '{sem}' holds {len(q)} unconsumed "
+                    f"credit(s) at kernel exit")
+        for dmas in self.src_dmas.values():
+            for dma in dmas:
+                if dma.ssem and dma.drained_clock is None:
+                    self._report(
+                        "undrained-send", dma.src[0],
+                        f"send of {dma.label} from "
+                        f"{dma.src[1]}[{dma.src[2]}] never drained "
+                        f"(the quiet contract)")
+        # write-once outputs
+        for buf, nslots in s.outputs.items():
+            for rank in range(self.world):
+                for slot in range(nslots):
+                    finals = [ev for ev in
+                              self.writes.get((rank, buf, slot), [])
+                              if ev.final]
+                    if len(finals) != 1:
+                        self._report(
+                            "write-once", rank,
+                            f"output {buf}[{slot}] finalized "
+                            f"{len(finals)} times (expected exactly 1)")
+        # slot-map bijectivity
+        for step, slots in sorted(s.slot_maps.items()):
+            if sorted(slots) != list(range(self.world)):
+                self._report(
+                    "slot-map", -1,
+                    f"step {step} slot map {slots} is not a bijection "
+                    f"on ranks 0..{self.world - 1}")
+
+
+def check_schedule(sched: CommSchedule) -> list[ScheduleViolation]:
+    """Run every check; [] means the schedule is provably clean under
+    any timing the happens-before relation admits."""
+    sim = _Sim(sched)
+    sim.run()
+    sim.finish_checks()
+    return sim.violations
+
+
+def check_kernel(kernel: str, worlds=range(2, 33)) -> dict:
+    """Convenience sweep: kernel x world sizes -> violation summary."""
+    out = {"kernel": kernel, "worlds": [], "violations": []}
+    for w in worlds:
+        v = check_schedule(build_schedule(kernel, w))
+        out["worlds"].append(w)
+        out["violations"] += [f"world={w} {x}" for x in v]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Mutations: the checker's own test harness
+# ---------------------------------------------------------------------------
+
+
+def mutate(sched: CommSchedule, kind: str,
+           rng: random.Random) -> CommSchedule:
+    """Return a deep-copied schedule corrupted by one seeded mutation of
+    class ``kind`` (:data:`MUTATIONS`).  Raises ValueError when the
+    schedule has no site for the class (the self-test skips those)."""
+    m = copy.deepcopy(sched)
+    m.kernel = f"{sched.kernel}+{kind}"
+    if kind == "drop_signal":
+        # dropped arrival: a signal op if any, else a send (its landing
+        # write AND its arrival credit vanish together, exactly like a
+        # producer that forgot to notify)
+        sites = [(r, i) for r in range(m.world)
+                 for i, op in enumerate(m.ranks[r])
+                 if op.kind == "signal"]
+        if not sites:
+            sites = [(r, i) for r in range(m.world)
+                     for i, op in enumerate(m.ranks[r])
+                     if op.kind == "send"]
+        if not sites:
+            raise ValueError("no signal/send to drop")
+        r, i = rng.choice(sites)
+        del m.ranks[r][i]
+    elif kind == "swap_slot":
+        # a consumed slot / landing slot / DMA source slot / slot-map
+        # entry points at the wrong tile
+        sites = []
+        for r in range(m.world):
+            for i, op in enumerate(m.ranks[r]):
+                if op.kind == "read":
+                    sites.append(("dst", r, i))
+                elif op.kind == "send":
+                    sites.append(("dst", r, i))
+                    sites.append(("src", r, i))
+        for step in m.slot_maps:
+            if m.world >= 2:
+                sites.append(("map", step, -1))
+        if not sites:
+            raise ValueError("no slot to swap")
+
+        def _nslots(buf):
+            return max(
+                [o.slot for rr in m.ranks for o in rr
+                 if o.kind in ("send", "write", "read")
+                 and o.buf == buf]
+                + [o.src_slot for rr in m.ranks for o in rr
+                   if o.kind == "send" and o.src_buf == buf]
+                + [0]) + 1
+
+        what, a, b = rng.choice(sites)
+        if what == "map":
+            slots = m.slot_maps[a]
+            j = rng.randrange(len(slots))
+            slots[j] = slots[(j + 1) % len(slots)]   # duplicate entry
+        elif what == "src":
+            op = m.ranks[a][b]
+            op.src_slot = (op.src_slot + 1) % max(_nslots(op.src_buf), 2)
+        else:
+            op = m.ranks[a][b]
+            op.slot = (op.slot + 1) % max(_nslots(op.buf), 2)
+    elif kind == "double_wait":
+        sites = [(r, i) for r in range(m.world)
+                 for i, op in enumerate(m.ranks[r])
+                 if op.kind == "wait"]
+        if not sites:
+            raise ValueError("no wait to double")
+        r, i = rng.choice(sites)
+        m.ranks[r][i].count *= 2
+    elif kind == "double_write":
+        sites = [(r, i) for r in range(m.world)
+                 for i, op in enumerate(m.ranks[r])
+                 if op.final and op.kind in ("write", "send")]
+        if not sites:
+            raise ValueError("no final write to double")
+        r, i = rng.choice(sites)
+        m.ranks[r].insert(i + 1, copy.deepcopy(m.ranks[r][i]))
+    else:
+        raise ValueError(f"unknown mutation {kind!r}; "
+                         f"choose from {MUTATIONS}")
+    return m
+
+
+def mutation_self_test(kernels=None, worlds=(2, 3, 4), seeds=range(4),
+                       ) -> dict:
+    """Seeded corruption sweep: for every kernel x world x seed x
+    mutation class, corrupt the schedule and assert the checker
+    reports >= 1 violation.  Returns the tally; raises AssertionError
+    naming the first silent corruption (a checker hole)."""
+    kernels = sorted(SCHEDULE_BUILDERS) if kernels is None else kernels
+    tally = {k: 0 for k in MUTATIONS}
+    for kernel in kernels:
+        for world in worlds:
+            clean = build_schedule(kernel, world)
+            base = check_schedule(clean)
+            assert not base, (
+                f"{kernel} world={world} not clean before mutation: "
+                f"{[str(v) for v in base]}")
+            for kind in MUTATIONS:
+                for seed in seeds:
+                    # stable site selection: crc32, not hash() — the
+                    # salted builtin would pick different corruption
+                    # sites every process, making a checker-hole
+                    # failure unreplayable (the very class the
+                    # no-unseeded-randomness rule bans)
+                    salt = zlib.crc32(
+                        f"{kernel}/{world}/{kind}".encode())
+                    rng = random.Random(salt * 1000 + seed)
+                    try:
+                        bad = mutate(clean, kind, rng)
+                    except ValueError:
+                        continue
+                    got = check_schedule(bad)
+                    assert got, (
+                        f"checker hole: {kind} on {kernel} "
+                        f"world={world} seed={seed} was NOT caught")
+                    tally[kind] += 1
+    for kind, n in tally.items():
+        assert n > 0, f"mutation class {kind} never had a site"
+    return tally

@@ -58,6 +58,27 @@ def bucket_down(ladder: list[int], value: int) -> int:
     return best
 
 
+def abstract_signature(args: tuple, kwargs: dict) -> tuple:
+    """(args, kwargs) with every array leaf abstracted to a
+    ``jax.ShapeDtypeStruct`` (non-array leaves pass through) — exactly
+    what ``jax.make_jaxpr`` needs to re-trace the call device-free.
+    The jaxpr auditor (``analysis/jaxpr_audit.py``) replays captured
+    signatures through this to audit compiled programs without holding
+    live buffers."""
+    def leaf(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+        return x
+
+    return jax.tree_util.tree_map(leaf, (args, kwargs))
+
+
+#: Most trace signatures one CountingJit retains for the auditor; the
+#: ladders bound real programs far below this — hitting the cap would
+#: itself be a retrace hazard the audit should surface.
+MAX_CAPTURED_SIGNATURES = 64
+
+
 def cache_stats() -> dict:
     """Hit/miss/size counters of the process-wide shard-jit memo cache
     (``functools.lru_cache`` on :func:`_build`).  A *miss* here means a
@@ -108,6 +129,10 @@ class CountingJit:
         self.compile_time = 0.0
         self._keys: set = set()
         self._sized = hasattr(fn, "_cache_size")
+        #: sig-key -> abstracted (args, kwargs) of each distinct traced
+        #: call (captured on miss only — zero steady-state overhead);
+        #: the jaxpr auditor re-traces these via ``abstract_signature``.
+        self.captured: dict = {}
 
     @staticmethod
     def _sig(args, kwargs) -> tuple:
@@ -133,6 +158,10 @@ class CountingJit:
         if fresh:
             self.misses += 1
             self.compile_time += dt
+            if len(self.captured) < MAX_CAPTURED_SIGNATURES:
+                self.captured.setdefault(
+                    self._sig(args, kwargs),
+                    abstract_signature(args, kwargs))
         else:
             self.hits += 1
         timer = self.timer

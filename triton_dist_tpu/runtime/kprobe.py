@@ -465,9 +465,14 @@ def probe_ag_gemm(mesh: Mesh, *, axis: str = "tp", M: int = 512,
         m_loc, n_loc, K, dtype)
     pred_comm = (perf_model.estimate_allgather_time_ms(
         m_loc * K * el, world) / (world - 1) if world > 1 else 0.0)
+    # arrival-order slot map — the shared contract with the static
+    # schedule checker (analysis/comm_schedule.py), which also proves
+    # its per-step bijectivity at every world size
+    from triton_dist_tpu.analysis.comm_schedule import arrival_slots
+
     slices = []
     for s in range(world):
-        slots = [(r - s) % world for r in range(world)]
+        slots = arrival_slots(s, world)
         slices.append(StepSlice(
             step=s, phase="compute",
             measured_ms=_time_ms(

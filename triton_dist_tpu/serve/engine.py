@@ -2580,6 +2580,43 @@ class ServeEngine:
                              "decode_horizon", "spec_round",
                              "draft_tail_step"})
 
+    def program_registry(self) -> list:
+        """Every compiled device program behind this engine, as audit
+        records for ``analysis.jaxpr_audit`` (docs/analysis.md): the
+        ``CountingJit`` wrappers ``metrics.register_compiled`` collected
+        at construction, each with its declared static-kwarg ladders
+        (the horizon's ``H`` rides ``h_ladder``, the spec round's ``K``
+        the pow2 k-ladder — off-ladder statics are the cache-fork
+        class) and its allowed collective seams (world-1 programs allow
+        none; mesh programs declare ``serve.mesh.collective_seams``)."""
+        ladders = {
+            "decode_horizon": {"H": tuple(self.h_ladder),
+                               "all_greedy": (True, False)},
+            "spec_round": {"K": tuple(getattr(self, "_k_ladder", ())),
+                           "all_greedy": (True, False)},
+            "draft_tail_step": {"K": tuple(getattr(self, "_k_ladder",
+                                                   ()))},
+        }
+        if self.mesh is not None:
+            from triton_dist_tpu.serve import mesh as serve_mesh
+
+            seams = serve_mesh.collective_seams(
+                self.cfg, kv_shard=self.kv_shard,
+                draft_cfg=(self.draft.cfg if self.draft is not None
+                           else None))
+        else:
+            seams = {}
+        recs, seen = [], set()
+        for fn in self.metrics.compiled_fns:
+            name = getattr(fn, "name", None)
+            if name is None or name in seen:
+                continue
+            seen.add(name)
+            recs.append({"name": name, "fn": fn,
+                         "ladders": ladders.get(name, {}),
+                         "seams": seams.get(name, {})})
+        return recs
+
     def _device_call(self, op: str, rids: tuple, fn, *args,
                      fire_injector: bool = True, **kwargs):
         """The ONE guarded device-dispatch seam: the ``forward`` fault

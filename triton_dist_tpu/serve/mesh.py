@@ -66,6 +66,7 @@ from jax.sharding import PartitionSpec as P
 from triton_dist_tpu.kernels.flash_decode import sp_gqa_decode_paged_shard
 from triton_dist_tpu.models.generate import _chunk_forward, _token_forward
 from triton_dist_tpu.models.llama import param_specs
+from triton_dist_tpu.runtime import jit_cache
 
 
 # ---------------------------------------------------------------------------
@@ -459,7 +460,12 @@ def sp_copy_pool_block_shard(pools, src, dst, *, axis, world, num_blocks):
 
 def _place(x, sharding):
     """Commit ``x`` onto ``sharding`` unless it already carries it —
-    the one-signature-per-program guarantee (module docstring)."""
+    the one-signature-per-program guarantee (module docstring).
+    Tracers pass through: under a re-trace (the jaxpr auditor replaying
+    a captured signature) placement is a runtime concern and a tracer
+    carries no sharding to inspect."""
+    if isinstance(x, jax.core.Tracer):
+        return x
     if isinstance(x, jax.Array) and x.sharding == sharding:
         return x
     return jax.device_put(x, sharding)
@@ -514,6 +520,9 @@ class ShardedProgram:
         self._placements = tuple(_shardings_of(mesh, s)
                                  for s in self.in_specs)
         self._jits: dict = {}
+        #: statics-key -> abstracted args of the first call per rung
+        #: (the jaxpr auditor's re-trace seed, like CountingJit's)
+        self.captured: dict = {}
 
     def _prog(self, statics: tuple):
         prog = self._jits.get(statics)
@@ -539,7 +548,12 @@ class ShardedProgram:
         placed = tuple(
             jax.tree_util.tree_map(_place, a, p)
             for a, p in zip(args, self._placements))
-        out = self._prog(tuple(sorted(statics.items())))(*placed)
+        key = tuple(sorted(statics.items()))
+        if key not in self.captured and \
+                len(self.captured) < jit_cache.MAX_CAPTURED_SIGNATURES:
+            self.captured[key] = jit_cache.abstract_signature(
+                placed, dict(statics))
+        out = self._prog(key)(*placed)
         # compile calls (cache grew) stay out of the distributions —
         # the same rule as CountingJit: stalls are compile accounting,
         # not program wall time
@@ -586,6 +600,66 @@ class MeshChunkJit:
 # ---------------------------------------------------------------------------
 # Program construction (the engine's mesh-mode __init__ calls this)
 # ---------------------------------------------------------------------------
+
+
+def collective_seams(cfg, *, kv_shard: str, draft_cfg=None) -> dict:
+    """Declared collective seams per engine program — the contract the
+    jaxpr auditor (``analysis/jaxpr_audit.py``) enforces: any
+    collective primitive a program traces that is NOT declared here is
+    a violation, and declared counts must match exactly.
+
+    ``kv_shard="heads"`` (Megatron TP): the ONLY collectives in any
+    forward are the two row-parallel ``psum``s per layer (attn
+    out-proj, ``_tp_out_proj``; FFN down, ``_tp_ffn``) — 2 x n_layers
+    per forward, nothing in per-rank attention, sampling, or the page
+    programs.  ``kv_shard="seq"`` (SP flash-decode): one inter-rank
+    LSE-combine gather per layer in the decode forwards
+    (``sp_gqa_decode_paged_shard``), a replicated chunk prefill (no
+    collectives), and one ``psum`` in the page gather
+    (``sp_gather_pool_pages_shard`` zeroes unowned rows and psum-
+    assembles the full gather).  Spec rounds chain draft (replicated —
+    collective-free) and target forwards: K+1 target forwards for the
+    K-step draft scan + verify + closing decode... the spec round's
+    exact chain is 2 target forwards traced (verify + closing decode,
+    the draft scan is replicated), so 2x the per-forward seam count.
+    """
+    n = cfg.n_layers
+    if kv_shard == "heads":
+        fwd = {"psum": 2 * n}
+        seams = {
+            "paged_decode": dict(fwd),
+            "paged_verify": dict(fwd),
+            "decode_horizon": dict(fwd),
+            "prefill_chunk": dict(fwd),
+            # page scatter/gather/COW move KV bytes inside each rank's
+            # own head shard: collective-free.
+            "fill_pages": {}, "load_pages": {}, "cow_copy": {},
+            # spec round: draft scan replicated (collective-free),
+            # verify + closing decode are 2 target forwards.
+            "spec_round": {"psum": 2 * (2 * n)},
+            "draft_tail_step": {},
+            "draft_prefill": {}, "draft_join": {}, "draft_step": {},
+            "draft_fill_pages": {}, "draft_load_pages": {},
+        }
+        return seams
+    if kv_shard == "seq":
+        fwd = {"all_gather": n}
+        return {
+            "paged_decode": dict(fwd),
+            "paged_verify": dict(fwd),
+            "decode_horizon": dict(fwd),
+            # seq-mode chunked prefill computes replicated (ROADMAP #1
+            # follow-up): only the page scatter shards.
+            "prefill_chunk": {},
+            "fill_pages": {},
+            "load_pages": {"psum": 1},
+            "cow_copy": {},
+            "spec_round": {"all_gather": 2 * n},
+            "draft_tail_step": {},
+            "draft_prefill": {}, "draft_join": {}, "draft_step": {},
+            "draft_fill_pages": {}, "draft_load_pages": {},
+        }
+    raise ValueError(f"unknown kv_shard {kv_shard!r}")
 
 
 def replicated_like(tree):
