@@ -475,6 +475,26 @@ def _bench_serve_fleet_net():
     return r["serve_fleet_net_zero_loss"]
 
 
+def _bench_serve_disagg():
+    """Disaggregated-serving chaos guardrail (scripts/bench_serve.py
+    bench_disagg): a 1:2 prefill→decode tier where every request
+    prefills on the prefill replica, PUSHes its KV pages at prefill
+    completion, and decodes in place on a decode replica — the chaos
+    leg kills the prefill tier mid-push AND a decode replica post-adopt
+    and reports the fraction of streams still bit-identical to the
+    single-engine oracle with exactly-once delivery.  The ISSUE-16 twin
+    of serve_fleet_zero_loss, same 1.0 floor, same contract: below it
+    the push protocol lost or duplicated tokens.  Also returns the
+    decode p99 ITL isolation ratio (co-located / disagg under a
+    long-prompt burst) — informational on CPU, where the compute/memory
+    split the ratio measures has no hardware to show on."""
+    from scripts.bench_serve import bench_disagg
+
+    r = bench_disagg(prefill=1, decode=2, batch=2, prompt_len=16,
+                     new_tokens=32, dim=32)
+    return r["serve_disagg_zero_loss"], r["serve_disagg_itl_isolation"]
+
+
 def _bench_serve_fleet_trace():
     """Fleet tracing overhead (scripts/bench_serve.py
     bench_fleet_trace_overhead): the identical warmed fleet workload
@@ -654,6 +674,7 @@ def main():
     trace_overhead = _bench_serve_trace()
     fleet_zero_loss, fleet_tps = _bench_serve_fleet()
     fleet_net_zero_loss = _bench_serve_fleet_net()
+    disagg_zero_loss, disagg_itl_isolation = _bench_serve_disagg()
     fleet_trace_overhead = _bench_serve_fleet_trace()
     mesh_zero_loss, mesh_tps = _bench_serve_mesh()
     overlap_eff, model_vs_meas = _bench_kernel_report()
@@ -707,6 +728,14 @@ def main():
         # reachable ONLY over the wire (kill + partition + retries +
         # journal crash migration) — the ISSUE-12 robustness bar.
         "serve_fleet_net_zero_loss": round(fleet_net_zero_loss, 4),
+        # Disaggregated-serving chaos zero-loss: exact streams / total
+        # after SIGKILLing the prefill tier mid-push AND a decode
+        # replica post-adopt in a 1:2 role tier (per-request KV-page
+        # PUSH + in-place adoption) — the ISSUE-16 robustness bar.
+        # The isolation ratio (decode p99 ITL, co-located / disagg
+        # under a prefill burst) is INFORMATIONAL on CPU.
+        "serve_disagg_zero_loss": round(disagg_zero_loss, 4),
+        "serve_disagg_itl_isolation": round(disagg_itl_isolation, 4),
         # Fleet tracing overhead: fleet tokens/s with the full
         # observability stack (engine rings + controller ring + router
         # decision audit) over tokens/s with it all off — the

@@ -202,6 +202,15 @@ def parse_args():
                         "step K — its in-flight requests live-migrate "
                         "to the survivors (journal hand-off) and r0 "
                         "restarts under exponential backoff")
+    p.add_argument("--disagg", default=None, metavar="P:D",
+                   help="disaggregated serving: a two-role tier of P "
+                        "prefill + D decode in-process replicas — every "
+                        "request prefills on the prefill pool, PUSHes "
+                        "its KV pages at prefill completion, and "
+                        "decodes in place on its stamped decode "
+                        "target; prints each request's journey "
+                        "(docs/serving.md 'Disaggregated serving'). "
+                        "Its own mode: no --engine/--mesh/--fleet")
     p.add_argument("--sessions", type=int, default=None, metavar="T",
                    help="engine mode: after the first drain, run T-1 "
                         "follow-up turns per request — each turn's "
@@ -239,6 +248,19 @@ def parse_args():
         p.error(f"--fleet must be >= 1, got {args.fleet}")
     if args.fleet_kill_step is not None and args.fleet is None:
         p.error("--fleet-kill-step needs --fleet")
+    if args.disagg is not None:
+        if args.engine or args.mesh is not None:
+            p.error("--disagg is its own serving mode: it does not "
+                    "combine with --engine or --mesh (the tier builds "
+                    "its own in-process replicas)")
+        if args.fleet is not None:
+            p.error("--disagg replaces --fleet: the P:D spec already "
+                    "sizes the tier")
+        from triton_dist_tpu.serve.disagg import parse_disagg
+        try:
+            parse_disagg(args.disagg)
+        except ValueError as e:
+            p.error(str(e))
     if args.fleet is not None and (args.mixed or args.sessions
                                    or args.shared_prompt
                                    or args.speculative or args.resume):
@@ -424,6 +446,114 @@ def run_fleet(args, key):
         dist_print(f"fleet metrics self-scrape: {len(body)} bytes, "
                    f"{series} series")
         srv.shutdown()
+    dist_print("done")
+
+
+def run_disagg(args, key):
+    """--disagg P:D: a two-role tier of P prefill + D decode in-process
+    replicas — every request prefills on the prefill pool, PUSHes its
+    single-request KV hand-off at prefill completion, and decodes IN
+    PLACE on its stamped decode target; prints each request's journey
+    and the push audit (docs/serving.md "Disaggregated serving")."""
+    import tempfile
+
+    import numpy as np
+
+    from triton_dist_tpu.models import llama
+    from triton_dist_tpu.models.generate import Generator
+    from triton_dist_tpu.runtime import dist_print
+    from triton_dist_tpu.serve import (
+        DisaggController,
+        Request,
+        SamplingParams,
+        ServeEngine,
+        parse_disagg,
+    )
+
+    n_p, n_d = parse_disagg(args.disagg)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    rng = np.random.default_rng(args.seed)
+    lens = rng.integers(max(2, args.prompt_len // 2),
+                        2 * args.prompt_len + 1, size=args.requests)
+    max_seq = int(max(lens)) + args.new_tokens
+    max_seq += (-max_seq) % args.page_size
+    cfg = llama.LlamaConfig(vocab=256, dim=32, n_layers=2, n_heads=2,
+                            n_kv_heads=2, ffn_dim=64, max_seq=max_seq,
+                            dtype=jnp.float32)
+    params = llama.init_params(cfg, key)
+    gen = Generator(cfg, mesh, axis="sp", max_seq=max_seq)
+    page = args.page_size
+    per_req = -(-max_seq // page)
+    num_blocks = args.num_blocks or (1 + per_req * max(
+        2, args.requests // max(n_d, 1)))
+
+    def factory(d):
+        return ServeEngine(gen, params, num_blocks=num_blocks,
+                           page_size=page, max_batch=args.max_batch,
+                           prefill_chunk=max(8, page),
+                           horizon=args.horizon,
+                           pipeline=args.pipeline,
+                           max_queue=args.max_queue, snapshot_dir=d,
+                           trace_level=(1 if args.trace_level is None
+                                        else args.trace_level))
+
+    root = args.snapshot_dir or tempfile.mkdtemp(prefix="disagg_")
+    fc = DisaggController(factory, n_p, n_d, root=root,
+                          backoff_base_s=0.05, backoff_cap_s=2.0,
+                          suspect_after_s=30.0, dead_after_s=120.0,
+                          trace_level=(1 if args.trace_level is None
+                                       else args.trace_level),
+                          seed=args.seed)
+    roles = {name: rep.role for name, rep in fc.replicas.items()}
+    dist_print(f"disagg tier: {n_p} prefill + {n_d} decode replicas x "
+               f"(pool {num_blocks} blocks, batch {args.max_batch}), "
+               f"{args.requests} requests under {root}")
+    dist_print(f"roles: {roles}")
+    params_s = SamplingParams(max_new_tokens=args.new_tokens,
+                              temperature=args.temperature,
+                              top_k=args.top_k, top_p=args.top_p,
+                              seed=args.seed, deadline_s=args.deadline)
+    reqs = [Request(f"req-{i}",
+                    rng.integers(0, cfg.vocab, size=int(lens[i]))
+                    .astype(np.int32), params_s)
+            for i in range(args.requests)]
+    t0 = time.perf_counter()
+    submitted = step = 0
+    while fc.has_work() or submitted < len(reqs):
+        if step % max(args.stagger, 1) == 0 and submitted < len(reqs):
+            fc.submit(reqs[submitted])
+            submitted += 1
+        fc.step()
+        step += 1
+    dt = time.perf_counter() - t0
+
+    total = 0
+    for rid in sorted(fc.outputs):
+        o = fc.outputs[rid]
+        total += len(o.token_ids)
+        # the journey the tier exists for: prefill replica -> push ->
+        # decode replica
+        path = " -push-> ".join(fc.history.get(rid, []))
+        dist_print(f"{rid}: prompt {len(o.prompt)} -> "
+                   f"{len(o.token_ids)} tokens "
+                   f"({o.finish_reason.value}) via {path}")
+    s = fc.fleet_summary()
+    d = s["disagg"]
+    dist_print(f"disagg: {total} tokens / {args.requests} requests in "
+               f"{dt * 1e3:.1f} ms over {s['steps']} fleet steps — "
+               f"{d['pushes']} pushes, {d['push_fallbacks']} "
+               f"fallbacks, {s['deaths']} deaths")
+    for name, r in s["replicas"].items():
+        dist_print(f"  {name} ({r['role']}): {r['state']}, "
+                   f"{r.get('completed', 0)} completed, "
+                   f"{r.get('pushed_out', 0)} pushed out / "
+                   f"{r.get('pushed_in', 0)} pushed in")
+    if fc.outputs:
+        rid = sorted(fc.outputs)[0]
+        hops = [f"{e['kind']}->{e.get('chosen')}"
+                for e in fc.explain(rid)
+                if e["kind"] in ("route", "decode_target", "push")]
+        dist_print(f"{rid} routing audit: {' '.join(hops)}")
     dist_print("done")
 
 
@@ -812,6 +942,8 @@ def main():
         # layout without a mesh would serve plain world-1 while the
         # user believes they exercised sequence sharding.
         raise SystemExit("--kv-shard needs --mesh N (and --engine)")
+    if args.disagg is not None:
+        return run_disagg(args, jax.random.key(args.seed))
     if args.engine and args.fleet is not None:
         if args.mesh is not None:
             raise SystemExit("--mesh does not compose with --fleet yet "

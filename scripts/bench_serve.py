@@ -742,6 +742,172 @@ def bench_fleet_net(*, n_replicas: int = 2, batch: int = 4,
     }
 
 
+def bench_disagg(*, prefill: int = 1, decode: int = 2, batch: int = 4,
+                 prompt_len: int = 16, new_tokens: int = 48,
+                 burst_len: int = 128, burst_n: int = 2,
+                 dim: int = 64, n_layers: int = 2, vocab: int = 256,
+                 page_size: int = 16, seed: int = 0,
+                 warmup: bool = True) -> dict:
+    """Disaggregated prefill→decode serving (docs/serving.md
+    "Disaggregated serving"): the P:D tier vs a co-located fleet of the
+    same size, under a long-prompt burst landing mid-decode.
+
+    ``serve_disagg_zero_loss`` is the headline: the chaos leg SIGKILLs
+    the prefill tier mid-push and a decode replica post-adopt, and
+    reports the fraction of streams that still finish BIT-IDENTICAL to
+    the single-engine oracle with exactly-once delivery.  1.0 is the
+    only acceptable reading (PERF_FLOORS.json floors it there — a
+    correctness guardrail wearing a bench harness, like
+    serve_fleet_zero_loss).  ``serve_disagg_itl_isolation`` is the
+    interference story: decode p99 inter-token latency under the burst,
+    co-located over disagg — > 1 means the split shielded decode from
+    the prefill burst.  Informational on CPU hosts (the compute/memory
+    split the ratio measures needs a real accelerator to show its
+    shape)."""
+    import shutil
+    import tempfile
+
+    from triton_dist_tpu.models import llama
+    from triton_dist_tpu.models.generate import Generator
+    from triton_dist_tpu.serve import Request, SamplingParams, ServeEngine
+    from triton_dist_tpu.serve.disagg import DisaggController
+    from triton_dist_tpu.serve.fleet import FleetController, ReplicaState
+
+    n_replicas = prefill + decode
+    max_seq = max(prompt_len, burst_len) + new_tokens
+    max_seq += (-max_seq) % page_size
+    cfg = llama.LlamaConfig(vocab=vocab, dim=dim, n_layers=n_layers,
+                            n_heads=2, n_kv_heads=2, ffn_dim=2 * dim,
+                            max_seq=max_seq, dtype=jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    params = llama.init_params(cfg, jax.random.key(seed))
+    gen = Generator(cfg, mesh, axis="sp", max_seq=max_seq)
+    per_req = -(-max_seq // page_size)
+    rng = np.random.default_rng(seed)
+    n_reqs = max(decode, 1) * batch
+    reqs = [(f"d{i}", rng.integers(0, vocab, size=prompt_len)
+             .astype(np.int32)) for i in range(n_reqs)]
+    burst = [(f"b{i}", rng.integers(0, vocab, size=burst_len)
+              .astype(np.int32)) for i in range(burst_n)]
+    sp = SamplingParams(max_new_tokens=new_tokens)
+    bsp = SamplingParams(max_new_tokens=8)
+
+    def factory(d):
+        eng = ServeEngine(gen, params,
+                          num_blocks=1 + per_req * (batch + burst_n),
+                          page_size=page_size, max_batch=batch,
+                          prefill_chunk=max(8, page_size),
+                          snapshot_dir=d)
+        if warmup:
+            eng.warmup()
+        return eng
+
+    def make_fc(root, disagg):
+        if disagg:
+            return DisaggController(factory, prefill, decode, root=root,
+                                    backoff_base_s=0.01,
+                                    backoff_cap_s=0.1,
+                                    suspect_after_s=1e6,
+                                    dead_after_s=2e6, seed=seed)
+        return FleetController(factory, n_replicas, root=root,
+                               backoff_base_s=0.01, backoff_cap_s=0.1,
+                               suspect_after_s=1e6, dead_after_s=2e6,
+                               seed=seed)
+
+    def drive(disagg, chaos=False):
+        root = tempfile.mkdtemp(prefix="bench_disagg_")
+        fc = make_fc(root, disagg)
+        stamps: dict = {rid: [] for rid, _ in reqs}
+
+        def on_tok(rid, _tok):
+            stamps[rid].append(time.perf_counter())
+
+        for rid, prompt in reqs:
+            fc.submit(Request(rid, prompt, sp, on_token=on_tok))
+        burst_sent = killed_decode = killed_prefill = False
+        t0 = time.perf_counter()
+        while fc.has_work() or not burst_sent:
+            # the burst lands once decode is underway everywhere
+            if (not burst_sent
+                    and all(len(s) >= 4 for s in stamps.values())):
+                for rid, prompt in burst:
+                    fc.submit(Request(rid, prompt, bsp))
+                burst_sent = True
+            if chaos and disagg:
+                if not killed_decode and fc.pushes >= 1:
+                    vs = {fc.placement.get(rid) for rid in fc.streams
+                          if rid not in fc.outputs} - {None, "r0"}
+                    if vs:
+                        fc.kill_replica(sorted(vs)[0],
+                                        "bench chaos: post-adopt")
+                        killed_decode = True
+                elif (killed_decode and not killed_prefill
+                      and (fc.replicas["r0"].state
+                           is ReplicaState.HEALTHY)
+                      and any(p == "r0"
+                              for p in fc.placement.values())):
+                    fc.kill_replica("r0", "bench chaos: mid-push")
+                    killed_prefill = True
+            fc.step()
+        dt = time.perf_counter() - t0
+        gaps = [b - a for ts in stamps.values()
+                for a, b in zip(ts, ts[1:])]
+        streams = {rid: list(fc.streams[rid]) for rid, _ in reqs}
+        outs = {rid: list(fc.outputs[rid].token_ids)
+                for rid, _ in reqs}
+        pushes = fc.pushes if disagg else 0
+        deaths = fc.deaths
+        kills_landed = (killed_decode and killed_prefill)
+        shutil.rmtree(root, ignore_errors=True)
+        return dt, gaps, streams, outs, pushes, deaths, kills_landed
+
+    # oracle: every stream is per-request deterministic
+    oracle = {}
+    for rid, prompt in reqs:
+        eng = ServeEngine(gen, params,
+                          num_blocks=1 + per_req * (batch + burst_n),
+                          page_size=page_size, max_batch=batch,
+                          prefill_chunk=max(8, page_size))
+        eng.submit(Request(rid, prompt, sp))
+        oracle[rid] = list(eng.run()[rid].token_ids)
+
+    _, colo_gaps, _, couts, _, _, _ = drive(disagg=False)
+    dt, dis_gaps, _, douts, pushes, _, _ = drive(disagg=True)
+    for rid in oracle:
+        assert douts[rid] == oracle[rid], f"disagg diverged on {rid}"
+        assert couts[rid] == oracle[rid], f"co-located diverged on {rid}"
+    colo_p99 = float(np.percentile(colo_gaps, 99)) * 1e3
+    dis_p99 = float(np.percentile(dis_gaps, 99)) * 1e3
+
+    cdt, _, cstreams, chouts, cpushes, cdeaths, kills = drive(
+        disagg=True, chaos=True)
+    # the floor is only meaningful if both kills actually landed — a
+    # workload that drains first would read 1.0 vacuously
+    assert kills, ("chaos leg drained before both kills landed; "
+                   "grow the workload")
+    exact = sum(1 for rid in oracle
+                if chouts[rid] == oracle[rid]
+                and cstreams[rid] == oracle[rid])
+    return {
+        "mode": "disagg",
+        "prefill": prefill,
+        "decode": decode,
+        "requests": n_reqs,
+        "burst": burst_n,
+        "new_tokens": new_tokens,
+        "wall_s": round(dt, 4),
+        "pushes": pushes,
+        "decode_itl_p99_ms_disagg": round(dis_p99, 3),
+        "decode_itl_p99_ms_colocated": round(colo_p99, 3),
+        "serve_disagg_itl_isolation": round(colo_p99 / max(dis_p99,
+                                                           1e-9), 4),
+        "chaos_wall_s": round(cdt, 4),
+        "chaos_deaths": cdeaths,
+        "chaos_pushes": cpushes,
+        "serve_disagg_zero_loss": round(exact / len(oracle), 4),
+    }
+
+
 def bench_fleet_trace_overhead(*, n_replicas: int = 2, batch: int = 4,
                                prompt_len: int = 16,
                                new_tokens: int = 64, dim: int = 64,
@@ -899,6 +1065,16 @@ def main():
                         "(healed at SUSPECT), zero-loss vs the oracle "
                         "(bench.py's serve_fleet_net_zero_loss, "
                         "floor 1.0)")
+    p.add_argument("--disagg", default=None, metavar="P:D",
+                   help="disaggregated prefill→decode tier: P prefill "
+                        "+ D decode replicas vs a co-located fleet of "
+                        "the same size under a long-prompt burst "
+                        "(serve_disagg_itl_isolation, informational "
+                        "on CPU), then the chaos leg — SIGKILL the "
+                        "prefill tier mid-push and a decode replica "
+                        "post-adopt — zero-loss vs the oracle "
+                        "(bench.py's serve_disagg_zero_loss, floor "
+                        "1.0)")
     args = p.parse_args()
     if args.sessions is not None and args.sessions < 1:
         p.error(f"--sessions must be >= 1, got {args.sessions}")
@@ -921,6 +1097,33 @@ def main():
                 "--sessions")
     if args.kv_shard != "heads" and args.mesh is None:
         p.error("--kv-shard needs --mesh N")
+    if args.disagg is not None:
+        if (args.mesh is not None or args.fleet is not None or args.net
+                or args.trace or args.spec or args.shared_prompt
+                or args.sessions is not None):
+            p.error("--disagg is its own mode: it does not combine "
+                    "with --mesh/--fleet/--net/--trace/--spec/"
+                    "--shared-prompt/--sessions")
+        from triton_dist_tpu.serve.disagg import parse_disagg
+        try:
+            n_p, n_d = parse_disagg(args.disagg)
+        except ValueError as e:
+            p.error(str(e))
+        r = bench_disagg(prefill=n_p, decode=n_d, batch=args.batch,
+                         prompt_len=args.prompt_len,
+                         new_tokens=args.new_tokens, dim=args.dim,
+                         n_layers=args.layers,
+                         page_size=args.page_size, seed=args.seed,
+                         warmup=not args.no_warmup)
+        print(json.dumps(r))
+        print(f"# disagg {r['prefill']}:{r['decode']}: {r['pushes']} "
+              f"pushes; chaos kill both tiers -> zero-loss "
+              f"{r['serve_disagg_zero_loss']:.3f} (floor 1.0); decode "
+              f"p99 ITL {r['decode_itl_p99_ms_disagg']:.2f} ms vs "
+              f"co-located {r['decode_itl_p99_ms_colocated']:.2f} ms "
+              f"({r['serve_disagg_itl_isolation']:.2f}x, informational "
+              f"on CPU)", file=sys.stderr)
+        return
     if args.mesh is not None:
         r = bench_mesh(n_devices=args.mesh, kv_shard=args.kv_shard,
                        batch=args.batch, prompt_len=args.prompt_len,

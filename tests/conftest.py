@@ -160,6 +160,14 @@ _FAST_GATE_MODULES = {
     # + partition another, deadline-bounded — the ISSUE-12 acceptance
     # bar; the whole file is the fast tier).
     "test_serve_net",
+    # disaggregated serving (ISSUE 16): role-aware routing units, the
+    # engine-pair push round trip (in-place adoption, receipts,
+    # re-admission), the tier bit-exactness + audit oracle, the
+    # capacity-walk / general-placer fallbacks, lost-ack push
+    # idempotency, AND both chaos harnesses (in-process and subprocess
+    # SIGKILL of either tier mid-hand-off — the ISSUE-16 acceptance
+    # bar; the whole file is the fast tier).
+    "test_serve_disagg",
     # kernel-layer observability: the annotation-coverage source-grep
     # meta-test (every public kernel entry point annotated — the
     # ISSUE-14 closure gate), the kprobe overlap-scoreboard reports,

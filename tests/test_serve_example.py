@@ -213,6 +213,33 @@ def test_serve_engine_fleet_cli(tmp_path):
     assert "done" in out
 
 
+def test_serve_disagg_cli(tmp_path):
+    """--disagg P:D through the CLI: every request's printed journey is
+    prefill replica -push-> decode replica, the summary counts the
+    pushes, and combining --disagg with --engine/--mesh or a malformed
+    spec is rejected (docs/serving.md "Disaggregated serving")."""
+    out = _run("--disagg", "1:2", "--requests", "4", "--stagger", "2",
+               "--max-batch", "2", "--page-size", "8", "--snapshot-dir",
+               str(tmp_path / "disagg"), devices=1, new_tokens=5)
+    assert "disagg tier: 1 prefill + 2 decode replicas" in out, out
+    assert "'r0': 'prefill'" in out and "'r1': 'decode'" in out, out
+    assert "disagg: 20 tokens / 4 requests" in out, out
+    assert "4 pushes, 0 fallbacks, 0 deaths" in out, out
+    import re
+    paths = re.findall(r"req-\d+: prompt \d+ -> (\d+) tokens "
+                       r"\((\w+)\) via (\S+) -push-> (\S+)", out)
+    assert len(paths) == 4, out
+    assert all(p[:3] == ("5", "length", "r0") for p in paths), out
+    assert all(p[3] in ("r1", "r2") for p in paths), out
+    assert "routing audit: route->r0 decode_target->" in out, out
+    assert "done" in out
+    # --disagg is its own mode, and the spec shape is validated
+    for extra in (("--disagg", "1:2", "--engine"),
+                  ("--disagg", "1:2", "--mesh", "2"),
+                  ("--disagg", "nope")):
+        _run(*extra, devices=1, expect_rc=2)
+
+
 def test_serve_engine_horizon():
     """--horizon: fused multi-step decode through the CLI — the decode
     stats line proves the dispatch economics (well under one dispatch

@@ -41,6 +41,11 @@ Layout:
   health with circuit breaking and backoff restarts, and live request
   migration over the journal/snapshot hand-off
   (docs/serving.md "Fleet serving")
+- ``disagg``     — disaggregated prefill→decode serving: role-aware
+  routing (prefill/decode/both replicas) and the per-request KV-page
+  PUSH at prefill completion — in-place adoption on the stamped decode
+  target, capacity-walk + general-placer fallbacks so no request is
+  ever lost (docs/serving.md "Disaggregated serving")
 - ``mesh``       — sharded serving: every engine device program as a
   ``shard_map`` body (TP weights + head-sharded pools, or replicated
   weights + block-sharded pools through the SP flash-decode combine),
@@ -82,4 +87,8 @@ from triton_dist_tpu.serve.fleet import (  # noqa: F401
     ReplicaState,
     RestartBackoff,
     Router,
+)
+from triton_dist_tpu.serve.disagg import (  # noqa: F401
+    DisaggController,
+    parse_disagg,
 )

@@ -1418,7 +1418,7 @@ class ServeEngine:
     # -- live migration ---------------------------------------------------
 
     def drain(self, rids: Optional[list] = None, *,
-              include_kv: bool = True) -> dict:
+              include_kv: bool = True, push: bool = False) -> dict:
         """Migrate-out: remove ``rids`` (default: every unfinished
         request) from this engine and return a migration manifest a
         peer replica's :meth:`migrate_in` continues from — the
@@ -1440,7 +1440,15 @@ class ServeEngine:
         resurrects a handed-off request, so the cross-replica token
         union stays exactly-once.  The drained requests leave the
         engine's maps entirely (they are not retirements — no output,
-        no finish accounting)."""
+        no finish accounting).
+
+        ``push=True`` keeps the identical receipt/release semantics but
+        frames the hand-off as a disaggregated prefill→decode PUSH
+        (docs/serving.md "Disaggregated serving"): the ring records
+        ``push_out`` instead of ``migrate_out`` and the
+        ``pushed_out`` counter advances instead of ``migrated_out`` —
+        tier hand-offs and failure migrations stay separately
+        observable."""
         from triton_dist_tpu.serve.recovery import MANIFEST_FORMAT
 
         if rids is None:
@@ -1516,7 +1524,7 @@ class ServeEngine:
                 self._journal.migrate(rid, len(rs.generated), now)
                 self._note_journal()
             ctx = rec["trace"]
-            self.trace.emit("migrate_out", rid,
+            self.trace.emit("push_out" if push else "migrate_out", rid,
                             tokens=len(rs.generated),
                             in_place="kv" in rec,
                             trace=ctx["trace_id"], hop=ctx["hop"],
@@ -1535,7 +1543,10 @@ class ServeEngine:
             rs.scratch = None
             rs.status = Status.FINISHED  # terminal for the old object
             del self._states[rid]
-            self.metrics.migrated_out += 1
+            if push:
+                self.metrics.pushed_out += 1
+            else:
+                self.metrics.migrated_out += 1
             reqs.append(rec)
         cfg = self.cfg
         return {
@@ -1553,7 +1564,8 @@ class ServeEngine:
         }
 
     def migrate_in(self, manifest: dict, *,
-                   on_token=None, replay_tokens: bool = False) -> dict:
+                   on_token=None, replay_tokens: bool = False,
+                   push: bool = False) -> dict:
         """Adopt a migration manifest's requests mid-stream — the target
         half of fleet live migration (docs/serving.md "Fleet serving").
 
@@ -1582,8 +1594,12 @@ class ServeEngine:
         source's journal holds the matching ``mig`` receipts).
         ``on_token`` re-attaches streaming callbacks (one callable or a
         ``{rid: callable}`` map); ``replay_tokens=True`` re-fires them
-        for the carried prefix.  Returns ``{"adopted", "requeued",
-        "rejected"}`` (rejected maps rid -> reason)."""
+        for the carried prefix.  ``push=True`` is the disaggregated
+        prefill→decode admission framing (:meth:`admit_pushed`): the
+        identical capacity-admission + in-place-adoption machinery, but
+        the ring records ``push_in`` and ``pushed_in`` advances instead
+        of the ``migrated_*`` counters.  Returns ``{"adopted",
+        "requeued", "rejected"}`` (rejected maps rid -> reason)."""
         from triton_dist_tpu.serve.recovery import (
             MANIFEST_FORMAT,
             _resolve_callback,
@@ -1701,7 +1717,8 @@ class ServeEngine:
                 rs.seq = self.scheduler._seq
                 self.scheduler._seq += 1
                 self.slots[slot] = rs
-                self.metrics.migrated_in_place += 1
+                if not push:
+                    self.metrics.migrated_in_place += 1
                 adopted.append(rid)
             else:
                 if tokens:
@@ -1710,10 +1727,13 @@ class ServeEngine:
                 rs.status = Status.WAITING
                 self.scheduler.add(rs)
                 requeued.append(rid)
-            self.metrics.migrated_in += 1
-            self.metrics.migrated_tokens += len(tokens)
-            self.trace.emit("migrate_in", rid, tokens=len(tokens),
-                            in_place=in_place,
+            if push:
+                self.metrics.pushed_in += 1
+            else:
+                self.metrics.migrated_in += 1
+                self.metrics.migrated_tokens += len(tokens)
+            self.trace.emit("push_in" if push else "migrate_in", rid,
+                            tokens=len(tokens), in_place=in_place,
                             trace=ctx["trace_id"], hop=ctx["hop"],
                             flow=f"{ctx['trace_id']}#{ctx['hop']}")
             if (replay_tokens and req.on_token is not None
@@ -1722,6 +1742,59 @@ class ServeEngine:
                     req.on_token(rid, t)
         return {"adopted": adopted, "requeued": requeued,
                 "rejected": rejected}
+
+    # -- disaggregated prefill -> decode hand-off --------------------------
+
+    def push_ready(self) -> list[str]:
+        """Requests whose prefill is complete and whose KV can leave
+        RIGHT NOW: plain RUNNING rows holding a pending token between
+        steps — exactly :meth:`drain`'s in-place hand-off eligibility.
+        The disagg controller (serve/disagg.py) polls this after each
+        step to find what a prefill-role replica should push.  Empty
+        while speculative rounds are live (spec rows carry slot-indexed
+        draft state that cannot leave this engine)."""
+        if bool(self.spec_k) and not self._spec_off:
+            return []
+        return [rid for rid, rs in self._states.items()
+                if not rid.startswith("__warmup_")
+                and rs.status is Status.RUNNING
+                and rs.pending_token is not None]
+
+    def push_out(self, rid: str, target=None) -> dict:
+        """Per-request prefill→decode hand-off: build the single-request
+        PUSH manifest (journal segment + live KV pages — the same
+        records :meth:`drain` emits) and release the request, with the
+        ``mig`` receipt journaled so crash recovery never resurrects it
+        (docs/serving.md "Disaggregated serving").
+
+        With ``target=None`` (the fleet-controller path) the manifest is
+        returned for the caller to deliver — the controller walks the
+        decode ranking on a capacity rejection.  With a ``target`` (an
+        object exposing ``admit_pushed`` — a peer :class:`ServeEngine`,
+        or a ``serve.fleet.RemoteReplica`` over the wire) the hand-off
+        is delivered directly and the admission result rides back:
+        ``{"manifest", "adopted", "requeued", "rejected"}``."""
+        m = self.drain([rid], include_kv=True, push=True)
+        if target is None:
+            return m
+        res = target.admit_pushed(m)
+        return {"manifest": m,
+                "adopted": res.get("adopted", []),
+                "requeued": res.get("requeued", []),
+                "rejected": res.get("rejected", {})}
+
+    def admit_pushed(self, manifest: dict, *, on_token=None,
+                     replay_tokens: bool = False) -> dict:
+        """Admit a prefill replica's PUSH manifest — :meth:`migrate_in`'s
+        cheap sibling (docs/serving.md "Disaggregated serving"):
+        capacity admission first (a rejected request is left for the
+        caller to place elsewhere — nothing journaled here), then
+        in-place adoption via the ``fill_pages`` scatter so the row
+        resumes RUNNING at its exact stream position with the
+        pending-token invariant intact.  Emits ``push_in`` and advances
+        ``pushed_in``; otherwise identical semantics and return shape."""
+        return self.migrate_in(manifest, on_token=on_token,
+                               replay_tokens=replay_tokens, push=True)
 
     # -- the iteration ----------------------------------------------------
 

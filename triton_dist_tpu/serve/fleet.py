@@ -215,6 +215,10 @@ FLEET_SERIES = (
     #                                per-replica health (alerting sees
     #                                WHICH breaker is open, not just a
     #                                count)
+    "fleet_replica_role",          # gauge, {replica=,role=}: one-hot
+    #                                routing role (prefill/decode/both —
+    #                                the disagg tier's shape, constant
+    #                                "both" for homogeneous fleets)
     "fleet_lives_total",           # counter: replica lives ever started
     "fleet_deaths_total",          # counter: replica deaths
     "fleet_migrations_total",      # counter: requests moved between replicas
@@ -300,6 +304,26 @@ def replica_state_lines(named_states) -> list[str]:
     return L
 
 
+#: Routing roles a replica can hold in a disaggregated tier
+#: (docs/serving.md "Disaggregated serving").  A role is routing
+#: POLICY, not capability — every replica can compute anything, so
+#: availability fallbacks may cross role lines.
+REPLICA_ROLES = ("prefill", "decode", "both")
+
+
+def replica_role_lines(named_roles) -> list[str]:
+    """The ``fleet_replica_role{replica=,role=}`` one-hot exposition
+    from ``[(name, role), ...]`` — same full-matrix rendering rule as
+    :func:`replica_state_lines` (a PromQL ``max by (replica)`` stays
+    well-defined if roles ever flip)."""
+    L = ["# TYPE fleet_replica_role gauge"]
+    for name, role in named_roles:
+        for r in REPLICA_ROLES:
+            L.append(f'fleet_replica_role{{replica="{name}",'
+                     f'role="{r}"}} {1 if role == r else 0}')
+    return L
+
+
 class Router:
     """Least-pressure admission placement over HEALTHY replicas.
 
@@ -366,6 +390,9 @@ class EngineReplica:
         self.name = name
         self._factory = factory
         self.root = root
+        # routing role (REPLICA_ROLES) — "both" keeps homogeneous
+        # fleets exactly as before; DisaggController splits the tier
+        self.role = "both"
         self.engine = None
         self.life = 0
         self.state = ReplicaState.DEAD
@@ -496,6 +523,10 @@ class RemoteReplica:
         self._bounced: list[tuple] = []   # (header, rec) to re-place
         self._drains = 0
         self._migs = 0
+        self._pushes = 0
+        # prefill-complete rids the remote engine reported on its last
+        # health answer — the disagg controller's PUSH trigger
+        self._push_ready: list[str] = []
 
     def attach_fleet(self, audit: DecisionAudit) -> None:
         """Wire this client's retry reporting into the fleet's decision
@@ -536,6 +567,7 @@ class RemoteReplica:
             running=int(h.get("running", 0)),
             max_batch=int(h.get("max_batch", 1)),
             kv_util=float(h.get("kv_util", 0.0)))
+        self._push_ready = [str(r) for r in h.get("push_ready", ())]
         return True
 
     def ping(self, force: bool = False) -> bool:
@@ -625,11 +657,24 @@ class RemoteReplica:
         return None
 
     def migrate_in(self, manifest: dict, *, on_token=None) -> dict:
+        self._migs += 1
+        return self._send_manifest(manifest, on_token, op="migrate_in",
+                                   key=f"{self.name}-mig-{self._migs}")
+
+    def admit_pushed(self, manifest: dict, *, on_token=None) -> dict:
+        """Adopt a disagg PUSH hand-off over the wire (``POST /push``
+        — the engine-side ``admit_pushed``).  Same retry / idempotency
+        / ambiguity discipline as :meth:`migrate_in`, under its own key
+        namespace and server cache kind."""
+        self._pushes += 1
+        return self._send_manifest(manifest, on_token, op="push",
+                                   key=f"{self.name}-push-{self._pushes}")
+
+    def _send_manifest(self, manifest: dict, on_token, *, op: str,
+                       key: str) -> dict:
         from triton_dist_tpu.serve.recovery import _resolve_callback
 
         enc = encode_manifest(manifest)
-        self._migs += 1
-        key = f"{self.name}-mig-{self._migs}"
         rids = [rec["rid"] for rec in manifest.get("requests", ())]
         for rec in manifest.get("requests", ()):
             rid = rec["rid"]
@@ -641,7 +686,7 @@ class RemoteReplica:
                 "req": None}
         try:
             resp = self.client.call(
-                "migrate_in", "/migrate_in", method="POST",
+                op, f"/{op}", method="POST",
                 body={"manifest": enc, "key": key},
                 timeout_s=max(self.timeout_s, 30.0))
         except NetHTTPError as e:
@@ -655,7 +700,7 @@ class RemoteReplica:
             # ambiguous — bound here until reconciled or resolved by
             # the journal at death (same argument as submit)
             self._maybe_migs.append({"enc": enc, "key": key,
-                                     "manifest": manifest})
+                                     "manifest": manifest, "op": op})
             return {"adopted": [], "requeued": rids, "rejected": {}}
         for rid in resp.get("rejected", {}):
             self._live.pop(rid, None)
@@ -664,7 +709,7 @@ class RemoteReplica:
                 "rejected": resp.get("rejected", {})}
 
     def drain(self, rids: Optional[list] = None, *,
-              include_kv: bool = True) -> dict:
+              include_kv: bool = True, push: bool = False) -> dict:
         """Cooperative migrate-out over the wire.  The idempotency key
         makes a retried drain return the CACHED manifest — the engine
         drains once however flaky the ack path was.  Raises
@@ -684,13 +729,27 @@ class RemoteReplica:
         key = f"{self.name}-drain-{self._drains + 1}"
         resp = self.client.call(
             "drain", "/drain", method="POST",
-            body={"rids": rids, "key": key, "include_kv": include_kv},
+            body={"rids": rids, "key": key, "include_kv": include_kv,
+                  "push": push},
             timeout_s=max(self.timeout_s, 30.0))
         self._drains += 1
         m = decode_manifest(resp["manifest"])
         for rec in m.get("requests", ()):
             self._live.pop(rec["rid"], None)
         return m
+
+    def push_ready(self) -> list[str]:
+        """Prefill-complete rids from the last health answer — the
+        remote twin of ``ServeEngine.push_ready`` (stale by at most one
+        poll interval; the push itself re-validates via the drain)."""
+        return list(self._push_ready)
+
+    def push_out(self, rid: str) -> dict:
+        """Extract ``rid``'s PUSH hand-off manifest (a single-request
+        drain framed as ``push_out`` — ``/drain`` with ``push=true``).
+        Raises :class:`NetError` when the replica is unreachable; the
+        drain key replays a landed-but-unacked attempt."""
+        return self.drain([rid], push=True)
 
     def has_work(self) -> bool:
         return (any(not s["done"] for s in self._live.values())
@@ -736,9 +795,10 @@ class RemoteReplica:
                 continue
             del self._maybe_reqs[rid]
         for m in list(self._maybe_migs):
+            op = m.get("op", "migrate_in")
             try:
                 resp = self.client.call(
-                    "migrate_in", "/migrate_in", method="POST",
+                    op, f"/{op}", method="POST",
                     body={"manifest": m["enc"], "key": m["key"]},
                     timeout_s=max(self.timeout_s, 30.0))
             except NetHTTPError:
@@ -893,9 +953,19 @@ class FleetController:
                  trace_events: int = 2048, trace_level: int = 1,
                  audit_events: int = 1024,
                  slo_window_s: float = 60.0,
-                 fleet_id: Optional[str] = None, seed: int = 0):
+                 fleet_id: Optional[str] = None, seed: int = 0,
+                 roles: Optional[dict] = None):
         if n_replicas < 1:
             raise ValueError(f"need >= 1 replica, got {n_replicas}")
+        # routing roles ({name: "prefill"|"decode"|"both"}, default
+        # "both" for every replica — a homogeneous fleet routes exactly
+        # as before; docs/serving.md "Disaggregated serving")
+        roles = dict(roles or {})
+        for rname, role in roles.items():
+            if role not in REPLICA_ROLES:
+                raise ValueError(
+                    f"replica {rname!r}: unknown role {role!r} "
+                    f"(expected one of {REPLICA_ROLES})")
         if not suspect_after_s < dead_after_s:
             raise ValueError(
                 f"need suspect_after_s < dead_after_s, got "
@@ -945,6 +1015,7 @@ class FleetController:
         for i in range(n_replicas):
             name = f"r{i}"
             rep = EngineReplica(name, factory, os.path.join(root, name))
+            rep.role = roles.pop(name, "both")
             self.replicas[name] = rep
             self._backoff[name] = RestartBackoff(
                 base_s=backoff_base_s, cap_s=backoff_cap_s,
@@ -954,6 +1025,10 @@ class FleetController:
             if hasattr(rep.engine, "attach_fleet"):
                 rep.engine.attach_fleet(self.audit)
             self._backoff[name].on_start(now)
+        if roles:
+            raise ValueError(
+                f"roles for unknown replicas: {sorted(roles)} "
+                f"(replicas are r0..r{n_replicas - 1})")
         self.steps = 0
         self.deaths = 0
         self.migrations = 0        # requests moved between replicas
@@ -1000,21 +1075,37 @@ class FleetController:
         if not self._place_request(req):
             self._pending_reqs.append(req)
 
-    def _healthy(self) -> list:
+    def _healthy(self, role: Optional[str] = None) -> list:
+        """HEALTHY ``(name, load)`` candidates, optionally filtered to
+        replicas that can serve ``role`` (a ``"both"`` replica serves
+        either role — role is routing preference, not capability)."""
         return [(name, r.load()) for name, r in self.replicas.items()
-                if r.state is ReplicaState.HEALTHY]
+                if r.state is ReplicaState.HEALTHY
+                and (role is None or r.role in (role, "both"))]
 
     def _place_request(self, req: Request) -> bool:
         from triton_dist_tpu.serve.engine import QueueFull
 
         healthy = self._healthy()
+        # role-aware admission: fresh requests prefer the PREFILL pool
+        # (least-pressure within it); with no prefill-capable replica
+        # up, availability beats role policy and any healthy replica
+        # serves.  All-"both" fleets: pool == healthy, routing exactly
+        # as before (docs/serving.md "Disaggregated serving").
+        pool = self._healthy("prefill") or healthy
         # capacity-aware: never place onto a queue already at its bound
         # (the engine would shed it; a fleet with room elsewhere must
         # not)
-        cands = [(n, l) for n, l in healthy
-                 if (self.replicas[n].engine.max_queue is None
-                     or l.queue_depth
-                     < self.replicas[n].engine.max_queue)]
+        def with_room(cs):
+            return [(n, l) for n, l in cs
+                    if (self.replicas[n].engine.max_queue is None
+                        or l.queue_depth
+                        < self.replicas[n].engine.max_queue)]
+        cands = with_room(pool)
+        if not cands and len(pool) < len(healthy):
+            # the whole prefill tier is at its bound: spill to the rest
+            # of the fleet rather than shed while decode queues idle
+            cands = with_room(healthy)
         deadline = req.params.deadline_s is not None
         # candidate pressures, captured BEFORE the walk: the audit
         # entry answers "why did this request land there" with the
@@ -1081,8 +1172,20 @@ class FleetController:
         pressures = ({n: round(self.router.pressure(l, deadline=deadline),
                                4) for n, l in cands}
                      if self.audit.enabled else None)
+        # decode-capable candidates first: a migrated/pushed record is
+        # past (or resuming) its prefill, so it belongs on the decode
+        # tier — prefill-role replicas stay as the availability
+        # fallback.  All-"both" fleets: one rank() call, ordering (and
+        # the round-robin tie state) exactly as before.
+        dec = [(n, l) for n, l in cands
+               if self.replicas[n].role != "prefill"]
+        rest = [(n, l) for n, l in cands
+                if self.replicas[n].role == "prefill"]
+        order = self.router.rank(dec, deadline=deadline) if dec else []
+        if rest:
+            order += self.router.rank(rest, deadline=deadline)
         rejected = {}
-        for name in self.router.rank(cands, deadline=deadline):
+        for name in order:
             rep = self.replicas[name]
             res = rep.engine.migrate_in(
                 {**header, "requests": [rec]},
@@ -1547,6 +1650,7 @@ class FleetController:
         for name, rep in self.replicas.items():
             r = {
                 "state": rep.state.value,
+                "role": rep.role,
                 "life": rep.life,
                 "restarts": rep.restarts,
                 "death_reason": rep.death_reason,
@@ -1558,7 +1662,9 @@ class FleetController:
                          kv_util=round(load.kv_util, 4),
                          completed=rep.engine.metrics.completed,
                          migrated_in=rep.engine.metrics.migrated_in,
-                         migrated_out=rep.engine.metrics.migrated_out)
+                         migrated_out=rep.engine.metrics.migrated_out,
+                         pushed_in=rep.engine.metrics.pushed_in,
+                         pushed_out=rep.engine.metrics.pushed_out)
             reps[name] = r
         return {
             "fleet_id": self.fleet_id,
@@ -1593,6 +1699,11 @@ class FleetController:
         # is SUSPECT/DEAD
         L.extend(replica_state_lines(
             (name, self.replicas[name].state)
+            for name in sorted(self.replicas)))
+        # per-replica routing role — the disagg tier's shape next to
+        # its health (constant "both" one-hots for homogeneous fleets)
+        L.extend(replica_role_lines(
+            (name, self.replicas[name].role)
             for name in sorted(self.replicas)))
         L.append("# TYPE fleet_lives_total counter")
         L.append(f"fleet_lives_total "

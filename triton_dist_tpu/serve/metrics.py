@@ -70,7 +70,8 @@ MERGE_COUNTERS = (
     "journal_records", "journal_bytes", "journal_rotations", "restores",
     "restored_in_place", "restored_requeued", "restored_tokens",
     "migrated_out", "migrated_in", "migrated_in_place",
-    "migrated_tokens", "prefix_hits", "prefix_hit_tokens",
+    "migrated_tokens", "pushed_out", "pushed_in",
+    "prefix_hits", "prefix_hit_tokens",
     "prefix_skipped_tokens", "running_sum", "kv_util_sum",
     "net_requests", "net_dup_hits", "net_redelivered_tokens",
 )
@@ -314,6 +315,13 @@ class ServeMetrics:
     migrated_in: int = 0          # manifest requests this engine adopted
     migrated_in_place: int = 0    # adopted WITH live KV (no recompute)
     migrated_tokens: int = 0      # journal tokens carried by migrations
+    # disaggregated prefill->decode counters (serve/disagg.py,
+    # docs/serving.md "Disaggregated serving"): per-request KV-page
+    # PUSH hand-offs at prefill completion — distinct from the
+    # migration counters above so tier hand-offs and failure-driven
+    # moves stay separately alertable.
+    pushed_out: int = 0           # requests pushed to a decode replica
+    pushed_in: int = 0            # pushed requests this engine admitted
     # prefix-cache counters (docs/serving.md "Prefix caching"): engine-
     # side admission hits; the block-level gauges (refcounts, cache
     # tier, COW/eviction counts) live on the attached BlockManager and
@@ -506,6 +514,8 @@ class ServeMetrics:
             "migrated_in": self.migrated_in,
             "migrated_in_place": self.migrated_in_place,
             "migrated_tokens": self.migrated_tokens,
+            "pushed_out": self.pushed_out,
+            "pushed_in": self.pushed_in,
         }
 
     def net_stats(self) -> dict:
@@ -782,6 +792,10 @@ class ServeMetrics:
                 "requests drained to a migration manifest")
         counter("serve_migrated_in_total", self.migrated_in,
                 "manifest requests adopted from another replica")
+        counter("serve_pushed_out_total", self.pushed_out,
+                "requests pushed to a decode replica at prefill end")
+        counter("serve_pushed_in_total", self.pushed_in,
+                "pushed requests admitted from a prefill replica")
         counter("serve_prefix_hits_total", self.prefix_hits)
         counter("serve_prefix_skipped_tokens_total",
                 self.prefix_skipped_tokens)
@@ -965,6 +979,10 @@ def format_stats(s: dict, *, spec: bool = False, prefix: bool = False,
                 f"{mg['migrated_in']} adopted "
                 f"({mg['migrated_in_place']} with live KV), "
                 f"{mg['migrated_tokens']} journal tokens carried")
+        if mg and (mg.get("pushed_out") or mg.get("pushed_in")):
+            lines.append(
+                f"disagg push: {mg['pushed_out']} pushed out, "
+                f"{mg['pushed_in']} admitted")
     comp = s["compilation"]
     per = ", ".join(f"{n} {c['misses']}c/{c['hits']}h"
                     for n, c in comp["programs"].items())

@@ -32,6 +32,10 @@ endpoint                  semantics
 ``POST /migrate_in``      adopt a migration manifest; same ``key`` replay
                           rule, and a duplicate rid is rejected by the
                           engine's own capacity admission
+``POST /push``            adopt a disaggregated prefill→decode PUSH
+                          hand-off (``ServeEngine.admit_pushed``); same
+                          ``key`` replay rule under its own cache kind,
+                          so a lost ack can never double-admit
 ``GET  /health``          liveness + load snapshot (the router's signal);
                           ``ok`` goes false when the serve loop stopped
                           pumping — a wedged engine thread reads as down
@@ -323,6 +327,10 @@ class ReplicaServer:
             "max_queue": eng.max_queue,
             "kv_util": round(float(eng.bm.utilization), 6),
             "unfinished": len(eng.unfinished_rids()),
+            # prefill-complete rows a disagg controller should push —
+            # rides every health/poll answer so the PUSH trigger costs
+            # no extra round trip (serve/disagg.py)
+            "push_ready": eng.push_ready(),
         }
         with self._lock:
             self._load = load
@@ -461,7 +469,8 @@ class ReplicaServer:
             rids = [r for r in (want if want is not None
                                 else sorted(present)) if r in present]
             m = self.engine.drain(rids,
-                                  include_kv=doc.get("include_kv", True))
+                                  include_kv=doc.get("include_kv", True),
+                                  push=doc.get("push", False))
             with self._lock:
                 for r in rids:
                     s = self._streams.get(r)
@@ -512,6 +521,49 @@ class ReplicaServer:
                     "requeued": res["requeued"],
                     "rejected": res["rejected"]}
             self._cache_put("migrate_in", key, resp)
+            return resp
+        return self._exec(do)
+
+    def handle_push(self, doc: dict) -> dict:
+        """Admit a prefill replica's PUSH manifest
+        (``ServeEngine.admit_pushed`` — docs/serving.md "Disaggregated
+        serving").  The same idempotency-key replay cache as
+        /migrate_in, under its own cache kind: a retried push whose
+        first attempt landed replays the cached admission verdict, so a
+        lost ack can never double-admit a request."""
+        key = doc.get("key")
+
+        def do():
+            cached = self._cached("push", key)
+            if cached is not None:
+                self._counts["dups"] += 1
+                return {**cached, "retried": True}
+            m = decode_manifest(doc["manifest"])
+            fresh, cbs = [], {}
+            for rec in m.get("requests", ()):
+                rid = rec["rid"]
+                cbs[rid] = self._appender(rid)
+                with self._lock:
+                    s = self._streams.get(rid)
+                    known = s is not None and not s["migrated"]
+                if not known:
+                    self._register(rid, tokens=rec.get("tokens", ()))
+                    fresh.append(rid)
+            try:
+                res = self.engine.admit_pushed(m, on_token=cbs)
+            except Exception:
+                # same ghost-stream cleanup as handle_migrate_in: an
+                # engine-rejected manifest surfaces as a definitive 400
+                for rid in fresh:
+                    self._unregister(rid)
+                raise
+            for rid in res["rejected"]:
+                if rid in fresh:
+                    self._unregister(rid)
+            resp = {"ok": True, "adopted": res["adopted"],
+                    "requeued": res["requeued"],
+                    "rejected": res["rejected"]}
+            self._cache_put("push", key, resp)
             return resp
         return self._exec(do)
 
@@ -624,6 +676,8 @@ class ReplicaServer:
                     return outer.handle_drain(self._body()), 200
                 if method == "POST" and path == "/migrate_in":
                     return outer.handle_migrate_in(self._body()), 200
+                if method == "POST" and path == "/push":
+                    return outer.handle_push(self._body()), 200
                 if method == "POST" and path == "/shutdown":
                     outer.request_shutdown()
                     return {"ok": True}, 200

@@ -327,14 +327,21 @@ def replay_journal(path: str | os.PathLike) -> dict[str, JournalRequest]:
                 continue
             jr = out.setdefault(rid, JournalRequest(rid=rid))
             t = rec.get("t")
-            if t == "submit" and jr.prompt is None:
-                jr.prompt = np.asarray(rec["prompt"], np.int32)
-                jr.params = SamplingParams.from_dict(rec["params"])
-                jr.arrival = rec.get("ts")
-                if jr.first_tok is None:
-                    jr.first_tok = rec.get("ftt")
-                if jr.trace is None:
-                    jr.trace = rec.get("trace")
+            if t == "submit":
+                if jr.prompt is None:
+                    jr.prompt = np.asarray(rec["prompt"], np.int32)
+                    jr.params = SamplingParams.from_dict(rec["params"])
+                    jr.arrival = rec.get("ts")
+                    if jr.first_tok is None:
+                        jr.first_tok = rec.get("ftt")
+                    if jr.trace is None:
+                        jr.trace = rec.get("trace")
+                # a submit AFTER a mig receipt re-opens ownership: the
+                # request was handed off (push/drain) and later
+                # re-admitted HERE (the disagg push fallback path) —
+                # this journal owns its stream again, and a crash must
+                # recover it rather than skip it as migrated
+                jr.migrated = False
             elif t == "tok":
                 jr.tokens.setdefault(int(rec["i"]),
                                      (int(rec["tok"]), rec.get("ts")))

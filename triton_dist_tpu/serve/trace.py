@@ -79,6 +79,13 @@ EVENT_TYPES = frozenset({
     "migrate_in",     # request adopted from a migration manifest
     "route",          # fleet router placed a request on a replica
     "replica_state",  # replica HEALTHY -> SUSPECT -> DEAD transitions
+    # disaggregated prefill->decode tier (serve/disagg.py,
+    # docs/serving.md "Disaggregated serving"): the per-request
+    # KV-page PUSH at prefill completion — the drain/migrate machinery
+    # under a distinct name, so tier hand-offs and failure migrations
+    # read apart on one timeline.
+    "push_out",       # prefill replica pushed a request's KV hand-off
+    "push_in",        # decode replica admitted a pushed request
     # network serving plane (serve/net.py, docs/serving.md "Network
     # fleet serving"): the RemoteReplica client's ring records every
     # retried call, so a postmortem shows the backoff ladder a
@@ -484,7 +491,8 @@ def link_migration_flows(sources: list,
     request's own thread, where its slices live — Perfetto binds a
     flow event to the slice enclosing its timestamp on the same
     pid/tid, so a slice-less tid would drop the arrow.  For every
-    ``migrate_in`` event, emit a flow-start (``ph: "s"``) anchored at
+    ``migrate_in`` (or disagg ``push_in``) event, emit a flow-start
+    (``ph: "s"``) anchored at
     the hand-off point on the SOURCE replica and a flow-finish
     (``ph: "f"``) at the adoption instant on the target, sharing one
     flow id — ui.perfetto.dev draws the arrow, making a migrated
@@ -506,7 +514,8 @@ def link_migration_flows(sources: list,
             ts, step, etype, rid, data = ev
             if rid is not None:
                 rid_events.setdefault(rid, []).append((ts, pid))
-            if etype == "migrate_out" and data and data.get("flow"):
+            if (etype in ("migrate_out", "push_out")
+                    and data and data.get("flow")):
                 out_by_flow[data["flow"]] = (pid, ts)
 
     def emit(ph, pid, rid, ts, fid, **extra):
@@ -518,7 +527,7 @@ def link_migration_flows(sources: list,
 
     for pid, events in sources:
         for ts, step, etype, rid, data in events:
-            if etype != "migrate_in" or rid is None:
+            if etype not in ("migrate_in", "push_in") or rid is None:
                 continue
             fid = (data or {}).get("flow") or f"{rid}#?"
             src = out_by_flow.get(fid)
