@@ -495,6 +495,26 @@ def _bench_serve_disagg():
     return r["serve_disagg_zero_loss"], r["serve_disagg_itl_isolation"]
 
 
+def _bench_serve_kv_int8():
+    """Quantized-serving capacity + fidelity (scripts/bench_serve.py
+    bench_kv_int8, docs/serving.md 'Quantized serving'): the identical
+    warmed greedy workload through a float32 and an int8 engine at
+    head_dim 64.  serve_kv_int8_capacity is the resident-token capacity
+    at EQUAL pool bytes (float bytes/token over int8 bytes/token, read
+    from the allocated pools — the model says 4D/(D+4) ~ 3.76x; the
+    1.9 floor catches a quantized pool that silently fell back to
+    float without false-alarming on layout changes).
+    serve_kv_int8_token_match is the mean greedy prefix match vs the
+    float oracle — quantization error is real and the floor pins how
+    much is acceptable.  Determinism (int8 leg bit-identical to
+    itself) is a hard assert inside the harness, not a scored field.
+    Returns (capacity, token_match)."""
+    from scripts.bench_serve import bench_kv_int8
+
+    r = bench_kv_int8(batch=4, prompt_len=16, new_tokens=32)
+    return r["serve_kv_int8_capacity"], r["serve_kv_int8_token_match"]
+
+
 def _bench_serve_fleet_trace():
     """Fleet tracing overhead (scripts/bench_serve.py
     bench_fleet_trace_overhead): the identical warmed fleet workload
@@ -677,6 +697,7 @@ def main():
     disagg_zero_loss, disagg_itl_isolation = _bench_serve_disagg()
     fleet_trace_overhead = _bench_serve_fleet_trace()
     mesh_zero_loss, mesh_tps = _bench_serve_mesh()
+    kv_int8_capacity, kv_int8_token_match = _bench_serve_kv_int8()
     overlap_eff, model_vs_meas = _bench_kernel_report()
     lint = _bench_lint()
 
@@ -749,6 +770,15 @@ def main():
         # host "chips" share this host's cores).
         "serve_mesh_zero_loss": round(mesh_zero_loss, 4),
         "serve_mesh_toks_per_s": round(mesh_tps, 1),
+        # Quantized serving (ISSUE 17): resident-token capacity at
+        # equal pool bytes — float bytes/token over int8 bytes/token on
+        # the engines' allocated pools at head_dim 64 (~3.76x; floor
+        # 1.9 guards against a silent float fallback) — and the mean
+        # greedy prefix match vs the float oracle (the acceptance
+        # metric for quantization error; determinism is a hard assert
+        # inside the harness).
+        "serve_kv_int8_capacity": round(kv_int8_capacity, 3),
+        "serve_kv_int8_token_match": round(kv_int8_token_match, 4),
         # Kernel overlap scoreboard (scripts/kernel_report.py): the
         # ag_gemm (T_compute + T_comm) / T_fused ratio and the
         # perf_model predicted-fused / measured-fused ratio from the
@@ -800,7 +830,9 @@ def main():
           f"spec/plain {spec_speedup:.2f}x t/dispatch, "
           f"trace {trace_overhead:.3f}x, "
           f"fleet zero-loss {fleet_zero_loss:.3f}, "
-          f"fleet trace {fleet_trace_overhead:.3f}x); "
+          f"fleet trace {fleet_trace_overhead:.3f}x, "
+          f"kv int8 {kv_int8_capacity:.2f}x capacity / "
+          f"{kv_int8_token_match:.3f} match); "
           f"ag overlap eff {overlap_eff:.3f} "
           f"(model/meas {model_vs_meas:.3f}); "
           f"sentinel dot {sentinel_tflops:.1f} TFLOPS"

@@ -9,7 +9,10 @@ subsystems end-to-end:
 - sampling knobs: ``--temperature`` / ``--top-k`` / ``--top-p``
   (temperature 0 = greedy), reproducible under ``--seed``;
 - optional W8A8 quantized prompt scoring for the dense family
-  (``--w8a8``: per-channel int8 weights, int8 over the AG-GEMM ring).
+  (``--w8a8``: per-channel int8 weights, int8 over the AG-GEMM ring);
+- quantized serving (``--kv-dtype int8`` with ``--engine``/``--fleet``/
+  ``--disagg``): int8 paged KV pools with per-page-slot scales, same
+  streams every run (docs/serving.md "Quantized serving").
 
 Runs anywhere, TPU or the virtual CPU mesh:
 
@@ -49,6 +52,15 @@ def parse_args():
                         "(dense family only) and report logit agreement")
     p.add_argument("--kv-int8", action="store_true",
                    help="int8 KV cache (half the memory, ~1.55x decode)")
+    p.add_argument("--kv-dtype", choices=("float32", "int8"),
+                   default="float32",
+                   help="serving modes (--engine/--fleet/--disagg): "
+                        "paged KV pool dtype.  'int8' stores pages as "
+                        "int8 with per-(block, head, page-slot) f32 "
+                        "scales — ~4x the resident sessions per pool "
+                        "byte at head_dim 64, same streams every run "
+                        "(docs/serving.md 'Quantized serving').  The "
+                        "bare generation demo uses --kv-int8 instead")
     p.add_argument("--chunk-prefill", type=int, default=None, metavar="C",
                    help="prefill in C-token chunks (bounded memory)")
     p.add_argument("--speculative", type=int, default=None, metavar="K",
@@ -294,6 +306,22 @@ def parse_args():
          or args.serve_idle_exit is not None)
             and args.serve_port is None):
         p.error("--serve-deadline/--serve-idle-exit need --serve-port")
+    if args.kv_dtype != "float32":
+        # Validated BEFORE dispatch, like --kv-shard: every serving
+        # mode either honours the dtype or refuses it loudly here —
+        # never a silent float fallback.
+        if not args.engine and args.disagg is None:
+            p.error("--kv-dtype is a serving-mode flag: add --engine "
+                    "(or --fleet/--disagg); the bare generation demo "
+                    "quantizes with --kv-int8")
+        if args.speculative:
+            p.error("--kv-dtype int8 does not compose with "
+                    "--speculative: the multi-token verify scatters "
+                    "accepted spans through the float write path "
+                    "(quantized verify is a recorded debt, ROADMAP)")
+    if args.kv_int8 and (args.engine or args.disagg is not None):
+        p.error("--kv-int8 is the bare-demo flag; serving modes take "
+                "--kv-dtype int8")
     return args
 
 
@@ -327,7 +355,9 @@ def run_fleet(args, key):
                             n_kv_heads=2, ffn_dim=64, max_seq=max_seq,
                             dtype=jnp.float32)
     params = llama.init_params(cfg, key)
-    gen = Generator(cfg, mesh, axis="sp", max_seq=max_seq)
+    gen = Generator(cfg, mesh, axis="sp", max_seq=max_seq,
+                    kv_dtype=jnp.int8 if args.kv_dtype == "int8"
+                    else None)
     page = args.page_size
     per_req = -(-max_seq // page)
     num_blocks = args.num_blocks or (1 + per_req * max(
@@ -406,6 +436,15 @@ def run_fleet(args, key):
                    f"{r.get('completed', 0)} completed, "
                    f"{r.get('migrated_in', 0)} migrated in / "
                    f"{r.get('migrated_out', 0)} out")
+    kv = [r.engine.metrics.kv_stats() for r in fc.replicas.values()
+          if r.engine is not None]
+    slots = sum(k["token_slots"] for k in kv)
+    if slots:
+        pool = sum(k["pool_bytes"] for k in kv)
+        dist_print(f"fleet kv pool: {pool} bytes for {slots} token "
+                   f"slots across {len(kv)} replicas "
+                   f"({pool / slots:.1f} B/token, "
+                   f"{'int8+scales' if any(k['quantized'] for k in kv) else 'float'})")
     lat = s["latency"]
 
     def _p(h, k):
@@ -481,7 +520,9 @@ def run_disagg(args, key):
                             n_kv_heads=2, ffn_dim=64, max_seq=max_seq,
                             dtype=jnp.float32)
     params = llama.init_params(cfg, key)
-    gen = Generator(cfg, mesh, axis="sp", max_seq=max_seq)
+    gen = Generator(cfg, mesh, axis="sp", max_seq=max_seq,
+                    kv_dtype=jnp.int8 if args.kv_dtype == "int8"
+                    else None)
     page = args.page_size
     per_req = -(-max_seq // page)
     num_blocks = args.num_blocks or (1 + per_req * max(
@@ -548,6 +589,15 @@ def run_disagg(args, key):
                    f"{r.get('completed', 0)} completed, "
                    f"{r.get('pushed_out', 0)} pushed out / "
                    f"{r.get('pushed_in', 0)} pushed in")
+    kv = [r.engine.metrics.kv_stats() for r in fc.replicas.values()
+          if r.engine is not None]
+    slots = sum(k["token_slots"] for k in kv)
+    if slots:
+        pool = sum(k["pool_bytes"] for k in kv)
+        dist_print(f"disagg kv pool: {pool} bytes for {slots} token "
+                   f"slots across {len(kv)} replicas "
+                   f"({pool / slots:.1f} B/token, "
+                   f"{'int8+scales' if any(k['quantized'] for k in kv) else 'float'})")
     if fc.outputs:
         rid = sorted(fc.outputs)[0]
         hops = [f"{e['kind']}->{e.get('chosen')}"
@@ -634,7 +684,9 @@ def run_engine(args, key):
                             ffn_dim=ffn_dim, max_seq=max_seq,
                             dtype=jnp.float32)
     params = llama.init_params(cfg, key)
-    gen = Generator(cfg, mesh, axis="sp", max_seq=max_seq)
+    gen = Generator(cfg, mesh, axis="sp", max_seq=max_seq,
+                    kv_dtype=jnp.int8 if args.kv_dtype == "int8"
+                    else None)
     draft = d_params = None
     if args.speculative:
         dcfg = llama.LlamaConfig(vocab=cfg.vocab, dim=cfg.dim // 2,

@@ -105,6 +105,106 @@ def bench_engine(horizon: int, *, batch: int = 4, prompt_len: int = 16,
     }
 
 
+def bench_kv_int8(*, batch: int = 4, prompt_len: int = 16,
+                  new_tokens: int = 32, dim: int = 128,
+                  n_layers: int = 2, vocab: int = 256,
+                  page_size: int = 16, seed: int = 0,
+                  warmup: bool = True) -> dict:
+    """Quantized-serving capacity + fidelity (docs/serving.md
+    'Quantized serving'): the SAME warmed greedy workload through a
+    float32 engine and an int8 engine of identical geometry.
+
+    Two headline fields:
+
+    - ``serve_kv_int8_capacity``: resident-token capacity at EQUAL pool
+      bytes — float bytes/token over int8 bytes/token, read from the
+      engines' own ``kv_stats()`` (the pool arrays as allocated, not a
+      formula).  With per-(block, head, slot) f32 scales the model is
+      4D/(D+4): ~3.76x at head_dim 64.  The PERF_FLOORS.json floor is
+      1.9 — well below the model so page-size/layout changes don't
+      false-alarm, well above 1 so the field still catches a quantized
+      pool that silently fell back to float.
+    - ``serve_kv_int8_token_match``: mean per-stream greedy prefix
+      match vs the float oracle (first divergence ends the credit —
+      positions after it match only by accident).  Quantization error
+      is real; the floor pins how much is acceptable, not zero.
+
+    The int8 leg runs TWICE and must be bit-identical to itself:
+    determinism is a hard assert here, not a scored metric."""
+    from triton_dist_tpu.models import llama
+    from triton_dist_tpu.models.generate import Generator
+    from triton_dist_tpu.serve import Request, SamplingParams, ServeEngine
+
+    max_seq = prompt_len + new_tokens
+    max_seq += (-max_seq) % page_size
+    # head_dim 64 (dim 128 / 2 heads): the capacity model only clears
+    # the floor when D dwarfs the 4-byte scale tax — at D=8 the ratio
+    # is 2.67 and a layout tweak could graze the floor.
+    cfg = llama.LlamaConfig(vocab=vocab, dim=dim, n_layers=n_layers,
+                            n_heads=2, n_kv_heads=2, ffn_dim=2 * dim,
+                            max_seq=max_seq, dtype=jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    params = llama.init_params(cfg, jax.random.key(seed))
+    per_req = -(-max_seq // page_size)
+    num_blocks = 1 + per_req * batch
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, vocab, size=prompt_len).astype(np.int32)
+               for _ in range(batch)]
+
+    def drive(kv_dtype):
+        gen = Generator(cfg, mesh, axis="sp", max_seq=max_seq,
+                        kv_dtype=kv_dtype)
+        eng = ServeEngine(gen, params, num_blocks=num_blocks,
+                          page_size=page_size, max_batch=batch,
+                          prefill_chunk=max(8, page_size),
+                          trace_level=0)
+        if warmup:
+            eng.warmup()
+        for i, tok in enumerate(prompts):
+            eng.submit(Request(f"q{i}", tok, SamplingParams(
+                max_new_tokens=new_tokens)))
+        t0 = time.perf_counter()
+        outs = eng.run()
+        dt = time.perf_counter() - t0
+        streams = {rid: list(o.token_ids) for rid, o in outs.items()}
+        return streams, eng.metrics.kv_stats(), dt
+
+    fp_streams, fp_kv, fp_dt = drive(None)
+    q_streams, q_kv, q_dt = drive(jnp.int8)
+    q2_streams, _, _ = drive(jnp.int8)
+    assert q_streams == q2_streams, (
+        "int8 engine is not bit-reproducible across runs")
+    assert q_kv["quantized"] and not fp_kv["quantized"]
+
+    def prefix_match(a, b):
+        n = 0
+        for x, y in zip(a, b):
+            if x != y:
+                break
+            n += 1
+        return n / max(len(a), len(b), 1)
+
+    matches = [prefix_match(fp_streams[r], q_streams[r])
+               for r in sorted(fp_streams)]
+    capacity = fp_kv["bytes_per_token"] / q_kv["bytes_per_token"]
+    total = sum(len(s) for s in fp_streams.values())
+    return {
+        "batch": batch,
+        "new_tokens": new_tokens,
+        "head_dim": cfg.head_dim,
+        "fp_bytes_per_token": round(fp_kv["bytes_per_token"], 2),
+        "int8_bytes_per_token": round(q_kv["bytes_per_token"], 2),
+        "fp_pool_bytes": fp_kv["pool_bytes"],
+        "int8_pool_bytes": q_kv["pool_bytes"],
+        "serve_kv_int8_capacity": round(capacity, 3),
+        "serve_kv_int8_token_match": round(
+            sum(matches) / max(len(matches), 1), 4),
+        "token_match_per_stream": [round(m, 3) for m in matches],
+        "fp_toks_per_s": round(total / fp_dt, 1),
+        "int8_toks_per_s": round(total / q_dt, 1),
+    }
+
+
 def bench_mesh(*, n_devices: int = 2, kv_shard: str = "heads",
                batch: int = 4, prompt_len: int = 16,
                new_tokens: int = 48, n_layers: int = 2, vocab: int = 256,
@@ -1057,6 +1157,16 @@ def main():
                    default="heads",
                    help="--mesh KV layout (docs/serving.md 'Sharded "
                         "serving')")
+    p.add_argument("--kv-dtype", choices=("float32", "int8"),
+                   default=None,
+                   help="'int8': the quantized-serving leg — identical "
+                        "warmed greedy traffic through a float32 and "
+                        "an int8 engine at head_dim 64; reports the "
+                        "equal-pool-bytes capacity ratio "
+                        "(serve_kv_int8_capacity, floor 1.9) and the "
+                        "greedy prefix match vs the float oracle "
+                        "(serve_kv_int8_token_match; docs/serving.md "
+                        "'Quantized serving')")
     p.add_argument("--net", action="store_true",
                    help="with --fleet N: the NETWORK chaos leg — "
                         "replicas reachable only over the serve/net.py "
@@ -1097,6 +1207,30 @@ def main():
                 "--sessions")
     if args.kv_shard != "heads" and args.mesh is None:
         p.error("--kv-shard needs --mesh N")
+    if args.kv_dtype is not None and (
+            args.mesh is not None or args.fleet is not None or args.net
+            or args.trace or args.spec or args.shared_prompt
+            or args.sessions is not None or args.disagg is not None):
+        p.error("--kv-dtype is its own paired leg: it does not combine "
+                "with the other modes")
+    if args.kv_dtype is not None:
+        if args.kv_dtype == "float32":
+            p.error("--kv-dtype float32 IS the baseline every other "
+                    "mode runs; the paired leg wants --kv-dtype int8")
+        r = bench_kv_int8(batch=args.batch, prompt_len=args.prompt_len,
+                          new_tokens=args.new_tokens,
+                          n_layers=args.layers,
+                          page_size=args.page_size, seed=args.seed,
+                          warmup=not args.no_warmup)
+        print(json.dumps(r))
+        print(f"# kv int8 (head_dim {r['head_dim']}): "
+              f"{r['int8_bytes_per_token']:.0f} vs "
+              f"{r['fp_bytes_per_token']:.0f} B/token -> capacity "
+              f"{r['serve_kv_int8_capacity']:.2f}x at equal pool bytes "
+              f"(floor 1.9); greedy prefix match vs float oracle "
+              f"{r['serve_kv_int8_token_match']:.3f}",
+              file=sys.stderr)
+        return
     if args.disagg is not None:
         if (args.mesh is not None or args.fleet is not None or args.net
                 or args.trace or args.spec or args.shared_prompt

@@ -168,6 +168,15 @@ _FAST_GATE_MODULES = {
     # SIGKILL of either tier mid-hand-off — the ISSUE-16 acceptance
     # bar; the whole file is the fast tier).
     "test_serve_disagg",
+    # quantized serving (ISSUE 17): int8-pool bit-reproducibility +
+    # continuous-batching-equals-dedicated oracles, the fp-oracle
+    # prefix-match floor, the construction rejection matrix, the state
+    # plane (quantized snapshot/restore, fp<->int8 loud geometry
+    # errors, drain->wire->adopt, cross-dtype requeue, lost-ack push
+    # idempotency), the head_dim-64 wire-size bound, the mixed-dtype
+    # fleet chaos kill, and w8a8 serving reproducibility; the mesh
+    # bit-exactness sweeps carry @pytest.mark.slow.
+    "test_serve_kv_int8",
     # kernel-layer observability: the annotation-coverage source-grep
     # meta-test (every public kernel entry point annotated — the
     # ISSUE-14 closure gate), the kprobe overlap-scoreboard reports,

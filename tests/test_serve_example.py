@@ -240,6 +240,31 @@ def test_serve_disagg_cli(tmp_path):
         _run(*extra, devices=1, expect_rc=2)
 
 
+def test_serve_engine_kv_dtype_int8():
+    """--kv-dtype int8 (ISSUE 17): the engine serves on quantized
+    pools and the end-of-run stats block reports the QUANTIZED pool
+    bytes (the capacity the flag exists to buy), and the dispatch-time
+    rejection matrix refuses the combinations the engine would reject
+    at construction."""
+    out = _run("--engine", "--kv-dtype", "int8", "--requests", "3",
+               "--page-size", "8", devices=1, new_tokens=5)
+    assert "engine: 15 tokens / 3 requests" in out, out
+    import re
+    m = re.search(r"kv pool: (\d+) bytes for (\d+) token slots "
+                  r"\(([\d.]+) B/token, int8\+scales\)", out)
+    assert m, out
+    # the CLI engine model: n_layers=2, Hkv=2, D=16 -> 2*2*2*(16+4)
+    assert float(m.group(3)) == 160.0, out
+    assert int(m.group(1)) == 160 * int(m.group(2)), out
+    assert "done" in out
+    # rejection matrix: bare mode wants --kv-int8; spec needs float KV;
+    # serving modes refuse the bare-demo flag
+    _run("--kv-dtype", "int8", devices=1, expect_rc=2)
+    _run("--engine", "--kv-dtype", "int8", "--speculative", "2",
+         devices=1, expect_rc=2)
+    _run("--engine", "--kv-int8", devices=1, expect_rc=2)
+
+
 def test_serve_engine_horizon():
     """--horizon: fused multi-step decode through the CLI — the decode
     stats line proves the dispatch economics (well under one dispatch

@@ -644,15 +644,34 @@ def _paged_gather(pool, table):
     return g.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, n * Pg, D)
 
 
+def _paged_gather_scale(scale_pool, table):
+    """[N, Hkv, P] per-position scale pool + [B, n] table →
+    [B, Hkv, n*P] contiguous scale view (the twin of
+    :func:`_paged_gather` for an int8 pool's scale plane)."""
+    g = scale_pool[table]                             # [B, n, Hkv, P]
+    B, n, Hkv, Pg = g.shape
+    return g.transpose(0, 2, 1, 3).reshape(B, Hkv, n * Pg)
+
+
 def gqa_decode_paged_shard(q, k_pool, v_pool, block_table, local_lens, *,
                            impl="auto", interpret=False, soft_cap=0.0,
-                           window=0, window_lens=None, q_lens=None):
+                           window=0, window_lens=None, q_lens=None,
+                           k_scale=None, v_scale=None):
     """Single-shard GQA decode over a PAGED KV cache.
 
     q [B, Hq, D]; k/v_pool [N_pages, Hkv, page, D] (the physical page
     pool); block_table [B, n_pages] int32 — logical page i of batch b
     lives at pool row ``block_table[b, i]``; local_lens [B] valid rows.
     Returns float32 partials (out [B, Hq, D], lse [B, Hq]).
+
+    INT8 POOLS: ``k_scale``/``v_scale`` [N_pages, Hkv, page] float32
+    per-position scale pools dequantize int8 k/v pools (the paged twin
+    of :func:`gqa_decode_shard`'s contiguous int8 path — scales ride
+    the same page indirection as their pages).  The quantized paged
+    attend runs the fused-dequant XLA path: the dedicated Pallas
+    paged-int8 kernel (lane-packed scale planes through the table
+    index_map) is a recorded debt — on a 128-aligned-page TPU layout
+    the float kernel's gate would apply unchanged.
 
     MULTI-TOKEN (r5, same contract as :func:`gqa_decode_shard`): q may
     be [B, T, Hq, D] with optional per-request ``q_lens`` [B] — the
@@ -669,6 +688,17 @@ def gqa_decode_paged_shard(q, k_pool, v_pool, block_table, local_lens, *,
     scale = 1.0 / math.sqrt(D)
     raw_impl = impl
     impl = resolve_impl(impl, interpret)
+
+    if k_scale is not None or v_scale is not None:
+        assert k_scale is not None and v_scale is not None, (
+            "int8 paged pools carry BOTH scale planes")
+        return _local_decode_xla(
+            q, _paged_gather(k_pool, block_table),
+            _paged_gather(v_pool, block_table), local_lens, scale=scale,
+            k_scale=_paged_gather_scale(k_scale, block_table),
+            v_scale=_paged_gather_scale(v_scale, block_table),
+            soft_cap=soft_cap, window=window, window_lens=window_lens,
+            q_lens=q_lens)
 
     # A page is the kernel's KV block — it cannot shrink (it IS the cache
     # layout), so an over-budget page must reroute/raise, not reach
@@ -749,12 +779,16 @@ def _decode_kernel_paged(lens_ref, table_ref, q_ref, k_ref, v_ref, out_ref,
 
 def sp_gqa_decode_paged_shard(q, k_pool, v_pool, block_table, kv_lens, *,
                               axis, impl="auto", interpret=False,
-                              soft_cap=0.0, window=0):
+                              soft_cap=0.0, window=0, k_scale=None,
+                              v_scale=None):
     """Per-device SP decode over a paged cache: each rank's pool holds
     the pages of ITS sequence shard and ``block_table`` [B, n_local]
     holds local pool indices for the rank's logical pages.  ``kv_lens``
     are GLOBAL lengths; shard ownership follows n_local * page rows per
-    rank (the contiguous-cache rule with S_loc = n_local * page)."""
+    rank (the contiguous-cache rule with S_loc = n_local * page).
+    ``k_scale``/``v_scale`` [N, Hkv, page] dequantize int8 pools — each
+    rank's scale plane shards with its pages, the combine is unchanged
+    (partials are float either way)."""
     assert q.ndim == 3, (
         f"sp_gqa_decode_paged_shard takes single-token q [B, Hq, D], got "
         f"shape {q.shape}; the multi-token q / q_lens verify contract is "
@@ -770,7 +804,8 @@ def sp_gqa_decode_paged_shard(q, k_pool, v_pool, block_table, kv_lens, *,
                                       local_lens, impl=impl,
                                       interpret=interpret,
                                       soft_cap=soft_cap, window=window,
-                                      window_lens=ends if window else None)
+                                      window_lens=ends if window else None,
+                                      k_scale=k_scale, v_scale=v_scale)
     return _combine_across_ranks(out, lse, q.dtype, axis=axis, impl=impl,
                                  interpret=interpret)
 

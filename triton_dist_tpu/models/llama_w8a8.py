@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from triton_dist_tpu.kernels.quant import quantize_channelwise
+from triton_dist_tpu.kernels.quant import quantize_channelwise, w8a8_linear
 from triton_dist_tpu.layers.tp_linear import (
     column_parallel_linear_w8a8,
     row_parallel_linear_w8a8,
@@ -118,6 +118,90 @@ def place_w8a8_params(qparams, cfg: LlamaConfig, mesh: Mesh,
     return jax.tree.map(
         lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
         qparams, specs, is_leaf=lambda x: isinstance(x, P))
+
+
+# ---------------------------------------------------------------------------
+# W8A8 SERVING (ServeEngine's weight plane — docs/serving.md "Quantized
+# serving"): QKV stays float (RoPE/attention/paged-KV addressing are
+# untouched, and the serving forwards contract wq/wk/wv per head), while
+# the two hook seams every serving forward already exposes — ``out_proj``
+# and ``ffn`` — run the W8A8 GEMMs.  World-1 uses the hooks bare; mesh
+# heads-TP passes ``axis=`` so the row-parallel halves psum their
+# dequantized partials (cross-rank sums need dequantized f32 — the
+# layers/tp_linear.py recipe).
+# ---------------------------------------------------------------------------
+
+
+def quantize_serve_params(params, cfg: LlamaConfig, world: int = 1) -> dict:
+    """Float serving tree → the W8A8 serving tree (host-side, once).
+
+    Unlike :func:`quantize_params_w8a8` (the full-forward tree with fused
+    QKV), serving keeps ``wq``/``wk``/``wv`` float — the paged forwards
+    reshape QKV per head and feed RoPE + the paged-attention kernels,
+    which stay in the float dtype per the standard W8A8 recipe.  Only the
+    hook-seam weights quantize: ``wgate``/``wup`` per output channel
+    (column-parallel under heads-TP), ``wo``/``wdown`` per rank k-chunk
+    with ``[world, N]`` stacked scales (row-parallel — each rank
+    dequantizes its own chunk exactly before the psum)."""
+    out = {"embed": params["embed"], "lm_head": params["lm_head"],
+           "final_norm": params["final_norm"], "layers": []}
+    for layer in params["layers"]:
+        gate_q, gate_s = _quant_col(layer["wgate"])
+        up_q, up_s = _quant_col(layer["wup"])
+        wo_q, wo_s = _quant_row(layer["wo"], world)
+        down_q, down_s = _quant_row(layer["wdown"], world)
+        out["layers"].append({
+            "attn_norm": layer["attn_norm"], "mlp_norm": layer["mlp_norm"],
+            "wq": layer["wq"], "wk": layer["wk"], "wv": layer["wv"],
+            "wgate_q": gate_q, "wgate_s": gate_s,
+            "wup_q": up_q, "wup_s": up_s,
+            "wo_q": wo_q, "wo_s": wo_s,
+            "wdown_q": down_q, "wdown_s": down_s,
+        })
+    return out
+
+
+def w8a8_serve_param_specs(cfg: LlamaConfig, axis: str = "tp") -> dict:
+    """PartitionSpec tree matching :func:`quantize_serve_params` under
+    heads-TP: float QKV shard column-parallel exactly as
+    ``llama.param_specs`` says; quantized column weights shard their
+    output channels (scales ride along on the same axis) and quantized
+    row weights shard their k-chunks (each rank holds its own [1, N]
+    scale row)."""
+    layer = {
+        "attn_norm": P(), "mlp_norm": P(),
+        "wq": P(None, axis), "wk": P(None, axis), "wv": P(None, axis),
+        "wgate_q": P(None, axis), "wgate_s": P(axis),
+        "wup_q": P(None, axis), "wup_s": P(axis),
+        "wo_q": P(axis, None), "wo_s": P(axis, None),
+        "wdown_q": P(axis, None), "wdown_s": P(axis, None),
+    }
+    return {"embed": P(), "lm_head": P(), "final_norm": P(),
+            "layers": [dict(layer) for _ in range(cfg.n_layers)]}
+
+
+def w8a8_serve_out_proj(o2, layer, *, axis=None, impl="auto",
+                        interpret=False):
+    """``out_proj`` hook: attention output through the W8A8 GEMM.
+    ``layer["wo_s"][0]`` is THIS rank's scale row — world-1 stacks one,
+    and under heads-TP the ``P(axis, None)`` shard hands each rank
+    exactly its own."""
+    y = w8a8_linear(o2, layer["wo_q"], layer["wo_s"][0], impl=impl,
+                    interpret=interpret)
+    return jax.lax.psum(y, axis) if axis is not None else y
+
+
+def w8a8_serve_ffn(h2, layer, *, axis=None, impl="auto", interpret=False):
+    """``ffn`` hook: the SwiGLU MLP with all three GEMMs W8A8 (same
+    activation math as ``generate._dense_prompt_ffn``)."""
+    gate = w8a8_linear(h2, layer["wgate_q"], layer["wgate_s"], impl=impl,
+                       interpret=interpret)
+    up = w8a8_linear(h2, layer["wup_q"], layer["wup_s"], impl=impl,
+                     interpret=interpret)
+    act = jax.nn.silu(gate.astype(jnp.float32)).astype(h2.dtype) * up
+    y = w8a8_linear(act, layer["wdown_q"], layer["wdown_s"][0], impl=impl,
+                    interpret=interpret)
+    return jax.lax.psum(y, axis) if axis is not None else y
 
 
 def w8a8_forward_shard(qparams, tokens_shard, cfg: LlamaConfig, *,

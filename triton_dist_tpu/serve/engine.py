@@ -77,9 +77,25 @@ partitioned block allocator).  The scheduler, block tables, journal,
 and step loop are unchanged host machinery; streams stay bit-identical
 to the world-1 engine and snapshots restore across mesh shapes.
 
-Scope: float KV pools, dense-Llama-family ``Generator`` (the same
-envelope as the r5 batched speculative verify; batch-1 SP + int8
-serving keeps the contiguous `Generator.generate` path).
+KV pools are float by default, or INT8 with per-page scale planes
+(ISSUE 17, docs/serving.md "Quantized serving"): construct the
+``Generator`` with ``kv_dtype=jnp.int8`` and every pool layer becomes a
+``{"q": int8 [NB, Hkv, page, D], "s": f32 [NB, Hkv, page]}`` pair —
+``_scatter_kv`` quantizes rows as they land (``flash_decode.quantize_kv``,
+the contiguous cache's recipe), the scale plane moves WITH its page
+through fill/gather/COW/snapshot/migration (never a dequant/requant
+round trip — quantization is not idempotent, so bit-reproducibility
+demands the bytes move as bytes), and attention dequantizes inside
+``gqa_decode_paged_shard``'s fused int8 path.  The emitted stream is
+bit-reproducible (same stream every run; snapshot/restore/migrate
+bit-exact; mesh bit-identical to quantized world-1) and tracked against
+the fp oracle by an explicit acceptance metric — the two-gate split
+ROADMAP #3 prescribes.  Speculative decoding over int8 pools is a
+recorded follow-up (rejected loudly at construction).
+
+Scope: dense-Llama-family ``Generator`` (the same envelope as the r5
+batched speculative verify; batch-1 SP serving keeps the contiguous
+`Generator.generate` path).
 """
 
 from __future__ import annotations
@@ -96,7 +112,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from triton_dist_tpu.kernels.flash_decode import gqa_decode_paged_shard
+from triton_dist_tpu.kernels.flash_decode import (
+    gqa_decode_paged_shard,
+    quantize_kv,
+)
 from triton_dist_tpu.models.generate import (
     GenerationState,
     Generator,
@@ -184,10 +203,33 @@ def _scatter_kv(pool, k, v, pool_row, in_page):
     """The ONE paged K/V write: scatter new rows into pool pages at
     (pool_row, in_page) — [B] indices for a decode token, [B, T] for a
     verify chunk.  Both paged forwards use it, so the write can never
-    diverge between decode and verify."""
+    diverge between decode and verify.
+
+    Quantized pools (``{"q", "s"}`` dicts) quantize each new row HERE —
+    ``quantize_kv``'s per-(head, position) absmax over D, the identical
+    recipe the contiguous quantized cache uses — so a row's int8 bytes
+    and its scale land together and never drift apart."""
     k_pool, v_pool = pool
+    if isinstance(k_pool, dict):
+        kq, ks = quantize_kv(k)
+        vq, vs = quantize_kv(v)
+        return ({"q": k_pool["q"].at[pool_row, :, in_page, :].set(kq),
+                 "s": k_pool["s"].at[pool_row, :, in_page].set(ks)},
+                {"q": v_pool["q"].at[pool_row, :, in_page, :].set(vq),
+                 "s": v_pool["s"].at[pool_row, :, in_page].set(vs)})
     return (k_pool.at[pool_row, :, in_page, :].set(k.astype(k_pool.dtype)),
             v_pool.at[pool_row, :, in_page, :].set(v.astype(v_pool.dtype)))
+
+
+def _pool_views(pool):
+    """``(k, v, k_scale, v_scale)`` kernel views of one pool layer: bare
+    float pools give ``(k, v, None, None)``; int8 dict pools expose
+    their quant and scale planes so attend closures pass them straight
+    to the paged kernels without branching on layout anywhere else."""
+    k_pool, v_pool = pool
+    if isinstance(k_pool, dict):
+        return k_pool["q"], v_pool["q"], k_pool["s"], v_pool["s"]
+    return k_pool, v_pool, None, None
 
 
 def _paged_decode_forward(params, pools, tables, kv_lens, token, active, *,
@@ -213,10 +255,11 @@ def _paged_decode_forward(params, pools, tables, kv_lens, token, active, *,
         return _scatter_kv(pool, k, v, pool_row, in_page)
 
     def attend(li, q, pool):
+        kq, vq, ks, vs = _pool_views(pool)
         o, _ = gqa_decode_paged_shard(
-            q, pool[0], pool[1], tables, kv_lens + inc, impl=impl,
+            q, kq, vq, tables, kv_lens + inc, impl=impl,
             interpret=interpret, soft_cap=cfg.attn_soft_cap,
-            window=cfg.attn_window)
+            window=cfg.attn_window, k_scale=ks, v_scale=vs)
         return o
 
     return _token_forward(params, pools, token, kv_lens,
@@ -247,10 +290,11 @@ def _paged_verify_forward(params, pools, tables, kv_lens, chunk, active, *,
         return _scatter_kv(pool, k, v, pool_row, in_page)
 
     def attend(li, q, pool):
+        kq, vq, ks, vs = _pool_views(pool)
         o, _ = gqa_decode_paged_shard(
-            q, pool[0], pool[1], tables, kv_lens + T, impl=impl,
+            q, kq, vq, tables, kv_lens + T, impl=impl,
             interpret=interpret, soft_cap=cfg.attn_soft_cap,
-            window=cfg.attn_window)
+            window=cfg.attn_window, k_scale=ks, v_scale=vs)
         return o
 
     return _multitoken_forward(params, pools, chunk, pos,
@@ -522,7 +566,21 @@ def _gather_pool_pages(pools, block_ids, *, page):
             Hkv, D = pages.shape[1], pages.shape[3]
             return (pages.transpose(1, 0, 2, 3)
                     .reshape(1, Hkv, n * page, D))
-        out.append((as_scratch(k_pool), as_scratch(v_pool)))
+
+        def as_scratch_s(sp):
+            pages = sp[block_ids]                   # [n, Hkv, page]
+            Hkv = pages.shape[1]
+            return pages.transpose(1, 0, 2).reshape(1, Hkv, n * page)
+
+        if isinstance(k_pool, dict):
+            # int8 pages travel as bytes + their scale plane — never a
+            # dequant/requant round trip (quantization isn't idempotent)
+            out.append(({"q": as_scratch(k_pool["q"]),
+                         "s": as_scratch_s(k_pool["s"])},
+                        {"q": as_scratch(v_pool["q"]),
+                         "s": as_scratch_s(v_pool["s"])}))
+        else:
+            out.append((as_scratch(k_pool), as_scratch(v_pool)))
     return out
 
 
@@ -530,11 +588,13 @@ def _copy_pool_block(pools, src, dst):
     """Copy one pool page ``src`` → ``dst`` across every layer's K and V
     — the device half of a copy-on-write split (``BlockManager.cow``
     swaps the table entry; this lands the bytes before any write)."""
-    out = []
-    for k_pool, v_pool in pools:
-        out.append((k_pool.at[dst].set(k_pool[src]),
-                    v_pool.at[dst].set(v_pool[src])))
-    return out
+    def copy(p):
+        if isinstance(p, dict):
+            return {"q": p["q"].at[dst].set(p["q"][src]),
+                    "s": p["s"].at[dst].set(p["s"][src])}
+        return p.at[dst].set(p[src])
+
+    return [(copy(k_pool), copy(v_pool)) for k_pool, v_pool in pools]
 
 
 def _fill_pool_pages(pools, scratch, block_ids, *, page):
@@ -553,8 +613,23 @@ def _fill_pool_pages(pools, scratch, block_ids, *, page):
             Hkv, S_ext, D = c.shape[1:]
             return c[0].reshape(Hkv, n, page, D).transpose(1, 0, 2, 3)
 
-        k_pool = k_pool.at[block_ids].set(as_pages(kc).astype(k_pool.dtype))
-        v_pool = v_pool.at[block_ids].set(as_pages(vc).astype(v_pool.dtype))
+        def as_pages_s(s):
+            Hkv = s.shape[1]
+            return s[0].reshape(Hkv, n, page).transpose(1, 0, 2)
+
+        if isinstance(k_pool, dict):
+            # the quantized scratch's int8 bytes + scales scatter AS-IS:
+            # the pool rows are bit-identical to the scratch rows, so a
+            # warm-prefix gather-back reproduces the cold prefill exactly
+            k_pool = {"q": k_pool["q"].at[block_ids].set(as_pages(kc["q"])),
+                      "s": k_pool["s"].at[block_ids].set(as_pages_s(kc["s"]))}
+            v_pool = {"q": v_pool["q"].at[block_ids].set(as_pages(vc["q"])),
+                      "s": v_pool["s"].at[block_ids].set(as_pages_s(vc["s"]))}
+        else:
+            k_pool = k_pool.at[block_ids].set(
+                as_pages(kc).astype(k_pool.dtype))
+            v_pool = v_pool.at[block_ids].set(
+                as_pages(vc).astype(v_pool.dtype))
         new_pools.append((k_pool, v_pool))
     return new_pools
 
@@ -645,6 +720,7 @@ class ServeEngine:
                  page_size: int, max_batch: int = 8,
                  mesh=None, tp_axis: str = "tp",
                  kv_shard: str = "heads",
+                 w8a8: bool = False,
                  prefill_chunk: int = 64,
                  prefill_budget: Optional[int] = None,
                  bucket_ladder: Optional[list] = None,
@@ -671,9 +747,38 @@ class ServeEngine:
             "tp_axis=/kv_shard= — docs/serving.md 'Sharded serving'); "
             "the Generator itself must stay world-1 (it only provides "
             "the model cfg and, off-mesh, the chunked-prefill program)")
-        assert not gen.attn.quantized, (
-            "paged int8 pools not supported yet (layer-level paged decode "
-            "has the same limit)")
+        # int8 paged KV (docs/serving.md "Quantized serving"): a
+        # Generator built with kv_dtype=jnp.int8 switches every pool
+        # layer to {"q", "s"} dicts; the stream is bit-reproducible but
+        # NOT the fp stream, so speculative decode (whose accept chain
+        # assumes the target's own fp logits) is a recorded follow-up.
+        self.kv_quant = bool(gen.attn.quantized)
+        if self.kv_quant and spec_k:
+            raise ValueError(
+                "int8 KV pools cannot drive speculative decoding yet "
+                "(recorded follow-up, ROADMAP #3): the draft/verify "
+                "round assumes fp target logits — serve with spec_k=0 "
+                "or a float kv_dtype")
+        if draft is not None and draft.attn.quantized:
+            raise ValueError(
+                "the draft Generator must keep float KV (its contiguous "
+                "caches are served unquantized); only the target's "
+                "paged pools quantize")
+        # w8a8 weights (docs/serving.md "Quantized serving"): the two
+        # hook seams (out_proj / ffn) run int8 GEMMs; QKV, norms and the
+        # KV pools are orthogonal (w8a8 composes with either kv dtype).
+        self.w8a8 = bool(w8a8)
+        if self.w8a8 and spec_k:
+            raise ValueError(
+                "w8a8 weights cannot drive speculative decoding yet "
+                "(recorded follow-up, ROADMAP #3): the draft/verify "
+                "round's target forwards are unhooked — serve with "
+                "spec_k=0 or float weights")
+        if self.w8a8 and mesh is not None and kv_shard == "seq":
+            raise ValueError(
+                "w8a8 is a tensor-parallel weight layout: supported "
+                "world-1 and kv_shard='heads' (the seq layout keeps "
+                "replicated float weights; recorded follow-up)")
         cfg = gen.cfg
         # mesh serving (docs/serving.md "Sharded serving"): with mesh=,
         # every device program below is rebuilt as a shard_map over the
@@ -904,12 +1009,45 @@ class ServeEngine:
 
         impl = gen.attn.ctx.impl
         interpret = gen.attn.ctx.interpret
-        self._pools = [
-            (jnp.zeros((num_blocks, cfg.n_kv_heads, page_size,
-                        cfg.head_dim), cfg.dtype),
-             jnp.zeros((num_blocks, cfg.n_kv_heads, page_size,
-                        cfg.head_dim), cfg.dtype))
-            for _ in range(cfg.n_layers)]
+        if self.kv_quant:
+            # int8 pools: the quant plane plus its per-(head, row) scale
+            # plane — one scale per (block, head, in-page row), the exact
+            # shape _scatter_kv's quantize_kv emits, living in the SAME
+            # pool tuple so pages and scales can never travel separately.
+            def _zpool():
+                return {"q": jnp.zeros((num_blocks, cfg.n_kv_heads,
+                                        page_size, cfg.head_dim),
+                                       jnp.int8),
+                        "s": jnp.zeros((num_blocks, cfg.n_kv_heads,
+                                        page_size), jnp.float32)}
+            self._pools = [(_zpool(), _zpool())
+                           for _ in range(cfg.n_layers)]
+        else:
+            self._pools = [
+                (jnp.zeros((num_blocks, cfg.n_kv_heads, page_size,
+                            cfg.head_dim), cfg.dtype),
+                 jnp.zeros((num_blocks, cfg.n_kv_heads, page_size,
+                            cfg.head_dim), cfg.dtype))
+                for _ in range(cfg.n_layers)]
+        # w8a8 swaps the weight tree ONCE, host-side, before any program
+        # captures it; the hooks ride the same ffn=/out_proj= seams the
+        # mesh TP bodies use, so every program below stays one copy.
+        w8a8_hooks = {}
+        if self.w8a8:
+            from triton_dist_tpu.models import llama_w8a8
+
+            params = llama_w8a8.quantize_serve_params(
+                params, cfg,
+                world=self.mesh_world if mesh is not None else 1)
+            self.params = params
+            w8a8_hooks = {
+                "ffn": functools.partial(
+                    llama_w8a8.w8a8_serve_ffn, impl=impl,
+                    interpret=interpret),
+                "out_proj": functools.partial(
+                    llama_w8a8.w8a8_serve_out_proj, impl=impl,
+                    interpret=interpret),
+            }
         # Every jitted program is wrapped for trace-cache accounting
         # (runtime/jit_cache.CountingJit): hit/miss/compile-stall
         # counters ride ServeMetrics onto the TDT_DUMP_IR dump path.
@@ -933,7 +1071,8 @@ class ServeEngine:
                 impl=impl, interpret=interpret, horizon=self.horizon,
                 draft=draft, draft_params=draft_params,
                 spec_fused=bool(spec_k) and self.spec_fused,
-                prefix_cache=self.prefix_cache)
+                prefix_cache=self.prefix_cache,
+                kv_quant=self.kv_quant, w8a8=self.w8a8)
             self._mesh_progs = progs
             self._pool_sharding = NamedSharding(mesh, progs["pool_spec"])
             # Weights live TP-sharded (heads) / replicated (seq) on the
@@ -960,21 +1099,27 @@ class ServeEngine:
         else:
             self._decode_fn = CountingJit(jax.jit(functools.partial(
                 _paged_decode_forward, cfg=cfg, page=page_size,
-                impl=impl, interpret=interpret), donate_argnums=(1,)),
-                "paged_decode")
+                impl=impl, interpret=interpret, **w8a8_hooks),
+                donate_argnums=(1,)), "paged_decode")
             self._verify_fn = CountingJit(jax.jit(functools.partial(
                 _paged_verify_forward, cfg=cfg, page=page_size,
-                impl=impl, interpret=interpret), donate_argnums=(1,)),
-                "paged_verify")
+                impl=impl, interpret=interpret, **w8a8_hooks),
+                donate_argnums=(1,)), "paged_verify")
             if self.horizon > 1:
                 # One program per (horizon rung, greedy-or-mixed): the
                 # scan length is static, so the ladder bounds the trace
                 # count and warmup() sweeps every rung (the
                 # prompt-extent ladder's twin for the decode side).
+                horizon_kw = {}
+                if self.w8a8:
+                    # the scan's per-step forward must carry the hooks
+                    horizon_kw["decode_fwd"] = functools.partial(
+                        _paged_decode_forward, cfg=cfg, page=page_size,
+                        impl=impl, interpret=interpret, **w8a8_hooks)
                 self._horizon_fn = CountingJit(jax.jit(
                     functools.partial(
                         _paged_decode_horizon, cfg=cfg, page=page_size,
-                        impl=impl, interpret=interpret),
+                        impl=impl, interpret=interpret, **horizon_kw),
                     static_argnames=("H", "all_greedy"),
                     donate_argnums=(1,)), "decode_horizon",
                     timed_statics=("H",))
@@ -994,7 +1139,22 @@ class ServeEngine:
             # The Generator's chunked-prefill program; the trace cache
             # lives on the Generator (shared with prefill_chunked/
             # speculative), the counters here see this engine's calls.
-            self._chunk_fn = CountingJit(gen._chunk_jit, "prefill_chunk")
+            # w8a8 needs its own jit: the Generator's program has no
+            # hook seams bound, and preemption recompute-exactness
+            # requires the SAME hooked program for cold and re-prefill.
+            if self.w8a8:
+                from triton_dist_tpu.models.generate import _chunk_forward
+
+                self._chunk_fn = CountingJit(jax.jit(
+                    functools.partial(
+                        _chunk_forward, cfg=cfg, impl=impl,
+                        interpret=interpret, mesh=gen.mesh,
+                        axis=gen.axis, **w8a8_hooks),
+                    static_argnames=("quantized", "extent"),
+                    donate_argnums=(2,)), "prefill_chunk")
+            else:
+                self._chunk_fn = CountingJit(gen._chunk_jit,
+                                             "prefill_chunk")
         for c in (self._chunk_fn, self._fill_fn, self._decode_fn,
                   self._verify_fn):
             if c is not None:
@@ -1005,6 +1165,15 @@ class ServeEngine:
             self.metrics.register_compiled(self._load_fn)
             self.metrics.register_compiled(self._cow_fn)
         self.metrics.attach_block_manager(self.bm)
+        # KV capacity observability (docs/observability.md "KV
+        # capacity"): pool bytes are THE capacity currency — stamp the
+        # real allocated footprint (quant + scale planes both) and the
+        # token-slot count so bytes/token and fleet-wide sums fall out.
+        self.metrics.set_kv_capacity(
+            pool_bytes=sum(int(x.size) * x.dtype.itemsize
+                           for x in jax.tree_util.tree_leaves(self._pools)),
+            token_slots=num_blocks * page_size,
+            quantized=self.kv_quant)
         # cache-tier reclaims happen inside the allocator; the hook puts
         # them on the flight-recorder timeline (an eviction storm under
         # allocation pressure is a classic tail-latency culprit)
@@ -1279,8 +1448,12 @@ class ServeEngine:
         if self._pool_sharding is None:
             return pools
         s = self._pool_sharding
-        return [(jax.device_put(k, s), jax.device_put(v, s))
-                for k, v in pools]
+        # tree_map covers both pool layouts: bare float arrays and the
+        # quantized {"q", "s"} dicts (one sharding leaf fits every plane
+        # — P(None, axis) shards the Hkv axis of 4D quant and 3D scale
+        # arrays alike; P(axis) shards their block axis).
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, s), pools)
 
     def snapshot(self, directory: Optional[str] = None) -> dict:
         """Durably capture the FULL serving state — paged KV pools +
@@ -1512,8 +1685,14 @@ class ServeEngine:
                 scratch = self._device_call(
                     "load_pages", (rid,), self._load_fn, self._pools,
                     jnp.asarray(ids))
-                rec["kv"] = [(np.asarray(k), np.asarray(v))
-                             for k, v in scratch]
+                def _host(x):
+                    # quantized scratch travels as int8 bytes + scales —
+                    # HALF the fp wire bytes, and never requantized
+                    if isinstance(x, dict):
+                        return {"q": np.asarray(x["q"]),
+                                "s": np.asarray(x["s"])}
+                    return np.asarray(x)
+                rec["kv"] = [(_host(k), _host(v)) for k, v in scratch]
                 rec["kv_len"] = rs.kv_len
                 rec["pending"] = int(rs.pending_token)
                 rec["s_ext"] = ext
@@ -1558,6 +1737,11 @@ class ServeEngine:
                 "n_kv_heads": cfg.n_kv_heads,
                 "head_dim": cfg.head_dim,
                 "dtype": str(np.dtype(cfg.dtype)),
+                # pool quantization is part of the geometry: int8 pages
+                # cannot adopt into fp pools (or vice versa) in place —
+                # a mismatched target requeues the request for exact
+                # recompute instead
+                "kv_quant": self.kv_quant,
             },
             "requests": reqs,
             "finished": [],
@@ -1618,6 +1802,7 @@ class ServeEngine:
                        "n_kv_heads": self.cfg.n_kv_heads,
                        "head_dim": self.cfg.head_dim,
                        "dtype": str(np.dtype(self.cfg.dtype)),
+                       "kv_quant": self.kv_quant,
                    })
         adopted, requeued, rejected = [], [], {}
         for rec in manifest.get("requests", ()):
@@ -1705,8 +1890,12 @@ class ServeEngine:
                 n_used = self.bm.blocks_for(rec["kv_len"])
                 ids = np.zeros((rec["s_ext"] // self.page,), np.int32)
                 ids[:n_used] = self.bm.table(rid)[:n_used]
-                scratch = [(jnp.asarray(k), jnp.asarray(v))
-                           for k, v in rec["kv"]]
+                def _dev(x):
+                    if isinstance(x, dict):
+                        return {"q": jnp.asarray(x["q"]),
+                                "s": jnp.asarray(x["s"])}
+                    return jnp.asarray(x)
+                scratch = [(_dev(k), _dev(v)) for k, v in rec["kv"]]
                 self._pools = self._device_call(
                     "fill_pages", (rid,), self._fill_fn, self._pools,
                     scratch, jnp.asarray(ids))
@@ -2267,12 +2456,24 @@ class ServeEngine:
                 self._pools, jnp.asarray(ids))
             self.metrics.prefix_skipped_tokens += start
             return
-        rs.scratch = [
-            (jnp.zeros((1, cfg.n_kv_heads, s_ext, cfg.head_dim),
-                       cfg.dtype),
-             jnp.zeros((1, cfg.n_kv_heads, s_ext, cfg.head_dim),
-                       cfg.dtype))
-            for _ in range(cfg.n_layers)]
+        if self.kv_quant:
+            # quantized scratch in the pool layout: chunked prefill
+            # quantizes each chunk's rows as it writes them (the
+            # generate._write_chunk convention), so fill_pages moves
+            # finished bytes + scales into the pool verbatim.
+            def _zs():
+                return {"q": jnp.zeros((1, cfg.n_kv_heads, s_ext,
+                                        cfg.head_dim), jnp.int8),
+                        "s": jnp.zeros((1, cfg.n_kv_heads, s_ext),
+                                       jnp.float32)}
+            rs.scratch = [(_zs(), _zs()) for _ in range(cfg.n_layers)]
+        else:
+            rs.scratch = [
+                (jnp.zeros((1, cfg.n_kv_heads, s_ext, cfg.head_dim),
+                           cfg.dtype),
+                 jnp.zeros((1, cfg.n_kv_heads, s_ext, cfg.head_dim),
+                           cfg.dtype))
+                for _ in range(cfg.n_layers)]
 
     def _run_prefill(self, rs: ReqState, n_tokens: int,
                      now: float) -> Optional[RequestOutput]:
@@ -2293,7 +2494,7 @@ class ServeEngine:
             rs.scratch, logits = self._device_call(
                 "prefill_chunk", (rs.req.request_id,), self._chunk_fn,
                 self.params, jnp.asarray(buf), rs.scratch,
-                jnp.int32(rs.prefill_pos), quantized=False,
+                jnp.int32(rs.prefill_pos), quantized=self.kv_quant,
                 extent=rs.s_ext, n_valid=jnp.int32(c))
             rs.prefill_pos += c
             n_last = c

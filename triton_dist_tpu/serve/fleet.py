@@ -1863,7 +1863,9 @@ def merge_scrapes(texts: list) -> str:
 
     Counters (and labeled counter families) sum per series; gauges sum
     except ``serve_kv_utilization`` (a ratio: the merged exposition
-    reports the max — the pressure signal an operator actually wants);
+    reports the max — the pressure signal an operator actually wants)
+    and ``serve_kv_bytes_per_token`` (re-derived from the summed
+    pool-bytes / token-slots series, never summed as a quotient);
     the five SLO histograms are REBUILT per scrape
     (``LogHistogram.from_prom`` de-accumulates the dense cumulative
     buckets) and merged count-wise, so the merged percentiles equal the
@@ -1903,6 +1905,15 @@ def merge_scrapes(texts: list) -> str:
                 parts = line.split()
                 if len(parts) == 4:
                     types.setdefault(parts[2], parts[3])
+    # bytes/token is a RATIO: summing per-replica quotients is
+    # meaningless — re-derive it from the summed pool-bytes and
+    # token-slots series so a mixed int8/fp fleet reports its true
+    # blended quotient (the serve-side twin of ServeMetrics.merge)
+    if "serve_kv_bytes_per_token" in sums:
+        slots = sums.get("serve_kv_token_slots", 0.0)
+        sums["serve_kv_bytes_per_token"] = (
+            sums.get("serve_kv_pool_bytes", 0.0) / slots if slots
+            else 0.0)
     L: list[str] = []
     typed: set = set()
     for key in order:

@@ -201,7 +201,8 @@ def _tp_ffn(h2, layer, *, axis):
 
 
 def tp_paged_decode_shard(params, pools, tables, kv_lens, token, active,
-                          *, cfg, page, axis, world, impl, interpret):
+                          *, cfg, page, axis, world, impl, interpret,
+                          ffn=None, out_proj=None):
     """Head-sharded twin of ``engine._paged_decode_forward``: QKV
     project onto the rank's head columns, the K/V scatter lands in the
     rank's pool shard, attention runs ``gqa_decode_paged_shard`` over
@@ -212,19 +213,22 @@ def tp_paged_decode_shard(params, pools, tables, kv_lens, token, active,
     the world-1 path.  The block-table addressing is the ENGINE's own
     forward — this only supplies the TP seams (local-head cfg + psum
     hooks), so the addressing can never diverge between world-1 and
-    mesh."""
+    mesh.  ``ffn``/``out_proj`` override the default TP seams (the
+    w8a8 serving hooks ride here — same psum count, quantized
+    contraction)."""
     from triton_dist_tpu.serve.engine import _paged_decode_forward
 
     return _paged_decode_forward(
         params, pools, tables, kv_lens, token, active, cfg=cfg,
         page=page, impl=impl, interpret=interpret,
         fwd_cfg=_local_cfg(cfg, world),
-        ffn=functools.partial(_tp_ffn, axis=axis),
-        out_proj=functools.partial(_tp_out_proj, axis=axis))
+        ffn=ffn or functools.partial(_tp_ffn, axis=axis),
+        out_proj=out_proj or functools.partial(_tp_out_proj, axis=axis))
 
 
 def tp_paged_verify_shard(params, pools, tables, kv_lens, chunk, active,
-                          *, cfg, page, axis, world, impl, interpret):
+                          *, cfg, page, axis, world, impl, interpret,
+                          ffn=None, out_proj=None):
     """Head-sharded twin of ``engine._paged_verify_forward`` — the
     multi-token verify under shard_map; like the decode twin, the
     engine's own forward with the TP seams supplied."""
@@ -234,8 +238,8 @@ def tp_paged_verify_shard(params, pools, tables, kv_lens, chunk, active,
         params, pools, tables, kv_lens, chunk, active, cfg=cfg,
         page=page, impl=impl, interpret=interpret,
         fwd_cfg=_local_cfg(cfg, world),
-        ffn=functools.partial(_tp_ffn, axis=axis),
-        out_proj=functools.partial(_tp_out_proj, axis=axis))
+        ffn=ffn or functools.partial(_tp_ffn, axis=axis),
+        out_proj=out_proj or functools.partial(_tp_out_proj, axis=axis))
 
 
 def _rebase_local(ids, *, axis, world, num_blocks):
@@ -265,8 +269,14 @@ def sp_paged_decode_shard(params, pools, tables, kv_lens, token, active,
     blocks and the global null — map to local row 0, the rank's own
     reserved null).  Attention goes through
     ``sp_gqa_decode_paged_shard`` (local lengths + LSE combine), so
-    the returned logits are replicated."""
-    from triton_dist_tpu.serve.engine import _page_slots, _scatter_kv
+    the returned logits are replicated.  Quantized pools ride through
+    unchanged: ``_scatter_kv`` and ``_pool_views`` are both
+    dict-aware, and the per-page scales feed the combine's dequant."""
+    from triton_dist_tpu.serve.engine import (
+        _page_slots,
+        _pool_views,
+        _scatter_kv,
+    )
 
     n_loc = n_pages_max // world
     inc = active.astype(kv_lens.dtype)
@@ -290,10 +300,11 @@ def sp_paged_decode_shard(params, pools, tables, kv_lens, token, active,
                           num_blocks=num_blocks)
 
     def attend(li, q, pool):
+        kq, vq, ks, vs = _pool_views(pool)
         return sp_gqa_decode_paged_shard(
-            q, pool[0], pool[1], lt, kv_lens + inc, axis=axis,
+            q, kq, vq, lt, kv_lens + inc, axis=axis,
             impl=impl, interpret=interpret, soft_cap=cfg.attn_soft_cap,
-            window=cfg.attn_window)
+            window=cfg.attn_window, k_scale=ks, v_scale=vs)
 
     return _token_forward(params, pools, token, kv_lens, cfg=cfg,
                           write_kv=write_kv, attend=attend)
@@ -303,7 +314,8 @@ def tp_paged_decode_horizon_shard(params, pools, tables, kv_lens, token,
                                   active, eos_done, limits, counts,
                                   base_keys, temps, top_ks, top_ps,
                                   greedy, eos_ids, *, H, all_greedy, cfg,
-                                  page, axis, world, impl, interpret):
+                                  page, axis, world, impl, interpret,
+                                  ffn=None, out_proj=None):
     """The fused decode horizon under shard_map (heads): the engine's
     ``_paged_decode_horizon`` scan with the TP per-step forward swapped
     in — on-device sampling and every carry stay replicated, so the
@@ -312,7 +324,8 @@ def tp_paged_decode_horizon_shard(params, pools, tables, kv_lens, token,
 
     fwd = functools.partial(tp_paged_decode_shard, cfg=cfg, page=page,
                             axis=axis, world=world, impl=impl,
-                            interpret=interpret)
+                            interpret=interpret, ffn=ffn,
+                            out_proj=out_proj)
     return _paged_decode_horizon(
         params, pools, tables, kv_lens, token, active, eos_done, limits,
         counts, base_keys, temps, top_ks, top_ps, greedy, eos_ids, H=H,
@@ -377,30 +390,36 @@ def tp_spec_round_shard(params, draft_params, pools, dcaches, tables,
 
 
 def tp_chunk_forward_shard(params, chunk, caches, prefix_len, n_valid, *,
-                           cfg, extent, axis, world, impl, interpret):
+                           cfg, extent, axis, world, impl, interpret,
+                           quantized=False, ffn=None, out_proj=None):
     """Head-sharded chunked prefill: ``generate._chunk_forward`` with
     the local-head cfg and the TP reduction hooks — each rank computes
     its head columns of the chunk's K/V into its shard of the prefill
     scratch, attention runs per-head over the local scratch, and the
     out-proj/FFN seams ``psum``.  ``mesh``/``axis`` stay None inside:
-    the per-rank scratch is head-local, never sequence-sharded."""
+    the per-rank scratch is head-local, never sequence-sharded.
+    ``quantized`` writes the chunk's K/V into int8+scale scratch
+    (the rank's local heads quantize independently — same per-(head,
+    position) absmax math as world-1, so the pages are bit-identical)."""
     return _chunk_forward(
         params, chunk, caches, prefix_len, cfg=_local_cfg(cfg, world),
-        quantized=False, ffn=functools.partial(_tp_ffn, axis=axis),
-        out_proj=functools.partial(_tp_out_proj, axis=axis),
+        quantized=quantized,
+        ffn=ffn or functools.partial(_tp_ffn, axis=axis),
+        out_proj=out_proj or functools.partial(_tp_out_proj, axis=axis),
         extent=extent, n_valid=n_valid, impl=impl, interpret=interpret)
 
 
 def rep_chunk_forward_shard(params, chunk, caches, prefix_len, n_valid,
-                            *, cfg, extent, impl, interpret):
+                            *, cfg, extent, impl, interpret,
+                            quantized=False):
     """Replicated chunked prefill (the seq layout, and the draft model
     under a heads mesh): every rank runs the identical world-1 chunk
     forward — prefill compute does not shard here, only the page
     scatter downstream does (kv_shard='seq' exists for the DECODE
     attention scaling; docs/serving.md records the trade)."""
     return _chunk_forward(params, chunk, caches, prefix_len, cfg=cfg,
-                          quantized=False, extent=extent, n_valid=n_valid,
-                          impl=impl, interpret=interpret)
+                          quantized=quantized, extent=extent,
+                          n_valid=n_valid, impl=impl, interpret=interpret)
 
 
 # -- page scatter / gather / COW over sharded pools -------------------------
@@ -432,9 +451,17 @@ def sp_gather_pool_pages_shard(pools, ids, *, page, axis, world,
     mine, loc = _rebase_local(ids, axis=axis, world=world,
                               num_blocks=num_blocks)
     sc = _gather_pool_pages(pools, loc, page=page)
-    rows = jnp.repeat(mine, page)[None, None, :, None]
-    sc = [(jnp.where(rows, k, jnp.zeros((), k.dtype)),
-           jnp.where(rows, v, jnp.zeros((), v.dtype))) for k, v in sc]
+    rows = jnp.repeat(mine, page)
+
+    def _own(x):
+        # scratch row axis is 2 for both layouts: [1,H,S,D] pages and
+        # [1,H,S] per-page scales — broadcast the ownership mask over
+        # whatever trails it (int8 pages psum exactly: one owner per
+        # row, everyone else contributes true zeros)
+        r = rows.reshape((1, 1, -1) + (1,) * (x.ndim - 3))
+        return jnp.where(r, x, jnp.zeros((), x.dtype))
+
+    sc = jax.tree_util.tree_map(_own, sc)
     return jax.lax.psum(sc, axis)
 
 
@@ -578,15 +605,21 @@ class MeshChunkJit:
     call convention (``(params, buf, scratch, prefix, *, quantized,
     extent, n_valid)`` with ``quantized``/``extent`` static and
     ``n_valid`` traced): one :class:`ShardedProgram` per extent rung,
-    ``n_valid`` folded into the positional args."""
+    ``n_valid`` folded into the positional args.  ``quantized`` is a
+    CONSTRUCTION property here, not a per-call rung: the pool dtype is
+    engine geometry, the chunk bodies are built for exactly one dtype,
+    and a call asking for the other is a wiring bug worth an assert."""
 
-    def __init__(self, maker):
+    def __init__(self, maker, *, quantized=False):
         self._maker = maker     # extent -> ShardedProgram
         self._progs: dict = {}
+        self._quantized = bool(quantized)
 
     def __call__(self, params, buf, scratch, prefix, *, quantized,
                  extent, n_valid):
-        assert not quantized, "mesh serving keeps float KV pools"
+        assert quantized == self._quantized, (
+            "mesh chunk prefill was built for "
+            f"quantized={self._quantized}; called with {quantized}")
         prog = self._progs.get(extent)
         if prog is None:
             prog = self._maker(extent)
@@ -671,33 +704,72 @@ def build_programs(*, mesh, tp_axis, kv_shard, cfg, params, page_size,
                    num_blocks, n_pages_max, impl, interpret,
                    horizon: int, draft=None, draft_params=None,
                    spec_fused: bool = False,
-                   prefix_cache: bool = False) -> dict:
+                   prefix_cache: bool = False,
+                   kv_quant: bool = False,
+                   w8a8: bool = False) -> dict:
     """All mesh device programs for one engine, keyed by the engine's
     program names (``paged_decode``, ``paged_verify``, ``fill_pages``,
     ``load_pages``, ``cow_copy``, ``decode_horizon``, ``prefill_chunk``
     — plus the draft family on spec engines).  Shapes/donation mirror
     the world-1 programs exactly, so warmup, metrics, and the step loop
-    need no mesh-specific branches past construction."""
+    need no mesh-specific branches past construction.
+
+    ``kv_quant`` swaps every pool/scratch spec for the dict-structured
+    ``{"q": spec, "s": spec}`` twin — the SAME PartitionSpec legally
+    covers both planes (heads shards axis 1 = Hkv of the 4D pages and
+    the 3D scales alike; seq shards the shared block axis 0), and the
+    forward/page bodies are already dict-aware, so the program set and
+    its collective seams are unchanged.  ``w8a8`` (heads only — the
+    engine rejects it elsewhere) swaps ``param_specs`` for
+    ``w8a8_serve_param_specs`` and the TP reduction seams for the
+    quantized serving hooks: same one-psum-per-seam shape, int8
+    contraction inside."""
     axis = tp_axis
     world = int(mesh.shape[axis])
     heads = kv_shard == "heads"
     pool_spec = P(None, axis) if heads else P(axis)
-    pools_specs = [(pool_spec, pool_spec)] * cfg.n_layers
-    p_specs = param_specs(cfg, axis) if heads else replicated_like(params)
+    kv_spec = ({"q": pool_spec, "s": pool_spec} if kv_quant
+               else pool_spec)
+    pools_specs = [(kv_spec, kv_spec)] * cfg.n_layers
+    if heads:
+        if w8a8:
+            from triton_dist_tpu.models.llama_w8a8 import (
+                w8a8_serve_ffn,
+                w8a8_serve_out_proj,
+                w8a8_serve_param_specs,
+            )
+
+            p_specs = w8a8_serve_param_specs(cfg, axis)
+            hooks = {
+                "ffn": functools.partial(
+                    w8a8_serve_ffn, axis=axis, impl=impl,
+                    interpret=interpret),
+                "out_proj": functools.partial(
+                    w8a8_serve_out_proj, axis=axis, impl=impl,
+                    interpret=interpret),
+            }
+        else:
+            p_specs = param_specs(cfg, axis)
+            hooks = {}
+    else:
+        p_specs = replicated_like(params)
     scratch_spec = P(None, axis) if heads else P()
+    sc_spec = ({"q": scratch_spec, "s": scratch_spec} if kv_quant
+               else scratch_spec)
 
     out = {"pool_spec": pool_spec, "params_specs": p_specs, "world": world}
 
     if heads:
         decode_body = functools.partial(
             tp_paged_decode_shard, cfg=cfg, page=page_size, axis=axis,
-            world=world, impl=impl, interpret=interpret)
+            world=world, impl=impl, interpret=interpret, **hooks)
         verify_body = functools.partial(
             tp_paged_verify_shard, cfg=cfg, page=page_size, axis=axis,
-            world=world, impl=impl, interpret=interpret)
+            world=world, impl=impl, interpret=interpret, **hooks)
         horizon_body = functools.partial(
             tp_paged_decode_horizon_shard, cfg=cfg, page=page_size,
-            axis=axis, world=world, impl=impl, interpret=interpret)
+            axis=axis, world=world, impl=impl, interpret=interpret,
+            **hooks)
         fill_body = functools.partial(
             __import_engine()._fill_pool_pages, page=page_size)
         load_body = functools.partial(
@@ -705,7 +777,7 @@ def build_programs(*, mesh, tp_axis, kv_shard, cfg, params, page_size,
         cow_body = __import_engine()._copy_pool_block
         chunk_body = functools.partial(
             tp_chunk_forward_shard, cfg=cfg, axis=axis, world=world,
-            impl=impl, interpret=interpret)
+            impl=impl, interpret=interpret, quantized=kv_quant, **hooks)
     else:
         decode_body = functools.partial(
             sp_paged_decode_shard, cfg=cfg, page=page_size, axis=axis,
@@ -727,7 +799,7 @@ def build_programs(*, mesh, tp_axis, kv_shard, cfg, params, page_size,
             num_blocks=num_blocks)
         chunk_body = functools.partial(
             rep_chunk_forward_shard, cfg=cfg, impl=impl,
-            interpret=interpret)
+            interpret=interpret, quantized=kv_quant)
 
     # (params, pools, tables, kv_lens, token/chunk, active)
     fwd_in = (p_specs, pools_specs, P(), P(), P(), P())
@@ -745,11 +817,11 @@ def build_programs(*, mesh, tp_axis, kv_shard, cfg, params, page_size,
             (pools_specs,) + (P(),) * 6, donate_argnums=(1,))
     out["fill_pages"] = ShardedProgram(
         fill_body, mesh,
-        (pools_specs, [(scratch_spec, scratch_spec)] * cfg.n_layers, P()),
+        (pools_specs, [(sc_spec, sc_spec)] * cfg.n_layers, P()),
         pools_specs, donate_argnums=(0,))
     out["load_pages"] = ShardedProgram(
         load_body, mesh, (pools_specs, P()),
-        [(scratch_spec, scratch_spec)] * cfg.n_layers)
+        [(sc_spec, sc_spec)] * cfg.n_layers)
     out["cow_copy"] = ShardedProgram(
         cow_body, mesh, (pools_specs, P(), P()), pools_specs,
         donate_argnums=(0,))
@@ -758,11 +830,11 @@ def build_programs(*, mesh, tp_axis, kv_shard, cfg, params, page_size,
         return ShardedProgram(
             functools.partial(chunk_body, extent=extent), mesh,
             (p_specs, P(),
-             [(scratch_spec, scratch_spec)] * cfg.n_layers, P(), P()),
-            ([(scratch_spec, scratch_spec)] * cfg.n_layers, P()),
+             [(sc_spec, sc_spec)] * cfg.n_layers, P(), P()),
+            ([(sc_spec, sc_spec)] * cfg.n_layers, P()),
             donate_argnums=(2,))
 
-    out["prefill_chunk"] = MeshChunkJit(make_chunk)
+    out["prefill_chunk"] = MeshChunkJit(make_chunk, quantized=kv_quant)
 
     if draft is not None and spec_fused:
         dcfg = draft.cfg

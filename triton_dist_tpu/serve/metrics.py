@@ -354,6 +354,15 @@ class ServeMetrics:
     kv_util_last: float = 0.0
     kv_util_peak: float = 0.0
     kv_util_sum: float = 0.0
+    # KV pool capacity gauges (docs/serving.md "Quantized serving"):
+    # stamped once at construction by set_kv_capacity() — the resident
+    # bytes the paged pools pin on device and the token slots they buy.
+    # bytes/token is THE quotient int8 pools exist to shrink (scales
+    # included: int8 pays Hkv*(D+4) per token-layer-plane vs fp32's
+    # Hkv*D*4), and the capacity bench gates its ratio across dtypes.
+    kv_pool_bytes: int = 0        # device bytes pinned by the KV pools
+    kv_token_slots: int = 0       # num_blocks * page_size token capacity
+    kv_quant: bool = False        # pools hold int8 pages + f32 scales
     # SLO latency histograms (serve/trace.LogHistogram): log-bucketed,
     # bounded, p50/p95/p99 in summary()["latency"] and the Prometheus
     # exposition.  TTFT/ITL/queue on the ENGINE clock; step/snapshot on
@@ -398,6 +407,34 @@ class ServeMetrics:
         self.kv_util_sum += kv_utilization
         if kv_utilization > self.kv_util_peak:
             self.kv_util_peak = kv_utilization
+
+    # -- KV pool capacity --------------------------------------------------
+
+    def set_kv_capacity(self, *, pool_bytes: int, token_slots: int,
+                        quantized: bool) -> None:
+        """Stamp the engine's KV pool geometry (the engine calls this at
+        construction, right after allocating pools): resident device
+        bytes across every pool leaf (int8 pages AND their f32 scales
+        both count — the scales are real memory), the token slots those
+        bytes buy (``num_blocks * page_size``), and whether the pools
+        are quantized.  Feeds ``summary()["kv"]``, the
+        ``serve_kv_pool_bytes`` / ``serve_kv_bytes_per_token`` gauges,
+        and the CLI stats block."""
+        self.kv_pool_bytes = int(pool_bytes)
+        self.kv_token_slots = int(token_slots)
+        self.kv_quant = bool(quantized)
+
+    def kv_stats(self) -> dict:
+        """KV pool capacity (summary()["kv"]): pool bytes, token slots,
+        and bytes/token — the memory-economics view the int8 pools
+        exist to move (docs/serving.md "Quantized serving")."""
+        return {
+            "pool_bytes": self.kv_pool_bytes,
+            "token_slots": self.kv_token_slots,
+            "bytes_per_token": (self.kv_pool_bytes / self.kv_token_slots
+                                if self.kv_token_slots else 0.0),
+            "quantized": self.kv_quant,
+        }
 
     # -- per-program wall-time attribution --------------------------------
 
@@ -550,6 +587,13 @@ class ServeMetrics:
         self.running_last += other.running_last
         self.kv_util_last = max(self.kv_util_last, other.kv_util_last)
         self.kv_util_peak = max(self.kv_util_peak, other.kv_util_peak)
+        # KV capacity sums replica-wise (the fleet's pooled bytes and
+        # slots; bytes/token re-derives from the sums, so a mixed
+        # int8/fp fleet reports its true blended quotient); kv_quant
+        # ORs — "any replica quantized" is the alertable fact
+        self.kv_pool_bytes += other.kv_pool_bytes
+        self.kv_token_slots += other.kv_token_slots
+        self.kv_quant = self.kv_quant or other.kv_quant
         for reason, n in other.finish_reasons.items():
             self.finish_reasons[reason] = \
                 self.finish_reasons.get(reason, 0) + n
@@ -730,6 +774,7 @@ class ServeMetrics:
             "latency": self.latency_stats(),
             "programs": self.program_stats(),
             "decode": self.decode_stats(),
+            "kv": self.kv_stats(),
             "spec": self.spec_stats(),
             "failures": self.failure_stats(),
             "recovery": self.recovery_stats(),
@@ -814,6 +859,16 @@ class ServeMetrics:
               "waiting requests at the last engine step")
         gauge("serve_running", self.running_last)
         gauge("serve_kv_utilization", round(self.kv_util_last, 6))
+        gauge("serve_kv_pool_bytes", self.kv_pool_bytes,
+              "device bytes pinned by the paged KV pools "
+              "(int8 pages + f32 scales both count)")
+        gauge("serve_kv_token_slots", self.kv_token_slots,
+              "token capacity of the pools (num_blocks * page_size)")
+        gauge("serve_kv_bytes_per_token",
+              round(self.kv_pool_bytes / self.kv_token_slots, 6)
+              if self.kv_token_slots else 0.0,
+              "KV pool bytes per token slot — the quotient int8 "
+              "pools shrink")
         gauge("serve_journal_bytes", self.journal_bytes)
         gauge("serve_compile_misses", self.compile_misses)
         if self.recorder is not None:
@@ -911,6 +966,13 @@ def format_stats(s: dict, *, spec: bool = False, prefix: bool = False,
          f"{_ms(lat['itl']['p99'])}, step p99 "
          f"{_ms(lat['step']['p99'])}"),
     ]
+    kv = s.get("kv")
+    if kv and kv.get("token_slots"):
+        lines.append(
+            f"kv pool: {kv['pool_bytes']} bytes for "
+            f"{kv['token_slots']} token slots "
+            f"({kv['bytes_per_token']:.1f} B/token, "
+            f"{'int8+scales' if kv['quantized'] else 'float'})")
     d = s["decode"]
     lines.append(
         f"decode horizon: {d['dispatches']} dispatches / "

@@ -126,6 +126,28 @@ def _dec_arr(d: dict) -> np.ndarray:
                          dtype=np.dtype(d["dtype"])).reshape(d["shape"])
 
 
+def _enc_kv(x) -> dict:
+    """One K or V cache element: a bare float array, or the quantized
+    ``{"q", "s"}`` pair (int8 page bytes + their scale plane travel
+    TOGETHER — the int8 payload is what halves the wire bytes of a
+    drain or a disagg KV-page push)."""
+    if isinstance(x, dict) and not x.get("__nd__"):
+        return {"q": _enc_arr(np.asarray(x["q"])),
+                "s": _enc_arr(np.asarray(x["s"]))}
+    return _enc_arr(np.asarray(x))
+
+
+def _dec_kv(x):
+    if isinstance(x, dict) and x.get("__nd__"):
+        return _dec_arr(x)
+    if isinstance(x, dict) and "q" in x:
+        return {"q": (_dec_arr(x["q"]) if isinstance(x["q"], dict)
+                      and x["q"].get("__nd__") else np.asarray(x["q"])),
+                "s": (_dec_arr(x["s"]) if isinstance(x["s"], dict)
+                      and x["s"].get("__nd__") else np.asarray(x["s"]))}
+    return np.asarray(x)
+
+
 def encode_manifest(manifest: dict) -> dict:
     """JSON-safe form of a migration manifest: KV page payloads become
     base64 blobs (dtype + shape + bytes), everything else is already
@@ -136,8 +158,7 @@ def encode_manifest(manifest: dict) -> dict:
     for rec in manifest.get("requests", ()):
         rec = dict(rec)
         if rec.get("kv") is not None:
-            rec["kv"] = [[_enc_arr(np.asarray(k)), _enc_arr(np.asarray(v))]
-                         for k, v in rec["kv"]]
+            rec["kv"] = [[_enc_kv(k), _enc_kv(v)] for k, v in rec["kv"]]
         reqs.append(rec)
     doc["requests"] = reqs
     return doc
@@ -152,12 +173,7 @@ def decode_manifest(doc: dict) -> dict:
         rec = dict(rec)
         kv = rec.get("kv")
         if kv is not None:
-            rec["kv"] = [
-                (_dec_arr(k) if isinstance(k, dict) and k.get("__nd__")
-                 else np.asarray(k),
-                 _dec_arr(v) if isinstance(v, dict) and v.get("__nd__")
-                 else np.asarray(v))
-                for k, v in kv]
+            rec["kv"] = [(_dec_kv(k), _dec_kv(v)) for k, v in kv]
         reqs.append(rec)
     m["requests"] = reqs
     return m

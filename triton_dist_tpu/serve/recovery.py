@@ -392,8 +392,18 @@ def _pool_tree(engine) -> dict:
     never expects the keys)."""
     tree = {}
     for i, (k, v) in enumerate(engine._pools):
-        tree[f"l{i}_k"] = k
-        tree[f"l{i}_v"] = v
+        if isinstance(k, dict):
+            # int8 pools: quant + scale planes snapshot AS THEY ARE —
+            # restore adopts the bytes verbatim (a dequant/requant round
+            # trip would break bit-exactness; quantization isn't
+            # idempotent)
+            tree[f"l{i}_k_q"] = k["q"]
+            tree[f"l{i}_k_s"] = k["s"]
+            tree[f"l{i}_v_q"] = v["q"]
+            tree[f"l{i}_v_s"] = v["s"]
+        else:
+            tree[f"l{i}_k"] = k
+            tree[f"l{i}_v"] = v
     if engine.spec_k and not engine._spec_off:
         sd = engine._draft_state
         for i, (k, v) in enumerate(sd.caches):
@@ -466,6 +476,10 @@ def _capture_meta(engine, now: float, *, journal_here: bool) -> dict:
         "head_dim": cfg.head_dim,
         "vocab": cfg.vocab,
         "kv_dtype": str(np.dtype(cfg.dtype)),
+        # int8 pools change the tree layout (l{i}_k_q/_s planes) AND the
+        # restore contract: quantized restores only into quantized
+        # (tolerated absent by the reader — pre-quant snapshots are fp).
+        "kv_quant": engine.kv_quant,
     }
     if engine.mesh is not None:
         # Mesh/sharding spec (docs/serving.md "Sharded serving"):
@@ -644,9 +658,18 @@ def _load_latest_snapshot(directory: str) -> Optional[tuple]:
             shape = (e["num_blocks"], e["n_kv_heads"], e["page_size"],
                      e["head_dim"])
             like = {}
-            for i in range(e["n_layers"]):
-                like[f"l{i}_k"] = jax.ShapeDtypeStruct(shape, dtype)
-                like[f"l{i}_v"] = jax.ShapeDtypeStruct(shape, dtype)
+            if e.get("kv_quant"):
+                s_shape = shape[:3]
+                for i in range(e["n_layers"]):
+                    for kv in ("k", "v"):
+                        like[f"l{i}_{kv}_q"] = jax.ShapeDtypeStruct(
+                            shape, np.int8)
+                        like[f"l{i}_{kv}_s"] = jax.ShapeDtypeStruct(
+                            s_shape, np.float32)
+            else:
+                for i in range(e["n_layers"]):
+                    like[f"l{i}_k"] = jax.ShapeDtypeStruct(shape, dtype)
+                    like[f"l{i}_v"] = jax.ShapeDtypeStruct(shape, dtype)
             d = e.get("draft")
             if e.get("spec_k") and d and "vocab" in e:
                 # Spec snapshots carry the draft's device state in the
@@ -767,6 +790,23 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
     if pools_raw is not None:
         e = meta["engine"]
         cfg = engine.cfg
+        # Pool quantization mismatches are LOUD, not a silent requeue:
+        # adopting fp bytes into int8 pools (or vice versa) would need a
+        # quantization pass that cannot be bit-exact, and silently
+        # recomputing every request would mask a deployment error (the
+        # operator pointed a differently-configured engine at live
+        # state).  Cross-dtype moves go through drain/migrate requeue by
+        # design; restore demands the same engine class.
+        if bool(e.get("kv_quant", False)) != engine.kv_quant:
+            raise ValueError(
+                f"snapshot under {directory} holds "
+                f"{'int8-quantized' if e.get('kv_quant') else 'float'} "
+                f"KV pools but the restoring engine allocates "
+                f"{'int8-quantized' if engine.kv_quant else 'float'} "
+                f"pools (Generator kv_dtype mismatch) — restore with a "
+                f"matching kv_dtype, or migrate the requests through a "
+                f"drain manifest (cross-dtype adoption requeues for "
+                f"exact recompute)")
         same_geom = (e["page_size"] == engine.page
                      and e["n_layers"] == cfg.n_layers
                      and e["n_kv_heads"] == cfg.n_kv_heads
@@ -776,18 +816,27 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
             import jax.numpy as jnp
 
             n_copy = min(e["num_blocks"], engine.bm.num_blocks)
+
+            def adopt(cur, saved):
+                if saved.shape == cur.shape:
+                    return jnp.asarray(saved)
+                # Different block count: the overlapping pool rows
+                # carry over; requests whose tables reach past them
+                # recompute instead of resuming in place.
+                return cur.at[:n_copy].set(jnp.asarray(saved)[:n_copy])
+
             new_pools = []
             for i, (k, v) in enumerate(engine._pools):
-                ko, vo = pools_raw[f"l{i}_k"], pools_raw[f"l{i}_v"]
-                if ko.shape == k.shape:
-                    new_pools.append((jnp.asarray(ko), jnp.asarray(vo)))
-                else:
-                    # Different block count: the overlapping pool rows
-                    # carry over; requests whose tables reach past them
-                    # recompute instead of resuming in place.
+                if engine.kv_quant:
                     new_pools.append(
-                        (k.at[:n_copy].set(jnp.asarray(ko)[:n_copy]),
-                         v.at[:n_copy].set(jnp.asarray(vo)[:n_copy])))
+                        ({"q": adopt(k["q"], pools_raw[f"l{i}_k_q"]),
+                          "s": adopt(k["s"], pools_raw[f"l{i}_k_s"])},
+                         {"q": adopt(v["q"], pools_raw[f"l{i}_v_q"]),
+                          "s": adopt(v["s"], pools_raw[f"l{i}_v_s"])}))
+                else:
+                    new_pools.append(
+                        (adopt(k, pools_raw[f"l{i}_k"]),
+                         adopt(v, pools_raw[f"l{i}_v"])))
             # One device_put per leaf lays the (global) restored pools
             # out on the restoring engine's mesh — restore across mesh
             # shapes is exactly this re-layout (no-op off-mesh).
