@@ -515,6 +515,25 @@ def _bench_serve_kv_int8():
     return r["serve_kv_int8_capacity"], r["serve_kv_int8_token_match"]
 
 
+def _bench_serve_overload():
+    """Bursty overload goodput guardrail (scripts/bench_serve.py
+    bench_overload, docs/serving.md 'Overload, SLO classes &
+    autoscaling'): measure fleet capacity closed-loop on a virtual
+    clock, then replay a trace-shaped workload (benchlib.trace_workload
+    — bursty arrivals, lognormal lengths, 50/30/20 class mix) at 2x
+    that rate through token-bucket ingress + the brownout ladder + the
+    autoscaler.  serve_slo_interactive_goodput is the fraction of
+    ADMITTED interactive requests finishing bit-exactly (refusals are
+    counted SHED terminals; exactly-once terminals are hard-asserted
+    inside the harness) — the ISSUE-18 bar, floor 1.0.  Returns
+    (goodput, brownout_rung_max, scale_ups)."""
+    from scripts.bench_serve import bench_overload
+
+    r = bench_overload()
+    return (r["serve_slo_interactive_goodput"],
+            r["brownout_rung_max"], r["scale_ups"])
+
+
 def _bench_serve_fleet_trace():
     """Fleet tracing overhead (scripts/bench_serve.py
     bench_fleet_trace_overhead): the identical warmed fleet workload
@@ -698,6 +717,7 @@ def main():
     fleet_trace_overhead = _bench_serve_fleet_trace()
     mesh_zero_loss, mesh_tps = _bench_serve_mesh()
     kv_int8_capacity, kv_int8_token_match = _bench_serve_kv_int8()
+    slo_goodput, slo_rung_max, slo_scale_ups = _bench_serve_overload()
     overlap_eff, model_vs_meas = _bench_kernel_report()
     lint = _bench_lint()
 
@@ -779,6 +799,16 @@ def main():
         # inside the harness).
         "serve_kv_int8_capacity": round(kv_int8_capacity, 3),
         "serve_kv_int8_token_match": round(kv_int8_token_match, 4),
+        # Overload robustness (ISSUE 18): fraction of ADMITTED
+        # interactive requests finishing bit-exactly under a bursty
+        # trace-shaped workload at 2x measured capacity through
+        # ingress + brownout + autoscaling (floor 1.0 — below it the
+        # fleet lost an interactive request it accepted).  The peak
+        # brownout rung and autoscaler spawns are the evidence the
+        # leg actually stressed the ladder, not scored fields.
+        "serve_slo_interactive_goodput": round(slo_goodput, 4),
+        "serve_slo_brownout_rung_max": slo_rung_max,
+        "serve_slo_scale_ups": slo_scale_ups,
         # Kernel overlap scoreboard (scripts/kernel_report.py): the
         # ag_gemm (T_compute + T_comm) / T_fused ratio and the
         # perf_model predicted-fused / measured-fused ratio from the
@@ -832,7 +862,9 @@ def main():
           f"fleet zero-loss {fleet_zero_loss:.3f}, "
           f"fleet trace {fleet_trace_overhead:.3f}x, "
           f"kv int8 {kv_int8_capacity:.2f}x capacity / "
-          f"{kv_int8_token_match:.3f} match); "
+          f"{kv_int8_token_match:.3f} match, "
+          f"slo goodput {slo_goodput:.3f} "
+          f"at rung {slo_rung_max} +{slo_scale_ups} replicas); "
           f"ag overlap eff {overlap_eff:.3f} "
           f"(model/meas {model_vs_meas:.3f}); "
           f"sentinel dot {sentinel_tflops:.1f} TFLOPS"

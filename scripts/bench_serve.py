@@ -1092,6 +1092,207 @@ def bench_fleet_trace_overhead(*, n_replicas: int = 2, batch: int = 4,
     }
 
 
+def bench_overload(*, n_replicas: int = 1, max_replicas: int = 3,
+                   batch: int = 4, n_requests: int = 48,
+                   prompt_len: int = 16, new_tokens: int = 12,
+                   dim: int = 64, n_layers: int = 2, vocab: int = 256,
+                   page_size: int = 16, seed: int = 0,
+                   warmup: bool = True,
+                   overload_factor: float = 2.0) -> dict:
+    """Bursty overload leg (docs/serving.md "Overload, SLO classes &
+    autoscaling"): a trace-shaped open-loop workload — bursty Poisson
+    arrivals, lognormal lengths, a 50/30/20 interactive/batch/
+    best_effort mix (``benchlib.trace_workload``) — offered at
+    ``overload_factor``x the fleet's measured capacity on a VIRTUAL
+    clock, through a class-aware fleet with token-bucket ingress, the
+    brownout ladder armed and the autoscaler allowed to grow from
+    ``n_replicas`` to ``max_replicas``.
+
+    ``serve_slo_interactive_goodput`` is the headline: the fraction of
+    ADMITTED interactive requests (not refused at ingress or the
+    brownout door — refusals land a counted SHED terminal, never a
+    silent drop) that finish healthy (EOS/LENGTH) with their delivered
+    stream exactly matching the final output.  1.0 is the only
+    acceptable reading (PERF_FLOORS.json floors it there): under 2x
+    overload the fleet may shed best_effort and batch — counted, per
+    class — but an interactive request it accepted must never be lost.
+    The harness also hard-asserts exactly-once terminals for EVERY
+    submitted request and that per-class shed counters match the
+    observed SHED terminals (shedding is never silent)."""
+    import shutil
+    import tempfile
+
+    from benchlib import trace_workload
+    from triton_dist_tpu.models import llama
+    from triton_dist_tpu.models.generate import Generator
+    from triton_dist_tpu.serve import Request, SamplingParams, ServeEngine
+    from triton_dist_tpu.serve.request import FinishReason
+    from triton_dist_tpu.serve.fleet import FleetController
+
+    max_seq = 2 * prompt_len + 2 * new_tokens
+    max_seq += (-max_seq) % page_size
+    cfg = llama.LlamaConfig(vocab=vocab, dim=dim, n_layers=n_layers,
+                            n_heads=2, n_kv_heads=2, ffn_dim=2 * dim,
+                            max_seq=max_seq, dtype=jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    params = llama.init_params(cfg, jax.random.key(seed))
+    gen = Generator(cfg, mesh, axis="sp", max_seq=max_seq)
+    per_req = -(-max_seq // page_size)
+    dt = 0.05  # virtual seconds per fleet step
+
+    class _Clock:
+        now = 0.0
+
+        def __call__(self):
+            return self.now
+
+    def make_fleet(clock, root, *, ingress, autoscale, brownout):
+        def factory(d):
+            eng = ServeEngine(
+                gen, params, num_blocks=1 + per_req * batch,
+                page_size=page_size, max_batch=batch,
+                prefill_chunk=max(8, page_size), clock=clock,
+                max_queue=4 * batch, class_aware=True,
+                brownout=brownout, snapshot_dir=d)
+            if warmup:
+                eng.warmup()
+            return eng
+        return FleetController(factory, n_replicas, root=root,
+                               clock=clock, suspect_after_s=1e6,
+                               dead_after_s=2e6, seed=seed,
+                               ingress=ingress, autoscale=autoscale)
+
+    # --- calibration: closed-loop service rate on the virtual clock ----
+    cal_clock = _Clock()
+    cal_root = tempfile.mkdtemp(prefix="bench_overload_cal_")
+    fc = make_fleet(cal_clock, cal_root, ingress=None, autoscale=None,
+                    brownout=None)
+    rng = np.random.default_rng(seed)
+    sp = SamplingParams(max_new_tokens=new_tokens)
+    n_cal = 2 * n_replicas * batch
+    for i in range(n_cal):
+        fc.submit(Request(f"c{i}", rng.integers(0, vocab, size=prompt_len)
+                          .astype(np.int32), sp))
+    cal_steps = 0
+    while fc.has_work():
+        fc.step()
+        cal_clock.now += dt
+        cal_steps += 1
+    assert all(o.finish_reason in (FinishReason.EOS, FinishReason.LENGTH)
+               for o in fc.outputs.values())
+    shutil.rmtree(cal_root, ignore_errors=True)
+    capacity_rps = n_cal / (cal_steps * dt)
+
+    # --- trace-shaped workload, rescaled to overload_factor x capacity -
+    wl = trace_workload(seed, n_requests, prompt_median=prompt_len,
+                        prompt_sigma=0.5, output_median=new_tokens,
+                        output_sigma=0.6, prompt_min=4,
+                        prompt_max=2 * prompt_len, output_min=2,
+                        output_max=2 * new_tokens)
+    raw_rate = n_requests / max(wl[-1]["t"], 1e-9)
+    target_rate = overload_factor * capacity_rps
+    scale = raw_rate / target_rate
+    for rec in wl:
+        rec["t"] *= scale
+
+    # ingress: per-class budget at ~60% of capacity each (1.8x total —
+    # deliberately above capacity so the brownout ladder and door sheds
+    # carry the rest; interactive borrows from the lower buckets)
+    ingress = {"rate": 0.6 * capacity_rps,
+               "burst": max(4.0, 0.6 * capacity_rps)}
+    autoscale = {"min": n_replicas, "max": max_replicas,
+                 "high": 0.75, "low": 0.2, "window_s": 10 * dt,
+                 "dwell_steps": 2}
+    brownout = {"high": 0.85, "low": 0.5, "window_s": 10 * dt,
+                "dwell_steps": 2, "best_effort_cap": 2}
+
+    clock = _Clock()
+    root = tempfile.mkdtemp(prefix="bench_overload_")
+    fc = make_fleet(clock, root, ingress=ingress, autoscale=autoscale,
+                    brownout=brownout)
+    finished: dict[str, list] = {}
+
+    def on_finish(out):
+        finished.setdefault(out.request_id, []).append(
+            out.finish_reason)
+
+    t0 = time.perf_counter()
+    i = 0
+    steps = 0
+    rung_max = 0
+    replicas_peak = n_replicas
+    step_cap = 200 * (cal_steps + n_requests)
+    while i < len(wl) or fc.has_work():
+        while i < len(wl) and wl[i]["t"] <= clock.now:
+            rec = wl[i]
+            i += 1
+            prompt = rng.integers(0, vocab, size=rec["prompt_len"]
+                                  ).astype(np.int32)
+            fc.submit(Request(
+                rec["rid"], prompt,
+                SamplingParams(max_new_tokens=rec["max_new"]),
+                slo_class=rec["slo"], on_finish=on_finish))
+        fc.step()
+        clock.now += dt
+        steps += 1
+        live = [r for r in fc.replicas.values() if r.engine is not None]
+        replicas_peak = max(replicas_peak, len(live))
+        rung_max = max([rung_max] + [r.engine.brownout_rung
+                                     for r in live])
+        assert steps < step_cap, "overload leg failed to drain"
+    wall = time.perf_counter() - t0
+
+    # --- accounting: exactly-once terminals, no silent sheds ----------
+    by_slo = {rec["rid"]: rec["slo"] for rec in wl}
+    assert sorted(finished) == sorted(by_slo), (
+        "missing/phantom terminal callbacks")
+    assert all(len(v) == 1 for v in finished.values()), (
+        "a request fired its terminal callback more than once")
+    shed_by_class: dict[str, int] = {}
+    healthy = (FinishReason.EOS, FinishReason.LENGTH)
+    inter_total = inter_ok = inter_refused = 0
+    for rec in wl:
+        rid, slo = rec["rid"], rec["slo"]
+        out = fc.outputs[rid]
+        if out.finish_reason == FinishReason.SHED:
+            shed_by_class[slo] = shed_by_class.get(slo, 0) + 1
+        if slo != "interactive":
+            continue
+        inter_total += 1
+        if out.finish_reason in healthy and (
+                list(fc.streams[rid]) == list(out.token_ids)
+                and len(out.token_ids) >= 1):
+            inter_ok += 1
+        elif out.finish_reason in (FinishReason.SHED,
+                                   FinishReason.DEADLINE):
+            inter_refused += 1
+    counted_shed = dict(fc.aggregate_metrics().slo_stats()["shed"])
+    for slo, n_shed in shed_by_class.items():
+        assert counted_shed.get(slo, 0) >= n_shed, (
+            f"silent shed: {slo} saw {n_shed} SHED terminals but the "
+            f"per-class counter reads {counted_shed.get(slo, 0)}")
+    admitted = inter_total - inter_refused
+    goodput = inter_ok / admitted if admitted else 0.0
+    shutil.rmtree(root, ignore_errors=True)
+    return {
+        "mode": "overload",
+        "requests": n_requests,
+        "offered_over_capacity": round(overload_factor, 2),
+        "capacity_rps": round(capacity_rps, 2),
+        "replicas_start": n_replicas,
+        "replicas_peak": replicas_peak,
+        "scale_ups": fc.scale_ups,
+        "scale_downs": fc.scale_downs,
+        "brownout_rung_max": rung_max,
+        "shed_by_class": dict(sorted(shed_by_class.items())),
+        "ingress_shed": dict(sorted(fc.ingress_shed_by_class.items())),
+        "interactive_total": inter_total,
+        "interactive_refused": inter_refused,
+        "serve_slo_interactive_goodput": round(goodput, 4),
+        "wall_s": round(wall, 4),
+    }
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--horizons", default="1,8",
@@ -1175,6 +1376,26 @@ def main():
                         "(healed at SUSPECT), zero-loss vs the oracle "
                         "(bench.py's serve_fleet_net_zero_loss, "
                         "floor 1.0)")
+    p.add_argument("--overload", action="store_true",
+                   help="bursty overload mode: a trace-shaped workload "
+                        "(bursty Poisson arrivals, lognormal lengths, "
+                        "50/30/20 interactive/batch/best_effort mix) "
+                        "offered at --overload-factor x measured "
+                        "capacity on a virtual clock through a "
+                        "class-aware fleet with token-bucket ingress, "
+                        "the brownout ladder and the autoscaler armed "
+                        "(docs/serving.md 'Overload, SLO classes & "
+                        "autoscaling'); reports "
+                        "serve_slo_interactive_goodput "
+                        "(PERF_FLOORS.json holds it at 1.0) plus "
+                        "per-class shed counts and the peak brownout "
+                        "rung")
+    p.add_argument("--overload-factor", type=float, default=2.0,
+                   help="--overload: offered load as a multiple of "
+                        "measured fleet capacity (>= 2.0 is the "
+                        "acceptance regime)")
+    p.add_argument("--overload-requests", type=int, default=48,
+                   help="--overload: workload size")
     p.add_argument("--disagg", default=None, metavar="P:D",
                    help="disaggregated prefill→decode tier: P prefill "
                         "+ D decode replicas vs a co-located fleet of "
@@ -1213,6 +1434,38 @@ def main():
             or args.sessions is not None or args.disagg is not None):
         p.error("--kv-dtype is its own paired leg: it does not combine "
                 "with the other modes")
+    if args.overload and (
+            args.mesh is not None or args.fleet is not None or args.net
+            or args.trace or args.spec or args.shared_prompt
+            or args.sessions is not None or args.disagg is not None
+            or args.kv_dtype is not None):
+        p.error("--overload is its own mode: it does not combine with "
+                "the other modes")
+    if args.overload:
+        if args.overload_factor < 1.0:
+            p.error(f"--overload-factor must be >= 1.0, got "
+                    f"{args.overload_factor}")
+        if args.overload_requests < 1:
+            p.error(f"--overload-requests must be >= 1, got "
+                    f"{args.overload_requests}")
+        r = bench_overload(batch=args.batch, prompt_len=args.prompt_len,
+                           n_requests=args.overload_requests,
+                           dim=args.dim, n_layers=args.layers,
+                           page_size=args.page_size, seed=args.seed,
+                           warmup=not args.no_warmup,
+                           overload_factor=args.overload_factor)
+        print(json.dumps(r))
+        print(f"# overload {r['offered_over_capacity']:.1f}x capacity "
+              f"({r['capacity_rps']:.1f} req/s): interactive goodput "
+              f"{r['serve_slo_interactive_goodput']:.3f} (floor 1.0), "
+              f"{r['interactive_refused']}/{r['interactive_total']} "
+              f"interactive refused-with-receipt; shed "
+              f"{r['shed_by_class']} (ingress {r['ingress_shed']}); "
+              f"brownout peak rung {r['brownout_rung_max']}, replicas "
+              f"{r['replicas_start']}->{r['replicas_peak']} "
+              f"({r['scale_ups']} up / {r['scale_downs']} down)",
+              file=sys.stderr)
+        return
     if args.kv_dtype is not None:
         if args.kv_dtype == "float32":
             p.error("--kv-dtype float32 IS the baseline every other "

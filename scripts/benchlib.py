@@ -23,8 +23,91 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 RUN_SEED = time.time_ns() % (1 << 31)
+
+# Default SLO-class mix for trace_workload: the interactive-heavy blend
+# the overload bench and tests drive (docs/serving.md "Overload, SLO
+# classes & autoscaling").
+TRACE_CLASS_MIX = (("interactive", 0.5), ("batch", 0.3),
+                   ("best_effort", 0.2))
+
+
+def trace_workload(seed, n, *, mean_interarrival_s=0.05,
+                   burst_factor=8.0, mean_burst=8, mean_lull=4,
+                   prompt_median=24, prompt_sigma=0.6,
+                   output_median=24, output_sigma=0.8,
+                   prompt_min=1, prompt_max=None,
+                   output_min=1, output_max=None,
+                   class_mix=TRACE_CLASS_MIX):
+    """Trace-shaped open-loop workload: ``n`` arrival records with bursty
+    Poisson timing, heavy-tailed lognormal prompt/output lengths and a
+    per-SLO-class mix — fully determined by ``seed`` (ROADMAP #5b's
+    "trace-shaped" bench half; docs/serving.md "Overload, SLO classes &
+    autoscaling").
+
+    Timing is a two-state modulated Poisson process: episodes alternate
+    between BURST (exponential interarrivals at ``mean_interarrival_s /
+    burst_factor``) and LULL (at ``mean_interarrival_s``), with
+    geometric episode lengths of ``mean_burst`` / ``mean_lull`` requests
+    — the on/off shape real serving traces show, not a flat rate.
+    Absolute rate rarely matters to callers (the overload bench rescales
+    arrival times to pin offered/capacity); the burst SHAPE is the
+    point.
+
+    Lengths are lognormal around the medians (sigma in log-space), so
+    the tail is heavy but the median is the knob you set.  Clipped to
+    ``[min, max]`` when bounds are given.
+
+    Returns a list of dicts sorted by arrival time::
+
+        {"rid": "w0003", "t": 0.173, "prompt_len": 31,
+         "max_new": 12, "slo": "interactive"}
+
+    Same seed + same kwargs => identical list (np.random.default_rng;
+    no wall-clock reads), so bench legs and tests replay it exactly.
+    """
+    if n < 1:
+        raise ValueError(f"need n >= 1, got {n}")
+    if mean_interarrival_s <= 0 or burst_factor < 1:
+        raise ValueError(
+            f"need mean_interarrival_s > 0 and burst_factor >= 1, got "
+            f"{mean_interarrival_s}, {burst_factor}")
+    classes = [c for c, _ in class_mix]
+    weights = np.array([w for _, w in class_mix], dtype=np.float64)
+    if (weights <= 0).any():
+        raise ValueError(f"class weights must be > 0: {class_mix}")
+    weights = weights / weights.sum()
+    rng = np.random.default_rng(seed)
+
+    # alternating burst/lull episodes (geometric lengths, >= 1 request)
+    gaps = np.empty(n)
+    i, in_burst = 0, bool(rng.integers(0, 2))
+    while i < n:
+        mean_len = mean_burst if in_burst else mean_lull
+        ep = int(rng.geometric(1.0 / max(mean_len, 1)))
+        ep = min(max(ep, 1), n - i)
+        scale = (mean_interarrival_s / burst_factor if in_burst
+                 else mean_interarrival_s)
+        gaps[i:i + ep] = rng.exponential(scale, size=ep)
+        i += ep
+        in_burst = not in_burst
+    times = np.cumsum(gaps)
+
+    def _lengths(median, sigma, lo, hi):
+        raw = median * np.exp(sigma * rng.standard_normal(n))
+        out = np.maximum(np.rint(raw).astype(np.int64), lo)
+        return np.minimum(out, hi) if hi is not None else out
+
+    prompts = _lengths(prompt_median, prompt_sigma, prompt_min,
+                       prompt_max)
+    outputs = _lengths(output_median, output_sigma, output_min,
+                       output_max)
+    slos = rng.choice(len(classes), size=n, p=weights)
+    return [{"rid": f"w{i:04d}", "t": float(times[i]),
+             "prompt_len": int(prompts[i]), "max_new": int(outputs[i]),
+             "slo": classes[int(slos[i])]} for i in range(n)]
 
 
 _CHURN_UINT = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32}

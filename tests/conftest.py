@@ -177,6 +177,15 @@ _FAST_GATE_MODULES = {
     # fleet chaos kill, and w8a8 serving reproducibility; the mesh
     # bit-exactness sweeps carry @pytest.mark.slow.
     "test_serve_kv_int8",
+    # overload robustness (ISSUE 18): the defaults-inert bit-identical
+    # oracle, class-aware admission + door displacement, the brownout
+    # ladder (white-box rung semantics + black-box climb/recover), the
+    # seeded trace-shaped workload generator, token-bucket ingress with
+    # downward borrowing, the autoscaler spawn/drain-retire cycle with
+    # journal receipts, the chaos kill during scale-up, the shed-
+    # terminal regression sweep, and the shed-paths-observable lint
+    # rule (the whole file is the fast tier).
+    "test_serve_overload",
     # kernel-layer observability: the annotation-coverage source-grep
     # meta-test (every public kernel entry point annotated — the
     # ISSUE-14 closure gate), the kprobe overlap-scoreboard reports,

@@ -357,6 +357,86 @@ def check_no_unseeded_randomness() -> list:
 
 
 # ---------------------------------------------------------------------------
+# Rule: shed-paths-observable
+# ---------------------------------------------------------------------------
+
+#: Serving-policy modules whose degrade decisions the rule audits (the
+#: scheduler is a mechanism layer — its pickers mutate no counters; the
+#: caller that acts on the pick is the accountable path).
+_SHED_POLICY_MODULES = ("serve/engine.py", "serve/fleet.py",
+                       "serve/disagg.py", "serve/net.py")
+
+#: Function names that constitute a shed/downgrade/preempt decision
+#: (anchored to name-segment starts: "unfinished"/"pushed" are not
+#: sheds).
+_SHED_NAME_PAT = re.compile(
+    r"(?:^|_)(?:shed|preempt|expire|brownout|degrade)")
+
+#: Evidence the path counts (metrics) and explains itself (trace/audit).
+_SHED_METRICS_PAT = re.compile(
+    r"self\.metrics\b|\b_carry\.|\bobserve_\w+\(|ingress_shed_by_class")
+_SHED_TRACE_PAT = re.compile(r"\.emit\(|\baudit\.record\(")
+
+#: Fewer matching decision paths than this means the name heuristic
+#: broke (renames), not that overload handling disappeared.
+_SHED_MIN_PATHS = 4
+
+
+@rule("shed-paths-observable")
+def check_shed_paths_observable() -> list:
+    """Every shed/downgrade/preempt decision path in the serving policy
+    layers must increment a metrics counter AND land a trace/audit
+    event — a degrade decision that is invisible to both the scrape and
+    the flight recorder is un-debuggable precisely when it matters
+    (overload).  A path may instead delegate to another function that
+    carries both markers itself (e.g. ``_expire`` retiring through
+    ``_retire``); justified exceptions go in LINT_WAIVERS.json."""
+    fns: list = []  # (relpath, node, segment)
+    for relmod in _SHED_POLICY_MODULES:
+        path = os.path.join(REPO, "triton_dist_tpu", relmod)
+        src = open(path, encoding="utf-8").read()
+        for node in ast.walk(ast.parse(src)):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fns.append((_rel(path), node,
+                            ast.get_source_segment(src, node) or ""))
+    # functions that carry both markers themselves are valid delegation
+    # targets: calling one makes the caller's decision observable
+    observable = {node.name for _, node, seg in fns
+                  if _SHED_METRICS_PAT.search(seg)
+                  and _SHED_TRACE_PAT.search(seg)}
+    out = []
+    checked = 0
+    for relpath, node, seg in fns:
+        if not _SHED_NAME_PAT.search(node.name):
+            continue
+        checked += 1
+        delegates = any(re.search(rf"\b{re.escape(t)}\(", seg)
+                        for t in observable if t != node.name)
+        has_metrics = bool(_SHED_METRICS_PAT.search(seg)) or delegates
+        has_trace = bool(_SHED_TRACE_PAT.search(seg)) or delegates
+        if not (has_metrics and has_trace):
+            missing = [w for w, ok in (("a metrics increment",
+                                        has_metrics),
+                                       ("a trace/audit event",
+                                        has_trace)) if not ok]
+            out.append(Violation(
+                "shed-paths-observable",
+                f"{node.name}() sheds/degrades without "
+                f"{' or '.join(missing)} (and delegates to no "
+                f"observable path) — overload decisions must never "
+                f"be silent",
+                path=relpath, line=node.lineno))
+    if checked < _SHED_MIN_PATHS:
+        out.append(Violation(
+            "shed-paths-observable",
+            f"only {checked} shed/preempt/expire/brownout paths found "
+            f"(expected >= {_SHED_MIN_PATHS}) — the name heuristic "
+            f"broke, update _SHED_NAME_PAT",
+            path="triton_dist_tpu/serve"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Rule: collective-ids-unique
 # ---------------------------------------------------------------------------
 

@@ -135,6 +135,37 @@ class DisaggController(FleetController):
         # their fallback placement (every later tick would re-offer to
         # the same full pool — churn, not progress) until retirement
         self._no_push: set[str] = set()
+        # per-tier autoscaler trackers: prefill and decode scale on
+        # INDEPENDENT smoothed-pressure signals (a prompt burst must
+        # grow the prefill tier without inflating decode, and vice
+        # versa) — the base controller's single tracker becomes the
+        # max-of-tiers gauge
+        self._role_scale = {
+            role: {"ema": 0.0, "t": None, "dwell": 0}
+            for role in ("prefill", "decode")}
+
+    # -- autoscaling (per tier) --------------------------------------------
+
+    def _autoscale_step(self, now: float) -> None:
+        for role in ("prefill", "decode"):
+            reps = [(n, r) for n, r in self.replicas.items()
+                    if r.role == role]
+            # fresh unplaced work waits on prefill capacity; parked
+            # migration/push records wait on decode capacity
+            pending = (bool(self._pending_reqs) if role == "prefill"
+                       else bool(self._pending_recs))
+            spawned, retired = self._autoscale_tier(
+                now, self._role_scale[role], reps, role=role,
+                pending=pending)
+            delta = (1 if spawned else 0) - (1 if retired else 0)
+            if role == "prefill":
+                self.n_prefill += delta
+            else:
+                self.n_decode += delta
+        # the fleet-level gauge reports the hotter tier
+        self._scale_state["ema"] = max(
+            s["ema"] for s in self._role_scale.values())
+        self._scale_state["t"] = now
 
     # -- admission ---------------------------------------------------------
 
@@ -326,6 +357,7 @@ class DisaggController(FleetController):
                 "prompt": [int(x) for x in np.asarray(req.prompt)],
                 "params": req.params.to_dict(),
                 "arrival": req.arrival_time,
+                "slo": req.slo_class,
                 "tokens": [int(t) for t in self.streams[rid]],
                 "trace": req.trace,
             }

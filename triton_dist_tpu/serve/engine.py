@@ -152,10 +152,12 @@ from triton_dist_tpu.serve.recovery import (
     has_restorable_state,
 )
 from triton_dist_tpu.serve.request import (
+    SLO_CLASSES,
     FinishReason,
     Request,
     RequestOutput,
     SamplingParams,
+    slo_rank,
 )
 from triton_dist_tpu.serve.scheduler import FCFSScheduler, ReqState, Status
 from triton_dist_tpu.serve.trace import MIGRATE_EVENT_TAIL, FlightRecorder
@@ -729,6 +731,8 @@ class ServeEngine:
                  spec_k: int = 0, spec_fused: bool = True,
                  spec_adaptive: int = 8, clock=time.monotonic,
                  max_queue: Optional[int] = None, overload: str = "shed",
+                 class_aware: bool = False,
+                 brownout: Optional[dict] = None,
                  step_timeout_s: Optional[float] = None,
                  heartbeat: Optional[str] = None,
                  heartbeat_interval_s: float = 10.0,
@@ -859,7 +863,54 @@ class ServeEngine:
         self.scheduler = FCFSScheduler(
             self.bm,
             prefill_budget=prefill_budget or 4 * prefill_chunk,
-            prefill_chunk=prefill_chunk)
+            prefill_chunk=prefill_chunk, class_aware=class_aware)
+        self.class_aware = bool(class_aware)
+        # Graceful-degradation ladder (docs/serving.md "Overload, SLO
+        # classes & autoscaling"): brownout=dict(...) arms an ordered
+        # response to SUSTAINED pressure — a smoothed (clock-driven EMA)
+        # max of queue backlog and KV utilization climbs the rungs after
+        # `dwell_steps` consecutive over-high steps and descends after
+        # as many under-low steps:
+        #   0 full service
+        #   1 speculative k clamped to 1
+        #   2 chunked-prefill token budget halved
+        #   3 best_effort max_new_tokens capped (best_effort_cap)
+        #   4 incoming best_effort shed
+        #   5 incoming batch shed too
+        #   6 incoming interactive refused (the old cliff, now last)
+        # brownout=None (default) skips the evaluation entirely — the
+        # ladder is provably inert (no state reads on the step path).
+        self.brownout_cfg = None
+        if brownout is not None:
+            b = dict(brownout)
+            high = float(b.pop("high", 0.85))
+            low = float(b.pop("low", 0.55))
+            window_s = float(b.pop("window_s", 1.0))
+            dwell_steps = int(b.pop("dwell_steps", 4))
+            best_effort_cap = int(b.pop("best_effort_cap", 4))
+            if b:
+                raise ValueError(
+                    f"unknown brownout keys: {sorted(b)} (expected "
+                    f"high/low/window_s/dwell_steps/best_effort_cap)")
+            if not 0.0 < low < high:
+                raise ValueError(
+                    f"brownout needs 0 < low < high, got low={low} "
+                    f"high={high}")
+            if window_s < 0 or dwell_steps < 1 or best_effort_cap < 1:
+                raise ValueError(
+                    f"brownout needs window_s >= 0, dwell_steps >= 1, "
+                    f"best_effort_cap >= 1; got {window_s}, "
+                    f"{dwell_steps}, {best_effort_cap}")
+            self.brownout_cfg = {
+                "high": high, "low": low, "window_s": window_s,
+                "dwell_steps": dwell_steps,
+                "best_effort_cap": best_effort_cap,
+            }
+        self.brownout_rung = 0
+        self._pressure_ema = 0.0
+        self._pressure_t: Optional[float] = None
+        self._brownout_dwell = 0
+        self._base_prefill_budget = self.scheduler.prefill_budget
         self.metrics = ServeMetrics()
         # flight recorder (docs/observability.md): a bounded ring of
         # typed engine events — submit/admit/prefill/decode drains, spec
@@ -1183,6 +1234,11 @@ class ServeEngine:
         self.slots: list[Optional[ReqState]] = [None] * max_batch
         self._states: dict[str, ReqState] = {}
         self._outputs: dict[str, RequestOutput] = {}
+        # terminal outputs produced OUTSIDE a step (class-aware
+        # displacement sheds inside submit()): already retired, they
+        # ride the next step()'s finished batch so polling controllers
+        # see them exactly once
+        self._shed_pending: list[RequestOutput] = []
         # distributed-tracing context per live request (docs/
         # observability.md "Fleet observability"): {"trace_id", "hop"} —
         # stamped by the fleet controller (or defaulted at submit),
@@ -1360,9 +1416,22 @@ class ServeEngine:
                 "greedy requests only")
         if req.arrival_time is None:
             req.arrival_time = self._clock()
+        # Brownout ingress rungs (4/5/6): under a deep enough rung the
+        # request's class is refused at the door regardless of queue
+        # headroom — rung 4 sheds best_effort, 5 adds batch, 6 finally
+        # refuses interactive (the old single cliff, now the LAST rung).
+        browned_out = (bounded and self.brownout_cfg is not None
+                       and self.brownout_rung >= 4
+                       and slo_rank(req.slo_class)
+                       >= 6 - self.brownout_rung)
         overloaded = (bounded and self.max_queue is not None
                       and self.scheduler.queue_depth >= self.max_queue)
-        if overloaded:
+        displaced: Optional[ReqState] = None
+        if browned_out:
+            msg = (f"brownout rung {self.brownout_rung}: "
+                   f"{req.slo_class} ingress shed")
+            overloaded = True
+        elif overloaded:
             # Bounded admission: shedding at submit() keeps an overload
             # from growing an unbounded queue of requests that would
             # only expire later — the caller learns immediately.
@@ -1373,6 +1442,16 @@ class ServeEngine:
                 # was told this request never entered the engine, so a
                 # restore must not resurrect and serve it.
                 raise QueueFull(f"{req.request_id}: {msg}")
+            if self.class_aware:
+                # Class-aware displacement: a full queue never sheds a
+                # request while a WORSE class holds a queue slot — the
+                # latest, lowest-tier waiting request is shed instead
+                # and the arrival takes its place (so interactive is
+                # only refused once the queue is all-interactive).
+                displaced = self.scheduler.pick_shed_victim(
+                    slo_rank(req.slo_class))
+                if displaced is not None:
+                    overloaded = False
         if req.trace is None:
             # a bare engine starts the journey itself: the request id is
             # fleet-unique within any one controller (duplicates are
@@ -1389,11 +1468,31 @@ class ServeEngine:
         self.trace.emit("submit", req.request_id,
                         prompt=int(req.prompt.shape[0]),
                         max_new=req.params.max_new_tokens)
+        self.metrics.observe_class_submit(req.slo_class)
         if overloaded:
             self._states[req.request_id] = rs
             self.metrics.shed += 1
             return self._retire(rs, FinishReason.SHED, free=False,
                                 error=msg)
+        if displaced is not None:
+            # The victim's terminal output cannot return from THIS call
+            # (submit answers for the arrival only): it retires now —
+            # journal finish, metrics, trace, on_finish all fire here —
+            # and the output joins the next step()'s finished batch so
+            # a polling controller finalizes its stream too.
+            self.scheduler.waiting.remove(displaced)
+            self.metrics.shed += 1
+            self._shed_pending.append(self._retire(
+                displaced, FinishReason.SHED, free=False,
+                error=(f"displaced by {req.request_id} "
+                       f"({req.slo_class} over "
+                       f"{displaced.req.slo_class})")))
+        if (self.brownout_cfg is not None and self.brownout_rung >= 3
+                and req.slo_class == "best_effort"):
+            # rung 3 caps best_effort output length at the door too —
+            # a cap that only touched in-flight rows would leak full-
+            # length best_effort admitted during the brownout
+            rs.new_cap = self.brownout_cfg["best_effort_cap"]
         self._states[req.request_id] = rs
         self.scheduler.add(rs)
         return None
@@ -1538,6 +1637,7 @@ class ServeEngine:
                     "t": "done", "rid": rid,
                     "prompt": [int(x) for x in np.asarray(rs.req.prompt)],
                     "params": rs.req.params.to_dict(),
+                    "slo": rs.req.slo_class,
                     "arrival": rs.req.arrival_time,
                     # carried explicitly: the windowed tts None-pads its
                     # head on long streams, so "first retained ts" would
@@ -1558,6 +1658,7 @@ class ServeEngine:
                     "t": "submit", "rid": rid,
                     "prompt": [int(x) for x in np.asarray(rs.req.prompt)],
                     "params": rs.req.params.to_dict(),
+                    "slo": rs.req.slo_class,
                     "ts": rs.req.arrival_time,
                     "ftt": rs.metrics.first_token_time,
                     # in-flight rows keep their trace context across
@@ -1657,6 +1758,7 @@ class ServeEngine:
                 "rid": rid,
                 "prompt": [int(x) for x in np.asarray(rs.req.prompt)],
                 "params": rs.req.params.to_dict(),
+                "slo": rs.req.slo_class,
                 "arrival": rs.req.arrival_time,
                 "tokens": [int(t) for t in rs.generated],
                 "tok_ts": [rs.metrics.time_at(i)
@@ -1850,7 +1952,8 @@ class ServeEngine:
                    "hop": int(prev.get("hop", 0)) + 1}
             req = Request(rid, prompt, params, arrival_time=rm.arrival_time,
                           on_token=_resolve_callback(on_token, rid),
-                          trace=ctx)
+                          trace=ctx,
+                          slo_class=rec.get("slo", "interactive"))
             rs = ReqState(req=req, metrics=rm)
             rs.generated = tokens
             rs.journal_base = len(tokens)
@@ -2034,6 +2137,13 @@ class ServeEngine:
         self.trace.set_step(self.metrics.steps)
         now = self._clock()
         finished: list[RequestOutput] = []
+        if self._shed_pending:
+            # displacement sheds retired inside submit(): deliver their
+            # terminal outputs through the normal finished batch
+            finished.extend(self._shed_pending)
+            self._shed_pending.clear()
+        if self.brownout_cfg is not None:
+            self._brownout_step(now)
 
         # Deadline sweep BEFORE admission: expired WAITING/PREFILL
         # requests retire (DEADLINE) and their slots/blocks free for
@@ -2687,6 +2797,8 @@ class ServeEngine:
             ttft = rs.metrics.ttft
             if ttft is not None:
                 self.metrics.hist_ttft.observe(ttft)
+                self.metrics.class_ttft_hist(
+                    rs.req.slo_class).observe(ttft)
         elif itl is not None:
             self.metrics.hist_itl.observe(itl)
         if self._journal_on(rs.req.request_id):
@@ -2716,7 +2828,7 @@ class ServeEngine:
         p = rs.req.params
         if p.eos_id is not None and token == p.eos_id:
             return self._retire(rs, FinishReason.EOS)
-        if len(rs.generated) >= p.max_new_tokens:
+        if len(rs.generated) >= rs.effective_max_new:
             return self._retire(rs, FinishReason.LENGTH)
         return None
 
@@ -2742,12 +2854,28 @@ class ServeEngine:
                             finish_reason=reason, metrics=rs.metrics,
                             error=error)
         self._outputs[rs.req.request_id] = out
-        self.metrics.observe_finish(rs.req.request_id, rs.metrics, reason)
+        self.metrics.observe_finish(rs.req.request_id, rs.metrics, reason,
+                                    slo_class=rs.req.slo_class)
         self.trace.emit("retire", rs.req.request_id,
                         reason=reason.value, n_tokens=len(rs.generated))
         # the journey ends here: the per-request trace context must not
         # outlive the request (the maps above are pruned; this one is too)
         self._trace_ctx.pop(rs.req.request_id, None)
+        if rs.req.on_finish is not None:
+            # The terminal notification, fired on EVERY retirement path
+            # (shed at submit, deadline sweep, quarantine, healthy
+            # finish) — a zero-token retirement never touches on_token,
+            # so without this a shed request's consumer waits forever.
+            # Contained like on_token: a raising frontend must not
+            # corrupt the retirement that already happened.
+            try:
+                rs.req.on_finish(out)
+            except _FATAL:
+                raise
+            except Exception as e:
+                self.metrics.callback_errors += 1
+                print(f"[serve] {rs.req.request_id}: on_finish callback "
+                      f"raised ({e!r}); ignored", file=sys.stderr)
         return out
 
     # -- flight recorder plumbing ----------------------------------------
@@ -2832,6 +2960,78 @@ class ServeEngine:
             error=(f"deadline {rs.req.params.deadline_s}s exceeded "
                    f"({waited:.3f}s since arrival, status "
                    f"{rs.status.value})"))
+
+    # -- graceful-degradation ladder --------------------------------------
+
+    def _brownout_step(self, now: float) -> None:
+        """One evaluation of the brownout ladder (docs/serving.md
+        "Overload, SLO classes & autoscaling"), called at the top of
+        every step while ``brownout=`` is armed.
+
+        Pressure is the worse of queue backlog (normalized by
+        ``max_queue``, or ``4 * max_batch`` unbounded) and KV-pool
+        utilization, smoothed by a clock-driven EMA over ``window_s``
+        (deterministic under a fake clock — no wall reads).  The rung
+        climbs ONE level after ``dwell_steps`` consecutive steps above
+        ``high`` and descends one after as many below ``low``; the
+        dwell counter is the hysteresis that keeps a bursty boundary
+        from flapping the ladder every step."""
+        cfg = self.brownout_cfg
+        qd = self.scheduler.queue_depth
+        denom = (self.max_queue if self.max_queue
+                 else 4 * self.max_batch)
+        pressure = max(qd / denom if denom else 0.0,
+                       self.bm.utilization)
+        if self._pressure_t is None or cfg["window_s"] <= 0:
+            self._pressure_ema = pressure
+        else:
+            dt = max(now - self._pressure_t, 0.0)
+            alpha = 1.0 - math.exp(-dt / cfg["window_s"])
+            self._pressure_ema += alpha * (pressure - self._pressure_ema)
+        self._pressure_t = now
+        if self._pressure_ema > cfg["high"] and self.brownout_rung < 6:
+            self._brownout_dwell = max(self._brownout_dwell, 0) + 1
+            if self._brownout_dwell >= cfg["dwell_steps"]:
+                self._brownout_dwell = 0
+                self._set_brownout(self.brownout_rung + 1)
+        elif self._pressure_ema < cfg["low"] and self.brownout_rung > 0:
+            self._brownout_dwell = min(self._brownout_dwell, 0) - 1
+            if -self._brownout_dwell >= cfg["dwell_steps"]:
+                self._brownout_dwell = 0
+                self._set_brownout(self.brownout_rung - 1)
+        else:
+            self._brownout_dwell = 0
+
+    def _set_brownout(self, rung: int) -> None:
+        """Move the ladder to ``rung``, applying/releasing each rung's
+        effect (entering and leaving both land a ``brownout`` trace
+        event and move the ``serve_brownout_rung`` gauge — a degrade
+        decision is never silent)."""
+        prev, self.brownout_rung = self.brownout_rung, rung
+        if rung == prev:
+            return
+        self.metrics.observe_brownout(rung)
+        self.trace.emit("brownout", None, rung=rung, prev=prev,
+                        pressure=round(self._pressure_ema, 4))
+        # rung 2: chunked-prefill budget halves (floor: one chunk, the
+        # scheduler's own livelock floor); released on descent
+        sched = self.scheduler
+        sched.prefill_budget = (
+            max(sched.prefill_chunk, self._base_prefill_budget // 2)
+            if rung >= 2 else self._base_prefill_budget)
+        # rung 3: best_effort emission caps (>= 1 token of headroom on
+        # live rows so every capped row retires through a normal LENGTH
+        # commit); released on descent — a request that outlived the
+        # brownout serves its full budget
+        cap = self.brownout_cfg["best_effort_cap"]
+        for rs in self._states.values():
+            if (rs.status is Status.FINISHED
+                    or rs.req.slo_class != "best_effort"):
+                continue
+            if rung >= 3:
+                rs.new_cap = max(len(rs.generated) + 1, cap)
+            elif rs.new_cap is not None:
+                rs.new_cap = None
 
     def _quarantine(self, rs: ReqState, msg: str) -> RequestOutput:
         """Retire a poison request (``FinishReason.ERROR``): its blocks
@@ -3010,6 +3210,7 @@ class ServeEngine:
         victim.scratch = None
         self.scheduler.preempt(victim)
         self.metrics.preemptions += 1
+        self.metrics.observe_class_preempt(victim.req.slo_class)
 
     # -- prefix sharing: copy-on-write + content commits ------------------
 
@@ -3371,6 +3572,11 @@ class ServeEngine:
         top = max(r.kv_len for r in live)
         k_cap = min(self.spec_k, self.gen.max_seq - 1 - top,
                     self.draft.max_seq - 1 - top)
+        if self.brownout_rung >= 1:
+            # brownout rung 1: clamp speculation to k=1 — the cheapest
+            # rung sheds DRAFT compute, not user tokens (the k=1 rung
+            # is already on the warmed pow2 k-ladder, so no new traces)
+            k_cap = min(k_cap, 1)
         if k_cap <= 0:
             return self._spec_tail(live)
         links = self.scheduler.plan_spec(

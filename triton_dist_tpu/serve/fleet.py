@@ -49,6 +49,7 @@ from __future__ import annotations
 
 import enum
 import glob
+import math
 import os
 import random
 import sys
@@ -69,14 +70,17 @@ from triton_dist_tpu.serve.net import (
     NetClient,
     NetError,
     NetHTTPError,
+    NetOverloaded,
     NetUnreachable,
     decode_manifest,
     encode_manifest,
 )
 from triton_dist_tpu.serve.request import (
+    SLO_CLASSES,
     FinishReason,
     Request,
     RequestOutput,
+    slo_rank,
 )
 from triton_dist_tpu.serve.trace import (
     FLEET_PID,
@@ -230,6 +234,16 @@ FLEET_SERIES = (
     "fleet_deadline_miss_per_s",   # gauge: deadline-miss burn rate
     "fleet_shed_per_s",            # gauge: shed burn rate
     "fleet_audit_records_total",   # counter: router decisions recorded
+    "fleet_pressure_smoothed",     # gauge: the autoscaler's EMA pressure
+    #                                signal (what the high/low water
+    #                                marks compare against)
+    "fleet_scale_ups_total",       # counter: replicas spawned by the
+    #                                autoscaler
+    "fleet_scale_downs_total",     # counter: replicas retired (drained)
+    #                                by the autoscaler
+    "fleet_ingress_shed_total",    # counter, {slo_class=}: requests the
+    #                                token-bucket admission refused at
+    #                                the door
 )
 
 
@@ -615,7 +629,8 @@ class RemoteReplica:
         rid = req.request_id
         doc = {"rid": rid,
                "prompt": [int(x) for x in np.asarray(req.prompt)],
-               "params": req.params.to_dict(), "trace": req.trace}
+               "params": req.params.to_dict(), "slo": req.slo_class,
+               "trace": req.trace}
         self._live[rid] = {"acked": 0, "tokens": [], "cb": req.on_token,
                            "done": False,
                            "prompt": np.asarray(req.prompt, np.int32),
@@ -623,6 +638,15 @@ class RemoteReplica:
         try:
             resp = self.client.call("submit", "/submit", method="POST",
                                     body=doc)
+        except NetOverloaded as e:
+            # the replica answered 429 on every paced retry: admission
+            # pressure is a DEFINITIVE verdict (never ambiguous — the
+            # rid-keyed replay cache would have answered dup had any
+            # attempt landed), and the fleet word for a full queue is
+            # QueueFull: the controller walks to the next candidate or
+            # sheds under the bounded-admission contract
+            del self._live[rid]
+            raise QueueFull(f"{self.name}: {e}") from e
         except NetHTTPError as e:
             # the replica ANSWERED with an error: definitive, not
             # ambiguous — same behavior as an in-process engine
@@ -954,9 +978,82 @@ class FleetController:
                  audit_events: int = 1024,
                  slo_window_s: float = 60.0,
                  fleet_id: Optional[str] = None, seed: int = 0,
-                 roles: Optional[dict] = None):
+                 roles: Optional[dict] = None,
+                 ingress: Optional[dict] = None,
+                 autoscale: Optional[dict] = None):
         if n_replicas < 1:
             raise ValueError(f"need >= 1 replica, got {n_replicas}")
+        # -- token-bucket ingress admission (per-SLO-class budgets) ------
+        # ``{"rate": req/s, "burst": bucket_cap, "per_class": {class:
+        # {"rate", "burst"}}}`` — rate/burst are the per-class defaults;
+        # per_class overrides one class's budget.  None (the default)
+        # admits everything: existing fleets are untouched.
+        self.ingress_cfg: Optional[dict] = None
+        self._buckets: dict[str, dict] = {}
+        if ingress is not None:
+            cfg = dict(ingress)
+            rate = float(cfg.pop("rate", 0.0))
+            burst = float(cfg.pop("burst", max(rate, 1.0)))
+            per_class = dict(cfg.pop("per_class", None) or {})
+            if cfg:
+                raise ValueError(f"unknown ingress keys: {sorted(cfg)}")
+            if rate <= 0:
+                raise ValueError(f"ingress rate must be > 0, got {rate}")
+            for klass in per_class:
+                if klass not in SLO_CLASSES:
+                    raise ValueError(
+                        f"unknown SLO class in ingress per_class: "
+                        f"{klass!r} (expected one of {SLO_CLASSES})")
+            for klass in SLO_CLASSES:
+                o = dict(per_class.get(klass, None) or {})
+                r = float(o.pop("rate", rate))
+                b = float(o.pop("burst", burst))
+                if o:
+                    raise ValueError(
+                        f"unknown ingress per_class[{klass!r}] keys: "
+                        f"{sorted(o)}")
+                if r <= 0 or b < 1:
+                    raise ValueError(
+                        f"ingress class {klass!r}: need rate > 0 and "
+                        f"burst >= 1, got {r}, {b}")
+                self._buckets[klass] = {"rate": r, "burst": b,
+                                        "tokens": b, "t": None}
+            self.ingress_cfg = {"rate": rate, "burst": burst}
+        self.ingress_shed_by_class: dict[str, int] = {}
+        # -- pressure-driven autoscaling ---------------------------------
+        # ``{"min", "max", "high", "low", "window_s", "dwell_steps"}`` —
+        # smoothed fleet pressure above ``high`` for ``dwell_steps``
+        # consecutive ticks spawns a replica (up to ``max``); below
+        # ``low`` retires the least-loaded one through the exactly-once
+        # drain path (down to ``min``).  None disables scaling.
+        self.autoscale_cfg: Optional[dict] = None
+        if autoscale is not None:
+            cfg = dict(autoscale)
+            a = {
+                "min": int(cfg.pop("min", 1)),
+                "max": int(cfg.pop("max", n_replicas)),
+                "high": float(cfg.pop("high", 0.8)),
+                "low": float(cfg.pop("low", 0.3)),
+                "window_s": float(cfg.pop("window_s", 5.0)),
+                "dwell_steps": int(cfg.pop("dwell_steps", 3)),
+            }
+            if cfg:
+                raise ValueError(f"unknown autoscale keys: {sorted(cfg)}")
+            if not 1 <= a["min"] <= n_replicas <= a["max"]:
+                raise ValueError(
+                    f"need 1 <= min <= n_replicas <= max, got "
+                    f"min={a['min']}, n_replicas={n_replicas}, "
+                    f"max={a['max']}")
+            if not 0.0 < a["low"] < a["high"]:
+                raise ValueError(
+                    f"need 0 < low < high, got {a['low']}, {a['high']}")
+            if a["window_s"] < 0:
+                raise ValueError(
+                    f"window_s must be >= 0, got {a['window_s']}")
+            if a["dwell_steps"] < 1:
+                raise ValueError(
+                    f"dwell_steps must be >= 1, got {a['dwell_steps']}")
+            self.autoscale_cfg = a
         # routing roles ({name: "prefill"|"decode"|"both"}, default
         # "both" for every replica — a homogeneous fleet routes exactly
         # as before; docs/serving.md "Disaggregated serving")
@@ -1010,6 +1107,15 @@ class FleetController:
         self._carry = ServeMetrics()
         self._carry_recorders: list = []
         now = self._clock()
+        # kept for autoscale spawns — a scaled-up replica is built
+        # exactly like the initial fleet (same factory, same backoff
+        # shape, its own jitter seed)
+        self._factory = factory
+        self._seed = seed
+        self._backoff_kw = dict(
+            base_s=backoff_base_s, cap_s=backoff_cap_s,
+            jitter=backoff_jitter, healthy_reset_s=healthy_reset_s,
+            max_restarts=max_restarts)
         self.replicas: dict[str, EngineReplica] = {}
         self._backoff: dict[str, RestartBackoff] = {}
         for i in range(n_replicas):
@@ -1037,8 +1143,22 @@ class FleetController:
         self.placement: dict[str, str] = {}  # rid -> current replica
         self.history: dict[str, list] = {}   # rid -> replicas that held it
         self._cbs: dict[str, Callable] = {}  # rid -> wrapped on_token
+        # rid -> the user's terminal callback, stripped off the Request
+        # at submit: the serving engine can change mid-stream
+        # (migration) and a fleet-level shed never reaches ANY engine,
+        # so the fleet is the only layer that can promise exactly-once
+        # terminal delivery (_finalize pops it)
+        self._finish_cbs: dict[str, Callable] = {}
         self._pending_reqs: deque = deque()  # unplaced fresh requests
         self._pending_recs: deque = deque()  # (header, rec) to re-place
+        # autoscaler state: monotonic replica naming (a retired or dead
+        # slot's name is NEVER reused — the double-adopt guard), the
+        # smoothed-pressure tracker, and the retirement record
+        self._next_index = n_replicas
+        self._scale_state = {"ema": 0.0, "t": None, "dwell": 0}
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.retired: set[str] = set()
 
     # -- submission -------------------------------------------------------
 
@@ -1072,8 +1192,39 @@ class FleetController:
         self.history[rid] = []
         self._cbs[rid] = self._make_cb(rid, req.on_token)
         req.on_token = self._cbs[rid]
+        if req.on_finish is not None:
+            self._finish_cbs[rid] = req.on_finish
+            req.on_finish = None
+        if self._buckets and not self._ingress_admit(req):
+            self.ingress_shed_by_class[req.slo_class] = (
+                self.ingress_shed_by_class.get(req.slo_class, 0) + 1)
+            self.trace.emit("ingress_shed", rid, slo=req.slo_class)
+            self.audit.record(self._clock(), self.steps, "ingress_shed",
+                              rid, slo=req.slo_class)
+            self._shed(req, f"ingress token bucket empty "
+                            f"(class {req.slo_class!r})")
+            return
         if not self._place_request(req):
             self._pending_reqs.append(req)
+
+    def _ingress_admit(self, req: Request) -> bool:
+        """Spend one ingress token for ``req``: its own class's bucket
+        first, then BORROW downward — a class is never refused while a
+        LOWER tier still holds budget (the interactive-never-shed-
+        before-best-effort contract, generalized), and a lower class
+        can never drain a higher one's budget."""
+        now = self._clock()
+        for klass in SLO_CLASSES[slo_rank(req.slo_class):]:
+            b = self._buckets[klass]
+            if b["t"] is not None:
+                b["tokens"] = min(
+                    b["burst"],
+                    b["tokens"] + (now - b["t"]) * b["rate"])
+            b["t"] = now
+            if b["tokens"] >= 1.0:
+                b["tokens"] -= 1.0
+                return True
+        return False
 
     def _healthy(self, role: Optional[str] = None) -> list:
         """HEALTHY ``(name, load)`` candidates, optionally filtered to
@@ -1158,6 +1309,13 @@ class FleetController:
         self.trace.emit("retire", req.request_id, reason="shed")
         self.audit.record(self._clock(), self.steps, "shed",
                           req.request_id, why=msg)
+        # a fleet-level shed reaches no engine, so no engine's metrics
+        # ever see it — count it in the carry exactly as an engine-side
+        # shed would (shed counter, finish reason, per-class split), or
+        # the fleet aggregate under-reports precisely under overload
+        self._carry.shed += 1
+        self._carry.observe_finish(req.request_id, rm, FinishReason.SHED,
+                                   slo_class=req.slo_class)
         self._finalize(out, "fleet")
 
     def _place_rec(self, header: dict, rec: dict,
@@ -1245,6 +1403,13 @@ class FleetController:
                     error=f"deadline {d}s exceeded in the fleet queue")
                 self.trace.emit("retire", req.request_id,
                                 reason="deadline")
+                # the fleet-queue sweep is this request's ONLY metrics
+                # seam (no engine ever saw it) — count like an engine
+                # deadline sweep would
+                self._carry.deadline_expired += 1
+                self._carry.observe_finish(
+                    req.request_id, rm, FinishReason.DEADLINE,
+                    slo_class=req.slo_class)
                 self._finalize(out, "fleet")
                 finished.append(out)
             else:
@@ -1272,6 +1437,10 @@ class FleetController:
                           f"{rec['params']['deadline_s']}s exceeded "
                           f"in the fleet queue (migrated)")
                 self.trace.emit("retire", rid, reason="deadline")
+                self._carry.deadline_expired += 1
+                self._carry.observe_finish(
+                    rid, rm, FinishReason.DEADLINE,
+                    slo_class=rec.get("slo", "interactive"))
                 self._finalize(out, "fleet")
                 finished.append(out)
             else:
@@ -1379,6 +1548,8 @@ class FleetController:
                 self.audit.record(now, self.steps, "replica_state",
                                   replica=name, state=rep.state.value,
                                   why="probe healthy")
+        if self.autoscale_cfg is not None:
+            self._autoscale_step(now)
         self.steps += 1
         return finished
 
@@ -1405,6 +1576,155 @@ class FleetController:
                 raise RuntimeError(
                     f"fleet not drained after {max_steps} steps")
         return dict(self.outputs)
+
+    # -- pressure-driven autoscaling --------------------------------------
+
+    def _tier_pressure(self, reps: list) -> float:
+        """Mean per-replica saturation over the live members of one
+        tier: queue depth against its admission bound (``max_queue``,
+        else ``4 * max_batch`` — the same denominator the engine's
+        brownout ladder uses) or KV-pool utilization, whichever is
+        tighter.  A tier with NO live replica is fully saturated."""
+        live = [r for r in reps if r.engine is not None
+                and r.state is not ReplicaState.DEAD]
+        if not live:
+            return 1.0
+
+        def sat(rep) -> float:
+            load = rep.load()
+            mq = rep.engine.max_queue
+            denom = mq if mq else 4 * max(load.max_batch, 1)
+            return max(load.queue_depth / max(denom, 1), load.kv_util)
+
+        return sum(sat(r) for r in live) / len(live)
+
+    def _autoscale_tier(self, now: float, st: dict, reps: list, *,
+                        role: str, pending: bool) -> None:
+        """One tier's autoscale evaluation: smooth the raw pressure
+        into ``st["ema"]`` (clock-driven EMA, ``alpha = 1 -
+        exp(-dt/window_s)``), walk the signed dwell counter, and act at
+        the water marks — spawn at sustained-high (to ``max``), retire
+        the least-loaded healthy replica through the exactly-once drain
+        path at sustained-low (to ``min``).  ``reps`` is ``[(name,
+        EngineReplica)]``; ``pending`` marks unplaced fleet-queue work
+        waiting on this tier (saturation wherever the replicas sit).
+        Returns ``(spawned_name, retired_name)`` (either may be
+        ``None``)."""
+        cfg = self.autoscale_cfg
+        raw = self._tier_pressure([r for _, r in reps])
+        if pending:
+            raw = max(raw, 1.0)
+        if st["t"] is None or cfg["window_s"] <= 0:
+            st["ema"] = raw
+        else:
+            dt = max(now - st["t"], 0.0)
+            alpha = 1.0 - math.exp(-dt / cfg["window_s"])
+            st["ema"] += alpha * (raw - st["ema"])
+        st["t"] = now
+        if st["ema"] >= cfg["high"]:
+            st["dwell"] = max(st["dwell"], 0) + 1
+        elif st["ema"] <= cfg["low"]:
+            st["dwell"] = min(st["dwell"], 0) - 1
+        else:
+            st["dwell"] = 0
+        spawned = retired = None
+        if st["dwell"] >= cfg["dwell_steps"]:
+            # a DEAD replica with a scheduled restart is capacity in
+            # flight — spawning past it would overshoot max
+            capacity = sum(1 for _, r in reps
+                           if r.state is not ReplicaState.DEAD
+                           or r.restart_at is not None)
+            if capacity < cfg["max"]:
+                spawned = self._spawn_replica(now, role=role,
+                                              pressure=st["ema"])
+            st["dwell"] = 0
+        elif st["dwell"] <= -cfg["dwell_steps"]:
+            healthy = [(self.router.pressure(r.load()), n)
+                       for n, r in reps
+                       if r.state is ReplicaState.HEALTHY]
+            if len(healthy) > cfg["min"]:
+                retired = min(healthy)[1]
+                self.retire_replica(retired)
+            st["dwell"] = 0
+        return spawned, retired
+
+    def _autoscale_step(self, now: float) -> None:
+        self._autoscale_tier(
+            now, self._scale_state, list(self.replicas.items()),
+            role="both",
+            pending=bool(self._pending_reqs or self._pending_recs))
+
+    def _spawn_replica(self, now: float, role: str = "both",
+                       pressure: Optional[float] = None) -> str:
+        """Scale-up: bring ONE new replica into the fleet from the
+        stored factory.  Names are monotonic (``r{next_index}``, never
+        reused) — a retired or dead replica's name can never be
+        double-adopted by a new life racing its crash migration."""
+        idx = self._next_index
+        self._next_index += 1
+        name = f"r{idx}"
+        rep = EngineReplica(name, self._factory,
+                            os.path.join(self.root, name))
+        rep.role = role
+        self.replicas[name] = rep
+        self._backoff[name] = RestartBackoff(**self._backoff_kw,
+                                             seed=self._seed + idx)
+        rep.start(now)
+        if hasattr(rep.engine, "attach_fleet"):
+            rep.engine.attach_fleet(self.audit)
+        self._backoff[name].on_start(now)
+        self.scale_ups += 1
+        p = round(self._scale_state["ema"] if pressure is None
+                  else pressure, 4)
+        self.trace.emit("scale", None, action="up", replica=name,
+                        role=role, pressure=p)
+        self.audit.record(now, self.steps, "scale", replica=name,
+                          action="up", role=role, pressure=p)
+        return name
+
+    def retire_replica(self, name: str) -> int:
+        """Scale-down: cooperatively drain every in-flight request off
+        ``name`` through the exactly-once path (``mig`` receipts land
+        in the journal before the manifest leaves — the same argument
+        as :meth:`drain_replica`), fold the life's metrics into the
+        fleet carry, and retire the replica FOR GOOD: no restart is
+        scheduled and the name is never reused (:attr:`retired`).
+        Returns the number of requests moved."""
+        rep = self.replicas[name]
+        if rep.engine is None:
+            raise ValueError(f"replica {name} is not live")
+        now = self._clock()
+        # circuit-break admissions FIRST: the drain re-places parked
+        # work through _drain_pending, and a still-HEALTHY leaver could
+        # win that placement and strand the request when its engine
+        # drops a moment later
+        rep.state = ReplicaState.SUSPECT
+        moved = self.drain_replica(name)
+        # same carry fold as a death, minus the crash migration: the
+        # drain already moved everything, so only the accounting rides
+        m = rep.engine.metrics
+        self._carry.merge(m)
+        self._carry.queue_depth_last = 0
+        self._carry.running_last = 0
+        self._carry.kv_util_last = 0.0
+        self._carry.compiled_fns.extend(m.compiled_fns)
+        if m.recorder is not None:
+            self._carry_recorders.append(m.recorder)
+        if rep.engine._journal is not None:
+            rep.engine._journal.close()
+        rep.engine = None
+        rep.state = ReplicaState.DEAD
+        rep.restart_at = None
+        rep.death_reason = "retired (scaled down)"
+        self.retired.add(name)
+        self.scale_downs += 1
+        self.trace.emit("scale", None, action="down", replica=name,
+                        moved=moved,
+                        pressure=round(self._scale_state["ema"], 4))
+        self.audit.record(now, self.steps, "scale", replica=name,
+                          action="down", moved=moved,
+                          pressure=round(self._scale_state["ema"], 4))
+        return moved
 
     # -- failure handling + migration -------------------------------------
 
@@ -1571,6 +1891,20 @@ class FleetController:
             # reconciles it
             s.extend(out.token_ids[len(s):])
         self.placement.pop(rid, None)
+        # the terminal callback, exactly once per rid (pop), whatever
+        # path retired the stream — engine step, journal backfill,
+        # fleet-queue sweep, or an admission shed that never reached an
+        # engine.  Same containment rule as the engine's callbacks.
+        cb = self._finish_cbs.pop(rid, None)
+        if cb is not None:
+            try:
+                cb(out)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:  # noqa: BLE001 — callback containment
+                self._carry.callback_errors += 1
+                print(f"[fleet] on_finish callback for {rid} raised "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
 
     def _finalize_from_journal(self, f: dict, name: str) -> None:
         rm = RequestMetrics(arrival_time=self._clock())
@@ -1676,6 +2010,11 @@ class FleetController:
             "pending": len(self._pending_reqs) + len(self._pending_recs),
             "latency": self.aggregate_metrics().latency_stats(),
             "slo": self.slo_stats(),
+            "pressure_smoothed": round(self._scale_state["ema"], 4),
+            "scale": {"ups": self.scale_ups, "downs": self.scale_downs,
+                      "retired": sorted(self.retired)},
+            "ingress_shed": dict(sorted(
+                self.ingress_shed_by_class.items())),
             "audit": {"recorded": self.audit.recorded,
                       "dropped": self.audit.dropped},
         }
@@ -1731,6 +2070,17 @@ class FleetController:
         L.append(f"fleet_shed_per_s {self._slo_shed.rate(now):.6g}")
         L.append("# TYPE fleet_audit_records_total counter")
         L.append(f"fleet_audit_records_total {self.audit.recorded}")
+        L.append("# TYPE fleet_pressure_smoothed gauge")
+        L.append(f"fleet_pressure_smoothed "
+                 f"{self._scale_state['ema']:.6g}")
+        L.append("# TYPE fleet_scale_ups_total counter")
+        L.append(f"fleet_scale_ups_total {self.scale_ups}")
+        L.append("# TYPE fleet_scale_downs_total counter")
+        L.append(f"fleet_scale_downs_total {self.scale_downs}")
+        L.append("# TYPE fleet_ingress_shed_total counter")
+        for k in SLO_CLASSES:
+            L.append(f'fleet_ingress_shed_total{{slo_class="{k}"}} '
+                     f'{self.ingress_shed_by_class.get(k, 0)}')
         return "\n".join(L) + "\n" + self.aggregate_metrics().to_prometheus()
 
     # -- the merged fleet timeline ----------------------------------------

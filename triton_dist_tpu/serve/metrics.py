@@ -74,6 +74,7 @@ MERGE_COUNTERS = (
     "prefix_hits", "prefix_hit_tokens",
     "prefix_skipped_tokens", "running_sum", "kv_util_sum",
     "net_requests", "net_dup_hits", "net_redelivered_tokens",
+    "brownout_transitions",
 )
 
 
@@ -292,6 +293,29 @@ class ServeMetrics:
     #                               draft-side page cache (warm admits)
     # retirements by FinishReason.value
     finish_reasons: dict = field(default_factory=dict)
+    # per-SLO-class accounting (docs/serving.md "Overload, SLO classes
+    # & autoscaling"): every counter keyed by slo_class so overload
+    # response is auditable PER TIER — "best_effort shed, interactive
+    # untouched" must be a number, not a claim.  Labeled dicts merge
+    # by-key across the fleet (the finish_reasons pattern), per-class
+    # TTFT histograms merge bucket-exactly by class (the program_hists
+    # pattern).  All-default traffic lands every count under
+    # "interactive", so the split costs nothing to read.
+    class_submitted: dict = field(default_factory=dict)
+    class_finished: dict = field(default_factory=dict)
+    class_shed: dict = field(default_factory=dict)
+    class_deadline: dict = field(default_factory=dict)
+    class_preempted: dict = field(default_factory=dict)
+    class_ttft: dict = field(default_factory=dict, repr=False)
+    # graceful-degradation ladder (engine brownout): the rung the
+    # engine currently sits on (0 = full service), its lifetime peak,
+    # and how many rung transitions it has walked.  Rung gauges take
+    # max across the fleet ("the worst brownout anywhere" is the
+    # alertable fact); transitions is an additive MERGE_COUNTERS
+    # member.
+    brownout_rung_last: int = 0
+    brownout_rung_peak: int = 0
+    brownout_transitions: int = 0
     # crash-recovery counters (docs/serving.md "Crash recovery"):
     # snapshot latency + journal overhead on the serving side, restore
     # provenance on the resume side (how much state came back in place
@@ -466,7 +490,8 @@ class ServeMetrics:
                 for name in sorted(self.program_hists)}
 
     def observe_finish(self, request_id: str, rm: RequestMetrics,
-                       reason=None) -> None:
+                       reason=None, slo_class: str = "interactive"
+                       ) -> None:
         self.completed += 1
         self.requests[request_id] = rm
         if self.requests_retain is not None:
@@ -474,9 +499,65 @@ class ServeMetrics:
             # (O(overflow) per finish — never materialize the whole map)
             while len(self.requests) > self.requests_retain:
                 del self.requests[next(iter(self.requests))]
+        self._bump(self.class_finished, slo_class)
         if reason is not None:
             key = getattr(reason, "value", str(reason))
             self.finish_reasons[key] = self.finish_reasons.get(key, 0) + 1
+            if key == "shed":
+                self._bump(self.class_shed, slo_class)
+            elif key == "deadline":
+                self._bump(self.class_deadline, slo_class)
+
+    # -- per-SLO-class accounting ------------------------------------------
+
+    @staticmethod
+    def _bump(d: dict, key: str, n: int = 1) -> None:
+        d[key] = d.get(key, 0) + n
+
+    def observe_class_submit(self, slo_class: str) -> None:
+        """One request accepted into the engine queue, by class."""
+        self._bump(self.class_submitted, slo_class)
+
+    def observe_class_preempt(self, slo_class: str) -> None:
+        """One preemption eviction, by the victim's class — with the
+        class-aware scheduler on, this is the proof best-effort absorbs
+        the pressure before interactive does."""
+        self._bump(self.class_preempted, slo_class)
+
+    def class_ttft_hist(self, slo_class: str) -> LogHistogram:
+        """Get-or-create the per-class TTFT histogram — one bucket
+        scheme across classes and engines, so fleet merge stays
+        bucket-exact (the ``program_hists`` pattern)."""
+        h = self.class_ttft.get(slo_class)
+        if h is None:
+            h = self.class_ttft[slo_class] = LogHistogram()
+        return h
+
+    def observe_brownout(self, rung: int) -> None:
+        """One brownout-ladder transition (engine `_brownout_step`):
+        the new rung becomes the gauge, every transition counts."""
+        self.brownout_transitions += 1
+        self.brownout_rung_last = rung
+        if rung > self.brownout_rung_peak:
+            self.brownout_rung_peak = rung
+
+    def slo_stats(self) -> dict:
+        """Per-class overload accounting (summary()["slo"]): submitted/
+        finished/shed/deadline/preempted by class, per-class TTFT
+        percentiles, and the brownout rung — the per-tier view the SLO
+        classes exist to provide."""
+        return {
+            "submitted": dict(sorted(self.class_submitted.items())),
+            "finished": dict(sorted(self.class_finished.items())),
+            "shed": dict(sorted(self.class_shed.items())),
+            "deadline_expired": dict(sorted(self.class_deadline.items())),
+            "preempted": dict(sorted(self.class_preempted.items())),
+            "ttft": {c: self.class_ttft[c].stats()
+                     for c in sorted(self.class_ttft)},
+            "brownout_rung": self.brownout_rung_last,
+            "brownout_rung_peak": self.brownout_rung_peak,
+            "brownout_transitions": self.brownout_transitions,
+        }
 
     def failure_stats(self) -> dict:
         """The containment counters as one dict (summary()["failures"])."""
@@ -597,6 +678,22 @@ class ServeMetrics:
         for reason, n in other.finish_reasons.items():
             self.finish_reasons[reason] = \
                 self.finish_reasons.get(reason, 0) + n
+        # per-class tallies merge by key (the finish_reasons pattern);
+        # brownout rung gauges take max — "the worst rung anywhere"
+        for mine, theirs in (
+                (self.class_submitted, other.class_submitted),
+                (self.class_finished, other.class_finished),
+                (self.class_shed, other.class_shed),
+                (self.class_deadline, other.class_deadline),
+                (self.class_preempted, other.class_preempted)):
+            for cls, n in theirs.items():
+                mine[cls] = mine.get(cls, 0) + n
+        for cls, theirs in other.class_ttft.items():
+            self.class_ttft_hist(cls).merge(theirs)
+        self.brownout_rung_last = max(self.brownout_rung_last,
+                                      other.brownout_rung_last)
+        self.brownout_rung_peak = max(self.brownout_rung_peak,
+                                      other.brownout_rung_peak)
         for mine, theirs in ((self.hist_ttft, other.hist_ttft),
                              (self.hist_itl, other.hist_itl),
                              (self.hist_queue, other.hist_queue),
@@ -776,6 +873,7 @@ class ServeMetrics:
             "decode": self.decode_stats(),
             "kv": self.kv_stats(),
             "spec": self.spec_stats(),
+            "slo": self.slo_stats(),
             "failures": self.failure_stats(),
             "recovery": self.recovery_stats(),
             "migration": self.migration_stats(),
@@ -855,6 +953,29 @@ class ServeMetrics:
         L.append("# TYPE serve_finished_total counter")
         for reason, n in sorted(self.finish_reasons.items()):
             L.append(f'serve_finished_total{{reason="{reason}"}} {n}')
+        # per-SLO-class series: labeled counter families (one TYPE
+        # header each) + the per-class TTFT histogram family
+        for name, d in (("serve_class_submitted_total",
+                         self.class_submitted),
+                        ("serve_class_finished_total",
+                         self.class_finished),
+                        ("serve_class_shed_total", self.class_shed),
+                        ("serve_class_deadline_expired_total",
+                         self.class_deadline),
+                        ("serve_class_preempted_total",
+                         self.class_preempted)):
+            L.append(f"# TYPE {name} counter")
+            for cls, n in sorted(d.items()):
+                L.append(f'{name}{{slo_class="{cls}"}} {n}')
+        for i, cls in enumerate(sorted(self.class_ttft)):
+            L.extend(self.class_ttft[cls].prom_lines(
+                "serve_class_ttft_seconds", labels=f'slo_class="{cls}"',
+                typed=i == 0))
+        counter("serve_brownout_transitions_total",
+                self.brownout_transitions,
+                "graceful-degradation ladder rung transitions")
+        gauge("serve_brownout_rung", self.brownout_rung_last,
+              "current brownout rung (0 = full service)")
         gauge("serve_queue_depth", self.queue_depth_last,
               "waiting requests at the last engine step")
         gauge("serve_running", self.running_last)

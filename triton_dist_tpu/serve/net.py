@@ -110,6 +110,23 @@ class NetHTTPError(NetError):
         self.body = body
 
 
+class NetOverloaded(NetHTTPError):
+    """The replica ANSWERED ``429 Too Many Requests``: admission
+    pressure, not a transport fault and not a wrong request.  The
+    client retries under its normal jittered ladder with the server's
+    ``Retry-After`` hint as the delay FLOOR (jitter on top keeps a
+    fleet's retries from synchronizing); exhausting the ladder
+    surfaces this exception, which the caller maps onto the
+    bounded-admission contract (``RemoteReplica.submit`` →
+    ``QueueFull``, so the controller re-places or sheds).  The
+    idempotency-key/rid replay cache is what makes every retry safe."""
+
+    def __init__(self, status: int, body: str, *,
+                 retry_after_s: float = 0.0):
+        super().__init__(status, body)
+        self.retry_after_s = retry_after_s
+
+
 # ---------------------------------------------------------------------------
 # Manifest wire form: KV pages as base64 so live hand-offs cross the wire
 # ---------------------------------------------------------------------------
@@ -227,10 +244,15 @@ class ReplicaServer:
     def __init__(self, engine, *, faults=None, stall_after_s: float = 10.0,
                  cache_entries: int = 32, cache_ttl_s: float = 120.0,
                  exec_timeout_s: float = 30.0,
-                 streams_retain: int = 4096):
+                 streams_retain: int = 4096,
+                 retry_after_s: float = 0.25):
         self.engine = engine
         self.faults = faults
         self.stall_after_s = stall_after_s
+        # the Retry-After hint a 429 answer carries (seconds): how long
+        # a submitting client should wait before re-offering — a full
+        # queue drains on the decode timescale, not the RTT one
+        self.retry_after_s = retry_after_s
         self.exec_timeout_s = exec_timeout_s
         self.streams_retain = streams_retain
         self._lock = threading.Lock()
@@ -424,11 +446,13 @@ class ReplicaServer:
                     rid, np.asarray(doc["prompt"], np.int32),
                     SamplingParams.from_dict(doc["params"]),
                     on_token=self._appender(rid),
+                    slo_class=doc.get("slo", "interactive"),
                     trace=doc.get("trace"))
                 shed = self.engine.submit(req)
             except QueueFull as e:
                 self._unregister(rid)
-                return {"ok": False, "queue_full": True, "why": str(e)}
+                return {"ok": False, "queue_full": True, "why": str(e),
+                        "retry_after_s": self.retry_after_s}
             except Exception as e:  # noqa: BLE001 — an engine-rejected
                 # submit (bad geometry, invalid params) must NOT leave
                 # a ghost stream behind: it would answer dup:true to
@@ -628,6 +652,10 @@ class ReplicaServer:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                if (code == 429
+                        and doc.get("retry_after_s") is not None):
+                    self.send_header(
+                        "Retry-After", f"{doc['retry_after_s']:.3f}")
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -687,7 +715,14 @@ class ReplicaServer:
                 if method == "POST" and path == "/poll":
                     return outer.handle_poll(self._body()), 200
                 if method == "POST" and path == "/submit":
-                    return outer.handle_submit(self._body()), 200
+                    doc = outer.handle_submit(self._body())
+                    if doc.get("queue_full"):
+                        # overload is 429 + Retry-After, not a 200: the
+                        # client's backoff ladder paces itself on the
+                        # hint instead of reading pressure as transport
+                        # trouble (docs/serving.md "Overload")
+                        return doc, 429
+                    return doc, 200
                 if method == "POST" and path == "/drain":
                     return outer.handle_drain(self._body()), 200
                 if method == "POST" and path == "/migrate_in":
@@ -838,6 +873,12 @@ class NetClient:
                 pass
             if e.code == 503:
                 raise ConnectionError(f"replica busy/dead: {body[:100]}")
+            if e.code == 429:
+                try:
+                    ra = float(e.headers.get("Retry-After") or 0.0)
+                except (TypeError, ValueError):
+                    ra = 0.0
+                raise NetOverloaded(e.code, body, retry_after_s=ra)
             raise NetHTTPError(e.code, body)
 
     def call(self, op: str, path: str, *, method: str = "GET",
@@ -881,6 +922,21 @@ class NetClient:
                     except Exception:  # noqa: BLE001
                         pass
                 return resp
+            except NetOverloaded as e:
+                # 429: retry under the SAME jittered ladder, but never
+                # sooner than the server's Retry-After hint — pressure
+                # is answered with patience, not with a tighter loop.
+                # An exhausted ladder surfaces the NetOverloaded for
+                # the caller's bounded-admission mapping.
+                delay = bo.on_death(time.monotonic())
+                if delay is None:
+                    raise
+                delay = max(delay, e.retry_after_s)
+                if self.on_retry is not None:
+                    self.on_retry(op, attempt, delay,
+                                  f"overloaded (retry after "
+                                  f"{e.retry_after_s:g}s)")
+                time.sleep(delay)
             except NetHTTPError:
                 raise
             except (InjectedNetFault, OSError,

@@ -190,6 +190,7 @@ class TokenJournal:
         rec = {"t": "submit", "rid": req.request_id,
                "prompt": [int(x) for x in req.prompt],
                "params": req.params.to_dict(),
+               "slo": req.slo_class,
                "ts": req.arrival_time}
         if getattr(req, "trace", None):
             # the distributed-tracing context rides the journal so a
@@ -283,6 +284,9 @@ class JournalRequest:
     # "hop"}) — crash-path manifests carry it so the journey survives
     # the replica (docs/observability.md "Fleet observability")
     trace: Optional[dict] = None
+    # SLO class from the submit record — a restored/migrated request
+    # keeps its service tier ("interactive" covers pre-slo journals)
+    slo: str = "interactive"
 
     def token_list(self) -> list[int]:
         """Emitted tokens in order (the contiguous prefix from 0 — a gap
@@ -332,6 +336,7 @@ def replay_journal(path: str | os.PathLike) -> dict[str, JournalRequest]:
                     jr.prompt = np.asarray(rec["prompt"], np.int32)
                     jr.params = SamplingParams.from_dict(rec["params"])
                     jr.arrival = rec.get("ts")
+                    jr.slo = rec.get("slo", "interactive")
                     if jr.first_tok is None:
                         jr.first_tok = rec.get("ftt")
                     if jr.trace is None:
@@ -358,6 +363,7 @@ def replay_journal(path: str | os.PathLike) -> dict[str, JournalRequest]:
                     jr.prompt = np.asarray(rec["prompt"], np.int32)
                     jr.params = SamplingParams.from_dict(rec["params"])
                     jr.arrival = rec.get("arrival")
+                    jr.slo = rec.get("slo", "interactive")
                 if jr.first_tok is None:
                     jr.first_tok = rec.get("ftt")
                 tts = rec.get("tts") or []
@@ -432,6 +438,7 @@ def _capture_meta(engine, now: float, *, journal_here: bool) -> dict:
             "arrival": rs.req.arrival_time,
             "prompt": [int(x) for x in np.asarray(rs.req.prompt)],
             "params": rs.req.params.to_dict(),
+            "slo": rs.req.slo_class,
             "first_sched": rs.metrics.first_scheduled_time,
             "first_tok": rs.metrics.first_token_time,
             "token_times": list(rs.metrics.token_times),
@@ -898,6 +905,7 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
         r["params"] = (SamplingParams.from_dict(src["params"])
                        if "params" in src else SamplingParams())
         r["arrival"] = src.get("arrival")
+        r["slo"] = src.get("slo", "interactive")
         if rid in m_reqs:
             r["tokens"] = list(m_reqs[rid]["gen"])
             r["tok_ts"] = list(m_reqs[rid].get("token_times", []))
@@ -913,6 +921,7 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
             r.setdefault("prompt", jr.prompt)
             r.setdefault("params", jr.params)
             r.setdefault("arrival", jr.arrival)
+            r.setdefault("slo", jr.slo)
         toks = jr.token_list()
         # The journal syncs before every snapshot, so it is a superset
         # of the manifest's token view — prefer it whenever longer (the
@@ -977,7 +986,8 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
             total=len(r["tokens"]))
         rm.finish_time = finish_ts
         req = Request(rid, r["prompt"], r["params"],
-                      arrival_time=rm.arrival_time)
+                      arrival_time=rm.arrival_time,
+                      slo_class=r.get("slo", "interactive"))
         rs = ReqState(req=req, metrics=rm, status=Status.FINISHED)
         rs.generated = list(r["tokens"])
         out = RequestOutput(request_id=rid, prompt=req.prompt,
@@ -985,7 +995,7 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
                             finish_reason=reason, metrics=rm, error=err)
         engine._states[rid] = rs
         engine._outputs[rid] = out
-        m.observe_finish(rid, rm, reason)
+        m.observe_finish(rid, rm, reason, slo_class=req.slo_class)
         return rs
 
     inflight: list[str] = []
@@ -1110,6 +1120,7 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
         req = Request(rid, r["prompt"], r["params"],
                       arrival_time=rm.arrival_time,
                       on_token=_resolve_callback(on_token, rid),
+                      slo_class=r.get("slo", "interactive"),
                       trace=r.get("trace")
                       or {"trace_id": rid, "hop": 0})
         rs = ReqState(req=req, metrics=rm)
@@ -1380,6 +1391,7 @@ def manifest_from_journal(directory: str | os.PathLike, *,
             "prompt": [int(x) for x in jr.prompt],
             "params": jr.params.to_dict(),
             "arrival": jr.arrival,
+            "slo": jr.slo,
             "tokens": toks,
             "tok_ts": jr.token_times(),
             "first_tok": jr.first_tok,

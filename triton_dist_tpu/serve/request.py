@@ -16,6 +16,18 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 
 
+#: SLO classes, best-first.  The position in this tuple is the class's
+#: RANK (0 = most latency-sensitive): class-aware admission prefers the
+#: lowest rank, victim picking and the brownout ladder spend the highest
+#: rank first (docs/serving.md "Overload, SLO classes & autoscaling").
+SLO_CLASSES = ("interactive", "batch", "best_effort")
+
+
+def slo_rank(slo_class: str) -> int:
+    """Rank of an SLO class (0 = interactive = most protected)."""
+    return SLO_CLASSES.index(slo_class)
+
+
 class FinishReason(enum.Enum):
     LENGTH = "length"      # hit max_new_tokens
     EOS = "eos"            # emitted params.eos_id (included in the output)
@@ -98,6 +110,20 @@ class Request:
     it at admission; a bare engine defaults it at ``submit()`` — either
     way it rides migration manifests and the token journal, so a
     request's journey stays one trace across replicas and restarts.
+
+    ``slo_class`` (:data:`SLO_CLASSES`) tags the request's service tier.
+    The default ``"interactive"`` keeps all-default traffic exactly as
+    before: with every request in one class, class-aware admission and
+    victim picking reduce to the original FCFS/LIFO orders bit-for-bit.
+    The tag rides the journal, migration manifests, and the wire, so a
+    request keeps its tier across replicas and restarts.
+
+    ``on_finish(output)`` (optional) fires EXACTLY ONCE at retirement —
+    whichever layer retires the request (engine step, deadline sweep,
+    admission shed, fleet-queue shed) and however it ends.  This is the
+    terminal notification a streaming frontend needs: ``on_token`` says
+    nothing for a zero-token retirement (shed/deadline), so without it a
+    shed request's consumer would wait forever.
     """
 
     request_id: str
@@ -106,11 +132,17 @@ class Request:
     arrival_time: Optional[float] = None
     on_token: Optional[Callable[[str, int], None]] = None
     trace: Optional[dict] = None
+    slo_class: str = "interactive"
+    on_finish: Optional[Callable[["RequestOutput"], None]] = None
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
         if self.prompt.size == 0:
             raise ValueError(f"request {self.request_id}: empty prompt")
+        if self.slo_class not in SLO_CLASSES:
+            raise ValueError(
+                f"request {self.request_id}: unknown slo_class "
+                f"{self.slo_class!r} (expected one of {SLO_CLASSES})")
 
 
 @dataclass

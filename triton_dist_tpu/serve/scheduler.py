@@ -26,7 +26,7 @@ import numpy as np
 
 from triton_dist_tpu.serve.block_manager import BlockManager
 from triton_dist_tpu.serve.metrics import RequestMetrics
-from triton_dist_tpu.serve.request import Request
+from triton_dist_tpu.serve.request import Request, slo_rank
 
 
 class Status(enum.Enum):
@@ -95,6 +95,13 @@ class ReqState:
     # engine, survives preemption (acceptance is a property of the
     # request's text, not of its admission)
     spec_window: list = field(default_factory=list)
+    # brownout ladder (engine-owned; docs/serving.md "Overload, SLO
+    # classes & autoscaling"): a rung-3 emission cap for best-effort
+    # rows — ``remaining_new`` and the LENGTH finish check both honor
+    # it, while ``total_tokens`` (the admitted cache ceiling) does not,
+    # so capping never re-plans allocations.  ``None`` = uncapped (the
+    # default path is untouched).
+    new_cap: Optional[int] = None
 
     def expired(self, now: float) -> bool:
         """Past its deadline TTL (``params.deadline_s`` from arrival)."""
@@ -108,8 +115,16 @@ class ReqState:
                 else self.req.prompt)
 
     @property
+    def effective_max_new(self) -> int:
+        """``params.max_new_tokens``, clamped by a brownout ``new_cap``
+        (the cap is applied with >= 1 token of headroom, so a live row
+        always retires through a normal LENGTH commit)."""
+        m = self.req.params.max_new_tokens
+        return m if self.new_cap is None else min(m, self.new_cap)
+
+    @property
     def remaining_new(self) -> int:
-        return self.req.params.max_new_tokens - len(self.generated)
+        return self.effective_max_new - len(self.generated)
 
     @property
     def total_tokens(self) -> int:
@@ -124,7 +139,8 @@ class FCFSScheduler:
     preemption, all against one :class:`BlockManager`."""
 
     def __init__(self, block_manager: BlockManager, *,
-                 prefill_budget: int, prefill_chunk: int):
+                 prefill_budget: int, prefill_chunk: int,
+                 class_aware: bool = False):
         assert prefill_chunk >= 1 and prefill_budget >= 1
         self.bm = block_manager
         # Batch-slot capacity lives with the ENGINE (admit() is bounded
@@ -133,6 +149,13 @@ class FCFSScheduler:
         # one chunk always proceeds so prefill cannot livelock
         self.prefill_budget = prefill_budget
         self.prefill_chunk = prefill_chunk
+        # SLO-class-aware policy (docs/serving.md "Overload, SLO classes
+        # & autoscaling"): admission considers waiting requests in
+        # (class rank, queue position) order and preemption spends the
+        # worst class first.  Both orders are STABLE on arrival, so with
+        # every request in one class (the default — slo_class defaults
+        # to "interactive") they reduce bit-for-bit to FCFS / LIFO.
+        self.class_aware = class_aware
         self.waiting: deque[ReqState] = deque()
         self._seq = 0
 
@@ -169,16 +192,30 @@ class FCFSScheduler:
         the engine where chunked prefill may start.  A recompute prompt
         (``work_prompt`` after preemption) matches the same way — the
         victim's own committed blocks usually sit in the cache tier, so
-        preemption recompute collapses too."""
+        preemption recompute collapses too.
+
+        With ``class_aware`` on, candidates are scanned in (class rank,
+        queue position) order — a stable sort, so within one class it IS
+        the FCFS order, and with every request in one class the two
+        paths admit identically.  Head-of-line blocking applies within
+        that order: the first blocked candidate stops the scan, so no
+        class starves its own members and no lower class jumps a
+        blocked higher-class head."""
         admitted = []
-        while self.waiting and free_slots:
+        if self.class_aware:
+            queue = sorted(self.waiting,
+                           key=lambda r: slo_rank(r.req.slo_class))
+        else:
+            queue = list(self.waiting)
+        for rs in queue:
+            if not free_slots:
+                break
             # Every admission needs >= 1 fresh block (match_prefix caps
             # at n_prompt - 1 tokens, so shared pages never cover the
             # prompt + headroom) — with nothing allocatable, skip the
             # O(prompt) chain walk entirely.
             if self.bm.num_free == 0:
                 break
-            rs = self.waiting[0]
             n_prompt = int(rs.prompt_tokens.shape[0])
             # match_prefix caps at n_prompt - 1: at least one prompt
             # token always prefills (the request needs its logits).
@@ -197,7 +234,7 @@ class FCFSScheduler:
             # immediately preempt something.
             if not self.bm.can_allocate(n_prompt + 1, shared):
                 break
-            self.waiting.popleft()
+            self.waiting.remove(rs)
             rs.slot = free_slots.pop(0)
             rs.status = Status.PREFILL
             rs.prefill_pos = 0
@@ -330,11 +367,35 @@ class FCFSScheduler:
                     needy: ReqState) -> Optional[ReqState]:
         """LIFO eviction: the latest-admitted running request other than
         ``needy`` (evicting the one that still needs blocks would free
-        nothing it can use — its own blocks come back to it)."""
+        nothing it can use — its own blocks come back to it).
+
+        With ``class_aware`` on, the worst SLO class is spent first —
+        best-effort before batch before interactive — LIFO within a
+        class.  With every request in one class the (rank, seq) max is
+        the seq max, so the default path is unchanged."""
         candidates = [r for r in running if r is not needy]
         if not candidates:
             return None
+        if self.class_aware:
+            return max(candidates,
+                       key=lambda r: (slo_rank(r.req.slo_class), r.seq))
         return max(candidates, key=lambda r: r.seq)
+
+    def pick_shed_victim(self, rank: int) -> Optional[ReqState]:
+        """Class-aware overload displacement: the latest-queued WAITING
+        request of the WORST class strictly below service rank ``rank``
+        (higher ``slo_rank``), or ``None`` when no lower class holds a
+        queue slot.  Used by the engine when the waiting queue is at
+        ``max_queue``: an arriving higher-class request sheds this
+        victim and takes its slot instead of being refused — interactive
+        is never shed while best-effort or batch occupies the queue."""
+        worst: Optional[ReqState] = None
+        worst_key = (rank, -1)
+        for i, rs in enumerate(self.waiting):
+            key = (slo_rank(rs.req.slo_class), i)
+            if key > worst_key:
+                worst, worst_key = rs, key
+        return worst
 
     def preempt(self, rs: ReqState) -> None:
         """Evict ``rs``: free its blocks and re-queue it (front) for

@@ -91,6 +91,13 @@ EVENT_TYPES = frozenset({
     # retried call, so a postmortem shows the backoff ladder a
     # partition actually drove.
     "net_retry",      # a network call failed and will retry under backoff
+    # overload robustness (docs/serving.md "Overload, SLO classes &
+    # autoscaling"): the engine's graceful-degradation ladder and the
+    # fleet's pressure-driven scaling — every degrade/scale decision
+    # lands on a timeline next to the traffic it shaped.
+    "brownout",       # engine ladder moved a rung (data: rung, prev)
+    "scale",          # fleet autoscaler spawned/retired a replica
+    "ingress_shed",   # fleet token-bucket refused a request at the door
 })
 
 #: FinishReason values the ``retire`` event is specified over — the
