@@ -578,6 +578,33 @@ def _bench_serve_mesh():
     return r["serve_mesh_zero_loss"], r["mesh_toks_per_s"]
 
 
+def _bench_serve_mesh2d():
+    """2D sharded-engine exactness guardrail (ISSUE 19): the same
+    paired-oracle leg on a 4-device heads+seq engine — bench_serve
+    factors the mesh 2x2 (tp x sp), TP weights + heads shard over tp
+    while the paged KV shards by block over sp — and the fraction of
+    mixed greedy + seeded-sampled streams bit-identical to the world-1
+    oracle must be 1.0 with zero post-warmup compiles (the 2-axis
+    ladder is fully enumerable, like the 1D one)."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    from triton_dist_tpu.runtime.testenv import virtual_mesh_env
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = subprocess.run(
+        [_sys.executable, os.path.join(here, "scripts", "bench_serve.py"),
+         "--mesh", "4", "--kv-shard", "heads+seq", "--new-tokens", "48"],
+        capture_output=True, text=True, timeout=1200, cwd=here,
+        env=virtual_mesh_env(n_devices=4))
+    assert out.returncode == 0, out.stderr[-2000:]
+    r = json.loads([ln for ln in out.stdout.splitlines()
+                    if ln.startswith("{")][-1])
+    assert r["mesh_fresh_compiles"] == 0, r
+    return r["serve_mesh2d_zero_loss"]
+
+
 def _bench_kernel_report():
     """Kernel overlap scoreboard (scripts/kernel_report.py, ISSUE 14):
     the ag_gemm fused/compute-only/comm-only legs + phase-sliced
@@ -716,6 +743,7 @@ def main():
     disagg_zero_loss, disagg_itl_isolation = _bench_serve_disagg()
     fleet_trace_overhead = _bench_serve_fleet_trace()
     mesh_zero_loss, mesh_tps = _bench_serve_mesh()
+    mesh2d_zero_loss = _bench_serve_mesh2d()
     kv_int8_capacity, kv_int8_token_match = _bench_serve_kv_int8()
     slo_goodput, slo_rung_max, slo_scale_ups = _bench_serve_overload()
     overlap_eff, model_vs_meas = _bench_kernel_report()
@@ -790,6 +818,12 @@ def main():
         # host "chips" share this host's cores).
         "serve_mesh_zero_loss": round(mesh_zero_loss, 4),
         "serve_mesh_toks_per_s": round(mesh_tps, 1),
+        # 2D sharded-engine exactness (ISSUE 19): the same bar on a
+        # 4-device heads+seq engine — a 2x2 (tp x sp) mesh with TP
+        # weights + heads over tp and block-sharded paged KV over sp —
+        # with zero post-warmup compiles (the 2-axis bucket ladder is
+        # enumerable exactly like the 1D one).
+        "serve_mesh2d_zero_loss": round(mesh2d_zero_loss, 4),
         # Quantized serving (ISSUE 17): resident-token capacity at
         # equal pool bytes — float bytes/token over int8 bytes/token on
         # the engines' allocated pools at head_dim 64 (~3.76x; floor

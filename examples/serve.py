@@ -114,14 +114,18 @@ def parse_args():
                         "cleanly when the runtime exposes fewer than N "
                         "devices (force them on CPU with XLA_FLAGS="
                         "--xla_force_host_platform_device_count=N)")
-    p.add_argument("--kv-shard", choices=("heads", "seq"),
+    p.add_argument("--kv-shard", choices=("heads", "seq", "heads+seq"),
                    default="heads",
                    help="--mesh KV layout: 'heads' shards the pools by "
-                        "KV head (Megatron TP attention, full feature "
-                        "set), 'seq' shards by block — each rank owns a "
-                        "contiguous sequence span and attention runs "
-                        "the SP flash-decode combine (long-context "
-                        "scaling; no speculative mode)")
+                        "KV head (Megatron TP attention), 'seq' shards "
+                        "by block — each rank owns a contiguous "
+                        "sequence span and attention runs the SP "
+                        "flash-decode combine (long-context scaling), "
+                        "'heads+seq' factors the mesh 2D — weights and "
+                        "heads TP-shard over the tp axis while the "
+                        "paged KV shards by block over the sp axis "
+                        "(pod-scale serving; docs/serving.md '2D "
+                        "sharded serving')")
     p.add_argument("--stagger", type=int, default=2,
                    help="engine mode: submit a new request every "
                         "S engine steps")
@@ -623,6 +627,7 @@ def run_engine(args, key):
     # prefill); --mesh places the ENGINE's forwards on a device mesh
     mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
     engine_mesh = None
+    tp_w = sp_w = 1
     if args.mesh:
         if args.mesh < 1:
             raise SystemExit("--mesh needs N >= 1")
@@ -636,7 +641,20 @@ def run_engine(args, key):
                   f"a {args.mesh}-chip platform to exercise sharded "
                   f"serving.")
             return
-        engine_mesh = Mesh(np.array(jax.devices()[:args.mesh]), ("tp",))
+        if args.kv_shard == "heads+seq":
+            # Factor N = tp x sp: sp takes the smallest prime factor
+            # (spare pages are easier to come by than whole KV heads),
+            # tp the rest — 4 -> 2x2, 8 -> 4x2, 6 -> 3x2.  A prime N
+            # degenerates to tp=1 (pure block sharding on a 2-axis
+            # mesh), which the engine serves identically to 'seq'.
+            sp_w = next((p for p in range(2, args.mesh + 1)
+                         if args.mesh % p == 0), 1)
+            tp_w = args.mesh // sp_w
+            engine_mesh = Mesh(np.array(jax.devices()[:args.mesh])
+                               .reshape(tp_w, sp_w), ("tp", "sp"))
+        else:
+            engine_mesh = Mesh(np.array(jax.devices()[:args.mesh]),
+                               ("tp",))
     rng = np.random.default_rng(args.seed)
     if args.mixed:
         if args.shared_prompt or args.sessions:
@@ -670,14 +688,19 @@ def run_engine(args, key):
     max_seq += (-max_seq) % args.page_size
     n_heads = 2
     ffn_dim = 64
+    seq_w = {"heads": 1, "seq": args.mesh,
+             "heads+seq": sp_w}.get(args.kv_shard, 1) or 1
     if engine_mesh is not None:
         # Geometry must divide the mesh (the engine rejects anything
-        # else loudly): whole heads per rank, ffn columns per rank, and
-        # for the seq layout a page count divisible by the world.
-        n_heads = max(2, args.mesh)
-        ffn_dim = -(-64 // args.mesh) * args.mesh
-        if args.kv_shard == "seq":
-            max_seq += (-max_seq) % (args.page_size * args.mesh)
+        # else loudly): whole heads per rank of the HEAD-sharding
+        # world (the full mesh for 'heads'/'seq', the tp axis for
+        # 'heads+seq'), ffn columns per rank, and for the block-
+        # sharded layouts a page count divisible by the sp world.
+        heads_w = tp_w if args.kv_shard == "heads+seq" else args.mesh
+        n_heads = max(2, heads_w)
+        ffn_dim = -(-64 // heads_w) * heads_w
+        if seq_w > 1:
+            max_seq += (-max_seq) % (args.page_size * seq_w)
 
     cfg = llama.LlamaConfig(vocab=256, dim=16 * n_heads, n_layers=2,
                             n_heads=n_heads, n_kv_heads=n_heads,
@@ -700,11 +723,10 @@ def run_engine(args, key):
     per_req = -(-max_seq // page)
     num_blocks = args.num_blocks or (1 + per_req * max(2, args.requests
                                                        // 2))
-    if (engine_mesh is not None and args.kv_shard == "seq"
-            and args.num_blocks is None):
-        # seq layout: equal per-rank partitions (one null each) sized
-        # so a full-length span still fits its partition
-        num_blocks = -(-(num_blocks + args.mesh) // args.mesh) * args.mesh
+    if engine_mesh is not None and seq_w > 1 and args.num_blocks is None:
+        # block-sharded layouts: equal per-rank partitions (one null
+        # each) sized so a full-length span still fits its partition
+        num_blocks = -(-(num_blocks + seq_w) // seq_w) * seq_w
     faults = None
     max_queue = args.max_queue
     if args.chaos:
@@ -756,8 +778,14 @@ def run_engine(args, key):
         layout = ("TP weights + head-sharded paged KV"
                   if args.kv_shard == "heads" else
                   "replicated weights + block-sharded paged KV "
-                  "(SP flash-decode)")
-        dist_print(f"mesh serving: {args.mesh} devices over axis 'tp', "
+                  "(SP flash-decode)"
+                  if args.kv_shard == "seq" else
+                  f"2D: TP weights + heads over tp={tp_w}, block-"
+                  f"sharded paged KV over sp={sp_w} (SP flash-decode "
+                  f"combine)")
+        axes = (f"axes ('tp', 'sp') = {tp_w} x {sp_w}"
+                if args.kv_shard == "heads+seq" else "axis 'tp'")
+        dist_print(f"mesh serving: {args.mesh} devices over {axes}, "
                    f"kv_shard={args.kv_shard!r} — {layout} under "
                    f"shard_map; streams are bit-identical to the "
                    f"world-1 engine")

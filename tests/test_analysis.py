@@ -365,6 +365,30 @@ def test_engine_registry_audits_clean_world1(tiny_serving):
             "prefill_chunk"} <= set(rep["audited"])
 
 
+def _assert_prefill_attend_sharded(eng, cfg):
+    """ISSUE-19 debt (b) acceptance: chunked prefill under a seq axis
+    no longer computes attention replicated — the traced prefill
+    program carries the rank-local-slice attend's LSE-combine
+    all_gather at exactly the declared per-layer count (a replicated
+    prefill traces to zero collectives, which this pins against).
+    The count assertion is needed because the auditor tolerates a
+    declared seam with zero occurrences."""
+    from triton_dist_tpu.analysis.jaxpr_audit import (
+        _PRIM_CANON, _signatures, _trace, jaxpr_stats)
+
+    rec = next(r for r in eng.program_registry()
+               if r["name"] == "prefill_chunk")
+    sigs = _signatures(rec["fn"])
+    assert sigs, "prefill_chunk never traced"
+    for args_abs, kwargs in sigs:
+        stats = jaxpr_stats(_trace(rec["fn"], args_abs, kwargs).jaxpr)
+        canon: dict = {}
+        for prim, n in stats["prims"].items():
+            k = _PRIM_CANON.get(prim, prim)
+            canon[k] = canon.get(k, 0) + n
+        assert canon.get("all_gather") == cfg.n_layers, canon
+
+
 @pytest.mark.parametrize("kv_shard", ["heads", "seq"])
 def test_engine_registry_audits_clean_mesh(tiny_serving, mesh2,
                                            kv_shard):
@@ -379,6 +403,45 @@ def test_engine_registry_audits_clean_mesh(tiny_serving, mesh2,
     rep = audit_engine(eng)
     assert not rep["findings"], [str(f) for f in rep["findings"]]
     assert {"paged_decode", "decode_horizon"} <= set(rep["audited"])
+    if kv_shard == "seq":
+        _assert_prefill_attend_sharded(eng, cfg)
+
+
+def test_engine_registry_audits_clean_mesh2d(tiny_serving):
+    """heads+seq on a 2x2 (tp x sp) mesh: the 2-axis registry audits
+    with zero findings — psum exactly at the tp out-proj/FFN seams AND
+    the LSE-combine gather exactly at the sp seam, in the same traced
+    bodies — and the sharded prefill attend shows its sp all_gather."""
+    cfg, params, gen = tiny_serving
+    mesh22 = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                  ("tp", "sp"))
+    eng = _build_engine(tiny_serving, horizon=4, mesh=mesh22,
+                        kv_shard="heads+seq")
+    eng.warmup()
+    _serve_mixed(eng, cfg)
+    rep = audit_engine(eng)
+    assert not rep["findings"], [str(f) for f in rep["findings"]]
+    assert {"paged_decode", "decode_horizon",
+            "prefill_chunk"} <= set(rep["audited"])
+    _assert_prefill_attend_sharded(eng, cfg)
+
+
+@pytest.mark.slow
+def test_engine_registry_audits_clean_mesh2d_world8(tiny_serving,
+                                                    mesh2d):
+    """World 8 re-run of the 2D audit on the hierarchical (dp x tp)
+    fixture with the serving axes mapped tp_axis='tp' (4 | heads) and
+    sp_axis='dp' (2 | pages)."""
+    cfg, params, gen = tiny_serving
+    eng = _build_engine(tiny_serving, horizon=4, mesh=mesh2d,
+                        kv_shard="heads+seq", tp_axis="tp",
+                        sp_axis="dp")
+    eng.warmup()
+    _serve_mixed(eng, cfg)
+    rep = audit_engine(eng)
+    assert not rep["findings"], [str(f) for f in rep["findings"]]
+    assert {"paged_decode", "decode_horizon",
+            "prefill_chunk"} <= set(rep["audited"])
 
 
 # ---------------------------------------------------------------------------

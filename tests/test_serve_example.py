@@ -94,6 +94,25 @@ def test_serve_engine_mesh():
     assert "--mesh is an engine-mode flag" in out.stderr
 
 
+def test_serve_engine_mesh2d():
+    """--engine --mesh 4 --kv-shard heads+seq: the 2D serving mesh
+    through the CLI — N factored into tp x sp (4 -> 2x2), TP weights
+    over tp, block-sharded paged KV over sp — plus the loud SKIP when
+    the runtime lacks the devices."""
+    out = _run("--engine", "--mesh", "4", "--kv-shard", "heads+seq",
+               "--requests", "3", "--max-batch", "2", "--page-size",
+               "8", devices=4, new_tokens=4)
+    assert "mesh serving: 4 devices over axes ('tp', 'sp') = 2 x 2" \
+        in out, out
+    assert "kv_shard='heads+seq'" in out, out
+    assert "engine: 12 tokens / 3 requests" in out and "done" in out
+    # not enough devices: loud SKIP, clean exit
+    out = _run("--engine", "--mesh", "4", "--kv-shard", "heads+seq",
+               "--requests", "2", devices=2)
+    assert "SKIP" in out and "--mesh 4 needs 4 devices" in out, out
+    assert "done" not in out
+
+
 def test_serve_engine_spec_adaptive_validated():
     """--spec-adaptive is validated like --sessions: a negative window
     or a use without --speculative is an argparse error, not a silent

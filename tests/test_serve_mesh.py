@@ -96,6 +96,14 @@ def oracle(model):
     return _serve(eng, _requests(cfg))
 
 
+@pytest.fixture(scope="module")
+def mesh22():
+    """The 2D serving mesh: 2 tp ranks x 2 sp ranks (kv_shard=
+    'heads+seq' — heads/weights over 'tp', KV blocks over 'sp')."""
+    return Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                ("tp", "sp"))
+
+
 # ---------------------------------------------------------------------------
 # Construction-time geometry rejection matrix
 # ---------------------------------------------------------------------------
@@ -143,14 +151,32 @@ def test_mesh_geometry_rejection_matrix(model, mesh4, mesh2):
         build(mesh=mesh4, kv_shard="seq", num_blocks=26)
     with pytest.raises(ValueError, match="null"):
         build(mesh=mesh4, kv_shard="seq", num_blocks=4)
-    # seq x speculative: the single-token combine contract
-    with pytest.raises(ValueError, match="spec"):
-        build(mesh=mesh2, kv_shard="seq", draft=gen, draft_params=params,
-              spec_k=4)
     # mesh x legacy unfused spec rounds
     with pytest.raises(ValueError, match="unfused"):
         build(mesh=mesh2, kv_shard="heads", draft=gen,
               draft_params=params, spec_k=4, spec_fused=False)
+    # heads+seq 2D matrix: the world must factor over two NAMED axes,
+    # and each factor owns its own divisibility rules — the error
+    # names the failing axis.
+    mesh2d = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                  ("tp", "sp"))
+    with pytest.raises(ValueError, match="sp_axis"):
+        build(mesh=mesh4, kv_shard="heads+seq")  # no 'sp' on a 1D mesh
+    with pytest.raises(ValueError, match="DISTINCT"):
+        build(mesh=mesh2d, kv_shard="heads+seq", tp_axis="tp",
+              sp_axis="tp")
+    with pytest.raises(ValueError, match=r"tp axis 'tp'"):
+        # heads fail on the tp factor: 3 KV heads % 2
+        ServeEngine(gen3, p3, num_blocks=24, page_size=8, mesh=mesh2d,
+                    kv_shard="heads+seq")
+    with pytest.raises(ValueError, match=r"sp axis 'sp'"):
+        # pages fail on the sp factor: 8 logical pages % 3
+        build(mesh=Mesh(np.array(jax.devices()[:6]).reshape(2, 3),
+                        ("tp", "sp")), kv_shard="heads+seq")
+    with pytest.raises(ValueError, match="num_blocks"):
+        build(mesh=mesh2d, kv_shard="heads+seq", num_blocks=25)
+    with pytest.raises(ValueError, match="null"):
+        build(mesh=mesh2d, kv_shard="heads+seq", num_blocks=2)
     # seq: a span that cannot fit its partition is rejected AT SUBMIT,
     # loudly, not as a shape error inside a traced forward
     eng = build(mesh=mesh2, kv_shard="seq", num_blocks=8)
@@ -266,6 +292,56 @@ def test_mesh_seq_oracle_with_preemption(model, mesh2):
     assert preempts >= 1
 
 
+def test_mesh_2d_oracle_h8_flat_misses(model, oracle, mesh22):
+    """THE tentpole oracle (ISSUE 19): kv_shard='heads+seq' on a 2x2
+    (tp x sp) mesh, fused horizon H=8 — head-sharded weights psum on
+    tp, block-sharded pools LSE-combine on sp, and every greedy +
+    seeded-sampled staggered stream is bit-identical to the world-1
+    oracle with zero fresh compiles after warmup."""
+    cfg, params, gen = model
+    eng = _build(gen, params, mesh=mesh22, kv_shard="heads+seq",
+                 horizon=8)
+    assert eng.mesh_world == 4 and eng.sp_world == 2
+    assert eng.bm.shards == 2          # partitions = SP world, not 4
+    eng.warmup()
+    flat = eng.metrics.compile_misses
+    got = _serve(eng, _requests(cfg))
+    assert got == oracle
+    assert eng.metrics.compile_misses == flat, (
+        eng.metrics.summary()["compilation"])
+
+
+def test_mesh_seq_spec_oracle(model, mesh2):
+    """Speculative rounds under kv_shard='seq' (the spec x seq
+    rejection this PR deletes): the 4D-q SP combine runs the
+    multi-token verify over block-sharded pools, and greedy + sampled
+    streams equal the draft-less world-1 run."""
+    cfg, params, gen = model
+    rng = np.random.default_rng(3)
+    reqs = [Request("a", rng.integers(0, cfg.vocab, 9).astype(np.int32),
+                    SamplingParams(max_new_tokens=8)),
+            Request("b", rng.integers(0, cfg.vocab, 12).astype(np.int32),
+                    SamplingParams(max_new_tokens=8, temperature=0.8,
+                                   top_k=16, seed=11))]
+
+    def run(mesh, kv_shard, **kw):
+        eng = _build(gen, params, mesh=mesh, kv_shard=kv_shard, **kw)
+        eng.warmup()
+        for r in reqs:
+            eng.submit(r)
+        outs = eng.run()
+        return ({k: v.token_ids for k, v in outs.items()},
+                eng.metrics.spec_rounds)
+
+    want, _ = run(None, "heads")
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    draft = Generator(cfg, mesh1, axis="sp", max_seq=64)
+    got, rounds = run(mesh2, "seq", draft=draft, draft_params=params,
+                      spec_k=4)
+    assert got == want
+    assert rounds > 0
+
+
 def test_mesh_prefix_cache_warm_hit(model, mesh4):
     """A shared system prompt hits the content index on a mesh engine
     exactly like world-1: the second request's prefill skips the cached
@@ -365,6 +441,46 @@ def test_mesh_restore_seq_shapes_chaos(model, tmp_path, mesh4, mesh2):
     assert r.metrics.restored_in_place == 0
 
 
+def test_mesh_restore_2d_to_world1_and_heads(model, tmp_path, mesh22,
+                                             mesh4):
+    """2D snapshot legs (fast tier — the tentpole's recovery story):
+    heads+seq/2x2 -> world-1 and -> heads/4 both adopt IN PLACE (pools
+    are saved global; both targets are partition-free), streams
+    bit-exact either way."""
+    r = _snap_crash_restore(model, tmp_path, mesh22, "heads+seq", None,
+                            "heads", "2d_to_w1")
+    assert r.metrics.restored_in_place == 2
+    r = _snap_crash_restore(model, tmp_path, mesh22, "heads+seq", mesh4,
+                            "heads", "2d_to_h4")
+    assert r.metrics.restored_in_place == 2
+
+
+@pytest.mark.slow
+def test_mesh_restore_2d_layout_pairs(model, tmp_path, mesh22, mesh2,
+                                      mesh4):
+    """The remaining heads+seq layout pairs: into a COMPATIBLE seq
+    partitioning (sp world 2 -> seq world 2: same block partition map)
+    restore adopts in place, and so does seq/4 -> 2D/sp2 (4 partitions
+    REFINE 2 — every old placement is legal under the coarser map);
+    2D/sp2 -> seq/4 goes the other way, breaks placement, and every
+    row re-queues through exact recompute; world-1 -> 2D re-queues too
+    (unpartitioned tables).  Streams are bit-exact on every leg."""
+    r = _snap_crash_restore(model, tmp_path, mesh22, "heads+seq", mesh2,
+                            "seq", "2d_to_s2")
+    assert r.metrics.restored_in_place == 2
+    r = _snap_crash_restore(model, tmp_path, mesh4, "seq", mesh22,
+                            "heads+seq", "s4_to_2d")
+    assert r.metrics.restored_in_place == 2
+    r = _snap_crash_restore(model, tmp_path, mesh22, "heads+seq", mesh4,
+                            "seq", "2d_to_s4")
+    assert r.metrics.restored_requeued == 2
+    assert r.metrics.restored_in_place == 0
+    r = _snap_crash_restore(model, tmp_path, None, "heads", mesh22,
+                            "heads+seq", "w1_to_2d")
+    assert (r.metrics.restored_in_place
+            + r.metrics.restored_requeued) == 2
+
+
 # ---------------------------------------------------------------------------
 # Slow tier: spec rounds on a mesh, horizon sweep, live migration
 # ---------------------------------------------------------------------------
@@ -390,17 +506,65 @@ def test_mesh_spec_oracle(model, oracle, mesh4):
 
 
 @pytest.mark.slow
-def test_mesh_horizon_sweep(model, oracle, mesh2):
-    """Horizon in {1, 8} x kv_shard in {heads, seq} all equal the
-    oracle (the H=1 heads case and seq H=8 — the fast tests cover the
-    other diagonal)."""
+def test_mesh_horizon_sweep(model, oracle, mesh2, mesh22):
+    """Horizon in {1, 8} x kv_shard in {heads, seq, heads+seq} all
+    equal the oracle (the fast tests cover the other diagonal: heads
+    H=8, heads+seq H=8)."""
     cfg, params, gen = model
-    for kv_shard, horizon in (("heads", 1), ("seq", 8)):
-        eng = _build(gen, params, mesh=mesh2, kv_shard=kv_shard,
+    for mesh, kv_shard, horizon in ((mesh2, "heads", 1),
+                                    (mesh2, "seq", 8),
+                                    (mesh22, "heads+seq", 1)):
+        eng = _build(gen, params, mesh=mesh, kv_shard=kv_shard,
                      horizon=horizon)
         eng.warmup()
         got = _serve(eng, _requests(cfg))
         assert got == oracle, (kv_shard, horizon)
+
+
+@pytest.mark.slow
+def test_mesh_2d_spec_oracle(model, oracle, mesh22):
+    """Fused speculative rounds on the 2D mesh: verify + decode legs
+    run head-sharded TP x block-sharded SP (the 4D-q combine under
+    both axes at once), draft replicated — streams bit-identical to
+    the draft-less world-1 oracle."""
+    cfg, params, gen = model
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    draft = Generator(cfg, mesh1, axis="sp", max_seq=64)
+    eng = _build(gen, params, mesh=mesh22, kv_shard="heads+seq",
+                 draft=draft, draft_params=params, spec_k=4)
+    eng.warmup()
+    flat = eng.metrics.compile_misses
+    got = _serve(eng, _requests(cfg))
+    assert got == oracle
+    assert eng.metrics.compile_misses == flat
+    assert eng.metrics.spec_rounds > 0
+
+
+@pytest.mark.slow
+def test_mesh_2d_prefix_warm_hit(model, mesh22):
+    """Warm prefix hits on the 2D mesh: the shared pages span BOTH sp
+    partitions and carry tp-local head shards; the masked-psum gather
+    re-assembles them and the warm streams stay bit-exact."""
+    cfg, params, gen = model
+    shared = np.arange(40, dtype=np.int32) % cfg.vocab
+    tails = [np.array([1, 2, 3], np.int32), np.array([4, 5, 6], np.int32)]
+
+    def run(mesh, kv_shard):
+        eng = ServeEngine(gen, params, num_blocks=24, page_size=8,
+                          max_batch=1, prefill_chunk=8, mesh=mesh,
+                          kv_shard=kv_shard)
+        eng.warmup()
+        outs = {}
+        for i, t in enumerate(tails):
+            eng.submit(Request(f"s{i}", np.concatenate([shared, t]),
+                               SamplingParams(max_new_tokens=6)))
+            outs.update({k: v.token_ids for k, v in eng.run().items()})
+        return outs, eng.metrics.prefix_skipped_tokens
+
+    want, _ = run(None, "heads")
+    got, skipped = run(mesh22, "heads+seq")
+    assert got == want
+    assert skipped >= 8
 
 
 @pytest.mark.slow
@@ -456,24 +620,58 @@ def test_mesh_drain_migrates_to_world1(model, mesh4):
     assert got == want
 
 
+@pytest.mark.slow
+def test_mesh_drain_2d_layout_pairs(model, mesh22, mesh2):
+    """Live migration off (and onto) the 2D mesh: heads+seq/2x2 drains
+    mid-stream into a world-1 adopter AND into a seq/2 adopter (same
+    partition map: in-place KV adopt); a heads/2 source drains INTO a
+    2D adopter — continued streams bit-exact on every leg."""
+    cfg, params, gen = model
+    p = np.arange(14, dtype=np.int32) % cfg.vocab
+    want_eng = _build(gen, params)
+    want_eng.submit(Request("m", p, SamplingParams(max_new_tokens=12)))
+    want = want_eng.run()["m"].token_ids
+
+    legs = [(mesh22, "heads+seq", None, "heads"),
+            (mesh22, "heads+seq", mesh2, "seq"),
+            (mesh2, "heads", mesh22, "heads+seq")]
+    for src_mesh, src_shard, dst_mesh, dst_shard in legs:
+        src = _build(gen, params, mesh=src_mesh, kv_shard=src_shard)
+        src.submit(Request("m", p, SamplingParams(max_new_tokens=12)))
+        for _ in range(6):
+            src.step()
+        manifest = src.drain(["m"])
+        kw = ({} if dst_mesh is None
+              else dict(mesh=dst_mesh, kv_shard=dst_shard))
+        dst = _build(gen, params, **kw)
+        res = dst.migrate_in(manifest)
+        assert res["adopted"] == ["m"], (src_shard, dst_shard)
+        got = dst.run()["m"].token_ids
+        assert got == want, (src_shard, dst_shard)
+
+
 def test_mesh_floor_present():
     """PERF_FLOORS.json carries the serve_mesh_zero_loss correctness
-    floor at 1.0 (bench.py's mesh leg gates on it)."""
+    floor at 1.0 (bench.py's mesh leg gates on it) and its 2D twin
+    serve_mesh2d_zero_loss (the heads+seq paired-oracle leg)."""
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     floors = json.load(open(os.path.join(root, "PERF_FLOORS.json")))
     spec = floors["floors"]["serve_mesh_zero_loss"]
     assert spec["min"] == 1.0
+    spec2d = floors["floors"]["serve_mesh2d_zero_loss"]
+    assert spec2d["min"] == 1.0
 
 
-def test_heterogeneous_mesh_fleet_chaos(model, mesh2, oracle, tmp_path):
+def test_heterogeneous_mesh_fleet_chaos(model, mesh22, oracle, tmp_path):
     """Fleet replicas on DIFFERENT mesh shapes behind one controller
-    (the ROADMAP #1 open follow-up): r0 is a 2-device kv_shard="heads"
-    mesh engine, r1 a plain world-1 engine.  Kill the mesh replica
-    mid-decode: every stream (migrated ones included) finishes
-    bit-identical to the world-1 oracle, the cross-replica token union
-    is exactly-once (single journal ownership, no index with two
-    values — the serve_fleet_zero_loss contract), and the mesh replica
-    restarts healthy."""
+    (the ROADMAP #1 open follow-up, upgraded to the ISSUE 19 2D
+    layout): r0 is a 2x2 kv_shard="heads+seq" mesh engine, r1 a plain
+    world-1 engine.  Kill the 2D replica mid-decode: every stream
+    (migrated ones included) finishes bit-identical to the world-1
+    oracle, the cross-replica token union is exactly-once (single
+    journal ownership, no index with two values — the
+    serve_fleet_zero_loss contract), and the 2D replica restarts
+    healthy."""
     from triton_dist_tpu.runtime.faults import FaultInjector
     from triton_dist_tpu.serve.fleet import FleetController
     from triton_dist_tpu.serve.recovery import JOURNAL_NAME, replay_journal
@@ -483,7 +681,8 @@ def test_heterogeneous_mesh_fleet_chaos(model, mesh2, oracle, tmp_path):
 
     def factory(d):
         if (os.sep + "r0" + os.sep) in d:
-            return _build(gen, params, mesh=mesh2, snapshot_dir=d,
+            return _build(gen, params, mesh=mesh22,
+                          kv_shard="heads+seq", snapshot_dir=d,
                           faults=inj if d.endswith("life1") else None)
         return _build(gen, params, snapshot_dir=d)
 

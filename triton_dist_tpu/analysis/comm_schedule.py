@@ -592,3 +592,106 @@ def build_sp_decode(world: int) -> CommSchedule:
         ops.append(Op("write", step=0, buf="final", slot=0,
                       label=("combined", me), final=True))
     return sched
+
+
+# ---------------------------------------------------------------------------
+# hier_sp_combine — two-phase (fast x slow) hierarchical SP combine
+# ---------------------------------------------------------------------------
+
+
+def _smallest_prime_factor(n: int) -> int:
+    p = 2
+    while p * p <= n:
+        if n % p == 0:
+            return p
+        p += 1
+    return n
+
+
+@schedule_builder("hier_sp_combine")
+def build_hier_sp_combine(world: int) -> CommSchedule:
+    """The hierarchical two-phase LSE combine behind the 2D serving
+    mesh (serve/mesh.py ``kv_shard="heads+seq"``): partials merge first
+    inside a FAST group (ICI-near neighbours: ``fast`` = the smallest
+    prime factor of ``world``), then the per-group merged planes ride a
+    second fcollect across the SLOW axis (``slow = world // fast``)
+    and the final merge combines them.  Each phase is one
+    :func:`build_sp_decode`-shaped fcollect round restricted to its
+    subgroup — rank ``me = g*fast + l`` gathers over ``l`` in phase 1
+    and over ``g`` in phase 2.  LSE merging is associative, so the
+    two-phase result is bit-wise the flat combine's up to the merge
+    order the schedule fixes.  Prime worlds (3, 5, 7...) have
+    ``slow == 1`` and degenerate to the single flat phase — the builder
+    must stay correct there, not just on the pow2 grid.
+    """
+    fast = _smallest_prime_factor(world)
+    slow = world // fast
+    sched = CommSchedule("hier_sp_combine", world,
+                         [[] for _ in range(world)],
+                         meta={"fast": fast, "slow": slow})
+    for me in range(world):
+        sched.init.append((me, "plane", 0, ("partial", me)))
+    sched.outputs = {"gath1": fast, "final": 1}
+    if slow > 1:
+        sched.outputs.update({"mid": 1, "gath2": slow})
+    for me in range(world):
+        g, l = divmod(me, fast)
+        ops = sched.ranks[me]
+        # ---- phase 1: fcollect + merge inside the fast group -------
+        # entry barrier over the fast group only (the slow peers'
+        # buffers are untouched until phase 2).
+        for i in range(1, fast):
+            ops.append(Op("signal", dst=g * fast + (l + i) % fast,
+                          sem="barrier"))
+        ops.append(Op("wait", sem="barrier", count=fast - 1))
+        for i in range(1, fast):
+            peer = g * fast + (l + i) % fast
+            ops.append(Op("send", step=0, dst=peer, src_buf="plane",
+                          src_slot=0, buf="gath1", slot=l, rsem="recv1",
+                          ssem="send1", label=("partial", me),
+                          final=True))
+        ops.append(Op("send", step=0, dst=me, src_buf="plane",
+                      src_slot=0, buf="gath1", slot=l, rsem="copy1",
+                      label=("partial", me), final=True, note="stage"))
+        ops.append(Op("wait", step=0, sem="copy1"))
+        ops.append(Op("wait", step=0, sem="send1", count=fast - 1,
+                      note="drain (quiet)"))
+        ops.append(Op("wait", step=0, sem="recv1", count=fast - 1,
+                      note="arrivals"))
+        for j in range(fast):
+            ops.append(Op("read", step=0, buf="gath1", slot=j,
+                          label=("partial", g * fast + j),
+                          note="LSE merge (fast)"))
+        if slow == 1:
+            # prime world: the fast group IS the world — phase 1's
+            # merge is already the flat combine.
+            ops.append(Op("write", step=0, buf="final", slot=0,
+                          label=("combined", me), final=True))
+            continue
+        ops.append(Op("write", step=0, buf="mid", slot=0,
+                      label=("mid", g), final=True,
+                      note="group-merged plane"))
+        # ---- phase 2: fcollect + merge across the slow axis --------
+        for i in range(1, slow):
+            ops.append(Op("signal", dst=((g + i) % slow) * fast + l,
+                          sem="barrier2"))
+        ops.append(Op("wait", sem="barrier2", count=slow - 1))
+        for i in range(1, slow):
+            peer = ((g + i) % slow) * fast + l
+            ops.append(Op("send", step=1, dst=peer, src_buf="mid",
+                          src_slot=0, buf="gath2", slot=g, rsem="recv2",
+                          ssem="send2", label=("mid", g), final=True))
+        ops.append(Op("send", step=1, dst=me, src_buf="mid",
+                      src_slot=0, buf="gath2", slot=g, rsem="copy2",
+                      label=("mid", g), final=True, note="stage"))
+        ops.append(Op("wait", step=1, sem="copy2"))
+        ops.append(Op("wait", step=1, sem="send2", count=slow - 1,
+                      note="drain (quiet)"))
+        ops.append(Op("wait", step=1, sem="recv2", count=slow - 1,
+                      note="arrivals"))
+        for j in range(slow):
+            ops.append(Op("read", step=1, buf="gath2", slot=j,
+                          label=("mid", j), note="LSE merge (slow)"))
+        ops.append(Op("write", step=1, buf="final", slot=0,
+                      label=("combined", me), final=True))
+    return sched

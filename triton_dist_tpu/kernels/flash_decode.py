@@ -779,8 +779,8 @@ def _decode_kernel_paged(lens_ref, table_ref, q_ref, k_ref, v_ref, out_ref,
 
 def sp_gqa_decode_paged_shard(q, k_pool, v_pool, block_table, kv_lens, *,
                               axis, impl="auto", interpret=False,
-                              soft_cap=0.0, window=0, k_scale=None,
-                              v_scale=None):
+                              soft_cap=0.0, window=0, q_lens=None,
+                              k_scale=None, v_scale=None):
     """Per-device SP decode over a paged cache: each rank's pool holds
     the pages of ITS sequence shard and ``block_table`` [B, n_local]
     holds local pool indices for the rank's logical pages.  ``kv_lens``
@@ -788,12 +788,16 @@ def sp_gqa_decode_paged_shard(q, k_pool, v_pool, block_table, kv_lens, *,
     rank (the contiguous-cache rule with S_loc = n_local * page).
     ``k_scale``/``v_scale`` [N, Hkv, page] dequantize int8 pools — each
     rank's scale plane shards with its pages, the combine is unchanged
-    (partials are float either way)."""
-    assert q.ndim == 3, (
-        f"sp_gqa_decode_paged_shard takes single-token q [B, Hq, D], got "
-        f"shape {q.shape}; the multi-token q / q_lens verify contract is "
-        "only wired up for the contiguous SP path (sp_gqa_decode_shard) — "
-        "its inter-rank combine does not handle [B, T, Hq, D] partials")
+    (partials are float either way).
+
+    MULTI-TOKEN (ISSUE 19 debt (a)): q may be [B, T, Hq, D] with optional
+    per-request ``q_lens`` [B] — the k-token verify over a sharded paged
+    cache.  Per-token causality under SP uses the unclipped local ``ends``
+    as ``window_lens`` (the same device kernel contract as the contiguous
+    path); [B, T, ...] partials combine like a B*T batch — dead rows carry
+    lse = NEG on every rank and merge to 0."""
+    multi = q.ndim == 4
+    B, Hq, D = q.shape[0], q.shape[-2], q.shape[-1]
     n_local = block_table.shape[1]
     s_loc = n_local * k_pool.shape[2]
     me = jax.lax.axis_index(axis)
@@ -804,8 +808,16 @@ def sp_gqa_decode_paged_shard(q, k_pool, v_pool, block_table, kv_lens, *,
                                       local_lens, impl=impl,
                                       interpret=interpret,
                                       soft_cap=soft_cap, window=window,
-                                      window_lens=ends if window else None,
+                                      window_lens=ends if (window or multi)
+                                      else None,
+                                      q_lens=q_lens,
                                       k_scale=k_scale, v_scale=v_scale)
+    if multi:
+        T = out.shape[1]
+        c = _combine_across_ranks(out.reshape(B * T, Hq, D),
+                                  lse.reshape(B * T, Hq), q.dtype,
+                                  axis=axis, impl=impl, interpret=interpret)
+        return c.reshape(B, T, Hq, D)
     return _combine_across_ranks(out, lse, q.dtype, axis=axis, impl=impl,
                                  interpret=interpret)
 

@@ -580,7 +580,7 @@ def _chunk_forward(params, chunk, caches, prefix_len, *, cfg: LlamaConfig,
                    quantized: bool, ffn=None, out_proj=None,
                    extent: int | None = None,
                    n_valid=None, impl: str = "auto", interpret: bool = False,
-                   mesh=None, axis=None):
+                   mesh=None, axis=None, attend=None):
     """One prompt chunk [B, c] against the cached prefix; returns
     (new_caches, logits [B, c, V] — position i predicts the token after
     chunk[:, i]).  The chunk's own K/V are written to the cache first
@@ -607,11 +607,24 @@ def _chunk_forward(params, chunk, caches, prefix_len, *, cfg: LlamaConfig,
     (serve/mesh.py's head-sharded chunk prefill — there ``mesh``/
     ``axis`` stay None because the TP caller is already inside its own
     ``shard_map`` and the per-rank cache is head-local, not
-    sequence-sharded)."""
+    sequence-sharded).
+
+    ``attend`` overrides the whole prefix-attention read:
+    ``attend(q, k_view, v_view, prefix_len, k_scale=, v_scale=)`` on
+    the extent-bounded cache views (scale views None unless
+    ``quantized``).  serve/mesh.py's sequence-sharded chunk prefill
+    supplies one that slices the rank-local span out of the views and
+    LSE-combines across ranks — the K/V write above it stays whole, so
+    cache contents never depend on the layout."""
     if ffn is None:
         ffn = _dense_prompt_ffn
     if out_proj is None:
         out_proj = _default_out_proj
+    if attend is None:
+        attend = functools.partial(_attend_prefix, impl=impl,
+                                   interpret=interpret, mesh=mesh,
+                                   axis=axis, window=cfg.attn_window,
+                                   soft_cap=cfg.attn_soft_cap)
     B, c = chunk.shape
     hd = cfg.head_dim
     x = params["embed"][chunk]                       # [B, c, D]
@@ -641,20 +654,12 @@ def _chunk_forward(params, chunk, caches, prefix_len, *, cfg: LlamaConfig,
         new_caches.append((k_c, v_c))
         ext = extent or (k_c["q"] if quantized else k_c).shape[2]
         if quantized:
-            o = _attend_prefix(q, k_c["q"][:, :, :ext],
-                               v_c["q"][:, :, :ext], prefix_len,
-                               k_scale=k_c["s"][:, :, :ext],
-                               v_scale=v_c["s"][:, :, :ext],
-                               impl=impl, interpret=interpret,
-                               mesh=mesh, axis=axis,
-                               window=cfg.attn_window,
-                               soft_cap=cfg.attn_soft_cap)
+            o = attend(q, k_c["q"][:, :, :ext], v_c["q"][:, :, :ext],
+                       prefix_len, k_scale=k_c["s"][:, :, :ext],
+                       v_scale=v_c["s"][:, :, :ext])
         else:
-            o = _attend_prefix(q, k_c[:, :, :ext], v_c[:, :, :ext],
-                               prefix_len, impl=impl, interpret=interpret,
-                               mesh=mesh, axis=axis,
-                               window=cfg.attn_window,
-                               soft_cap=cfg.attn_soft_cap)
+            o = attend(q, k_c[:, :, :ext], v_c[:, :, :ext], prefix_len,
+                       k_scale=None, v_scale=None)
         o = o.reshape(B * c, cfg.n_heads * hd).astype(cfg.dtype)
         x = x + out_proj(o, layer).reshape(B, c, cfg.dim)
         h2 = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps).reshape(

@@ -721,6 +721,7 @@ class ServeEngine:
     def __init__(self, gen: Generator, params, *, num_blocks: int,
                  page_size: int, max_batch: int = 8,
                  mesh=None, tp_axis: str = "tp",
+                 sp_axis: str = "sp",
                  kv_shard: str = "heads",
                  w8a8: bool = False,
                  prefill_chunk: int = 64,
@@ -778,36 +779,46 @@ class ServeEngine:
                 "(recorded follow-up, ROADMAP #3): the draft/verify "
                 "round's target forwards are unhooked — serve with "
                 "spec_k=0 or float weights")
-        if self.w8a8 and mesh is not None and kv_shard == "seq":
+        if self.w8a8 and mesh is not None and kv_shard != "heads":
             raise ValueError(
                 "w8a8 is a tensor-parallel weight layout: supported "
-                "world-1 and kv_shard='heads' (the seq layout keeps "
-                "replicated float weights; recorded follow-up)")
+                "world-1 and kv_shard='heads' (the seq and heads+seq "
+                "layouts keep float weights on their sp bodies; "
+                "recorded follow-up)")
         cfg = gen.cfg
         # mesh serving (docs/serving.md "Sharded serving"): with mesh=,
         # every device program below is rebuilt as a shard_map over the
-        # tp_axis — TP weights + head-sharded pools (kv_shard="heads")
-        # or replicated weights + block-sharded pools with SP
-        # flash-decode (kv_shard="seq").  Geometry that cannot divide
-        # the mesh is rejected HERE, loudly, instead of as a shape
-        # error inside a traced forward.
+        # tp_axis — TP weights + head-sharded pools (kv_shard="heads"),
+        # replicated weights + block-sharded pools with SP flash-decode
+        # (kv_shard="seq"), or BOTH on a 2D mesh (kv_shard="heads+seq":
+        # heads over tp_axis, blocks over sp_axis).  Geometry that
+        # cannot divide the mesh is rejected HERE, loudly, instead of
+        # as a shape error inside a traced forward.
         self.mesh = mesh
         self.tp_axis = tp_axis
+        self.sp_axis = sp_axis
         self.kv_shard = kv_shard
         self.mesh_world = 1
+        self.sp_world = 1
         self._pool_sharding = None
-        if mesh is None and kv_shard not in ("heads", "seq"):
+        if mesh is None and kv_shard not in ("heads", "seq",
+                                             "heads+seq"):
             # validated even off-mesh: a typo'd layout must not ride
             # silently until a mesh= is added later
             raise ValueError(
-                f"kv_shard must be 'heads' or 'seq', got {kv_shard!r}")
+                f"kv_shard must be 'heads', 'seq' or 'heads+seq', "
+                f"got {kv_shard!r}")
         if mesh is not None:
             from triton_dist_tpu.serve import mesh as serve_mesh
 
             self.mesh_world = serve_mesh.validate_mesh_geometry(
                 mesh=mesh, tp_axis=tp_axis, kv_shard=kv_shard, cfg=cfg,
                 max_seq=gen.max_seq, num_blocks=num_blocks,
-                page_size=page_size, spec_k=spec_k)
+                page_size=page_size, spec_k=spec_k, sp_axis=sp_axis)
+            if kv_shard == "seq":
+                self.sp_world = self.mesh_world
+            elif kv_shard == "heads+seq":
+                self.sp_world = int(mesh.shape[sp_axis])
             if spec_k and not spec_fused:
                 raise ValueError(
                     "mesh serving fuses every speculative round into "
@@ -852,9 +863,10 @@ class ServeEngine:
         # owns pool rows [r*NB/W, (r+1)*NB/W) = the pages of its
         # sequence span); the allocator places every logical page in
         # its owner's partition and reserves one null block per
-        # partition (serve/block_manager.py).
-        seq_shards = (self.mesh_world
-                      if mesh is not None and kv_shard == "seq" else 1)
+        # partition (serve/block_manager.py).  Under "heads+seq" the
+        # partition count is the SP world — the tp axis splits heads
+        # inside each block, never the block-id space.
+        seq_shards = self.sp_world
         self.bm = BlockManager(num_blocks, page_size, faults=faults,
                                prefix_cache=self.prefix_cache,
                                shards=seq_shards,
@@ -1123,7 +1135,8 @@ class ServeEngine:
                 draft=draft, draft_params=draft_params,
                 spec_fused=bool(spec_k) and self.spec_fused,
                 prefix_cache=self.prefix_cache,
-                kv_quant=self.kv_quant, w8a8=self.w8a8)
+                kv_quant=self.kv_quant, w8a8=self.w8a8,
+                sp_axis=sp_axis)
             self._mesh_progs = progs
             self._pool_sharding = NamedSharding(mesh, progs["pool_spec"])
             # Weights live TP-sharded (heads) / replicated (seq) on the

@@ -26,11 +26,22 @@ forward.  Two KV layouts:
   through ``sp_gqa_decode_paged_shard`` (per-rank local lengths + the
   LSE combine) with the rank's slice of the block table rebased to
   local pool rows.  Weights stay replicated (the decode-serving layout
-  of models/generate.py: the sharded thing is the KV cache).
-  Speculative engines are REJECTED at construction — the paged SP
-  combine only merges single-token partials (the loud assert
-  tests/test_serve_engine.py pins), and a verify chunk is multi-token
-  by definition.
+  of models/generate.py: the sharded thing is the KV cache).  Since
+  ISSUE 19 the layout is first-class: the paged SP combine merges
+  queries×heads 4D partials, so multi-token verify — and therefore
+  speculative decode — runs under seq, and chunked prefill attends
+  over the rank-local slice of the scratch (per-shard partials + the
+  same LSE combine) instead of computing replicated.
+- ``kv_shard="heads+seq"`` — the 2D composition (ISSUE 19): one
+  ``Mesh((tp, sp))`` where weights and attention heads shard on the
+  ``tp`` axis (psum only at the out-proj/FFN row-parallel seams,
+  exactly the heads layout) while the paged pools and the partitioned
+  BlockManager shard on the block axis over ``sp`` (partition count =
+  sp world, NOT total world).  Every per-shard body is the seq body
+  with the TP seams threaded through (``fwd_cfg``/``ffn``/
+  ``out_proj``), so attention runs per-rank over (local heads × local
+  blocks) and combines on ``sp`` only — KV capacity (sp) and per-step
+  latency (tp) scale on independent axes.
 
 **The executable-cache fork (the PR-7 problem, solved here).**  A
 mesh-placed program's outputs carry ``NamedSharding`` while host-built
@@ -63,8 +74,15 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from triton_dist_tpu.kernels.flash_decode import sp_gqa_decode_paged_shard
-from triton_dist_tpu.models.generate import _chunk_forward, _token_forward
+from triton_dist_tpu.kernels.flash_decode import (
+    sp_gqa_decode_paged_shard,
+    sp_gqa_decode_shard,
+)
+from triton_dist_tpu.models.generate import (
+    _chunk_forward,
+    _multitoken_forward,
+    _token_forward,
+)
 from triton_dist_tpu.models.llama import param_specs
 from triton_dist_tpu.runtime import jit_cache
 
@@ -74,21 +92,77 @@ from triton_dist_tpu.runtime import jit_cache
 # ---------------------------------------------------------------------------
 
 
-KV_SHARDS = ("heads", "seq")
+KV_SHARDS = ("heads", "seq", "heads+seq")
+
+
+def _check_heads_geometry(cfg, world, kv_shard, label):
+    """The heads-TP divisibility rules, parameterized over the axis
+    label so a 2D rejection names WHICH axis failed."""
+    if cfg.n_kv_heads % world:
+        raise ValueError(
+            f"kv_shard={kv_shard!r} needs n_kv_heads ({cfg.n_kv_heads}) "
+            f"divisible by the {label} ({world}) — each rank "
+            f"must own whole KV heads of the paged pools")
+    if cfg.n_heads % world:
+        raise ValueError(
+            f"kv_shard={kv_shard!r} needs n_heads ({cfg.n_heads}) "
+            f"divisible by the {label} ({world}) — the "
+            f"column-parallel QKV split assigns whole query heads "
+            f"per rank")
+    if cfg.ffn_dim % world:
+        raise ValueError(
+            f"TP weights need ffn_dim ({cfg.ffn_dim}) divisible by "
+            f"the {label} ({world}) — wgate/wup shard by "
+            f"columns, wdown by rows")
+
+
+def _check_seq_geometry(max_seq, num_blocks, page_size, world, kv_shard,
+                        label):
+    """The seq-SP divisibility rules, axis-labeled like the heads
+    twin."""
+    n_pages = max_seq // page_size
+    if n_pages % world:
+        raise ValueError(
+            f"kv_shard={kv_shard!r} needs max_seq/page_size ({n_pages} "
+            f"logical pages) divisible by the {label} ({world}) "
+            f"— each rank owns a contiguous span of "
+            f"{n_pages}//{world} logical pages")
+    if num_blocks % world:
+        raise ValueError(
+            f"kv_shard={kv_shard!r} needs num_blocks ({num_blocks}) "
+            f"divisible by the {label} ({world}) — the pool "
+            f"splits into equal per-rank partitions")
+    if num_blocks // world < 2:
+        raise ValueError(
+            f"kv_shard={kv_shard!r} needs num_blocks//world >= 2 "
+            f"({num_blocks}//{world} = {num_blocks // world}): "
+            f"every partition reserves its own null block and "
+            f"still needs at least one allocatable page")
+    if page_size % world:
+        raise ValueError(
+            f"kv_shard={kv_shard!r} needs page_size ({page_size}) "
+            f"divisible by the {label} ({world}) — the sharded "
+            f"chunked-prefill attend splits every scratch-extent rung "
+            f"(a page multiple) into equal per-rank row spans")
 
 
 def validate_mesh_geometry(*, mesh, tp_axis, kv_shard, cfg, max_seq,
-                           num_blocks, page_size, spec_k=0) -> int:
+                           num_blocks, page_size, spec_k=0,
+                           sp_axis=None) -> int:
     """Reject impossible (mesh, engine-geometry) combinations with a
     loud ``ValueError`` at CONSTRUCTION — the alternative is a shape
     error deep inside a traced forward, long after the caller can tell
-    which knob was wrong.  Returns the mesh world size along
-    ``tp_axis``."""
+    which knob was wrong.  Returns the TOTAL mesh world the layout
+    spans: the size along ``tp_axis`` for the 1-axis layouts, tp × sp
+    for ``"heads+seq"`` (the 2D rejection matrix names which axis a
+    failed divisibility belongs to).  ``spec_k`` rides along for
+    API stability only — speculative decode serves every layout since
+    the 4D-q SP combine landed (ISSUE 19)."""
+    del spec_k  # spec × seq works now: the combine merges 4D partials
     if tp_axis not in mesh.axis_names:
         raise ValueError(
             f"tp_axis {tp_axis!r} is not an axis of the mesh "
-            f"{mesh.axis_names}; ServeEngine shards over exactly one "
-            f"named mesh axis")
+            f"{mesh.axis_names}")
     if kv_shard not in KV_SHARDS:
         raise ValueError(
             f"kv_shard must be one of {KV_SHARDS}, got {kv_shard!r}")
@@ -96,48 +170,31 @@ def validate_mesh_geometry(*, mesh, tp_axis, kv_shard, cfg, max_seq,
     if world < 1:
         raise ValueError(f"mesh axis {tp_axis!r} has size {world}")
     if kv_shard == "heads":
-        if cfg.n_kv_heads % world:
+        _check_heads_geometry(cfg, world, kv_shard, "mesh world")
+    elif kv_shard == "seq":
+        _check_seq_geometry(max_seq, num_blocks, page_size, world,
+                            kv_shard, "mesh world")
+    else:  # heads+seq: the world must factor as tp x sp on NAMED axes
+        if sp_axis is None:
             raise ValueError(
-                f"kv_shard='heads' needs n_kv_heads ({cfg.n_kv_heads}) "
-                f"divisible by the mesh world ({world}) — each rank "
-                f"must own whole KV heads of the paged pools")
-        if cfg.n_heads % world:
+                "kv_shard='heads+seq' needs an sp_axis: the world must "
+                "factor as tp x sp over two named mesh axes (weights/"
+                "heads on tp, KV blocks on sp)")
+        if sp_axis not in mesh.axis_names:
             raise ValueError(
-                f"kv_shard='heads' needs n_heads ({cfg.n_heads}) "
-                f"divisible by the mesh world ({world}) — the "
-                f"column-parallel QKV split assigns whole query heads "
-                f"per rank")
-        if cfg.ffn_dim % world:
+                f"sp_axis {sp_axis!r} is not an axis of the mesh "
+                f"{mesh.axis_names}")
+        if sp_axis == tp_axis:
             raise ValueError(
-                f"TP weights need ffn_dim ({cfg.ffn_dim}) divisible by "
-                f"the mesh world ({world}) — wgate/wup shard by "
-                f"columns, wdown by rows")
-    else:  # seq
-        if spec_k:
-            raise ValueError(
-                "kv_shard='seq' cannot serve speculative engines: the "
-                "paged SP decode combine merges SINGLE-token partials "
-                "only (sp_gqa_decode_paged_shard's 3D-q contract), and "
-                "a verify chunk is multi-token by definition — use "
-                "kv_shard='heads' for spec serving on a mesh")
-        n_pages = max_seq // page_size
-        if n_pages % world:
-            raise ValueError(
-                f"kv_shard='seq' needs max_seq/page_size ({n_pages} "
-                f"logical pages) divisible by the mesh world ({world}) "
-                f"— each rank owns a contiguous span of "
-                f"{n_pages}//{world} logical pages")
-        if num_blocks % world:
-            raise ValueError(
-                f"kv_shard='seq' needs num_blocks ({num_blocks}) "
-                f"divisible by the mesh world ({world}) — the pool "
-                f"splits into equal per-rank partitions")
-        if num_blocks // world < 2:
-            raise ValueError(
-                f"kv_shard='seq' needs num_blocks//world >= 2 "
-                f"({num_blocks}//{world} = {num_blocks // world}): "
-                f"every partition reserves its own null block and "
-                f"still needs at least one allocatable page")
+                f"kv_shard='heads+seq' needs DISTINCT tp/sp axes, got "
+                f"{tp_axis!r} for both — a 1-axis mesh cannot factor "
+                f"the world as tp x sp")
+        sp = int(mesh.shape[sp_axis])
+        _check_heads_geometry(cfg, world, kv_shard,
+                              f"tp axis {tp_axis!r}")
+        _check_seq_geometry(max_seq, num_blocks, page_size, sp,
+                            kv_shard, f"sp axis {sp_axis!r}")
+        world = world * sp
     return world
 
 
@@ -258,7 +315,8 @@ def _rebase_local(ids, *, axis, world, num_blocks):
 
 def sp_paged_decode_shard(params, pools, tables, kv_lens, token, active,
                           *, cfg, page, axis, world, num_blocks,
-                          n_pages_max, impl, interpret):
+                          n_pages_max, impl, interpret, fwd_cfg=None,
+                          ffn=None, out_proj=None):
     """Sequence-sharded twin of ``engine._paged_decode_forward``:
     weights replicated, pools sharded on the BLOCK axis — rank ``r``
     holds global blocks ``[r*nb_loc, (r+1)*nb_loc)``, which the
@@ -271,7 +329,14 @@ def sp_paged_decode_shard(params, pools, tables, kv_lens, token, active,
     ``sp_gqa_decode_paged_shard`` (local lengths + LSE combine), so
     the returned logits are replicated.  Quantized pools ride through
     unchanged: ``_scatter_kv`` and ``_pool_views`` are both
-    dict-aware, and the per-page scales feed the combine's dequant."""
+    dict-aware, and the per-page scales feed the combine's dequant.
+
+    ``axis``/``world`` are the SP axis; ``fwd_cfg``/``ffn``/
+    ``out_proj`` thread the heads-TP seams through for the 2D
+    ``"heads+seq"`` layout (local-head cfg + psum hooks on the tp
+    axis) — the pool's head axis then holds the rank's local KV heads
+    and the block addressing is untouched, so ONE body serves both
+    layouts."""
     from triton_dist_tpu.serve.engine import (
         _page_slots,
         _pool_views,
@@ -306,8 +371,62 @@ def sp_paged_decode_shard(params, pools, tables, kv_lens, token, active,
             impl=impl, interpret=interpret, soft_cap=cfg.attn_soft_cap,
             window=cfg.attn_window, k_scale=ks, v_scale=vs)
 
-    return _token_forward(params, pools, token, kv_lens, cfg=cfg,
-                          write_kv=write_kv, attend=attend)
+    return _token_forward(params, pools, token, kv_lens,
+                          cfg=fwd_cfg or cfg, write_kv=write_kv,
+                          attend=attend, ffn=ffn, out_proj=out_proj)
+
+
+def sp_paged_verify_shard(params, pools, tables, kv_lens, chunk, active,
+                          *, cfg, page, axis, world, num_blocks,
+                          n_pages_max, impl, interpret, fwd_cfg=None,
+                          ffn=None, out_proj=None):
+    """Sequence-sharded twin of ``engine._paged_verify_forward`` — the
+    multi-token verify over block-sharded pools (ISSUE 19 debt (a):
+    this body exists because ``sp_gqa_decode_paged_shard`` now merges
+    queries×heads 4D partials).  The [B, T] write addressing is the
+    engine forward's own math with the seq rebase applied elementwise:
+    each of a row's T scatter targets redirects to the rank's local
+    null unless the rank owns that block, so a verify chunk spanning a
+    page boundary (and therefore possibly TWO ranks' partitions)
+    writes each row exactly once fleet-wide.  Attention reads back
+    through the rank's rebased table slice with GLOBAL ``kv_lens + T``
+    — per-token causality rides the combine's unclipped local ends,
+    exactly the contiguous SP verify contract.  TP seams as in
+    :func:`sp_paged_decode_shard` (the 2D layout)."""
+    from triton_dist_tpu.serve.engine import _pool_views, _scatter_kv
+
+    n_loc = n_pages_max // world
+    T = chunk.shape[1]
+    n_pages = tables.shape[1]
+    pos = kv_lens[:, None] + jnp.arange(T, dtype=jnp.int32)[None]  # [B, T]
+    logical = jnp.minimum(pos // page, n_pages - 1)
+    pool_row_g = jnp.take_along_axis(tables, logical, axis=1)      # [B, T]
+    in_page = pos % page
+    mine, pool_row = _rebase_local(pool_row_g, axis=axis, world=world,
+                                   num_blocks=num_blocks)
+    mine = mine & active[:, None]
+    pool_row = jnp.where(mine, pool_row, 0)
+    in_page = jnp.where(mine, in_page, 0)
+
+    def write_kv(li, pool, k, v):
+        return _scatter_kv(pool, k, v, pool_row, in_page)
+
+    me = jax.lax.axis_index(axis)
+    lt = jax.lax.dynamic_slice_in_dim(tables, me * n_loc, n_loc, axis=1)
+    _, lt = _rebase_local(lt, axis=axis, world=world,
+                          num_blocks=num_blocks)
+
+    def attend(li, q, pool):
+        kq, vq, ks, vs = _pool_views(pool)
+        return sp_gqa_decode_paged_shard(
+            q, kq, vq, lt, kv_lens + T, axis=axis,
+            impl=impl, interpret=interpret, soft_cap=cfg.attn_soft_cap,
+            window=cfg.attn_window, k_scale=ks, v_scale=vs)
+
+    return _multitoken_forward(params, pools, chunk, pos,
+                               cfg=fwd_cfg or cfg, write_kv=write_kv,
+                               attend=attend, ffn=ffn,
+                               out_proj=out_proj)
 
 
 def tp_paged_decode_horizon_shard(params, pools, tables, kv_lens, token,
@@ -338,16 +457,19 @@ def sp_paged_decode_horizon_shard(params, pools, tables, kv_lens, token,
                                   base_keys, temps, top_ks, top_ps,
                                   greedy, eos_ids, *, H, all_greedy, cfg,
                                   page, axis, world, num_blocks,
-                                  n_pages_max, impl, interpret):
+                                  n_pages_max, impl, interpret,
+                                  fwd_cfg=None, ffn=None, out_proj=None):
     """The fused decode horizon over sequence-sharded pools: the same
-    scan with the SP per-step forward (local spans + LSE combine)."""
+    scan with the SP per-step forward (local spans + LSE combine).
+    TP seams thread through for the 2D layout."""
     from triton_dist_tpu.serve.engine import _paged_decode_horizon
 
     fwd = functools.partial(sp_paged_decode_shard, cfg=cfg, page=page,
                             axis=axis, world=world,
                             num_blocks=num_blocks,
                             n_pages_max=n_pages_max, impl=impl,
-                            interpret=interpret)
+                            interpret=interpret, fwd_cfg=fwd_cfg,
+                            ffn=ffn, out_proj=out_proj)
     return _paged_decode_horizon(
         params, pools, tables, kv_lens, token, active, eos_done, limits,
         counts, base_keys, temps, top_ks, top_ps, greedy, eos_ids, H=H,
@@ -389,6 +511,52 @@ def tp_spec_round_shard(params, draft_params, pools, dcaches, tables,
         decode_fwd=decode_fwd, verify_fwd=verify_fwd)
 
 
+def sp_spec_round_shard(params, draft_params, pools, dcaches, tables,
+                        kv_lens, active, done, last_logits, dlast_logits,
+                        counts, limits, k_rows, base_keys, temps, top_ks,
+                        top_ps, greedy, eos_ids, *, K, all_greedy, cfg,
+                        dcfg, page, axis, world, num_blocks, n_pages_max,
+                        impl, interpret, dimpl, dinterpret, fwd_cfg=None,
+                        ffn=None, out_proj=None):
+    """The fused speculative round over sequence-sharded pools (ISSUE 19
+    debt (a) unlocked this: the 4D-q SP combine lets the verify leg run
+    under ``seq``).  Target decode/verify use the SP bodies — local
+    pool spans + LSE combine — while the draft steps stay REPLICATED
+    per rank for the same host-managed-cache reason as the heads
+    layout; accept/sampling math runs on replicated logits.  TP seams
+    (``fwd_cfg``/``ffn``/``out_proj``) thread into the target legs for
+    ``heads+seq``; the draft is NEVER head-sharded (its cfg would need
+    its own local view for marginal win)."""
+    from triton_dist_tpu.serve.engine import (
+        _draft_decode_forward,
+        _spec_round_fused,
+    )
+
+    decode_fwd = functools.partial(sp_paged_decode_shard, cfg=cfg,
+                                   page=page, axis=axis, world=world,
+                                   num_blocks=num_blocks,
+                                   n_pages_max=n_pages_max,
+                                   impl=impl, interpret=interpret,
+                                   fwd_cfg=fwd_cfg, ffn=ffn,
+                                   out_proj=out_proj)
+    verify_fwd = functools.partial(sp_paged_verify_shard, cfg=cfg,
+                                   page=page, axis=axis, world=world,
+                                   num_blocks=num_blocks,
+                                   n_pages_max=n_pages_max,
+                                   impl=impl, interpret=interpret,
+                                   fwd_cfg=fwd_cfg, ffn=ffn,
+                                   out_proj=out_proj)
+    draft_step = functools.partial(_draft_decode_forward, cfg=dcfg,
+                                   impl=dimpl, interpret=dinterpret)
+    return _spec_round_fused(
+        params, draft_params, pools, dcaches, tables, kv_lens, active,
+        done, last_logits, dlast_logits, counts, limits, k_rows,
+        base_keys, temps, top_ks, top_ps, greedy, eos_ids, K=K,
+        all_greedy=all_greedy, cfg=cfg, page=page, impl=impl,
+        interpret=interpret, draft_step=draft_step,
+        decode_fwd=decode_fwd, verify_fwd=verify_fwd)
+
+
 def tp_chunk_forward_shard(params, chunk, caches, prefix_len, n_valid, *,
                            cfg, extent, axis, world, impl, interpret,
                            quantized=False, ffn=None, out_proj=None):
@@ -412,14 +580,58 @@ def tp_chunk_forward_shard(params, chunk, caches, prefix_len, n_valid, *,
 def rep_chunk_forward_shard(params, chunk, caches, prefix_len, n_valid,
                             *, cfg, extent, impl, interpret,
                             quantized=False):
-    """Replicated chunked prefill (the seq layout, and the draft model
-    under a heads mesh): every rank runs the identical world-1 chunk
-    forward — prefill compute does not shard here, only the page
-    scatter downstream does (kv_shard='seq' exists for the DECODE
-    attention scaling; docs/serving.md records the trade)."""
+    """Replicated chunked prefill (the DRAFT model under any mesh):
+    every rank runs the identical world-1 chunk forward.  The target
+    model no longer rides this under ``kv_shard='seq'`` — ISSUE 19
+    debt (b) moved it to :func:`sp_chunk_forward_shard`."""
     return _chunk_forward(params, chunk, caches, prefix_len, cfg=cfg,
                           quantized=quantized, extent=extent,
                           n_valid=n_valid, impl=impl, interpret=interpret)
+
+
+def sp_chunk_forward_shard(params, chunk, caches, prefix_len, n_valid,
+                           *, cfg, extent, axis, world, impl, interpret,
+                           quantized=False, fwd_cfg=None, ffn=None,
+                           out_proj=None):
+    """Sequence-sharded chunked prefill (ISSUE 19 debt (b)): the chunk's
+    QKV/FFN math and the scratch K/V WRITE stay replicated — the
+    partitioned allocator's page→partition map does not align with an
+    even row-split of an extent-``m`` scratch, so the scratch must hold
+    the whole extent on every rank for the downstream page scatter —
+    but the O(c·extent) attention read, the term that dominates long
+    prompts, now shards: each rank slices its ``extent/world`` span out
+    of the cache view (geometry guarantees ``page_size % world``, and
+    every ladder rung is a page multiple, so the split is exact) and
+    attends via ``sp_gqa_decode_shard``; the partials LSE-combine over
+    ``axis``.  The causal rule rides the combine's unclipped local ends
+    — chunk row ``i`` sees positions ``<= prefix + i`` exactly as the
+    dense mask does, and padded K rows (``n_valid``) stay hidden the
+    same way they do in world-1.  TP seams (``fwd_cfg``/``ffn``/
+    ``out_proj``) thread through for ``heads+seq``, where the scratch's
+    head axis is already the rank's local shard."""
+    me = jax.lax.axis_index(axis)
+
+    def attend(q, k_view, v_view, plen, *, k_scale=None, v_scale=None):
+        s_loc = k_view.shape[2] // world
+
+        def loc(x):
+            return (None if x is None else
+                    jax.lax.dynamic_slice_in_dim(x, me * s_loc, s_loc,
+                                                 axis=2))
+
+        B, c = q.shape[0], q.shape[1]
+        lens = jnp.full((B,), c, jnp.int32) + plen
+        return sp_gqa_decode_shard(
+            q, loc(k_view), loc(v_view), lens, axis=axis, impl="auto",
+            interpret=interpret, k_scale=loc(k_scale),
+            v_scale=loc(v_scale), soft_cap=cfg.attn_soft_cap,
+            window=cfg.attn_window).astype(jnp.float32)
+
+    return _chunk_forward(params, chunk, caches, prefix_len,
+                          cfg=fwd_cfg or cfg, quantized=quantized,
+                          ffn=ffn, out_proj=out_proj, extent=extent,
+                          n_valid=n_valid, impl=impl, interpret=interpret,
+                          attend=attend)
 
 
 # -- page scatter / gather / COW over sharded pools -------------------------
@@ -646,15 +858,21 @@ def collective_seams(cfg, *, kv_shard: str, draft_cfg=None) -> dict:
     out-proj, ``_tp_out_proj``; FFN down, ``_tp_ffn``) — 2 x n_layers
     per forward, nothing in per-rank attention, sampling, or the page
     programs.  ``kv_shard="seq"`` (SP flash-decode): one inter-rank
-    LSE-combine gather per layer in the decode forwards
-    (``sp_gqa_decode_paged_shard``), a replicated chunk prefill (no
-    collectives), and one ``psum`` in the page gather
-    (``sp_gather_pool_pages_shard`` zeroes unowned rows and psum-
-    assembles the full gather).  Spec rounds chain draft (replicated —
-    collective-free) and target forwards: K+1 target forwards for the
-    K-step draft scan + verify + closing decode... the spec round's
-    exact chain is 2 target forwards traced (verify + closing decode,
-    the draft scan is replicated), so 2x the per-forward seam count.
+    LSE-combine gather per layer in EVERY forward — decode, verify,
+    horizon AND chunked prefill, whose attention read shards since
+    ISSUE 19 debt (b) (``sp_chunk_forward_shard``) — and one ``psum``
+    in the page gather (``sp_gather_pool_pages_shard`` zeroes unowned
+    rows and psum-assembles the full gather).  Spec rounds chain draft
+    (replicated — collective-free) and target forwards: K+1 target
+    forwards for the K-step draft scan + verify + closing decode... the
+    spec round's exact chain is 2 target forwards traced (verify +
+    closing decode, the draft scan is replicated), so 2x the
+    per-forward seam count.  ``kv_shard="heads+seq"`` composes: every
+    target forward carries BOTH the 2 TP psums and the 1 SP gather per
+    layer (the axes never mix — psum on tp, all_gather on sp; the
+    schedule-level story is the ``hier_sp_combine`` two-phase proof in
+    analysis/comm_schedule.py), and the page programs keep the seq
+    layout's counts (the head axis moves no bytes between ranks).
     """
     n = cfg.n_layers
     if kv_shard == "heads":
@@ -675,19 +893,22 @@ def collective_seams(cfg, *, kv_shard: str, draft_cfg=None) -> dict:
             "draft_fill_pages": {}, "draft_load_pages": {},
         }
         return seams
-    if kv_shard == "seq":
+    if kv_shard in ("seq", "heads+seq"):
         fwd = {"all_gather": n}
+        if kv_shard == "heads+seq":
+            fwd["psum"] = 2 * n
+        spec = {k: 2 * v for k, v in fwd.items()}
         return {
             "paged_decode": dict(fwd),
             "paged_verify": dict(fwd),
             "decode_horizon": dict(fwd),
-            # seq-mode chunked prefill computes replicated (ROADMAP #1
-            # follow-up): only the page scatter shards.
-            "prefill_chunk": {},
+            # chunked prefill shards its attention read (debt (b)):
+            # same per-layer combine gather as the decode forwards.
+            "prefill_chunk": dict(fwd),
             "fill_pages": {},
             "load_pages": {"psum": 1},
             "cow_copy": {},
-            "spec_round": {"all_gather": 2 * n},
+            "spec_round": spec,
             "draft_tail_step": {},
             "draft_prefill": {}, "draft_join": {}, "draft_step": {},
             "draft_fill_pages": {}, "draft_load_pages": {},
@@ -706,7 +927,8 @@ def build_programs(*, mesh, tp_axis, kv_shard, cfg, params, page_size,
                    spec_fused: bool = False,
                    prefix_cache: bool = False,
                    kv_quant: bool = False,
-                   w8a8: bool = False) -> dict:
+                   w8a8: bool = False,
+                   sp_axis=None) -> dict:
     """All mesh device programs for one engine, keyed by the engine's
     program names (``paged_decode``, ``paged_verify``, ``fill_pages``,
     ``load_pages``, ``cow_copy``, ``decode_horizon``, ``prefill_chunk``
@@ -723,14 +945,38 @@ def build_programs(*, mesh, tp_axis, kv_shard, cfg, params, page_size,
     engine rejects it elsewhere) swaps ``param_specs`` for
     ``w8a8_serve_param_specs`` and the TP reduction seams for the
     quantized serving hooks: same one-psum-per-seam shape, int8
-    contraction inside."""
+    contraction inside.
+
+    ``kv_shard="heads+seq"`` composes the two layouts on a 2D mesh:
+    params/scratch shard their head axes on ``tp_axis`` exactly as the
+    heads layout, pools shard ``P(sp_axis, tp_axis)`` — block axis over
+    sp, head axis over tp — and every body is the SP body with the TP
+    seams (local-head cfg + psum hooks) threaded through.  The
+    BlockManager partition count is the SP world (``out["sp_world"]``),
+    not the total world."""
     axis = tp_axis
-    world = int(mesh.shape[axis])
     heads = kv_shard == "heads"
-    pool_spec = P(None, axis) if heads else P(axis)
+    two_d = kv_shard == "heads+seq"
+    if two_d:
+        tp_world = int(mesh.shape[tp_axis])
+        sp_world = int(mesh.shape[sp_axis])
+        world = tp_world * sp_world
+        sp = sp_axis
+    else:
+        world = int(mesh.shape[axis])
+        tp_world = world if heads else 1
+        sp_world = 1 if heads else world
+        sp = axis
+    if heads:
+        pool_spec = P(None, axis)
+    elif two_d:
+        pool_spec = P(sp_axis, tp_axis)
+    else:
+        pool_spec = P(axis)
     kv_spec = ({"q": pool_spec, "s": pool_spec} if kv_quant
                else pool_spec)
     pools_specs = [(kv_spec, kv_spec)] * cfg.n_layers
+    sp_hooks = {}
     if heads:
         if w8a8:
             from triton_dist_tpu.models.llama_w8a8 import (
@@ -751,13 +997,21 @@ def build_programs(*, mesh, tp_axis, kv_shard, cfg, params, page_size,
         else:
             p_specs = param_specs(cfg, axis)
             hooks = {}
+    elif two_d:
+        p_specs = param_specs(cfg, tp_axis)
+        sp_hooks = {
+            "fwd_cfg": _local_cfg(cfg, tp_world),
+            "ffn": functools.partial(_tp_ffn, axis=tp_axis),
+            "out_proj": functools.partial(_tp_out_proj, axis=tp_axis),
+        }
     else:
         p_specs = replicated_like(params)
-    scratch_spec = P(None, axis) if heads else P()
+    scratch_spec = P(None, tp_axis) if (heads or two_d) else P()
     sc_spec = ({"q": scratch_spec, "s": scratch_spec} if kv_quant
                else scratch_spec)
 
-    out = {"pool_spec": pool_spec, "params_specs": p_specs, "world": world}
+    out = {"pool_spec": pool_spec, "params_specs": p_specs,
+           "world": world, "tp_world": tp_world, "sp_world": sp_world}
 
     if heads:
         decode_body = functools.partial(
@@ -780,36 +1034,42 @@ def build_programs(*, mesh, tp_axis, kv_shard, cfg, params, page_size,
             impl=impl, interpret=interpret, quantized=kv_quant, **hooks)
     else:
         decode_body = functools.partial(
-            sp_paged_decode_shard, cfg=cfg, page=page_size, axis=axis,
-            world=world, num_blocks=num_blocks, n_pages_max=n_pages_max,
-            impl=impl, interpret=interpret)
-        verify_body = None  # rejected at construction (spec x seq)
+            sp_paged_decode_shard, cfg=cfg, page=page_size, axis=sp,
+            world=sp_world, num_blocks=num_blocks,
+            n_pages_max=n_pages_max, impl=impl, interpret=interpret,
+            **sp_hooks)
+        verify_body = functools.partial(
+            sp_paged_verify_shard, cfg=cfg, page=page_size, axis=sp,
+            world=sp_world, num_blocks=num_blocks,
+            n_pages_max=n_pages_max, impl=impl, interpret=interpret,
+            **sp_hooks)
         horizon_body = functools.partial(
             sp_paged_decode_horizon_shard, cfg=cfg, page=page_size,
-            axis=axis, world=world, num_blocks=num_blocks,
-            n_pages_max=n_pages_max, impl=impl, interpret=interpret)
+            axis=sp, world=sp_world, num_blocks=num_blocks,
+            n_pages_max=n_pages_max, impl=impl, interpret=interpret,
+            **sp_hooks)
         fill_body = functools.partial(
-            sp_fill_pool_pages_shard, page=page_size, axis=axis,
-            world=world, num_blocks=num_blocks)
+            sp_fill_pool_pages_shard, page=page_size, axis=sp,
+            world=sp_world, num_blocks=num_blocks)
         load_body = functools.partial(
-            sp_gather_pool_pages_shard, page=page_size, axis=axis,
-            world=world, num_blocks=num_blocks)
+            sp_gather_pool_pages_shard, page=page_size, axis=sp,
+            world=sp_world, num_blocks=num_blocks)
         cow_body = functools.partial(
-            sp_copy_pool_block_shard, axis=axis, world=world,
+            sp_copy_pool_block_shard, axis=sp, world=sp_world,
             num_blocks=num_blocks)
         chunk_body = functools.partial(
-            rep_chunk_forward_shard, cfg=cfg, impl=impl,
-            interpret=interpret, quantized=kv_quant)
+            sp_chunk_forward_shard, cfg=cfg, axis=sp, world=sp_world,
+            impl=impl, interpret=interpret, quantized=kv_quant,
+            **sp_hooks)
 
     # (params, pools, tables, kv_lens, token/chunk, active)
     fwd_in = (p_specs, pools_specs, P(), P(), P(), P())
     out["paged_decode"] = ShardedProgram(
         decode_body, mesh, fwd_in, (pools_specs, P()),
         donate_argnums=(1,))
-    if verify_body is not None:
-        out["paged_verify"] = ShardedProgram(
-            verify_body, mesh, fwd_in, (pools_specs, P()),
-            donate_argnums=(1,))
+    out["paged_verify"] = ShardedProgram(
+        verify_body, mesh, fwd_in, (pools_specs, P()),
+        donate_argnums=(1,))
     if horizon > 1:
         out["decode_horizon"] = ShardedProgram(
             horizon_body, mesh,
@@ -840,10 +1100,19 @@ def build_programs(*, mesh, tp_axis, kv_shard, cfg, params, page_size,
         dcfg = draft.cfg
         d_specs = replicated_like(draft_params)
         dpools_specs = [(P(), P())] * dcfg.n_layers
-        spec_body = functools.partial(
-            tp_spec_round_shard, cfg=cfg, dcfg=dcfg, page=page_size,
-            axis=axis, world=world, impl=impl, interpret=interpret,
-            dimpl=draft.attn.ctx.impl, dinterpret=draft.attn.ctx.interpret)
+        if heads:
+            spec_body = functools.partial(
+                tp_spec_round_shard, cfg=cfg, dcfg=dcfg, page=page_size,
+                axis=axis, world=world, impl=impl, interpret=interpret,
+                dimpl=draft.attn.ctx.impl,
+                dinterpret=draft.attn.ctx.interpret)
+        else:
+            spec_body = functools.partial(
+                sp_spec_round_shard, cfg=cfg, dcfg=dcfg, page=page_size,
+                axis=sp, world=sp_world, num_blocks=num_blocks,
+                n_pages_max=n_pages_max, impl=impl, interpret=interpret,
+                dimpl=draft.attn.ctx.impl,
+                dinterpret=draft.attn.ctx.interpret, **sp_hooks)
         out["spec_round"] = ShardedProgram(
             spec_body, mesh,
             (p_specs, d_specs, pools_specs, dpools_specs)

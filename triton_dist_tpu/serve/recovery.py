@@ -503,6 +503,10 @@ def _capture_meta(engine, now: float, *, journal_here: bool) -> dict:
             "world": engine.mesh_world,
             "axis": engine.tp_axis,
             "kv_shard": engine.kv_shard,
+            # 2D layouts record both axes (tolerated absent by every
+            # reader — 1D and pre-mesh snapshots omit them)
+            "sp_axis": engine.sp_axis,
+            "sp_world": engine.sp_world,
         }
     if engine.spec_k and not engine._spec_off:
         # Draft-state geometry: the snapshot reader needs it to build
