@@ -495,6 +495,26 @@ def _bench_serve_disagg():
     return r["serve_disagg_zero_loss"], r["serve_disagg_itl_isolation"]
 
 
+def _bench_serve_corrupt():
+    """State-integrity chaos guardrail (scripts/bench_serve.py
+    bench_corrupt, docs/serving.md 'Durability & integrity'): the
+    network fleet under injected CORRUPTION of every artifact class —
+    a bitflipped journal line on disk, a bitflipped drain-response KV
+    blob (client-side detect → same-key retry), a bitflipped
+    migrate_in manifest (server-side counted 400 → placer fallback) —
+    with a SIGKILL on the bit-rotted replica so the crash path must
+    quarantine + salvage its journal and reconcile against the
+    delivery record.  The fraction of streams bit-identical to the
+    single-engine oracle with exactly-once delivery; 1.0 floor, same
+    contract as the other zero-loss bars: below it, corruption was
+    adopted as state or committed tokens were lost."""
+    from scripts.bench_serve import bench_corrupt
+
+    r = bench_corrupt(n_replicas=2, batch=4, prompt_len=16,
+                      new_tokens=32, dim=32)
+    return r["serve_corrupt_recovery_zero_loss"]
+
+
 def _bench_serve_kv_int8():
     """Quantized-serving capacity + fidelity (scripts/bench_serve.py
     bench_kv_int8, docs/serving.md 'Quantized serving'): the identical
@@ -741,6 +761,7 @@ def main():
     fleet_zero_loss, fleet_tps = _bench_serve_fleet()
     fleet_net_zero_loss = _bench_serve_fleet_net()
     disagg_zero_loss, disagg_itl_isolation = _bench_serve_disagg()
+    corrupt_zero_loss = _bench_serve_corrupt()
     fleet_trace_overhead = _bench_serve_fleet_trace()
     mesh_zero_loss, mesh_tps = _bench_serve_mesh()
     mesh2d_zero_loss = _bench_serve_mesh2d()
@@ -805,6 +826,13 @@ def main():
         # under a prefill burst) is INFORMATIONAL on CPU.
         "serve_disagg_zero_loss": round(disagg_zero_loss, 4),
         "serve_disagg_itl_isolation": round(disagg_itl_isolation, 4),
+        # State-integrity chaos zero-loss: exact streams / total with
+        # injected corruption of every artifact class (journal line on
+        # disk, drain-response wire blob, migrate_in manifest) plus a
+        # SIGKILL forcing journal quarantine + salvage — the ISSUE-20
+        # robustness bar: corruption degrades to re-queue + recompute,
+        # never adopted rot or lost tokens.
+        "serve_corrupt_recovery_zero_loss": round(corrupt_zero_loss, 4),
         # Fleet tracing overhead: fleet tokens/s with the full
         # observability stack (engine rings + controller ring + router
         # decision audit) over tokens/s with it all off — the

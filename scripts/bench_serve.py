@@ -857,6 +857,180 @@ def bench_fleet_net(*, n_replicas: int = 2, batch: int = 4,
     }
 
 
+def bench_corrupt(*, n_replicas: int = 2, batch: int = 4,
+                  prompt_len: int = 16, new_tokens: int = 48,
+                  dim: int = 64, n_layers: int = 2, vocab: int = 256,
+                  page_size: int = 16, seed: int = 0,
+                  warmup: bool = True,
+                  step_sleep_s: float = 0.004) -> dict:
+    """State-integrity chaos guardrail (docs/serving.md "Durability &
+    integrity"): the network fleet under injected CORRUPTION of each
+    artifact class, mid-run, with a SIGKILL on top.
+
+    Timeline: (a) replica r0's engine carries an ``integrity`` fault
+    that bitflips one journal line mid-decode (interior corruption on
+    disk); (b) once tokens flow, r1 is cooperatively drained with its
+    drain-response manifest bitflipped in flight (wire KV blob — the
+    client detects the digest mismatch and retries the SAME key, so
+    the server's cached clean manifest replays), and the re-placement
+    ``migrate_in`` manifest is bitflipped once too (the receiver
+    REJECTS with the counted 400 and the placer walks on); (c) r0 is
+    then SIGKILLed, so the crash path must SALVAGE its bit-rotted
+    journal — quarantine, longest-valid prefix, controller
+    reconciliation against the delivery record, recompute for the
+    lost tail.
+
+    ``serve_corrupt_recovery_zero_loss`` is the fraction of streams
+    bit-identical to the single-engine oracle with an exactly-once
+    delivery record across all of that.  1.0 is the only acceptable
+    reading (PERF_FLOORS.json floors it there): corruption must
+    degrade to re-queue + recompute, never to adopted rot or lost
+    tokens."""
+    import shutil
+    import tempfile
+
+    from triton_dist_tpu.models import llama
+    from triton_dist_tpu.models.generate import Generator
+    from triton_dist_tpu.runtime.faults import FaultInjector
+    from triton_dist_tpu.serve import Request, SamplingParams, ServeEngine
+    from triton_dist_tpu.serve.fleet import FleetController, RemoteReplica
+    from triton_dist_tpu.serve.net import InProcessReplica
+
+    max_seq = prompt_len + new_tokens
+    max_seq += (-max_seq) % page_size
+    cfg = llama.LlamaConfig(vocab=vocab, dim=dim, n_layers=n_layers,
+                            n_heads=2, n_kv_heads=2, ffn_dim=2 * dim,
+                            max_seq=max_seq, dtype=jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    params = llama.init_params(cfg, jax.random.key(seed))
+    gen = Generator(cfg, mesh, axis="sp", max_seq=max_seq)
+    per_req = -(-max_seq // page_size)
+    n_reqs = n_replicas * batch
+    rng = np.random.default_rng(seed)
+    reqs = [(f"c{i}", rng.integers(0, vocab, size=prompt_len)
+             .astype(np.int32)) for i in range(n_reqs)]
+    sp = SamplingParams(max_new_tokens=new_tokens)
+
+    oracle = {}
+    for rid, prompt in reqs:
+        eng = ServeEngine(gen, params, num_blocks=1 + per_req * n_reqs,
+                          page_size=page_size, max_batch=batch,
+                          prefill_chunk=max(8, page_size))
+        eng.submit(Request(rid, prompt, sp))
+        oracle[rid] = list(eng.run()[rid].token_ids)
+
+    client_inj = FaultInjector(seed=seed)
+    # r0's engine carries this injector; the journal-rot spec is armed
+    # mid-timeline (after the drain re-placements land), so the damage
+    # falls on a tok line — the realistic class (tok lines are ~all of
+    # the file).  A rotted SUBMIT line is a different, honest failure:
+    # the prompt exists nowhere else and salvage reports the rid lost.
+    journal_inj = FaultInjector(seed=seed)
+    root = tempfile.mkdtemp(prefix="bench_corrupt_")
+    procs: dict = {}
+
+    def factory(life_dir):
+        name = os.path.basename(os.path.dirname(life_dir))
+        eng = ServeEngine(gen, params,
+                          num_blocks=1 + per_req * n_reqs,
+                          page_size=page_size, max_batch=batch,
+                          prefill_chunk=max(8, page_size),
+                          snapshot_dir=life_dir,
+                          faults=(journal_inj if name == "r0"
+                                  and life_dir.endswith("life1")
+                                  else None))
+        if warmup:
+            eng.warmup()
+        rep = InProcessReplica(eng, stall_after_s=5.0,
+                               step_sleep_s=step_sleep_s)
+        procs[name] = rep
+        rr = RemoteReplica(name, rep.url, kill=rep.kill, retries=2,
+                           retry_base_s=0.01, retry_cap_s=0.05,
+                           timeout_s=5.0, faults=client_inj, seed=seed)
+        return rr.wait_ready(60)
+
+    try:
+        fc = FleetController(factory, n_replicas, root=root,
+                             suspect_after_s=0.5, dead_after_s=1.5,
+                             backoff_base_s=0.05, backoff_cap_s=0.1,
+                             max_restarts=0, seed=seed)
+        t0 = time.perf_counter()
+        for rid, prompt in reqs:
+            fc.submit(Request(rid, prompt, sp))
+        drained = killed = False
+        t_death = None
+        deadline = time.monotonic() + 300.0
+        while fc.has_work():
+            if time.monotonic() > deadline:
+                raise RuntimeError("bench_corrupt: fleet not drained "
+                                   "inside the 300s chaos deadline")
+            fc.step()
+            toks = sum(len(s) for s in fc.streams.values())
+            if not drained and toks >= 1:
+                # wire-blob corruption, both directions: the drain
+                # RESPONSE (client-side detect -> same-key retry) and
+                # the re-placement migrate_in (server-side reject ->
+                # placer fallback).  max_fires=1 without at_call: each
+                # spec takes its op's FIRST arrival, whatever the
+                # shared per-point call index has reached by then.
+                client_inj.inject("integrity", corrupt="bitflip",
+                                  op="drain", max_fires=1)
+                client_inj.inject("integrity", corrupt="bitflip",
+                                  op="migrate_in", max_fires=1)
+                fc.drain_replica("r1")
+                drained = True
+                # every submit (originals + the re-placements the drain
+                # just adopted) is now journaled on r0 — the next
+                # append is a tok/fin line: rot it
+                journal_inj.inject("integrity", corrupt="bitflip",
+                                   op="journal", max_fires=1)
+            elif (drained and not killed and toks >= n_reqs
+                  and journal_inj.fire_count("integrity") >= 1):
+                procs["r0"].kill()
+                killed = True
+            if t_death is None and fc.deaths:
+                t_death = time.perf_counter()
+        dt = time.perf_counter() - t0
+        assert killed and fc.deaths >= 1, \
+            "chaos leg never killed the bit-rotted replica"
+        fired = [k for p, _, k, _, _ in journal_inj.fired
+                 if p == "integrity"]
+        assert "bitflip" in fired, "the journal bitflip never fired"
+        wire_fired = [k for p, _, k, _, _ in client_inj.fired
+                      if p == "integrity"]
+        # each wire spec is max_fires=1, so >= 2 bitflips means BOTH
+        # the drain-response and the migrate_in corruption fired
+        assert wire_fired.count("bitflip") >= 2, (
+            f"wire corruption incomplete: {wire_fired}")
+        salvages = sum(1 for e in fc.audit.entries()
+                       if e["kind"] == "journal_corrupt")
+        assert salvages >= 1, (
+            "the crash path never salvaged the corrupt journal — the "
+            "bitflipped line was not exercised")
+        exact = sum(1 for rid in oracle
+                    if rid in fc.outputs
+                    and list(fc.outputs[rid].token_ids) == oracle[rid]
+                    and fc.streams[rid] == oracle[rid])
+        toks = sum(len(o.token_ids) for o in fc.outputs.values())
+    finally:
+        for rep in procs.values():
+            rep.kill()
+        shutil.rmtree(root, ignore_errors=True)
+    return {
+        "mode": "corrupt",
+        "replicas": n_replicas,
+        "requests": n_reqs,
+        "new_tokens": new_tokens,
+        "chaos_wall_s": round(dt, 4),
+        "corrupt_toks_per_s": round(toks / dt, 1),
+        "chaos_deaths": fc.deaths,
+        "chaos_recovery_s": (round(time.perf_counter() - t_death, 4)
+                             if t_death is not None else None),
+        "journal_salvages": salvages,
+        "serve_corrupt_recovery_zero_loss": round(exact / len(oracle), 4),
+    }
+
+
 def bench_disagg(*, prefill: int = 1, decode: int = 2, batch: int = 4,
                  prompt_len: int = 16, new_tokens: int = 48,
                  burst_len: int = 128, burst_n: int = 2,
@@ -1393,6 +1567,17 @@ def main():
                         "(healed at SUSPECT), zero-loss vs the oracle "
                         "(bench.py's serve_fleet_net_zero_loss, "
                         "floor 1.0)")
+    p.add_argument("--corrupt", action="store_true",
+                   help="state-integrity chaos mode: the network "
+                        "fleet with a bitflipped journal line on one "
+                        "replica, a bitflipped drain-response + "
+                        "migrate_in wire manifest mid-run, and a "
+                        "SIGKILL of the bit-rotted replica — salvage, "
+                        "quarantine, digest rejection and recompute "
+                        "must keep every stream bit-identical to the "
+                        "oracle (bench.py's "
+                        "serve_corrupt_recovery_zero_loss, floor 1.0; "
+                        "docs/serving.md 'Durability & integrity')")
     p.add_argument("--overload", action="store_true",
                    help="bursty overload mode: a trace-shaped workload "
                         "(bursty Poisson arrivals, lognormal lengths, "
@@ -1458,6 +1643,26 @@ def main():
             or args.kv_dtype is not None):
         p.error("--overload is its own mode: it does not combine with "
                 "the other modes")
+    if args.corrupt and (
+            args.mesh is not None or args.fleet is not None or args.net
+            or args.trace or args.spec or args.shared_prompt
+            or args.sessions is not None or args.disagg is not None
+            or args.kv_dtype is not None or args.overload):
+        p.error("--corrupt is its own mode: it does not combine with "
+                "the other modes")
+    if args.corrupt:
+        r = bench_corrupt(batch=args.batch, prompt_len=args.prompt_len,
+                          new_tokens=args.new_tokens, dim=args.dim,
+                          n_layers=args.layers,
+                          page_size=args.page_size, seed=args.seed,
+                          warmup=not args.no_warmup)
+        print(json.dumps(r))
+        print(f"# corrupt chaos: zero-loss "
+              f"{r['serve_corrupt_recovery_zero_loss']:.3f} "
+              f"(floor 1.0), {r['chaos_deaths']} death(s), "
+              f"{r['journal_salvages']} journal salvage(s), recovery "
+              f"{r['chaos_recovery_s']}s", file=sys.stderr)
+        return
     if args.overload:
         if args.overload_factor < 1.0:
             p.error(f"--overload-factor must be >= 1.0, got "

@@ -1,0 +1,148 @@
+#!/usr/bin/env python3
+"""Offline integrity verifier for a replica's durable serving state
+(docs/serving.md "Durability & integrity").
+
+Walks a snapshot directory — the token journal, every published
+snapshot step's meta.json + pool leaves, and any postmortem flight
+files — verifying every digest WITHOUT an engine, and prints a
+per-artifact OK/CORRUPT report:
+
+    python scripts/serve_fsck.py /path/to/snapshot_dir
+    python scripts/serve_fsck.py /path/to/snapshot_dir --salvage
+
+Exit status: 0 when every artifact verifies (unverified pre-integrity
+artifacts count as OK — they predate the digests), nonzero on any
+damage.  ``--salvage`` additionally repairs what can be repaired
+offline:
+
+* a corrupt journal is quarantined (``journal.jsonl.corrupt-<ts>``)
+  and rewritten as its longest-valid CRC-framed prefix — exactly what
+  ``restore_engine`` would do, done ahead of time so the next restore
+  is clean;
+* a corrupt snapshot STEP is quarantined (``<step>.corrupt-<ts>``,
+  moved out of the manager's numeric namespace) so restore's
+  newest→oldest walk falls back to the previous good step instead of
+  refusing on the damaged one.
+
+Corrupt flight files are reported but never salvaged: they are
+best-effort postmortem evidence, and readers already treat an
+unverifiable one as absent.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _findings_journal(path: str, salvage: bool) -> list[dict]:
+    from triton_dist_tpu.serve.recovery import salvage_journal, scan_journal
+    if not os.path.exists(path):
+        return [{"artifact": path, "ok": True, "why": "absent"}]
+    if salvage:
+        _, damage = salvage_journal(path)
+    else:
+        _, damage = scan_journal(path)
+    if damage is None:
+        return [{"artifact": path, "ok": True, "why": "digest ok"}]
+    why = "; ".join(f"line {ln}: {reason}"
+                    for ln, reason in damage.bad_lines)
+    for rid, idx in damage.gaps:
+        why += f"; {rid}: token index gap at {idx}"
+    out = {"artifact": path, "ok": False,
+           "why": f"{why} — salvaged {damage.salvaged_lines}/"
+                  f"{damage.total_lines} lines"}
+    if damage.quarantine:
+        out["why"] += f"; quarantined at {damage.quarantine}"
+    return [out]
+
+
+def _findings_snapshots(directory: str, salvage: bool) -> list[dict]:
+    from triton_dist_tpu.serve.recovery import (
+        KV_SUBDIR,
+        quarantine_path,
+        verify_snapshot_step,
+    )
+    kvdir = os.path.join(directory, KV_SUBDIR)
+    if not os.path.isdir(kvdir):
+        return [{"artifact": kvdir, "ok": True, "why": "absent"}]
+    out: list[dict] = []
+    for name in sorted(os.listdir(kvdir)):
+        step_dir = os.path.join(kvdir, name)
+        if not (name.isdigit() and os.path.isdir(step_dir)):
+            continue
+        findings = verify_snapshot_step(step_dir)
+        if salvage and any(not f["ok"] for f in findings):
+            qp = quarantine_path(step_dir)
+            os.replace(step_dir, qp)
+            findings.append({"artifact": step_dir, "ok": False,
+                             "why": f"step quarantined at {qp} "
+                                    f"(restore falls back to the "
+                                    f"previous good step)"})
+        out.extend(findings)
+    return out
+
+
+def _findings_flights(directory: str) -> list[dict]:
+    import glob as _glob
+
+    from triton_dist_tpu.serve.trace import load_flight
+    out: list[dict] = []
+    for path in sorted(_glob.glob(os.path.join(directory,
+                                               "flight_*.json"))):
+        try:
+            load_flight(path)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            out.append({"artifact": path, "ok": False, "why": str(e)})
+        else:
+            out.append({"artifact": path, "ok": True, "why": "digest ok"})
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="verify (and optionally salvage) a replica's "
+                    "durable serving state offline")
+    ap.add_argument("directory", help="replica snapshot directory "
+                                      "(holds journal.jsonl and kv/)")
+    ap.add_argument("--salvage", action="store_true",
+                    help="quarantine damaged artifacts and rewrite the "
+                         "salvaged journal")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    args = ap.parse_args(argv)
+
+    from triton_dist_tpu.serve.recovery import JOURNAL_NAME
+
+    directory = os.path.abspath(args.directory)
+    if not os.path.isdir(directory):
+        print(f"serve_fsck: {directory}: not a directory",
+              file=sys.stderr)
+        return 2
+    findings = []
+    findings += _findings_journal(
+        os.path.join(directory, JOURNAL_NAME), args.salvage)
+    findings += _findings_snapshots(directory, args.salvage)
+    findings += _findings_flights(directory)
+
+    bad = [f for f in findings if not f["ok"]]
+    if args.json:
+        print(json.dumps({"directory": directory, "findings": findings,
+                          "corrupt": len(bad)}, indent=2))
+    else:
+        for f in findings:
+            tag = "OK     " if f["ok"] else "CORRUPT"
+            print(f"{tag}  {f['artifact']}  ({f['why']})")
+        print(f"# serve_fsck: {len(findings)} artifact(s), "
+              f"{len(bad)} corrupt — "
+              f"{'DAMAGED' if bad else 'OK'}")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

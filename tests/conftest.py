@@ -121,6 +121,13 @@ _FAST_GATE_MODULES = {
     # accounting, and geometry-override restores gate the recovery
     # layer; the randomized kill soak carries @pytest.mark.slow.
     "test_serve_recovery",
+    # state integrity (ISSUE 20): CRC journal framing (torn tail pinned
+    # vs interior-corruption-is-loud), skip-and-continue salvage +
+    # quarantine, snapshot leaf digests (silent-rot refusal + torn
+    # fallback), wire manifest digest rejection, the integrity fault
+    # point, the serve_fsck CLI, and the corrupt-chaos zero-loss
+    # harness all run in the gate (the whole file is the fast tier).
+    "test_serve_integrity",
     # prefix reuse: the content-addressed index units (chains, collision
     # safety, id-reuse orphaning, LRU eviction, COW splits), the
     # warm≡cold≡Generator.generate oracles (greedy/sampled/horizon-fused),

@@ -351,6 +351,11 @@ def test_cow_decode_into_shared_tail_via_restore(tiny, tmp_path):
     r2 = dict(r1, slot=1, seq=r1["seq"] + 1)
     meta["requests"]["r2"] = r2
     meta["tables"]["r2"] = list(meta["tables"]["r1"])
+    # state surgery must re-authenticate what it edits (ISSUE 20): the
+    # manifest self-digest and each cloned record's CRC frame
+    from triton_dist_tpu.serve.integrity import canonical_crc, stamp_crc
+    from triton_dist_tpu.serve.recovery import META_CRC
+    meta[META_CRC] = canonical_crc(meta, exclude=(META_CRC,))
     with open(mpath, "w") as f:
         json.dump(meta, f)
     # r2 needs journal submit/tok records too (exactly r1's, renamed).
@@ -360,7 +365,8 @@ def test_cow_decode_into_shared_tail_via_restore(tiny, tmp_path):
     with open(jpath, "a") as f:
         for rec in lines:
             if rec.get("rid") == "r1":
-                f.write(json.dumps(dict(rec, rid="r2")) + "\n")
+                f.write(json.dumps(stamp_crc(dict(rec, rid="r2")))
+                        + "\n")
 
     eng2 = ServeEngine.restore(d, gen, params)
     tail = eng2.bm.table("r1")[-1]

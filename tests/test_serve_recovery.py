@@ -181,14 +181,22 @@ def test_journal_roundtrip_tolerates_torn_tail(tmp_path):
     # sampling params round-trip exactly (seed drives the PRNG stream)
     assert jr.params == req.params
     assert jr.arrival == 1.0
-    # duplicates keep their first occurrence; a gap truncates
+    # duplicates keep their first occurrence; a token-index GAP is
+    # damage now (ISSUE 20): replay refuses loudly, salvage truncates
+    # the stream to its contiguous prefix and reports the rid
+    from triton_dist_tpu.serve.recovery import (JournalCorrupt,
+                                                salvage_journal)
     j2 = TokenJournal(path)
     j2.token("a", 2, 31, 5.0)
     j2.token("a", 2, 99, 6.0)     # duplicate index: ignored
-    j2.token("a", 4, 77, 7.0)     # gap at 3: never reached
+    j2.token("a", 4, 77, 7.0)     # gap at 3: missing token
     j2.close()
-    jr = replay_journal(path)["a"]
-    assert jr.token_list() == [17, 23, 31]
+    with pytest.raises(JournalCorrupt) as exc:
+        replay_journal(path)
+    assert "a" in exc.value.damage.affected_rids
+    state, damage = salvage_journal(path)
+    assert state["a"].token_list() == [17, 23, 31]
+    assert "a" in damage.affected_rids
     assert replay_journal(tmp_path / "missing.jsonl") == {}
 
 

@@ -502,3 +502,74 @@ def check_ring_schedules() -> list:
                     f"{kernel} world={world}: {v}",
                     path="triton_dist_tpu/analysis/comm_schedule.py"))
     return out
+
+
+# ---------------------------------------------------------------------------
+# Rule: durable-writes-integrity
+# ---------------------------------------------------------------------------
+
+#: A write-mode open or a json.dump in the serving layer — the
+#: candidate durable-artifact producers the rule audits.
+_DW_WRITE_PAT = re.compile(
+    r"json\.dump\(|open\([^)\n]*[\"']wt?[\"']")
+
+#: Atomicity evidence: the function publishes via rename (or delegates
+#: to the shared helper, which does).
+_DW_ATOMIC_PAT = re.compile(r"os\.replace\(|atomic_write_json\(")
+
+#: Digest evidence: the written bytes carry a verifiable CRC stamp.
+_DW_DIGEST_PAT = re.compile(
+    r"atomic_write_json\(|stamp_crc\(|canonical_crc\(|crc32")
+
+#: Fewer audited write sites than this means the detection pattern
+#: broke (refactor moved the writers), not that serving stopped
+#: persisting state — the shed-paths-observable self-blindness guard.
+_DW_MIN_SITES = 4
+
+
+@rule("durable-writes-integrity")
+def check_durable_writes_integrity() -> list:
+    """Every ``json.dump`` / ``open(..., "w")`` write of a durable
+    serving artifact under ``serve/`` must route through the shared
+    atomic-write + digest helper (``integrity.atomic_write_json``) or
+    carry equivalent evidence itself — rename-publish atomicity AND a
+    CRC stamp on the bytes (the journal's framing methods).  A durable
+    artifact written raw is exactly the silent-corruption surface
+    ISSUE 20 closed; justified exceptions (ephemeral discovery files,
+    external-tool export formats) go in LINT_WAIVERS.json."""
+    out = []
+    checked = 0
+    serve_dir = os.path.join(REPO, "triton_dist_tpu", "serve")
+    for path in sorted(glob.glob(os.path.join(serve_dir, "*.py"))):
+        if os.path.basename(path) == "integrity.py":
+            continue   # the helper's own implementation
+        src = open(path, encoding="utf-8").read()
+        tree = ast.parse(src)
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            seg = ast.get_source_segment(src, node) or ""
+            if not _DW_WRITE_PAT.search(seg):
+                continue
+            checked += 1
+            has_atomic = bool(_DW_ATOMIC_PAT.search(seg))
+            has_digest = bool(_DW_DIGEST_PAT.search(seg))
+            if not (has_atomic and has_digest):
+                missing = [w for w, ok in (
+                    ("rename-publish atomicity", has_atomic),
+                    ("a CRC digest stamp", has_digest)) if not ok]
+                out.append(Violation(
+                    "durable-writes-integrity",
+                    f"{node.name}() writes a durable artifact without "
+                    f"{' or '.join(missing)} — route it through "
+                    f"integrity.atomic_write_json",
+                    path=_rel(path), line=node.lineno))
+    if checked < _DW_MIN_SITES:
+        out.append(Violation(
+            "durable-writes-integrity",
+            f"only {checked} durable write sites found under serve/ "
+            f"(expected >= {_DW_MIN_SITES}) — the detection pattern "
+            f"broke, update _DW_WRITE_PAT",
+            path="triton_dist_tpu/serve"))
+    return out

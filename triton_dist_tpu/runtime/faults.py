@@ -26,6 +26,13 @@ point           context                  seam
                                          (``where`` = ``client`` /
                                          ``server_recv`` /
                                          ``server_resp``)
+``integrity``   ``op, rid``              durable/wire artifact writes
+                                         (``op`` = ``journal`` /
+                                         ``snapshot`` / ``push`` /
+                                         ``migrate_in`` / ``drain``):
+                                         journal-line appends, the
+                                         snapshot tmp-dir window, and
+                                         wire manifest blobs
 ==============  =======================  ================================
 
 Actions: ``error=`` raises :class:`InjectedFault` at the point;
@@ -48,6 +55,17 @@ server must dedupe); ``partition=True`` is a PERSISTENT drop — every
 matching call raises until :meth:`heal` clears it (the deterministic
 stand-in for a network partition; pair with ``target=`` to cut one
 replica off).
+
+Corruption actions (the ``integrity`` point; docs/serving.md
+"Durability & integrity"): ``corrupt="bitflip"|"truncate"|"zero"``
+makes :meth:`fire` RETURN the action string (like ``"duplicate"``),
+and the instrumented seam damages the artifact's bytes with
+:func:`corrupt_bytes` — a journal line before its write, a snapshot
+pool leaf inside the unrenamed tmp dir, a wire manifest KV blob before
+the send / after the receive.  The seams write genuinely-damaged bytes
+to disk/wire, so the VERIFIERS (journal CRC framing, snapshot leaf
+digests, manifest digests) are what the chaos tests prove, not the
+injection plumbing.
 
 A spec fires when its filters match: ``at_call`` pins the nth *enabled*
 arrival at the point, ``rid`` / ``op`` restrict to one request / program,
@@ -108,6 +126,30 @@ class InjectedNetFault(RuntimeError):
         self.action = action
 
 
+#: the corruption vocabulary of the ``integrity`` fault point
+CORRUPT_ACTIONS = ("bitflip", "truncate", "zero")
+
+
+def corrupt_bytes(data: bytes, action: str) -> bytes:
+    """Deterministically damage ``data`` per one ``integrity`` action:
+    ``bitflip`` XORs one bit mid-payload (the classic silent-rot shape
+    — the payload stays the same length and mostly plausible),
+    ``truncate`` drops the second half (a torn write), ``zero``
+    blanks everything (a lost extent).  Empty input passes through —
+    there is nothing to damage."""
+    if action not in CORRUPT_ACTIONS:
+        raise ValueError(f"unknown corrupt action {action!r}; "
+                         f"expected one of {CORRUPT_ACTIONS}")
+    if not data:
+        return data
+    if action == "bitflip":
+        i = len(data) // 2
+        return data[:i] + bytes([data[i] ^ 0x01]) + data[i + 1:]
+    if action == "truncate":
+        return data[:len(data) // 2]
+    return b"\x00" * len(data)
+
+
 @dataclass
 class _FaultSpec:
     point: str
@@ -121,6 +163,7 @@ class _FaultSpec:
     max_fires: Optional[int] = None
     kill: bool = False
     net: Optional[str] = None       # drop / duplicate / partition
+    corrupt: Optional[str] = None   # bitflip / truncate / zero
     target: Optional[str] = None    # net peer filter (replica name)
     where: Optional[str] = None     # net seam side filter
     healed: bool = False            # heal() turned this spec off
@@ -165,7 +208,8 @@ class FaultInjector:
                stall_s: float = 0.0, skew_s: float = 0.0,
                kill: bool = False, drop: bool = False,
                delay_s: float = 0.0, duplicate: bool = False,
-               partition: bool = False, target: Optional[str] = None,
+               partition: bool = False, corrupt: Optional[str] = None,
+               target: Optional[str] = None,
                where: Optional[str] = None,
                at_call: Optional[int] = None, rate: float = 1.0,
                rid: Optional[str] = None, op: Optional[str] = None,
@@ -176,19 +220,22 @@ class FaultInjector:
         if sum((drop, duplicate, partition)) > 1:
             raise ValueError("drop=, duplicate= and partition= are "
                              "mutually exclusive net actions")
+        if corrupt is not None and corrupt not in CORRUPT_ACTIONS:
+            raise ValueError(f"corrupt= must be one of {CORRUPT_ACTIONS},"
+                             f" got {corrupt!r}")
         stall_s = stall_s or delay_s
         if (error is None and not stall_s and not skew_s and not kill
-                and net is None):
+                and net is None and corrupt is None):
             raise ValueError("a fault needs an action: error=, stall_s=, "
                              "skew_s=, kill=, drop=, delay_s=, "
-                             "duplicate= or partition=")
+                             "duplicate=, partition= or corrupt=")
         if not 0.0 <= rate <= 1.0:
             raise ValueError(f"rate must be in [0, 1], got {rate}")
         if max_fires is None and at_call is not None:
             max_fires = 1
         self._specs.append(_FaultSpec(
             point, error, stall_s, skew_s, at_call, rate, rid, op,
-            max_fires, kill, net, target, where))
+            max_fires, kill, net, corrupt, target, where))
         return self
 
     def heal(self, point: str = "net", *,
@@ -231,7 +278,10 @@ class FaultInjector:
         ``point``; may raise :class:`InjectedFault` /
         :class:`InjectedNetFault`, sleep, or no-op.  Returns
         ``"duplicate"`` when a net duplicate spec fired (the transport
-        must then send the request twice), else ``None``."""
+        must then send the request twice), a :data:`CORRUPT_ACTIONS`
+        string when an ``integrity`` corrupt spec fired (the seam must
+        then damage the artifact's bytes via :func:`corrupt_bytes`),
+        else ``None``."""
         if not self._enabled:
             return None
         n = self.calls[point] = self.calls.get(point, 0) + 1
@@ -256,6 +306,7 @@ class FaultInjector:
                 continue
             f.fires += 1
             kind = (f.net if f.net is not None
+                    else f.corrupt if f.corrupt is not None
                     else "kill" if f.kill
                     else "error" if f.error is not None
                     else "stall" if f.stall_s else "skew")
@@ -277,6 +328,8 @@ class FaultInjector:
                     f"{f' [{where}]' if where else ''}", f.net)
             if f.net == "duplicate":
                 result = "duplicate"
+            if f.corrupt is not None:
+                result = f.corrupt
             if f.error is not None:
                 raise InjectedFault(
                     f"injected {point} fault #{n}"

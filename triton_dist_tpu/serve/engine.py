@@ -1045,7 +1045,8 @@ class ServeEngine:
                     f"engine at a clean directory")
             self._journal = TokenJournal(
                 jpath, fsync=journal_fsync,
-                fsync_interval_s=journal_fsync_interval_s)
+                fsync_interval_s=journal_fsync_interval_s,
+                faults=self.faults)
 
         # The scratch-extent bucket ladder: every prefill's s_ext (and
         # with it the _chunk_jit extent and the _fill_fn table width)

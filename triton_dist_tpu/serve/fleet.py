@@ -60,6 +60,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from triton_dist_tpu.runtime.faults import CORRUPT_ACTIONS
 from triton_dist_tpu.runtime.watchdog import WatchdogTimeout
 from triton_dist_tpu.serve.metrics import (
     RequestMetrics,
@@ -67,11 +68,13 @@ from triton_dist_tpu.serve.metrics import (
     WindowedRate,
 )
 from triton_dist_tpu.serve.net import (
+    ManifestCorrupt,
     NetClient,
     NetError,
     NetHTTPError,
     NetOverloaded,
     NetUnreachable,
+    corrupt_wire_doc,
     decode_manifest,
     encode_manifest,
 )
@@ -699,6 +702,20 @@ class RemoteReplica:
         from triton_dist_tpu.serve.recovery import _resolve_callback
 
         enc = encode_manifest(manifest)
+        # The integrity fault point's wire-blob site: damage a COPY of
+        # the encoded doc in flight (the clean ``enc`` is what a later
+        # ambiguous-call reconcile re-sends — transport rot must not
+        # become persistent sender state).  The receiver's digest check
+        # rejects with 400 → the rejected-path fallback below.
+        wire = enc
+        faults = getattr(self.client, "faults", None)
+        if faults is not None:
+            rids_hint = [rec.get("rid")
+                         for rec in manifest.get("requests", ())]
+            act = faults.fire("integrity", op=op,
+                              rid=rids_hint[0] if rids_hint else None)
+            if act in CORRUPT_ACTIONS:
+                wire = corrupt_wire_doc(enc, act)
         rids = [rec["rid"] for rec in manifest.get("requests", ())]
         for rec in manifest.get("requests", ()):
             rid = rec["rid"]
@@ -711,7 +728,7 @@ class RemoteReplica:
         try:
             resp = self.client.call(
                 op, f"/{op}", method="POST",
-                body={"manifest": enc, "key": key},
+                body={"manifest": wire, "key": key},
                 timeout_s=max(self.timeout_s, 30.0))
         except NetHTTPError as e:
             # answered-with-error is definitive: nothing was adopted —
@@ -751,16 +768,39 @@ class RemoteReplica:
         journal still has the receipts but the cooperative manifest is
         gone.)"""
         key = f"{self.name}-drain-{self._drains + 1}"
-        resp = self.client.call(
-            "drain", "/drain", method="POST",
-            body={"rids": rids, "key": key, "include_kv": include_kv,
-                  "push": push},
-            timeout_s=max(self.timeout_s, 30.0))
-        self._drains += 1
-        m = decode_manifest(resp["manifest"])
-        for rec in m.get("requests", ()):
-            self._live.pop(rec["rid"], None)
-        return m
+        faults = getattr(self.client, "faults", None)
+        # A drain-RESPONSE corrupted in flight is recoverable without
+        # re-draining: the server cached the clean manifest under this
+        # key (the engine drained once), so a bounded retry with the
+        # SAME key replays it.  Corruption that survives the retries is
+        # a dead transport for state-bearing purposes: raise NetError so
+        # the controller walks the death ladder and recovers from the
+        # journal instead of adopting rot.
+        last: Optional[ManifestCorrupt] = None
+        for _ in range(3):
+            resp = self.client.call(
+                "drain", "/drain", method="POST",
+                body={"rids": rids, "key": key, "include_kv": include_kv,
+                      "push": push},
+                timeout_s=max(self.timeout_s, 30.0))
+            doc = resp["manifest"]
+            if faults is not None:   # wire-blob site, receive direction
+                act = faults.fire("integrity", op="drain")
+                if act in CORRUPT_ACTIONS:
+                    doc = corrupt_wire_doc(doc, act)
+            try:
+                m = decode_manifest(doc)
+            except ManifestCorrupt as e:
+                last = e
+                continue
+            self._drains += 1
+            for rec in m.get("requests", ()):
+                self._live.pop(rec["rid"], None)
+            return m
+        raise NetError(
+            f"drain manifest from {self.name} corrupt after retries "
+            f"({last}) — treating the replica as unrecoverable over "
+            f"the wire; the journal crash path has the receipts")
 
     def push_ready(self) -> list[str]:
         """Prefill-complete rids from the last health answer — the
@@ -1807,6 +1847,21 @@ class FleetController:
         self.audit.record(now, self.steps, "replica_state",
                           replica=name, state=rep.state.value, why=why)
         manifest = manifest_from_journal(life_dir, mark=True)
+        # Journal salvage escalation: the dead life's journal carried
+        # interior corruption — the salvaged prefix may be missing
+        # committed tokens.  Count + trace it here (the dead engine's
+        # own metrics are gone), then let _absorb_manifest reconcile
+        # each stream against OUR delivery record: what the controller
+        # delivered is committed truth the salvage cannot un-commit.
+        jdamage = manifest.get("damage")
+        if jdamage is not None:
+            self._carry.journal_corrupt += 1
+            self.trace.emit("corrupt", None, artifact="journal",
+                            replica=name, **jdamage)
+            self.audit.record(now, self.steps, "journal_corrupt",
+                              replica=name,
+                              quarantine=jdamage.get("quarantine"),
+                              affected=jdamage.get("affected_rids"))
         # retirements whose outputs the dying step swallowed: the
         # journal's fin records are the accounting of record
         for f in manifest["finished"]:
@@ -1857,14 +1912,42 @@ class FleetController:
         stream's delivery record from the journal segment (tokens the
         source journaled but never delivered — the commit→callback
         crash window — redeliver HERE, exactly the missing indices),
-        then queue the records for placement."""
+        then queue the records for placement.
+
+        A manifest carrying a journal-salvage ``damage`` report may
+        hold FEWER tokens than we delivered (the corrupt tail was cut);
+        the delivery record is then the authority — tokens the client
+        already saw are committed whatever the rotted journal says, so
+        the rec is extended back to the delivered prefix and recompute
+        resumes from there.  Without damage, a shorter journal still
+        means the journal-precedes-callback invariant broke: assert."""
+        damaged = manifest.get("damage") is not None
         header = _manifest_header(manifest)
         for rec in manifest.get("requests", ()):
             rid = rec["rid"]
             if rid not in self.streams:
                 continue  # not fleet traffic (foreign journal entry)
+            if rid in self.outputs:
+                continue  # finished-and-delivered: salvage must never
+                #           resurrect a retired stream
+            cur = self.placement.get(rid)
+            if cur is not None and cur != source:
+                other = self.replicas.get(cur)
+                if (other is not None and other.engine is not None
+                        and other.state is not ReplicaState.DEAD):
+                    continue  # the stream is LIVE on another replica —
+                    #           a salvaged journal missing its mig
+                    #           receipt must not double-place it
             toks = rec.get("tokens", [])
             d = len(self.streams[rid])
+            if damaged and d > len(toks):
+                rec["tokens"] = toks = [int(t) for t in
+                                        self.streams[rid]]
+                # token timestamps past the salvaged prefix are gone
+                # with the corrupt lines; the adopting engine treats a
+                # short tok_ts like a pre-timestamp manifest (re-bases)
+                if rec.get("tok_ts") is not None:
+                    rec["tok_ts"] = rec["tok_ts"][:len(toks)]
             assert d <= len(toks), (
                 f"{rid}: delivered {d} tokens but the journal only "
                 f"holds {len(toks)} — the journal-precedes-callback "

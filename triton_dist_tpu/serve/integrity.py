@@ -1,0 +1,112 @@
+"""Shared integrity primitives for every durable / wire-crossing
+serving artifact (docs/serving.md "Durability & integrity").
+
+The exactly-once story (serve/recovery.py, serve/net.py) rests on
+artifacts — the token journal, snapshot manifests, migration manifests,
+base64 KV blobs — whose bytes were, before this module, trusted
+verbatim.  A flipped bit in any of them used to become either silent
+token loss (a journal line skipped) or subtly-wrong KV (a corrupt pool
+leaf adopted).  Every producer now stamps a CRC32 digest and every
+reader verifies BEFORE adoption; corruption downgrades to a loud
+salvage/re-queue, never wrong state.
+
+Why CRC32: the adversary is bit rot and torn writes, not a forger —
+a 32-bit checksum over the canonical JSON (or raw bytes) catches the
+random-corruption class at negligible cost on the per-token journal
+path (the `serve_trace_overhead`-style paired bench gate keeps it
+honest).  Canonical form is ``json.dumps(..., sort_keys=True,
+separators=(",", ":"))``: ``json.loads`` → ``dumps`` round-trips
+deterministically in Python (shortest-repr floats, ensure_ascii), so
+the digest survives a decode/re-encode even when the original byte
+layout does not.
+
+The ``durable-writes-integrity`` lint rule (analysis/rules.py) pins the
+convention: every ``json.dump``/``open(..., "w")`` of a durable serving
+artifact under ``serve/`` must route through :func:`atomic_write_json`
+(or carry its own atomicity + digest evidence, like the journal's
+framing methods).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Optional
+
+#: digest field name for whole-document JSON artifacts
+#: (:func:`atomic_write_json` / :func:`verify_json_doc`)
+DOC_CRC = "doc_crc"
+
+#: digest field name for per-line journal records
+#: (``TokenJournal.append`` / ``replay_journal`` in serve/recovery.py)
+REC_CRC = "c"
+
+
+def crc32_bytes(data: bytes) -> int:
+    """CRC32 of raw bytes (pool leaves, wire KV blobs)."""
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def canonical_json(obj) -> str:
+    """The canonical serialization digests are computed over: sorted
+    keys, no whitespace — identical for an object and its
+    ``json.loads(json.dumps(obj))`` round trip.  The round trip is
+    ENFORCED by taking it: JSON stringifies non-string dict keys, and
+    ``sort_keys`` orders ``{1: ..., 10: ..., 2: ...}`` numerically
+    before the trip but lexicographically after — a digest computed on
+    the raw object would never verify against the parsed-back doc
+    (block-id-keyed snapshot metadata is exactly that shape)."""
+    return json.dumps(json.loads(json.dumps(obj)),
+                      sort_keys=True, separators=(",", ":"))
+
+
+def canonical_crc(obj, *, exclude: tuple = ()) -> int:
+    """CRC32 over the canonical JSON of ``obj``, minus ``exclude``
+    keys (so a digest field can live inside the object it covers)."""
+    if exclude and isinstance(obj, dict):
+        obj = {k: v for k, v in obj.items() if k not in exclude}
+    return crc32_bytes(canonical_json(obj).encode("utf-8"))
+
+
+def stamp_crc(rec: dict, *, field: str = REC_CRC) -> dict:
+    """Return a copy of ``rec`` carrying its own digest under
+    ``field`` (the journal-record framing)."""
+    out = dict(rec)
+    out[field] = canonical_crc(out, exclude=(field,))
+    return out
+
+
+def rec_crc_ok(rec: dict, *, field: str = REC_CRC) -> Optional[bool]:
+    """Tri-state record verification: ``None`` when the record carries
+    no digest (pre-integrity artifact — tolerated for back-compat),
+    else whether the digest matches."""
+    want = rec.get(field)
+    if want is None:
+        return None
+    return int(want) == canonical_crc(rec, exclude=(field,))
+
+
+def atomic_write_json(path: str | os.PathLike, doc: dict, *,
+                      digest_field: str = DOC_CRC) -> str:
+    """THE durable-JSON writer for serving artifacts: stamps a
+    whole-document digest, then publishes through tmp + fsync + rename
+    so a crash at any instant leaves either the old file or the
+    complete new one — never a torn, and never an undigested, artifact.
+    (Enforced by the ``durable-writes-integrity`` lint rule.)"""
+    path = os.path.abspath(os.fspath(path))
+    out = stamp_crc(doc, field=digest_field)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(out, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def verify_json_doc(doc: dict, *,
+                    digest_field: str = DOC_CRC) -> Optional[bool]:
+    """Tri-state whole-document verification (see :func:`rec_crc_ok`);
+    does not mutate ``doc``."""
+    return rec_crc_ok(doc, field=digest_field)

@@ -75,6 +75,7 @@ MERGE_COUNTERS = (
     "prefix_skipped_tokens", "running_sum", "kv_util_sum",
     "net_requests", "net_dup_hits", "net_redelivered_tokens",
     "brownout_transitions",
+    "journal_corrupt", "manifest_corrupt",
 )
 
 
@@ -362,6 +363,16 @@ class ServeMetrics:
     net_requests: int = 0         # API calls the replica server answered
     net_dup_hits: int = 0         # idempotent no-op replays
     net_redelivered_tokens: int = 0  # tokens re-served below the watermark
+    # state-integrity counters (serve/integrity.py, docs/serving.md
+    # "Durability & integrity"): journal_corrupt counts salvage events
+    # (interior damage quarantined, longest-valid prefix replayed);
+    # manifest_corrupt counts wire manifests a RECEIVER rejected on a
+    # digest mismatch (the sender re-queues through exact recompute —
+    # corruption is never adopted, so either counter being nonzero is
+    # an alert about the storage/transport substrate, not about
+    # correctness).
+    journal_corrupt: int = 0      # journal salvage (quarantine) events
+    manifest_corrupt: int = 0     # wire manifests rejected on digest
     block_manager: object = field(default=None, repr=False)
     # compilation observability: CountingJit wrappers the engine
     # registers (runtime/jit_cache.py) + warmup accounting
@@ -622,6 +633,7 @@ class ServeMetrics:
             "restored_in_place": self.restored_in_place,
             "restored_requeued": self.restored_requeued,
             "restored_tokens": self.restored_tokens,
+            "journal_corrupt": self.journal_corrupt,
         }
 
     def migration_stats(self) -> dict:
@@ -643,6 +655,7 @@ class ServeMetrics:
             "net_requests": self.net_requests,
             "net_dup_hits": self.net_dup_hits,
             "net_redelivered_tokens": self.net_redelivered_tokens,
+            "manifest_corrupt": self.manifest_corrupt,
         }
 
     def merge(self, other: "ServeMetrics") -> "ServeMetrics":
@@ -950,6 +963,12 @@ class ServeMetrics:
         counter("serve_net_redelivered_tokens_total",
                 self.net_redelivered_tokens,
                 "tokens re-served below a stream's high-water mark")
+        counter("serve_journal_corrupt_total", self.journal_corrupt,
+                "journal salvage events (interior corruption "
+                "quarantined, longest-valid prefix replayed)")
+        counter("serve_manifest_corrupt_total", self.manifest_corrupt,
+                "wire manifests rejected on a digest mismatch "
+                "(sender re-queues through exact recompute)")
         L.append("# TYPE serve_finished_total counter")
         for reason, n in sorted(self.finish_reasons.items()):
             L.append(f'serve_finished_total{{reason="{reason}"}} {n}')

@@ -75,7 +75,12 @@ from typing import Callable, Optional
 
 import numpy as np
 
-from triton_dist_tpu.runtime.faults import InjectedNetFault
+from triton_dist_tpu.runtime.faults import (
+    CORRUPT_ACTIONS,
+    InjectedNetFault,
+    corrupt_bytes,
+)
+from triton_dist_tpu.serve.integrity import canonical_crc, crc32_bytes
 
 #: Wire protocol version — both ends check it, so a stale replica binary
 #: fails loud instead of mis-parsing.
@@ -132,15 +137,55 @@ class NetOverloaded(NetHTTPError):
 # ---------------------------------------------------------------------------
 
 
+class ManifestCorrupt(ValueError):
+    """A wire manifest failed digest verification on the RECEIVER —
+    a KV blob's bytes or a request's metadata no longer match the
+    sender's stamp.  Subclasses :class:`ValueError` so ``_route`` maps
+    it to a definitive 400 (never retried verbatim); the sender's
+    rejection fallback ladder (capacity walk → general placer →
+    ``_no_push`` pin / crash-path re-placement) then re-routes the
+    request through exact recompute.  Corruption is a re-queue, never
+    adopted state — docs/serving.md "Durability & integrity"."""
+
+
+#: request-metadata fields covered by the per-request wire digest
+#: (``mdig``).  Deliberately the invariant core — rid, prompt, committed
+#: tokens, sampling params — not the mutable transport envelope
+#: (kv/kv_len/pending/s_ext are covered by their own per-blob CRCs or
+#: recomputed on adoption), so the digest survives both the live-KV and
+#: the journal-segment (save_manifest-stripped) forms.
+MDIG_FIELDS = ("rid", "prompt", "tokens", "params")
+
+
+def _req_mdig(rec: dict) -> int:
+    return canonical_crc({k: rec[k] for k in MDIG_FIELDS if k in rec})
+
+
 def _enc_arr(a: np.ndarray) -> dict:
     a = np.ascontiguousarray(a)
+    raw = a.tobytes()
     return {"__nd__": True, "dtype": str(a.dtype), "shape": list(a.shape),
-            "b64": base64.b64encode(a.tobytes()).decode("ascii")}
+            "crc": crc32_bytes(raw),
+            "b64": base64.b64encode(raw).decode("ascii")}
 
 
 def _dec_arr(d: dict) -> np.ndarray:
-    return np.frombuffer(base64.b64decode(d["b64"]),
-                         dtype=np.dtype(d["dtype"])).reshape(d["shape"])
+    try:
+        raw = base64.b64decode(d["b64"], validate=True)
+    except (ValueError, TypeError) as e:
+        raise ManifestCorrupt(f"KV blob is not valid base64: {e}") from None
+    want = d.get("crc")   # absent on pre-integrity senders: tolerated
+    if want is not None and int(want) != crc32_bytes(raw):
+        raise ManifestCorrupt(
+            f"KV blob digest mismatch (stamped {want}, received "
+            f"{crc32_bytes(raw)}) — rejecting the manifest; the sender "
+            f"re-routes through exact recompute")
+    try:
+        return np.frombuffer(raw, dtype=np.dtype(d["dtype"])).reshape(
+            d["shape"])
+    except (ValueError, TypeError) as e:
+        raise ManifestCorrupt(
+            f"KV blob bytes do not fit dtype/shape: {e}") from None
 
 
 def _enc_kv(x) -> dict:
@@ -176,6 +221,7 @@ def encode_manifest(manifest: dict) -> dict:
         rec = dict(rec)
         if rec.get("kv") is not None:
             rec["kv"] = [[_enc_kv(k), _enc_kv(v)] for k, v in rec["kv"]]
+        rec["mdig"] = _req_mdig(rec)
         reqs.append(rec)
     doc["requests"] = reqs
     return doc
@@ -183,17 +229,52 @@ def encode_manifest(manifest: dict) -> dict:
 
 def decode_manifest(doc: dict) -> dict:
     """Inverse of :func:`encode_manifest` (idempotent on an
-    already-decoded manifest)."""
+    already-decoded manifest).  Verifies every per-blob CRC and
+    per-request ``mdig`` stamped by the sender, raising
+    :class:`ManifestCorrupt` (→ definitive 400 on the server paths)
+    BEFORE any state is adopted; manifests from pre-integrity senders
+    carry no digests and decode unverified (mixed-fleet tolerance,
+    ``NET_PROTOCOL`` unchanged)."""
     m = dict(doc)
     reqs = []
     for rec in m.get("requests", ()):
         rec = dict(rec)
+        want = rec.pop("mdig", None)
+        if want is not None and int(want) != _req_mdig(rec):
+            raise ManifestCorrupt(
+                f"request {rec.get('rid')!r}: metadata digest mismatch "
+                f"— rejecting the manifest; the sender re-routes "
+                f"through exact recompute")
         kv = rec.get("kv")
         if kv is not None:
             rec["kv"] = [(_dec_kv(k), _dec_kv(v)) for k, v in kv]
         reqs.append(rec)
     m["requests"] = reqs
     return m
+
+
+def corrupt_wire_doc(doc: dict, action: str) -> dict:
+    """Damage an ENCODED manifest in place of transport bit rot (the
+    ``integrity`` fault point's wire-blob site — tests/bench only).
+    Returns a DEEP copy with the first KV blob's payload bytes (or,
+    when the manifest carries no KV, the first request's committed
+    tokens) corrupted WITHOUT restamping the digests, so the receiver's
+    :func:`decode_manifest` must detect and reject."""
+    out = json.loads(json.dumps(doc))
+    for rec in out.get("requests", ()):
+        kv = rec.get("kv")
+        if kv:
+            blob = kv[0][0]
+            if isinstance(blob, dict) and not blob.get("__nd__"):
+                blob = blob["q"]   # quantized pair: damage the int8 plane
+            raw = corrupt_bytes(base64.b64decode(blob["b64"]), action)
+            blob["b64"] = base64.b64encode(raw).decode("ascii")
+            return out
+    for rec in out.get("requests", ()):
+        if rec.get("tokens"):
+            rec["tokens"] = rec["tokens"][:-1] + [rec["tokens"][-1] ^ 1]
+            return out
+    return out
 
 
 def write_port_file(path: str, port: int) -> str:
@@ -394,6 +475,26 @@ class ReplicaServer:
             raise box["error"]
         return box["result"]
 
+    def _decode_verified(self, doc: dict, op: str) -> dict:
+        """decode_manifest with the receiver-side rejection accounting:
+        a digest mismatch counts ``manifest_corrupt``, emits the
+        ``corrupt`` trace event, and re-raises — ``_route`` maps the
+        :class:`ManifestCorrupt` (a ValueError) to a definitive 400,
+        which the sender's fallback ladder turns into a re-queue
+        through exact recompute.  Runs on the engine thread (inside
+        ``_exec``), so touching engine.metrics/trace is safe."""
+        if self.engine.faults is not None:
+            act = self.engine.faults.fire("integrity", op=op)
+            if act in CORRUPT_ACTIONS:
+                doc = corrupt_wire_doc(doc, act)
+        try:
+            return decode_manifest(doc)
+        except ManifestCorrupt as e:
+            self.engine.metrics.manifest_corrupt += 1
+            self.engine.trace.emit("corrupt", None, artifact="wire",
+                                   op=op, why=str(e)[:200])
+            raise
+
     def _cache_sweep(self) -> None:
         # TTL besides the count bound: a drain response pins its full
         # KV payload (base64) in memory, and the useful replay window
@@ -530,7 +631,7 @@ class ReplicaServer:
             if cached is not None:
                 self._counts["dups"] += 1
                 return {**cached, "retried": True}
-            m = decode_manifest(doc["manifest"])
+            m = self._decode_verified(doc["manifest"], "migrate_in")
             fresh, cbs = [], {}
             for rec in m.get("requests", ()):
                 rid = rec["rid"]
@@ -578,7 +679,7 @@ class ReplicaServer:
             if cached is not None:
                 self._counts["dups"] += 1
                 return {**cached, "retried": True}
-            m = decode_manifest(doc["manifest"])
+            m = self._decode_verified(doc["manifest"], "push")
             fresh, cbs = [], {}
             for rec in m.get("requests", ()):
                 rid = rec["rid"]

@@ -68,6 +68,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional, Union
@@ -76,6 +77,16 @@ import jax
 import numpy as np
 
 from triton_dist_tpu.runtime import checkpoint as ck
+from triton_dist_tpu.runtime.faults import CORRUPT_ACTIONS, corrupt_bytes
+from triton_dist_tpu.serve.integrity import (
+    DOC_CRC,
+    atomic_write_json,
+    canonical_crc,
+    crc32_bytes,
+    rec_crc_ok,
+    stamp_crc,
+    verify_json_doc,
+)
 from triton_dist_tpu.serve.metrics import RequestMetrics
 from triton_dist_tpu.serve.request import (
     FinishReason,
@@ -89,6 +100,34 @@ SNAPSHOT_FORMAT = 1
 JOURNAL_NAME = "journal.jsonl"
 KV_SUBDIR = "kv"
 META_NAME = "meta.json"
+#: meta.json's self-digest field (over the manifest minus this key)
+META_CRC = "meta_crc"
+
+
+class JournalCorrupt(RuntimeError):
+    """A journal with INTERIOR damage (an undecodable or CRC-mismatched
+    non-final line, or a token-index gap) — distinct from the tolerated
+    torn FINAL line a crash mid-append leaves.  Carries the salvaged
+    state (every record that still authenticates, ``state``) and the structured
+    :class:`JournalDamage` report (``damage``): a caller that can
+    salvage goes through :func:`salvage_journal`; one that cannot must
+    fail loudly rather than silently absorb token loss."""
+
+    def __init__(self, damage: "JournalDamage",
+                 state: dict[str, "JournalRequest"]):
+        super().__init__(str(damage))
+        self.damage = damage
+        self.state = state
+
+
+class SnapshotCorrupt(RuntimeError):
+    """A PUBLISHED snapshot failed digest verification (a pool leaf or
+    the meta.json manifest) — bit rot, not a torn write (torn writes
+    never survive the tmp-dir + rename publish and fall back to the
+    previous step).  Never caught by the restore fallback walk: a
+    corrupt snapshot must fail loudly naming the bad leaf, and the
+    operator (or ``scripts/serve_fsck.py --salvage``) quarantines the
+    step so restore can use an older snapshot + the journal."""
 
 
 # ---------------------------------------------------------------------------
@@ -113,10 +152,20 @@ class TokenJournal:
     snapshot barriers — finished requests collapse into single ``done``
     records — through an atomic tmp + rename, so the file stops growing
     with every token ever served; a crash anywhere during the rewrite
-    leaves either the old or the new journal whole."""
+    leaves either the old or the new journal whole.
+
+    **Integrity framing** (docs/serving.md "Durability & integrity"):
+    every appended/rewritten record carries a CRC32 of its canonical
+    JSON under ``"c"`` — :func:`replay_journal` verifies per line and
+    distinguishes a torn final line (tolerated, as ever) from interior
+    corruption (loud salvage).  ``faults=`` threads the engine's
+    injector so the ``integrity`` point can damage a line's bytes
+    BEFORE they hit disk (the chaos seam the verifiers are proved
+    against)."""
 
     def __init__(self, path: str | os.PathLike, *, fsync: bool = False,
-                 fsync_interval_s: Optional[float] = None):
+                 fsync_interval_s: Optional[float] = None, faults=None):
+        self.faults = faults
         self.path = os.path.abspath(os.fspath(path))
         parent = os.path.dirname(self.path)
         if parent:
@@ -168,7 +217,18 @@ class TokenJournal:
             f.truncate(0)             # a single torn line was the file
 
     def append(self, rec: dict) -> None:
-        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        rec = stamp_crc(rec)
+        body = json.dumps(rec, separators=(",", ":"))
+        if self.faults is not None:
+            act = self.faults.fire("integrity", op="journal",
+                                   rid=rec.get("rid"))
+            if act in CORRUPT_ACTIONS:
+                # damage the LINE bytes, keep the line framing: the
+                # corruption lands inside one record, which is exactly
+                # the interior-damage class replay must catch loudly
+                raw = corrupt_bytes(body.encode("utf-8"), act)
+                body = raw.decode("utf-8", errors="replace")
+        line = body + "\n"
         self._f.write(line)
         self._f.flush()
         self._dirty = True
@@ -241,11 +301,14 @@ class TokenJournal:
         """Atomically replace the journal's contents with ``records``
         (the engine's snapshot-barrier compaction).  tmp + fsync +
         rename: readers and a crash at any instant see either the old
-        journal or the complete new one, never a torn mix."""
+        journal or the complete new one, never a torn mix.  Every
+        record is (re-)stamped with its CRC framing — compaction
+        produces fresh record shapes, so digests must be recomputed."""
         tmp = self.path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             for rec in records:
-                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+                f.write(json.dumps(stamp_crc(rec),
+                                   separators=(",", ":")) + "\n")
             f.flush()
             os.fsync(f.fileno())
         self._f.close()
@@ -289,8 +352,11 @@ class JournalRequest:
     slo: str = "interactive"
 
     def token_list(self) -> list[int]:
-        """Emitted tokens in order (the contiguous prefix from 0 — a gap
-        means a corrupt journal and truncates the replay there)."""
+        """Emitted tokens in order (the contiguous prefix from 0).  A
+        gap is journal corruption — :func:`scan_journal` reports it as
+        damage (never silently absorbed; the pre-integrity silent
+        truncation was the ISSUE-20 bug) and the salvage keeps exactly
+        this contiguous prefix."""
         out = []
         i = 0
         while i in self.tokens:
@@ -307,75 +373,304 @@ class JournalRequest:
         return out
 
 
+def _apply_record(out: dict[str, JournalRequest], rec: dict) -> None:
+    """Fold one decoded journal record into the replay state (shared by
+    the salvage scan and any future incremental reader)."""
+    rid = rec.get("rid")
+    if rid is None:
+        return
+    jr = out.setdefault(rid, JournalRequest(rid=rid))
+    t = rec.get("t")
+    if t == "submit":
+        if jr.prompt is None:
+            jr.prompt = np.asarray(rec["prompt"], np.int32)
+            jr.params = SamplingParams.from_dict(rec["params"])
+            jr.arrival = rec.get("ts")
+            jr.slo = rec.get("slo", "interactive")
+            if jr.first_tok is None:
+                jr.first_tok = rec.get("ftt")
+            if jr.trace is None:
+                jr.trace = rec.get("trace")
+        # a submit AFTER a mig receipt re-opens ownership: the
+        # request was handed off (push/drain) and later
+        # re-admitted HERE (the disagg push fallback path) —
+        # this journal owns its stream again, and a crash must
+        # recover it rather than skip it as migrated
+        jr.migrated = False
+    elif t == "tok":
+        jr.tokens.setdefault(int(rec["i"]),
+                             (int(rec["tok"]), rec.get("ts")))
+    elif t == "fin" and jr.finish is None:
+        jr.finish = {"reason": rec["reason"],
+                     "err": rec.get("err"),
+                     "n": rec.get("n"), "ts": rec.get("ts")}
+    elif t == "mig":
+        jr.migrated = True
+    elif t == "done":
+        # One-line compacted request (a snapshot-barrier journal
+        # rotation): submit + every tok + fin folded together.
+        if jr.prompt is None:
+            jr.prompt = np.asarray(rec["prompt"], np.int32)
+            jr.params = SamplingParams.from_dict(rec["params"])
+            jr.arrival = rec.get("arrival")
+            jr.slo = rec.get("slo", "interactive")
+        if jr.first_tok is None:
+            jr.first_tok = rec.get("ftt")
+        tts = rec.get("tts") or []
+        for i, tok in enumerate(rec.get("toks", [])):
+            jr.tokens.setdefault(
+                i, (int(tok), tts[i] if i < len(tts) else None))
+        if jr.finish is None:
+            jr.finish = {"reason": rec["reason"],
+                         "err": rec.get("err"),
+                         "n": len(rec.get("toks", [])),
+                         "ts": rec.get("fts")}
+
+
+@dataclass
+class JournalDamage:
+    """Structured damage report for a corrupt journal (what the salvage
+    kept and what it lost) — the payload of :class:`JournalCorrupt`,
+    the ``corrupt`` trace event, and the crash-path manifest's
+    ``damage`` field."""
+
+    path: str
+    #: (1-based line number, reason) per damaged line — every line the
+    #: salvage skipped (the records around them still apply: each line
+    #: authenticates independently)
+    bad_lines: list = field(default_factory=list)
+    #: (rid, first missing token index) per token-index gap — damage
+    #: even in a pre-integrity journal (an interior tok line vanished)
+    gaps: list = field(default_factory=list)
+    #: rids that lost records (bad-line owners where readable, gap
+    #: owners, rids dropped for a rotted submit)
+    affected_rids: list = field(default_factory=list)
+    #: last contiguous token index the salvage kept, per affected rid
+    #: (-1 when nothing of the stream survived)
+    last_good_tok: dict = field(default_factory=dict)
+    total_lines: int = 0
+    salvaged_lines: int = 0
+    #: where the damaged original went (``journal.jsonl.corrupt-<ts>``),
+    #: once :func:`salvage_journal` quarantined it
+    quarantine: Optional[str] = None
+
+    def summary(self) -> dict:
+        """JSON-able form (wire manifests, trace events)."""
+        return {
+            "path": self.path,
+            "bad_lines": [[int(n), why] for n, why in self.bad_lines],
+            "gaps": [[rid, int(i)] for rid, i in self.gaps],
+            "affected_rids": list(self.affected_rids),
+            "last_good_tok": {r: int(i)
+                              for r, i in self.last_good_tok.items()},
+            "total_lines": self.total_lines,
+            "salvaged_lines": self.salvaged_lines,
+            "quarantine": self.quarantine,
+        }
+
+    def __str__(self) -> str:
+        first = self.bad_lines[0] if self.bad_lines else None
+        what = (f"line {first[0]} ({first[1]})" if first
+                else f"token gap {self.gaps[0]}" if self.gaps
+                else "damage")
+        return (f"journal {self.path} corrupt at {what}: salvaged "
+                f"{self.salvaged_lines}/{self.total_lines} lines, "
+                f"{len(self.affected_rids)} request(s) affected "
+                f"({', '.join(self.affected_rids[:4])}"
+                f"{'...' if len(self.affected_rids) > 4 else ''})")
+
+
+def scan_journal(path: str | os.PathLike) \
+        -> tuple[dict[str, JournalRequest], Optional[JournalDamage]]:
+    """Parse a journal into per-request state (submit order) plus a
+    damage report when the file holds more than crash-shaped damage.
+
+    The tolerance contract (pinned by tests): a torn FINAL line — the
+    one shape a crash mid-append leaves — is healed silently, exactly
+    as before.  Everything else is damage, and the salvage keeps every
+    record that still AUTHENTICATES: records are independently
+    CRC-framed and self-describing (explicit token indices,
+    first-submit-wins, idempotent fin/mig receipts), so a rotted line
+    costs exactly the records on that line, not the suffix behind it —
+    at fleet scale the suffix holds migrated-in submits whose prompts
+    exist nowhere else.  A skipped tok line surfaces as a token-index
+    gap (also a pre-integrity journal's only corruption signature) that
+    truncates that rid to its contiguous prefix; a rid whose submit
+    line rotted is dropped from state entirely (its prompt is
+    unrecoverable here).  Both are REPORTED, never silently absorbed.
+    Pre-integrity records (no ``"c"`` field) are accepted unverified —
+    back-compat.  Returns ``({}, None)`` when no journal exists."""
+    out: dict[str, JournalRequest] = {}
+    if not os.path.exists(path):
+        return out, None
+    with open(path, encoding="utf-8") as f:
+        lines = f.readlines()
+    n_content = len(lines)
+    while n_content and not lines[n_content - 1].strip():
+        n_content -= 1  # trailing blank lines are not records
+    bad: list = []
+    affected: list[str] = []
+    salvaged = 0
+    for idx in range(n_content):
+        line = lines[idx].strip()
+        if not line:
+            salvaged += 1
+            continue
+        why = None
+        rec = None
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            why = "undecodable"
+        if rec is not None and rec_crc_ok(rec) is False:
+            why = "crc mismatch"
+        if (why == "undecodable" and idx == n_content - 1
+                and not lines[idx].endswith("\n")):
+            # the torn final line a crash mid-append leaves (buffered
+            # writes land prefixes, so a torn record never has its
+            # newline): healed, not damage.  A newline-TERMINATED
+            # garbage final line — or a CRC mismatch on a parseable
+            # one — is real corruption: a torn write cannot re-close
+            # the framing.
+            break
+        if why is not None:
+            bad.append((idx + 1, why))
+            # best-effort owner classification (report only — a record
+            # that failed its CRC is never applied to state)
+            if rec is not None:
+                rid = rec.get("rid")
+                if rid is not None and rid not in affected:
+                    affected.append(rid)
+            continue
+        _apply_record(out, rec)
+        salvaged += 1
+    damage: Optional[JournalDamage] = None
+    # a rid whose submit line ROTTED leaves orphan tok/fin records with
+    # no prompt to recompute from: drop it from state (a half request
+    # must not reach placement) and report it lost.  Only when damage
+    # was seen — an undamaged journal that opens mid-stream (tok lines
+    # with no submit) is the long-tolerated partial-state shape
+    if bad:
+        for rid in [r for r, jr in out.items()
+                    if jr.prompt is None and not jr.migrated]:
+            del out[rid]
+            if rid not in affected:
+                affected.append(rid)
+    # token-index gaps inside the trusted records: the pre-integrity
+    # corruption signature (a deleted/garbled interior tok line whose
+    # loss JSON alone cannot see) — report it and truncate the stream
+    # to its contiguous prefix instead of silently absorbing it
+    gaps: list = []
+    for rid, jr in out.items():
+        if not jr.tokens:
+            continue
+        contiguous = len(jr.token_list())
+        if max(jr.tokens) + 1 > contiguous:
+            gaps.append((rid, contiguous))
+            jr.tokens = {i: jr.tokens[i] for i in range(contiguous)}
+            if rid not in affected:
+                affected.append(rid)
+    if bad or gaps:
+        damage = JournalDamage(
+            path=os.path.abspath(os.fspath(path)), bad_lines=bad,
+            gaps=gaps, affected_rids=affected,
+            last_good_tok={rid: len(out[rid].token_list()) - 1
+                           if rid in out else -1 for rid in affected},
+            total_lines=n_content, salvaged_lines=salvaged)
+    return out, damage
+
+
 def replay_journal(path: str | os.PathLike) -> dict[str, JournalRequest]:
     """Parse a journal into per-request state, in submit order.
 
     Tolerant of exactly the damage a crash can cause: a torn final line
-    (the process died mid-append) is skipped, and a duplicate record
+    (the process died mid-append) is healed, and a duplicate record
     keeps its first occurrence.  Returns ``{}`` when no journal exists.
-    """
-    out: dict[str, JournalRequest] = {}
-    if not os.path.exists(path):
-        return out
-    with open(path, encoding="utf-8") as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue  # torn by the crash mid-append
-            rid = rec.get("rid")
-            if rid is None:
-                continue
-            jr = out.setdefault(rid, JournalRequest(rid=rid))
-            t = rec.get("t")
-            if t == "submit":
-                if jr.prompt is None:
-                    jr.prompt = np.asarray(rec["prompt"], np.int32)
-                    jr.params = SamplingParams.from_dict(rec["params"])
-                    jr.arrival = rec.get("ts")
-                    jr.slo = rec.get("slo", "interactive")
-                    if jr.first_tok is None:
-                        jr.first_tok = rec.get("ftt")
-                    if jr.trace is None:
-                        jr.trace = rec.get("trace")
-                # a submit AFTER a mig receipt re-opens ownership: the
-                # request was handed off (push/drain) and later
-                # re-admitted HERE (the disagg push fallback path) —
-                # this journal owns its stream again, and a crash must
-                # recover it rather than skip it as migrated
-                jr.migrated = False
-            elif t == "tok":
-                jr.tokens.setdefault(int(rec["i"]),
-                                     (int(rec["tok"]), rec.get("ts")))
-            elif t == "fin" and jr.finish is None:
-                jr.finish = {"reason": rec["reason"],
-                             "err": rec.get("err"),
-                             "n": rec.get("n"), "ts": rec.get("ts")}
-            elif t == "mig":
-                jr.migrated = True
-            elif t == "done":
-                # One-line compacted request (a snapshot-barrier journal
-                # rotation): submit + every tok + fin folded together.
-                if jr.prompt is None:
-                    jr.prompt = np.asarray(rec["prompt"], np.int32)
-                    jr.params = SamplingParams.from_dict(rec["params"])
-                    jr.arrival = rec.get("arrival")
-                    jr.slo = rec.get("slo", "interactive")
-                if jr.first_tok is None:
-                    jr.first_tok = rec.get("ftt")
-                tts = rec.get("tts") or []
-                for i, tok in enumerate(rec.get("toks", [])):
-                    jr.tokens.setdefault(
-                        i, (int(tok), tts[i] if i < len(tts) else None))
-                if jr.finish is None:
-                    jr.finish = {"reason": rec["reason"],
-                                 "err": rec.get("err"),
-                                 "n": len(rec.get("toks", [])),
-                                 "ts": rec.get("fts")}
-    return out
+    ANY other damage — an interior undecodable line, a CRC mismatch, a
+    token-index gap — raises :class:`JournalCorrupt` (carrying the
+    salvaged state + damage report): silent absorption of committed
+    tokens was the bug this layer exists to kill.  Callers that own the
+    directory and can quarantine go through :func:`salvage_journal`."""
+    state, damage = scan_journal(path)
+    if damage is not None:
+        raise JournalCorrupt(damage, state)
+    return state
+
+
+def _serialize_state(state: dict[str, JournalRequest]) -> list[dict]:
+    """Re-serialize replayed state as plain journal records (the
+    salvage writer): submit + contiguous toks + fin/mig per request, in
+    submit order.  Equivalent-for-replay to the damaged journal's
+    surviving records."""
+    recs: list[dict] = []
+    for rid, jr in state.items():
+        if jr.prompt is not None:
+            rec = {"t": "submit", "rid": rid,
+                   "prompt": [int(x) for x in jr.prompt],
+                   "params": jr.params.to_dict(),
+                   "slo": jr.slo, "ts": jr.arrival}
+            if jr.first_tok is not None:
+                rec["ftt"] = jr.first_tok
+            if jr.trace is not None:
+                rec["trace"] = jr.trace
+            recs.append(rec)
+        for i, tok in enumerate(jr.token_list()):
+            recs.append({"t": "tok", "rid": rid, "i": i,
+                         "tok": int(tok), "ts": jr.tokens[i][1]})
+        if jr.finish is not None:
+            recs.append({"t": "fin", "rid": rid,
+                         "reason": jr.finish["reason"],
+                         "err": jr.finish.get("err"),
+                         "n": jr.finish.get("n"),
+                         "ts": jr.finish.get("ts")})
+        if jr.migrated:
+            recs.append({"t": "mig", "rid": rid,
+                         "n": len(jr.token_list()),
+                         "ts": jr.arrival or 0.0})
+    return recs
+
+
+def quarantine_path(path: str) -> str:
+    """The ``<journal>.corrupt-<ts>`` name a damaged original moves to
+    (unique even for same-second salvages)."""
+    base = f"{path}.corrupt-{int(time.time())}"
+    cand, n = base, 0
+    while os.path.exists(cand):
+        n += 1
+        cand = f"{base}.{n}"
+    return cand
+
+
+def salvage_journal(path: str | os.PathLike, *, quarantine: bool = True) \
+        -> tuple[dict[str, JournalRequest], Optional[JournalDamage]]:
+    """Replay ``path`` with salvage semantics: an undamaged (or merely
+    torn-tail) journal returns ``(state, None)`` untouched; a corrupt
+    one QUARANTINES the damaged original (``journal.jsonl.corrupt-<ts>``
+    — evidence survives for the postmortem, and no later writer appends
+    onto rot) and atomically rewrites ``path`` with every record that
+    still authenticates, CRC-framed, before anything else touches it.
+    Returns the salvaged state + the damage report; the caller owns the
+    LOUD part (counter, ``corrupt`` trace event, re-queue escalation)."""
+    state, damage = scan_journal(path)
+    if damage is None:
+        return state, None
+    path = os.path.abspath(os.fspath(path))
+    if quarantine:
+        qp = quarantine_path(path)
+        os.replace(path, qp)
+        damage.quarantine = qp
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for rec in _serialize_state(state):
+                f.write(json.dumps(stamp_crc(rec),
+                                   separators=(",", ":")) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    print(f"[recovery] {damage}"
+          + (f"; original quarantined at {damage.quarantine}"
+             if damage.quarantine else ""), file=sys.stderr)
+    return state, damage
 
 
 # ---------------------------------------------------------------------------
@@ -582,6 +877,27 @@ def snapshot_engine(engine, directory: str | os.PathLike) -> dict:
     meta = _capture_meta(engine, now, journal_here=journal_here)
     if engine.faults is not None:
         engine.faults.fire("snapshot")
+    tree = _pool_tree(engine)
+    # Leaf digests + manifest self-digest (docs/serving.md "Durability
+    # & integrity"): meta.json records a CRC32 per pool leaf and one
+    # over itself, computed from the in-memory arrays BEFORE the bytes
+    # hit disk — restore verifies against exactly what the engine
+    # meant to persist, so stored-byte rot can never restore as
+    # subtly-wrong KV.
+    meta["digests"] = {
+        name: crc32_bytes(np.ascontiguousarray(
+            np.asarray(arr)).tobytes())
+        for name, arr in tree.items()}
+    meta[META_CRC] = canonical_crc(meta, exclude=(META_CRC,))
+    if engine.faults is not None:
+        # integrity chaos, the SILENT-rot class: damage one leaf after
+        # its digest was recorded and before the bytes hit disk.  The
+        # published checkpoint is internally valid (tensorstore's own
+        # framing CRC passes, orbax restores it without complaint) —
+        # only the meta.json leaf digests can refuse it at restore.
+        act = engine.faults.fire("integrity", op="snapshot")
+        if act in CORRUPT_ACTIONS:
+            _corrupt_pool_leaf(tree, act)
     # The home-directory manager is cached on the engine: its init
     # scans the directory (stale-.tmp GC + cross-host sync) — once is
     # enough on the periodic capture path that snapshot_ms meters.  A
@@ -599,14 +915,14 @@ def snapshot_engine(engine, directory: str | os.PathLike) -> dict:
             engine._snap_mgr = mgr
     hook = None
     if engine.faults is not None:
-        def hook(tmp_path, _f=engine.faults):
+        def hook(_tmp_path, _f=engine.faults):
             _f.fire("snapshot")
     if home:
         step = engine._snap_seq
     else:
         last = mgr.latest_step()
         step = 0 if last is None else last + 1
-    mgr.save(step, _pool_tree(engine),
+    mgr.save(step, tree,
              extras={META_NAME: json.dumps(meta)},
              on_before_finalize=hook)
     if home:
@@ -617,6 +933,102 @@ def snapshot_engine(engine, directory: str | os.PathLike) -> dict:
     m.snapshot_ms_last = ms
     m.snapshot_ms_total += ms
     return {"step": step, "ms": ms}
+
+
+def _corrupt_pool_leaf(tree: dict, action: str) -> Optional[str]:
+    """Rot one pool leaf IN MEMORY (the ``op="snapshot"`` integrity
+    seam): picks the largest leaf, corrupts its bytes, and rebuilds it
+    at the original shape/dtype (truncation zero-fills the tail) so
+    the checkpoint write itself succeeds.  Because the rot lands after
+    the digest was recorded and before serialization, the stored step
+    is internally valid — only the restore-time digest check can catch
+    it.  Returns the rotted leaf name."""
+    if not tree:
+        return None
+    name = max(sorted(tree),
+               key=lambda n: np.asarray(tree[n]).nbytes)
+    arr = np.ascontiguousarray(np.asarray(tree[name]))
+    raw = arr.tobytes()
+    rot = (corrupt_bytes(raw, action) + b"\x00" * len(raw))[:len(raw)]
+    tree[name] = np.frombuffer(rot, dtype=arr.dtype).reshape(arr.shape)
+    return name
+
+
+def _corrupt_snapshot_leaf(step_dir: str, action: str) -> Optional[str]:
+    """Damage the largest READ-PATH data file under a published
+    ``step_dir`` (test/fsck utility for the on-disk rot class).  The
+    per-process OCDBT staging copies (``ocdbt.process_*``) are skipped
+    — restore never reads them, so damage there is invisible.  Note
+    tensorstore frames its b-tree nodes with its own CRC-32C, so this
+    class surfaces as a restore ERROR (torn-snapshot fallback), not as
+    silently-wrong values — the in-memory seam above is what exercises
+    the digest check.  Returns the damaged path."""
+    best, size = None, -1
+    for root, dirs, files in os.walk(step_dir):
+        dirs[:] = [d for d in dirs if not d.startswith("ocdbt.process")]
+        for name in files:
+            if name.endswith(".json"):
+                continue
+            p = os.path.join(root, name)
+            s = os.path.getsize(p)
+            if s > size:
+                best, size = p, s
+    if best is None:
+        return None
+    with open(best, "rb") as f:
+        data = f.read()
+    with open(best, "wb") as f:
+        f.write(corrupt_bytes(data, action))
+    return best
+
+
+def verify_snapshot_step(step_dir: str | os.PathLike) -> list[dict]:
+    """Offline digest verification of one published snapshot step (the
+    ``scripts/serve_fsck.py`` core): returns per-artifact findings
+    ``{"artifact", "ok", "why"}`` — meta.json's self-digest first, then
+    every pool leaf against its recorded digest.  A pre-integrity
+    snapshot (no digests) reports a single unverified finding."""
+    step_dir = os.path.abspath(os.fspath(step_dir))
+    out: list[dict] = []
+    meta_path = os.path.join(step_dir, META_NAME)
+    try:
+        with open(meta_path, encoding="utf-8") as f:
+            meta = json.load(f)
+    except Exception as e:  # noqa: BLE001 — unreadable IS the finding
+        return [{"artifact": meta_path, "ok": False,
+                 "why": f"unreadable: {e}"}]
+    mc = meta.get(META_CRC)
+    if mc is None:
+        return [{"artifact": meta_path, "ok": True,
+                 "why": "pre-integrity snapshot (no digests): "
+                        "unverified"}]
+    if int(mc) != canonical_crc(meta, exclude=(META_CRC,)):
+        return [{"artifact": meta_path, "ok": False,
+                 "why": "meta.json self-digest mismatch"}]
+    out.append({"artifact": meta_path, "ok": True, "why": "digest ok"})
+    digs = meta.get("digests") or {}
+    try:
+        like = _abstract_pool_tree(meta)
+        pools = ck.restore(step_dir, like)
+    except Exception as e:  # noqa: BLE001 — unreadable IS the finding
+        out.append({"artifact": step_dir, "ok": False,
+                    "why": f"pool tree unreadable: {e}"})
+        return out
+    for name in sorted(like):
+        want = digs.get(name)
+        got = crc32_bytes(np.ascontiguousarray(
+            np.asarray(pools[name])).tobytes())
+        if want is None:
+            out.append({"artifact": f"{step_dir}:{name}", "ok": False,
+                        "why": "no recorded digest for leaf"})
+        elif int(want) != got:
+            out.append({"artifact": f"{step_dir}:{name}", "ok": False,
+                        "why": f"leaf digest mismatch "
+                               f"(recorded {want}, stored {got})"})
+        else:
+            out.append({"artifact": f"{step_dir}:{name}", "ok": True,
+                        "why": "digest ok"})
+    return out
 
 
 def has_restorable_state(directory: str | os.PathLike) -> bool:
@@ -635,6 +1047,48 @@ def has_restorable_state(directory: str | os.PathLike) -> bool:
     return any(name.isdigit() for name in os.listdir(kvdir))
 
 
+def _abstract_pool_tree(meta: dict) -> dict:
+    """ShapeDtypeStruct targets for a snapshot manifest's pool tree —
+    the reader-side twin of :func:`_pool_tree` (shared by restore and
+    the offline fsck verifier)."""
+    e = meta["engine"]
+    dtype = np.dtype(e["kv_dtype"])
+    shape = (e["num_blocks"], e["n_kv_heads"], e["page_size"],
+             e["head_dim"])
+    like = {}
+    if e.get("kv_quant"):
+        s_shape = shape[:3]
+        for i in range(e["n_layers"]):
+            for kv in ("k", "v"):
+                like[f"l{i}_{kv}_q"] = jax.ShapeDtypeStruct(
+                    shape, np.int8)
+                like[f"l{i}_{kv}_s"] = jax.ShapeDtypeStruct(
+                    s_shape, np.float32)
+    else:
+        for i in range(e["n_layers"]):
+            like[f"l{i}_k"] = jax.ShapeDtypeStruct(shape, dtype)
+            like[f"l{i}_v"] = jax.ShapeDtypeStruct(shape, dtype)
+    d = e.get("draft")
+    if e.get("spec_k") and d and "vocab" in e:
+        # Spec snapshots carry the draft's device state in the
+        # same tree (see _pool_tree); the manifest's draft
+        # geometry shapes the abstract targets.  Pre-PR-7
+        # manifests lack "draft" and restore pools-only.
+        ddt = np.dtype(d["dtype"])
+        dshape = (e["max_batch"], d["n_kv_heads"], d["max_seq"],
+                  d["head_dim"])
+        for i in range(d["n_layers"]):
+            like[f"d{i}_k"] = jax.ShapeDtypeStruct(dshape, ddt)
+            like[f"d{i}_v"] = jax.ShapeDtypeStruct(dshape, ddt)
+        like["draft_kv_lens"] = jax.ShapeDtypeStruct(
+            (e["max_batch"],), np.int32)
+        like["draft_last_logits"] = jax.ShapeDtypeStruct(
+            (e["max_batch"], d["vocab"]), np.float32)
+        like["spec_last_logits"] = jax.ShapeDtypeStruct(
+            (e["max_batch"], e["vocab"]), np.float32)
+    return like
+
+
 def _load_latest_snapshot(directory: str) -> Optional[tuple]:
     """(step, meta, pools dict) for the newest READABLE snapshot, or
     None.  Walks newest → oldest like ``restore_latest`` — a snapshot
@@ -643,7 +1097,16 @@ def _load_latest_snapshot(directory: str) -> Optional[tuple]:
     another process is mid-snapshot (a standby peeking at a live
     engine's directory), and GC-ing ``.tmp`` here would tear that
     writer's save; orphans are reclaimed by the next WRITER instead
-    (the restored engine's first snapshot)."""
+    (the restored engine's first snapshot).
+
+    Digest verification (docs/serving.md "Durability & integrity"):
+    a snapshot whose meta.json self-digest or pool-leaf digest
+    mismatches raises :class:`SnapshotCorrupt` LOUDLY, naming the bad
+    leaf — it never joins the torn-write fallback walk, because orbax
+    restores a flipped bit without complaint and walking past would
+    either adopt subtly-wrong KV or silently resume from stale state.
+    Pre-integrity snapshots (no digests) restore with a one-line
+    unverified warning."""
     kvdir = os.path.join(directory, KV_SUBDIR)
     if not os.path.isdir(kvdir):
         return None
@@ -663,46 +1126,37 @@ def _load_latest_snapshot(directory: str) -> Optional[tuple]:
             raise ValueError(
                 f"snapshot {step_dir} has format {meta.get('format')}; "
                 f"this build reads format {SNAPSHOT_FORMAT}")
+        mc = meta.get(META_CRC)
+        if mc is not None and int(mc) != canonical_crc(
+                meta, exclude=(META_CRC,)):
+            raise SnapshotCorrupt(
+                f"snapshot {step_dir}: meta.json self-digest mismatch "
+                f"— refusing to adopt; quarantine the step "
+                f"(scripts/serve_fsck.py --salvage) to restore from an "
+                f"older snapshot + the journal")
         try:
-            e = meta["engine"]
-            dtype = np.dtype(e["kv_dtype"])
-            shape = (e["num_blocks"], e["n_kv_heads"], e["page_size"],
-                     e["head_dim"])
-            like = {}
-            if e.get("kv_quant"):
-                s_shape = shape[:3]
-                for i in range(e["n_layers"]):
-                    for kv in ("k", "v"):
-                        like[f"l{i}_{kv}_q"] = jax.ShapeDtypeStruct(
-                            shape, np.int8)
-                        like[f"l{i}_{kv}_s"] = jax.ShapeDtypeStruct(
-                            s_shape, np.float32)
-            else:
-                for i in range(e["n_layers"]):
-                    like[f"l{i}_k"] = jax.ShapeDtypeStruct(shape, dtype)
-                    like[f"l{i}_v"] = jax.ShapeDtypeStruct(shape, dtype)
-            d = e.get("draft")
-            if e.get("spec_k") and d and "vocab" in e:
-                # Spec snapshots carry the draft's device state in the
-                # same tree (see _pool_tree); the manifest's draft
-                # geometry shapes the abstract targets.  Pre-PR-7
-                # manifests lack "draft" and restore pools-only.
-                ddt = np.dtype(d["dtype"])
-                dshape = (e["max_batch"], d["n_kv_heads"], d["max_seq"],
-                          d["head_dim"])
-                for i in range(d["n_layers"]):
-                    like[f"d{i}_k"] = jax.ShapeDtypeStruct(dshape, ddt)
-                    like[f"d{i}_v"] = jax.ShapeDtypeStruct(dshape, ddt)
-                like["draft_kv_lens"] = jax.ShapeDtypeStruct(
-                    (e["max_batch"],), np.int32)
-                like["draft_last_logits"] = jax.ShapeDtypeStruct(
-                    (e["max_batch"], d["vocab"]), np.float32)
-                like["spec_last_logits"] = jax.ShapeDtypeStruct(
-                    (e["max_batch"], e["vocab"]), np.float32)
+            like = _abstract_pool_tree(meta)
             pools = ck.restore(step_dir, like)
-            return step, meta, pools
         except Exception:  # noqa: BLE001 — torn snapshot: fall back
             continue
+        digs = meta.get("digests")
+        if digs is None:
+            print(f"[recovery] snapshot {step_dir} predates leaf "
+                  f"digests: restoring unverified", file=sys.stderr)
+        else:
+            for name in sorted(like):
+                got = crc32_bytes(np.ascontiguousarray(
+                    np.asarray(pools[name])).tobytes())
+                want = digs.get(name)
+                if want is None or int(want) != got:
+                    raise SnapshotCorrupt(
+                        f"snapshot {step_dir}: pool leaf {name!r} "
+                        f"digest mismatch (recorded {want}, stored "
+                        f"{got}) — refusing to adopt corrupt KV; "
+                        f"quarantine the step (scripts/serve_fsck.py "
+                        f"--salvage) to restore from an older "
+                        f"snapshot + the journal")
+        return step, meta, pools
     return None
 
 
@@ -757,7 +1211,11 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
 
     directory = os.path.abspath(os.fspath(directory))
     snap = _load_latest_snapshot(directory)
-    journal = replay_journal(os.path.join(directory, JOURNAL_NAME))
+    # Salvage, don't just replay: interior journal corruption quarantines
+    # the damaged file and resumes from the records that still verify —
+    # the snapshot manifest + fleet delivery record reconcile anything
+    # the salvage lost (see the merge below and fleet._absorb_manifest).
+    journal, jdamage = salvage_journal(os.path.join(directory, JOURNAL_NAME))
     if snap is None and not journal:
         raise FileNotFoundError(
             f"no restorable snapshot or journal under {directory}")
@@ -791,7 +1249,7 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
     engine.journal_rotate_bytes = journal_rotate_bytes
     engine._journal = TokenJournal(
         os.path.join(directory, JOURNAL_NAME), fsync=journal_fsync,
-        fsync_interval_s=journal_fsync_interval_s)
+        fsync_interval_s=journal_fsync_interval_s, faults=faults)
     if meta is not None:
         engine._snap_seq = step + 1
         engine._spec_off = bool(meta.get("spec_off", False))
@@ -1284,6 +1742,10 @@ def restore_engine(directory: str | os.PathLike, gen, params, *,
     # is an event: a later postmortem shows the lineage.
     if meta is not None and meta.get("flight"):
         engine.trace.seed(meta["flight"])
+    if jdamage is not None:
+        m.journal_corrupt += 1
+        engine.trace.emit("corrupt", None, artifact="journal",
+                          **jdamage.summary())
     engine.trace.emit("restore", None, in_place=m.restored_in_place,
                       requeued=m.restored_requeued,
                       tokens=m.restored_tokens)
@@ -1353,7 +1815,11 @@ def manifest_from_journal(directory: str | os.PathLike, *,
         # this when a child is killed before the engine exists).
         return {"format": MANIFEST_FORMAT, "clock": 0.0,
                 "requests": [], "finished": []}
-    journal = replay_journal(os.path.join(directory, JOURNAL_NAME))
+    # The replica is already dead — corruption here must not kill the
+    # crash path too.  Salvage the longest-valid prefix and carry the
+    # damage report in the manifest so the controller can reconcile the
+    # lost tail against its delivery record (fleet._absorb_manifest).
+    journal, jdamage = salvage_journal(os.path.join(directory, JOURNAL_NAME))
     # per-rid event tails from the dead life's postmortem flush (best
     # effort: a SIGKILL with no flush just means no carried events)
     tails: dict[str, list] = {}
@@ -1411,12 +1877,16 @@ def manifest_from_journal(directory: str | os.PathLike, *,
             j.sync()
         finally:
             j.close()
-    return {"format": MANIFEST_FORMAT, "clock": old_now,
-            "requests": reqs, "finished": finished}
+    out = {"format": MANIFEST_FORMAT, "clock": old_now,
+           "requests": reqs, "finished": finished}
+    if jdamage is not None:
+        out["damage"] = jdamage.summary()
+    return out
 
 
 def save_manifest(manifest: dict, path: str | os.PathLike) -> str:
-    """Write a manifest as JSON (atomic tmp + rename) — the subprocess
+    """Write a manifest as JSON (atomic tmp + rename + whole-document
+    digest, via :func:`integrity.atomic_write_json`) — the subprocess
     hand-off format (``examples/serve.py --migrate-in``).  KV payloads
     are dropped: the JSON manifest is the journal-segment crash path,
     and the target replays through exact recompute."""
@@ -1425,13 +1895,7 @@ def save_manifest(manifest: dict, path: str | os.PathLike) -> str:
     doc["requests"] = [{k: v for k, v in r.items() if k not in
                         ("kv", "kv_len", "pending", "s_ext")}
                        for r in manifest.get("requests", [])]
-    tmp = path + ".tmp"
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(doc, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
-    return path
+    return atomic_write_json(path, doc)
 
 
 def load_manifest(path: str | os.PathLike) -> dict:
@@ -1440,4 +1904,11 @@ def load_manifest(path: str | os.PathLike) -> dict:
     if m.get("format") != MANIFEST_FORMAT:
         raise ValueError(f"manifest {path} has format {m.get('format')}; "
                          f"this build reads format {MANIFEST_FORMAT}")
+    # Pre-integrity manifests carry no digest (tri-state None passes).
+    if verify_json_doc(m) is False:
+        raise ValueError(
+            f"manifest {path}: whole-document digest mismatch — the "
+            f"file is corrupt; regenerate it from the source journal "
+            f"(manifest_from_journal) or scripts/serve_fsck.py")
+    m.pop(DOC_CRC, None)
     return m

@@ -98,6 +98,12 @@ EVENT_TYPES = frozenset({
     "brownout",       # engine ladder moved a rung (data: rung, prev)
     "scale",          # fleet autoscaler spawned/retired a replica
     "ingress_shed",   # fleet token-bucket refused a request at the door
+    # state integrity (docs/serving.md "Durability & integrity"): a
+    # durable or wire artifact FAILED verification — journal interior
+    # corruption salvaged + quarantined at restore, a snapshot leaf
+    # digest mismatch, or a wire manifest rejected by its receiver.
+    # Data names the artifact class and what the salvage kept/lost.
+    "corrupt",        # artifact integrity check failed (never adopted)
 })
 
 #: FinishReason values the ``retire`` event is specified over — the
@@ -124,6 +130,11 @@ FAULT_POINT_EVENTS = {
     "net": "fault",           # network serving plane seams (serve/net.py:
                               # client send, server receive, server
                               # respond — drop/delay/duplicate/partition)
+    "integrity": "fault",     # artifact corruption seams (journal-line
+                              # append, snapshot tmp-dir leaf, wire
+                              # manifest blob — bitflip/truncate/zero);
+                              # the DETECTION lands as a "corrupt" event
+                              # on whichever surface caught it
 }
 
 #: pid the engine timeline claims in exported Chrome traces.  Below the
@@ -695,14 +706,18 @@ class FlightRecorder:
         }
         if extra:
             doc.update(extra)
-        with open(path, "w") as f:
-            json.dump(doc, f, default=str)
-            f.flush()
-            try:
-                os.fsync(f.fileno())
-            except OSError:
-                pass
-        return path
+        from triton_dist_tpu.serve.integrity import atomic_write_json
+        # JSON-safe normalization first (ring events may carry numpy
+        # scalars etc. — the old ``default=str`` behavior), then the
+        # shared digest-stamping atomic writer: the postmortem file is
+        # read back on the crash path (manifest_from_journal's event
+        # tails), so it gets the same integrity framing as every other
+        # durable serving artifact.
+        doc = json.loads(json.dumps(doc, default=str))
+        try:
+            return atomic_write_json(path, doc)
+        except OSError:
+            return path  # best-effort durable, as before
 
 
 def write_trace(doc: dict, path: str) -> str:
@@ -722,9 +737,18 @@ def write_trace(doc: dict, path: str) -> str:
 
 
 def load_flight(path: str) -> dict:
-    """Read a :meth:`FlightRecorder.flush` postmortem file."""
+    """Read a :meth:`FlightRecorder.flush` postmortem file.  Raises
+    :class:`ValueError` on a whole-document digest mismatch (readers on
+    the crash path already treat an unreadable flight file as
+    best-effort-absent); pre-integrity files carry no digest and load
+    unverified."""
+    from triton_dist_tpu.serve.integrity import DOC_CRC, verify_json_doc
     with open(path) as f:
-        return json.load(f)
+        doc = json.load(f)
+    if verify_json_doc(doc) is False:
+        raise ValueError(f"flight file {path}: digest mismatch")
+    doc.pop(DOC_CRC, None)
+    return doc
 
 
 def latest_flight(directory: str) -> Optional[str]:
